@@ -1,0 +1,264 @@
+"""Typed configuration registry.
+
+Re-designs the reference's config system for a single-process TPU runtime:
+Auron has engine-agnostic `ConfigOption<T>` (auron-core/.../ConfigOption.java,
+AuronConfiguration.java:26-63) bound to Spark via `SparkAuronConfiguration`
+(73 `spark.auron.*` options) and read natively over JNI by reflected static
+field name (native-engine/auron-jni-bridge/src/conf.rs:20-63).  Here the
+registry is process-local: typed options with defaults, environment-variable
+fallback (`AURON_TPU_*`), and programmatic override, readable from both the
+Python runtime and (by name) the C++ host runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def _env_key(key: str) -> str:
+    return "AURON_TPU_" + key.upper().replace(".", "_")
+
+
+@dataclass(frozen=True)
+class ConfigOption(Generic[T]):
+    """A typed config option (analogue of auron-core ConfigOption.java)."""
+
+    key: str
+    default: T
+    type: type
+    doc: str = ""
+    session_settable: bool = True  # analogue of SQLConfOption
+
+    def parse(self, raw: str) -> T:
+        if self.type is bool:
+            return raw.strip().lower() in ("1", "true", "yes", "on")  # type: ignore[return-value]
+        return self.type(raw)  # type: ignore[call-arg]
+
+
+class Configuration:
+    """Mutable view over the registry with env fallback and overrides."""
+
+    def __init__(self) -> None:
+        self._options: Dict[str, ConfigOption[Any]] = {}
+        self._overrides: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+
+    def register(self, option: ConfigOption[T]) -> ConfigOption[T]:
+        with self._lock:
+            if option.key in self._options:
+                raise ValueError(f"duplicate config option {option.key!r}")
+            self._options[option.key] = option
+        return option
+
+    def define(self, key: str, default: T, doc: str = "", **kw: Any) -> ConfigOption[T]:
+        return self.register(
+            ConfigOption(key=key, default=default, type=type(default), doc=doc, **kw)
+        )
+
+    def get(self, key: str) -> Any:
+        opt = self._options[key]
+        with self._lock:
+            if key in self._overrides:
+                return self._overrides[key]
+        raw = os.environ.get(_env_key(key))
+        if raw is not None:
+            return opt.parse(raw)
+        return opt.default
+
+    def set(self, key: str, value: Any) -> None:
+        opt = self._options[key]
+        if not opt.session_settable:
+            raise ValueError(f"config option {key!r} is not session-settable")
+        if value is not None:
+            # strings from a front-end conf map go through the parser so that
+            # e.g. "false" disables a bool option instead of bool("false")
+            value = opt.parse(value) if isinstance(value, str) and opt.type is not str \
+                else opt.type(value)
+        with self._lock:
+            self._overrides[key] = value
+
+    def unset(self, key: str) -> None:
+        with self._lock:
+            self._overrides.pop(key, None)
+
+    def options(self) -> List[ConfigOption[Any]]:
+        return sorted(self._options.values(), key=lambda o: o.key)
+
+    def generate_doc(self) -> str:
+        """Markdown config reference (analogue of
+        SparkAuronConfigurationDocGenerator.java)."""
+        lines = ["| Key | Type | Default | Description |", "|---|---|---|---|"]
+        for o in self.options():
+            lines.append(f"| `{o.key}` | {o.type.__name__} | `{o.default!r}` | {o.doc} |")
+        return "\n".join(lines)
+
+    class _Scoped:
+        def __init__(self, conf: "Configuration", kv: Dict[str, Any]):
+            self._conf, self._kv = conf, kv
+            self._saved: Dict[str, Any] = {}
+
+        def __enter__(self):
+            try:
+                for k, v in self._kv.items():
+                    with self._conf._lock:
+                        self._saved[k] = self._conf._overrides.get(k, _MISSING)
+                    self._conf.set(k, v)
+            except Exception:
+                self.__exit__()  # roll back keys applied before the failure
+                raise
+            return self._conf
+
+        def __exit__(self, *exc):
+            for k, old in self._saved.items():
+                with self._conf._lock:
+                    if old is _MISSING:
+                        self._conf._overrides.pop(k, None)
+                    else:
+                        self._conf._overrides[k] = old
+            return False
+
+    def scoped(self, kv: Optional[Dict[str, Any]] = None,
+               **kv_underscored: Any) -> "Configuration._Scoped":
+        """Temporarily override options.
+
+        Pass a dict of dotted keys positionally, or kwargs where single `_`
+        stands for `.` (option keys themselves never contain underscores):
+        `conf.scoped(auron_batch_size=1024)`.
+        """
+        merged = dict(kv or {})
+        merged.update({k.replace("_", "."): v for k, v in kv_underscored.items()})
+        return Configuration._Scoped(self, merged)
+
+
+_MISSING = object()
+
+conf = Configuration()
+
+# ---------------------------------------------------------------------------
+# Core engine options (names parallel spark.auron.* semantics, TPU-adapted).
+# ---------------------------------------------------------------------------
+
+BATCH_SIZE = conf.define(
+    "auron.batch.size", 8192, "Target rows per columnar batch fed to jitted kernels."
+)
+BATCH_CAPACITY_MIN = conf.define(
+    "auron.batch.capacity.min", 1024,
+    "Smallest padded batch capacity bucket (capacities are powers of two to bound "
+    "XLA recompilation).",
+)
+SUGGESTED_BATCH_MEM_SIZE = conf.define(
+    "auron.suggested.batch.mem.size", 8 << 20,
+    "Target in-memory bytes per batch (analogue of datafusion-ext-commons "
+    "suggested_batch_mem_size, lib.rs:74-100).",
+)
+SUGGESTED_BATCH_MEM_SIZE_KWAY_MERGE = conf.define(
+    "auron.suggested.batch.mem.size.kway.merge", 1 << 20,
+    "Smaller batch byte target while k-way merging spills.",
+)
+MEMORY_FRACTION = conf.define(
+    "auron.memory.fraction", 0.6,
+    "Fraction of the per-device HBM budget the memory manager hands to consumers.",
+)
+MEMORY_BUDGET_BYTES = conf.define(
+    "auron.memory.budget.bytes", 0,
+    "Absolute memory budget override in bytes; 0 = derive from device memory "
+    "and auron.memory.fraction.",
+)
+SPILL_COMPRESSION_CODEC = conf.define(
+    "auron.spill.compression.codec", "zstd", "Codec for spill files: zstd|zlib|none."
+)
+SPILL_DIR = conf.define(
+    "auron.spill.dir", "", "Directory for spill files ('' = system temp dir)."
+)
+SHUFFLE_COMPRESSION_CODEC = conf.define(
+    "auron.shuffle.compression.codec", "zstd", "Codec for shuffle blocks."
+)
+SMJ_FALLBACK_ENABLE = conf.define(
+    "auron.smj.fallback.enable", True,
+    "Allow broadcast joins to fall back to sort-merge join when the build side "
+    "exceeds its memory budget (reference: SMJ_FALLBACK_* conf.rs).",
+)
+SMJ_FALLBACK_ROWS_THRESHOLD = conf.define(
+    "auron.smj.fallback.rows.threshold", 10_000_000,
+    "Build-side row threshold beyond which BHJ falls back to SMJ.",
+)
+SMJ_FALLBACK_MEM_SIZE_THRESHOLD = conf.define(
+    "auron.smj.fallback.mem.size.threshold", 1 << 30,
+    "Build-side byte threshold beyond which BHJ falls back to SMJ.",
+)
+PARTIAL_AGG_SKIPPING_ENABLE = conf.define(
+    "auron.partial.agg.skipping.enable", True,
+    "Skip partial aggregation when cardinality reduction is poor "
+    "(reference: agg_ctx.rs:63-66).",
+)
+PARTIAL_AGG_SKIPPING_RATIO = conf.define(
+    "auron.partial.agg.skipping.ratio", 0.999,
+    "Unique-groups/rows ratio above which partial agg passes rows through.",
+)
+PARTIAL_AGG_SKIPPING_MIN_ROWS = conf.define(
+    "auron.partial.agg.skipping.min.rows", 20480,
+    "Do not consider partial-agg skipping before this many input rows.",
+)
+PARQUET_ENABLE_PAGE_FILTERING = conf.define(
+    "auron.parquet.enable.page.filtering", True,
+    "Apply predicate pushdown (row-group/page pruning) in the Parquet scan.",
+)
+PARQUET_ENABLE_BLOOM_FILTER = conf.define(
+    "auron.parquet.enable.bloom.filter", True,
+    "Use Parquet bloom filters when pruning row groups.",
+)
+IGNORE_CORRUPTED_FILES = conf.define(
+    "auron.ignore.corrupted.files", False,
+    "Tolerate unreadable input splits (reference conf.rs:38).",
+)
+UDF_FALLBACK_ENABLE = conf.define(
+    "auron.udf.fallback.enable", True,
+    "Evaluate unconvertible expressions via the host-python UDF wrapper "
+    "(analogue of SparkUDFWrapperExpr).",
+)
+TOKIO_WORKER_THREADS_PER_CPU = conf.define(
+    "auron.host.io.threads", 4,
+    "Host IO/prefetch thread count (reference rt.rs:107-111 sizes a per-task "
+    "tokio pool; here it sizes the native host thread pool).",
+)
+CASE_SENSITIVE = conf.define(
+    "auron.case.sensitive", False, "Case sensitivity for column resolution."
+)
+ENABLE_METRICS = conf.define("auron.metrics.enable", True, "Collect operator metrics.")
+FORCE_SHUFFLED_HASH_JOIN = conf.define(
+    "auron.force.shuffled.hash.join", False,
+    "Prefer shuffled-hash-join over sort-merge-join when both are legal "
+    "(reference: ForceApplyShuffledHashJoinInjector).",
+)
+ON_HEAP_SPILL = conf.define(
+    "auron.spill.host.memory.first", True,
+    "Spill device memory to pinned host RAM before falling back to files "
+    "(analogue of OnHeapSpill vs FileSpill, auron-memmgr/src/spill.rs).",
+)
+NATIVE_LIB_ENABLE = conf.define(
+    "auron.native.enable", True,
+    "Use the C++ host runtime (libauron_host.so) when built; pure-python "
+    "fallbacks are used otherwise.",
+)
+STRING_WIDTH_BUCKETS = conf.define(
+    "auron.string.width.buckets", "8,16,32,64,128,256",
+    "Fixed string byte-widths used for device string columns.",
+)
+DEVICE_STRING_MAX_WIDTH = conf.define(
+    "auron.string.device.max.width", 256,
+    "Strings longer than this stay host-resident (hybrid execution).",
+)
+
+# per-operator enable switches (reference: SparkAuronConfiguration:312-496)
+for _op in (
+    "project", "filter", "sort", "agg", "limit", "union", "expand", "window",
+    "generate", "parquet.scan", "orc.scan", "parquet.sink", "orc.sink",
+    "shuffle", "smj", "shj", "bhj", "ffi.reader", "coalesce.batches",
+    "rename.columns", "empty.partitions", "debug", "kafka.scan",
+):
+    conf.define(f"auron.enable.{_op}", True, f"Enable native {_op} operator.")

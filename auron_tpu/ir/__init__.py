@@ -1,0 +1,16 @@
+"""Plan/expression IR — the wire format of the framework.
+
+Analogue of the reference's auron-planner crate: auron.proto defines a
+27-node `PhysicalPlanNode` oneof, a `PhysicalExprNode` with ~35 expr kinds,
+a ~75-entry `ScalarFunction` enum and a `TaskDefinition`
+(native-engine/auron-planner/proto/auron.proto:27-57,60-127,214-294,798-813).
+Here the IR is a set of frozen dataclasses with a canonical dict/JSON/binary
+serde (auron_tpu.ir.serde) that a front-end (e.g. a JVM plan translator)
+can target.
+"""
+
+from auron_tpu.ir.schema import DataType, Field, Schema, TypeId
+from auron_tpu.ir import expr as exprs
+from auron_tpu.ir import plan as plans
+
+__all__ = ["DataType", "Field", "Schema", "TypeId", "exprs", "plans"]

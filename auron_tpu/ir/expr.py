@@ -1,0 +1,323 @@
+"""Physical expression IR nodes.
+
+Parity surface: the reference's `PhysicalExprNode` oneof
+(auron.proto:60-127): column/literal/bound-reference, binary, agg, null
+checks, case/cast/try_cast, sort, negative, in-list, scalar function, like,
+short-circuit and/or, UDF wrapper, scalar-subquery wrapper,
+get_indexed_field, get_map_value, named_struct, string starts/ends/contains,
+row_num, partition id, monotonically_increasing_id,
+bloom_filter_might_contain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, Optional, Tuple
+
+from auron_tpu.ir.node import Node, register
+from auron_tpu.ir.schema import DataType
+
+
+@dataclass(frozen=True)
+class Expr(Node):
+    kind: ClassVar[str] = "expr"
+
+
+@register
+@dataclass(frozen=True)
+class Column(Expr):
+    """Column reference by name (resolved against input schema at compile)."""
+    kind: ClassVar[str] = "column"
+    name: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class BoundReference(Expr):
+    """Column reference by ordinal (already resolved)."""
+    kind: ClassVar[str] = "bound_reference"
+    index: int = 0
+
+
+@register
+@dataclass(frozen=True)
+class Literal(Expr):
+    kind: ClassVar[str] = "literal"
+    value: Any = None
+    dtype: DataType = field(default_factory=DataType.null)
+
+
+@register
+@dataclass(frozen=True)
+class BinaryExpr(Expr):
+    """op in {+,-,*,/,%,==,!=,<,<=,>,>=,and,or,&,|,^,<<,>>}."""
+    kind: ClassVar[str] = "binary"
+    left: Expr = None  # type: ignore[assignment]
+    op: str = "+"
+    right: Expr = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class IsNull(Expr):
+    kind: ClassVar[str] = "is_null"
+    child: Expr = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class IsNotNull(Expr):
+    kind: ClassVar[str] = "is_not_null"
+    child: Expr = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class Not(Expr):
+    kind: ClassVar[str] = "not"
+    child: Expr = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class Negative(Expr):
+    kind: ClassVar[str] = "negative"
+    child: Expr = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class Cast(Expr):
+    """Spark-semantics cast (overflow wraps for integral, invalid => null)."""
+    kind: ClassVar[str] = "cast"
+    child: Expr = None  # type: ignore[assignment]
+    dtype: DataType = field(default_factory=DataType.null)
+
+
+@register
+@dataclass(frozen=True)
+class TryCast(Expr):
+    kind: ClassVar[str] = "try_cast"
+    child: Expr = None  # type: ignore[assignment]
+    dtype: DataType = field(default_factory=DataType.null)
+
+
+@register
+@dataclass(frozen=True)
+class WhenThen(Node):
+    kind: ClassVar[str] = "when_then"
+    when: Expr = None  # type: ignore[assignment]
+    then: Expr = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class Case(Expr):
+    kind: ClassVar[str] = "case"
+    branches: Tuple[WhenThen, ...] = ()
+    else_expr: Optional[Expr] = None
+
+
+@register
+@dataclass(frozen=True)
+class InList(Expr):
+    kind: ClassVar[str] = "in_list"
+    child: Expr = None  # type: ignore[assignment]
+    values: Tuple[Expr, ...] = ()
+    negated: bool = False
+
+
+@register
+@dataclass(frozen=True)
+class ScalarFunctionCall(Expr):
+    kind: ClassVar[str] = "scalar_function"
+    name: str = ""
+    args: Tuple[Expr, ...] = ()
+    return_type: DataType = field(default_factory=DataType.null)
+
+
+@register
+@dataclass(frozen=True)
+class Like(Expr):
+    kind: ClassVar[str] = "like"
+    child: Expr = None  # type: ignore[assignment]
+    pattern: Expr = None  # type: ignore[assignment]
+    negated: bool = False
+    case_insensitive: bool = False
+
+
+@register
+@dataclass(frozen=True)
+class ScAnd(Expr):
+    """Short-circuit AND (right side only evaluated where left is true)."""
+    kind: ClassVar[str] = "sc_and"
+    left: Expr = None  # type: ignore[assignment]
+    right: Expr = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class ScOr(Expr):
+    kind: ClassVar[str] = "sc_or"
+    left: Expr = None  # type: ignore[assignment]
+    right: Expr = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class SortExpr(Node):
+    kind: ClassVar[str] = "sort_expr"
+    child: Expr = None  # type: ignore[assignment]
+    asc: bool = True
+    nulls_first: bool = True
+
+
+@register
+@dataclass(frozen=True)
+class AggExpr(Node):
+    """Aggregate call: fn is an AggFunction value string."""
+    kind: ClassVar[str] = "agg_expr"
+    fn: str = "sum"
+    children: Tuple[Expr, ...] = ()
+    return_type: DataType = field(default_factory=DataType.null)
+    distinct: bool = False
+    udaf: Optional[bytes] = None   # pickled PyUDAF for fn == "udaf"
+
+
+@register
+@dataclass(frozen=True)
+class PyUdfWrapper(Expr):
+    """Host-python UDF escape hatch.
+
+    Analogue of SparkUDFWrapperExpr (datafusion-ext-exprs/src/
+    spark_udf_wrapper.rs:43): where the reference round-trips unconvertible
+    expressions back to the JVM over Arrow FFI, we evaluate a pickled python
+    callable over host numpy columns and transfer the result to device.
+    """
+    kind: ClassVar[str] = "py_udf_wrapper"
+    serialized: bytes = b""
+    args: Tuple[Expr, ...] = ()
+    return_type: DataType = field(default_factory=DataType.null)
+    name: str = "udf"
+
+
+@register
+@dataclass(frozen=True)
+class ScalarSubqueryWrapper(Expr):
+    """Pre-computed scalar subquery result carried as a literal value
+    (analogue of PhysicalSparkScalarSubqueryWrapperExprNode)."""
+    kind: ClassVar[str] = "scalar_subquery"
+    value: Any = None
+    dtype: DataType = field(default_factory=DataType.null)
+
+
+@register
+@dataclass(frozen=True)
+class GetIndexedField(Expr):
+    kind: ClassVar[str] = "get_indexed_field"
+    child: Expr = None  # type: ignore[assignment]
+    ordinal: Any = 0    # list index (0-based) or struct field name
+
+
+@register
+@dataclass(frozen=True)
+class GetMapValue(Expr):
+    kind: ClassVar[str] = "get_map_value"
+    child: Expr = None  # type: ignore[assignment]
+    key: Any = None
+
+
+@register
+@dataclass(frozen=True)
+class NamedStruct(Expr):
+    kind: ClassVar[str] = "named_struct"
+    names: Tuple[str, ...] = ()
+    values: Tuple[Expr, ...] = ()
+    return_type: DataType = field(default_factory=DataType.null)
+
+
+@register
+@dataclass(frozen=True)
+class StringStartsWith(Expr):
+    kind: ClassVar[str] = "string_starts_with"
+    child: Expr = None  # type: ignore[assignment]
+    prefix: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class StringEndsWith(Expr):
+    kind: ClassVar[str] = "string_ends_with"
+    child: Expr = None  # type: ignore[assignment]
+    suffix: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class StringContains(Expr):
+    kind: ClassVar[str] = "string_contains"
+    child: Expr = None  # type: ignore[assignment]
+    infix: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class RowNum(Expr):
+    """1-based row number within the task partition (stateful across
+    batches; analogue of datafusion-ext-exprs row_num.rs)."""
+    kind: ClassVar[str] = "row_num"
+
+
+@register
+@dataclass(frozen=True)
+class SparkPartitionId(Expr):
+    kind: ClassVar[str] = "partition_id"
+
+
+@register
+@dataclass(frozen=True)
+class MonotonicallyIncreasingId(Expr):
+    """(partition_id << 33) | row_number, Spark semantics."""
+    kind: ClassVar[str] = "monotonically_increasing_id"
+
+
+@register
+@dataclass(frozen=True)
+class BloomFilterMightContain(Expr):
+    kind: ClassVar[str] = "bloom_filter_might_contain"
+    bloom_filter: Expr = None  # type: ignore[assignment]
+    value: Expr = None  # type: ignore[assignment]
+
+
+# -------------------------------------------------------------------------
+# convenience builders
+# -------------------------------------------------------------------------
+
+def col(name: str) -> Column:
+    return Column(name=name)
+
+
+def lit(value: Any, dtype: Optional[DataType] = None) -> Literal:
+    if dtype is None:
+        dtype = _infer_literal_type(value)
+    return Literal(value=value, dtype=dtype)
+
+
+def _infer_literal_type(value: Any) -> DataType:
+    if value is None:
+        return DataType.null()
+    if isinstance(value, bool):
+        return DataType.bool_()
+    if isinstance(value, int):
+        if value < -(2**63) or value > 2**63 - 1:
+            raise OverflowError(f"integer literal {value} exceeds int64 range")
+        if -(2**31) <= value <= 2**31 - 1:
+            return DataType.int32()
+        return DataType.int64()
+    if isinstance(value, float):
+        return DataType.float64()
+    if isinstance(value, str):
+        return DataType.string()
+    if isinstance(value, bytes):
+        return DataType.binary()
+    raise TypeError(f"cannot infer literal type for {value!r}")

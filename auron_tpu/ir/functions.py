@@ -1,0 +1,98 @@
+"""Scalar / aggregate / window function vocabularies.
+
+Parity target: the reference's ~75-entry `ScalarFunction` enum
+(auron.proto:214-294) plus the `Spark_*` extension function families
+registered in datafusion-ext-functions/src/lib.rs, the `AggFunction`
+enum (auron.proto:140-154) and `WindowFunction` (auron.proto:128-138).
+Names here are lower-snake strings (an open vocabulary: the expression
+compiler dispatches by name, and unknown names fall back to the host UDF
+wrapper when enabled).
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Core scalar functions (auron.proto ScalarFunction enum analogue)
+SCALAR_FUNCTIONS = frozenset({
+    # math
+    "abs", "acos", "acosh", "asin", "atan", "atan2", "ceil", "cos", "cosh",
+    "exp", "expm1", "factorial", "floor", "ln", "log", "log10", "log2",
+    "power", "round", "signum", "sin", "sinh", "sqrt", "tan", "tanh",
+    "trunc", "is_nan", "random",
+    # conditional / generic
+    "null_if", "null_if_zero", "nvl", "nvl2", "coalesce", "least", "greatest",
+    # string
+    "ascii", "bit_length", "btrim", "character_length", "chr", "concat",
+    "concat_ws", "initcap", "left", "lower", "lpad", "ltrim", "octet_length",
+    "repeat", "replace", "reverse", "right", "rpad", "rtrim", "split_part",
+    "starts_with", "ends_with", "contains", "strpos", "substr", "translate",
+    "trim", "upper", "levenshtein", "find_in_set", "string_space",
+    "string_split", "regexp_match", "regexp_replace", "regexp_extract",
+    # date/time
+    "date_part", "date_trunc", "to_timestamp", "to_timestamp_millis",
+    "to_timestamp_micros", "to_timestamp_seconds", "now", "make_date",
+    "year", "quarter", "month", "day", "day_of_week", "week_of_year",
+    "hour", "minute", "second", "months_between", "date_add", "date_sub",
+    "datediff", "last_day", "next_day", "unix_timestamp", "from_unixtime",
+    # spark-specific numerics
+    "bround", "check_overflow", "make_decimal", "unscaled_value",
+    "normalize_nan_and_zero",
+    # hash / crypto
+    "murmur3_hash", "xxhash64", "md5", "sha224", "sha256", "sha384",
+    "sha512", "crc32", "hex", "unhex", "digest",
+    # json
+    "get_json_object", "get_parsed_json_object", "parse_json", "json_tuple",
+    # collections
+    "make_array", "array_contains", "array_union", "brickhouse_array_union",
+    "map", "map_concat", "map_from_arrays", "map_from_entries", "str_to_map",
+    "size", "sort_array", "element_at",
+})
+
+
+class AggFunction(enum.Enum):
+    MIN = "min"
+    MAX = "max"
+    SUM = "sum"
+    AVG = "avg"
+    COUNT = "count"
+    COLLECT_LIST = "collect_list"
+    COLLECT_SET = "collect_set"
+    FIRST = "first"
+    FIRST_IGNORES_NULL = "first_ignores_null"
+    BLOOM_FILTER = "bloom_filter"
+    BRICKHOUSE_COLLECT = "brickhouse_collect"
+    BRICKHOUSE_COMBINE_UNIQUE = "brickhouse_combine_unique"
+    UDAF = "udaf"
+
+
+class WindowFunction(enum.Enum):
+    ROW_NUMBER = "row_number"
+    RANK = "rank"
+    DENSE_RANK = "dense_rank"
+    PERCENT_RANK = "percent_rank"
+    CUME_DIST = "cume_dist"
+    LEAD = "lead"
+    LAG = "lag"
+    NTH_VALUE = "nth_value"
+    NTH_VALUE_IGNORE_NULLS = "nth_value_ignore_nulls"
+    FIRST_VALUE = "first_value"
+    LAST_VALUE = "last_value"
+    AGG = "agg"   # aggregate-over-window
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL = "full"
+    LEFT_SEMI = "left_semi"
+    LEFT_ANTI = "left_anti"
+    RIGHT_SEMI = "right_semi"
+    RIGHT_ANTI = "right_anti"
+    EXISTENCE = "existence"
+
+
+class JoinSide(enum.Enum):
+    LEFT = "left"
+    RIGHT = "right"

@@ -1,0 +1,140 @@
+"""Base machinery for IR nodes: a registry + reflective dict serde.
+
+Every expr/plan node is a frozen dataclass subclassing `Node` with a unique
+`kind` tag; `to_dict`/`from_dict` recurse over dataclass fields, handling
+nested nodes, DataType/Field/Schema, tuples and scalars.  This gives the IR
+a canonical JSON form (the wire format a front-end targets), mirroring what
+auron.proto's protobuf encoding provides in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar, Dict, Type
+
+from auron_tpu.ir.schema import DataType, Field, Schema, TypeId
+
+_REGISTRY: Dict[str, Type["Node"]] = {}
+
+
+def register(cls: Type["Node"]) -> Type["Node"]:
+    kind = cls.kind
+    if kind in _REGISTRY:
+        raise ValueError(f"duplicate IR node kind {kind!r}")
+    _REGISTRY[kind] = cls
+    return cls
+
+
+def _encode(v: Any) -> Any:
+    if isinstance(v, Node):
+        return v.to_dict()
+    if isinstance(v, DataType):
+        out: Dict[str, Any] = {"@type": v.id.name}
+        if v.id == TypeId.DECIMAL:
+            out["precision"], out["scale"] = v.precision, v.scale
+        if v.children:
+            out["children"] = [_encode(f) for f in v.children]
+        return out
+    if isinstance(v, Field):
+        return {"@field": v.name, "dtype": _encode(v.dtype), "nullable": v.nullable}
+    if isinstance(v, Schema):
+        return {"@schema": [_encode(f) for f in v.fields]}
+    if isinstance(v, tuple):
+        return [_encode(x) for x in v]
+    if isinstance(v, (list,)):
+        return [_encode(x) for x in v]
+    if isinstance(v, bytes):
+        import base64
+        return {"@bytes": base64.b64encode(v).decode("ascii")}
+    if isinstance(v, float):
+        # JSON has no inf/nan literal; tag them
+        import math
+        if math.isnan(v):
+            return {"@float": "nan"}
+        if math.isinf(v):
+            return {"@float": "inf" if v > 0 else "-inf"}
+        return v
+    return v
+
+
+def _decode(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "@kind" in v:
+            return Node.from_dict(v)
+        if "@type" in v:
+            tid = TypeId[v["@type"]]
+            children = tuple(_decode(c) for c in v.get("children", []))
+            return DataType(tid, precision=v.get("precision", 0),
+                            scale=v.get("scale", 0), children=children)
+        if "@field" in v:
+            return Field(v["@field"], _decode(v["dtype"]), v.get("nullable", True))
+        if "@schema" in v:
+            return Schema(tuple(_decode(f) for f in v["@schema"]))
+        if "@bytes" in v:
+            import base64
+            return base64.b64decode(v["@bytes"])
+        if "@float" in v:
+            return float(v["@float"].replace("inf", "Infinity")
+                         if "inf" in v["@float"] else "nan")
+        return {k: _decode(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return tuple(_decode(x) for x in v)
+    return v
+
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    kind: ClassVar[str] = "node"
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"@kind": self.kind}
+        for f in dataclasses.fields(self):
+            out[f.name] = _encode(getattr(self, f.name))
+        return out
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Node":
+        cls = _REGISTRY[d["@kind"]]
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name in d:
+                kwargs[f.name] = _decode(d[f.name])
+        return cls(**kwargs)  # type: ignore[call-arg]
+
+    def children_nodes(self):
+        """All direct child Nodes (exprs or plans), for tree walks."""
+        out = []
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Node):
+                out.append(v)
+            elif isinstance(v, tuple):
+                for x in v:
+                    if isinstance(x, Node):
+                        out.append(x)
+                    elif isinstance(x, tuple):
+                        out.extend(y for y in x if isinstance(y, Node))
+        return out
+
+    def transform_up(self, fn):
+        """Bottom-up rewrite: rebuild with transformed children, then apply fn.
+
+        Handles Nodes nested arbitrarily deep inside tuples (e.g.
+        Expand.projections is a tuple of tuples of exprs)."""
+
+        def rec(v: Any) -> Any:
+            if isinstance(v, Node):
+                return v.transform_up(fn)
+            if isinstance(v, tuple):
+                return tuple(rec(x) for x in v)
+            return v
+
+        changes = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, (Node, tuple)):
+                nv = rec(v)
+                if nv != v:
+                    changes[f.name] = nv
+        node = dataclasses.replace(self, **changes) if changes else self
+        return fn(node)

@@ -1,0 +1,447 @@
+"""Physical plan IR nodes.
+
+Parity surface: the 27-node `PhysicalPlanNode` oneof in the reference's
+auron.proto:27-57 (debug, shuffle_writer, ipc_reader, ipc_writer,
+parquet_scan, projection, sort, filter, union, sort_merge_join, hash_join,
+broadcast_join_build_hash_map, broadcast_join, rename_columns,
+empty_partitions, agg, limit, ffi_reader, coalesce_batches, expand,
+rss_shuffle_writer, window, generate, parquet_sink, orc_scan, kafka_scan,
+orc_sink) plus `TaskDefinition` (auron.proto:798-813).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, Optional, Tuple
+
+from auron_tpu.ir.expr import AggExpr, Expr, SortExpr
+from auron_tpu.ir.node import Node, register
+from auron_tpu.ir.schema import DataType, Schema
+
+
+@dataclass(frozen=True)
+class PlanNode(Node):
+    kind: ClassVar[str] = "plan"
+    # every concrete node has `schema` (its output schema); most have children
+
+
+# ---------------------------------------------------------------------------
+# partitioning (shuffle/mod.rs:112-123 analogue)
+# ---------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class Partitioning(Node):
+    """mode in {hash, round_robin, single, range}."""
+    kind: ClassVar[str] = "partitioning"
+    mode: str = "single"
+    num_partitions: int = 1
+    expressions: Tuple[Expr, ...] = ()          # hash keys
+    sort_orders: Tuple[SortExpr, ...] = ()      # range partitioning orders
+    range_bounds: Tuple[Any, ...] = ()          # sampled bounds rows (tuples)
+
+
+# ---------------------------------------------------------------------------
+# leaves
+# ---------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class FileGroup(Node):
+    kind: ClassVar[str] = "file_group"
+    paths: Tuple[str, ...] = ()
+    # per-file (offset, length) splits; empty = whole file
+    ranges: Tuple[Tuple[int, int], ...] = ()
+
+
+@register
+@dataclass(frozen=True)
+class ParquetScan(PlanNode):
+    """Native Parquet scan (analogue of parquet_exec.rs:70)."""
+    kind: ClassVar[str] = "parquet_scan"
+    schema: Schema = None  # type: ignore[assignment]
+    file_groups: Tuple[FileGroup, ...] = ()       # one group per partition
+    projection: Tuple[int, ...] = ()              # column indices ( () = all )
+    predicate: Optional[Expr] = None              # pushed-down filter
+    partition_schema: Optional[Schema] = None     # hive partition columns
+    partition_values: Tuple[Tuple[Any, ...], ...] = ()
+
+
+@register
+@dataclass(frozen=True)
+class OrcScan(PlanNode):
+    """Analogue of orc_exec.rs:68 (orc-rust fork); here pyarrow.orc."""
+    kind: ClassVar[str] = "orc_scan"
+    schema: Schema = None  # type: ignore[assignment]
+    file_groups: Tuple[FileGroup, ...] = ()
+    projection: Tuple[int, ...] = ()
+    predicate: Optional[Expr] = None
+    positional_evolution: bool = False            # FORCE_POSITIONAL_EVOLUTION
+
+
+@register
+@dataclass(frozen=True)
+class KafkaScan(PlanNode):
+    """Streaming source; partition/offset assignment supplied by the
+    front-end (analogue of flink/kafka_scan_exec.rs:81,243-247)."""
+    kind: ClassVar[str] = "kafka_scan"
+    schema: Schema = None  # type: ignore[assignment]
+    topic: str = ""
+    assignment_json: str = ""      # {"partitions":[{"partition":0,"start":..,"end":..}]}
+    value_format: str = "json"     # json | protobuf | raw
+    bootstrap_servers: str = ""
+    mock_data: Tuple[Any, ...] = ()  # for the mock scan (kafka_mock_scan_exec.rs)
+
+
+@register
+@dataclass(frozen=True)
+class IpcReader(PlanNode):
+    """Reads compressed-IPC blocks from a resource (shuffle read / broadcast
+    read); analogue of ipc_reader_exec.rs:65."""
+    kind: ClassVar[str] = "ipc_reader"
+    schema: Schema = None  # type: ignore[assignment]
+    resource_id: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class FFIReader(PlanNode):
+    """Imports front-end-produced Arrow batches through the Arrow C-Data
+    interface (analogue of ffi_reader_exec.rs:46 / ConvertToNativeExec)."""
+    kind: ClassVar[str] = "ffi_reader"
+    schema: Schema = None  # type: ignore[assignment]
+    resource_id: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class EmptyPartitions(PlanNode):
+    kind: ClassVar[str] = "empty_partitions"
+    schema: Schema = None  # type: ignore[assignment]
+    num_partitions: int = 1
+
+
+# ---------------------------------------------------------------------------
+# unary operators
+# ---------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class Projection(PlanNode):
+    kind: ClassVar[str] = "projection"
+    child: PlanNode = None  # type: ignore[assignment]
+    exprs: Tuple[Expr, ...] = ()
+    names: Tuple[str, ...] = ()
+
+
+@register
+@dataclass(frozen=True)
+class Filter(PlanNode):
+    kind: ClassVar[str] = "filter"
+    child: PlanNode = None  # type: ignore[assignment]
+    predicates: Tuple[Expr, ...] = ()   # conjunctive
+
+
+@register
+@dataclass(frozen=True)
+class Sort(PlanNode):
+    """External sort w/ optional fetch-limit pushdown
+    (sort_exec.rs:86; FetchLimit auron.proto:667)."""
+    kind: ClassVar[str] = "sort"
+    child: PlanNode = None  # type: ignore[assignment]
+    sort_exprs: Tuple[SortExpr, ...] = ()
+    fetch_limit: Optional[int] = None
+    fetch_offset: int = 0
+
+
+@register
+@dataclass(frozen=True)
+class Limit(PlanNode):
+    kind: ClassVar[str] = "limit"
+    child: PlanNode = None  # type: ignore[assignment]
+    limit: int = 0
+    offset: int = 0
+
+
+@register
+@dataclass(frozen=True)
+class Agg(PlanNode):
+    """Hash/sort aggregation.
+
+    exec_mode: partial | final | single (two-phase like agg_exec.rs:59).
+    grouping: key exprs; aggs: AggExpr list evaluated over input.
+    """
+    kind: ClassVar[str] = "agg"
+    child: PlanNode = None  # type: ignore[assignment]
+    exec_mode: str = "single"
+    grouping: Tuple[Expr, ...] = ()
+    grouping_names: Tuple[str, ...] = ()
+    aggs: Tuple[AggExpr, ...] = ()
+    agg_names: Tuple[str, ...] = ()
+    supports_partial_skipping: bool = False
+
+
+@register
+@dataclass(frozen=True)
+class Expand(PlanNode):
+    """Grouping-sets projections (expand_exec.rs:40)."""
+    kind: ClassVar[str] = "expand"
+    child: PlanNode = None  # type: ignore[assignment]
+    projections: Tuple[Tuple[Expr, ...], ...] = ()
+    names: Tuple[str, ...] = ()
+    types: Tuple[DataType, ...] = ()
+
+
+@register
+@dataclass(frozen=True)
+class WindowGroupLimit(Node):
+    """Top-k per partition pre-filter (auron.proto:590 window-group-limit)."""
+    kind: ClassVar[str] = "window_group_limit"
+    k: int = 0
+    rank_fn: str = "row_number"   # row_number | rank | dense_rank
+
+
+@register
+@dataclass(frozen=True)
+class WindowFuncCall(Node):
+    kind: ClassVar[str] = "window_func_call"
+    fn: str = "row_number"                 # WindowFunction value
+    args: Tuple[Expr, ...] = ()
+    agg: Optional[AggExpr] = None          # for fn == "agg"
+    return_type: DataType = None  # type: ignore[assignment]
+    name: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class Window(PlanNode):
+    kind: ClassVar[str] = "window"
+    child: PlanNode = None  # type: ignore[assignment]
+    window_funcs: Tuple[WindowFuncCall, ...] = ()
+    partition_by: Tuple[Expr, ...] = ()
+    order_by: Tuple[SortExpr, ...] = ()
+    group_limit: Optional[WindowGroupLimit] = None
+    output_window_cols: bool = True
+
+
+@register
+@dataclass(frozen=True)
+class Generate(PlanNode):
+    """explode / posexplode / json_tuple / python-UDTF
+    (generate_exec.rs:50)."""
+    kind: ClassVar[str] = "generate"
+    child: PlanNode = None  # type: ignore[assignment]
+    generator: str = "explode"    # explode|posexplode|json_tuple|udtf
+    args: Tuple[Expr, ...] = ()
+    generator_output_names: Tuple[str, ...] = ()
+    generator_output_types: Tuple[DataType, ...] = ()
+    required_child_output: Tuple[int, ...] = ()
+    outer: bool = False
+    udtf: Optional[bytes] = None   # pickled python generator fn
+
+
+@register
+@dataclass(frozen=True)
+class RenameColumns(PlanNode):
+    kind: ClassVar[str] = "rename_columns"
+    child: PlanNode = None  # type: ignore[assignment]
+    names: Tuple[str, ...] = ()
+
+
+@register
+@dataclass(frozen=True)
+class CoalesceBatches(PlanNode):
+    kind: ClassVar[str] = "coalesce_batches"
+    child: PlanNode = None  # type: ignore[assignment]
+    target_batch_size: int = 0    # 0 = use config default
+
+
+@register
+@dataclass(frozen=True)
+class Debug(PlanNode):
+    kind: ClassVar[str] = "debug"
+    child: PlanNode = None  # type: ignore[assignment]
+    debug_id: str = ""
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class JoinOn(Node):
+    kind: ClassVar[str] = "join_on"
+    left_keys: Tuple[Expr, ...] = ()
+    right_keys: Tuple[Expr, ...] = ()
+
+
+@register
+@dataclass(frozen=True)
+class SortMergeJoin(PlanNode):
+    kind: ClassVar[str] = "sort_merge_join"
+    left: PlanNode = None  # type: ignore[assignment]
+    right: PlanNode = None  # type: ignore[assignment]
+    on: JoinOn = None  # type: ignore[assignment]
+    join_type: str = "inner"
+    sort_options: Tuple[Tuple[bool, bool], ...] = ()   # (asc, nulls_first) per key
+    existence_output_name: str = "exists"
+
+
+@register
+@dataclass(frozen=True)
+class HashJoin(PlanNode):
+    """Shuffled hash join (both sides partitioned by key)."""
+    kind: ClassVar[str] = "hash_join"
+    left: PlanNode = None  # type: ignore[assignment]
+    right: PlanNode = None  # type: ignore[assignment]
+    on: JoinOn = None  # type: ignore[assignment]
+    join_type: str = "inner"
+    build_side: str = "right"
+    existence_output_name: str = "exists"
+
+
+@register
+@dataclass(frozen=True)
+class BroadcastJoinBuildHashMap(PlanNode):
+    """Builds the broadcast hash map once per device from broadcast batches
+    (broadcast_join_build_hash_map_exec.rs:55)."""
+    kind: ClassVar[str] = "broadcast_join_build_hash_map"
+    child: PlanNode = None  # type: ignore[assignment]
+    keys: Tuple[Expr, ...] = ()
+    cache_id: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class BroadcastJoin(PlanNode):
+    kind: ClassVar[str] = "broadcast_join"
+    left: PlanNode = None  # type: ignore[assignment]
+    right: PlanNode = None  # type: ignore[assignment]
+    on: JoinOn = None  # type: ignore[assignment]
+    join_type: str = "inner"
+    broadcast_side: str = "right"
+    cached_build_hash_map_id: str = ""
+    existence_output_name: str = "exists"
+
+
+# ---------------------------------------------------------------------------
+# multi-input / exchange
+# ---------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class UnionInput(Node):
+    kind: ClassVar[str] = "union_input"
+    child: PlanNode = None  # type: ignore[assignment]
+    # which partition of this child feeds the union's output partition
+    partition: int = 0
+
+
+@register
+@dataclass(frozen=True)
+class Union(PlanNode):
+    kind: ClassVar[str] = "union"
+    inputs: Tuple[UnionInput, ...] = ()
+    schema: Schema = None  # type: ignore[assignment]
+    num_partitions: int = 1
+    cur_partition: int = 0
+
+
+@register
+@dataclass(frozen=True)
+class ShuffleWriter(PlanNode):
+    """Partitions child output and writes shuffle data (file-backed on a
+    single host; all-to-all over ICI in the distributed executor);
+    analogue of shuffle_writer_exec.rs:51."""
+    kind: ClassVar[str] = "shuffle_writer"
+    child: PlanNode = None  # type: ignore[assignment]
+    partitioning: Partitioning = None  # type: ignore[assignment]
+    output_data_file: str = ""
+    output_index_file: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class RssShuffleWriter(PlanNode):
+    """Remote-shuffle-service write: partition buffers are pushed to a
+    pluggable RSS client (analogue of rss_shuffle_writer_exec.rs:52,
+    Celeborn/Uniffle integrations)."""
+    kind: ClassVar[str] = "rss_shuffle_writer"
+    child: PlanNode = None  # type: ignore[assignment]
+    partitioning: Partitioning = None  # type: ignore[assignment]
+    rss_resource_id: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class IpcWriter(PlanNode):
+    """Writes child output as compressed IPC to a resource (broadcast
+    collect path; ipc_writer_exec.rs:43)."""
+    kind: ClassVar[str] = "ipc_writer"
+    child: PlanNode = None  # type: ignore[assignment]
+    resource_id: str = ""
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class ParquetSink(PlanNode):
+    """Native parquet write incl. dynamic partitions
+    (parquet_sink_exec.rs:55)."""
+    kind: ClassVar[str] = "parquet_sink"
+    child: PlanNode = None  # type: ignore[assignment]
+    output_dir: str = ""
+    partition_cols: Tuple[str, ...] = ()
+    compression: str = "zstd"
+    props: Tuple[Tuple[str, str], ...] = ()
+
+
+@register
+@dataclass(frozen=True)
+class OrcSink(PlanNode):
+    kind: ClassVar[str] = "orc_sink"
+    child: PlanNode = None  # type: ignore[assignment]
+    output_dir: str = ""
+    partition_cols: Tuple[str, ...] = ()
+    compression: str = "zstd"
+    props: Tuple[Tuple[str, str], ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# task definition
+# ---------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class TaskDefinition(Node):
+    """The unit shipped from a front-end to the runtime
+    (auron.proto:798-813: task_id{stage_id,partition_id}, plan, cpus)."""
+    kind: ClassVar[str] = "task_definition"
+    plan: PlanNode = None  # type: ignore[assignment]
+    stage_id: int = 0
+    partition_id: int = 0
+    num_partitions: int = 1
+    host_threads: int = 0     # 0 = config default
+
+
+def plan_children(plan: Node):
+    """Direct child plans, descending through wrapper Nodes (e.g. UnionInput)
+    but not through expressions."""
+    out = []
+    for c in plan.children_nodes():
+        if isinstance(c, PlanNode):
+            out.append(c)
+        elif isinstance(c, Node) and not isinstance(c, Expr):
+            out.extend(plan_children(c))
+    return out
+
+
+def walk(plan: PlanNode):
+    """Pre-order traversal over plan nodes only (not exprs)."""
+    yield plan
+    for c in plan_children(plan):
+        yield from walk(c)

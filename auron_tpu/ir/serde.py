@@ -1,0 +1,67 @@
+"""IR serialization: canonical JSON + compressed binary envelope.
+
+This is the wire format a front-end ships `TaskDefinition`s in — the
+analogue of the protobuf bytes the reference fetches from the JVM
+(rt.rs:79-84 getRawTaskDefinition / AuronCallNativeWrapper.java:170-183).
+Binary envelope: magic "ATPU" + u8 version + u8 codec + zstd/zlib/raw JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+from auron_tpu.ir.node import Node
+
+MAGIC = b"ATPU"
+VERSION = 1
+_CODEC_RAW, _CODEC_ZSTD, _CODEC_ZLIB = 0, 1, 2
+
+
+def to_json(node: Node) -> str:
+    return json.dumps(node.to_dict(), separators=(",", ":"), sort_keys=True)
+
+
+def from_json(s: str) -> Node:
+    return Node.from_dict(json.loads(s))
+
+
+def serialize(node: Node, codec: str = "zstd") -> bytes:
+    payload = to_json(node).encode("utf-8")
+    if codec == "zstd":
+        import zstandard
+        body, cid = zstandard.ZstdCompressor(level=3).compress(payload), _CODEC_ZSTD
+    elif codec == "zlib":
+        import zlib
+        body, cid = zlib.compress(payload, 6), _CODEC_ZLIB
+    elif codec == "raw":
+        body, cid = payload, _CODEC_RAW
+    else:
+        raise ValueError(f"unknown codec {codec!r}")
+    return MAGIC + struct.pack("<BB", VERSION, cid) + body
+
+
+def deserialize(data: bytes) -> Node:
+    if data[:4] != MAGIC:
+        raise ValueError("bad IR envelope magic")
+    version, cid = struct.unpack_from("<BB", data, 4)
+    if version != VERSION:
+        raise ValueError(f"unsupported IR version {version}")
+    body = data[6:]
+    if cid == _CODEC_ZSTD:
+        import zstandard
+        payload = zstandard.ZstdDecompressor().decompress(body)
+    elif cid == _CODEC_ZLIB:
+        import zlib
+        payload = zlib.decompress(body)
+    elif cid == _CODEC_RAW:
+        payload = body
+    else:
+        raise ValueError(f"unknown codec id {cid}")
+    return from_json(payload.decode("utf-8"))
+
+
+def roundtrip(node: Node) -> Node:
+    """Serialize+deserialize (used by golden tests)."""
+    return deserialize(serialize(node))

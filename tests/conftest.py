@@ -1,0 +1,24 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax import.
+
+Multi-chip hardware is unavailable in CI; sharding paths are validated on a
+virtual CPU mesh (xla_force_host_platform_device_count=8), mirroring how the
+reference exercises distribution via Spark local[*] instead of a cluster
+(SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,137 @@
+"""IR construction + serde roundtrip tests (golden-file style)."""
+
+import math
+
+from auron_tpu.ir import serde
+from auron_tpu.ir.expr import (
+    AggExpr, BinaryExpr, Case, Cast, Column, InList, IsNull, Like, Literal,
+    ScalarFunctionCall, ScAnd, SortExpr, WhenThen, col, lit,
+)
+from auron_tpu.ir.plan import (
+    Agg, BroadcastJoin, FileGroup, Filter, JoinOn, Limit, ParquetScan,
+    Partitioning, Projection, ShuffleWriter, Sort, TaskDefinition, Union,
+    UnionInput, plan_children, walk,
+)
+from auron_tpu.ir.schema import DataType, Field, Schema
+
+
+def make_schema():
+    return Schema.of(
+        Field("id", DataType.int64(), nullable=False),
+        Field("name", DataType.string()),
+        Field("price", DataType.decimal(12, 2)),
+        Field("ts", DataType.timestamp_us()),
+        Field("tags", DataType.list_(DataType.string())),
+    )
+
+
+def make_plan():
+    schema = make_schema()
+    scan = ParquetScan(schema=schema, file_groups=(FileGroup(paths=("/tmp/x.parquet",)),),
+                       projection=(0, 1, 2))
+    filt = Filter(child=scan, predicates=(
+        ScAnd(left=BinaryExpr(left=col("id"), op=">", right=lit(10)),
+              right=Like(child=col("name"), pattern=lit("a%"))),
+    ))
+    proj = Projection(child=filt,
+                      exprs=(col("id"),
+                             ScalarFunctionCall(name="upper", args=(col("name"),),
+                                                return_type=DataType.string())),
+                      names=("id", "uname"))
+    agg = Agg(child=proj, exec_mode="partial", grouping=(col("uname"),),
+              grouping_names=("uname",),
+              aggs=(AggExpr(fn="sum", children=(col("id"),),
+                            return_type=DataType.int64()),),
+              agg_names=("sum_id",))
+    sw = ShuffleWriter(child=agg,
+                       partitioning=Partitioning(mode="hash", num_partitions=8,
+                                                 expressions=(col("uname"),)))
+    return TaskDefinition(plan=sw, stage_id=3, partition_id=1, num_partitions=8)
+
+
+def test_schema_basics():
+    s = make_schema()
+    assert len(s) == 5
+    assert s.index_of("NAME") == 1  # case-insensitive default
+    assert s.field("price").dtype.is_decimal
+    assert repr(s.field("tags").dtype) == "list<string>"
+
+
+def test_serde_roundtrip():
+    td = make_plan()
+    td2 = serde.roundtrip(td)
+    assert td2 == td
+    # JSON stability: canonical form equal after double roundtrip
+    assert serde.to_json(td2) == serde.to_json(td)
+
+
+def test_serde_special_floats():
+    e = InList(child=col("x"), values=(lit(float("nan")), lit(float("inf")), lit(1.5)))
+    e2 = serde.roundtrip(e)
+    assert math.isnan(e2.values[0].value)
+    assert math.isinf(e2.values[1].value)
+    assert e2.values[2].value == 1.5
+
+
+def test_serde_bytes_and_case():
+    e = Case(branches=(WhenThen(when=IsNull(child=col("a")), then=lit(0)),),
+             else_expr=Cast(child=col("a"), dtype=DataType.int64()))
+    assert serde.roundtrip(e) == e
+
+
+def test_walk_and_children():
+    td = make_plan()
+    kinds = [p.kind for p in walk(td.plan)]
+    assert kinds == ["shuffle_writer", "agg", "projection", "filter", "parquet_scan"]
+
+
+def test_union_walk_through_wrappers():
+    schema = Schema.of(Field("a", DataType.int32()))
+    leaf1 = ParquetScan(schema=schema)
+    leaf2 = ParquetScan(schema=schema)
+    u = Union(inputs=(UnionInput(child=leaf1), UnionInput(child=leaf2)),
+              schema=schema, num_partitions=1)
+    assert len(plan_children(u)) == 2
+    assert len(list(walk(u))) == 3
+
+
+def test_transform_up():
+    plan = make_plan().plan
+    # rewrite every column named "uname" to "u2"
+    def rw(n):
+        if isinstance(n, Column) and n.name == "uname":
+            return Column(name="u2")
+        return n
+    plan2 = plan.transform_up(rw)
+    cols = [n for p in walk(plan2) for n in _all_exprs(p) if isinstance(n, Column)]
+    assert all(c.name != "uname" for c in cols)
+    assert any(c.name == "u2" for c in cols)
+
+
+def _all_exprs(node):
+    """Every Node reachable from `node` (not descending into child plans)."""
+    from auron_tpu.ir.plan import PlanNode
+    out = []
+    stack = [c for c in node.children_nodes() if not isinstance(c, PlanNode)]
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        stack.extend(c for c in n.children_nodes() if not isinstance(c, PlanNode))
+    return out
+
+
+def test_transform_up_nested_tuples():
+    # Expand.projections is a tuple-of-tuples: transform_up must reach inside
+    from auron_tpu.ir.plan import Expand
+    e = Expand(child=ParquetScan(schema=make_schema()),
+               projections=((col("a"), lit(1)), (col("a"), lit(2))))
+    e2 = e.transform_up(lambda n: Column(name="b")
+                        if isinstance(n, Column) and n.name == "a" else n)
+    assert all(p[0].name == "b" for p in e2.projections)
+
+
+def test_binary_envelope_codecs():
+    td = make_plan()
+    for codec in ("zstd", "zlib", "raw"):
+        data = serde.serialize(td, codec=codec)
+        assert serde.deserialize(data) == td
