@@ -1,0 +1,35 @@
+"""Columnar substrate: device-resident batches with static shapes.
+
+The reference's unit of exchange is an Arrow RecordBatch flowing through
+DataFusion streams.  On TPU the equivalent must be XLA-friendly, so the core
+design decision is the **fixed-capacity padded batch**: every column is a
+device array padded to a power-of-two capacity, with an explicit validity
+mask and a dynamic row count.  Shapes are static per (schema, capacity)
+bucket, so each jitted kernel compiles once and row counts stay dynamic
+(traced scalars), never triggering recompilation.
+
+Strings are fixed-width padded uint8 matrices (width buckets); nested and
+oversized values stay host-resident as pyarrow arrays (hybrid execution,
+the analogue of Auron's per-expression JVM fallback).
+"""
+
+from auron_tpu.columnar.batch import (
+    Batch,
+    DeviceColumn,
+    DeviceStringColumn,
+    HostColumn,
+    bucket_capacity,
+    bucket_width,
+)
+from auron_tpu.columnar import arrow_interop, serde
+
+__all__ = [
+    "Batch",
+    "DeviceColumn",
+    "DeviceStringColumn",
+    "HostColumn",
+    "bucket_capacity",
+    "bucket_width",
+    "arrow_interop",
+    "serde",
+]

@@ -1,0 +1,376 @@
+"""Device batch: fixed-capacity padded columns + validity + row count.
+
+Invariants (the contract every kernel relies on):
+- every device array's leading dim == `capacity` (a power of two);
+- rows with index >= num_rows are *padding*: validity False, data zeroed;
+- null/pad positions hold canonical zeros (no NaN poisoning in reductions);
+- `num_rows` is a host int (known after the producing op), but kernels
+  receive it as a traced scalar so XLA never specializes on it.
+
+This file replaces the Arrow-RecordBatch-centric plumbing of the reference's
+datafusion-ext-commons (batch serde, batch size heuristics, lib.rs:74-100)
+with a TPU-native representation; Arrow remains the host-side interchange
+(arrow_interop.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.config import conf
+from auron_tpu.ir.schema import DataType, Field, Schema, TypeId
+
+Array = Any  # jnp.ndarray
+
+
+def bucket_capacity(n: int) -> int:
+    """Smallest power-of-two capacity >= n (bounded below by config)."""
+    cap = int(conf.get("auron.batch.capacity.min"))
+    n = max(int(n), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def bucket_width(w: int) -> int:
+    """Smallest configured string width bucket >= w."""
+    buckets = [int(x) for x in str(conf.get("auron.string.width.buckets")).split(",")]
+    for b in buckets:
+        if w <= b:
+            return b
+    return buckets[-1]
+
+
+# ---------------------------------------------------------------------------
+# columns
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DeviceColumn:
+    """Flat (fixed-width) column: data[capacity], validity[capacity]."""
+    dtype: DataType
+    data: Array
+    validity: Array  # bool[capacity]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def gather(self, indices: Array, valid: Array) -> "DeviceColumn":
+        """Row gather with an index-validity mask (padding => null+zero)."""
+        d = jnp.where(valid, jnp.take(self.data, indices, axis=0,
+                                      mode="fill", fill_value=0), 0)
+        v = jnp.where(valid, jnp.take(self.validity, indices, axis=0,
+                                      mode="fill", fill_value=False), False)
+        return DeviceColumn(self.dtype, d, v)
+
+    def astuple(self):
+        return (self.data, self.validity)
+
+
+@dataclass
+class DeviceStringColumn:
+    """Fixed-width padded string/binary column.
+
+    data[capacity, width] uint8 (zero-padded), lengths[capacity] int32,
+    validity[capacity] bool.  Width is a config bucket; strings longer than
+    auron.string.device.max.width never enter this representation (they stay
+    host-resident as a HostColumn).
+    """
+    dtype: DataType
+    data: Array       # uint8 [capacity, width]
+    lengths: Array    # int32 [capacity]
+    validity: Array   # bool [capacity]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def width(self) -> int:
+        return int(self.data.shape[1])
+
+    def gather(self, indices: Array, valid: Array) -> "DeviceStringColumn":
+        d = jnp.where(valid[:, None],
+                      jnp.take(self.data, indices, axis=0, mode="fill",
+                               fill_value=0), 0)
+        l = jnp.where(valid, jnp.take(self.lengths, indices, axis=0,
+                                      mode="fill", fill_value=0), 0)
+        v = jnp.where(valid, jnp.take(self.validity, indices, axis=0,
+                                      mode="fill", fill_value=False), False)
+        return DeviceStringColumn(self.dtype, d, l, v)
+
+    def astuple(self):
+        return (self.data, self.lengths, self.validity)
+
+
+@dataclass
+class HostColumn:
+    """Host-resident column for nested / oversized values (pyarrow array of
+    length num_rows, NOT padded).  The hybrid-execution escape hatch."""
+    dtype: DataType
+    array: Any  # pyarrow.Array, len == num_rows of owning batch
+
+    @property
+    def capacity(self) -> int:  # logical; host cols are unpadded
+        return len(self.array)
+
+    def gather_host(self, indices: np.ndarray) -> "HostColumn":
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        idx = pa.array(indices.astype(np.int64), type=pa.int64())
+        return HostColumn(self.dtype, pc.take(self.array, idx))
+
+
+Column = Union[DeviceColumn, DeviceStringColumn, HostColumn]
+
+
+# ---------------------------------------------------------------------------
+# batch
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Batch:
+    schema: Schema
+    columns: List[Column]
+    num_rows: int
+    capacity: int
+
+    def __post_init__(self):
+        assert len(self.columns) == len(self.schema), \
+            f"{len(self.columns)} columns vs schema {self.schema!r}"
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def empty(schema: Schema, capacity: Optional[int] = None) -> "Batch":
+        cap = capacity or bucket_capacity(0)
+        cols: List[Column] = []
+        for f in schema:
+            cols.append(_empty_column(f.dtype, cap))
+        return Batch(schema, cols, 0, cap)
+
+    @staticmethod
+    def from_numpy(schema: Schema, arrays: Sequence[np.ndarray],
+                   validities: Optional[Sequence[Optional[np.ndarray]]] = None,
+                   capacity: Optional[int] = None) -> "Batch":
+        """Build a device batch from host numpy columns (flat types; strings
+        via numpy object/str arrays are routed through arrow_interop)."""
+        n = len(arrays[0]) if arrays else 0
+        cap = capacity or bucket_capacity(n)
+        cols: List[Column] = []
+        for i, f in enumerate(schema):
+            a = np.asarray(arrays[i])
+            v = None if validities is None else validities[i]
+            if v is None:
+                v = np.ones(n, dtype=bool)
+            cols.append(_device_column_from_numpy(f.dtype, a, v, cap))
+        return Batch(schema, cols, n, cap)
+
+    # -- row-count helpers --------------------------------------------------
+
+    def row_mask(self) -> Array:
+        """bool[capacity]: True for live rows."""
+        return jnp.arange(self.capacity) < jnp.int32(self.num_rows)
+
+    # -- transforms ---------------------------------------------------------
+
+    def select(self, indices: Sequence[int]) -> "Batch":
+        return Batch(self.schema.select(indices),
+                     [self.columns[i] for i in indices],
+                     self.num_rows, self.capacity)
+
+    def rename(self, names: Sequence[str]) -> "Batch":
+        return Batch(self.schema.rename(tuple(names)), self.columns,
+                     self.num_rows, self.capacity)
+
+    def with_columns(self, schema: Schema, columns: List[Column]) -> "Batch":
+        return Batch(schema, columns, self.num_rows, self.capacity)
+
+    def gather(self, indices: Array, num_rows: int,
+               capacity: Optional[int] = None) -> "Batch":
+        """Gather rows by device index vector (shape [out_capacity]); rows
+        beyond num_rows in the index vector are padding."""
+        out_cap = capacity or int(indices.shape[0])
+        valid = jnp.arange(out_cap) < jnp.int32(num_rows)
+        cols: List[Column] = []
+        host_idx: Optional[np.ndarray] = None
+        for c in self.columns:
+            if isinstance(c, HostColumn):
+                if host_idx is None:
+                    host_idx = np.asarray(indices)[:num_rows]
+                cols.append(c.gather_host(host_idx))
+            else:
+                cols.append(c.gather(indices, valid))
+        return Batch(self.schema, cols, num_rows, out_cap)
+
+    def head(self, n: int) -> "Batch":
+        """Logical truncation (no data movement): clamp num_rows and fix
+        validity beyond n."""
+        n = min(n, self.num_rows)
+        mask = jnp.arange(self.capacity) < jnp.int32(n)
+        cols: List[Column] = []
+        for c in self.columns:
+            if isinstance(c, HostColumn):
+                cols.append(HostColumn(c.dtype, c.array.slice(0, n)))
+            elif isinstance(c, DeviceStringColumn):
+                cols.append(DeviceStringColumn(
+                    c.dtype, jnp.where(mask[:, None], c.data, 0),
+                    jnp.where(mask, c.lengths, 0),
+                    jnp.logical_and(c.validity, mask)))
+            else:
+                cols.append(DeviceColumn(
+                    c.dtype, jnp.where(mask, c.data, _zero_like(c.data)),
+                    jnp.logical_and(c.validity, mask)))
+        return Batch(self.schema, cols, n, self.capacity)
+
+    def mem_bytes(self) -> int:
+        """Approximate device bytes held by this batch."""
+        total = 0
+        for c in self.columns:
+            if isinstance(c, DeviceColumn):
+                total += c.data.size * c.data.dtype.itemsize + c.validity.size
+            elif isinstance(c, DeviceStringColumn):
+                total += c.data.size + c.lengths.size * 4 + c.validity.size
+            elif isinstance(c, HostColumn):
+                total += c.array.nbytes
+        return int(total)
+
+    def has_host_columns(self) -> bool:
+        return any(isinstance(c, HostColumn) for c in self.columns)
+
+    # -- conversion shortcuts ----------------------------------------------
+
+    def to_arrow(self):
+        from auron_tpu.columnar.arrow_interop import batch_to_arrow
+        return batch_to_arrow(self)
+
+    @staticmethod
+    def from_arrow(rb, capacity: Optional[int] = None) -> "Batch":
+        from auron_tpu.columnar.arrow_interop import arrow_to_batch
+        return arrow_to_batch(rb, capacity=capacity)
+
+    def to_pylist(self) -> List[dict]:
+        return self.to_arrow().to_pylist()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _zero_like(a: Array):
+    return jnp.zeros((), dtype=a.dtype)
+
+
+def is_device_type(dt: DataType) -> bool:
+    """Can this logical type live on device?"""
+    if dt.is_nested:
+        return False
+    if dt.id == TypeId.DECIMAL and dt.precision > 18:
+        return False
+    return True
+
+
+def _empty_column(dt: DataType, cap: int) -> Column:
+    if not is_device_type(dt):
+        import pyarrow as pa
+        from auron_tpu.ir.schema import to_arrow_type
+        return HostColumn(dt, pa.array([], type=to_arrow_type(dt)))
+    if dt.is_stringlike:
+        w = bucket_width(1)
+        return DeviceStringColumn(
+            dt, jnp.zeros((cap, w), dtype=jnp.uint8),
+            jnp.zeros(cap, dtype=jnp.int32), jnp.zeros(cap, dtype=bool))
+    return DeviceColumn(dt, jnp.zeros(cap, dtype=dt.numpy_dtype()),
+                        jnp.zeros(cap, dtype=bool))
+
+
+def _device_column_from_numpy(dt: DataType, a: np.ndarray, v: np.ndarray,
+                              cap: int) -> Column:
+    if dt.is_stringlike or a.dtype.kind in ("U", "S", "O"):
+        from auron_tpu.columnar.arrow_interop import numpy_strings_to_column
+        return numpy_strings_to_column(dt, a, v, cap)
+    n = len(a)
+    data = np.zeros(cap, dtype=dt.numpy_dtype())
+    data[:n] = np.where(v, a.astype(dt.numpy_dtype(), copy=False), 0)
+    valid = np.zeros(cap, dtype=bool)
+    valid[:n] = v
+    return DeviceColumn(dt, jnp.asarray(data), jnp.asarray(valid))
+
+
+# ---------------------------------------------------------------------------
+# pytree registration: device columns flow through jax.jit directly (dtype is
+# static aux data; DataType is a frozen dataclass => hashable).  Batch itself
+# stays host-side; operators pass column lists + a traced num_rows scalar.
+# ---------------------------------------------------------------------------
+
+jax.tree_util.register_pytree_node(
+    DeviceColumn,
+    lambda c: ((c.data, c.validity), c.dtype),
+    lambda dtype, kids: DeviceColumn(dtype, *kids),
+)
+jax.tree_util.register_pytree_node(
+    DeviceStringColumn,
+    lambda c: ((c.data, c.lengths, c.validity), c.dtype),
+    lambda dtype, kids: DeviceStringColumn(dtype, *kids),
+)
+
+
+def concat_batches(schema: Schema, batches: List[Batch],
+                   capacity: Optional[int] = None) -> Batch:
+    """Concatenate along rows into one padded batch (device concat; host
+    columns concat via pyarrow)."""
+    import pyarrow as pa
+    total = sum(b.num_rows for b in batches)
+    cap = capacity or bucket_capacity(total)
+    assert cap >= total, f"concat capacity {cap} < total rows {total}"
+    if not batches:
+        return Batch.empty(schema, cap)
+    cols: List[Column] = []
+    for ci, f in enumerate(schema):
+        parts = [b.columns[ci] for b in batches]
+        if any(isinstance(p, HostColumn) for p in parts):
+            # representation can differ per batch (oversize strings demote
+            # to host); normalize the whole column to host
+            from auron_tpu.columnar.arrow_interop import column_to_arrow
+            arrs = []
+            for b, p in zip(batches, parts):
+                a = p.array if isinstance(p, HostColumn) else \
+                    column_to_arrow(f.dtype, p, b.num_rows)
+                if isinstance(a, pa.ChunkedArray):
+                    a = a.combine_chunks()
+                arrs.append(a)
+            t0 = arrs[0].type
+            arrs = [a.cast(t0) if a.type != t0 else a for a in arrs]
+            cols.append(HostColumn(f.dtype, pa.concat_arrays(arrs)))
+        elif isinstance(parts[0], DeviceStringColumn):
+            w = max(p.width for p in parts)
+            datas, lens, vals = [], [], []
+            for b, p in zip(batches, parts):
+                d = p.data
+                if p.width < w:
+                    d = jnp.pad(d, ((0, 0), (0, w - p.width)))
+                datas.append(d[:b.num_rows])
+                lens.append(p.lengths[:b.num_rows])
+                vals.append(p.validity[:b.num_rows])
+            data = jnp.concatenate(datas)[:cap]
+            data = jnp.pad(data, ((0, cap - data.shape[0]), (0, 0)))
+            ln = jnp.concatenate(lens)[:cap]
+            ln = jnp.pad(ln, (0, cap - ln.shape[0]))
+            va = jnp.concatenate(vals)[:cap]
+            va = jnp.pad(va, (0, cap - va.shape[0]))
+            cols.append(DeviceStringColumn(f.dtype, data, ln, va))
+        else:
+            datas = [p.data[:b.num_rows] for b, p in zip(batches, parts)]
+            vals = [p.validity[:b.num_rows] for b, p in zip(batches, parts)]
+            data = jnp.concatenate(datas)[:cap]
+            data = jnp.pad(data, (0, cap - data.shape[0]))
+            va = jnp.concatenate(vals)[:cap]
+            va = jnp.pad(va, (0, cap - va.shape[0]))
+            cols.append(DeviceColumn(f.dtype, data, va))
+    return Batch(schema, cols, total, cap)

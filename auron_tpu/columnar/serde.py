@@ -1,0 +1,92 @@
+"""Compressed batch serde — the spill/shuffle wire format.
+
+Analogue of datafusion-ext-commons' compact batch serde + IpcCompression
+(io/batch_serde.rs:68,81; io/ipc_compression.rs:35,115): length-prefixed
+compressed Arrow IPC frames.  When the C++ host runtime is built
+(auron_tpu.native), its codec is used; otherwise python zstandard/zlib.
+
+Frame layout (one or more per stream):
+  u32 LE compressed-payload length | u8 codec id | payload
+Payload = Arrow IPC stream (schema + single batch) compressed whole.
+An empty stream is valid (zero frames).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, Iterator, List, Optional
+
+import pyarrow as pa
+
+from auron_tpu.config import conf
+
+_CODEC_IDS = {"none": 0, "zstd": 1, "zlib": 2}
+_CODEC_NAMES = {v: k for k, v in _CODEC_IDS.items()}
+
+
+def _compress(payload: bytes, codec: str) -> bytes:
+    if codec == "zstd":
+        from auron_tpu.native import bindings
+        return bindings.compress(payload)
+    if codec == "zlib":
+        import zlib
+        return zlib.compress(payload, 4)
+    return payload
+
+
+def _decompress(payload: bytes, codec: str) -> bytes:
+    if codec == "zstd":
+        from auron_tpu.native import bindings
+        return bindings.decompress(payload)
+    if codec == "zlib":
+        import zlib
+        return zlib.decompress(payload)
+    return payload
+
+
+def write_one_batch(rb: pa.RecordBatch, out: BinaryIO,
+                    codec: Optional[str] = None) -> int:
+    """Write one frame; returns bytes written."""
+    codec = codec or conf.get("auron.shuffle.compression.codec")
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    payload = _compress(sink.getvalue(), codec)
+    header = struct.pack("<IB", len(payload), _CODEC_IDS[codec])
+    out.write(header)
+    out.write(payload)
+    return len(header) + len(payload)
+
+
+def read_one_batch(inp: BinaryIO) -> Optional[pa.RecordBatch]:
+    header = inp.read(5)
+    if len(header) < 5:
+        return None
+    n, cid = struct.unpack("<IB", header)
+    payload = inp.read(n)
+    if len(payload) < n:
+        raise EOFError("truncated batch frame")
+    data = _decompress(payload, _CODEC_NAMES[cid])
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        return r.read_next_batch()
+
+
+def read_batches(inp: BinaryIO) -> Iterator[pa.RecordBatch]:
+    while True:
+        rb = read_one_batch(inp)
+        if rb is None:
+            return
+        yield rb
+
+
+def serialize_batches(batches: List[pa.RecordBatch],
+                      codec: Optional[str] = None) -> bytes:
+    sink = io.BytesIO()
+    for rb in batches:
+        write_one_batch(rb, sink, codec=codec)
+    return sink.getvalue()
+
+
+def deserialize_batches(data: bytes) -> List[pa.RecordBatch]:
+    return list(read_batches(io.BytesIO(data)))

@@ -249,6 +249,12 @@ STRING_WIDTH_BUCKETS = conf.define(
     "auron.string.width.buckets", "8,16,32,64,128,256",
     "Fixed string byte-widths used for device string columns.",
 )
+ASCII_CASE_KERNELS = conf.define(
+    "auron.string.ascii.case.enable", False,
+    "Run upper/lower/initcap as device ASCII kernels (fast but byte-level: "
+    "non-ASCII characters keep their case).  Off = exact unicode semantics "
+    "on the host path.",
+)
 DEVICE_STRING_MAX_WIDTH = conf.define(
     "auron.string.device.max.width", 256,
     "Strings longer than this stay host-resident (hybrid execution).",

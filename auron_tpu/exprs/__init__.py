@@ -1,0 +1,21 @@
+"""Expression evaluation.
+
+Two evaluators over the same expression IR:
+
+- `compiler` — the device path: compiles an expr tree into a function over
+  device columns built from jax.numpy ops, jitted (and cached) per
+  (exprs, schema, capacity) by the calling operator.  Analogue of the
+  reference's CachedExprsEvaluator (datafusion-ext-plans/src/common/
+  cached_exprs_evaluator.rs) including its common-subexpression caching.
+- `host_eval` — the host path: numpy/pyarrow evaluation with full Spark
+  semantics; used for expressions that cannot (yet) run on device (regex,
+  json, nested types, big decimals).  The compiler extracts such subtrees as
+  "host islands" and splices their results back in as extra input columns —
+  the analogue of Auron's per-expression JVM-UDF fallback wrapping
+  (spark-extension/.../NativeConverters.scala:277-324).
+"""
+
+from auron_tpu.exprs.compiler import build_evaluator, build_predicate
+from auron_tpu.exprs import host_eval
+
+__all__ = ["build_evaluator", "build_predicate", "host_eval"]

@@ -1,0 +1,154 @@
+"""Spark-semantics casts on device.
+
+Non-ANSI Spark behavior (the reference implements this in
+datafusion-ext-exprs/src/cast.rs): invalid input produces null (never an
+error), float->int truncates toward zero and saturates at the type bounds
+(Java (int)/(long) semantics), NaN -> 0, int narrowing wraps.  String
+parsing casts run on the host path (compiler routes them there).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import DeviceColumn, DeviceStringColumn, bucket_width
+from auron_tpu.exprs.values import flat, string_col
+from auron_tpu.ir.schema import DataType, TypeId
+
+_INT_BOUNDS = {
+    TypeId.INT8: (-2**7, 2**7 - 1),
+    TypeId.INT16: (-2**15, 2**15 - 1),
+    TypeId.INT32: (-2**31, 2**31 - 1),
+    TypeId.INT64: (-2**63, 2**63 - 1),
+}
+
+
+def cast_column(col, dst: DataType, try_: bool = False):
+    src = col.dtype
+    if src.id == dst.id and src.precision == dst.precision \
+            and src.scale == dst.scale:
+        return col
+    if isinstance(col, DeviceStringColumn):
+        if dst.is_stringlike:
+            return DeviceStringColumn(dst, col.data, col.lengths, col.validity)
+        raise NotImplementedError(
+            "string->numeric casts run on the host path")
+    data, valid = col.data, col.validity
+    if dst.is_stringlike:
+        return _int_to_string(col, dst)
+    if dst.id == TypeId.BOOL:
+        return flat(dst, data.astype(bool) if not src.is_floating
+                    else (data != 0), valid)
+    if dst.id == TypeId.DECIMAL:
+        return _to_decimal(col, dst, valid)
+    if src.id == TypeId.DECIMAL:
+        real = data.astype(jnp.float64) / (10.0 ** src.scale)
+        return cast_column(DeviceColumn(DataType.float64(), real, valid), dst,
+                           try_)
+    if dst.is_floating:
+        return flat(dst, data.astype(dst.numpy_dtype()), valid)
+    if dst.id in (TypeId.DATE32, TypeId.TIMESTAMP_US):
+        if src.id == TypeId.TIMESTAMP_US and dst.id == TypeId.DATE32:
+            from auron_tpu.exprs.datetime import ts_days
+            return flat(dst, ts_days(data), valid)
+        if src.id == TypeId.DATE32 and dst.id == TypeId.TIMESTAMP_US:
+            from auron_tpu.exprs.datetime import US_PER_DAY
+            return flat(dst, data.astype(jnp.int64) * US_PER_DAY, valid)
+        return flat(dst, data.astype(dst.numpy_dtype()), valid)
+    # -> integral
+    lo, hi = _INT_BOUNDS[dst.id]
+    if src.is_floating:
+        nan = jnp.isnan(data)
+        clamped = jnp.clip(jnp.where(nan, 0.0, data), lo, hi)
+        out = jnp.trunc(clamped).astype(dst.numpy_dtype())
+        out = jnp.where(nan, 0, out)
+        return flat(dst, out, valid)
+    if src.id in (TypeId.DATE32, TypeId.TIMESTAMP_US):
+        return flat(dst, data.astype(dst.numpy_dtype()), valid)
+    # int -> int narrowing wraps (Java semantics); jnp astype wraps
+    return flat(dst, data.astype(dst.numpy_dtype()), valid)
+
+
+def rescale_half_up(x, div: int):
+    """Divide unscaled ints by 10^k with HALF_UP rounding (sign-correct:
+    operates on magnitude, then restores sign)."""
+    mag = jnp.abs(x)
+    q = mag // div
+    rem = mag - q * div
+    q = q + (2 * rem >= div).astype(q.dtype)
+    return jnp.sign(x) * q
+
+
+def _to_decimal(col, dst: DataType, valid):
+    src = col.dtype
+    scale_mult = 10 ** dst.scale
+    if src.id == TypeId.DECIMAL:
+        shift = dst.scale - src.scale
+        if shift >= 0:
+            unscaled = col.data * (10 ** shift)
+        else:
+            unscaled = rescale_half_up(col.data, 10 ** (-shift))
+    elif src.is_floating:
+        scaled = data_round_half_up(col.data.astype(jnp.float64) * scale_mult)
+        unscaled = scaled.astype(jnp.int64)
+    else:
+        unscaled = col.data.astype(jnp.int64) * scale_mult
+    # overflow beyond precision -> null (CheckOverflow semantics)
+    bound = 10 ** dst.precision
+    ok = jnp.logical_and(unscaled > -bound, unscaled < bound)
+    return flat(dst, unscaled, jnp.logical_and(valid, ok))
+
+
+def data_round_half_up(x):
+    return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
+
+
+_MAX_I64_DIGITS = 20  # sign + 19 digits
+
+
+def _int_to_string(col: DeviceColumn, dst: DataType) -> DeviceStringColumn:
+    """Integer/bool -> decimal text on device."""
+    cap = col.data.shape[0]
+    if col.dtype.id == TypeId.BOOL:
+        w = bucket_width(5)
+        t = np.zeros((1, w), np.uint8)
+        f = np.zeros((1, w), np.uint8)
+        t[0, :4] = np.frombuffer(b"true", np.uint8)
+        f[0, :5] = np.frombuffer(b"false", np.uint8)
+        tj, fj = jnp.asarray(t), jnp.asarray(f)
+        b = col.data.astype(bool)
+        data = jnp.where(b[:, None], tj, fj)
+        lens = jnp.where(b, 4, 5).astype(jnp.int32)
+        return string_col(dst, data, lens, col.validity)
+    v = col.data.astype(jnp.int64)
+    neg = v < 0
+    # magnitude in uint64 so INT64_MIN (whose negation overflows i64) still
+    # yields the right digits
+    vu = v.astype(jnp.uint64)
+    mag = jnp.where(neg, (~vu) + jnp.uint64(1), vu)
+    w = bucket_width(_MAX_I64_DIGITS)
+    digits = []
+    x = mag
+    for _ in range(19):
+        digits.append((x % jnp.uint64(10)).astype(jnp.uint8))
+        x = x // jnp.uint64(10)
+    dmat = jnp.stack(digits[::-1], axis=1)  # [cap, 19] most-significant first
+    ndig = jnp.maximum(
+        19 - jnp.argmax(dmat != 0, axis=1), 1).astype(jnp.int32)
+    all_zero = jnp.all(dmat == 0, axis=1)
+    ndig = jnp.where(all_zero, 1, ndig)
+    lens = ndig + neg.astype(jnp.int32)
+    out = jnp.zeros((cap, w), jnp.uint8)
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    # digit at output position p (after optional sign): index into dmat
+    start = 19 - ndig
+    src_idx = start[:, None] + (pos - neg.astype(jnp.int32)[:, None])
+    dig = jnp.take_along_axis(dmat, jnp.clip(src_idx, 0, 18), axis=1)
+    chars = dig + ord("0")
+    in_digits = jnp.logical_and(pos >= neg.astype(jnp.int32)[:, None],
+                                pos < lens[:, None])
+    out = jnp.where(in_digits, chars, out)
+    sign_here = jnp.logical_and(neg[:, None], pos == 0)
+    out = jnp.where(sign_here, ord("-"), out)
+    return string_col(dst, out.astype(jnp.uint8), lens, col.validity)

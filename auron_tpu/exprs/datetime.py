@@ -1,0 +1,166 @@
+"""Date/time kernels on device.
+
+DATE32 = int32 days since 1970-01-01; TIMESTAMP_US = int64 microseconds
+since epoch (UTC; session timezones are a front-end concern).  Calendar
+decomposition uses Howard Hinnant's civil-from-days algorithm — pure integer
+arithmetic, fully vectorized, no lookup tables.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+US_PER_DAY = 86_400_000_000
+US_PER_SECOND = 1_000_000
+
+
+def civil_from_days(days):
+    """days since epoch (int32/int64 array) -> (year, month, day)."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                                  # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)         # [0, 365]
+    mp = (5 * doy + 2) // 153                               # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                       # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)                  # [1, 12]
+    year = jnp.where(m <= 2, y + 1, y)
+    return year.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def year(days):  return civil_from_days(days)[0]
+def month(days): return civil_from_days(days)[1]
+def day(days):   return civil_from_days(days)[2]
+
+
+def quarter(days):
+    return (civil_from_days(days)[1] - 1) // 3 + 1
+
+
+def day_of_week(days):
+    """Spark dayofweek: 1 = Sunday ... 7 = Saturday; epoch was a Thursday."""
+    d = days.astype(jnp.int64)
+    return (((d % 7) + 7 + 4) % 7 + 1).astype(jnp.int32)
+
+
+def day_of_year(days):
+    y, _, _ = civil_from_days(days)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return (days.astype(jnp.int32) - jan1 + 1).astype(jnp.int32)
+
+
+def week_of_year(days):
+    """ISO-8601 week number (Spark weekofyear)."""
+    d = days.astype(jnp.int64)
+    # ISO: week of the Thursday of this week
+    dow_mon0 = (d + 3) % 7          # Monday=0 ... Sunday=6
+    thursday = d - dow_mon0 + 3
+    y, _, _ = civil_from_days(thursday)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return ((thursday - jan1) // 7 + 1).astype(jnp.int32)
+
+
+def last_day(days):
+    y, m, _ = civil_from_days(days)
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    first_next = days_from_civil(ny, nm, jnp.ones_like(nm))
+    return (first_next - 1).astype(jnp.int32)
+
+
+def make_date(y, m, d):
+    """Spark make_date; invalid component combos yield garbage values —
+    callers mask with a validity check (1<=m<=12, 1<=d<=31 refined below)."""
+    return days_from_civil(y, m, d)
+
+
+def make_date_valid(y, m, d):
+    days = days_from_civil(y, m, d)
+    y2, m2, d2 = civil_from_days(days.astype(jnp.int32))
+    return jnp.logical_and(
+        jnp.logical_and(y2 == y.astype(jnp.int32), m2 == m.astype(jnp.int32)),
+        d2 == d.astype(jnp.int32))
+
+
+# -- timestamp decomposition -------------------------------------------------
+
+def ts_days(us):
+    """Floor-division days for a microsecond timestamp (handles negatives)."""
+    return jnp.floor_divide(us, US_PER_DAY).astype(jnp.int32)
+
+
+def ts_time_of_day_us(us):
+    return us - ts_days(us).astype(jnp.int64) * US_PER_DAY
+
+
+def hour(us):
+    return (ts_time_of_day_us(us) // 3_600_000_000).astype(jnp.int32)
+
+
+def minute(us):
+    return ((ts_time_of_day_us(us) // 60_000_000) % 60).astype(jnp.int32)
+
+
+def second(us):
+    return ((ts_time_of_day_us(us) // US_PER_SECOND) % 60).astype(jnp.int32)
+
+
+def date_trunc_us(us, unit: str):
+    """Truncate a timestamp to unit; returns int64 microseconds."""
+    unit = unit.lower()
+    if unit in ("microsecond", "us"):
+        return us
+    if unit in ("millisecond", "ms"):
+        return (us // 1000) * 1000
+    if unit in ("second",):
+        return (us // US_PER_SECOND) * US_PER_SECOND
+    if unit in ("minute",):
+        return (us // 60_000_000) * 60_000_000
+    if unit in ("hour",):
+        return (us // 3_600_000_000) * 3_600_000_000
+    days = ts_days(us)
+    if unit in ("day", "dd"):
+        return days.astype(jnp.int64) * US_PER_DAY
+    y, m, d = civil_from_days(days)
+    one = jnp.ones_like(y)
+    if unit in ("week",):
+        dow_mon0 = ((days.astype(jnp.int64) + 3) % 7)
+        return (days.astype(jnp.int64) - dow_mon0) * US_PER_DAY
+    if unit in ("month", "mon", "mm"):
+        return days_from_civil(y, m, one).astype(jnp.int64) * US_PER_DAY
+    if unit in ("quarter",):
+        qm = ((m - 1) // 3) * 3 + 1
+        return days_from_civil(y, qm, one).astype(jnp.int64) * US_PER_DAY
+    if unit in ("year", "yyyy", "yy"):
+        return days_from_civil(y, one, one).astype(jnp.int64) * US_PER_DAY
+    raise ValueError(f"unsupported date_trunc unit {unit!r}")
+
+
+def months_between(d1_days, d2_days):
+    """Spark months_between over date32 inputs (float64 result, day
+    component scaled by 31-day months, matching Spark when times are 0)."""
+    y1, m1, dd1 = civil_from_days(d1_days)
+    y2, m2, dd2 = civil_from_days(d2_days)
+    last1 = last_day(d1_days)
+    last2 = last_day(d2_days)
+    both_last = jnp.logical_and(d1_days == last1, d2_days == last2)
+    months = (y1 - y2) * 12 + (m1 - m2)
+    frac = (dd1 - dd2).astype(jnp.float64) / 31.0
+    same_day = dd1 == dd2
+    use_whole = jnp.logical_or(both_last, same_day)
+    return jnp.where(use_whole, months.astype(jnp.float64),
+                     months.astype(jnp.float64) + frac)

@@ -1,0 +1,694 @@
+"""Host scalar-function implementations (fallback path + test oracle).
+
+Covers the full function vocabulary (ir/functions.py), including the
+families that never run on device: regex, json (get_json_object — analogue
+of spark_get_json_object.rs), crypto digests, collections, str_to_map.
+Per-row python is acceptable here: this path handles the tail of
+expressions, not the hot loop.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import json
+import math
+import re
+import zlib
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from auron_tpu.exprs.host_eval import HV, _from_pylist, _EPOCH_DATE
+from auron_tpu.exprs.typing import infer_type
+from auron_tpu.ir.schema import DataType, Schema, TypeId
+
+
+def eval_function(expr, rec, n: int, schema: Schema) -> HV:
+    name = expr.name
+    args = [rec(a) for a in expr.args]
+    fn = _FUNCS.get(name)
+    if fn is None:
+        raise NotImplementedError(f"host function {name!r}")
+    out_dt = None
+    try:
+        out_dt = infer_type(expr, schema)
+    except TypeError:
+        pass
+    return fn(args, n, out_dt)
+
+
+def _rowwise(out_dt_default: DataType, fn: Callable, nulls_propagate=True):
+    """Lift a python scalar function over rows (None in -> None out)."""
+    def impl(args: List[HV], n: int, out_dt) -> HV:
+        dt = out_dt or out_dt_default
+        out, mask = [], np.zeros(n, bool)
+        for i in range(n):
+            row = [a.vals[i] if a.mask[i] else None for a in args]
+            if nulls_propagate and any(v is None for v in row):
+                out.append(None)
+                continue
+            try:
+                v = fn(*row)
+            except (ValueError, ZeroDivisionError, ArithmeticError,
+                    IndexError, TypeError):
+                v = None
+            mask[i] = v is not None
+            out.append(v)
+        return _from_pylist(out, mask, dt)
+    return impl
+
+
+def _f64(fn):
+    return _rowwise(DataType.float64(), lambda *a: _nan_to_none_guard(fn, a))
+
+
+def _nan_to_none_guard(fn, a):
+    try:
+        v = fn(*[float(x) for x in a])
+    except (ValueError, OverflowError):
+        return float("nan")
+    return v
+
+
+def _str(s) -> str:
+    return s.decode("utf-8", "replace") if isinstance(s, bytes) else str(s)
+
+
+def _days_to_date(v) -> _dt.date:
+    return _EPOCH_DATE + _dt.timedelta(days=int(v))
+
+
+# -- date helpers ------------------------------------------------------------
+
+def _as_date(v):
+    if isinstance(v, (int, np.integer)):
+        return _days_to_date(v)
+    return v
+
+
+def _iso_week(d: _dt.date) -> int:
+    return d.isocalendar()[1]
+
+
+def _last_day(v):
+    d = _as_date(v)
+    ny, nm = (d.year + 1, 1) if d.month == 12 else (d.year, d.month + 1)
+    return (_dt.date(ny, nm, 1) - _dt.timedelta(days=1) - _EPOCH_DATE).days
+
+
+_DOW = {"SU": 6, "MO": 0, "TU": 1, "WE": 2, "TH": 3, "FR": 4, "SA": 5}
+
+
+def _next_day(v, day_name):
+    d = _as_date(v)
+    target = _DOW.get(str(day_name)[:2].upper())
+    if target is None:
+        return None
+    delta = (target - d.weekday() + 7) % 7
+    return (d - _EPOCH_DATE).days + (delta if delta else 7)
+
+
+def _ts_us_to_dt(us) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(int(us) / 1e6, tz=_dt.timezone.utc)
+
+
+# -- json --------------------------------------------------------------------
+
+_JSON_PATH_RE = re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]|\['([^']+)'\]")
+
+
+def _get_json_object(s, path):
+    s, path = _str(s), _str(path)
+    if not path.startswith("$"):
+        return None
+    try:
+        obj = json.loads(s)
+    except json.JSONDecodeError:
+        return None
+    pos = 1
+    for m in _JSON_PATH_RE.finditer(path, 1):
+        if m.start() != pos:
+            return None
+        pos = m.end()
+        key = m.group(1) or m.group(3)
+        if key is not None:
+            if not isinstance(obj, dict) or key not in obj:
+                return None
+            obj = obj[key]
+        else:
+            idx = int(m.group(2))
+            if not isinstance(obj, list) or idx >= len(obj):
+                return None
+            obj = obj[idx]
+    if pos != len(path):
+        return None
+    if obj is None:
+        return None
+    if isinstance(obj, str):
+        return obj
+    return json.dumps(obj, separators=(",", ":"))
+
+
+# -- string helpers ----------------------------------------------------------
+
+def _split_part(s, sep, k):
+    parts = _str(s).split(_str(sep)) if sep else [s]
+    k = int(k)
+    if k == 0:
+        return None
+    idx = k - 1 if k > 0 else len(parts) + k
+    return parts[idx] if 0 <= idx < len(parts) else ""
+
+
+def _translate(s, frm, to):
+    table = {}
+    frm, to = _str(frm), _str(to)
+    for i, ch in enumerate(frm):
+        table[ord(ch)] = to[i] if i < len(to) else None
+    return _str(s).translate(table)
+
+
+def _levenshtein(a, b):
+    a, b = _str(a), _str(b)
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def _find_in_set(s, csv):
+    parts = _str(csv).split(",")
+    s = _str(s)
+    if "," in s:
+        return 0
+    try:
+        return parts.index(s) + 1
+    except ValueError:
+        return 0
+
+
+def _initcap(s):
+    return re.sub(r"[A-Za-z0-9]+", lambda m: m.group(0).capitalize(), _str(s))
+
+
+def _regexp_extract(s, pattern, idx=1):
+    m = re.search(_str(pattern), _str(s))
+    if m is None:
+        return ""
+    return m.group(int(idx)) or ""
+
+
+def _str_to_map(s, pair_sep=",", kv_sep=":"):
+    out = []
+    for pair in _str(s).split(_str(pair_sep)):
+        if _str(kv_sep) in pair:
+            k, v = pair.split(_str(kv_sep), 1)
+            out.append((k, v))
+        else:
+            out.append((pair, None))
+    return out
+
+
+# -- collection helpers ------------------------------------------------------
+
+def _array_union_impl(a, b):
+    seen, out = set(), []
+    for x in list(a) + list(b):
+        key = json.dumps(x, sort_keys=True, default=str)
+        if key not in seen:
+            seen.add(key)
+            out.append(x)
+    return out
+
+
+def _sort_array(a, asc=True):
+    return sorted(a, key=lambda x: (x is None, x), reverse=not asc)
+
+
+def _element_at(c, k):
+    if isinstance(c, list) and isinstance(k, (int, np.integer)):
+        k = int(k)
+        if k == 0:
+            return None
+        idx = k - 1 if k > 0 else len(c) + k
+        return c[idx] if 0 <= idx < len(c) else None
+    if isinstance(c, list):  # map as list of pairs
+        for kk, vv in c:
+            if kk == k:
+                return vv
+    return None
+
+
+# -- special multi-arg functions --------------------------------------------
+
+def _concat_ws(args: List[HV], n: int, out_dt) -> HV:
+    out, mask = [], np.zeros(n, bool)
+    for i in range(n):
+        if not args[0].mask[i]:
+            out.append(None)
+            continue
+        sep = _str(args[0].vals[i])
+        parts = [_str(a.vals[i]) for a in args[1:] if a.mask[i]]
+        out.append(sep.join(parts))
+        mask[i] = True
+    return _from_pylist(out, mask, DataType.string())
+
+
+def _make_array(args: List[HV], n: int, out_dt) -> HV:
+    out = []
+    for i in range(n):
+        out.append([a.vals[i].item() if isinstance(a.vals[i], np.generic)
+                    else a.vals[i] if a.mask[i] else None for a in args])
+    dt = out_dt or DataType.list_(args[0].dtype if args else DataType.int32())
+    return HV(np.array(out, dtype=object), np.ones(n, bool), dt)
+
+
+def _map_fn(args: List[HV], n: int, out_dt) -> HV:
+    out = []
+    for i in range(n):
+        pairs = []
+        for j in range(0, len(args) - 1, 2):
+            k = args[j].vals[i] if args[j].mask[i] else None
+            v = args[j + 1].vals[i] if args[j + 1].mask[i] else None
+            pairs.append((k, v))
+        out.append(pairs)
+    dt = out_dt or DataType.map_(DataType.string(), DataType.string())
+    return HV(np.array(out, dtype=object), np.ones(n, bool), dt)
+
+
+def _json_tuple(args: List[HV], n: int, out_dt) -> HV:
+    # returns struct-like list of extracted fields; Generate handles fan-out
+    out, mask = [], np.zeros(n, bool)
+    for i in range(n):
+        if not args[0].mask[i]:
+            out.append(None)
+            continue
+        vals = [_get_json_object(args[0].vals[i], "$." + _str(a.vals[i]))
+                if a.mask[i] else None for a in args[1:]]
+        out.append(vals)
+        mask[i] = True
+    dt = out_dt or DataType.list_(DataType.string())
+    return HV(np.array(out, dtype=object), mask, dt)
+
+
+def _digest(algo: str):
+    def impl(s):
+        data = s if isinstance(s, bytes) else _str(s).encode("utf-8")
+        return getattr(hashlib, algo)(data).hexdigest()
+    return impl
+
+
+def _murmur3_host(args: List[HV], n: int, out_dt) -> HV:
+    from auron_tpu.native import bindings
+    h = np.full(n, 42, dtype=np.int64)
+    for a in args:
+        for i in range(n):
+            if not a.mask[i]:
+                continue
+            v = a.vals[i]
+            seed = int(h[i]) & 0xFFFFFFFF
+            if a.dtype.is_stringlike:
+                h[i] = bindings.murmur3_32(_str(v).encode("utf-8"), seed)
+            elif a.dtype.id in (TypeId.INT64, TypeId.TIMESTAMP_US,
+                                TypeId.DECIMAL):
+                h[i] = bindings.murmur3_32(
+                    int(v).to_bytes(8, "little", signed=True), seed)
+            elif a.dtype.id == TypeId.FLOAT64:
+                f = float(v)
+                f = 0.0 if f == 0.0 else f
+                import struct as _struct
+                h[i] = bindings.murmur3_32(_struct.pack("<d", f), seed)
+            elif a.dtype.id == TypeId.FLOAT32:
+                f = np.float32(0.0 if v == 0 else v)
+                h[i] = bindings.murmur3_32(f.tobytes(), seed)
+            else:
+                h[i] = bindings.murmur3_32(
+                    int(v).to_bytes(4, "little", signed=True), seed)
+    return HV(h.astype(np.int32), np.ones(n, bool), DataType.int32())
+
+
+def _xxhash64_host(args: List[HV], n: int, out_dt) -> HV:
+    from auron_tpu.native import bindings
+    h = np.full(n, 42, dtype=np.uint64)
+    for a in args:
+        for i in range(n):
+            if not a.mask[i]:
+                continue
+            v = a.vals[i]
+            seed = int(h[i])
+            if a.dtype.is_stringlike:
+                h[i] = bindings.xxhash64(_str(v).encode("utf-8"), seed)
+            else:
+                h[i] = bindings.xxhash64(
+                    int(v).to_bytes(8, "little", signed=True), seed)
+    return HV(h.view(np.int64) if hasattr(h, "view") else h,
+              np.ones(n, bool), DataType.int64())
+
+
+_FUNCS: Dict[str, Callable] = {
+    # math (host mirrors of device kernels for oracle use)
+    "abs": _rowwise(DataType.float64(), lambda x: abs(x)),
+    "acos": _f64(math.acos), "acosh": _f64(math.acosh),
+    "asin": _f64(math.asin), "atan": _f64(math.atan),
+    "atan2": _f64(math.atan2),
+    # NaN -> 0, +/-inf clamp: Java .toLong semantics after Math.ceil/floor
+    "ceil": _rowwise(DataType.int64(), lambda x: _to_long(math.ceil(x))
+                     if not (isinstance(x, float) and
+                             (math.isnan(x) or math.isinf(x)))
+                     else _to_long(x)),
+    "floor": _rowwise(DataType.int64(), lambda x: _to_long(math.floor(x))
+                      if not (isinstance(x, float) and
+                              (math.isnan(x) or math.isinf(x)))
+                      else _to_long(x)),
+    "cos": _f64(math.cos), "cosh": _f64(math.cosh), "exp": _f64(math.exp),
+    "expm1": _f64(math.expm1), "ln": _f64(math.log), "log": _f64(math.log),
+    "log10": _f64(math.log10), "log2": _f64(math.log2),
+    "power": _f64(math.pow), "sin": _f64(math.sin), "sinh": _f64(math.sinh),
+    "sqrt": _f64(math.sqrt), "tan": _f64(math.tan), "tanh": _f64(math.tanh),
+    "signum": _rowwise(DataType.float64(), lambda x: float(np.sign(x))),
+    "factorial": _rowwise(DataType.int64(),
+                          lambda x: math.factorial(int(x))
+                          if 0 <= int(x) <= 20 else None),
+    # spark isnan(NULL) = false (never null)
+    "is_nan": _rowwise(DataType.bool_(),
+                       lambda x: x is not None and isinstance(x, float)
+                       and math.isnan(x), nulls_propagate=False),
+    # strings
+    "upper": _rowwise(DataType.string(), lambda s: _str(s).upper()),
+    "lower": _rowwise(DataType.string(), lambda s: _str(s).lower()),
+    "initcap": _rowwise(DataType.string(), _initcap),
+    "trim": _rowwise(DataType.string(),
+                     lambda s, c=" ": _str(s).strip(_str(c))),
+    "btrim": _rowwise(DataType.string(),
+                      lambda s, c=" ": _str(s).strip(_str(c))),
+    "ltrim": _rowwise(DataType.string(),
+                      lambda s, c=" ": _str(s).lstrip(_str(c))),
+    "rtrim": _rowwise(DataType.string(),
+                      lambda s, c=" ": _str(s).rstrip(_str(c))),
+    "reverse": _rowwise(DataType.string(), lambda s: _str(s)[::-1]),
+    "character_length": _rowwise(DataType.int32(), lambda s: len(_str(s))),
+    "octet_length": _rowwise(DataType.int32(),
+                             lambda s: len(_str(s).encode("utf-8"))),
+    "bit_length": _rowwise(DataType.int32(),
+                           lambda s: 8 * len(_str(s).encode("utf-8"))),
+    "ascii": _rowwise(DataType.int32(),
+                      lambda s: ord(_str(s)[0]) if _str(s) else 0),
+    "chr": _rowwise(DataType.string(), lambda x: chr(int(x) % 256)
+                    if int(x) >= 0 else ""),
+    "concat": _rowwise(DataType.string(),
+                       lambda *a: "".join(_str(x) for x in a)),
+    "concat_ws": _concat_ws,
+    "substr": _rowwise(DataType.string(), lambda s, p, l=None: _substr_impl(
+        _str(s), int(p), None if l is None else int(l))),
+    "left": _rowwise(DataType.string(),
+                     lambda s, k: _str(s)[:max(int(k), 0)]),
+    "right": _rowwise(DataType.string(),
+                      lambda s, k: _str(s)[-int(k):] if int(k) > 0 else ""),
+    "lpad": _rowwise(DataType.string(), lambda s, n, p=" ": _pad_impl(
+        _str(s), int(n), _str(p), True)),
+    "rpad": _rowwise(DataType.string(), lambda s, n, p=" ": _pad_impl(
+        _str(s), int(n), _str(p), False)),
+    "repeat": _rowwise(DataType.string(),
+                       lambda s, k: _str(s) * max(int(k), 0)),
+    "replace": _rowwise(DataType.string(),
+                        lambda s, a, b="": _str(s).replace(_str(a), _str(b))),
+    "split_part": _rowwise(DataType.string(), _split_part),
+    "starts_with": _rowwise(DataType.bool_(),
+                            lambda s, p: _str(s).startswith(_str(p))),
+    "ends_with": _rowwise(DataType.bool_(),
+                          lambda s, p: _str(s).endswith(_str(p))),
+    "contains": _rowwise(DataType.bool_(), lambda s, p: _str(p) in _str(s)),
+    "strpos": _rowwise(DataType.int32(),
+                       lambda s, p: _str(s).find(_str(p)) + 1),
+    "translate": _rowwise(DataType.string(), _translate),
+    "levenshtein": _rowwise(DataType.int32(), _levenshtein),
+    "find_in_set": _rowwise(DataType.int32(), _find_in_set),
+    "string_space": _rowwise(DataType.string(), lambda k: " " * max(int(k), 0)),
+    "string_split": _rowwise(DataType.list_(DataType.string()),
+                             lambda s, sep: _str(s).split(_str(sep))),
+    "regexp_match": _rowwise(DataType.bool_(),
+                             lambda s, p: re.search(_str(p), _str(s))
+                             is not None),
+    "regexp_replace": _rowwise(DataType.string(),
+                               lambda s, p, r: re.sub(_str(p), _str(r),
+                                                      _str(s))),
+    "regexp_extract": _rowwise(DataType.string(), _regexp_extract),
+    # json
+    "get_json_object": _rowwise(DataType.string(), _get_json_object),
+    "get_parsed_json_object": _rowwise(DataType.string(), _get_json_object),
+    "parse_json": _rowwise(DataType.string(), lambda s: _str(s)),
+    "json_tuple": _json_tuple,
+    # dates
+    "year": _rowwise(DataType.int32(), lambda d: _as_date(d).year),
+    "quarter": _rowwise(DataType.int32(),
+                        lambda d: (_as_date(d).month - 1) // 3 + 1),
+    "month": _rowwise(DataType.int32(), lambda d: _as_date(d).month),
+    "day": _rowwise(DataType.int32(), lambda d: _as_date(d).day),
+    "day_of_week": _rowwise(DataType.int32(),
+                            lambda d: (_as_date(d).weekday() + 1) % 7 + 1),
+    "week_of_year": _rowwise(DataType.int32(), lambda d: _iso_week(_as_date(d))),
+    "hour": _rowwise(DataType.int32(), lambda t: _ts_us_to_dt(t).hour),
+    "minute": _rowwise(DataType.int32(), lambda t: _ts_us_to_dt(t).minute),
+    "second": _rowwise(DataType.int32(), lambda t: _ts_us_to_dt(t).second),
+    "make_date": _rowwise(DataType.date32(), lambda y, m, d: (
+        _dt.date(int(y), int(m), int(d)) - _EPOCH_DATE).days),
+    "date_add": _rowwise(DataType.date32(), lambda d, k: int(d) + int(k)),
+    "date_sub": _rowwise(DataType.date32(), lambda d, k: int(d) - int(k)),
+    "datediff": _rowwise(DataType.int32(), lambda a, b: int(a) - int(b)),
+    "last_day": _rowwise(DataType.date32(), _last_day),
+    "next_day": _rowwise(DataType.date32(), _next_day),
+    "unix_timestamp": _rowwise(DataType.int64(), lambda t: int(t) // 1_000_000),
+    "from_unixtime": _rowwise(DataType.string(), lambda t: _ts_us_to_dt(
+        int(t) * 1_000_000).strftime("%Y-%m-%d %H:%M:%S")),
+    # conditional / generic (oracle mirrors of device kernels)
+    "coalesce": lambda args, n, dt: _coalesce_host(args, n, dt),
+    "nvl": lambda args, n, dt: _coalesce_host(args, n, dt),
+    "nvl2": lambda args, n, dt: _nvl2_host(args, n, dt),
+    "null_if": lambda args, n, dt: _null_if_host(args, n, dt),
+    "null_if_zero": _rowwise(DataType.float64(),
+                             lambda x: None if x == 0 else x),
+    "least": lambda args, n, dt: _least_greatest_host(args, n, dt, True),
+    "greatest": lambda args, n, dt: _least_greatest_host(args, n, dt, False),
+    "round": _rowwise(DataType.float64(), lambda x, s=0: _round_half_up(x, s)),
+    "bround": _rowwise(DataType.float64(),
+                       lambda x, s=0: _round_half_even(x, s)),
+    "trunc": _rowwise(DataType.float64(), lambda x: math.trunc(float(x))),
+    "expm1": _f64(math.expm1),
+    # decimal / spark-specific
+    "unscaled_value": lambda args, n, dt: HV(
+        args[0].vals.astype(np.int64), args[0].mask.copy(), DataType.int64()),
+    "make_decimal": lambda args, n, dt: _make_decimal_host(args, n, dt),
+    "check_overflow": lambda args, n, dt: _check_overflow_host(args, n, dt),
+    "normalize_nan_and_zero": _rowwise(
+        DataType.float64(), lambda x: 0.0 if x == 0 else float(x),
+    ),
+    # timestamps
+    "to_timestamp_seconds": _rowwise(DataType.timestamp_us(),
+                                     lambda v: int(v) * 1_000_000),
+    "to_timestamp_millis": _rowwise(DataType.timestamp_us(),
+                                    lambda v: int(v) * 1_000),
+    "to_timestamp_micros": _rowwise(DataType.timestamp_us(),
+                                    lambda v: int(v)),
+    "months_between": lambda args, n, dt: _months_between_host(args, n),
+    "date_trunc": lambda args, n, dt: _date_trunc_host(args, n),
+    # crypto / hash
+    "md5": _rowwise(DataType.string(), _digest("md5")),
+    "sha224": _rowwise(DataType.string(), _digest("sha224")),
+    "sha256": _rowwise(DataType.string(), _digest("sha256")),
+    "sha384": _rowwise(DataType.string(), _digest("sha384")),
+    "sha512": _rowwise(DataType.string(), _digest("sha512")),
+    "crc32": _rowwise(DataType.int64(), lambda s: zlib.crc32(
+        s if isinstance(s, bytes) else _str(s).encode("utf-8"))),
+    "hex": _rowwise(DataType.string(), lambda v: format(int(v), "X")
+                    if isinstance(v, (int, np.integer))
+                    else _str(v).encode("utf-8").hex().upper()),
+    "unhex": _rowwise(DataType.binary(), lambda s: bytes.fromhex(_str(s))),
+    "murmur3_hash": _murmur3_host,
+    "xxhash64": _xxhash64_host,
+    # collections
+    "make_array": _make_array,
+    "array_contains": _rowwise(DataType.bool_(), lambda a, v: v in a),
+    "array_union": _rowwise(DataType.list_(DataType.string()),
+                            _array_union_impl),
+    "brickhouse_array_union": _rowwise(DataType.list_(DataType.string()),
+                                       _array_union_impl),
+    "map": _map_fn,
+    "map_from_arrays": _rowwise(
+        DataType.map_(DataType.string(), DataType.string()),
+        lambda k, v: list(zip(k, v))),
+    "map_from_entries": _rowwise(
+        DataType.map_(DataType.string(), DataType.string()),
+        lambda e: [tuple(x) if not isinstance(x, tuple) else x for x in e]),
+    "map_concat": _rowwise(
+        DataType.map_(DataType.string(), DataType.string()),
+        lambda *ms: [p for m in ms for p in m]),
+    "str_to_map": _rowwise(
+        DataType.map_(DataType.string(), DataType.string()), _str_to_map),
+    "size": _rowwise(DataType.int32(),
+                     lambda c: len(c) if c is not None else -1,
+                     nulls_propagate=False),
+    "sort_array": _rowwise(DataType.list_(DataType.string()), _sort_array),
+    "element_at": _rowwise(DataType.string(), _element_at),
+}
+
+
+def _to_long(x) -> int:
+    if isinstance(x, float):
+        if math.isnan(x):
+            return 0
+        if math.isinf(x):
+            return (2**63 - 1) if x > 0 else -(2**63)
+    return int(x)
+
+
+def _coalesce_host(args: List[HV], n: int, out_dt) -> HV:
+    dt = out_dt or args[0].dtype
+    vals = args[0].vals.copy()
+    mask = args[0].mask.copy()
+    for a in args[1:]:
+        use = ~mask & a.mask
+        vals = np.where(use, a.vals.astype(vals.dtype)
+                        if vals.dtype != object else a.vals, vals)
+        mask |= a.mask
+    return HV(vals, mask, dt)
+
+
+def _nvl2_host(args: List[HV], n: int, out_dt) -> HV:
+    cond = args[0].mask
+    b, c = args[1], args[2]
+    vals = np.where(cond, b.vals, c.vals.astype(b.vals.dtype)
+                    if b.vals.dtype != object else c.vals)
+    mask = np.where(cond, b.mask, c.mask)
+    return HV(vals, mask, out_dt or b.dtype)
+
+
+def _null_if_host(args: List[HV], n: int, out_dt) -> HV:
+    a, b = args[0], args[1]
+    eq = np.array([x == y for x, y in zip(a.vals, b.vals)]) \
+        if a.vals.dtype == object else (a.vals == b.vals)
+    kill = eq & b.mask
+    return HV(a.vals, a.mask & ~kill, a.dtype)
+
+
+def _least_greatest_host(args: List[HV], n: int, out_dt, is_least: bool) -> HV:
+    from auron_tpu.exprs.values import promote
+    from auron_tpu.exprs.host_eval import _num
+    t = args[0].dtype
+    for a in args[1:]:
+        t = promote(t, a.dtype)
+    if t.is_stringlike:
+        vals = args[0].vals.copy()
+    else:
+        vals = _num(args[0], t).copy()
+    mask = args[0].mask.copy()
+    for a in args[1:]:
+        av = a.vals if t.is_stringlike else _num(a, t)
+        pick = a.mask & (~mask | ((av < vals) if is_least else (av > vals)))
+        vals = np.where(pick, av, vals)
+        mask |= a.mask
+    return HV(vals, mask, t)
+
+
+def _round_half_up(x, s=0):
+    if isinstance(x, float) and (math.isnan(x) or math.isinf(x)):
+        return x
+    m = 10.0 ** int(s)
+    v = float(x) * m
+    return (math.floor(v + 0.5) if v >= 0 else math.ceil(v - 0.5)) / m
+
+
+def _round_half_even(x, s=0):
+    if isinstance(x, float) and (math.isnan(x) or math.isinf(x)):
+        return x
+    m = 10.0 ** int(s)
+    v = float(x) * m
+    fl = math.floor(v)
+    diff = v - fl
+    if diff > 0.5:
+        r = fl + 1
+    elif diff < 0.5:
+        r = fl
+    else:
+        r = fl + (1 if fl % 2 != 0 else 0)
+    return r / m
+
+
+def _make_decimal_host(args: List[HV], n: int, out_dt) -> HV:
+    dt = out_dt if (out_dt is not None and out_dt.id == TypeId.DECIMAL) \
+        else DataType.decimal(18, 0)
+    unscaled = args[0].vals.astype(np.int64)
+    bound = 10 ** dt.precision
+    ok = (unscaled > -bound) & (unscaled < bound)
+    return HV(unscaled, args[0].mask & ok, dt)
+
+
+def _check_overflow_host(args: List[HV], n: int, out_dt) -> HV:
+    from auron_tpu.exprs.host_eval import _cast
+    dt = out_dt if (out_dt is not None and out_dt.id == TypeId.DECIMAL) \
+        else args[0].dtype
+    return _cast(args[0], dt)
+
+
+def _months_between_host(args: List[HV], n: int) -> HV:
+    out = np.zeros(n, np.float64)
+    mask = args[0].mask & args[1].mask
+    for i in range(n):
+        if not mask[i]:
+            continue
+        d1 = _as_date(args[0].vals[i] if args[0].dtype.id != TypeId.TIMESTAMP_US
+                      else int(args[0].vals[i]) // 86_400_000_000)
+        d2 = _as_date(args[1].vals[i] if args[1].dtype.id != TypeId.TIMESTAMP_US
+                      else int(args[1].vals[i]) // 86_400_000_000)
+        months = (d1.year - d2.year) * 12 + (d1.month - d2.month)
+        if d1.day == d2.day or (_last_day((d1 - _EPOCH_DATE).days) ==
+                                (d1 - _EPOCH_DATE).days and
+                                _last_day((d2 - _EPOCH_DATE).days) ==
+                                (d2 - _EPOCH_DATE).days):
+            out[i] = float(months)
+        else:
+            out[i] = months + (d1.day - d2.day) / 31.0
+    return HV(out, mask, DataType.float64())
+
+
+def _date_trunc_host(args: List[HV], n: int) -> HV:
+    # args[0] = unit literal, args[1] = timestamp/date
+    unit = None
+    for i in range(n):
+        if args[0].mask[i]:
+            unit = str(args[0].vals[i])
+            break
+    c = args[1]
+    us = c.vals.astype(np.int64) if c.dtype.id == TypeId.TIMESTAMP_US \
+        else c.vals.astype(np.int64) * 86_400_000_000
+    import jax.numpy as jnp
+    from auron_tpu.exprs.datetime import date_trunc_us
+    out = np.asarray(date_trunc_us(jnp.asarray(us), unit or "day"))
+    return HV(out, c.mask.copy(), DataType.timestamp_us())
+
+
+def _substr_impl(s: str, pos: int, length):
+    n = len(s)
+    if pos > 0:
+        start = pos - 1
+    elif pos < 0:
+        start = max(n + pos, 0)
+    else:
+        start = 0
+    end = n if length is None else min(start + max(length, 0), n)
+    return s[start:end]
+
+
+def _pad_impl(s: str, n: int, pad: str, left: bool) -> str:
+    if n <= len(s):
+        return s[:n]
+    if not pad:
+        return s
+    fill = (pad * ((n - len(s)) // len(pad) + 1))[: n - len(s)]
+    return fill + s if left else s + fill

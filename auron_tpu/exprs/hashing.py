@@ -1,0 +1,209 @@
+"""Spark-compatible hashes as device kernels.
+
+The exchange layer computes partition ids on device:
+pid = pmod(murmur3_hash(keys, seed=42), num_partitions) — exactly the
+reference's shuffle semantics (native-engine/datafusion-ext-plans/src/
+shuffle/mod.rs:164-189, spark_hash.rs), so a mixed deployment (this engine
+for some stages, Spark for others) shuffles identically.
+
+Per-type Spark encoding (Murmur3_x86_32):
+- int8/16/32/bool/date32 -> hashInt(v as i32)
+- int64/timestamp        -> hashLong (two 4-byte blocks, len=8 finalize)
+- float32 -> hashInt(bits), float64 -> hashLong(bits); -0.0 normalized
+- decimal(p<=18) -> hashLong(unscaled)
+- string/binary -> hashUnsafeBytes (4-byte LE blocks + signed tail bytes)
+
+All arithmetic is uint32/int32 on device (no 64-bit mults on the hot path);
+xxhash64 (Spark's XxHash64 expression) uses uint64 ops via jax x64.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import DeviceColumn, DeviceStringColumn
+from auron_tpu.ir.schema import TypeId
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+
+
+def _rotl32(x, r: int):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl32(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(h1, 13)
+    return h1 * np.uint32(5) + np.uint32(0xE6546B64)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.uint32(length)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> 16)
+
+
+def hash_int32(v, seed):
+    """v: int32 array; seed: uint32 array or scalar -> uint32."""
+    k1 = _mix_k1(v.astype(jnp.uint32))
+    h1 = _mix_h1(jnp.asarray(seed, jnp.uint32), k1)
+    return _fmix(h1, 4)
+
+
+def hash_int64(v, seed):
+    v = v.astype(jnp.int64)
+    lo = (v & 0xFFFFFFFF).astype(jnp.uint32)
+    hi = ((v >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+    h1 = _mix_h1(jnp.asarray(seed, jnp.uint32), _mix_k1(lo))
+    h1 = _mix_h1(h1, _mix_k1(hi))
+    return _fmix(h1, 8)
+
+
+def hash_float32(v, seed):
+    v = jnp.where(v == 0.0, 0.0, v)  # -0.0 -> 0.0
+    bits = jax_bitcast_i32(v.astype(jnp.float32))
+    return hash_int32(bits, seed)
+
+
+def hash_float64(v, seed):
+    v = jnp.where(v == 0.0, 0.0, v)
+    lo, hi = f64_bits_u32_pair(v)
+    h1 = _mix_h1(jnp.asarray(seed, jnp.uint32), _mix_k1(lo))
+    h1 = _mix_h1(h1, _mix_k1(hi))
+    return _fmix(h1, 8)
+
+
+def jax_bitcast_i32(v):
+    import jax.lax as lax
+    return lax.bitcast_convert_type(v, jnp.int32)
+
+
+def f64_bits_u32_pair(v):
+    """(lo, hi) uint32 words of the IEEE-754 double bits.
+
+    TPU CAVEAT: XLA's x64 rewrite pass does not implement 64-bit
+    bitcast-convert, and f64 itself is demoted on TPU — so on TPU backends
+    the value is hashed through its float32 bits (hi word = 0).  This keeps
+    partitioning internally consistent across an all-TPU mesh; bit-exact
+    Spark parity for double hashing holds on CPU/GPU backends.
+    """
+    import jax
+    import jax.lax as lax
+    if jax.default_backend() == "cpu" or jax.default_backend() == "gpu":
+        pair = lax.bitcast_convert_type(v.astype(jnp.float64), jnp.uint32)
+        return pair[..., 0], pair[..., 1]
+    bits32 = lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32)
+    return bits32, jnp.zeros_like(bits32)
+
+
+def hash_bytes(data, lengths, seed):
+    """Spark hashUnsafeBytes over padded byte matrices.
+
+    data: uint8[rows, W] zero-padded, lengths: int32[rows].  Processes
+    len//4 4-byte LE blocks then tail bytes individually (as *signed*
+    int8).  W is static, so the loop unrolls into W/4 fused mixes with
+    per-row masking — each row applies exactly the mixes its length needs
+    by carrying an h state per prefix and selecting.
+    """
+    rows, w = data.shape
+    seed = jnp.broadcast_to(jnp.asarray(seed, jnp.uint32), (rows,))
+    nblocks = lengths // 4
+    d32 = data.astype(jnp.uint32)
+    h = seed
+    # full 4-byte blocks: iterate static W//4 positions, masked per row
+    for b in range(w // 4):
+        k = (d32[:, 4 * b] | (d32[:, 4 * b + 1] << 8)
+             | (d32[:, 4 * b + 2] << 16) | (d32[:, 4 * b + 3] << 24))
+        nh = _mix_h1(h, _mix_k1(k))
+        h = jnp.where(b < nblocks, nh, h)
+    # tail bytes (signed), one at a time
+    for t in range(min(3, w)):
+        byte_idx = nblocks * 4 + t
+        in_tail = byte_idx < lengths
+        raw = jnp.take_along_axis(data, jnp.clip(byte_idx, 0, w - 1)[:, None],
+                                  axis=1)[:, 0]
+        signed = raw.astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+        nh = _mix_h1(h, _mix_k1(signed))
+        h = jnp.where(in_tail, nh, h)
+    return _fmix(h, lengths.astype(jnp.uint32))
+
+
+def hash_column(col, seed):
+    """Dispatch per logical type -> uint32 hash; null rows keep the incoming
+    seed unchanged (Spark semantics: nulls don't contribute)."""
+    seed = jnp.asarray(seed, jnp.uint32)
+    if isinstance(col, DeviceStringColumn):
+        h = hash_bytes(col.data, col.lengths, seed)
+    else:
+        tid = col.dtype.id
+        if tid in (TypeId.BOOL,):
+            h = hash_int32(col.data.astype(jnp.int32), seed)
+        elif tid in (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.DATE32):
+            h = hash_int32(col.data.astype(jnp.int32), seed)
+        elif tid in (TypeId.INT64, TypeId.TIMESTAMP_US, TypeId.DECIMAL):
+            h = hash_int64(col.data, seed)
+        elif tid == TypeId.FLOAT32:
+            h = hash_float32(col.data, seed)
+        elif tid == TypeId.FLOAT64:
+            h = hash_float64(col.data, seed)
+        else:
+            raise TypeError(f"unhashable device type {col.dtype}")
+    bseed = jnp.broadcast_to(seed, h.shape)
+    return jnp.where(col.validity, h, bseed)
+
+
+def hash_columns(cols, seed=42):
+    """Chained multi-column hash (each column's hash seeds the next),
+    Spark HashExpression semantics; returns int32."""
+    h = jnp.full(cols[0].capacity if hasattr(cols[0], "capacity")
+                 else cols[0].data.shape[0], np.uint32(seed), jnp.uint32)
+    for c in cols:
+        h = hash_column(c, h)
+    return h.astype(jnp.int32)
+
+
+def pmod(x, m: int):
+    """Positive modulo (partition id from hash)."""
+    r = x % jnp.int32(m)
+    return jnp.where(r < 0, r + m, r)
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 (Spark XxHash64 expression; shuffle checksums)
+# ---------------------------------------------------------------------------
+
+_XP1 = np.uint64(0x9E3779B185EBCA87)
+_XP2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_XP3 = np.uint64(0x165667B19E3779F9)
+_XP4 = np.uint64(0x85EBCA77C2B2AE63)
+_XP5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _xrotl(x, r: int):
+    return (x << r) | (x >> (64 - r))
+
+
+def xxh64_int64(v, seed):
+    """xxhash64 of a single 8-byte value (Spark XxHash64 on longs)."""
+    v = v.astype(jnp.uint64)
+    seed = jnp.asarray(seed, jnp.uint64)
+    h = seed + _XP5 + jnp.uint64(8)
+    k = _xrotl(v * _XP2, 31) * _XP1
+    h = h ^ k
+    h = _xrotl(h, 27) * _XP1 + _XP4
+    h = h ^ (h >> 33)
+    h = h * _XP2
+    h = h ^ (h >> 29)
+    h = h * _XP3
+    return h ^ (h >> 32)

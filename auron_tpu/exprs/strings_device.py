@@ -1,0 +1,354 @@
+"""Device string kernels over the fixed-width padded representation.
+
+Strings live as uint8[capacity, W] + int32 lengths.  All kernels are pure
+jnp (vectorized over rows, unrolled/broadcast over the static width W), so
+XLA fuses them; there is no per-row host work.  The padding invariant
+(bytes >= length are zero) is maintained by every producer.
+
+The reference implements these families in Rust
+(datafusion-ext-functions/src/spark_strings.rs, datafusion-ext-exprs/src/
+string_{starts_with,ends_with,contains}.rs); here they are TPU-shaped:
+comparisons become masked byte-matrix reductions, substring becomes a
+row-wise gather, concat a width-bucketed scatter.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import DeviceStringColumn, bucket_width
+from auron_tpu.exprs.values import string_col
+from auron_tpu.ir.schema import DataType
+
+
+def _positions(w: int):
+    return jnp.arange(w, dtype=jnp.int32)
+
+
+def byte_mask(col: DeviceStringColumn):
+    """bool[capacity, W]: True where a byte is inside the string."""
+    return _positions(col.width)[None, :] < col.lengths[:, None]
+
+
+# ---------------------------------------------------------------------------
+# UTF-8 codepoint machinery: Spark string functions count characters, not
+# bytes.  Per byte we derive (char_id, within-char offset) with cumulative
+# ops over the static width; variable-length byte selection is a per-row
+# stable sort by a position key (W is small, XLA vectorizes across rows).
+# ---------------------------------------------------------------------------
+
+def char_ids(col: DeviceStringColumn):
+    """(char_id[cap,W], nchars[cap]): char_id = codepoint index per byte."""
+    m = byte_mask(col)
+    is_start = jnp.logical_and((col.data & 0xC0) != 0x80, m)
+    cid = jnp.cumsum(is_start.astype(jnp.int32), axis=1) - 1
+    nchars = jnp.sum(is_start, axis=1).astype(jnp.int32)
+    return cid, nchars
+
+
+def take_bytes(col: DeviceStringColumn, keep) -> DeviceStringColumn:
+    """Select bytes by mask, compacting left (stable), per row."""
+    w = col.width
+    pos = _positions(w)[None, :]
+    key = jnp.where(keep, pos, pos + w)      # kept bytes sort first, stable
+    order = jnp.argsort(key, axis=1)
+    data = jnp.take_along_axis(col.data, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    data = jnp.where(pos < new_len[:, None], data, 0)
+    return string_col(col.dtype, data, new_len, col.validity)
+
+
+# ---------------------------------------------------------------------------
+# comparisons
+# ---------------------------------------------------------------------------
+
+def string_eq(a: DeviceStringColumn, b: DeviceStringColumn):
+    w = max(a.width, b.width)
+    da = _pad_width(a.data, w)
+    db = _pad_width(b.data, w)
+    same_bytes = jnp.all(da == db, axis=1)
+    return jnp.logical_and(same_bytes, a.lengths == b.lengths)
+
+
+def string_cmp(a: DeviceStringColumn, b: DeviceStringColumn):
+    """-1/0/+1 lexicographic byte compare (zero padding sorts correctly
+    because pad bytes are 0, below every live byte; ties on shared prefix
+    resolve by length)."""
+    w = max(a.width, b.width)
+    da = _pad_width(a.data, w).astype(jnp.int32)
+    db = _pad_width(b.data, w).astype(jnp.int32)
+    diff = jnp.sign(da - db)
+    # first nonzero byte difference decides
+    idx = jnp.argmax(diff != 0, axis=1)
+    first = jnp.take_along_axis(diff, idx[:, None], axis=1)[:, 0]
+    any_diff = jnp.any(diff != 0, axis=1)
+    len_cmp = jnp.sign(a.lengths - b.lengths)
+    return jnp.where(any_diff, first, len_cmp).astype(jnp.int32)
+
+
+def _pad_width(data, w: int):
+    cur = data.shape[1]
+    if cur == w:
+        return data
+    return jnp.pad(data, ((0, 0), (0, w - cur)))
+
+
+# ---------------------------------------------------------------------------
+# predicates: starts_with / ends_with / contains (literal needle)
+# ---------------------------------------------------------------------------
+
+def starts_with(col: DeviceStringColumn, needle: bytes):
+    k = len(needle)
+    if k == 0:
+        return jnp.ones(col.capacity, bool)
+    if k > col.width:
+        return jnp.zeros(col.capacity, bool)
+    pat = jnp.asarray(np.frombuffer(needle, np.uint8))
+    return jnp.logical_and(col.lengths >= k,
+                           jnp.all(col.data[:, :k] == pat[None, :], axis=1))
+
+
+def ends_with(col: DeviceStringColumn, needle: bytes):
+    k = len(needle)
+    if k == 0:
+        return jnp.ones(col.capacity, bool)
+    if k > col.width:
+        return jnp.zeros(col.capacity, bool)
+    pat = jnp.asarray(np.frombuffer(needle, np.uint8))
+    # gather the last k bytes of each row: positions len-k .. len-1
+    start = jnp.maximum(col.lengths - k, 0)
+    idx = start[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    tail = jnp.take_along_axis(col.data, jnp.minimum(idx, col.width - 1), axis=1)
+    return jnp.logical_and(col.lengths >= k,
+                           jnp.all(tail == pat[None, :], axis=1))
+
+
+def contains(col: DeviceStringColumn, needle: bytes):
+    k = len(needle)
+    if k == 0:
+        return jnp.ones(col.capacity, bool)
+    if k > col.width:
+        return jnp.zeros(col.capacity, bool)
+    pat = jnp.asarray(np.frombuffer(needle, np.uint8))
+    w = col.width
+    # sliding windows: for each offset o in [0, w-k], all k bytes match
+    # (vectorized as a [rows, w-k+1, k] broadcast — XLA fuses the reduce)
+    offs = jnp.arange(w - k + 1, dtype=jnp.int32)
+    win_idx = offs[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]  # [o,k]
+    windows = col.data[:, win_idx]                     # [rows, o, k]
+    match = jnp.all(windows == pat[None, None, :], axis=2)  # [rows, o]
+    inside = offs[None, :] + k <= col.lengths[:, None]
+    return jnp.any(jnp.logical_and(match, inside), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+
+def upper(col: DeviceStringColumn) -> DeviceStringColumn:
+    d = col.data
+    is_lower = jnp.logical_and(d >= ord("a"), d <= ord("z"))
+    return DeviceStringColumn(col.dtype, jnp.where(is_lower, d - 32, d),
+                              col.lengths, col.validity)
+
+
+def lower(col: DeviceStringColumn) -> DeviceStringColumn:
+    d = col.data
+    is_upper = jnp.logical_and(d >= ord("A"), d <= ord("Z"))
+    return DeviceStringColumn(col.dtype, jnp.where(is_upper, d + 32, d),
+                              col.lengths, col.validity)
+
+
+def char_length(col: DeviceStringColumn):
+    """UTF-8 codepoint count: bytes that are not continuation bytes."""
+    m = byte_mask(col)
+    cont = (col.data & 0xC0) == 0x80
+    return jnp.sum(jnp.logical_and(m, jnp.logical_not(cont)),
+                   axis=1).astype(jnp.int32)
+
+
+def octet_length(col: DeviceStringColumn):
+    return col.lengths
+
+
+def reverse(col: DeviceStringColumn) -> DeviceStringColumn:
+    """Codepoint-reverse: chars swap order, bytes within a char keep order
+    (so multi-byte UTF-8 stays valid)."""
+    w = col.width
+    pos = _positions(w)[None, :]
+    m = byte_mask(col)
+    cid, nchars = char_ids(col)
+    is_start = jnp.logical_and((col.data & 0xC0) != 0x80, m)
+    import jax.lax as lax
+    char_start = lax.cummax(jnp.where(is_start, pos, -1), axis=1)
+    within = pos - char_start
+    key = jnp.where(m, (nchars[:, None] - 1 - cid) * w + within, 2 * w * w + pos)
+    order = jnp.argsort(key, axis=1)
+    data = jnp.take_along_axis(col.data, order, axis=1)
+    data = jnp.where(m, data, 0)
+    return DeviceStringColumn(col.dtype, data, col.lengths, col.validity)
+
+
+def substr(col: DeviceStringColumn, start, length) -> DeviceStringColumn:
+    """SQL substr, 1-based start in *characters* (Spark semantics);
+    start/length are scalars or per-row int32 arrays.  Negative start counts
+    from the end."""
+    start = jnp.asarray(start, jnp.int32)
+    length = jnp.asarray(length, jnp.int32)
+    cid, nchars = char_ids(col)
+    begin = jnp.where(start > 0, start - 1,
+                      jnp.where(start < 0, nchars + start, 0))
+    begin = jnp.clip(begin, 0, nchars)
+    eff = jnp.clip(length, 0, nchars - begin)
+    m = byte_mask(col)
+    keep = jnp.logical_and(
+        m, jnp.logical_and(cid >= begin[:, None],
+                           cid < (begin + eff)[:, None]))
+    return take_bytes(col, keep)
+
+
+def left(col: DeviceStringColumn, k) -> DeviceStringColumn:
+    return substr(col, jnp.int32(1), jnp.maximum(jnp.asarray(k, jnp.int32), 0))
+
+
+def right(col: DeviceStringColumn, k) -> DeviceStringColumn:
+    k = jnp.maximum(jnp.asarray(k, jnp.int32), 0)
+    _, nchars = char_ids(col)
+    start = jnp.where(k >= nchars, 1, nchars - k + 1)
+    return substr(col, start, k)
+
+
+def concat(cols, out_dtype: DataType) -> DeviceStringColumn:
+    """Concatenate string columns row-wise (null if any input null — Spark
+    concat semantics)."""
+    total_w = sum(c.width for c in cols)
+    w = bucket_width(total_w)
+    cap = cols[0].capacity
+    out = jnp.zeros((cap, w), jnp.uint8)
+    out_len = jnp.zeros(cap, jnp.int32)
+    pos = _positions(w)[None, :]
+    for c in cols:
+        # place c at offset out_len within each row
+        src = pos - out_len[:, None]
+        take = jnp.logical_and(src >= 0, src < c.lengths[:, None])
+        vals = jnp.take_along_axis(c.data, jnp.clip(src, 0, c.width - 1), axis=1)
+        out = jnp.where(take, vals, out)
+        out_len = out_len + c.lengths
+    valid = cols[0].validity
+    for c in cols[1:]:
+        valid = jnp.logical_and(valid, c.validity)
+    return string_col(out_dtype, out, jnp.minimum(out_len, w), valid)
+
+
+def trim(col: DeviceStringColumn, left_side=True, right_side=True) -> DeviceStringColumn:
+    """Trim ASCII spaces."""
+    w = col.width
+    pos = _positions(w)[None, :]
+    m = byte_mask(col)
+    is_space = jnp.logical_and(col.data == 32, m)
+    non_space = jnp.logical_and(jnp.logical_not(is_space), m)
+    any_ns = jnp.any(non_space, axis=1)
+    first_ns = jnp.argmax(non_space, axis=1).astype(jnp.int32)
+    last_ns = (w - 1 - jnp.argmax(non_space[:, ::-1], axis=1)).astype(jnp.int32)
+    begin = jnp.where(any_ns, first_ns if left_side else 0, 0)
+    end = jnp.where(any_ns, (last_ns + 1) if right_side else col.lengths,
+                    jnp.int32(0))
+    end = jnp.where(any_ns, end, 0)
+    new_len = jnp.maximum(end - begin, 0)
+    src = begin[:, None] + pos
+    data = jnp.take_along_axis(col.data, jnp.clip(src, 0, w - 1), axis=1)
+    data = jnp.where(pos < new_len[:, None], data, 0)
+    return string_col(col.dtype, data, new_len, col.validity)
+
+
+def lpad(col: DeviceStringColumn, target_len: int, pad: bytes) -> DeviceStringColumn:
+    w = bucket_width(max(target_len, col.width))
+    cap = col.capacity
+    pos = _positions(w)[None, :]
+    tl = jnp.int32(target_len)
+    new_len = jnp.where(col.lengths >= tl, jnp.minimum(col.lengths, tl), tl)
+    shift = jnp.maximum(tl - col.lengths, 0)  # pad bytes in front
+    pad_arr = jnp.asarray(np.frombuffer(pad, np.uint8)) if pad else \
+        jnp.zeros(1, jnp.uint8)
+    k = max(len(pad), 1)
+    src = pos - shift[:, None]
+    from_str = jnp.logical_and(src >= 0, pos < new_len[:, None])
+    str_vals = jnp.take_along_axis(
+        _pad_width(col.data, w), jnp.clip(src, 0, w - 1), axis=1)
+    pad_vals = pad_arr[pos % k]
+    data = jnp.where(from_str, str_vals,
+                     jnp.where(pos < new_len[:, None], pad_vals, 0))
+    return string_col(col.dtype, data, new_len, col.validity)
+
+
+def rpad(col: DeviceStringColumn, target_len: int, pad: bytes) -> DeviceStringColumn:
+    w = bucket_width(max(target_len, col.width))
+    pos = _positions(w)[None, :]
+    tl = jnp.int32(target_len)
+    new_len = jnp.where(col.lengths >= tl, jnp.minimum(col.lengths, tl), tl)
+    pad_arr = jnp.asarray(np.frombuffer(pad, np.uint8)) if pad else \
+        jnp.zeros(1, jnp.uint8)
+    k = max(len(pad), 1)
+    in_str = pos < col.lengths[:, None]
+    str_vals = _pad_width(col.data, w)
+    pad_pos = pos - col.lengths[:, None]
+    pad_vals = pad_arr[jnp.clip(pad_pos, 0, None) % k]
+    data = jnp.where(in_str, str_vals,
+                     jnp.where(pos < new_len[:, None], pad_vals, 0))
+    data = jnp.where(pos < new_len[:, None], data, 0)
+    return string_col(col.dtype, data, new_len, col.validity)
+
+
+def strpos(col: DeviceStringColumn, needle: bytes):
+    """1-based *character* position of first occurrence, 0 if absent
+    (Spark locate/position semantics)."""
+    k = len(needle)
+    if k == 0:
+        return jnp.ones(col.capacity, jnp.int32)
+    if k > col.width:
+        return jnp.zeros(col.capacity, jnp.int32)
+    pat = jnp.asarray(np.frombuffer(needle, np.uint8))
+    w = col.width
+    offs = jnp.arange(w - k + 1, dtype=jnp.int32)
+    win_idx = offs[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    windows = col.data[:, win_idx]
+    match = jnp.all(windows == pat[None, None, :], axis=2)
+    inside = offs[None, :] + k <= col.lengths[:, None]
+    ok = jnp.logical_and(match, inside)
+    first_byte = jnp.argmax(ok, axis=1).astype(jnp.int32)
+    cid, _ = char_ids(col)
+    first_char = jnp.take_along_axis(cid, first_byte[:, None], axis=1)[:, 0]
+    return jnp.where(jnp.any(ok, axis=1), first_char + 1, 0)
+
+
+def repeat(col: DeviceStringColumn, n: int) -> DeviceStringColumn:
+    n = max(int(n), 0)
+    w = bucket_width(max(col.width * max(n, 1), 1))
+    cap = col.capacity
+    if n == 0:
+        return string_col(col.dtype, jnp.zeros((cap, w), jnp.uint8),
+                          jnp.zeros(cap, jnp.int32), col.validity)
+    pos = _positions(w)[None, :]
+    new_len = jnp.minimum(col.lengths * n, w)
+    src = pos % jnp.maximum(col.lengths[:, None], 1)
+    vals = jnp.take_along_axis(_pad_width(col.data, w),
+                               jnp.clip(src, 0, w - 1), axis=1)
+    data = jnp.where(pos < new_len[:, None], vals, 0)
+    return string_col(col.dtype, data, new_len, col.validity)
+
+
+def ascii_code(col: DeviceStringColumn):
+    """Codepoint of the first character (Spark `ascii`), 0 for empty."""
+    w = col.width
+    b = [col.data[:, i].astype(jnp.int32) if i < w else
+         jnp.zeros(col.capacity, jnp.int32) for i in range(4)]
+    cp1 = b[0]
+    cp2 = ((b[0] & 0x1F) << 6) | (b[1] & 0x3F)
+    cp3 = ((b[0] & 0x0F) << 12) | ((b[1] & 0x3F) << 6) | (b[2] & 0x3F)
+    cp4 = ((b[0] & 0x07) << 18) | ((b[1] & 0x3F) << 12) \
+        | ((b[2] & 0x3F) << 6) | (b[3] & 0x3F)
+    cp = jnp.where(b[0] < 0x80, cp1,
+                   jnp.where(b[0] < 0xE0, cp2,
+                             jnp.where(b[0] < 0xF0, cp3, cp4)))
+    return jnp.where(col.lengths > 0, cp, 0)

@@ -1,0 +1,169 @@
+"""Static type inference over expression trees.
+
+The front-end (like Auron's NativeConverters) supplies explicit result types
+where semantics are subtle (decimal arithmetic, function returns); this pass
+fills in the rest so the compiler can pick kernels and decide device vs host
+placement.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from auron_tpu.ir import expr as E
+from auron_tpu.ir.schema import DataType, Schema, TypeId
+from auron_tpu.exprs.values import promote
+
+_CMP_OPS = {"==", "=", "!=", "<", "<=", ">", ">=", "<=>"}
+_LOGIC_OPS = {"and", "or"}
+_BIT_OPS = {"&", "|", "^", "<<", ">>"}
+
+_INT_RESULT_FUNCS = {
+    "year", "quarter", "month", "day", "day_of_week", "week_of_year",
+    "hour", "minute", "second", "ascii", "bit_length", "character_length",
+    "octet_length", "strpos", "levenshtein", "find_in_set", "crc32",
+    "murmur3_hash", "datediff", "size",
+}
+_F64_RESULT_FUNCS = {
+    "acos", "acosh", "asin", "atan", "atan2", "cos", "cosh", "exp", "expm1",
+    "ln", "log", "log10", "log2", "power", "sin", "sinh", "sqrt", "tan",
+    "tanh", "random", "months_between",
+}
+_STR_RESULT_FUNCS = {
+    "concat", "concat_ws", "initcap", "left", "lower", "lpad", "ltrim",
+    "repeat", "replace", "reverse", "right", "rpad", "rtrim", "split_part",
+    "substr", "translate", "trim", "upper", "btrim", "chr", "hex", "md5",
+    "sha224", "sha256", "sha384", "sha512", "get_json_object", "string_space",
+    "regexp_replace", "regexp_extract", "from_unixtime",
+}
+_BOOL_RESULT_FUNCS = {"is_nan", "starts_with", "ends_with", "contains",
+                      "array_contains"}
+
+
+def infer_type(expr: E.Expr, schema: Schema) -> DataType:
+    k = expr.kind
+    if k == "column":
+        return schema.field(expr.name).dtype
+    if k == "bound_reference":
+        return schema[expr.index].dtype
+    if k == "literal":
+        return expr.dtype
+    if k == "binary":
+        if expr.op in _CMP_OPS or expr.op in _LOGIC_OPS:
+            return DataType.bool_()
+        lt = infer_type(expr.left, schema)
+        rt = infer_type(expr.right, schema)
+        if expr.op in _BIT_OPS:
+            return promote(lt, rt)
+        if expr.op == "/":
+            if lt.is_decimal or rt.is_decimal:
+                return DataType.float64()
+            return DataType.float64() if (lt.is_integral and rt.is_integral) \
+                else promote(lt, rt)
+        if expr.op == "+" and lt.id == TypeId.DATE32 and rt.is_integral:
+            return lt
+        if expr.op == "-" and lt.id == TypeId.DATE32:
+            return DataType.int32() if rt.id == TypeId.DATE32 else lt
+        return promote(lt, rt)
+    if k in ("is_null", "is_not_null", "not", "like", "sc_and", "sc_or",
+             "string_starts_with", "string_ends_with", "string_contains",
+             "in_list", "bloom_filter_might_contain"):
+        return DataType.bool_()
+    if k in ("cast", "try_cast"):
+        return expr.dtype
+    if k == "negative":
+        return infer_type(expr.child, schema)
+    if k == "case":
+        for b in expr.branches:
+            t = infer_type(b.then, schema)
+            if t.id != TypeId.NULL:
+                return t
+        if expr.else_expr is not None:
+            return infer_type(expr.else_expr, schema)
+        return DataType.null()
+    if k == "scalar_function":
+        if expr.return_type.id != TypeId.NULL:
+            return expr.return_type
+        return _infer_function_type(expr, schema)
+    if k == "py_udf_wrapper":
+        return expr.return_type
+    if k == "scalar_subquery":
+        return expr.dtype
+    if k == "get_indexed_field":
+        ct = infer_type(expr.child, schema)
+        if ct.id == TypeId.LIST:
+            return ct.children[0].dtype
+        if ct.id == TypeId.STRUCT:
+            for f in ct.children:
+                if f.name == expr.ordinal:
+                    return f.dtype
+            return ct.children[int(expr.ordinal)].dtype
+        raise TypeError(f"get_indexed_field over {ct}")
+    if k == "get_map_value":
+        ct = infer_type(expr.child, schema)
+        return ct.children[1].dtype
+    if k == "named_struct":
+        if expr.return_type.id != TypeId.NULL:
+            return expr.return_type
+        from auron_tpu.ir.schema import Field
+        return DataType.struct(tuple(
+            Field(n, infer_type(v, schema))
+            for n, v in zip(expr.names, expr.values)))
+    if k == "row_num":
+        return DataType.int64()
+    if k == "partition_id":
+        return DataType.int32()
+    if k == "monotonically_increasing_id":
+        return DataType.int64()
+    raise TypeError(f"cannot infer type of expr kind {k!r}")
+
+
+def _infer_function_type(expr: E.ScalarFunctionCall, schema: Schema) -> DataType:
+    n = expr.name
+    if n in _INT_RESULT_FUNCS:
+        return DataType.int32() if n != "crc32" and n != "murmur3_hash" else (
+            DataType.int64() if n == "crc32" else DataType.int32())
+    if n in _F64_RESULT_FUNCS:
+        return DataType.float64()
+    if n in _STR_RESULT_FUNCS:
+        return DataType.string()
+    if n in _BOOL_RESULT_FUNCS:
+        return DataType.bool_()
+    if n == "xxhash64":
+        return DataType.int64()
+    if n in ("abs", "ceil", "floor", "round", "bround", "signum", "trunc",
+             "negative", "normalize_nan_and_zero"):
+        if not expr.args:
+            return DataType.float64()
+        t = infer_type(expr.args[0], schema)
+        if n in ("ceil", "floor") and t.is_floating:
+            return DataType.int64()
+        return t
+    if n in ("least", "greatest"):
+        t = infer_type(expr.args[0], schema)
+        for a in expr.args[1:]:
+            t = promote(t, infer_type(a, schema))
+        return t
+    if n in ("coalesce", "nvl", "null_if", "null_if_zero"):
+        for a in expr.args:
+            t = infer_type(a, schema)
+            if t.id != TypeId.NULL:
+                return t
+        return DataType.null()
+    if n == "nvl2":
+        return infer_type(expr.args[1], schema)
+    if n in ("make_date", "last_day", "next_day", "date_add", "date_sub",
+             "date_trunc"):
+        return DataType.date32()
+    if n in ("to_timestamp", "to_timestamp_millis", "to_timestamp_micros",
+             "to_timestamp_seconds", "now", "unix_timestamp"):
+        return DataType.timestamp_us() if n != "unix_timestamp" \
+            else DataType.int64()
+    if n in ("date_part",):
+        return DataType.int32()
+    if n in ("unscaled_value",):
+        return DataType.int64()
+    if n in ("factorial",):
+        return DataType.int64()
+    raise TypeError(f"unknown scalar function {n!r}; front-end must supply "
+                    f"return_type")

@@ -1,0 +1,100 @@
+"""Value helpers shared by device expression kernels: type promotion,
+null propagation, literal materialization."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import (
+    DeviceColumn, DeviceStringColumn, bucket_width,
+)
+from auron_tpu.ir.schema import DataType, TypeId
+
+Col = Union[DeviceColumn, DeviceStringColumn]
+
+_RANK = {
+    TypeId.BOOL: 0, TypeId.INT8: 1, TypeId.INT16: 2, TypeId.INT32: 3,
+    TypeId.INT64: 4, TypeId.FLOAT32: 5, TypeId.FLOAT64: 6,
+}
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    """Numeric binary-op result type (Spark-ish widening; decimals handled
+    by the front-end supplying explicit result types via Cast)."""
+    if a.id == b.id and not a.is_decimal:
+        return a
+    if a.is_decimal or b.is_decimal:
+        # operate on float64 unless the plan pre-cast; front-ends should
+        # insert explicit decimal typing (NativeConverters.scala:583-703)
+        return DataType.float64()
+    if a.id in (TypeId.DATE32, TypeId.TIMESTAMP_US):
+        return a
+    if b.id in (TypeId.DATE32, TypeId.TIMESTAMP_US):
+        return b
+    ra, rb = _RANK.get(a.id, 6), _RANK.get(b.id, 6)
+    hi = a if ra >= rb else b
+    if {a.id, b.id} == {TypeId.INT64, TypeId.FLOAT32}:
+        return DataType.float64()
+    return hi
+
+
+def flat(dtype: DataType, data, validity) -> DeviceColumn:
+    """Construct a flat column enforcing canonical zeros at null slots."""
+    zero = jnp.zeros((), dtype=data.dtype)
+    return DeviceColumn(dtype, jnp.where(validity, data, zero), validity)
+
+
+def string_col(dtype: DataType, data, lengths, validity) -> DeviceStringColumn:
+    return DeviceStringColumn(
+        dtype,
+        jnp.where(validity[:, None], data, 0),
+        jnp.where(validity, lengths, 0),
+        validity)
+
+
+def literal_column(value, dtype: DataType, capacity: int) -> Col:
+    """Broadcast a python literal to a device column."""
+    if value is None or dtype.id == TypeId.NULL:
+        target = dtype if dtype.id != TypeId.NULL else DataType.bool_()
+        if target.is_stringlike:
+            w = bucket_width(1)
+            return DeviceStringColumn(
+                target, jnp.zeros((capacity, w), jnp.uint8),
+                jnp.zeros(capacity, jnp.int32), jnp.zeros(capacity, bool))
+        return DeviceColumn(target,
+                            jnp.zeros(capacity, dtype=target.numpy_dtype()),
+                            jnp.zeros(capacity, bool))
+    if dtype.is_stringlike:
+        raw = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        w = bucket_width(max(len(raw), 1))
+        mat = np.zeros((capacity, w), dtype=np.uint8)
+        mat[:, :len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        return DeviceStringColumn(
+            dtype, jnp.asarray(mat),
+            jnp.full(capacity, len(raw), jnp.int32),
+            jnp.ones(capacity, bool))
+    if dtype.id == TypeId.DECIMAL:
+        unscaled = int(round(float(value) * (10 ** dtype.scale))) \
+            if not isinstance(value, int) else value
+        data = jnp.full(capacity, unscaled, jnp.int64)
+    else:
+        data = jnp.full(capacity, value, dtype=dtype.numpy_dtype())
+    return DeviceColumn(dtype, data, jnp.ones(capacity, bool))
+
+
+def cast_numeric_data(data, src: DataType, dst: DataType):
+    """Raw numeric representation change (no Spark cast semantics; used for
+    promotions where values are known in-range)."""
+    if src.id == dst.id and not (src.is_decimal or dst.is_decimal):
+        return data
+    if src.id == TypeId.DECIMAL:
+        scaled = data.astype(jnp.float64) / (10.0 ** src.scale)
+        return scaled.astype(dst.numpy_dtype()) if not dst.is_decimal else data
+    return data.astype(dst.numpy_dtype())
+
+
+def both_valid(a: Col, b: Col):
+    return jnp.logical_and(a.validity, b.validity)

@@ -1,0 +1,14 @@
+"""HBM-budgeted memory management with host-offload spill.
+
+Redesign of the reference's auron-memmgr for TPU: a registry of MemConsumers
+with a fair per-consumer budget and wait-or-spill arbitration
+(auron-memmgr/src/lib.rs:46,82,303-423), where "spill" means device->host
+transfer of a consumer's batches, optionally compressed to files
+(spill.rs:89 FileSpill / spill.rs:180 OnHeapSpill -> here HostMemSpill).
+"""
+
+from auron_tpu.memmgr.manager import MemConsumer, MemManager, get_manager
+from auron_tpu.memmgr.spill import Spill, SpillManager
+
+__all__ = ["MemConsumer", "MemManager", "get_manager", "Spill",
+           "SpillManager"]

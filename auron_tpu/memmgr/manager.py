@@ -1,0 +1,131 @@
+"""MemManager: consumer registry + wait-or-spill arbitration.
+
+Mirrors the decision structure of auron-memmgr/src/lib.rs:303-423
+(`Operation::{Spill, Wait, Nothing}`): when a consumer grows past its fair
+share and the pool is exhausted, the largest spillable consumer is asked to
+spill; tiny consumers (< MIN_TRIGGER_SIZE) are never forced.  Single-process
+synchronous version: "Wait" (multi-task backpressure) degenerates into
+immediate spill of the requester.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from auron_tpu.config import conf
+
+MIN_TRIGGER_SIZE = 16 << 20  # 16MB, lib.rs:36
+
+
+class MemConsumer:
+    """Operators subclass (or compose) this; `spill()` must release device
+    memory (return bytes freed)."""
+
+    def __init__(self, name: str, spillable: bool = True):
+        self.name = name
+        self.spillable = spillable
+        self.mem_used = 0
+        self._manager: Optional["MemManager"] = None
+
+    def update_mem_used(self, new_bytes: int) -> None:
+        if self._manager is not None:
+            self._manager.update(self, int(new_bytes))
+        else:
+            self.mem_used = int(new_bytes)
+
+    def spill(self) -> int:
+        raise NotImplementedError
+
+
+class MemManager:
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self._lock = threading.RLock()
+        self._consumers: List[MemConsumer] = []
+        self.budget = budget_bytes if budget_bytes is not None \
+            else self._default_budget()
+        self.total_used = 0
+        self.num_spills = 0
+
+    @staticmethod
+    def _default_budget() -> int:
+        override = int(conf.get("auron.memory.budget.bytes"))
+        if override:
+            return override
+        frac = float(conf.get("auron.memory.fraction"))
+        try:
+            import jax
+            dev = jax.devices()[0]
+            stats = dev.memory_stats() or {}
+            limit = stats.get("bytes_limit")
+            if limit:
+                return int(limit * frac)
+        except Exception:
+            pass
+        return int(4 * (1 << 30) * frac)  # fallback: 4GB-class device
+
+    def register_consumer(self, consumer: MemConsumer) -> MemConsumer:
+        with self._lock:
+            consumer._manager = self
+            self._consumers.append(consumer)
+        return consumer
+
+    def unregister_consumer(self, consumer: MemConsumer) -> None:
+        with self._lock:
+            if consumer in self._consumers:
+                self.total_used -= consumer.mem_used
+                consumer.mem_used = 0
+                consumer._manager = None
+                self._consumers.remove(consumer)
+
+    def update(self, consumer: MemConsumer, new_bytes: int) -> None:
+        """Update usage; may synchronously trigger spills (of this consumer
+        or a larger one) to stay under budget — the arbitration loop of
+        lib.rs:303-423."""
+        spill_target: Optional[MemConsumer] = None
+        with self._lock:
+            self.total_used += new_bytes - consumer.mem_used
+            consumer.mem_used = new_bytes
+            if self.total_used <= self.budget:
+                return
+            candidates = [c for c in self._consumers
+                          if c.spillable and c.mem_used >= MIN_TRIGGER_SIZE]
+            if not candidates:
+                # over budget but nothing is big enough to bother: allow
+                # (reference returns Nothing below MIN_TRIGGER_SIZE)
+                return
+            spill_target = max(candidates, key=lambda c: c.mem_used)
+        # spill outside the lock (spill() re-enters update())
+        freed = spill_target.spill()
+        with self._lock:
+            self.num_spills += 1
+        if freed <= 0 and spill_target is not consumer and consumer.spillable \
+                and consumer.mem_used >= MIN_TRIGGER_SIZE:
+            consumer.spill()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"budget": self.budget, "total_used": self.total_used,
+                    "num_consumers": len(self._consumers),
+                    "num_spills": self.num_spills}
+
+
+_GLOBAL: Optional[MemManager] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_manager() -> MemManager:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = MemManager()
+        return _GLOBAL
+
+
+def reset_manager(budget_bytes: Optional[int] = None) -> MemManager:
+    """Test/driver hook: install a fresh manager (e.g. tiny budget for the
+    spill fuzz tests, SURVEY §4)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = MemManager(budget_bytes)
+        return _GLOBAL

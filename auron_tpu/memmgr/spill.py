@@ -1,0 +1,124 @@
+"""Spill storage: host-memory blocks first, compressed files second.
+
+Analogue of auron-memmgr/src/spill.rs (`try_new_spill`: OnHeapSpill when the
+JVM has heap to spare, else FileSpill).  Here the fast tier is host RAM
+(device->host transfer of serialized batches) and the durable tier is a
+compressed file via the native codec.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+import threading
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from auron_tpu.columnar import serde as batch_serde
+from auron_tpu.config import conf
+
+
+class Spill:
+    """One spill unit: a sequence of record batches, written once, read
+    back once (optionally many times for broadcast)."""
+
+    def write_batches(self, batches: Iterator[pa.RecordBatch]) -> int:
+        raise NotImplementedError
+
+    def read_batches(self) -> Iterator[pa.RecordBatch]:
+        raise NotImplementedError
+
+    def release(self) -> None:
+        pass
+
+    @property
+    def size_bytes(self) -> int:
+        raise NotImplementedError
+
+
+class HostMemSpill(Spill):
+    def __init__(self, codec: Optional[str] = None):
+        self._buf = b""
+        self._codec = codec or conf.get("auron.spill.compression.codec")
+
+    def write_batches(self, batches) -> int:
+        sink = io.BytesIO()
+        for rb in batches:
+            batch_serde.write_one_batch(rb, sink, codec=self._codec)
+        self._buf = sink.getvalue()
+        return len(self._buf)
+
+    def read_batches(self):
+        yield from batch_serde.read_batches(io.BytesIO(self._buf))
+
+    def release(self) -> None:
+        self._buf = b""
+
+    @property
+    def size_bytes(self) -> int:
+        return len(self._buf)
+
+
+class FileSpill(Spill):
+    def __init__(self, directory: Optional[str] = None,
+                 codec: Optional[str] = None):
+        d = directory or conf.get("auron.spill.dir") or None
+        fd, self.path = tempfile.mkstemp(prefix="auron_spill_", dir=d)
+        os.close(fd)
+        self._codec = codec or conf.get("auron.spill.compression.codec")
+        self._size = 0
+
+    def write_batches(self, batches) -> int:
+        with open(self.path, "wb") as f:
+            for rb in batches:
+                self._size += batch_serde.write_one_batch(
+                    rb, f, codec=self._codec)
+        return self._size
+
+    def read_batches(self):
+        with open(self.path, "rb") as f:
+            yield from batch_serde.read_batches(f)
+
+    def release(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    @property
+    def size_bytes(self) -> int:
+        return self._size
+
+
+class SpillManager:
+    """Tracks spills for one consumer; chooses tier (try_new_spill)."""
+
+    def __init__(self, name: str = "spill"):
+        self.name = name
+        self.spills: List[Spill] = []
+        self._lock = threading.Lock()
+
+    def new_spill(self, prefer_host: Optional[bool] = None) -> Spill:
+        if prefer_host is None:
+            prefer_host = bool(conf.get("auron.spill.host.memory.first"))
+        s: Spill = HostMemSpill() if prefer_host else FileSpill()
+        with self._lock:
+            self.spills.append(s)
+        return s
+
+    def release_all(self) -> None:
+        with self._lock:
+            for s in self.spills:
+                s.release()
+            self.spills.clear()
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(s.size_bytes for s in self.spills)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.spills)

@@ -1,0 +1,16 @@
+"""C++ host runtime (libauron_host).
+
+The reference keeps its runtime native (Rust: auron-memmgr, ext-commons IO,
+jni-bridge); here the host-side runtime pieces that sit outside the XLA
+compute path are C++ (auron_tpu/native/src), exposed over a C ABI loaded
+with ctypes: compression codecs, xxhash64/murmur3 hashing, spill file IO,
+shuffle file (data+index) writer and a prefetching thread pool.
+
+Pure-python fallbacks keep the framework functional when the .so has not
+been built; `auron_tpu.native.bindings.available()` reports which path is
+active.
+"""
+
+from auron_tpu.native import bindings
+
+__all__ = ["bindings"]

@@ -1,0 +1,271 @@
+"""ctypes bindings for the C++ host runtime, with python fallbacks.
+
+Build: `make -C auron_tpu/native` produces libauron_host.so next to this
+file.  Loading is lazy and failure-tolerant: every entry point falls back to
+a python implementation (zstandard, hashlib-free xxhash in numpy) so the
+engine works before/without the native build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from auron_tpu.config import conf
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "libauron_host.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    with _LIB_LOCK:
+        if _LIB_TRIED:
+            return _LIB
+        _LIB_TRIED = True
+        if not conf.get("auron.native.enable"):
+            return None
+        path = _lib_path()
+        if not os.path.exists(path):
+            # try a one-shot build if the toolchain is present
+            try:
+                import subprocess
+                subprocess.run(["make", "-s", "-C", os.path.dirname(__file__)],
+                               check=True, capture_output=True, timeout=300)
+            except Exception:
+                return None
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            _configure(lib)
+            _LIB = lib
+        except OSError:
+            _LIB = None
+        return _LIB
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.auron_zlib_compress_bound.restype = ctypes.c_size_t
+    lib.auron_zlib_compress_bound.argtypes = [ctypes.c_size_t]
+    lib.auron_zlib_compress.restype = ctypes.c_ssize_t
+    lib.auron_zlib_compress.argtypes = [u8p, ctypes.c_size_t, u8p,
+                                        ctypes.c_size_t, ctypes.c_int]
+    lib.auron_zlib_decompress.restype = ctypes.c_ssize_t
+    lib.auron_zlib_decompress.argtypes = [u8p, ctypes.c_size_t, u8p,
+                                          ctypes.c_size_t]
+    lib.auron_xxhash64.restype = ctypes.c_uint64
+    lib.auron_xxhash64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64]
+    lib.auron_murmur3_x86_32.restype = ctypes.c_int32
+    lib.auron_murmur3_x86_32.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int32]
+    lib.auron_murmur3_hash_i64.restype = None
+    lib.auron_murmur3_hash_i64.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# compression: zstd preferred (python zstandard is itself a C binding);
+# the C++ lib supplies a zlib path for the "zlib" codec and serves as the
+# native codec used by spill files.
+# ---------------------------------------------------------------------------
+
+def compress(payload: bytes, level: int = 3) -> bytes:
+    import zstandard
+    return zstandard.ZstdCompressor(level=level).compress(payload)
+
+
+def decompress(payload: bytes) -> bytes:
+    import zstandard
+    return zstandard.ZstdDecompressor().decompress(payload)
+
+
+def zlib_compress(payload: bytes, level: int = 4) -> bytes:
+    lib = _load()
+    if lib is None:
+        import zlib
+        return zlib.compress(payload, level)
+    src = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+    bound = lib.auron_zlib_compress_bound(len(payload))
+    dst = (ctypes.c_uint8 * bound)()
+    n = lib.auron_zlib_compress(src, len(payload), dst, bound, level)
+    if n < 0:
+        raise RuntimeError(f"native zlib compress failed: {n}")
+    return bytes(dst[:n])
+
+
+def zlib_decompress(payload: bytes, uncompressed_size: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        import zlib
+        return zlib.decompress(payload)
+    src = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+    dst = (ctypes.c_uint8 * uncompressed_size)()
+    n = lib.auron_zlib_decompress(src, len(payload), dst, uncompressed_size)
+    if n < 0:
+        raise RuntimeError(f"native zlib decompress failed: {n}")
+    return bytes(dst[:n])
+
+
+# ---------------------------------------------------------------------------
+# hashing (spark-compatible)
+# ---------------------------------------------------------------------------
+
+def xxhash64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is not None:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        return int(lib.auron_xxhash64(buf, len(data), seed & (2**64 - 1)))
+    return _py_xxhash64(data, seed)
+
+
+def murmur3_32(data: bytes, seed: int = 42) -> int:
+    """Spark-compatible murmur3_x86_32 (signed int32 result)."""
+    lib = _load()
+    if lib is not None:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        return int(lib.auron_murmur3_x86_32(buf, len(data),
+                                            np.int32(seed)))
+    return _py_murmur3_32(data, seed)
+
+
+def murmur3_hash_i64_array(values: np.ndarray, seed: int = 42) -> np.ndarray:
+    """Vectorized spark murmur3 over int64 values (8-byte LE encoding, the
+    layout Spark uses for long columns in hash partitioning)."""
+    lib = _load()
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    out = np.empty(len(values), dtype=np.int32)
+    if lib is not None and len(values):
+        lib.auron_murmur3_hash_i64(
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(values),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), np.int32(seed))
+        return out
+    for i, v in enumerate(values):
+        out[i] = _py_murmur3_32(int(v).to_bytes(8, "little", signed=True), seed)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# python fallbacks
+# ---------------------------------------------------------------------------
+
+_P1, _P2, _P3, _P4, _P5 = (0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F,
+                           0x165667B19E3779F9, 0x85EBCA77C2B2AE63,
+                           0x27D4EB2F165667C5)
+_M64 = 2**64 - 1
+
+
+def _rotl64(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _py_xxhash64(data: bytes, seed: int = 0) -> int:
+    n = len(data)
+    seed &= _M64
+    if n >= 32:
+        v1 = (seed + _P1 + _P2) & _M64
+        v2 = (seed + _P2) & _M64
+        v3 = seed
+        v4 = (seed - _P1) & _M64
+        i = 0
+        while i <= n - 32:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 8 * j:i + 8 * j + 8], "little")
+                v = (v + lane * _P2) & _M64
+                v = _rotl64(v, 31)
+                v = (v * _P1) & _M64
+                if j == 0: v1 = v
+                elif j == 1: v2 = v
+                elif j == 2: v3 = v
+                else: v4 = v
+            i += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) +
+             _rotl64(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            v = (v * _P2) & _M64
+            v = _rotl64(v, 31)
+            v = (v * _P1) & _M64
+            h ^= v
+            h = (h * _P1 + _P4) & _M64
+    else:
+        h = (seed + _P5) & _M64
+        i = 0
+    h = (h + n) & _M64
+    while i <= n - 8:
+        lane = int.from_bytes(data[i:i + 8], "little")
+        k = (lane * _P2) & _M64
+        k = _rotl64(k, 31)
+        k = (k * _P1) & _M64
+        h ^= k
+        h = (_rotl64(h, 27) * _P1 + _P4) & _M64
+        i += 8
+    if i <= n - 4:
+        lane = int.from_bytes(data[i:i + 4], "little")
+        h ^= (lane * _P1) & _M64
+        h = (_rotl64(h, 23) * _P2 + _P3) & _M64
+        i += 4
+    while i < n:
+        h ^= (data[i] * _P5) & _M64
+        h = (_rotl64(h, 11) * _P1) & _M64
+        i += 1
+    h ^= h >> 33
+    h = (h * _P2) & _M64
+    h ^= h >> 29
+    h = (h * _P3) & _M64
+    h ^= h >> 32
+    return h
+
+
+_M32 = 2**32 - 1
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def _py_murmur3_32(data: bytes, seed: int) -> int:
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & _M32
+    n = len(data)
+    nblocks = n // 4
+    for i in range(nblocks):
+        k = int.from_bytes(data[4 * i:4 * i + 4], "little")
+        k = (k * c1) & _M32
+        k = _rotl32(k, 15)
+        k = (k * c2) & _M32
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & _M32
+    # spark processes tail bytes one at a time as full int mixes
+    for i in range(4 * nblocks, n):
+        b = data[i]
+        if b >= 128:
+            b -= 256
+        k = b & _M32
+        k = (k * c1) & _M32
+        k = _rotl32(k, 15)
+        k = (k * c2) & _M32
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & _M32
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h if h < 2**31 else h - 2**32

@@ -1,0 +1,6 @@
+"""Operator library — the analogue of datafusion-ext-plans (27 operators).
+
+Operators are host-driven streams of padded device batches; each operator's
+hot kernel is a jitted jnp program cached per (plan-fragment, schema,
+capacity).
+"""

@@ -1,0 +1,1 @@
+"""Aggregation internals (agg table, accumulators, bloom filter)."""

@@ -1,0 +1,168 @@
+"""Bloom filter (build via BLOOM_FILTER agg, probe via
+bloom_filter_might_contain) — analogue of spark_bloom_filter.rs +
+bloom_filter.rs in datafusion-ext-plans/commons.
+
+Layout: a binary blob `b"ATBF" + u32 num_bits + u32 num_hashes + bits` with
+bit positions derived from two murmur3 hashes (h1 + i*h2, Kirsch-
+Mitzenmacher), computed identically on device (probe) and host (build), so
+filters built by the agg can be shipped in plans as binary literals.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import DeviceColumn, DeviceStringColumn
+from auron_tpu.exprs import hashing as H
+from auron_tpu.exprs.values import flat
+from auron_tpu.ir.schema import DataType, TypeId
+
+MAGIC = b"ATBF"
+
+
+def optimal_num_bits(expected_items: int, fpp: float = 0.03) -> int:
+    import math
+    n = max(expected_items, 1)
+    m = int(-n * math.log(fpp) / (math.log(2) ** 2))
+    return max(64, 1 << (m - 1).bit_length())  # pow2 => mask instead of mod
+
+
+def optimal_num_hashes(num_bits: int, expected_items: int) -> int:
+    import math
+    k = int(round(num_bits / max(expected_items, 1) * math.log(2)))
+    return min(max(k, 1), 8)
+
+
+class BloomFilter:
+    def __init__(self, num_bits: int, num_hashes: int,
+                 bits: np.ndarray | None = None):
+        assert num_bits & (num_bits - 1) == 0, "num_bits must be a power of 2"
+        self.num_bits = num_bits
+        self.num_hashes = num_hashes
+        self.bits = bits if bits is not None else \
+            np.zeros(num_bits // 8, dtype=np.uint8)
+
+    # -- host build ---------------------------------------------------------
+
+    def put_hashes(self, h1: np.ndarray, h2: np.ndarray) -> None:
+        mask = self.num_bits - 1
+        for i in range(self.num_hashes):
+            pos = (h1 + i * h2) & mask
+            np.bitwise_or.at(self.bits, pos >> 3,
+                             (1 << (pos & 7)).astype(np.uint8))
+
+    def put_values(self, values: np.ndarray, dtype: DataType,
+                   valid: np.ndarray) -> None:
+        h1, h2 = _host_two_hashes(values, dtype)
+        self.put_hashes(h1[valid], h2[valid])
+
+    def might_contain_host(self, values: np.ndarray, dtype: DataType
+                           ) -> np.ndarray:
+        h1, h2 = _host_two_hashes(values, dtype)
+        mask = self.num_bits - 1
+        out = np.ones(len(values), dtype=bool)
+        for i in range(self.num_hashes):
+            pos = (h1 + i * h2) & mask
+            out &= (self.bits[pos >> 3] >> (pos & 7)).astype(bool) & True
+        return out
+
+    def merge(self, other: "BloomFilter") -> None:
+        assert self.num_bits == other.num_bits
+        self.bits |= other.bits
+
+    # -- serde --------------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        return MAGIC + struct.pack("<II", self.num_bits, self.num_hashes) \
+            + self.bits.tobytes()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "BloomFilter":
+        if data[:4] != MAGIC:
+            raise ValueError("bad bloom filter blob")
+        num_bits, num_hashes = struct.unpack_from("<II", data, 4)
+        bits = np.frombuffer(data[12:], dtype=np.uint8).copy()
+        return BloomFilter(num_bits, num_hashes, bits)
+
+
+def _host_two_hashes(values: np.ndarray, dtype: DataType):
+    """(h1, h2) uint64 pairs per value, matching the device kernel."""
+    from auron_tpu.native import bindings
+    n = len(values)
+    h1 = np.empty(n, np.uint64)
+    h2 = np.empty(n, np.uint64)
+    for i in range(n):
+        v = values[i]
+        if dtype.is_stringlike:
+            data = v if isinstance(v, bytes) else str(v).encode("utf-8")
+            h1[i] = np.uint64(bindings.murmur3_32(data, 0) & 0xFFFFFFFF)
+            h2[i] = np.uint64(bindings.murmur3_32(data, 0x9747B28C) & 0xFFFFFFFF)
+        else:
+            data = int(v).to_bytes(8, "little", signed=True)
+            h1[i] = np.uint64(bindings.murmur3_32(data, 0) & 0xFFFFFFFF)
+            h2[i] = np.uint64(bindings.murmur3_32(data, 0x9747B28C) & 0xFFFFFFFF)
+    return h1, h2
+
+
+# ---------------------------------------------------------------------------
+# device probe
+# ---------------------------------------------------------------------------
+
+def _device_two_hashes(col):
+    if isinstance(col, DeviceStringColumn):
+        h1 = H.hash_bytes(col.data, col.lengths, jnp.uint32(0))
+        h2 = H.hash_bytes(col.data, col.lengths, jnp.uint32(0x9747B28C))
+    else:
+        v = col.data.astype(jnp.int64)
+        h1 = H.hash_int64(v, jnp.uint32(0))
+        h2 = H.hash_int64(v, jnp.uint32(0x9747B28C))
+    return h1.astype(jnp.uint32), h2.astype(jnp.uint32)
+
+
+def might_contain_device(bf: BloomFilter, col) -> Any:
+    """bool[capacity] device array."""
+    bits = jnp.asarray(bf.bits)
+    h1, h2 = _device_two_hashes(col)
+    mask = jnp.uint32(bf.num_bits - 1)
+    out = jnp.ones(h1.shape, bool)
+    for i in range(bf.num_hashes):
+        pos = (h1 + jnp.uint32(i) * h2) & mask
+        byte = bits[(pos >> 3).astype(jnp.int32)]
+        out = jnp.logical_and(out, (byte >> (pos & 7).astype(jnp.uint8)) & 1)
+    return out
+
+
+def bloom_might_contain_expr(e, ctx):
+    """Device eval for the bloom_filter_might_contain expr: the bloom side
+    must be a binary literal / scalar-subquery blob."""
+    from auron_tpu.exprs.compiler import evaluate
+    blob = getattr(e.bloom_filter, "value", None)
+    if blob is None:
+        raise NotImplementedError(
+            "bloom_filter_might_contain requires a literal bloom blob")
+    bf = BloomFilter.from_bytes(bytes(blob))
+    val = evaluate(e.value, ctx)
+    data = might_contain_device(bf, val)
+    return flat(DataType.bool_(), data, val.validity)
+
+
+def host_might_contain(bloom_hv, value_hv):
+    """Host eval counterpart (HV in/out)."""
+    from auron_tpu.exprs.host_eval import HV
+    n = len(value_hv)
+    out = np.zeros(n, bool)
+    # bloom blob is constant per batch
+    blob = None
+    for i in range(n):
+        if bloom_hv.mask[i]:
+            blob = bloom_hv.vals[i]
+            break
+    if blob is not None:
+        bf = BloomFilter.from_bytes(bytes(blob))
+        res = bf.might_contain_host(value_hv.vals, value_hv.dtype)
+        out = np.where(value_hv.mask, res, False)
+    return HV(out, value_hv.mask.copy(), DataType.bool_())

@@ -1,0 +1,437 @@
+"""Aggregation operator (sort-based grouping on device).
+
+Re-design of agg_exec.rs:59 + agg/agg_table.rs for TPU: instead of the
+SIMD-8-way hash map (agg_hash_map.rs:26), grouping sorts encoded key words
+and segment-reduces — the contiguous, branch-free shape XLA/TPU wants.
+
+Flow per input batch:
+  keys = eval(grouping)  ->  key words  ->  lexsort  ->  seg ids
+  states = spec.update_segments(...)            (partial accumulate)
+  acc    = merge(acc, partial)                  (concat + regroup)
+Under memory pressure the accumulator spills (sorted by key words) and
+spilled runs merge at output (the bucket-spill analogue, agg_table.rs:323).
+Partial-agg skipping (agg_ctx.rs:63-66): in `partial` mode, if cardinality
+reduction is poor the operator passes rows through (the final agg upstream
+regroups anyway).
+
+collect_list/collect_set/bloom/udaf aggregate on the host path (arrow
+values grouped by segment id) — the SparkUDAFWrapper analogue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import (
+    Batch, DeviceColumn, DeviceStringColumn, HostColumn, bucket_capacity,
+    concat_batches,
+)
+from auron_tpu.config import conf
+from auron_tpu.exprs.compiler import build_evaluator
+from auron_tpu.exprs.typing import infer_type
+from auron_tpu.ir.expr import AggExpr
+from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.memmgr import MemConsumer, SpillManager, get_manager
+from auron_tpu.ops.agg.functions import AggSpec, HostAggSpec, make_spec
+from auron_tpu.ops.base import Operator, TaskContext, batch_size
+from auron_tpu.ops.sort_keys import (
+    encode_sort_keys, keys_equal_prev, lexsort_indices,
+)
+
+
+class AggExec(Operator, MemConsumer):
+    def __init__(self, child: Operator, exec_mode: str, grouping,
+                 grouping_names, aggs: Tuple[AggExpr, ...], agg_names,
+                 supports_partial_skipping: bool = False):
+        in_schema = child.schema
+        self.exec_mode = exec_mode
+        self.grouping = tuple(grouping)
+        self.grouping_names = tuple(grouping_names)
+        self.aggs = tuple(aggs)
+        self.agg_names = tuple(agg_names)
+
+        # resolve agg specs
+        self.specs: List[AggSpec] = []
+        for a, name in zip(self.aggs, self.agg_names):
+            if exec_mode == "final":
+                # inputs are partial states; in dtype recorded in children
+                in_dt = None if not a.children else _child_type(a, in_schema)
+            else:
+                in_dt = None if not a.children else _child_type(a, in_schema)
+            self.specs.append(make_spec(a.fn, in_dt or DataType.int64(),
+                                        a.return_type, name, a.udaf))
+
+        key_fields = tuple(
+            Field(n, infer_type(g, in_schema))
+            for n, g in zip(self.grouping_names, self.grouping))
+        if exec_mode == "partial":
+            out_fields = list(key_fields)
+            for spec in self.specs:
+                out_fields.extend(spec.state_fields())
+        else:
+            out_fields = list(key_fields) + [
+                Field(n, a.return_type)
+                for n, a in zip(self.agg_names, self.aggs)]
+        Operator.__init__(self, Schema(tuple(out_fields)), [child])
+        MemConsumer.__init__(self, "AggExec")
+
+        self._key_eval = build_evaluator(self.grouping, in_schema)
+        if exec_mode == "final":
+            # inputs to merge are the partial state columns laid out after
+            # the key columns in the child schema
+            self._val_eval = None
+        else:
+            flat_inputs: List[Any] = []
+            self._agg_arg_slices: List[Tuple[int, int]] = []
+            for a in self.aggs:
+                start = len(flat_inputs)
+                flat_inputs.extend(a.children)
+                self._agg_arg_slices.append((start, len(flat_inputs)))
+            self._val_eval = build_evaluator(tuple(flat_inputs), in_schema) \
+                if flat_inputs else None
+
+        self.supports_partial_skipping = supports_partial_skipping and \
+            exec_mode == "partial" and \
+            bool(conf.get("auron.partial.agg.skipping.enable")) and \
+            not any(isinstance(s, HostAggSpec) for s in self.specs)
+
+        # accumulator
+        self._acc: Optional[Batch] = None      # device path accumulator
+        self._host_groups: Dict = {}           # host path accumulator
+        self._spills = SpillManager("agg")
+        self._input_rows = 0
+        self._passthrough = False
+        self._has_host_aggs = any(isinstance(s, HostAggSpec)
+                                  for s in self.specs)
+
+    # ------------------------------------------------------------------
+    # device path
+    # ------------------------------------------------------------------
+
+    def _key_orders(self):
+        return tuple((True, True) for _ in self.grouping)
+
+    def _group_reduce(self, keys: List[Any], value_cols: List[List[Any]],
+                      capacity: int, num_rows: int, merge: bool) -> Batch:
+        """Sort rows by key, segment-reduce each agg; returns grouped batch
+        (keys + states)."""
+        words = encode_sort_keys(keys, self._key_orders())
+        perm = lexsort_indices(words, num_rows, capacity)
+        live = jnp.arange(capacity) < jnp.asarray(num_rows, jnp.int32)
+        sorted_words = [jnp.take(w, perm) for w in words]
+        eq_prev = keys_equal_prev(sorted_words)
+        is_boundary = jnp.logical_and(jnp.logical_not(eq_prev), live)
+        seg_of_sorted = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
+        seg_of_sorted = jnp.where(live, seg_of_sorted, capacity - 1)
+        n_groups = int(jnp.sum(is_boundary))
+        # first row index (into原 sorted order) per segment for key gather
+        first_sorted_idx = jnp.nonzero(is_boundary, size=capacity,
+                                       fill_value=0)[0].astype(jnp.int32)
+        key_src = jnp.take(perm, first_sorted_idx)
+        g_valid = jnp.arange(capacity) < n_groups
+        out_cols: List[Any] = []
+        for k in keys:
+            out_cols.append(k.gather(key_src, g_valid))
+        for spec, cols in zip(self.specs, value_cols):
+            scols = [_gather_col(c, perm) for c in cols]
+            if merge:
+                states = spec.merge_segments(scols, seg_of_sorted, capacity)
+            else:
+                states = spec.update_segments(scols, seg_of_sorted, capacity)
+            out_cols.extend(_clip_states(states, n_groups))
+        schema_fields = list(self.schema.fields[:len(keys)])
+        for spec in self.specs:
+            schema_fields.extend(spec.state_fields())
+        return Batch(Schema(tuple(schema_fields)), out_cols, n_groups,
+                     capacity)
+
+    def _merge_acc(self, grouped: Batch) -> None:
+        if self._acc is None:
+            self._acc = grouped
+        else:
+            total = self._acc.num_rows + grouped.num_rows
+            cap = bucket_capacity(total)
+            merged = concat_batches(grouped.schema, [self._acc, grouped], cap)
+            nk = len(self.grouping)
+            keys = merged.columns[:nk]
+            states: List[List[Any]] = []
+            off = nk
+            for spec in self.specs:
+                k = len(spec.state_fields())
+                states.append(merged.columns[off:off + k])
+                off += k
+            self._acc = self._group_reduce(keys, states, cap,
+                                           merged.num_rows, merge=True)
+        self.update_mem_used(self._acc.mem_bytes() if self._acc else 0)
+
+    # ------------------------------------------------------------------
+    # host path (collect/bloom/udaf or host-typed keys)
+    # ------------------------------------------------------------------
+
+    def _host_accs(self):
+        from auron_tpu.ops.agg.functions import host_accumulator
+        return [host_accumulator(spec, bool(a.children))
+                for spec, a in zip(self.specs, self.aggs)]
+
+    def _host_update(self, b: Batch, merge: bool) -> None:
+        """Accumulate a batch into the host group map.  merge=True means
+        the batch carries partial states (state tuples per spec)."""
+        rb = b.to_arrow()
+        from auron_tpu.exprs.host_eval import evaluate as hev, hv_to_arrow
+        in_schema = self.children[0].schema
+        if merge:
+            nk = len(self.grouping)
+            key_lists = [rb.column(i).to_pylist() for i in range(nk)]
+            state_lists: List[List[tuple]] = []
+            off = nk
+            for spec in self.specs:
+                k = len(spec.state_fields())
+                cols = [rb.column(off + j).to_pylist() for j in range(k)]
+                state_lists.append(list(zip(*cols)) if cols
+                                   else [()] * b.num_rows)
+                off += k
+        else:
+            key_lists = [hv_to_arrow(hev(g, rb, in_schema)).to_pylist()
+                         for g in self.grouping]
+            state_lists = []
+            for a in self.aggs:
+                if a.children:
+                    state_lists.append(hv_to_arrow(
+                        hev(a.children[0], rb, in_schema)).to_pylist())
+                else:
+                    state_lists.append([None] * b.num_rows)
+        keys_py = list(zip(*key_lists)) if key_lists else \
+            [()] * b.num_rows
+        for i in range(b.num_rows):
+            k = keys_py[i]
+            entry = self._host_groups.get(k)
+            if entry is None:
+                haccs = self._host_accs()
+                entry = (haccs, [h.init() for h in haccs])
+                self._host_groups[k] = entry
+            haccs, accs = entry
+            for j, h in enumerate(haccs):
+                if merge:
+                    accs[j] = h.merge_state(accs[j], state_lists[j][i])
+                else:
+                    accs[j] = h.update(accs[j], state_lists[j][i])
+
+    def _absorb_device_acc_into_host(self) -> None:
+        """When the host path takes over mid-stream, fold the existing
+        device accumulator (a valid partial-state batch) into the host
+        group map instead of dropping it."""
+        if self._acc is not None:
+            self._host_update(self._acc, merge=True)
+            self._acc = None
+            self.update_mem_used(0)
+
+    def _host_emit(self) -> Iterator[Batch]:
+        import pyarrow as pa
+        from auron_tpu.ir.schema import to_arrow_schema
+        rows = []
+        for k, (haccs, accs) in self._host_groups.items():
+            row = list(k)
+            for h, acc in zip(haccs, accs):
+                if self.exec_mode == "partial":
+                    row.extend(h.state(acc))
+                else:
+                    row.append(h.eval(acc))
+            rows.append(row)
+        if not rows and not self.grouping and self.exec_mode != "partial":
+            rows = [[h.eval(h.init()) for h in self._host_accs()]]
+        aschema = to_arrow_schema(self.schema)
+        bs = batch_size()
+        for off in range(0, len(rows), bs):
+            chunk = rows[off:off + bs]
+            cols = list(zip(*chunk))
+            arrays = [pa.array(list(c), type=f.type)
+                      for c, f in zip(cols, aschema)]
+            yield Batch.from_arrow(
+                pa.RecordBatch.from_arrays(arrays, schema=aschema))
+
+    # ------------------------------------------------------------------
+
+    def spill(self) -> int:
+        if self._acc is None or self._has_host_aggs:
+            return 0
+        freed = self._acc.mem_bytes()
+        spill = self._spills.new_spill()
+        size = spill.write_batches([self._acc.to_arrow()])
+        self.metrics.add("mem_spill_count", 1)
+        self.metrics.add("mem_spill_size", size)
+        self._acc = None
+        self.update_mem_used(0)
+        return freed
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        mgr = ctx.mem_manager or get_manager()
+        mgr.register_consumer(self)
+        try:
+            yield from self._execute_inner(ctx)
+        finally:
+            self._spills.release_all()
+            mgr.unregister_consumer(self)
+
+    def _execute_inner(self, ctx: TaskContext) -> Iterator[Batch]:
+        merge_input = self.exec_mode == "final"
+        stream = self.child_stream(ctx)   # single iterator: both loops share
+        for b in stream:
+            if b.num_rows == 0:
+                continue
+            self._input_rows += b.num_rows
+            if self._has_host_aggs or b.has_host_columns():
+                if not self._has_host_aggs:
+                    self._has_host_aggs = True
+                    self._absorb_device_acc_into_host()
+                self._host_update(b, merge_input)
+                continue
+            keys = self._key_eval(b, partition_id=ctx.partition_id)
+            if merge_input:
+                vcols: List[List[Any]] = []
+                nk = len(self.grouping)
+                off = nk
+                for spec in self.specs:
+                    k = len(spec.state_fields())
+                    vcols.append(b.columns[off:off + k])
+                    off += k
+            else:
+                flat_vals = self._val_eval(b, partition_id=ctx.partition_id) \
+                    if self._val_eval else []
+                vcols = [flat_vals[s:e] for s, e in self._agg_arg_slices]
+            grouped = self._group_reduce(keys, vcols, b.capacity,
+                                         b.num_rows, merge=merge_input)
+            self._merge_acc(grouped)
+            # partial-agg skipping (agg_ctx.rs:63-66)
+            if self.supports_partial_skipping and self._acc is not None and \
+                    self._input_rows >= int(conf.get(
+                        "auron.partial.agg.skipping.min.rows")):
+                ratio = self._acc.num_rows / max(self._input_rows, 1)
+                if ratio >= float(conf.get(
+                        "auron.partial.agg.skipping.ratio")):
+                    self._passthrough = True
+                    yield self._acc
+                    self._acc = None
+                    self.update_mem_used(0)
+                    break
+        if self._passthrough:
+            # stream the remainder of the SAME child iterator as
+            # locally-grouped batches (update only)
+            for b in stream:
+                if b.num_rows == 0:
+                    continue
+                keys = self._key_eval(b, partition_id=ctx.partition_id)
+                flat_vals = self._val_eval(b, partition_id=ctx.partition_id) \
+                    if self._val_eval else []
+                vcols = [flat_vals[s:e] for s, e in self._agg_arg_slices]
+                yield self._group_reduce(keys, vcols, b.capacity,
+                                         b.num_rows, merge=False)
+            return
+        if self._has_host_aggs:
+            yield from self._host_emit()
+            return
+        if len(self._spills):
+            if self._acc is not None:
+                self.spill()
+            yield from self._merge_spilled()
+            return
+        if self._acc is None:
+            if not self.grouping and self.exec_mode != "partial":
+                yield self._empty_global_agg()
+            return
+        if self.exec_mode == "partial":
+            yield self._acc
+        else:
+            yield self._finalize(self._acc)
+        self._acc = None
+        self.update_mem_used(0)
+
+    def _merge_spilled(self) -> Iterator[Batch]:
+        batches = []
+        for s in self._spills.spills:
+            for rb in s.read_batches():
+                batches.append(Batch.from_arrow(rb))
+        total = sum(b.num_rows for b in batches)
+        cap = bucket_capacity(total)
+        merged = concat_batches(batches[0].schema, batches, cap)
+        nk = len(self.grouping)
+        keys = merged.columns[:nk]
+        states: List[List[Any]] = []
+        off = nk
+        for spec in self.specs:
+            k = len(spec.state_fields())
+            states.append(merged.columns[off:off + k])
+            off += k
+        acc = self._group_reduce(keys, states, cap, merged.num_rows,
+                                 merge=True)
+        yield acc if self.exec_mode == "partial" else self._finalize(acc)
+
+    def _finalize(self, acc: Batch) -> Batch:
+        nk = len(self.grouping)
+        out_cols = list(acc.columns[:nk])
+        off = nk
+        for spec in self.specs:
+            k = len(spec.state_fields())
+            out_cols.append(spec.eval_final(acc.columns[off:off + k]))
+            off += k
+        return Batch(self.schema, out_cols, acc.num_rows, acc.capacity)
+
+    def _empty_global_agg(self) -> Batch:
+        """Global agg over empty input: one row (count=0, sum=null...)."""
+        cap = bucket_capacity(1)
+        empty = Batch.empty(
+            self.children[0].schema if self.children else self.schema, cap)
+        seg = jnp.zeros(cap, jnp.int32)
+        out_cols: List[Any] = []
+        for spec, a in zip(self.specs, self.aggs):
+            zero_in = [
+                DeviceColumn(spec.in_dtype,
+                             jnp.zeros(cap, spec.in_dtype.numpy_dtype()),
+                             jnp.zeros(cap, bool))
+            ] if a.children else []
+            states = spec.update_segments(zero_in, seg, cap)
+            # no input rows: count states come back 0-filled which is right,
+            # but count counted the zero rows -> rebuild with empty seg
+            states = [DeviceColumn(s.dtype, jnp.zeros_like(s.data),
+                                   jnp.zeros_like(s.validity))
+                      if spec.fn != "count" else
+                      DeviceColumn(s.dtype, jnp.zeros_like(s.data),
+                                   jnp.ones_like(s.validity))
+                      for s in states]
+            out_cols.append(spec.eval_final(states))
+        return Batch(self.schema, out_cols, 1, cap)
+
+
+def _child_type(a: AggExpr, schema: Schema) -> Optional[DataType]:
+    try:
+        return infer_type(a.children[0], schema)
+    except Exception:
+        return None
+
+
+def _gather_col(c, perm):
+    cap = perm.shape[0]
+    valid = jnp.ones(cap, bool)
+    return c.gather(perm, valid)
+
+
+def _clip_states(states: List[Any], n_groups: int) -> List[Any]:
+    """Mark state rows beyond the group count invalid (they hold segment
+    reductions of padding)."""
+    out = []
+    for s in states:
+        cap = s.capacity
+        live = jnp.arange(cap) < n_groups
+        if isinstance(s, DeviceStringColumn):
+            out.append(DeviceStringColumn(
+                s.dtype, jnp.where(live[:, None], s.data, 0),
+                jnp.where(live, s.lengths, 0),
+                jnp.logical_and(s.validity, live)))
+        else:
+            out.append(DeviceColumn(
+                s.dtype, jnp.where(live, s.data, jnp.zeros((), s.data.dtype)),
+                jnp.logical_and(s.validity, live)))
+    return out

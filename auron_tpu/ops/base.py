@@ -1,0 +1,96 @@
+"""Operator protocol + execution context.
+
+Analogue of the reference's ExecutionContext scaffolding
+(datafusion-ext-plans/src/common/execution_context.rs:70): operators are
+host-driven generators of padded device batches; the hot kernels inside are
+jitted jnp programs cached per (fragment, schema, capacity).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.config import conf
+from auron_tpu.ir.schema import Schema
+from auron_tpu.runtime.metrics import MetricNode
+from auron_tpu.runtime.resources import GLOBAL_RESOURCES, ResourceRegistry
+
+
+@dataclass
+class TaskContext:
+    """Per-task execution context (stage/partition ids, resources, memory
+    manager handle) — analogue of the JVM TaskContext the reference
+    propagates to native worker threads (rt.rs:113-139)."""
+    stage_id: int = 0
+    partition_id: int = 0
+    num_partitions: int = 1
+    resources: ResourceRegistry = field(default_factory=lambda: GLOBAL_RESOURCES)
+    mem_manager: Optional[Any] = None
+    is_running: bool = True    # is_task_running analogue (jni lib.rs:35)
+
+    def cancel(self) -> None:
+        self.is_running = False
+
+
+class Operator:
+    """Base operator: `execute(ctx)` yields Batches of `self.schema`."""
+
+    def __init__(self, schema: Schema, children: List["Operator"],
+                 name: Optional[str] = None):
+        self.schema = schema
+        self.children = children
+        self.name = name or type(self).__name__
+        self.metrics = MetricNode(self.name)
+        for c in children:
+            self.metrics.children.append(c.metrics)
+
+    # -- interface ----------------------------------------------------------
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        raise NotImplementedError
+
+    # -- helpers ------------------------------------------------------------
+
+    def execute_with_metrics(self, ctx: TaskContext) -> Iterator[Batch]:
+        """Wraps execute() with output_rows/batches + compute-time metrics
+        and task-cancellation checks."""
+        import time
+        it = self.execute(ctx)
+        while True:
+            t0 = time.perf_counter_ns()
+            try:
+                batch = next(it)
+            except StopIteration:
+                self.metrics.add("elapsed_compute_ns",
+                                 time.perf_counter_ns() - t0)
+                return
+            self.metrics.add("elapsed_compute_ns", time.perf_counter_ns() - t0)
+            if not ctx.is_running:
+                return
+            self.metrics.add("output_rows", batch.num_rows)
+            self.metrics.add("output_batches", 1)
+            yield batch
+
+    def child_stream(self, ctx: TaskContext, i: int = 0) -> Iterator[Batch]:
+        return self.children[i].execute_with_metrics(ctx)
+
+
+def compact_indices(mask, capacity: int):
+    """Stable indices of set mask bits, padded with 0; returns (idx, count).
+    The core filter/compaction primitive (device-side, static shape)."""
+    idx = jnp.nonzero(mask, size=capacity, fill_value=0)[0].astype(jnp.int32)
+    count = jnp.sum(mask.astype(jnp.int32))
+    return idx, count
+
+
+def batch_size() -> int:
+    return int(conf.get("auron.batch.size"))
+
+
+def suggested_output_capacity(n: int) -> int:
+    from auron_tpu.columnar.batch import bucket_capacity
+    return bucket_capacity(min(n, batch_size()) if n else batch_size())

@@ -1,0 +1,212 @@
+"""Basic operators: Project, Filter, Limit, Union, Expand, CoalesceBatches,
+RenameColumns, EmptyPartitions, Debug.
+
+Reference analogues: project_exec.rs:48, filter_exec.rs:44 (fused
+filter+project via the shared evaluator), limit_exec.rs:42, union_exec.rs:39,
+expand_exec.rs:40, ExecutionContext::coalesce_with_default_batch_size,
+rename_columns_exec.rs:41, empty_partitions_exec.rs:36, debug_exec.rs:37.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import Batch, concat_batches
+from auron_tpu.exprs.compiler import build_evaluator, build_predicate
+from auron_tpu.ir.schema import Field, Schema
+from auron_tpu.exprs.typing import infer_type
+from auron_tpu.ops.base import (
+    Operator, TaskContext, batch_size, compact_indices,
+)
+
+
+class ProjectExec(Operator):
+    def __init__(self, child: Operator, exprs, names):
+        in_schema = child.schema
+        fields = tuple(Field(n, infer_type(x, in_schema))
+                       for n, x in zip(names, exprs))
+        super().__init__(Schema(fields), [child])
+        self.exprs = tuple(exprs)
+        self._eval = build_evaluator(self.exprs, in_schema)
+        self._row_base = 0
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        for b in self.child_stream(ctx):
+            cols = self._eval(b, partition_id=ctx.partition_id,
+                              row_base=self._row_base)
+            self._row_base += b.num_rows
+            yield b.with_columns(self.schema, cols)
+
+
+class FilterExec(Operator):
+    """Filter + optional fused projection (reference fuses them too)."""
+
+    def __init__(self, child: Operator, predicates,
+                 exprs=None, names=None):
+        in_schema = child.schema
+        if exprs is None:
+            out_schema = in_schema
+        else:
+            out_schema = Schema(tuple(
+                Field(n, infer_type(x, in_schema))
+                for n, x in zip(names, exprs)))
+        super().__init__(out_schema, [child])
+        self.predicates = tuple(predicates)
+        self.exprs = tuple(exprs) if exprs is not None else None
+        self._pred = build_predicate(self.predicates, in_schema)
+        self._proj = build_evaluator(self.exprs, in_schema) \
+            if self.exprs is not None else None
+        self._row_base = 0
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        for b in self.child_stream(ctx):
+            [m] = self._pred(b, partition_id=ctx.partition_id,
+                             row_base=self._row_base)
+            keep = jnp.logical_and(
+                jnp.logical_and(m.validity, m.data.astype(bool)),
+                b.row_mask())
+            idx, count = compact_indices(keep, b.capacity)
+            n = int(count)
+            self._row_base += b.num_rows
+            if n == 0:
+                continue
+            src = b
+            if self._proj is not None:
+                cols = self._proj(b, partition_id=ctx.partition_id,
+                                  row_base=self._row_base - b.num_rows)
+                src = b.with_columns(self.schema, cols)
+            yield src.gather(idx, n)
+
+
+class LimitExec(Operator):
+    def __init__(self, child: Operator, limit: int, offset: int = 0):
+        super().__init__(child.schema, [child])
+        self.limit = limit
+        self.offset = offset
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        to_skip = self.offset
+        remaining = self.limit
+        for b in self.child_stream(ctx):
+            if remaining <= 0:
+                return
+            if to_skip >= b.num_rows:
+                to_skip -= b.num_rows
+                continue
+            if to_skip > 0:
+                idx = jnp.arange(b.capacity, dtype=jnp.int32) + to_skip
+                b = b.gather(idx, b.num_rows - to_skip)
+                to_skip = 0
+            if b.num_rows > remaining:
+                b = b.head(remaining)
+            remaining -= b.num_rows
+            yield b
+
+
+class UnionExec(Operator):
+    """Multi-input union; each input contributes its mapped partition
+    (proto:542-552 per-input partition mapping is resolved by the planner
+    into the child list for this task's partition)."""
+
+    def __init__(self, children: List[Operator], schema: Schema):
+        super().__init__(schema, children)
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        for i in range(len(self.children)):
+            for b in self.child_stream(ctx, i):
+                yield b.rename(self.schema.names()) \
+                    if b.schema.names() != self.schema.names() else b
+
+
+class ExpandExec(Operator):
+    """Grouping-sets: emits one copy of the input per projection list."""
+
+    def __init__(self, child: Operator, projections, names, types=None):
+        in_schema = child.schema
+        if types:
+            fields = tuple(Field(n, t) for n, t in zip(names, types))
+        else:
+            fields = tuple(Field(n, infer_type(x, in_schema))
+                           for n, x in zip(names, projections[0]))
+        super().__init__(Schema(fields), [child])
+        self.projections = tuple(tuple(p) for p in projections)
+        self._evals = [build_evaluator(p, in_schema) for p in self.projections]
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        for b in self.child_stream(ctx):
+            for ev in self._evals:
+                cols = ev(b, partition_id=ctx.partition_id)
+                yield b.with_columns(self.schema, cols)
+
+
+class CoalesceBatchesExec(Operator):
+    def __init__(self, child: Operator, target: int = 0):
+        super().__init__(child.schema, [child])
+        self.target = target or batch_size()
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        staged: List[Batch] = []
+        staged_rows = 0
+        for b in self.child_stream(ctx):
+            if b.num_rows == 0:
+                continue
+            if b.num_rows >= self.target and not staged:
+                yield b
+                continue
+            staged.append(b)
+            staged_rows += b.num_rows
+            if staged_rows >= self.target:
+                yield concat_batches(self.schema, staged)
+                staged, staged_rows = [], 0
+        if staged:
+            yield concat_batches(self.schema, staged)
+
+
+class RenameColumnsExec(Operator):
+    def __init__(self, child: Operator, names):
+        super().__init__(child.schema.rename(tuple(names)), [child])
+        self.names = tuple(names)
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        for b in self.child_stream(ctx):
+            yield b.rename(self.names)
+
+
+class EmptyPartitionsExec(Operator):
+    def __init__(self, schema: Schema, num_partitions: int = 1):
+        super().__init__(schema, [])
+        self.num_partitions = num_partitions
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        return iter(())
+
+
+class DebugExec(Operator):
+    def __init__(self, child: Operator, debug_id: str = ""):
+        super().__init__(child.schema, [child])
+        self.debug_id = debug_id
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        import logging
+        log = logging.getLogger("auron_tpu.debug")
+        for i, b in enumerate(self.child_stream(ctx)):
+            log.info("[%s] batch %d: %d rows\n%s", self.debug_id, i,
+                     b.num_rows, b.to_arrow().slice(0, 10).to_pydict())
+            yield b
+
+
+class MemoryScanExec(Operator):
+    """In-memory table scan (the MemoryExec analogue the reference's operator
+    tests build fixtures with, joins/test.rs:57)."""
+
+    def __init__(self, schema: Schema, batches: List[Batch],
+                 partitions: Optional[List[List[Batch]]] = None):
+        super().__init__(schema, [])
+        self._partitions = partitions if partitions is not None else [batches]
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        pid = min(ctx.partition_id, len(self._partitions) - 1)
+        yield from iter(self._partitions[pid])

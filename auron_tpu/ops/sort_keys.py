@@ -1,0 +1,124 @@
+"""Sortable key encoding — the analogue of the reference's key-prefix
+encoded rows (sort_exec.rs: "key-prefix encoded rows, in-mem radix/stable
+sort").
+
+Each sort key column is transformed into one or more uint64 device vectors
+whose unsigned lexicographic order equals the SQL ordering (asc/desc,
+nulls_first, Spark NaN-greatest, decimal scales, string bytes).  Multi-key
+ordering = jnp.lexsort over the concatenated vector list.  The same encoding
+drives Sort, SortMergeJoin, Window partitioning and sort-based Agg grouping.
+
+Numeric trick: IEEE doubles order correctly as unsigned ints after
+  bits >= 0 ? bits ^ SIGN : ~bits
+with NaN (0x7ff8...) landing above +inf — exactly Spark's NaN-last-asc.
+Strings pack 8 bytes per u64 word, zero-padded (pad < any byte), length as
+a final tiebreaker word.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import DeviceColumn, DeviceStringColumn
+from auron_tpu.ir.schema import TypeId
+
+SIGN64 = jnp.uint64(0x8000000000000000)
+MAXU64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _orderable_u64_from_i64(v):
+    return v.astype(jnp.uint64) ^ SIGN64
+
+
+def _orderable_u64_from_f64(v):
+    """IEEE trick without 64-bit bitcast (unimplemented in XLA's TPU x64
+    rewrite): assemble the u64 from two u32 words; on TPU backends f64 is
+    demoted so ordering is at f32 granularity (see f64_bits_u32_pair)."""
+    from auron_tpu.exprs.hashing import f64_bits_u32_pair
+    import jax
+    if jax.default_backend() not in ("cpu", "gpu"):
+        return _orderable_u64_from_f32(v.astype(jnp.float32))
+    lo, hi = f64_bits_u32_pair(v)
+    bits = (hi.astype(jnp.uint64) << 32) | lo.astype(jnp.uint64)
+    neg = (bits & SIGN64) != 0
+    return jnp.where(neg, ~bits, bits ^ SIGN64)
+
+
+def _orderable_u64_from_f32(v):
+    import jax.lax as lax
+    bits = lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32) \
+        .astype(jnp.uint64) << 32
+    neg = (bits & SIGN64) != 0
+    return jnp.where(neg, ~bits, bits ^ SIGN64) & \
+        jnp.uint64(0xFFFFFFFF00000000)
+
+
+def encode_key_column(col, asc: bool = True, nulls_first: bool = True
+                      ) -> List[Any]:
+    """-> list of uint64[capacity] words, most-significant first."""
+    words: List[Any] = []
+    if isinstance(col, DeviceStringColumn):
+        w = col.width
+        d = col.data.astype(jnp.uint64)
+        for blk in range(0, w, 8):
+            word = jnp.zeros(col.capacity, jnp.uint64)
+            for j in range(8):
+                byte = d[:, blk + j] if blk + j < w else \
+                    jnp.zeros(col.capacity, jnp.uint64)
+                word = (word << 8) | byte
+            words.append(word)
+        words.append(col.lengths.astype(jnp.uint64))
+    else:
+        tid = col.dtype.id
+        if tid in (TypeId.FLOAT64,):
+            words = [_orderable_u64_from_f64(col.data)]
+        elif tid in (TypeId.FLOAT32,):
+            words = [_orderable_u64_from_f32(col.data)]
+        elif tid == TypeId.BOOL:
+            words = [col.data.astype(jnp.uint64)]
+        else:
+            words = [_orderable_u64_from_i64(col.data.astype(jnp.int64))]
+    if not asc:
+        words = [~w for w in words]
+    # null handling: prepend a null-rank word would cost a word per key;
+    # instead fold into the first word is unsafe (overflow), so use a
+    # dedicated leading word only when the column is nullable in practice —
+    # cheap and simple: always add the rank word.
+    null_rank = jnp.where(col.validity,
+                          jnp.uint64(1) if nulls_first else jnp.uint64(0),
+                          jnp.uint64(0) if nulls_first else jnp.uint64(1))
+    return [null_rank] + words
+
+
+def encode_sort_keys(cols: Sequence[Any],
+                     orders: Sequence[Tuple[bool, bool]]) -> List[Any]:
+    """cols+(asc, nulls_first) list -> u64 word list, most-significant
+    first (ready for lexsort_indices)."""
+    words: List[Any] = []
+    for col, (asc, nf) in zip(cols, orders):
+        words.extend(encode_key_column(col, asc, nf))
+    return words
+
+
+def lexsort_indices(words: List[Any], num_rows, capacity: int):
+    """Stable argsort by word list (most-significant first); padding rows
+    (index >= num_rows) sort last.  Returns int32[capacity] permutation."""
+    live = jnp.arange(capacity) < jnp.asarray(num_rows, jnp.int32)
+    pad_rank = jnp.where(live, jnp.uint64(0), jnp.uint64(1))
+    # jnp.lexsort: last key is primary
+    keys = list(reversed([pad_rank] + words))
+    return jnp.lexsort(tuple(keys)).astype(jnp.int32)
+
+
+def keys_equal_prev(words: List[Any]):
+    """bool[capacity]: row i has identical keys to row i-1 (row 0 -> False).
+    Used for group-boundary detection after sorting."""
+    eq = None
+    for w in words:
+        prev = jnp.concatenate([w[:1] ^ MAXU64, w[:-1]])  # row0 differs
+        e = w == prev
+        eq = e if eq is None else jnp.logical_and(eq, e)
+    return eq

@@ -1,0 +1,7 @@
+"""Task runtime: planner, executor, metrics, resources.
+
+Analogue of the reference's native-engine/auron runtime crate + auron-planner:
+a TaskDefinition arrives (IR bytes), the planner builds the operator tree,
+the executor pulls batches through it and finalizes metrics
+(exec.rs:42, rt.rs:76-308, planner.rs:121).
+"""

@@ -1,0 +1,69 @@
+"""Operator metrics tree.
+
+Analogue of the reference's metric plumbing: native operators carry
+ExecutionPlanMetricsSet and update_metric_node walks the plan + mirrored JVM
+MetricNode tree at finalize (auron/src/metrics.rs:22-52, MetricNode.java,
+NativeHelper.scala:170-238).  Here MetricNode mirrors the operator tree and
+is returned to the driver/front-end after execution.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# the default metric vocabulary (NativeHelper.scala:170-202)
+STANDARD_METRICS = (
+    "output_rows", "output_batches", "elapsed_compute_ns",
+    "mem_spill_count", "mem_spill_size", "mem_spill_iotime_ns",
+    "disk_spill_size", "disk_spill_iotime_ns",
+    "shuffle_write_rows", "shuffle_write_time_ns",
+    "shuffle_read_rows", "shuffle_read_time_ns",
+    "build_hash_map_time_ns", "probe_time_ns",
+    "fallback_sort_merge_join_count",
+    "input_rows", "input_batches",
+    "parquet_row_groups_pruned", "parquet_row_groups_read",
+)
+
+
+@dataclass
+class MetricNode:
+    name: str
+    values: Dict[str, int] = field(default_factory=dict)
+    children: List["MetricNode"] = field(default_factory=list)
+
+    def add(self, key: str, delta: int) -> None:
+        self.values[key] = self.values.get(key, 0) + int(delta)
+
+    def set(self, key: str, value: int) -> None:
+        self.values[key] = int(value)
+
+    def get(self, key: str) -> int:
+        return self.values.get(key, 0)
+
+    @contextmanager
+    def timer(self, key: str):
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.add(key, time.perf_counter_ns() - t0)
+
+    def child(self, name: str) -> "MetricNode":
+        node = MetricNode(name)
+        self.children.append(node)
+        return node
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "values": dict(self.values),
+                "children": [c.to_dict() for c in self.children]}
+
+    def render(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        vals = ", ".join(f"{k}={v}" for k, v in sorted(self.values.items()))
+        lines = [f"{pad}{self.name}: {vals}"]
+        for c in self.children:
+            lines.append(c.render(indent + 1))
+        return "\n".join(lines)

@@ -1,0 +1,44 @@
+"""Task-scoped resource registry.
+
+Analogue of JniBridge's resource map (auron-core JniBridge.java:65-137
+putResource/getResource): front-ends and exchange operators park byte
+buffers, batch iterators, Arrow streams and RSS writers here under string
+ids referenced by plan nodes (IpcReader.resource_id, FFIReader.resource_id).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class ResourceRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._map: Dict[str, Any] = {}
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._map[key] = value
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            if key not in self._map:
+                raise KeyError(f"resource {key!r} not registered")
+            return self._map[key]
+
+    def pop(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._map.pop(key, default)
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._map
+
+    def clear(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+
+# process-global registry (per-task registries layer on top via TaskContext)
+GLOBAL_RESOURCES = ResourceRegistry()

@@ -1,22 +1,38 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before jax import.
+"""Test bootstrap: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is unavailable in CI; sharding paths are validated on a
 virtual CPU mesh (xla_force_host_platform_device_count=8), mirroring how the
 reference exercises distribution via Spark local[*] instead of a cluster
 (SURVEY.md §4).
+
+NOTE: this environment ships a TPU platform plugin that overrides the
+JAX_PLATFORMS env var, so the CPU backend must be forced through
+jax.config.update *after* importing jax (env-var setdefault is not enough).
+XLA_FLAGS must still be set before backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", f"tests must run on CPU, got {devs}"
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    yield
 
 
 @pytest.fixture

@@ -1,0 +1,142 @@
+"""Columnar substrate tests: arrow<->device roundtrip, padding invariants,
+gather/concat, compressed IPC serde."""
+
+import io
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.batch import Batch, DeviceColumn, DeviceStringColumn, \
+    HostColumn, bucket_capacity, bucket_width, concat_batches
+from auron_tpu.columnar import serde
+from auron_tpu.ir.schema import DataType, Field, Schema
+
+
+def test_buckets():
+    assert bucket_capacity(0) == 1024
+    assert bucket_capacity(1024) == 1024
+    assert bucket_capacity(1025) == 2048
+    assert bucket_width(1) == 8
+    assert bucket_width(9) == 16
+    assert bucket_width(300) == 256  # clamped to largest bucket
+
+
+def _sample_rb():
+    return pa.record_batch({
+        "i32": pa.array([1, None, 3, -4], type=pa.int32()),
+        "i64": pa.array([10, 20, None, 2**40], type=pa.int64()),
+        "f64": pa.array([1.5, float("nan"), None, -0.0], type=pa.float64()),
+        "b": pa.array([True, None, False, True], type=pa.bool_()),
+        "s": pa.array(["hello", "", None, "wörld"], type=pa.utf8()),
+        "dec": pa.array([Decimal("1.25"), None, Decimal("-3.50"),
+                         Decimal("99.99")], type=pa.decimal128(10, 2)),
+        "d": pa.array([0, 1, None, 19000], type=pa.int32()).cast(pa.date32()),
+        "ts": pa.array([0, 1_000_000, None, -5], type=pa.int64()).cast(
+            pa.timestamp("us")),
+        "lst": pa.array([[1, 2], None, [], [3]], type=pa.list_(pa.int64())),
+    })
+
+
+def assert_rows_equal(exp_rows, got_rows):
+    assert len(exp_rows) == len(got_rows)
+    for e, g in zip(exp_rows, got_rows):
+        for k in e:
+            if isinstance(e[k], float) and e[k] != e[k]:
+                assert g[k] != g[k], k  # NaN preserved
+            else:
+                assert g[k] == e[k], (k, e[k], g[k])
+
+
+def test_arrow_roundtrip():
+    rb = _sample_rb()
+    b = Batch.from_arrow(rb)
+    assert b.num_rows == 4 and b.capacity == 1024
+    # types normalize (e.g. utf8 -> large_utf8); compare via pylist
+    assert_rows_equal(rb.to_pylist(), b.to_arrow().to_pylist())
+
+
+def test_padding_invariants():
+    rb = _sample_rb()
+    b = Batch.from_arrow(rb)
+    i32 = b.columns[0]
+    assert isinstance(i32, DeviceColumn)
+    assert not np.asarray(i32.validity)[4:].any()
+    assert (np.asarray(i32.data)[4:] == 0).all()
+    # null slot is zeroed (canonical)
+    assert np.asarray(i32.data)[1] == 0
+    s = b.columns[4]
+    assert isinstance(s, DeviceStringColumn)
+    assert np.asarray(s.lengths)[2] == 0  # null string
+    lst = b.columns[8]
+    assert isinstance(lst, HostColumn)
+
+
+def test_gather():
+    rb = _sample_rb()
+    b = Batch.from_arrow(rb)
+    import jax.numpy as jnp
+    idx = jnp.zeros(1024, dtype=jnp.int32).at[0].set(3).at[1].set(0).at[2].set(2)
+    g = b.gather(idx, 3)
+    rows = g.to_pylist()
+    assert rows[0]["i32"] == -4 and rows[1]["i32"] == 1 and rows[2]["i32"] == 3
+    assert rows[0]["s"] == "wörld"
+    assert rows[2]["s"] is None  # null propagated through gather
+    assert rows[2]["lst"] == []
+
+
+def test_head_and_concat():
+    rb = _sample_rb()
+    b = Batch.from_arrow(rb)
+    h = b.head(2)
+    assert h.num_rows == 2
+    assert len(h.to_pylist()) == 2
+    c = concat_batches(b.schema, [h, b])
+    assert c.num_rows == 6
+    rows = c.to_pylist()
+    assert rows[0]["s"] == "hello" and rows[2]["s"] == "hello"
+    assert rows[5]["dec"] == Decimal("99.99")
+
+
+def test_from_numpy():
+    schema = Schema.of(Field("x", DataType.int64()), Field("y", DataType.float64()),
+                       Field("s", DataType.string()))
+    b = Batch.from_numpy(schema, [np.arange(5), np.linspace(0, 1, 5),
+                                  np.array(["a", "bb", "ccc", "", "ddddé"])])
+    rows = b.to_pylist()
+    assert rows[4]["s"] == "ddddé"
+    assert rows[2]["x"] == 2
+
+
+def test_long_string_host_fallback():
+    long = "x" * 5000
+    rb = pa.record_batch({"s": pa.array([long, "short"])})
+    b = Batch.from_arrow(rb)
+    assert isinstance(b.columns[0], HostColumn)
+    assert b.to_pylist()[0]["s"] == long
+
+
+def test_ipc_serde_roundtrip():
+    rb = _sample_rb()
+    for codec in ("zstd", "zlib", "none"):
+        data = serde.serialize_batches([rb, rb], codec=codec)
+        out = serde.deserialize_batches(data)
+        assert len(out) == 2
+        assert_rows_equal(rb.to_pylist(), out[0].to_pylist())
+    assert serde.deserialize_batches(b"") == []
+
+
+def test_ipc_serde_truncated():
+    data = serde.serialize_batches([_sample_rb()])
+    with pytest.raises(EOFError):
+        serde.deserialize_batches(data[:-3])
+
+
+def test_empty_batch():
+    schema = Schema.of(Field("x", DataType.int64()), Field("s", DataType.string()))
+    b = Batch.empty(schema)
+    assert b.num_rows == 0
+    assert b.to_arrow().num_rows == 0
+    c = concat_batches(schema, [])
+    assert c.num_rows == 0
