@@ -251,9 +251,10 @@ class Batch:
         return batch_to_arrow(self)
 
     @staticmethod
-    def from_arrow(rb, capacity: Optional[int] = None) -> "Batch":
+    def from_arrow(rb, capacity: Optional[int] = None,
+                   schema: Optional[Schema] = None) -> "Batch":
         from auron_tpu.columnar.arrow_interop import arrow_to_batch
-        return arrow_to_batch(rb, capacity=capacity)
+        return arrow_to_batch(rb, capacity=capacity, schema=schema)
 
     def to_pylist(self) -> List[dict]:
         return self.to_arrow().to_pylist()

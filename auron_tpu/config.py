@@ -268,3 +268,9 @@ for _op in (
     "rename.columns", "empty.partitions", "debug", "kafka.scan",
 ):
     conf.define(f"auron.enable.{_op}", True, f"Enable native {_op} operator.")
+
+SPILL_MIN_TRIGGER = conf.define(
+    "auron.memory.spill.min.trigger.bytes", 16 << 20,
+    "Consumers below this size are never forced to spill "
+    "(reference MIN_TRIGGER_SIZE, auron-memmgr/src/lib.rs:36).",
+)

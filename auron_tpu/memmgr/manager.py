@@ -15,7 +15,10 @@ from typing import Dict, List, Optional
 
 from auron_tpu.config import conf
 
-MIN_TRIGGER_SIZE = 16 << 20  # 16MB, lib.rs:36
+def min_trigger_size() -> int:
+    """Consumers below this size are never forced to spill (lib.rs:36;
+    configurable so tiny-budget fuzz tests can exercise spill paths)."""
+    return int(conf.get("auron.memory.spill.min.trigger.bytes"))
 
 
 class MemConsumer:
@@ -88,8 +91,9 @@ class MemManager:
             consumer.mem_used = new_bytes
             if self.total_used <= self.budget:
                 return
+            trigger = min_trigger_size()
             candidates = [c for c in self._consumers
-                          if c.spillable and c.mem_used >= MIN_TRIGGER_SIZE]
+                          if c.spillable and c.mem_used >= trigger]
             if not candidates:
                 # over budget but nothing is big enough to bother: allow
                 # (reference returns Nothing below MIN_TRIGGER_SIZE)
@@ -100,7 +104,7 @@ class MemManager:
         with self._lock:
             self.num_spills += 1
         if freed <= 0 and spill_target is not consumer and consumer.spillable \
-                and consumer.mem_used >= MIN_TRIGGER_SIZE:
+                and consumer.mem_used >= min_trigger_size():
             consumer.spill()
 
     def stats(self) -> Dict[str, int]:

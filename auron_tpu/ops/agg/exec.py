@@ -54,14 +54,13 @@ class AggExec(Operator, MemConsumer):
         self.aggs = tuple(aggs)
         self.agg_names = tuple(agg_names)
 
-        # resolve agg specs
+        # resolve agg specs; in final mode the AggExpr children still carry
+        # the ORIGINAL input expressions (the partial stage's), which is
+        # what make_spec needs for the input dtype — state columns are
+        # located positionally, not via these expressions
         self.specs: List[AggSpec] = []
         for a, name in zip(self.aggs, self.agg_names):
-            if exec_mode == "final":
-                # inputs are partial states; in dtype recorded in children
-                in_dt = None if not a.children else _child_type(a, in_schema)
-            else:
-                in_dt = None if not a.children else _child_type(a, in_schema)
+            in_dt = None if not a.children else _child_type(a, in_schema)
             self.specs.append(make_spec(a.fn, in_dt or DataType.int64(),
                                         a.return_type, name, a.udaf))
 
@@ -123,7 +122,11 @@ class AggExec(Operator, MemConsumer):
         perm = lexsort_indices(words, num_rows, capacity)
         live = jnp.arange(capacity) < jnp.asarray(num_rows, jnp.int32)
         sorted_words = [jnp.take(w, perm) for w in words]
-        eq_prev = keys_equal_prev(sorted_words)
+        if sorted_words:
+            eq_prev = keys_equal_prev(sorted_words)
+        else:
+            # global agg: every row belongs to the single segment
+            eq_prev = jnp.arange(capacity) != 0
         is_boundary = jnp.logical_and(jnp.logical_not(eq_prev), live)
         seg_of_sorted = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
         seg_of_sorted = jnp.where(live, seg_of_sorted, capacity - 1)

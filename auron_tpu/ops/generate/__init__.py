@@ -1,0 +1,3 @@
+from auron_tpu.ops.generate.exec import GenerateExec
+
+__all__ = ["GenerateExec"]

@@ -1,0 +1,114 @@
+"""Generate operator: explode / posexplode / json_tuple / python UDTF.
+
+Analogue of generate_exec.rs:50 + generate/{explode.rs,json_tuple.rs,
+spark_udtf_wrapper.rs}.  Generators fan rows out over host-resident nested
+values (lists/maps live on host in this engine), so generation runs on the
+host and the result re-enters the device representation; required child
+columns are repeated by gather.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.exprs.host_eval import evaluate as host_evaluate, hv_to_arrow
+from auron_tpu.ir.schema import DataType, Field, Schema, to_arrow_schema
+from auron_tpu.ops.base import Operator, TaskContext, batch_size
+
+
+class GenerateExec(Operator):
+    def __init__(self, child: Operator, generator: str, args,
+                 generator_output_names, generator_output_types,
+                 required_child_output=(), outer: bool = False,
+                 udtf: Optional[bytes] = None):
+        in_schema = child.schema
+        self.generator = generator
+        self.args = tuple(args)
+        self.outer = outer
+        self.udtf = udtf
+        self.required_child_output = tuple(required_child_output) or \
+            tuple(range(len(in_schema)))
+        child_fields = tuple(in_schema[i] for i in self.required_child_output)
+        gen_fields = tuple(Field(n, t) for n, t in
+                           zip(generator_output_names, generator_output_types))
+        super().__init__(Schema(child_fields + gen_fields), [child])
+        self._gen_fields = gen_fields
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        in_schema = self.children[0].schema
+        for b in self.child_stream(ctx):
+            if b.num_rows == 0:
+                continue
+            rb = b.to_arrow()
+            arg_vals = [host_evaluate(a, rb, in_schema,
+                                      partition_id=ctx.partition_id)
+                        for a in self.args]
+            src_idx: List[int] = []
+            gen_rows: List[Tuple] = []
+            for i in range(b.num_rows):
+                outs = list(self._generate_row(
+                    [None if not a.mask[i] else a.vals[i] for a in arg_vals]))
+                if not outs and self.outer:
+                    outs = [tuple(None for _ in self._gen_fields)]
+                for o in outs:
+                    src_idx.append(i)
+                    gen_rows.append(o)
+            if not gen_rows:
+                continue
+            child_tbl = rb.select([in_schema[i].name
+                                   for i in self.required_child_output]) \
+                if self.required_child_output else rb
+            taken = child_tbl.take(pa.array(src_idx, type=pa.int64()))
+            gen_cols = list(zip(*gen_rows))
+            gen_schema = to_arrow_schema(Schema(self._gen_fields))
+            gen_arrays = [pa.array(list(cvals), type=f.type)
+                          for cvals, f in zip(gen_cols, gen_schema)]
+            out = pa.RecordBatch.from_arrays(
+                list(taken.columns) + gen_arrays,
+                schema=to_arrow_schema(self.schema))
+            for off in range(0, out.num_rows, batch_size()):
+                yield Batch.from_arrow(out.slice(off, batch_size()))
+
+    def _generate_row(self, args: List[Any]):
+        g = self.generator
+        if g == "explode":
+            v = args[0]
+            if v is None:
+                return
+            if isinstance(v, list) and v and isinstance(v[0], tuple):
+                # map: emit (key, value)
+                for k, val in v:
+                    yield (k, val)
+            elif isinstance(v, (list, np.ndarray)):
+                for x in v:
+                    yield (x,)
+            elif isinstance(v, dict):
+                for k, val in v.items():
+                    yield (k, val)
+        elif g == "posexplode":
+            v = args[0]
+            if v is None:
+                return
+            if isinstance(v, (list, np.ndarray)):
+                for i, x in enumerate(v):
+                    yield (i, x)
+        elif g == "json_tuple":
+            from auron_tpu.exprs.functions_host import _get_json_object
+            s = args[0]
+            if s is None:
+                yield tuple(None for _ in args[1:])
+                return
+            yield tuple(_get_json_object(s, "$." + str(f)) if f is not None
+                        else None for f in args[1:])
+        elif g == "udtf":
+            import pickle
+            fn = pickle.loads(self.udtf)
+            for out in fn(*args):
+                yield tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        else:
+            raise NotImplementedError(f"generator {self.generator!r}")

@@ -1,0 +1,21 @@
+"""Joins: broadcast hash join, shuffled hash join, sort-merge join.
+
+Reference analogues: broadcast_join_exec.rs:82 (+ bhj/ joiners),
+sort_merge_join_exec.rs:57 (+ smj/ joiners), HashJoinExec via
+join_hash_map.rs, broadcast_join_build_hash_map_exec.rs:55.
+
+TPU redesign: instead of pointer-chasing hash tables, the build side is a
+device-sorted table of 64-bit key hashes; probes binary-search match ranges
+(jnp.searchsorted), expand to (probe, build) index pairs in fixed-capacity
+chunks, and verify true key equality to kill hash collisions — contiguous
+gathers and compares instead of random access, the shape TPU vector units
+want.
+"""
+
+from auron_tpu.ops.joins.exec import (
+    BroadcastJoinBuildHashMapExec, BroadcastJoinExec, HashJoinExec,
+    SortMergeJoinExec,
+)
+
+__all__ = ["BroadcastJoinExec", "BroadcastJoinBuildHashMapExec",
+           "HashJoinExec", "SortMergeJoinExec"]

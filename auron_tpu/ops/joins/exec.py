@@ -1,0 +1,290 @@
+"""Join operators over the shared sorted-hash kernel.
+
+Covers every reference join shape (joins/smj/*.rs, joins/bhj/*.rs,
+join_hash_map.rs): inner/left/right/full outer, left/right semi, left/right
+anti, existence — probe-side streaming with build-side matched-flag
+tracking for the outer variants.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import (
+    Batch, DeviceColumn, bucket_capacity, concat_batches,
+)
+from auron_tpu.config import conf
+from auron_tpu.exprs.compiler import build_evaluator
+from auron_tpu.ir.plan import JoinOn
+from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.ops.base import Operator, TaskContext, batch_size, compact_indices
+from auron_tpu.ops.joins.kernel import (
+    BuildTable, combine_sides, expand_pairs, join_key_hash,
+    null_columns_like, probe_ranges, verify_pairs,
+)
+
+_PAIR_SIDES = {"inner", "left", "right", "full"}
+
+
+def _nullable(fields) -> Tuple[Field, ...]:
+    return tuple(Field(f.name, f.dtype, True) for f in fields)
+
+
+def join_output_schema(left: Schema, right: Schema, join_type: str,
+                       existence_name: str = "exists") -> Schema:
+    if join_type in ("inner",):
+        return left.concat(right)
+    if join_type == "left":
+        return Schema(left.fields + _nullable(right.fields))
+    if join_type == "right":
+        return Schema(_nullable(left.fields) + right.fields)
+    if join_type == "full":
+        return Schema(_nullable(left.fields) + _nullable(right.fields))
+    if join_type in ("left_semi", "left_anti"):
+        return left
+    if join_type in ("right_semi", "right_anti"):
+        return right
+    if join_type == "existence":
+        return Schema(left.fields +
+                      (Field(existence_name, DataType.bool_(), False),))
+    raise ValueError(f"unknown join type {join_type!r}")
+
+
+class _HashJoinBase(Operator):
+    """Probe-side streaming join; build side fully materialized (device)."""
+
+    def __init__(self, left: Operator, right: Operator, on: JoinOn,
+                 join_type: str, build_side: str,
+                 existence_name: str = "exists", name: str = "HashJoin"):
+        schema = join_output_schema(left.schema, right.schema, join_type,
+                                    existence_name)
+        super().__init__(schema, [left, right], name=name)
+        self.on = on
+        self.join_type = join_type
+        self.build_side = build_side
+        self.probe_is_left = build_side == "right"
+        if join_type in ("left_semi", "left_anti", "existence") \
+                and not self.probe_is_left:
+            raise ValueError(f"{join_type} requires build_side=right")
+        if join_type in ("right_semi", "right_anti") and self.probe_is_left:
+            raise ValueError(f"{join_type} requires build_side=left")
+        self._left_keys = build_evaluator(on.left_keys, left.schema)
+        self._right_keys = build_evaluator(on.right_keys, right.schema)
+
+    # -- build --------------------------------------------------------------
+
+    def _collect_build(self, ctx: TaskContext) -> BuildTable:
+        child_i = 1 if self.build_side == "right" else 0
+        batches = [b for b in self.child_stream(ctx, child_i) if b.num_rows]
+        child = self.children[child_i]
+        total = sum(b.num_rows for b in batches)
+        cap = bucket_capacity(total)
+        merged = concat_batches(child.schema, batches, cap) if batches \
+            else Batch.empty(child.schema, cap)
+        key_eval = self._right_keys if self.build_side == "right" \
+            else self._left_keys
+        with self.metrics.timer("build_hash_map_time_ns"):
+            key_cols = key_eval(merged, partition_id=ctx.partition_id)
+            return BuildTable.build(merged, key_cols)
+
+    # -- probe --------------------------------------------------------------
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        table = self._get_build_table(ctx)
+        yield from self._probe_stream(ctx, table)
+
+    def _get_build_table(self, ctx: TaskContext) -> BuildTable:
+        return self._collect_build(ctx)
+
+    def _probe_stream(self, ctx: TaskContext,
+                      table: BuildTable) -> Iterator[Batch]:
+        probe_i = 0 if self.probe_is_left else 1
+        probe_child = self.children[probe_i]
+        key_eval = self._left_keys if self.probe_is_left else self._right_keys
+        jt = self.join_type
+        build_matched = jnp.zeros(table.batch.capacity, bool)
+        emit_pairs = jt in _PAIR_SIDES
+        for b in self.child_stream(ctx, probe_i):
+            if b.num_rows == 0:
+                continue
+            with self.metrics.timer("probe_time_ns"):
+                pkeys = key_eval(b, partition_id=ctx.partition_id)
+                ph, pvalid = join_key_hash(pkeys, b.capacity)
+                lo, counts = probe_ranges(table, ph, pvalid, b.row_mask())
+                total = int(jnp.sum(counts))
+                probe_matched = jnp.zeros(b.capacity, bool)
+                chunk_cap = bucket_capacity(min(max(total, 1), batch_size()))
+                for start in range(0, max(total, 0), chunk_cap):
+                    probe_idx, offset, live = expand_pairs(
+                        lo, counts, start, chunk_cap)
+                    sorted_pos = jnp.take(lo, probe_idx) + offset
+                    sorted_pos = jnp.clip(sorted_pos, 0,
+                                          table.batch.capacity - 1)
+                    build_idx = jnp.take(table.perm, sorted_pos)
+                    ok = verify_pairs(pkeys, table.key_cols, probe_idx,
+                                      build_idx, live)
+                    probe_matched = probe_matched.at[probe_idx].max(ok)
+                    if jt == "full" or (jt == "right" and self.probe_is_left) \
+                            or (jt == "left" and not self.probe_is_left):
+                        build_matched = build_matched.at[build_idx].max(ok)
+                    if emit_pairs:
+                        idx, cnt = compact_indices(ok, chunk_cap)
+                        n = int(cnt)
+                        if n == 0:
+                            continue
+                        pi = jnp.take(probe_idx, idx)
+                        bi = jnp.take(build_idx, idx)
+                        yield self._emit_pair_batch(b, table.batch, pi, bi,
+                                                    n, chunk_cap)
+                # per-batch probe-side emissions
+                if jt == "full":
+                    yield from self._emit_unmatched(
+                        b, probe_matched, probe_side_left=self.probe_is_left)
+                elif jt == "left" and self.probe_is_left:
+                    yield from self._emit_unmatched(b, probe_matched,
+                                                    probe_side_left=True)
+                elif jt == "right" and not self.probe_is_left:
+                    yield from self._emit_unmatched(b, probe_matched,
+                                                    probe_side_left=False)
+                elif jt in ("left_semi", "right_semi"):
+                    yield from self._emit_filtered(b, probe_matched)
+                elif jt in ("left_anti", "right_anti"):
+                    yield from self._emit_filtered(
+                        b, jnp.logical_not(probe_matched))
+                elif jt == "existence":
+                    ex = DeviceColumn(DataType.bool_(),
+                                      jnp.logical_and(probe_matched,
+                                                      b.row_mask()),
+                                      jnp.ones(b.capacity, bool))
+                    yield Batch(self.schema, list(b.columns) + [ex],
+                                b.num_rows, b.capacity)
+        # build-side unmatched (right/full outer relative to orientation)
+        if (jt == "right" and self.probe_is_left) or \
+                (jt == "left" and not self.probe_is_left) or jt == "full":
+            yield from self._emit_build_unmatched(table, build_matched)
+
+    # -- emitters ------------------------------------------------------------
+
+    def _emit_pair_batch(self, probe: Batch, build: Batch, pi, bi,
+                         n: int, cap: int) -> Batch:
+        pg = probe.gather(pi, n, cap)
+        bg = build.gather(bi, n, cap)
+        left_cols, right_cols = (pg.columns, bg.columns) \
+            if self.probe_is_left else (bg.columns, pg.columns)
+        return combine_sides(self.schema, left_cols, right_cols, n, cap)
+
+    def _emit_unmatched(self, b: Batch, matched, probe_side_left: bool
+                        ) -> Iterator[Batch]:
+        keep = jnp.logical_and(jnp.logical_not(matched), b.row_mask())
+        idx, cnt = compact_indices(keep, b.capacity)
+        n = int(cnt)
+        if n == 0:
+            return
+        g = b.gather(idx, n)
+        other = self.children[1 if probe_side_left else 0].schema
+        nulls = null_columns_like(other.fields, b.capacity)
+        if probe_side_left:
+            yield combine_sides(self.schema, g.columns, nulls, n, b.capacity)
+        else:
+            yield combine_sides(self.schema, nulls, g.columns, n, b.capacity)
+
+    def _emit_filtered(self, b: Batch, keep_mask) -> Iterator[Batch]:
+        keep = jnp.logical_and(keep_mask, b.row_mask())
+        idx, cnt = compact_indices(keep, b.capacity)
+        n = int(cnt)
+        if n == 0:
+            return
+        yield b.gather(idx, n)
+
+    def _emit_build_unmatched(self, table: BuildTable, build_matched
+                              ) -> Iterator[Batch]:
+        b = table.batch
+        keep = jnp.logical_and(jnp.logical_not(build_matched), b.row_mask())
+        idx, cnt = compact_indices(keep, b.capacity)
+        n = int(cnt)
+        if n == 0:
+            return
+        g = b.gather(idx, n)
+        build_is_left = self.build_side == "left"
+        other = self.children[1 if build_is_left else 0].schema
+        nulls = null_columns_like(other.fields, b.capacity)
+        if build_is_left:
+            yield combine_sides(self.schema, g.columns, nulls, n, b.capacity)
+        else:
+            yield combine_sides(self.schema, nulls, g.columns, n, b.capacity)
+
+
+class HashJoinExec(_HashJoinBase):
+    """Shuffled hash join (both sides already partitioned by key);
+    proto tag hash_join (auron.proto:470)."""
+
+    def __init__(self, left, right, on, join_type, build_side="right",
+                 existence_name="exists"):
+        super().__init__(left, right, on, join_type, build_side,
+                         existence_name, name="HashJoinExec")
+
+
+class BroadcastJoinExec(_HashJoinBase):
+    """Build side is broadcast; the built table is cached per device under
+    `cached_build_hash_map_id` (broadcast_join_build_hash_map_exec.rs
+    caches once per executor)."""
+
+    def __init__(self, left, right, on, join_type, broadcast_side="right",
+                 cached_build_hash_map_id: str = "", existence_name="exists"):
+        super().__init__(left, right, on, join_type,
+                         build_side=broadcast_side,
+                         existence_name=existence_name,
+                         name="BroadcastJoinExec")
+        self.cache_id = cached_build_hash_map_id
+
+    def _get_build_table(self, ctx: TaskContext) -> BuildTable:
+        if not self.cache_id:
+            return self._collect_build(ctx)
+        key = f"bhm:{self.cache_id}"
+        if ctx.resources.contains(key):
+            return ctx.resources.get(key)
+        table = self._collect_build(ctx)
+        ctx.resources.put(key, table)
+        return table
+
+
+class BroadcastJoinBuildHashMapExec(Operator):
+    """Standalone build-map stage: materializes the BuildTable into the
+    resource registry and streams nothing (its parent BroadcastJoinExec
+    reads the cache)."""
+
+    def __init__(self, child: Operator, keys, cache_id: str):
+        super().__init__(child.schema, [child])
+        self.keys = tuple(keys)
+        self.cache_id = cache_id
+        self._key_eval = build_evaluator(self.keys, child.schema)
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        batches = [b for b in self.child_stream(ctx) if b.num_rows]
+        total = sum(b.num_rows for b in batches)
+        cap = bucket_capacity(total)
+        merged = concat_batches(self.children[0].schema, batches, cap) \
+            if batches else Batch.empty(self.children[0].schema, cap)
+        key_cols = self._key_eval(merged, partition_id=ctx.partition_id)
+        table = BuildTable.build(merged, key_cols)
+        ctx.resources.put(f"bhm:{self.cache_id}", table)
+        yield merged
+
+
+class SortMergeJoinExec(_HashJoinBase):
+    """Sort-merge join.  The TPU build keeps the probe streaming but uses
+    the same sorted-hash table for the other side (sortedness of inputs is
+    not exploited yet; the searchsorted probe is already log-time).  The
+    fallback direction the reference takes (BHJ -> SMJ under memory
+    pressure, NativeHelper.scala:185) is therefore a no-op here."""
+
+    def __init__(self, left, right, on, join_type,
+                 sort_options=(), existence_name="exists"):
+        build_side = "left" if join_type in ("right_semi", "right_anti") \
+            else "right"
+        super().__init__(left, right, on, join_type, build_side,
+                         existence_name, name="SortMergeJoinExec")
+        self.sort_options = tuple(sort_options)

@@ -1,0 +1,115 @@
+"""The shared join kernel: sorted-hash build table + searchsorted probe.
+
+Build:  key columns -> u64 hash (two murmur passes packed) with null-key
+        sentinels -> argsort -> (sorted_hashes, perm, build_batch)
+Probe:  probe hashes -> lo/hi = searchsorted range -> candidate counts ->
+        chunked pair expansion -> exact key verification -> joined batches.
+
+All device work is eager jnp (XLA kernels); chunk sizes are fixed
+capacities so shapes stay static.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import (
+    Batch, DeviceColumn, DeviceStringColumn, HostColumn, bucket_capacity,
+    concat_batches,
+)
+from auron_tpu.exprs import hashing as H
+from auron_tpu.exprs import strings_device as S
+from auron_tpu.ir.schema import DataType, Field, Schema
+
+# hash-sentinels: null join keys never match (SQL equi-join semantics)
+_NULL_BUILD = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+_NULL_PROBE = jnp.uint64(0xFFFFFFFFFFFFFFFE)
+
+
+def join_key_hash(cols: List[Any], capacity: int):
+    """u64 key hash: two chained murmur3 passes with different seeds packed
+    into one u64; rows with any null key get a non-matching sentinel."""
+    h1 = H.hash_columns(cols, seed=42).astype(jnp.uint32)
+    h2 = H.hash_columns(cols, seed=0x9747B28C).astype(jnp.uint32)
+    h = (h1.astype(jnp.uint64) << 32) | h2.astype(jnp.uint64)
+    all_valid = cols[0].validity
+    for c in cols[1:]:
+        all_valid = jnp.logical_and(all_valid, c.validity)
+    return h, all_valid
+
+
+@dataclass
+class BuildTable:
+    """The 'hash map': build batch + hash-sorted permutation."""
+    batch: Batch                 # concatenated build side
+    key_cols: List[Any]          # evaluated key columns (batch order)
+    sorted_hashes: Any           # u64[capacity], ascending; padding = MAX
+    perm: Any                    # int32[capacity]: sorted idx -> batch row
+    num_rows: int
+
+    @staticmethod
+    def build(batch: Batch, key_cols: List[Any]) -> "BuildTable":
+        cap = batch.capacity
+        h, valid = join_key_hash(key_cols, cap)
+        live = batch.row_mask()
+        h = jnp.where(jnp.logical_and(live, valid), h, _NULL_BUILD)
+        perm = jnp.argsort(h).astype(jnp.int32)
+        return BuildTable(batch=batch, key_cols=key_cols,
+                          sorted_hashes=jnp.take(h, perm), perm=perm,
+                          num_rows=batch.num_rows)
+
+
+def probe_ranges(table: BuildTable, probe_hash, probe_valid, probe_live):
+    ph = jnp.where(jnp.logical_and(probe_live, probe_valid), probe_hash,
+                   _NULL_PROBE)
+    lo = jnp.searchsorted(table.sorted_hashes, ph, side="left")
+    hi = jnp.searchsorted(table.sorted_hashes, ph, side="right")
+    counts = (hi - lo).astype(jnp.int64)
+    return lo.astype(jnp.int32), counts
+
+
+def verify_pairs(probe_keys: List[Any], build_keys: List[Any],
+                 probe_idx, build_idx, pair_live):
+    """Exact key equality for candidate pairs (hash-collision filter)."""
+    ok = pair_live
+    for pk, bk in zip(probe_keys, build_keys):
+        p = pk.gather(probe_idx, pair_live)
+        b = bk.gather(build_idx, pair_live)
+        if isinstance(p, DeviceStringColumn):
+            eq = S.string_eq(p, b)
+        else:
+            eq = p.data == b.data
+        ok = jnp.logical_and(ok, jnp.logical_and(
+            eq, jnp.logical_and(p.validity, b.validity)))
+    return ok
+
+
+def expand_pairs(lo, counts, chunk_start: int, chunk_cap: int):
+    """Pair expansion for output slots [chunk_start, chunk_start+chunk_cap):
+    returns (probe_idx, cand_offset, live) device vectors."""
+    prefix = jnp.cumsum(counts)                      # inclusive
+    starts = prefix - counts                         # exclusive prefix
+    slots = chunk_start + jnp.arange(chunk_cap, dtype=jnp.int64)
+    probe_idx = jnp.searchsorted(prefix, slots, side="right").astype(jnp.int32)
+    total = prefix[-1] if counts.shape[0] else jnp.int64(0)
+    live = slots < total
+    safe_probe = jnp.clip(probe_idx, 0, counts.shape[0] - 1)
+    offset = slots - jnp.take(starts, safe_probe)
+    return safe_probe, offset.astype(jnp.int32), live
+
+
+def null_columns_like(schema_fields, capacity: int) -> List[Any]:
+    """All-null device columns for outer-join padding."""
+    from auron_tpu.columnar.batch import _empty_column
+    return [_empty_column(f.dtype, capacity) for f in schema_fields]
+
+
+def combine_sides(out_schema: Schema, left_cols: List[Any],
+                  right_cols: List[Any], num_rows: int, capacity: int,
+                  extra: Optional[List[Any]] = None) -> Batch:
+    cols = list(left_cols) + list(right_cols) + list(extra or [])
+    return Batch(out_schema, cols, num_rows, capacity)

@@ -1,0 +1,7 @@
+from auron_tpu.ops.scan.parquet import ParquetScanExec
+from auron_tpu.ops.scan.orc import OrcScanExec
+from auron_tpu.ops.scan.ipc import FFIReaderExec, IpcReaderExec
+from auron_tpu.ops.scan.kafka import KafkaScanExec
+
+__all__ = ["ParquetScanExec", "OrcScanExec", "FFIReaderExec",
+           "IpcReaderExec", "KafkaScanExec"]

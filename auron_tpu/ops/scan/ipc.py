@@ -1,0 +1,112 @@
+"""IPC reader/writer + FFI reader.
+
+Analogues of ipc_reader_exec.rs:65 (reads compressed-IPC blocks from
+JVM-provided channels — here from the resource registry: bytes, a list of
+byte blocks, or a file path), ipc_writer_exec.rs:43 (broadcast collect
+path), and ffi_reader_exec.rs:46 (imports front-end Arrow batches through
+the Arrow C-Data interface / any python RecordBatch iterable).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Any, Iterator
+
+import pyarrow as pa
+
+from auron_tpu.columnar import serde as batch_serde
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.ir.schema import Schema
+from auron_tpu.ops.base import Operator, TaskContext
+
+
+class IpcReaderExec(Operator):
+    def __init__(self, schema: Schema, resource_id: str):
+        super().__init__(schema, [])
+        self.resource_id = resource_id
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        src = ctx.resources.get(self.resource_id)
+        import time
+        t0 = time.perf_counter_ns()
+        n = 0
+        for rb in _iter_ipc(src):
+            n += rb.num_rows
+            yield Batch.from_arrow(rb, schema=self.schema)
+        self.metrics.add("shuffle_read_rows", n)
+        self.metrics.add("shuffle_read_time_ns", time.perf_counter_ns() - t0)
+
+
+def _iter_ipc(src) -> Iterator[pa.RecordBatch]:
+    if isinstance(src, (bytes, bytearray, memoryview)):
+        yield from batch_serde.read_batches(io.BytesIO(bytes(src)))
+    elif isinstance(src, str) and os.path.exists(src):
+        with open(src, "rb") as f:
+            yield from batch_serde.read_batches(f)
+    elif hasattr(src, "read"):
+        yield from batch_serde.read_batches(src)
+    elif isinstance(src, (list, tuple)):
+        for block in src:
+            yield from _iter_ipc(block)
+    else:
+        raise TypeError(f"unsupported IPC source {type(src)}")
+
+
+class IpcWriterExec(Operator):
+    """Serializes child output as compressed IPC into the resource registry
+    under `resource_id` (the broadcast collect path:
+    NativeBroadcastExchangeBase.collectNative)."""
+
+    def __init__(self, child: Operator, resource_id: str):
+        super().__init__(child.schema, [child])
+        self.resource_id = resource_id
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        sink = io.BytesIO()
+        rows = 0
+        for b in self.child_stream(ctx):
+            if b.num_rows:
+                batch_serde.write_one_batch(b.to_arrow(), sink)
+                rows += b.num_rows
+        ctx.resources.put(self.resource_id, sink.getvalue())
+        self.metrics.add("shuffle_write_rows", rows)
+        return
+        yield  # generator
+
+
+class FFIReaderExec(Operator):
+    """Imports batches produced by a front-end: the resource may be a
+    pyarrow RecordBatchReader, an iterable of RecordBatches, a Table, or a
+    pair of Arrow C-Data capsules."""
+
+    def __init__(self, schema: Schema, resource_id: str):
+        super().__init__(schema, [])
+        self.resource_id = resource_id
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        src = ctx.resources.get(self.resource_id)
+        for rb in _iter_arrow(src):
+            yield Batch.from_arrow(rb, schema=self.schema)
+
+
+def _iter_arrow(src) -> Iterator[pa.RecordBatch]:
+    if isinstance(src, pa.RecordBatch):
+        yield src
+    elif isinstance(src, pa.Table):
+        yield from src.to_batches()
+    elif isinstance(src, pa.RecordBatchReader):
+        for rb in src:
+            yield rb
+    elif isinstance(src, tuple) and len(src) == 2:
+        # Arrow C-Data (array_capsule, schema_capsule) from a foreign runtime
+        rb = pa.RecordBatch._import_from_c_capsule(*src)
+        yield rb
+    elif callable(src):
+        yield from _iter_arrow(src())
+    else:
+        for rb in src:
+            if isinstance(rb, pa.RecordBatch):
+                yield rb
+            else:
+                yield from _iter_arrow(rb)

@@ -1,0 +1,75 @@
+"""Kafka scan (streaming source).
+
+Analogue of flink/kafka_scan_exec.rs:81: the front-end computes the
+partition/offset assignment (kafka_scan_exec.rs:243-247) and passes it as
+JSON; the scan consumes records and deserializes json/raw payloads into the
+declared schema.  Without a kafka client in the image, the consumer is
+pluggable: a resource named `kafka:<topic>` supplies records — the
+analogue of kafka_mock_scan_exec.rs — and a real client can be registered
+the same way.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterator, List, Optional, Tuple
+
+import pyarrow as pa
+
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.ir.schema import Schema, to_arrow_schema
+from auron_tpu.ops.base import Operator, TaskContext, batch_size
+
+
+class KafkaScanExec(Operator):
+    def __init__(self, schema: Schema, topic: str, assignment_json: str = "",
+                 value_format: str = "json", bootstrap_servers: str = "",
+                 mock_data: Tuple[Any, ...] = ()):
+        super().__init__(schema, [])
+        self.topic = topic
+        self.assignment = json.loads(assignment_json) if assignment_json \
+            else {}
+        self.value_format = value_format
+        self.bootstrap_servers = bootstrap_servers
+        self.mock_data = tuple(mock_data)
+
+    def _records(self, ctx: TaskContext) -> Iterator[bytes]:
+        key = f"kafka:{self.topic}"
+        if ctx.resources.contains(key):
+            source = ctx.resources.get(key)
+            yield from source(self.assignment) if callable(source) \
+                else iter(source)
+            return
+        if self.mock_data:
+            for r in self.mock_data:
+                yield r if isinstance(r, (bytes, bytearray)) else \
+                    str(r).encode("utf-8")
+            return
+        raise RuntimeError(
+            f"no kafka consumer registered for topic {self.topic!r}; "
+            f"register a record source under resource {key!r}")
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        rows: List[dict] = []
+        names = self.schema.names()
+        for payload in self._records(ctx):
+            if self.value_format == "json":
+                try:
+                    obj = json.loads(payload)
+                except json.JSONDecodeError:
+                    continue
+                rows.append({n: obj.get(n) for n in names})
+            elif self.value_format == "raw":
+                rows.append({names[0]: payload})
+            else:
+                raise NotImplementedError(
+                    f"kafka value format {self.value_format!r}")
+            if len(rows) >= batch_size():
+                yield self._flush(rows)
+                rows = []
+        if rows:
+            yield self._flush(rows)
+
+    def _flush(self, rows: List[dict]) -> Batch:
+        tbl = pa.Table.from_pylist(rows, schema=to_arrow_schema(self.schema))
+        return Batch.from_arrow(tbl.combine_chunks().to_batches()[0])

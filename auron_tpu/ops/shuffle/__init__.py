@@ -1,0 +1,7 @@
+from auron_tpu.ops.shuffle.partitioner import compute_partition_ids
+from auron_tpu.ops.shuffle.writer import (
+    RssShuffleWriterExec, ShuffleWriterExec,
+)
+
+__all__ = ["compute_partition_ids", "ShuffleWriterExec",
+           "RssShuffleWriterExec"]

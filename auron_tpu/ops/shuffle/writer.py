@@ -1,0 +1,222 @@
+"""Shuffle writers.
+
+ShuffleWriterExec (shuffle_writer_exec.rs:51 + sort_repartitioner.rs +
+buffered_data.rs): computes partition ids on device, radix-groups rows by
+id (argsort), serializes per-partition compressed IPC runs into one data
+file plus an offset index file — the reference's exact on-disk layout
+(data + int64 offsets), so a Spark-side reader could fetch ranges.
+
+RssShuffleWriterExec (rss_shuffle_writer_exec.rs:52 + shuffle/rss.rs): same
+partitioning, but pushes per-partition buffers to a pluggable
+RssPartitionWriter (the Celeborn/Uniffle SPI analogue) registered in the
+resource registry.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar import serde as batch_serde
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.ir.plan import Partitioning
+from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.memmgr import MemConsumer, SpillManager, get_manager
+from auron_tpu.ops.base import Operator, TaskContext, compact_indices
+from auron_tpu.ops.shuffle.partitioner import PartitionIdComputer
+
+
+class RssPartitionWriter:
+    """SPI the native writer pushes partition bytes into
+    (RssPartitionWriterBase.scala:21 analogue).  Implementations: local
+    files, in-memory service, Celeborn/Uniffle-style clients."""
+
+    def write(self, partition_id: int, data: bytes) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+
+class _PartitionBuffers(MemConsumer):
+    """Staged per-partition rows (BufferedData analogue) with spill to
+    per-partition compressed runs."""
+
+    def __init__(self, n: int, schema: Schema):
+        super().__init__("ShuffleWriter")
+        self.n = n
+        self.schema = schema
+        self.runs: List[Dict[int, bytes]] = []   # spilled run: pid -> frames
+        self.staged: Dict[int, List[Batch]] = {}
+        self.staged_bytes = 0
+
+    def add(self, pid: int, b: Batch) -> None:
+        self.staged.setdefault(pid, []).append(b)
+        self.staged_bytes += b.mem_bytes()
+        self.update_mem_used(self.staged_bytes)
+
+    def spill(self) -> int:
+        if not self.staged:
+            return 0
+        freed = self.staged_bytes
+        run: Dict[int, bytes] = {}
+        for pid, batches in sorted(self.staged.items()):
+            sink = io.BytesIO()
+            for b in batches:
+                batch_serde.write_one_batch(b.to_arrow(), sink)
+            run[pid] = sink.getvalue()
+        self.runs.append(run)
+        self.staged = {}
+        self.staged_bytes = 0
+        self.update_mem_used(0)
+        return freed
+
+    def partition_bytes(self, pid: int) -> bytes:
+        """All frames for a partition (spilled runs + staged), concatenated
+        — IPC frames are self-delimiting so concatenation is valid."""
+        out = io.BytesIO()
+        for run in self.runs:
+            if pid in run:
+                out.write(run[pid])
+        for b in self.staged.get(pid, []):
+            batch_serde.write_one_batch(b.to_arrow(), out)
+        return out.getvalue()
+
+
+class _ShuffleWriterBase(Operator):
+    def __init__(self, child: Operator, partitioning: Partitioning,
+                 name: str):
+        out_schema = Schema((Field("partition", DataType.int32()),
+                             Field("bytes", DataType.int64()),
+                             Field("rows", DataType.int64())))
+        Operator.__init__(self, out_schema, [child], name=name)
+        self.partitioning = partitioning
+        self._computer = PartitionIdComputer(partitioning, child.schema)
+
+    def _partitioned_stream(self, ctx: TaskContext):
+        """Yields (pid, sub_batch) pairs per input batch."""
+        import time
+        row_start = 0
+        n = self.partitioning.num_partitions
+        for b in self.child_stream(ctx):
+            if b.num_rows == 0:
+                continue
+            t0 = time.perf_counter_ns()
+            pids = self._computer(b, partition_id=ctx.partition_id,
+                                  row_start=row_start)
+            row_start += b.num_rows
+            live = b.row_mask()
+            # device-side grouping: one compaction per non-empty partition
+            present = np.unique(np.asarray(
+                jnp.where(live, pids, -1))).tolist()
+            for pid in present:
+                if pid < 0:
+                    continue
+                mask = jnp.logical_and(pids == pid, live)
+                idx, cnt = compact_indices(mask, b.capacity)
+                c = int(cnt)
+                if c == 0:
+                    continue
+                yield int(pid), b.gather(idx, c)
+            self.metrics.add("shuffle_write_time_ns",
+                             time.perf_counter_ns() - t0)
+            self.metrics.add("shuffle_write_rows", b.num_rows)
+
+
+class ShuffleWriterExec(_ShuffleWriterBase):
+    def __init__(self, child: Operator, partitioning: Partitioning,
+                 output_data_file: str, output_index_file: str):
+        super().__init__(child, partitioning, "ShuffleWriterExec")
+        self.output_data_file = output_data_file
+        self.output_index_file = output_index_file
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        mgr = ctx.mem_manager or get_manager()
+        bufs = _PartitionBuffers(self.partitioning.num_partitions,
+                                 self.children[0].schema)
+        mgr.register_consumer(bufs)
+        rows_per_pid: Dict[int, int] = {}
+        try:
+            for pid, sub in self._partitioned_stream(ctx):
+                bufs.add(pid, sub)
+                rows_per_pid[pid] = rows_per_pid.get(pid, 0) + sub.num_rows
+            n = self.partitioning.num_partitions
+            offsets = [0] * (n + 1)
+            with open(self.output_data_file, "wb") as f:
+                for pid in range(n):
+                    data = bufs.partition_bytes(pid)
+                    f.write(data)
+                    offsets[pid + 1] = offsets[pid] + len(data)
+            with open(self.output_index_file, "wb") as f:
+                f.write(struct.pack(f"<{n + 1}q", *offsets))
+            lengths = [offsets[i + 1] - offsets[i] for i in range(n)]
+            out_rows = [{"partition": pid, "bytes": lengths[pid],
+                         "rows": rows_per_pid.get(pid, 0)}
+                        for pid in range(n)]
+            import pyarrow as pa
+            from auron_tpu.ir.schema import to_arrow_schema
+            yield Batch.from_arrow(pa.Table.from_pylist(
+                out_rows, schema=to_arrow_schema(self.schema))
+                .combine_chunks().to_batches()[0])
+        finally:
+            mgr.unregister_consumer(bufs)
+
+
+class RssShuffleWriterExec(_ShuffleWriterBase):
+    def __init__(self, child: Operator, partitioning: Partitioning,
+                 rss_resource_id: str):
+        super().__init__(child, partitioning, "RssShuffleWriterExec")
+        self.rss_resource_id = rss_resource_id
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        writer: RssPartitionWriter = ctx.resources.get(self.rss_resource_id)
+        rows_per_pid: Dict[int, int] = {}
+        bytes_per_pid: Dict[int, int] = {}
+        for pid, sub in self._partitioned_stream(ctx):
+            sink = io.BytesIO()
+            batch_serde.write_one_batch(sub.to_arrow(), sink)
+            data = sink.getvalue()
+            writer.write(pid, data)
+            rows_per_pid[pid] = rows_per_pid.get(pid, 0) + sub.num_rows
+            bytes_per_pid[pid] = bytes_per_pid.get(pid, 0) + len(data)
+        writer.flush()
+        out_rows = [{"partition": pid, "bytes": bytes_per_pid.get(pid, 0),
+                     "rows": rows_per_pid.get(pid, 0)}
+                    for pid in range(self.partitioning.num_partitions)]
+        import pyarrow as pa
+        from auron_tpu.ir.schema import to_arrow_schema
+        yield Batch.from_arrow(pa.Table.from_pylist(
+            out_rows, schema=to_arrow_schema(self.schema))
+            .combine_chunks().to_batches()[0])
+
+
+class InProcessShuffleService:
+    """Single-host multi-stage exchange: map tasks write partition frames
+    here; reduce tasks read them back via IpcReaderExec resources.  The
+    analogue of the Spark block-store path (AuronShuffleManager) for the
+    standalone driver."""
+
+    def __init__(self) -> None:
+        # (shuffle_id, reduce_pid) -> list of byte blocks (one per map task)
+        self._blocks: Dict[tuple, List[bytes]] = {}
+
+    def rss_writer(self, shuffle_id: str, map_id: int) -> RssPartitionWriter:
+        svc = self
+
+        class _W(RssPartitionWriter):
+            def write(self, partition_id: int, data: bytes) -> None:
+                svc._blocks.setdefault((shuffle_id, partition_id),
+                                       []).append(data)
+        return _W()
+
+    def reduce_blocks(self, shuffle_id: str, reduce_pid: int) -> List[bytes]:
+        return self._blocks.get((shuffle_id, reduce_pid), [])
+
+    def clear(self, shuffle_id: str) -> None:
+        for k in [k for k in self._blocks if k[0] == shuffle_id]:
+            del self._blocks[k]

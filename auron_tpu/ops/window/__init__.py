@@ -1,0 +1,3 @@
+from auron_tpu.ops.window.exec import WindowExec
+
+__all__ = ["WindowExec"]

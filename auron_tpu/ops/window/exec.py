@@ -1,0 +1,354 @@
+"""Window operator.
+
+Analogue of window_exec.rs:45 + window/processors/*.rs (row_number, rank,
+dense_rank, percent_rank, cume_dist, lead/lag, nth_value/first/last,
+agg-over-window, window-group-limit).
+
+TPU shape: sort the partition's rows by (partition_by, order_by) once, then
+every processor is a segmented scan/reduce over the sorted batch — no
+per-row state machines.  Segmented running aggregates use prefix sums with
+segment-start subtraction; rank family uses order-group boundaries.
+
+Frame semantics: Spark's default frame (RANGE BETWEEN UNBOUNDED PRECEDING
+AND CURRENT ROW) when order_by is present, whole partition otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import (
+    Batch, DeviceColumn, DeviceStringColumn, bucket_capacity, concat_batches,
+)
+from auron_tpu.exprs.compiler import build_evaluator
+from auron_tpu.exprs.typing import infer_type
+from auron_tpu.ir.plan import WindowFuncCall, WindowGroupLimit
+from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.ops.base import Operator, TaskContext, batch_size, compact_indices
+from auron_tpu.ops.sort_keys import (
+    encode_sort_keys, keys_equal_prev, lexsort_indices,
+)
+
+
+class WindowExec(Operator):
+    def __init__(self, child: Operator, window_funcs: Tuple[WindowFuncCall, ...],
+                 partition_by, order_by, group_limit: Optional[WindowGroupLimit]
+                 = None, output_window_cols: bool = True):
+        in_schema = child.schema
+        self.window_funcs = tuple(window_funcs)
+        self.partition_by = tuple(partition_by)
+        self.order_by = tuple(order_by)
+        self.group_limit = group_limit
+        self.output_window_cols = output_window_cols
+        fields = list(in_schema.fields)
+        if output_window_cols:
+            for wf in self.window_funcs:
+                dt = wf.return_type or _default_window_type(wf)
+                fields.append(Field(wf.name or wf.fn, dt))
+        super().__init__(Schema(tuple(fields)), [child])
+        self._part_eval = build_evaluator(self.partition_by, in_schema)
+        self._order_eval = build_evaluator(
+            tuple(s.child for s in self.order_by), in_schema)
+        self._arg_evals = [build_evaluator(
+            tuple(wf.args) + ((wf.agg.children if wf.agg else ())), in_schema)
+            for wf in self.window_funcs]
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        from auron_tpu.memmgr import MemConsumer, get_manager
+        consumer = MemConsumer("WindowExec", spillable=False)
+        mgr = ctx.mem_manager or get_manager()
+        mgr.register_consumer(consumer)
+        try:
+            yield from self._execute_inner(ctx, consumer)
+        finally:
+            mgr.unregister_consumer(consumer)
+
+    def _execute_inner(self, ctx: TaskContext, consumer) -> Iterator[Batch]:
+        batches = []
+        staged = 0
+        for b in self.child_stream(ctx):
+            if not b.num_rows:
+                continue
+            batches.append(b)
+            staged += b.mem_bytes()
+            # accounted (non-spillable): budget pressure pushes other
+            # consumers to spill; window itself needs the full partition
+            consumer.update_mem_used(staged)
+        if not batches:
+            return
+        total = sum(b.num_rows for b in batches)
+        cap = bucket_capacity(total)
+        merged = concat_batches(self.children[0].schema, batches, cap)
+        n = merged.num_rows
+        live = merged.row_mask()
+
+        pcols = self._part_eval(merged, partition_id=ctx.partition_id)
+        ocols = self._order_eval(merged, partition_id=ctx.partition_id)
+        orders = tuple((s.asc, s.nulls_first) for s in self.order_by)
+        pwords = encode_sort_keys(pcols, tuple((True, True)
+                                               for _ in self.partition_by))
+        owords = encode_sort_keys(ocols, orders)
+        perm = lexsort_indices(pwords + owords, n, cap)
+        sorted_b = merged.gather(perm, n)
+        sp = [jnp.take(w, perm) for w in pwords]
+        so = [jnp.take(w, perm) for w in owords]
+        live = sorted_b.row_mask()
+
+        part_bound = _boundaries(sp, live, cap)
+        order_bound = jnp.logical_or(part_bound, _boundaries(so, live, cap)) \
+            if so else part_bound
+
+        idx = jnp.arange(cap, dtype=jnp.int64)
+        NEG = jnp.int64(-1)
+        seg_start = jax.lax.cummax(jnp.where(part_bound, idx, NEG))
+        og_start = jax.lax.cummax(jnp.where(order_bound, idx, NEG))
+        seg_id = jnp.cumsum(part_bound.astype(jnp.int32)) - 1
+        seg_id = jnp.where(live, seg_id, cap - 1)
+        # partition sizes + last index
+        ones = jnp.where(live, 1, 0)
+        seg_sizes = jax.ops.segment_sum(ones, seg_id, num_segments=cap)
+        part_n = jnp.take(seg_sizes, seg_id)
+        seg_end = seg_start + part_n  # exclusive
+
+        row_number = (idx - seg_start + 1).astype(jnp.int64)
+        rank = (og_start - seg_start + 1).astype(jnp.int64)
+
+        out_cols: List[Any] = []
+        for wf, arg_eval in zip(self.window_funcs, self._arg_evals):
+            args = arg_eval(sorted_b, partition_id=ctx.partition_id)
+            out_cols.append(self._compute(wf, args, sorted_b, dict(
+                row_number=row_number, rank=rank, idx=idx,
+                seg_start=seg_start, seg_end=seg_end, part_n=part_n,
+                seg_id=seg_id, og_start=og_start, order_bound=order_bound,
+                part_bound=part_bound, live=live, cap=cap)))
+
+        result = sorted_b
+        if self.output_window_cols:
+            result = Batch(self.schema, list(sorted_b.columns) + out_cols,
+                           n, cap)
+        if self.group_limit is not None:
+            rank_fn = {"row_number": row_number, "rank": rank,
+                       "dense_rank": self._dense_rank(part_bound, order_bound,
+                                                      seg_id, cap, live)}[
+                self.group_limit.rank_fn]
+            keep = jnp.logical_and(rank_fn <= self.group_limit.k, live)
+            sel, cnt = compact_indices(keep, cap)
+            result = result.gather(sel, int(cnt))
+        yield from _rechunk_stream(result)
+
+    # ------------------------------------------------------------------
+
+    def _dense_rank(self, part_bound, order_bound, seg_id, cap, live):
+        og = jnp.cumsum(order_bound.astype(jnp.int64))
+        og_at_seg_start = jax.lax.cummax(
+            jnp.where(part_bound, og, jnp.int64(-1)))
+        return og - og_at_seg_start + 1
+
+    def _compute(self, wf: WindowFuncCall, args, sorted_b: Batch, c) -> Any:
+        fn = wf.fn
+        cap = c["cap"]
+        if fn == "row_number":
+            return DeviceColumn(DataType.int64(), c["row_number"],
+                                jnp.ones(cap, bool))
+        if fn == "rank":
+            return DeviceColumn(DataType.int64(), c["rank"],
+                                jnp.ones(cap, bool))
+        if fn == "dense_rank":
+            d = self._dense_rank(c["part_bound"], c["order_bound"],
+                                 c["seg_id"], cap, c["live"])
+            return DeviceColumn(DataType.int64(), d, jnp.ones(cap, bool))
+        if fn == "percent_rank":
+            denom = jnp.maximum(c["part_n"] - 1, 1).astype(jnp.float64)
+            pr = (c["rank"] - 1).astype(jnp.float64) / denom
+            pr = jnp.where(c["part_n"] <= 1, 0.0, pr)
+            return DeviceColumn(DataType.float64(), pr, jnp.ones(cap, bool))
+        if fn == "cume_dist":
+            # rows with order-key <= current = last index of this order group
+            og_end = _order_group_end(c)
+            cd = (og_end - c["seg_start"]).astype(jnp.float64) / \
+                jnp.maximum(c["part_n"], 1).astype(jnp.float64)
+            return DeviceColumn(DataType.float64(), cd, jnp.ones(cap, bool))
+        if fn in ("lead", "lag"):
+            k = int(wf.args[1].value) if len(wf.args) > 1 and \
+                hasattr(wf.args[1], "value") else 1
+            shift = k if fn == "lead" else -k
+            src = c["idx"] + shift
+            in_seg = jnp.logical_and(src >= c["seg_start"],
+                                     src < c["seg_end"])
+            out = _gather_with_default(args[0], src, in_seg, wf, cap)
+            default = wf.args[2].value if len(wf.args) > 2 and \
+                hasattr(wf.args[2], "value") else None
+            if default is not None:
+                fill = jnp.asarray(default, out.data.dtype) \
+                    if not isinstance(out, DeviceStringColumn) else None
+                if fill is not None:
+                    data = jnp.where(in_seg, out.data, fill)
+                    valid = jnp.logical_or(out.validity,
+                                           jnp.logical_not(in_seg))
+                    out = DeviceColumn(out.dtype, data,
+                                       jnp.logical_and(valid, c["live"]))
+            return out
+        if fn in ("first_value", "nth_value", "nth_value_ignore_nulls",
+                  "last_value"):
+            if fn == "last_value":
+                # Spark default RANGE frame: last *peer* row's value
+                src = _order_group_end(c) - 1
+                ok = c["live"]
+            else:
+                nth = 1
+                if fn.startswith("nth") and len(wf.args) > 1 and \
+                        hasattr(wf.args[1], "value"):
+                    nth = int(wf.args[1].value)
+                src = c["seg_start"] + (nth - 1)
+                ok = jnp.logical_and(src <= c["idx"], src < c["seg_end"])
+            return _gather_with_default(args[0], src, ok, wf, cap)
+        if fn == "agg":
+            return self._agg_over_window(wf, args, c)
+        raise NotImplementedError(f"window function {fn!r}")
+
+    def _agg_over_window(self, wf: WindowFuncCall, args, c) -> Any:
+        agg = wf.agg
+        cap = c["cap"]
+        val = args[-1] if args else None
+        running = bool(self.order_by)
+
+        def to_range_frame(rowwise):
+            """Spark's default frame is RANGE (peers share it): broadcast
+            the running value at each order group's LAST row to the whole
+            group."""
+            last = jnp.clip(_order_group_end(c) - 1, 0, cap - 1) \
+                .astype(jnp.int32)
+            return jnp.take(rowwise, last)
+
+        if agg.fn == "count":
+            x = val.validity.astype(jnp.int64) if agg.children else \
+                jnp.where(c["live"], 1, 0).astype(jnp.int64)
+            out = to_range_frame(_seg_running_sum(x, c)) if running \
+                else _seg_total(x, c)
+            return DeviceColumn(DataType.int64(), out, jnp.ones(cap, bool))
+        if agg.fn in ("sum", "avg"):
+            acc_dt = jnp.float64 if agg.return_type.is_floating or \
+                agg.fn == "avg" else jnp.int64
+            x = jnp.where(val.validity, val.data.astype(acc_dt), 0)
+            hs = val.validity.astype(jnp.int64)
+            if running:
+                s = to_range_frame(_seg_running_sum(x, c))
+                cnt = to_range_frame(_seg_running_sum(hs, c))
+            else:
+                s = _seg_total(x, c)
+                cnt = _seg_total(hs, c)
+            if agg.fn == "avg":
+                out = s.astype(jnp.float64) / jnp.maximum(cnt, 1)
+                return DeviceColumn(DataType.float64(), out, cnt > 0)
+            return DeviceColumn(agg.return_type,
+                                s.astype(agg.return_type.numpy_dtype()
+                                         if not agg.return_type.is_decimal
+                                         else jnp.int64), cnt > 0)
+        if agg.fn in ("min", "max"):
+            np_dt = np.dtype(str(val.data.dtype))
+            if np_dt.kind == "f":
+                neutral = jnp.asarray(
+                    np.inf if agg.fn == "min" else -np.inf, np_dt)
+            else:
+                info = np.iinfo(np_dt)
+                neutral = jnp.asarray(info.max if agg.fn == "min"
+                                      else info.min, np_dt)
+            x = jnp.where(val.validity, val.data, neutral)
+            if running:
+                scan = to_range_frame(_seg_running_minmax(
+                    x, c, is_min=agg.fn == "min"))
+                has = to_range_frame(
+                    _seg_running_sum(val.validity.astype(jnp.int64), c)) > 0
+            else:
+                scan = _seg_total_minmax(x, c, is_min=agg.fn == "min")
+                has = _seg_total(val.validity.astype(jnp.int64), c) > 0
+            return DeviceColumn(val.dtype, jnp.where(has, scan, 0), has)
+        raise NotImplementedError(f"window agg {agg.fn!r}")
+
+
+def _default_window_type(wf: WindowFuncCall) -> DataType:
+    if wf.fn in ("row_number", "rank", "dense_rank"):
+        return DataType.int64()
+    if wf.fn in ("percent_rank", "cume_dist"):
+        return DataType.float64()
+    return DataType.float64()
+
+
+def _boundaries(words, live, cap):
+    if not words:
+        # single partition: row 0 is the only boundary
+        return jnp.logical_and(jnp.arange(cap) == 0, live)
+    eq = keys_equal_prev(words)
+    return jnp.logical_and(jnp.logical_not(eq), live)
+
+
+def _order_group_end(c):
+    """Exclusive end index of each row's order group (same order key)."""
+    cap = c["cap"]
+    idx = c["idx"]
+    # next boundary at or after idx+1
+    nb = c["order_bound"]
+    big = jnp.int64(cap)
+    next_bound = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(nb, idx, big))))
+    # next_bound[i] = first boundary index >= i; we want > i
+    shifted = jnp.concatenate([next_bound[1:], jnp.array([big])])
+    end = jnp.minimum(shifted, c["seg_end"])
+    return end
+
+
+def _gather_with_default(val, src, ok, wf: WindowFuncCall, cap):
+    srcc = jnp.clip(src, 0, cap - 1).astype(jnp.int32)
+    return val.gather(srcc, ok)
+
+
+def _seg_running_sum(x, c):
+    pref = jnp.cumsum(x)
+    at_start = jnp.take(pref, jnp.clip(c["seg_start"], 0, None).astype(jnp.int32))
+    start_val = jnp.take(x, jnp.clip(c["seg_start"], 0, None).astype(jnp.int32))
+    return pref - at_start + start_val
+
+
+def _seg_total(x, c):
+    seg = c["seg_id"]
+    cap = c["cap"]
+    tot = jax.ops.segment_sum(x, seg, num_segments=cap)
+    return jnp.take(tot, seg)
+
+
+def _seg_running_minmax(x, c, is_min: bool):
+    import jax.lax as lax
+    # associative scan with segment reset: combine (flag, value)
+    flags = c["part_bound"]
+
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        keep_b = bf
+        merged = jnp.minimum(av, bv) if is_min else jnp.maximum(av, bv)
+        return (jnp.logical_or(af, bf), jnp.where(keep_b, bv, merged))
+
+    _, out = lax.associative_scan(combine, (flags, x))
+    return out
+
+
+def _seg_total_minmax(x, c, is_min: bool):
+    seg = c["seg_id"]
+    cap = c["cap"]
+    red = jax.ops.segment_min(x, seg, num_segments=cap) if is_min else \
+        jax.ops.segment_max(x, seg, num_segments=cap)
+    return jnp.take(red, seg)
+
+
+def _rechunk_stream(b: Batch) -> Iterator[Batch]:
+    bs = batch_size()
+    if b.num_rows <= bs:
+        yield b
+        return
+    arrow = b.to_arrow()
+    for off in range(0, b.num_rows, bs):
+        yield Batch.from_arrow(arrow.slice(off, bs))

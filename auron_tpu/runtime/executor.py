@@ -1,0 +1,104 @@
+"""Task execution runtime.
+
+Analogue of NativeExecutionRuntime (native-engine/auron/src/rt.rs:76-308):
+decode the TaskDefinition, build the operator tree, pull batches through
+it (with cancellation + error ferrying), finalize metrics.  The tokio
+mpsc(1) producer/consumer pair becomes a straightforward generator pull —
+XLA's async dispatch already overlaps device compute with host work.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Iterator, List, Optional
+
+import pyarrow as pa
+
+from auron_tpu.columnar.batch import Batch
+from auron_tpu.ir import plan as P
+from auron_tpu.ir import serde as ir_serde
+from auron_tpu.memmgr import get_manager
+from auron_tpu.ops.base import Operator, TaskContext
+from auron_tpu.runtime.metrics import MetricNode
+from auron_tpu.runtime.planner import PhysicalPlanner
+from auron_tpu.runtime.resources import GLOBAL_RESOURCES, ResourceRegistry
+
+log = logging.getLogger("auron_tpu.runtime")
+
+
+@dataclass
+class ExecutionResult:
+    batches: List[pa.RecordBatch]
+    metrics: MetricNode
+
+    def to_table(self) -> pa.Table:
+        if not self.batches:
+            return pa.table({})
+        return pa.Table.from_batches(self.batches)
+
+    def to_pylist(self) -> List[dict]:
+        return self.to_table().to_pylist() if self.batches else []
+
+
+class NativeExecutionRuntime:
+    """One runtime per task (rt.rs:76): start -> iterate batches ->
+    finalize."""
+
+    def __init__(self, task: P.TaskDefinition,
+                 resources: Optional[ResourceRegistry] = None):
+        self.task = task
+        self.planner = PhysicalPlanner()
+        self.root: Operator = self.planner.create_plan(task.plan)
+        self.ctx = TaskContext(
+            stage_id=task.stage_id, partition_id=task.partition_id,
+            num_partitions=task.num_partitions,
+            resources=resources or GLOBAL_RESOURCES,
+            mem_manager=get_manager())
+        self.error: Optional[BaseException] = None
+
+    def batches(self) -> Iterator[Batch]:
+        """Pull the stream; errors are recorded and re-raised (the setError
+        + rethrow-on-next-loadNextBatch contract, rt.rs:207-238)."""
+        try:
+            yield from self.root.execute_with_metrics(self.ctx)
+        except BaseException as e:  # noqa: BLE001 - ferried to caller
+            self.error = e
+            if self.ctx.is_running:
+                log.error("[stage %d part %d] native execution failed: %s",
+                          self.task.stage_id, self.task.partition_id, e)
+                raise
+
+    def cancel(self) -> None:
+        self.ctx.cancel()
+
+    def finalize(self) -> MetricNode:
+        return self.root.metrics
+
+
+def execute_plan(plan: P.PlanNode, partition_id: int = 0,
+                 num_partitions: int = 1,
+                 resources: Optional[ResourceRegistry] = None
+                 ) -> ExecutionResult:
+    """Convenience driver: run one partition of a plan to completion."""
+    td = P.TaskDefinition(plan=plan, partition_id=partition_id,
+                          num_partitions=num_partitions)
+    return execute_task(td, resources)
+
+
+def execute_task(task: P.TaskDefinition,
+                 resources: Optional[ResourceRegistry] = None
+                 ) -> ExecutionResult:
+    rt = NativeExecutionRuntime(task, resources)
+    out = [b.to_arrow() for b in rt.batches() if b.num_rows > 0]
+    return ExecutionResult(out, rt.finalize())
+
+
+def execute_task_bytes(task_bytes: bytes,
+                       resources: Optional[ResourceRegistry] = None
+                       ) -> ExecutionResult:
+    """The wire entry point: serialized TaskDefinition in, batches out
+    (the callNative/nextBatch/finalizeNative surface, exec.rs:42-144)."""
+    td = ir_serde.deserialize(task_bytes)
+    assert isinstance(td, P.TaskDefinition)
+    return execute_task(td, resources)

@@ -1,0 +1,220 @@
+"""Physical planner: plan IR -> operator tree.
+
+Analogue of auron-planner's PhysicalPlanner::create_plan (planner.rs:121):
+one dispatch arm per plan-node kind, honoring per-operator enable switches
+(auron.enable.*) — a disabled operator raises (the front-end should not
+have emitted it), mirroring the reference where conversion happens before
+the native side ever sees the node.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from auron_tpu.config import conf
+from auron_tpu.ir import plan as P
+from auron_tpu.ops.base import Operator
+from auron_tpu.ops.basic import (
+    CoalesceBatchesExec, DebugExec, EmptyPartitionsExec, ExpandExec,
+    FilterExec, LimitExec, ProjectExec, RenameColumnsExec, UnionExec,
+)
+from auron_tpu.ops.sort import SortExec
+from auron_tpu.ops.agg.exec import AggExec
+from auron_tpu.ops.joins import (
+    BroadcastJoinBuildHashMapExec, BroadcastJoinExec, HashJoinExec,
+    SortMergeJoinExec,
+)
+from auron_tpu.ops.window import WindowExec
+from auron_tpu.ops.generate import GenerateExec
+from auron_tpu.ops.scan import (
+    FFIReaderExec, IpcReaderExec, KafkaScanExec, OrcScanExec,
+    ParquetScanExec,
+)
+from auron_tpu.ops.scan.parquet import ParquetSinkExec
+from auron_tpu.ops.scan.orc import OrcSinkExec
+from auron_tpu.ops.shuffle.writer import RssShuffleWriterExec, ShuffleWriterExec
+
+
+class PhysicalPlanner:
+    def __init__(self) -> None:
+        self._arms: Dict[str, Callable[[P.PlanNode], Operator]] = {
+            "parquet_scan": self._parquet_scan,
+            "orc_scan": self._orc_scan,
+            "kafka_scan": self._kafka_scan,
+            "ipc_reader": self._ipc_reader,
+            "ffi_reader": self._ffi_reader,
+            "empty_partitions": self._empty_partitions,
+            "projection": self._projection,
+            "filter": self._filter,
+            "sort": self._sort,
+            "limit": self._limit,
+            "agg": self._agg,
+            "expand": self._expand,
+            "window": self._window,
+            "generate": self._generate,
+            "rename_columns": self._rename_columns,
+            "coalesce_batches": self._coalesce_batches,
+            "debug": self._debug,
+            "union": self._union,
+            "sort_merge_join": self._smj,
+            "hash_join": self._hash_join,
+            "broadcast_join": self._broadcast_join,
+            "broadcast_join_build_hash_map": self._bhm,
+            "shuffle_writer": self._shuffle_writer,
+            "rss_shuffle_writer": self._rss_shuffle_writer,
+            "ipc_writer": self._ipc_writer,
+            "parquet_sink": self._parquet_sink,
+            "orc_sink": self._orc_sink,
+        }
+
+    def create_plan(self, node: P.PlanNode) -> Operator:
+        arm = self._arms.get(node.kind)
+        if arm is None:
+            raise NotImplementedError(f"plan node {node.kind!r}")
+        return arm(node)
+
+    # -- leaves --------------------------------------------------------------
+
+    def _check(self, switch: str) -> None:
+        if not conf.get(f"auron.enable.{switch}"):
+            raise RuntimeError(f"operator {switch!r} disabled by config")
+
+    def _parquet_scan(self, n: P.ParquetScan) -> Operator:
+        self._check("parquet.scan")
+        return ParquetScanExec(n.schema, n.file_groups, n.projection,
+                               n.predicate, n.partition_schema,
+                               n.partition_values)
+
+    def _orc_scan(self, n: P.OrcScan) -> Operator:
+        self._check("orc.scan")
+        return OrcScanExec(n.schema, n.file_groups, n.projection,
+                           n.predicate, n.positional_evolution)
+
+    def _kafka_scan(self, n: P.KafkaScan) -> Operator:
+        self._check("kafka.scan")
+        return KafkaScanExec(n.schema, n.topic, n.assignment_json,
+                             n.value_format, n.bootstrap_servers, n.mock_data)
+
+    def _ipc_reader(self, n: P.IpcReader) -> Operator:
+        return IpcReaderExec(n.schema, n.resource_id)
+
+    def _ffi_reader(self, n: P.FFIReader) -> Operator:
+        self._check("ffi.reader")
+        return FFIReaderExec(n.schema, n.resource_id)
+
+    def _empty_partitions(self, n: P.EmptyPartitions) -> Operator:
+        return EmptyPartitionsExec(n.schema, n.num_partitions)
+
+    # -- unary ---------------------------------------------------------------
+
+    def _projection(self, n: P.Projection) -> Operator:
+        self._check("project")
+        child = self.create_plan(n.child)
+        # fuse filter+project (the reference's CachedExprsEvaluator fusion)
+        if isinstance(child, FilterExec) and child.exprs is None:
+            return FilterExec(child.children[0], child.predicates,
+                              exprs=n.exprs, names=n.names)
+        return ProjectExec(child, n.exprs, n.names)
+
+    def _filter(self, n: P.Filter) -> Operator:
+        self._check("filter")
+        return FilterExec(self.create_plan(n.child), n.predicates)
+
+    def _sort(self, n: P.Sort) -> Operator:
+        self._check("sort")
+        return SortExec(self.create_plan(n.child), n.sort_exprs,
+                        n.fetch_limit, n.fetch_offset)
+
+    def _limit(self, n: P.Limit) -> Operator:
+        return LimitExec(self.create_plan(n.child), n.limit, n.offset)
+
+    def _agg(self, n: P.Agg) -> Operator:
+        self._check("agg")
+        return AggExec(self.create_plan(n.child), n.exec_mode, n.grouping,
+                       n.grouping_names, n.aggs, n.agg_names,
+                       n.supports_partial_skipping)
+
+    def _expand(self, n: P.Expand) -> Operator:
+        self._check("expand")
+        return ExpandExec(self.create_plan(n.child), n.projections, n.names,
+                          n.types)
+
+    def _window(self, n: P.Window) -> Operator:
+        self._check("window")
+        return WindowExec(self.create_plan(n.child), n.window_funcs,
+                          n.partition_by, n.order_by, n.group_limit,
+                          n.output_window_cols)
+
+    def _generate(self, n: P.Generate) -> Operator:
+        self._check("generate")
+        return GenerateExec(self.create_plan(n.child), n.generator, n.args,
+                            n.generator_output_names,
+                            n.generator_output_types,
+                            n.required_child_output, n.outer, n.udtf)
+
+    def _rename_columns(self, n: P.RenameColumns) -> Operator:
+        return RenameColumnsExec(self.create_plan(n.child), n.names)
+
+    def _coalesce_batches(self, n: P.CoalesceBatches) -> Operator:
+        return CoalesceBatchesExec(self.create_plan(n.child),
+                                   n.target_batch_size)
+
+    def _debug(self, n: P.Debug) -> Operator:
+        return DebugExec(self.create_plan(n.child), n.debug_id)
+
+    # -- multi-input ---------------------------------------------------------
+
+    def _union(self, n: P.Union) -> Operator:
+        children = [self.create_plan(i.child) for i in n.inputs]
+        return UnionExec(children, n.schema)
+
+    def _smj(self, n: P.SortMergeJoin) -> Operator:
+        self._check("smj")
+        return SortMergeJoinExec(self.create_plan(n.left),
+                                 self.create_plan(n.right), n.on,
+                                 n.join_type, n.sort_options,
+                                 n.existence_output_name)
+
+    def _hash_join(self, n: P.HashJoin) -> Operator:
+        self._check("shj")
+        return HashJoinExec(self.create_plan(n.left),
+                            self.create_plan(n.right), n.on, n.join_type,
+                            n.build_side, n.existence_output_name)
+
+    def _broadcast_join(self, n: P.BroadcastJoin) -> Operator:
+        self._check("bhj")
+        return BroadcastJoinExec(self.create_plan(n.left),
+                                 self.create_plan(n.right), n.on,
+                                 n.join_type, n.broadcast_side,
+                                 n.cached_build_hash_map_id,
+                                 n.existence_output_name)
+
+    def _bhm(self, n: P.BroadcastJoinBuildHashMap) -> Operator:
+        return BroadcastJoinBuildHashMapExec(self.create_plan(n.child),
+                                             n.keys, n.cache_id)
+
+    # -- exchange / sinks ----------------------------------------------------
+
+    def _shuffle_writer(self, n: P.ShuffleWriter) -> Operator:
+        self._check("shuffle")
+        return ShuffleWriterExec(self.create_plan(n.child), n.partitioning,
+                                 n.output_data_file, n.output_index_file)
+
+    def _rss_shuffle_writer(self, n: P.RssShuffleWriter) -> Operator:
+        self._check("shuffle")
+        return RssShuffleWriterExec(self.create_plan(n.child),
+                                    n.partitioning, n.rss_resource_id)
+
+    def _ipc_writer(self, n: P.IpcWriter) -> Operator:
+        from auron_tpu.ops.scan.ipc import IpcWriterExec
+        return IpcWriterExec(self.create_plan(n.child), n.resource_id)
+
+    def _parquet_sink(self, n: P.ParquetSink) -> Operator:
+        self._check("parquet.sink")
+        return ParquetSinkExec(self.create_plan(n.child), n.output_dir,
+                               n.partition_cols, n.compression, n.props)
+
+    def _orc_sink(self, n: P.OrcSink) -> Operator:
+        self._check("orc.sink")
+        return OrcSinkExec(self.create_plan(n.child), n.output_dir,
+                           n.partition_cols, n.compression, n.props)

@@ -1,0 +1,475 @@
+"""Reference interpreter: executes plan IR over python rows.
+
+The differential oracle of SURVEY §4 — where the reference runs every query
+twice (vanilla Spark vs native) and compares, we interpret the same plan IR
+with plain python/pyarrow (reusing the host expression evaluator) and
+compare against the device engine.
+"""
+
+from __future__ import annotations
+
+import io
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from auron_tpu.exprs.host_eval import evaluate as hev, hv_to_arrow
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.schema import Schema, to_arrow_schema
+from auron_tpu.runtime.resources import ResourceRegistry
+
+
+def run_plan(plan: P.PlanNode, resources: ResourceRegistry,
+             partition_id: int = 0) -> List[dict]:
+    return _Interp(resources, partition_id).run(plan)
+
+
+def _rows_to_table(rows: List[dict], schema: Schema) -> pa.RecordBatch:
+    t = pa.Table.from_pylist(rows, schema=to_arrow_schema(schema))
+    t = t.combine_chunks()
+    return t.to_batches()[0] if t.num_rows else \
+        pa.RecordBatch.from_pylist([], schema=to_arrow_schema(schema))
+
+
+class _Interp:
+    def __init__(self, resources: ResourceRegistry, partition_id: int):
+        self.res = resources
+        self.pid = partition_id
+
+    def run(self, plan: P.PlanNode) -> List[dict]:
+        return getattr(self, "_" + plan.kind)(plan)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _schema_of(self, plan: P.PlanNode) -> Schema:
+        from auron_tpu.runtime.planner import PhysicalPlanner
+        return PhysicalPlanner().create_plan(plan).schema
+
+    def _eval_rows(self, exprs, rows: List[dict], schema: Schema,
+                   row_base: int = 0) -> List[List[Any]]:
+        """Evaluate exprs over rows -> per-expr python value lists."""
+        if not rows:
+            return [[] for _ in exprs]
+        rb = _rows_to_table(rows, schema)
+        out = []
+        for x in exprs:
+            hv = hev(x, rb, schema, partition_id=self.pid, row_base=row_base)
+            out.append(hv_to_arrow(hv).to_pylist())
+        return out
+
+    # -- leaves -------------------------------------------------------------
+
+    def _parquet_scan(self, n: P.ParquetScan) -> List[dict]:
+        import pyarrow.parquet as pq
+        if self.pid >= len(n.file_groups):
+            return []
+        gi = self.pid
+        names = [n.schema[i].name for i in (n.projection or
+                                            range(len(n.schema)))]
+        rows: List[dict] = []
+        for path in n.file_groups[gi].paths:
+            t = pq.read_table(path)
+            avail = [c for c in names if c in t.schema.names]
+            for r in t.select(avail).to_pylist():
+                rows.append({c: r.get(c) for c in names})
+        if n.partition_schema:
+            pv = n.partition_values[gi]
+            for r in rows:
+                for f, v in zip(n.partition_schema, pv):
+                    r[f.name] = v
+        if n.predicate is not None:
+            scan_schema = self._schema_of(n)
+            [keep] = self._eval_rows([n.predicate], rows, scan_schema)
+            rows = [r for r, k in zip(rows, keep) if k]
+        return rows
+
+    def _orc_scan(self, n: P.OrcScan) -> List[dict]:
+        from pyarrow import orc
+        if self.pid >= len(n.file_groups):
+            return []
+        gi = self.pid
+        names = [n.schema[i].name for i in (n.projection or
+                                            range(len(n.schema)))]
+        rows = []
+        for path in n.file_groups[gi].paths:
+            t = orc.ORCFile(path).read()
+            for r in t.to_pylist():
+                rows.append({c: r.get(c) for c in names})
+        return rows
+
+    def _ffi_reader(self, n: P.FFIReader) -> List[dict]:
+        from auron_tpu.ops.scan.ipc import _iter_arrow
+        rows = []
+        for rb in _iter_arrow(self.res.get(n.resource_id)):
+            rows.extend(rb.to_pylist())
+        return rows
+
+    def _ipc_reader(self, n: P.IpcReader) -> List[dict]:
+        from auron_tpu.ops.scan.ipc import _iter_ipc
+        rows = []
+        for rb in _iter_ipc(self.res.get(n.resource_id)):
+            rows.extend(rb.to_pylist())
+        return rows
+
+    def _empty_partitions(self, n: P.EmptyPartitions) -> List[dict]:
+        return []
+
+    def _kafka_scan(self, n: P.KafkaScan) -> List[dict]:
+        import json
+        rows = []
+        for payload in n.mock_data:
+            try:
+                obj = json.loads(payload)
+            except Exception:
+                continue
+            rows.append({f.name: obj.get(f.name) for f in n.schema})
+        return rows
+
+    # -- unary --------------------------------------------------------------
+
+    def _projection(self, n: P.Projection) -> List[dict]:
+        rows = self.run(n.child)
+        schema = self._schema_of(n.child)
+        cols = self._eval_rows(n.exprs, rows, schema)
+        return [dict(zip(n.names, vals)) for vals in zip(*cols)] if rows \
+            else []
+
+    def _filter(self, n: P.Filter) -> List[dict]:
+        rows = self.run(n.child)
+        schema = self._schema_of(n.child)
+        keep = None
+        for p in n.predicates:
+            [k] = self._eval_rows([p], rows, schema)
+            keep = k if keep is None else [a and b for a, b in zip(keep, k)]
+        return [r for r, k in zip(rows, keep or [])
+                if k] if rows else []
+
+    def _sort(self, n: P.Sort) -> List[dict]:
+        rows = self.run(n.child)
+        schema = self._schema_of(n.child)
+        key_vals = self._eval_rows([s.child for s in n.sort_exprs], rows,
+                                   schema)
+        decorated = list(zip(zip(*key_vals), rows)) if rows else []
+
+        def keyfn(item):
+            ks = []
+            for v, s in zip(item[0], n.sort_exprs):
+                null_rank = (v is None) != s.nulls_first  # null_first->0
+                kv = _orderable(v, s.asc)
+                ks.append((null_rank, kv))
+            return tuple(ks)
+
+        decorated.sort(key=keyfn)
+        out = [r for _, r in decorated]
+        if n.fetch_limit is not None:
+            out = out[n.fetch_offset:n.fetch_offset + n.fetch_limit]
+        return out
+
+    def _limit(self, n: P.Limit) -> List[dict]:
+        rows = self.run(n.child)
+        return rows[n.offset:n.offset + n.limit]
+
+    def _agg(self, n: P.Agg) -> List[dict]:
+        # interprets single/partial+final pipelines end-to-end only when
+        # modes are "single" (tests compose partial+final as one single)
+        rows = self.run(n.child)
+        schema = self._schema_of(n.child)
+        key_cols = self._eval_rows(n.grouping, rows, schema)
+        keys = list(zip(*key_cols)) if key_cols and rows else \
+            [() for _ in rows]
+        arg_vals = []
+        for a in n.aggs:
+            if a.children:
+                [v] = self._eval_rows([a.children[0]], rows, schema)
+            else:
+                v = [1] * len(rows)
+            arg_vals.append(v)
+        groups: Dict[tuple, List[int]] = defaultdict(list)
+        order: List[tuple] = []
+        for i, k in enumerate(keys if rows else []):
+            kk = tuple(k)
+            if kk not in groups:
+                order.append(kk)
+            groups[kk].append(i)
+        if not n.grouping and not groups:
+            groups[()] = []
+            order.append(())
+        out = []
+        for kk in order:
+            idxs = groups[kk]
+            row = dict(zip(n.grouping_names, kk))
+            for a, name, vals in zip(n.aggs, n.agg_names, arg_vals):
+                row[name] = _oracle_agg(a.fn, [vals[i] for i in idxs],
+                                        bool(a.children))
+            out.append(row)
+        return out
+
+    def _expand(self, n: P.Expand) -> List[dict]:
+        rows = self.run(n.child)
+        schema = self._schema_of(n.child)
+        out = []
+        for proj in n.projections:
+            cols = self._eval_rows(proj, rows, schema)
+            out.extend(dict(zip(n.names, vals)) for vals in zip(*cols))
+        return out
+
+    def _rename_columns(self, n: P.RenameColumns) -> List[dict]:
+        rows = self.run(n.child)
+        old = self._schema_of(n.child).names()
+        return [{nn: r[o] for nn, o in zip(n.names, old)} for r in rows]
+
+    def _coalesce_batches(self, n: P.CoalesceBatches) -> List[dict]:
+        return self.run(n.child)
+
+    def _debug(self, n: P.Debug) -> List[dict]:
+        return self.run(n.child)
+
+    def _union(self, n: P.Union) -> List[dict]:
+        out = []
+        names = n.schema.names()
+        for i in n.inputs:
+            for r in self.run(i.child):
+                out.append(dict(zip(names, r.values())))
+        return out
+
+    # -- joins --------------------------------------------------------------
+
+    def _join(self, left_plan, right_plan, on, join_type, existence_name):
+        lrows = self.run(left_plan)
+        rrows = self.run(right_plan)
+        ls = self._schema_of(left_plan)
+        rs = self._schema_of(right_plan)
+        lk = list(zip(*self._eval_rows(on.left_keys, lrows, ls))) \
+            if lrows else []
+        rk = list(zip(*self._eval_rows(on.right_keys, rrows, rs))) \
+            if rrows else []
+        rmap: Dict[tuple, List[int]] = defaultdict(list)
+        for j, k in enumerate(rk):
+            if all(v is not None for v in k):
+                rmap[tuple(k)].append(j)
+        rnull = {f.name: None for f in rs}
+        lnull = {f.name: None for f in ls}
+        out = []
+        rmatched = set()
+        for i, l in enumerate(lrows):
+            k = tuple(lk[i])
+            ms = rmap.get(k, []) if all(v is not None for v in k) else []
+            if join_type in ("inner", "left", "right", "full"):
+                for j in ms:
+                    out.append({**l, **rrows[j]})
+                    rmatched.add(j)
+                if not ms and join_type in ("left", "full"):
+                    out.append({**l, **rnull})
+            elif join_type == "left_semi" and ms:
+                out.append(dict(l))
+            elif join_type == "left_anti" and not ms:
+                out.append(dict(l))
+            elif join_type == "existence":
+                out.append({**l, existence_name: bool(ms)})
+            elif join_type == "right_semi":
+                for j in ms:
+                    rmatched.add(j)
+            elif join_type == "right_anti":
+                for j in ms:
+                    rmatched.add(j)
+        if join_type in ("right", "full"):
+            for j, r in enumerate(rrows):
+                if j not in rmatched:
+                    out.append({**lnull, **r})
+        elif join_type == "right_semi":
+            out = [rrows[j] for j in sorted(rmatched)]
+        elif join_type == "right_anti":
+            out = [r for j, r in enumerate(rrows) if j not in rmatched]
+        return out
+
+    def _sort_merge_join(self, n: P.SortMergeJoin):
+        return self._join(n.left, n.right, n.on, n.join_type,
+                          n.existence_output_name)
+
+    def _hash_join(self, n: P.HashJoin):
+        return self._join(n.left, n.right, n.on, n.join_type,
+                          n.existence_output_name)
+
+    def _broadcast_join(self, n: P.BroadcastJoin):
+        return self._join(n.left, n.right, n.on, n.join_type,
+                          n.existence_output_name)
+
+    # -- window -------------------------------------------------------------
+
+    def _window(self, n: P.Window) -> List[dict]:
+        rows = self.run(n.child)
+        schema = self._schema_of(n.child)
+        pk = list(zip(*self._eval_rows(n.partition_by, rows, schema))) \
+            if n.partition_by and rows else [()] * len(rows)
+        ok_vals = self._eval_rows([s.child for s in n.order_by], rows, schema)
+        ok = list(zip(*ok_vals)) if n.order_by and rows else \
+            [()] * len(rows)
+
+        def skey(i):
+            parts = tuple((v is None, _orderable(v, True)) for v in pk[i])
+            ords = tuple(((v is None) != s.nulls_first, _orderable(v, s.asc))
+                         for v, s in zip(ok[i], n.order_by))
+            return parts + ords
+
+        order = sorted(range(len(rows)), key=skey)
+        out_rows = [dict(rows[i]) for i in order]
+        spk = [pk[i] for i in order]
+        sok = [ok[i] for i in order]
+        # arg values for lead/lag/nth/agg
+        for wf in n.window_funcs:
+            args = wf.args or (wf.agg.children if wf.agg else ())
+            arg_vals = self._eval_rows(list(args), rows, schema)
+            sorted_args = [[arg_vals[a][i] for i in order]
+                           for a in range(len(arg_vals))]
+            vals = _oracle_window(wf, spk, sok, sorted_args, n.order_by)
+            for r, v in zip(out_rows, vals):
+                r[wf.name or wf.fn] = v
+        if n.group_limit is not None:
+            vals = _oracle_window(
+                P.WindowFuncCall(fn=n.group_limit.rank_fn, name="__r"),
+                spk, sok, [], n.order_by)
+            out_rows = [r for r, v in zip(out_rows, vals)
+                        if v <= n.group_limit.k]
+        if not n.output_window_cols:
+            for r in out_rows:
+                for wf in n.window_funcs:
+                    r.pop(wf.name or wf.fn, None)
+        return out_rows
+
+    def _generate(self, n: P.Generate) -> List[dict]:
+        from auron_tpu.ops.generate.exec import GenerateExec
+        rows = self.run(n.child)
+        schema = self._schema_of(n.child)
+        arg_vals = self._eval_rows(n.args, rows, schema)
+        gen = GenerateExec.__new__(GenerateExec)
+        gen.generator = n.generator
+        gen.udtf = n.udtf
+        keep = [schema[i].name for i in (n.required_child_output or
+                                         range(len(schema)))]
+        gnames = n.generator_output_names
+        out = []
+        for i, r in enumerate(rows):
+            produced = list(gen._generate_row(
+                [arg_vals[a][i] for a in range(len(arg_vals))]))
+            if not produced and n.outer:
+                produced = [tuple(None for _ in gnames)]
+            for tup in produced:
+                out.append({**{k: r[k] for k in keep},
+                            **dict(zip(gnames, tup))})
+        return out
+
+
+def _orderable(v, asc: bool):
+    if v is None:
+        return _Rev(0) if not asc else 0
+    try:
+        if isinstance(v, float) and v != v:
+            v = float("inf")  # NaN sorts greatest
+    except TypeError:
+        pass
+    return v if asc else _Rev(v)
+
+
+class _Rev:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        if self.v is None or other.v is None:
+            return False
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def _oracle_agg(fn: str, vals: List[Any], has_children: bool):
+    nn = [v for v in vals if v is not None]
+    if fn == "count":
+        return len(nn) if has_children else len(vals)
+    if fn == "sum":
+        return sum(nn) if nn else None
+    if fn == "min":
+        return min(nn) if nn else None
+    if fn == "max":
+        return max(nn) if nn else None
+    if fn == "avg":
+        return (float(sum(nn)) / len(nn)) if nn else None
+    if fn == "first":
+        return vals[0] if vals else None
+    if fn == "first_ignores_null":
+        return nn[0] if nn else None
+    if fn == "collect_list":
+        return nn
+    if fn == "collect_set":
+        seen, out = set(), []
+        for v in nn:
+            if repr(v) not in seen:
+                seen.add(repr(v))
+                out.append(v)
+        return out
+    raise NotImplementedError(fn)
+
+
+def _oracle_window(wf, spk, sok, sorted_args, order_by):
+    nrows = len(spk)
+    vals: List[Any] = [None] * nrows
+    # group rows by partition key
+    parts: Dict[tuple, List[int]] = defaultdict(list)
+    for i in range(nrows):
+        parts[tuple((v is None, str(v)) for v in spk[i])].append(i)
+    for idxs in parts.values():
+        for pos, i in enumerate(idxs):
+            if wf.fn == "row_number":
+                vals[i] = pos + 1
+            elif wf.fn in ("rank", "dense_rank", "percent_rank", "cume_dist"):
+                same = [p for p in range(len(idxs))
+                        if sok[idxs[p]] == sok[i]]
+                first = min(same)
+                if wf.fn == "rank":
+                    vals[i] = first + 1
+                elif wf.fn == "dense_rank":
+                    distinct_before = len({str(sok[idxs[p]])
+                                           for p in range(first)})
+                    vals[i] = distinct_before + 1
+                elif wf.fn == "percent_rank":
+                    vals[i] = (first) / (len(idxs) - 1) if len(idxs) > 1 \
+                        else 0.0
+                else:
+                    vals[i] = (max(same) + 1) / len(idxs)
+            elif wf.fn in ("lead", "lag"):
+                k = int(wf.args[1].value) if len(wf.args) > 1 else 1
+                default = wf.args[2].value if len(wf.args) > 2 else None
+                tgt = pos + (k if wf.fn == "lead" else -k)
+                vals[i] = sorted_args[0][idxs[tgt]] \
+                    if 0 <= tgt < len(idxs) else default
+            elif wf.fn in ("first_value",):
+                vals[i] = sorted_args[0][idxs[0]]
+            elif wf.fn == "last_value":
+                # spark default RANGE frame: last peer's value
+                peers = [p for p in range(len(idxs)) if sok[idxs[p]] == sok[i]]
+                vals[i] = sorted_args[0][idxs[max(peers)]]
+            elif wf.fn in ("nth_value",):
+                nth = int(wf.args[1].value) if len(wf.args) > 1 else 1
+                vals[i] = sorted_args[0][idxs[nth - 1]] \
+                    if nth - 1 <= pos and nth - 1 < len(idxs) else None
+            elif wf.fn == "agg":
+                if order_by:
+                    # RANGE frame: include all peer rows of the current key
+                    peers = [p for p in range(len(idxs))
+                             if sok[idxs[p]] == sok[i]]
+                    frame = idxs[:max(peers) + 1]
+                else:
+                    frame = idxs
+                fvals = [sorted_args[-1][j] for j in frame]
+                vals[i] = _oracle_agg(wf.agg.fn, fvals,
+                                      bool(wf.agg.children))
+                if wf.agg.fn == "count" and not wf.agg.children:
+                    vals[i] = len(frame)
+            else:
+                raise NotImplementedError(wf.fn)
+    return vals
