@@ -1,0 +1,25 @@
+"""Distributed execution over a jax.sharding.Mesh.
+
+The reference's distribution is Spark tasks + shuffle files/RSS push
+(SURVEY §2.5); the TPU-native equivalent keeps the same logical exchanges
+but rides ICI/DCN collectives inside SPMD programs:
+
+- hash/round-robin/range repartition  -> lax.all_to_all (quota-based
+  fixed-size blocks, shapes static)
+- broadcast exchange / BHJ build side -> lax.all_gather
+- global aggregates / metrics         -> lax.psum
+
+`spmd.py` builds a fully jitted SPMD "query step" (filter -> project ->
+exchange -> aggregate -> broadcast-join probe) over the mesh; `mesh.py`
+holds mesh construction helpers; `exchange.py` the collective repartition
+kernels.  Multi-host meshes compose the same way (jax initializes the
+global mesh across hosts; collectives cross DCN transparently).
+"""
+
+from auron_tpu.parallel.mesh import data_mesh, device_count
+from auron_tpu.parallel.exchange import (
+    all_to_all_repartition, broadcast_all_gather,
+)
+
+__all__ = ["data_mesh", "device_count", "all_to_all_repartition",
+           "broadcast_all_gather"]

@@ -1,0 +1,88 @@
+"""Collective exchange kernels (called INSIDE shard_map bodies).
+
+The shuffle redesign: where the reference writes per-partition sorted runs
+to files fetched by the next stage (sort_repartitioner.rs + Spark block
+store), an SPMD stage reshuffles rows in-flight with lax.all_to_all.
+
+Shapes must be static, so the exchange uses a fixed per-destination quota
+Q: each device scatters its rows into an [N, Q] send buffer grouped by
+destination, all_to_all swaps blocks, and receivers compact the valid rows.
+Rows beyond quota would overflow — callers size Q = local capacity (safe
+upper bound: a device cannot send more rows than it holds) or run multiple
+rounds for skewed data.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _scatter_to_send(data, dest, valid, n_dev: int, quota: int):
+    """data: [C, ...] row-major payload; dest int32 [C]; -> [N, Q, ...]."""
+    cap = dest.shape[0]
+    safe_dest = jnp.where(valid, dest, n_dev)          # invalid -> dropped
+    # within-destination slot: stable rank of each row among its dest group
+    order = jnp.argsort(safe_dest, stable=True)        # groups by dest
+    sorted_dest = jnp.take(safe_dest, order)
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    # start offset of each dest group in sorted order
+    is_start = jnp.concatenate([jnp.ones(1, bool),
+                                sorted_dest[1:] != sorted_dest[:-1]])
+    group_start = lax.cummax(jnp.where(is_start, idx, -1))
+    slot_sorted = idx - group_start
+    # scatter into [N*Q] flat send buffer
+    flat_pos = sorted_dest * quota + jnp.minimum(slot_sorted, quota - 1)
+    ok = jnp.logical_and(sorted_dest < n_dev, slot_sorted < quota)
+    flat_pos = jnp.where(ok, flat_pos, n_dev * quota)  # spill to scratch row
+    payload = jnp.take(data, order, axis=0)
+    out_shape = (n_dev * quota + quota,) + data.shape[1:]
+    send = jnp.zeros(out_shape, data.dtype)
+    send = send.at[flat_pos].set(payload, mode="drop")
+    send_valid = jnp.zeros(n_dev * quota + quota, bool)
+    send_valid = send_valid.at[flat_pos].set(ok, mode="drop")
+    send = send[:n_dev * quota].reshape((n_dev, quota) + data.shape[1:])
+    send_valid = send_valid[:n_dev * quota].reshape(n_dev, quota)
+    return send, send_valid
+
+
+def all_to_all_repartition(arrays: List[Any], dest, valid, axis: str,
+                           n_dev: int, quota: int
+                           ) -> Tuple[List[Any], Any]:
+    """Repartition rows of `arrays` (each [C, ...]) by `dest` device ids.
+
+    Returns (received_arrays each [N*Q, ...], received_valid [N*Q]).
+    Must run inside shard_map with named axis `axis`.
+    """
+    outs = []
+    recv_valid = None
+    for a in arrays:
+        send, send_valid = _scatter_to_send(a, dest, valid, n_dev, quota)
+        recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+        outs.append(recv.reshape((n_dev * quota,) + a.shape[1:]))
+        if recv_valid is None:
+            rv = lax.all_to_all(send_valid, axis, split_axis=0,
+                                concat_axis=0, tiled=False)
+            recv_valid = rv.reshape(n_dev * quota)
+    return outs, recv_valid
+
+
+def broadcast_all_gather(arrays: List[Any], valid, axis: str
+                         ) -> Tuple[List[Any], Any]:
+    """Broadcast exchange: every device receives every device's rows
+    (the BHJ build-side path: one all_gather instead of TorrentBroadcast).
+    arrays: [C, ...] -> [N*C, ...]."""
+    outs = []
+    for a in arrays:
+        g = lax.all_gather(a, axis, axis=0, tiled=False)
+        outs.append(g.reshape((-1,) + a.shape[1:]))
+    gv = lax.all_gather(valid, axis, axis=0, tiled=False).reshape(-1)
+    return outs, gv
+
+
+def global_sum(x, axis: str):
+    return lax.psum(x, axis)

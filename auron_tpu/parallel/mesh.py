@@ -1,0 +1,36 @@
+"""Mesh helpers.
+
+Queries are data-parallel over partitions, so the default mesh is 1-D
+("parts").  Joins/aggregations that want a 2-D layout (partition x replica
+for broadcast reuse) can build ("parts", "replica") meshes the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def data_mesh(n: Optional[int] = None, axis: str = "parts") -> Mesh:
+    devs = jax.devices()
+    n = n or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def grid_mesh(parts: int, replicas: int,
+              axes: Sequence[str] = ("parts", "replica")) -> Mesh:
+    devs = jax.devices()
+    need = parts * replicas
+    if need > len(devs):
+        raise ValueError(f"requested {need} devices, have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(parts, replicas)
+    return Mesh(arr, tuple(axes))
