@@ -49,7 +49,9 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(path)
             _configure(lib)
             _LIB = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale .so from an older ABI lingers (the
+            # file is gitignored) — fall back rather than crash
             _LIB = None
         return _LIB
 
@@ -72,6 +74,14 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.auron_murmur3_hash_i64.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+    lib.auron_xxhash64_i64.restype = None
+    lib.auron_xxhash64_i64.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    lib.auron_partition_sort.restype = None
+    lib.auron_partition_sort.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
 
 
 def available() -> bool:
@@ -139,8 +149,15 @@ def murmur3_32(data: bytes, seed: int = 42) -> int:
     if lib is not None:
         buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
         return int(lib.auron_murmur3_x86_32(buf, len(data),
-                                            np.int32(seed)))
+                                            _i32(seed)))
     return _py_murmur3_32(data, seed)
+
+
+def _i32(seed: int) -> int:
+    """Wrap a python int to signed int32 (callers may pass the previous
+    hash's unsigned value when chaining column hashes, spark-style)."""
+    seed &= 0xFFFFFFFF
+    return seed - 2**32 if seed >= 2**31 else seed
 
 
 def murmur3_hash_i64_array(values: np.ndarray, seed: int = 42) -> np.ndarray:
@@ -152,11 +169,59 @@ def murmur3_hash_i64_array(values: np.ndarray, seed: int = 42) -> np.ndarray:
     if lib is not None and len(values):
         lib.auron_murmur3_hash_i64(
             values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(values),
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), np.int32(seed))
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), _i32(seed))
         return out
     for i, v in enumerate(values):
         out[i] = _py_murmur3_32(int(v).to_bytes(8, "little", signed=True), seed)
     return out
+
+
+def xxhash64_i64_array(values: np.ndarray, seed: int = 42) -> np.ndarray:
+    """Vectorized spark xxhash64 over int64 values (8-byte LE encoding)."""
+    lib = _load()
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    out = np.empty(len(values), dtype=np.int64)
+    if lib is not None and len(values):
+        lib.auron_xxhash64_i64(
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(values),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64((seed & _M64) - (2**64 if (seed & _M64) >= 2**63
+                                            else 0)))
+        return out
+    for i, v in enumerate(values):
+        h = _py_xxhash64(int(v).to_bytes(8, "little", signed=True), seed)
+        out[i] = np.uint64(h).astype(np.int64)
+    return out
+
+
+def partition_sort(pids: np.ndarray, num_parts: int):
+    """Stable counting sort of row indices by partition id (reference
+    rdx_sort.rs / buffered_data.rs:285 analogue).
+
+    Returns (perm int64[n], offsets int64[num_parts+1]): rows of partition p
+    are perm[offsets[p]:offsets[p+1]], in original order.
+    """
+    pids = np.ascontiguousarray(pids, dtype=np.int32)
+    n = len(pids)
+    if n and (pids.min() < 0 or pids.max() >= num_parts):
+        raise ValueError(
+            f"partition id out of range [0, {num_parts}): "
+            f"min={pids.min()}, max={pids.max()}")
+    offsets = np.empty(num_parts + 1, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        perm = np.empty(n, dtype=np.int64)
+        lib.auron_partition_sort(
+            pids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+            np.int32(num_parts),
+            perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return perm, offsets
+    perm = np.argsort(pids, kind="stable").astype(np.int64)
+    counts = np.bincount(pids, minlength=num_parts)
+    offsets[0] = 0
+    np.cumsum(counts, out=offsets[1:])
+    return perm, offsets
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,223 @@
+// auron_tpu C++ host runtime — the native-code half of the framework.
+//
+// Role analogue: where the reference keeps its host-side hot loops in Rust
+// (native-engine/datafusion-ext-commons: spark_hash.rs xxhash64/murmur3,
+// io/ipc_compression.rs codec path, algorithm/rdx_sort.rs), this library
+// provides the same primitives for the TPU build's host runtime: the JAX/XLA
+// device path does the columnar math, and this .so does the byte-level work
+// that stays on the host — shuffle/spill compression, spark-compatible
+// hashing of encoded rows, and partition-id radix grouping.
+//
+// ABI is C (ctypes-friendly); see auron_tpu/native/bindings.py.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// zlib codec (spill files / "zlib" shuffle codec)
+// ---------------------------------------------------------------------------
+
+size_t auron_zlib_compress_bound(size_t n) { return compressBound(n); }
+
+// returns bytes written, or -1 on error
+ptrdiff_t auron_zlib_compress(const uint8_t* src, size_t src_len, uint8_t* dst,
+                              size_t dst_cap, int level) {
+  uLongf out_len = static_cast<uLongf>(dst_cap);
+  int rc = compress2(dst, &out_len, src, static_cast<uLong>(src_len), level);
+  if (rc != Z_OK) return -1;
+  return static_cast<ptrdiff_t>(out_len);
+}
+
+// returns bytes written, or -1 on error
+ptrdiff_t auron_zlib_decompress(const uint8_t* src, size_t src_len,
+                                uint8_t* dst, size_t dst_cap) {
+  uLongf out_len = static_cast<uLongf>(dst_cap);
+  int rc = uncompress(dst, &out_len, src, static_cast<uLong>(src_len));
+  if (rc != Z_OK) return -1;
+  return static_cast<ptrdiff_t>(out_len);
+}
+
+// ---------------------------------------------------------------------------
+// xxhash64 (spark-compatible; reference spark_hash.rs / XXH64 spec)
+// ---------------------------------------------------------------------------
+
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86/arm)
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint64_t xxh64_round(uint64_t acc, uint64_t lane) {
+  acc += lane * P2;
+  acc = rotl64(acc, 31);
+  return acc * P1;
+}
+
+uint64_t auron_xxhash64(const uint8_t* data, size_t n, uint64_t seed) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + n;
+  uint64_t h;
+  if (n >= 32) {
+    uint64_t v1 = seed + P1 + P2;
+    uint64_t v2 = seed + P2;
+    uint64_t v3 = seed;
+    uint64_t v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = xxh64_round(v1, read64(p));
+      v2 = xxh64_round(v2, read64(p + 8));
+      v3 = xxh64_round(v3, read64(p + 16));
+      v4 = xxh64_round(v4, read64(p + 24));
+      p += 32;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    for (uint64_t v : {v1, v2, v3, v4}) {
+      h ^= xxh64_round(0, v);
+      h = h * P1 + P4;
+    }
+  } else {
+    h = seed + P5;
+  }
+  h += static_cast<uint64_t>(n);
+  while (p + 8 <= end) {
+    h ^= xxh64_round(0, read64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= static_cast<uint64_t>(read32(p)) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= static_cast<uint64_t>(*p) * P5;
+    h = rotl64(h, 11) * P1;
+    ++p;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// murmur3_x86_32, spark variant: tail bytes are sign-extended and each mixed
+// as a full block (reference shuffle/mod.rs:164-189 seed 42 partitioning)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mm3_mix_k(uint32_t k) {
+  k *= 0xCC9E2D51u;
+  k = rotl32(k, 15);
+  k *= 0x1B873593u;
+  return k;
+}
+
+static inline uint32_t mm3_mix_h(uint32_t h, uint32_t k) {
+  h ^= k;
+  h = rotl32(h, 13);
+  return h * 5u + 0xE6546B64u;
+}
+
+static inline int32_t mm3_fmix(uint32_t h, uint32_t len) {
+  h ^= len;
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return static_cast<int32_t>(h);
+}
+
+int32_t auron_murmur3_x86_32(const uint8_t* data, size_t n, int32_t seed) {
+  uint32_t h = static_cast<uint32_t>(seed);
+  size_t nblocks = n / 4;
+  for (size_t i = 0; i < nblocks; ++i) {
+    h = mm3_mix_h(h, mm3_mix_k(read32(data + 4 * i)));
+  }
+  for (size_t i = 4 * nblocks; i < n; ++i) {
+    // spark treats each tail byte as a sign-extended int and mixes fully
+    int32_t b = static_cast<int8_t>(data[i]);
+    h = mm3_mix_h(h, mm3_mix_k(static_cast<uint32_t>(b)));
+  }
+  return mm3_fmix(h, static_cast<uint32_t>(n));
+}
+
+// vectorized spark murmur3 over i64 values (8-byte LE = 2 blocks, no tail)
+void auron_murmur3_hash_i64(const int64_t* vals, size_t n, int32_t* out,
+                            int32_t seed) {
+  for (size_t i = 0; i < n; ++i) {
+    uint64_t v = static_cast<uint64_t>(vals[i]);
+    uint32_t h = static_cast<uint32_t>(seed);
+    h = mm3_mix_h(h, mm3_mix_k(static_cast<uint32_t>(v)));
+    h = mm3_mix_h(h, mm3_mix_k(static_cast<uint32_t>(v >> 32)));
+    out[i] = mm3_fmix(h, 8u);
+  }
+}
+
+// vectorized xxhash64 over i64 values (8-byte LE encoding)
+void auron_xxhash64_i64(const int64_t* vals, size_t n, int64_t* out,
+                        int64_t seed) {
+  for (size_t i = 0; i < n; ++i) {
+    uint64_t lane = static_cast<uint64_t>(vals[i]);
+    uint64_t h = static_cast<uint64_t>(seed) + P5 + 8u;
+    h ^= xxh64_round(0, lane);
+    h = rotl64(h, 27) * P1 + P4;
+    h ^= h >> 33;
+    h *= P2;
+    h ^= h >> 29;
+    h *= P3;
+    h ^= h >> 32;
+    out[i] = static_cast<int64_t>(h);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// partition-id counting sort (reference algorithm/rdx_sort.rs +
+// buffered_data.rs:285: radix-sort rows by partition id).  Produces a stable
+// permutation grouping row indices by partition id plus per-partition
+// offsets; the shuffle writer slices rows with it.
+// ---------------------------------------------------------------------------
+
+// pids: n partition ids in [0, num_parts); perm: out n row indices grouped
+// stably by pid; offsets: out num_parts+1 boundaries into perm.
+void auron_partition_sort(const int32_t* pids, size_t n, int32_t num_parts,
+                          int64_t* perm, int64_t* offsets) {
+  std::vector<int64_t> counts(static_cast<size_t>(num_parts) + 1, 0);
+  for (size_t i = 0; i < n; ++i) counts[static_cast<size_t>(pids[i]) + 1]++;
+  for (int32_t p = 0; p < num_parts; ++p) counts[p + 1] += counts[p];
+  std::copy(counts.begin(), counts.end(), offsets);
+  std::vector<int64_t> cursor(counts.begin(), counts.end() - 1);
+  for (size_t i = 0; i < n; ++i) {
+    perm[cursor[pids[i]]++] = static_cast<int64_t>(i);
+  }
+}
+
+}  // extern "C"

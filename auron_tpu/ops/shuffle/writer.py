@@ -23,11 +23,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from auron_tpu.columnar import serde as batch_serde
-from auron_tpu.columnar.batch import Batch
+from auron_tpu.columnar.batch import Batch, bucket_capacity
+from auron_tpu.native import bindings
 from auron_tpu.ir.plan import Partitioning
 from auron_tpu.ir.schema import DataType, Field, Schema
 from auron_tpu.memmgr import MemConsumer, SpillManager, get_manager
-from auron_tpu.ops.base import Operator, TaskContext, compact_indices
+from auron_tpu.ops.base import Operator, TaskContext
 from auron_tpu.ops.shuffle.partitioner import PartitionIdComputer
 
 
@@ -99,8 +100,17 @@ class _ShuffleWriterBase(Operator):
         self._computer = PartitionIdComputer(partitioning, child.schema)
 
     def _partitioned_stream(self, ctx: TaskContext):
-        """Yields (pid, sub_batch) pairs per input batch."""
+        """Yields (pid, sub_batch) pairs per input batch.
+
+        Grouping strategy (reference buffered_data.rs:285 radix sort): pull
+        the partition-id vector to host once per batch, run the C++ counting
+        sort (native/host_runtime.cpp auron_partition_sort; numpy fallback),
+        then issue exactly one device gather per non-empty partition with a
+        right-sized index buffer — instead of one full-capacity mask
+        compaction per *declared* partition.
+        """
         import time
+
         row_start = 0
         n = self.partitioning.num_partitions
         for b in self.child_stream(ctx):
@@ -110,19 +120,17 @@ class _ShuffleWriterBase(Operator):
             pids = self._computer(b, partition_id=ctx.partition_id,
                                   row_start=row_start)
             row_start += b.num_rows
-            live = b.row_mask()
-            # device-side grouping: one compaction per non-empty partition
-            present = np.unique(np.asarray(
-                jnp.where(live, pids, -1))).tolist()
-            for pid in present:
-                if pid < 0:
+            host_pids = np.asarray(pids)[:b.num_rows].astype(np.int32)
+            perm, offsets = bindings.partition_sort(host_pids, n)
+            for pid in range(n):
+                lo, hi = int(offsets[pid]), int(offsets[pid + 1])
+                if hi == lo:
                     continue
-                mask = jnp.logical_and(pids == pid, live)
-                idx, cnt = compact_indices(mask, b.capacity)
-                c = int(cnt)
-                if c == 0:
-                    continue
-                yield int(pid), b.gather(idx, c)
+                c = hi - lo
+                cap = bucket_capacity(c)
+                idx = np.zeros(cap, dtype=np.int64)
+                idx[:c] = perm[lo:hi]
+                yield pid, b.gather(jnp.asarray(idx), c)
             self.metrics.add("shuffle_write_time_ns",
                              time.perf_counter_ns() - t0)
             self.metrics.add("shuffle_write_rows", b.num_rows)

@@ -1,0 +1,146 @@
+"""C++ host runtime vs python-fallback equivalence.
+
+The native library (auron_tpu/native/host_runtime.cpp) must agree bit-for-bit
+with the pure-python reference implementations in bindings.py — the same
+contract the reference enforces between its Rust spark_hash.rs and Spark's
+own Murmur3_x86_32/XXH64 (datafusion-ext-commons/src/spark_hash.rs tests).
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from auron_tpu.native import bindings
+
+
+requires_native = pytest.mark.skipif(not bindings.available(),
+                                     reason="native toolchain unavailable")
+
+
+@requires_native
+def test_zlib_roundtrip_and_interop():
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 100, 10_000, 1_000_000):
+        payload = rng.integers(0, 8, n, dtype=np.uint8).tobytes()
+        comp = bindings.zlib_compress(payload, level=4)
+        assert bindings.zlib_decompress(comp, len(payload)) == payload
+        # interop both directions with python zlib
+        assert zlib.decompress(comp) == payload
+        assert bindings.zlib_decompress(zlib.compress(payload, 6),
+                                        len(payload)) == payload
+
+
+@requires_native
+def test_xxhash64_matches_python():
+    rng = np.random.default_rng(1)
+    for n in (0, 1, 3, 4, 7, 8, 15, 31, 32, 33, 63, 64, 100, 1000):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        for seed in (0, 42, 2**63, 2**64 - 1):
+            assert bindings.xxhash64(data, seed) == \
+                bindings._py_xxhash64(data, seed), (n, seed)
+
+
+@requires_native
+def test_murmur3_matches_python():
+    rng = np.random.default_rng(2)
+    for n in (0, 1, 2, 3, 4, 5, 7, 8, 9, 16, 33, 100):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        for seed in (42, 0, -1, 12345):
+            assert bindings.murmur3_32(data, seed) == \
+                bindings._py_murmur3_32(data, seed), (n, seed)
+
+
+@requires_native
+def test_murmur3_i64_array_matches_scalar():
+    rng = np.random.default_rng(3)
+    vals = rng.integers(-2**62, 2**62, 1000, dtype=np.int64)
+    out = bindings.murmur3_hash_i64_array(vals, seed=42)
+    for i in (0, 1, 17, 999):
+        expect = bindings._py_murmur3_32(
+            int(vals[i]).to_bytes(8, "little", signed=True), 42)
+        assert out[i] == expect
+
+
+@requires_native
+def test_xxhash64_i64_array_matches_scalar():
+    rng = np.random.default_rng(4)
+    vals = rng.integers(-2**62, 2**62, 500, dtype=np.int64)
+    out = bindings.xxhash64_i64_array(vals, seed=42)
+    for i in (0, 3, 250, 499):
+        expect = bindings._py_xxhash64(
+            int(vals[i]).to_bytes(8, "little", signed=True), 42)
+        assert np.uint64(out[i].view(np.uint64) if hasattr(out[i], "view")
+                         else out[i]) == np.uint64(expect)
+
+
+def test_xxhash64_i64_array_fallback_agrees():
+    # fallback path (force by computing directly) must agree with native
+    rng = np.random.default_rng(5)
+    vals = rng.integers(-2**30, 2**30, 64, dtype=np.int64)
+    native = bindings.xxhash64_i64_array(vals, seed=7)
+    py = np.array([
+        np.uint64(bindings._py_xxhash64(
+            int(v).to_bytes(8, "little", signed=True), 7)).astype(np.int64)
+        for v in vals], dtype=np.int64)
+    np.testing.assert_array_equal(native, py)
+
+
+@pytest.fixture
+def no_native(monkeypatch):
+    """Force the pure-python fallback paths regardless of toolchain."""
+    monkeypatch.setattr(bindings, "_LIB", None)
+    monkeypatch.setattr(bindings, "_LIB_TRIED", True)
+
+
+def test_xxhash64_i64_array_fallback_branch(no_native):
+    rng = np.random.default_rng(8)
+    vals = rng.integers(-2**30, 2**30, 64, dtype=np.int64)
+    py = bindings.xxhash64_i64_array(vals, seed=7)
+    expect = np.array([
+        np.uint64(bindings._py_xxhash64(
+            int(v).to_bytes(8, "little", signed=True), 7)).astype(np.int64)
+        for v in vals], dtype=np.int64)
+    np.testing.assert_array_equal(py, expect)
+
+
+def test_partition_sort_fallback_branch(no_native):
+    rng = np.random.default_rng(9)
+    pids = rng.integers(0, 11, 500).astype(np.int32)
+    perm, offsets = bindings.partition_sort(pids, 11)
+    assert offsets[0] == 0 and offsets[-1] == 500
+    for p in range(11):
+        rows = perm[offsets[p]:offsets[p + 1]]
+        assert (pids[rows] == p).all()
+        if len(rows) > 1:
+            assert (np.diff(rows) > 0).all()
+
+
+def test_partition_sort_rejects_out_of_range():
+    with pytest.raises(ValueError):
+        bindings.partition_sort(np.array([0, 3], np.int32), 3)
+    with pytest.raises(ValueError):
+        bindings.partition_sort(np.array([-1, 0], np.int32), 3)
+
+
+def test_partition_sort_stable_grouping():
+    rng = np.random.default_rng(6)
+    n, parts = 10_000, 37
+    pids = rng.integers(0, parts, n).astype(np.int32)
+    perm, offsets = bindings.partition_sort(pids, parts)
+    assert offsets[0] == 0 and offsets[-1] == n
+    for p in range(parts):
+        rows = perm[offsets[p]:offsets[p + 1]]
+        assert (pids[rows] == p).all()
+        # stability: original order preserved within a partition
+        assert (np.diff(rows) > 0).all() if len(rows) > 1 else True
+    # empty partitions allowed
+    perm2, off2 = bindings.partition_sort(np.array([], np.int32), 4)
+    assert len(perm2) == 0 and list(off2) == [0, 0, 0, 0, 0]
+
+
+def test_partition_sort_single_partition():
+    pids = np.zeros(100, np.int32)
+    perm, offsets = bindings.partition_sort(pids, 1)
+    np.testing.assert_array_equal(perm, np.arange(100))
+    assert list(offsets) == [0, 100]
