@@ -245,6 +245,17 @@ NATIVE_LIB_ENABLE = conf.define(
     "Use the C++ host runtime (libauron_host.so) when built; pure-python "
     "fallbacks are used otherwise.",
 )
+SORTED_SEGMENTS = conf.define(
+    "auron.segments.sorted.enable", True,
+    "Reduce sorted segment ids with gather-shaped cumulative kernels "
+    "instead of XLA scatter-add (ops/segments.py); off = "
+    "jax.ops.segment_* scatter path.",
+)
+PALLAS_ENABLE = conf.define(
+    "auron.pallas.enable", True,
+    "Use Pallas TPU kernels for hot device ops (hash partition ids); "
+    "falls back to plain XLA ops off-TPU or when disabled.",
+)
 STRING_WIDTH_BUCKETS = conf.define(
     "auron.string.width.buckets", "8,16,32,64,128,256",
     "Fixed string byte-widths used for device string columns.",

@@ -11,8 +11,9 @@ Each AggSpec defines:
 - update_segments(vals, seg_ids, num_segments): input values -> states
 - merge_segments(states, seg_ids, num_segments): partial states -> states
 - eval_final(states): states -> result column
-Device specs use jax.ops.segment_* ; host specs (collect/udaf/bloom) run in
-python over arrow values.
+Device specs reduce with ops/segments.py sorted-segment kernels — seg ids
+MUST be ascending (AggExec lexsorts before reducing); host specs
+(collect/udaf/bloom) run in python over arrow values.
 """
 
 from __future__ import annotations
@@ -27,18 +28,21 @@ import numpy as np
 from auron_tpu.columnar.batch import DeviceColumn, DeviceStringColumn
 from auron_tpu.exprs.values import flat
 from auron_tpu.ir.schema import DataType, Field, TypeId
+from auron_tpu.ops import segments
 
 
 def _seg_sum(x, seg, n):
-    return jax.ops.segment_sum(x, seg, num_segments=n)
+    # seg ids arrive sorted (AggExec lexsorts before reducing) — use the
+    # gather-shaped kernels instead of scatter-add (ops/segments.py)
+    return segments.sorted_segment_sum(x, seg, n)
 
 
 def _seg_min(x, seg, n):
-    return jax.ops.segment_min(x, seg, num_segments=n)
+    return segments.sorted_segment_min(x, seg, n)
 
 
 def _seg_max(x, seg, n):
-    return jax.ops.segment_max(x, seg, num_segments=n)
+    return segments.sorted_segment_max(x, seg, n)
 
 
 class AggSpec:

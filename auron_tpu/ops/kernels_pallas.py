@@ -1,0 +1,98 @@
+"""Pallas TPU kernels for hot device ops.
+
+First kernel: fused spark-murmur3 + pmod partition-id computation for the
+single-int64-key hash repartition (the dominant exchange pattern; reference
+semantics shuffle/mod.rs:164-189, seed 42).  The whole hash→pid chain runs
+in one VMEM pass per row tile instead of a chain of XLA elementwise HLOs.
+
+TPU constraints honored:
+- all arithmetic is uint32 (the VPU is 32-bit; int64 keys are bitcast to
+  (lo, hi) u32 pairs before entering the kernel);
+- rows are viewed as (rows/128, 128) lanes, gridded over row tiles;
+- off-TPU the public entry falls back to the jnp implementation
+  (exprs/hashing.py) — interpret mode is for tests only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from auron_tpu.config import conf
+# reuse the exact jnp murmur3 primitives — bit-parity between this kernel
+# and the fallback path is load-bearing (supported() picks per batch)
+from auron_tpu.exprs.hashing import _fmix, _mix_h1, _mix_k1
+
+_SEED = np.uint32(42)
+
+_LANES = 128
+_MAX_TILE_ROWS = 256  # (256, 128) u32 tiles: 128KB/input in VMEM
+
+
+def _pid_kernel(lo_ref, hi_ref, valid_ref, out_ref, *, n_parts: int):
+    lo = lo_ref[:]
+    hi = hi_ref[:]
+    v = valid_ref[:]
+    h = _mix_h1(jnp.full_like(lo, _SEED), _mix_k1(lo))
+    h = _mix_h1(h, _mix_k1(hi))
+    h = _fmix(h, 8)
+    # null key: hash stays the seed (spark skips null columns)
+    h = jnp.where(v != 0, h, jnp.full_like(h, _SEED))
+    hs = h.astype(jnp.int32)
+    # jnp % on int32 is floor-mod => already non-negative for n_parts > 0
+    out_ref[:] = hs % np.int32(n_parts)
+
+
+def supported(keys, platform: str | None = None) -> bool:
+    """Is the pallas fast path applicable to these evaluated key columns?"""
+    if not bool(conf.get("auron.pallas.enable")):
+        return False
+    platform = platform or jax.default_backend()
+    if platform != "tpu":
+        return False
+    if len(keys) != 1:
+        return False
+    c = keys[0]
+    from auron_tpu.columnar.batch import DeviceColumn
+    if not isinstance(c, DeviceColumn):
+        return False
+    from auron_tpu.ir.schema import TypeId
+    if c.dtype.id not in (TypeId.INT64, TypeId.TIMESTAMP_US):
+        return False
+    return c.data.shape[0] % _LANES == 0
+
+
+@functools.partial(jax.jit, static_argnames=("n_parts", "interpret"))
+def hash_partition_ids_i64(data, validity, n_parts: int,
+                           interpret: bool = False):
+    """pid = pmod(murmur3_spark(int64 key, seed=42), n_parts) as one pallas
+    pass.  data: int64[cap] (cap % 128 == 0), validity: bool[cap]."""
+    cap = data.shape[0]
+    rows = cap // _LANES
+    tile_rows = min(rows, _MAX_TILE_ROWS)
+    while rows % tile_rows:
+        tile_rows -= 1
+    v64 = data.astype(jnp.uint64)
+    lo = (v64 & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (v64 >> np.uint64(32)).astype(jnp.uint32)
+    lo2 = lo.reshape(rows, _LANES)
+    hi2 = hi.reshape(rows, _LANES)
+    va2 = validity.astype(jnp.uint32).reshape(rows, _LANES)
+    grid = (rows // tile_rows,)
+    spec = pl.BlockSpec((tile_rows, _LANES), lambda i: (i, 0))
+    # mosaic rejects i64 index/iota types: trace the kernel in 32-bit mode
+    # (the engine enables x64 globally; all kernel operands are 32-bit)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_pid_kernel, n_parts=n_parts),
+            out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+            grid=grid,
+            in_specs=[spec, spec, spec],
+            out_specs=spec,
+            interpret=interpret,
+        )(lo2, hi2, va2)
+    return out.reshape(cap)

@@ -1,0 +1,101 @@
+"""Sorted-segment reductions without scatter.
+
+The engine's group-by pipeline (agg/exec.py, parallel/spmd.py, window)
+always reduces over SORTED segment ids (they come from a lexsort +
+boundary cumsum).  XLA lowers jax.ops.segment_* to scatter-(add|min|max),
+which serializes badly on TPU; for sorted ids the same reductions are
+expressible with purely gather-shaped ops — cumulative scan along rows,
+then a vectorized binary search for each segment's [start, end) range —
+the TPU-friendly form (reference analogue: Auron leans on radix-sorted
+runs for exactly this reason, agg/agg_table.rs).
+
+- sum:  inclusive cumsum; total(s) = csum[end(s)-1] - csum[start(s)-1].
+  Integer sums are EXACT even if the running cumsum wraps (modular diff);
+  float sums are f64 in SQL semantics, where the cancellation error of
+  differencing is ~ulp(global sum) — covered by the differential-test
+  tolerances.
+- min/max: segmented running min/max via an associative scan with a
+  reset-at-segment-start combine, read at end(s)-1.
+
+All functions take 1-D x and require seg ascending (rows of equal seg
+contiguous).  Callers with possibly-unsorted ids must keep using
+jax.ops.segment_*.  Behavior matches jax.ops.segment_{sum,min,max}
+(empty segments -> 0 / +inf|max / -inf|min).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.config import conf
+
+
+def _use_sorted() -> bool:
+    return bool(conf.get("auron.segments.sorted.enable"))
+
+
+def _segment_ranges(seg, num_segments: int):
+    sids = jnp.arange(num_segments, dtype=seg.dtype)
+    starts = jnp.searchsorted(seg, sids, side="left")
+    ends = jnp.searchsorted(seg, sids, side="right")
+    return starts, ends, ends > starts
+
+
+def sorted_segment_sum(x, seg, num_segments: int):
+    """segment_sum for ascending seg ids (same contract as
+    jax.ops.segment_sum(x, seg, num_segments))."""
+    if x.shape[0] == 0:
+        return jnp.zeros((num_segments,), x.dtype)
+    if not _use_sorted():
+        return jax.ops.segment_sum(x, seg, num_segments=num_segments,
+                                   indices_are_sorted=True)
+    csum = jnp.cumsum(x)
+    starts, ends, nonempty = _segment_ranges(seg, num_segments)
+    upper = jnp.take(csum, jnp.clip(ends - 1, 0), mode="clip")
+    lower = jnp.where(starts > 0,
+                      jnp.take(csum, jnp.clip(starts - 1, 0), mode="clip"),
+                      jnp.zeros((), x.dtype))
+    return jnp.where(nonempty, upper - lower, jnp.zeros((), x.dtype))
+
+
+def _segmented_running(x, is_first, op_is_min: bool):
+    """Running min/max that resets at segment starts (segmented scan)."""
+    def combine(a, b):
+        a_flag, a_val = a
+        b_flag, b_val = b
+        merged = jnp.minimum(a_val, b_val) if op_is_min else \
+            jnp.maximum(a_val, b_val)
+        val = jnp.where(b_flag, b_val, merged)
+        return jnp.logical_or(a_flag, b_flag), val
+    _, run = jax.lax.associative_scan(combine, (is_first, x))
+    return run
+
+
+def _extreme_identity(dtype, op_is_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if op_is_min else -jnp.inf
+    info = jnp.iinfo(dtype)
+    return info.max if op_is_min else info.min
+
+
+def _sorted_segment_extreme(x, seg, num_segments: int, op_is_min: bool):
+    fill = _extreme_identity(x.dtype, op_is_min)
+    if x.shape[0] == 0:
+        return jnp.full((num_segments,), fill, x.dtype)
+    if not _use_sorted():
+        f = jax.ops.segment_min if op_is_min else jax.ops.segment_max
+        return f(x, seg, num_segments=num_segments, indices_are_sorted=True)
+    is_first = jnp.concatenate([jnp.ones((1,), bool), seg[1:] != seg[:-1]])
+    run = _segmented_running(x, is_first, op_is_min)
+    starts, ends, nonempty = _segment_ranges(seg, num_segments)
+    at_end = jnp.take(run, jnp.clip(ends - 1, 0), mode="clip")
+    return jnp.where(nonempty, at_end, jnp.asarray(fill, x.dtype))
+
+
+def sorted_segment_min(x, seg, num_segments: int):
+    return _sorted_segment_extreme(x, seg, num_segments, True)
+
+
+def sorted_segment_max(x, seg, num_segments: int):
+    return _sorted_segment_extreme(x, seg, num_segments, False)

@@ -52,6 +52,10 @@ class PartitionIdComputer:
             return ids.astype(jnp.int32)
         if self.mode == "hash":
             keys = self._key_eval(batch, partition_id=partition_id)
+            from auron_tpu.ops import kernels_pallas as KP
+            if KP.supported(keys):
+                return KP.hash_partition_ids_i64(
+                    keys[0].data, keys[0].validity, self.n)
             h = H.hash_columns(keys, seed=42)
             return H.pmod(h, self.n)
         if self.mode == "range":

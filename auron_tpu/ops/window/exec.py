@@ -28,6 +28,7 @@ from auron_tpu.exprs.compiler import build_evaluator
 from auron_tpu.exprs.typing import infer_type
 from auron_tpu.ir.plan import WindowFuncCall, WindowGroupLimit
 from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.ops import segments
 from auron_tpu.ops.base import Operator, TaskContext, batch_size, compact_indices
 from auron_tpu.ops.sort_keys import (
     encode_sort_keys, keys_equal_prev, lexsort_indices,
@@ -110,7 +111,7 @@ class WindowExec(Operator):
         seg_id = jnp.where(live, seg_id, cap - 1)
         # partition sizes + last index
         ones = jnp.where(live, 1, 0)
-        seg_sizes = jax.ops.segment_sum(ones, seg_id, num_segments=cap)
+        seg_sizes = segments.sorted_segment_sum(ones, seg_id, cap)
         part_n = jnp.take(seg_sizes, seg_id)
         seg_end = seg_start + part_n  # exclusive
 
@@ -316,7 +317,7 @@ def _seg_running_sum(x, c):
 def _seg_total(x, c):
     seg = c["seg_id"]
     cap = c["cap"]
-    tot = jax.ops.segment_sum(x, seg, num_segments=cap)
+    tot = segments.sorted_segment_sum(x, seg, cap)
     return jnp.take(tot, seg)
 
 
@@ -339,8 +340,8 @@ def _seg_running_minmax(x, c, is_min: bool):
 def _seg_total_minmax(x, c, is_min: bool):
     seg = c["seg_id"]
     cap = c["cap"]
-    red = jax.ops.segment_min(x, seg, num_segments=cap) if is_min else \
-        jax.ops.segment_max(x, seg, num_segments=cap)
+    red = segments.sorted_segment_min(x, seg, cap) if is_min else \
+        segments.sorted_segment_max(x, seg, cap)
     return jnp.take(red, seg)
 
 

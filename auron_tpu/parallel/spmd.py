@@ -17,6 +17,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from auron_tpu.exprs import hashing as H
+from auron_tpu.ops import segments
 from auron_tpu.parallel.exchange import (
     all_to_all_repartition, broadcast_all_gather, global_sum,
 )
@@ -99,10 +100,10 @@ def local_group_aggregate(key, value, live, dim_key, dim_val):
         jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]]), slive)
     seg = jnp.where(slive, jnp.cumsum(boundary.astype(jnp.int32)) - 1,
                     cap2 - 1)
-    sums = jax.ops.segment_sum(jnp.where(slive, sv, 0.0), seg,
-                               num_segments=cap2)
-    counts = jax.ops.segment_sum(slive.astype(jnp.int64), seg,
-                                 num_segments=cap2)
+    sums = segments.sorted_segment_sum(jnp.where(slive, sv, 0.0), seg,
+                                       cap2)
+    counts = segments.sorted_segment_sum(slive.astype(jnp.int64), seg,
+                                         cap2)
     first_idx = jnp.nonzero(boundary, size=cap2, fill_value=cap2 - 1)[0]
     gkeys = jnp.where(jnp.arange(cap2) < jnp.sum(boundary),
                       jnp.take(sk, first_idx), -1)

@@ -1,0 +1,88 @@
+"""sorted_segment_* vs jax.ops.segment_* equivalence (fuzzed)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from auron_tpu.ops import segments
+
+
+def _rand_sorted_seg(rng, n, max_segs):
+    seg = np.sort(rng.integers(0, max_segs, n)).astype(np.int32)
+    return jnp.asarray(seg)
+
+
+@pytest.mark.parametrize("n,num_segments", [(0, 4), (1, 1), (17, 5),
+                                            (256, 256), (1000, 37),
+                                            (1000, 2000)])
+def test_sorted_segment_sum_int(n, num_segments):
+    rng = np.random.default_rng(n + num_segments)
+    x = jnp.asarray(rng.integers(-100, 100, n).astype(np.int64))
+    seg = _rand_sorted_seg(rng, n, num_segments)
+    got = segments.sorted_segment_sum(x, seg, num_segments)
+    exp = jax.ops.segment_sum(x, seg, num_segments=num_segments)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(exp))
+
+
+@pytest.mark.parametrize("n,num_segments", [(17, 5), (1000, 37), (4096, 512)])
+def test_sorted_segment_sum_float(n, num_segments):
+    rng = np.random.default_rng(n)
+    x = jnp.asarray(rng.normal(0, 10, n))
+    seg = _rand_sorted_seg(rng, n, num_segments)
+    got = segments.sorted_segment_sum(x, seg, num_segments)
+    exp = jax.ops.segment_sum(x, seg, num_segments=num_segments)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                               rtol=1e-9, atol=1e-7)
+
+
+@pytest.mark.parametrize("op,ref", [
+    (segments.sorted_segment_min, jax.ops.segment_min),
+    (segments.sorted_segment_max, jax.ops.segment_max),
+])
+@pytest.mark.parametrize("dtype", [np.int64, np.float64])
+def test_sorted_segment_extremes(op, ref, dtype):
+    rng = np.random.default_rng(5)
+    n, num_segments = 1000, 64
+    if np.issubdtype(dtype, np.integer):
+        x = jnp.asarray(rng.integers(-1000, 1000, n).astype(dtype))
+    else:
+        x = jnp.asarray(rng.normal(0, 10, n).astype(dtype))
+    seg = _rand_sorted_seg(rng, n, num_segments)
+    got = np.asarray(op(x, seg, num_segments))
+    exp = np.asarray(ref(x, seg, num_segments=num_segments))
+    # compare only non-empty segments: identities differ (inf vs dtype max)
+    present = np.isin(np.arange(num_segments), np.asarray(seg))
+    np.testing.assert_array_equal(got[present], exp[present])
+    # empty segments: our identity convention
+    fill = segments._extreme_identity(x.dtype,
+                                      op is segments.sorted_segment_min)
+    assert (got[~present] == fill).all() or not (~present).any()
+
+
+def test_all_rows_one_segment():
+    x = jnp.arange(100, dtype=jnp.int64)
+    seg = jnp.zeros(100, jnp.int32)
+    assert int(segments.sorted_segment_sum(x, seg, 1)[0]) == 4950
+    assert int(segments.sorted_segment_min(x, seg, 1)[0]) == 0
+    assert int(segments.sorted_segment_max(x, seg, 1)[0]) == 99
+
+
+def test_each_row_own_segment():
+    x = jnp.asarray(np.array([5, -3, 7], np.int64))
+    seg = jnp.asarray(np.array([0, 1, 2], np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(segments.sorted_segment_sum(x, seg, 3)), [5, -3, 7])
+
+
+def test_scatter_fallback_path():
+    from auron_tpu.config import conf
+    old = conf.get("auron.segments.sorted.enable")
+    conf.set("auron.segments.sorted.enable", False)
+    try:
+        x = jnp.arange(10, dtype=jnp.int64)
+        seg = jnp.asarray(np.array([0] * 5 + [2] * 5, np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(segments.sorted_segment_sum(x, seg, 3)), [10, 0, 35])
+    finally:
+        conf.set("auron.segments.sorted.enable", old)
