@@ -39,6 +39,12 @@ class ConfigOption(Generic[T]):
             return raw.strip().lower() in ("1", "true", "yes", "on")  # type: ignore[return-value]
         return self.type(raw)  # type: ignore[call-arg]
 
+    def get(self) -> T:
+        """Current value from the registry this option was registered on
+        (ConfigOption.java defaultValue/env-fallback resolution)."""
+        owner = getattr(self, "_owner", None)
+        return (owner if owner is not None else conf).get(self.key)
+
 
 class Configuration:
     """Mutable view over the registry with env fallback and overrides."""
@@ -53,6 +59,7 @@ class Configuration:
             if option.key in self._options:
                 raise ValueError(f"duplicate config option {option.key!r}")
             self._options[option.key] = option
+        object.__setattr__(option, "_owner", self)  # frozen dataclass
         return option
 
     def define(self, key: str, default: T, doc: str = "", **kw: Any) -> ConfigOption[T]:
@@ -279,6 +286,27 @@ for _op in (
     "rename.columns", "empty.partitions", "debug", "kafka.scan",
 ):
     conf.define(f"auron.enable.{_op}", True, f"Enable native {_op} operator.")
+
+ENABLE = conf.define(
+    "auron.enable", True,
+    "Master switch: when false the front-end session leaves foreign plans "
+    "untouched (reference: spark.auron.enable).",
+)
+DECIMAL_ARITH_ENABLE = conf.define(
+    "auron.decimal.arith.enable", True,
+    "Convert +,-,*,/ over decimals natively (reference "
+    "decimalArithOpEnabled gating, NativeConverters.scala:579-755).",
+)
+CASE_CONVERT_FUNCTIONS_ENABLE = conf.define(
+    "auron.caseconvert.functions.enable", True,
+    "Convert lower()/upper() natively (reference "
+    "CASE_CONVERT_FUNCTIONS_ENABLE; locale-divergence escape hatch).",
+)
+DATETIME_EXTRACT_ENABLE = conf.define(
+    "auron.datetime.extract.enable", True,
+    "Convert hour()/minute()/second() natively (reference "
+    "datetimeExtractEnabled, NativeConverters.scala:980-986).",
+)
 
 SPILL_MIN_TRIGGER = conf.define(
     "auron.memory.spill.min.trigger.bytes", 16 << 20,

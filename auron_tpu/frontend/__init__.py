@@ -1,0 +1,15 @@
+"""Engine front-end: foreign-plan intake, convert strategy, converters,
+session/driver.  The analogue of the reference's L7-L5 JVM layers
+(spark-extension: AuronSparkSessionExtension -> AuronConvertStrategy ->
+AuronConverters -> Native* wrappers + NativeRDD), re-hosted as an
+engine-agnostic python surface over the same plan-IR wire format.
+"""
+
+from auron_tpu.frontend.foreign import (ForeignExpr, ForeignNode, falias,
+                                        fcall, fcol, flit)
+from auron_tpu.frontend.session import AuronSession, SessionResult
+
+__all__ = [
+    "AuronSession", "SessionResult", "ForeignExpr", "ForeignNode",
+    "fcol", "flit", "falias", "fcall",
+]

@@ -1,0 +1,238 @@
+"""Front-end session: strategy + conversion + stage-scheduled execution.
+
+The driver-side glue the reference spreads across
+AuronSparkSessionExtension.scala (rule injection), NativeRDD.scala /
+NativeHelper.scala (per-task native execution), AuronShuffleManager
+(exchange materialization) and NativeBroadcastExchangeBase (broadcast
+collect): `AuronSession.execute` tags a foreign plan, converts the
+convertible sections, then runs the converted tree — native sections
+through the task runtime (stage-by-stage across exchange boundaries via
+the in-process shuffle service), foreign sections through the pluggable
+host engine, with Arrow tables crossing the boundary both ways.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol
+
+import pyarrow as pa
+
+from auron_tpu import config
+from auron_tpu.frontend import converters, strategy
+from auron_tpu.frontend.converters import (
+    BroadcastJob, ConvertContext, ConvertedT, ForeignSource, ForeignWrap,
+    ShuffleJob,
+)
+from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.node import Node
+from auron_tpu.ir.schema import to_arrow_schema
+from auron_tpu.ops.shuffle.writer import InProcessShuffleService
+from auron_tpu.runtime.executor import ExecutionResult, execute_plan
+from auron_tpu.runtime.metrics import MetricNode
+from auron_tpu.runtime.resources import ResourceRegistry
+
+log = logging.getLogger("auron_tpu.frontend")
+
+
+class ForeignEngine(Protocol):
+    """The host engine executing non-converted plan sections (the role
+    Spark itself plays in the reference).  Native child results arrive as
+    Arrow tables."""
+
+    def execute(self, node: ForeignNode, child_tables: List[pa.Table]
+                ) -> pa.Table:
+        ...
+
+
+@dataclass
+class SessionResult:
+    table: pa.Table
+    converted: ConvertedT = None  # type: ignore[assignment]
+    tags: Optional[strategy.Tags] = None
+    metrics: List[MetricNode] = field(default_factory=list)
+
+    def to_pylist(self) -> List[dict]:
+        return self.table.to_pylist()
+
+    def all_native(self) -> bool:
+        """True when no foreign section remains (the
+        checkSparkAnswerAndOperator plan-walk assertion,
+        AuronQueryTest.scala:29-91).  LocalTableScan C2N sources are
+        pass-through, matching the reference's allowance for
+        ConvertToNative inputs."""
+        return not isinstance(self.converted, ForeignWrap) and \
+            getattr(self, "_foreign_sections", 0) == 0
+
+
+class AuronSession:
+    def __init__(self, foreign_engine: Optional[ForeignEngine] = None):
+        self.foreign_engine = foreign_engine
+        self.shuffle_service = InProcessShuffleService()
+        self._metrics: List[MetricNode] = []
+
+    # -- public entry (preColumnarTransitions analogue) -------------------
+
+    def execute(self, plan: ForeignNode) -> SessionResult:
+        if not config.ENABLE.get():
+            return SessionResult(table=self._run_foreign_only(plan))
+        tags = strategy.apply(plan)
+        ctx = ConvertContext()
+        converted = converters.convert_recursively(plan, tags, ctx)
+        self._metrics = []
+        table = self._run_converted(converted, ctx)
+        res = SessionResult(table=table, converted=converted, tags=tags,
+                            metrics=self._metrics)
+        # count foreign sections that needed the host engine (local-table
+        # sources are data, not computation)
+        res._foreign_sections = sum(  # type: ignore[attr-defined]
+            1 for s in ctx.sources.values()
+            if s.node.children or s.node.node.op != "LocalTableScanExec")
+        return res
+
+    # -- foreign-only path (auron.enable=false) ---------------------------
+
+    def _run_foreign_only(self, node: ForeignNode) -> pa.Table:
+        engine = self._require_engine()
+        child_tables = [self._run_foreign_only(c) for c in node.children]
+        return engine.execute(node, child_tables)
+
+    def _require_engine(self) -> ForeignEngine:
+        if self.foreign_engine is None:
+            raise RuntimeError(
+                "plan has non-native sections but no foreign engine is "
+                "attached to this AuronSession")
+        return self.foreign_engine
+
+    # -- converted-tree execution ----------------------------------------
+
+    def _run_converted(self, c: ConvertedT, ctx: ConvertContext) -> pa.Table:
+        if isinstance(c, ForeignWrap):
+            engine = self._require_engine()
+            child_tables = [self._run_converted(ch, ctx)
+                            for ch in c.children]
+            return engine.execute(c.node, child_tables)
+        return self._run_native(c, ctx)
+
+    def _run_native(self, plan: P.PlanNode, ctx: ConvertContext) -> pa.Table:
+        resources = self._materialize_deps(plan, ctx)
+        n_parts = ctx.parts(plan)
+        batches: List[pa.RecordBatch] = []
+        for pid in range(n_parts):
+            res = execute_plan(plan, partition_id=pid, resources=resources,
+                               num_partitions=n_parts)
+            self._metrics.append(res.metrics)
+            batches.extend(res.batches)
+        if not batches:
+            return pa.Table.from_batches(
+                [], schema=to_arrow_schema(plan.schema)) \
+                if getattr(plan, "schema", None) else pa.table({})
+        return pa.Table.from_batches(batches)
+
+    # -- dependency materialization (stage scheduling) --------------------
+
+    def _collect_rids(self, plan: Node, rids: List[str]) -> None:
+        if isinstance(plan, (P.IpcReader, P.FFIReader)):
+            rids.append(plan.resource_id)
+        for c in plan.children_nodes():
+            if isinstance(c, Node):
+                self._collect_rids(c, rids)
+
+    def _materialize_deps(self, plan: P.PlanNode, ctx: ConvertContext
+                          ) -> ResourceRegistry:
+        resources = ResourceRegistry()
+        rids: List[str] = []
+        self._collect_rids(plan, rids)
+        for rid in rids:
+            if rid in ctx.sources:
+                self._materialize_source(ctx.sources[rid], ctx, resources)
+            elif rid in ctx.broadcasts:
+                self._materialize_broadcast(ctx.broadcasts[rid], ctx,
+                                            resources)
+            elif rid in ctx.exchanges:
+                self._materialize_exchange(ctx.exchanges[rid], ctx,
+                                           resources)
+        return resources
+
+    def _materialize_source(self, src: ForeignSource, ctx: ConvertContext,
+                            resources: ResourceRegistry) -> None:
+        """C2N: the foreign engine computes the subtree; its table feeds
+        the FFIReader (ConvertToNativeBase.doExecuteNative analogue)."""
+        is_local_table = (not src.node.children and
+                          src.node.node.op == "LocalTableScanExec")
+        table = self._local_table(src.node.node) if is_local_table \
+            else self._run_converted(src.node, ctx)
+        resources.put(src.rid, table)
+
+    @staticmethod
+    def _local_table(node: ForeignNode) -> pa.Table:
+        schema = to_arrow_schema(node.output)
+        return pa.Table.from_pylist(node.attrs.get("rows", []),
+                                    schema=schema)
+
+    def _materialize_broadcast(self, job: BroadcastJob, ctx: ConvertContext,
+                               resources: ResourceRegistry) -> None:
+        """Broadcast collect: run the build side once (all partitions) and
+        serve the IPC bytes to every probe partition
+        (NativeBroadcastExchangeBase.collectNative:195-230)."""
+        import io
+
+        from auron_tpu.columnar import serde as batch_serde
+        table = self._run_converted(job.child, ctx)
+        sink = io.BytesIO()
+        for rb in table.to_batches():
+            if rb.num_rows:
+                batch_serde.write_one_batch(rb, sink)
+        resources.put(job.rid, sink.getvalue())
+
+    def _materialize_exchange(self, job: ShuffleJob, ctx: ConvertContext,
+                              resources: ResourceRegistry) -> None:
+        """Shuffle: run the map side through RssShuffleWriter into the
+        in-process shuffle service, then register per-reduce block lists
+        (AuronShuffleManager.getWriter/getReader analogue)."""
+        child = job.child
+        if isinstance(child, ForeignWrap):
+            # foreign map side: its table enters native through FFI first
+            table = self._run_converted(child, ctx)
+            rid = f"{job.rid}:ffi"
+            map_plan: P.PlanNode = P.FFIReader(schema=job.schema,
+                                               resource_id=rid)
+            ctx.set_parts(map_plan, 1)
+            extra = {rid: table}
+        else:
+            map_plan, extra = child, {}
+        map_parts = ctx.parts(map_plan)
+        map_deps = self._materialize_deps(map_plan, ctx)
+        for k, v in extra.items():
+            map_deps.put(k, v)
+        for map_pid in range(map_parts):
+            writer_rid = f"{job.rid}:writer:{map_pid}"
+            map_deps.put(writer_rid,
+                         self.shuffle_service.rss_writer(job.rid, map_pid))
+            writer = P.RssShuffleWriter(child=map_plan,
+                                        partitioning=job.partitioning,
+                                        rss_resource_id=writer_rid)
+            res = execute_plan(writer, partition_id=map_pid,
+                               resources=map_deps, num_partitions=map_parts)
+            self._metrics.append(res.metrics)
+        n_reduce = job.partitioning.num_partitions
+        # reduce-side resource: partition-indexed block lists; the task
+        # context picks its partition's list (resources.ResourceRegistry
+        # supports per-partition values via PartitionedResource)
+        resources.put(job.rid, PartitionedBlocks(
+            [self.shuffle_service.reduce_blocks(job.rid, pid)
+             for pid in range(n_reduce)]))
+
+
+class PartitionedBlocks:
+    """Per-reduce-partition block lists behind one resource id."""
+
+    def __init__(self, per_partition: List[List[bytes]]):
+        self.per_partition = per_partition
+
+    def for_partition(self, pid: int) -> List[bytes]:
+        if pid >= len(self.per_partition):
+            return []
+        return self.per_partition[pid]

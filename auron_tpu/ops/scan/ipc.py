@@ -28,6 +28,11 @@ class IpcReaderExec(Operator):
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
         src = ctx.resources.get(self.resource_id)
+        if hasattr(src, "for_partition"):
+            # partition-indexed source (shuffle reduce side): pick this
+            # task's block list (the per-task segment-iterator contract of
+            # AuronBlockStoreShuffleReader.readBlocks)
+            src = src.for_partition(ctx.partition_id)
         import time
         t0 = time.perf_counter_ns()
         n = 0

@@ -1,0 +1,392 @@
+"""Front-end tests: foreign-plan conversion strategy + session execution.
+
+The differential pattern mirrors AuronQueryTest.checkSparkAnswerAndOperator
+(AuronQueryTest.scala:29-91): run the plan once with auron.enable=false
+through the toy foreign engine (the oracle), once through the session, and
+assert (a) identical results, (b) that every operator went native.
+"""
+
+import pickle
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu import config
+from auron_tpu.frontend import (AuronSession, ForeignExpr, ForeignNode,
+                                falias, fcall, fcol, flit)
+from auron_tpu.frontend import strategy
+from auron_tpu.frontend.converters import ForeignWrap
+from auron_tpu.ir.schema import DataType, Field, Schema
+
+I64 = DataType.int64()
+F64 = DataType.float64()
+STR = DataType.string()
+
+
+# ---------------------------------------------------------------------------
+# toy foreign engine: executes the ops our tests leave non-native
+# ---------------------------------------------------------------------------
+
+class ToyEngine:
+    """Pandas-ish oracle over foreign nodes (the role Spark plays)."""
+
+    def execute(self, node: ForeignNode, child_tables):
+        op = node.op
+        if op == "LocalTableScanExec":
+            import auron_tpu.ir.schema as S
+            return pa.Table.from_pylist(
+                node.attrs.get("rows", []),
+                schema=S.to_arrow_schema(node.output))
+        if op == "OpaqueRowOpExec":
+            # an op the converter can never claim: multiplies column
+            # `target` by 3 on the host
+            t = child_tables[0]
+            target = node.attrs["target"]
+            col = pa.compute.multiply(t[target], 3)
+            return t.set_column(t.schema.get_field_index(target), target,
+                                col)
+        raise NotImplementedError(f"toy engine cannot run {op}")
+
+
+def local_table(rows, schema: Schema) -> ForeignNode:
+    return ForeignNode("LocalTableScanExec", output=schema,
+                       attrs={"rows": rows})
+
+
+def canon(rows):
+    def norm(v):
+        if isinstance(v, float):
+            return round(v, 9)
+        return v
+    return sorted([tuple((k, v is None, str(norm(v)))
+                         for k, v in sorted(r.items())) for r in rows])
+
+
+def check(plan: ForeignNode, expect_all_native=True):
+    """Differential: session vs foreign-only oracle."""
+    session = AuronSession(foreign_engine=ToyEngine())
+    res = session.execute(plan)
+    with config.conf.scoped({"auron.enable": False}):
+        oracle_session = AuronSession(foreign_engine=_OracleEngine())
+        oracle = oracle_session.execute(plan)
+    assert canon(res.to_pylist()) == canon(oracle.to_pylist())
+    if expect_all_native:
+        assert res.all_native(), \
+            f"plan has foreign sections: {type(res.converted)}"
+    return res
+
+
+class _OracleEngine(ToyEngine):
+    """Full-plan oracle: interprets every foreign op via the IR reference
+    interpreter by round-tripping through conversion with all gates off."""
+
+    def execute(self, node: ForeignNode, child_tables):
+        try:
+            return super().execute(node, child_tables)
+        except NotImplementedError:
+            pass
+        import reference_engine
+        from auron_tpu.frontend import converters
+        from auron_tpu.frontend.expr_convert import NotConvertible
+        from auron_tpu.ir import plan as P
+        from auron_tpu.ir.schema import from_arrow_schema
+        from auron_tpu.runtime.resources import ResourceRegistry
+        # convert this single node with FFI readers over child tables
+        ctx = converters.ConvertContext()
+        res = ResourceRegistry()
+        children = []
+        for i, t in enumerate(child_tables):
+            rid = f"oracle:{i}"
+            res.put(rid, t)
+            ph = P.FFIReader(schema=from_arrow_schema(t.schema),
+                             resource_id=rid)
+            children.append(ctx.set_parts(ph, 1))
+        if node.op == "ShuffleExchangeExec":
+            return child_tables[0]  # exchange is an identity over rows
+        if node.op == "BroadcastExchangeExec":
+            return child_tables[0]
+        native = converters.convert_node(node, children, ctx)
+        rows = reference_engine.run_plan(native, res, partition_id=0)
+        import auron_tpu.ir.schema as S
+        try:
+            from auron_tpu.runtime.planner import PhysicalPlanner
+            schema = S.to_arrow_schema(
+                PhysicalPlanner().create_plan(native).schema)
+            return pa.Table.from_pylist(rows, schema=schema)
+        except Exception:
+            return pa.Table.from_pylist(rows)
+
+
+# ---------------------------------------------------------------------------
+# data
+# ---------------------------------------------------------------------------
+
+def sales_rows(n=500, seed=3):
+    rng = np.random.default_rng(seed)
+    return [{"k": int(rng.integers(0, 12)),
+             "v": float(np.round(rng.normal(50, 20), 3)),
+             "s": ["red", "green", "blue"][int(rng.integers(0, 3))]}
+            for _ in range(n)]
+
+
+SALES = Schema((Field("k", I64), Field("v", F64), Field("s", STR)))
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+def test_foreign_plan_json_roundtrip():
+    plan = ForeignNode(
+        "ProjectExec",
+        children=(local_table(sales_rows(5), SALES),),
+        output=Schema((Field("k2", I64),)),
+        attrs={"project_list": [
+            falias(fcall("Add", fcol("k", I64), flit(1)), "k2")]})
+    back = ForeignNode.from_json(plan.to_json())
+    assert back.op == "ProjectExec"
+    assert back.attrs["project_list"][0].name == "Alias"
+    assert back.output.names() == ("k2",)
+    assert back.children[0].attrs["rows"][:2] == sales_rows(5)[:2]
+
+
+def test_project_filter_native():
+    src = local_table(sales_rows(), SALES)
+    filt = ForeignNode(
+        "FilterExec", children=(src,), output=SALES,
+        attrs={"condition": fcall(
+            "And",
+            fcall("GreaterThan", fcol("v", F64), flit(30.0)),
+            fcall("IsNotNull", fcol("s", STR)))})
+    proj = ForeignNode(
+        "ProjectExec", children=(filt,),
+        output=Schema((Field("k", I64), Field("v2", F64))),
+        attrs={"project_list": [
+            fcol("k", I64),
+            falias(fcall("Multiply", fcol("v", F64), flit(2.0)), "v2")]})
+    res = check(proj)
+    assert len(res.to_pylist()) > 0
+
+
+def test_sort_limit_native():
+    src = local_table(sales_rows(), SALES)
+    sort = ForeignNode(
+        "SortExec", children=(src,), output=SALES,
+        attrs={"sort_order": [
+            ForeignExpr("SortOrder", children=(fcol("v", F64),),
+                        attrs={"asc": False, "nulls_first": False})]})
+    lim = ForeignNode("GlobalLimitExec", children=(sort,), output=SALES,
+                      attrs={"limit": 7})
+    res = check(lim)
+    got = [r["v"] for r in res.to_pylist()]
+    assert got == sorted(got, reverse=True) and len(got) == 7
+
+
+def test_partial_shuffle_final_agg():
+    """The canonical two-phase agg: partial -> hash exchange -> final
+    (the shape every TPC-DS group-by stage takes)."""
+    src = local_table(sales_rows(800), SALES)
+    agg_exprs = [
+        ForeignExpr("AggregateExpression",
+                    children=(fcall("Sum", fcol("v", F64), dtype=F64),)),
+        ForeignExpr("AggregateExpression",
+                    children=(fcall("Count", fcol("v", F64), dtype=I64),)),
+        ForeignExpr("AggregateExpression",
+                    children=(fcall("Average", fcol("v", F64), dtype=F64),)),
+    ]
+    partial = ForeignNode(
+        "HashAggregateExec", children=(src,),
+        output=Schema((Field("k", I64), Field("sv#sum", F64),
+                       Field("cv#count", I64), Field("av#sum", F64),
+                       Field("av#count", I64))),
+        attrs={"grouping": [fcol("k", I64)], "aggs": agg_exprs,
+               "agg_names": ["sv", "cv", "av"], "mode": "partial"})
+    exchange = ForeignNode(
+        "ShuffleExchangeExec", children=(partial,), output=partial.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": 4,
+            "expressions": [fcol("k", I64)]}})
+    final = ForeignNode(
+        "HashAggregateExec", children=(exchange,),
+        output=Schema((Field("k", I64), Field("sv", F64), Field("cv", I64),
+                       Field("av", F64))),
+        attrs={"grouping": [fcol("k", I64)], "aggs": agg_exprs,
+               "agg_names": ["sv", "cv", "av"], "mode": "final"})
+    session = AuronSession(foreign_engine=ToyEngine())
+    res = session.execute(final)
+    rows = {r["k"]: r for r in res.to_pylist()}
+    # direct oracle
+    import collections
+    agg = collections.defaultdict(list)
+    for r in sales_rows(800):
+        agg[r["k"]].append(r["v"])
+    assert set(rows) == set(agg)
+    for k, vs in agg.items():
+        assert rows[k]["cv"] == len(vs)
+        assert abs(rows[k]["sv"] - sum(vs)) < 1e-6
+        assert abs(rows[k]["av"] - sum(vs) / len(vs)) < 1e-9
+    assert res.all_native()
+
+
+def test_broadcast_hash_join():
+    dim_schema = Schema((Field("k", I64), Field("name", STR)))
+    dim = local_table([{"k": i, "name": f"cat{i}"} for i in range(12)],
+                      dim_schema)
+    bx = ForeignNode("BroadcastExchangeExec", children=(dim,),
+                     output=dim_schema)
+    fact = local_table(sales_rows(300), SALES)
+    join = ForeignNode(
+        "BroadcastHashJoinExec", children=(fact, bx),
+        output=SALES.concat(dim_schema),
+        attrs={"left_keys": [fcol("k", I64)],
+               "right_keys": [fcol("k", I64)],
+               "join_type": "Inner", "build_side": "right"})
+    session = AuronSession(foreign_engine=ToyEngine())
+    res = session.execute(join)
+    rows = res.to_pylist()
+    assert len(rows) == 300
+    assert all(r["name"] == f"cat{r['k']}" for r in rows)
+    assert res.all_native()
+
+
+def test_sort_merge_join_via_exchanges():
+    left = local_table(sales_rows(200, seed=1), SALES)
+    right_schema = Schema((Field("k", I64), Field("w", F64)))
+    right = local_table(
+        [{"k": i % 12, "w": float(i)} for i in range(24)], right_schema)
+
+    def exchange(child, keys_schema):
+        return ForeignNode(
+            "ShuffleExchangeExec", children=(child,), output=child.output,
+            attrs={"partitioning": {
+                "mode": "hash", "num_partitions": 3,
+                "expressions": [fcol("k", I64)]}})
+
+    join = ForeignNode(
+        "SortMergeJoinExec",
+        children=(exchange(left, SALES), exchange(right, right_schema)),
+        output=SALES.concat(right_schema),
+        attrs={"left_keys": [fcol("k", I64)],
+               "right_keys": [fcol("k", I64)], "join_type": "Inner"})
+    session = AuronSession(foreign_engine=ToyEngine())
+    res = session.execute(join)
+    rows = res.to_pylist()
+    assert len(rows) == 200 * 2  # each k in 0..11 appears twice in right
+    assert res.all_native()
+
+
+def test_mixed_plan_foreign_section():
+    """An inconvertible op in the middle: N2C under it, C2N above it."""
+    src = local_table(sales_rows(100), SALES)
+    proj = ForeignNode(
+        "ProjectExec", children=(src,), output=SALES,
+        attrs={"project_list": [fcol("k", I64), fcol("v", F64),
+                                fcol("s", STR)]})
+    opaque = ForeignNode("OpaqueRowOpExec", children=(proj,), output=SALES,
+                         attrs={"target": "v"})
+    # strategy's anti-thrash rule: a lone filter over a non-native child
+    # stays foreign; use sort (AlwaysConvert even over non-native child)
+    sort = ForeignNode(
+        "SortExec", children=(opaque,), output=SALES,
+        attrs={"sort_order": [
+            ForeignExpr("SortOrder", children=(fcol("v", F64),))]})
+    session = AuronSession(foreign_engine=ToyEngine())
+    res = session.execute(sort)
+    rows = res.to_pylist()
+    expect = sorted((r["v"] * 3 for r in sales_rows(100)))
+    got = [r["v"] for r in rows]
+    assert np.allclose(got, expect)
+    assert not res.all_native()
+
+
+def test_strategy_inefficient_filter_stays_foreign():
+    """removeInefficientConverts: Filter over a never-convert child is
+    demoted (AuronConvertStrategy.scala:214-222)."""
+    src = local_table(sales_rows(50), SALES)
+    opaque = ForeignNode("OpaqueRowOpExec", children=(src,), output=SALES,
+                         attrs={"target": "v"})
+    filt = ForeignNode(
+        "FilterExec", children=(opaque,), output=SALES,
+        attrs={"condition": fcall("GreaterThan", fcol("v", F64),
+                                  flit(0.0))})
+    tags = strategy.apply(filt)
+    assert tags.is_never_convert(filt)
+    assert "not native" in tags.reason(filt)
+
+
+def _weird_udf(k):
+    # row-wise evaluation (host_eval's UDF contract)
+    return int(k) * 2 + 1
+
+
+def test_udf_fallback_expression():
+    """Unconvertible expr w/ pickled evaluator -> PyUdfWrapper
+    (SparkUDFWrapperExpr analogue)."""
+    weird = _weird_udf
+    src = local_table(sales_rows(60), SALES)
+    proj = ForeignNode(
+        "ProjectExec", children=(src,),
+        output=Schema((Field("wk", I64),)),
+        attrs={"project_list": [falias(
+            ForeignExpr("MysteryUdf", children=(fcol("k", I64),),
+                        dtype=I64, py_fn=pickle.dumps(weird)), "wk")]})
+    session = AuronSession(foreign_engine=ToyEngine())
+    res = session.execute(proj)
+    rows = res.to_pylist()
+    assert [r["wk"] for r in rows] == \
+        [r["k"] * 2 + 1 for r in sales_rows(60)]
+    assert res.all_native()
+
+
+def test_master_switch_disables_conversion():
+    src = local_table([{"k": 1, "v": 2.0, "s": "x"}], SALES)
+    filt = ForeignNode(
+        "FilterExec", children=(src,), output=SALES,
+        attrs={"condition": fcall("GreaterThan", fcol("v", F64),
+                                  flit(1.0))})
+    with config.conf.scoped({"auron.enable": False}):
+        res = AuronSession(foreign_engine=_OracleEngine()).execute(filt)
+    assert res.to_pylist() == [{"k": 1, "v": 2.0, "s": "x"}]
+    assert res.converted is None
+
+
+def test_per_op_disable_switch():
+    src = local_table(sales_rows(30), SALES)
+    sort = ForeignNode(
+        "SortExec", children=(src,), output=SALES,
+        attrs={"sort_order": [
+            ForeignExpr("SortOrder", children=(fcol("v", F64),))]})
+    with config.conf.scoped({"auron.enable.sort": False}):
+        tags = strategy.apply(sort)
+        assert tags.is_never_convert(sort)
+        assert "disabled by conf" in tags.reason(sort)
+
+
+def test_expand_window_take_ordered():
+    src = local_table(sales_rows(120), SALES)
+    expand = ForeignNode(
+        "ExpandExec", children=(src,),
+        output=Schema((Field("k", I64), Field("v", F64), Field("g", I64))),
+        attrs={"projections": [
+            [fcol("k", I64), fcol("v", F64), flit(0)],
+            [fcol("k", I64), fcol("v", F64), flit(1)]]})
+    res = check(expand)
+    assert len(res.to_pylist()) == 240
+
+    win = ForeignNode(
+        "WindowExec", children=(src,),
+        output=SALES.concat(Schema((Field("rn", I64),))),
+        attrs={"window_exprs": [
+            {"name": "rn", "fn": "row_number", "dtype": I64}],
+            "partition_spec": [fcol("k", I64)],
+            "order_spec": [ForeignExpr("SortOrder",
+                                       children=(fcol("v", F64),))]})
+    res = check(win)
+    by_k = {}
+    for r in res.to_pylist():
+        by_k.setdefault(r["k"], []).append(r)
+    for rows in by_k.values():
+        rows.sort(key=lambda r: r["rn"])
+        vs = [r["v"] for r in rows]
+        assert vs == sorted(vs)
