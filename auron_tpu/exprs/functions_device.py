@@ -46,6 +46,21 @@ def _f64(col):
     return col.data.astype(jnp.float64)
 
 
+def _spark_log(args, raw, e, ctx):
+    """Spark log: unary = ln(x); binary = log_base(x) with (base, x) arg
+    order (Logarithm), null/nan outside the domain."""
+    if len(args) == 1:
+        return _unary_f64(jnp.log, domain=lambda x: x > 0)(args, raw, e,
+                                                           ctx)
+    b, x = _f64(args[0]), _f64(args[1])
+    valid = jnp.logical_and(args[0].validity, args[1].validity)
+    ok = (x > 0) & (b > 0) & (b != 1.0)
+    out = jnp.where(ok,
+                    jnp.log(jnp.where(ok, x, 1.0)) /
+                    jnp.log(jnp.where(ok, b, 2.0)), jnp.nan)
+    return flat(DataType.float64(), out, valid)
+
+
 def _unary_f64(jfn, domain=None):
     def impl(args, raw, e, ctx):
         x = _f64(args[0])
@@ -454,7 +469,7 @@ _FUNCS = {
     "exp": _unary_f64(jnp.exp),
     "expm1": _unary_f64(jnp.expm1),
     "ln": _unary_f64(jnp.log, domain=lambda x: x > 0),
-    "log": _unary_f64(jnp.log, domain=lambda x: x > 0),
+    "log": _spark_log,
     "log10": _unary_f64(jnp.log10, domain=lambda x: x > 0),
     "log2": _unary_f64(jnp.log2, domain=lambda x: x > 0),
     "power": _math_binary(jnp.power),

@@ -368,7 +368,12 @@ _FUNCS: Dict[str, Callable] = {
                               (math.isnan(x) or math.isinf(x)))
                       else _to_long(x)),
     "cos": _f64(math.cos), "cosh": _f64(math.cosh), "exp": _f64(math.exp),
-    "expm1": _f64(math.expm1), "ln": _f64(math.log), "log": _f64(math.log),
+    "expm1": _f64(math.expm1), "ln": _f64(math.log),
+    # Spark log(x) = ln(x); log(base, x) = ln(x)/ln(base)
+    "log": _f64(lambda *a: math.log(a[0]) if len(a) == 1
+                else (math.log(a[1]) / math.log(a[0])
+                      if a[0] > 0 and a[0] != 1.0 and a[1] > 0
+                      else float("nan"))),
     "log10": _f64(math.log10), "log2": _f64(math.log2),
     "power": _f64(math.pow), "sin": _f64(math.sin), "sinh": _f64(math.sinh),
     "sqrt": _f64(math.sqrt), "tan": _f64(math.tan), "tanh": _f64(math.tanh),

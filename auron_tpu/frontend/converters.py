@@ -240,9 +240,7 @@ def _sort(node, children, ctx) -> P.PlanNode:
 
 
 @_plan("LocalLimitExec")
-@_plan("GlobalLimitExec")
-@_plan("CollectLimitExec")
-def _limit(node, children, ctx) -> P.PlanNode:
+def _local_limit(node, children, ctx) -> P.PlanNode:
     _op_enabled("limit")
     return ctx.set_parts(
         P.Limit(child=children[0], limit=int(node.attrs["limit"]),
@@ -250,17 +248,60 @@ def _limit(node, children, ctx) -> P.PlanNode:
         ctx.parts(children[0]))
 
 
+@_plan("GlobalLimitExec")
+@_plan("CollectLimitExec")
+def _global_limit(node, children, ctx) -> P.PlanNode:
+    """Global limit over a multi-partition child: per-partition pre-limit,
+    single-partition exchange, then the real limit+offset (CollectLimit's
+    gather-to-one shape)."""
+    _op_enabled("limit")
+    limit = int(node.attrs["limit"])
+    offset = int(node.attrs.get("offset", 0))
+    child = children[0]
+    if ctx.parts(child) > 1:
+        local = ctx.set_parts(
+            P.Limit(child=child, limit=limit + offset, offset=0),
+            ctx.parts(child))
+        rid = ctx.fresh("shuffle")
+        schema = _native_schema_of(local) or _schema(node)
+        ctx.exchanges[rid] = ShuffleJob(
+            rid=rid, child=local,
+            partitioning=P.Partitioning(mode="single", num_partitions=1),
+            schema=schema)
+        child = ctx.set_parts(P.IpcReader(schema=schema, resource_id=rid),
+                              1)
+    return ctx.set_parts(P.Limit(child=child, limit=limit, offset=offset),
+                         1)
+
+
 @_plan("TakeOrderedAndProjectExec")
 def _take_ordered(node, children, ctx) -> P.PlanNode:
+    """Global top-K: per-partition sort+limit, single-partition exchange,
+    final merge sort+limit (NativeTakeOrderedBase's two-stage shape)."""
     _op_enabled("sort")
     orders = tuple(EC.convert_sort_order(s)
                    for s in node.attrs["sort_order"])
-    sort = P.Sort(child=children[0], sort_exprs=orders,
-                  fetch_limit=int(node.attrs["limit"]),
-                  fetch_offset=int(node.attrs.get("offset", 0)))
+    limit = int(node.attrs["limit"])
+    offset = int(node.attrs.get("offset", 0))
+    merged_child = children[0]
+    if ctx.parts(children[0]) > 1:
+        local = ctx.set_parts(
+            P.Sort(child=children[0], sort_exprs=orders,
+                   fetch_limit=limit + offset),
+            ctx.parts(children[0]))
+        rid = ctx.fresh("shuffle")
+        schema = _native_schema_of(local) or _schema(node)
+        ctx.exchanges[rid] = ShuffleJob(
+            rid=rid, child=local,
+            partitioning=P.Partitioning(mode="single", num_partitions=1),
+            schema=schema)
+        merged_child = ctx.set_parts(
+            P.IpcReader(schema=schema, resource_id=rid), 1)
+    sort = P.Sort(child=merged_child, sort_exprs=orders,
+                  fetch_limit=limit, fetch_offset=offset)
     exprs, names = _named_exprs(node.attrs["project_list"])
     return ctx.set_parts(P.Projection(child=sort, exprs=exprs, names=names),
-                         ctx.parts(children[0]))
+                         1)
 
 
 @_plan("HashAggregateExec")
@@ -321,7 +362,19 @@ def _window(node, children, ctx) -> P.PlanNode:
             agg = EC.convert_agg_expr(w["agg"])
             rt = agg.return_type
         else:
-            rt = w.get("dtype") or DataType.int32()
+            # per-function defaults (Spark: rank family is IntegerType,
+            # percent_rank/cume_dist are DoubleType); value functions
+            # (lead/lag/nth_value/...) have data-dependent types and must
+            # declare one
+            rt = w.get("dtype")
+            if rt is None:
+                if w["fn"] in ("percent_rank", "cume_dist"):
+                    rt = DataType.float64()
+                elif w["fn"] in ("row_number", "rank", "dense_rank"):
+                    rt = DataType.int32()
+                else:
+                    raise NotConvertible(
+                        f"window function {w['fn']} requires a dtype")
         funcs.append(P.WindowFuncCall(
             fn=w["fn"],
             args=tuple(EC.convert_expr_with_fallback(a)
@@ -369,8 +422,6 @@ def _generate(node, children, ctx) -> P.PlanNode:
         raise NotConvertible(f"generator {gen.name} is not supported yet")
     out_names = tuple(node.attrs["generator_output_names"])
     out_types = tuple(node.attrs["generator_output_types"])
-    child_schema = children[0].schema if hasattr(children[0], "schema") \
-        else None
     required = tuple(int(i) for i in node.attrs.get(
         "required_child_output", ()))
     return ctx.set_parts(
@@ -388,11 +439,20 @@ def _generate(node, children, ctx) -> P.PlanNode:
 def _union(node, children, ctx) -> P.PlanNode:
     _op_enabled("union")
     schema = _schema(node)
-    inputs = tuple(P.UnionInput(child=c, partition=0) for c in children)
+    # flattened partition mapping (proto:542-552): output partitions are
+    # the concatenation of every child's partitions, so each child
+    # partition is read exactly once
+    inputs = []
+    out_pid = 0
+    for c in children:
+        for q in range(ctx.parts(c)):
+            inputs.append(P.UnionInput(child=c, partition=q,
+                                       out_partition=out_pid))
+            out_pid += 1
     return ctx.set_parts(
-        P.Union(inputs=inputs, schema=schema, num_partitions=1,
-                cur_partition=0),
-        1)
+        P.Union(inputs=tuple(inputs), schema=schema,
+                num_partitions=out_pid, cur_partition=0),
+        out_pid)
 
 
 def _join_on(node) -> P.JoinOn:

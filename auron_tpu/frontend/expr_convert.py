@@ -213,22 +213,36 @@ def _like(fe, conv):
                                                      False)))
 
 
+def _literal_value(fe, what: str):
+    """The native string predicates take a constant pattern (the reference
+    converts only literal-pattern StartsWith/EndsWith/Contains,
+    NativeConverters.scala); a non-literal must fall back, not silently
+    become a constant."""
+    if fe.name != "Literal":
+        raise NotConvertible(f"{what} requires a literal argument, "
+                             f"got {fe.name}")
+    return fe.value
+
+
 @_reg("StartsWith")
 def _starts(fe, conv):
-    return E.StringStartsWith(child=conv(fe.children[0]),
-                              prefix=fe.children[1].value)
+    return E.StringStartsWith(
+        child=conv(fe.children[0]),
+        prefix=_literal_value(fe.children[1], "StartsWith prefix"))
 
 
 @_reg("EndsWith")
 def _ends(fe, conv):
-    return E.StringEndsWith(child=conv(fe.children[0]),
-                            suffix=fe.children[1].value)
+    return E.StringEndsWith(
+        child=conv(fe.children[0]),
+        suffix=_literal_value(fe.children[1], "EndsWith suffix"))
 
 
 @_reg("Contains")
 def _contains(fe, conv):
-    return E.StringContains(child=conv(fe.children[0]),
-                            infix=fe.children[1].value)
+    return E.StringContains(
+        child=conv(fe.children[0]),
+        infix=_literal_value(fe.children[1], "Contains infix"))
 
 
 # -- simple function-name mappings ------------------------------------------
@@ -317,7 +331,8 @@ def _bround(fe, conv):
 
 @_reg("Sha2")
 def _sha2(fe, conv):
-    bits = fe.children[1].value if len(fe.children) > 1 else 256
+    bits = _literal_value(fe.children[1], "Sha2 bit length") \
+        if len(fe.children) > 1 else 256
     name = {0: "sha256", 224: "sha224", 256: "sha256",
             384: "sha384", 512: "sha512"}.get(bits)
     if name is None:

@@ -53,6 +53,7 @@ class SessionResult:
     converted: ConvertedT = None  # type: ignore[assignment]
     tags: Optional[strategy.Tags] = None
     metrics: List[MetricNode] = field(default_factory=list)
+    ctx: Optional[ConvertContext] = None  # exchange/broadcast subtrees
 
     def to_pylist(self) -> List[dict]:
         return self.table.to_pylist()
@@ -62,8 +63,10 @@ class SessionResult:
         checkSparkAnswerAndOperator plan-walk assertion,
         AuronQueryTest.scala:29-91).  LocalTableScan C2N sources are
         pass-through, matching the reference's allowance for
-        ConvertToNative inputs."""
-        return not isinstance(self.converted, ForeignWrap) and \
+        ConvertToNative inputs.  A foreign-only run (auron.enable=false)
+        has converted=None and is never 'all native'."""
+        return self.converted is not None and \
+            not isinstance(self.converted, ForeignWrap) and \
             getattr(self, "_foreign_sections", 0) == 0
 
 
@@ -84,7 +87,7 @@ class AuronSession:
         self._metrics = []
         table = self._run_converted(converted, ctx)
         res = SessionResult(table=table, converted=converted, tags=tags,
-                            metrics=self._metrics)
+                            metrics=self._metrics, ctx=ctx)
         # count foreign sections that needed the host engine (local-table
         # sources are data, not computation)
         res._foreign_sections = sum(  # type: ignore[attr-defined]
@@ -126,9 +129,13 @@ class AuronSession:
             self._metrics.append(res.metrics)
             batches.extend(res.batches)
         if not batches:
-            return pa.Table.from_batches(
-                [], schema=to_arrow_schema(plan.schema)) \
-                if getattr(plan, "schema", None) else pa.table({})
+            schema = getattr(plan, "schema", None)
+            if schema is None:
+                # non-leaf IR nodes carry no schema; derive it from the
+                # instantiated operator tree
+                from auron_tpu.runtime.planner import PhysicalPlanner
+                schema = PhysicalPlanner().create_plan(plan).schema
+            return pa.Table.from_batches([], schema=to_arrow_schema(schema))
         return pa.Table.from_batches(batches)
 
     # -- dependency materialization (stage scheduling) --------------------
@@ -145,7 +152,9 @@ class AuronSession:
         resources = ResourceRegistry()
         rids: List[str] = []
         self._collect_rids(plan, rids)
-        for rid in rids:
+        # a subtree may be referenced from several places (e.g. a union's
+        # flattened partition mapping repeats the child) — materialize once
+        for rid in dict.fromkeys(rids):
             if rid in ctx.sources:
                 self._materialize_source(ctx.sources[rid], ctx, resources)
             elif rid in ctx.broadcasts:
@@ -192,21 +201,12 @@ class AuronSession:
         """Shuffle: run the map side through RssShuffleWriter into the
         in-process shuffle service, then register per-reduce block lists
         (AuronShuffleManager.getWriter/getReader analogue)."""
-        child = job.child
-        if isinstance(child, ForeignWrap):
-            # foreign map side: its table enters native through FFI first
-            table = self._run_converted(child, ctx)
-            rid = f"{job.rid}:ffi"
-            map_plan: P.PlanNode = P.FFIReader(schema=job.schema,
-                                               resource_id=rid)
-            ctx.set_parts(map_plan, 1)
-            extra = {rid: table}
-        else:
-            map_plan, extra = child, {}
+        # job.child is always native: convert_recursively runs every
+        # foreign subtree through convert_to_native (FFI source) before a
+        # converter sees it
+        map_plan = job.child
         map_parts = ctx.parts(map_plan)
         map_deps = self._materialize_deps(map_plan, ctx)
-        for k, v in extra.items():
-            map_deps.put(k, v)
         for map_pid in range(map_parts):
             writer_rid = f"{job.rid}:writer:{map_pid}"
             map_deps.put(writer_rid,

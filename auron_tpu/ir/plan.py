@@ -334,8 +334,10 @@ class BroadcastJoin(PlanNode):
 class UnionInput(Node):
     kind: ClassVar[str] = "union_input"
     child: PlanNode = None  # type: ignore[assignment]
-    # which partition of this child feeds the union's output partition
+    # which partition of this child feeds `out_partition` of the union
+    # (the flattened form of proto:542-552's per-input partition mapping)
     partition: int = 0
+    out_partition: int = 0
 
 
 @register

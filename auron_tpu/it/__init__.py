@@ -1,0 +1,13 @@
+"""TPC-DS integration harness — the analogue of the reference's
+`dev/auron-it` CLI (Main.scala:26, QueryRunner.scala:33): generate a
+deterministic TPC-DS-subset star schema as parquet, run a corpus of
+TPC-DS-shaped physical plans through the engine twice (native vs host
+oracle), compare results with float tolerance
+(QueryResultComparator.scala:39-98 analogue) and check plan stability
+(PlanStabilityChecker analogue)."""
+
+from auron_tpu.it.datagen import Catalog, generate
+from auron_tpu.it.compare import compare_tables
+from auron_tpu.it.runner import QueryRunner
+
+__all__ = ["Catalog", "generate", "compare_tables", "QueryRunner"]

@@ -1,0 +1,40 @@
+"""CLI: python -m auron_tpu.it --sf 0.01 --data-dir /tmp/tpcds
+[--queries q03,q42] [--golden-dir tests/golden_plans] [--json out.json]
+
+The `dev/auron-it` Main.scala:26 analogue."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="auron_tpu.it")
+    ap.add_argument("--data-dir", default="/tmp/auron_tpcds")
+    ap.add_argument("--sf", type=float, default=0.01)
+    ap.add_argument("--queries", default=None,
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--golden-dir", default=None)
+    ap.add_argument("--json", default=None, help="write results JSON here")
+    args = ap.parse_args()
+
+    from auron_tpu.it.datagen import generate
+    from auron_tpu.it.runner import QueryRunner
+
+    print(f"generating sf={args.sf} data into {args.data_dir} ...",
+          flush=True)
+    cat = generate(args.data_dir, sf=args.sf)
+
+    runner = QueryRunner(catalog=cat, golden_dir=args.golden_dir)
+    names = args.queries.split(",") if args.queries else None
+    runner.run_all(names)
+    print(runner.report())
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(runner.to_json())
+    return 0 if all(r.ok for r in runner.results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Result comparison with float tolerance — the
+QueryResultComparator.scala:39-98 analogue: rows are canonicalized
+(row-sorted unless the query is ordered), floats compared within relative
+tolerance, None/NaN treated as equal to themselves."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Tuple
+
+import pyarrow as pa
+
+
+def _norm_value(v: Any) -> Any:
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        return v
+    return v
+
+
+def _rows(table: pa.Table) -> List[Tuple]:
+    names = table.schema.names
+    return [tuple(_norm_value(r[c]) for c in names)
+            for r in table.to_pylist()]
+
+
+def _value_eq(a: Any, b: Any, rel_tol: float, abs_tol: float) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if a == "NaN" or b == "NaN":
+        return a == b
+    if isinstance(a, float) or isinstance(b, float):
+        try:
+            return math.isclose(float(a), float(b), rel_tol=rel_tol,
+                                abs_tol=abs_tol)
+        except (TypeError, ValueError):
+            return False
+    return a == b
+
+
+def _sort_key(row: Tuple) -> Tuple:
+    return tuple((v is None, str(type(v).__name__), str(v)) for v in row)
+
+
+def compare_tables(actual: pa.Table, expected: pa.Table,
+                   rel_tol: float = 1e-4, abs_tol: float = 1e-6,
+                   ordered: bool = False) -> Optional[str]:
+    """None when equal; otherwise a human-readable first-difference."""
+    if actual.num_rows != expected.num_rows:
+        return (f"row count differs: actual={actual.num_rows} "
+                f"expected={expected.num_rows}")
+    if actual.schema.names != expected.schema.names:
+        return (f"column names differ: {actual.schema.names} vs "
+                f"{expected.schema.names}")
+    a_rows, e_rows = _rows(actual), _rows(expected)
+    if not ordered:
+        a_rows = sorted(a_rows, key=_sort_key)
+        e_rows = sorted(e_rows, key=_sort_key)
+    for i, (ar, er) in enumerate(zip(a_rows, e_rows)):
+        for c, (av, ev) in enumerate(zip(ar, er)):
+            if not _value_eq(av, ev, rel_tol, abs_tol):
+                col = actual.schema.names[c]
+                return (f"row {i} col {col!r}: actual={av!r} "
+                        f"expected={ev!r}")
+    return None

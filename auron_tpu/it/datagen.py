@@ -1,0 +1,237 @@
+"""Deterministic TPC-DS-subset data generator.
+
+The reference's IT harness points Spark at dsdgen output; here the star
+schema (the subset of TPC-DS tables our query corpus touches) is generated
+directly as parquet with referential integrity between facts and dims, and
+each fact table is split into several parquet chunk files so scans get real
+multi-partition file groups (FileGroup per chunk = the Spark task split).
+
+Row counts scale linearly with `sf` (sf=1 ≈ 1M store_sales rows, the same
+order as dsdgen sf=1's 2.9M) and everything derives from a seeded
+Generator, so any two runs at the same sf produce identical tables.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from auron_tpu.frontend.foreign import ForeignExpr, ForeignNode
+from auron_tpu.ir.schema import DataType, Field, Schema
+
+I32 = DataType.int32()
+I64 = DataType.int64()
+F64 = DataType.float64()
+STR = DataType.string()
+
+_DAY_NAMES = ("Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+              "Friday", "Saturday")
+_CATEGORIES = ("Books", "Home", "Electronics", "Jewelry", "Music",
+               "Shoes", "Sports", "Women", "Men", "Children")
+_STATES = ("TN", "CA", "TX", "OH", "GA", "MI", "NY", "WA", "IL", "FL")
+_COUNTRIES = ("United States", "Canada", "Mexico", "Germany", "Japan")
+_CHANNELS = ("N", "Y")
+
+
+@dataclass
+class TableDef:
+    name: str
+    schema: Schema
+    chunks: List[str] = field(default_factory=list)   # parquet paths
+
+
+@dataclass
+class Catalog:
+    """Knows every generated table's schema + file chunks and builds the
+    FileSourceScanExec foreign node a Spark bridge would hand us."""
+
+    data_dir: str
+    tables: Dict[str, TableDef] = field(default_factory=dict)
+
+    def scan(self, table: str, columns: Optional[Sequence[str]] = None,
+             pushed_filters: Sequence[ForeignExpr] = (),
+             parts: Optional[int] = None) -> ForeignNode:
+        t = self.tables[table]
+        cols = list(columns) if columns is not None else t.schema.names()
+        fields = {f.name: f for f in t.schema.fields}
+        out = Schema(tuple(fields[c] for c in cols))
+        n = parts or len(t.chunks)
+        groups: List[List[str]] = [[] for _ in range(min(n, len(t.chunks)))]
+        for i, path in enumerate(t.chunks):
+            groups[i % len(groups)].append(path)
+        return ForeignNode(
+            "FileSourceScanExec", output=out,
+            attrs={"format": "parquet",
+                   "file_groups": [list(g) for g in groups],
+                   "pushed_filters": list(pushed_filters)})
+
+    def field(self, table: str, column: str) -> Field:
+        for f in self.tables[table].schema.fields:
+            if f.name == column:
+                return f
+        raise KeyError(f"{table}.{column}")
+
+
+def _write_chunks(out_dir: str, name: str, table: pa.Table,
+                  n_chunks: int) -> TableDef:
+    tdir = os.path.join(out_dir, name)
+    os.makedirs(tdir, exist_ok=True)
+    n = table.num_rows
+    n_chunks = max(1, min(n_chunks, max(1, n)))
+    bounds = np.linspace(0, n, n_chunks + 1).astype(int)
+    paths = []
+    for i in range(n_chunks):
+        path = os.path.join(tdir, f"part-{i:05d}.parquet")
+        pq.write_table(table.slice(bounds[i], bounds[i + 1] - bounds[i]),
+                       path)
+        paths.append(path)
+    arrow = table.schema
+    from auron_tpu.ir.schema import from_arrow_schema
+    return TableDef(name=name, schema=from_arrow_schema(arrow), chunks=paths)
+
+
+def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
+             fact_chunks: int = 4) -> Catalog:
+    """Generate the star schema at scale factor `sf` into data_dir."""
+    rng = np.random.default_rng(seed)
+    cat = Catalog(data_dir=data_dir)
+
+    # ---- date_dim: 5 years of days, 1998-2002 (TPC-DS's window) ----------
+    n_days = 5 * 365
+    sk = np.arange(n_days, dtype=np.int64) + 2450815
+    doy = np.arange(n_days) % 365
+    year = 1998 + np.arange(n_days) // 365
+    moy = np.minimum(doy // 30 + 1, 12)
+    dom = doy % 30 + 1
+    date_dim = pa.table({
+        "d_date_sk": sk,
+        "d_year": year.astype(np.int32),
+        "d_moy": moy.astype(np.int32),
+        "d_dom": dom.astype(np.int32),
+        "d_qoy": ((moy - 1) // 3 + 1).astype(np.int32),
+        "d_day_name": pa.array([_DAY_NAMES[int(i) % 7] for i in doy]),
+    })
+    cat.tables["date_dim"] = _write_chunks(data_dir, "date_dim", date_dim, 1)
+
+    # ---- item -------------------------------------------------------------
+    n_item = max(200, int(2000 * max(sf, 0.01)))
+    isk = np.arange(n_item, dtype=np.int64) + 1
+    item = pa.table({
+        "i_item_sk": isk,
+        "i_item_id": pa.array([f"AAAAAAAA{i:08d}" for i in isk]),
+        "i_category": pa.array([_CATEGORIES[int(i) % len(_CATEGORIES)]
+                                for i in isk]),
+        "i_brand": pa.array([f"brand#{int(i) % 50}" for i in isk]),
+        "i_class": pa.array([f"class#{int(i) % 20}" for i in isk]),
+        "i_current_price": np.round(rng.uniform(0.5, 100.0, n_item), 2),
+        "i_manager_id": rng.integers(1, 101, n_item).astype(np.int32),
+        "i_manufact_id": rng.integers(1, 1001, n_item).astype(np.int32),
+    })
+    cat.tables["item"] = _write_chunks(data_dir, "item", item, 1)
+
+    # ---- store ------------------------------------------------------------
+    n_store = max(4, int(12 * max(sf, 0.1)))
+    ssk = np.arange(n_store, dtype=np.int64) + 1
+    store = pa.table({
+        "s_store_sk": ssk,
+        "s_store_id": pa.array([f"S{i:04d}" for i in ssk]),
+        "s_store_name": pa.array([f"store-{int(i)}" for i in ssk]),
+        "s_state": pa.array([_STATES[int(i) % len(_STATES)] for i in ssk]),
+        "s_gmt_offset": np.full(n_store, -5.0),
+    })
+    cat.tables["store"] = _write_chunks(data_dir, "store", store, 1)
+
+    # ---- customer + address ----------------------------------------------
+    n_cust = max(500, int(20_000 * sf))
+    csk = np.arange(n_cust, dtype=np.int64) + 1
+    addr_sk = rng.integers(1, n_cust + 1, n_cust).astype(np.int64)
+    customer = pa.table({
+        "c_customer_sk": csk,
+        "c_customer_id": pa.array([f"C{i:09d}" for i in csk]),
+        "c_current_addr_sk": addr_sk,
+        "c_birth_country": pa.array(
+            [_COUNTRIES[int(i) % len(_COUNTRIES)] for i in csk]),
+    })
+    cat.tables["customer"] = _write_chunks(data_dir, "customer", customer, 2)
+    ca = pa.table({
+        "ca_address_sk": csk,
+        "ca_state": pa.array([_STATES[int(rng.integers(len(_STATES)))]
+                              for _ in range(n_cust)]),
+        "ca_country": pa.array(["United States"] * n_cust),
+        "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], n_cust),
+    })
+    cat.tables["customer_address"] = _write_chunks(
+        data_dir, "customer_address", ca, 2)
+
+    # ---- promotion --------------------------------------------------------
+    n_promo = max(10, int(30 * max(sf, 0.1)))
+    psk = np.arange(n_promo, dtype=np.int64) + 1
+    promo = pa.table({
+        "p_promo_sk": psk,
+        "p_channel_email": pa.array([_CHANNELS[int(i) % 2] for i in psk]),
+        "p_channel_event": pa.array([_CHANNELS[(int(i) // 2) % 2]
+                                     for i in psk]),
+    })
+    cat.tables["promotion"] = _write_chunks(data_dir, "promotion", promo, 1)
+
+    # ---- fact tables ------------------------------------------------------
+    def fact(n_rows: int, prefix: str, extra: Dict[str, np.ndarray],
+             date_col: str, item_col: str, cust_col: str) -> pa.Table:
+        qty = rng.integers(1, 100, n_rows).astype(np.int32)
+        price = np.round(rng.uniform(1.0, 200.0, n_rows), 2)
+        cols = {
+            date_col: sk[rng.integers(0, n_days, n_rows)],
+            item_col: isk[rng.integers(0, n_item, n_rows)],
+            cust_col: csk[rng.integers(0, n_cust, n_rows)],
+            f"{prefix}_quantity": qty,
+            f"{prefix}_sales_price": price,
+            f"{prefix}_ext_sales_price": np.round(price * qty, 2),
+            f"{prefix}_net_profit": np.round(
+                rng.normal(10, 40, n_rows), 2),
+        }
+        cols.update(extra)
+        return pa.table(cols)
+
+    n_ss = max(2_000, int(1_000_000 * sf))
+    ss = fact(n_ss, "ss", {
+        "ss_store_sk": ssk[rng.integers(0, n_store, n_ss)],
+        "ss_promo_sk": psk[rng.integers(0, n_promo, n_ss)],
+        "ss_ticket_number": np.arange(n_ss, dtype=np.int64) + 1,
+    }, "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk")
+    cat.tables["store_sales"] = _write_chunks(
+        data_dir, "store_sales", ss, fact_chunks)
+
+    # store_returns: a subset of tickets comes back
+    n_sr = max(200, n_ss // 10)
+    ridx = rng.choice(n_ss, n_sr, replace=False)
+    sr = pa.table({
+        "sr_returned_date_sk": sk[rng.integers(0, n_days, n_sr)],
+        "sr_item_sk": ss["ss_item_sk"].to_numpy()[ridx],
+        "sr_customer_sk": ss["ss_customer_sk"].to_numpy()[ridx],
+        "sr_store_sk": ss["ss_store_sk"].to_numpy()[ridx],
+        "sr_ticket_number": ss["ss_ticket_number"].to_numpy()[ridx],
+        "sr_return_amt": np.round(
+            ss["ss_ext_sales_price"].to_numpy()[ridx] *
+            rng.uniform(0.1, 1.0, n_sr), 2),
+    })
+    cat.tables["store_returns"] = _write_chunks(
+        data_dir, "store_returns", sr, max(1, fact_chunks // 2))
+
+    n_cs = max(1_000, n_ss // 2)
+    cs = fact(n_cs, "cs", {}, "cs_sold_date_sk", "cs_item_sk",
+              "cs_bill_customer_sk")
+    cat.tables["catalog_sales"] = _write_chunks(
+        data_dir, "catalog_sales", cs, max(1, fact_chunks // 2))
+
+    n_ws = max(1_000, n_ss // 4)
+    ws = fact(n_ws, "ws", {}, "ws_sold_date_sk", "ws_item_sk",
+              "ws_bill_customer_sk")
+    cat.tables["web_sales"] = _write_chunks(
+        data_dir, "web_sales", ws, max(1, fact_chunks // 2))
+
+    return cat

@@ -1,0 +1,541 @@
+"""Host oracle engine: executes foreign (Spark-vocabulary) plans with
+pyarrow + numpy.
+
+This plays the role vanilla Spark plays in the reference's differential
+tests (AuronQueryTest.checkSparkAnswerAndOperator runs each query once with
+`spark.auron.enable=false` — AuronQueryTest.scala:29-91): a completely
+independent execution path for the same physical plan, used by the IT
+runner as both the correctness oracle and the host-CPU timing baseline.
+
+It deliberately shares no code with the device engine or the IR
+interpreter: expressions are evaluated straight off Spark expression-class
+names over numpy arrays, joins/aggregations are dictionary/sort based.
+
+Semantics notes (mirroring the engine under test):
+- `mode=partial` aggregates pass rows through unchanged and exchanges are
+  identities (single-process oracle), so the `final` aggregate computes
+  the whole aggregation from raw rows — equivalent by associativity.
+- Oracle runs are single-partition; per-partition ops (LocalLimit) behave
+  as their global counterparts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from auron_tpu.frontend.foreign import ForeignExpr, ForeignNode
+from auron_tpu.ir.schema import Schema, to_arrow_schema
+
+
+def _col(values, mask=None):
+    """An evaluated column: numpy values + validity mask (True=null)."""
+    v = np.asarray(values)
+    if mask is None:
+        mask = np.zeros(len(v), bool)
+    return v, np.asarray(mask, bool)
+
+
+class _Eval:
+    """Foreign-expression evaluator over a record batch of numpy columns."""
+
+    def __init__(self, table: pa.Table):
+        self.n = table.num_rows
+        self.cols: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for name in table.schema.names:
+            arr = table[name].combine_chunks()
+            mask = np.asarray(arr.is_null())
+            if pa.types.is_string(arr.type) or pa.types.is_large_string(
+                    arr.type):
+                vals = np.asarray(arr.fill_null("").to_pylist(), object)
+            else:
+                vals = arr.to_numpy(zero_copy_only=False)
+            self.cols[name] = (vals, mask)
+
+    def eval(self, fe: ForeignExpr) -> Tuple[np.ndarray, np.ndarray]:
+        return getattr(self, "_" + fe.name.lower(),
+                       self._unsupported)(fe)
+
+    def _unsupported(self, fe):
+        raise NotImplementedError(f"oracle expression {fe.name}")
+
+    # -- leaves -----------------------------------------------------------
+
+    def _attributereference(self, fe):
+        return self.cols[fe.value]
+
+    def _literal(self, fe):
+        if fe.value is None:
+            return _col(np.zeros(self.n), np.ones(self.n, bool))
+        v = np.full(self.n, fe.value,
+                    dtype=object if isinstance(fe.value, str) else None)
+        return _col(v)
+
+    def _alias(self, fe):
+        return self.eval(fe.children[0])
+
+    # -- arithmetic / comparison ------------------------------------------
+
+    def _bin(self, fe, op):
+        (a, am), (b, bm) = self.eval(fe.children[0]), \
+            self.eval(fe.children[1])
+        with np.errstate(all="ignore"):
+            return _col(op(a, b), am | bm)
+
+    def _add(self, fe): return self._bin(fe, np.add)
+    def _subtract(self, fe): return self._bin(fe, np.subtract)
+    def _multiply(self, fe): return self._bin(fe, np.multiply)
+
+    def _divide(self, fe):
+        (a, am), (b, bm) = self.eval(fe.children[0]), \
+            self.eval(fe.children[1])
+        zero = b == 0
+        with np.errstate(all="ignore"):
+            out = np.where(zero, np.nan,
+                           a.astype(np.float64) /
+                           np.where(zero, 1, b).astype(np.float64))
+        return _col(out, am | bm | zero)   # spark: x/0 -> null
+
+    def _greaterthan(self, fe): return self._bin(fe, np.greater)
+    def _greaterthanorequal(self, fe): return self._bin(fe,
+                                                        np.greater_equal)
+    def _lessthan(self, fe): return self._bin(fe, np.less)
+    def _lessthanorequal(self, fe): return self._bin(fe, np.less_equal)
+    def _equalto(self, fe): return self._bin(fe, np.equal)
+
+    def _and(self, fe):
+        (a, am), (b, bm) = self.eval(fe.children[0]), \
+            self.eval(fe.children[1])
+        a, b = a.astype(bool), b.astype(bool)
+        val = a & b
+        # 3-valued logic: False & null = False
+        mask = (am & bm) | (am & b) | (bm & a)
+        return _col(val & ~mask, mask)
+
+    def _or(self, fe):
+        (a, am), (b, bm) = self.eval(fe.children[0]), \
+            self.eval(fe.children[1])
+        a, b = a.astype(bool), b.astype(bool)
+        mask = (am & bm) | (am & ~b) | (bm & ~a)
+        return _col((a | b) & ~mask, mask)
+
+    def _not(self, fe):
+        a, am = self.eval(fe.children[0])
+        return _col(~a.astype(bool), am)
+
+    def _isnotnull(self, fe):
+        _, am = self.eval(fe.children[0])
+        return _col(~am)
+
+    def _isnull(self, fe):
+        _, am = self.eval(fe.children[0])
+        return _col(am)
+
+    def _in(self, fe):
+        a, am = self.eval(fe.children[0])
+        vals = {c.value for c in fe.children[1:]}
+        hit = np.array([v in vals for v in a.tolist()], bool)
+        return _col(hit, am)
+
+    def _cast(self, fe):
+        a, am = self.eval(fe.children[0])
+        dt = fe.dtype
+        from auron_tpu.ir.schema import TypeId
+        if dt is None:
+            return _col(a, am)
+        if dt.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+            return _col(a.astype(np.float64), am)
+        if dt.id in (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.INT64):
+            return _col(a.astype(np.float64).astype(np.int64), am)
+        if dt.id == TypeId.STRING:
+            return _col(np.array([str(v) for v in a.tolist()], object), am)
+        return _col(a, am)
+
+    def _casewhen(self, fe):
+        # children: [cond1, val1, cond2, val2, ..., else?]
+        ch = fe.children
+        pairs = [(ch[i], ch[i + 1]) for i in range(0, len(ch) - 1, 2)]
+        has_else = len(ch) % 2 == 1
+        out, mask = None, None
+        decided = np.zeros(self.n, bool)
+        for cond, val in pairs:
+            c, cm = self.eval(cond)
+            v, vm = self.eval(val)
+            take = c.astype(bool) & ~cm & ~decided
+            if out is None:
+                out = np.where(take, v, v[0] if len(v) else 0)
+                mask = np.ones(self.n, bool)
+            out = np.where(take, v, out)
+            mask = np.where(take, vm, mask)
+            decided |= take
+        if has_else:
+            v, vm = self.eval(ch[-1])
+            out = np.where(decided, out, v)
+            mask = np.where(decided, mask, vm)
+        return _col(out, np.asarray(mask, bool))
+
+    def _substring(self, fe):
+        a, am = self.eval(fe.children[0])
+        pos = int(fe.children[1].value)
+        ln = int(fe.children[2].value)
+        start = pos - 1 if pos > 0 else 0
+        out = np.array([str(v)[start:start + ln] for v in a.tolist()],
+                       object)
+        return _col(out, am)
+
+
+def _to_table(cols: List[Tuple[np.ndarray, np.ndarray]], names: List[str],
+              schema: Schema) -> pa.Table:
+    arrow = to_arrow_schema(schema)
+    arrays = []
+    for (v, m), f in zip(cols, arrow):
+        arrays.append(pa.array(
+            [None if mm else vv for vv, mm in zip(v.tolist(), m.tolist())],
+            type=f.type))
+    return pa.Table.from_arrays(arrays, schema=arrow)
+
+
+def _key_tuples(table: pa.Table, keys: Sequence[ForeignExpr]) -> List[Tuple]:
+    ev = _Eval(table)
+    cols = [ev.eval(k) for k in keys]
+    return [tuple(None if m[i] else _norm(v[i]) for v, m in cols)
+            for i in range(table.num_rows)]
+
+
+def _norm(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.str_):
+        return str(v)
+    return v
+
+
+class PyArrowEngine:
+    """ForeignEngine executing the corpus' op vocabulary on host."""
+
+    def execute(self, node: ForeignNode, child_tables: List[pa.Table]
+                ) -> pa.Table:
+        fn = getattr(self, "_" + _snake(node.op), None)
+        if fn is None:
+            raise NotImplementedError(f"oracle op {node.op}")
+        return fn(node, child_tables)
+
+    # -- sources ----------------------------------------------------------
+
+    def _file_source_scan_exec(self, node, children):
+        import pyarrow.parquet as pq
+        names = node.output.names()
+        parts = []
+        for group in node.attrs["file_groups"]:
+            for path in group:
+                t = pq.read_table(path)
+                parts.append(t.select([c for c in names
+                                       if c in t.schema.names]))
+        table = pa.concat_tables(parts) if parts else \
+            pa.Table.from_pylist([], schema=to_arrow_schema(node.output))
+        return table.combine_chunks()
+
+    def _local_table_scan_exec(self, node, children):
+        return pa.Table.from_pylist(
+            node.attrs.get("rows", []),
+            schema=to_arrow_schema(node.output))
+
+    # -- row ops ----------------------------------------------------------
+
+    def _project_exec(self, node, children):
+        t = children[0]
+        ev = _Eval(t)
+        cols = [ev.eval(e) for e in node.attrs["project_list"]]
+        return _to_table(cols, node.output.names(), node.output)
+
+    def _filter_exec(self, node, children):
+        t = children[0]
+        ev = _Eval(t)
+        v, m = ev.eval(node.attrs["condition"])
+        keep = v.astype(bool) & ~m
+        return t.filter(pa.array(keep))
+
+    def _sort_rows(self, t: pa.Table, sort_order) -> np.ndarray:
+        idx = np.arange(t.num_rows)
+        ev = _Eval(t)
+        # stable sorts applied from minor to major key
+        for so in reversed(list(sort_order)):
+            v, m = ev.eval(so.children[0])
+            asc = bool(so.attrs.get("asc", True))
+            nulls_first = bool(so.attrs.get("nulls_first", asc))
+            v, m = v[idx], m[idx]
+            if asc:
+                if v.dtype == object:
+                    order = np.argsort(np.array([str(x) for x in v]),
+                                       kind="stable")
+                else:
+                    order = np.argsort(v, kind="stable")
+            else:
+                order = _stable_desc(v)
+            nulls = m[order]
+            order = np.concatenate([order[nulls], order[~nulls]]) \
+                if nulls_first else \
+                np.concatenate([order[~nulls], order[nulls]])
+            idx = idx[order]
+        return idx
+
+    def _sort_exec(self, node, children):
+        t = children[0]
+        return t.take(pa.array(self._sort_rows(t, node.attrs["sort_order"])))
+
+    def _global_limit_exec(self, node, children):
+        off = int(node.attrs.get("offset", 0))
+        return children[0].slice(off, int(node.attrs["limit"]))
+
+    _local_limit_exec = _global_limit_exec
+    _collect_limit_exec = _global_limit_exec
+
+    def _take_ordered_and_project_exec(self, node, children):
+        t = children[0]
+        idx = self._sort_rows(t, node.attrs["sort_order"])
+        off = int(node.attrs.get("offset", 0))
+        idx = idx[off:off + int(node.attrs["limit"])]
+        t = t.take(pa.array(idx))
+        ev = _Eval(t)
+        cols = [ev.eval(e) for e in node.attrs["project_list"]]
+        return _to_table(cols, node.output.names(), node.output)
+
+    def _union_exec(self, node, children):
+        schema = to_arrow_schema(node.output)
+        return pa.concat_tables(
+            [c.rename_columns(schema.names) for c in children])
+
+    def _expand_exec(self, node, children):
+        t = children[0]
+        ev = _Eval(t)
+        outs = []
+        for proj in node.attrs["projections"]:
+            cols = [ev.eval(e) for e in proj]
+            outs.append(_to_table(cols, node.output.names(), node.output))
+        return pa.concat_tables(outs)
+
+    # -- exchanges are identities in the single-process oracle -------------
+
+    def _shuffle_exchange_exec(self, node, children):
+        return children[0]
+
+    def _broadcast_exchange_exec(self, node, children):
+        return children[0]
+
+    # -- aggregation -------------------------------------------------------
+
+    def _hash_aggregate_exec(self, node, children):
+        mode = node.attrs.get("mode", "single")
+        if mode == "partial":
+            return children[0]          # final recomputes from raw rows
+        t = children[0]
+        grouping = list(node.attrs.get("grouping", ()))
+        aggs = list(node.attrs.get("aggs", ()))
+        ev = _Eval(t)
+        gcols = [ev.eval(g) for g in grouping]
+        keys = [tuple(None if m[i] else _norm(v[i]) for v, m in gcols)
+                for i in range(t.num_rows)]
+        groups: Dict[Tuple, List[int]] = {}
+        if grouping:
+            for i, k in enumerate(keys):
+                groups.setdefault(k, []).append(i)
+        else:
+            groups[()] = list(range(t.num_rows))
+        acols = []
+        for a in aggs:
+            fn_node = a.children[0]
+            args = [ev.eval(c) for c in fn_node.children] or [_col(
+                np.ones(t.num_rows))]
+            acols.append((fn_node.name, args,
+                          bool(a.attrs.get("distinct", False))))
+        out_rows = []
+        for k, idxs in groups.items():
+            row = list(k)
+            for name, args, distinct in acols:
+                v, m = args[0]
+                vals = [(_norm(v[i])) for i in idxs if not m[i]]
+                if distinct:
+                    vals = list(dict.fromkeys(vals))
+                row.append(_agg_value(name, vals))
+            out_rows.append(row)
+        names = node.output.names()
+        return pa.Table.from_pylist(
+            [dict(zip(names, r)) for r in out_rows],
+            schema=to_arrow_schema(node.output))
+
+    _object_hash_aggregate_exec = _hash_aggregate_exec
+    _sort_aggregate_exec = _hash_aggregate_exec
+
+    # -- joins -------------------------------------------------------------
+
+    def _join(self, node, children):
+        left, right = children
+        jt = node.attrs.get("join_type", "Inner")
+        lk = _key_tuples(left, node.attrs["left_keys"])
+        rk = _key_tuples(right, node.attrs["right_keys"])
+        index: Dict[Tuple, List[int]] = {}
+        for i, k in enumerate(rk):
+            if None not in k:
+                index.setdefault(k, []).append(i)
+        li, ri = [], []
+        matched_r = np.zeros(len(rk), bool)
+        for i, k in enumerate(lk):
+            hits = index.get(k, []) if None not in k else []
+            if jt in ("Inner", "LeftOuter", "RightOuter", "FullOuter"):
+                for j in hits:
+                    li.append(i)
+                    ri.append(j)
+                    matched_r[j] = True
+                if not hits and jt in ("LeftOuter", "FullOuter"):
+                    li.append(i)
+                    ri.append(-1)
+            elif jt == "LeftSemi":
+                if hits:
+                    li.append(i)
+            elif jt == "LeftAnti":
+                if not hits:
+                    li.append(i)
+        lt = left.take(pa.array(li)) if li else left.slice(0, 0)
+        if jt in ("LeftSemi", "LeftAnti"):
+            return lt
+        rtake = [j if j >= 0 else None for j in ri]
+        rt = right.take(pa.array(rtake, type=pa.int64())) if rtake else \
+            right.slice(0, 0)
+        cols = list(lt.columns) + list(rt.columns)
+        top = pa.Table.from_arrays(cols, names=_join_names(left, right))
+        if jt in ("RightOuter", "FullOuter"):
+            # append unmatched right rows with null left columns
+            extra = np.where(~matched_r)[0]
+            null_l = pa.Table.from_pylist(
+                [{c: None for c in left.schema.names}
+                 for _ in range(len(extra))],
+                schema=left.schema)
+            rt2 = right.take(pa.array(extra))
+            bottom = pa.Table.from_arrays(
+                list(null_l.columns) + list(rt2.columns),
+                names=_join_names(left, right))
+            return pa.concat_tables([top, bottom])
+        return top
+
+    _sort_merge_join_exec = _join
+    _shuffled_hash_join_exec = _join
+    _broadcast_hash_join_exec = _join
+
+    # -- window ------------------------------------------------------------
+
+    def _window_exec(self, node, children):
+        t = children[0]
+        ev = _Eval(t)
+        part = [ev.eval(e) for e in node.attrs.get("partition_spec", ())]
+        pkeys = [tuple(None if m[i] else _norm(v[i]) for v, m in part)
+                 for i in range(t.num_rows)] if part else \
+            [()] * t.num_rows
+        order_idx = self._sort_rows(t, node.attrs.get("order_spec", ()))
+        groups: Dict[Tuple, List[int]] = {}
+        for i in order_idx:
+            groups.setdefault(pkeys[i], []).append(int(i))
+        extra_cols: Dict[str, List] = {}
+        base_names = set(t.schema.names)
+        for w in node.attrs.get("window_exprs", ()):
+            out = [None] * t.num_rows
+            fn = w["fn"]
+            for _, idxs in groups.items():
+                if fn == "row_number":
+                    for r, i in enumerate(idxs):
+                        out[i] = r + 1
+                elif fn == "rank" or fn == "dense_rank":
+                    okeys = [tuple(_norm(x) for x in row) for row in
+                             _order_keys(t, node.attrs.get("order_spec",
+                                                           ()), idxs)]
+                    rank = 0
+                    dense = 0
+                    prev = object()
+                    for r, (i, k) in enumerate(zip(idxs, okeys)):
+                        if k != prev:
+                            rank = r + 1
+                            dense += 1
+                            prev = k
+                        out[i] = rank if fn == "rank" else dense
+                elif fn == "agg":
+                    agg = w["agg"]
+                    fn_node = agg.children[0]
+                    argv = ev.eval(fn_node.children[0]) if \
+                        fn_node.children else _col(np.ones(t.num_rows))
+                    v, m = argv
+                    vals = [_norm(v[i]) for i in idxs if not m[i]]
+                    res = _agg_value(fn_node.name, vals)
+                    for i in idxs:
+                        out[i] = res
+                else:
+                    raise NotImplementedError(f"window fn {fn}")
+            extra_cols[w["name"]] = out
+        names = node.output.names()
+        arrays = []
+        arrow = to_arrow_schema(node.output)
+        for f in arrow:
+            if f.name in base_names:
+                arrays.append(t[f.name].combine_chunks().cast(f.type))
+            else:
+                arrays.append(pa.array(extra_cols[f.name], type=f.type))
+        return pa.Table.from_arrays(arrays, schema=arrow)
+
+
+def _order_keys(t, order_spec, idxs):
+    ev = _Eval(t)
+    cols = [ev.eval(s.children[0]) for s in order_spec]
+    return [[(None if m[i] else v[i]) for v, m in cols] for i in idxs]
+
+
+def _stable_desc(v: np.ndarray) -> np.ndarray:
+    """Stable descending argsort (ties keep original order)."""
+    if v.dtype == object:
+        keys = np.array([str(x) for x in v])
+        order = np.argsort(keys, kind="stable")[::-1]
+        # re-stabilize ties
+        out = []
+        i = 0
+        while i < len(order):
+            j = i
+            while j + 1 < len(order) and keys[order[j + 1]] == \
+                    keys[order[i]]:
+                j += 1
+            out.extend(sorted(order[i:j + 1]))
+            i = j + 1
+        return np.array(out, int)
+    neg = -v.astype(np.float64)
+    return np.argsort(neg, kind="stable")
+
+
+def _agg_value(name: str, vals: List) -> Any:
+    if name == "Count":
+        return len(vals)
+    if not vals:
+        return None
+    if name == "Sum":
+        return sum(vals)
+    if name == "Average":
+        return sum(vals) / len(vals)
+    if name == "Min":
+        return min(vals)
+    if name == "Max":
+        return max(vals)
+    if name == "First":
+        return vals[0]
+    raise NotImplementedError(f"oracle aggregate {name}")
+
+
+def _join_names(left: pa.Table, right: pa.Table) -> List[str]:
+    return list(left.schema.names) + list(right.schema.names)
+
+
+def _snake(op: str) -> str:
+    out = []
+    for i, c in enumerate(op):
+        if c.isupper() and i and not op[i - 1].isupper():
+            out.append("_")
+        out.append(c.lower())
+    return "".join(out)

@@ -1,0 +1,584 @@
+"""TPC-DS query corpus as foreign physical plans.
+
+Each query builder takes a `Catalog` and returns the already-optimized
+physical plan Spark would hand the converter for that TPC-DS query family:
+scans with pushed filters, broadcast joins on dims, the canonical
+partial-agg -> hash exchange -> final-agg pair, TakeOrderedAndProject on
+top.  Query shapes follow the official TPC-DS queries the reference's IT
+matrix runs (dev/auron-it/src/main/resources/tpcds-queries/); columns are
+restricted to the generated subset schema.
+
+Register order doubles as the default run order of `auron_tpu.it.runner`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from auron_tpu.frontend.foreign import (ForeignExpr, ForeignNode, falias,
+                                        fcall, fcol, flit)
+from auron_tpu.ir.schema import DataType, Field, Schema
+
+from auron_tpu.it.datagen import Catalog
+
+I32 = DataType.int32()
+I64 = DataType.int64()
+F64 = DataType.float64()
+STR = DataType.string()
+
+QUERIES: Dict[str, Callable[[Catalog], ForeignNode]] = {}
+
+
+def _q(name: str):
+    def deco(fn):
+        QUERIES[name] = fn
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# plan-building helpers (the idioms Spark's planner emits)
+# ---------------------------------------------------------------------------
+
+def so(e: ForeignExpr, asc: bool = True,
+       nulls_first: Optional[bool] = None) -> ForeignExpr:
+    return ForeignExpr("SortOrder", children=(e,),
+                       attrs={"asc": asc,
+                              "nulls_first": asc if nulls_first is None
+                              else nulls_first})
+
+
+def agg(fn: str, child: Optional[ForeignExpr], dtype: DataType,
+        distinct: bool = False) -> ForeignExpr:
+    children = (child,) if child is not None else ()
+    return ForeignExpr("AggregateExpression",
+                       children=(fcall(fn, *children, dtype=dtype),),
+                       attrs={"distinct": distinct})
+
+
+def ffilter(child: ForeignNode, cond: ForeignExpr) -> ForeignNode:
+    return ForeignNode("FilterExec", children=(child,), output=child.output,
+                       attrs={"condition": cond})
+
+
+def fproject(child: ForeignNode, exprs: Sequence[ForeignExpr],
+             out: Schema) -> ForeignNode:
+    return ForeignNode("ProjectExec", children=(child,), output=out,
+                       attrs={"project_list": list(exprs)})
+
+
+def bhj(probe: ForeignNode, build: ForeignNode, left_key: ForeignExpr,
+        right_key: ForeignExpr, join_type: str = "Inner") -> ForeignNode:
+    bx = ForeignNode("BroadcastExchangeExec", children=(build,),
+                     output=build.output)
+    return ForeignNode(
+        "BroadcastHashJoinExec", children=(probe, bx),
+        output=probe.output.concat(build.output),
+        attrs={"left_keys": [left_key], "right_keys": [right_key],
+               "join_type": join_type, "build_side": "right"})
+
+
+def smj(left: ForeignNode, right: ForeignNode,
+        left_keys: Sequence[ForeignExpr], right_keys: Sequence[ForeignExpr],
+        join_type: str = "Inner", n_parts: int = 4,
+        out: Optional[Schema] = None) -> ForeignNode:
+    def exchange(child, keys):
+        return ForeignNode(
+            "ShuffleExchangeExec", children=(child,), output=child.output,
+            attrs={"partitioning": {"mode": "hash",
+                                    "num_partitions": n_parts,
+                                    "expressions": list(keys)}})
+    if out is None:
+        out = left.output.concat(right.output) \
+            if join_type in ("Inner", "LeftOuter", "RightOuter",
+                             "FullOuter") else left.output
+    return ForeignNode(
+        "SortMergeJoinExec",
+        children=(exchange(left, left_keys), exchange(right, right_keys)),
+        output=out,
+        attrs={"left_keys": list(left_keys),
+               "right_keys": list(right_keys), "join_type": join_type})
+
+
+def two_phase_agg(child: ForeignNode, grouping: Sequence[ForeignExpr],
+                  group_fields: Sequence[Field],
+                  aggs: Sequence[Tuple[str, ForeignExpr, Field]],
+                  n_parts: int = 4) -> ForeignNode:
+    """partial HashAggregate -> hash ShuffleExchange -> final HashAggregate
+    (the shape of every TPC-DS group-by stage)."""
+    agg_exprs = [a for _, a, _ in aggs]
+    agg_names = [n for n, _, _ in aggs]
+    state_fields = list(group_fields)
+    for name, a, out_f in aggs:
+        fn = a.children[0].name
+        if fn == "Average":
+            state_fields += [Field(f"{name}#sum", F64),
+                             Field(f"{name}#count", I64)]
+        elif fn == "Count":
+            state_fields.append(Field(f"{name}#count", I64))
+        else:
+            state_fields.append(Field(f"{name}#{fn.lower()}", out_f.dtype))
+    partial = ForeignNode(
+        "HashAggregateExec", children=(child,),
+        output=Schema(tuple(state_fields)),
+        attrs={"grouping": list(grouping), "aggs": agg_exprs,
+               "agg_names": agg_names, "mode": "partial"})
+    exchange = ForeignNode(
+        "ShuffleExchangeExec", children=(partial,), output=partial.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": n_parts,
+            "expressions": [g if g.name != "Alias" else g.children[0]
+                            for g in grouping]}})
+    final_out = Schema(tuple(group_fields) + tuple(f for _, _, f in aggs))
+    return ForeignNode(
+        "HashAggregateExec", children=(exchange,), output=final_out,
+        attrs={"grouping": list(grouping), "aggs": agg_exprs,
+               "agg_names": agg_names, "mode": "final"})
+
+
+def take_ordered(child: ForeignNode, orders: Sequence[ForeignExpr],
+                 limit: int, project: Sequence[ForeignExpr],
+                 out: Schema) -> ForeignNode:
+    return ForeignNode(
+        "TakeOrderedAndProjectExec", children=(child,), output=out,
+        attrs={"sort_order": list(orders), "limit": limit,
+               "project_list": list(project)})
+
+
+def _dim_date(cat: Catalog, cond: ForeignExpr,
+              cols: Sequence[str]) -> ForeignNode:
+    scan = cat.scan("date_dim", cols, pushed_filters=[cond])
+    return ffilter(scan, cond)
+
+
+# ---------------------------------------------------------------------------
+# the corpus
+# ---------------------------------------------------------------------------
+
+@_q("q03")
+def q03(cat: Catalog) -> ForeignNode:
+    """TPC-DS q03: brand revenue for manufacturer in November by year."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_moy", I32), flit(11)),
+                   ["d_date_sk", "d_year", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_brand", "i_manufact_id"])
+    it = ffilter(it, fcall("LessThanOrEqual", fcol("i_manufact_id", I32),
+                           flit(100)))
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("d_year", I32), fcol("i_brand", STR)],
+        group_fields=[Field("d_year", I32), Field("i_brand", STR)],
+        aggs=[("sum_agg", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("sum_agg", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("d_year", I32)),
+                so(fcol("sum_agg", F64), asc=False),
+                so(fcol("i_brand", STR))],
+        limit=100,
+        project=[fcol("d_year", I32), fcol("i_brand", STR),
+                 fcol("sum_agg", F64)],
+        out=Schema((Field("d_year", I32), Field("i_brand", STR),
+                    Field("sum_agg", F64))))
+
+
+@_q("q07")
+def q07(cat: Catalog) -> ForeignNode:
+    """TPC-DS q07 family: average quantities/prices per item under a
+    promotion-channel predicate in one year."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_promo_sk",
+                   "ss_quantity", "ss_sales_price"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32), flit(2000)),
+                   ["d_date_sk", "d_year"])
+    pr = cat.scan("promotion",
+                  ["p_promo_sk", "p_channel_email", "p_channel_event"])
+    pr = ffilter(pr, fcall(
+        "Or",
+        fcall("EqualTo", fcol("p_channel_email", STR), flit("N")),
+        fcall("EqualTo", fcol("p_channel_event", STR), flit("N"))))
+    it = cat.scan("item", ["i_item_sk", "i_item_id"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, pr, fcol("ss_promo_sk", I64), fcol("p_promo_sk", I64))
+    j3 = bhj(j2, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j3,
+        grouping=[fcol("i_item_id", STR)],
+        group_fields=[Field("i_item_id", STR)],
+        aggs=[("agg1", agg("Average", fcall(
+                   "Cast", fcol("ss_quantity", I32), dtype=F64), F64),
+               Field("agg1", F64)),
+              ("agg2", agg("Average", fcol("ss_sales_price", F64), F64),
+               Field("agg2", F64)),
+              ("cnt", agg("Count", fcol("ss_quantity", I32), I64),
+               Field("cnt", I64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("i_item_id", STR))], limit=100,
+        project=[fcol("i_item_id", STR), fcol("agg1", F64),
+                 fcol("agg2", F64), fcol("cnt", I64)],
+        out=Schema((Field("i_item_id", STR), Field("agg1", F64),
+                    Field("agg2", F64), Field("cnt", I64))))
+
+
+@_q("q19")
+def q19(cat: Catalog) -> ForeignNode:
+    """TPC-DS q19 family: brand revenue by customer geography — the
+    join-heavy shape (5-way star join)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+                   "ss_store_sk", "ss_ext_sales_price"])
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("EqualTo", fcol("d_moy", I32), flit(11)),
+              fcall("EqualTo", fcol("d_year", I32), flit(1999))),
+        ["d_date_sk", "d_year", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_brand", "i_manager_id"])
+    it = ffilter(it, fcall("LessThanOrEqual", fcol("i_manager_id", I32),
+                           flit(10)))
+    cu = cat.scan("customer", ["c_customer_sk", "c_current_addr_sk"])
+    caddr = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    st = cat.scan("store", ["s_store_sk", "s_state"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    j3 = smj(j2, cu, [fcol("ss_customer_sk", I64)],
+             [fcol("c_customer_sk", I64)])
+    j4 = bhj(j3, caddr, fcol("c_current_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    j5 = bhj(j4, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    grouped = two_phase_agg(
+        j5,
+        grouping=[fcol("i_brand", STR), fcol("ca_state", STR)],
+        group_fields=[Field("i_brand", STR), Field("ca_state", STR)],
+        aggs=[("ext_price", agg("Sum", fcol("ss_ext_sales_price", F64),
+                                F64),
+               Field("ext_price", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("ext_price", F64), asc=False),
+                so(fcol("i_brand", STR)), so(fcol("ca_state", STR))],
+        limit=100,
+        project=[fcol("i_brand", STR), fcol("ca_state", STR),
+                 fcol("ext_price", F64)],
+        out=Schema((Field("i_brand", STR), Field("ca_state", STR),
+                    Field("ext_price", F64))))
+
+
+@_q("q42")
+def q42(cat: Catalog) -> ForeignNode:
+    """TPC-DS q42: category revenue for one month/year."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("EqualTo", fcol("d_moy", I32), flit(12)),
+              fcall("EqualTo", fcol("d_year", I32), flit(1998))),
+        ["d_date_sk", "d_year", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_category"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("d_year", I32), fcol("i_category", STR)],
+        group_fields=[Field("d_year", I32), Field("i_category", STR)],
+        aggs=[("total", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("total", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("total", F64), asc=False),
+                so(fcol("d_year", I32)), so(fcol("i_category", STR))],
+        limit=100,
+        project=[fcol("d_year", I32), fcol("i_category", STR),
+                 fcol("total", F64)],
+        out=Schema((Field("d_year", I32), Field("i_category", STR),
+                    Field("total", F64))))
+
+
+@_q("q55")
+def q55(cat: Catalog) -> ForeignNode:
+    """TPC-DS q55: brand revenue for one manager's items in a month."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("EqualTo", fcol("d_moy", I32), flit(11)),
+              fcall("EqualTo", fcol("d_year", I32), flit(1999))),
+        ["d_date_sk", "d_year", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_brand", "i_manager_id"])
+    it = ffilter(it, fcall("LessThanOrEqual", fcol("i_manager_id", I32),
+                           flit(20)))
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("i_brand", STR)],
+        group_fields=[Field("i_brand", STR)],
+        aggs=[("ext_price", agg("Sum", fcol("ss_ext_sales_price", F64),
+                                F64),
+               Field("ext_price", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("ext_price", F64), asc=False),
+                so(fcol("i_brand", STR))],
+        limit=100,
+        project=[fcol("i_brand", STR), fcol("ext_price", F64)],
+        out=Schema((Field("i_brand", STR), Field("ext_price", F64))))
+
+
+@_q("q01")
+def q01(cat: Catalog) -> ForeignNode:
+    """TPC-DS q01: customers whose store returns exceed 1.2x the store
+    average — aggregation over aggregation with a broadcast self-join."""
+    def ctr() -> ForeignNode:
+        sr = cat.scan("store_returns",
+                      ["sr_customer_sk", "sr_store_sk", "sr_return_amt"])
+        return two_phase_agg(
+            sr,
+            grouping=[fcol("sr_customer_sk", I64),
+                      fcol("sr_store_sk", I64)],
+            group_fields=[Field("sr_customer_sk", I64),
+                          Field("sr_store_sk", I64)],
+            aggs=[("ctr_total_return",
+                   agg("Sum", fcol("sr_return_amt", F64), F64),
+                   Field("ctr_total_return", F64))])
+
+    # per-store threshold = avg(ctr_total_return) * 1.2 over the ctr table
+    avg_side = two_phase_agg(
+        ctr(),
+        grouping=[fcol("sr_store_sk", I64)],
+        group_fields=[Field("sr_store_sk", I64)],
+        aggs=[("avg_return", agg("Average",
+                                 fcol("ctr_total_return", F64), F64),
+               Field("avg_return", F64))],
+        n_parts=2)
+    threshold = fproject(
+        avg_side,
+        [falias(fcol("sr_store_sk", I64), "avg_store_sk"),
+         falias(fcall("Multiply", fcol("avg_return", F64), flit(1.2)),
+                "threshold")],
+        Schema((Field("avg_store_sk", I64), Field("threshold", F64))))
+    joined = bhj(ctr(), threshold, fcol("sr_store_sk", I64),
+                 fcol("avg_store_sk", I64))
+    over = ffilter(joined, fcall(
+        "GreaterThan", fcol("ctr_total_return", F64),
+        fcol("threshold", F64)))
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+    named = smj(over, cu, [fcol("sr_customer_sk", I64)],
+                [fcol("c_customer_sk", I64)])
+    return take_ordered(
+        named, orders=[so(fcol("c_customer_id", STR)),
+                       so(fcol("sr_store_sk", I64)),
+                       so(fcol("ctr_total_return", F64), asc=False)],
+        limit=100,
+        project=[fcol("c_customer_id", STR)],
+        out=Schema((Field("c_customer_id", STR),)))
+
+
+@_q("q65w")
+def q65w(cat: Catalog) -> ForeignNode:
+    """q65/q67 family: top revenue items per store via a rank() window
+    over aggregated revenue."""
+    ss = cat.scan("store_sales",
+                  ["ss_item_sk", "ss_store_sk", "ss_sales_price",
+                   "ss_quantity"])
+    grouped = two_phase_agg(
+        ss,
+        grouping=[fcol("ss_store_sk", I64), fcol("ss_item_sk", I64)],
+        group_fields=[Field("ss_store_sk", I64), Field("ss_item_sk", I64)],
+        aggs=[("revenue", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("revenue", F64))])
+    # Spark partitions window input by the window partition key
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {"mode": "hash", "num_partitions": 4,
+                                "expressions": [fcol("ss_store_sk", I64)]}})
+    win_out = Schema((Field("ss_store_sk", I64), Field("ss_item_sk", I64),
+                      Field("revenue", F64), Field("rk", I32)))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "rk", "fn": "rank", "args": [], "agg": None,
+                    "dtype": I32}],
+               "partition_spec": [fcol("ss_store_sk", I64)],
+               "order_spec": [so(fcol("revenue", F64), asc=False),
+                              so(fcol("ss_item_sk", I64))]})
+    top = ffilter(win, fcall("LessThanOrEqual", fcol("rk", I32), flit(5)))
+    return take_ordered(
+        top,
+        orders=[so(fcol("ss_store_sk", I64)), so(fcol("rk", I32)),
+                so(fcol("ss_item_sk", I64))],
+        limit=200,
+        project=[fcol("ss_store_sk", I64), fcol("ss_item_sk", I64),
+                 fcol("revenue", F64), fcol("rk", I32)],
+        out=win_out)
+
+
+@_q("q16a")
+def q16a(cat: Catalog) -> ForeignNode:
+    """q16 family: anti-join — sales whose ticket never came back, counted
+    per store (LeftAnti on the returns table)."""
+    ss = cat.scan("store_sales",
+                  ["ss_ticket_number", "ss_item_sk", "ss_store_sk",
+                   "ss_net_profit"])
+    sr = cat.scan("store_returns", ["sr_ticket_number", "sr_item_sk"])
+    anti = smj(ss, sr,
+               [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+               [fcol("sr_ticket_number", I64), fcol("sr_item_sk", I64)],
+               join_type="LeftAnti")
+    grouped = two_phase_agg(
+        anti,
+        grouping=[fcol("ss_store_sk", I64)],
+        group_fields=[Field("ss_store_sk", I64)],
+        aggs=[("kept", agg("Count", fcol("ss_ticket_number", I64), I64),
+               Field("kept", I64)),
+              ("profit", agg("Sum", fcol("ss_net_profit", F64), F64),
+               Field("profit", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("ss_store_sk", I64))], limit=100,
+        project=[fcol("ss_store_sk", I64), fcol("kept", I64),
+                 fcol("profit", F64)],
+        out=Schema((Field("ss_store_sk", I64), Field("kept", I64),
+                    Field("profit", F64))))
+
+
+@_q("q71u")
+def q71u(cat: Catalog) -> ForeignNode:
+    """q71 family: brand revenue unioned across the three sales channels."""
+    def channel(table: str, date_col: str, item_col: str,
+                price_col: str) -> ForeignNode:
+        sc = cat.scan(table, [date_col, item_col, price_col])
+        dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32),
+                                  flit(2001)),
+                       ["d_date_sk", "d_year"])
+        j = bhj(sc, dd, fcol(date_col, I64), fcol("d_date_sk", I64))
+        return fproject(
+            j, [falias(fcol(item_col, I64), "sold_item_sk"),
+                falias(fcol(price_col, F64), "ext_price")],
+            Schema((Field("sold_item_sk", I64), Field("ext_price", F64))))
+
+    union_out = Schema((Field("sold_item_sk", I64),
+                        Field("ext_price", F64)))
+    un = ForeignNode(
+        "UnionExec",
+        children=(channel("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                          "ws_ext_sales_price"),
+                  channel("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                          "cs_ext_sales_price"),
+                  channel("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                          "ss_ext_sales_price")),
+        output=union_out)
+    it = cat.scan("item", ["i_item_sk", "i_brand", "i_manager_id"])
+    it = ffilter(it, fcall("LessThanOrEqual", fcol("i_manager_id", I32),
+                           flit(30)))
+    j = bhj(un, it, fcol("sold_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j,
+        grouping=[fcol("i_brand", STR)],
+        group_fields=[Field("i_brand", STR)],
+        aggs=[("ext_price", agg("Sum", fcol("ext_price", F64), F64),
+               Field("ext_price", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("ext_price", F64), asc=False),
+                so(fcol("i_brand", STR))],
+        limit=100,
+        project=[fcol("i_brand", STR), fcol("ext_price", F64)],
+        out=Schema((Field("i_brand", STR), Field("ext_price", F64))))
+
+
+@_q("q27r")
+def q27r(cat: Catalog) -> ForeignNode:
+    """q27/q18 family: rollup over (category, state) via ExpandExec
+    (grouping sets) feeding the aggregate."""
+    ss = cat.scan("store_sales",
+                  ["ss_item_sk", "ss_store_sk", "ss_quantity"])
+    it = cat.scan("item", ["i_item_sk", "i_category"])
+    st = cat.scan("store", ["s_store_sk", "s_state"])
+    j1 = bhj(ss, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    j2 = bhj(j1, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    pre = fproject(
+        j2, [fcol("i_category", STR), fcol("s_state", STR),
+             falias(fcall("Cast", fcol("ss_quantity", I32), dtype=F64),
+                    "qty")],
+        Schema((Field("i_category", STR), Field("s_state", STR),
+                Field("qty", F64))))
+    expand_out = Schema((Field("i_category", STR), Field("s_state", STR),
+                         Field("qty", F64),
+                         Field("spark_grouping_id", I64)))
+    expand = ForeignNode(
+        "ExpandExec", children=(pre,), output=expand_out,
+        attrs={"projections": [
+            [fcol("i_category", STR), fcol("s_state", STR),
+             fcol("qty", F64), flit(0, I64)],
+            [fcol("i_category", STR), flit(None, STR), fcol("qty", F64),
+             flit(1, I64)],
+            [flit(None, STR), flit(None, STR), fcol("qty", F64),
+             flit(3, I64)],
+        ]})
+    grouped = two_phase_agg(
+        expand,
+        grouping=[fcol("i_category", STR), fcol("s_state", STR),
+                  fcol("spark_grouping_id", I64)],
+        group_fields=[Field("i_category", STR), Field("s_state", STR),
+                      Field("spark_grouping_id", I64)],
+        aggs=[("avg_qty", agg("Average", fcol("qty", F64), F64),
+               Field("avg_qty", F64)),
+              ("n", agg("Count", fcol("qty", F64), I64),
+               Field("n", I64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("spark_grouping_id", I64)),
+                so(fcol("i_category", STR), nulls_first=True),
+                so(fcol("s_state", STR), nulls_first=True)],
+        limit=200,
+        project=[fcol("i_category", STR), fcol("s_state", STR),
+                 fcol("spark_grouping_id", I64), fcol("avg_qty", F64),
+                 fcol("n", I64)],
+        out=Schema((Field("i_category", STR), Field("s_state", STR),
+                    Field("spark_grouping_id", I64),
+                    Field("avg_qty", F64), Field("n", I64))))
+
+
+@_q("q68s")
+def q68s(cat: Catalog) -> ForeignNode:
+    """q68 family: per-customer basket totals through a shuffled hash join
+    against the customer dim, with a HAVING-style filter on the agg."""
+    ss = cat.scan("store_sales",
+                  ["ss_customer_sk", "ss_ticket_number",
+                   "ss_ext_sales_price"])
+    grouped = two_phase_agg(
+        ss,
+        grouping=[fcol("ss_customer_sk", I64),
+                  fcol("ss_ticket_number", I64)],
+        group_fields=[Field("ss_customer_sk", I64),
+                      Field("ss_ticket_number", I64)],
+        aggs=[("basket", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("basket", F64))])
+    big = ffilter(grouped, fcall("GreaterThan", fcol("basket", F64),
+                                 flit(100.0)))
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+    named = smj(big, cu, [fcol("ss_customer_sk", I64)],
+                [fcol("c_customer_sk", I64)])
+    return take_ordered(
+        named,
+        orders=[so(fcol("c_customer_id", STR)),
+                so(fcol("ss_ticket_number", I64))],
+        limit=100,
+        project=[fcol("c_customer_id", STR),
+                 fcol("ss_ticket_number", I64), fcol("basket", F64)],
+        out=Schema((Field("c_customer_id", STR),
+                    Field("ss_ticket_number", I64),
+                    Field("basket", F64))))
+
+
+def build(name: str, cat: Catalog) -> ForeignNode:
+    return QUERIES[name](cat)
+
+
+def names() -> List[str]:
+    return list(QUERIES)

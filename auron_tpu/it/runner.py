@@ -1,0 +1,100 @@
+"""Differential query runner — QueryRunner.scala:33 analogue.
+
+Runs each corpus query twice through the same `AuronSession` front-end:
+once with conversion enabled (device engine; pyarrow oracle only serves
+any residual foreign sections) and once with `auron.enable=false` (pure
+host oracle — the vanilla-Spark role), then compares results with float
+tolerance and optionally checks plan stability against goldens.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from auron_tpu import config
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.it import compare, queries, stability
+from auron_tpu.it.datagen import Catalog
+from auron_tpu.it.oracle import PyArrowEngine
+
+
+@dataclass
+class QueryResult:
+    name: str
+    ok: bool
+    native_s: float
+    oracle_s: float
+    rows: int
+    all_native: bool
+    error: Optional[str] = None
+    plan_error: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        return {"name": self.name, "ok": self.ok,
+                "native_s": round(self.native_s, 4),
+                "oracle_s": round(self.oracle_s, 4), "rows": self.rows,
+                "all_native": self.all_native, "error": self.error,
+                "plan_error": self.plan_error}
+
+
+@dataclass
+class QueryRunner:
+    catalog: Catalog
+    golden_dir: Optional[str] = None
+    results: List[QueryResult] = field(default_factory=list)
+
+    def run(self, name: str) -> QueryResult:
+        plan = queries.build(name, self.catalog)
+
+        session = AuronSession(foreign_engine=PyArrowEngine())
+        t0 = time.perf_counter()
+        res = session.execute(plan)
+        native_s = time.perf_counter() - t0
+
+        with config.conf.scoped({"auron.enable": False}):
+            oracle_session = AuronSession(foreign_engine=PyArrowEngine())
+            t0 = time.perf_counter()
+            oracle = oracle_session.execute(plan)
+            oracle_s = time.perf_counter() - t0
+
+        diff = compare.compare_tables(res.table, oracle.table)
+        plan_err = None
+        if self.golden_dir is not None:
+            text = stability.render_plan(res.converted, res.ctx)
+            plan_err = stability.check_stability(name, text,
+                                                self.golden_dir)
+        qr = QueryResult(
+            name=name, ok=diff is None and plan_err is None,
+            native_s=native_s, oracle_s=oracle_s,
+            rows=res.table.num_rows, all_native=res.all_native(),
+            error=diff, plan_error=plan_err)
+        self.results.append(qr)
+        return qr
+
+    def run_all(self, names: Optional[List[str]] = None
+                ) -> List[QueryResult]:
+        for name in names or queries.names():
+            self.run(name)
+        return self.results
+
+    def report(self) -> str:
+        lines = [f"{'query':8} {'ok':4} {'native_s':>9} {'oracle_s':>9} "
+                 f"{'rows':>7} native"]
+        for r in self.results:
+            lines.append(
+                f"{r.name:8} {'PASS' if r.ok else 'FAIL':4} "
+                f"{r.native_s:9.3f} {r.oracle_s:9.3f} {r.rows:7d} "
+                f"{'yes' if r.all_native else 'NO'}")
+            if r.error:
+                lines.append(f"         diff: {r.error}")
+            if r.plan_error:
+                lines.append(f"         plan: {r.plan_error.splitlines()[0]}")
+        n_ok = sum(1 for r in self.results if r.ok)
+        lines.append(f"{n_ok}/{len(self.results)} passed")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps([r.to_dict() for r in self.results])

@@ -1,0 +1,92 @@
+"""Plan-stability checking — the PlanStabilityChecker analogue: the
+converted native plan (including exchange/broadcast subtrees) is rendered
+to a canonical text form and compared against a golden file, so an
+accidental conversion regression (an operator silently falling back to the
+foreign engine, a join strategy flip) fails the IT run even when results
+still match.
+
+Regenerate goldens with AURON_REGEN_GOLDEN=1 (the reference uses the same
+convention for its approved-plans directories)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from auron_tpu.frontend.converters import ConvertContext, ForeignWrap
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.node import Node
+
+
+def render_plan(converted, ctx: Optional[ConvertContext]) -> str:
+    """Canonical text rendering of the converted tree; IpcReaders are
+    expanded into their exchange/broadcast producer subtrees."""
+    lines: List[str] = []
+    _render(converted, ctx, 0, lines)
+    return "\n".join(lines) + "\n"
+
+
+def _render(node, ctx, depth: int, lines: List[str]) -> None:
+    pad = "  " * depth
+    if isinstance(node, ForeignWrap):
+        lines.append(f"{pad}Foreign[{node.node.op}]")
+        for c in node.children:
+            _render(c, ctx, depth + 1, lines)
+        return
+    if not isinstance(node, Node):
+        lines.append(f"{pad}{type(node).__name__}")
+        return
+    label = type(node).__name__
+    detail = ""
+    if isinstance(node, P.Agg):
+        detail = f" mode={node.exec_mode} aggs={[a.fn for a in node.aggs]}"
+    elif isinstance(node, (P.SortMergeJoin, P.HashJoin, P.BroadcastJoin)):
+        detail = f" type={node.join_type}"
+    elif isinstance(node, P.Sort):
+        detail = f" limit={node.fetch_limit}"
+    elif isinstance(node, P.ParquetScan):
+        detail = (f" parts={len(node.file_groups)}"
+                  f" pred={'yes' if node.predicate is not None else 'no'}")
+    elif isinstance(node, P.IpcReader):
+        kind = "?"
+        if ctx is not None:
+            if node.resource_id in ctx.exchanges:
+                job = ctx.exchanges[node.resource_id]
+                kind = f"shuffle:{job.partitioning.mode}" \
+                       f"[{job.partitioning.num_partitions}]"
+            elif node.resource_id in ctx.broadcasts:
+                kind = "broadcast"
+        lines.append(f"{pad}Exchange {kind}")
+        if ctx is not None:
+            job = ctx.exchanges.get(node.resource_id) or \
+                ctx.broadcasts.get(node.resource_id)
+            if job is not None:
+                _render(job.child, ctx, depth + 1, lines)
+        return
+    lines.append(f"{pad}{label}{detail}")
+    for c in node.children_nodes():
+        if isinstance(c, (Node, ForeignWrap)):
+            if isinstance(c, P.PlanNode) or isinstance(c, ForeignWrap):
+                _render(c, ctx, depth + 1, lines)
+            elif isinstance(c, P.UnionInput):
+                _render(c.child, ctx, depth + 1, lines)
+
+
+def check_stability(name: str, plan_text: str, golden_dir: str
+                    ) -> Optional[str]:
+    """None when stable; error message otherwise.  Writes the golden when
+    absent or when AURON_REGEN_GOLDEN=1."""
+    os.makedirs(golden_dir, exist_ok=True)
+    path = os.path.join(golden_dir, f"{name}.plan.txt")
+    regen = os.environ.get("AURON_REGEN_GOLDEN") == "1"
+    if regen or not os.path.exists(path):
+        with open(path, "w") as f:
+            f.write(plan_text)
+        return None
+    with open(path) as f:
+        golden = f.read()
+    if golden != plan_text:
+        return (f"plan for {name} deviates from golden {path} "
+                f"(set AURON_REGEN_GOLDEN=1 to approve):\n--- golden\n"
+                f"{golden}\n--- actual\n{plan_text}")
+    return None

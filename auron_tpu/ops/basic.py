@@ -107,16 +107,28 @@ class LimitExec(Operator):
 
 
 class UnionExec(Operator):
-    """Multi-input union; each input contributes its mapped partition
-    (proto:542-552 per-input partition mapping is resolved by the planner
-    into the child list for this task's partition)."""
+    """Multi-input union with the proto:542-552 per-input partition
+    mapping: this task's output partition streams exactly the child
+    partitions assigned to it (so multi-partition children are read once
+    across the union's output partitions, never replayed)."""
 
-    def __init__(self, children: List[Operator], schema: Schema):
+    def __init__(self, children: List[Operator], schema: Schema,
+                 assignments: Optional[List[Tuple[int, int]]] = None):
         super().__init__(schema, children)
+        # per-child (out_partition, child_local_partition); None = every
+        # partition streams every child at its own partition id (direct
+        # construction without a planner-provided mapping)
+        self.assignments = assignments
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
-        for i in range(len(self.children)):
-            for b in self.child_stream(ctx, i):
+        import dataclasses
+        assignments = self.assignments if self.assignments is not None \
+            else [(ctx.partition_id, ctx.partition_id)] * len(self.children)
+        for i, (out_pid, local_pid) in enumerate(assignments):
+            if out_pid != ctx.partition_id:
+                continue
+            sub = dataclasses.replace(ctx, partition_id=local_pid)
+            for b in self.child_stream(sub, i):
                 yield b.rename(self.schema.names()) \
                     if b.schema.names() != self.schema.names() else b
 

@@ -121,11 +121,11 @@ class WindowExec(Operator):
         out_cols: List[Any] = []
         for wf, arg_eval in zip(self.window_funcs, self._arg_evals):
             args = arg_eval(sorted_b, partition_id=ctx.partition_id)
-            out_cols.append(self._compute(wf, args, sorted_b, dict(
+            out_cols.append(_coerce_to(wf, self._compute(wf, args, sorted_b, dict(
                 row_number=row_number, rank=rank, idx=idx,
                 seg_start=seg_start, seg_end=seg_end, part_n=part_n,
                 seg_id=seg_id, og_start=og_start, order_bound=order_bound,
-                part_bound=part_bound, live=live, cap=cap)))
+                part_bound=part_bound, live=live, cap=cap))))
 
         result = sorted_b
         if self.output_window_cols:
@@ -269,6 +269,22 @@ class WindowExec(Operator):
                 has = _seg_total(val.validity.astype(jnp.int64), c) > 0
             return DeviceColumn(val.dtype, jnp.where(has, scan, 0), has)
         raise NotImplementedError(f"window agg {agg.fn!r}")
+
+
+def _coerce_to(wf: WindowFuncCall, col):
+    """Cast a computed window column to the declared return type (e.g.
+    Spark's rank/row_number are IntegerType while the kernel computes in
+    int64); the output schema is built from the declaration, and a dtype
+    mismatch would reinterpret raw buffers at the Arrow boundary."""
+    want = wf.return_type or _default_window_type(wf)
+    if isinstance(col, DeviceStringColumn) or want.is_decimal or \
+            col.dtype == want:
+        return col
+    try:
+        np_dt = want.numpy_dtype()
+    except Exception:
+        return col
+    return DeviceColumn(want, col.data.astype(np_dt), col.validity)
 
 
 def _default_window_type(wf: WindowFuncCall) -> DataType:

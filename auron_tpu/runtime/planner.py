@@ -166,7 +166,8 @@ class PhysicalPlanner:
 
     def _union(self, n: P.Union) -> Operator:
         children = [self.create_plan(i.child) for i in n.inputs]
-        return UnionExec(children, n.schema)
+        assignments = [(i.out_partition, i.partition) for i in n.inputs]
+        return UnionExec(children, n.schema, assignments)
 
     def _smj(self, n: P.SortMergeJoin) -> Operator:
         self._check("smj")

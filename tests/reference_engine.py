@@ -230,7 +230,15 @@ class _Interp:
         out = []
         names = n.schema.names()
         for i in n.inputs:
-            for r in self.run(i.child):
+            if i.out_partition != self.pid:
+                continue
+            saved = self.pid
+            self.pid = i.partition
+            try:
+                rows = self.run(i.child)
+            finally:
+                self.pid = saved
+            for r in rows:
                 out.append(dict(zip(names, r.values())))
         return out
 

@@ -1,0 +1,100 @@
+"""Regression tests for conversion-layer bugs found in review: global
+limit over multi-partition children, union flattened partition mapping,
+two-argument Logarithm, non-literal string-predicate patterns, and
+all_native() on foreign-only runs."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu import config
+from auron_tpu.frontend.expr_convert import NotConvertible, convert_expr
+from auron_tpu.frontend.foreign import ForeignNode, fcall, fcol, flit
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.ir.schema import DataType, Field, Schema
+
+I64 = DataType.int64()
+F64 = DataType.float64()
+STR = DataType.string()
+
+
+class _Engine:
+    def execute(self, node, child_tables):
+        if node.op == "LocalTableScanExec":
+            from auron_tpu.ir.schema import to_arrow_schema
+            return pa.Table.from_pylist(
+                node.attrs.get("rows", []),
+                schema=to_arrow_schema(node.output))
+        raise NotImplementedError(node.op)
+
+
+def _rows_plan(rows, schema):
+    return ForeignNode("LocalTableScanExec", output=schema,
+                       attrs={"rows": rows})
+
+
+def _hash_exchange(child, key, n):
+    return ForeignNode(
+        "ShuffleExchangeExec", children=(child,), output=child.output,
+        attrs={"partitioning": {"mode": "hash", "num_partitions": n,
+                                "expressions": [key]}})
+
+
+def test_global_limit_is_global_over_partitions():
+    sch = Schema((Field("x", I64),))
+    src = _rows_plan([{"x": i} for i in range(40)], sch)
+    ex = _hash_exchange(src, fcol("x", I64), 4)
+    lim = ForeignNode("GlobalLimitExec", children=(ex,), output=sch,
+                      attrs={"limit": 7})
+    res = AuronSession(foreign_engine=_Engine()).execute(lim)
+    assert res.table.num_rows == 7
+    assert res.all_native()
+
+
+def test_global_limit_offset_applied_once():
+    sch = Schema((Field("x", I64),))
+    src = _rows_plan([{"x": i} for i in range(10)], sch)
+    ex = _hash_exchange(src, fcol("x", I64), 3)
+    lim = ForeignNode("GlobalLimitExec", children=(ex,), output=sch,
+                      attrs={"limit": 100, "offset": 4})
+    res = AuronSession(foreign_engine=_Engine()).execute(lim)
+    assert res.table.num_rows == 6  # 10 - 4, not 10 - 3*4
+
+
+def test_union_mixed_partition_counts_no_duplication():
+    sch = Schema((Field("x", I64),))
+    a = _rows_plan([{"x": 1}, {"x": 2}], sch)
+    ex = _hash_exchange(a, fcol("x", I64), 2)
+    b = _rows_plan([{"x": 100}], sch)
+    u = ForeignNode("UnionExec", children=(ex, b), output=sch)
+    res = AuronSession(foreign_engine=_Engine()).execute(u)
+    assert sorted(r["x"] for r in res.to_pylist()) == [1, 2, 100]
+    assert res.all_native()
+
+
+def test_logarithm_base_semantics():
+    from auron_tpu.frontend.foreign import falias
+    sch = Schema((Field("v", F64),))
+    src = _rows_plan([{"v": 8.0}, {"v": 16.0}], sch)
+    proj = ForeignNode(
+        "ProjectExec", children=(src,),
+        output=Schema((Field("lb", F64),)),
+        attrs={"project_list": [
+            falias(fcall("Logarithm", flit(2.0), fcol("v", F64)), "lb")]})
+    res = AuronSession(foreign_engine=_Engine()).execute(proj)
+    got = sorted(r["lb"] for r in res.to_pylist())
+    assert got == pytest.approx([3.0, 4.0])
+
+
+def test_string_predicates_require_literal():
+    for op in ("StartsWith", "EndsWith", "Contains"):
+        with pytest.raises(NotConvertible):
+            convert_expr(fcall(op, fcol("a", STR), fcol("b", STR)))
+
+
+def test_all_native_false_on_foreign_only_run():
+    sch = Schema((Field("x", I64),))
+    src = _rows_plan([{"x": 1}], sch)
+    with config.conf.scoped({"auron.enable": False}):
+        res = AuronSession(foreign_engine=_Engine()).execute(src)
+    assert not res.all_native()
