@@ -1,0 +1,39 @@
+"""Build metadata — AuronBuildInfo.scala + the Auron Spark-UI tab
+(auron-spark-ui, AuronSQLAppStatusListener.scala:29) analogue: one place
+reporting version/revision/toolchain, surfaced on the profiling server's
+/status endpoint and importable by bridges."""
+
+from __future__ import annotations
+
+import platform
+import subprocess
+from typing import Dict
+
+VERSION = "0.1.0"
+
+
+def _git_revision() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=__file__.rsplit("/", 2)[0])
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def build_info() -> Dict[str, str]:
+    info = {
+        "name": "auron-tpu",
+        "version": VERSION,
+        "revision": _git_revision(),
+        "python": platform.python_version(),
+    }
+    try:
+        import jax
+        info["jax"] = jax.__version__
+        info["backend"] = jax.default_backend()
+    except Exception:
+        pass
+    return info

@@ -182,6 +182,13 @@ SPILL_COMPRESSION_CODEC = conf.define(
 SPILL_DIR = conf.define(
     "auron.spill.dir", "", "Directory for spill files ('' = system temp dir)."
 )
+SHUFFLE_SERVICE = conf.define(
+    "auron.shuffle.service", "inprocess",
+    "Exchange transport: inprocess | celeborn | uniffle (remote shuffle "
+    "service, AuronShuffleManager selection analogue).")
+SHUFFLE_SERVICE_ADDRESS = conf.define(
+    "auron.shuffle.service.address", "",
+    "host:port of the remote shuffle server for celeborn/uniffle modes.")
 SHUFFLE_COMPRESSION_CODEC = conf.define(
     "auron.shuffle.compression.codec", "zstd", "Codec for shuffle blocks."
 )
@@ -313,3 +320,22 @@ SPILL_MIN_TRIGGER = conf.define(
     "Consumers below this size are never forced to spill "
     "(reference MIN_TRIGGER_SIZE, auron-memmgr/src/lib.rs:36).",
 )
+PROFILING_HTTP_ENABLE = conf.define(
+    "auron.profiling.http.enable", False,
+    "Lazily start the HTTP profiling service on first task execution "
+    "(reference feature http-service, exec.rs:53-59): /debug/profile "
+    "(jax trace zip), /debug/pyspy (folded stacks), /metrics, /status.",
+)
+
+
+def _main() -> None:
+    """`python -m auron_tpu.config` writes the markdown config reference
+    (SparkAuronConfigurationDocGenerator analogue)."""
+    import sys
+    header = ("# Configuration reference\n\n"
+              "Generated by `python -m auron_tpu.config`.\n\n")
+    sys.stdout.write(header + conf.generate_doc() + "\n")
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,0 +1,43 @@
+"""Table-format scan providers (thirdparty integrations, SURVEY §2.4).
+
+The reference ships ServiceLoader-discovered `AuronConvertProvider`s for
+Iceberg / Paimon / Hudi (AuronConvertProvider.scala:27, hook at
+AuronConverters.scala:108-112) whose job is: resolve the table's committed
+snapshot to a concrete list of data files, then hand the native engine a
+plain columnar scan.  These modules do the same for the TPU engine: each
+understands its format's on-disk metadata layout (Iceberg snapshot +
+manifest lists, Paimon snapshot/manifest dirs, Hudi .hoodie timeline) and
+converts the foreign scan node into a native ParquetScan over the resolved
+file groups.
+
+Importing this package registers all three providers (the ServiceLoader
+analogue); call `unregister_all()` to detach them (tests)."""
+
+from auron_tpu.formats.iceberg import IcebergProvider
+from auron_tpu.formats.paimon import PaimonProvider
+from auron_tpu.formats.hudi import HudiProvider
+
+_PROVIDERS = []
+
+
+def register_all() -> None:
+    from auron_tpu.frontend import converters
+    if _PROVIDERS:
+        return
+    for cls in (IcebergProvider, PaimonProvider, HudiProvider):
+        p = cls()
+        converters.register_provider(p)
+        _PROVIDERS.append(p)
+
+
+def unregister_all() -> None:
+    from auron_tpu.frontend import converters
+    for p in _PROVIDERS:
+        try:
+            converters._EXT_PROVIDERS.remove(p)
+        except ValueError:
+            pass
+    _PROVIDERS.clear()
+
+
+register_all()

@@ -1,0 +1,139 @@
+"""Hudi table scan provider (auron-hudi analogue).
+
+Reads a Hudi copy-on-write table's `.hoodie/` timeline: completed commits
+(`<ts>.commit` JSON) list the base files written per partition path; the
+snapshot view keeps, for every file group (fileId), only the base file of
+the latest completed commit — exactly the file-slice resolution Hudi's
+HoodieTableFileSystemView performs for the reference's
+HudiScanSupport/HudiConvertProvider before the native engine scans the
+resolved parquet.
+
+Foreign node contract: op="HudiScanExec", attrs:
+  table_path, as_of (optional commit ts string), pushed_filters.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from auron_tpu.frontend import converters
+from auron_tpu.frontend.expr_convert import NotConvertible
+from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.ir import expr as E
+from auron_tpu.ir import plan as P
+
+
+class HudiTable:
+    def __init__(self, table_path: str):
+        self.path = table_path
+        self.timeline_dir = os.path.join(table_path, ".hoodie")
+
+    def commits(self) -> List[str]:
+        """Completed commit timestamps, ascending."""
+        if not os.path.isdir(self.timeline_dir):
+            raise FileNotFoundError(f"not a hudi table: {self.path}")
+        return sorted(n[:-len(".commit")]
+                      for n in os.listdir(self.timeline_dir)
+                      if n.endswith(".commit"))
+
+    def file_slices(self, as_of: Optional[str] = None
+                    ) -> Dict[Tuple[str, str], str]:
+        """(partition_path, file_id) -> latest base file rel path."""
+        slices: Dict[Tuple[str, str], str] = {}
+        for ts in self.commits():
+            if as_of is not None and ts > as_of:
+                break
+            with open(os.path.join(self.timeline_dir,
+                                   f"{ts}.commit")) as f:
+                commit = json.load(f)
+            for part, files in commit.get("partitionToWriteStats",
+                                          {}).items():
+                for st in files:
+                    slices[(part, st["fileId"])] = st["path"]
+        return slices
+
+
+class HudiProvider(converters.ConvertProvider):
+    OP = "HudiScanExec"
+
+    def is_supported(self, node: ForeignNode) -> bool:
+        return node.op == self.OP
+
+    def convert(self, node: ForeignNode, children,
+                ctx: converters.ConvertContext) -> P.PlanNode:
+        if not converters.config.conf.get("auron.enable.parquet.scan"):
+            raise NotConvertible("native parquet scan disabled by conf")
+        table = HudiTable(node.attrs["table_path"])
+        slices = table.file_slices(node.attrs.get("as_of"))
+        pushed = node.attrs.get("pushed_filters", ())
+        pred = None
+        if pushed:
+            conv = [converters.EC.convert_expr(p) for p in pushed]
+            pred = conv[0]
+            for p in conv[1:]:
+                pred = E.ScAnd(left=pred, right=p)
+        if node.output is None:
+            raise NotConvertible("hudi scan requires a declared schema")
+        # one scan partition per hudi partition path (the reference's
+        # split granularity for COW snapshot queries)
+        by_part: Dict[str, List[str]] = {}
+        for (part, _fid), rel in sorted(slices.items()):
+            by_part.setdefault(part, []).append(
+                os.path.join(self.table_root(node), rel))
+        groups = [P.FileGroup(paths=tuple(v)) for _, v in
+                  sorted(by_part.items())]
+        if not groups:
+            return ctx.set_parts(
+                P.EmptyPartitions(schema=node.output, num_partitions=1), 1)
+        plan = P.ParquetScan(schema=node.output,
+                             file_groups=tuple(groups), predicate=pred)
+        return ctx.set_parts(plan, len(groups))
+
+    @staticmethod
+    def table_root(node: ForeignNode) -> str:
+        return node.attrs["table_path"]
+
+
+# ---------------------------------------------------------------------------
+# writer (test/tooling side)
+# ---------------------------------------------------------------------------
+
+def write_commit(table_path: str, table, partition_col: Optional[str],
+                 ts: str, update_file_ids: Optional[List[str]] = None
+                 ) -> List[str]:
+    """Write one COW commit; returns the fileIds written.  When
+    update_file_ids is given, those file groups are rewritten (the COW
+    update path: same fileId, newer commit wins)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(os.path.join(table_path, ".hoodie"), exist_ok=True)
+
+    def chunks():
+        if partition_col is None:
+            yield "", table
+            return
+        import pyarrow.compute as pc
+        for v in pc.unique(table[partition_col]).to_pylist():
+            yield str(v), table.filter(
+                pc.equal(table[partition_col], pa.scalar(v)))
+
+    stats: Dict[str, List[Dict[str, Any]]] = {}
+    written = []
+    for i, (part, chunk) in enumerate(chunks()):
+        pdir = os.path.join(table_path, part) if part else table_path
+        os.makedirs(pdir, exist_ok=True)
+        file_id = update_file_ids[i] if update_file_ids else \
+            f"fg-{part or 'root'}-{i}"
+        rel = os.path.join(part, f"{file_id}_0-0-0_{ts}.parquet") \
+            if part else f"{file_id}_0-0-0_{ts}.parquet"
+        pq.write_table(chunk, os.path.join(table_path, rel))
+        stats.setdefault(part, []).append(
+            {"fileId": file_id, "path": rel, "numWrites": chunk.num_rows})
+        written.append(file_id)
+    with open(os.path.join(table_path, ".hoodie", f"{ts}.commit"),
+              "w") as f:
+        json.dump({"partitionToWriteStats": stats}, f)
+    return written

@@ -1,0 +1,241 @@
+"""Iceberg table scan provider (auron-iceberg analogue).
+
+Reads the Iceberg v2 metadata layout directly: `metadata/version-hint.text`
+→ `metadata/vN.metadata.json` → current snapshot → manifest list →
+manifests → data files.  Manifest files are JSON here (the reference
+delegates Avro manifest decoding to the Iceberg Java library on the JVM
+side and never parses them natively either — the native engine only ever
+sees resolved parquet splits, NativeIcebergTableScanExec); a
+`write_table` helper produces the layout so snapshot time-travel,
+append/overwrite commits, and hidden-partition pruning are exercised end
+to end.
+
+Foreign node contract (what a bridge would emit for
+`IcebergTableScanExec`): op="IcebergScanExec", attrs:
+  table_path, snapshot_id (optional), pushed_filters (optional),
+  parts (optional target partition count).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from auron_tpu.frontend import converters
+from auron_tpu.frontend.expr_convert import NotConvertible
+from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.ir import expr as E
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.schema import from_arrow_schema
+
+
+def _read_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+class IcebergTable:
+    """Minimal Iceberg-layout reader: metadata json + JSON manifests."""
+
+    def __init__(self, table_path: str):
+        self.path = table_path
+        meta_dir = os.path.join(table_path, "metadata")
+        hint = os.path.join(meta_dir, "version-hint.text")
+        if os.path.exists(hint):
+            with open(hint) as f:
+                version = int(f.read().strip())
+        else:
+            versions = sorted(
+                int(n[1:].split(".")[0]) for n in os.listdir(meta_dir)
+                if n.startswith("v") and n.endswith(".metadata.json"))
+            if not versions:
+                raise FileNotFoundError(f"no metadata under {meta_dir}")
+            version = versions[-1]
+        self.metadata = _read_json(
+            os.path.join(meta_dir, f"v{version}.metadata.json"))
+
+    def snapshot(self, snapshot_id: Optional[int] = None) -> Dict[str, Any]:
+        snaps = self.metadata.get("snapshots", [])
+        if not snaps:
+            return {}
+        if snapshot_id is None:
+            cur = self.metadata.get("current-snapshot-id")
+            for s in snaps:
+                if s["snapshot-id"] == cur:
+                    return s
+            return snaps[-1]
+        for s in snaps:
+            if s["snapshot-id"] == snapshot_id:
+                return s
+        raise KeyError(f"snapshot {snapshot_id} not found")
+
+    def data_files(self, snapshot_id: Optional[int] = None
+                   ) -> List[Dict[str, Any]]:
+        snap = self.snapshot(snapshot_id)
+        if not snap:
+            return []
+        manifest_list = _read_json(
+            os.path.join(self.path, snap["manifest-list"]))
+        out: List[Dict[str, Any]] = []
+        for m in manifest_list["manifests"]:
+            manifest = _read_json(os.path.join(self.path, m["manifest-path"]))
+        # each manifest entry: {"status", "data_file": {"file_path",
+        # "partition", "record_count"}}
+            for entry in manifest["entries"]:
+                if entry.get("status") != "DELETED":
+                    out.append(entry["data_file"])
+        return out
+
+
+class IcebergProvider(converters.ConvertProvider):
+    """Claims IcebergScanExec foreign nodes and lowers them to a native
+    ParquetScan over the snapshot's data files (with partition-summary
+    pruning for hidden identity partitions)."""
+
+    OP = "IcebergScanExec"
+
+    def is_supported(self, node: ForeignNode) -> bool:
+        return node.op == self.OP
+
+    def convert(self, node: ForeignNode, children,
+                ctx: converters.ConvertContext) -> P.PlanNode:
+        if not converters.config.conf.get("auron.enable.parquet.scan"):
+            raise NotConvertible("native parquet scan disabled by conf")
+        table = IcebergTable(node.attrs["table_path"])
+        files = table.data_files(node.attrs.get("snapshot_id"))
+        pushed = node.attrs.get("pushed_filters", ())
+        pred = None
+        if pushed:
+            conv = [converters.EC.convert_expr(p) for p in pushed]
+            pred = conv[0]
+            for p in conv[1:]:
+                pred = E.ScAnd(left=pred, right=p)
+        files = _prune(files, pushed)
+        paths = [os.path.join(table.path, f["file_path"])
+                 if not os.path.isabs(f["file_path"]) else f["file_path"]
+                 for f in files]
+        schema = node.output
+        if schema is None:
+            schema = _schema_from_paths(paths)
+        n_parts = max(1, min(int(node.attrs.get("parts", len(paths))),
+                             max(len(paths), 1)))
+        groups: List[List[str]] = [[] for _ in range(n_parts)]
+        for i, path in enumerate(paths):
+            groups[i % n_parts].append(path)
+        plan = P.ParquetScan(
+            schema=schema,
+            file_groups=tuple(P.FileGroup(paths=tuple(g)) for g in groups),
+            predicate=pred)
+        return ctx.set_parts(plan, n_parts)
+
+
+def _prune(files: List[Dict[str, Any]], pushed) -> List[Dict[str, Any]]:
+    """Partition pruning on identity-partition equality predicates, using
+    each data file's partition tuple (the manifest partition summary)."""
+    eq: Dict[str, Any] = {}
+    for fe in pushed or ():
+        if fe.name == "EqualTo" and fe.children[0].name == \
+                "AttributeReference" and fe.children[1].name == "Literal":
+            eq[fe.children[0].value] = fe.children[1].value
+    if not eq:
+        return files
+    out = []
+    for f in files:
+        part = f.get("partition") or {}
+        if any(k in part and part[k] != v for k, v in eq.items()):
+            continue
+        out.append(f)
+    return out
+
+
+def _schema_from_paths(paths):
+    import pyarrow.parquet as pq
+    if not paths:
+        raise NotConvertible("empty iceberg table without declared schema")
+    return from_arrow_schema(pq.read_schema(paths[0]))
+
+
+# ---------------------------------------------------------------------------
+# writer (test/tooling side — produces the layout the provider reads)
+# ---------------------------------------------------------------------------
+
+def write_table(table_path: str, batches, partition_by: Optional[str] = None,
+                mode: str = "append") -> int:
+    """Append or overwrite a commit; returns the new snapshot id."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    meta_dir = os.path.join(table_path, "metadata")
+    data_dir = os.path.join(table_path, "data")
+    os.makedirs(meta_dir, exist_ok=True)
+    os.makedirs(data_dir, exist_ok=True)
+
+    hint = os.path.join(meta_dir, "version-hint.text")
+    if os.path.exists(hint):
+        with open(hint) as f:
+            version = int(f.read().strip())
+        metadata = _read_json(
+            os.path.join(meta_dir, f"v{version}.metadata.json"))
+    else:
+        version = 0
+        metadata = {"format-version": 2, "table-uuid": "auron-tpu",
+                    "location": table_path, "snapshots": [],
+                    "current-snapshot-id": None}
+
+    table = pa.Table.from_batches([b for b in batches]) \
+        if not isinstance(batches, pa.Table) else batches
+    snap_id = len(metadata["snapshots"]) + 1
+    seq = snap_id
+
+    # split by identity partition when requested
+    def parts():
+        if partition_by is None:
+            yield {}, table
+            return
+        import pyarrow.compute as pc
+        for v in pc.unique(table[partition_by]).to_pylist():
+            yield {partition_by: v}, table.filter(
+                pc.equal(table[partition_by], pa.scalar(v)))
+
+    entries = []
+    for i, (pvals, chunk) in enumerate(parts()):
+        rel = f"data/snap{snap_id}-{i:04d}.parquet"
+        pq.write_table(chunk, os.path.join(table_path, rel))
+        entries.append({"status": "ADDED",
+                        "data_file": {"file_path": rel,
+                                      "partition": pvals,
+                                      "record_count": chunk.num_rows}})
+
+    manifest_rel = f"metadata/manifest-{snap_id}.json"
+    with open(os.path.join(table_path, manifest_rel), "w") as f:
+        json.dump({"entries": entries}, f)
+
+    prev_manifests = []
+    if mode == "append" and metadata["snapshots"]:
+        cur = metadata["current-snapshot-id"]
+        for s in metadata["snapshots"]:
+            if s["snapshot-id"] == cur:
+                prev = _read_json(os.path.join(table_path,
+                                               s["manifest-list"]))
+                prev_manifests = prev["manifests"]
+    mlist_rel = f"metadata/snap-{snap_id}-manifest-list.json"
+    with open(os.path.join(table_path, mlist_rel), "w") as f:
+        json.dump({"manifests": prev_manifests +
+                   [{"manifest-path": manifest_rel}]}, f)
+
+    metadata["snapshots"].append({
+        "snapshot-id": snap_id, "sequence-number": seq,
+        "timestamp-ms": int(time.time() * 1000),
+        "manifest-list": mlist_rel,
+        "summary": {"operation": "append" if mode == "append"
+                    else "overwrite"}})
+    metadata["current-snapshot-id"] = snap_id
+    version += 1
+    with open(os.path.join(meta_dir, f"v{version}.metadata.json"),
+              "w") as f:
+        json.dump(metadata, f)
+    with open(hint, "w") as f:
+        f.write(str(version))
+    return snap_id

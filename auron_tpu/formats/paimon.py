@@ -1,0 +1,149 @@
+"""Paimon table scan provider (auron-paimon analogue).
+
+Reads the Paimon filesystem layout: `snapshot/LATEST` → `snapshot/
+snapshot-N` JSON → manifest list → manifests → data files living under
+`bucket-B/` directories (and `pt=<v>/bucket-B/` for partitioned tables).
+Buckets map one-to-one onto scan partitions — the same partition-parallel
+unit Paimon's own readers use.  Manifests are JSON (the reference leaves
+manifest decoding to the Paimon Java reader and natively scans only the
+resolved splits, NativePaimonTableScanExec / PaimonUtil).
+
+Foreign node contract: op="PaimonScanExec", attrs:
+  table_path, snapshot (optional int), pushed_filters (optional).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from auron_tpu.frontend import converters
+from auron_tpu.frontend.expr_convert import NotConvertible
+from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.ir import expr as E
+from auron_tpu.ir import plan as P
+
+
+def _read_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+class PaimonTable:
+    def __init__(self, table_path: str):
+        self.path = table_path
+        self.snap_dir = os.path.join(table_path, "snapshot")
+
+    def snapshot(self, n: Optional[int] = None) -> Dict[str, Any]:
+        if n is None:
+            with open(os.path.join(self.snap_dir, "LATEST")) as f:
+                n = int(f.read().strip())
+        return _read_json(os.path.join(self.snap_dir, f"snapshot-{n}"))
+
+    def splits(self, n: Optional[int] = None) -> Dict[int, List[str]]:
+        """bucket -> data file paths at the given snapshot."""
+        snap = self.snapshot(n)
+        mlist = _read_json(os.path.join(self.path, snap["baseManifestList"]))
+        buckets: Dict[int, List[str]] = {}
+        for m in mlist["manifests"]:
+            manifest = _read_json(os.path.join(self.path, m["manifestPath"]))
+            for entry in manifest["entries"]:
+                if entry.get("kind") == "DELETE":
+                    bucket_files = buckets.get(int(entry["bucket"]), [])
+                    path = os.path.join(self.path, entry["file"])
+                    if path in bucket_files:
+                        bucket_files.remove(path)
+                    continue
+                buckets.setdefault(int(entry["bucket"]), []).append(
+                    os.path.join(self.path, entry["file"]))
+        return buckets
+
+
+class PaimonProvider(converters.ConvertProvider):
+    OP = "PaimonScanExec"
+
+    def is_supported(self, node: ForeignNode) -> bool:
+        return node.op == self.OP
+
+    def convert(self, node: ForeignNode, children,
+                ctx: converters.ConvertContext) -> P.PlanNode:
+        if not converters.config.conf.get("auron.enable.parquet.scan"):
+            raise NotConvertible("native parquet scan disabled by conf")
+        table = PaimonTable(node.attrs["table_path"])
+        buckets = table.splits(node.attrs.get("snapshot"))
+        pushed = node.attrs.get("pushed_filters", ())
+        pred = None
+        if pushed:
+            conv = [converters.EC.convert_expr(p) for p in pushed]
+            pred = conv[0]
+            for p in conv[1:]:
+                pred = E.ScAnd(left=pred, right=p)
+        if node.output is None:
+            raise NotConvertible("paimon scan requires a declared schema")
+        groups = [P.FileGroup(paths=tuple(buckets[b]))
+                  for b in sorted(buckets)]
+        if not groups:
+            return ctx.set_parts(
+                P.EmptyPartitions(schema=node.output, num_partitions=1), 1)
+        plan = P.ParquetScan(schema=node.output,
+                             file_groups=tuple(groups), predicate=pred)
+        return ctx.set_parts(plan, len(groups))
+
+
+# ---------------------------------------------------------------------------
+# writer (test/tooling side)
+# ---------------------------------------------------------------------------
+
+def write_table(table_path: str, table, bucket_by: str,
+                n_buckets: int = 4) -> int:
+    """Write one commit bucketed by hash(bucket_by) % n_buckets; returns
+    the new snapshot number."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(os.path.join(table_path, "snapshot"), exist_ok=True)
+    os.makedirs(os.path.join(table_path, "manifest"), exist_ok=True)
+
+    latest_path = os.path.join(table_path, "snapshot", "LATEST")
+    prev_manifests = []
+    n = 1
+    if os.path.exists(latest_path):
+        with open(latest_path) as f:
+            prev_n = int(f.read().strip())
+        prev = _read_json(os.path.join(table_path, "snapshot",
+                                       f"snapshot-{prev_n}"))
+        prev_manifests = _read_json(
+            os.path.join(table_path, prev["baseManifestList"]))["manifests"]
+        n = prev_n + 1
+
+    key = table[bucket_by].to_pylist()
+    import numpy as np
+    bucket_of = np.array([hash(k) % n_buckets for k in key])
+    entries = []
+    for b in range(n_buckets):
+        mask = bucket_of == b
+        if not mask.any():
+            continue
+        chunk = table.filter(pa.array(mask))
+        bdir = os.path.join(table_path, f"bucket-{b}")
+        os.makedirs(bdir, exist_ok=True)
+        rel = f"bucket-{b}/data-{n}-0.parquet"
+        pq.write_table(chunk, os.path.join(table_path, rel))
+        entries.append({"kind": "ADD", "bucket": b, "file": rel,
+                        "rowCount": chunk.num_rows})
+
+    manifest_rel = f"manifest/manifest-{n}.json"
+    with open(os.path.join(table_path, manifest_rel), "w") as f:
+        json.dump({"entries": entries}, f)
+    mlist_rel = f"manifest/manifest-list-{n}.json"
+    with open(os.path.join(table_path, mlist_rel), "w") as f:
+        json.dump({"manifests": prev_manifests +
+                   [{"manifestPath": manifest_rel}]}, f)
+    with open(os.path.join(table_path, "snapshot", f"snapshot-{n}"),
+              "w") as f:
+        json.dump({"version": 3, "id": n, "baseManifestList": mlist_rel,
+                   "commitKind": "APPEND"}, f)
+    with open(latest_path, "w") as f:
+        f.write(str(n))
+    return n

@@ -73,7 +73,12 @@ ConvertedT = Union[P.PlanNode, ForeignWrap]
 
 class ConvertContext:
     def __init__(self) -> None:
+        import uuid
         self._ids = itertools.count()
+        # resource ids are globally unique so concurrent queries (or
+        # sequential queries against a shared remote shuffle server) can
+        # never observe each other's blocks
+        self._uid = uuid.uuid4().hex[:8]
         self.exchanges: Dict[str, ShuffleJob] = {}
         self.broadcasts: Dict[str, BroadcastJob] = {}
         self.sources: Dict[str, ForeignSource] = {}
@@ -81,7 +86,7 @@ class ConvertContext:
         self.n_parts: Dict[int, int] = {}
 
     def fresh(self, prefix: str) -> str:
-        return f"{prefix}:{next(self._ids)}"
+        return f"{prefix}:{self._uid}:{next(self._ids)}"
 
     def parts(self, plan: P.PlanNode) -> int:
         return self.n_parts.get(id(plan), 1)

@@ -71,9 +71,16 @@ class SessionResult:
 
 
 class AuronSession:
-    def __init__(self, foreign_engine: Optional[ForeignEngine] = None):
+    def __init__(self, foreign_engine: Optional[ForeignEngine] = None,
+                 shuffle_service=None):
         self.foreign_engine = foreign_engine
-        self.shuffle_service = InProcessShuffleService()
+        if shuffle_service is None:
+            # conf-selected transport: in-process (default) or a remote
+            # shuffle service client (Celeborn/Uniffle analogues)
+            from auron_tpu.shuffle_rss import service_from_conf
+            shuffle_service = service_from_conf() or \
+                InProcessShuffleService()
+        self.shuffle_service = shuffle_service
         self._metrics: List[MetricNode] = []
 
     # -- public entry (preColumnarTransitions analogue) -------------------
@@ -85,7 +92,17 @@ class AuronSession:
         ctx = ConvertContext()
         converted = converters.convert_recursively(plan, tags, ctx)
         self._metrics = []
-        table = self._run_converted(converted, ctx)
+        try:
+            table = self._run_converted(converted, ctx)
+        finally:
+            # release exchange blocks (local or remote shuffle server —
+            # the shuffle-cleanup the reference delegates to Spark's
+            # ShuffleManager.unregisterShuffle)
+            for rid in ctx.exchanges:
+                try:
+                    self.shuffle_service.clear(rid)
+                except Exception:
+                    log.warning("failed to clear shuffle %s", rid)
         res = SessionResult(table=table, converted=converted, tags=tags,
                             metrics=self._metrics, ctx=ctx)
         # count foreign sections that needed the host engine (local-table

@@ -440,6 +440,12 @@ class PyArrowEngine:
             groups.setdefault(pkeys[i], []).append(int(i))
         extra_cols: Dict[str, List] = {}
         base_names = set(t.schema.names)
+        # order-key columns evaluated once over the whole table (shared by
+        # every partition's rank computation)
+        ocols = [ev.eval(s.children[0])
+                 for s in node.attrs.get("order_spec", ())]
+        okey_of = [tuple(None if m[i] else _norm(v[i]) for v, m in ocols)
+                   for i in range(t.num_rows)]
         for w in node.attrs.get("window_exprs", ()):
             out = [None] * t.num_rows
             fn = w["fn"]
@@ -448,13 +454,11 @@ class PyArrowEngine:
                     for r, i in enumerate(idxs):
                         out[i] = r + 1
                 elif fn == "rank" or fn == "dense_rank":
-                    okeys = [tuple(_norm(x) for x in row) for row in
-                             _order_keys(t, node.attrs.get("order_spec",
-                                                           ()), idxs)]
                     rank = 0
                     dense = 0
                     prev = object()
-                    for r, (i, k) in enumerate(zip(idxs, okeys)):
+                    for r, i in enumerate(idxs):
+                        k = okey_of[i]
                         if k != prev:
                             rank = r + 1
                             dense += 1
@@ -482,12 +486,6 @@ class PyArrowEngine:
             else:
                 arrays.append(pa.array(extra_cols[f.name], type=f.type))
         return pa.Table.from_arrays(arrays, schema=arrow)
-
-
-def _order_keys(t, order_spec, idxs):
-    ev = _Eval(t)
-    cols = [ev.eval(s.children[0]) for s in order_spec]
-    return [[(None if m[i] else v[i]) for v, m in cols] for i in idxs]
 
 
 def _stable_desc(v: np.ndarray) -> np.ndarray:

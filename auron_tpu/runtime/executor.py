@@ -86,11 +86,20 @@ def execute_plan(plan: P.PlanNode, partition_id: int = 0,
     return execute_task(td, resources)
 
 
+_TASKS_COMPLETED = 0
+
+
 def execute_task(task: P.TaskDefinition,
                  resources: Optional[ResourceRegistry] = None
                  ) -> ExecutionResult:
+    global _TASKS_COMPLETED
+    from auron_tpu.runtime import profiling, task_logging
+
+    profiling.maybe_start_from_conf()   # lazy start (exec.rs:53-59)
     rt = NativeExecutionRuntime(task, resources)
-    out = [b.to_arrow() for b in rt.batches() if b.num_rows > 0]
+    with task_logging.task_scope(task.stage_id, task.partition_id):
+        out = [b.to_arrow() for b in rt.batches() if b.num_rows > 0]
+    _TASKS_COMPLETED += 1
     return ExecutionResult(out, rt.finalize())
 
 

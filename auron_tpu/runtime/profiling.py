@@ -1,0 +1,158 @@
+"""HTTP profiling service — the reference's lazily-started poem server
+(feature `http-service`, exec.rs:53-59, http/mod.rs:25-105) exposing
+`/debug/pprof/profile` (CPU via pprof) and a heap endpoint.
+
+TPU analogue on a free port, started lazily on first task execution when
+`auron.profiling.http.enable` is set (or explicitly via `ensure_started`):
+
+- GET /debug/profile?seconds=S  — device/host trace via jax.profiler,
+  returned as a zip of the TensorBoard trace directory (the pprof-protobuf
+  role; load into TensorBoard/XProf)
+- GET /debug/pyspy              — pure-python stack sample fallback
+  (sys._current_frames), the CPU-profile analogue with zero deps
+- GET /metrics                  — memory-manager + task-counter snapshot
+- GET /status                   — build info (the Auron UI tab analogue)
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tempfile
+import threading
+import time
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+_server: Optional["ProfilingServer"] = None
+_lock = threading.Lock()
+
+
+def ensure_started() -> "ProfilingServer":
+    """Idempotent lazy start (exec.rs:53-59 analogue)."""
+    global _server
+    with _lock:
+        if _server is None:
+            _server = ProfilingServer().start()
+        return _server
+
+
+def maybe_start_from_conf() -> Optional["ProfilingServer"]:
+    from auron_tpu import config
+    if config.conf.get("auron.profiling.http.enable"):
+        return ensure_started()
+    return None
+
+
+def _trace_zip(seconds: float) -> bytes:
+    import jax
+
+    with tempfile.TemporaryDirectory(prefix="auron-trace-") as d:
+        jax.profiler.start_trace(d)
+        time.sleep(min(seconds, 30.0))
+        jax.profiler.stop_trace()
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for root, _, files in os.walk(d):
+                for name in files:
+                    full = os.path.join(root, name)
+                    z.write(full, os.path.relpath(full, d))
+        return buf.getvalue()
+
+
+def _stack_samples(seconds: float, hz: int = 50) -> bytes:
+    import sys
+    import traceback
+    from collections import Counter
+
+    counts: Counter = Counter()
+    deadline = time.time() + min(seconds, 30.0)
+    while time.time() < deadline:
+        for tid, frame in sys._current_frames().items():
+            stack = tuple(f"{fs.filename}:{fs.lineno}:{fs.name}"
+                          for fs in traceback.extract_stack(frame))
+            counts[stack] += 1
+        time.sleep(1.0 / hz)
+    lines = []
+    for stack, n in counts.most_common():
+        lines.append(";".join(reversed(stack)) + f" {n}")
+    return ("\n".join(lines) + "\n").encode()   # folded-stacks format
+
+
+def _metrics_snapshot() -> dict:
+    from auron_tpu.memmgr import get_manager
+    from auron_tpu.runtime import executor
+
+    out = {"mem": get_manager().stats(),
+           "tasks_completed": getattr(executor, "_TASKS_COMPLETED", 0)}
+    try:
+        import jax
+        out["devices"] = [str(d) for d in jax.devices()]
+    except Exception:
+        pass
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        try:
+            if url.path == "/debug/profile":
+                seconds = float(q.get("seconds", ["1"])[0])
+                self._send(200, _trace_zip(seconds), "application/zip")
+            elif url.path == "/debug/pyspy":
+                seconds = float(q.get("seconds", ["1"])[0])
+                self._send(200, _stack_samples(seconds), "text/plain")
+            elif url.path == "/metrics":
+                self._send(200, json.dumps(_metrics_snapshot()).encode())
+            elif url.path == "/status":
+                from auron_tpu.build_info import build_info
+                self._send(200, json.dumps(build_info()).encode())
+            else:
+                self._send(404, b'{"error": "not found"}')
+        except Exception as e:  # pragma: no cover - defensive
+            self._send(500, json.dumps({"error": str(e)}).encode())
+
+
+class ProfilingServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv.daemon_threads = True
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    @property
+    def address(self):
+        return self._srv.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ProfilingServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        global _server
+        self._srv.shutdown()
+        self._srv.server_close()
+        with _lock:
+            if _server is self:
+                _server = None

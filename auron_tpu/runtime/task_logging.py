@@ -1,0 +1,56 @@
+"""Structured task logging — logging.rs:17-60 analogue.
+
+The reference prefixes every native log line with the Spark
+stage/partition/task ids taken from thread-locals set at runtime start.
+Here a contextvar carries (stage_id, partition_id) across the task's
+generator frames, and a logging.Filter injects the prefix into every
+record emitted under the `auron_tpu` logger tree."""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+from typing import Iterator, Optional, Tuple
+
+_task: contextvars.ContextVar[Optional[Tuple[int, int]]] = \
+    contextvars.ContextVar("auron_task", default=None)
+
+
+class TaskContextFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = _task.get()
+        record.task = f"[stage {ctx[0]} part {ctx[1]}] " if ctx else ""
+        return True
+
+
+_installed = False
+
+
+def install() -> None:
+    """Attach the prefixing filter + formatter to the package logger
+    (idempotent; init_logging analogue, logging.rs:30)."""
+    global _installed
+    if _installed:
+        return
+    logger = logging.getLogger("auron_tpu")
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s "
+                          "%(task)s%(message)s"))
+    handler.addFilter(TaskContextFilter())
+    logger.addHandler(handler)
+    _installed = True
+
+
+@contextlib.contextmanager
+def task_scope(stage_id: int, partition_id: int) -> Iterator[None]:
+    token = _task.set((stage_id, partition_id))
+    try:
+        yield
+    finally:
+        _task.reset(token)
+
+
+def current() -> Optional[Tuple[int, int]]:
+    return _task.get()

@@ -1,0 +1,42 @@
+"""Remote shuffle service integrations (thirdparty SPI, SURVEY §2.4).
+
+The reference integrates two push-based remote shuffle services through
+one SPI — `RssPartitionWriterBase.write(partitionId, ByteBuffer)`
+(RssPartitionWriterBase.scala:21, called from native shuffle/rss.rs:21-40):
+
+- Celeborn (auron-celeborn-0.5/-0.6): partitions are AGGREGATED
+  server-side — every mapper pushes partition P to the same growing
+  partition file, reducers fetch one stream per partition.
+- Uniffle (auron-uniffle): pushes are discrete BLOCKS with ids; reducers
+  fetch block lists and deduplicate (at-least-once delivery).
+
+These modules reproduce both models against a real socket boundary: a
+threaded TCP shuffle server (`server.py`) and two clients implementing the
+engine's shuffle-service interface (`rss_writer` / `reduce_blocks` /
+`clear`), selected via `auron.shuffle.service` — the AuronShuffleManager
+registry analogue."""
+
+from auron_tpu.shuffle_rss.server import ShuffleServer
+from auron_tpu.shuffle_rss.celeborn import CelebornShuffleClient
+from auron_tpu.shuffle_rss.uniffle import UniffleShuffleClient
+
+__all__ = ["ShuffleServer", "CelebornShuffleClient",
+           "UniffleShuffleClient", "service_from_conf"]
+
+
+def service_from_conf():
+    """Build the session's shuffle service from config
+    (AuronShuffleManager selection analogue).  Returns None for the
+    default in-process service."""
+    from auron_tpu import config
+
+    kind = config.conf.get("auron.shuffle.service")
+    if kind in (None, "", "inprocess"):
+        return None
+    address = config.conf.get("auron.shuffle.service.address")
+    host, port = address.rsplit(":", 1)
+    if kind == "celeborn":
+        return CelebornShuffleClient(host, int(port))
+    if kind == "uniffle":
+        return UniffleShuffleClient(host, int(port))
+    raise ValueError(f"unknown shuffle service {kind!r}")

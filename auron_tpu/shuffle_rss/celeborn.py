@@ -1,0 +1,91 @@
+"""Celeborn-style shuffle client (auron-celeborn analogue).
+
+Celeborn's model (CelebornPartitionWriter.scala:27-40): every map task
+pushes partition P's bytes to the same server-side partition aggregate;
+the reducer fetches ONE aggregated stream per partition.  The client
+below implements the engine's shuffle-service interface over that model:
+`rss_writer` returns the RssPartitionWriter the native shuffle writer
+pushes into (shuffle/rss.rs:21-40 upcall path), `reduce_blocks` fetches
+the aggregate."""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import List
+
+from auron_tpu.ops.shuffle.writer import RssPartitionWriter
+from auron_tpu.shuffle_rss.server import recv_msg, send_msg
+
+
+class _Conn:
+    """One pooled connection per thread (the client is used from both the
+    session thread and operator iterators)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._local = threading.local()
+
+    def sock(self) -> socket.socket:
+        s = getattr(self._local, "sock", None)
+        if s is None:
+            s = socket.create_connection((self.host, self.port), timeout=30)
+            self._local.sock = s
+        return s
+
+    def request(self, header: dict, payload: bytes = b""):
+        s = self.sock()
+        send_msg(s, header, payload)
+        resp, body = recv_msg(s)
+        if not resp.get("ok"):
+            raise RuntimeError(f"shuffle server error: {resp}")
+        return resp, body
+
+
+class _CelebornPartitionWriter(RssPartitionWriter):
+    """Buffers pushes per partition and flushes batched (Celeborn's
+    client-side push buffering), at-most batch_bytes per push RPC."""
+
+    def __init__(self, conn: _Conn, shuffle_id: str,
+                 batch_bytes: int = 1 << 20):
+        self.conn = conn
+        self.shuffle_id = shuffle_id
+        self.batch_bytes = batch_bytes
+        self._buf = {}
+
+    def write(self, partition_id: int, data: bytes) -> None:
+        buf = self._buf.setdefault(partition_id, bytearray())
+        buf.extend(data)
+        if len(buf) >= self.batch_bytes:
+            self._push(partition_id)
+
+    def _push(self, partition_id: int) -> None:
+        buf = self._buf.get(partition_id)
+        if not buf:
+            return
+        self.conn.request({"cmd": "push", "shuffle": self.shuffle_id,
+                           "partition": partition_id, "len": len(buf)},
+                          bytes(buf))
+        self._buf[partition_id] = bytearray()
+
+    def flush(self) -> None:
+        for pid in list(self._buf):
+            self._push(pid)
+
+
+class CelebornShuffleClient:
+    """Engine shuffle-service interface over the aggregate model."""
+
+    def __init__(self, host: str, port: int):
+        self.conn = _Conn(host, port)
+
+    def rss_writer(self, shuffle_id: str, map_id: int) -> RssPartitionWriter:
+        return _CelebornPartitionWriter(self.conn, shuffle_id)
+
+    def reduce_blocks(self, shuffle_id: str, reduce_pid: int) -> List[bytes]:
+        _, body = self.conn.request({"cmd": "fetch", "shuffle": shuffle_id,
+                                     "partition": reduce_pid})
+        return [body] if body else []
+
+    def clear(self, shuffle_id: str) -> None:
+        self.conn.request({"cmd": "delete", "shuffle": shuffle_id})

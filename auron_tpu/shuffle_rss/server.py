@@ -1,0 +1,172 @@
+"""Standalone TCP shuffle server.
+
+Plays the role of the Celeborn/Uniffle worker for the client modules: a
+threaded socket server storing pushed partition data in memory (optionally
+spilling large partitions to disk), with both storage models:
+
+- aggregate model (Celeborn): PUSH appends to one per-partition buffer
+- block model (Uniffle): PUSH_BLOCK stores (block_id, bytes) per partition
+
+Wire protocol: 4-byte big-endian header length, JSON header, raw payload.
+Requests: {"cmd": "push"|"push_block"|"fetch"|"fetch_blocks"|"delete"|
+"ping", "shuffle": str, "partition": int, "block_id": str, "len": int}.
+Responses: JSON header (+ payload for fetch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def send_msg(sock: socket.socket, header: dict,
+             payload: bytes = b"") -> None:
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack(">I", len(h)) + h + payload)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen))
+    payload = _recv_exact(sock, header["len"]) if header.get("len") else b""
+    return header, payload
+
+
+class _State:
+    def __init__(self, spill_dir: Optional[str], spill_threshold: int):
+        self.lock = threading.Lock()
+        # aggregate model: (shuffle, partition) -> bytearray | spill path
+        self.agg: Dict[Tuple[str, int], bytearray] = {}
+        self.agg_spilled: Dict[Tuple[str, int], str] = {}
+        # block model: (shuffle, partition) -> [(block_id, bytes)]
+        self.blocks: Dict[Tuple[str, int], List[Tuple[str, bytes]]] = {}
+        self.spill_dir = spill_dir
+        self.spill_threshold = spill_threshold
+
+    def _maybe_spill(self, key: Tuple[str, int]) -> None:
+        if self.spill_dir is None:
+            return
+        buf = self.agg.get(key)
+        if buf is None or len(buf) < self.spill_threshold:
+            return
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir,
+                            f"{key[0].replace(':', '_')}-{key[1]}.agg")
+        with open(path, "ab") as f:
+            f.write(bytes(buf))
+        self.agg_spilled[key] = path
+        self.agg[key] = bytearray()
+
+    def read_agg(self, key: Tuple[str, int]) -> bytes:
+        spilled = b""
+        if key in self.agg_spilled:
+            with open(self.agg_spilled[key], "rb") as f:
+                spilled = f.read()
+        return spilled + bytes(self.agg.get(key, b""))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        state: _State = self.server.state  # type: ignore[attr-defined]
+        try:
+            while True:
+                header, payload = recv_msg(self.request)
+                cmd = header["cmd"]
+                if cmd == "ping":
+                    send_msg(self.request, {"ok": True})
+                elif cmd == "push":
+                    key = (header["shuffle"], int(header["partition"]))
+                    with state.lock:
+                        state.agg.setdefault(key, bytearray()).extend(
+                            payload)
+                        state._maybe_spill(key)
+                    send_msg(self.request, {"ok": True})
+                elif cmd == "push_block":
+                    key = (header["shuffle"], int(header["partition"]))
+                    with state.lock:
+                        state.blocks.setdefault(key, []).append(
+                            (header["block_id"], payload))
+                    send_msg(self.request, {"ok": True})
+                elif cmd == "fetch":
+                    key = (header["shuffle"], int(header["partition"]))
+                    with state.lock:
+                        data = state.read_agg(key)
+                    send_msg(self.request, {"ok": True, "len": len(data)},
+                             data)
+                elif cmd == "fetch_blocks":
+                    key = (header["shuffle"], int(header["partition"]))
+                    with state.lock:
+                        blocks = list(state.blocks.get(key, []))
+                    body = b"".join(b for _, b in blocks)
+                    send_msg(self.request, {
+                        "ok": True, "len": len(body),
+                        "blocks": [{"id": bid, "len": len(b)}
+                                   for bid, b in blocks]}, body)
+                elif cmd == "delete":
+                    sid = header["shuffle"]
+                    with state.lock:
+                        for k in [k for k in state.agg if k[0] == sid]:
+                            del state.agg[k]
+                        for k in [k for k in state.agg_spilled
+                                  if k[0] == sid]:
+                            try:
+                                os.remove(state.agg_spilled[k])
+                            except OSError:
+                                pass
+                            del state.agg_spilled[k]
+                        for k in [k for k in state.blocks if k[0] == sid]:
+                            del state.blocks[k]
+                    send_msg(self.request, {"ok": True})
+                else:
+                    send_msg(self.request,
+                             {"ok": False, "error": f"bad cmd {cmd}"})
+        except (ConnectionError, OSError):
+            return
+
+
+class ShuffleServer:
+    """Threaded in-process server; `with ShuffleServer() as srv:` yields
+    (host, port)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 spill_dir: Optional[str] = None,
+                 spill_threshold: int = 64 << 20):
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.state = _State(spill_dir, spill_threshold)  # type: ignore
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    def start(self) -> "ShuffleServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self) -> "ShuffleServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

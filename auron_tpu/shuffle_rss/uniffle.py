@@ -1,0 +1,67 @@
+"""Uniffle-style shuffle client (auron-uniffle analogue).
+
+Uniffle's model (UnifflePartitionWriter): pushes are discrete BLOCKS
+carrying ids; delivery is at-least-once, so readers fetch the partition's
+block list and deduplicate by block id.  The client exercises that
+semantic for real: block ids are `{map_id}-{seq}`, a configurable
+duplicate-push factor simulates retries, and `reduce_blocks` drops
+duplicate ids before handing frames to the engine."""
+
+from __future__ import annotations
+
+from typing import List
+
+from auron_tpu.ops.shuffle.writer import RssPartitionWriter
+from auron_tpu.shuffle_rss.celeborn import _Conn
+
+
+class _UnifflePartitionWriter(RssPartitionWriter):
+    def __init__(self, conn: _Conn, shuffle_id: str, map_id: int,
+                 duplicate_pushes: int = 1):
+        self.conn = conn
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.seq = 0
+        self.duplicate_pushes = max(1, duplicate_pushes)
+
+    def write(self, partition_id: int, data: bytes) -> None:
+        if not data:
+            return
+        block_id = f"{self.map_id}-{self.seq}"
+        self.seq += 1
+        # at-least-once: a retrying client may push the same block twice;
+        # the reader's dedup must make this invisible
+        for _ in range(self.duplicate_pushes):
+            self.conn.request(
+                {"cmd": "push_block", "shuffle": self.shuffle_id,
+                 "partition": partition_id, "block_id": block_id,
+                 "len": len(data)}, data)
+
+
+class UniffleShuffleClient:
+    def __init__(self, host: str, port: int, duplicate_pushes: int = 1):
+        self.conn = _Conn(host, port)
+        self.duplicate_pushes = duplicate_pushes
+
+    def rss_writer(self, shuffle_id: str, map_id: int) -> RssPartitionWriter:
+        return _UnifflePartitionWriter(self.conn, shuffle_id, map_id,
+                                       self.duplicate_pushes)
+
+    def reduce_blocks(self, shuffle_id: str, reduce_pid: int) -> List[bytes]:
+        resp, body = self.conn.request(
+            {"cmd": "fetch_blocks", "shuffle": shuffle_id,
+             "partition": reduce_pid})
+        out: List[bytes] = []
+        seen = set()
+        off = 0
+        for b in resp.get("blocks", []):
+            chunk = body[off:off + b["len"]]
+            off += b["len"]
+            if b["id"] in seen:
+                continue
+            seen.add(b["id"])
+            out.append(chunk)
+        return out
+
+    def clear(self, shuffle_id: str) -> None:
+        self.conn.request({"cmd": "delete", "shuffle": shuffle_id})

@@ -1,0 +1,20 @@
+"""Streaming front-end (the Flink integration analogue, SURVEY §2.3).
+
+The reference's Flink path is narrower than its Spark path: a Calc
+(project+filter) operator streaming rows through a native
+Project/Filter/FFIReader plan (FlinkAuronCalcOperator.java:87), RexNode →
+expression conversion (auron-flink-planner), and a Kafka source whose
+partition/offset assignment is computed JVM-side while the native engine
+consumes (AuronKafkaSourceFunction + flink/kafka_scan_exec.rs:81).
+
+Here the same three pieces exist TPU-side: `StreamingCalcOperator`
+(element-at-a-time in, micro-batched device execution, eager drain on
+watermark/checkpoint), `rex` (RexNode-vocabulary conversion to the same
+foreign-expression form), and the Kafka scan op (ops/scan/kafka.py) driven
+by an assignment JSON."""
+
+from auron_tpu.streaming.calc_operator import (Collector,
+                                               StreamingCalcOperator)
+from auron_tpu.streaming import rex
+
+__all__ = ["StreamingCalcOperator", "Collector", "rex"]

@@ -1,0 +1,95 @@
+"""Calcite RexNode → foreign-expression conversion
+(FlinkRexNodeConverter / RexCall/InputRef/Literal converter analogues).
+
+A Flink bridge serializes the Calc's RexProgram as JSON rex trees:
+  {"rex": "call", "op": "GREATER_THAN", "operands": [...]}
+  {"rex": "input", "index": 2}
+  {"rex": "literal", "value": 3, "type": "BIGINT"}
+Conversion targets the same ForeignExpr vocabulary the Spark front-end
+uses, so the whole expression/compiler stack below is shared."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+from auron_tpu.frontend.expr_convert import NotConvertible
+from auron_tpu.frontend.foreign import ForeignExpr, fcall, fcol, flit
+from auron_tpu.ir.schema import DataType, Schema
+
+# SqlKind / SqlOperator names → Spark expression-class names
+_CALL_MAP = {
+    "PLUS": "Add", "MINUS": "Subtract", "TIMES": "Multiply",
+    "DIVIDE": "Divide", "MOD": "Remainder",
+    "GREATER_THAN": "GreaterThan",
+    "GREATER_THAN_OR_EQUAL": "GreaterThanOrEqual",
+    "LESS_THAN": "LessThan", "LESS_THAN_OR_EQUAL": "LessThanOrEqual",
+    "EQUALS": "EqualTo",
+    "AND": "And", "OR": "Or", "NOT": "Not",
+    "IS_NULL": "IsNull", "IS_NOT_NULL": "IsNotNull",
+    "CASE": "CaseWhen", "CAST": "Cast",
+    "UPPER": "Upper", "LOWER": "Lower", "TRIM": "StringTrim",
+    "CONCAT": "Concat", "SUBSTRING": "Substring", "ABS": "Abs",
+    "CEIL": "Ceil", "FLOOR": "Floor", "POWER": "Pow", "SQRT": "Sqrt",
+    "LN": "Log", "LOG10": "Log10", "EXP": "Exp",
+    "COALESCE": "Coalesce",
+}
+
+_TYPE_MAP = {
+    "BOOLEAN": DataType.bool_(),
+    "TINYINT": DataType.int8(), "SMALLINT": DataType.int16(),
+    "INTEGER": DataType.int32(), "INT": DataType.int32(),
+    "BIGINT": DataType.int64(),
+    "FLOAT": DataType.float32(), "REAL": DataType.float32(),
+    "DOUBLE": DataType.float64(),
+    "VARCHAR": DataType.string(), "CHAR": DataType.string(),
+    "STRING": DataType.string(),
+}
+
+
+def rex_type(name: str) -> DataType:
+    base = name.split("(")[0].strip().upper()
+    if base not in _TYPE_MAP:
+        raise NotConvertible(f"rex type {name!r}")
+    return _TYPE_MAP[base]
+
+
+def convert_rex(node: Dict[str, Any], input_schema: Schema) -> ForeignExpr:
+    """One rex tree → ForeignExpr against the operator's input row type."""
+    kind = node.get("rex")
+    if kind == "input":
+        idx = int(node["index"])
+        f = input_schema.fields[idx]
+        return fcol(f.name, f.dtype)
+    if kind == "literal":
+        dtype = rex_type(node["type"]) if node.get("type") else None
+        return flit(node.get("value"), dtype)
+    if kind == "call":
+        op = node["op"].upper()
+        if op not in _CALL_MAP and op != "NOT_EQUALS":
+            raise NotConvertible(f"rex call {op!r}")
+        operands = [convert_rex(o, input_schema)
+                    for o in node.get("operands", ())]
+        if op == "NOT_EQUALS":
+            # Spark has no NotEqualTo class; its planner emits Not(EqualTo)
+            return fcall("Not", fcall("EqualTo", *operands))
+        if op == "CAST":
+            return fcall("Cast", operands[0],
+                         dtype=rex_type(node["type"]))
+        # n-ary AND/OR come flattened from Calcite; Spark form is binary
+        if op in ("AND", "OR") and len(operands) > 2:
+            out = operands[0]
+            for o in operands[1:]:
+                out = fcall(_CALL_MAP[op], out, o)
+            return out
+        return fcall(_CALL_MAP[op], *operands)
+    raise NotConvertible(f"rex node kind {kind!r}")
+
+
+def convert_program(projections: Sequence[Dict[str, Any]],
+                    condition: Dict[str, Any],
+                    input_schema: Schema):
+    """RexProgram (project list + optional condition) → foreign exprs."""
+    projs = [convert_rex(p, input_schema) for p in projections]
+    cond = convert_rex(condition, input_schema) \
+        if condition is not None else None
+    return projs, cond
