@@ -33,10 +33,7 @@ def register_all() -> None:
 def unregister_all() -> None:
     from auron_tpu.frontend import converters
     for p in _PROVIDERS:
-        try:
-            converters._EXT_PROVIDERS.remove(p)
-        except ValueError:
-            pass
+        converters.unregister_provider(p)
     _PROVIDERS.clear()
 
 

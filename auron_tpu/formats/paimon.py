@@ -117,9 +117,15 @@ def write_table(table_path: str, table, bucket_by: str,
             os.path.join(table_path, prev["baseManifestList"]))["manifests"]
         n = prev_n + 1
 
-    key = table[bucket_by].to_pylist()
+    import zlib
+
     import numpy as np
-    bucket_of = np.array([hash(k) % n_buckets for k in key])
+    key = table[bucket_by].to_pylist()
+    # stable across processes (builtin hash() is seed-randomized for
+    # strings, which would scatter one key over several buckets between
+    # commits — Paimon's fixed-bucket invariant forbids that)
+    bucket_of = np.array(
+        [zlib.crc32(str(k).encode()) % n_buckets for k in key])
     entries = []
     for b in range(n_buckets):
         mask = bucket_of == b

@@ -476,6 +476,11 @@ def _check_no_condition(node) -> None:
 
 @_plan("SortMergeJoinExec")
 def _smj(node, children, ctx) -> P.PlanNode:
+    if config.FORCE_SHUFFLED_HASH_JOIN.get():
+        # rewrite the planned SMJ into a shuffled hash join — what the
+        # reference achieves by patching Spark's planner bytecode
+        # (ForceApplyShuffledHashJoinInjector.java)
+        return _shj(node, children, ctx)
     _op_enabled("smj")
     _check_no_condition(node)
     jt = EC.convert_join_type(node.attrs.get("join_type", "Inner"))
@@ -593,6 +598,13 @@ _EXT_PROVIDERS: List[ConvertProvider] = []
 
 def register_provider(p: ConvertProvider) -> None:
     _EXT_PROVIDERS.append(p)
+
+
+def unregister_provider(p: ConvertProvider) -> None:
+    try:
+        _EXT_PROVIDERS.remove(p)
+    except ValueError:
+        pass
 
 
 def ext_convert_supported(node: ForeignNode) -> bool:

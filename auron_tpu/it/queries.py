@@ -123,12 +123,13 @@ def two_phase_agg(child: ForeignNode, grouping: Sequence[ForeignExpr],
         output=Schema(tuple(state_fields)),
         attrs={"grouping": list(grouping), "aggs": agg_exprs,
                "agg_names": agg_names, "mode": "partial"})
+    part_spec = {"mode": "hash", "num_partitions": n_parts,
+                 "expressions": [g if g.name != "Alias" else g.children[0]
+                                 for g in grouping]} if grouping else \
+        {"mode": "single", "num_partitions": 1}
     exchange = ForeignNode(
         "ShuffleExchangeExec", children=(partial,), output=partial.output,
-        attrs={"partitioning": {
-            "mode": "hash", "num_partitions": n_parts,
-            "expressions": [g if g.name != "Alias" else g.children[0]
-                            for g in grouping]}})
+        attrs={"partitioning": part_spec})
     final_out = Schema(tuple(group_fields) + tuple(f for _, _, f in aggs))
     return ForeignNode(
         "HashAggregateExec", children=(exchange,), output=final_out,
@@ -582,3 +583,179 @@ def build(name: str, cat: Catalog) -> ForeignNode:
 
 def names() -> List[str]:
     return list(QUERIES)
+
+
+@_q("q52")
+def q52(cat: Catalog) -> ForeignNode:
+    """TPC-DS q52: brand revenue for one month/year (q03's sibling with a
+    different sort: year asc, revenue desc)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("EqualTo", fcol("d_moy", I32), flit(11)),
+              fcall("EqualTo", fcol("d_year", I32), flit(2000))),
+        ["d_date_sk", "d_year", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_brand", "i_manager_id"])
+    it = ffilter(it, fcall("LessThanOrEqual", fcol("i_manager_id", I32),
+                           flit(40)))
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("d_year", I32), fcol("i_brand", STR)],
+        group_fields=[Field("d_year", I32), Field("i_brand", STR)],
+        aggs=[("ext_price", agg("Sum", fcol("ss_ext_sales_price", F64),
+                                F64),
+               Field("ext_price", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("d_year", I32)),
+                so(fcol("ext_price", F64), asc=False),
+                so(fcol("i_brand", STR))],
+        limit=100,
+        project=[fcol("d_year", I32), fcol("i_brand", STR),
+                 fcol("ext_price", F64)],
+        out=Schema((Field("d_year", I32), Field("i_brand", STR),
+                    Field("ext_price", F64))))
+
+
+@_q("q43")
+def q43(cat: Catalog) -> ForeignNode:
+    """TPC-DS q43: store sales totals by store and day-of-week."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_store_sk", "ss_sales_price"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32), flit(2001)),
+                   ["d_date_sk", "d_year", "d_day_name"])
+    st = cat.scan("store", ["s_store_sk", "s_store_id", "s_store_name"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("s_store_name", STR), fcol("s_store_id", STR),
+                  fcol("d_day_name", STR)],
+        group_fields=[Field("s_store_name", STR),
+                      Field("s_store_id", STR),
+                      Field("d_day_name", STR)],
+        aggs=[("sales", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("sales", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("s_store_name", STR)),
+                so(fcol("s_store_id", STR)),
+                so(fcol("d_day_name", STR))],
+        limit=100,
+        project=[fcol("s_store_name", STR), fcol("s_store_id", STR),
+                 fcol("d_day_name", STR), fcol("sales", F64)],
+        out=Schema((Field("s_store_name", STR), Field("s_store_id", STR),
+                    Field("d_day_name", STR), Field("sales", F64))))
+
+
+@_q("q96")
+def q96(cat: Catalog) -> ForeignNode:
+    """TPC-DS q96: global count of qualifying store sales (grouping-free
+    two-phase count through a single-partition exchange)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_quantity", "ss_sales_price"])
+    filt = ffilter(ss, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("ss_quantity", I32), flit(20)),
+        fcall("LessThan", fcol("ss_sales_price", F64), flit(120.0))))
+    grouped = two_phase_agg(
+        filt, grouping=[], group_fields=[],
+        aggs=[("cnt", agg("Count", fcol("ss_quantity", I32), I64),
+               Field("cnt", I64))])
+    return ForeignNode("GlobalLimitExec", children=(grouped,),
+                       output=grouped.output, attrs={"limit": 100})
+
+
+@_q("q98")
+def q98(cat: Catalog) -> ForeignNode:
+    """TPC-DS q98: item revenue with each item's share of its class's
+    total — agg feeding a sum-over-window partitioned by class."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32), flit(1999)),
+                   ["d_date_sk", "d_year"])
+    it = cat.scan("item", ["i_item_sk", "i_item_id", "i_class",
+                           "i_category"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("i_item_id", STR), fcol("i_class", STR),
+                  fcol("i_category", STR)],
+        group_fields=[Field("i_item_id", STR), Field("i_class", STR),
+                      Field("i_category", STR)],
+        aggs=[("itemrevenue", agg("Sum", fcol("ss_ext_sales_price", F64),
+                                  F64),
+               Field("itemrevenue", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {"mode": "hash", "num_partitions": 4,
+                                "expressions": [fcol("i_class", STR)]}})
+    win_out = Schema((Field("i_item_id", STR), Field("i_class", STR),
+                      Field("i_category", STR),
+                      Field("itemrevenue", F64),
+                      Field("class_total", F64)))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "class_total", "fn": "agg", "args": [],
+                    "agg": agg("Sum", fcol("itemrevenue", F64), F64)}],
+               "partition_spec": [fcol("i_class", STR)],
+               "order_spec": []})
+    ratio = fproject(
+        win,
+        [fcol("i_item_id", STR), fcol("i_class", STR),
+         fcol("i_category", STR), fcol("itemrevenue", F64),
+         falias(fcall("Multiply",
+                      fcall("Divide", fcol("itemrevenue", F64),
+                            fcol("class_total", F64)),
+                      flit(100.0)), "revenueratio")],
+        Schema((Field("i_item_id", STR), Field("i_class", STR),
+                Field("i_category", STR), Field("itemrevenue", F64),
+                Field("revenueratio", F64))))
+    return take_ordered(
+        ratio,
+        orders=[so(fcol("i_category", STR)), so(fcol("i_class", STR)),
+                so(fcol("i_item_id", STR)),
+                so(fcol("revenueratio", F64))],
+        limit=100,
+        project=[fcol("i_item_id", STR), fcol("i_class", STR),
+                 fcol("i_category", STR), fcol("itemrevenue", F64),
+                 fcol("revenueratio", F64)],
+        out=ratio.output)
+
+
+@_q("q15")
+def q15(cat: Catalog) -> ForeignNode:
+    """TPC-DS q15: catalog sales revenue by customer state via two
+    sort-merge joins (cs -> customer -> address) and a date broadcast."""
+    cs = cat.scan("catalog_sales",
+                  ["cs_sold_date_sk", "cs_bill_customer_sk",
+                   "cs_ext_sales_price"])
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("EqualTo", fcol("d_qoy", I32), flit(1)),
+              fcall("EqualTo", fcol("d_year", I32), flit(2001))),
+        ["d_date_sk", "d_year", "d_qoy"])
+    cu = cat.scan("customer", ["c_customer_sk", "c_current_addr_sk"])
+    caddr = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    j1 = bhj(cs, dd, fcol("cs_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = smj(j1, cu, [fcol("cs_bill_customer_sk", I64)],
+             [fcol("c_customer_sk", I64)])
+    j3 = smj(j2, caddr, [fcol("c_current_addr_sk", I64)],
+             [fcol("ca_address_sk", I64)])
+    grouped = two_phase_agg(
+        j3,
+        grouping=[fcol("ca_state", STR)],
+        group_fields=[Field("ca_state", STR)],
+        aggs=[("total", agg("Sum", fcol("cs_ext_sales_price", F64), F64),
+               Field("total", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("ca_state", STR))], limit=100,
+        project=[fcol("ca_state", STR), fcol("total", F64)],
+        out=Schema((Field("ca_state", STR), Field("total", F64))))

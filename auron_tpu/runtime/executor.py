@@ -96,6 +96,7 @@ def execute_task(task: P.TaskDefinition,
     from auron_tpu.runtime import profiling, task_logging
 
     profiling.maybe_start_from_conf()   # lazy start (exec.rs:53-59)
+    task_logging.install()              # idempotent (init_logging analogue)
     rt = NativeExecutionRuntime(task, resources)
     with task_logging.task_scope(task.stage_id, task.partition_id):
         out = [b.to_arrow() for b in rt.batches() if b.num_rows > 0]

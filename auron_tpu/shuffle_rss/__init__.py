@@ -34,6 +34,11 @@ def service_from_conf():
     if kind in (None, "", "inprocess"):
         return None
     address = config.conf.get("auron.shuffle.service.address")
+    if not address or ":" not in address:
+        raise ValueError(
+            f"auron.shuffle.service={kind!r} requires "
+            f"auron.shuffle.service.address=host:port "
+            f"(got {address!r})")
     host, port = address.rsplit(":", 1)
     if kind == "celeborn":
         return CelebornShuffleClient(host, int(port))

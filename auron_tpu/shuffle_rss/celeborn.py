@@ -33,10 +33,27 @@ class _Conn:
             self._local.sock = s
         return s
 
+    def _invalidate(self) -> None:
+        s = getattr(self._local, "sock", None)
+        if s is not None:
+            try:
+                s.close()
+            finally:
+                self._local.sock = None
+
     def request(self, header: dict, payload: bytes = b""):
-        s = self.sock()
-        send_msg(s, header, payload)
-        resp, body = recv_msg(s)
+        # one reconnect attempt: a dead/desynced cached socket (server
+        # restart, mid-stream failure) must not poison the thread forever
+        for attempt in (0, 1):
+            try:
+                s = self.sock()
+                send_msg(s, header, payload)
+                resp, body = recv_msg(s)
+                break
+            except (ConnectionError, OSError, socket.timeout):
+                self._invalidate()
+                if attempt:
+                    raise
         if not resp.get("ok"):
             raise RuntimeError(f"shuffle server error: {resp}")
         return resp, body

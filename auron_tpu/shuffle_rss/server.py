@@ -139,6 +139,11 @@ class _Handler(socketserver.BaseRequestHandler):
             return
 
 
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True   # rebind promptly after restart
+    daemon_threads = True
+
+
 class ShuffleServer:
     """Threaded in-process server; `with ShuffleServer() as srv:` yields
     (host, port)."""
@@ -146,9 +151,8 @@ class ShuffleServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  spill_dir: Optional[str] = None,
                  spill_threshold: int = 64 << 20):
-        self._srv = socketserver.ThreadingTCPServer(
-            (host, port), _Handler, bind_and_activate=True)
-        self._srv.daemon_threads = True
+        self._srv = _TCPServer((host, port), _Handler,
+                               bind_and_activate=True)
         self._srv.state = _State(spill_dir, spill_threshold)  # type: ignore
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)

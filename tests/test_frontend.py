@@ -390,3 +390,32 @@ def test_expand_window_take_ordered():
         rows.sort(key=lambda r: r["rn"])
         vs = [r["v"] for r in rows]
         assert vs == sorted(vs)
+
+
+def test_force_shuffled_hash_join_rewrites_smj():
+    """auron.force.shuffled.hash.join converts planned SMJs into shuffled
+    hash joins (ForceApplyShuffledHashJoinInjector analogue)."""
+    from auron_tpu.ir import plan as P
+
+    left = local_table(sales_rows(60, seed=2), SALES)
+    right_schema = Schema((Field("k", I64), Field("w", F64)))
+    right = local_table([{"k": i % 12, "w": float(i)} for i in range(12)],
+                        right_schema)
+
+    def exchange(child):
+        return ForeignNode(
+            "ShuffleExchangeExec", children=(child,), output=child.output,
+            attrs={"partitioning": {"mode": "hash", "num_partitions": 2,
+                                    "expressions": [fcol("k", I64)]}})
+
+    join = ForeignNode(
+        "SortMergeJoinExec", children=(exchange(left), exchange(right)),
+        output=SALES.concat(right_schema),
+        attrs={"left_keys": [fcol("k", I64)],
+               "right_keys": [fcol("k", I64)], "join_type": "Inner"})
+    with config.conf.scoped({"auron.force.shuffled.hash.join": True}):
+        session = AuronSession(foreign_engine=ToyEngine())
+        res = session.execute(join)
+    assert isinstance(res.converted, P.HashJoin), type(res.converted)
+    assert len(res.to_pylist()) == 60
+    assert res.all_native()

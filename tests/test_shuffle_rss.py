@@ -120,3 +120,31 @@ def test_sequential_queries_shared_server_no_stale_data(server):
     # post-query cleanup released the server-side aggregates
     state = server._srv.state
     assert not state.agg and not state.blocks
+
+
+def test_client_reconnects_after_connection_loss(server):
+    """A dead cached connection must not poison the client thread: the
+    next request reconnects once and succeeds."""
+    host, port = server.address
+    client = CelebornShuffleClient(host, port)
+    w = client.rss_writer("sy", 0)
+    w.write(0, b"first")
+    w.flush()
+    # sever the cached connection out from under the client (the effect a
+    # server bounce or network reset has on an idle pooled socket)
+    client.conn.sock().close()
+    w2 = client.rss_writer("sy", 0)
+    w2.write(0, b"second")
+    w2.flush()
+    assert client.reduce_blocks("sy", 0) == [b"firstsecond"]
+    client.clear("sy")
+
+
+def test_service_from_conf_missing_address_errors():
+    import pytest as _pytest
+
+    from auron_tpu.shuffle_rss import service_from_conf
+    with config.conf.scoped({"auron.shuffle.service": "celeborn",
+                             "auron.shuffle.service.address": ""}):
+        with _pytest.raises(ValueError, match="service.address"):
+            service_from_conf()

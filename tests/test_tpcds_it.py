@@ -51,3 +51,15 @@ def test_plan_stability(catalog, tmp_path):
         text2 = stability.render_plan(res.converted, res.ctx)
     assert text2 != text
     assert stability.check_stability("q03", text2, golden) is not None
+
+
+def test_runner_exclusion_list(catalog):
+    """Excluded queries are skipped with a documented reason (the
+    reference's per-suite .exclude(...) lists)."""
+    from auron_tpu.it.runner import QueryRunner
+
+    r = QueryRunner(catalog=catalog,
+                    exclusions={"q03": "known divergence: demo"})
+    qr = r.run("q03")
+    assert qr.ok and qr.skipped == "known divergence: demo"
+    assert "SKIP" in r.report()
