@@ -479,8 +479,13 @@ def _smj(node, children, ctx) -> P.PlanNode:
     if config.FORCE_SHUFFLED_HASH_JOIN.get():
         # rewrite the planned SMJ into a shuffled hash join — what the
         # reference achieves by patching Spark's planner bytecode
-        # (ForceApplyShuffledHashJoinInjector.java)
-        return _shj(node, children, ctx)
+        # (ForceApplyShuffledHashJoinInjector.java).  "Prefer when both
+        # are legal": if SHJ conversion is not possible (disabled,
+        # unsupported shape) fall through to the normal SMJ path.
+        try:
+            return _shj(node, children, ctx)
+        except NotConvertible:
+            pass
     _op_enabled("smj")
     _check_no_condition(node)
     jt = EC.convert_join_type(node.attrs.get("join_type", "Inner"))

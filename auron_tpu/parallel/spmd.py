@@ -92,10 +92,13 @@ def local_group_aggregate(key, value, live, dim_key, dim_val):
     SPMD per-device body and the single-chip step."""
     cap2 = key.shape[0]
     sort_key = jnp.where(live, key, jnp.int64(2**62))
-    order = jnp.argsort(sort_key)
-    sk = jnp.take(sort_key, order)
-    sv = jnp.take(value, order)
-    slive = jnp.take(live, order)
+    # multi-operand sort carries the payload through the sorting network
+    # instead of argsort + 3 gathers — gathers are the expensive part on
+    # TPU (random-access HBM), the sort itself is MXU-adjacent vector work
+    sk, sv, slive_i = jax.lax.sort(
+        (sort_key, value, live.astype(jnp.int32)), num_keys=1,
+        is_stable=False)
+    slive = slive_i.astype(bool)
     boundary = jnp.logical_and(
         jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]]), slive)
     seg = jnp.where(slive, jnp.cumsum(boundary.astype(jnp.int32)) - 1,
@@ -107,9 +110,7 @@ def local_group_aggregate(key, value, live, dim_key, dim_val):
     first_idx = jnp.nonzero(boundary, size=cap2, fill_value=cap2 - 1)[0]
     gkeys = jnp.where(jnp.arange(cap2) < jnp.sum(boundary),
                       jnp.take(sk, first_idx), -1)
-    dorder = jnp.argsort(dim_key)
-    dk = jnp.take(dim_key, dorder)
-    dv = jnp.take(dim_val, dorder)
+    dk, dv = jax.lax.sort((dim_key, dim_val), num_keys=1, is_stable=False)
     pos = jnp.clip(jnp.searchsorted(dk, gkeys), 0, dk.shape[0] - 1)
     hit = jnp.take(dk, pos) == gkeys
     joined = jnp.where(hit, jnp.take(dv, pos), jnp.nan)

@@ -43,14 +43,16 @@ class _Conn:
 
     def request(self, header: dict, payload: bytes = b""):
         # one reconnect attempt: a dead/desynced cached socket (server
-        # restart, mid-stream failure) must not poison the thread forever
+        # restart, mid-stream failure) must not poison the thread forever.
+        # Retried pushes are safe because every push carries a dedupable
+        # id (push_id / block_id) the server applies at most once.
         for attempt in (0, 1):
             try:
                 s = self.sock()
                 send_msg(s, header, payload)
                 resp, body = recv_msg(s)
                 break
-            except (ConnectionError, OSError, socket.timeout):
+            except OSError:
                 self._invalidate()
                 if attempt:
                     raise
@@ -65,10 +67,13 @@ class _CelebornPartitionWriter(RssPartitionWriter):
 
     def __init__(self, conn: _Conn, shuffle_id: str,
                  batch_bytes: int = 1 << 20):
+        import uuid
         self.conn = conn
         self.shuffle_id = shuffle_id
         self.batch_bytes = batch_bytes
         self._buf = {}
+        self._writer_id = uuid.uuid4().hex[:12]
+        self._seq = 0
 
     def write(self, partition_id: int, data: bytes) -> None:
         buf = self._buf.setdefault(partition_id, bytearray())
@@ -80,8 +85,11 @@ class _CelebornPartitionWriter(RssPartitionWriter):
         buf = self._buf.get(partition_id)
         if not buf:
             return
+        push_id = f"{self._writer_id}-{self._seq}"
+        self._seq += 1
         self.conn.request({"cmd": "push", "shuffle": self.shuffle_id,
-                           "partition": partition_id, "len": len(buf)},
+                           "partition": partition_id, "len": len(buf),
+                           "push_id": push_id},
                           bytes(buf))
         self._buf[partition_id] = bytearray()
 

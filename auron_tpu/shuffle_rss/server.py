@@ -53,6 +53,9 @@ class _State:
         # aggregate model: (shuffle, partition) -> bytearray | spill path
         self.agg: Dict[Tuple[str, int], bytearray] = {}
         self.agg_spilled: Dict[Tuple[str, int], str] = {}
+        # applied push ids per key — client retries are at-least-once, so
+        # the server deduplicates (the role Celeborn's batch ids play)
+        self.agg_seen: Dict[Tuple[str, int], set] = {}
         # block model: (shuffle, partition) -> [(block_id, bytes)]
         self.blocks: Dict[Tuple[str, int], List[Tuple[str, bytes]]] = {}
         self.spill_dir = spill_dir
@@ -91,10 +94,15 @@ class _Handler(socketserver.BaseRequestHandler):
                     send_msg(self.request, {"ok": True})
                 elif cmd == "push":
                     key = (header["shuffle"], int(header["partition"]))
+                    push_id = header.get("push_id")
                     with state.lock:
-                        state.agg.setdefault(key, bytearray()).extend(
-                            payload)
-                        state._maybe_spill(key)
+                        seen = state.agg_seen.setdefault(key, set())
+                        if push_id is None or push_id not in seen:
+                            if push_id is not None:
+                                seen.add(push_id)
+                            state.agg.setdefault(key, bytearray()).extend(
+                                payload)
+                            state._maybe_spill(key)
                     send_msg(self.request, {"ok": True})
                 elif cmd == "push_block":
                     key = (header["shuffle"], int(header["partition"]))
@@ -129,6 +137,9 @@ class _Handler(socketserver.BaseRequestHandler):
                             except OSError:
                                 pass
                             del state.agg_spilled[k]
+                        for k in [k for k in state.agg_seen
+                                  if k[0] == sid]:
+                            del state.agg_seen[k]
                         for k in [k for k in state.blocks if k[0] == sid]:
                             del state.blocks[k]
                     send_msg(self.request, {"ok": True})

@@ -419,3 +419,33 @@ def test_force_shuffled_hash_join_rewrites_smj():
     assert isinstance(res.converted, P.HashJoin), type(res.converted)
     assert len(res.to_pylist()) == 60
     assert res.all_native()
+
+
+def test_force_shj_falls_back_to_smj_when_shj_disabled():
+    """Forced SHJ with the SHJ converter disabled must still convert the
+    planned SMJ natively (prefer-when-legal semantics)."""
+    from auron_tpu.ir import plan as P
+
+    left = local_table(sales_rows(30, seed=4), SALES)
+    right_schema = Schema((Field("k", I64), Field("w", F64)))
+    right = local_table([{"k": i % 12, "w": float(i)} for i in range(12)],
+                        right_schema)
+
+    def exchange(child):
+        return ForeignNode(
+            "ShuffleExchangeExec", children=(child,), output=child.output,
+            attrs={"partitioning": {"mode": "hash", "num_partitions": 2,
+                                    "expressions": [fcol("k", I64)]}})
+
+    join = ForeignNode(
+        "SortMergeJoinExec", children=(exchange(left), exchange(right)),
+        output=SALES.concat(right_schema),
+        attrs={"left_keys": [fcol("k", I64)],
+               "right_keys": [fcol("k", I64)], "join_type": "Inner"})
+    with config.conf.scoped({"auron.force.shuffled.hash.join": True,
+                             "auron.enable.shj": False}):
+        session = AuronSession(foreign_engine=ToyEngine())
+        res = session.execute(join)
+    assert isinstance(res.converted, P.SortMergeJoin), type(res.converted)
+    assert len(res.to_pylist()) == 30
+    assert res.all_native()

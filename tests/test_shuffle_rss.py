@@ -148,3 +148,19 @@ def test_service_from_conf_missing_address_errors():
                              "auron.shuffle.service.address": ""}):
         with _pytest.raises(ValueError, match="service.address"):
             service_from_conf()
+
+
+def test_push_retry_is_idempotent(server):
+    """A retried push (response lost after server applied it) must not
+    duplicate partition bytes — pushes carry dedupable push ids."""
+    host, port = server.address
+    client = CelebornShuffleClient(host, port)
+    w = client.rss_writer("sz", 0)
+    w.write(0, b"payload")
+    w.flush()
+    # simulate the lost-response retry: resend the exact same push id
+    client.conn.request({"cmd": "push", "shuffle": "sz", "partition": 0,
+                         "len": 7, "push_id": f"{w._writer_id}-0"},
+                        b"payload")
+    assert client.reduce_blocks("sz", 0) == [b"payload"]
+    client.clear("sz")
