@@ -86,3 +86,38 @@ def broadcast_all_gather(arrays: List[Any], valid, axis: str
 
 def global_sum(x, axis: str):
     return lax.psum(x, axis)
+
+
+def hierarchical_repartition(arrays: List[Any], dest, valid,
+                             ici_axis: str, dcn_axis: str,
+                             n_ici: int, n_dcn: int, quota: int
+                             ) -> Tuple[List[Any], Any]:
+    """Two-stage repartition for multi-slice meshes: rows first move
+    WITHIN a slice (over the fast ICI axis) to the local chip whose ICI
+    rank matches the destination chip, then cross slices over DCN in one
+    aligned all_to_all.
+
+    This is the standard hierarchical all-to-all: every row crosses DCN at
+    most once and the DCN transfer is slice-to-slice aligned, instead of a
+    flat all_to_all over N_ici*N_dcn devices whose traffic is dominated by
+    the slow axis (SURVEY §2.5: "lay out shardings so collectives ride
+    ICI, not DCN").
+
+    `dest` is the GLOBAL destination device id laid out as
+    dcn_rank * n_ici + ici_rank.  Must run inside shard_map with both
+    named axes.  Returns ([n_dcn*n_ici*quota, ...] arrays, valid mask) on
+    each destination device (same contract as all_to_all_repartition).
+    """
+    # stage 1 (ICI): deliver each row to the local chip with ici_rank ==
+    # dest_ici; rows keep their dcn destination as payload
+    dest_ici = (dest % n_ici).astype(jnp.int32)
+    dest_dcn = (dest // n_ici).astype(jnp.int32)
+    stage1, v1 = all_to_all_repartition(
+        arrays + [dest_dcn], dest_ici, valid, ici_axis, n_ici, quota)
+    payload1, dcn1 = stage1[:-1], stage1[-1]
+    # stage 2 (DCN): every chip now holds only rows whose final chip has
+    # its own ici_rank; swap across slices by dcn rank
+    q2 = n_ici * quota
+    stage2, v2 = all_to_all_repartition(
+        payload1, dcn1, v1, dcn_axis, n_dcn, q2)
+    return stage2, v2

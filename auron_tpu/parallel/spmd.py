@@ -110,7 +110,9 @@ def local_group_aggregate(key, value, live, dim_key, dim_val):
     first_idx = jnp.nonzero(boundary, size=cap2, fill_value=cap2 - 1)[0]
     gkeys = jnp.where(jnp.arange(cap2) < jnp.sum(boundary),
                       jnp.take(sk, first_idx), -1)
-    dk, dv = jax.lax.sort((dim_key, dim_val), num_keys=1, is_stable=False)
+    # stable: with duplicate dim keys, the first-occurring row must win
+    # deterministically (searchsorted probes the leftmost equal slot)
+    dk, dv = jax.lax.sort((dim_key, dim_val), num_keys=1, is_stable=True)
     pos = jnp.clip(jnp.searchsorted(dk, gkeys), 0, dk.shape[0] - 1)
     hit = jnp.take(dk, pos) == gkeys
     joined = jnp.where(hit, jnp.take(dv, pos), jnp.nan)
