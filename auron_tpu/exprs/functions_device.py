@@ -48,20 +48,24 @@ def _f64(col):
 
 def _spark_log(args, raw, e, ctx):
     """Spark log: unary = ln(x); binary = log_base(x) with (base, x) arg
-    order (Logarithm), null/nan outside the domain."""
+    order (Logarithm.nullSafeEval): NULL for x<=0 or base<=0; base==1 is
+    allowed and yields ln(x)/0 = ±Inf/NaN per IEEE double division."""
     if len(args) == 1:
-        return _unary_f64(jnp.log, domain=lambda x: x > 0)(args, raw, e,
-                                                           ctx)
+        return _unary_f64(jnp.log, domain=lambda x: ~(x <= 0),
+                          domain_null=True)(args, raw, e, ctx)
     b, x = _f64(args[0]), _f64(args[1])
     valid = jnp.logical_and(args[0].validity, args[1].validity)
-    ok = (x > 0) & (b > 0) & (b != 1.0)
-    out = jnp.where(ok,
-                    jnp.log(jnp.where(ok, x, 1.0)) /
-                    jnp.log(jnp.where(ok, b, 2.0)), jnp.nan)
+    # NaN inputs stay in-domain (Java `NaN <= 0` is false -> NaN result)
+    ok = jnp.logical_not((x <= 0) | (b <= 0))
+    valid = jnp.logical_and(valid, ok)
+    out = jnp.log(jnp.where(ok, x, 1.0)) / jnp.log(jnp.where(ok, b, 2.0))
     return flat(DataType.float64(), out, valid)
 
 
-def _unary_f64(jfn, domain=None):
+def _unary_f64(jfn, domain=None, domain_null=False):
+    """domain_null=True: out-of-domain rows become NULL (the Spark
+    UnaryLogExpression contract); False: NaN with validity kept (the
+    UnaryMathExpression contract, e.g. acos/sqrt)."""
     def impl(args, raw, e, ctx):
         x = _f64(args[0])
         valid = args[0].validity
@@ -69,6 +73,8 @@ def _unary_f64(jfn, domain=None):
             ok = domain(x)
             x = jnp.where(ok, x, 1.0)
             out = jnp.where(ok, jfn(x), jnp.nan)
+            if domain_null:
+                valid = jnp.logical_and(valid, ok)
         else:
             out = jfn(x)
         return flat(DataType.float64(), out, valid)
@@ -468,10 +474,14 @@ _FUNCS = {
     "cosh": _unary_f64(jnp.cosh),
     "exp": _unary_f64(jnp.exp),
     "expm1": _unary_f64(jnp.expm1),
-    "ln": _unary_f64(jnp.log, domain=lambda x: x > 0),
+    # log family: Spark UnaryLogExpression -> NULL outside the domain
+    "ln": _unary_f64(jnp.log, domain=lambda x: ~(x <= 0),
+                     domain_null=True),
     "log": _spark_log,
-    "log10": _unary_f64(jnp.log10, domain=lambda x: x > 0),
-    "log2": _unary_f64(jnp.log2, domain=lambda x: x > 0),
+    "log10": _unary_f64(jnp.log10, domain=lambda x: ~(x <= 0),
+                        domain_null=True),
+    "log2": _unary_f64(jnp.log2, domain=lambda x: ~(x <= 0),
+                       domain_null=True),
     "power": _math_binary(jnp.power),
     "round": _round,
     "bround": _bround,

@@ -71,6 +71,26 @@ def _nan_to_none_guard(fn, a):
     return v
 
 
+def _host_log1(fn, x):
+    """Spark UnaryLogExpression: NULL (None) when x <= yAsymptote (0)."""
+    x = float(x)
+    return None if x <= 0 else fn(x)
+
+
+def _host_log2(base, x):
+    """Spark Logarithm.nullSafeEval: NULL for x<=0 or base<=0; base==1
+    yields ln(x)/0.0 with Java double-division semantics (±Inf / NaN)."""
+    base, x = float(base), float(x)
+    if base <= 0 or x <= 0:
+        return None
+    lx, lb = math.log(x), math.log(base)
+    if lb == 0.0:
+        # Java double division: 0/0 and NaN/0 -> NaN; ±y/0 -> ±Inf
+        return float("nan") if (lx == 0.0 or math.isnan(lx)) else \
+            math.copysign(float("inf"), lx)
+    return lx / lb
+
+
 def _str(s) -> str:
     return s.decode("utf-8", "replace") if isinstance(s, bytes) else str(s)
 
@@ -368,13 +388,18 @@ _FUNCS: Dict[str, Callable] = {
                               (math.isnan(x) or math.isinf(x)))
                       else _to_long(x)),
     "cos": _f64(math.cos), "cosh": _f64(math.cosh), "exp": _f64(math.exp),
-    "expm1": _f64(math.expm1), "ln": _f64(math.log),
-    # Spark log(x) = ln(x); log(base, x) = ln(x)/ln(base)
-    "log": _f64(lambda *a: math.log(a[0]) if len(a) == 1
-                else (math.log(a[1]) / math.log(a[0])
-                      if a[0] > 0 and a[0] != 1.0 and a[1] > 0
-                      else float("nan"))),
-    "log10": _f64(math.log10), "log2": _f64(math.log2),
+    "expm1": _f64(math.expm1),
+    # log family: Spark UnaryLogExpression / Logarithm.nullSafeEval ->
+    # NULL outside the domain (x<=0, base<=0); base==1 allowed (IEEE
+    # ln(x)/0 = ±Inf/NaN, matching Java double division)
+    "ln": _rowwise(DataType.float64(), lambda x: _host_log1(math.log, x)),
+    "log": _rowwise(DataType.float64(),
+                    lambda *a: _host_log1(math.log, a[0]) if len(a) == 1
+                    else _host_log2(a[0], a[1])),
+    "log10": _rowwise(DataType.float64(),
+                      lambda x: _host_log1(math.log10, x)),
+    "log2": _rowwise(DataType.float64(),
+                     lambda x: _host_log1(math.log2, x)),
     "power": _f64(math.pow), "sin": _f64(math.sin), "sinh": _f64(math.sinh),
     "sqrt": _f64(math.sqrt), "tan": _f64(math.tan), "tanh": _f64(math.tanh),
     "signum": _rowwise(DataType.float64(), lambda x: float(np.sign(x))),

@@ -40,7 +40,17 @@ def _value_eq(a: Any, b: Any, rel_tol: float, abs_tol: float) -> bool:
 
 
 def _sort_key(row: Tuple) -> Tuple:
-    return tuple((v is None, str(type(v).__name__), str(v)) for v in row)
+    # floats are rounded to well below the compare tolerance before keying:
+    # two tolerant-equal values that stringify differently must land in the
+    # same sorted position on both sides, or the positional zip below
+    # reports spurious first-differences
+    out = []
+    for v in row:
+        if isinstance(v, float) and not math.isnan(v):
+            out.append((v is None, "float", f"{v + 0.0:.3e}"))  # -0.0 == 0.0
+        else:
+            out.append((v is None, str(type(v).__name__), str(v)))
+    return tuple(out)
 
 
 def compare_tables(actual: pa.Table, expected: pa.Table,

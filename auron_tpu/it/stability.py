@@ -74,15 +74,19 @@ def _render(node, ctx, depth: int, lines: List[str]) -> None:
 
 def check_stability(name: str, plan_text: str, golden_dir: str
                     ) -> Optional[str]:
-    """None when stable; error message otherwise.  Writes the golden when
-    absent or when AURON_REGEN_GOLDEN=1."""
+    """None when stable; error message otherwise.  Writes the golden only
+    under AURON_REGEN_GOLDEN=1; a missing golden is a failure (a silently
+    auto-created golden would make the stability gate vacuous in CI)."""
     os.makedirs(golden_dir, exist_ok=True)
     path = os.path.join(golden_dir, f"{name}.plan.txt")
     regen = os.environ.get("AURON_REGEN_GOLDEN") == "1"
-    if regen or not os.path.exists(path):
+    if regen:
         with open(path, "w") as f:
             f.write(plan_text)
         return None
+    if not os.path.exists(path):
+        return (f"no golden plan for {name} at {path} "
+                f"(run with AURON_REGEN_GOLDEN=1 to create it)")
     with open(path) as f:
         golden = f.read()
     if golden != plan_text:

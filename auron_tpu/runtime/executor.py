@@ -10,6 +10,7 @@ XLA's async dispatch already overlaps device compute with host work.
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterator, List, Optional
 
@@ -87,6 +88,7 @@ def execute_plan(plan: P.PlanNode, partition_id: int = 0,
 
 
 _TASKS_COMPLETED = 0
+_TASKS_LOCK = threading.Lock()
 
 
 def execute_task(task: P.TaskDefinition,
@@ -100,7 +102,8 @@ def execute_task(task: P.TaskDefinition,
     rt = NativeExecutionRuntime(task, resources)
     with task_logging.task_scope(task.stage_id, task.partition_id):
         out = [b.to_arrow() for b in rt.batches() if b.num_rows > 0]
-    _TASKS_COMPLETED += 1
+    with _TASKS_LOCK:
+        _TASKS_COMPLETED += 1
     return ExecutionResult(out, rt.finalize())
 
 

@@ -29,6 +29,9 @@ from urllib.parse import parse_qs, urlparse
 
 _server: Optional["ProfilingServer"] = None
 _lock = threading.Lock()
+# the jax profiler is process-global: concurrent start_trace calls collide
+# and can wedge it, so trace capture is serialized (busy -> 429)
+_trace_lock = threading.Lock()
 
 
 def ensure_started() -> "ProfilingServer":
@@ -114,7 +117,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if url.path == "/debug/profile":
                 seconds = float(q.get("seconds", ["1"])[0])
-                self._send(200, _trace_zip(seconds), "application/zip")
+                if not _trace_lock.acquire(blocking=False):
+                    self._send(429, b'{"error": "trace in progress"}')
+                    return
+                try:
+                    self._send(200, _trace_zip(seconds), "application/zip")
+                finally:
+                    _trace_lock.release()
             elif url.path == "/debug/pyspy":
                 seconds = float(q.get("seconds", ["1"])[0])
                 self._send(200, _stack_samples(seconds), "text/plain")

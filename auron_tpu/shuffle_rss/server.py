@@ -40,10 +40,25 @@ def send_msg(sock: socket.socket, header: dict,
     sock.sendall(struct.pack(">I", len(h)) + h + payload)
 
 
-def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+# frame caps: the header/payload sizes come off the wire untrusted, so
+# bound the allocations they can force.  The payload cap applies to the
+# SERVER (untrusted ingress) only — clients fetching from the server they
+# connected to pass max_payload=None, so a >2GiB aggregated partition
+# stays fetchable.  The server binds loopback/trusted networks only.
+MAX_HEADER_LEN = 1 << 20          # 1 MiB of JSON header
+MAX_PAYLOAD_LEN = 1 << 31         # 2 GiB per pushed frame (server ingress)
+
+
+def recv_msg(sock: socket.socket,
+             max_payload: Optional[int] = None) -> Tuple[dict, bytes]:
     (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if hlen > MAX_HEADER_LEN:
+        raise ValueError(f"header length {hlen} exceeds {MAX_HEADER_LEN}")
     header = json.loads(_recv_exact(sock, hlen))
-    payload = _recv_exact(sock, header["len"]) if header.get("len") else b""
+    plen = int(header.get("len") or 0)
+    if plen < 0 or (max_payload is not None and plen > max_payload):
+        raise ValueError(f"payload length {plen} exceeds {max_payload}")
+    payload = _recv_exact(sock, plen) if plen else b""
     return header, payload
 
 
@@ -87,67 +102,72 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         state: _State = self.server.state  # type: ignore[attr-defined]
         try:
-            while True:
-                header, payload = recv_msg(self.request)
-                cmd = header["cmd"]
-                if cmd == "ping":
-                    send_msg(self.request, {"ok": True})
-                elif cmd == "push":
-                    key = (header["shuffle"], int(header["partition"]))
-                    push_id = header.get("push_id")
-                    with state.lock:
-                        seen = state.agg_seen.setdefault(key, set())
-                        if push_id is None or push_id not in seen:
-                            if push_id is not None:
-                                seen.add(push_id)
-                            state.agg.setdefault(key, bytearray()).extend(
-                                payload)
-                            state._maybe_spill(key)
-                    send_msg(self.request, {"ok": True})
-                elif cmd == "push_block":
-                    key = (header["shuffle"], int(header["partition"]))
-                    with state.lock:
-                        state.blocks.setdefault(key, []).append(
-                            (header["block_id"], payload))
-                    send_msg(self.request, {"ok": True})
-                elif cmd == "fetch":
-                    key = (header["shuffle"], int(header["partition"]))
-                    with state.lock:
-                        data = state.read_agg(key)
-                    send_msg(self.request, {"ok": True, "len": len(data)},
-                             data)
-                elif cmd == "fetch_blocks":
-                    key = (header["shuffle"], int(header["partition"]))
-                    with state.lock:
-                        blocks = list(state.blocks.get(key, []))
-                    body = b"".join(b for _, b in blocks)
-                    send_msg(self.request, {
-                        "ok": True, "len": len(body),
-                        "blocks": [{"id": bid, "len": len(b)}
-                                   for bid, b in blocks]}, body)
-                elif cmd == "delete":
-                    sid = header["shuffle"]
-                    with state.lock:
-                        for k in [k for k in state.agg if k[0] == sid]:
-                            del state.agg[k]
-                        for k in [k for k in state.agg_spilled
-                                  if k[0] == sid]:
-                            try:
-                                os.remove(state.agg_spilled[k])
-                            except OSError:
-                                pass
-                            del state.agg_spilled[k]
-                        for k in [k for k in state.agg_seen
-                                  if k[0] == sid]:
-                            del state.agg_seen[k]
-                        for k in [k for k in state.blocks if k[0] == sid]:
-                            del state.blocks[k]
-                    send_msg(self.request, {"ok": True})
-                else:
-                    send_msg(self.request,
-                             {"ok": False, "error": f"bad cmd {cmd}"})
-        except (ConnectionError, OSError):
+            self._serve(state)
+        except (ConnectionError, OSError, ValueError):
+            # bad frame / oversized header: drop the connection quietly
             return
+
+    def _serve(self, state: "_State") -> None:
+        while True:
+            header, payload = recv_msg(self.request,
+                                   max_payload=MAX_PAYLOAD_LEN)
+            cmd = header["cmd"]
+            if cmd == "ping":
+                send_msg(self.request, {"ok": True})
+            elif cmd == "push":
+                key = (header["shuffle"], int(header["partition"]))
+                push_id = header.get("push_id")
+                with state.lock:
+                    seen = state.agg_seen.setdefault(key, set())
+                    if push_id is None or push_id not in seen:
+                        if push_id is not None:
+                            seen.add(push_id)
+                        state.agg.setdefault(key, bytearray()).extend(
+                            payload)
+                        state._maybe_spill(key)
+                send_msg(self.request, {"ok": True})
+            elif cmd == "push_block":
+                key = (header["shuffle"], int(header["partition"]))
+                with state.lock:
+                    state.blocks.setdefault(key, []).append(
+                        (header["block_id"], payload))
+                send_msg(self.request, {"ok": True})
+            elif cmd == "fetch":
+                key = (header["shuffle"], int(header["partition"]))
+                with state.lock:
+                    data = state.read_agg(key)
+                send_msg(self.request, {"ok": True, "len": len(data)},
+                         data)
+            elif cmd == "fetch_blocks":
+                key = (header["shuffle"], int(header["partition"]))
+                with state.lock:
+                    blocks = list(state.blocks.get(key, []))
+                body = b"".join(b for _, b in blocks)
+                send_msg(self.request, {
+                    "ok": True, "len": len(body),
+                    "blocks": [{"id": bid, "len": len(b)}
+                               for bid, b in blocks]}, body)
+            elif cmd == "delete":
+                sid = header["shuffle"]
+                with state.lock:
+                    for k in [k for k in state.agg if k[0] == sid]:
+                        del state.agg[k]
+                    for k in [k for k in state.agg_spilled
+                              if k[0] == sid]:
+                        try:
+                            os.remove(state.agg_spilled[k])
+                        except OSError:
+                            pass
+                        del state.agg_spilled[k]
+                    for k in [k for k in state.agg_seen
+                              if k[0] == sid]:
+                        del state.agg_seen[k]
+                    for k in [k for k in state.blocks if k[0] == sid]:
+                        del state.blocks[k]
+                send_msg(self.request, {"ok": True})
+            else:
+                send_msg(self.request,
+                         {"ok": False, "error": f"bad cmd {cmd}"})
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -157,7 +177,12 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 class ShuffleServer:
     """Threaded in-process server; `with ShuffleServer() as srv:` yields
-    (host, port)."""
+    (host, port).
+
+    Security note: the protocol is unauthenticated — bind loopback (the
+    default) or a trusted network only.  Frame sizes are capped
+    (MAX_HEADER_LEN / MAX_PAYLOAD_LEN) so a malformed header cannot force
+    unbounded allocations."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  spill_dir: Optional[str] = None,

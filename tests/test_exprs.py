@@ -217,6 +217,29 @@ def test_math_functions():
         left=col("i32"), op="%", right=lit(25)), dtype=DataType.int32()),)))
 
 
+def test_log_null_semantics():
+    """Spark UnaryLogExpression / Logarithm: NULL outside the domain
+    (x<=0, base<=0); base==1 allowed -> ±Inf/NaN by IEEE division."""
+    f = E.ScalarFunctionCall
+    rb = pa.record_batch({"x": pa.array([2.0, 0.0, -3.0, 1.0, None]),
+                          "b": pa.array([10.0, 2.0, 2.0, 1.0, 2.0])})
+    for name in ("ln", "log10", "log2"):
+        check_expr(f(name=name, args=(col("x"),)), rb)
+    check_expr(f(name="log", args=(col("x"),)), rb)
+    check_expr(f(name="log", args=(col("b"), col("x"))), rb)
+    # explicit value assertions (not just device/host agreement)
+    schema = from_arrow_schema(rb.schema)
+    got = host_eval.evaluate_arrow(
+        f(name="log", args=(col("b"), col("x"))), rb, schema).to_pylist()
+    assert got[0] == pytest.approx(math.log(2.0) / math.log(10.0))
+    assert got[1] is None and got[2] is None      # x <= 0 -> NULL
+    assert math.isnan(got[3])      # base==1, x==1: ln(1)/ln(1) = 0/0 = NaN
+    assert got[4] is None
+    got_ln = host_eval.evaluate_arrow(
+        f(name="ln", args=(col("x"),)), rb, schema).to_pylist()
+    assert got_ln[1] is None and got_ln[2] is None
+
+
 def test_conditional_functions():
     f = E.ScalarFunctionCall
     check_expr(f(name="coalesce", args=(col("i32"), col("i64"), lit(0))))

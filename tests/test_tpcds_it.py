@@ -29,7 +29,7 @@ def test_tpcds_query(runner, query):
     assert r.rows > 0, f"{query} returned no rows"
 
 
-def test_plan_stability(catalog, tmp_path):
+def test_plan_stability(catalog, tmp_path, monkeypatch):
     """Same plan converted twice renders identically (golden round-trip)."""
     from auron_tpu.it import stability
     from auron_tpu import config
@@ -38,6 +38,15 @@ def test_plan_stability(catalog, tmp_path):
     from auron_tpu.it.queries import build
 
     golden = str(tmp_path / "goldens")
+    # a missing golden is a hard failure, not a silent auto-create
+    monkeypatch.delenv("AURON_REGEN_GOLDEN", raising=False)
+    session = AuronSession(foreign_engine=PyArrowEngine())
+    res = session.execute(build("q03", catalog))
+    text = stability.render_plan(res.converted, res.ctx)
+    assert stability.check_stability("q03", text, golden) is not None
+    monkeypatch.setenv("AURON_REGEN_GOLDEN", "1")
+    assert stability.check_stability("q03", text, golden) is None
+    monkeypatch.delenv("AURON_REGEN_GOLDEN")
     for attempt in range(2):
         session = AuronSession(foreign_engine=PyArrowEngine())
         res = session.execute(build("q03", catalog))
