@@ -205,6 +205,12 @@ SMJ_FALLBACK_MEM_SIZE_THRESHOLD = conf.define(
     "auron.smj.fallback.mem.size.threshold", 1 << 30,
     "Build-side byte threshold beyond which BHJ falls back to SMJ.",
 )
+AGG_MERGE_FANIN = conf.define(
+    "auron.agg.merge.fanin", 8,
+    "Staged grouped entries accumulated before one device-side merge "
+    "reduce; higher values amortize the per-merge host sync over more "
+    "input batches (the multi-level merge analogue, agg_table.rs:323).",
+)
 PARTIAL_AGG_SKIPPING_ENABLE = conf.define(
     "auron.partial.agg.skipping.enable", True,
     "Skip partial aggregation when cardinality reduction is poor "

@@ -58,6 +58,28 @@ _DEVICE_FUNCS = {
 }
 
 
+_ROW_BASE_KINDS = {"row_num", "monotonically_increasing_id"}
+
+
+def _tree_has_row_base(e: Node) -> bool:
+    """Does this expr (sub)tree read the running row offset?  Operators
+    only track row_base (a per-batch host count, i.e. a sync on lazy
+    batches) when an expression actually needs it.  Recurses through ANY
+    Node field (e.g. Case's WhenThen branches are Nodes, not Exprs)."""
+    import dataclasses as _dc
+    if getattr(e, "kind", None) in _ROW_BASE_KINDS:
+        return True
+    for f in _dc.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, Node) and _tree_has_row_base(v):
+            return True
+        if isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, Node) and _tree_has_row_base(x):
+                    return True
+    return False
+
+
 def _is_literal(e: E.Expr) -> bool:
     return e.kind in ("literal", "scalar_subquery")
 
@@ -609,7 +631,7 @@ class CompiledExprs:
     def __init__(self, exprs: Tuple[E.Expr, ...], schema: Schema):
         self.exprs = tuple(exprs)
         self.schema = schema
-        self._jit_cache: Dict[Tuple, Any] = {}
+        self.uses_row_base = any(_tree_has_row_base(x) for x in self.exprs)
         self.out_types: List[DataType] = []
         # placeholder; resolved per batch because host-column placement can
         # depend on runtime column representation (oversize strings)
@@ -683,7 +705,7 @@ class CompiledExprs:
         if run_exprs:
             fn = self._get_jit(tuple(run_exprs), dev_schema, batch.capacity,
                                tuple(self._shape_sig(c) for c in dev_in))
-            outs = list(fn(dev_in, jnp.asarray(batch.num_rows, jnp.int32),
+            outs = list(fn(dev_in, batch.num_rows_dev(),
                            jnp.asarray(partition_id, jnp.int32),
                            jnp.asarray(row_base, jnp.int64)))
         result: List[Col] = []
@@ -699,17 +721,19 @@ class CompiledExprs:
 
     def _get_jit(self, device_exprs, dev_schema: Schema, capacity: int,
                  sig: Tuple):
-        key = (device_exprs, dev_schema, capacity, sig)
-        fn = self._jit_cache.get(key)
-        if fn is None:
+        # module-global cache: operator instances are rebuilt per task, so a
+        # per-instance cache would re-trace every execute_plan call
+        from auron_tpu.ops.kernel_cache import cached_jit
+        key = ("exprs", device_exprs, dev_schema, capacity, sig)
+
+        def build():
             def run(cols, num_rows, partition_id, row_base):
                 ctx = EvalCtx(cols=list(cols), schema=dev_schema,
                               num_rows=num_rows, capacity=capacity,
                               partition_id=partition_id, row_base=row_base)
                 return [evaluate(x, ctx) for x in device_exprs]
-            fn = jax.jit(run)
-            self._jit_cache[key] = fn
-        return fn
+            return run
+        return cached_jit(key, build)
 
 
 def build_evaluator(exprs, schema: Schema) -> CompiledExprs:

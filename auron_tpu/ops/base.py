@@ -71,7 +71,12 @@ class Operator:
             self.metrics.add("elapsed_compute_ns", time.perf_counter_ns() - t0)
             if not ctx.is_running:
                 return
-            self.metrics.add("output_rows", batch.num_rows)
+            if batch.num_rows_known:
+                self.metrics.add("output_rows", batch.num_rows)
+            else:
+                # lazy batch: never force a sync just for a metric
+                self.metrics.add_deferred("output_rows",
+                                          batch.num_rows_dev())
             self.metrics.add("output_batches", 1)
             yield batch
 

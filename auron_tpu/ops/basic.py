@@ -37,7 +37,8 @@ class ProjectExec(Operator):
         for b in self.child_stream(ctx):
             cols = self._eval(b, partition_id=ctx.partition_id,
                               row_base=self._row_base)
-            self._row_base += b.num_rows
+            if self._eval.uses_row_base:
+                self._row_base += b.num_rows
             yield b.with_columns(self.schema, cols)
 
 
@@ -62,23 +63,56 @@ class FilterExec(Operator):
         self._row_base = 0
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        from auron_tpu.columnar.batch import HostColumn
+        from auron_tpu.ops.kernel_cache import cached_jit, host_sync
+        track_base = self._pred.uses_row_base or \
+            (self._proj is not None and self._proj.uses_row_base)
+        compact = cached_jit("filter.compact_gather",
+                             _filter_compact_builder)
         for b in self.child_stream(ctx):
             [m] = self._pred(b, partition_id=ctx.partition_id,
                              row_base=self._row_base)
-            keep = jnp.logical_and(
-                jnp.logical_and(m.validity, m.data.astype(bool)),
-                b.row_mask())
-            idx, count = compact_indices(keep, b.capacity)
-            n = int(count)
-            self._row_base += b.num_rows
-            if n == 0:
-                continue
             src = b
             if self._proj is not None:
                 cols = self._proj(b, partition_id=ctx.partition_id,
-                                  row_base=self._row_base - b.num_rows)
+                                  row_base=self._row_base)
                 src = b.with_columns(self.schema, cols)
-            yield src.gather(idx, n)
+            if track_base:
+                self._row_base += b.num_rows
+            host_cols = [i for i, c in enumerate(src.columns)
+                         if isinstance(c, HostColumn)]
+            dev_cols = [c for i, c in enumerate(src.columns)
+                        if i not in host_cols]
+            out, idx, count = compact(dev_cols, m.data, m.validity,
+                                      b.num_rows_dev())
+            if host_cols:
+                # hybrid row: host columns gather on host by the same index
+                n = int(host_sync(count))
+                if n == 0:
+                    continue
+                hidx = np.asarray(host_sync(idx))[:n]
+                merged = []
+                it = iter(out)
+                for i, c in enumerate(src.columns):
+                    merged.append(c.gather_host(hidx) if i in host_cols
+                                  else next(it))
+                yield Batch(self.schema, merged, n, src.capacity)
+            else:
+                # lazy emission: the count stays on device; downstream
+                # syncs only if it actually needs the host int
+                yield Batch(self.schema, list(out), count, src.capacity)
+
+
+def _filter_compact_builder():
+    def run(cols, mask_data, mask_valid, num_rows):
+        cap = mask_data.shape[0]
+        live = jnp.arange(cap) < num_rows
+        keep = jnp.logical_and(
+            jnp.logical_and(mask_valid, mask_data.astype(bool)), live)
+        idx, count = compact_indices(keep, cap)
+        valid = jnp.arange(cap) < count
+        return [c.gather(idx, valid) for c in cols], idx, count
+    return run
 
 
 class LimitExec(Operator):

@@ -22,8 +22,9 @@ from auron_tpu.ir.plan import JoinOn
 from auron_tpu.ir.schema import DataType, Field, Schema
 from auron_tpu.ops.base import Operator, TaskContext, batch_size, compact_indices
 from auron_tpu.ops.joins.kernel import (
-    BuildTable, combine_sides, expand_pairs, join_key_hash,
-    null_columns_like, probe_ranges, verify_pairs,
+    BuildTable, _build_pair_kernel, _build_range_kernel, combine_sides,
+    expand_pairs, join_key_hash, null_columns_like, probe_ranges,
+    verify_pairs,
 )
 
 _PAIR_SIDES = {"inner", "left", "right", "full"}
@@ -102,69 +103,177 @@ class _HashJoinBase(Operator):
     def _probe_stream(self, ctx: TaskContext,
                       table: BuildTable) -> Iterator[Batch]:
         probe_i = 0 if self.probe_is_left else 1
-        probe_child = self.children[probe_i]
         key_eval = self._left_keys if self.probe_is_left else self._right_keys
         jt = self.join_type
         build_matched = jnp.zeros(table.batch.capacity, bool)
-        emit_pairs = jt in _PAIR_SIDES
+        state = {"build_matched": build_matched}
+        hybrid_table = table.batch.has_host_columns()
         for b in self.child_stream(ctx, probe_i):
             if b.num_rows == 0:
                 continue
             with self.metrics.timer("probe_time_ns"):
                 pkeys = key_eval(b, partition_id=ctx.partition_id)
-                ph, pvalid = join_key_hash(pkeys, b.capacity)
-                lo, counts = probe_ranges(table, ph, pvalid, b.row_mask())
-                total = int(jnp.sum(counts))
-                probe_matched = jnp.zeros(b.capacity, bool)
-                chunk_cap = bucket_capacity(min(max(total, 1), batch_size()))
-                for start in range(0, max(total, 0), chunk_cap):
-                    probe_idx, offset, live = expand_pairs(
-                        lo, counts, start, chunk_cap)
-                    sorted_pos = jnp.take(lo, probe_idx) + offset
-                    sorted_pos = jnp.clip(sorted_pos, 0,
-                                          table.batch.capacity - 1)
-                    build_idx = jnp.take(table.perm, sorted_pos)
-                    ok = verify_pairs(pkeys, table.key_cols, probe_idx,
-                                      build_idx, live)
-                    probe_matched = probe_matched.at[probe_idx].max(ok)
-                    if jt == "full" or (jt == "right" and self.probe_is_left) \
-                            or (jt == "left" and not self.probe_is_left):
-                        build_matched = build_matched.at[build_idx].max(ok)
-                    if emit_pairs:
-                        idx, cnt = compact_indices(ok, chunk_cap)
-                        n = int(cnt)
-                        if n == 0:
-                            continue
-                        pi = jnp.take(probe_idx, idx)
-                        bi = jnp.take(build_idx, idx)
-                        yield self._emit_pair_batch(b, table.batch, pi, bi,
-                                                    n, chunk_cap)
-                # per-batch probe-side emissions
-                if jt == "full":
-                    yield from self._emit_unmatched(
-                        b, probe_matched, probe_side_left=self.probe_is_left)
-                elif jt == "left" and self.probe_is_left:
-                    yield from self._emit_unmatched(b, probe_matched,
-                                                    probe_side_left=True)
-                elif jt == "right" and not self.probe_is_left:
-                    yield from self._emit_unmatched(b, probe_matched,
-                                                    probe_side_left=False)
-                elif jt in ("left_semi", "right_semi"):
-                    yield from self._emit_filtered(b, probe_matched)
-                elif jt in ("left_anti", "right_anti"):
-                    yield from self._emit_filtered(
-                        b, jnp.logical_not(probe_matched))
-                elif jt == "existence":
-                    ex = DeviceColumn(DataType.bool_(),
-                                      jnp.logical_and(probe_matched,
-                                                      b.row_mask()),
-                                      jnp.ones(b.capacity, bool))
-                    yield Batch(self.schema, list(b.columns) + [ex],
-                                b.num_rows, b.capacity)
+                if hybrid_table or b.has_host_columns():
+                    yield from self._probe_batch_eager(b, pkeys, table, state)
+                else:
+                    yield from self._probe_batch_fused(b, pkeys, table, state)
         # build-side unmatched (right/full outer relative to orientation)
         if (jt == "right" and self.probe_is_left) or \
                 (jt == "left" and not self.probe_is_left) or jt == "full":
-            yield from self._emit_build_unmatched(table, build_matched)
+            yield from self._emit_build_unmatched(table,
+                                                  state["build_matched"])
+
+    # -- fused probe (all-device batches): one jitted kernel per chunk,
+    #    one packed host fetch per probe batch in the common case ---------
+
+    def _track_build(self) -> bool:
+        jt = self.join_type
+        return jt == "full" or (jt == "right" and self.probe_is_left) \
+            or (jt == "left" and not self.probe_is_left)
+
+    def _side_kind(self) -> str:
+        """Probe-side emission kind computed from final probe_matched."""
+        jt = self.join_type
+        if jt == "full" or (jt == "left" and self.probe_is_left) \
+                or (jt == "right" and not self.probe_is_left):
+            return "unmatched"
+        if jt in ("left_semi", "right_semi"):
+            return "semi"
+        if jt in ("left_anti", "right_anti"):
+            return "anti"
+        if jt == "existence":
+            return "existence"
+        return "none"
+
+    def _probe_batch_fused(self, b: Batch, pkeys, table: BuildTable,
+                           state) -> Iterator[Batch]:
+        from auron_tpu.ops.kernel_cache import cached_jit, host_sync
+        jt = self.join_type
+        emit_pairs = jt in _PAIR_SIDES
+        track_build = self._track_build()
+        side_kind = self._side_kind()
+        chunk_cap = bucket_capacity(batch_size())
+
+        def pair_kernel(is_final: bool):
+            return cached_jit(
+                ("join.pair", emit_pairs, track_build, side_kind, is_final),
+                lambda: _build_pair_kernel(emit_pairs, track_build,
+                                           side_kind, is_final),
+                static_argnames=("chunk_cap",))
+
+        range_k = cached_jit("join.range", _build_range_kernel)
+        lo, counts, total_dev = range_k(pkeys, table.sorted_hashes,
+                                        b.num_rows_dev())
+        probe_matched = jnp.zeros(b.capacity, bool)
+
+        def run_chunk(start: int, is_final: bool):
+            nonlocal probe_matched
+            (out_p, out_b, side_cols, counts3, probe_matched,
+             bm) = pair_kernel(is_final)(
+                list(b.columns), pkeys, list(table.batch.columns),
+                table.key_cols, lo, counts, total_dev, table.perm,
+                b.num_rows_dev(), probe_matched, state["build_matched"],
+                jnp.asarray(start, jnp.int64), chunk_cap=chunk_cap)
+            state["build_matched"] = bm
+            total, n_pairs, n_side = (int(x) for x in host_sync(counts3))
+            return out_p, out_b, side_cols, total, n_pairs, n_side
+
+        # chunk 0 optimistically computes the side emission too (single
+        # fetch in the common single-chunk case); multi-chunk probes rerun
+        # the side gather on the true final chunk
+        out_p, out_b, side_cols, total, n_pairs, n_side = \
+            run_chunk(0, is_final=True)
+        if emit_pairs and n_pairs > 0:
+            left_cols, right_cols = (out_p, out_b) \
+                if self.probe_is_left else (out_b, out_p)
+            yield combine_sides(self.schema, left_cols, right_cols,
+                                n_pairs, chunk_cap)
+        for start in range(chunk_cap, total, chunk_cap):
+            is_final = start + chunk_cap >= total
+            out_p, out_b, side_cols, _t, n_pairs, n_side = \
+                run_chunk(start, is_final)
+            if emit_pairs and n_pairs > 0:
+                left_cols, right_cols = (out_p, out_b) \
+                    if self.probe_is_left else (out_b, out_p)
+                yield combine_sides(self.schema, left_cols, right_cols,
+                                    n_pairs, chunk_cap)
+        # side emission (valid only after the final chunk): kernel computed
+        # it from the running probe_matched, which is final here
+        if side_kind == "existence":
+            ex = DeviceColumn(DataType.bool_(),
+                              jnp.logical_and(probe_matched, b.row_mask()),
+                              jnp.ones(b.capacity, bool))
+            yield Batch(self.schema, list(b.columns) + [ex], b.num_rows,
+                        b.capacity)
+        elif side_kind != "none" and n_side > 0:
+            if side_kind == "unmatched":
+                other = self.children[1 if self.probe_is_left else 0].schema
+                nulls = null_columns_like(other.fields, b.capacity)
+                if self.probe_is_left:
+                    yield combine_sides(self.schema, side_cols, nulls,
+                                        n_side, b.capacity)
+                else:
+                    yield combine_sides(self.schema, nulls, side_cols,
+                                        n_side, b.capacity)
+            else:  # semi / anti
+                yield Batch(self.schema, list(side_cols), n_side, b.capacity)
+
+    # -- eager probe (host-column fallback) ------------------------------
+
+    def _probe_batch_eager(self, b: Batch, pkeys, table: BuildTable,
+                           state) -> Iterator[Batch]:
+        jt = self.join_type
+        emit_pairs = jt in _PAIR_SIDES
+        ph, pvalid = join_key_hash(pkeys, b.capacity)
+        lo, counts = probe_ranges(table.sorted_hashes, ph, pvalid,
+                                  b.row_mask())
+        total = int(jnp.sum(counts))
+        probe_matched = jnp.zeros(b.capacity, bool)
+        chunk_cap = bucket_capacity(min(max(total, 1), batch_size()))
+        for start in range(0, max(total, 0), chunk_cap):
+            probe_idx, offset, live = expand_pairs(
+                lo, counts, jnp.asarray(start, jnp.int64), chunk_cap)
+            sorted_pos = jnp.take(lo, probe_idx) + offset
+            sorted_pos = jnp.clip(sorted_pos, 0,
+                                  table.batch.capacity - 1)
+            build_idx = jnp.take(table.perm, sorted_pos)
+            ok = verify_pairs(pkeys, table.key_cols, probe_idx,
+                              build_idx, live)
+            probe_matched = probe_matched.at[probe_idx].max(ok)
+            if self._track_build():
+                state["build_matched"] = \
+                    state["build_matched"].at[build_idx].max(ok)
+            if emit_pairs:
+                idx, cnt = compact_indices(ok, chunk_cap)
+                n = int(cnt)
+                if n == 0:
+                    continue
+                pi = jnp.take(probe_idx, idx)
+                bi = jnp.take(build_idx, idx)
+                yield self._emit_pair_batch(b, table.batch, pi, bi,
+                                            n, chunk_cap)
+        # per-batch probe-side emissions
+        if jt == "full":
+            yield from self._emit_unmatched(
+                b, probe_matched, probe_side_left=self.probe_is_left)
+        elif jt == "left" and self.probe_is_left:
+            yield from self._emit_unmatched(b, probe_matched,
+                                            probe_side_left=True)
+        elif jt == "right" and not self.probe_is_left:
+            yield from self._emit_unmatched(b, probe_matched,
+                                            probe_side_left=False)
+        elif jt in ("left_semi", "right_semi"):
+            yield from self._emit_filtered(b, probe_matched)
+        elif jt in ("left_anti", "right_anti"):
+            yield from self._emit_filtered(
+                b, jnp.logical_not(probe_matched))
+        elif jt == "existence":
+            ex = DeviceColumn(DataType.bool_(),
+                              jnp.logical_and(probe_matched,
+                                              b.row_mask()),
+                              jnp.ones(b.capacity, bool))
+            yield Batch(self.schema, list(b.columns) + [ex],
+                        b.num_rows, b.capacity)
 
     # -- emitters ------------------------------------------------------------
 

@@ -63,11 +63,11 @@ class BuildTable:
                           num_rows=batch.num_rows)
 
 
-def probe_ranges(table: BuildTable, probe_hash, probe_valid, probe_live):
+def probe_ranges(sorted_hashes, probe_hash, probe_valid, probe_live):
     ph = jnp.where(jnp.logical_and(probe_live, probe_valid), probe_hash,
                    _NULL_PROBE)
-    lo = jnp.searchsorted(table.sorted_hashes, ph, side="left")
-    hi = jnp.searchsorted(table.sorted_hashes, ph, side="right")
+    lo = jnp.searchsorted(sorted_hashes, ph, side="left")
+    hi = jnp.searchsorted(sorted_hashes, ph, side="right")
     counts = (hi - lo).astype(jnp.int64)
     return lo.astype(jnp.int32), counts
 
@@ -113,3 +113,68 @@ def combine_sides(out_schema: Schema, left_cols: List[Any],
                   extra: Optional[List[Any]] = None) -> Batch:
     cols = list(left_cols) + list(right_cols) + list(extra or [])
     return Batch(out_schema, cols, num_rows, capacity)
+
+
+def _build_range_kernel():
+    """Once-per-probe-batch program: key hash + build-table range lookup.
+    Outputs feed every chunk of the pair kernel (so the double-searchsorted
+    is never repeated per chunk)."""
+    def run(pkeys, sorted_hashes, probe_num_rows):
+        pcap = pkeys[0].validity.shape[0]
+        plive = jnp.arange(pcap) < probe_num_rows
+        ph, pvalid = join_key_hash(pkeys, pcap)
+        lo, counts = probe_ranges(sorted_hashes, ph, pvalid, plive)
+        return lo, counts, jnp.sum(counts)
+    return run
+
+
+def _build_pair_kernel(emit_pairs: bool, track_build: bool,
+                       side_kind: str, is_final: bool):
+    """The fused per-chunk probe program: pair expansion -> verification ->
+    matched-flag updates -> pair gather -> (final chunk only) probe-side
+    emission gather.  Pure jax; jitted once per static-flag combination via
+    kernel_cache and reused across all joins of that shape — the
+    counterpart of the reference's compiled bhj/smj joiners
+    (joins/bhj/full_join.rs:379)."""
+    from auron_tpu.ops.base import compact_indices
+
+    def run(probe_cols, pkeys, build_cols, bkeys, lo, counts, total, perm,
+            probe_num_rows, probe_matched_in, build_matched_in, start,
+            *, chunk_cap):
+        pcap = probe_matched_in.shape[0]
+        bcap = perm.shape[0]
+        plive = jnp.arange(pcap) < probe_num_rows
+        probe_idx, offset, pair_live = expand_pairs(lo, counts, start,
+                                                    chunk_cap)
+        sorted_pos = jnp.clip(jnp.take(lo, probe_idx) + offset, 0, bcap - 1)
+        build_idx = jnp.take(perm, sorted_pos)
+        ok = verify_pairs(pkeys, bkeys, probe_idx, build_idx, pair_live)
+        probe_matched = probe_matched_in.at[probe_idx].max(ok)
+        build_matched = build_matched_in.at[build_idx].max(ok) \
+            if track_build else build_matched_in
+        out_p: List[Any] = []
+        out_b: List[Any] = []
+        n_pairs = jnp.int32(0)
+        if emit_pairs:
+            idx, n_pairs = compact_indices(ok, chunk_cap)
+            ev = jnp.arange(chunk_cap) < n_pairs
+            pi = jnp.take(probe_idx, idx)
+            bi = jnp.take(build_idx, idx)
+            out_p = [c.gather(pi, ev) for c in probe_cols]
+            out_b = [c.gather(bi, ev) for c in build_cols]
+        side_cols: List[Any] = []
+        n_side = jnp.int32(0)
+        if is_final and side_kind in ("unmatched", "semi", "anti"):
+            if side_kind == "semi":
+                smask = jnp.logical_and(probe_matched, plive)
+            else:
+                smask = jnp.logical_and(jnp.logical_not(probe_matched),
+                                        plive)
+            sidx, n_side = compact_indices(smask, pcap)
+            sv = jnp.arange(pcap) < n_side
+            side_cols = [c.gather(sidx, sv) for c in probe_cols]
+        counts3 = jnp.stack([total.astype(jnp.int64),
+                             n_pairs.astype(jnp.int64),
+                             n_side.astype(jnp.int64)])
+        return out_p, out_b, side_cols, counts3, probe_matched, build_matched
+    return run

@@ -1,0 +1,56 @@
+"""Global jitted-kernel cache + the single-sync policy.
+
+The reference keeps one long-lived native runtime per executor process and
+compiles nothing per task; round 1 of this engine rebuilt every operator's
+jit cache per `execute_plan` call, so every task re-traced every kernel.
+This module is the fix: jitted kernels live at module scope, keyed by the
+*static structure* that determines the traced program (jax.jit's own cache
+then keys on avals/pytree structure), so a repeated query shape executes
+with zero re-tracing — the analogue of the reference running pre-compiled
+Rust code per task (rt.rs:76-139).
+
+Single-sync policy: operators fetch device results to host only through
+`host_sync` (one fetch per operator per batch — typically the output row
+count).  Tests wrap pipelines in `jax.transfer_guard("disallow")` and count
+`host_sync` calls, which both catches stray implicit transfers and enforces
+the <=1-sync budget (the per-batch-host-round-trip problem the reference
+avoids with its mpsc(1) pipeline, rt.rs:141-238).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Hashable, Tuple
+
+import jax
+
+_CACHE: Dict[Hashable, Any] = {}
+
+
+def cached_jit(key: Hashable, builder: Callable[[], Callable],
+               static_argnames: Tuple[str, ...] = ()) -> Callable:
+    """Return the module-global jitted kernel for `key`, building it on
+    first use.  `builder()` must return a pure function of jax pytrees;
+    differing input shapes/structures are handled by jax.jit's own cache
+    under the same key."""
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(builder(), static_argnames=static_argnames)
+        _CACHE[key] = fn
+    return fn
+
+
+def host_sync(x: Any) -> Any:
+    """The sanctioned device->host fetch (see module docstring).  Returns
+    numpy/python values; accepts any pytree (fetched as one unit so a
+    packed scalar pair costs one round trip)."""
+    with jax.transfer_guard("allow"):
+        return jax.device_get(x)
+
+
+def cache_info() -> Dict[str, int]:
+    return {"kernels": len(_CACHE)}
+
+
+def clear() -> None:
+    """Test hook: drop every cached kernel (forces re-tracing)."""
+    _CACHE.clear()

@@ -107,6 +107,12 @@ def lexsort_indices(words: List[Any], num_rows, capacity: int):
     """Stable argsort by word list (most-significant first); padding rows
     (index >= num_rows) sort last.  Returns int32[capacity] permutation."""
     live = jnp.arange(capacity) < jnp.asarray(num_rows, jnp.int32)
+    return lexsort_indices_live(words, live)
+
+
+def lexsort_indices_live(words: List[Any], live):
+    """Same, from an explicit live mask (non-live rows sort last) — lets
+    kernels sort concatenations of padded segments without a host sync."""
     pad_rank = jnp.where(live, jnp.uint64(0), jnp.uint64(1))
     # jnp.lexsort: last key is primary
     keys = list(reversed([pad_rank] + words))

@@ -33,14 +33,30 @@ class MetricNode:
     name: str
     values: Dict[str, int] = field(default_factory=dict)
     children: List["MetricNode"] = field(default_factory=list)
+    deferred: Dict[str, list] = field(default_factory=dict)
 
     def add(self, key: str, delta: int) -> None:
         self.values[key] = self.values.get(key, 0) + int(delta)
+
+    def add_deferred(self, key: str, device_scalar) -> None:
+        """Accumulate a device scalar without syncing; folded into values
+        on first read (metrics must never force a hot-path round trip)."""
+        self.deferred.setdefault(key, []).append(device_scalar)
+
+    def _settle(self) -> None:
+        if self.deferred:
+            from auron_tpu.ops.kernel_cache import host_sync
+            vals = host_sync(self.deferred)
+            self.deferred = {}
+            for key, deltas in vals.items():
+                for d in deltas:
+                    self.add(key, int(d))
 
     def set(self, key: str, value: int) -> None:
         self.values[key] = int(value)
 
     def get(self, key: str) -> int:
+        self._settle()
         return self.values.get(key, 0)
 
     @contextmanager
@@ -57,10 +73,12 @@ class MetricNode:
         return node
 
     def to_dict(self) -> dict:
+        self._settle()
         return {"name": self.name, "values": dict(self.values),
                 "children": [c.to_dict() for c in self.children]}
 
     def render(self, indent: int = 0) -> str:
+        self._settle()
         pad = "  " * indent
         vals = ", ".join(f"{k}={v}" for k, v in sorted(self.values.items()))
         lines = [f"{pad}{self.name}: {vals}"]

@@ -98,3 +98,81 @@ def test_all_native_false_on_foreign_only_run():
     with config.conf.scoped({"auron.enable": False}):
         res = AuronSession(foreign_engine=_Engine()).execute(src)
     assert not res.all_native()
+
+
+# -- round-2 lazy-batch / staged-agg review findings ---------------------
+
+def _exec_ir(plan, rows, schema, chunk=30):
+    """Run an IR plan over an FFI source feeding `rows`."""
+    from auron_tpu.ir import plan as P
+    from auron_tpu.ir.schema import from_arrow_schema, to_arrow_schema
+    from auron_tpu.runtime.executor import execute_plan
+    from auron_tpu.runtime.resources import ResourceRegistry
+    t = pa.Table.from_pylist(rows, schema=to_arrow_schema(schema))
+    res = ResourceRegistry()
+    res.put("src", t.to_batches(max_chunksize=chunk) if rows else [])
+    return execute_plan(plan, resources=res).to_pylist()
+
+
+def _ffi_src(schema):
+    from auron_tpu.ir import plan as P
+    return P.FFIReader(schema=schema, resource_id="src")
+
+
+def test_global_agg_over_fully_filtered_stream():
+    """Lazy filtered-to-empty batches must still produce the single
+    count=0 row for a global aggregate (round-2 review finding #1)."""
+    from auron_tpu.ir import expr as E, plan as P
+    from auron_tpu.ir.expr import AggExpr, col, lit
+    sch = Schema((Field("v", F64),))
+    plan = P.Agg(
+        child=P.Filter(child=_ffi_src(sch), predicates=(
+            E.BinaryExpr(left=col("v"), op=">", right=lit(1000.0)),)),
+        exec_mode="single", grouping=(), grouping_names=(),
+        aggs=(AggExpr(fn="count", children=(col("v"),),
+                      return_type=I64),),
+        agg_names=("c",))
+    rows = [{"v": float(i)} for i in range(100)]
+    assert _exec_ir(plan, rows, sch) == [{"c": 0}]
+
+
+def test_row_num_inside_case_tracks_row_base():
+    """row_num nested in a CASE branch must advance the running row base
+    across batches (round-2 review finding #2)."""
+    from auron_tpu.ir import expr as E, plan as P
+    from auron_tpu.ir.expr import col
+    sch = Schema((Field("v", F64),))
+    case = E.Case(
+        branches=(E.WhenThen(when=E.BinaryExpr(left=col("v"), op=">=",
+                                               right=E.Literal(value=0.0,
+                                                               dtype=F64)),
+                             then=E.RowNum()),),
+        else_expr=None)
+    plan = P.Projection(child=_ffi_src(sch), exprs=(case,), names=("rn",))
+    rows = [{"v": float(i)} for i in range(100)]
+    got = [r["rn"] for r in _exec_ir(plan, rows, sch, chunk=30)]
+    assert got == list(range(1, 101)), got[:40]
+
+
+def test_partial_skipping_single_batch():
+    """A single staged batch must still update the true group count so the
+    skip-ratio check can fire (round-2 review finding #3)."""
+    from auron_tpu.ir import plan as P
+    from auron_tpu.ir.expr import AggExpr, col
+    from auron_tpu.ops.agg.exec import AggExec
+    from auron_tpu.ops.basic import MemoryScanExec
+    from auron_tpu.columnar.batch import Batch
+    from auron_tpu.ops.base import TaskContext
+    sch = Schema((Field("k", I64), Field("v", F64)))
+    n = 64
+    b = Batch.from_numpy(sch, [np.arange(n), np.ones(n)])
+    with config.conf.scoped({"auron.partial.agg.skipping.min.rows": 10,
+                             "auron.partial.agg.skipping.ratio": 0.5}):
+        agg = AggExec(MemoryScanExec(sch, [b]), "partial", (col("k"),),
+                      ("k",),
+                      (AggExpr(fn="sum", children=(col("v"),),
+                               return_type=F64),), ("s",),
+                      supports_partial_skipping=True)
+        out = list(agg.execute(TaskContext()))
+        assert agg._passthrough, "all-distinct keys must trigger skipping"
+        assert sum(bb.num_rows for bb in out) == n
