@@ -194,9 +194,22 @@ def numpy_strings_to_column(dt: DataType, a: np.ndarray, v: np.ndarray,
 # ---------------------------------------------------------------------------
 
 def batch_to_arrow(batch: Batch) -> pa.RecordBatch:
-    n = batch.num_rows
+    """Device batch -> arrow.  All device buffers (and a lazy row count)
+    are fetched in ONE host_sync call: per-column np.asarray would pay a
+    full host round trip per buffer (~70ms each on a tunnel-attached
+    TPU)."""
+    from auron_tpu.ops.kernel_cache import host_sync
+    dev_idx = [i for i, c in enumerate(batch.columns)
+               if not isinstance(c, HostColumn)]
+    count, fetched = host_sync((batch.num_rows_raw,
+                                [batch.columns[i] for i in dev_idx]))
+    n = int(count)
+    batch._num_rows = n
+    cols = list(batch.columns)
+    for i, c in zip(dev_idx, fetched):
+        cols[i] = c
     arrays = []
-    for f, c in zip(batch.schema, batch.columns):
+    for f, c in zip(batch.schema, cols):
         arrays.append(column_to_arrow(f.dtype, c, n))
     return pa.RecordBatch.from_arrays(arrays, schema=to_arrow_schema(batch.schema))
 

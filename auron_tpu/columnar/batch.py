@@ -315,6 +315,22 @@ def _gather_kernel_builder():
     return run
 
 
+def concat_device_columns(parts: List[Any]):
+    """Device concat of the same logical column across batches (pure jax;
+    string widths are padded to the widest part)."""
+    if isinstance(parts[0], DeviceStringColumn):
+        w = max(p.data.shape[1] for p in parts)
+        datas = [jnp.pad(p.data, ((0, 0), (0, w - p.data.shape[1])))
+                 if p.data.shape[1] < w else p.data for p in parts]
+        return DeviceStringColumn(
+            parts[0].dtype, jnp.concatenate(datas),
+            jnp.concatenate([p.lengths for p in parts]),
+            jnp.concatenate([p.validity for p in parts]))
+    return DeviceColumn(parts[0].dtype,
+                        jnp.concatenate([p.data for p in parts]),
+                        jnp.concatenate([p.validity for p in parts]))
+
+
 def is_device_type(dt: DataType) -> bool:
     """Can this logical type live on device?"""
     if dt.is_nested:

@@ -64,20 +64,9 @@ _ROW_BASE_KINDS = {"row_num", "monotonically_increasing_id"}
 def _tree_has_row_base(e: Node) -> bool:
     """Does this expr (sub)tree read the running row offset?  Operators
     only track row_base (a per-batch host count, i.e. a sync on lazy
-    batches) when an expression actually needs it.  Recurses through ANY
-    Node field (e.g. Case's WhenThen branches are Nodes, not Exprs)."""
-    import dataclasses as _dc
-    if getattr(e, "kind", None) in _ROW_BASE_KINDS:
-        return True
-    for f in _dc.fields(e):
-        v = getattr(e, f.name)
-        if isinstance(v, Node) and _tree_has_row_base(v):
-            return True
-        if isinstance(v, tuple):
-            for x in v:
-                if isinstance(x, Node) and _tree_has_row_base(x):
-                    return True
-    return False
+    batches) when an expression actually needs it."""
+    from auron_tpu.ir.node import tree_has_kind
+    return tree_has_kind(e, _ROW_BASE_KINDS)
 
 
 def _is_literal(e: E.Expr) -> bool:

@@ -54,6 +54,7 @@ class SessionResult:
     tags: Optional[strategy.Tags] = None
     metrics: List[MetricNode] = field(default_factory=list)
     ctx: Optional[ConvertContext] = None  # exchange/broadcast subtrees
+    spmd: bool = False  # executed as one shard_map program over a mesh
 
     def to_pylist(self) -> List[dict]:
         return self.table.to_pylist()
@@ -85,13 +86,40 @@ class AuronSession:
 
     # -- public entry (preColumnarTransitions analogue) -------------------
 
-    def execute(self, plan: ForeignNode) -> SessionResult:
+    def execute(self, plan: ForeignNode,
+                mesh=None, mesh_axis: str = "parts") -> SessionResult:
+        """Run a foreign plan.  With `mesh`, the converted native tree is
+        first offered to the SPMD stage compiler (parallel/stage.py): the
+        WHOLE pipeline — exchanges included — compiles to one shard_map
+        program riding ICI collectives; plans it cannot express fall back
+        to the serial per-partition path transparently."""
         if not config.ENABLE.get():
             return SessionResult(table=self._run_foreign_only(plan))
         tags = strategy.apply(plan)
         ctx = ConvertContext()
         converted = converters.convert_recursively(plan, tags, ctx)
         self._metrics = []
+        if mesh is not None and isinstance(converted, P.PlanNode):
+            from auron_tpu.parallel.stage import (
+                SpmdUnsupported, execute_plan_spmd, precheck_plan,
+            )
+            try:
+                # cheap kind-level check BEFORE materializing any foreign
+                # source (a fallback must not pay for C2N subtrees twice)
+                precheck_plan(converted, ctx)
+                sources = {rid: self._source_table(src, ctx)
+                           for rid, src in ctx.sources.items()}
+                table = execute_plan_spmd(converted, ctx, mesh, sources,
+                                          axis=mesh_axis)
+                res = SessionResult(table=table, converted=converted,
+                                    tags=tags, ctx=ctx, spmd=True)
+                res._foreign_sections = sum(  # type: ignore[attr-defined]
+                    1 for s in ctx.sources.values()
+                    if s.node.children or
+                    s.node.node.op != "LocalTableScanExec")
+                return res
+            except SpmdUnsupported as e:
+                log.info("SPMD compile fell back to serial path: %s", e)
         try:
             table = self._run_converted(converted, ctx)
         finally:
@@ -182,15 +210,18 @@ class AuronSession:
                                            resources)
         return resources
 
+    def _source_table(self, src: ForeignSource,
+                      ctx: ConvertContext) -> pa.Table:
+        is_local_table = (not src.node.children and
+                          src.node.node.op == "LocalTableScanExec")
+        return self._local_table(src.node.node) if is_local_table \
+            else self._run_converted(src.node, ctx)
+
     def _materialize_source(self, src: ForeignSource, ctx: ConvertContext,
                             resources: ResourceRegistry) -> None:
         """C2N: the foreign engine computes the subtree; its table feeds
         the FFIReader (ConvertToNativeBase.doExecuteNative analogue)."""
-        is_local_table = (not src.node.children and
-                          src.node.node.op == "LocalTableScanExec")
-        table = self._local_table(src.node.node) if is_local_table \
-            else self._run_converted(src.node, ctx)
-        resources.put(src.rid, table)
+        resources.put(src.rid, self._source_table(src, ctx))
 
     @staticmethod
     def _local_table(node: ForeignNode) -> pa.Table:

@@ -138,3 +138,22 @@ class Node:
                     changes[f.name] = nv
         node = dataclasses.replace(self, **changes) if changes else self
         return fn(node)
+
+
+def tree_has_kind(node: "Node", kinds) -> bool:
+    """True when any node of a kind in `kinds` appears in the (sub)tree,
+    recursing through Node fields and tuples (arbitrarily nested)."""
+    if getattr(node, "kind", None) in kinds:
+        return True
+
+    def rec(v: Any) -> bool:
+        if isinstance(v, Node):
+            return tree_has_kind(v, kinds)
+        if isinstance(v, tuple):
+            return any(rec(x) for x in v)
+        return False
+
+    for f in dataclasses.fields(node):
+        if rec(getattr(node, f.name)):
+            return True
+    return False

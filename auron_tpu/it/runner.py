@@ -32,13 +32,15 @@ class QueryResult:
     error: Optional[str] = None
     plan_error: Optional[str] = None
     skipped: Optional[str] = None   # exclusion reason
+    spmd: bool = False              # ran as one shard_map mesh program
 
     def to_dict(self) -> Dict:
         return {"name": self.name, "ok": self.ok,
                 "native_s": round(self.native_s, 4),
                 "oracle_s": round(self.oracle_s, 4), "rows": self.rows,
                 "all_native": self.all_native, "error": self.error,
-                "plan_error": self.plan_error, "skipped": self.skipped}
+                "plan_error": self.plan_error, "skipped": self.skipped,
+                "spmd": self.spmd}
 
 
 @dataclass
@@ -50,6 +52,9 @@ class QueryRunner:
     # reference's per-suite `.exclude(...)` lists
     # (AuronSparkTestSettings.scala:21-58)
     exclusions: Dict[str, str] = field(default_factory=dict)
+    # multi-device mode: offer every query to the SPMD stage compiler
+    # over this mesh first (serial fallback stays transparent)
+    mesh: Optional[object] = None
 
     def run(self, name: str) -> QueryResult:
         if name in self.exclusions:
@@ -62,7 +67,7 @@ class QueryRunner:
 
         session = AuronSession(foreign_engine=PyArrowEngine())
         t0 = time.perf_counter()
-        res = session.execute(plan)
+        res = session.execute(plan, mesh=self.mesh)
         native_s = time.perf_counter() - t0
 
         with config.conf.scoped({"auron.enable": False}):
@@ -81,7 +86,7 @@ class QueryRunner:
             name=name, ok=diff is None and plan_err is None,
             native_s=native_s, oracle_s=oracle_s,
             rows=res.table.num_rows, all_native=res.all_native(),
-            error=diff, plan_error=plan_err)
+            error=diff, plan_error=plan_err, spmd=res.spmd)
         self.results.append(qr)
         return qr
 

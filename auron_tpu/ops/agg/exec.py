@@ -28,7 +28,7 @@ import numpy as np
 
 from auron_tpu.columnar.batch import (
     Batch, DeviceColumn, DeviceStringColumn, HostColumn, bucket_capacity,
-    concat_batches,
+    concat_batches, concat_device_columns as _concat_cols,
 )
 from auron_tpu.config import conf
 from auron_tpu.exprs.compiler import build_evaluator
@@ -147,42 +147,60 @@ class AggExec(Operator, MemConsumer):
             return run
         return cached_jit(key, build)
 
-    def _merge_staged_kernel(self):
-        """Cached kernel merging N staged grouped entries (device concat of
-        partial states + one merge-reduce) in a single dispatch."""
+    def _reduce(self, keys: List[Any], vcols: List[List[Any]], live,
+                merge: bool):
+        """Dispatch a group reduction.  The update path is one fused
+        kernel; the MERGE path splits into a shared sort-base kernel plus
+        one kernel per agg spec: fusing two specs' merge reductions into a
+        single program SIGSEGVs the current libtpu AOT compiler (observed
+        on v5e; each piece compiles fine in isolation), and the split is
+        behaviorally identical with only extra async dispatches."""
         from auron_tpu.ops.kernel_cache import cached_jit
-        specs, orders = self.specs, self._key_orders()
+        if not merge or len(self.specs) <= 1:
+            return self._reduce_kernel(merge)(keys, vcols, live)
+        orders = self._key_orders()
         nk = len(self.grouping)
-        key = ("agg.merge_staged", self._spec_struct_key(), orders, nk)
+        base = cached_jit(("agg.sort_base", orders, nk),
+                          lambda: _sort_base_builder(orders))
+        perm, seg, n_groups, key_out = base(keys, live)
+        out_cols: List[Any] = list(key_out)
+        for spec, skey, cols in zip(self.specs, self._spec_struct_key(),
+                                    vcols):
+            k = cached_jit(("agg.spec_merge", skey),
+                           lambda spec=spec: _spec_merge_builder(spec))
+            out_cols.extend(k(cols, perm, seg, n_groups))
+        return out_cols, n_groups
 
-        def build():
-            def run(entries_cols, entries_ns):
-                lives = [jnp.arange(cols[0].data.shape[0]
-                                    if cols else 0) < n
-                         for cols, n in zip(entries_cols, entries_ns)]
-                ncols = len(entries_cols[0])
-                merged = [_concat_cols([e[i] for e in entries_cols])
-                          for i in range(ncols)]
-                live = jnp.concatenate(lives) if lives[0].shape[0] else \
-                    jnp.zeros(0, bool)
-                keys, states = merged[:nk], merged[nk:]
-                vcols: List[List[Any]] = []
-                off = 0
-                for spec in specs:
-                    k = len(spec.state_fields())
-                    vcols.append(states[off:off + k])
-                    off += k
-                return _group_reduce_body(keys, vcols, live, specs, orders,
-                                          merge=True)
-            return run
-        return cached_jit(key, build)
+    def _merge_staged_kernel(self):
+        """Merge N staged grouped entries: one small cached concat kernel
+        builds (merged cols, live mask); the merge-reduce then reuses the
+        SAME group-reduce kernel a single batch uses (two async dispatches,
+        zero syncs — and one heavy program shape instead of two)."""
+        from auron_tpu.ops.kernel_cache import cached_jit
+        nk = len(self.grouping)
+        specs = self.specs
+        concat_k = cached_jit("agg.concat_staged", _concat_staged_builder)
+
+        def run(entries_cols, entries_ns):
+            merged, live = concat_k(entries_cols,
+                                    [jnp.asarray(n, jnp.int32)
+                                     for n in entries_ns])
+            keys, states = merged[:nk], merged[nk:]
+            vcols: List[List[Any]] = []
+            off = 0
+            for spec in specs:
+                k = len(spec.state_fields())
+                vcols.append(states[off:off + k])
+                off += k
+            return self._reduce(keys, vcols, live, merge=True)
+        return run
 
     def _group_reduce(self, keys: List[Any], value_cols: List[List[Any]],
                       capacity: int, num_rows, merge: bool) -> Batch:
         """Compat wrapper: reduce one batch worth of rows to a grouped
         Batch with a LAZY group count (no host sync)."""
         live = jnp.arange(capacity) < jnp.asarray(num_rows, jnp.int32)
-        out_cols, n_dev = self._reduce_kernel(merge)(keys, value_cols, live)
+        out_cols, n_dev = self._reduce(keys, value_cols, live, merge)
         return Batch(self._state_schema(), out_cols, n_dev, capacity)
 
     # -- staged sync-free accumulation ---------------------------------
@@ -234,7 +252,9 @@ class AggExec(Operator, MemConsumer):
                                                       entries_ns)
         merged_cap = sum(cap for _c, _n, cap in self._staged)
         n = int(host_sync(n_dev))
-        out_cap = bucket_capacity(max(n, 1))
+        # never exceed the merged arrays' real length (bucket_capacity can
+        # round PAST it, leaving capacity > column length)
+        out_cap = min(bucket_capacity(max(n, 1)), merged_cap)
         if out_cap < merged_cap:
             # groups are compacted to the front: static truncation is safe
             kernel = cached_jit("agg.truncate", _truncate_builder,
@@ -397,8 +417,8 @@ class AggExec(Operator, MemConsumer):
                 # pays stats upkeep in, agg_ctx.rs:63-66)
                 self._input_rows += b.num_rows
             keys, vcols = self._eval_vcols(b, ctx, merge_input)
-            out_cols, n_dev = self._reduce_kernel(merge_input)(
-                keys, vcols, b.row_mask())
+            out_cols, n_dev = self._reduce(keys, vcols, b.row_mask(),
+                                           merge_input)
             self._stage(out_cols, n_dev, b.capacity)
             # partial-agg skipping (agg_ctx.rs:63-66)
             if self.supports_partial_skipping and \
@@ -538,19 +558,55 @@ def _group_reduce_body(keys: List[Any], value_cols: List[List[Any]],
     return out_cols, n_groups
 
 
-def _concat_cols(parts: List[Any]):
-    """Device concat of the same logical column across staged entries."""
-    if isinstance(parts[0], DeviceStringColumn):
-        w = max(p.data.shape[1] for p in parts)
-        datas = [jnp.pad(p.data, ((0, 0), (0, w - p.data.shape[1])))
-                 if p.data.shape[1] < w else p.data for p in parts]
-        return DeviceStringColumn(
-            parts[0].dtype, jnp.concatenate(datas),
-            jnp.concatenate([p.lengths for p in parts]),
-            jnp.concatenate([p.validity for p in parts]))
-    return DeviceColumn(parts[0].dtype,
-                        jnp.concatenate([p.data for p in parts]),
-                        jnp.concatenate([p.validity for p in parts]))
+def _sort_base_builder(orders):
+    """Shared half of the split merge reduction: sort + segment structure
+    + key gather (no per-spec state math)."""
+    def run(keys, live):
+        capacity = live.shape[0]
+        n_live = jnp.sum(live.astype(jnp.int32))
+        words = encode_sort_keys(keys, orders)
+        perm = lexsort_indices_live(words, live)
+        slive = jnp.arange(capacity) < n_live
+        sorted_words = [jnp.take(w, perm) for w in words]
+        if sorted_words:
+            eq_prev = keys_equal_prev(sorted_words)
+        else:
+            eq_prev = jnp.arange(capacity) != 0
+        is_boundary = jnp.logical_and(jnp.logical_not(eq_prev), slive)
+        seg = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
+        seg = jnp.where(slive, seg, capacity - 1)
+        n_groups = jnp.sum(is_boundary.astype(jnp.int32))
+        first_idx = jnp.nonzero(is_boundary, size=capacity,
+                                fill_value=0)[0].astype(jnp.int32)
+        key_src = jnp.take(perm, first_idx)
+        g_valid = jnp.arange(capacity) < n_groups
+        key_out = [k.gather(key_src, g_valid) for k in keys]
+        return perm, seg, n_groups, key_out
+    return run
+
+
+def _spec_merge_builder(spec):
+    """Per-spec half of the split merge reduction."""
+    def run(cols, perm, seg, n_groups):
+        capacity = perm.shape[0]
+        scols = [_gather_col(c, perm) for c in cols]
+        states = spec.merge_segments(scols, seg, capacity)
+        return _clip_states(states, n_groups)
+    return run
+
+
+def _concat_staged_builder():
+    def run(entries_cols, entries_ns):
+        lives = [jnp.arange(cols[0].data.shape[0] if cols else 0) < n
+                 for cols, n in zip(entries_cols, entries_ns)]
+        ncols = len(entries_cols[0])
+        merged = [_concat_cols([e[i] for e in entries_cols])
+                  for i in range(ncols)]
+        live = jnp.concatenate(lives)
+        return merged, live
+    return run
+
+
 
 
 def _truncate_builder():

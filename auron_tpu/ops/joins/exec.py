@@ -78,18 +78,35 @@ class _HashJoinBase(Operator):
     # -- build --------------------------------------------------------------
 
     def _collect_build(self, ctx: TaskContext) -> BuildTable:
+        from auron_tpu.columnar.batch import concat_device_columns
         child_i = 1 if self.build_side == "right" else 0
-        batches = [b for b in self.child_stream(ctx, child_i) if b.num_rows]
+        batches = [b for b in self.child_stream(ctx, child_i)
+                   if not (b.num_rows_known and b.num_rows == 0)]
         child = self.children[child_i]
-        total = sum(b.num_rows for b in batches)
-        cap = bucket_capacity(total)
-        merged = concat_batches(child.schema, batches, cap) if batches \
-            else Batch.empty(child.schema, cap)
         key_eval = self._right_keys if self.build_side == "right" \
             else self._left_keys
         with self.metrics.timer("build_hash_map_time_ns"):
+            if not batches:
+                merged = Batch.empty(child.schema, bucket_capacity(0))
+                key_cols = key_eval(merged, partition_id=ctx.partition_id)
+                return BuildTable.build(merged, key_cols)
+            if any(b.has_host_columns() for b in batches):
+                # hybrid rows: host-side concat (counts sync here)
+                total = sum(b.num_rows for b in batches)
+                cap = bucket_capacity(total)
+                merged = concat_batches(child.schema, batches, cap)
+                key_cols = key_eval(merged, partition_id=ctx.partition_id)
+                return BuildTable.build(merged, key_cols)
+            # device concat, UNcompacted: the live mask replaces slicing,
+            # so collecting the build side costs zero host round trips
+            cols = [concat_device_columns([b.columns[i] for b in batches])
+                    for i in range(len(child.schema))]
+            live = jnp.concatenate([b.row_mask() for b in batches])
+            cap = int(live.shape[0])
+            n_dev = jnp.sum(live.astype(jnp.int32))
+            merged = Batch(child.schema, cols, n_dev, cap)
             key_cols = key_eval(merged, partition_id=ctx.partition_id)
-            return BuildTable.build(merged, key_cols)
+            return BuildTable.build(merged, key_cols, live)
 
     # -- probe --------------------------------------------------------------
 
@@ -109,7 +126,9 @@ class _HashJoinBase(Operator):
         state = {"build_matched": build_matched}
         hybrid_table = table.batch.has_host_columns()
         for b in self.child_stream(ctx, probe_i):
-            if b.num_rows == 0:
+            # sync-free emptiness check: lazy batches flow on (the fused
+            # probe fetches its counts anyway)
+            if b.num_rows_known and b.num_rows == 0:
                 continue
             with self.metrics.timer("probe_time_ns"):
                 pkeys = key_eval(b, partition_id=ctx.partition_id)
@@ -311,7 +330,7 @@ class _HashJoinBase(Operator):
     def _emit_build_unmatched(self, table: BuildTable, build_matched
                               ) -> Iterator[Batch]:
         b = table.batch
-        keep = jnp.logical_and(jnp.logical_not(build_matched), b.row_mask())
+        keep = jnp.logical_and(jnp.logical_not(build_matched), table.live)
         idx, cnt = compact_indices(keep, b.capacity)
         n = int(cnt)
         if n == 0:

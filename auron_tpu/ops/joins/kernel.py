@@ -44,23 +44,27 @@ def join_key_hash(cols: List[Any], capacity: int):
 
 @dataclass
 class BuildTable:
-    """The 'hash map': build batch + hash-sorted permutation."""
+    """The 'hash map': build batch + hash-sorted permutation.  `live`
+    marks real rows (the batch may be an UNcompacted device concat of the
+    build stream — dead rows carry the null sentinel and never match)."""
     batch: Batch                 # concatenated build side
     key_cols: List[Any]          # evaluated key columns (batch order)
     sorted_hashes: Any           # u64[capacity], ascending; padding = MAX
     perm: Any                    # int32[capacity]: sorted idx -> batch row
-    num_rows: int
+    live: Any                    # bool[capacity]
 
     @staticmethod
-    def build(batch: Batch, key_cols: List[Any]) -> "BuildTable":
+    def build(batch: Batch, key_cols: List[Any],
+              live: Optional[Any] = None) -> "BuildTable":
         cap = batch.capacity
         h, valid = join_key_hash(key_cols, cap)
-        live = batch.row_mask()
+        if live is None:
+            live = batch.row_mask()
         h = jnp.where(jnp.logical_and(live, valid), h, _NULL_BUILD)
         perm = jnp.argsort(h).astype(jnp.int32)
         return BuildTable(batch=batch, key_cols=key_cols,
                           sorted_hashes=jnp.take(h, perm), perm=perm,
-                          num_rows=batch.num_rows)
+                          live=live)
 
 
 def probe_ranges(sorted_hashes, probe_hash, probe_valid, probe_live):

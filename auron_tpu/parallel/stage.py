@@ -1,0 +1,629 @@
+"""SPMD stage compiler: planner IR -> ONE jitted shard_map program.
+
+This is the multi-chip execution path of the engine (SURVEY §2.5 rows
+67-72; reference analogue: Spark schedules the reference's native tasks
+per partition, rt.rs:76-139, with shuffle files between stages,
+shuffle/mod.rs:112-189).  On TPU the whole pipeline compiles to one XLA
+program over a `jax.sharding.Mesh`:
+
+- partition (data) parallelism: every operator body runs per device on its
+  shard of rows, shapes static, a `live` row mask carrying filtered-ness
+  (no compaction between operators — the mask IS the selection vector);
+- hash/round-robin/single repartitioning: murmur3(seed=42) partition ids
+  computed on device, rows exchanged with `lax.all_to_all` riding ICI
+  (parallel/exchange.py), replacing the reference's sort-based shuffle
+  files;
+- broadcast exchange: `lax.all_gather` materializes the build side on
+  every device (NativeBroadcastExchangeBase.collectNative analogue);
+- group aggregation: the same sort-based `_group_reduce_body` kernel the
+  serial engine uses, traced inline;
+- broadcast/hash join: sorted-hash build + searchsorted probe, restricted
+  to probe-row-preserving shapes (single-match builds: the dim-table
+  pattern) — multi-match joins fall back to the serial engine.
+
+Anything the compiler cannot express raises `SpmdUnsupported`; callers
+(AuronSession.execute with a mesh) fall back to the per-partition serial
+path, mirroring how the reference falls back to JVM execution for
+unconvertible plan sections (AuronConvertStrategy).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from auron_tpu.columnar.batch import (
+    Batch, DeviceColumn, DeviceStringColumn, HostColumn, bucket_capacity,
+)
+from auron_tpu.exprs import hashing as H
+from auron_tpu.exprs.compiler import EvalCtx, device_capable, evaluate
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.expr import Expr
+from auron_tpu.ir.node import Node
+from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.parallel.exchange import (
+    all_to_all_repartition, broadcast_all_gather,
+)
+
+Array = Any
+
+
+class SpmdUnsupported(Exception):
+    """Plan shape the SPMD compiler cannot express; fall back to the
+    serial per-partition engine."""
+
+
+@dataclass
+class DeviceTable:
+    """Per-device value flowing between traced operator bodies."""
+    schema: Schema
+    cols: List[Any]     # DeviceColumn / DeviceStringColumn (capacity rows)
+    live: Array         # bool[capacity]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.live.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# plan walk (traced inside shard_map)
+# ---------------------------------------------------------------------------
+
+class _StageTracer:
+    def __init__(self, conv_ctx, bindings: Dict[str, DeviceTable],
+                 axis: str, n_dev: int,
+                 shadow_sort: Optional[P.Sort] = None,
+                 scan_rids: Optional[Dict[int, str]] = None):
+        self.exchanges = getattr(conv_ctx, "exchanges", None) or {}
+        self.broadcasts = getattr(conv_ctx, "broadcasts", None) or {}
+        self.bindings = bindings
+        self.axis = axis
+        self.n_dev = n_dev
+        # the driver-side global sort that re-orders (and re-limits) the
+        # gathered result; per-partition top-k sorts it shadows are
+        # dropped (the TakeOrderedAndProject pattern: partition top-k ->
+        # single exchange -> global top-k)
+        self.shadow_sort = shadow_sort
+        self.scan_rids = scan_rids or {}
+        # runtime guards: device booleans that invalidate the SPMD result
+        # post-run (e.g. a duplicate-key build side the single-match join
+        # cannot express); the driver fetches them with the output and
+        # falls back to the serial engine when any is set
+        self.guards: List[Any] = []
+
+    # -- expression eval -------------------------------------------------
+
+    def _eval_exprs(self, exprs, t: DeviceTable) -> List[Any]:
+        for x in exprs:
+            if not device_capable(x, t.schema, frozenset()):
+                raise SpmdUnsupported(f"expr not device-capable: {x.kind}")
+            if _tree_has(x, ("row_num", "monotonically_increasing_id",
+                             "py_udf_wrapper", "scalar_subquery")):
+                raise SpmdUnsupported(f"stateful expr in SPMD: {x.kind}")
+        ctx = EvalCtx(cols=list(t.cols), schema=t.schema,
+                      num_rows=jnp.sum(t.live.astype(jnp.int32)),
+                      capacity=t.capacity,
+                      partition_id=lax.axis_index(self.axis),
+                      row_base=jnp.int64(0))
+        return [evaluate(x, ctx) for x in exprs]
+
+    # -- node dispatch -----------------------------------------------------
+
+    def eval_node(self, node) -> DeviceTable:
+        if not isinstance(node, P.PlanNode):
+            raise SpmdUnsupported(f"non-native section: {type(node).__name__}")
+        handler = getattr(self, f"_do_{node.kind}", None)
+        if handler is None:
+            raise SpmdUnsupported(f"operator not SPMD-compilable: {node.kind}")
+        return handler(node)
+
+    # sources ---------------------------------------------------------------
+
+    def _binding(self, rid: str, schema: Schema) -> DeviceTable:
+        if rid not in self.bindings:
+            raise SpmdUnsupported(f"unbound resource {rid!r}")
+        return self.bindings[rid]
+
+    def _do_ffi_reader(self, n: P.FFIReader) -> DeviceTable:
+        return self._binding(n.resource_id, n.schema)
+
+    def _do_parquet_scan(self, n: P.ParquetScan) -> DeviceTable:
+        # scans were pre-materialized by the driver (host IO) and sharded
+        # over the mesh under deterministic walk-order rids
+        return self._binding(self.scan_rids.get(id(n), "?"), n.schema)
+
+    def _do_orc_scan(self, n: P.OrcScan) -> DeviceTable:
+        return self._binding(self.scan_rids.get(id(n), "?"), n.schema)
+
+    def _do_ipc_reader(self, n: P.IpcReader) -> DeviceTable:
+        # an IpcReader is how the converted plan references an exchange or
+        # broadcast boundary; inline it as a collective
+        rid = n.resource_id
+        if rid in self.exchanges:
+            job = self.exchanges[rid]
+            child = self.eval_node(_require_native(job.child))
+            return self._exchange(child, job.partitioning)
+        if rid in self.broadcasts:
+            job = self.broadcasts[rid]
+            child = self.eval_node(_require_native(job.child))
+            return self._broadcast(child)
+        return self._binding(rid, n.schema)
+
+    # exchanges --------------------------------------------------------------
+
+    def _exchange(self, t: DeviceTable, part: P.Partitioning) -> DeviceTable:
+        n_dev = self.n_dev
+        if part.mode == "hash":
+            keys = self._eval_exprs(part.expressions, t)
+            h = H.hash_columns(keys, seed=42)
+            pid = H.pmod(h, n_dev).astype(jnp.int32)
+        elif part.mode == "round_robin":
+            base = lax.axis_index(self.axis).astype(jnp.int32)
+            pid = (base + jnp.arange(t.capacity, dtype=jnp.int32)) % n_dev
+        elif part.mode == "single":
+            pid = jnp.zeros(t.capacity, jnp.int32)
+        else:
+            raise SpmdUnsupported(f"partitioning mode {part.mode!r}")
+        flat, treedef = jax.tree.flatten(t.cols)
+        outs, live = all_to_all_repartition(flat, pid, t.live, self.axis,
+                                            n_dev, quota=t.capacity)
+        cols = jax.tree.unflatten(treedef, outs)
+        return DeviceTable(t.schema, cols, live)
+
+    def _broadcast(self, t: DeviceTable) -> DeviceTable:
+        flat, treedef = jax.tree.flatten(t.cols)
+        outs, live = broadcast_all_gather(flat, t.live, self.axis)
+        cols = jax.tree.unflatten(treedef, outs)
+        return DeviceTable(t.schema, cols, live)
+
+    # row ops -----------------------------------------------------------------
+
+    def _do_filter(self, n: P.Filter) -> DeviceTable:
+        t = self.eval_node(n.child)
+        live = t.live
+        for p in n.predicates:
+            [m] = self._eval_exprs((p,), t)
+            live = jnp.logical_and(
+                live, jnp.logical_and(m.validity, m.data.astype(bool)))
+        return DeviceTable(t.schema, t.cols, live)
+
+    def _do_projection(self, n: P.Projection) -> DeviceTable:
+        t = self.eval_node(n.child)
+        cols = self._eval_exprs(n.exprs, t)
+        from auron_tpu.exprs.typing import infer_type
+        fields = tuple(Field(nm, infer_type(x, t.schema))
+                       for nm, x in zip(n.names, n.exprs))
+        return DeviceTable(Schema(fields), cols, t.live)
+
+    def _do_rename_columns(self, n: P.RenameColumns) -> DeviceTable:
+        t = self.eval_node(n.child)
+        return DeviceTable(t.schema.rename(tuple(n.names)), t.cols, t.live)
+
+    def _do_coalesce_batches(self, n: P.CoalesceBatches) -> DeviceTable:
+        return self.eval_node(n.child)
+
+    def _do_debug(self, n: P.Debug) -> DeviceTable:
+        return self.eval_node(n.child)
+
+    # aggregation --------------------------------------------------------------
+
+    def _agg_exec_meta(self, n: P.Agg, child_schema: Schema):
+        """Instantiate AggExec purely for its spec/schema metadata."""
+        from auron_tpu.ops.agg.exec import AggExec
+        from auron_tpu.ops.agg.functions import HostAggSpec
+
+        class _SchemaOp:
+            def __init__(self, schema):
+                self.schema = schema
+                self.metrics = None
+        dummy = _SchemaOp(child_schema)
+        dummy.children = []
+        from auron_tpu.runtime.metrics import MetricNode
+        dummy.metrics = MetricNode("src")
+        agg = AggExec(dummy, n.exec_mode, n.grouping, n.grouping_names,
+                      n.aggs, n.agg_names, False)
+        if any(isinstance(s, HostAggSpec) for s in agg.specs):
+            raise SpmdUnsupported("host-path agg function in SPMD")
+        return agg
+
+    def _do_agg(self, n: P.Agg) -> DeviceTable:
+        from auron_tpu.ops.agg.exec import _group_reduce_body
+        t = self.eval_node(n.child)
+        agg = self._agg_exec_meta(n, t.schema)
+        merge = n.exec_mode == "final"
+        keys = self._eval_exprs(n.grouping, t)
+        nk = len(n.grouping)
+        if merge:
+            vcols: List[List[Any]] = []
+            off = nk
+            for spec in agg.specs:
+                k = len(spec.state_fields())
+                vcols.append(t.cols[off:off + k])
+                off += k
+        else:
+            vcols = []
+            for a in n.aggs:
+                vcols.append(self._eval_exprs(a.children, t)
+                             if a.children else [])
+        out_cols, n_groups = _group_reduce_body(
+            keys, vcols, t.live, agg.specs, agg._key_orders(), merge)
+        live = jnp.arange(t.capacity) < n_groups
+        if n.exec_mode in ("final", "single"):
+            final_cols = list(out_cols[:nk])
+            off = nk
+            for spec in agg.specs:
+                k = len(spec.state_fields())
+                final_cols.append(spec.eval_final(out_cols[off:off + k]))
+                off += k
+            return DeviceTable(agg.schema, final_cols, live)
+        return DeviceTable(agg._state_schema(), out_cols, live)
+
+    # joins ---------------------------------------------------------------------
+
+    def _do_broadcast_join(self, n: P.BroadcastJoin) -> DeviceTable:
+        return self._join(n.left, n.right, n.on, n.join_type,
+                          build_side=n.broadcast_side)
+
+    def _do_hash_join(self, n: P.HashJoin) -> DeviceTable:
+        return self._join(n.left, n.right, n.on, n.join_type,
+                          build_side=n.build_side)
+
+    def _do_broadcast_join_build_hash_map(self, n) -> DeviceTable:
+        return self.eval_node(n.child)
+
+    def _join(self, left_ir, right_ir, on, join_type: str,
+              build_side: str) -> DeviceTable:
+        from auron_tpu.ops.joins.exec import join_output_schema
+        from auron_tpu.ops.joins.kernel import (
+            _NULL_BUILD, _NULL_PROBE, join_key_hash,
+        )
+        if join_type not in ("inner", "left"):
+            raise SpmdUnsupported(f"SPMD join type {join_type!r}")
+        if build_side != "right":
+            raise SpmdUnsupported("SPMD join requires build_side=right")
+        probe = self.eval_node(left_ir)
+        build = self.eval_node(right_ir)
+        pkeys = self._eval_exprs(on.left_keys, probe)
+        bkeys = self._eval_exprs(on.right_keys, build)
+        bh, bvalid = join_key_hash(bkeys, build.capacity)
+        bh = jnp.where(jnp.logical_and(build.live, bvalid), bh, _NULL_BUILD)
+        order = jnp.argsort(bh).astype(jnp.int32)
+        sorted_bh = jnp.take(bh, order)
+        # single-match restriction: duplicate build keys would need pair
+        # expansion (dynamic output size).  A runtime guard detects them
+        # (adjacent equal non-sentinel hashes after the sort — which also
+        # catches hash collisions) and forces the driver to fall back to
+        # the serial engine rather than silently dropping matches.
+        dup = jnp.any(jnp.logical_and(sorted_bh[1:] == sorted_bh[:-1],
+                                      sorted_bh[1:] != _NULL_BUILD))
+        self.guards.append(
+            lax.psum(dup.astype(jnp.int32), self.axis) > 0)
+        ph, pvalid = join_key_hash(pkeys, probe.capacity)
+        ph = jnp.where(jnp.logical_and(probe.live, pvalid), ph, _NULL_PROBE)
+        pos = jnp.clip(jnp.searchsorted(sorted_bh, ph), 0,
+                       build.capacity - 1)
+        hit = jnp.take(sorted_bh, pos) == ph
+        bidx = jnp.take(order, pos)
+        # exact verification (hash-collision filter)
+        ok = hit
+        for pk, bk in zip(pkeys, bkeys):
+            bg = bk.gather(bidx, hit)
+            if isinstance(pk, DeviceStringColumn):
+                from auron_tpu.exprs import strings_device as S
+                eq = S.string_eq(pk, bg)
+            else:
+                eq = pk.data == bg.data
+            ok = jnp.logical_and(ok, jnp.logical_and(
+                eq, jnp.logical_and(pk.validity, bg.validity)))
+        schema = join_output_schema(probe.schema, build.schema, join_type)
+        bcols = [c.gather(bidx, ok) for c in build.cols]
+        out_cols = list(probe.cols) + bcols
+        live = jnp.logical_and(probe.live, ok) if join_type == "inner" \
+            else probe.live
+        return DeviceTable(schema, out_cols, live)
+
+    # sort / limit -------------------------------------------------------
+    #
+    # SPMD operator bodies are order-insensitive (hash agg, hash join,
+    # exchanges); ordering only matters at the driver-side emission, which
+    # the peeled host tail re-establishes.  A mid-plan Sort with no fetch
+    # limit is therefore a no-op here; one WITH a fetch limit prunes rows
+    # and may only be dropped when the host tail's global sort shadows it
+    # (same key prefix, limit at least as strict).
+
+    def _do_sort(self, n: P.Sort) -> DeviceTable:
+        if n.fetch_limit is None:
+            return self.eval_node(n.child)
+        s = self.shadow_sort
+        if s is not None and s.fetch_limit is not None and \
+                s.fetch_limit <= n.fetch_limit and \
+                s.sort_exprs == n.sort_exprs[:len(s.sort_exprs)]:
+            return self.eval_node(n.child)
+        raise SpmdUnsupported("unshadowed top-k sort inside an SPMD stage")
+
+    def _do_limit(self, n: P.Limit) -> DeviceTable:
+        raise SpmdUnsupported("limit inside an SPMD stage")
+
+
+def _require_native(node) -> P.PlanNode:
+    if not isinstance(node, P.PlanNode):
+        raise SpmdUnsupported("foreign subtree inside SPMD stage")
+    return node
+
+
+from auron_tpu.ir.node import tree_has_kind as _tree_has  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# host driver: shard inputs, run the program, gather + compact
+# ---------------------------------------------------------------------------
+
+def _shard_table(table, mesh: Mesh, axis: str) -> Tuple[Schema, List[Any],
+                                                        Array, int]:
+    """Split an arrow table row-wise across the mesh: returns flat arrays
+    of shape [n_dev*cap] (sharded along the axis) + live mask."""
+    import pyarrow as pa
+    from auron_tpu.ir.schema import from_arrow_schema
+    n_dev = mesh.shape[axis]
+    n = table.num_rows
+    per_dev = -(-max(n, 1) // n_dev)
+    cap = bucket_capacity(per_dev)
+    schema = from_arrow_schema(table.schema)
+    dev_batches = []
+    for d in range(n_dev):
+        chunk = table.slice(d * per_dev, per_dev)
+        arrays = [c.combine_chunks() if c.num_chunks else
+                  pa.array([], type=c.type) for c in chunk.columns]
+        rb = pa.RecordBatch.from_arrays(arrays, schema=table.schema)
+        b = Batch.from_arrow(rb, capacity=cap, schema=schema)
+        if b.has_host_columns():
+            raise SpmdUnsupported("host-resident column in SPMD source")
+        dev_batches.append(b)
+    # normalize string widths across shards, then stack host-side
+    cols: List[Any] = []
+    for ci, f in enumerate(schema):
+        parts = [db.columns[ci] for db in dev_batches]
+        if isinstance(parts[0], DeviceStringColumn):
+            w = max(p.width for p in parts)
+            data = np.concatenate([
+                np.pad(np.asarray(p.data), ((0, 0), (0, w - p.width)))
+                for p in parts])
+            cols.append(DeviceStringColumn(
+                f.dtype, jnp.asarray(data),
+                jnp.asarray(np.concatenate(
+                    [np.asarray(p.lengths) for p in parts])),
+                jnp.asarray(np.concatenate(
+                    [np.asarray(p.validity) for p in parts]))))
+        else:
+            cols.append(DeviceColumn(
+                f.dtype,
+                jnp.asarray(np.concatenate(
+                    [np.asarray(p.data) for p in parts])),
+                jnp.asarray(np.concatenate(
+                    [np.asarray(p.validity) for p in parts]))))
+    live = np.zeros(n_dev * cap, bool)
+    for d in range(n_dev):
+        got = min(max(n - d * per_dev, 0), per_dev)
+        live[d * cap: d * cap + got] = True
+    return schema, cols, jnp.asarray(live), cap
+
+
+def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
+                      source_tables: Dict[str, Any], axis: str = "parts"):
+    """Compile + run `plan` as one shard_map program over `mesh`.
+
+    source_tables: rid -> pyarrow.Table for every FFI source the plan
+    references (the C2N boundary inputs).  Returns a pyarrow.Table.
+    Raises SpmdUnsupported when the plan shape cannot be expressed.
+    """
+    import dataclasses
+
+    import pyarrow as pa
+    from auron_tpu.ir.schema import to_arrow_schema
+
+    n_dev = mesh.shape[axis]
+    exchanges = getattr(conv_ctx, "exchanges", None) or {}
+
+    # 1. peel the driver-side tail: a root chain of single-partition ops
+    # (projection / sort / limit / renames) replayed through the SERIAL
+    # engine on the gathered table — the reference's equivalent is the
+    # final collect on the driver (TakeOrderedAndProject)
+    tail: List[P.PlanNode] = []
+    shadow_sort: Optional[P.Sort] = None
+    while isinstance(plan, (P.Projection, P.Sort, P.Limit,
+                            P.RenameColumns)):
+        tail.append(plan)
+        if isinstance(plan, P.Sort) and shadow_sort is None:
+            shadow_sort = plan
+        plan = plan.child
+
+    # 2. a root single-mode exchange feeding the tail is redundant: the
+    # host gather itself is the "move everything to one place" step
+    while isinstance(plan, P.IpcReader) and plan.resource_id in exchanges:
+        job = exchanges[plan.resource_id]
+        if job.partitioning.mode != "single":
+            break
+        plan = _require_native(job.child)
+
+    # fast kind-level rejection BEFORE any source materialization (the
+    # session materializes C2N sources only after this passes)
+    precheck_plan(plan, conv_ctx)
+
+    # 3. materialize scan leaves (host IO through the serial engine) and
+    # FFI sources, then shard row-wise over the mesh
+    source_tables = dict(source_tables)
+    scan_rids, scan_tables = _materialize_scans(plan, conv_ctx)
+    source_tables.update(scan_tables)
+
+    host_inputs = {}
+    schemas = {}
+    for rid, table in source_tables.items():
+        schema, cols, live, cap = _shard_table(table, mesh, axis)
+        host_inputs[rid] = (cols, live)
+        schemas[rid] = schema
+
+    sharded = NamedSharding(mesh, PS(axis))
+    # program cache: repeat executions of the SAME converted plan over the
+    # same input shapes reuse the compiled shard_map program (a fresh
+    # jax.jit closure per call would re-trace+re-compile every time)
+    cache_key = (
+        plan, axis, n_dev,
+        tuple(sorted((rid, job.child, job.partitioning)
+                     for rid, job in (getattr(conv_ctx, "exchanges", None)
+                                      or {}).items())),
+        tuple(sorted((rid, job.child)
+                     for rid, job in (getattr(conv_ctx, "broadcasts", None)
+                                      or {}).items())),
+        tuple(sorted((rid, schemas[rid],
+                      tuple((str(x.dtype), x.shape)
+                            for x in jax.tree.leaves(ci)))
+                     for rid, ci in host_inputs.items())),
+        shadow_sort)
+    cached = _PROGRAM_CACHE.get(cache_key)
+
+    if cached is None:
+        schema_box: List[Schema] = []
+
+        def program(bindings_flat):
+            bindings = {
+                rid: DeviceTable(schemas[rid], cols, live)
+                for rid, (cols, live) in bindings_flat.items()}
+            tracer = _StageTracer(conv_ctx, bindings, axis, n_dev,
+                                  shadow_sort=shadow_sort,
+                                  scan_rids=scan_rids)
+            out = tracer.eval_node(plan)
+            if not schema_box:
+                schema_box.append(out.schema)
+            guards = jnp.stack(tracer.guards) if tracer.guards else \
+                jnp.zeros(0, bool)
+            return out.cols, out.live, guards
+
+        shard = jax.jit(jax.shard_map(
+            program, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: PS(axis), host_inputs),),
+            out_specs=(PS(axis), PS(axis), PS()), check_vma=False))
+    else:
+        shard, schema_box = cached
+
+    put = {rid: (jax.tree.map(lambda x: jax.device_put(x, sharded), cols),
+                 jax.device_put(live, sharded))
+           for rid, (cols, live) in host_inputs.items()}
+    out_cols, out_live, guards = shard(put)
+    if cached is None:
+        _PROGRAM_CACHE[cache_key] = (shard, schema_box)
+    out_schema = schema_box[0]
+
+    # gather + compact on host (one batched fetch, guards included)
+    from auron_tpu.ops.kernel_cache import host_sync
+    out_live_np, out_cols_np, guards_np = host_sync(
+        (out_live, out_cols, guards))
+    if np.any(np.asarray(guards_np)):
+        raise SpmdUnsupported(
+            "runtime guard tripped (duplicate-key build side): result "
+            "discarded, serial engine takes over")
+    live_np = np.asarray(out_live_np)
+    arrays = []
+    for f, c in zip(out_schema, out_cols_np):
+        from auron_tpu.columnar.arrow_interop import column_to_arrow
+        total = live_np.shape[0]
+        arr = column_to_arrow(f.dtype, c, total)
+        arrays.append(arr.filter(pa.array(live_np)))
+    table = pa.Table.from_arrays(
+        arrays, schema=to_arrow_schema(out_schema))
+
+    # 4. replay the peeled tail through the serial engine
+    if tail:
+        from auron_tpu.runtime.executor import execute_plan
+        from auron_tpu.runtime.resources import ResourceRegistry
+        from auron_tpu.ir.schema import from_arrow_schema
+        replay: P.PlanNode = P.FFIReader(
+            schema=from_arrow_schema(table.schema),
+            resource_id="__spmd_gathered")
+        for node in reversed(tail):
+            replay = dataclasses.replace(node, child=replay)
+        res = ResourceRegistry()
+        res.put("__spmd_gathered", table.to_batches())
+        table = execute_plan(replay, resources=res).to_table()
+    return table
+
+
+def _walk_native(node, conv_ctx):
+    """Yield every native plan node reachable from `node`, following
+    exchange/broadcast boundaries into their (native) children."""
+    exchanges = getattr(conv_ctx, "exchanges", None) or {}
+    broadcasts = getattr(conv_ctx, "broadcasts", None) or {}
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if not isinstance(n, P.PlanNode):
+            continue
+        yield n
+        if isinstance(n, P.IpcReader):
+            job = exchanges.get(n.resource_id) or \
+                broadcasts.get(n.resource_id)
+            if job is not None:
+                stack.append(job.child)
+            continue
+        for c in n.children_nodes():
+            stack.append(c)
+
+
+_PROGRAM_CACHE: Dict[Any, Any] = {}
+
+# node kinds the tracer can (conditionally) express; anything else is
+# rejected by precheck_plan before source materialization
+_PRECHECK_OK = frozenset({
+    "ffi_reader", "ipc_reader", "parquet_scan", "orc_scan", "filter",
+    "projection", "rename_columns", "coalesce_batches", "debug", "agg",
+    "broadcast_join", "hash_join", "broadcast_join_build_hash_map",
+    "sort", "limit",
+})
+
+
+def precheck_plan(plan, conv_ctx) -> None:
+    """Cheap kind-level SPMD compilability check (no tracing, no source
+    materialization) — rejects the common fallbacks (smj, window, union,
+    expand, generate, sinks) up front."""
+    for node in _walk_native(plan, conv_ctx):
+        if node.kind not in _PRECHECK_OK:
+            raise SpmdUnsupported(
+                f"operator not SPMD-compilable: {node.kind}")
+        if node.kind in ("broadcast_join", "hash_join"):
+            jt = node.join_type
+            if jt not in ("inner", "left"):
+                raise SpmdUnsupported(f"SPMD join type {jt!r}")
+
+
+def _materialize_scans(plan, conv_ctx):
+    """Run every Parquet/Orc scan leaf through the serial engine (host IO
+    + pruning); rids are deterministic walk-order indexes so the compiled
+    program's binding structure is stable across conversions."""
+    import pyarrow as pa
+    from auron_tpu.runtime.executor import execute_plan
+    rids: Dict[int, str] = {}
+    tables: Dict[str, Any] = {}
+    for node in _walk_native(plan, conv_ctx):
+        if node.kind not in ("parquet_scan", "orc_scan"):
+            continue
+        if id(node) in rids:
+            continue
+        rid = f"scan:{len(rids)}"
+        rids[id(node)] = rid
+        n_parts = max(1, len(getattr(node, "file_groups", ()) or ()))
+        batches = []
+        for pid in range(n_parts):
+            r = execute_plan(node, partition_id=pid,
+                             num_partitions=n_parts)
+            batches.extend(r.batches)
+        from auron_tpu.ir.schema import to_arrow_schema
+        tables[rid] = pa.Table.from_batches(
+            batches, schema=to_arrow_schema(node.schema)) if batches \
+            else pa.Table.from_batches(
+                [], schema=to_arrow_schema(node.schema))
+    return rids, tables

@@ -101,7 +101,11 @@ def execute_task(task: P.TaskDefinition,
     task_logging.install()              # idempotent (init_logging analogue)
     rt = NativeExecutionRuntime(task, resources)
     with task_logging.task_scope(task.stage_id, task.partition_id):
-        out = [b.to_arrow() for b in rt.batches() if b.num_rows > 0]
+        # convert BEFORE the row-count check: to_arrow fetches count +
+        # columns in one round trip, while `b.num_rows` alone would pay a
+        # separate sync for lazy batches
+        out = [rb for rb in (b.to_arrow() for b in rt.batches())
+               if rb.num_rows > 0]
     with _TASKS_LOCK:
         _TASKS_COMPLETED += 1
     return ExecutionResult(out, rt.finalize())
