@@ -126,6 +126,15 @@ class HostColumn:
         idx = pa.array(indices.astype(np.int64), type=pa.int64())
         return HostColumn(self.dtype, pc.take(self.array, idx))
 
+    def pylist(self) -> list:
+        """Memoized to_pylist: host-path kernels (hash, key compare) may
+        touch the same column once per chunk — convert once."""
+        cached = getattr(self, "_pylist", None)
+        if cached is None:
+            cached = self.array.to_pylist()
+            self._pylist = cached
+        return cached
+
 
 Column = Union[DeviceColumn, DeviceStringColumn, HostColumn]
 

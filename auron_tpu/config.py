@@ -192,6 +192,12 @@ SHUFFLE_SERVICE_ADDRESS = conf.define(
 SHUFFLE_COMPRESSION_CODEC = conf.define(
     "auron.shuffle.compression.codec", "zstd", "Codec for shuffle blocks."
 )
+SMJ_STREAMING_ENABLE = conf.define(
+    "auron.smj.streaming.enable", True,
+    "Execute sort-merge joins as a bounded-memory streaming merge of "
+    "sorted inputs (window-per-frontier, spillable buffers) instead of "
+    "materializing one side (smj/full_join.rs, stream_cursor.rs).",
+)
 SMJ_FALLBACK_ENABLE = conf.define(
     "auron.smj.fallback.enable", True,
     "Allow broadcast joins to fall back to sort-merge join when the build side "

@@ -303,7 +303,7 @@ def _unix_timestamp(args, raw, e, ctx):
 # -- hashes -----------------------------------------------------------------
 
 def _murmur3(args, raw, e, ctx):
-    h = H.hash_columns(args, seed=42)
+    h = H.hash_columns(args, seed=42, capacity=ctx.capacity)
     return DeviceColumn(DataType.int32(), h,
                         jnp.ones(ctx.capacity, bool))
 

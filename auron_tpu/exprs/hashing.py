@@ -139,10 +139,36 @@ def hash_bytes(data, lengths, seed):
     return _fmix(h, lengths.astype(jnp.uint32))
 
 
+def _hash_host_column(col, seed):
+    """Host-resident rows (oversized strings, hybrid batches): Spark
+    murmur3 computed on host (spark_hash.rs StringType/BinaryType arm);
+    null and padding rows keep the incoming per-row seed."""
+    from auron_tpu.native import bindings
+    seeds = np.asarray(seed, dtype=np.uint32)
+    out = seeds.copy()
+    for i, v in enumerate(col.pylist()):
+        if v is None:
+            continue
+        if isinstance(v, str):
+            b = v.encode("utf-8")
+        elif isinstance(v, bytes):
+            b = v
+        else:
+            raise TypeError(
+                f"unhashable host value {type(v).__name__} ({col.dtype})")
+        out[i] = np.uint32(
+            bindings.murmur3_32(b, int(seeds[i].astype(np.int32)))
+            & 0xFFFFFFFF)
+    return jnp.asarray(out)
+
+
 def hash_column(col, seed):
     """Dispatch per logical type -> uint32 hash; null rows keep the incoming
     seed unchanged (Spark semantics: nulls don't contribute)."""
+    from auron_tpu.columnar.batch import HostColumn
     seed = jnp.asarray(seed, jnp.uint32)
+    if isinstance(col, HostColumn):
+        return _hash_host_column(col, seed)
     if isinstance(col, DeviceStringColumn):
         h = hash_bytes(col.data, col.lengths, seed)
     else:
@@ -163,11 +189,16 @@ def hash_column(col, seed):
     return jnp.where(col.validity, h, bseed)
 
 
-def hash_columns(cols, seed=42):
+def hash_columns(cols, seed=42, capacity=None):
     """Chained multi-column hash (each column's hash seeds the next),
-    Spark HashExpression semantics; returns int32."""
-    h = jnp.full(cols[0].capacity if hasattr(cols[0], "capacity")
-                 else cols[0].data.shape[0], np.uint32(seed), jnp.uint32)
+    Spark HashExpression semantics; returns int32.  `capacity` pads the
+    seed vector when host columns (unpadded) are narrower than the owning
+    batch."""
+    cap = capacity
+    if cap is None:
+        cap = max(c.capacity if hasattr(c, "capacity")
+                  else c.data.shape[0] for c in cols)
+    h = jnp.full(cap, np.uint32(seed), jnp.uint32)
     for c in cols:
         h = hash_column(c, h)
     return h.astype(jnp.int32)

@@ -490,10 +490,25 @@ def _smj(node, children, ctx) -> P.PlanNode:
     _check_no_condition(node)
     jt = EC.convert_join_type(node.attrs.get("join_type", "Inner"))
     nkeys = len(node.attrs["left_keys"])
+    on = _join_on(node)
+
+    def ensure_sorted(child: P.PlanNode, keys) -> P.PlanNode:
+        # EnsureRequirements analogue: the streaming SMJ consumes
+        # key-sorted inputs (childOrderingRequired tag,
+        # AuronConvertStrategy.scala:41-47); a real engine plan carries
+        # explicit SortExec children, a synthetic plan may not
+        want = tuple(E.SortExpr(child=k, asc=True, nulls_first=True)
+                     for k in keys)
+        if isinstance(child, P.Sort) and child.sort_exprs[:nkeys] == want:
+            return child
+        return ctx.set_parts(P.Sort(child=child, sort_exprs=want),
+                             ctx.parts(child))
+
     return ctx.set_parts(
         P.SortMergeJoin(
-            left=children[0], right=children[1], on=_join_on(node),
-            join_type=jt,
+            left=ensure_sorted(children[0], on.left_keys),
+            right=ensure_sorted(children[1], on.right_keys),
+            on=on, join_type=jt,
             sort_options=tuple((True, True) for _ in range(nkeys)),
             existence_output_name=node.attrs.get("existence_name",
                                                  "exists")),

@@ -20,6 +20,7 @@ from auron_tpu.config import conf
 from auron_tpu.exprs.compiler import build_evaluator
 from auron_tpu.ir.plan import JoinOn
 from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.memmgr import MemConsumer, SpillManager
 from auron_tpu.ops.base import Operator, TaskContext, batch_size, compact_indices
 from auron_tpu.ops.joins.kernel import (
     BuildTable, _build_pair_kernel, _build_range_kernel, combine_sides,
@@ -78,10 +79,15 @@ class _HashJoinBase(Operator):
     # -- build --------------------------------------------------------------
 
     def _collect_build(self, ctx: TaskContext) -> BuildTable:
-        from auron_tpu.columnar.batch import concat_device_columns
         child_i = 1 if self.build_side == "right" else 0
         batches = [b for b in self.child_stream(ctx, child_i)
                    if not (b.num_rows_known and b.num_rows == 0)]
+        return self._build_from_batches(batches, ctx)
+
+    def _build_from_batches(self, batches: List[Batch],
+                            ctx: TaskContext) -> BuildTable:
+        from auron_tpu.columnar.batch import concat_device_columns
+        child_i = 1 if self.build_side == "right" else 0
         child = self.children[child_i]
         key_eval = self._right_keys if self.build_side == "right" \
             else self._left_keys
@@ -402,12 +408,15 @@ class BroadcastJoinBuildHashMapExec(Operator):
         yield merged
 
 
-class SortMergeJoinExec(_HashJoinBase):
-    """Sort-merge join.  The TPU build keeps the probe streaming but uses
-    the same sorted-hash table for the other side (sortedness of inputs is
-    not exploited yet; the searchsorted probe is already log-time).  The
-    fallback direction the reference takes (BHJ -> SMJ under memory
-    pressure, NativeHelper.scala:185) is therefore a no-op here."""
+class SortMergeJoinExec(_HashJoinBase, MemConsumer):
+    """Streaming sort-merge join (joins/smj/full_join.rs:256,
+    stream_cursor.rs): both inputs arrive key-sorted, a frontier (the
+    smaller side's last buffered key) bounds each window, and complete
+    key groups below the frontier are joined window-by-window with the
+    shared sorted-hash kernel — so resident memory is one batch per side
+    plus the largest key group, and the buffers spill under pressure.
+    Falls back to the whole-side hash path when a side carries host
+    columns (hybrid rows can't ride the device split kernels)."""
 
     def __init__(self, left, right, on, join_type,
                  sort_options=(), existence_name="exists"):
@@ -415,4 +424,106 @@ class SortMergeJoinExec(_HashJoinBase):
             else "right"
         super().__init__(left, right, on, join_type, build_side,
                          existence_name, name="SortMergeJoinExec")
-        self.sort_options = tuple(sort_options)
+        MemConsumer.__init__(self, "SortMergeJoinExec")
+        self.sort_options = tuple(sort_options) or \
+            tuple((True, True) for _ in on.left_keys)
+        self._spills = SpillManager("smj")
+        self._cursors: List[Any] = []
+
+    # -- MemConsumer ------------------------------------------------------
+
+    def spill(self) -> int:
+        cursors = sorted((c for c in self._cursors if c.mem_bytes > 0),
+                         key=lambda c: c.mem_bytes, reverse=True)
+        for cur in cursors:     # a cursor mid-iteration refuses; try next
+            freed = cur.spill_mem()
+            if freed:
+                self.update_mem_used(
+                    sum(c.mem_bytes for c in self._cursors))
+                return freed
+        return 0
+
+    # -- execution --------------------------------------------------------
+
+    def _can_stream(self) -> bool:
+        from auron_tpu.columnar.batch import is_device_type
+        if not bool(conf.get("auron.smj.streaming.enable")):
+            return False
+        return all(is_device_type(f.dtype)
+                   for c in self.children for f in c.schema.fields)
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        if self._can_stream():
+            yield from self._execute_streaming(ctx)
+        else:
+            yield from super().execute(ctx)
+
+    def _execute_streaming(self, ctx: TaskContext) -> Iterator[Batch]:
+        from auron_tpu.memmgr import get_manager
+        from auron_tpu.ops.joins.smj import SideCursor, cmp_keys
+        orders = self.sort_options
+        mgr = ctx.mem_manager or get_manager()
+        mgr.register_consumer(self)
+        key_evals = (self._left_keys, self._right_keys)
+        cursors = [SideCursor(self.child_stream(ctx, i), key_evals[i],
+                              orders, ctx.partition_id, self._spills,
+                              self.metrics)
+                   for i in (0, 1)]
+        self._cursors = cursors
+        build_cur = cursors[0 if self.build_side == "left" else 1]
+        probe_cur = cursors[1 if self.build_side == "left" else 0]
+        try:
+            for c in cursors:
+                c.advance()
+            self.update_mem_used(sum(c.mem_bytes for c in cursors))
+            while ctx.is_running:
+                if all(c.exhausted for c in cursors):
+                    if any(not c.empty for c in cursors):
+                        yield from self._join_window(ctx, build_cur,
+                                                     probe_cur, None)
+                    return
+                frontier = None
+                for c in cursors:
+                    if not c.exhausted and (
+                            frontier is None or
+                            cmp_keys(c.boundary, frontier, orders) < 0):
+                        frontier = c.boundary
+                yield from self._join_window(ctx, build_cur, probe_cur,
+                                             frontier)
+                for c in cursors:
+                    if not c.exhausted and \
+                            cmp_keys(c.boundary, frontier, orders) == 0:
+                        c.advance()
+                self.update_mem_used(sum(c.mem_bytes for c in cursors))
+        finally:
+            self._cursors = []
+            self._spills.release_all()
+            mgr.unregister_consumer(self)
+
+    def _join_window(self, ctx: TaskContext, build_cur, probe_cur,
+                     frontier) -> Iterator[Batch]:
+        """Join all buffered rows strictly below the frontier: they form
+        complete key groups, so every join flavor (incl. outer/semi/anti/
+        existence emissions) is correct window-locally."""
+        build_batches = list(build_cur.iter_ready(frontier))
+        probe_iter = probe_cur.iter_ready(frontier)
+        jt = self.join_type
+        if not build_batches and jt in ("inner", "left_semi", "right_semi"):
+            for _ in probe_iter:     # drain: no output possible
+                pass
+            return
+        table = self._build_from_batches(build_batches, ctx)
+        state = {"build_matched": jnp.zeros(table.batch.capacity, bool)}
+        key_eval = self._left_keys if self.probe_is_left else self._right_keys
+        hybrid_table = table.batch.has_host_columns()
+        for b in probe_iter:
+            with self.metrics.timer("probe_time_ns"):
+                pkeys = key_eval(b, partition_id=ctx.partition_id)
+                if hybrid_table or b.has_host_columns():
+                    yield from self._probe_batch_eager(b, pkeys, table, state)
+                else:
+                    yield from self._probe_batch_fused(b, pkeys, table, state)
+        if (jt == "right" and self.probe_is_left) or \
+                (jt == "left" and not self.probe_is_left) or jt == "full":
+            yield from self._emit_build_unmatched(table,
+                                                  state["build_matched"])

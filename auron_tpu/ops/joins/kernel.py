@@ -30,15 +30,24 @@ _NULL_BUILD = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 _NULL_PROBE = jnp.uint64(0xFFFFFFFFFFFFFFFE)
 
 
+def _key_validity(c: Any, capacity: int):
+    if isinstance(c, HostColumn):
+        v = np.zeros(capacity, bool)
+        v[:len(c.array)] = ~np.asarray(c.array.is_null())
+        return jnp.asarray(v)
+    return c.validity
+
+
 def join_key_hash(cols: List[Any], capacity: int):
     """u64 key hash: two chained murmur3 passes with different seeds packed
     into one u64; rows with any null key get a non-matching sentinel."""
-    h1 = H.hash_columns(cols, seed=42).astype(jnp.uint32)
-    h2 = H.hash_columns(cols, seed=0x9747B28C).astype(jnp.uint32)
+    h1 = H.hash_columns(cols, seed=42, capacity=capacity).astype(jnp.uint32)
+    h2 = H.hash_columns(cols, seed=0x9747B28C,
+                        capacity=capacity).astype(jnp.uint32)
     h = (h1.astype(jnp.uint64) << 32) | h2.astype(jnp.uint64)
-    all_valid = cols[0].validity
+    all_valid = _key_validity(cols[0], capacity)
     for c in cols[1:]:
-        all_valid = jnp.logical_and(all_valid, c.validity)
+        all_valid = jnp.logical_and(all_valid, _key_validity(c, capacity))
     return h, all_valid
 
 
@@ -76,9 +85,50 @@ def probe_ranges(sorted_hashes, probe_hash, probe_valid, probe_live):
     return lo.astype(jnp.int32), counts
 
 
+def _host_key_values(c: Any, idx: np.ndarray) -> List[Any]:
+    """Python values of column `c` at rows idx (None = null/out-of-range);
+    strings normalized to bytes so host (str) and device (padded bytes)
+    representations compare equal."""
+    if isinstance(c, HostColumn):
+        vals = c.pylist()
+        out = [vals[i] if 0 <= i < len(vals) else None for i in idx]
+        return [v.encode("utf-8") if isinstance(v, str) else v for v in out]
+    if isinstance(c, DeviceStringColumn):
+        data = np.asarray(c.data)
+        lens = np.asarray(c.lengths)
+        valid = np.asarray(c.validity)
+        return [bytes(data[i, :lens[i]].astype(np.uint8))
+                if 0 <= i < len(valid) and valid[i] else None for i in idx]
+    data = np.asarray(c.data)
+    valid = np.asarray(c.validity)
+    return [data[i].item() if 0 <= i < len(valid) and valid[i] else None
+            for i in idx]
+
+
+def _verify_pairs_host(probe_keys, build_keys, probe_idx, build_idx,
+                       pair_live):
+    """Exact-equality fallback when any key column is host-resident
+    (oversized strings / hybrid rows): values may live in different
+    representations on the two sides, so compare as python values."""
+    import jax
+    pidx, bidx, live = jax.device_get([probe_idx, build_idx, pair_live])
+    pidx, bidx = np.asarray(pidx), np.asarray(bidx)
+    ok = np.asarray(live).copy()
+    for pk, bk in zip(probe_keys, build_keys):
+        pv = _host_key_values(pk, pidx)
+        bv = _host_key_values(bk, bidx)
+        for i in range(len(ok)):
+            if ok[i] and (pv[i] is None or bv[i] is None or pv[i] != bv[i]):
+                ok[i] = False
+    return jnp.asarray(ok)
+
+
 def verify_pairs(probe_keys: List[Any], build_keys: List[Any],
                  probe_idx, build_idx, pair_live):
     """Exact key equality for candidate pairs (hash-collision filter)."""
+    if any(isinstance(c, HostColumn) for c in probe_keys + build_keys):
+        return _verify_pairs_host(probe_keys, build_keys, probe_idx,
+                                  build_idx, pair_live)
     ok = pair_live
     for pk, bk in zip(probe_keys, build_keys):
         p = pk.gather(probe_idx, pair_live)

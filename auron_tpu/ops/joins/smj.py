@@ -1,0 +1,374 @@
+"""Streaming sort-merge join: bounded-memory merge of sorted streams.
+
+The TPU re-design of the reference's SMJ cursors
+(joins/smj/full_join.rs:256, semi_join.rs:243, stream_cursor.rs): both
+children arrive sorted on the join keys, and the join advances a *frontier*
+— the smaller of the two sides' last buffered keys.  All rows strictly
+below the frontier form a complete key-group window: they are joined as one
+device program (build table on the build side's window, fused probe over
+the other side's window) and released.  Rows at or above the frontier stay
+buffered until the lagging stream catches up, so resident memory is
+bounded by one batch per side plus the largest single key group.
+
+Buffered rows register with the MemManager; under pressure the larger
+side's buffer is serialized to spill storage (host RAM tier first, then
+file — memmgr/spill.py) as a sorted run and streamed back when its keys
+fall below the frontier.
+
+Key-order machinery reuses the sort-key encoding (ops/sort_keys.py): the
+device-side window split compares encoded u64 key words against the
+frontier row, and the host-side frontier selection compares raw key values
+with the same null-rank / IEEE-bits / bytes ordering, so both views of the
+order agree (the device view may be coarser on TPU f64 — that only delays
+rows into a later window, never mis-groups them).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.columnar.batch import (
+    Batch, DeviceColumn, DeviceStringColumn, HostColumn, bucket_width,
+)
+from auron_tpu.ir.schema import TypeId
+from auron_tpu.memmgr import SpillManager
+from auron_tpu.ops.base import TaskContext, compact_indices
+from auron_tpu.ops.sort_keys import encode_key_column
+
+_SIGN64 = 0x8000000000000000
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+HostKey = Tuple[Any, ...]
+
+
+# ---------------------------------------------------------------------------
+# host-side key ordering (frontier selection)
+# ---------------------------------------------------------------------------
+
+def _f64_orderable(x: float) -> int:
+    bits = int(np.frombuffer(np.float64(x).tobytes(), dtype=np.uint64)[0])
+    return (~bits & _MASK64) if bits & _SIGN64 else (bits ^ _SIGN64)
+
+
+def _orderable(v: Any) -> Any:
+    if isinstance(v, (bool, np.bool_)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return _f64_orderable(float(v))
+    if isinstance(v, (bytes, str)):
+        b = v.encode() if isinstance(v, str) else v
+        # the engine-wide string order is a total PREORDER: first
+        # device-max-width bytes, then length (sort_keys.py device words,
+        # sort.py _np_encode_key).  The SMJ comparator must match it —
+        # keys tied under it stay buffered into one window, where the
+        # hash kernel resolves exact equality.
+        from auron_tpu.config import conf
+        w = int(conf.get("auron.string.device.max.width"))
+        return (b[:w], len(b))
+    return int(v)
+
+
+def cmp_keys(a: HostKey, b: HostKey,
+             orders: Tuple[Tuple[bool, bool], ...]) -> int:
+    """-1/0/1 under the SQL ordering. Null rank follows nulls_first and is
+    NOT flipped by desc — matching encode_key_column, whose null-rank word
+    is emitted outside the asc/desc word inversion."""
+    for va, vb, (asc, nf) in zip(a, b, orders):
+        ra = (0 if va is None else 1) if nf else (1 if va is None else 0)
+        rb = (0 if vb is None else 1) if nf else (1 if vb is None else 0)
+        if ra != rb:
+            return -1 if ra < rb else 1
+        if va is None:
+            continue
+        oa, ob = _orderable(va), _orderable(vb)
+        if oa == ob:
+            continue
+        c = -1 if oa < ob else 1
+        return c if asc else -c
+    return 0
+
+
+def _host_value(c: Any, v: np.ndarray, valid: bool, length: int) -> Any:
+    if not valid:
+        return None
+    if isinstance(c, DeviceStringColumn):
+        return bytes(np.asarray(v[:length], dtype=np.uint8))
+    if c.dtype.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+        return float(v)
+    if c.dtype.id == TypeId.BOOL:
+        return bool(v)
+    return int(v)
+
+
+def _py_key_value(v: Any) -> Any:
+    if isinstance(v, str):
+        return v.encode()
+    return v
+
+
+def host_keys_of_rows(key_cols: List[Any], rows: List[int]
+                      ) -> List[HostKey]:
+    """Fetch the key values of a few rows in ONE device round trip (the
+    cursor needs first+last keys per batch; per-scalar fetches would put
+    several serialized RTTs on every SMJ input batch)."""
+    import jax
+    refs: List[Any] = []
+    for c in key_cols:
+        if isinstance(c, HostColumn):
+            refs.append(None)
+        elif isinstance(c, DeviceStringColumn):
+            idx = jnp.asarray(rows, jnp.int32)
+            refs.append((jnp.take(c.data, idx, axis=0),
+                         jnp.take(c.lengths, idx),
+                         jnp.take(c.validity, idx)))
+        else:
+            idx = jnp.asarray(rows, jnp.int32)
+            refs.append((jnp.take(c.data, idx), None,
+                         jnp.take(c.validity, idx)))
+    fetched = jax.device_get([r for r in refs if r is not None])
+    it = iter(fetched)
+    out: List[List[Any]] = [[] for _ in rows]
+    for c, r in zip(key_cols, refs):
+        if r is None:
+            vals = c.pylist() if len(rows) > 2 else None
+            for j, row in enumerate(rows):
+                v = vals[row] if vals is not None else c.array[row].as_py()
+                out[j].append(_py_key_value(v))
+            continue
+        data, lengths, validity = next(it)
+        for j in range(len(rows)):
+            ln = int(lengths[j]) if lengths is not None else 0
+            out[j].append(_host_value(c, data[j], bool(validity[j]), ln))
+    return [tuple(k) for k in out]
+
+
+# ---------------------------------------------------------------------------
+# device-side window split
+# ---------------------------------------------------------------------------
+
+def _widen_strings(col: DeviceStringColumn, width: int) -> DeviceStringColumn:
+    if col.width >= width:
+        return col
+    pad = jnp.zeros((col.capacity, width - col.width), jnp.uint8)
+    return DeviceStringColumn(col.dtype, jnp.concatenate([col.data, pad],
+                                                         axis=1),
+                              col.lengths, col.validity)
+
+
+def _scalar_key_column(col: Any, value: Any):
+    """1-row column of `col`'s type holding the frontier value; for strings
+    both columns are padded to a shared width so their encoded words align.
+    Returns (batch_col, frontier_col)."""
+    if isinstance(col, DeviceStringColumn):
+        b = value if isinstance(value, bytes) else \
+            (value.encode() if isinstance(value, str) else b"")
+        width = bucket_width(max(col.width, len(b)))
+        col = _widen_strings(col, width)
+        data = np.zeros((1, width), np.uint8)
+        arr = np.frombuffer(b, dtype=np.uint8)
+        data[0, :len(arr)] = arr
+        f = DeviceStringColumn(col.dtype, jnp.asarray(data),
+                               jnp.asarray([len(b)], jnp.int32),
+                               jnp.asarray([value is not None]))
+        return col, f
+    dt = col.data.dtype
+    v = 0 if value is None else value
+    f = DeviceColumn(col.dtype, jnp.asarray([v], dt),
+                     jnp.asarray([value is not None]))
+    return col, f
+
+
+def rows_below_frontier(key_cols: List[Any], frontier: HostKey,
+                        orders: Tuple[Tuple[bool, bool], ...],
+                        capacity: int):
+    """bool[capacity]: row key strictly less than the frontier key under
+    the SQL ordering (word-lexicographic compare of sort-key encodings).
+    Host-resident key columns (oversized strings, hybrid rows) drop to a
+    host-side row loop — rare, correct."""
+    if any(isinstance(c, HostColumn) for c in key_cols):
+        n = min(c.capacity for c in key_cols
+                if isinstance(c, HostColumn))
+        keys = host_keys_of_rows(key_cols, list(range(n)))
+        mask = np.zeros(capacity, bool)
+        for i, k in enumerate(keys):
+            mask[i] = cmp_keys(k, frontier, orders) < 0
+        return jnp.asarray(mask)
+    lt = None
+    eq = None
+    for col, fval, (asc, nf) in zip(key_cols, frontier, orders):
+        col, fcol = _scalar_key_column(col, fval)
+        words = encode_key_column(col, asc, nf)
+        fwords = encode_key_column(fcol, asc, nf)
+        for w, fw in zip(words, fwords):
+            f0 = fw[0]
+            l, e = w < f0, w == f0
+            if lt is None:
+                lt, eq = l, e
+            else:
+                lt = jnp.logical_or(lt, jnp.logical_and(eq, l))
+                eq = jnp.logical_and(eq, e)
+    return lt
+
+
+def split_batch(b: Batch, key_cols: List[Any], frontier: HostKey,
+                orders) -> Tuple[Optional[Batch], Optional[Batch]]:
+    """-> (ready, keep): rows strictly below / at-or-above the frontier."""
+    below = rows_below_frontier(key_cols, frontier, orders, b.capacity)
+    live = b.row_mask()
+    ridx, rcnt = compact_indices(jnp.logical_and(below, live), b.capacity)
+    kidx, kcnt = compact_indices(
+        jnp.logical_and(jnp.logical_not(below), live), b.capacity)
+    nr, nk = int(rcnt), int(kcnt)
+    ready = b.gather(ridx, nr) if nr else None
+    keep = b.gather(kidx, nk) if nk else None
+    return ready, keep
+
+
+# ---------------------------------------------------------------------------
+# buffered side: in-memory deque + spilled sorted runs
+# ---------------------------------------------------------------------------
+
+class _Run:
+    """One spilled sorted run, streamed back at most once (FIFO order
+    relative to its side: runs precede the in-memory buffer)."""
+
+    def __init__(self, spill, last_key: HostKey):
+        self.spill = spill
+        self.last_key = last_key
+        self.pushback: Optional[Batch] = None
+        self._reader = None
+        self.done = False
+
+    def next_batch(self) -> Optional[Batch]:
+        if self.pushback is not None:
+            b, self.pushback = self.pushback, None
+            return b
+        if self.done:
+            return None
+        if self._reader is None:
+            self._reader = self.spill.read_batches()
+        for rb in self._reader:
+            if rb.num_rows:
+                return Batch.from_arrow(rb)
+        self.done = True
+        self.spill.release()
+        return None
+
+
+class SideCursor:
+    """Cursor over one sorted input: pulls batches on demand, tracks the
+    boundary (last buffered row's key), splits ready rows below a frontier,
+    and spills its in-memory buffer under pressure (stream_cursor.rs)."""
+
+    def __init__(self, stream: Iterator[Batch], key_eval, orders,
+                 partition_id: int, spills: SpillManager, metrics):
+        self._stream = stream
+        self._key_eval = key_eval
+        self.orders = orders
+        self._pid = partition_id
+        self._spills = spills
+        self._metrics = metrics
+        # mem entries: (batch, first_key, last_key); first/last are lower/
+        # upper bounds used only for whole-batch fast paths
+        self.mem: Deque[Tuple[Batch, HostKey, HostKey]] = deque()
+        self.runs: Deque[_Run] = deque()
+        self.exhausted = False
+        self.boundary: Optional[HostKey] = None
+        self.mem_bytes = 0
+        self.iterating = False   # guards spill vs a suspended iter_ready
+
+    def keys_of(self, b: Batch) -> List[Any]:
+        return self._key_eval(b, partition_id=self._pid)
+
+    @property
+    def empty(self) -> bool:
+        return not self.mem and not self.runs
+
+    def advance(self) -> bool:
+        """Buffer one more non-empty batch from upstream."""
+        for b in self._stream:
+            n = b.num_rows          # syncs lazy producers: cursor needs keys
+            if n == 0:
+                continue
+            kc = self.keys_of(b)
+            first, last = host_keys_of_rows(kc, [0, n - 1])
+            self.mem.append((b, first, last))
+            self.mem_bytes += b.mem_bytes()
+            self.boundary = last
+            return True
+        self.exhausted = True
+        return False
+
+    def spill_mem(self) -> int:
+        """Move the in-memory buffer to a spilled run (keeps sort order:
+        spilled rows precede anything buffered later).  Refused while an
+        iter_ready generator is suspended over this buffer — a spill then
+        would move still-pending rows into a run the iterator has already
+        passed."""
+        if not self.mem or self.iterating:
+            return 0
+        last_key = self.mem[-1][2]
+        spill = self._spills.new_spill()
+        size = spill.write_batches(b.to_arrow() for (b, _f, _l) in self.mem)
+        self.runs.append(_Run(spill, last_key))
+        freed = self.mem_bytes
+        self.mem.clear()
+        self.mem_bytes = 0
+        self._metrics.add("mem_spill_count", 1)
+        self._metrics.add("mem_spill_size", size)
+        return freed
+
+    def iter_ready(self, frontier: Optional[HostKey]) -> Iterator[Batch]:
+        self.iterating = True
+        try:
+            yield from self._iter_ready(frontier)
+        finally:
+            self.iterating = False
+
+    def _iter_ready(self, frontier: Optional[HostKey]) -> Iterator[Batch]:
+        """Yield (and drop from the buffer) all rows strictly below the
+        frontier; frontier None means everything buffered."""
+        while self.runs:
+            run = self.runs[0]
+            if frontier is None or cmp_keys(run.last_key, frontier,
+                                            self.orders) < 0:
+                while (b := run.next_batch()) is not None:
+                    yield b
+                self.runs.popleft()
+                continue
+            # straddling run: later runs/mem rows sort >= this one's tail
+            while (b := run.next_batch()) is not None:
+                ready, keep = split_batch(b, self.keys_of(b), frontier,
+                                          self.orders)
+                if ready is not None:
+                    yield ready
+                if keep is not None:
+                    run.pushback = keep
+                    break
+            return
+        while self.mem:
+            b, first, last = self.mem[0]
+            if frontier is None or cmp_keys(last, frontier,
+                                            self.orders) < 0:
+                self.mem.popleft()
+                self.mem_bytes -= b.mem_bytes()
+                yield b
+                continue
+            if cmp_keys(first, frontier, self.orders) >= 0:
+                return      # whole batch (and all later ones) still pending
+            self.mem.popleft()
+            self.mem_bytes -= b.mem_bytes()
+            ready, keep = split_batch(b, self.keys_of(b), frontier,
+                                      self.orders)
+            if keep is not None:
+                # kept rows are >= frontier, so frontier is a valid lower
+                # bound for the fast paths above
+                self.mem.appendleft((keep, frontier, last))
+                self.mem_bytes += keep.mem_bytes()
+            if ready is not None:
+                yield ready
+            return

@@ -56,7 +56,7 @@ class PartitionIdComputer:
             if KP.supported(keys):
                 return KP.hash_partition_ids_i64(
                     keys[0].data, keys[0].validity, self.n)
-            h = H.hash_columns(keys, seed=42)
+            h = H.hash_columns(keys, seed=42, capacity=cap)
             return H.pmod(h, self.n)
         if self.mode == "range":
             return self._range_ids(batch, partition_id)

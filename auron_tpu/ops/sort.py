@@ -15,7 +15,9 @@ import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
-from auron_tpu.columnar.batch import Batch, bucket_capacity, concat_batches
+from auron_tpu.columnar.batch import (
+    Batch, HostColumn, bucket_capacity, concat_batches,
+)
 from auron_tpu.exprs.compiler import build_evaluator
 from auron_tpu.ir.expr import SortExpr
 from auron_tpu.ir.schema import Schema
@@ -61,12 +63,28 @@ class SortExec(Operator, MemConsumer):
 
     def _sort_batch(self, b: Batch) -> Batch:
         key_cols = self._key_eval(b)
-        words = encode_sort_keys(key_cols, self._orders)
-        perm = lexsort_indices(words, b.num_rows, b.capacity)
-        out = b.gather(perm, b.num_rows)
+        if any(isinstance(c, HostColumn) for c in key_cols):
+            out = self._sort_batch_host(b)
+        else:
+            words = encode_sort_keys(key_cols, self._orders)
+            perm = lexsort_indices(words, b.num_rows, b.capacity)
+            out = b.gather(perm, b.num_rows)
         if self.fetch_limit is not None:
             out = out.head(self.fetch_offset + self.fetch_limit)
         return out
+
+    def _sort_batch_host(self, b: Batch) -> Batch:
+        """Key columns living host-side (oversized strings, hybrid rows)
+        can't ride the device key encoding; sort with the same numpy
+        encoding the spill merger uses, so both paths order identically."""
+        rb = b.to_arrow()
+        words = encode_host_sort_words(self.sort_exprs, rb,
+                                       self.children[0].schema)
+        order = np.lexsort(tuple(reversed(words)))
+        tbl = pa.Table.from_batches([rb]).take(
+            pa.array(order, type=pa.int64())).combine_chunks()
+        out = tbl.to_batches()
+        return Batch.from_arrow(out[0] if out else rb.slice(0, 0))
 
     def _sort_staged(self) -> List[Batch]:
         """Sort all staged batches into one run (list of output batches)."""
@@ -158,13 +176,9 @@ class HostKeyMerger:
         """[n, n_words] uint64 matrix mirroring ops.sort_keys encoding
         (device and host agree because spilled runs were device-sorted with
         the same transform)."""
-        from auron_tpu.exprs.host_eval import evaluate as host_evaluate
-        words: List[np.ndarray] = []
-        n = rb.num_rows
-        for s in self.sort_exprs:
-            hv = host_evaluate(s.child, rb, self.schema)
-            words.extend(_np_encode_key(hv, s.asc, s.nulls_first))
-        return np.stack(words, axis=1) if words else np.zeros((n, 0), np.uint64)
+        words = encode_host_sort_words(self.sort_exprs, rb, self.schema)
+        return np.stack(words, axis=1) if words \
+            else np.zeros((rb.num_rows, 0), np.uint64)
 
     def merge(self, runs: List[Iterator[pa.RecordBatch]]) -> Iterator[Batch]:
         heads: List[Optional[pa.RecordBatch]] = []
@@ -229,6 +243,20 @@ class HostKeyMerger:
             emitted = all_rb.take(pa.array(order, type=pa.int64()))
             for rb in emitted.to_batches(max_chunksize=batch_size()):
                 yield Batch.from_arrow(rb)
+
+
+def encode_host_sort_words(sort_exprs: Tuple[SortExpr, ...],
+                           rb: pa.RecordBatch,
+                           schema: Schema) -> List[np.ndarray]:
+    """Host mirror of ops.sort_keys.encode_sort_keys over a record batch —
+    the ONE implementation both the host in-memory sort and the spill
+    merger use, so their orders cannot diverge."""
+    from auron_tpu.exprs.host_eval import evaluate as host_evaluate
+    words: List[np.ndarray] = []
+    for s in sort_exprs:
+        hv = host_evaluate(s.child, rb, schema)
+        words.extend(_np_encode_key(hv, s.asc, s.nulls_first))
+    return words
 
 
 def _key_rank(keys: np.ndarray):

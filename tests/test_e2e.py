@@ -116,7 +116,12 @@ def test_join_plans():
         plan = P.HashJoin(left=lsrc, right=rsrc, on=on, join_type=jt,
                           build_side="right")
         check_plan(plan, res)
-    plan = P.SortMergeJoin(left=lsrc, right=rsrc, on=on, join_type="inner")
+    # the SMJ IR node's contract is key-sorted children (the wire plan
+    # carries the SortExecs explicitly, auron.proto SMJ semantics)
+    plan = P.SortMergeJoin(
+        left=P.Sort(child=lsrc, sort_exprs=(SortExpr(child=col("lk")),)),
+        right=P.Sort(child=rsrc, sort_exprs=(SortExpr(child=col("rk")),)),
+        on=on, join_type="inner")
     check_plan(plan, res)
     plan = P.BroadcastJoin(left=lsrc, right=rsrc, on=on, join_type="inner",
                            broadcast_side="right")

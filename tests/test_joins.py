@@ -150,14 +150,122 @@ def test_multi_key_join():
     assert canon(got) == canon(exp)
 
 
+def sort_rows(rows, key):
+    # nulls first, like the SMJ's required child ordering
+    return sorted(rows, key=lambda r: (r[key] is not None, r[key] or 0))
+
+
 def test_smj_matches_hash_join():
     rng = np.random.default_rng(8)
     left, right = make_sides(rng, nl=200, nr=200)
+    left, right = sort_rows(left, "lk"), sort_rows(right, "rk")
     on = JoinOn(left_keys=(col("lk"),), right_keys=(col("rk"),))
     for how in ("inner", "left", "full", "left_semi", "left_anti"):
         smj = SortMergeJoinExec(scan_of(left), scan_of(right), on, how)
         exp = oracle_join(left, right, "lk", "rk", how)
         assert canon(collect(smj)) == canon(exp), how
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti", "existence"])
+def test_smj_streaming_types(how):
+    """Streaming merge windows: small batches force many frontiers, a
+    skewed key makes groups straddle batch boundaries."""
+    rng = np.random.default_rng(21)
+    left, right = make_sides(rng, nl=400, nr=300, key_range=25)
+    # skew one key so a single group spans several 32-row batches
+    for r in left[:90]:
+        r["lk"] = 7
+    left, right = sort_rows(left, "lk"), sort_rows(right, "rk")
+    on = JoinOn(left_keys=(col("lk"),), right_keys=(col("rk"),))
+    smj = SortMergeJoinExec(scan_of(left, chunk=32), scan_of(right, chunk=32),
+                            on, how)
+    got = collect(smj)
+    exp = oracle_join(left, right, "lk", "rk", how)
+    assert canon(got) == canon(exp), how
+
+
+def test_smj_string_keys():
+    rng = np.random.default_rng(22)
+    words = ["ant", "bee", "cat", "dog", "elk", "fox", None, "anteater"]
+    left = [{"lk": words[int(rng.integers(0, len(words)))], "lv": i}
+            for i in range(150)]
+    right = [{"rk": words[int(rng.integers(0, len(words)))], "rv": 500 + i}
+             for i in range(120)]
+    left = sorted(left, key=lambda r: (r["lk"] is not None, r["lk"] or ""))
+    right = sorted(right, key=lambda r: (r["rk"] is not None, r["rk"] or ""))
+    on = JoinOn(left_keys=(col("lk"),), right_keys=(col("rk"),))
+    for how in ("inner", "full", "left_anti"):
+        smj = SortMergeJoinExec(scan_of(left, chunk=16),
+                                scan_of(right, chunk=16), on, how)
+        exp = oracle_join(left, right, "lk", "rk", how)
+        assert canon(collect(smj)) == canon(exp), how
+
+
+def test_smj_oversized_string_keys_hybrid():
+    """String keys longer than auron.string.device.max.width arrive as
+    HostColumns; the streaming SMJ must route them through the host key
+    path + eager probe instead of the device kernels."""
+    from auron_tpu.config import conf
+    rng = np.random.default_rng(31)
+    keys = ["k" * 300 + str(i) for i in range(6)] + [None]
+    left = [{"lk": keys[int(rng.integers(0, len(keys)))], "lv": i}
+            for i in range(80)]
+    right = [{"rk": keys[int(rng.integers(0, len(keys)))], "rv": 300 + i}
+             for i in range(60)]
+    left = sorted(left, key=lambda r: (r["lk"] is not None, r["lk"] or ""))
+    right = sorted(right, key=lambda r: (r["rk"] is not None, r["rk"] or ""))
+    on = JoinOn(left_keys=(col("lk"),), right_keys=(col("rk"),))
+    for how in ("inner", "full"):
+        smj = SortMergeJoinExec(scan_of(left, chunk=16),
+                                scan_of(right, chunk=16), on, how)
+        exp = oracle_join(left, right, "lk", "rk", how)
+        assert canon(collect(smj)) == canon(exp), how
+
+
+def test_smj_truncation_tied_string_keys():
+    """Distinct oversized keys sharing the first 256 bytes AND the same
+    length tie under the engine's truncated string preorder; they must
+    land in one SMJ window where exact hash matching separates them."""
+    ka = "x" * 256 + "aa"
+    kb = "x" * 256 + "ab"
+    left = ([{"lk": ka, "lv": i} for i in range(8)]
+            + [{"lk": kb, "lv": 100 + i} for i in range(8)])
+    right = ([{"rk": ka, "rv": 200 + i} for i in range(5)]
+             + [{"rk": kb, "rv": 300 + i} for i in range(5)])
+    on = JoinOn(left_keys=(col("lk"),), right_keys=(col("rk"),))
+    for how in ("inner", "full", "left_semi"):
+        smj = SortMergeJoinExec(scan_of(left, chunk=4),
+                                scan_of(right, chunk=4), on, how)
+        exp = oracle_join(left, right, "lk", "rk", how)
+        assert canon(collect(smj)) == canon(exp), how
+
+
+@pytest.mark.parametrize("how", ["inner", "full", "left_anti"])
+def test_smj_spill_tiny_budget(how):
+    """Tiny-budget fuzz: the buffered-side spill path must activate and
+    results stay exact (the joins analogue of test_ops_basic.py's sort/agg
+    spill fuzz tests, sort_exec.rs:1512-1698)."""
+    from auron_tpu.config import conf
+    from auron_tpu.memmgr import get_manager
+    from auron_tpu.memmgr.manager import reset_manager
+    rng = np.random.default_rng(23)
+    left, right = make_sides(rng, nl=600, nr=500, key_range=12)
+    for r in left[:200]:
+        r["lk"] = 3  # giant group: forces a wide buffered window
+    left, right = sort_rows(left, "lk"), sort_rows(right, "rk")
+    on = JoinOn(left_keys=(col("lk"),), right_keys=(col("rk"),))
+    mgr = reset_manager(budget_bytes=1)
+    try:
+        with conf.scoped({"auron.memory.spill.min.trigger.bytes": 1}):
+            smj = SortMergeJoinExec(scan_of(left, chunk=64),
+                                    scan_of(right, chunk=64), on, how)
+            got = collect(smj)
+            assert mgr.num_spills > 0, "budget=1 must force join spills"
+    finally:
+        reset_manager()
+    exp = oracle_join(left, right, "lk", "rk", how)
+    assert canon(got) == canon(exp), how
 
 
 def test_broadcast_join_cache():
