@@ -192,6 +192,12 @@ SHUFFLE_SERVICE_ADDRESS = conf.define(
 SHUFFLE_COMPRESSION_CODEC = conf.define(
     "auron.shuffle.compression.codec", "zstd", "Codec for shuffle blocks."
 )
+TASK_RETRIES = conf.define(
+    "auron.task.retries", 0,
+    "Per-partition task retry count above the runtime (the Spark "
+    "task-retry model the reference inherits; stage inputs are "
+    "materialized once, so a retry replays only the failed task).",
+)
 SMJ_STREAMING_ENABLE = conf.define(
     "auron.smj.streaming.enable", True,
     "Execute sort-merge joins as a bounded-memory streaming merge of "

@@ -146,6 +146,7 @@ def _hash_host_column(col, seed):
     from auron_tpu.native import bindings
     seeds = np.asarray(seed, dtype=np.uint32)
     out = seeds.copy()
+    import decimal as _dec
     for i, v in enumerate(col.pylist()):
         if v is None:
             continue
@@ -153,6 +154,16 @@ def _hash_host_column(col, seed):
             b = v.encode("utf-8")
         elif isinstance(v, bytes):
             b = v
+        elif isinstance(v, _dec.Decimal):
+            # Spark DecimalType p>18: murmur3 over the java BigDecimal
+            # unscaledValue().toByteArray() — minimal big-endian two's
+            # complement (spark_hash.rs decimal arm).  Java bitLength
+            # excludes the sign bit: bitLength(-2^k) == k, so negatives
+            # use (-v-1).bit_length()
+            unscaled = int(v.scaleb(col.dtype.scale))
+            bl = (-unscaled - 1).bit_length() if unscaled < 0 \
+                else unscaled.bit_length()
+            b = unscaled.to_bytes(bl // 8 + 1, "big", signed=True)
         else:
             raise TypeError(
                 f"unhashable host value {type(v).__name__} ({col.dtype})")

@@ -120,8 +120,15 @@ def evaluate(expr: E.Expr, rb: pa.RecordBatch, schema: Schema,
             t = dt if dt.id != TypeId.NULL else DataType.bool_()
             return HV(np.zeros(n, object if (t.is_stringlike or t.is_nested)
                                else t.numpy_dtype()), np.zeros(n, bool), t)
-        if dt.id == TypeId.DECIMAL and not isinstance(v, int):
-            v = int(round(float(v) * 10 ** dt.scale))
+        if dt.id == TypeId.DECIMAL:
+            from decimal import Decimal
+            if not isinstance(v, int):
+                # exact unscaling (a float round-trip would corrupt
+                # high-precision literals)
+                v = int(Decimal(str(v)).scaleb(dt.scale))
+            if dt.precision > 18:   # beyond int64: object-int column
+                return HV(np.full(n, v, dtype=object), np.ones(n, bool),
+                          dt)
         if dt.is_stringlike or dt.is_nested:
             return HV(np.array([v] * n, dtype=object), np.ones(n, bool), dt)
         return HV(np.full(n, v, dtype=dt.numpy_dtype()), np.ones(n, bool), dt)

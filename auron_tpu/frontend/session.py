@@ -168,9 +168,25 @@ class AuronSession:
         resources = self._materialize_deps(plan, ctx)
         n_parts = ctx.parts(plan)
         batches: List[pa.RecordBatch] = []
+        max_attempts = 1 + int(config.conf.get("auron.task.retries"))
         for pid in range(n_parts):
-            res = execute_plan(plan, partition_id=pid, resources=resources,
-                               num_partitions=n_parts)
+            # task-retry model above the runtime (the Spark scheduler's
+            # role the reference inherits): a failed partition task
+            # re-executes from its inputs — stage inputs (exchanges,
+            # broadcasts) are already materialized, so the retry replays
+            # only this task's work
+            for attempt in range(max_attempts):
+                try:
+                    res = execute_plan(plan, partition_id=pid,
+                                       resources=resources,
+                                       num_partitions=n_parts)
+                    break
+                except Exception:
+                    if attempt + 1 >= max_attempts:
+                        raise
+                    log.warning("task for partition %d failed "
+                                "(attempt %d/%d); retrying",
+                                pid, attempt + 1, max_attempts)
             self._metrics.append(res.metrics)
             batches.extend(res.batches)
         if not batches:

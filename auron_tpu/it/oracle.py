@@ -331,7 +331,23 @@ class PyArrowEngine:
     def _hash_aggregate_exec(self, node, children):
         mode = node.attrs.get("mode", "single")
         if mode == "partial":
-            return children[0]          # final recomputes from raw rows
+            # final recomputes from raw rows, but aliased grouping keys
+            # must exist under their OUTPUT names for the final grouping
+            # (and the exchange partitioning) to resolve
+            t = children[0]
+            ev = _Eval(t)
+            out_arrow = to_arrow_schema(node.output)
+            for g, out_name in zip(node.attrs.get("grouping", ()),
+                                   node.output.names()):
+                if out_name in t.schema.names:
+                    continue
+                v, m = ev.eval(g)
+                vals = [None if m[i] else _norm(v[i])
+                        for i in range(t.num_rows)]
+                t = t.append_column(
+                    out_name, pa.array(vals,
+                                       type=out_arrow.field(out_name).type))
+            return t
         t = children[0]
         grouping = list(node.attrs.get("grouping", ()))
         aggs = list(node.attrs.get("aggs", ()))

@@ -71,9 +71,12 @@ def bhj(probe: ForeignNode, build: ForeignNode, left_key: ForeignExpr,
         right_key: ForeignExpr, join_type: str = "Inner") -> ForeignNode:
     bx = ForeignNode("BroadcastExchangeExec", children=(build,),
                      output=build.output)
+    out = probe.output.concat(build.output) \
+        if join_type in ("Inner", "LeftOuter", "RightOuter", "FullOuter") \
+        else probe.output
     return ForeignNode(
         "BroadcastHashJoinExec", children=(probe, bx),
-        output=probe.output.concat(build.output),
+        output=out,
         attrs={"left_keys": [left_key], "right_keys": [right_key],
                "join_type": join_type, "build_side": "right"})
 
@@ -123,17 +126,22 @@ def two_phase_agg(child: ForeignNode, grouping: Sequence[ForeignExpr],
         output=Schema(tuple(state_fields)),
         attrs={"grouping": list(grouping), "aggs": agg_exprs,
                "agg_names": agg_names, "mode": "partial"})
+    # the exchange consumes the PARTIAL agg's output, so it partitions by
+    # the output attributes (alias names), not the pre-agg child columns
     part_spec = {"mode": "hash", "num_partitions": n_parts,
-                 "expressions": [g if g.name != "Alias" else g.children[0]
-                                 for g in grouping]} if grouping else \
+                 "expressions": [fcol(f.name, f.dtype)
+                                 for f in group_fields]} if grouping else \
         {"mode": "single", "num_partitions": 1}
     exchange = ForeignNode(
         "ShuffleExchangeExec", children=(partial,), output=partial.output,
         attrs={"partitioning": part_spec})
     final_out = Schema(tuple(group_fields) + tuple(f for _, _, f in aggs))
+    # like the exchange, the final agg sees the partial-state schema, so
+    # its grouping references the output attributes
+    final_grouping = [fcol(f.name, f.dtype) for f in group_fields]
     return ForeignNode(
         "HashAggregateExec", children=(exchange,), output=final_out,
-        attrs={"grouping": list(grouping), "aggs": agg_exprs,
+        attrs={"grouping": final_grouping, "aggs": agg_exprs,
                "agg_names": agg_names, "mode": "final"})
 
 
@@ -759,3 +767,941 @@ def q15(cat: Catalog) -> ForeignNode:
         grouped, orders=[so(fcol("ca_state", STR))], limit=100,
         project=[fcol("ca_state", STR), fcol("total", F64)],
         out=Schema((Field("ca_state", STR), Field("total", F64))))
+
+
+# ---------------------------------------------------------------------------
+# round-2 corpus growth (VERDICT r1 #6): grouping-sets/rollup, window-heavy,
+# semi/anti/outer-join, union, casewhen/in expression shapes, at 40+ queries
+# ---------------------------------------------------------------------------
+
+@_q("q06a")
+def q06a(cat: Catalog) -> ForeignNode:
+    """q06 family: customer count per address state for store shoppers."""
+    ss = cat.scan("store_sales", ["ss_customer_sk", "ss_ext_sales_price"])
+    cu = cat.scan("customer", ["c_customer_sk", "c_current_addr_sk"])
+    caddr = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    j1 = smj(ss, cu, [fcol("ss_customer_sk", I64)],
+             [fcol("c_customer_sk", I64)])
+    j2 = bhj(j1, caddr, fcol("c_current_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("ca_state", STR)],
+        group_fields=[Field("ca_state", STR)],
+        aggs=[("cnt", agg("Count", fcol("ss_customer_sk", I64), I64),
+               Field("cnt", I64)),
+              ("rev", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("rev", F64))])
+    big = ffilter(grouped, fcall("GreaterThanOrEqual", fcol("cnt", I64),
+                                 flit(10)))
+    return take_ordered(
+        big, orders=[so(fcol("cnt", I64), asc=False),
+                     so(fcol("ca_state", STR))], limit=100,
+        project=[fcol("ca_state", STR), fcol("cnt", I64),
+                 fcol("rev", F64)],
+        out=Schema((Field("ca_state", STR), Field("cnt", I64),
+                    Field("rev", F64))))
+
+
+@_q("q13a")
+def q13a(cat: Catalog) -> ForeignNode:
+    """q13 family: averages under an IN-list store-state predicate."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_store_sk", "ss_quantity",
+                   "ss_sales_price", "ss_net_profit"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32), flit(2001)),
+                   ["d_date_sk", "d_year"])
+    st = cat.scan("store", ["s_store_sk", "s_state"])
+    st = ffilter(st, fcall("In", fcol("s_state", STR), flit("TN"),
+                           flit("CA"), flit("TX"), flit("OH")))
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    grouped = two_phase_agg(
+        j2, grouping=[fcol("s_state", STR)],
+        group_fields=[Field("s_state", STR)],
+        aggs=[("avg_q", agg("Average", fcall("Cast", fcol("ss_quantity",
+                                                          I32), dtype=F64),
+                            F64), Field("avg_q", F64)),
+              ("avg_p", agg("Average", fcol("ss_sales_price", F64), F64),
+               Field("avg_p", F64)),
+              ("profit", agg("Sum", fcol("ss_net_profit", F64), F64),
+               Field("profit", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("s_state", STR))], limit=100,
+        project=[fcol("s_state", STR), fcol("avg_q", F64),
+                 fcol("avg_p", F64), fcol("profit", F64)],
+        out=Schema((Field("s_state", STR), Field("avg_q", F64),
+                    Field("avg_p", F64), Field("profit", F64))))
+
+
+@_q("q17m")
+def q17m(cat: Catalog) -> ForeignNode:
+    """q17 family: sold-then-returned tickets, quantity stats by store."""
+    ss = cat.scan("store_sales",
+                  ["ss_ticket_number", "ss_item_sk", "ss_store_sk",
+                   "ss_quantity"])
+    sr = cat.scan("store_returns",
+                  ["sr_ticket_number", "sr_item_sk", "sr_return_amt"])
+    j = smj(ss, sr,
+            [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+            [fcol("sr_ticket_number", I64), fcol("sr_item_sk", I64)])
+    grouped = two_phase_agg(
+        j, grouping=[fcol("ss_store_sk", I64)],
+        group_fields=[Field("ss_store_sk", I64)],
+        aggs=[("min_q", agg("Min", fcol("ss_quantity", I32), I32),
+               Field("min_q", I32)),
+              ("max_q", agg("Max", fcol("ss_quantity", I32), I32),
+               Field("max_q", I32)),
+              ("avg_r", agg("Average", fcol("sr_return_amt", F64), F64),
+               Field("avg_r", F64)),
+              ("n", agg("Count", fcol("ss_ticket_number", I64), I64),
+               Field("n", I64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("ss_store_sk", I64))], limit=100,
+        project=[fcol("ss_store_sk", I64), fcol("min_q", I32),
+                 fcol("max_q", I32), fcol("avg_r", F64), fcol("n", I64)],
+        out=Schema((Field("ss_store_sk", I64), Field("min_q", I32),
+                    Field("max_q", I32), Field("avg_r", F64),
+                    Field("n", I64))))
+
+
+@_q("q22r")
+def q22r(cat: Catalog) -> ForeignNode:
+    """q22 family: rollup (category, brand) average quantity on catalog
+    sales (ExpandExec grouping sets)."""
+    cs = cat.scan("catalog_sales", ["cs_item_sk", "cs_quantity"])
+    it = cat.scan("item", ["i_item_sk", "i_category", "i_brand"])
+    j = bhj(cs, it, fcol("cs_item_sk", I64), fcol("i_item_sk", I64))
+    pre = fproject(
+        j, [fcol("i_category", STR), fcol("i_brand", STR),
+            falias(fcall("Cast", fcol("cs_quantity", I32), dtype=F64),
+                   "qty")],
+        Schema((Field("i_category", STR), Field("i_brand", STR),
+                Field("qty", F64))))
+    expand_out = Schema((Field("i_category", STR), Field("i_brand", STR),
+                         Field("qty", F64),
+                         Field("spark_grouping_id", I64)))
+    expand = ForeignNode(
+        "ExpandExec", children=(pre,), output=expand_out,
+        attrs={"projections": [
+            [fcol("i_category", STR), fcol("i_brand", STR),
+             fcol("qty", F64), flit(0, I64)],
+            [fcol("i_category", STR), flit(None, STR), fcol("qty", F64),
+             flit(1, I64)],
+            [flit(None, STR), flit(None, STR), fcol("qty", F64),
+             flit(3, I64)]]})
+    grouped = two_phase_agg(
+        expand,
+        grouping=[fcol("i_category", STR), fcol("i_brand", STR),
+                  fcol("spark_grouping_id", I64)],
+        group_fields=[Field("i_category", STR), Field("i_brand", STR),
+                      Field("spark_grouping_id", I64)],
+        aggs=[("avg_q", agg("Average", fcol("qty", F64), F64),
+               Field("avg_q", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("avg_q", F64), asc=False),
+                so(fcol("i_category", STR)), so(fcol("i_brand", STR)),
+                so(fcol("spark_grouping_id", I64))],
+        limit=100,
+        project=[fcol("i_category", STR), fcol("i_brand", STR),
+                 fcol("spark_grouping_id", I64), fcol("avg_q", F64)],
+        out=Schema((Field("i_category", STR), Field("i_brand", STR),
+                    Field("spark_grouping_id", I64),
+                    Field("avg_q", F64))))
+
+
+@_q("q25m")
+def q25m(cat: Catalog) -> ForeignNode:
+    """q25 family: sold, returned, then re-bought through the catalog —
+    three-fact join with profit sums per store."""
+    ss = cat.scan("store_sales",
+                  ["ss_ticket_number", "ss_item_sk", "ss_customer_sk",
+                   "ss_store_sk", "ss_net_profit"])
+    sr = cat.scan("store_returns",
+                  ["sr_ticket_number", "sr_item_sk", "sr_customer_sk",
+                   "sr_return_amt"])
+    cs = cat.scan("catalog_sales",
+                  ["cs_bill_customer_sk", "cs_item_sk", "cs_net_profit"])
+    j1 = smj(ss, sr,
+             [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+             [fcol("sr_ticket_number", I64), fcol("sr_item_sk", I64)])
+    j2 = smj(j1, cs,
+             [fcol("sr_customer_sk", I64), fcol("sr_item_sk", I64)],
+             [fcol("cs_bill_customer_sk", I64), fcol("cs_item_sk", I64)])
+    grouped = two_phase_agg(
+        j2, grouping=[fcol("ss_store_sk", I64)],
+        group_fields=[Field("ss_store_sk", I64)],
+        aggs=[("store_profit", agg("Sum", fcol("ss_net_profit", F64),
+                                   F64), Field("store_profit", F64)),
+              ("returns_amt", agg("Sum", fcol("sr_return_amt", F64), F64),
+               Field("returns_amt", F64)),
+              ("catalog_profit", agg("Sum", fcol("cs_net_profit", F64),
+                                     F64), Field("catalog_profit", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("ss_store_sk", I64))], limit=100,
+        project=[fcol("ss_store_sk", I64), fcol("store_profit", F64),
+                 fcol("returns_amt", F64), fcol("catalog_profit", F64)],
+        out=Schema((Field("ss_store_sk", I64),
+                    Field("store_profit", F64),
+                    Field("returns_amt", F64),
+                    Field("catalog_profit", F64))))
+
+
+@_q("q26a")
+def q26a(cat: Catalog) -> ForeignNode:
+    """q26: catalog mirror of q07 (promotion-channel averages)."""
+    cs = cat.scan("catalog_sales",
+                  ["cs_sold_date_sk", "cs_item_sk", "cs_quantity",
+                   "cs_sales_price"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32), flit(2000)),
+                   ["d_date_sk", "d_year"])
+    it = cat.scan("item", ["i_item_sk", "i_item_id"])
+    j1 = bhj(cs, dd, fcol("cs_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("cs_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2, grouping=[fcol("i_item_id", STR)],
+        group_fields=[Field("i_item_id", STR)],
+        aggs=[("agg1", agg("Average", fcall("Cast", fcol("cs_quantity",
+                                                         I32), dtype=F64),
+                           F64), Field("agg1", F64)),
+              ("agg2", agg("Average", fcol("cs_sales_price", F64), F64),
+               Field("agg2", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("i_item_id", STR))], limit=100,
+        project=[fcol("i_item_id", STR), fcol("agg1", F64),
+                 fcol("agg2", F64)],
+        out=Schema((Field("i_item_id", STR), Field("agg1", F64),
+                    Field("agg2", F64))))
+
+
+@_q("q29m")
+def q29m(cat: Catalog) -> ForeignNode:
+    """q29 family: quantity extremes for sold+returned items by item id."""
+    ss = cat.scan("store_sales",
+                  ["ss_ticket_number", "ss_item_sk", "ss_quantity"])
+    sr = cat.scan("store_returns",
+                  ["sr_ticket_number", "sr_item_sk", "sr_return_amt"])
+    it = cat.scan("item", ["i_item_sk", "i_item_id"])
+    j1 = smj(ss, sr,
+             [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+             [fcol("sr_ticket_number", I64), fcol("sr_item_sk", I64)])
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2, grouping=[fcol("i_item_id", STR)],
+        group_fields=[Field("i_item_id", STR)],
+        aggs=[("min_q", agg("Min", fcol("ss_quantity", I32), I32),
+               Field("min_q", I32)),
+              ("max_r", agg("Max", fcol("sr_return_amt", F64), F64),
+               Field("max_r", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("i_item_id", STR))], limit=100,
+        project=[fcol("i_item_id", STR), fcol("min_q", I32),
+                 fcol("max_r", F64)],
+        out=Schema((Field("i_item_id", STR), Field("min_q", I32),
+                    Field("max_r", F64))))
+
+
+@_q("q33b")
+def q33b(cat: Catalog) -> ForeignNode:
+    """q33 family: manufacturer revenue across all three channels
+    (union) in one month."""
+    def channel(table, date_col, item_col, price_col):
+        sc = cat.scan(table, [date_col, item_col, price_col])
+        dd = _dim_date(
+            cat,
+            fcall("And", fcall("EqualTo", fcol("d_year", I32), flit(1999)),
+                  fcall("EqualTo", fcol("d_moy", I32), flit(3))),
+            ["d_date_sk", "d_year", "d_moy"])
+        j = bhj(sc, dd, fcol(date_col, I64), fcol("d_date_sk", I64))
+        it = cat.scan("item", ["i_item_sk", "i_manufact_id"])
+        j2 = bhj(j, it, fcol(item_col, I64), fcol("i_item_sk", I64))
+        return fproject(
+            j2, [fcol("i_manufact_id", I32),
+                 falias(fcol(price_col, F64), "ext_price")],
+            Schema((Field("i_manufact_id", I32),
+                    Field("ext_price", F64))))
+    un = ForeignNode(
+        "UnionExec",
+        children=(channel("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                          "ss_ext_sales_price"),
+                  channel("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                          "cs_ext_sales_price"),
+                  channel("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                          "ws_ext_sales_price")),
+        output=Schema((Field("i_manufact_id", I32),
+                       Field("ext_price", F64))))
+    grouped = two_phase_agg(
+        un, grouping=[fcol("i_manufact_id", I32)],
+        group_fields=[Field("i_manufact_id", I32)],
+        aggs=[("total", agg("Sum", fcol("ext_price", F64), F64),
+               Field("total", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("total", F64), asc=False),
+                         so(fcol("i_manufact_id", I32))], limit=100,
+        project=[fcol("i_manufact_id", I32), fcol("total", F64)],
+        out=Schema((Field("i_manufact_id", I32), Field("total", F64))))
+
+
+@_q("q34c")
+def q34c(cat: Catalog) -> ForeignNode:
+    """q34 family: busy baskets (5..20 items) early in the month, named
+    customers."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_customer_sk",
+                   "ss_ticket_number"])
+    dd = _dim_date(cat, fcall("LessThanOrEqual", fcol("d_dom", I32),
+                              flit(10)),
+                   ["d_date_sk", "d_dom"])
+    j = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    grouped = two_phase_agg(
+        j,
+        grouping=[fcol("ss_customer_sk", I64)],
+        group_fields=[Field("ss_customer_sk", I64)],
+        aggs=[("cnt", agg("Count", fcol("ss_ticket_number", I64), I64),
+               Field("cnt", I64))])
+    sized = ffilter(
+        grouped,
+        fcall("And",
+              fcall("GreaterThanOrEqual", fcol("cnt", I64), flit(2)),
+              fcall("LessThanOrEqual", fcol("cnt", I64), flit(50))))
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+    named = smj(sized, cu, [fcol("ss_customer_sk", I64)],
+                [fcol("c_customer_sk", I64)])
+    return take_ordered(
+        named,
+        orders=[so(fcol("cnt", I64), asc=False),
+                so(fcol("c_customer_id", STR))],
+        limit=100,
+        project=[fcol("c_customer_id", STR), fcol("cnt", I64)],
+        out=Schema((Field("c_customer_id", STR), Field("cnt", I64))))
+
+
+@_q("q38i")
+def q38i(cat: Catalog) -> ForeignNode:
+    """q38 family: customers active in ALL three channels (semi-join
+    intersection), counted."""
+    ss = cat.scan("store_sales", ["ss_customer_sk"])
+    cs = cat.scan("catalog_sales", ["cs_bill_customer_sk"])
+    ws = cat.scan("web_sales", ["ws_bill_customer_sk"])
+    in_cs = smj(ss, cs, [fcol("ss_customer_sk", I64)],
+                [fcol("cs_bill_customer_sk", I64)], join_type="LeftSemi")
+    in_all = smj(in_cs, ws, [fcol("ss_customer_sk", I64)],
+                 [fcol("ws_bill_customer_sk", I64)], join_type="LeftSemi")
+    dedup = two_phase_agg(
+        in_all, grouping=[fcol("ss_customer_sk", I64)],
+        group_fields=[Field("ss_customer_sk", I64)], aggs=[])
+    return two_phase_agg(
+        dedup, grouping=[],
+        group_fields=[],
+        aggs=[("n", agg("Count", fcol("ss_customer_sk", I64), I64),
+               Field("n", I64))])
+
+
+@_q("q45s")
+def q45s(cat: Catalog) -> ForeignNode:
+    """q45 family: web revenue by customer address state (IN-list)."""
+    ws = cat.scan("web_sales",
+                  ["ws_bill_customer_sk", "ws_ext_sales_price"])
+    cu = cat.scan("customer", ["c_customer_sk", "c_current_addr_sk"])
+    caddr = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    caddr = ffilter(caddr, fcall("In", fcol("ca_state", STR), flit("CA"),
+                                 flit("TX"), flit("NY"), flit("FL"),
+                                 flit("WA")))
+    j1 = smj(ws, cu, [fcol("ws_bill_customer_sk", I64)],
+             [fcol("c_customer_sk", I64)])
+    j2 = bhj(j1, caddr, fcol("c_current_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    grouped = two_phase_agg(
+        j2, grouping=[fcol("ca_state", STR)],
+        group_fields=[Field("ca_state", STR)],
+        aggs=[("rev", agg("Sum", fcol("ws_ext_sales_price", F64), F64),
+               Field("rev", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("ca_state", STR))], limit=100,
+        project=[fcol("ca_state", STR), fcol("rev", F64)],
+        out=Schema((Field("ca_state", STR), Field("rev", F64))))
+
+
+@_q("q47w")
+def q47w(cat: Catalog) -> ForeignNode:
+    """q47 family: top revenue months per brand via rank() over monthly
+    sums (window-heavy)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_year", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_brand"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("i_brand", STR), fcol("d_year", I32),
+                  fcol("d_moy", I32)],
+        group_fields=[Field("i_brand", STR), Field("d_year", I32),
+                      Field("d_moy", I32)],
+        aggs=[("sum_sales", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("sum_sales", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {"mode": "hash", "num_partitions": 4,
+                                "expressions": [fcol("i_brand", STR)]}})
+    win_out = Schema((Field("i_brand", STR), Field("d_year", I32),
+                      Field("d_moy", I32), Field("sum_sales", F64),
+                      Field("rk", I32)))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "rk", "fn": "rank", "args": [], "agg": None,
+                    "dtype": I32}],
+               "partition_spec": [fcol("i_brand", STR)],
+               "order_spec": [so(fcol("sum_sales", F64), asc=False),
+                              so(fcol("d_year", I32)),
+                              so(fcol("d_moy", I32))]})
+    top = ffilter(win, fcall("LessThanOrEqual", fcol("rk", I32), flit(3)))
+    return take_ordered(
+        top,
+        orders=[so(fcol("i_brand", STR)), so(fcol("rk", I32))],
+        limit=200,
+        project=[fcol("i_brand", STR), fcol("d_year", I32),
+                 fcol("d_moy", I32), fcol("sum_sales", F64),
+                 fcol("rk", I32)],
+        out=win_out)
+
+
+@_q("q48a")
+def q48a(cat: Catalog) -> ForeignNode:
+    """q48 family: CASE-bucketed revenue by store state (conditional
+    aggregation)."""
+    ss = cat.scan("store_sales",
+                  ["ss_store_sk", "ss_quantity", "ss_sales_price"])
+    st = cat.scan("store", ["s_store_sk", "s_state"])
+    j = bhj(ss, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    bucketed = fproject(
+        j, [fcol("s_state", STR),
+            falias(fcall("CaseWhen",
+                         fcall("LessThan", fcol("ss_quantity", I32),
+                               flit(25)),
+                         fcol("ss_sales_price", F64),
+                         flit(0.0, F64), dtype=F64),
+                   "low_rev"),
+            falias(fcall("CaseWhen",
+                         fcall("GreaterThanOrEqual",
+                               fcol("ss_quantity", I32), flit(75)),
+                         fcol("ss_sales_price", F64),
+                         flit(0.0, F64), dtype=F64),
+                   "high_rev")],
+        Schema((Field("s_state", STR), Field("low_rev", F64),
+                Field("high_rev", F64))))
+    grouped = two_phase_agg(
+        bucketed, grouping=[fcol("s_state", STR)],
+        group_fields=[Field("s_state", STR)],
+        aggs=[("low", agg("Sum", fcol("low_rev", F64), F64),
+               Field("low", F64)),
+              ("high", agg("Sum", fcol("high_rev", F64), F64),
+               Field("high", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("s_state", STR))], limit=100,
+        project=[fcol("s_state", STR), fcol("low", F64),
+                 fcol("high", F64)],
+        out=Schema((Field("s_state", STR), Field("low", F64),
+                    Field("high", F64))))
+
+
+@_q("q50c")
+def q50c(cat: Catalog) -> ForeignNode:
+    """q50 family: days-to-return latency stats per store (date
+    arithmetic on join output)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_ticket_number", "ss_item_sk",
+                   "ss_store_sk"])
+    sr = cat.scan("store_returns",
+                  ["sr_returned_date_sk", "sr_ticket_number",
+                   "sr_item_sk"])
+    j = smj(ss, sr,
+            [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+            [fcol("sr_ticket_number", I64), fcol("sr_item_sk", I64)])
+    lat = fproject(
+        j, [fcol("ss_store_sk", I64),
+            falias(fcall("Subtract", fcol("sr_returned_date_sk", I64),
+                         fcol("ss_sold_date_sk", I64), dtype=I64),
+                   "lag_days")],
+        Schema((Field("ss_store_sk", I64), Field("lag_days", I64))))
+    grouped = two_phase_agg(
+        lat, grouping=[fcol("ss_store_sk", I64)],
+        group_fields=[Field("ss_store_sk", I64)],
+        aggs=[("n", agg("Count", fcol("lag_days", I64), I64),
+               Field("n", I64)),
+              ("avg_lag", agg("Average", fcall("Cast",
+                                               fcol("lag_days", I64),
+                                               dtype=F64), F64),
+               Field("avg_lag", F64)),
+              ("max_lag", agg("Max", fcol("lag_days", I64), I64),
+               Field("max_lag", I64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("ss_store_sk", I64))], limit=100,
+        project=[fcol("ss_store_sk", I64), fcol("n", I64),
+                 fcol("avg_lag", F64), fcol("max_lag", I64)],
+        out=Schema((Field("ss_store_sk", I64), Field("n", I64),
+                    Field("avg_lag", F64), Field("max_lag", I64))))
+
+
+@_q("q51w")
+def q51w(cat: Catalog) -> ForeignNode:
+    """q51 family: monthly revenue share of each item's total (window
+    whole-partition sum + divide)."""
+    ws = cat.scan("web_sales",
+                  ["ws_sold_date_sk", "ws_item_sk", "ws_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_moy"])
+    j = bhj(ws, dd, fcol("ws_sold_date_sk", I64), fcol("d_date_sk", I64))
+    monthly = two_phase_agg(
+        j, grouping=[fcol("ws_item_sk", I64), fcol("d_moy", I32)],
+        group_fields=[Field("ws_item_sk", I64), Field("d_moy", I32)],
+        aggs=[("rev", agg("Sum", fcol("ws_sales_price", F64), F64),
+               Field("rev", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(monthly,), output=monthly.output,
+        attrs={"partitioning": {"mode": "hash", "num_partitions": 4,
+                                "expressions": [fcol("ws_item_sk", I64)]}})
+    win_out = Schema((Field("ws_item_sk", I64), Field("d_moy", I32),
+                      Field("rev", F64), Field("total", F64)))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "total", "fn": "agg",
+                    "args": [],
+                    "agg": agg("Sum", fcol("rev", F64), F64),
+                    "dtype": F64}],
+               "partition_spec": [fcol("ws_item_sk", I64)],
+               "order_spec": []})
+    share = fproject(
+        win, [fcol("ws_item_sk", I64), fcol("d_moy", I32),
+              fcol("rev", F64),
+              falias(fcall("Divide", fcol("rev", F64),
+                           fcol("total", F64), dtype=F64), "share")],
+        Schema((Field("ws_item_sk", I64), Field("d_moy", I32),
+                Field("rev", F64), Field("share", F64))))
+    hot = ffilter(share, fcall("GreaterThan", fcol("share", F64),
+                               flit(0.5)))
+    return take_ordered(
+        hot,
+        orders=[so(fcol("share", F64), asc=False),
+                so(fcol("ws_item_sk", I64)), so(fcol("d_moy", I32))],
+        limit=100,
+        project=[fcol("ws_item_sk", I64), fcol("d_moy", I32),
+                 fcol("rev", F64), fcol("share", F64)],
+        out=Schema((Field("ws_item_sk", I64), Field("d_moy", I32),
+                    Field("rev", F64), Field("share", F64))))
+
+
+@_q("q57w")
+def q57w(cat: Catalog) -> ForeignNode:
+    """q57 family: catalog channel's top months per brand (rank window
+    over two-key partition)."""
+    cs = cat.scan("catalog_sales",
+                  ["cs_sold_date_sk", "cs_item_sk", "cs_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_year", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_brand"])
+    j1 = bhj(cs, dd, fcol("cs_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("cs_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2,
+        grouping=[fcol("i_brand", STR), fcol("d_year", I32),
+                  fcol("d_moy", I32)],
+        group_fields=[Field("i_brand", STR), Field("d_year", I32),
+                      Field("d_moy", I32)],
+        aggs=[("sum_sales", agg("Sum", fcol("cs_sales_price", F64), F64),
+               Field("sum_sales", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {"mode": "hash", "num_partitions": 4,
+                                "expressions": [fcol("i_brand", STR),
+                                                fcol("d_year", I32)]}})
+    win_out = Schema((Field("i_brand", STR), Field("d_year", I32),
+                      Field("d_moy", I32), Field("sum_sales", F64),
+                      Field("rn", I32)))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "rn", "fn": "row_number", "args": [],
+                    "agg": None, "dtype": I32}],
+               "partition_spec": [fcol("i_brand", STR),
+                                  fcol("d_year", I32)],
+               "order_spec": [so(fcol("sum_sales", F64), asc=False),
+                              so(fcol("d_moy", I32))]})
+    top = ffilter(win, fcall("EqualTo", fcol("rn", I32), flit(1)))
+    return take_ordered(
+        top,
+        orders=[so(fcol("i_brand", STR)), so(fcol("d_year", I32))],
+        limit=200,
+        project=[fcol("i_brand", STR), fcol("d_year", I32),
+                 fcol("d_moy", I32), fcol("sum_sales", F64)],
+        out=Schema((Field("i_brand", STR), Field("d_year", I32),
+                    Field("d_moy", I32), Field("sum_sales", F64))))
+
+
+@_q("q60b")
+def q60b(cat: Catalog) -> ForeignNode:
+    """q60 family: category-filtered item revenue across channels."""
+    def channel(table, item_col, price_col):
+        sc = cat.scan(table, [item_col, price_col])
+        it = cat.scan("item", ["i_item_sk", "i_item_id", "i_category"])
+        it = ffilter(it, fcall("In", fcol("i_category", STR),
+                               flit("Music"), flit("Books"),
+                               flit("Sports")))
+        j = bhj(sc, it, fcol(item_col, I64), fcol("i_item_sk", I64))
+        return fproject(
+            j, [fcol("i_item_id", STR),
+                falias(fcol(price_col, F64), "ext_price")],
+            Schema((Field("i_item_id", STR), Field("ext_price", F64))))
+    un = ForeignNode(
+        "UnionExec",
+        children=(channel("store_sales", "ss_item_sk",
+                          "ss_ext_sales_price"),
+                  channel("catalog_sales", "cs_item_sk",
+                          "cs_ext_sales_price"),
+                  channel("web_sales", "ws_item_sk",
+                          "ws_ext_sales_price")),
+        output=Schema((Field("i_item_id", STR), Field("ext_price", F64))))
+    grouped = two_phase_agg(
+        un, grouping=[fcol("i_item_id", STR)],
+        group_fields=[Field("i_item_id", STR)],
+        aggs=[("total", agg("Sum", fcol("ext_price", F64), F64),
+               Field("total", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("total", F64), asc=False),
+                         so(fcol("i_item_id", STR))], limit=100,
+        project=[fcol("i_item_id", STR), fcol("total", F64)],
+        out=Schema((Field("i_item_id", STR), Field("total", F64))))
+
+
+@_q("q63w")
+def q63w(cat: Catalog) -> ForeignNode:
+    """q63 family: manager monthly sales vs their overall monthly average
+    (window whole-partition average + comparison filter)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_manager_id"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2, grouping=[fcol("i_manager_id", I32), fcol("d_moy", I32)],
+        group_fields=[Field("i_manager_id", I32), Field("d_moy", I32)],
+        aggs=[("sum_sales", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("sum_sales", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": 4,
+            "expressions": [fcol("i_manager_id", I32)]}})
+    win_out = Schema((Field("i_manager_id", I32), Field("d_moy", I32),
+                      Field("sum_sales", F64), Field("avg_monthly", F64)))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "avg_monthly", "fn": "agg", "args": [],
+                    "agg": agg("Average", fcol("sum_sales", F64), F64),
+                    "dtype": F64}],
+               "partition_spec": [fcol("i_manager_id", I32)],
+               "order_spec": []})
+    above = ffilter(win, fcall("GreaterThan", fcol("sum_sales", F64),
+                               fcol("avg_monthly", F64)))
+    return take_ordered(
+        above,
+        orders=[so(fcol("i_manager_id", I32)), so(fcol("d_moy", I32))],
+        limit=200,
+        project=[fcol("i_manager_id", I32), fcol("d_moy", I32),
+                 fcol("sum_sales", F64), fcol("avg_monthly", F64)],
+        out=win_out)
+
+
+@_q("q69a")
+def q69a(cat: Catalog) -> ForeignNode:
+    """q69 family: store customers who never bought online, by state
+    (semi + anti join chain)."""
+    cu = cat.scan("customer", ["c_customer_sk", "c_current_addr_sk"])
+    ss = cat.scan("store_sales", ["ss_customer_sk"])
+    ws = cat.scan("web_sales", ["ws_bill_customer_sk"])
+    in_store = smj(cu, ss, [fcol("c_customer_sk", I64)],
+                   [fcol("ss_customer_sk", I64)], join_type="LeftSemi")
+    not_web = smj(in_store, ws, [fcol("c_customer_sk", I64)],
+                  [fcol("ws_bill_customer_sk", I64)],
+                  join_type="LeftAnti")
+    caddr = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    j = bhj(not_web, caddr, fcol("c_current_addr_sk", I64),
+            fcol("ca_address_sk", I64))
+    grouped = two_phase_agg(
+        j, grouping=[fcol("ca_state", STR)],
+        group_fields=[Field("ca_state", STR)],
+        aggs=[("cnt", agg("Count", fcol("c_customer_sk", I64), I64),
+               Field("cnt", I64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("ca_state", STR))], limit=100,
+        project=[fcol("ca_state", STR), fcol("cnt", I64)],
+        out=Schema((Field("ca_state", STR), Field("cnt", I64))))
+
+
+@_q("q76u")
+def q76u(cat: Catalog) -> ForeignNode:
+    """q76 family: channel-tagged union with per-channel counts by
+    category (literal channel columns)."""
+    def channel(tag, table, item_col, price_col):
+        sc = cat.scan(table, [item_col, price_col])
+        it = cat.scan("item", ["i_item_sk", "i_category"])
+        j = bhj(sc, it, fcol(item_col, I64), fcol("i_item_sk", I64))
+        return fproject(
+            j, [falias(flit(tag, STR), "channel"),
+                fcol("i_category", STR),
+                falias(fcol(price_col, F64), "ext_price")],
+            Schema((Field("channel", STR), Field("i_category", STR),
+                    Field("ext_price", F64))))
+    un = ForeignNode(
+        "UnionExec",
+        children=(channel("store", "store_sales", "ss_item_sk",
+                          "ss_ext_sales_price"),
+                  channel("catalog", "catalog_sales", "cs_item_sk",
+                          "cs_ext_sales_price"),
+                  channel("web", "web_sales", "ws_item_sk",
+                          "ws_ext_sales_price")),
+        output=Schema((Field("channel", STR), Field("i_category", STR),
+                       Field("ext_price", F64))))
+    grouped = two_phase_agg(
+        un, grouping=[fcol("channel", STR), fcol("i_category", STR)],
+        group_fields=[Field("channel", STR), Field("i_category", STR)],
+        aggs=[("sales_cnt", agg("Count", fcol("ext_price", F64), I64),
+               Field("sales_cnt", I64)),
+              ("sales_amt", agg("Sum", fcol("ext_price", F64), F64),
+               Field("sales_amt", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("channel", STR)), so(fcol("i_category", STR))],
+        limit=100,
+        project=[fcol("channel", STR), fcol("i_category", STR),
+                 fcol("sales_cnt", I64), fcol("sales_amt", F64)],
+        out=Schema((Field("channel", STR), Field("i_category", STR),
+                    Field("sales_cnt", I64), Field("sales_amt", F64))))
+
+
+@_q("q79s")
+def q79s(cat: Catalog) -> ForeignNode:
+    """q79 family: biggest baskets per store through a store join and a
+    customer name join."""
+    ss = cat.scan("store_sales",
+                  ["ss_customer_sk", "ss_ticket_number", "ss_store_sk",
+                   "ss_net_profit"])
+    st = cat.scan("store", ["s_store_sk", "s_store_name"])
+    j1 = bhj(ss, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    grouped = two_phase_agg(
+        j1,
+        grouping=[fcol("ss_customer_sk", I64), fcol("s_store_name", STR)],
+        group_fields=[Field("ss_customer_sk", I64),
+                      Field("s_store_name", STR)],
+        aggs=[("profit", agg("Sum", fcol("ss_net_profit", F64), F64),
+               Field("profit", F64))])
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+    named = smj(grouped, cu, [fcol("ss_customer_sk", I64)],
+                [fcol("c_customer_sk", I64)])
+    return take_ordered(
+        named,
+        orders=[so(fcol("profit", F64), asc=False),
+                so(fcol("c_customer_id", STR)),
+                so(fcol("s_store_name", STR))],
+        limit=100,
+        project=[fcol("c_customer_id", STR), fcol("s_store_name", STR),
+                 fcol("profit", F64)],
+        out=Schema((Field("c_customer_id", STR),
+                    Field("s_store_name", STR), Field("profit", F64))))
+
+
+@_q("q87a")
+def q87a(cat: Catalog) -> ForeignNode:
+    """q87 family: EXCEPT via anti-join over deduplicated customers,
+    globally counted."""
+    ss = cat.scan("store_sales", ["ss_customer_sk"])
+    cs = cat.scan("catalog_sales", ["cs_bill_customer_sk"])
+    dedup = two_phase_agg(
+        ss, grouping=[fcol("ss_customer_sk", I64)],
+        group_fields=[Field("ss_customer_sk", I64)], aggs=[])
+    only_store = smj(dedup, cs, [fcol("ss_customer_sk", I64)],
+                     [fcol("cs_bill_customer_sk", I64)],
+                     join_type="LeftAnti")
+    return two_phase_agg(
+        only_store, grouping=[], group_fields=[],
+        aggs=[("n", agg("Count", fcol("ss_customer_sk", I64), I64),
+               Field("n", I64))])
+
+
+@_q("q89w")
+def q89w(cat: Catalog) -> ForeignNode:
+    """q89 family: months deviating above the category's monthly
+    average (window average + subtraction)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_category"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2, grouping=[fcol("i_category", STR), fcol("d_moy", I32)],
+        group_fields=[Field("i_category", STR), Field("d_moy", I32)],
+        aggs=[("sum_sales", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("sum_sales", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": 4,
+            "expressions": [fcol("i_category", STR)]}})
+    win_out = Schema((Field("i_category", STR), Field("d_moy", I32),
+                      Field("sum_sales", F64), Field("avg_sales", F64)))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "avg_sales", "fn": "agg", "args": [],
+                    "agg": agg("Average", fcol("sum_sales", F64), F64),
+                    "dtype": F64}],
+               "partition_spec": [fcol("i_category", STR)],
+               "order_spec": []})
+    dev = fproject(
+        win, [fcol("i_category", STR), fcol("d_moy", I32),
+              fcol("sum_sales", F64), fcol("avg_sales", F64),
+              falias(fcall("Subtract", fcol("sum_sales", F64),
+                           fcol("avg_sales", F64), dtype=F64), "dev")],
+        Schema(tuple(win_out.fields) + (Field("dev", F64),)))
+    up = ffilter(dev, fcall("GreaterThan", fcol("dev", F64), flit(0.0)))
+    return take_ordered(
+        up,
+        orders=[so(fcol("dev", F64), asc=False),
+                so(fcol("i_category", STR)), so(fcol("d_moy", I32))],
+        limit=100,
+        project=[fcol("i_category", STR), fcol("d_moy", I32),
+                 fcol("sum_sales", F64), fcol("dev", F64)],
+        out=Schema((Field("i_category", STR), Field("d_moy", I32),
+                    Field("sum_sales", F64), Field("dev", F64))))
+
+
+@_q("q92f")
+def q92f(cat: Catalog) -> ForeignNode:
+    """q92 family: sales beating 1.3x their item's average price
+    (aggregate self-join)."""
+    ws = cat.scan("web_sales", ["ws_item_sk", "ws_ext_sales_price"])
+    avg_by_item = two_phase_agg(
+        cat.scan("web_sales", ["ws_item_sk", "ws_ext_sales_price"]),
+        grouping=[falias(fcol("ws_item_sk", I64), "avg_item_sk")],
+        group_fields=[Field("avg_item_sk", I64)],
+        aggs=[("avg_price", agg("Average", fcol("ws_ext_sales_price",
+                                                F64), F64),
+               Field("avg_price", F64))])
+    j = bhj(ws, avg_by_item, fcol("ws_item_sk", I64),
+            fcol("avg_item_sk", I64))
+    hot = ffilter(
+        j, fcall("GreaterThan", fcol("ws_ext_sales_price", F64),
+                 fcall("Multiply", flit(1.3), fcol("avg_price", F64),
+                       dtype=F64)))
+    return two_phase_agg(
+        hot, grouping=[], group_fields=[],
+        aggs=[("excess_rev", agg("Sum", fcol("ws_ext_sales_price", F64),
+                                 F64), Field("excess_rev", F64)),
+              ("n", agg("Count", fcol("ws_ext_sales_price", F64), I64),
+               Field("n", I64))])
+
+
+@_q("q93s")
+def q93s(cat: Catalog) -> ForeignNode:
+    """q93 family: actual revenue net of returns via LEFT OUTER join +
+    CASE (returned rows subtract their refund)."""
+    ss = cat.scan("store_sales",
+                  ["ss_ticket_number", "ss_item_sk", "ss_customer_sk",
+                   "ss_ext_sales_price"])
+    sr = cat.scan("store_returns",
+                  ["sr_ticket_number", "sr_item_sk", "sr_return_amt"])
+    j = smj(ss, sr,
+            [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+            [fcol("sr_ticket_number", I64), fcol("sr_item_sk", I64)],
+            join_type="LeftOuter")
+    act = fproject(
+        j, [fcol("ss_customer_sk", I64),
+            falias(fcall("CaseWhen",
+                         fcall("IsNotNull", fcol("sr_return_amt", F64)),
+                         fcall("Subtract", fcol("ss_ext_sales_price",
+                                                F64),
+                               fcol("sr_return_amt", F64), dtype=F64),
+                         fcol("ss_ext_sales_price", F64), dtype=F64),
+                   "act_sales")],
+        Schema((Field("ss_customer_sk", I64), Field("act_sales", F64))))
+    grouped = two_phase_agg(
+        act, grouping=[fcol("ss_customer_sk", I64)],
+        group_fields=[Field("ss_customer_sk", I64)],
+        aggs=[("sumsales", agg("Sum", fcol("act_sales", F64), F64),
+               Field("sumsales", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("sumsales", F64), asc=False),
+                so(fcol("ss_customer_sk", I64))],
+        limit=100,
+        project=[fcol("ss_customer_sk", I64), fcol("sumsales", F64)],
+        out=Schema((Field("ss_customer_sk", I64),
+                    Field("sumsales", F64))))
+
+
+@_q("q36r")
+def q36r(cat: Catalog) -> ForeignNode:
+    """q36 family: gross-margin rollup over (category, class) with the
+    ratio computed post-aggregation."""
+    ss = cat.scan("store_sales",
+                  ["ss_item_sk", "ss_ext_sales_price", "ss_net_profit"])
+    it = cat.scan("item", ["i_item_sk", "i_category", "i_class"])
+    j = bhj(ss, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    pre = fproject(
+        j, [fcol("i_category", STR), fcol("i_class", STR),
+            fcol("ss_ext_sales_price", F64), fcol("ss_net_profit", F64)],
+        Schema((Field("i_category", STR), Field("i_class", STR),
+                Field("ss_ext_sales_price", F64),
+                Field("ss_net_profit", F64))))
+    expand_out = Schema((Field("i_category", STR), Field("i_class", STR),
+                         Field("ss_ext_sales_price", F64),
+                         Field("ss_net_profit", F64),
+                         Field("spark_grouping_id", I64)))
+    expand = ForeignNode(
+        "ExpandExec", children=(pre,), output=expand_out,
+        attrs={"projections": [
+            [fcol("i_category", STR), fcol("i_class", STR),
+             fcol("ss_ext_sales_price", F64), fcol("ss_net_profit", F64),
+             flit(0, I64)],
+            [fcol("i_category", STR), flit(None, STR),
+             fcol("ss_ext_sales_price", F64), fcol("ss_net_profit", F64),
+             flit(1, I64)],
+            [flit(None, STR), flit(None, STR),
+             fcol("ss_ext_sales_price", F64), fcol("ss_net_profit", F64),
+             flit(3, I64)]]})
+    grouped = two_phase_agg(
+        expand,
+        grouping=[fcol("i_category", STR), fcol("i_class", STR),
+                  fcol("spark_grouping_id", I64)],
+        group_fields=[Field("i_category", STR), Field("i_class", STR),
+                      Field("spark_grouping_id", I64)],
+        aggs=[("profit", agg("Sum", fcol("ss_net_profit", F64), F64),
+               Field("profit", F64)),
+              ("rev", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("rev", F64))])
+    margined = fproject(
+        grouped,
+        [fcol("i_category", STR), fcol("i_class", STR),
+         fcol("spark_grouping_id", I64),
+         falias(fcall("Divide", fcol("profit", F64), fcol("rev", F64),
+                      dtype=F64), "gross_margin")],
+        Schema((Field("i_category", STR), Field("i_class", STR),
+                Field("spark_grouping_id", I64),
+                Field("gross_margin", F64))))
+    return take_ordered(
+        margined,
+        orders=[so(fcol("spark_grouping_id", I64)),
+                so(fcol("gross_margin", F64)),
+                so(fcol("i_category", STR), nulls_first=True),
+                so(fcol("i_class", STR), nulls_first=True)],
+        limit=100,
+        project=[fcol("i_category", STR), fcol("i_class", STR),
+                 fcol("spark_grouping_id", I64),
+                 fcol("gross_margin", F64)],
+        out=Schema((Field("i_category", STR), Field("i_class", STR),
+                    Field("spark_grouping_id", I64),
+                    Field("gross_margin", F64))))

@@ -33,6 +33,8 @@ class QueryResult:
     plan_error: Optional[str] = None
     skipped: Optional[str] = None   # exclusion reason
     spmd: bool = False              # ran as one shard_map mesh program
+    native_warm_s: Optional[float] = None   # second (post-compile) run
+    perf_error: Optional[str] = None
 
     def to_dict(self) -> Dict:
         return {"name": self.name, "ok": self.ok,
@@ -40,7 +42,10 @@ class QueryResult:
                 "oracle_s": round(self.oracle_s, 4), "rows": self.rows,
                 "all_native": self.all_native, "error": self.error,
                 "plan_error": self.plan_error, "skipped": self.skipped,
-                "spmd": self.spmd}
+                "spmd": self.spmd,
+                "native_warm_s": (None if self.native_warm_s is None
+                                  else round(self.native_warm_s, 4)),
+                "perf_error": self.perf_error}
 
 
 @dataclass
@@ -55,6 +60,15 @@ class QueryRunner:
     # multi-device mode: offer every query to the SPMD stage compiler
     # over this mesh first (serial fallback stays transparent)
     mesh: Optional[object] = None
+    # perf gate (QueryRunner.scala + VERDICT r1 #6): when set, a query
+    # FAILS if its warm (second, post-compile) native run exceeds
+    # perf_factor x the numpy oracle's time.  The floor keeps trivial
+    # sub-10ms oracle timings from tripping the gate on noise.
+    perf_factor: Optional[float] = None
+    # floor: per-run host orchestration (conversion, exchange tasks,
+    # arrow round trips) is ~0.5-1s regardless of scale; tiny oracle
+    # times must not turn that fixed cost into a failure
+    perf_floor_s: float = 0.1
 
     def run(self, name: str) -> QueryResult:
         if name in self.exclusions:
@@ -82,11 +96,25 @@ class QueryRunner:
             text = stability.render_plan(res.converted, res.ctx)
             plan_err = stability.check_stability(name, text,
                                                 self.golden_dir)
+        warm_s = None
+        perf_err = None
+        if diff is None and self.perf_factor is not None:
+            warm_session = AuronSession(foreign_engine=PyArrowEngine())
+            t0 = time.perf_counter()
+            warm_session.execute(plan, mesh=self.mesh)
+            warm_s = time.perf_counter() - t0
+            budget = self.perf_factor * max(oracle_s, self.perf_floor_s)
+            if warm_s > budget:
+                perf_err = (f"warm native {warm_s:.3f}s > "
+                            f"{self.perf_factor:g}x oracle "
+                            f"{oracle_s:.3f}s")
         qr = QueryResult(
-            name=name, ok=diff is None and plan_err is None,
+            name=name,
+            ok=diff is None and plan_err is None and perf_err is None,
             native_s=native_s, oracle_s=oracle_s,
             rows=res.table.num_rows, all_native=res.all_native(),
-            error=diff, plan_error=plan_err, spmd=res.spmd)
+            error=diff, plan_error=plan_err, spmd=res.spmd,
+            native_warm_s=warm_s, perf_error=perf_err)
         self.results.append(qr)
         return qr
 
@@ -111,6 +139,8 @@ class QueryRunner:
                 lines.append(f"         diff: {r.error}")
             if r.plan_error:
                 lines.append(f"         plan: {r.plan_error.splitlines()[0]}")
+            if r.perf_error:
+                lines.append(f"         perf: {r.perf_error}")
         n_ok = sum(1 for r in self.results if r.ok)
         lines.append(f"{n_ok}/{len(self.results)} passed")
         return "\n".join(lines)

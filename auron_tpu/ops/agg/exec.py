@@ -470,19 +470,69 @@ class AggExec(Operator, MemConsumer):
         self.update_mem_used(0)
 
     def _merge_spilled(self) -> Iterator[Batch]:
-        entries_cols: List[List[Any]] = []
-        entries_ns: List[Any] = []
-        cap = 0
-        for s in self._spills.spills:
-            for rb in s.read_batches():
-                b = Batch.from_arrow(rb, schema=self._state_schema())
-                entries_cols.append(list(b.columns))
-                entries_ns.append(jnp.asarray(b.num_rows, jnp.int32))
-                cap += b.capacity
-        out_cols, n_dev = self._merge_staged_kernel()(entries_cols,
-                                                      entries_ns)
-        acc = Batch(self._state_schema(), out_cols, n_dev, cap)
-        yield acc if self.exec_mode == "partial" else self._finalize(acc)
+        """Bounded k-way merge of spilled grouped runs (the LevelSpill /
+        bucket-merge analogue, agg_table.rs:323-592): runs are key-sorted
+        with one row per group, so the sort-spill merger yields globally
+        key-sorted state rows; each merged batch is merge-reduced and only
+        the LAST group is held back (it alone can continue into the next
+        batch) — resident memory is one merged batch, not every run."""
+        from auron_tpu.ops.kernel_cache import host_sync
+        nk = len(self.grouping)
+        if nk == 0:
+            # global agg: one state row per run — concat is already bounded
+            entries_cols: List[List[Any]] = []
+            entries_ns: List[Any] = []
+            cap = 0
+            for s in self._spills.spills:
+                for rb in s.read_batches():
+                    b = Batch.from_arrow(rb, schema=self._state_schema())
+                    entries_cols.append(list(b.columns))
+                    entries_ns.append(jnp.asarray(b.num_rows, jnp.int32))
+                    cap += b.capacity
+            out_cols, n_dev = self._merge_staged_kernel()(entries_cols,
+                                                          entries_ns)
+            acc = Batch(self._state_schema(), out_cols, n_dev, cap)
+            yield acc if self.exec_mode == "partial" else self._finalize(acc)
+            return
+        from auron_tpu.ir.expr import SortExpr, col as col_ref
+        from auron_tpu.ops.sort import HostKeyMerger
+        state_schema = self._state_schema()
+        merger = HostKeyMerger(state_schema, tuple(
+            SortExpr(child=col_ref(f.name))
+            for f in state_schema.fields[:nk]))
+        runs = [s.read_batches() for s in self._spills.spills]
+        carry: Optional[Tuple[List[Any], Any, int]] = None
+        for mb in merger.merge(runs):
+            keys = list(mb.columns[:nk])
+            states = list(mb.columns[nk:])
+            vcols: List[List[Any]] = []
+            off = 0
+            for spec in self.specs:
+                k = len(spec.state_fields())
+                vcols.append(states[off:off + k])
+                off += k
+            out_cols, n_dev = self._reduce(keys, vcols, mb.row_mask(),
+                                           merge=True)
+            cap = mb.capacity
+            if carry is not None:
+                out_cols, n_dev = self._merge_staged_kernel()(
+                    [carry[0], out_cols], [carry[1], n_dev])
+                cap += carry[2]
+            n = int(host_sync(n_dev))
+            if n == 0:
+                continue
+            if n > 1:
+                done = Batch(state_schema, out_cols, n - 1, cap)
+                yield done if self.exec_mode == "partial" \
+                    else self._finalize(done)
+            last_cap = bucket_capacity(1)
+            last = Batch(state_schema, out_cols, n, cap).gather(
+                jnp.full(last_cap, n - 1, jnp.int32), 1, last_cap)
+            carry = (list(last.columns), jnp.asarray(1, jnp.int32),
+                     last_cap)
+        if carry is not None:
+            acc = Batch(state_schema, carry[0], 1, carry[2])
+            yield acc if self.exec_mode == "partial" else self._finalize(acc)
 
     def _finalize(self, acc: Batch) -> Batch:
         nk = len(self.grouping)

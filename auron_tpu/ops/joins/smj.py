@@ -55,8 +55,11 @@ def _f64_orderable(x: float) -> int:
 
 
 def _orderable(v: Any) -> Any:
+    import decimal as _dec
     if isinstance(v, (bool, np.bool_)):
         return int(v)
+    if isinstance(v, _dec.Decimal):
+        return v          # Decimals compare exactly among themselves
     if isinstance(v, (float, np.floating)):
         return _f64_orderable(float(v))
     if isinstance(v, (bytes, str)):

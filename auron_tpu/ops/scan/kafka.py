@@ -45,6 +45,14 @@ class KafkaScanExec(Operator):
                 yield r if isinstance(r, (bytes, bytearray)) else \
                     str(r).encode("utf-8")
             return
+        if self.bootstrap_servers:
+            # real consumer: the wire-protocol client (Metadata/
+            # ListOffsets/Fetch v4, record batch v2) — the rdkafka
+            # analogue, kafka_scan_exec.rs:81
+            from auron_tpu.streaming.kafka_client import KafkaWireConsumer
+            consumer = KafkaWireConsumer(self.bootstrap_servers, self.topic)
+            yield from consumer(self.assignment)
+            return
         raise RuntimeError(
             f"no kafka consumer registered for topic {self.topic!r}; "
             f"register a record source under resource {key!r}")

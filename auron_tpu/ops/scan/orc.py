@@ -35,9 +35,10 @@ class OrcScanExec(Operator):
         if ctx.partition_id >= len(self.file_groups):
             return  # extra partitions are empty
         gi = ctx.partition_id
+        from auron_tpu.ops.scan.parquet import _open_for_read
         for path in self.file_groups[gi].paths:
             try:
-                f = orc.ORCFile(path)
+                f = orc.ORCFile(_open_for_read(path))
             except Exception:
                 if conf.get("auron.ignore.corrupted.files"):
                     continue
@@ -96,12 +97,18 @@ class OrcSinkExec(Operator):
                 parts.setdefault(key, []).append(part)
         rows = []
         for key, batches in parts.items():
+            from auron_tpu.formats import fs as FS
             d = os.path.join(self.output_dir, *key)
-            os.makedirs(d, exist_ok=True)
+            FS.makedirs(d)
             path = os.path.join(d, f"part-{ctx.partition_id:05d}.orc")
             tbl = pa.Table.from_batches(batches)
-            orc.write_table(tbl, path,
-                            compression=_orc_codec(self.compression))
+            if FS.is_remote(path):
+                with FS.open_output(path) as f:
+                    orc.write_table(tbl, f,
+                                    compression=_orc_codec(self.compression))
+            else:
+                orc.write_table(tbl, path,
+                                compression=_orc_codec(self.compression))
             rows.append({"path": path, "rows": tbl.num_rows})
         if rows:
             yield Batch.from_arrow(pa.Table.from_pylist(

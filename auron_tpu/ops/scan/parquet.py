@@ -24,6 +24,16 @@ from auron_tpu.ops.base import Operator, TaskContext, batch_size
 from auron_tpu.ops.scan.pushdown import expr_to_arrow_filter
 
 
+def _open_for_read(path: str):
+    """Local paths go straight to pyarrow; scheme-qualified paths
+    (gs://, hdfs://, memory://, ...) resolve through the FS bridge
+    (formats/fs.py — the hadoop_fs.rs Fs/FsProvider analogue)."""
+    from auron_tpu.formats import fs
+    if fs.is_remote(path):
+        return fs.open_input(path)
+    return path
+
+
 class ParquetScanExec(Operator):
     def __init__(self, schema: Schema, file_groups: Tuple[FileGroup, ...],
                  projection: Tuple[int, ...] = (), predicate=None,
@@ -63,7 +73,7 @@ class ParquetScanExec(Operator):
             filt = expr_to_arrow_filter(self.predicate, self.file_schema)
         for path in group.paths:
             try:
-                pf = pq.ParquetFile(path)
+                pf = pq.ParquetFile(_open_for_read(path))
             except Exception:
                 if conf.get("auron.ignore.corrupted.files"):
                     continue
@@ -125,7 +135,8 @@ class ParquetSinkExec(Operator):
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
         import os
         import pyarrow.parquet as pqm
-        os.makedirs(self.output_dir, exist_ok=True)
+        from auron_tpu.formats import fs as FS
+        FS.makedirs(self.output_dir)
         child_schema = self.children[0].schema
         writers = {}
         counts = {}
@@ -138,10 +149,12 @@ class ParquetSinkExec(Operator):
                     w = writers.get(key)
                     if w is None:
                         d = os.path.join(self.output_dir, *key)
-                        os.makedirs(d, exist_ok=True)
+                        FS.makedirs(d)
                         path = os.path.join(
                             d, f"part-{ctx.partition_id:05d}.parquet")
-                        w = pqm.ParquetWriter(path, part.schema,
+                        sink = FS.open_output(path) if FS.is_remote(path) \
+                            else path
+                        w = pqm.ParquetWriter(sink, part.schema,
                                               compression=self.compression)
                         writers[key] = (w, path)
                         counts[key] = 0

@@ -303,7 +303,32 @@ def _np_encode_key(hv, asc: bool, nulls_first: bool) -> List[np.ndarray]:
         neg = (bits & np.uint64(1 << 63)) != 0
         words = [np.where(neg, ~bits, bits ^ np.uint64(1 << 63))]
     elif dt.id == TypeId.BOOL:
-        words = [hv.vals.astype(np.uint64)]
+        words = [hv.vals.astype(np.uint32)]
+    elif dt.id == TypeId.DECIMAL:
+        # hv.vals already hold the UNSCALED integer (arrow_to_hv).
+        # p<=18: one u64 word, bit-identical to the device encoding so
+        # device-sorted runs and host merges/bounds stay aligned;
+        # p>18 (host-resident): 128-bit two's complement as two words
+        # (|unscaled| < 10^38 < 2^127, so no wrap).
+        his = np.zeros(n, np.uint64)
+        los = np.zeros(n, np.uint64)
+        for i, (v, m) in enumerate(zip(hv.vals, hv.mask)):
+            if not m or v is None:
+                continue
+            u = int(v) & ((1 << 128) - 1)
+            his[i] = u >> 64
+            los[i] = u & ((1 << 64) - 1)
+        if dt.precision <= 18:
+            words = [los ^ np.uint64(1 << 63)]
+        else:
+            words = [his ^ np.uint64(1 << 63), los]
+    elif dt.id in (TypeId.INT8, TypeId.INT16, TypeId.INT32,
+                   TypeId.DATE32):
+        # u32 mirror of the device narrow-int encoding (sort_keys.py):
+        # same VALUES, so device-sorted runs, host merges, and range
+        # bounds all promote consistently
+        words = [hv.vals.astype(np.int32).view(np.uint32)
+                 ^ np.uint32(1 << 31)]
     else:
         words = [hv.vals.astype(np.int64).view(np.uint64)
                  ^ np.uint64(1 << 63)]

@@ -28,6 +28,7 @@ from auron_tpu.exprs.compiler import build_evaluator
 from auron_tpu.exprs.typing import infer_type
 from auron_tpu.ir.plan import WindowFuncCall, WindowGroupLimit
 from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.memmgr import MemConsumer, SpillManager
 from auron_tpu.ops import segments
 from auron_tpu.ops.base import Operator, TaskContext, batch_size, compact_indices
 from auron_tpu.ops.sort_keys import (
@@ -35,7 +36,7 @@ from auron_tpu.ops.sort_keys import (
 )
 
 
-class WindowExec(Operator):
+class WindowExec(Operator, MemConsumer):
     def __init__(self, child: Operator, window_funcs: Tuple[WindowFuncCall, ...],
                  partition_by, order_by, group_limit: Optional[WindowGroupLimit]
                  = None, output_window_cols: bool = True):
@@ -51,6 +52,10 @@ class WindowExec(Operator):
                 dt = wf.return_type or _default_window_type(wf)
                 fields.append(Field(wf.name or wf.fn, dt))
         super().__init__(Schema(tuple(fields)), [child])
+        MemConsumer.__init__(self, "WindowExec")
+        self._spills = SpillManager("window")
+        self._staged: List[Batch] = []
+        self._staged_bytes = 0
         self._part_eval = build_evaluator(self.partition_by, in_schema)
         self._order_eval = build_evaluator(
             tuple(s.child for s in self.order_by), in_schema)
@@ -59,28 +64,94 @@ class WindowExec(Operator):
             for wf in self.window_funcs]
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
-        from auron_tpu.memmgr import MemConsumer, get_manager
-        consumer = MemConsumer("WindowExec", spillable=False)
+        from auron_tpu.memmgr import get_manager
         mgr = ctx.mem_manager or get_manager()
-        mgr.register_consumer(consumer)
+        mgr.register_consumer(self)
         try:
-            yield from self._execute_inner(ctx, consumer)
+            yield from self._execute_inner(ctx)
         finally:
-            mgr.unregister_consumer(consumer)
+            self._staged = []
+            self._spills.release_all()
+            mgr.unregister_consumer(self)
 
-    def _execute_inner(self, ctx: TaskContext, consumer) -> Iterator[Batch]:
-        batches = []
-        staged = 0
+    # -- spillable staging (window_exec.rs buffers per partition; here
+    #    staged input spills as (partition, order)-sorted runs and whole
+    #    partitions stream out of the run merge) -----------------------
+
+    def _sort_exprs(self):
+        from auron_tpu.ir.expr import SortExpr
+        return tuple(SortExpr(child=e) for e in self.partition_by) + \
+            tuple(self.order_by)
+
+    def spill(self) -> int:
+        # hybrid batches are fine: the sorter routes host-resident key
+        # columns through its host path, and arrow serde round-trips
+        # host columns — refusing them here would strand staged rows
+        if not self._staged:
+            return 0
+        from auron_tpu.ops.sort import SortExec
+        sorter = SortExec(self.children[0], self._sort_exprs())
+        run = sorter._sort_batch(concat_batches(self.children[0].schema,
+                                                self._staged))
+        spill = self._spills.new_spill()
+        size = spill.write_batches([run.to_arrow()])
+        freed = self._staged_bytes
+        self._staged = []
+        self._staged_bytes = 0
+        self.metrics.add("mem_spill_count", 1)
+        self.metrics.add("mem_spill_size", size)
+        self.update_mem_used(0)
+        return freed
+
+    def _execute_inner(self, ctx: TaskContext) -> Iterator[Batch]:
         for b in self.child_stream(ctx):
             if not b.num_rows:
                 continue
-            batches.append(b)
-            staged += b.mem_bytes()
-            # accounted (non-spillable): budget pressure pushes other
-            # consumers to spill; window itself needs the full partition
-            consumer.update_mem_used(staged)
-        if not batches:
+            self._staged.append(b)
+            self._staged_bytes += b.mem_bytes()
+            self.update_mem_used(self._staged_bytes)
+        if not len(self._spills):
+            batches, self._staged = self._staged, []
+            self.update_mem_used(0)
+            if batches:
+                yield from self._process_batches(batches, ctx)
             return
+        if self._staged:
+            self.spill()
+        yield from self._merge_spilled(ctx)
+
+    def _merge_spilled(self, ctx: TaskContext) -> Iterator[Batch]:
+        """Stream (partition, order)-sorted runs through the k-way merger
+        and process COMPLETE partitions as they close — only the trailing
+        open partition stays buffered (the carry), so resident memory is
+        one merged batch plus the largest single partition."""
+        from auron_tpu.ops.joins.smj import host_keys_of_rows, split_batch
+        from auron_tpu.ops.sort import HostKeyMerger
+        merger = HostKeyMerger(self.children[0].schema, self._sort_exprs())
+        runs = [s.read_batches() for s in self._spills.spills]
+        orders = tuple((True, True) for _ in self.partition_by)
+        carry: List[Batch] = []
+        for mb in merger.merge(runs):
+            if mb.num_rows == 0:
+                continue
+            if not self.partition_by:
+                carry.append(mb)      # one global partition: no frontier
+                continue
+            pcols = self._part_eval(mb, partition_id=ctx.partition_id)
+            frontier = host_keys_of_rows(pcols, [mb.num_rows - 1])[0]
+            ready, keep = split_batch(mb, pcols, frontier, orders)
+            if ready is not None:
+                chunk = carry + [ready]
+                carry = []
+                yield from self._process_batches(chunk, ctx)
+            if keep is not None:
+                carry.append(keep)
+            self.update_mem_used(sum(b.mem_bytes() for b in carry))
+        if carry:
+            yield from self._process_batches(carry, ctx)
+
+    def _process_batches(self, batches: List[Batch],
+                         ctx: TaskContext) -> Iterator[Batch]:
         total = sum(b.num_rows for b in batches)
         cap = bucket_capacity(total)
         merged = concat_batches(self.children[0].schema, batches, cap)

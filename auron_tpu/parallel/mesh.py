@@ -34,3 +34,18 @@ def grid_mesh(parts: int, replicas: int,
         raise ValueError(f"requested {need} devices, have {len(devs)}")
     arr = np.array(devs[:need]).reshape(parts, replicas)
     return Mesh(arr, tuple(axes))
+
+
+def hierarchical_mesh(n_dcn: int, n_ici: Optional[int] = None,
+                      axes: Sequence[str] = ("dcn", "ici")) -> Mesh:
+    """Multi-slice mesh: the slow (DCN) axis outermost, fast (ICI)
+    innermost.  Exchanges over this mesh ride the two-stage hierarchical
+    all-to-all (parallel/exchange.py:hierarchical_repartition) so every
+    row crosses DCN at most once."""
+    devs = jax.devices()
+    n_ici = n_ici or len(devs) // n_dcn
+    need = n_dcn * n_ici
+    if need > len(devs):
+        raise ValueError(f"requested {need} devices, have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(n_dcn, n_ici)
+    return Mesh(arr, tuple(axes))

@@ -49,6 +49,7 @@ from auron_tpu.ir.node import Node
 from auron_tpu.ir.schema import DataType, Field, Schema
 from auron_tpu.parallel.exchange import (
     all_to_all_repartition, broadcast_all_gather,
+    hierarchical_repartition,
 )
 
 Array = Any
@@ -77,14 +78,17 @@ class DeviceTable:
 
 class _StageTracer:
     def __init__(self, conv_ctx, bindings: Dict[str, DeviceTable],
-                 axis: str, n_dev: int,
+                 axis, n_dev: int,
                  shadow_sort: Optional[P.Sort] = None,
-                 scan_rids: Optional[Dict[int, str]] = None):
+                 scan_rids: Optional[Dict[int, str]] = None,
+                 axis_sizes: Optional[Tuple[int, ...]] = None):
         self.exchanges = getattr(conv_ctx, "exchanges", None) or {}
         self.broadcasts = getattr(conv_ctx, "broadcasts", None) or {}
         self.bindings = bindings
         self.axis = axis
         self.n_dev = n_dev
+        # multi-axis mesh (dcn, ici): sizes aligned with the axis tuple
+        self.axis_sizes = axis_sizes
         # the driver-side global sort that re-orders (and re-limits) the
         # gathered result; per-partition top-k sorts it shadows are
         # dropped (the TakeOrderedAndProject pattern: partition top-k ->
@@ -96,6 +100,16 @@ class _StageTracer:
         # cannot express); the driver fetches them with the output and
         # falls back to the serial engine when any is set
         self.guards: List[Any] = []
+
+    def _axis_index(self):
+        """Global device id; for a (dcn, ici) mesh the layout is
+        dcn_rank * n_ici + ici_rank (hierarchical_repartition contract)."""
+        if isinstance(self.axis, tuple):
+            a_dcn, a_ici = self.axis
+            n_ici = self.axis_sizes[1]
+            return (lax.axis_index(a_dcn) * n_ici +
+                    lax.axis_index(a_ici)).astype(jnp.int32)
+        return lax.axis_index(self.axis)
 
     # -- expression eval -------------------------------------------------
 
@@ -109,7 +123,7 @@ class _StageTracer:
         ctx = EvalCtx(cols=list(t.cols), schema=t.schema,
                       num_rows=jnp.sum(t.live.astype(jnp.int32)),
                       capacity=t.capacity,
-                      partition_id=lax.axis_index(self.axis),
+                      partition_id=self._axis_index(),
                       row_base=jnp.int64(0))
         return [evaluate(x, ctx) for x in exprs]
 
@@ -164,22 +178,37 @@ class _StageTracer:
             h = H.hash_columns(keys, seed=42)
             pid = H.pmod(h, n_dev).astype(jnp.int32)
         elif part.mode == "round_robin":
-            base = lax.axis_index(self.axis).astype(jnp.int32)
+            base = self._axis_index().astype(jnp.int32)
             pid = (base + jnp.arange(t.capacity, dtype=jnp.int32)) % n_dev
         elif part.mode == "single":
             pid = jnp.zeros(t.capacity, jnp.int32)
         else:
             raise SpmdUnsupported(f"partitioning mode {part.mode!r}")
         flat, treedef = jax.tree.flatten(t.cols)
-        outs, live = all_to_all_repartition(flat, pid, t.live, self.axis,
-                                            n_dev, quota=t.capacity)
+        if isinstance(self.axis, tuple):
+            # 2-D (dcn, ici) mesh: two-stage exchange so every row crosses
+            # the slow DCN axis at most once (SURVEY 2.5 comm-backend row)
+            a_dcn, a_ici = self.axis
+            n_dcn, n_ici = self.axis_sizes
+            outs, live = hierarchical_repartition(
+                flat, pid, t.live, a_ici, a_dcn, n_ici, n_dcn,
+                quota=t.capacity)
+        else:
+            outs, live = all_to_all_repartition(flat, pid, t.live,
+                                                self.axis, n_dev,
+                                                quota=t.capacity)
         cols = jax.tree.unflatten(treedef, outs)
         return DeviceTable(t.schema, cols, live)
 
     def _broadcast(self, t: DeviceTable) -> DeviceTable:
         flat, treedef = jax.tree.flatten(t.cols)
-        outs, live = broadcast_all_gather(flat, t.live, self.axis)
-        cols = jax.tree.unflatten(treedef, outs)
+        if isinstance(self.axis, tuple):
+            live = t.live
+            for ax in reversed(self.axis):    # gather ICI first, then DCN
+                flat, live = broadcast_all_gather(flat, live, ax)
+        else:
+            flat, live = broadcast_all_gather(flat, t.live, self.axis)
+        cols = jax.tree.unflatten(treedef, flat)
         return DeviceTable(t.schema, cols, live)
 
     # row ops -----------------------------------------------------------------
@@ -370,7 +399,8 @@ def _shard_table(table, mesh: Mesh, axis: str) -> Tuple[Schema, List[Any],
     of shape [n_dev*cap] (sharded along the axis) + live mask."""
     import pyarrow as pa
     from auron_tpu.ir.schema import from_arrow_schema
-    n_dev = mesh.shape[axis]
+    n_dev = int(np.prod([mesh.shape[a] for a in axis])) \
+        if isinstance(axis, tuple) else mesh.shape[axis]
     n = table.num_rows
     per_dev = -(-max(n, 1) // n_dev)
     cap = bucket_capacity(per_dev)
@@ -427,7 +457,12 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     import pyarrow as pa
     from auron_tpu.ir.schema import to_arrow_schema
 
-    n_dev = mesh.shape[axis]
+    if isinstance(axis, tuple):
+        axis_sizes = tuple(mesh.shape[a] for a in axis)
+        n_dev = int(np.prod(axis_sizes))
+    else:
+        axis_sizes = None
+        n_dev = mesh.shape[axis]
     exchanges = getattr(conv_ctx, "exchanges", None) or {}
 
     # 1. peel the driver-side tail: a root chain of single-partition ops
@@ -496,7 +531,8 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                 for rid, (cols, live) in bindings_flat.items()}
             tracer = _StageTracer(conv_ctx, bindings, axis, n_dev,
                                   shadow_sort=shadow_sort,
-                                  scan_rids=scan_rids)
+                                  scan_rids=scan_rids,
+                                  axis_sizes=axis_sizes)
             out = tracer.eval_node(plan)
             if not schema_box:
                 schema_box.append(out.schema)

@@ -1,0 +1,11 @@
+"""Out-of-process engine boundary.
+
+The socket analogue of the reference's JNI surface (JniBridge.java:49-55,
+AuronCallNativeWrapper.java:78-183): a foreign host process drives native
+execution by shipping serialized TaskDefinitions and Arrow resources over
+a framed TCP channel and pulling Arrow batches back.
+"""
+
+from auron_tpu.service.engine import EngineClient, EngineServer, serve
+
+__all__ = ["EngineClient", "EngineServer", "serve"]

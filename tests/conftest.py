@@ -23,6 +23,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# persistent XLA executable cache: the sf>=0.1 TPC-DS corpus compiles
+# hundreds of kernels; caching them across test processes/CI rounds turns
+# ~25s cold queries into ~1s warm ones (first run after a kernel-shape
+# change still pays)
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                            "/tmp/auron_jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    # the engine's kernels are many SMALL programs (~80ms compiles);
+    # a nonzero threshold caches none of them
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:  # older jax without the knobs: compile cold
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

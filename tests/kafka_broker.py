@@ -1,0 +1,177 @@
+"""In-process Kafka broker speaking the real wire protocol (Metadata v1,
+ListOffsets v1, Fetch v4) over TCP — the test peer for the wire-protocol
+consumer, playing the role a containerized broker plays in the
+reference's kafka workflow CI."""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from auron_tpu.streaming.kafka_client import (
+    API_FETCH, API_LIST_OFFSETS, API_METADATA, _Reader, _Writer,
+    encode_record_batch,
+)
+
+# topic -> partition -> list of (timestamp_delta, key, value)
+TopicData = Dict[str, Dict[int, List[Tuple[int, Optional[bytes],
+                                           Optional[bytes]]]]]
+
+
+class MockKafkaBroker:
+    def __init__(self, data: TopicData, codec_id: int = 0,
+                 batch_rows: int = 3):
+        self.data = data
+        self.codec_id = codec_id
+        self.batch_rows = batch_rows
+        broker = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        raw = broker._recv_frame(self.request)
+                        resp = broker._dispatch(raw)
+                        self.request.sendall(
+                            struct.pack(">i", len(resp)) + resp)
+                except (ConnectionError, EOFError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(("127.0.0.1", 0), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "MockKafkaBroker":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @staticmethod
+    def _recv_frame(sock) -> bytes:
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = sock.recv(4 - len(hdr))
+            if not chunk:
+                raise EOFError
+            hdr += chunk
+        (n,) = struct.unpack(">i", hdr)
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError
+            buf += chunk
+        return bytes(buf)
+
+    def _dispatch(self, frame: bytes) -> bytes:
+        r = _Reader(frame)
+        api_key = r.i16()
+        api_version = r.i16()
+        corr = r.i32()
+        r.string()              # client id
+        body = frame[r.o:]
+        w = _Writer()
+        w.i32(corr)
+        if api_key == API_METADATA:
+            self._metadata(_Reader(body), w)
+        elif api_key == API_LIST_OFFSETS:
+            self._list_offsets(_Reader(body), w)
+        elif api_key == API_FETCH:
+            self._fetch(_Reader(body), w, api_version)
+        else:
+            raise ValueError(f"mock broker: api {api_key} unsupported")
+        return bytes(w.b)
+
+    def _metadata(self, r: _Reader, w: _Writer) -> None:
+        n = r.i32()
+        topics = [r.string() for _ in range(n)] if n >= 0 else \
+            list(self.data)
+        host, port = self._server.server_address[:2]
+        w.i32(1)                # brokers
+        w.i32(0)                # node id
+        w.string(host)
+        w.i32(port)
+        w.string(None)          # rack
+        w.i32(0)                # controller
+        w.i32(len(topics))
+        for t in topics:
+            parts = self.data.get(t, {})
+            w.i16(0 if t in self.data else 3)   # UNKNOWN_TOPIC
+            w.string(t)
+            w.i8(0)
+            w.i32(len(parts))
+            for pid in sorted(parts):
+                w.i16(0)
+                w.i32(pid)
+                w.i32(0)        # leader = node 0
+                w.i32(0)        # replicas
+                w.i32(0)        # isr
+
+    def _list_offsets(self, r: _Reader, w: _Writer) -> None:
+        r.i32()                 # replica id
+        out = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            for _p in range(r.i32()):
+                pid = r.i32()
+                ts = r.i64()
+                n = len(self.data.get(topic, {}).get(pid, []))
+                out.append((topic, pid, 0 if ts == -2 else n))
+        w.i32(len({t for t, _, _ in out}))
+        for topic, pid, off in out:
+            w.string(topic)
+            w.i32(1)
+            w.i32(pid)
+            w.i16(0)
+            w.i64(-1)
+            w.i64(off)
+
+    def _fetch(self, r: _Reader, w: _Writer, version: int) -> None:
+        r.i32()                 # replica
+        r.i32()                 # max wait
+        r.i32()                 # min bytes
+        r.i32()                 # max bytes
+        r.i8()                  # isolation
+        reqs = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            for _p in range(r.i32()):
+                pid = r.i32()
+                off = r.i64()
+                r.i32()         # partition max bytes
+                reqs.append((topic, pid, off))
+        w.i32(0)                # throttle
+        w.i32(len({t for t, _, _ in reqs}))
+        for topic, pid, off in reqs:
+            rows = self.data.get(topic, {}).get(pid, [])
+            w.string(topic)
+            w.i32(1)
+            w.i32(pid)
+            w.i16(0)
+            w.i64(len(rows))    # high watermark
+            w.i64(len(rows))    # last stable offset
+            w.i32(0)            # aborted
+            record_set = b""
+            base = int(off)
+            while base < len(rows):
+                chunk = rows[base:base + self.batch_rows]
+                record_set += encode_record_batch(
+                    base, chunk, first_ts=1_700_000_000_000,
+                    codec_id=self.codec_id)
+                base += len(chunk)
+            w.bytes_(record_set)

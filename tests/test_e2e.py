@@ -155,6 +155,39 @@ def test_window_plan():
     assert {"rn", "rk", "dr", "lg", "rs"} <= set(got[0].keys())
 
 
+def test_window_spill_tiny_budget():
+    """Window staging must spill as sorted runs and reassemble whole
+    partitions from the run merge (VERDICT r1: window had a non-spillable
+    consumer)."""
+    from auron_tpu.config import conf
+    from auron_tpu.memmgr.manager import reset_manager
+    rng = np.random.default_rng(14)
+    rows = [{"g": int(rng.integers(0, 12)), "o": int(rng.integers(0, 50)),
+             "v": float(rng.normal())} for _ in range(4000)]
+    src, res = ffi_source(rows, chunk=256)
+    plan = P.Window(
+        child=src,
+        window_funcs=(
+            P.WindowFuncCall(fn="row_number", return_type=DataType.int64(),
+                             name="rn"),
+            P.WindowFuncCall(fn="agg",
+                             agg=AggExpr(fn="sum", children=(col("v"),),
+                                         return_type=DataType.float64()),
+                             return_type=DataType.float64(), name="rs"),
+        ),
+        partition_by=(col("g"),),
+        order_by=(SortExpr(child=col("o")),))
+    mgr = reset_manager(budget_bytes=1)
+    try:
+        with conf.scoped({"auron.memory.spill.min.trigger.bytes": 1}):
+            got = execute_plan(plan, resources=res).to_pylist()
+            assert mgr.num_spills > 0
+    finally:
+        reset_manager()
+    exp = reference_engine.run_plan(plan, res)
+    assert canon(got) == canon(exp)
+
+
 def test_window_group_limit():
     rows = [{"g": i % 4, "o": i, "v": i} for i in range(100)]
     src, res = ffi_source(rows)

@@ -1,0 +1,153 @@
+"""Out-of-process engine boundary tests (JniBridge analogue): a separate
+engine process driven over the socket with serialized plans + Arrow
+resources, mirroring how AuronCallNativeWrapper drives native execution
+(AuronCallNativeWrapper.java:78-183)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.ir import plan as P
+from auron_tpu.ir import serde as ir_serde
+from auron_tpu.ir.expr import AggExpr, col, lit
+from auron_tpu.ir.schema import from_arrow_schema
+from auron_tpu.service import EngineClient, EngineServer
+from auron_tpu.service.engine import RemoteExecutionError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_table(n=400):
+    rng = np.random.default_rng(17)
+    return pa.Table.from_pylist(
+        [{"g": int(rng.integers(0, 10)), "v": float(rng.normal())}
+         for _ in range(n)])
+
+
+def agg_plan(table, resource="T"):
+    from auron_tpu.ir import expr as E
+    from auron_tpu.ir.schema import DataType
+    src = P.FFIReader(schema=from_arrow_schema(table.schema),
+                      resource_id=resource)
+    filt = P.Filter(child=src, predicates=(
+        E.BinaryExpr(left=col("v"), op=">", right=lit(-1.0)),))
+    return P.Agg(
+        child=filt, exec_mode="single",
+        grouping=(col("g"),), grouping_names=("g",),
+        aggs=(AggExpr(fn="sum", children=(col("v"),),
+                      return_type=DataType.float64()),
+              AggExpr(fn="count", children=(col("v"),),
+                      return_type=DataType.int64())),
+        agg_names=("sv", "cv"))
+
+
+def canon(rows):
+    return sorted((r["g"], round(r["sv"], 6), r["cv"]) for r in rows)
+
+
+def expected(table):
+    rows = table.to_pylist()
+    agg = {}
+    for r in rows:
+        if r["v"] > -1.0:
+            s, c = agg.get(r["g"], (0.0, 0))
+            agg[r["g"]] = (s + r["v"], c + 1)
+    return sorted((g, round(s, 6), c) for g, (s, c) in agg.items())
+
+
+def test_engine_service_in_thread():
+    table = make_table()
+    server = EngineServer().start()
+    try:
+        host, port = server.address
+        with EngineClient(host, port) as cli:
+            assert cli.ping()
+            cli.put_arrow("T", table)
+            td = P.TaskDefinition(plan=agg_plan(table), partition_id=0,
+                                  num_partitions=1)
+            out = cli.execute(ir_serde.serialize(td))
+            assert canon(out.to_pylist()) == expected(table)
+            assert cli.last_metrics  # metrics tree ferried back
+    finally:
+        server.stop()
+
+
+def test_engine_service_error_ferry_keeps_connection():
+    table = make_table(50)
+    server = EngineServer().start()
+    try:
+        host, port = server.address
+        with EngineClient(host, port) as cli:
+            td = P.TaskDefinition(plan=agg_plan(table, resource="missing"))
+            with pytest.raises(RemoteExecutionError) as ei:
+                cli.execute(td)
+            assert ei.value.remote_traceback
+            # the channel survives a ferried failure (rt.rs:207-238)
+            assert cli.ping()
+            cli.put_arrow("T", table)
+            out = cli.execute(P.TaskDefinition(plan=agg_plan(table)))
+            assert canon(out.to_pylist()) == expected(table)
+    finally:
+        server.stop()
+
+
+def test_engine_service_resource_upcall():
+    """Mid-execution resource upcall: the engine misses a resource, asks
+    the driving host on the same channel, and the host streams it inline
+    (the JavaClasses getResource / ArrowFFIExporter flow)."""
+    table = make_table(200)
+    server = EngineServer().start()
+    try:
+        host, port = server.address
+        with EngineClient(host, port) as cli:
+            served = []
+
+            def lazy_source():
+                served.append(True)
+                return table
+
+            cli.provide("T", lazy_source)
+            out = cli.execute(P.TaskDefinition(plan=agg_plan(table)))
+            assert served, "engine never issued the upcall"
+            assert canon(out.to_pylist()) == expected(table)
+            # second execute: resource now cached server-side, no upcall
+            served.clear()
+            out = cli.execute(P.TaskDefinition(plan=agg_plan(table)))
+            assert not served
+            assert canon(out.to_pylist()) == expected(table)
+    finally:
+        server.stop()
+
+
+def test_engine_service_subprocess():
+    """A real foreign process: spawn the service, drive a plan over the
+    socket end-to-end."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "auron_tpu.service.engine", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        cwd=REPO, text=True)
+    try:
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        assert info["event"] == "listening"
+        table = make_table()
+        with EngineClient(info["host"], info["port"], timeout=900.0) as cli:
+            assert cli.ping()
+            cli.put_arrow("T", table)
+            td = P.TaskDefinition(plan=agg_plan(table))
+            out = cli.execute(ir_serde.serialize(td))
+            assert canon(out.to_pylist()) == expected(table)
+            cli.shutdown_server()
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

@@ -449,3 +449,37 @@ def test_force_shj_falls_back_to_smj_when_shj_disabled():
     assert isinstance(res.converted, P.SortMergeJoin), type(res.converted)
     assert len(res.to_pylist()) == 30
     assert res.all_native()
+
+
+def test_task_retry_model(monkeypatch):
+    """A failed partition task re-executes (auron.task.retries): the
+    scheduler-level retry the reference inherits from Spark."""
+    import auron_tpu.frontend.session as sess_mod
+    from auron_tpu.config import conf
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it.oracle import PyArrowEngine
+
+    real = sess_mod.execute_plan
+    fails = {"n": 1}
+
+    def flaky(plan, partition_id=0, num_partitions=1, resources=None):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise RuntimeError("injected transient task failure")
+        return real(plan, partition_id=partition_id,
+                    num_partitions=num_partitions, resources=resources)
+
+    monkeypatch.setattr(sess_mod, "execute_plan", flaky)
+    rows = [{"a": i, "b": float(i)} for i in range(50)]
+    plan = ForeignNode(
+        "LocalTableScanExec",
+        output=Schema((Field("a", I64), Field("b", F64))),
+        attrs={"rows": rows})
+    with conf.scoped({"auron.task.retries": 1}):
+        res = AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
+    assert res.table.num_rows == 50
+    # with retries off the same failure propagates
+    fails["n"] = 1
+    with conf.scoped({"auron.task.retries": 0}):
+        with pytest.raises(RuntimeError, match="injected"):
+            AuronSession(foreign_engine=PyArrowEngine()).execute(plan)

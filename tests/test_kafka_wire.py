@@ -1,0 +1,100 @@
+"""Wire-protocol Kafka consumer tests: a real TCP broker (mock, speaking
+the actual Kafka binary protocol) drives the client end-to-end — the
+analogue of the reference's kafka CI workflow, without a container."""
+
+import json
+
+import pytest
+
+from kafka_broker import MockKafkaBroker
+from auron_tpu.streaming.kafka_client import (
+    EARLIEST, KafkaRecord, KafkaWireClient, KafkaWireConsumer, crc32c,
+    encode_record_batch, parse_record_batches,
+)
+
+
+def rows_for(n, pid):
+    return [(i, f"k{i}".encode(), json.dumps(
+        {"id": pid * 1000 + i, "v": i * 0.5}).encode()) for i in range(n)]
+
+
+def test_crc32c_vectors():
+    # RFC 3720 test vector: 32 bytes of zeros -> 0x8A9136AA
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+def test_record_batch_roundtrip_and_truncation():
+    rows = [(i, f"k{i}".encode(), f"v{i}".encode()) for i in range(5)]
+    raw = encode_record_batch(10, rows)
+    recs = list(parse_record_batches(raw, partition=0))
+    assert [r.offset for r in recs] == [10, 11, 12, 13, 14]
+    assert recs[0].key == b"k0" and recs[4].value == b"v4"
+    # a truncated trailing batch (max_bytes cut) is ignored, not an error
+    recs2 = list(parse_record_batches(raw + raw[:20], partition=0))
+    assert len(recs2) == 5
+    # corrupted payload trips the crc check
+    bad = bytearray(raw)
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="crc32c"):
+        list(parse_record_batches(bytes(bad), partition=0))
+
+
+@pytest.mark.parametrize("codec_id", [0, 1, 4])  # none, gzip, zstd
+def test_fetch_end_to_end(codec_id):
+    broker = MockKafkaBroker(
+        {"events": {0: rows_for(7, 0), 1: rows_for(4, 1)}},
+        codec_id=codec_id).start()
+    try:
+        cli = KafkaWireClient(broker.address)
+        leaders = cli.metadata("events")
+        assert set(leaders) == {0, 1}
+        addr = leaders[0]
+        assert cli.list_offset(addr, "events", 0, EARLIEST) == 0
+        recs, hwm = cli.fetch(addr, "events", 0, offset=0)
+        assert hwm == 7 and [r.offset for r in recs] == list(range(7))
+        # offset resume: fetch from 5
+        recs2, _ = cli.fetch(addr, "events", 0, offset=5)
+        assert [r.offset for r in recs2] == [5, 6]
+        cli.close()
+    finally:
+        broker.stop()
+
+
+def test_kafka_scan_exec_wire_consumer():
+    """KafkaScanExec with bootstrap_servers set consumes through the
+    wire-protocol client and lands JSON rows as a device batch."""
+    from auron_tpu.ir.schema import DataType, Field, Schema
+    from auron_tpu.ops.base import TaskContext
+    from auron_tpu.ops.scan.kafka import KafkaScanExec
+    from auron_tpu.runtime.resources import ResourceRegistry
+
+    broker = MockKafkaBroker(
+        {"t1": {0: rows_for(6, 0), 1: rows_for(3, 1)}}).start()
+    try:
+        schema = Schema((Field("id", DataType.int64()),
+                         Field("v", DataType.float64())))
+        op = KafkaScanExec(schema, "t1", value_format="json",
+                           bootstrap_servers=broker.address)
+        ctx = TaskContext(resources=ResourceRegistry())
+        out = [b.to_arrow() for b in op.execute(ctx)]
+        rows = [r for rb in out for r in rb.to_pylist()]
+        assert len(rows) == 9
+        ids = sorted(r["id"] for r in rows)
+        assert ids == [0, 1, 2, 3, 4, 5, 1000, 1001, 1002]
+    finally:
+        broker.stop()
+
+
+def test_wire_consumer_assignment_offsets():
+    """The front-end's partition/offset assignment bounds consumption
+    (kafka_scan_exec.rs:243-247 contract)."""
+    broker = MockKafkaBroker({"t2": {0: rows_for(10, 0)}}).start()
+    try:
+        consumer = KafkaWireConsumer(broker.address, "t2")
+        vals = list(consumer({"partitions": {"0": 4},
+                              "end_offsets": {"0": 8}}))
+        ids = [json.loads(v)["id"] for v in vals]
+        assert ids == [4, 5, 6, 7]
+    finally:
+        broker.stop()

@@ -227,3 +227,57 @@ def test_spmd_join_duplicate_build_keys_guard():
     mesh = data_mesh(8)
     with pytest.raises(SpmdUnsupported, match="duplicate-key"):
         execute_plan_spmd(join, ctx, mesh, {"fact": fact, "dim": dim})
+
+
+def test_spmd_hierarchical_2d_mesh():
+    """The same planner-produced pipeline on a 2-D (dcn x ici) mesh: hash
+    exchanges ride the two-stage hierarchical all-to-all, broadcasts
+    gather ICI-first — differentially equal to the serial engine."""
+    from auron_tpu.parallel.mesh import hierarchical_mesh
+    fact = make_fact(n=3000, keys=32, seed=9)
+    dim = make_dim(keys=32)
+    fact_schema = from_arrow_schema(fact.schema)
+    dim_schema = from_arrow_schema(dim.schema)
+    src = P.FFIReader(schema=fact_schema, resource_id="fact")
+    agg1 = P.Agg(
+        child=src, exec_mode="partial", grouping=(col("key"),),
+        grouping_names=("key",),
+        aggs=(AggExpr(fn="sum", children=(col("amount"),),
+                      return_type=F64),),
+        agg_names=("s",))
+    ctx = _Ctx()
+    ctx.exchanges["ex0"] = ShuffleJob(
+        rid="ex0", child=agg1,
+        partitioning=P.Partitioning(mode="hash", num_partitions=8,
+                                    expressions=(col("key"),)),
+        schema=None)
+    ctx.broadcasts["bc0"] = BroadcastJob(
+        rid="bc0", child=P.FFIReader(schema=dim_schema, resource_id="dim"),
+        schema=None)
+    final = P.Agg(
+        child=P.IpcReader(schema=None, resource_id="ex0"),
+        exec_mode="final", grouping=(col("key"),), grouping_names=("key",),
+        aggs=(AggExpr(fn="sum", children=(col("amount"),),
+                      return_type=F64),),
+        agg_names=("s",))
+    join = P.BroadcastJoin(
+        left=final,
+        right=P.IpcReader(schema=None, resource_id="bc0"),
+        on=JoinOn(left_keys=(col("key"),), right_keys=(col("dkey"),)),
+        join_type="inner", broadcast_side="right")
+
+    mesh = hierarchical_mesh(2, 4)
+    got = execute_plan_spmd(join, ctx, mesh, {"fact": fact, "dim": dim},
+                            axis=("dcn", "ici")).to_pylist()
+
+    serial_join = P.BroadcastJoin(
+        left=P.Agg(child=agg1, exec_mode="final", grouping=(col("key"),),
+                   grouping_names=("key",),
+                   aggs=(AggExpr(fn="sum", children=(col("amount"),),
+                                 return_type=F64),),
+                   agg_names=("s",)),
+        right=P.FFIReader(schema=dim_schema, resource_id="dim"),
+        on=JoinOn(left_keys=(col("key"),), right_keys=(col("dkey"),)),
+        join_type="inner", broadcast_side="right")
+    exp = _serial_reference(serial_join, {"fact": fact, "dim": dim})
+    assert _canon(got) == _canon(exp)

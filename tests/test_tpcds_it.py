@@ -1,7 +1,10 @@
-"""TPC-DS integration tests: the full corpus at tiny scale through the
-differential QueryRunner (the in-CI equivalent of the reference's
-tpcds.yml per-query matrix, run at sf≈0.002 so the device path stays
-fast on the virtual CPU mesh)."""
+"""TPC-DS integration tests: the full corpus through the differential
+QueryRunner (the in-CI equivalent of the reference's tpcds.yml per-query
+matrix).  Single-device runs at sf>=0.1 with the perf gate armed (warm
+native must stay within 10x the numpy oracle); the mesh parametrization
+stays at tiny scale so the shard_map compiles dominate less."""
+
+import os
 
 import pytest
 
@@ -9,35 +12,53 @@ from auron_tpu.it.datagen import generate
 from auron_tpu.it.queries import names
 from auron_tpu.it.runner import QueryRunner
 
+SF = float(os.environ.get("AURON_IT_SF", "0.1"))
+
 
 @pytest.fixture(scope="module")
 def catalog(tmp_path_factory):
-    return generate(str(tmp_path_factory.mktemp("tpcds")), sf=0.002,
+    return generate(str(tmp_path_factory.mktemp("tpcds")), sf=SF,
+                    fact_chunks=4)
+
+
+@pytest.fixture(scope="module")
+def small_catalog(tmp_path_factory):
+    return generate(str(tmp_path_factory.mktemp("tpcds_small")), sf=0.002,
                     fact_chunks=3)
 
 
 @pytest.fixture(scope="module")
 def runner(catalog):
-    return QueryRunner(catalog=catalog)
+    return QueryRunner(catalog=catalog, perf_factor=10.0)
 
 
 @pytest.mark.parametrize("query", names())
 def test_tpcds_query(runner, query):
     r = runner.run(query)
     assert r.error is None, f"{query}: {r.error}"
+    assert r.perf_error is None, f"{query}: {r.perf_error}"
     assert r.all_native, f"{query} left foreign sections in the plan"
     assert r.rows > 0, f"{query} returned no rows"
 
 
 @pytest.fixture(scope="module")
-def mesh_runner(catalog):
+def mesh_runner(small_catalog):
     from auron_tpu.parallel.mesh import data_mesh
-    return QueryRunner(catalog=catalog, mesh=data_mesh(8))
+    return QueryRunner(catalog=small_catalog, mesh=data_mesh(8))
 
 
-@pytest.mark.parametrize("query", names())
+# representative mesh subset: the SPMD-compilable shapes (BHJ/agg/
+# filter/project pipelines) plus fallback exemplars for every operator
+# family the stage compiler rejects (smj, window, union, expand) — the
+# full corpus already runs single-device above; re-running all 42 on the
+# mesh only re-compiles the same fallback kernels at a second scale
+MESH_QUERIES = ["q03", "q07", "q42", "q55", "q13a", "q26a", "q48a",
+                "q19", "q65w", "q71u", "q27r", "q93s"]
+
+
+@pytest.mark.parametrize("query", MESH_QUERIES)
 def test_tpcds_query_multi_device(mesh_runner, query):
-    """Every corpus query offered to the SPMD stage compiler over the
+    """Corpus queries offered to the SPMD stage compiler over the
     8-device mesh: SPMD-compilable plans run as one shard_map program
     (collectives for the exchanges), the rest transparently fall back to
     the serial path — correctness holds either way."""
@@ -54,7 +75,7 @@ def test_some_queries_ride_the_mesh(mesh_runner):
         f"expected >=2 SPMD-executed corpus queries, got {sorted(ran)}"
 
 
-def test_plan_stability(catalog, tmp_path, monkeypatch):
+def test_plan_stability(small_catalog, tmp_path, monkeypatch):
     """Same plan converted twice renders identically (golden round-trip)."""
     from auron_tpu.it import stability
     from auron_tpu import config
@@ -66,7 +87,7 @@ def test_plan_stability(catalog, tmp_path, monkeypatch):
     # a missing golden is a hard failure, not a silent auto-create
     monkeypatch.delenv("AURON_REGEN_GOLDEN", raising=False)
     session = AuronSession(foreign_engine=PyArrowEngine())
-    res = session.execute(build("q03", catalog))
+    res = session.execute(build("q03", small_catalog))
     text = stability.render_plan(res.converted, res.ctx)
     assert stability.check_stability("q03", text, golden) is not None
     monkeypatch.setenv("AURON_REGEN_GOLDEN", "1")
@@ -74,25 +95,25 @@ def test_plan_stability(catalog, tmp_path, monkeypatch):
     monkeypatch.delenv("AURON_REGEN_GOLDEN")
     for attempt in range(2):
         session = AuronSession(foreign_engine=PyArrowEngine())
-        res = session.execute(build("q03", catalog))
+        res = session.execute(build("q03", small_catalog))
         text = stability.render_plan(res.converted, res.ctx)
         err = stability.check_stability("q03", text, golden)
         assert err is None, err
     # a conversion regression (agg falling back) must be caught
     with config.conf.scoped({"auron.enable.agg": False}):
         session = AuronSession(foreign_engine=PyArrowEngine())
-        res = session.execute(build("q03", catalog))
+        res = session.execute(build("q03", small_catalog))
         text2 = stability.render_plan(res.converted, res.ctx)
     assert text2 != text
     assert stability.check_stability("q03", text2, golden) is not None
 
 
-def test_runner_exclusion_list(catalog):
+def test_runner_exclusion_list(small_catalog):
     """Excluded queries are skipped with a documented reason (the
     reference's per-suite .exclude(...) lists)."""
     from auron_tpu.it.runner import QueryRunner
 
-    r = QueryRunner(catalog=catalog,
+    r = QueryRunner(catalog=small_catalog,
                     exclusions={"q03": "known divergence: demo"})
     qr = r.run("q03")
     assert qr.ok and qr.skipped == "known divergence: demo"
