@@ -160,7 +160,8 @@ def _hash_host_column(col, seed):
             # complement (spark_hash.rs decimal arm).  Java bitLength
             # excludes the sign bit: bitLength(-2^k) == k, so negatives
             # use (-v-1).bit_length()
-            unscaled = int(v.scaleb(col.dtype.scale))
+            from auron_tpu.exprs.host_eval import decimal_unscaled
+            unscaled = decimal_unscaled(v, col.dtype.scale)
             bl = (-unscaled - 1).bit_length() if unscaled < 0 \
                 else unscaled.bit_length()
             b = unscaled.to_bytes(bl // 8 + 1, "big", signed=True)

@@ -96,6 +96,16 @@ import datetime as _dt
 _EPOCH_DATE = _dt.date(1970, 1, 1)
 
 
+def decimal_unscaled(v, scale: int) -> int:
+    """Exact unscaled integer of a Decimal at `scale` — the default
+    28-digit decimal context silently ROUNDS 38-digit values, so scaleb
+    must run under a wide context."""
+    import decimal
+    with decimal.localcontext() as ctx:
+        ctx.prec = 80
+        return int(decimal.Decimal(v).scaleb(scale))
+
+
 # ---------------------------------------------------------------------------
 # dispatcher
 # ---------------------------------------------------------------------------
@@ -121,11 +131,10 @@ def evaluate(expr: E.Expr, rb: pa.RecordBatch, schema: Schema,
             return HV(np.zeros(n, object if (t.is_stringlike or t.is_nested)
                                else t.numpy_dtype()), np.zeros(n, bool), t)
         if dt.id == TypeId.DECIMAL:
-            from decimal import Decimal
             if not isinstance(v, int):
-                # exact unscaling (a float round-trip would corrupt
-                # high-precision literals)
-                v = int(Decimal(str(v)).scaleb(dt.scale))
+                # exact unscaling (a float round-trip or narrow decimal
+                # context would corrupt high-precision literals)
+                v = decimal_unscaled(str(v), dt.scale)
             if dt.precision > 18:   # beyond int64: object-int column
                 return HV(np.full(n, v, dtype=object), np.ones(n, bool),
                           dt)

@@ -1280,10 +1280,8 @@ def q51w(cat: Catalog) -> ForeignNode:
                            fcol("total", F64), dtype=F64), "share")],
         Schema((Field("ws_item_sk", I64), Field("d_moy", I32),
                 Field("rev", F64), Field("share", F64))))
-    hot = ffilter(share, fcall("GreaterThan", fcol("share", F64),
-                               flit(0.5)))
     return take_ordered(
-        hot,
+        share,
         orders=[so(fcol("share", F64), asc=False),
                 so(fcol("ws_item_sk", I64)), so(fcol("d_moy", I32))],
         limit=100,
@@ -1417,15 +1415,19 @@ def q63w(cat: Catalog) -> ForeignNode:
 
 @_q("q69a")
 def q69a(cat: Catalog) -> ForeignNode:
-    """q69 family: store customers who never bought online, by state
-    (semi + anti join chain)."""
+    """q69 family: store customers with no returns at one store, by
+    state (semi + anti join chain).  The anti side is a FILTERED returns
+    set so the result stays non-empty at every scale factor (an anti
+    join against all of web_sales empties out once every customer has
+    bought online)."""
     cu = cat.scan("customer", ["c_customer_sk", "c_current_addr_sk"])
     ss = cat.scan("store_sales", ["ss_customer_sk"])
-    ws = cat.scan("web_sales", ["ws_bill_customer_sk"])
+    sr = cat.scan("store_returns", ["sr_customer_sk", "sr_store_sk"])
+    sr = ffilter(sr, fcall("EqualTo", fcol("sr_store_sk", I64), flit(1)))
     in_store = smj(cu, ss, [fcol("c_customer_sk", I64)],
                    [fcol("ss_customer_sk", I64)], join_type="LeftSemi")
-    not_web = smj(in_store, ws, [fcol("c_customer_sk", I64)],
-                  [fcol("ws_bill_customer_sk", I64)],
+    not_web = smj(in_store, sr, [fcol("c_customer_sk", I64)],
+                  [fcol("sr_customer_sk", I64)],
                   join_type="LeftAnti")
     caddr = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
     j = bhj(not_web, caddr, fcol("c_current_addr_sk", I64),

@@ -116,6 +116,13 @@ class QueryRunner:
             error=diff, plan_error=plan_err, spmd=res.spmd,
             native_warm_s=warm_s, perf_error=perf_err)
         self.results.append(qr)
+        # drop compiled executables between queries: queries share few
+        # kernels, and letting thousands of CPU executables accumulate in
+        # one process eventually SEGFAULTS this jaxlib's CPU backend
+        # inside backend_compile_and_load (observed reproducibly ~40
+        # corpus queries in)
+        import jax
+        jax.clear_caches()
         return qr
 
     def run_all(self, names: Optional[List[str]] = None

@@ -78,6 +78,12 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.auron_xxhash64_i64.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    try:        # newer symbol: tolerate a stale prebuilt .so
+        lib.auron_crc32c.restype = ctypes.c_uint32
+        lib.auron_crc32c.argtypes = [u8p, ctypes.c_size_t,
+                                     ctypes.c_uint32]
+    except AttributeError:
+        pass
     lib.auron_partition_sort.restype = None
     lib.auron_partition_sort.argtypes = [
         ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t, ctypes.c_int32,
@@ -151,6 +157,17 @@ def murmur3_32(data: bytes, seed: int = 42) -> int:
         return int(lib.auron_murmur3_x86_32(buf, len(data),
                                             _i32(seed)))
     return _py_murmur3_32(data, seed)
+
+
+def crc32c(data: bytes, crc: int = 0):
+    """Castagnoli CRC (kafka record batches); None when the native lib
+    (or the symbol, for stale builds) is absent — callers fall back to
+    their python implementation."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "auron_crc32c"):
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return int(lib.auron_crc32c(buf, len(data), crc & 0xFFFFFFFF))
 
 
 def _i32(seed: int) -> int:

@@ -170,6 +170,25 @@ int32_t auron_murmur3_x86_32(const uint8_t* data, size_t n, int32_t seed) {
   return mm3_fmix(h, static_cast<uint32_t>(n));
 }
 
+// crc32c (Castagnoli, reflected 0x1EDC6F41) — kafka record-batch checksum
+static uint32_t kCrc32cTable[256];
+static bool kCrc32cInit = [] {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; ++j)
+      crc = (crc & 1u) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+    kCrc32cTable[i] = crc;
+  }
+  return true;
+}();
+
+uint32_t auron_crc32c(const uint8_t* data, size_t n, uint32_t crc) {
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = kCrc32cTable[(crc ^ data[i]) & 0xFFu] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
 // vectorized spark murmur3 over i64 values (8-byte LE = 2 blocks, no tail)
 void auron_murmur3_hash_i64(const int64_t* vals, size_t n, int32_t* out,
                             int32_t seed) {

@@ -61,6 +61,22 @@ def _batches_from_ipc(data: bytes) -> List[pa.RecordBatch]:
         return list(r)
 
 
+def _batches_to_ipc(source) -> bytes:
+    """Serialize a Table / iterable of RecordBatches / zero-arg callable
+    returning either, as one Arrow IPC stream."""
+    if callable(source):
+        source = source()
+    if isinstance(source, pa.Table):
+        source = source.to_batches()
+    batches = list(source)
+    sink = pa.BufferOutputStream()
+    schema = batches[0].schema if batches else pa.schema([])
+    with pa.ipc.new_stream(sink, schema) as w:
+        for rb in batches:
+            w.write_batch(rb)
+    return sink.getvalue().to_pybytes()
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         server: "EngineServer" = self.server.engine  # type: ignore[attr-defined]
@@ -77,6 +93,15 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
             except (BrokenPipeError, ConnectionError):
                 return
+            except Exception as e:  # noqa: BLE001 - keep connection
+                # malformed payloads (corrupt IPC, bad keys) answer
+                # in-band instead of tearing the connection down with
+                # every resource registered on it
+                try:
+                    send_msg(sock, {"ok": False,
+                                    "error": f"{type(e).__name__}: {e}"})
+                except (BrokenPipeError, ConnectionError, OSError):
+                    return
 
     def _dispatch(self, server: "EngineServer", sock, header: dict,
                   payload: bytes) -> bool:
@@ -279,15 +304,7 @@ class EngineClient:
     def put_arrow(self, key: str, batches) -> None:
         """Register Arrow data under `key` (putResource analogue);
         accepts a Table or an iterable of RecordBatches."""
-        if isinstance(batches, pa.Table):
-            batches = batches.to_batches()
-        batches = list(batches)
-        sink = pa.BufferOutputStream()
-        schema = batches[0].schema if batches else pa.schema([])
-        with pa.ipc.new_stream(sink, schema) as w:
-            for rb in batches:
-                w.write_batch(rb)
-        data = sink.getvalue().to_pybytes()
+        data = _batches_to_ipc(batches)
         self._call({"cmd": "put_resource", "key": key, "kind": "arrow_ipc",
                     "len": len(data)}, data)
 
@@ -329,17 +346,7 @@ class EngineClient:
             send_msg(self._sock, {"cmd": "resource_data",
                                   "kind": "missing"})
             return
-        if callable(src):
-            src = src()
-        if isinstance(src, pa.Table):
-            src = src.to_batches()
-        batches = list(src)
-        sink = pa.BufferOutputStream()
-        schema = batches[0].schema if batches else pa.schema([])
-        with pa.ipc.new_stream(sink, schema) as w:
-            for rb in batches:
-                w.write_batch(rb)
-        data = sink.getvalue().to_pybytes()
+        data = _batches_to_ipc(src)
         send_msg(self._sock, {"cmd": "resource_data", "kind": "arrow_ipc",
                               "len": len(data)}, data)
 

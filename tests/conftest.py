@@ -15,27 +15,20 @@ import os
 
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    xla_flags = (xla_flags +
+                 " --xla_force_host_platform_device_count=8").strip()
+os.environ["XLA_FLAGS"] = xla_flags
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# persistent XLA executable cache: the sf>=0.1 TPC-DS corpus compiles
-# hundreds of kernels; caching them across test processes/CI rounds turns
-# ~25s cold queries into ~1s warm ones (first run after a kernel-shape
-# change still pays)
-_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                            "/tmp/auron_jax_cache")
-try:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    # the engine's kernels are many SMALL programs (~80ms compiles);
-    # a nonzero threshold caches none of them
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-except Exception:  # older jax without the knobs: compile cold
-    pass
+# NOTE on the persistent XLA compilation cache: do NOT enable it here.
+# This jaxlib's CPU AOT serialization is unsound — cache WRITES and READS
+# of the engine's executables segfault nondeterministically mid-suite
+# (observed in jax._src.compilation_cache.put/get_executable_and_time,
+# with machine-feature-mismatch warnings on reads).  The suite compiles
+# cold instead; per-process jit caches still dedupe within a run.
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

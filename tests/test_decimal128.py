@@ -138,3 +138,13 @@ def test_decimal_hash_java_bytearray_boundaries():
     exp = [np.uint32(bindings.murmur3_32(b, 42) & 0xFFFFFFFF)
            for b in cases.values()]
     assert list(got) == exp
+
+
+def test_decimal_unscaled_full_precision():
+    """38-significant-digit values must unscale exactly (the default
+    28-digit decimal context silently rounds them)."""
+    from auron_tpu.exprs.host_eval import decimal_unscaled
+    v = Decimal("123456789012345678901234567.89012345678")
+    assert decimal_unscaled(v, 11) == \
+        12345678901234567890123456789012345678
+    assert decimal_unscaled(Decimal("-1.5"), 6) == -1500000
