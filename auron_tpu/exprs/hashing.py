@@ -143,10 +143,11 @@ def _hash_host_column(col, seed):
     """Host-resident rows (oversized strings, hybrid batches): Spark
     murmur3 computed on host (spark_hash.rs StringType/BinaryType arm);
     null and padding rows keep the incoming per-row seed."""
+    import decimal as _dec
+    from auron_tpu.exprs.host_eval import decimal_unscaled
     from auron_tpu.native import bindings
     seeds = np.asarray(seed, dtype=np.uint32)
     out = seeds.copy()
-    import decimal as _dec
     for i, v in enumerate(col.pylist()):
         if v is None:
             continue
@@ -160,7 +161,6 @@ def _hash_host_column(col, seed):
             # complement (spark_hash.rs decimal arm).  Java bitLength
             # excludes the sign bit: bitLength(-2^k) == k, so negatives
             # use (-v-1).bit_length()
-            from auron_tpu.exprs.host_eval import decimal_unscaled
             unscaled = decimal_unscaled(v, col.dtype.scale)
             bl = (-unscaled - 1).bit_length() if unscaled < 0 \
                 else unscaled.bit_length()

@@ -68,7 +68,8 @@ def arrow_to_hv(arr: pa.Array, dtype: DataType) -> HV:
     n = len(arr)
     mask = np.ones(n, bool) if arr.null_count == 0 else np.asarray(arr.is_valid())
     if dtype.id == TypeId.DECIMAL:
-        vals = np.array([None if v is None else int(v.scaleb(dtype.scale))
+        vals = np.array([None if v is None
+                         else decimal_unscaled(v, dtype.scale)
                          for v in arr.to_pylist()], dtype=object)
         vals = np.where(mask, vals, 0)
         return HV(vals.astype(np.int64) if dtype.precision <= 18 else vals,
@@ -96,14 +97,18 @@ import datetime as _dt
 _EPOCH_DATE = _dt.date(1970, 1, 1)
 
 
+_WIDE_DECIMAL_CTX = None
+
+
 def decimal_unscaled(v, scale: int) -> int:
     """Exact unscaled integer of a Decimal at `scale` — the default
     28-digit decimal context silently ROUNDS 38-digit values, so scaleb
-    must run under a wide context."""
+    runs under a reusable wide context."""
     import decimal
-    with decimal.localcontext() as ctx:
-        ctx.prec = 80
-        return int(decimal.Decimal(v).scaleb(scale))
+    global _WIDE_DECIMAL_CTX
+    if _WIDE_DECIMAL_CTX is None:
+        _WIDE_DECIMAL_CTX = decimal.Context(prec=80)
+    return int(decimal.Decimal(v).scaleb(scale, _WIDE_DECIMAL_CTX))
 
 
 # ---------------------------------------------------------------------------

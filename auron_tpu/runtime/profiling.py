@@ -132,6 +132,37 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/status":
                 from auron_tpu.build_info import build_info
                 self._send(200, json.dumps(build_info()).encode())
+            elif url.path in ("/", "/auron"):
+                # the Spark-UI "Auron" tab analogue
+                # (auron-spark-ui AuronSQLAppStatusListener: a page of
+                # build info; here plus live engine metrics)
+                from auron_tpu.build_info import build_info
+                info = build_info()
+                snap = _metrics_snapshot()
+                import html as _html
+                rows = "".join(
+                    f"<tr><td>{_html.escape(str(k))}</td>"
+                    f"<td><code>{_html.escape(str(v))}</code></td></tr>"
+                    for k, v in sorted(info.items()))
+                mrows = "".join(
+                    f"<tr><td>{_html.escape(str(k))}</td>"
+                    f"<td><code>{_html.escape(json.dumps(v))}</code>"
+                    f"</td></tr>" for k, v in sorted(snap.items()))
+                html = (
+                    "<html><head><title>Auron</title><style>"
+                    "body{font-family:sans-serif;margin:2em}"
+                    "table{border-collapse:collapse}"
+                    "td{border:1px solid #ccc;padding:4px 10px}"
+                    "</style></head><body>"
+                    "<h2>Auron TPU engine</h2>"
+                    f"<h3>Build</h3><table>{rows}</table>"
+                    f"<h3>Runtime</h3><table>{mrows}</table>"
+                    "<p><a href='/metrics'>metrics</a> · "
+                    "<a href='/status'>status</a> · "
+                    "<a href='/debug/profile?seconds=1'>trace</a> · "
+                    "<a href='/debug/pyspy?seconds=1'>stacks</a></p>"
+                    "</body></html>")
+                self._send(200, html.encode(), "text/html")
             else:
                 self._send(404, b'{"error": "not found"}')
         except Exception as e:  # pragma: no cover - defensive

@@ -202,10 +202,35 @@ def _compress(codec_id: int, data: bytes) -> bytes:
     return sink.getvalue().to_pybytes()
 
 
+def parse_fetch_response(data: bytes, partition: int,
+                         verify_crc: bool = True
+                         ) -> Tuple[List[KafkaRecord], int]:
+    """Parse a Fetch record_set: -> (records, next_offset).  next_offset
+    covers EVERY fully-received batch — including control batches, whose
+    records are skipped but whose offset range must still advance the
+    consumer (a `continue` without accounting strands it forever behind
+    a transaction marker)."""
+    out: List[KafkaRecord] = []
+    next_offset = -1
+    for base_offset, last_delta, records in _iter_batches(data, partition,
+                                                          verify_crc):
+        next_offset = max(next_offset, base_offset + last_delta + 1)
+        out.extend(records)
+    return out, next_offset
+
+
 def parse_record_batches(data: bytes, partition: int,
                          verify_crc: bool = True) -> Iterator[KafkaRecord]:
-    """Parse a Fetch record_set: a sequence of v2 RecordBatches (the last
-    may be truncated by max_bytes — ignored, refetched next poll)."""
+    """Record-only view of parse_fetch_response."""
+    for _base, _last, records in _iter_batches(data, partition,
+                                               verify_crc):
+        yield from records
+
+
+def _iter_batches(data: bytes, partition: int, verify_crc: bool):
+    """-> (base_offset, last_offset_delta, records) per complete batch;
+    the last batch may be truncated by max_bytes — ignored, refetched
+    next poll."""
     r = _Reader(data)
     while r.remaining() >= 12:
         base_offset = r.i64()
@@ -223,9 +248,10 @@ def parse_record_batches(data: bytes, partition: int,
         if verify_crc and crc32c(rest) != crc:
             raise ValueError("kafka record batch crc32c mismatch")
         attrs = br.i16()
-        if attrs & 0x20:    # control batch: txn COMMIT/ABORT markers
+        last_delta = br.i32()   # last offset delta
+        if attrs & 0x20:        # control batch: txn COMMIT/ABORT markers
+            yield base_offset, last_delta, []
             continue
-        br.i32()            # last offset delta
         first_ts = br.i64()
         br.i64()            # max timestamp
         br.i64()            # producer id
@@ -237,6 +263,7 @@ def parse_record_batches(data: bytes, partition: int,
         if codec_id:
             payload = _decompress(codec_id, payload)
         pr = _Reader(payload)
+        records: List[KafkaRecord] = []
         for _ in range(n_records):
             length = pr.varint()
             rec = _Reader(pr.take(length))
@@ -254,14 +281,16 @@ def parse_record_batches(data: bytes, partition: int,
                 hvlen = rec.varint()
                 if hvlen > 0:
                     rec.take(hvlen)
-            yield KafkaRecord(partition=partition,
-                              offset=base_offset + off_delta,
-                              timestamp=first_ts + ts_delta,
-                              key=key, value=value)
+            records.append(KafkaRecord(partition=partition,
+                                       offset=base_offset + off_delta,
+                                       timestamp=first_ts + ts_delta,
+                                       key=key, value=value))
+        yield base_offset, last_delta, records
 
 
 def encode_record_batch(base_offset: int, records: List[Tuple[int, Optional[bytes], Optional[bytes]]],
-                        first_ts: int = 0, codec_id: int = 0) -> bytes:
+                        first_ts: int = 0, codec_id: int = 0,
+                        control: bool = False) -> bytes:
     """v2 RecordBatch encoder (used by the in-process test broker; also
     exercises the parser against an independent spec implementation)."""
     body = bytearray()
@@ -284,7 +313,7 @@ def encode_record_batch(base_offset: int, records: List[Tuple[int, Optional[byte
     if codec_id:
         payload = _compress(codec_id, payload)
     after_crc = _Writer()
-    after_crc.i16(codec_id)                  # attributes
+    after_crc.i16(codec_id | (0x20 if control else 0))   # attributes
     after_crc.i32(len(records) - 1)          # last offset delta
     after_crc.i64(first_ts)
     after_crc.i64(first_ts + max((r[0] for r in records), default=0))
@@ -445,8 +474,10 @@ class KafkaWireClient:
 
     def fetch(self, addr: Tuple[str, int], topic: str, partition: int,
               offset: int, max_bytes: int = 1 << 20,
-              max_wait_ms: int = 500) -> Tuple[List[KafkaRecord], int]:
-        """-> (records at >= offset, high watermark)."""
+              max_wait_ms: int = 500
+              ) -> Tuple[List[KafkaRecord], int, int]:
+        """-> (records at >= offset, high watermark, next_offset past the
+        last fully-received batch — advances over control batches)."""
         body = _Writer()
         body.i32(-1)            # replica id
         body.i32(max_wait_ms)
@@ -461,6 +492,7 @@ class KafkaWireClient:
         r.i32()                 # throttle ms
         records: List[KafkaRecord] = []
         hwm = -1
+        next_offset = offset
         for _ in range(r.i32()):
             r.string()          # topic
             for _p in range(r.i32()):
@@ -475,11 +507,12 @@ class KafkaWireClient:
                 if err:
                     raise RuntimeError(f"kafka Fetch error {err} "
                                        f"(partition {pid})")
-                for rec in parse_record_batches(record_set, pid,
-                                                self.verify_crc):
-                    if rec.offset >= offset:
-                        records.append(rec)
-        return records, hwm
+                recs, parsed_next = parse_fetch_response(
+                    record_set, pid, self.verify_crc)
+                next_offset = max(next_offset, parsed_next)
+                records.extend(rec for rec in recs
+                               if rec.offset >= offset)
+        return records, hwm, next_offset
 
 
 class KafkaWireConsumer:
@@ -510,11 +543,11 @@ class KafkaWireConsumer:
             end = assignment.get("end_offsets", {}).get(pid_s) \
                 if assignment else None
             while True:
-                records, hwm = self.client.fetch(
+                records, hwm, next_off = self.client.fetch(
                     addr, self.topic, pid, offset,
                     max_bytes=self.max_bytes)
                 stop = hwm if end is None else min(end, hwm)
-                if not records:
+                if offset >= stop:
                     break
                 progressed = False
                 for rec in records:
@@ -524,8 +557,15 @@ class KafkaWireConsumer:
                         yield rec.value
                     offset = rec.offset + 1
                     progressed = True
-                if offset >= stop or not progressed:
-                    # not progressed: a compaction gap straddles the stop
-                    # offset — everything below it is gone, done here
+                if not progressed:
+                    if offset < next_off:
+                        # only control batches / compacted gaps below
+                        # here: advance past them and keep draining
+                        offset = min(next_off, stop)
+                        continue
+                    # no data below stop and nothing to skip: a
+                    # compaction gap straddles the stop offset — done
+                    break
+                if offset >= stop:
                     break
         self.client.close()

@@ -28,6 +28,13 @@ N_KEYS = 4096
 BATCH_ROWS = 1 << 20      # 1M-row batches into the engine
 WORKER_TIMEOUT_S = 900    # first TPU compile can take minutes
 ATTEMPTS = 3
+TOTAL_DEADLINE_S = 2700   # whole-bench budget: never let retries of a
+                          # wedged tunnel eat the driver's bench window
+_T0 = time.time()
+
+
+def _remaining() -> float:
+    return TOTAL_DEADLINE_S - (time.time() - _T0)
 
 
 # ---------------------------------------------------------------------------
@@ -327,13 +334,14 @@ def worker_fused() -> dict:
 # orchestrator
 # ---------------------------------------------------------------------------
 
-def _run_worker(mode: str, env_extra=None) -> dict:
+def _run_worker(mode: str, env_extra=None, timeout=WORKER_TIMEOUT_S
+                ) -> dict:
     env = dict(os.environ)
     env.update(env_extra or {})
     p = subprocess.run([sys.executable, os.path.abspath(__file__),
                         "--worker", mode],
                        capture_output=True, text=True,
-                       timeout=WORKER_TIMEOUT_S, env=env,
+                       timeout=timeout, env=env,
                        cwd=os.path.dirname(os.path.abspath(__file__)))
     for line in reversed(p.stdout.strip().splitlines()):
         line = line.strip()
@@ -345,11 +353,18 @@ def _run_worker(mode: str, env_extra=None) -> dict:
 
 def _attempt(mode: str, diagnostics: list) -> dict | None:
     for attempt in range(ATTEMPTS):
+        left = _remaining()
+        if left < 60:
+            diagnostics.append(f"{mode}#{attempt}: skipped "
+                               f"(bench deadline, {left:.0f}s left)")
+            return None
+        eff_timeout = min(WORKER_TIMEOUT_S, left)
         try:
-            return _run_worker(mode)
+            return _run_worker(mode, timeout=eff_timeout)
         except subprocess.TimeoutExpired:
             diagnostics.append(f"{mode}#{attempt}: timeout "
-                               f"{WORKER_TIMEOUT_S}s (wedged backend?)")
+                               f"{eff_timeout:.0f}s (wedged backend or "
+                               f"bench deadline)")
         except Exception as e:  # noqa: BLE001
             diagnostics.append(f"{mode}#{attempt}: {str(e)[:300]}")
         time.sleep(10 * (attempt + 1))

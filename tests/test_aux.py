@@ -34,6 +34,12 @@ def test_profiling_server_endpoints():
 
         code, body = _get(srv.url + "/debug/profile?seconds=0.2")
         assert code == 200 and body[:2] == b"PK"  # zip magic
+
+        # the Spark-UI "Auron tab" analogue: build info + live metrics
+        code, body = _get(srv.url + "/auron")
+        assert code == 200
+        page = body.decode()
+        assert "Auron TPU engine" in page and "auron-tpu" in page
     finally:
         srv.stop()
 

@@ -9,7 +9,7 @@ import pytest
 from kafka_broker import MockKafkaBroker
 from auron_tpu.streaming.kafka_client import (
     EARLIEST, KafkaRecord, KafkaWireClient, KafkaWireConsumer, crc32c,
-    encode_record_batch, parse_record_batches,
+    encode_record_batch, parse_fetch_response, parse_record_batches,
 )
 
 
@@ -51,14 +51,28 @@ def test_fetch_end_to_end(codec_id):
         assert set(leaders) == {0, 1}
         addr = leaders[0]
         assert cli.list_offset(addr, "events", 0, EARLIEST) == 0
-        recs, hwm = cli.fetch(addr, "events", 0, offset=0)
+        recs, hwm, next_off = cli.fetch(addr, "events", 0, offset=0)
         assert hwm == 7 and [r.offset for r in recs] == list(range(7))
+        assert next_off == 7
         # offset resume: fetch from 5
-        recs2, _ = cli.fetch(addr, "events", 0, offset=5)
+        recs2, _, _ = cli.fetch(addr, "events", 0, offset=5)
         assert [r.offset for r in recs2] == [5, 6]
         cli.close()
     finally:
         broker.stop()
+
+
+def test_control_batches_advance_offset():
+    """Transaction-marker control batches are skipped but still advance
+    the consumer past their offsets (a bare skip would strand the drain
+    loop behind the first marker)."""
+    data = encode_record_batch(0, [(0, b"k0", b"v0")])
+    marker = encode_record_batch(1, [(0, b"\x00\x00\x00\x00", b"")],
+                                 control=True)
+    after = encode_record_batch(2, [(0, b"k2", b"v2"), (1, b"k3", b"v3")])
+    recs, next_off = parse_fetch_response(data + marker + after, 0)
+    assert [r.offset for r in recs] == [0, 2, 3]
+    assert next_off == 4
 
 
 def test_kafka_scan_exec_wire_consumer():
