@@ -213,6 +213,50 @@ class _StageTracer:
 
     # row ops -----------------------------------------------------------------
 
+    def _concat_tables(self, schema: Schema,
+                       tables: List[DeviceTable]) -> DeviceTable:
+        from auron_tpu.columnar.batch import concat_device_columns
+        cols = [concat_device_columns([t.cols[i] for t in tables])
+                for i in range(len(schema))]
+        live = jnp.concatenate([t.live for t in tables])
+        return DeviceTable(schema, cols, live)
+
+    def _do_union(self, n: P.Union) -> DeviceTable:
+        # SPMD union: every device holds a shard of every child, so the
+        # per-partition enumeration (proto:542-552 — one UnionInput per
+        # child partition) collapses to ONE concat of the child; a child
+        # whose partitions are each referenced m times contributes m
+        # replicated copies (rows-twice semantics of duplicate inputs)
+        by_child: Dict[int, Any] = {}
+        order: List[int] = []
+        for i in n.inputs:
+            if id(i.child) not in by_child:
+                by_child[id(i.child)] = (i.child, {})
+                order.append(id(i.child))
+            by_child[id(i.child)][1].setdefault(i.partition, 0)
+            by_child[id(i.child)][1][i.partition] += 1
+        tables: List[DeviceTable] = []
+        for cid in order:
+            child, part_counts = by_child[cid]
+            counts = set(part_counts.values())
+            if len(counts) != 1:
+                raise SpmdUnsupported(
+                    "union references a child's partitions unevenly")
+            t = self.eval_node(child)
+            for _ in range(counts.pop()):
+                tables.append(t)
+        return self._concat_tables(n.schema, tables)
+
+    def _do_expand(self, n: P.Expand) -> DeviceTable:
+        # grouping-sets: each projection contributes one replicated copy
+        # of the child rows (expand_exec.rs:40)
+        t = self.eval_node(n.child)
+        schema = Schema(tuple(Field(nm, dt)
+                              for nm, dt in zip(n.names, n.types)))
+        parts = [DeviceTable(schema, self._eval_exprs(proj, t), t.live)
+                 for proj in n.projections]
+        return self._concat_tables(schema, parts)
+
     def _do_filter(self, n: P.Filter) -> DeviceTable:
         t = self.eval_node(n.child)
         live = t.live
@@ -263,6 +307,13 @@ class _StageTracer:
 
     def _do_agg(self, n: P.Agg) -> DeviceTable:
         from auron_tpu.ops.agg.exec import _group_reduce_body
+        if n.exec_mode == "single" and self.n_dev > 1:
+            # a single-mode agg is per-partition in SPMD — without the
+            # partial/exchange/final pair its device-local groups would
+            # be silently wrong; reject so the serial engine takes over
+            raise SpmdUnsupported(
+                "single-mode agg needs the partial/exchange/final shape "
+                "on a multi-device mesh")
         t = self.eval_node(n.child)
         agg = self._agg_exec_meta(n, t.schema)
         merge = n.exec_mode == "final"
@@ -606,6 +657,13 @@ def _walk_native(node, conv_ctx):
             if job is not None:
                 stack.append(job.child)
             continue
+        if isinstance(n, P.Union):
+            pushed = set()           # one walk per child, not per partition
+            for i in n.inputs:       # UnionInput wrappers are not plans
+                if id(i.child) not in pushed:
+                    pushed.add(id(i.child))
+                    stack.append(i.child)
+            continue
         for c in n.children_nodes():
             stack.append(c)
 
@@ -618,14 +676,14 @@ _PRECHECK_OK = frozenset({
     "ffi_reader", "ipc_reader", "parquet_scan", "orc_scan", "filter",
     "projection", "rename_columns", "coalesce_batches", "debug", "agg",
     "broadcast_join", "hash_join", "broadcast_join_build_hash_map",
-    "sort", "limit",
+    "sort", "limit", "union", "expand",
 })
 
 
 def precheck_plan(plan, conv_ctx) -> None:
     """Cheap kind-level SPMD compilability check (no tracing, no source
-    materialization) — rejects the common fallbacks (smj, window, union,
-    expand, generate, sinks) up front."""
+    materialization) — rejects the common fallbacks (smj, window,
+    generate, sinks) up front; union/expand compile since round 2."""
     for node in _walk_native(plan, conv_ctx):
         if node.kind not in _PRECHECK_OK:
             raise SpmdUnsupported(
@@ -634,6 +692,9 @@ def precheck_plan(plan, conv_ctx) -> None:
             jt = node.join_type
             if jt not in ("inner", "left"):
                 raise SpmdUnsupported(f"SPMD join type {jt!r}")
+        if node.kind == "agg" and node.exec_mode == "single":
+            raise SpmdUnsupported(
+                "single-mode agg needs the partial/exchange/final shape")
 
 
 def _materialize_scans(plan, conv_ctx):

@@ -281,3 +281,66 @@ def test_spmd_hierarchical_2d_mesh():
         join_type="inner", broadcast_side="right")
     exp = _serial_reference(serial_join, {"fact": fact, "dim": dim})
     assert _canon(got) == _canon(exp)
+
+
+def test_spmd_union_and_expand():
+    """Union (incl. rows-twice duplicate inputs) and Expand compile into
+    the shard_map program with serial-engine-equivalent results."""
+    from auron_tpu.ir.plan import UnionInput
+    fact = make_fact(n=1200, keys=16, seed=11)
+    fact_schema = from_arrow_schema(fact.schema)
+    src = P.FFIReader(schema=fact_schema, resource_id="fact")
+    proj = P.Projection(child=src, exprs=(col("key"), col("amount")),
+                        names=("key", "amount"))
+    doubled = P.Union(
+        inputs=(UnionInput(child=proj, partition=0, out_partition=0),
+                UnionInput(child=proj, partition=0, out_partition=1)),
+        schema=from_arrow_schema(fact.schema), num_partitions=2)
+
+    def agg_pair(child, fn, rtype, out):
+        partial = P.Agg(
+            child=child, exec_mode="partial", grouping=(col("key"),),
+            grouping_names=("key",),
+            aggs=(AggExpr(fn=fn, children=(col("amount"),),
+                          return_type=rtype),),
+            agg_names=(out,))
+        ctx = _Ctx()
+        ctx.exchanges["exu"] = ShuffleJob(
+            rid="exu", child=partial,
+            partitioning=P.Partitioning(mode="hash", num_partitions=8,
+                                        expressions=(col("key"),)),
+            schema=None)
+        final = P.Agg(
+            child=P.IpcReader(schema=None, resource_id="exu"),
+            exec_mode="final", grouping=(col("key"),),
+            grouping_names=("key",),
+            aggs=(AggExpr(fn=fn, children=(col("amount"),),
+                          return_type=rtype),),
+            agg_names=(out,))
+        serial = P.Agg(
+            child=partial, exec_mode="final", grouping=(col("key"),),
+            grouping_names=("key",),
+            aggs=(AggExpr(fn=fn, children=(col("amount"),),
+                          return_type=rtype),),
+            agg_names=(out,))
+        return final, ctx, serial
+
+    agg, ctx, serial = agg_pair(doubled, "count", I64, "c")
+    mesh = data_mesh(8)
+    got = execute_plan_spmd(agg, ctx, mesh, {"fact": fact}).to_pylist()
+    exp = _serial_reference(serial, {"fact": fact})
+    assert _canon(got) == _canon(exp)
+    assert sum(r["c"] for r in got) == 2 * fact.num_rows
+
+    # expand: grouping-sets replication
+    exp_node = P.Expand(
+        child=proj,
+        projections=((col("key"), col("amount")),
+                     (lit(None, I64), col("amount"))),
+        names=("key", "amount"),
+        types=(I64, F64))
+    agg2, ctx2, serial2 = agg_pair(exp_node, "sum", F64, "s")
+    got2 = execute_plan_spmd(agg2, ctx2, mesh,
+                             {"fact": fact}).to_pylist()
+    exp2 = _serial_reference(serial2, {"fact": fact})
+    assert _canon(got2) == _canon(exp2)

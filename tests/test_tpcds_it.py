@@ -53,7 +53,8 @@ def mesh_runner(small_catalog):
 # full corpus already runs single-device above; re-running all 42 on the
 # mesh only re-compiles the same fallback kernels at a second scale
 MESH_QUERIES = ["q03", "q07", "q42", "q55", "q13a", "q26a", "q48a",
-                "q19", "q65w", "q71u", "q27r", "q93s"]
+                "q19", "q65w", "q71u", "q27r", "q93s", "q76u", "q22r",
+                "q33b", "q60b", "q36r"]
 
 
 @pytest.mark.parametrize("query", MESH_QUERIES)
