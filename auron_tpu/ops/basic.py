@@ -158,8 +158,12 @@ class UnionExec(Operator):
         import dataclasses
         assignments = self.assignments if self.assignments is not None \
             else [(ctx.partition_id, ctx.partition_id)] * len(self.children)
+        # collapsed single-partition execution (exchange-inlined pipeline)
+        # must stream EVERY assignment: dropping out_partition != 0 would
+        # silently lose those union inputs' rows
+        collapsed = ctx.num_partitions == 1
         for i, (out_pid, local_pid) in enumerate(assignments):
-            if out_pid != ctx.partition_id:
+            if not collapsed and out_pid != ctx.partition_id:
                 continue
             sub = dataclasses.replace(ctx, partition_id=local_pid)
             for b in self.child_stream(sub, i):

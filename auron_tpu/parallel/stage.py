@@ -305,15 +305,25 @@ class _StageTracer:
             raise SpmdUnsupported("host-path agg function in SPMD")
         return agg
 
+    def _admitting_exchange_mode(self, agg) -> Optional[str]:
+        child = agg.child
+        if isinstance(child, P.IpcReader) and \
+                child.resource_id in self.exchanges:
+            return self.exchanges[child.resource_id].partitioning.mode
+        return None
+
     def _do_agg(self, n: P.Agg) -> DeviceTable:
         from auron_tpu.ops.agg.exec import _group_reduce_body
-        if n.exec_mode == "single" and self.n_dev > 1:
-            # a single-mode agg is per-partition in SPMD — without the
-            # partial/exchange/final pair its device-local groups would
-            # be silently wrong; reject so the serial engine takes over
+        if n.exec_mode == "single" and self.n_dev > 1 and \
+                not _single_agg_ok(n, self.exchanges):
+            # a single-mode agg is per-partition; on a sharded SOURCE its
+            # device-local groups would diverge from the collapsed serial
+            # oracle — but directly after an exchange the device IS the
+            # partition, so per-device reduction is exactly the
+            # per-partition semantics (empty devices emit zero groups)
             raise SpmdUnsupported(
-                "single-mode agg needs the partial/exchange/final shape "
-                "on a multi-device mesh")
+                "single-mode agg needs an exchange (or partial/final "
+                "shape) on a multi-device mesh")
         t = self.eval_node(n.child)
         agg = self._agg_exec_meta(n, t.schema)
         merge = n.exec_mode == "final"
@@ -333,6 +343,22 @@ class _StageTracer:
                              if a.children else [])
         out_cols, n_groups = _group_reduce_body(
             keys, vcols, t.live, agg.specs, agg._key_orders(), merge)
+        if nk == 0 and n.exec_mode in ("final", "single"):
+            # a global agg over an empty input still emits the identity
+            # row (count=0, sum=null — the serial _empty_global_agg
+            # contract).  The clipped row-0 states are exactly the
+            # identities: count's eval_final forces validity over the
+            # zeroed data, every other agg finalizes to null.  Under a
+            # round-robin exchange every device IS a live partition, so
+            # each empty device owes its own identity row; otherwise
+            # (single exchange / partial-final) only device 0 does.
+            empty = n_groups == 0
+            if n.exec_mode == "single" and \
+                    self._admitting_exchange_mode(n) == "round_robin":
+                force = empty
+            else:
+                force = jnp.logical_and(self._axis_index() == 0, empty)
+            n_groups = jnp.where(force, 1, n_groups)
         live = jnp.arange(t.capacity) < n_groups
         if n.exec_mode in ("final", "single"):
             final_cols = list(out_cols[:nk])
@@ -429,6 +455,29 @@ class _StageTracer:
 
     def _do_limit(self, n: P.Limit) -> DeviceTable:
         raise SpmdUnsupported("limit inside an SPMD stage")
+
+
+def _single_agg_ok(agg, exchanges) -> bool:
+    """A single-mode agg is per-partition; in SPMD the device is the
+    partition.  Admit it only when the exchange feeding it guarantees
+    per-device groups are complete: a single-partition exchange (all rows
+    on one device), a hash exchange whose keys are a subset of the
+    grouping keys (every group wholly on one device), or a round-robin
+    exchange under an UNGROUPED agg (per-partition global rows, the
+    engine's per-partition contract)."""
+    child = agg.child
+    if not (isinstance(child, P.IpcReader) and
+            child.resource_id in exchanges):
+        return False
+    part = exchanges[child.resource_id].partitioning
+    if part.mode == "single":
+        return True
+    if part.mode == "hash":
+        grouping = set(agg.grouping)
+        return all(e in grouping for e in (part.expressions or ()))
+    if part.mode == "round_robin":
+        return not agg.grouping
+    return False
 
 
 def _require_native(node) -> P.PlanNode:
@@ -692,9 +741,12 @@ def precheck_plan(plan, conv_ctx) -> None:
             jt = node.join_type
             if jt not in ("inner", "left"):
                 raise SpmdUnsupported(f"SPMD join type {jt!r}")
-        if node.kind == "agg" and node.exec_mode == "single":
+        if node.kind == "agg" and node.exec_mode == "single" and \
+                not _single_agg_ok(node, getattr(conv_ctx, "exchanges",
+                                                 None) or {}):
             raise SpmdUnsupported(
-                "single-mode agg needs the partial/exchange/final shape")
+                "single-mode agg needs an exchange (or partial/final "
+                "shape)")
 
 
 def _materialize_scans(plan, conv_ctx):

@@ -91,7 +91,9 @@ def test_config_doc_covers_all_options():
     for opt in config.conf.options():
         assert f"`{opt.key}`" in doc
     # the generated reference in the repo is up to date
-    with open("CONFIG.md") as f:
+    import pathlib
+    cfg_md = pathlib.Path(__file__).resolve().parent.parent / "CONFIG.md"
+    with open(cfg_md) as f:
         committed = f.read()
     for opt in config.conf.options():
         assert f"`{opt.key}`" in committed, \

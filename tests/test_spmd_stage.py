@@ -32,9 +32,11 @@ class _Ctx:
 
 def _canon(rows):
     def norm(v):
+        if v is None:               # None-safe sort (null grouping keys)
+            return (0, "")
         if isinstance(v, float):
-            return round(v, 6)
-        return v
+            return (1, round(v, 6))
+        return (1, v)
     return sorted(tuple(sorted((k, norm(v)) for k, v in r.items()))
                   for r in rows)
 
@@ -203,6 +205,54 @@ def test_spmd_round_robin_and_single_exchange():
         # a global agg after an exchange produces one row PER DEVICE that
         # holds rows; total count must equal the table size
         assert sum(r["c"] for r in got) == fact.num_rows
+
+
+def test_spmd_single_agg_guards():
+    """Review round-3: (a) an all-empty ungrouped single agg emits the
+    one identity row (count=0) like the serial engine; (b) a single-mode
+    GROUPED agg after a hash exchange on non-grouping keys is rejected
+    (per-device groups would be incomplete)."""
+    fact = make_fact(n=800, keys=16)
+    fact_schema = from_arrow_schema(fact.schema)
+    mesh = data_mesh(8)
+
+    # (a) filter everything out, then global count
+    ctx = _Ctx()
+    ctx.exchanges["ex0"] = ShuffleJob(
+        rid="ex0",
+        child=P.Filter(
+            child=P.FFIReader(schema=fact_schema, resource_id="fact"),
+            predicates=(E.BinaryExpr(left=col("key"), op="<",
+                                     right=lit(-1)),)),
+        partitioning=P.Partitioning(mode="single", num_partitions=1),
+        schema=None)
+    plan = P.Agg(
+        child=P.IpcReader(schema=None, resource_id="ex0"),
+        exec_mode="single", grouping=(), grouping_names=(),
+        aggs=(AggExpr(fn="count", children=(col("key"),), return_type=I64),
+              AggExpr(fn="sum", children=(col("amount"),),
+                      return_type=F64)),
+        agg_names=("c", "s"))
+    got = execute_plan_spmd(plan, ctx, mesh, {"fact": fact}).to_pylist()
+    assert got == [{"c": 0, "s": None}]
+
+    # (b) grouped single agg over a hash exchange on a DIFFERENT column
+    ctx2 = _Ctx()
+    ctx2.exchanges["ex1"] = ShuffleJob(
+        rid="ex1",
+        child=P.FFIReader(schema=fact_schema, resource_id="fact"),
+        partitioning=P.Partitioning(mode="hash", num_partitions=8,
+                                    expressions=(col("amount"),)),
+        schema=None)
+    bad = P.Agg(
+        child=P.IpcReader(schema=None, resource_id="ex1"),
+        exec_mode="single", grouping=(col("key"),),
+        grouping_names=("key",),
+        aggs=(AggExpr(fn="count", children=(col("key"),),
+                      return_type=I64),),
+        agg_names=("c",))
+    with pytest.raises(SpmdUnsupported, match="single-mode agg"):
+        execute_plan_spmd(bad, ctx2, mesh, {"fact": fact})
 
 
 def test_spmd_join_duplicate_build_keys_guard():
