@@ -182,7 +182,10 @@ class Batch:
 
     def num_rows_dev(self):
         """Row count as a device scalar (no sync)."""
-        return jnp.asarray(self._num_rows, jnp.int32)
+        n = self._num_rows
+        if isinstance(n, jnp.ndarray) and n.dtype == jnp.int32:
+            return n          # avoid an eager convert dispatch per call
+        return jnp.asarray(n, jnp.int32)
 
     # -- constructors -------------------------------------------------------
 

@@ -223,6 +223,14 @@ AGG_MERGE_FANIN = conf.define(
     "reduce; higher values amortize the per-merge host sync over more "
     "input batches (the multi-level merge analogue, agg_table.rs:323).",
 )
+AGG_GROUPING_STRATEGY = conf.define(
+    "auron.agg.grouping.strategy", "auto",
+    "Group-id assignment inside the agg reduce kernel: 'sort' (lexsort + "
+    "boundary scan — the TPU-native form), 'hash' (linear-probed scatter "
+    "table, ops/hash_group.py — the agg_hash_map.rs analogue; CPU "
+    "backend only, ignored elsewhere), or 'auto' (hash on CPU, sort "
+    "elsewhere).",
+)
 PARTIAL_AGG_SKIPPING_ENABLE = conf.define(
     "auron.partial.agg.skipping.enable", True,
     "Skip partial aggregation when cardinality reduction is poor "

@@ -100,6 +100,7 @@ class AggExec(Operator, MemConsumer):
 
         # device accumulator: staged grouped entries (cols, n_dev, cap)
         self._staged: List[Tuple[List[Any], Any, int]] = []
+        self._staged_unsorted = False          # any hash-grouped entries
         self._acc_rows = 0                     # host estimate after compaction
         self._host_groups: Dict = {}           # host path accumulator
         self._spills = SpillManager("agg")
@@ -130,32 +131,55 @@ class AggExec(Operator, MemConsumer):
             fields.extend(spec.state_fields())
         return Schema(tuple(fields))
 
-    def _reduce_kernel(self, merge: bool):
-        """One cached jitted kernel: sort by key + segment-reduce; takes an
-        explicit live mask so callers never sync (the n_groups output stays
-        on device)."""
+    def _grouping_strategy(self) -> str:
+        """sort | hash; 'auto' resolves to hash on the CPU backend (XLA's
+        comparator sort is ~3x numpy there; scatter/gather are fast) and
+        sort elsewhere.  hash is CPU-ONLY even when set explicitly: on
+        TPU scatters serialize, and the hash dispatch fuses every spec's
+        merge reduction into one kernel — the exact shape that SIGSEGVs
+        the libtpu AOT compiler (see _reduce)."""
+        import jax
+        if jax.default_backend() != "cpu":
+            return "sort"
+        s = str(conf.get("auron.agg.grouping.strategy"))
+        return "hash" if s in ("auto", "hash") else "sort"
+
+    def _reduce_kernel(self, merge: bool, strategy: str = "sort"):
+        """One cached jitted kernel: group (sort- or hash-based) +
+        segment-reduce; takes an explicit live mask so callers never sync
+        (the n_groups output stays on device)."""
         from auron_tpu.ops.kernel_cache import cached_jit
         specs, orders = self.specs, self._key_orders()
         nk = len(self.grouping)
         key = ("agg.group_reduce", self._spec_struct_key(), orders, merge,
-               nk)
+               nk, strategy)
 
         def build():
+            body = _group_reduce_body_hash if strategy == "hash" \
+                else _group_reduce_body
+
             def run(keys, value_cols, live):
-                return _group_reduce_body(keys, value_cols, live, specs,
-                                          orders, merge)
+                return body(keys, value_cols, live, specs, orders, merge)
             return run
         return cached_jit(key, build)
 
     def _reduce(self, keys: List[Any], vcols: List[List[Any]], live,
-                merge: bool):
+                merge: bool, force_sort: bool = False):
         """Dispatch a group reduction.  The update path is one fused
         kernel; the MERGE path splits into a shared sort-base kernel plus
         one kernel per agg spec: fusing two specs' merge reductions into a
         single program SIGSEGVs the current libtpu AOT compiler (observed
         on v5e; each piece compiles fine in isolation), and the split is
-        behaviorally identical with only extra async dispatches."""
+        behaviorally identical with only extra async dispatches.
+
+        force_sort callers (spill runs, the merge-carry loop) depend on
+        key-sorted group output; everything else may take the hash path.
+        """
         from auron_tpu.ops.kernel_cache import cached_jit
+        if not force_sort and self._grouping_strategy() == "hash":
+            # hash grouping is CPU-only, where the fused multi-spec merge
+            # kernel is safe (the SIGSEGV above is a libtpu AOT issue)
+            return self._reduce_kernel(merge, "hash")(keys, vcols, live)
         if not merge or len(self.specs) <= 1:
             return self._reduce_kernel(merge)(keys, vcols, live)
         orders = self._key_orders()
@@ -192,7 +216,8 @@ class AggExec(Operator, MemConsumer):
                 k = len(spec.state_fields())
                 vcols.append(states[off:off + k])
                 off += k
-            return self._reduce(keys, vcols, live, merge=True)
+            return self._reduce(keys, vcols, live, merge=True,
+                                force_sort=True)
         return run
 
     def _group_reduce(self, keys: List[Any], value_cols: List[List[Any]],
@@ -213,8 +238,24 @@ class AggExec(Operator, MemConsumer):
     # round trips per batch ~ 1/fanin — the design answer to the
     # per-batch-sync problem (VERDICT round 1, weak #2).
 
-    def _stage(self, cols: List[Any], n_dev, capacity: int) -> None:
+    def _stage(self, cols: List[Any], n_dev, capacity: int,
+               unsorted: bool = False) -> None:
         self._staged.append((cols, n_dev, capacity))
+        if unsorted:
+            # hash-grouped entries are first-winner ordered; spill files
+            # and the merge-carry loop need key-sorted runs, so the next
+            # _compact_staged must run the (sorting) merge kernel even if
+            # only one entry is staged
+            self._staged_unsorted = True
+        # start the group count's device->host copy NOW (non-blocking):
+        # by merge time the value is host-resident, so the one batched
+        # count fetch in _compact_staged costs no extra round trip
+        copy_async = getattr(n_dev, "copy_to_host_async", None)
+        if copy_async is not None:
+            try:
+                copy_async()
+            except Exception:  # noqa: BLE001 - best-effort prefetch
+                pass
         fanin = int(conf.get("auron.agg.merge.fanin"))
         if len(self._staged) >= fanin:
             self._compact_staged()
@@ -237,7 +278,7 @@ class AggExec(Operator, MemConsumer):
         from auron_tpu.ops.kernel_cache import cached_jit, host_sync
         if not self._staged:
             return
-        if len(self._staged) == 1:
+        if len(self._staged) == 1 and not self._staged_unsorted:
             # nothing to merge, but callers (skip check, emission) rely on
             # _acc_rows reflecting the staged entry's true group count
             cols, n, cap = self._staged[0]
@@ -246,11 +287,26 @@ class AggExec(Operator, MemConsumer):
                 self._staged[0] = (cols, n, cap)
             self._acc_rows = int(n)
             return
-        entries_cols = [cols for cols, _n, _c in self._staged]
-        entries_ns = [n for _c, n, _cap in self._staged]
+        # truncate every entry to its live group prefix BEFORE merging:
+        # staged entries sit at INPUT capacity (1M rows for a few thousand
+        # groups), so merging untruncated entries lexsorts mostly padding.
+        # One batched fetch (counts were prefetched async at stage time).
+        ns = [int(x) for x in host_sync(
+            [n for _c, n, _cap in self._staged])]
+        trunc = cached_jit("agg.truncate", _truncate_builder,
+                           static_argnames=("out_cap",))
+        staged = []
+        for (cols, _n, cap), n in zip(self._staged, ns):
+            want = min(bucket_capacity(max(n, 1)), cap)
+            if want < cap:
+                cols = trunc(cols, out_cap=want)
+                cap = want
+            staged.append((cols, n, cap))
+        entries_cols = [cols for cols, _n, _c in staged]
+        entries_ns = [n for _c, n, _cap in staged]
         out_cols, n_dev = self._merge_staged_kernel()(entries_cols,
                                                       entries_ns)
-        merged_cap = sum(cap for _c, _n, cap in self._staged)
+        merged_cap = sum(cap for _c, _n, cap in staged)
         n = int(host_sync(n_dev))
         # never exceed the merged arrays' real length (bucket_capacity can
         # round PAST it, leaving capacity > column length)
@@ -261,6 +317,7 @@ class AggExec(Operator, MemConsumer):
                                 static_argnames=("out_cap",))
             out_cols = kernel(out_cols, out_cap=out_cap)
         self._staged = [(list(out_cols), n, out_cap)]
+        self._staged_unsorted = False    # the merge kernel key-sorts
         self._acc_rows = n
         self.update_mem_used(self._staged_mem_bytes())
 
@@ -419,7 +476,8 @@ class AggExec(Operator, MemConsumer):
             keys, vcols = self._eval_vcols(b, ctx, merge_input)
             out_cols, n_dev = self._reduce(keys, vcols, b.row_mask(),
                                            merge_input)
-            self._stage(out_cols, n_dev, b.capacity)
+            self._stage(out_cols, n_dev, b.capacity,
+                        unsorted=self._grouping_strategy() == "hash")
             # partial-agg skipping (agg_ctx.rs:63-66)
             if self.supports_partial_skipping and \
                     self._input_rows >= int(conf.get(
@@ -512,7 +570,7 @@ class AggExec(Operator, MemConsumer):
                 vcols.append(states[off:off + k])
                 off += k
             out_cols, n_dev = self._reduce(keys, vcols, mb.row_mask(),
-                                           merge=True)
+                                           merge=True, force_sort=True)
             cap = mb.capacity
             if carry is not None:
                 out_cols, n_dev = self._merge_staged_kernel()(
@@ -605,6 +663,36 @@ def _group_reduce_body(keys: List[Any], value_cols: List[List[Any]],
         else:
             states = spec.update_segments(scols, seg_of_sorted, capacity)
         out_cols.extend(_clip_states(states, n_groups))
+    return out_cols, n_groups
+
+
+def _group_reduce_body_hash(keys: List[Any], value_cols: List[List[Any]],
+                            live, specs, orders, merge: bool):
+    """Hash-table group reduction (ops/hash_group.py): same output
+    structure as `_group_reduce_body` but groups arrive in first-winner
+    row order, NOT key order — callers needing sorted runs must use the
+    sort body.  Value columns reduce in original row order via unsorted
+    (scatter) segment kernels."""
+    from auron_tpu.ops import segments
+    from auron_tpu.ops.hash_group import hash_group_structure
+    capacity = live.shape[0]
+    words = encode_sort_keys(keys, orders)
+    if words:
+        seg, key_src, n_groups = hash_group_structure(words, live)
+    else:
+        first = jnp.argmax(live).astype(jnp.int32)
+        n_groups = jnp.any(live).astype(jnp.int32)
+        seg = jnp.where(live, 0, max(capacity - 1, 0)).astype(jnp.int32)
+        key_src = jnp.zeros(capacity, jnp.int32).at[0].set(first)
+    g_valid = jnp.arange(capacity) < n_groups
+    out_cols: List[Any] = [k.gather(key_src, g_valid) for k in keys]
+    with segments.unsorted_segments():
+        for spec, cols in zip(specs, value_cols):
+            if merge:
+                states = spec.merge_segments(cols, seg, capacity)
+            else:
+                states = spec.update_segments(cols, seg, capacity)
+            out_cols.extend(_clip_states(states, n_groups))
     return out_cols, n_groups
 
 

@@ -1,0 +1,94 @@
+"""Hash-based group-id assignment: linear-probed scatter table, no sort.
+
+The sort-based group reduction (`_group_reduce_body`) pays one megarow
+lexsort per input batch; on the CPU backend XLA's comparator sort is ~3x
+slower than numpy's and dominates the whole query (engine profile,
+round 3).  Scatter/gather, by contrast, are FASTER than numpy there — so
+the CPU backend groups by building an open-addressing hash table of row
+ids (scatter-min + probe rounds), mirroring the reference's hash-map agg
+(agg/agg_hash_map.rs:26 — its SIMD probe loop) instead of its
+radix-sort shuffle path.  TPU keeps the sort-based kernel: scatters
+serialize there (ops/segments.py docstring) and the TPU sort is fast.
+
+Contract (mirrors the sort path's group structure):
+
+    seg, key_src, n_groups = hash_group_structure(words, live)
+
+- `words`: equality-preserving u64 encodings (encode_sort_keys), so
+  grouping equality matches the sort path exactly — including the
+  truncated-prefix string preorder and canonicalized floats.
+- `seg[i]`: dense group id of live row i, in FIRST-WINNER row order;
+  dead rows map to the padding segment `capacity-1` (same trick as the
+  sort path; padding can never collide with a real group because
+  n_groups <= n_live < capacity whenever dead rows exist).
+- `key_src`: row index of each group's representative, densely packed
+  [0, n_groups) in ascending row order.
+- group order is NOT key-sorted: consumers that need sorted runs
+  (spill files, the merge-carry loop) must force the sort kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_SENT = np.int32(2**31 - 1)
+
+
+def _mix64(h):
+    """splitmix64 finalizer (public-domain constant mix)."""
+    h = (h ^ (h >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    h = (h ^ (h >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return h ^ (h >> 31)
+
+
+def hash_group_structure(words: List[Any], live
+                         ) -> Tuple[Any, Any, Any]:
+    capacity = int(live.shape[0])
+    table_size = 1 << max(3, (2 * capacity - 1).bit_length())
+    h = None
+    for w in words:
+        hw = _mix64(w.astype(jnp.uint64))
+        h = hw if h is None else _mix64(h ^ hw)
+    slot0 = (h & jnp.uint64(table_size - 1)).astype(jnp.int32)
+    rows = jnp.arange(capacity, dtype=jnp.int32)
+
+    def cond(carry):
+        _slot, _owner, done = carry
+        return jnp.any(jnp.logical_not(done))
+
+    def body(carry):
+        slot, owner, done = carry
+        cand = jnp.where(done, _SENT, rows)
+        table = jnp.full((table_size,), _SENT, jnp.int32) \
+            .at[slot].min(cand, mode="drop")
+        win = jnp.take(table, slot)
+        ok = jnp.logical_and(jnp.logical_not(done), win != _SENT)
+        win_c = jnp.clip(win, 0, capacity - 1)
+        for w in words:
+            ok = jnp.logical_and(ok, jnp.take(w, win_c) == w)
+        owner = jnp.where(ok, win_c, owner)
+        done = jnp.logical_or(done, ok)
+        slot = jnp.where(done, slot,
+                         (slot + 1) & jnp.int32(table_size - 1))
+        return slot, owner, done
+
+    # every round resolves at least the globally smallest unresolved
+    # row's whole group (it wins its slot), so the loop terminates in
+    # <= n_distinct_keys rounds — typically a handful
+    _, owner, _ = lax.while_loop(
+        cond, body,
+        (slot0, jnp.zeros(capacity, jnp.int32), jnp.logical_not(live)))
+
+    mark = jnp.logical_and(live, owner == rows)
+    prefix = jnp.cumsum(mark.astype(jnp.int32))
+    n_groups = prefix[-1]
+    gid_at_winner = prefix - 1
+    gid = jnp.take(gid_at_winner, owner)
+    seg = jnp.where(live, gid, capacity - 1).astype(jnp.int32)
+    key_src = jnp.nonzero(mark, size=capacity, fill_value=0)[0] \
+        .astype(jnp.int32)
+    return seg, key_src, n_groups

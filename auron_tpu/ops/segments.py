@@ -31,6 +31,29 @@ import jax.numpy as jnp
 from auron_tpu.config import conf
 
 
+import threading
+
+_TRACE_MODE = threading.local()
+
+
+class unsorted_segments:
+    """Trace-time context: segment ids are NOT ascending (hash-grouped
+    reduction, ops/hash_group.py) — route to jax.ops.segment_* scatter
+    kernels instead of the sorted gather-shaped forms.  Thread-local so a
+    concurrent task tracing a sorted kernel on another thread cannot be
+    poisoned into caching the scatter form."""
+
+    def __enter__(self):
+        _TRACE_MODE.unsorted = getattr(_TRACE_MODE, "unsorted", 0) + 1
+
+    def __exit__(self, *exc):
+        _TRACE_MODE.unsorted -= 1
+
+
+def _unsorted_mode() -> int:
+    return getattr(_TRACE_MODE, "unsorted", 0)
+
+
 def _use_sorted() -> bool:
     return bool(conf.get("auron.segments.sorted.enable"))
 
@@ -47,6 +70,8 @@ def sorted_segment_sum(x, seg, num_segments: int):
     jax.ops.segment_sum(x, seg, num_segments))."""
     if x.shape[0] == 0:
         return jnp.zeros((num_segments,), x.dtype)
+    if _unsorted_mode():
+        return jax.ops.segment_sum(x, seg, num_segments=num_segments)
     if not _use_sorted():
         return jax.ops.segment_sum(x, seg, num_segments=num_segments,
                                    indices_are_sorted=True)
@@ -83,6 +108,9 @@ def _sorted_segment_extreme(x, seg, num_segments: int, op_is_min: bool):
     fill = _extreme_identity(x.dtype, op_is_min)
     if x.shape[0] == 0:
         return jnp.full((num_segments,), fill, x.dtype)
+    if _unsorted_mode():
+        f = jax.ops.segment_min if op_is_min else jax.ops.segment_max
+        return f(x, seg, num_segments=num_segments)
     if not _use_sorted():
         f = jax.ops.segment_min if op_is_min else jax.ops.segment_max
         return f(x, seg, num_segments=num_segments, indices_are_sorted=True)
