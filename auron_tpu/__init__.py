@@ -17,7 +17,12 @@ lowers to jax.jit-compiled XLA programs on TPU:
   spill/shuffle file IO and hashing where Auron uses Rust.
 
 64-bit types are enabled globally: SQL semantics require int64 sums,
-timestamp micros and 64-bit hashes (Spark's BIGINT / xxhash64).
+timestamp micros and 64-bit hashes (Spark's BIGINT / xxhash64) — jax's
+x64 switch is all-or-nothing, and without it BIGINT columns silently
+truncate.  The cost is contained instead (the round-1 x64 audit): every
+index/permutation/iota/mask path is explicit int32 (capacities are
+< 2^31 by construction), murmur3 runs in uint32, and only column VALUES
+whose SQL type demands it carry 64-bit lanes.
 """
 
 from __future__ import annotations

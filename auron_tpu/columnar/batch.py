@@ -218,7 +218,7 @@ class Batch:
 
     def row_mask(self) -> Array:
         """bool[capacity]: True for live rows (no sync)."""
-        return jnp.arange(self.capacity) < self.num_rows_dev()
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows_dev()
 
     # -- transforms ---------------------------------------------------------
 
@@ -265,7 +265,7 @@ class Batch:
         """Logical truncation (no data movement): clamp num_rows and fix
         validity beyond n."""
         n = min(n, self.num_rows)
-        mask = jnp.arange(self.capacity) < jnp.int32(n)
+        mask = jnp.arange(self.capacity, dtype=jnp.int32) < jnp.int32(n)
         cols: List[Column] = []
         for c in self.columns:
             if isinstance(c, HostColumn):
@@ -322,7 +322,7 @@ def _zero_like(a: Array):
 
 def _gather_kernel_builder():
     def run(cols, indices, num_rows):
-        valid = jnp.arange(indices.shape[0]) < num_rows
+        valid = jnp.arange(indices.shape[0], dtype=jnp.int32) < num_rows
         return [c.gather(indices, valid) for c in cols]
     return run
 

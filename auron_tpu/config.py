@@ -223,6 +223,14 @@ AGG_MERGE_FANIN = conf.define(
     "reduce; higher values amortize the per-merge host sync over more "
     "input batches (the multi-level merge analogue, agg_table.rs:323).",
 )
+SPMD_EXCHANGE_QUOTA_MARGIN = conf.define(
+    "auron.spmd.exchange.quota.margin", 2.0,
+    "Skew headroom for SPMD hash/round-robin exchanges: each device's "
+    "per-destination send quota is ceil(capacity/n_dev) * margin, so "
+    "post-exchange buffers are O(global/n_dev * margin) instead of "
+    "O(global).  Overflowing rows trip a runtime guard and the driver "
+    "falls back to the serial engine.",
+)
 AGG_GROUPING_STRATEGY = conf.define(
     "auron.agg.grouping.strategy", "auto",
     "Group-id assignment inside the agg reduce kernel: 'sort' (lexsort + "

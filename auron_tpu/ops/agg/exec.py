@@ -224,7 +224,7 @@ class AggExec(Operator, MemConsumer):
                       capacity: int, num_rows, merge: bool) -> Batch:
         """Compat wrapper: reduce one batch worth of rows to a grouped
         Batch with a LAZY group count (no host sync)."""
-        live = jnp.arange(capacity) < jnp.asarray(num_rows, jnp.int32)
+        live = jnp.arange(capacity, dtype=jnp.int32) < jnp.asarray(num_rows, jnp.int32)
         out_cols, n_dev = self._reduce(keys, value_cols, live, merge)
         return Batch(self._state_schema(), out_cols, n_dev, capacity)
 
@@ -638,13 +638,13 @@ def _group_reduce_body(keys: List[Any], value_cols: List[List[Any]],
     n_live = jnp.sum(live.astype(jnp.int32))
     words = encode_sort_keys(keys, orders)
     perm = lexsort_indices_live(words, live)
-    slive = jnp.arange(capacity) < n_live
+    slive = jnp.arange(capacity, dtype=jnp.int32) < n_live
     sorted_words = [jnp.take(w, perm) for w in words]
     if sorted_words:
         eq_prev = keys_equal_prev(sorted_words)
     else:
         # global agg: every row belongs to the single segment
-        eq_prev = jnp.arange(capacity) != 0
+        eq_prev = jnp.arange(capacity, dtype=jnp.int32) != 0
     is_boundary = jnp.logical_and(jnp.logical_not(eq_prev), slive)
     seg_of_sorted = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
     seg_of_sorted = jnp.where(slive, seg_of_sorted, capacity - 1)
@@ -652,7 +652,7 @@ def _group_reduce_body(keys: List[Any], value_cols: List[List[Any]],
     first_sorted_idx = jnp.nonzero(is_boundary, size=capacity,
                                    fill_value=0)[0].astype(jnp.int32)
     key_src = jnp.take(perm, first_sorted_idx)
-    g_valid = jnp.arange(capacity) < n_groups
+    g_valid = jnp.arange(capacity, dtype=jnp.int32) < n_groups
     out_cols: List[Any] = []
     for k in keys:
         out_cols.append(k.gather(key_src, g_valid))
@@ -684,7 +684,7 @@ def _group_reduce_body_hash(keys: List[Any], value_cols: List[List[Any]],
         n_groups = jnp.any(live).astype(jnp.int32)
         seg = jnp.where(live, 0, max(capacity - 1, 0)).astype(jnp.int32)
         key_src = jnp.zeros(capacity, jnp.int32).at[0].set(first)
-    g_valid = jnp.arange(capacity) < n_groups
+    g_valid = jnp.arange(capacity, dtype=jnp.int32) < n_groups
     out_cols: List[Any] = [k.gather(key_src, g_valid) for k in keys]
     with segments.unsorted_segments():
         for spec, cols in zip(specs, value_cols):
@@ -704,12 +704,12 @@ def _sort_base_builder(orders):
         n_live = jnp.sum(live.astype(jnp.int32))
         words = encode_sort_keys(keys, orders)
         perm = lexsort_indices_live(words, live)
-        slive = jnp.arange(capacity) < n_live
+        slive = jnp.arange(capacity, dtype=jnp.int32) < n_live
         sorted_words = [jnp.take(w, perm) for w in words]
         if sorted_words:
             eq_prev = keys_equal_prev(sorted_words)
         else:
-            eq_prev = jnp.arange(capacity) != 0
+            eq_prev = jnp.arange(capacity, dtype=jnp.int32) != 0
         is_boundary = jnp.logical_and(jnp.logical_not(eq_prev), slive)
         seg = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
         seg = jnp.where(slive, seg, capacity - 1)
@@ -717,7 +717,7 @@ def _sort_base_builder(orders):
         first_idx = jnp.nonzero(is_boundary, size=capacity,
                                 fill_value=0)[0].astype(jnp.int32)
         key_src = jnp.take(perm, first_idx)
-        g_valid = jnp.arange(capacity) < n_groups
+        g_valid = jnp.arange(capacity, dtype=jnp.int32) < n_groups
         key_out = [k.gather(key_src, g_valid) for k in keys]
         return perm, seg, n_groups, key_out
     return run
@@ -735,7 +735,7 @@ def _spec_merge_builder(spec):
 
 def _concat_staged_builder():
     def run(entries_cols, entries_ns):
-        lives = [jnp.arange(cols[0].data.shape[0] if cols else 0) < n
+        lives = [jnp.arange(cols[0].data.shape[0] if cols else 0, dtype=jnp.int32) < n
                  for cols, n in zip(entries_cols, entries_ns)]
         ncols = len(entries_cols[0])
         merged = [_concat_cols([e[i] for e in entries_cols])
@@ -781,7 +781,7 @@ def _clip_states(states: List[Any], n_groups: int) -> List[Any]:
     out = []
     for s in states:
         cap = s.capacity
-        live = jnp.arange(cap) < n_groups
+        live = jnp.arange(cap, dtype=jnp.int32) < n_groups
         if isinstance(s, DeviceStringColumn):
             out.append(DeviceStringColumn(
                 s.dtype, jnp.where(live[:, None], s.data, 0),

@@ -106,11 +106,11 @@ class FilterExec(Operator):
 def _filter_compact_builder():
     def run(cols, mask_data, mask_valid, num_rows):
         cap = mask_data.shape[0]
-        live = jnp.arange(cap) < num_rows
+        live = jnp.arange(cap, dtype=jnp.int32) < num_rows
         keep = jnp.logical_and(
             jnp.logical_and(mask_valid, mask_data.astype(bool)), live)
         idx, count = compact_indices(keep, cap)
-        valid = jnp.arange(cap) < count
+        valid = jnp.arange(cap, dtype=jnp.int32) < count
         return [c.gather(idx, valid) for c in cols], idx, count
     return run
 

@@ -175,7 +175,7 @@ def _build_range_kernel():
     is never repeated per chunk)."""
     def run(pkeys, sorted_hashes, probe_num_rows):
         pcap = pkeys[0].validity.shape[0]
-        plive = jnp.arange(pcap) < probe_num_rows
+        plive = jnp.arange(pcap, dtype=jnp.int32) < probe_num_rows
         ph, pvalid = join_key_hash(pkeys, pcap)
         lo, counts = probe_ranges(sorted_hashes, ph, pvalid, plive)
         return lo, counts, jnp.sum(counts)
@@ -197,7 +197,7 @@ def _build_pair_kernel(emit_pairs: bool, track_build: bool,
             *, chunk_cap):
         pcap = probe_matched_in.shape[0]
         bcap = perm.shape[0]
-        plive = jnp.arange(pcap) < probe_num_rows
+        plive = jnp.arange(pcap, dtype=jnp.int32) < probe_num_rows
         probe_idx, offset, pair_live = expand_pairs(lo, counts, start,
                                                     chunk_cap)
         sorted_pos = jnp.clip(jnp.take(lo, probe_idx) + offset, 0, bcap - 1)
@@ -211,7 +211,7 @@ def _build_pair_kernel(emit_pairs: bool, track_build: bool,
         n_pairs = jnp.int32(0)
         if emit_pairs:
             idx, n_pairs = compact_indices(ok, chunk_cap)
-            ev = jnp.arange(chunk_cap) < n_pairs
+            ev = jnp.arange(chunk_cap, dtype=jnp.int32) < n_pairs
             pi = jnp.take(probe_idx, idx)
             bi = jnp.take(build_idx, idx)
             out_p = [c.gather(pi, ev) for c in probe_cols]
@@ -225,7 +225,7 @@ def _build_pair_kernel(emit_pairs: bool, track_build: bool,
                 smask = jnp.logical_and(jnp.logical_not(probe_matched),
                                         plive)
             sidx, n_side = compact_indices(smask, pcap)
-            sv = jnp.arange(pcap) < n_side
+            sv = jnp.arange(pcap, dtype=jnp.int32) < n_side
             side_cols = [c.gather(sidx, sv) for c in probe_cols]
         counts3 = jnp.stack([total.astype(jnp.int64),
                              n_pairs.astype(jnp.int64),

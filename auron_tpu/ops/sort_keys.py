@@ -122,7 +122,7 @@ def encode_sort_keys(cols: Sequence[Any],
 def lexsort_indices(words: List[Any], num_rows, capacity: int):
     """Stable argsort by word list (most-significant first); padding rows
     (index >= num_rows) sort last.  Returns int32[capacity] permutation."""
-    live = jnp.arange(capacity) < jnp.asarray(num_rows, jnp.int32)
+    live = jnp.arange(capacity, dtype=jnp.int32) < jnp.asarray(num_rows, jnp.int32)
     return lexsort_indices_live(words, live)
 
 

@@ -369,7 +369,7 @@ def _default_window_type(wf: WindowFuncCall) -> DataType:
 def _boundaries(words, live, cap):
     if not words:
         # single partition: row 0 is the only boundary
-        return jnp.logical_and(jnp.arange(cap) == 0, live)
+        return jnp.logical_and(jnp.arange(cap, dtype=jnp.int32) == 0, live)
     eq = keys_equal_prev(words)
     return jnp.logical_and(jnp.logical_not(eq), live)
 

@@ -7,9 +7,17 @@ store), an SPMD stage reshuffles rows in-flight with lax.all_to_all.
 Shapes must be static, so the exchange uses a fixed per-destination quota
 Q: each device scatters its rows into an [N, Q] send buffer grouped by
 destination, all_to_all swaps blocks, and receivers compact the valid rows.
-Rows beyond quota would overflow — callers size Q = local capacity (safe
-upper bound: a device cannot send more rows than it holds) or run multiple
-rounds for skewed data.
+
+Quota sizing (round-3 fix: quota=capacity made every post-exchange buffer
+GLOBAL sized, nullifying memory scaling): hash/round-robin exchanges use a
+skew-margined per-destination quota ~ capacity/n_dev * margin, so the
+received buffer is O(global/n_dev * margin); a single-partition exchange
+keeps Q = capacity (one device legitimately receives everything).  Rows
+beyond quota cannot be silently lost: every exchange returns an `overflow`
+device flag that callers must surface (the SPMD stage compiler psums it
+into its runtime guards, and the driver falls back to the serial engine —
+the same escape hatch the reference's sort-based repartitioner never
+needs because its buffers are dynamic, buffered_data.rs:285).
 """
 
 from __future__ import annotations
@@ -19,6 +27,20 @@ from typing import Any, List, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def bounded_quota(capacity: int, n_dev: int,
+                  margin: float | None = None) -> int:
+    """Skew-margined per-destination quota for hash/round-robin exchanges:
+    ceil(capacity / n_dev) * margin, rounded up to a multiple of 8.  The
+    received buffer is then n_dev * quota ~= capacity * margin instead of
+    n_dev * capacity."""
+    if margin is None:
+        from auron_tpu.config import conf
+        margin = float(conf.get("auron.spmd.exchange.quota.margin"))
+    per = -(-capacity // max(n_dev, 1))
+    q = int(per * margin) + 8
+    return min(capacity, -(-q // 8) * 8)
 
 
 def _scatter_to_send(data, dest, valid, n_dev: int, quota: int):
@@ -46,7 +68,12 @@ def _scatter_to_send(data, dest, valid, n_dev: int, quota: int):
     send_valid = send_valid.at[flat_pos].set(ok, mode="drop")
     send = send[:n_dev * quota].reshape((n_dev, quota) + data.shape[1:])
     send_valid = send_valid[:n_dev * quota].reshape(n_dev, quota)
-    return send, send_valid
+    # a valid row routed to a real destination but past its quota slot was
+    # dropped from the buffer — flag it (callers must not ignore this)
+    overflow = jnp.any(jnp.logical_and(
+        jnp.logical_and(sorted_dest < n_dev, slot_sorted >= quota),
+        jnp.take(valid, order)))
+    return send, send_valid, overflow
 
 
 def all_to_all_repartition(arrays: List[Any], dest, valid, axis: str,
@@ -54,21 +81,27 @@ def all_to_all_repartition(arrays: List[Any], dest, valid, axis: str,
                            ) -> Tuple[List[Any], Any]:
     """Repartition rows of `arrays` (each [C, ...]) by `dest` device ids.
 
-    Returns (received_arrays each [N*Q, ...], received_valid [N*Q]).
+    Returns (received_arrays each [N*Q, ...], received_valid [N*Q],
+    overflow bool scalar — LOCAL to this device; psum/any-reduce it).
     Must run inside shard_map with named axis `axis`.
     """
     outs = []
     recv_valid = None
+    overflow = None
     for a in arrays:
-        send, send_valid = _scatter_to_send(a, dest, valid, n_dev, quota)
+        send, send_valid, ovf = _scatter_to_send(a, dest, valid, n_dev,
+                                                 quota)
         recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
                               tiled=False)
         outs.append(recv.reshape((n_dev * quota,) + a.shape[1:]))
         if recv_valid is None:
+            overflow = ovf
             rv = lax.all_to_all(send_valid, axis, split_axis=0,
                                 concat_axis=0, tiled=False)
             recv_valid = rv.reshape(n_dev * quota)
-    return outs, recv_valid
+    if overflow is None:
+        overflow = jnp.asarray(False)
+    return outs, recv_valid, overflow
 
 
 def broadcast_all_gather(arrays: List[Any], valid, axis: str
@@ -90,8 +123,8 @@ def global_sum(x, axis: str):
 
 def hierarchical_repartition(arrays: List[Any], dest, valid,
                              ici_axis: str, dcn_axis: str,
-                             n_ici: int, n_dcn: int, quota: int
-                             ) -> Tuple[List[Any], Any]:
+                             n_ici: int, n_dcn: int, quota: int,
+                             bound_stage2: bool = True):
     """Two-stage repartition for multi-slice meshes: rows first move
     WITHIN a slice (over the fast ICI axis) to the local chip whose ICI
     rank matches the destination chip, then cross slices over DCN in one
@@ -104,20 +137,30 @@ def hierarchical_repartition(arrays: List[Any], dest, valid,
     ICI, not DCN").
 
     `dest` is the GLOBAL destination device id laid out as
-    dcn_rank * n_ici + ici_rank.  Must run inside shard_map with both
-    named axes.  Returns ([n_dcn*n_ici*quota, ...] arrays, valid mask) on
-    each destination device (same contract as all_to_all_repartition).
+    dcn_rank * n_ici + ici_rank.  `quota` is the per-destination bound of
+    stage 1, which spreads over the n_ici LOCAL chips — size it for
+    n_ici destinations (bounded_quota(capacity, n_ici)), not n_dev.
+    Must run inside shard_map with both named axes.  Returns
+    ([n_dcn*q2, ...] arrays, valid mask, overflow flag) on each
+    destination device, where q2 = n_ici*quota unbounded, or its
+    n_dcn-margined bound when bound_stage2 (same row-layout contract as
+    all_to_all_repartition).
     """
     # stage 1 (ICI): deliver each row to the local chip with ici_rank ==
     # dest_ici; rows keep their dcn destination as payload
     dest_ici = (dest % n_ici).astype(jnp.int32)
     dest_dcn = (dest // n_ici).astype(jnp.int32)
-    stage1, v1 = all_to_all_repartition(
+    stage1, v1, ovf1 = all_to_all_repartition(
         arrays + [dest_dcn], dest_ici, valid, ici_axis, n_ici, quota)
     payload1, dcn1 = stage1[:-1], stage1[-1]
     # stage 2 (DCN): every chip now holds only rows whose final chip has
-    # its own ici_rank; swap across slices by dcn rank
-    q2 = n_ici * quota
-    stage2, v2 = all_to_all_repartition(
+    # its own ici_rank; swap across slices by dcn rank.  Stage-1 output
+    # splits over n_dcn destinations, so the same margined bound applies
+    # (n_ici*quota covers the worst case; the bound keeps receive buffers
+    # O(global/n_dev))
+    cap1 = n_ici * quota
+    q2 = cap1 if (n_dcn <= 1 or not bound_stage2) \
+        else min(cap1, bounded_quota(cap1, n_dcn))
+    stage2, v2, ovf2 = all_to_all_repartition(
         payload1, dcn1, v1, dcn_axis, n_dcn, q2)
-    return stage2, v2
+    return stage2, v2, jnp.logical_or(ovf1, ovf2)

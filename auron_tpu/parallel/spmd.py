@@ -57,7 +57,7 @@ def make_query_step(mesh: Mesh, axis: str = "parts",
         kcol = _FakeCol(key, keep)
         h = H.hash_columns([kcol], seed=42)
         pid = H.pmod(h, n_dev)
-        (rk, rnet), rvalid = all_to_all_repartition(
+        (rk, rnet), rvalid, _ovf = all_to_all_repartition(
             [key, net], pid, keep, axis, n_dev, quota)
         # 4. broadcast exchange: dim table arrives sharded; all_gather
         #    materializes the full build side on every device (the
@@ -108,7 +108,7 @@ def local_group_aggregate(key, value, live, dim_key, dim_val):
     counts = segments.sorted_segment_sum(slive.astype(jnp.int64), seg,
                                          cap2)
     first_idx = jnp.nonzero(boundary, size=cap2, fill_value=cap2 - 1)[0]
-    gkeys = jnp.where(jnp.arange(cap2) < jnp.sum(boundary),
+    gkeys = jnp.where(jnp.arange(cap2, dtype=jnp.int32) < jnp.sum(boundary),
                       jnp.take(sk, first_idx), -1)
     # stable: with duplicate dim keys, the first-occurring row must win
     # deterministically (searchsorted probes the leftmost equal slot)

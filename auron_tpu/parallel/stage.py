@@ -48,7 +48,7 @@ from auron_tpu.ir.expr import Expr
 from auron_tpu.ir.node import Node
 from auron_tpu.ir.schema import DataType, Field, Schema
 from auron_tpu.parallel.exchange import (
-    all_to_all_repartition, broadcast_all_gather,
+    all_to_all_repartition, bounded_quota, broadcast_all_gather,
     hierarchical_repartition,
 )
 
@@ -185,18 +185,35 @@ class _StageTracer:
         else:
             raise SpmdUnsupported(f"partitioning mode {part.mode!r}")
         flat, treedef = jax.tree.flatten(t.cols)
+        # bounded quota for spreading modes (hash/rr): received buffers
+        # stay O(global/n_dev * margin); a single-partition exchange
+        # legitimately funnels everything to one device, so it keeps the
+        # full-capacity quota.  Overflow (quota exceeded under skew) trips
+        # a runtime guard -> driver falls back to the serial engine.
         if isinstance(self.axis, tuple):
             # 2-D (dcn, ici) mesh: two-stage exchange so every row crosses
-            # the slow DCN axis at most once (SURVEY 2.5 comm-backend row)
+            # the slow DCN axis at most once (SURVEY 2.5 comm-backend
+            # row).  Stage 1 spreads over only the n_ici LOCAL
+            # destinations, so its quota is sized for n_ici — an
+            # n_dev-sized quota would overflow on uniform data whenever
+            # n_dcn > margin
             a_dcn, a_ici = self.axis
             n_dcn, n_ici = self.axis_sizes
-            outs, live = hierarchical_repartition(
+            q1 = t.capacity if part.mode == "single" \
+                else bounded_quota(t.capacity, n_ici)
+            outs, live, ovf = hierarchical_repartition(
                 flat, pid, t.live, a_ici, a_dcn, n_ici, n_dcn,
-                quota=t.capacity)
+                quota=q1, bound_stage2=part.mode != "single")
+            any_ovf = lax.psum(
+                lax.psum(ovf.astype(jnp.int32), a_ici), a_dcn) > 0
         else:
-            outs, live = all_to_all_repartition(flat, pid, t.live,
-                                                self.axis, n_dev,
-                                                quota=t.capacity)
+            quota = t.capacity if part.mode == "single" \
+                else bounded_quota(t.capacity, n_dev)
+            outs, live, ovf = all_to_all_repartition(flat, pid, t.live,
+                                                     self.axis, n_dev,
+                                                     quota=quota)
+            any_ovf = lax.psum(ovf.astype(jnp.int32), self.axis) > 0
+        self.guards.append(any_ovf)
         cols = jax.tree.unflatten(treedef, outs)
         return DeviceTable(t.schema, cols, live)
 
@@ -359,7 +376,7 @@ class _StageTracer:
             else:
                 force = jnp.logical_and(self._axis_index() == 0, empty)
             n_groups = jnp.where(force, 1, n_groups)
-        live = jnp.arange(t.capacity) < n_groups
+        live = jnp.arange(t.capacity, dtype=jnp.int32) < n_groups
         if n.exec_mode in ("final", "single"):
             final_cols = list(out_cols[:nk])
             off = nk
@@ -607,8 +624,11 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     # program cache: repeat executions of the SAME converted plan over the
     # same input shapes reuse the compiled shard_map program (a fresh
     # jax.jit closure per call would re-trace+re-compile every time)
+    from auron_tpu.config import conf as _conf
     cache_key = (
         plan, axis, n_dev,
+        # trace-time config the compiled program bakes in
+        float(_conf.get("auron.spmd.exchange.quota.margin")),
         tuple(sorted((rid, job.child, job.partitioning)
                      for rid, job in (getattr(conv_ctx, "exchanges", None)
                                       or {}).items())),
@@ -661,8 +681,8 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         (out_live, out_cols, guards))
     if np.any(np.asarray(guards_np)):
         raise SpmdUnsupported(
-            "runtime guard tripped (duplicate-key build side): result "
-            "discarded, serial engine takes over")
+            "runtime guard tripped (duplicate-key build side or exchange "
+            "quota overflow): result discarded, serial engine takes over")
     live_np = np.asarray(out_live_np)
     arrays = []
     for f, c in zip(out_schema, out_cols_np):

@@ -36,7 +36,7 @@ def test_hierarchical_delivers_every_row_once(mesh):
     valid = rng.random(n) < 0.9
 
     def body(v, d, ok):
-        outs, rv = hierarchical_repartition(
+        outs, rv, _ovf = hierarchical_repartition(
             [v], d, ok, ici_axis="ici", dcn_axis="dcn",
             n_ici=N_ICI, n_dcn=N_DCN, quota=CAP)
         recv = outs[0]
@@ -68,7 +68,7 @@ def test_hierarchical_multi_payload(mesh):
     spec = P(("dcn", "ici"))
 
     def body(x, y, d, ok):
-        outs, rv = hierarchical_repartition(
+        outs, rv, _ovf = hierarchical_repartition(
             [x, y], d, ok, ici_axis="ici", dcn_axis="dcn",
             n_ici=N_ICI, n_dcn=N_DCN, quota=CAP)
         return (jnp.where(rv, outs[0], -1), jnp.where(rv, outs[1], -1),

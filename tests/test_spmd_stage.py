@@ -255,6 +255,71 @@ def test_spmd_single_agg_guards():
         execute_plan_spmd(bad, ctx2, mesh, {"fact": fact})
 
 
+def test_spmd_exchange_quota_bounded_and_overflow_guard():
+    """Round-3 VERDICT #4: hash-exchange receive buffers must be
+    O(global/n_dev * margin), not O(global); skew past the margin trips
+    the runtime guard instead of silently dropping rows."""
+    from auron_tpu.config import conf
+    from auron_tpu.parallel.exchange import bounded_quota
+
+    # shape check: the bounded quota is ~capacity/n_dev * margin
+    assert bounded_quota(1 << 20, 8, margin=2.0) <= (1 << 18) + 16
+    assert bounded_quota(100, 8, margin=2.0) <= 100
+
+    # differential run under a bounded quota (uniform keys: no overflow)
+    fact = make_fact(n=4000, keys=64, seed=21)
+    fact_schema = from_arrow_schema(fact.schema)
+    src = P.FFIReader(schema=fact_schema, resource_id="fact")
+
+    def build(keys_col):
+        partial = P.Agg(
+            child=src, exec_mode="partial", grouping=(col(keys_col),),
+            grouping_names=(keys_col,),
+            aggs=(AggExpr(fn="count", children=(col("amount"),),
+                          return_type=I64),),
+            agg_names=("c",))
+        ctx = _Ctx()
+        ctx.exchanges["ex"] = ShuffleJob(
+            rid="ex", child=P.Projection(
+                child=src, exprs=(col("key"), col("amount")),
+                names=("key", "amount")),
+            partitioning=P.Partitioning(mode="hash", num_partitions=8,
+                                        expressions=(col(keys_col),)),
+            schema=None)
+        final = P.Agg(
+            child=P.IpcReader(schema=None, resource_id="ex"),
+            exec_mode="single", grouping=(col(keys_col),),
+            grouping_names=(keys_col,),
+            aggs=(AggExpr(fn="count", children=(col("amount"),),
+                          return_type=I64),),
+            agg_names=("c",))
+        return final, ctx
+
+    mesh = data_mesh(8)
+    plan, ctx = build("key")
+    got = execute_plan_spmd(plan, ctx, mesh, {"fact": fact}).to_pylist()
+    assert sum(r["c"] for r in got) == fact.num_rows
+
+    # skew: every row hashes to ONE destination -> quota overflow must
+    # raise (guard), not lose rows
+    skew = pa.table({
+        "key": np.zeros(4000, dtype=np.int64),
+        "amount": np.arange(4000, dtype=np.float64)})
+    plan2, ctx2 = build("key")
+    with pytest.raises(SpmdUnsupported, match="guard"):
+        execute_plan_spmd(plan2, ctx2, mesh, {"fact": skew})
+
+    # 2-D mesh: stage-1 quota must be sized for n_ici destinations — an
+    # n_dev-sized quota overflows on UNIFORM data whenever n_dcn > margin
+    # (round-3 review finding)
+    from auron_tpu.parallel.mesh import hierarchical_mesh
+    mesh2d = hierarchical_mesh(n_dcn=4, n_ici=2)
+    plan3, ctx3 = build("key")
+    got2 = execute_plan_spmd(plan3, ctx3, mesh2d, {"fact": fact},
+                             axis=("dcn", "ici")).to_pylist()
+    assert sum(r["c"] for r in got2) == fact.num_rows
+
+
 def test_spmd_join_duplicate_build_keys_guard():
     """The single-match SPMD join must DETECT a duplicate-key build side
     at runtime and raise (driver falls back) instead of silently dropping
