@@ -198,6 +198,12 @@ TASK_RETRIES = conf.define(
     "task-retry model the reference inherits; stage inputs are "
     "materialized once, so a retry replays only the failed task).",
 )
+TASK_PARALLELISM = conf.define(
+    "auron.task.parallelism", 0,
+    "Thread-pool size for per-partition tasks on the serial fallback "
+    "path (one native runtime per task, rt.rs:76-139 analogue). "
+    "0 = auto (min(8, cpu count)); 1 = sequential.",
+)
 SMJ_STREAMING_ENABLE = conf.define(
     "auron.smj.streaming.enable", True,
     "Execute sort-merge joins as a bounded-memory streaming merge of "

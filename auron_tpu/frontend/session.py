@@ -14,6 +14,7 @@ host engine, with Arrow tables crossing the boundary both ways.
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol
 
@@ -169,7 +170,8 @@ class AuronSession:
         n_parts = ctx.parts(plan)
         batches: List[pa.RecordBatch] = []
         max_attempts = 1 + int(config.conf.get("auron.task.retries"))
-        for pid in range(n_parts):
+
+        def run_task(pid: int):
             # task-retry model above the runtime (the Spark scheduler's
             # role the reference inherits): a failed partition task
             # re-executes from its inputs — stage inputs (exchanges,
@@ -177,16 +179,34 @@ class AuronSession:
             # only this task's work
             for attempt in range(max_attempts):
                 try:
-                    res = execute_plan(plan, partition_id=pid,
-                                       resources=resources,
-                                       num_partitions=n_parts)
-                    break
+                    return execute_plan(plan, partition_id=pid,
+                                        resources=resources,
+                                        num_partitions=n_parts)
                 except Exception:
                     if attempt + 1 >= max_attempts:
                         raise
                     log.warning("task for partition %d failed "
                                 "(attempt %d/%d); retrying",
                                 pid, attempt + 1, max_attempts)
+
+        # one runtime per task, tasks in parallel across a thread pool —
+        # the analogue of the reference running one native runtime per
+        # Spark task across executor cores (rt.rs:76-139).  Each task
+        # builds its own operator tree; the shared pieces (resource
+        # registry, mem manager) are lock-protected, and jax dispatch is
+        # thread-safe.  Results keep partition order.
+        pool_size = int(config.conf.get("auron.task.parallelism"))
+        if pool_size <= 0:
+            pool_size = min(8, os.cpu_count() or 4)
+        if n_parts <= 1 or pool_size <= 1:
+            results = [run_task(pid) for pid in range(n_parts)]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=min(pool_size, n_parts),
+                    thread_name_prefix="auron-task") as pool:
+                results = list(pool.map(run_task, range(n_parts)))
+        for res in results:
             self._metrics.append(res.metrics)
             batches.extend(res.batches)
         if not batches:

@@ -70,6 +70,10 @@ class MemManager:
     def register_consumer(self, consumer: MemConsumer) -> MemConsumer:
         with self._lock:
             consumer._manager = self
+            # spill() mutates operator internals, so only the thread
+            # running the operator's task may invoke it (parallel
+            # partition tasks each register their own consumers)
+            consumer._owner_thread = threading.get_ident()
             self._consumers.append(consumer)
         return consumer
 
@@ -92,8 +96,14 @@ class MemManager:
             if self.total_used <= self.budget:
                 return
             trigger = min_trigger_size()
+            # only consumers OWNED by this thread are safe to spill from
+            # here: spilling another task's operator mid-execute would
+            # race its buffered state (the reference's Wait arm covers
+            # the cross-task case; our degenerate form self-spills)
+            me = threading.get_ident()
             candidates = [c for c in self._consumers
-                          if c.spillable and c.mem_used >= trigger]
+                          if c.spillable and c.mem_used >= trigger and
+                          getattr(c, "_owner_thread", me) == me]
             if not candidates:
                 # over budget but nothing is big enough to bother: allow
                 # (reference returns Nothing below MIN_TRIGGER_SIZE)

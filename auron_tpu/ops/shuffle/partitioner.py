@@ -66,53 +66,61 @@ class PartitionIdComputer:
         from auron_tpu.ops.sort_keys import encode_sort_keys
         keys = self._key_eval(batch, partition_id=partition_id)
         words = encode_sort_keys(keys, self._orders)
-        bounds = self._encoded_bounds(len(words))
-        # compare each row against each bound (num bounds = N-1, small):
-        # id = count of bounds < row_key
-        cap = batch.capacity
-        ids = jnp.zeros(cap, jnp.int32)
-        for b in range(bounds.shape[0]):
-            lt = jnp.zeros(cap, bool)
-            decided = jnp.zeros(cap, bool)
-            for wi, w in enumerate(words):
-                bw = bounds[b, wi]
-                is_lt = jnp.logical_and(jnp.logical_not(decided), w > bw)
-                is_gt = jnp.logical_and(jnp.logical_not(decided), w < bw)
-                lt = jnp.logical_or(lt, is_lt)
-                decided = jnp.logical_or(decided, jnp.logical_or(is_lt, is_gt))
-            ids = ids + lt.astype(jnp.int32)
-        return ids
-
-    def _encoded_bounds(self, n_words: int):
         if self._bounds_words is None:
-            from auron_tpu.ops.sort import _np_encode_key
-            from auron_tpu.exprs.host_eval import HV
-            from auron_tpu.exprs.typing import infer_type
-            rows = self.part.range_bounds
-            per_key: List[List[np.ndarray]] = []
-            schema_types = []
-            nb = len(rows)
-            cols = list(zip(*rows)) if rows else []
-            words: List[np.ndarray] = []
-            for ki, s in enumerate(self.part.sort_orders):
-                vals = np.array(cols[ki], dtype=object) if cols else \
-                    np.zeros(0, dtype=object)
-                mask = np.array([v is not None for v in vals]) \
-                    if len(vals) else np.zeros(0, bool)
-                from auron_tpu.ir.schema import DataType
-                dt = _python_dtype(vals, mask)
-                safe = np.array([0 if (v is None or not m) else v
-                                 for v, m in zip(vals, mask)])
-                hv = HV(safe if dt.is_stringlike is False else
-                        np.array([v if m else "" for v, m in
-                                  zip(vals, mask)], dtype=object),
-                        mask, dt)
-                asc, nf = self._orders[ki]
-                words.extend(_np_encode_key(hv, asc, nf))
-            mat = np.stack(words, axis=1) if words else \
-                np.zeros((nb, 0), np.uint64)
-            self._bounds_words = jnp.asarray(mat)
-        return self._bounds_words
+            self._bounds_words = encoded_range_bounds(
+                self.part.range_bounds, self.part.sort_orders,
+                self._orders)
+        return range_ids_from_words(words, self._bounds_words,
+                                    batch.capacity)
+
+
+def range_ids_from_words(words, bounds, capacity: int):
+    """Range partition ids from encoded sort-key words: id = count of
+    bounds lexicographically < the row key (ties go to the lower
+    partition).  Shared by the serial repartitioner and the SPMD stage
+    tracer (parallel/stage.py) so the bound-compare semantics cannot
+    drift.  `bounds` is the [n_bounds, n_words] uint64 matrix from
+    encoded_range_bounds; num bounds = N-1, small."""
+    ids = jnp.zeros(capacity, jnp.int32)
+    for b in range(bounds.shape[0]):
+        lt = jnp.zeros(capacity, bool)
+        decided = jnp.zeros(capacity, bool)
+        for wi, w in enumerate(words):
+            bw = bounds[b, wi]
+            is_lt = jnp.logical_and(jnp.logical_not(decided), w > bw)
+            is_gt = jnp.logical_and(jnp.logical_not(decided), w < bw)
+            lt = jnp.logical_or(lt, is_lt)
+            decided = jnp.logical_or(decided, jnp.logical_or(is_lt, is_gt))
+        ids = ids + lt.astype(jnp.int32)
+    return ids
+
+
+def encoded_range_bounds(range_bounds, sort_orders, orders):
+    """Encode driver-sampled bound rows (tuples of python values) into
+    the [n_bounds, n_words] uint64 sort-key-word matrix."""
+    from auron_tpu.exprs.host_eval import HV
+    from auron_tpu.ops.sort import _np_encode_key
+    rows = range_bounds
+    nb = len(rows)
+    cols = list(zip(*rows)) if rows else []
+    words: List[np.ndarray] = []
+    for ki, s in enumerate(sort_orders):
+        vals = np.array(cols[ki], dtype=object) if cols else \
+            np.zeros(0, dtype=object)
+        mask = np.array([v is not None for v in vals]) \
+            if len(vals) else np.zeros(0, bool)
+        dt = _python_dtype(vals, mask)
+        safe = np.array([0 if (v is None or not m) else v
+                         for v, m in zip(vals, mask)])
+        hv = HV(safe if dt.is_stringlike is False else
+                np.array([v if m else "" for v, m in
+                          zip(vals, mask)], dtype=object),
+                mask, dt)
+        asc, nf = orders[ki]
+        words.extend(_np_encode_key(hv, asc, nf))
+    mat = np.stack(words, axis=1) if words else \
+        np.zeros((nb, 0), np.uint64)
+    return jnp.asarray(mat)
 
 
 def _python_dtype(vals, mask):

@@ -170,176 +170,188 @@ class WindowExec(Operator, MemConsumer):
         so = [jnp.take(w, perm) for w in owords]
         live = sorted_b.row_mask()
 
-        part_bound = _boundaries(sp, live, cap)
-        order_bound = jnp.logical_or(part_bound, _boundaries(so, live, cap)) \
-            if so else part_bound
-
-        idx = jnp.arange(cap, dtype=jnp.int64)
-        NEG = jnp.int64(-1)
-        seg_start = jax.lax.cummax(jnp.where(part_bound, idx, NEG))
-        og_start = jax.lax.cummax(jnp.where(order_bound, idx, NEG))
-        seg_id = jnp.cumsum(part_bound.astype(jnp.int32)) - 1
-        seg_id = jnp.where(live, seg_id, cap - 1)
-        # partition sizes + last index
-        ones = jnp.where(live, 1, 0)
-        seg_sizes = segments.sorted_segment_sum(ones, seg_id, cap)
-        part_n = jnp.take(seg_sizes, seg_id)
-        seg_end = seg_start + part_n  # exclusive
-
-        row_number = (idx - seg_start + 1).astype(jnp.int64)
-        rank = (og_start - seg_start + 1).astype(jnp.int64)
+        c = segment_context(sp, so, live, cap)
 
         out_cols: List[Any] = []
         for wf, arg_eval in zip(self.window_funcs, self._arg_evals):
             args = arg_eval(sorted_b, partition_id=ctx.partition_id)
-            out_cols.append(_coerce_to(wf, self._compute(wf, args, sorted_b, dict(
-                row_number=row_number, rank=rank, idx=idx,
-                seg_start=seg_start, seg_end=seg_end, part_n=part_n,
-                seg_id=seg_id, og_start=og_start, order_bound=order_bound,
-                part_bound=part_bound, live=live, cap=cap))))
+            out_cols.append(_coerce_to(
+                wf, compute_window_fn(wf, args, c, self.order_by)))
 
         result = sorted_b
         if self.output_window_cols:
             result = Batch(self.schema, list(sorted_b.columns) + out_cols,
                            n, cap)
         if self.group_limit is not None:
-            rank_fn = {"row_number": row_number, "rank": rank,
-                       "dense_rank": self._dense_rank(part_bound, order_bound,
-                                                      seg_id, cap, live)}[
-                self.group_limit.rank_fn]
-            keep = jnp.logical_and(rank_fn <= self.group_limit.k, live)
+            keep = jnp.logical_and(
+                group_limit_rank(self.group_limit.rank_fn, c)
+                <= self.group_limit.k, live)
             sel, cnt = compact_indices(keep, cap)
             result = result.gather(sel, int(cnt))
         yield from _rechunk_stream(result)
 
-    # ------------------------------------------------------------------
 
-    def _dense_rank(self, part_bound, order_bound, seg_id, cap, live):
-        og = jnp.cumsum(order_bound.astype(jnp.int64))
-        og_at_seg_start = jax.lax.cummax(
-            jnp.where(part_bound, og, jnp.int64(-1)))
-        return og - og_at_seg_start + 1
+def segment_context(sp, so, live, cap):
+    """Segment structure over (partition, order)-sorted key words: the
+    shared context dict both the serial operator and the SPMD stage
+    tracer (parallel/stage.py:_do_window) compute window functions
+    from — single source of truth for boundary/rank semantics."""
+    part_bound = _boundaries(sp, live, cap)
+    order_bound = jnp.logical_or(part_bound, _boundaries(so, live, cap)) \
+        if so else part_bound
 
-    def _compute(self, wf: WindowFuncCall, args, sorted_b: Batch, c) -> Any:
-        fn = wf.fn
-        cap = c["cap"]
-        if fn == "row_number":
-            return DeviceColumn(DataType.int64(), c["row_number"],
-                                jnp.ones(cap, bool))
-        if fn == "rank":
-            return DeviceColumn(DataType.int64(), c["rank"],
-                                jnp.ones(cap, bool))
-        if fn == "dense_rank":
-            d = self._dense_rank(c["part_bound"], c["order_bound"],
-                                 c["seg_id"], cap, c["live"])
-            return DeviceColumn(DataType.int64(), d, jnp.ones(cap, bool))
-        if fn == "percent_rank":
-            denom = jnp.maximum(c["part_n"] - 1, 1).astype(jnp.float64)
-            pr = (c["rank"] - 1).astype(jnp.float64) / denom
-            pr = jnp.where(c["part_n"] <= 1, 0.0, pr)
-            return DeviceColumn(DataType.float64(), pr, jnp.ones(cap, bool))
-        if fn == "cume_dist":
-            # rows with order-key <= current = last index of this order group
-            og_end = _order_group_end(c)
-            cd = (og_end - c["seg_start"]).astype(jnp.float64) / \
-                jnp.maximum(c["part_n"], 1).astype(jnp.float64)
-            return DeviceColumn(DataType.float64(), cd, jnp.ones(cap, bool))
-        if fn in ("lead", "lag"):
-            k = int(wf.args[1].value) if len(wf.args) > 1 and \
-                hasattr(wf.args[1], "value") else 1
-            shift = k if fn == "lead" else -k
-            src = c["idx"] + shift
-            in_seg = jnp.logical_and(src >= c["seg_start"],
-                                     src < c["seg_end"])
-            out = _gather_with_default(args[0], src, in_seg, wf, cap)
-            default = wf.args[2].value if len(wf.args) > 2 and \
-                hasattr(wf.args[2], "value") else None
-            if default is not None:
-                fill = jnp.asarray(default, out.data.dtype) \
-                    if not isinstance(out, DeviceStringColumn) else None
-                if fill is not None:
-                    data = jnp.where(in_seg, out.data, fill)
-                    valid = jnp.logical_or(out.validity,
-                                           jnp.logical_not(in_seg))
-                    out = DeviceColumn(out.dtype, data,
-                                       jnp.logical_and(valid, c["live"]))
-            return out
-        if fn in ("first_value", "nth_value", "nth_value_ignore_nulls",
-                  "last_value"):
-            if fn == "last_value":
-                # Spark default RANGE frame: last *peer* row's value
-                src = _order_group_end(c) - 1
-                ok = c["live"]
-            else:
-                nth = 1
-                if fn.startswith("nth") and len(wf.args) > 1 and \
-                        hasattr(wf.args[1], "value"):
-                    nth = int(wf.args[1].value)
-                src = c["seg_start"] + (nth - 1)
-                ok = jnp.logical_and(src <= c["idx"], src < c["seg_end"])
-            return _gather_with_default(args[0], src, ok, wf, cap)
-        if fn == "agg":
-            return self._agg_over_window(wf, args, c)
-        raise NotImplementedError(f"window function {fn!r}")
+    idx = jnp.arange(cap, dtype=jnp.int64)
+    NEG = jnp.int64(-1)
+    seg_start = jax.lax.cummax(jnp.where(part_bound, idx, NEG))
+    og_start = jax.lax.cummax(jnp.where(order_bound, idx, NEG))
+    seg_id = jnp.cumsum(part_bound.astype(jnp.int32)) - 1
+    seg_id = jnp.where(live, seg_id, cap - 1)
+    # partition sizes + last index
+    ones = jnp.where(live, 1, 0)
+    seg_sizes = segments.sorted_segment_sum(ones, seg_id, cap)
+    part_n = jnp.take(seg_sizes, seg_id)
+    seg_end = seg_start + part_n  # exclusive
 
-    def _agg_over_window(self, wf: WindowFuncCall, args, c) -> Any:
-        agg = wf.agg
-        cap = c["cap"]
-        val = args[-1] if args else None
-        running = bool(self.order_by)
+    row_number = (idx - seg_start + 1).astype(jnp.int64)
+    rank = (og_start - seg_start + 1).astype(jnp.int64)
+    return dict(row_number=row_number, rank=rank, idx=idx,
+                seg_start=seg_start, seg_end=seg_end, part_n=part_n,
+                seg_id=seg_id, og_start=og_start, order_bound=order_bound,
+                part_bound=part_bound, live=live, cap=cap)
 
-        def to_range_frame(rowwise):
-            """Spark's default frame is RANGE (peers share it): broadcast
-            the running value at each order group's LAST row to the whole
-            group."""
-            last = jnp.clip(_order_group_end(c) - 1, 0, cap - 1) \
-                .astype(jnp.int32)
-            return jnp.take(rowwise, last)
 
-        if agg.fn == "count":
-            x = val.validity.astype(jnp.int64) if agg.children else \
-                jnp.where(c["live"], 1, 0).astype(jnp.int64)
-            out = to_range_frame(_seg_running_sum(x, c)) if running \
-                else _seg_total(x, c)
-            return DeviceColumn(DataType.int64(), out, jnp.ones(cap, bool))
-        if agg.fn in ("sum", "avg"):
-            acc_dt = jnp.float64 if agg.return_type.is_floating or \
-                agg.fn == "avg" else jnp.int64
-            x = jnp.where(val.validity, val.data.astype(acc_dt), 0)
-            hs = val.validity.astype(jnp.int64)
-            if running:
-                s = to_range_frame(_seg_running_sum(x, c))
-                cnt = to_range_frame(_seg_running_sum(hs, c))
-            else:
-                s = _seg_total(x, c)
-                cnt = _seg_total(hs, c)
-            if agg.fn == "avg":
-                out = s.astype(jnp.float64) / jnp.maximum(cnt, 1)
-                return DeviceColumn(DataType.float64(), out, cnt > 0)
-            return DeviceColumn(agg.return_type,
-                                s.astype(agg.return_type.numpy_dtype()
-                                         if not agg.return_type.is_decimal
-                                         else jnp.int64), cnt > 0)
-        if agg.fn in ("min", "max"):
-            np_dt = np.dtype(str(val.data.dtype))
-            if np_dt.kind == "f":
-                neutral = jnp.asarray(
-                    np.inf if agg.fn == "min" else -np.inf, np_dt)
-            else:
-                info = np.iinfo(np_dt)
-                neutral = jnp.asarray(info.max if agg.fn == "min"
-                                      else info.min, np_dt)
-            x = jnp.where(val.validity, val.data, neutral)
-            if running:
-                scan = to_range_frame(_seg_running_minmax(
-                    x, c, is_min=agg.fn == "min"))
-                has = to_range_frame(
-                    _seg_running_sum(val.validity.astype(jnp.int64), c)) > 0
-            else:
-                scan = _seg_total_minmax(x, c, is_min=agg.fn == "min")
-                has = _seg_total(val.validity.astype(jnp.int64), c) > 0
-            return DeviceColumn(val.dtype, jnp.where(has, scan, 0), has)
-        raise NotImplementedError(f"window agg {agg.fn!r}")
+def group_limit_rank(rank_fn: str, c):
+    return {"row_number": c["row_number"], "rank": c["rank"],
+            "dense_rank": _dense_rank(c["part_bound"], c["order_bound"])}[
+        rank_fn]
+
+
+def _dense_rank(part_bound, order_bound):
+    og = jnp.cumsum(order_bound.astype(jnp.int64))
+    og_at_seg_start = jax.lax.cummax(
+        jnp.where(part_bound, og, jnp.int64(-1)))
+    return og - og_at_seg_start + 1
+
+
+def compute_window_fn(wf: WindowFuncCall, args, c, order_by) -> Any:
+    fn = wf.fn
+    cap = c["cap"]
+    if fn == "row_number":
+        return DeviceColumn(DataType.int64(), c["row_number"],
+                            jnp.ones(cap, bool))
+    if fn == "rank":
+        return DeviceColumn(DataType.int64(), c["rank"],
+                            jnp.ones(cap, bool))
+    if fn == "dense_rank":
+        d = _dense_rank(c["part_bound"], c["order_bound"])
+        return DeviceColumn(DataType.int64(), d, jnp.ones(cap, bool))
+    if fn == "percent_rank":
+        denom = jnp.maximum(c["part_n"] - 1, 1).astype(jnp.float64)
+        pr = (c["rank"] - 1).astype(jnp.float64) / denom
+        pr = jnp.where(c["part_n"] <= 1, 0.0, pr)
+        return DeviceColumn(DataType.float64(), pr, jnp.ones(cap, bool))
+    if fn == "cume_dist":
+        # rows with order-key <= current = last index of this order group
+        og_end = _order_group_end(c)
+        cd = (og_end - c["seg_start"]).astype(jnp.float64) / \
+            jnp.maximum(c["part_n"], 1).astype(jnp.float64)
+        return DeviceColumn(DataType.float64(), cd, jnp.ones(cap, bool))
+    if fn in ("lead", "lag"):
+        k = int(wf.args[1].value) if len(wf.args) > 1 and \
+            hasattr(wf.args[1], "value") else 1
+        shift = k if fn == "lead" else -k
+        src = c["idx"] + shift
+        in_seg = jnp.logical_and(src >= c["seg_start"],
+                                 src < c["seg_end"])
+        out = _gather_with_default(args[0], src, in_seg, wf, cap)
+        default = wf.args[2].value if len(wf.args) > 2 and \
+            hasattr(wf.args[2], "value") else None
+        if default is not None:
+            fill = jnp.asarray(default, out.data.dtype) \
+                if not isinstance(out, DeviceStringColumn) else None
+            if fill is not None:
+                data = jnp.where(in_seg, out.data, fill)
+                valid = jnp.logical_or(out.validity,
+                                       jnp.logical_not(in_seg))
+                out = DeviceColumn(out.dtype, data,
+                                   jnp.logical_and(valid, c["live"]))
+        return out
+    if fn in ("first_value", "nth_value", "nth_value_ignore_nulls",
+              "last_value"):
+        if fn == "last_value":
+            # Spark default RANGE frame: last *peer* row's value
+            src = _order_group_end(c) - 1
+            ok = c["live"]
+        else:
+            nth = 1
+            if fn.startswith("nth") and len(wf.args) > 1 and \
+                    hasattr(wf.args[1], "value"):
+                nth = int(wf.args[1].value)
+            src = c["seg_start"] + (nth - 1)
+            ok = jnp.logical_and(src <= c["idx"], src < c["seg_end"])
+        return _gather_with_default(args[0], src, ok, wf, cap)
+    if fn == "agg":
+        return _agg_over_window(wf, args, c, order_by)
+    raise NotImplementedError(f"window function {fn!r}")
+
+def _agg_over_window(wf: WindowFuncCall, args, c, order_by) -> Any:
+    agg = wf.agg
+    cap = c["cap"]
+    val = args[-1] if args else None
+    running = bool(order_by)
+
+    def to_range_frame(rowwise):
+        """Spark's default frame is RANGE (peers share it): broadcast
+        the running value at each order group's LAST row to the whole
+        group."""
+        last = jnp.clip(_order_group_end(c) - 1, 0, cap - 1) \
+            .astype(jnp.int32)
+        return jnp.take(rowwise, last)
+
+    if agg.fn == "count":
+        x = val.validity.astype(jnp.int64) if agg.children else \
+            jnp.where(c["live"], 1, 0).astype(jnp.int64)
+        out = to_range_frame(_seg_running_sum(x, c)) if running \
+            else _seg_total(x, c)
+        return DeviceColumn(DataType.int64(), out, jnp.ones(cap, bool))
+    if agg.fn in ("sum", "avg"):
+        acc_dt = jnp.float64 if agg.return_type.is_floating or \
+            agg.fn == "avg" else jnp.int64
+        x = jnp.where(val.validity, val.data.astype(acc_dt), 0)
+        hs = val.validity.astype(jnp.int64)
+        if running:
+            s = to_range_frame(_seg_running_sum(x, c))
+            cnt = to_range_frame(_seg_running_sum(hs, c))
+        else:
+            s = _seg_total(x, c)
+            cnt = _seg_total(hs, c)
+        if agg.fn == "avg":
+            out = s.astype(jnp.float64) / jnp.maximum(cnt, 1)
+            return DeviceColumn(DataType.float64(), out, cnt > 0)
+        return DeviceColumn(agg.return_type,
+                            s.astype(agg.return_type.numpy_dtype()
+                                     if not agg.return_type.is_decimal
+                                     else jnp.int64), cnt > 0)
+    if agg.fn in ("min", "max"):
+        np_dt = np.dtype(str(val.data.dtype))
+        if np_dt.kind == "f":
+            neutral = jnp.asarray(
+                np.inf if agg.fn == "min" else -np.inf, np_dt)
+        else:
+            info = np.iinfo(np_dt)
+            neutral = jnp.asarray(info.max if agg.fn == "min"
+                                  else info.min, np_dt)
+        x = jnp.where(val.validity, val.data, neutral)
+        if running:
+            scan = to_range_frame(_seg_running_minmax(
+                x, c, is_min=agg.fn == "min"))
+            has = to_range_frame(
+                _seg_running_sum(val.validity.astype(jnp.int64), c)) > 0
+        else:
+            scan = _seg_total_minmax(x, c, is_min=agg.fn == "min")
+            has = _seg_total(val.validity.astype(jnp.int64), c) > 0
+        return DeviceColumn(val.dtype, jnp.where(has, scan, 0), has)
+    raise NotImplementedError(f"window agg {agg.fn!r}")
 
 
 def _coerce_to(wf: WindowFuncCall, col):

@@ -182,6 +182,23 @@ class _StageTracer:
             pid = (base + jnp.arange(t.capacity, dtype=jnp.int32)) % n_dev
         elif part.mode == "single":
             pid = jnp.zeros(t.capacity, jnp.int32)
+        elif part.mode == "range":
+            # sampled-bounds range ids (shared kernel with the serial
+            # repartitioner), then bucket -> device by modulo: SPMD
+            # bodies are order-insensitive, so range locality only
+            # matters to the driver-side tail sort, not device placement
+            from auron_tpu.ops.shuffle.partitioner import (
+                encoded_range_bounds, range_ids_from_words,
+            )
+            from auron_tpu.ops.sort_keys import encode_sort_keys as _enc
+            keys = self._eval_exprs(
+                tuple(s.child for s in part.sort_orders), t)
+            orders = tuple((s.asc, s.nulls_first)
+                           for s in part.sort_orders)
+            words = _enc(keys, orders)
+            bounds = encoded_range_bounds(part.range_bounds,
+                                          part.sort_orders, orders)
+            pid = range_ids_from_words(words, bounds, t.capacity) % n_dev
         else:
             raise SpmdUnsupported(f"partitioning mode {part.mode!r}")
         flat, treedef = jax.tree.flatten(t.cols)
@@ -190,6 +207,13 @@ class _StageTracer:
         # legitimately funnels everything to one device, so it keeps the
         # full-capacity quota.  Overflow (quota exceeded under skew) trips
         # a runtime guard -> driver falls back to the serial engine.
+        # only single (and a degenerate 1-partition range — all ids 0)
+        # actually funnel everything to one device; hash/round-robin
+        # spread over n_dev regardless of the plan's num_partitions,
+        # while range spreads over at most its num_partitions buckets
+        funnel = part.mode == "single" or (
+            part.mode == "range" and part.num_partitions <= 1)
+        spread = part.num_partitions if part.mode == "range" else n_dev
         if isinstance(self.axis, tuple):
             # 2-D (dcn, ici) mesh: two-stage exchange so every row crosses
             # the slow DCN axis at most once (SURVEY 2.5 comm-backend
@@ -199,16 +223,16 @@ class _StageTracer:
             # n_dcn > margin
             a_dcn, a_ici = self.axis
             n_dcn, n_ici = self.axis_sizes
-            q1 = t.capacity if part.mode == "single" \
-                else bounded_quota(t.capacity, n_ici)
+            q1 = t.capacity if funnel \
+                else bounded_quota(t.capacity, min(n_ici, spread))
             outs, live, ovf = hierarchical_repartition(
                 flat, pid, t.live, a_ici, a_dcn, n_ici, n_dcn,
-                quota=q1, bound_stage2=part.mode != "single")
+                quota=q1, bound_stage2=not funnel)
             any_ovf = lax.psum(
                 lax.psum(ovf.astype(jnp.int32), a_ici), a_dcn) > 0
         else:
-            quota = t.capacity if part.mode == "single" \
-                else bounded_quota(t.capacity, n_dev)
+            quota = t.capacity if funnel \
+                else bounded_quota(t.capacity, min(n_dev, spread))
             outs, live, ovf = all_to_all_repartition(flat, pid, t.live,
                                                      self.axis, n_dev,
                                                      quota=quota)
@@ -323,11 +347,8 @@ class _StageTracer:
         return agg
 
     def _admitting_exchange_mode(self, agg) -> Optional[str]:
-        child = agg.child
-        if isinstance(child, P.IpcReader) and \
-                child.resource_id in self.exchanges:
-            return self.exchanges[child.resource_id].partitioning.mode
-        return None
+        part = _feeding_exchange(agg, self.exchanges)
+        return part.mode if part is not None else None
 
     def _do_agg(self, n: P.Agg) -> DeviceTable:
         from auron_tpu.ops.agg.exec import _group_reduce_body
@@ -456,11 +477,16 @@ class _StageTracer:
     # SPMD operator bodies are order-insensitive (hash agg, hash join,
     # exchanges); ordering only matters at the driver-side emission, which
     # the peeled host tail re-establishes.  A mid-plan Sort with no fetch
-    # limit is therefore a no-op here; one WITH a fetch limit prunes rows
-    # and may only be dropped when the host tail's global sort shadows it
-    # (same key prefix, limit at least as strict).
+    # limit is therefore a no-op here; one WITH a fetch limit is a
+    # per-device top-k MASK (rows keep their positions, losers go dead —
+    # the sort_exec.rs:86 FetchLimit analogue), skipped entirely when the
+    # host tail's global sort shadows it (same key prefix, limit at least
+    # as strict).
 
     def _do_sort(self, n: P.Sort) -> DeviceTable:
+        from auron_tpu.ops.sort_keys import (
+            encode_sort_keys, lexsort_indices_live,
+        )
         if n.fetch_limit is None:
             return self.eval_node(n.child)
         s = self.shadow_sort
@@ -468,33 +494,146 @@ class _StageTracer:
                 s.fetch_limit <= n.fetch_limit and \
                 s.sort_exprs == n.sort_exprs[:len(s.sort_exprs)]:
             return self.eval_node(n.child)
-        raise SpmdUnsupported("unshadowed top-k sort inside an SPMD stage")
+        t = self.eval_node(n.child)
+        keys = self._eval_exprs(tuple(x.child for x in n.sort_exprs), t)
+        orders = tuple((x.asc, x.nulls_first) for x in n.sort_exprs)
+        words = encode_sort_keys(keys, orders)
+        perm = lexsort_indices_live(words, t.live)
+        rank = jnp.zeros(t.capacity, jnp.int32).at[perm].set(
+            jnp.arange(t.capacity, dtype=jnp.int32))
+        live = jnp.logical_and(t.live, rank < n.fetch_limit)
+        return DeviceTable(t.schema, t.cols, live)
 
     def _do_limit(self, n: P.Limit) -> DeviceTable:
-        raise SpmdUnsupported("limit inside an SPMD stage")
+        # per-device limit+offset over the device's row order — exactly
+        # the serial engine's per-partition stream semantics
+        # (limit_exec.rs:42); the global CollectLimit shape puts a single
+        # exchange + final limit above this.  A Sort anywhere below makes
+        # the prefix ORDER-dependent (serial takes the sorted prefix; the
+        # SPMD sort is a no-op/mask that leaves rows in place) — reject
+        # so the serial engine computes the correct sorted prefix.
+        for node in _walk_native(n.child, self):
+            if node.kind == "sort":
+                raise SpmdUnsupported(
+                    "limit over a sorted input is order-sensitive")
+        t = self.eval_node(n.child)
+        live_rank = jnp.cumsum(t.live.astype(jnp.int32))  # 1-based
+        live = jnp.logical_and(
+            t.live, jnp.logical_and(live_rank > n.offset,
+                                    live_rank <= n.offset + n.limit))
+        return DeviceTable(t.schema, t.cols, live)
+
+    # window -------------------------------------------------------------
+
+    def _do_window(self, n: P.Window) -> DeviceTable:
+        from auron_tpu.ops.sort_keys import (
+            encode_sort_keys, lexsort_indices_live,
+        )
+        from auron_tpu.ops.window.exec import (
+            _coerce_to, _default_window_type, compute_window_fn,
+            group_limit_rank, segment_context,
+        )
+        if not _window_ok(n, self.exchanges):
+            raise SpmdUnsupported(
+                "window needs a colocating exchange (hash on a subset of "
+                "its partition keys, or single) under it")
+        # unsupported window fns surface as NotImplementedError from
+        # compute_window_fn below — wrapped into SpmdUnsupported there,
+        # so the supported set lives in ONE place (ops/window/exec.py)
+        t = self.eval_node(n.child)
+        cap = t.capacity
+        pcols = self._eval_exprs(n.partition_by, t)
+        ocols = self._eval_exprs(tuple(s.child for s in n.order_by), t)
+        args_u = [self._eval_exprs(
+            tuple(wf.args) + ((wf.agg.children if wf.agg else ())), t)
+            for wf in n.window_funcs]
+        orders = tuple((s.asc, s.nulls_first) for s in n.order_by)
+        pwords = encode_sort_keys(
+            pcols, tuple((True, True) for _ in n.partition_by))
+        owords = encode_sort_keys(ocols, orders)
+        perm = lexsort_indices_live(pwords + owords, t.live)
+        allv = jnp.ones(cap, bool)
+        sorted_cols = [c.gather(perm, allv) for c in t.cols]
+        sorted_args = [[a.gather(perm, allv) for a in args]
+                       for args in args_u]
+        n_live = jnp.sum(t.live.astype(jnp.int32))
+        live = jnp.arange(cap, dtype=jnp.int32) < n_live
+        sp = [jnp.take(w, perm) for w in pwords]
+        so = [jnp.take(w, perm) for w in owords]
+
+        # segment structure + per-fn kernels: the SAME helpers the serial
+        # operator runs (single source of truth for boundary semantics)
+        c = segment_context(sp, so, live, cap)
+        out_cols = []
+        for wf, args in zip(n.window_funcs, sorted_args):
+            try:
+                out_cols.append(_coerce_to(
+                    wf, compute_window_fn(wf, args, c, n.order_by)))
+            except NotImplementedError as e:
+                raise SpmdUnsupported(str(e)) from e
+        fields = list(t.schema.fields)
+        cols = list(sorted_cols)
+        if n.output_window_cols:
+            cols += out_cols
+            fields += [Field(wf.name or wf.fn,
+                             wf.return_type or _default_window_type(wf))
+                       for wf in n.window_funcs]
+        if n.group_limit is not None:
+            live = jnp.logical_and(
+                live, group_limit_rank(n.group_limit.rank_fn, c)
+                <= n.group_limit.k)
+        return DeviceTable(Schema(tuple(fields)), cols, live)
+
+
+def _feeding_exchange(node, exchanges):
+    """The exchange Partitioning feeding `node`, looking through
+    row-preserving pass-through ops (coalesce/debug); None otherwise."""
+    child = node.child
+    while isinstance(child, (P.CoalesceBatches, P.Debug)):
+        child = child.child
+    if isinstance(child, P.IpcReader) and child.resource_id in exchanges:
+        return exchanges[child.resource_id].partitioning
+    return None
+
+
+def _colocating(part, keys) -> bool:
+    """True when `part` guarantees rows with equal `keys` land on one
+    device: a single-partition exchange, or a hash exchange whose
+    expressions are a subset of `keys`."""
+    if part is None:
+        return False
+    if part.mode == "single":
+        return True
+    if part.mode == "hash":
+        ks = set(keys)
+        return all(e in ks for e in (part.expressions or ()))
+    return False
 
 
 def _single_agg_ok(agg, exchanges) -> bool:
     """A single-mode agg is per-partition; in SPMD the device is the
     partition.  Admit it only when the exchange feeding it guarantees
-    per-device groups are complete: a single-partition exchange (all rows
-    on one device), a hash exchange whose keys are a subset of the
-    grouping keys (every group wholly on one device), or a round-robin
-    exchange under an UNGROUPED agg (per-partition global rows, the
-    engine's per-partition contract)."""
-    child = agg.child
-    if not (isinstance(child, P.IpcReader) and
-            child.resource_id in exchanges):
+    per-device groups are complete (colocating for its grouping keys),
+    or — for an UNGROUPED agg — any exchange (per-partition global rows,
+    the engine's per-partition contract)."""
+    part = _feeding_exchange(agg, exchanges)
+    if part is None:
         return False
-    part = exchanges[child.resource_id].partitioning
-    if part.mode == "single":
+    if _colocating(part, agg.grouping):
         return True
-    if part.mode == "hash":
-        grouping = set(agg.grouping)
-        return all(e in grouping for e in (part.expressions or ()))
     if part.mode == "round_robin":
         return not agg.grouping
     return False
+
+
+def _window_ok(win, exchanges) -> bool:
+    """Window partitions must be device-complete: the feeding exchange
+    must colocate the PARTITION BY keys (no partition keys -> only a
+    single exchange qualifies)."""
+    return _colocating(_feeding_exchange(win, exchanges),
+                       win.partition_by)
+
+
 
 
 def _require_native(node) -> P.PlanNode:
@@ -745,14 +884,16 @@ _PRECHECK_OK = frozenset({
     "ffi_reader", "ipc_reader", "parquet_scan", "orc_scan", "filter",
     "projection", "rename_columns", "coalesce_batches", "debug", "agg",
     "broadcast_join", "hash_join", "broadcast_join_build_hash_map",
-    "sort", "limit", "union", "expand",
+    "sort", "limit", "union", "expand", "window",
 })
 
 
 def precheck_plan(plan, conv_ctx) -> None:
     """Cheap kind-level SPMD compilability check (no tracing, no source
-    materialization) — rejects the common fallbacks (smj, window,
-    generate, sinks) up front; union/expand compile since round 2."""
+    materialization) — rejects the remaining fallbacks (smj, generate,
+    sinks) up front; union/expand compile since round 2,
+    window/limit/top-k-sort/range since round 3."""
+    exchanges = getattr(conv_ctx, "exchanges", None) or {}
     for node in _walk_native(plan, conv_ctx):
         if node.kind not in _PRECHECK_OK:
             raise SpmdUnsupported(
@@ -762,11 +903,15 @@ def precheck_plan(plan, conv_ctx) -> None:
             if jt not in ("inner", "left"):
                 raise SpmdUnsupported(f"SPMD join type {jt!r}")
         if node.kind == "agg" and node.exec_mode == "single" and \
-                not _single_agg_ok(node, getattr(conv_ctx, "exchanges",
-                                                 None) or {}):
+                not _single_agg_ok(node, exchanges):
             raise SpmdUnsupported(
                 "single-mode agg needs an exchange (or partial/final "
                 "shape)")
+        if node.kind == "window" and not _window_ok(node, exchanges):
+            raise SpmdUnsupported(
+                "window needs a colocating exchange under it")
+        # (limit-over-sort rejection lives in _do_limit — trace-time only,
+        # one authoritative copy)
 
 
 def _materialize_scans(plan, conv_ctx):

@@ -70,10 +70,14 @@ def test_tpcds_query_multi_device(mesh_runner, query):
 
 def test_some_queries_ride_the_mesh(mesh_runner):
     """The SPMD path must actually engage for part of the corpus (guards
-    against the fallback silently swallowing everything)."""
+    against the fallback silently swallowing everything) — including,
+    since round 3, window- and sort/rollup-bearing queries (VERDICT #5)."""
     ran = {r.name for r in mesh_runner.results if r.spmd}
     assert len(ran) >= 2, \
         f"expected >=2 SPMD-executed corpus queries, got {sorted(ran)}"
+    assert "q65w" in ran, "window-bearing q65w fell back to serial"
+    assert {"q22r", "q27r", "q36r"} & ran, \
+        f"no rollup/sort-bearing query rode the mesh: {sorted(ran)}"
 
 
 def test_plan_stability(small_catalog, tmp_path, monkeypatch):
