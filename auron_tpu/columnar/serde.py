@@ -21,7 +21,7 @@ import pyarrow as pa
 
 from auron_tpu.config import conf
 
-_CODEC_IDS = {"none": 0, "zstd": 1, "zlib": 2}
+_CODEC_IDS = {"none": 0, "zstd": 1, "zlib": 2, "lz4": 3}
 _CODEC_NAMES = {v: k for k, v in _CODEC_IDS.items()}
 
 
@@ -32,6 +32,12 @@ def _compress(payload: bytes, codec: str) -> bytes:
     if codec == "zlib":
         import zlib
         return zlib.compress(payload, 4)
+    if codec == "lz4":
+        # lz4 frame via Arrow's bundled codec (ipc_compression.rs:35
+        # parity); pyarrow's decompress needs the raw size, so prefix it
+        import pyarrow as _pa
+        body = _pa.Codec("lz4").compress(payload, asbytes=True)
+        return struct.pack("<I", len(payload)) + body
     return payload
 
 
@@ -42,6 +48,11 @@ def _decompress(payload: bytes, codec: str) -> bytes:
     if codec == "zlib":
         import zlib
         return zlib.decompress(payload)
+    if codec == "lz4":
+        import pyarrow as _pa
+        (raw_len,) = struct.unpack_from("<I", payload, 0)
+        return _pa.Codec("lz4").decompress(payload[4:], raw_len,
+                                           asbytes=True)
     return payload
 
 

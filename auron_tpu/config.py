@@ -190,7 +190,8 @@ SHUFFLE_SERVICE_ADDRESS = conf.define(
     "auron.shuffle.service.address", "",
     "host:port of the remote shuffle server for celeborn/uniffle modes.")
 SHUFFLE_COMPRESSION_CODEC = conf.define(
-    "auron.shuffle.compression.codec", "zstd", "Codec for shuffle blocks."
+    "auron.shuffle.compression.codec", "zstd",
+    "Codec for shuffle/spill blocks: zstd, zlib, lz4, none."
 )
 TASK_RETRIES = conf.define(
     "auron.task.retries", 0,

@@ -119,7 +119,7 @@ def test_long_string_host_fallback():
 
 def test_ipc_serde_roundtrip():
     rb = _sample_rb()
-    for codec in ("zstd", "zlib", "none"):
+    for codec in ("zstd", "zlib", "lz4", "none"):
         data = serde.serialize_batches([rb, rb], codec=codec)
         out = serde.deserialize_batches(data)
         assert len(out) == 2

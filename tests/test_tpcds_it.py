@@ -29,7 +29,17 @@ def small_catalog(tmp_path_factory):
 
 @pytest.fixture(scope="module")
 def runner(catalog):
-    return QueryRunner(catalog=catalog, perf_factor=10.0)
+    r = QueryRunner(catalog=catalog, perf_factor=3.0)
+    yield r
+    # per-query perf artifact for the driver to archive (VERDICT r2 #8):
+    # native/oracle/warm seconds per corpus query
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "IT_PERF.json")
+    try:
+        with open(out, "w") as f:
+            f.write(r.to_json() + "\n")
+    except OSError:
+        pass
 
 
 @pytest.mark.parametrize("query", names())
