@@ -28,7 +28,8 @@ _CODEC_NAMES = {v: k for k, v in _CODEC_IDS.items()}
 def _compress(payload: bytes, codec: str) -> bytes:
     if codec == "zstd":
         from auron_tpu.native import bindings
-        return bindings.compress(payload)
+        return bindings.compress(
+            payload, int(conf.get("auron.io.compression.zstd.level")))
     if codec == "zlib":
         import zlib
         return zlib.compress(payload, 4)

@@ -199,6 +199,26 @@ TASK_RETRIES = conf.define(
     "task-retry model the reference inherits; stage inputs are "
     "materialized once, so a retry replays only the failed task).",
 )
+LOG_LEVEL = conf.define(
+    "auron.log.level", "INFO",
+    "Engine logger level (NATIVE_LOG_LEVEL analogue, conf.rs:63).",
+)
+IO_COMPRESSION_ZSTD_LEVEL = conf.define(
+    "auron.io.compression.zstd.level", 3,
+    "zstd level for shuffle/spill frames "
+    "(SPARK_IO_COMPRESSION_ZSTD_LEVEL analogue, conf.rs:48).",
+)
+PARTIAL_AGG_SKIPPING_SKIP_SPILL = conf.define(
+    "auron.partial.agg.skipping.skip.spill", True,
+    "Allow partial-agg skipping to engage even when spills already "
+    "exist; when false, a spilled agg never switches to passthrough "
+    "(PARTIAL_AGG_SKIPPING_SKIP_SPILL analogue, conf.rs:42).",
+)
+INPUT_BATCH_STATISTICS_ENABLE = conf.define(
+    "auron.input.batch.statistics.enable", False,
+    "Record per-operator input batch/row counts in the metric tree "
+    "(INPUT_BATCH_STATISTICS_ENABLE analogue, conf.rs:37).",
+)
 TASK_PARALLELISM = conf.define(
     "auron.task.parallelism", 0,
     "Thread-pool size for per-partition tasks on the serial fallback "

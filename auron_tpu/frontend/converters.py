@@ -596,6 +596,46 @@ def _data_writing(node, children, ctx) -> P.PlanNode:
     return ctx.set_parts(plan, ctx.parts(children[0]))
 
 
+@_plan("InsertIntoHiveTableExec")
+def _insert_into_hive(node, children, ctx) -> P.PlanNode:
+    """Hive insert glue (NativeParquetInsertIntoHiveTableBase /
+    NativeOrcInsertIntoHiveTableBase analogue): the command carries the
+    table's storage descriptor; static partition values extend the
+    output path, dynamic partition columns flow to the sink's
+    partitioned write."""
+    storage = node.attrs.get("storage", {})
+    fmt = str(storage.get("format", node.attrs.get("format",
+                                                   "parquet"))).lower()
+    if "orc" in fmt:
+        fmt = "orc"
+    elif "parquet" in fmt or fmt in ("hive", ""):
+        fmt = "parquet"
+    else:
+        raise NotConvertible(f"hive serde format {fmt!r}")
+    location = storage.get("location") or node.attrs.get("output_dir")
+    if not location:
+        raise NotConvertible("hive table without a location")
+    # static partitions become path segments (k=v), Hive layout
+    static_parts = node.attrs.get("static_partitions", {}) or {}
+    out_dir = location
+    for k, v in static_parts.items():
+        out_dir = f"{out_dir}/{k}={v}"
+    dyn_cols = tuple(node.attrs.get("dynamic_partition_cols", ()) or ())
+    compression = storage.get("compression",
+                              node.attrs.get("compression", "zstd"))
+    if fmt == "parquet":
+        _op_enabled("parquet.sink")
+        plan: P.PlanNode = P.ParquetSink(
+            child=children[0], output_dir=out_dir,
+            partition_cols=dyn_cols, compression=compression)
+    else:
+        _op_enabled("orc.sink")
+        plan = P.OrcSink(child=children[0], output_dir=out_dir,
+                         partition_cols=dyn_cols,
+                         compression=compression)
+    return ctx.set_parts(plan, ctx.parts(children[0]))
+
+
 # ---------------------------------------------------------------------------
 # external convert providers (thirdparty SPI; AuronConvertProvider.scala:27
 # + ServiceLoader discovery at AuronConverters.scala:108-112)

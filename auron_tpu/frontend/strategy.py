@@ -120,7 +120,7 @@ def apply(plan: ForeignNode) -> Tags:
             always = any(is_native(c) for c in ch)
         elif op == "BroadcastHashJoinExec":
             always = all(is_native(c) for c in ch)
-        elif op == "DataWritingCommandExec":
+        elif op in ("DataWritingCommandExec", "InsertIntoHiveTableExec"):
             always = bool(ch) and is_native(ch[0])
         elif converters.ext_convert_supported(n):
             always = True

@@ -484,7 +484,9 @@ class AggExec(Operator, MemConsumer):
                         "auron.partial.agg.skipping.min.rows")):
                 self._compact_staged()
                 ratio = self._acc_rows / max(self._input_rows, 1)
-                if ratio >= float(conf.get(
+                skip_ok = not len(self._spills) or bool(conf.get(
+                    "auron.partial.agg.skipping.skip.spill"))
+                if skip_ok and ratio >= float(conf.get(
                         "auron.partial.agg.skipping.ratio")):
                     self._passthrough = True
                     acc = self._staged_batch()

@@ -81,7 +81,17 @@ class Operator:
             yield batch
 
     def child_stream(self, ctx: TaskContext, i: int = 0) -> Iterator[Batch]:
-        return self.children[i].execute_with_metrics(ctx)
+        stream = self.children[i].execute_with_metrics(ctx)
+        if conf.get("auron.input.batch.statistics.enable"):
+            return self._counted_input(stream)
+        return stream
+
+    def _counted_input(self, stream: Iterator[Batch]) -> Iterator[Batch]:
+        for b in stream:
+            self.metrics.add("input_batch_count", 1)
+            if b.num_rows_known:
+                self.metrics.add("input_rows", b.num_rows)
+            yield b
 
 
 def compact_indices(mask, capacity: int):

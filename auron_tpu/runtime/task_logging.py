@@ -40,6 +40,10 @@ def install() -> None:
                           "%(task)s%(message)s"))
     handler.addFilter(TaskContextFilter())
     logger.addHandler(handler)
+    from auron_tpu.config import conf
+    level = str(conf.get("auron.log.level")).upper()
+    if level and hasattr(logging, level):
+        logger.setLevel(getattr(logging, level))
     _installed = True
 
 

@@ -98,3 +98,30 @@ def test_config_doc_covers_all_options():
     for opt in config.conf.options():
         assert f"`{opt.key}`" in committed, \
             f"CONFIG.md is stale: regenerate with python -m auron_tpu.config"
+
+
+def test_input_batch_statistics_option():
+    """INPUT_BATCH_STATISTICS_ENABLE analogue: per-operator input
+    batch/row counters appear in the metric tree when enabled."""
+    import numpy as np
+    import pyarrow as pa
+    from auron_tpu.ir import plan as P
+    from auron_tpu.ir.expr import col
+    from auron_tpu.ir import expr as E
+    from auron_tpu.ir.schema import from_arrow_schema
+    from auron_tpu.runtime.executor import execute_plan
+    from auron_tpu.runtime.resources import ResourceRegistry
+
+    t = pa.table({"x": np.arange(100, dtype=np.int64)})
+    res = ResourceRegistry()
+    res.put("t", t.to_batches(max_chunksize=25))
+    plan = P.Filter(
+        child=P.FFIReader(schema=from_arrow_schema(t.schema),
+                          resource_id="t"),
+        predicates=(E.BinaryExpr(left=col("x"), op=">",
+                                 right=E.Literal(value=10)),))
+    with config.conf.scoped({"auron.input.batch.statistics.enable": True}):
+        r = execute_plan(plan, resources=res)
+    stats = r.metrics.to_dict()
+    flat = str(stats)
+    assert "input_batch_count" in flat and "input_rows" in flat
