@@ -195,17 +195,8 @@ class AuronSession:
         # builds its own operator tree; the shared pieces (resource
         # registry, mem manager) are lock-protected, and jax dispatch is
         # thread-safe.  Results keep partition order.
-        pool_size = int(config.conf.get("auron.task.parallelism"))
-        if pool_size <= 0:
-            pool_size = min(8, os.cpu_count() or 4)
-        if n_parts <= 1 or pool_size <= 1:
-            results = [run_task(pid) for pid in range(n_parts)]
-        else:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(
-                    max_workers=min(pool_size, n_parts),
-                    thread_name_prefix="auron-task") as pool:
-                results = list(pool.map(run_task, range(n_parts)))
+        from auron_tpu.runtime.task_pool import run_tasks
+        results = run_tasks(run_task, range(n_parts))
         for res in results:
             self._metrics.append(res.metrics)
             batches.extend(res.batches)
@@ -291,15 +282,31 @@ class AuronSession:
         map_plan = job.child
         map_parts = ctx.parts(map_plan)
         map_deps = self._materialize_deps(map_plan, ctx)
-        for map_pid in range(map_parts):
+
+        def map_task(map_pid: int):
             writer_rid = f"{job.rid}:writer:{map_pid}"
             map_deps.put(writer_rid,
                          self.shuffle_service.rss_writer(job.rid, map_pid))
             writer = P.RssShuffleWriter(child=map_plan,
                                         partitioning=job.partitioning,
                                         rss_resource_id=writer_rid)
-            res = execute_plan(writer, partition_id=map_pid,
-                               resources=map_deps, num_partitions=map_parts)
+            return execute_plan(writer, partition_id=map_pid,
+                                resources=map_deps,
+                                num_partitions=map_parts)
+
+        # map tasks in parallel, like the reduce-side task pool in
+        # _run_native — but ONLY for the in-process shuffle service,
+        # whose reads sort blocks by map id; the remote clients
+        # (celeborn aggregate buffers, uniffle arrival-order blocks)
+        # record pushes in arrival order, so concurrent maps would make
+        # reduce-side streams nondeterministic there
+        from auron_tpu.ops.shuffle.writer import InProcessShuffleService
+        from auron_tpu.runtime.task_pool import run_tasks
+        if isinstance(self.shuffle_service, InProcessShuffleService):
+            results = run_tasks(map_task, range(map_parts), "auron-map")
+        else:
+            results = [map_task(pid) for pid in range(map_parts)]
+        for res in results:
             self._metrics.append(res.metrics)
         n_reduce = job.partitioning.num_partitions
         # reduce-side resource: partition-indexed block lists; the task

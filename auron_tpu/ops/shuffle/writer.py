@@ -17,6 +17,7 @@ from __future__ import annotations
 import io
 import os
 import struct
+import threading
 from typing import Any, Dict, Iterator, List, Optional
 
 import jax.numpy as jnp
@@ -210,21 +211,29 @@ class InProcessShuffleService:
     standalone driver."""
 
     def __init__(self) -> None:
-        # (shuffle_id, reduce_pid) -> list of byte blocks (one per map task)
-        self._blocks: Dict[tuple, List[bytes]] = {}
+        # (shuffle_id, reduce_pid) -> [(map_id, block)]; map tasks now run
+        # on a thread pool, so reads sort by map id to keep reduce-side
+        # block order deterministic (differential tests compare per-
+        # partition streams)
+        self._blocks: Dict[tuple, List[tuple]] = {}
+        self._lock = threading.Lock()
 
     def rss_writer(self, shuffle_id: str, map_id: int) -> RssPartitionWriter:
         svc = self
 
         class _W(RssPartitionWriter):
             def write(self, partition_id: int, data: bytes) -> None:
-                svc._blocks.setdefault((shuffle_id, partition_id),
-                                       []).append(data)
+                with svc._lock:
+                    svc._blocks.setdefault((shuffle_id, partition_id),
+                                           []).append((map_id, data))
         return _W()
 
     def reduce_blocks(self, shuffle_id: str, reduce_pid: int) -> List[bytes]:
-        return self._blocks.get((shuffle_id, reduce_pid), [])
+        with self._lock:
+            entries = list(self._blocks.get((shuffle_id, reduce_pid), []))
+        return [d for _mid, d in sorted(entries, key=lambda e: e[0])]
 
     def clear(self, shuffle_id: str) -> None:
-        for k in [k for k in self._blocks if k[0] == shuffle_id]:
-            del self._blocks[k]
+        with self._lock:
+            for k in [k for k in self._blocks if k[0] == shuffle_id]:
+                del self._blocks[k]

@@ -924,14 +924,11 @@ def _materialize_scans(plan, conv_ctx):
     sf100+; the reference streams scans per-task, parquet_exec.rs:70) —
     results reassemble in partition order so sharding stays
     deterministic."""
-    import os as _os
-    from concurrent.futures import ThreadPoolExecutor
-
     import pyarrow as pa
 
-    from auron_tpu.config import conf as _conf
     from auron_tpu.ir.schema import to_arrow_schema
     from auron_tpu.runtime.executor import execute_plan
+    from auron_tpu.runtime.task_pool import run_tasks
 
     rids: Dict[int, str] = {}
     nodes: Dict[str, Any] = {}
@@ -953,15 +950,7 @@ def _materialize_scans(plan, conv_ctx):
         return rid, pid, execute_plan(node, partition_id=pid,
                                       num_partitions=n_parts).batches
 
-    pool_size = int(_conf.get("auron.task.parallelism"))
-    if pool_size <= 0:
-        pool_size = min(8, _os.cpu_count() or 4)
-    if len(jobs) <= 1 or pool_size <= 1:
-        results = [read(j) for j in jobs]
-    else:
-        with ThreadPoolExecutor(max_workers=min(pool_size, len(jobs)),
-                                thread_name_prefix="auron-scan") as pool:
-            results = list(pool.map(read, jobs))
+    results = run_tasks(read, jobs, "auron-scan")
 
     per_rid: Dict[str, List[Tuple[int, List[Any]]]] = {}
     for rid, pid, batches in results:

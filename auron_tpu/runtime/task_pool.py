@@ -1,0 +1,31 @@
+"""Shared per-partition task pool (rt.rs:76-139 analogue: one native
+runtime per task, tasks across cores).  Sizing policy lives HERE so the
+serial fallback, the exchange map side, and the SPMD scan feed cannot
+drift: auron.task.parallelism, 0 = auto (min(8, cpu count)),
+1 = sequential.  Results keep task order."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Sequence
+
+from auron_tpu.config import conf
+
+
+def pool_size() -> int:
+    n = int(conf.get("auron.task.parallelism"))
+    if n <= 0:
+        n = min(8, os.cpu_count() or 4)
+    return n
+
+
+def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
+              prefix: str = "auron-task") -> List[Any]:
+    items = list(items)
+    size = pool_size()
+    if len(items) <= 1 or size <= 1:
+        return [fn(i) for i in items]
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(size, len(items)),
+                            thread_name_prefix=prefix) as pool:
+        return list(pool.map(fn, items))
