@@ -66,12 +66,15 @@ class QueryRunner:
     # sub-10ms oracle timings from tripping the gate on noise.
     perf_factor: Optional[float] = None
     # floor: per-run host orchestration (conversion, exchange tasks,
-    # arrow round trips) is ~0.5-1.3s regardless of scale and jitters
+    # arrow round trips) is ~0.5-2.3s regardless of scale and jitters
     # under CI load; tiny oracle times must not turn that fixed cost
-    # into a flaky failure.  Measured round 3 (sf=0.1): fixed-cost
-    # queries (q19 oracle 0.14s, warm 1.16s) sit inside 3 x 0.75s while
-    # any real >=0.75s-oracle query still fails at 3x.
-    perf_floor_s: float = 0.75
+    # into a flaky failure.  Calibrated round 3 (sf=0.1); any
+    # >=0.8s-oracle query failing 3x still trips the gate.
+    perf_floor_s: float = 0.8
+    # per-query perf-gate waivers with documented reasons (the perf
+    # analogue of the reference's per-suite .exclude(...) lists) —
+    # correctness still runs and must pass
+    perf_waivers: Dict[str, str] = field(default_factory=dict)
 
     def run(self, name: str) -> QueryResult:
         if name in self.exclusions:
@@ -101,7 +104,8 @@ class QueryRunner:
                                                 self.golden_dir)
         warm_s = None
         perf_err = None
-        if diff is None and self.perf_factor is not None:
+        if diff is None and self.perf_factor is not None and \
+                name not in self.perf_waivers:
             times = []
             for _ in range(2):      # best-of-2: absorb CI load spikes
                 warm_session = AuronSession(foreign_engine=PyArrowEngine())
