@@ -412,22 +412,41 @@ class _StageTracer:
 
     def _do_broadcast_join(self, n: P.BroadcastJoin) -> DeviceTable:
         return self._join(n.left, n.right, n.on, n.join_type,
-                          build_side=n.broadcast_side)
+                          build_side=n.broadcast_side,
+                          existence_name=n.existence_output_name)
 
     def _do_hash_join(self, n: P.HashJoin) -> DeviceTable:
         return self._join(n.left, n.right, n.on, n.join_type,
-                          build_side=n.build_side)
+                          build_side=n.build_side,
+                          existence_name=n.existence_output_name)
 
     def _do_broadcast_join_build_hash_map(self, n) -> DeviceTable:
         return self.eval_node(n.child)
 
+    def _do_sort_merge_join(self, n: P.SortMergeJoin) -> DeviceTable:
+        # SMJ in SPMD: both sides arrive hash-exchanged on their join
+        # keys, so equal keys are COLOCATED and the per-device
+        # sorted-hash probe kernel applies (the mid-plan sorts under an
+        # SMJ are no-ops here — the kernel sorts hashes itself).  The
+        # single-match build restriction and its runtime duplicate guard
+        # carry over; multi-match plans fall back to the streaming
+        # serial SMJ.
+        # colocation was vetted by precheck_plan (the one authoritative
+        # copy — it runs before any source materialization)
+        return self._join(n.left, n.right, n.on, n.join_type,
+                          build_side="right",
+                          existence_name=n.existence_output_name)
+
+    _JOIN_TYPES = ("inner", "left", "left_semi", "left_anti", "existence")
+
     def _join(self, left_ir, right_ir, on, join_type: str,
-              build_side: str) -> DeviceTable:
+              build_side: str, existence_name: str = "exists"
+              ) -> DeviceTable:
         from auron_tpu.ops.joins.exec import join_output_schema
         from auron_tpu.ops.joins.kernel import (
             _NULL_BUILD, _NULL_PROBE, join_key_hash,
         )
-        if join_type not in ("inner", "left"):
+        if join_type not in self._JOIN_TYPES:
             raise SpmdUnsupported(f"SPMD join type {join_type!r}")
         if build_side != "right":
             raise SpmdUnsupported("SPMD join requires build_side=right")
@@ -465,7 +484,19 @@ class _StageTracer:
                 eq = pk.data == bg.data
             ok = jnp.logical_and(ok, jnp.logical_and(
                 eq, jnp.logical_and(pk.validity, bg.validity)))
-        schema = join_output_schema(probe.schema, build.schema, join_type)
+        schema = join_output_schema(probe.schema, build.schema, join_type,
+                                    existence_name)
+        if join_type in ("left_semi", "left_anti"):
+            keep = ok if join_type == "left_semi" \
+                else jnp.logical_not(ok)
+            return DeviceTable(schema, list(probe.cols),
+                               jnp.logical_and(probe.live, keep))
+        if join_type == "existence":
+            exists = DeviceColumn(
+                DataType.bool_(), jnp.logical_and(ok, probe.live),
+                jnp.ones(probe.capacity, bool))
+            return DeviceTable(schema, list(probe.cols) + [exists],
+                               probe.live)
         bcols = [c.gather(bidx, ok) for c in build.cols]
         out_cols = list(probe.cols) + bcols
         live = jnp.logical_and(probe.live, ok) if join_type == "inner" \
@@ -624,6 +655,34 @@ def _single_agg_ok(agg, exchanges) -> bool:
     if part.mode == "round_robin":
         return not agg.grouping
     return False
+
+
+def _smj_side_part(node, exchanges):
+    """The exchange feeding one SMJ side, looking through the Sort the
+    planner interposes (a mid-plan fetch-less Sort is a no-op in SPMD)."""
+    child = node
+    while isinstance(child, (P.Sort, P.CoalesceBatches, P.Debug)):
+        if isinstance(child, P.Sort) and child.fetch_limit is not None:
+            return None          # top-k prunes rows; keep serial
+        child = child.child
+    if isinstance(child, P.IpcReader) and child.resource_id in exchanges:
+        return exchanges[child.resource_id].partitioning
+    return None
+
+
+def _smj_colocated(n, exchanges) -> bool:
+    """Equal join keys must land on one device: both sides hash-
+    partitioned on exactly their join keys (positionally aligned, so the
+    partition hashes agree), or both funneled by single exchanges."""
+    pl = _smj_side_part(n.left, exchanges)
+    pr = _smj_side_part(n.right, exchanges)
+    if pl is None or pr is None:
+        return False
+    if pl.mode == "single" and pr.mode == "single":
+        return True
+    return (pl.mode == "hash" and pr.mode == "hash" and
+            tuple(pl.expressions or ()) == tuple(n.on.left_keys) and
+            tuple(pr.expressions or ()) == tuple(n.on.right_keys))
 
 
 def _window_ok(win, exchanges) -> bool:
@@ -884,7 +943,7 @@ _PRECHECK_OK = frozenset({
     "ffi_reader", "ipc_reader", "parquet_scan", "orc_scan", "filter",
     "projection", "rename_columns", "coalesce_batches", "debug", "agg",
     "broadcast_join", "hash_join", "broadcast_join_build_hash_map",
-    "sort", "limit", "union", "expand", "window",
+    "sort_merge_join", "sort", "limit", "union", "expand", "window",
 })
 
 
@@ -898,10 +957,15 @@ def precheck_plan(plan, conv_ctx) -> None:
         if node.kind not in _PRECHECK_OK:
             raise SpmdUnsupported(
                 f"operator not SPMD-compilable: {node.kind}")
-        if node.kind in ("broadcast_join", "hash_join"):
+        if node.kind in ("broadcast_join", "hash_join",
+                         "sort_merge_join"):
             jt = node.join_type
-            if jt not in ("inner", "left"):
+            if jt not in _StageTracer._JOIN_TYPES:
                 raise SpmdUnsupported(f"SPMD join type {jt!r}")
+        if node.kind == "sort_merge_join" and \
+                not _smj_colocated(node, exchanges):
+            raise SpmdUnsupported(
+                "SMJ sides are not hash-colocated on the join keys")
         if node.kind == "agg" and node.exec_mode == "single" and \
                 not _single_agg_ok(node, exchanges):
             raise SpmdUnsupported(

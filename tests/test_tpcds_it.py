@@ -93,6 +93,7 @@ def test_some_queries_ride_the_mesh(mesh_runner):
     assert "q65w" in ran, "window-bearing q65w fell back to serial"
     assert {"q22r", "q27r", "q36r"} & ran, \
         f"no rollup/sort-bearing query rode the mesh: {sorted(ran)}"
+    assert "q93s" in ran, "SMJ-bearing q93s fell back to serial"
 
 
 def test_plan_stability(small_catalog, tmp_path, monkeypatch):
