@@ -411,14 +411,21 @@ class _StageTracer:
     # joins ---------------------------------------------------------------------
 
     def _do_broadcast_join(self, n: P.BroadcastJoin) -> DeviceTable:
+        # build side is REPLICATED on every device: emitting unmatched
+        # build rows (full/right) would duplicate them per device, so
+        # those types are precheck-rejected for broadcast joins
         return self._join(n.left, n.right, n.on, n.join_type,
                           build_side=n.broadcast_side,
                           existence_name=n.existence_output_name)
 
     def _do_hash_join(self, n: P.HashJoin) -> DeviceTable:
+        # colocation vetted by precheck_plan: a shuffled hash join is
+        # only correct per-device when both sides were hash-exchanged on
+        # the join keys
         return self._join(n.left, n.right, n.on, n.join_type,
                           build_side=n.build_side,
-                          existence_name=n.existence_output_name)
+                          existence_name=n.existence_output_name,
+                          colocated=True)
 
     def _do_broadcast_join_build_hash_map(self, n) -> DeviceTable:
         return self.eval_node(n.child)
@@ -435,18 +442,22 @@ class _StageTracer:
         # copy — it runs before any source materialization)
         return self._join(n.left, n.right, n.on, n.join_type,
                           build_side="right",
-                          existence_name=n.existence_output_name)
+                          existence_name=n.existence_output_name,
+                          colocated=True)
 
     _JOIN_TYPES = ("inner", "left", "left_semi", "left_anti", "existence")
+    _JOIN_TYPES_COLOCATED = _JOIN_TYPES + ("full", "right")
 
     def _join(self, left_ir, right_ir, on, join_type: str,
-              build_side: str, existence_name: str = "exists"
-              ) -> DeviceTable:
+              build_side: str, existence_name: str = "exists",
+              colocated: bool = False) -> DeviceTable:
         from auron_tpu.ops.joins.exec import join_output_schema
         from auron_tpu.ops.joins.kernel import (
             _NULL_BUILD, _NULL_PROBE, join_key_hash,
         )
-        if join_type not in self._JOIN_TYPES:
+        allowed = self._JOIN_TYPES_COLOCATED if colocated \
+            else self._JOIN_TYPES
+        if join_type not in allowed:
             raise SpmdUnsupported(f"SPMD join type {join_type!r}")
         if build_side != "right":
             raise SpmdUnsupported("SPMD join requires build_side=right")
@@ -499,6 +510,24 @@ class _StageTracer:
                                probe.live)
         bcols = [c.gather(bidx, ok) for c in build.cols]
         out_cols = list(probe.cols) + bcols
+        if join_type in ("full", "right"):
+            # colocated-only (checked above): build rows live on THIS
+            # device, so unmatched build rows emit locally — probe
+            # segment (left-join shaped for full, matched-only for
+            # right) concatenated with the unmatched-build segment
+            # carrying null probe columns
+            live1 = probe.live if join_type == "full" \
+                else jnp.logical_and(probe.live, ok)
+            t1 = DeviceTable(schema, out_cols, live1)
+            matched = jnp.zeros(build.capacity, bool).at[
+                jnp.where(ok, bidx, build.capacity)].set(True, mode="drop")
+            live2 = jnp.logical_and(build.live,
+                                    jnp.logical_not(matched))
+            from auron_tpu.ops.joins.kernel import null_columns_like
+            null_probe = null_columns_like(probe.schema.fields,
+                                           build.capacity)
+            t2 = DeviceTable(schema, null_probe + list(build.cols), live2)
+            return self._concat_tables(schema, [t1, t2])
         live = jnp.logical_and(probe.live, ok) if join_type == "inner" \
             else probe.live
         return DeviceTable(schema, out_cols, live)
@@ -657,32 +686,59 @@ def _single_agg_ok(agg, exchanges) -> bool:
     return False
 
 
-def _smj_side_part(node, exchanges):
-    """The exchange feeding one SMJ side, looking through the Sort the
-    planner interposes (a mid-plan fetch-less Sort is a no-op in SPMD)."""
-    child = node
-    while isinstance(child, (P.Sort, P.CoalesceBatches, P.Debug)):
-        if isinstance(child, P.Sort) and child.fetch_limit is not None:
-            return None          # top-k prunes rows; keep serial
-        child = child.child
-    if isinstance(child, P.IpcReader) and child.resource_id in exchanges:
-        return exchanges[child.resource_id].partitioning
+def _key_positions(part, keys):
+    """The index set of `keys` a partitioning hashes on, or None when it
+    gives no colocation guarantee for `keys`.  single -> empty set (all
+    rows funnel to one device)."""
+    if part is None:
+        return None
+    if part.mode == "single":
+        return frozenset()
+    if part.mode != "hash" or not part.expressions:
+        return None
+    keys = list(keys)
+    try:
+        return frozenset(keys.index(e) for e in part.expressions)
+    except ValueError:
+        return None
+
+
+def _side_positions(node, keys, exchanges):
+    """Colocation guarantee of one join side for `keys`, looked through
+    distribution-preserving operators: fetch-less sorts, coalesce/debug,
+    filters (row drops don't move rows), grouped aggs (a group's row
+    stays where its exchange put the inputs; the feeding exchange's
+    expressions name the agg's output attributes in the canonical
+    partial/exchange/final shape), and joins (output rows keep the probe
+    side's placement; pl == pr makes the build's appended rows agree)."""
+    while True:
+        if isinstance(node, (P.CoalesceBatches, P.Debug, P.Filter)):
+            node = node.child
+            continue
+        if isinstance(node, P.Sort) and node.fetch_limit is None:
+            node = node.child
+            continue
+        break
+    if isinstance(node, P.IpcReader) and node.resource_id in exchanges:
+        return _key_positions(exchanges[node.resource_id].partitioning,
+                              keys)
+    if isinstance(node, P.Agg):
+        return _key_positions(_feeding_exchange(node, exchanges), keys)
+    if isinstance(node, (P.HashJoin, P.SortMergeJoin)):
+        return _side_positions(node.left, keys, exchanges)
+    if isinstance(node, P.BroadcastJoin):
+        probe = node.left if node.broadcast_side == "right" else node.right
+        return _side_positions(probe, keys, exchanges)
     return None
 
 
 def _smj_colocated(n, exchanges) -> bool:
-    """Equal join keys must land on one device: both sides hash-
-    partitioned on exactly their join keys (positionally aligned, so the
-    partition hashes agree), or both funneled by single exchanges."""
-    pl = _smj_side_part(n.left, exchanges)
-    pr = _smj_side_part(n.right, exchanges)
-    if pl is None or pr is None:
-        return False
-    if pl.mode == "single" and pr.mode == "single":
-        return True
-    return (pl.mode == "hash" and pr.mode == "hash" and
-            tuple(pl.expressions or ()) == tuple(n.on.left_keys) and
-            tuple(pr.expressions or ()) == tuple(n.on.right_keys))
+    """Equal join keys must land on one device: both sides carry the
+    same positional hash-key guarantee (so the partition hashes agree
+    row-for-row), or both funnel through single exchanges."""
+    pl = _side_positions(n.left, tuple(n.on.left_keys), exchanges)
+    pr = _side_positions(n.right, tuple(n.on.right_keys), exchanges)
+    return pl is not None and pl == pr
 
 
 def _window_ok(win, exchanges) -> bool:
@@ -957,15 +1013,19 @@ def precheck_plan(plan, conv_ctx) -> None:
         if node.kind not in _PRECHECK_OK:
             raise SpmdUnsupported(
                 f"operator not SPMD-compilable: {node.kind}")
-        if node.kind in ("broadcast_join", "hash_join",
-                         "sort_merge_join"):
-            jt = node.join_type
-            if jt not in _StageTracer._JOIN_TYPES:
-                raise SpmdUnsupported(f"SPMD join type {jt!r}")
-        if node.kind == "sort_merge_join" and \
-                not _smj_colocated(node, exchanges):
+        if node.kind == "broadcast_join" and \
+                node.join_type not in _StageTracer._JOIN_TYPES:
             raise SpmdUnsupported(
-                "SMJ sides are not hash-colocated on the join keys")
+                f"SPMD broadcast-join type {node.join_type!r}")
+        if node.kind in ("hash_join", "sort_merge_join"):
+            if node.join_type not in _StageTracer._JOIN_TYPES_COLOCATED:
+                raise SpmdUnsupported(
+                    f"SPMD join type {node.join_type!r}")
+            # shuffled joins are per-device correct only when both sides
+            # were hash-exchanged on the join keys
+            if not _smj_colocated(node, exchanges):
+                raise SpmdUnsupported(
+                    "join sides are not hash-colocated on the join keys")
         if node.kind == "agg" and node.exec_mode == "single" and \
                 not _single_agg_ok(node, exchanges):
             raise SpmdUnsupported(

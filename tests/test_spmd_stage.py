@@ -581,6 +581,21 @@ def test_spmd_sort_merge_join():
     # semi / anti / existence ride the same probe kernel (no pair
     # expansion needed); restrict dim to half the keys so each type has
     # both outcomes
+    # full / right emit unmatched build rows locally (colocated sides);
+    # a sparse dim (every 3rd key up to 300) gives unmatched rows on
+    # both sides
+    sparse_dim = pa.table({
+        "dk": np.arange(0, 300, 3, dtype=np.int64),
+        "w": np.arange(100, dtype=np.float64)})
+    for jt in ("full", "right"):
+        ctx_f, j_f = smj_plan(sparse_dim, jt)
+        got_f = execute_plan_spmd(j_f, ctx_f, mesh,
+                                  {"fact": fact,
+                                   "dim": sparse_dim}).to_pylist()
+        exp_f = _serial_reference(serial_smj(sparse_dim, jt),
+                                  {"fact": fact, "dim": sparse_dim})
+        assert _canon(got_f) == _canon(exp_f), jt
+
     half_dim = pa.table({"dk": np.arange(100, dtype=np.int64),
                          "w": np.ones(100)})
     for jt in ("left_semi", "left_anti", "existence"):
@@ -591,6 +606,33 @@ def test_spmd_sort_merge_join():
         exp_j = _serial_reference(serial_smj(half_dim, jt),
                                   {"fact": fact, "dim": half_dim})
         assert _canon(got_j) == _canon(exp_j), jt
+
+    # shuffled HASH join: same colocation machinery, full-outer output
+    sparse2 = pa.table({"dk": np.arange(0, 300, 3, dtype=np.int64),
+                        "w": np.arange(100, dtype=np.float64)})
+    ctx_h, smj_h = smj_plan(sparse2, "full")
+    hj = P.HashJoin(
+        left=smj_h.left, right=smj_h.right, on=smj_h.on,
+        join_type="full", build_side="right")
+    got_h = execute_plan_spmd(hj, ctx_h, mesh,
+                              {"fact": fact, "dim": sparse2}).to_pylist()
+    exp_h = _serial_reference(serial_smj(sparse2, "full"),
+                              {"fact": fact, "dim": sparse2})
+    assert _canon(got_h) == _canon(exp_h)
+
+    # NON-colocated shuffled join (round-robin side) must be rejected
+    # up front — per-device probing would drop cross-device matches
+    ctx_rr, smj_rr = smj_plan(sparse2)
+    ctx_rr.exchanges["exl"] = ShuffleJob(
+        rid="exl",
+        child=P.FFIReader(schema=from_arrow_schema(fact.schema),
+                          resource_id="fact"),
+        partitioning=P.Partitioning(mode="round_robin",
+                                    num_partitions=8),
+        schema=None)
+    with pytest.raises(SpmdUnsupported, match="colocated"):
+        execute_plan_spmd(smj_rr, ctx_rr, mesh,
+                          {"fact": fact, "dim": sparse2})
 
     # duplicate-key build side -> guard -> SpmdUnsupported
     dup_dim = pa.table({"dk": np.array([1, 1, 2], dtype=np.int64),
