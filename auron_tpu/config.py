@@ -258,6 +258,14 @@ SPMD_EXCHANGE_QUOTA_MARGIN = conf.define(
     "O(global).  Overflowing rows trip a runtime guard and the driver "
     "falls back to the serial engine.",
 )
+SPMD_JOIN_MATCH_FACTOR = conf.define(
+    "auron.spmd.join.match.factor", 4,
+    "Pair-expansion factor the SPMD join retries with after its "
+    "single-match guard trips (duplicate build keys): each probe row "
+    "may emit up to this many pairs (static output capacity scales by "
+    "the factor).  Builds with wider key runs fall back to the serial "
+    "engine; <=1 disables the retry.",
+)
 AGG_GROUPING_STRATEGY = conf.define(
     "auron.agg.grouping.strategy", "auto",
     "Group-id assignment inside the agg reduce kernel: 'sort' (lexsort + "

@@ -26,8 +26,8 @@ from auron_tpu.exprs import strings_device as S
 from auron_tpu.ir.schema import DataType, Field, Schema
 
 # hash-sentinels: null join keys never match (SQL equi-join semantics)
-_NULL_BUILD = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-_NULL_PROBE = jnp.uint64(0xFFFFFFFFFFFFFFFE)
+_NULL_BUILD = np.uint64(0xFFFFFFFFFFFFFFFF)
+_NULL_PROBE = np.uint64(0xFFFFFFFFFFFFFFFE)
 
 
 def _key_validity(c: Any, capacity: int):

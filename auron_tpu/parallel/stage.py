@@ -60,6 +60,17 @@ class SpmdUnsupported(Exception):
     serial per-partition engine."""
 
 
+class SpmdGuardTripped(SpmdUnsupported):
+    """A runtime guard invalidated the SPMD result.  `retryable` marks
+    join duplicate-key trips a pair-expansion retry can fix; hard trips
+    (exchange quota overflow, dup keys past the factor or under a
+    semi-like join) fall straight back to the serial engine."""
+
+    def __init__(self, message: str, retryable: bool = False):
+        super().__init__(message)
+        self.retryable = retryable
+
+
 @dataclass
 class DeviceTable:
     """Per-device value flowing between traced operator bodies."""
@@ -81,7 +92,8 @@ class _StageTracer:
                  axis, n_dev: int,
                  shadow_sort: Optional[P.Sort] = None,
                  scan_rids: Optional[Dict[int, str]] = None,
-                 axis_sizes: Optional[Tuple[int, ...]] = None):
+                 axis_sizes: Optional[Tuple[int, ...]] = None,
+                 match_factor: int = 1):
         self.exchanges = getattr(conv_ctx, "exchanges", None) or {}
         self.broadcasts = getattr(conv_ctx, "broadcasts", None) or {}
         self.bindings = bindings
@@ -96,10 +108,15 @@ class _StageTracer:
         self.shadow_sort = shadow_sort
         self.scan_rids = scan_rids or {}
         # runtime guards: device booleans that invalidate the SPMD result
-        # post-run (e.g. a duplicate-key build side the single-match join
-        # cannot express); the driver fetches them with the output and
-        # falls back to the serial engine when any is set
+        # post-run; the driver fetches them with the output.  `guards`
+        # are HARD (quota overflow, dup keys past the match factor, dup
+        # keys under a semi-like join): fall back to serial.
+        # `retry_guards` are join dup-key trips a pair-expansion retry
+        # can fix.
         self.guards: List[Any] = []
+        self.retry_guards: List[Any] = []
+        # join pair-expansion factor (1 = single-candidate probe)
+        self.match_factor = max(1, int(match_factor))
 
     def _axis_index(self):
         """Global device id; for a (dcn, ici) mesh the layout is
@@ -434,10 +451,9 @@ class _StageTracer:
         # SMJ in SPMD: both sides arrive hash-exchanged on their join
         # keys, so equal keys are COLOCATED and the per-device
         # sorted-hash probe kernel applies (the mid-plan sorts under an
-        # SMJ are no-ops here — the kernel sorts hashes itself).  The
-        # single-match build restriction and its runtime duplicate guard
-        # carry over; multi-match plans fall back to the streaming
-        # serial SMJ.
+        # SMJ are no-ops here — the kernel sorts hashes itself).
+        # Duplicate build keys retry with K-way pair expansion; key runs
+        # wider than the factor fall back to the streaming serial SMJ.
         # colocation was vetted by precheck_plan (the one authoritative
         # copy — it runs before any source materialization)
         return self._join(n.left, n.right, n.on, n.join_type,
@@ -469,22 +485,21 @@ class _StageTracer:
         bh = jnp.where(jnp.logical_and(build.live, bvalid), bh, _NULL_BUILD)
         order = jnp.argsort(bh).astype(jnp.int32)
         sorted_bh = jnp.take(bh, order)
-        # single-match restriction: duplicate build keys would need pair
-        # expansion (dynamic output size).  A runtime guard detects them
-        # (adjacent equal non-sentinel hashes after the sort — which also
-        # catches hash collisions) and forces the driver to fall back to
-        # the serial engine rather than silently dropping matches.
-        dup = jnp.any(jnp.logical_and(sorted_bh[1:] == sorted_bh[:-1],
-                                      sorted_bh[1:] != _NULL_BUILD))
-        self.guards.append(
-            lax.psum(dup.astype(jnp.int32), self.axis) > 0)
         ph, pvalid = join_key_hash(pkeys, probe.capacity)
         ph = jnp.where(jnp.logical_and(probe.live, pvalid), ph, _NULL_PROBE)
-        pos = jnp.clip(jnp.searchsorted(sorted_bh, ph), 0,
-                       build.capacity - 1)
-        hit = jnp.take(sorted_bh, pos) == ph
-        bidx = jnp.take(order, pos)
-        # exact verification (hash-collision filter)
+        semi_like = join_type in ("left_semi", "left_anti", "existence")
+        K = 1 if semi_like else self.match_factor
+        if K <= 1:
+            return self._join_single(probe, build, pkeys, bkeys, order,
+                                     sorted_bh, ph, join_type,
+                                     existence_name)
+        return self._join_expanded(probe, build, pkeys, bkeys, order,
+                                   sorted_bh, ph, join_type,
+                                   existence_name, K)
+
+    def _exact_eq(self, pkeys, bkeys, bidx, hit):
+        """Exact key equality for candidate pairs (hash-collision
+        filter); pkeys are already pair-aligned."""
         ok = hit
         for pk, bk in zip(pkeys, bkeys):
             bg = bk.gather(bidx, hit)
@@ -495,6 +510,45 @@ class _StageTracer:
                 eq = pk.data == bg.data
             ok = jnp.logical_and(ok, jnp.logical_and(
                 eq, jnp.logical_and(pk.validity, bg.validity)))
+        return ok
+
+    def _join_outer_tail(self, schema, probe, build, out_cols, ok, bidx,
+                         live1):
+        """full/right tail: colocated builds, so unmatched build rows
+        emit locally — probe segment + null-padded unmatched-build
+        segment concatenated."""
+        from auron_tpu.ops.joins.kernel import null_columns_like
+        t1 = DeviceTable(schema, out_cols, live1)
+        matched = jnp.zeros(build.capacity, bool).at[
+            jnp.where(ok, bidx, build.capacity)].set(True, mode="drop")
+        live2 = jnp.logical_and(build.live, jnp.logical_not(matched))
+        null_probe = null_columns_like(probe.schema.fields,
+                                       build.capacity)
+        t2 = DeviceTable(schema, null_probe + list(build.cols), live2)
+        return self._concat_tables(schema, [t1, t2])
+
+    def _join_single(self, probe, build, pkeys, bkeys, order, sorted_bh,
+                     ph, join_type, existence_name):
+        """Single-candidate probe (match_factor=1): duplicate build keys
+        would need pair expansion, so a runtime guard detects them
+        (adjacent equal non-sentinel hashes after the sort — which also
+        catches hash collisions).  For pair-emitting join types the trip
+        is RETRYABLE (the driver re-traces with the expansion factor);
+        semi/anti/existence stay at K=1, so their trip is hard."""
+        from auron_tpu.ops.joins.exec import join_output_schema
+        from auron_tpu.ops.joins.kernel import _NULL_BUILD
+        dup = jnp.any(jnp.logical_and(sorted_bh[1:] == sorted_bh[:-1],
+                                      sorted_bh[1:] != _NULL_BUILD))
+        tripped = lax.psum(dup.astype(jnp.int32), self.axis) > 0
+        if join_type in ("left_semi", "left_anti", "existence"):
+            self.guards.append(tripped)
+        else:
+            self.retry_guards.append(tripped)
+        pos = jnp.clip(jnp.searchsorted(sorted_bh, ph), 0,
+                       build.capacity - 1)
+        hit = jnp.take(sorted_bh, pos) == ph
+        bidx = jnp.take(order, pos)
+        ok = self._exact_eq(pkeys, bkeys, bidx, hit)
         schema = join_output_schema(probe.schema, build.schema, join_type,
                                     existence_name)
         if join_type in ("left_semi", "left_anti"):
@@ -511,26 +565,63 @@ class _StageTracer:
         bcols = [c.gather(bidx, ok) for c in build.cols]
         out_cols = list(probe.cols) + bcols
         if join_type in ("full", "right"):
-            # colocated-only (checked above): build rows live on THIS
-            # device, so unmatched build rows emit locally — probe
-            # segment (left-join shaped for full, matched-only for
-            # right) concatenated with the unmatched-build segment
-            # carrying null probe columns
             live1 = probe.live if join_type == "full" \
                 else jnp.logical_and(probe.live, ok)
-            t1 = DeviceTable(schema, out_cols, live1)
-            matched = jnp.zeros(build.capacity, bool).at[
-                jnp.where(ok, bidx, build.capacity)].set(True, mode="drop")
-            live2 = jnp.logical_and(build.live,
-                                    jnp.logical_not(matched))
-            from auron_tpu.ops.joins.kernel import null_columns_like
-            null_probe = null_columns_like(probe.schema.fields,
-                                           build.capacity)
-            t2 = DeviceTable(schema, null_probe + list(build.cols), live2)
-            return self._concat_tables(schema, [t1, t2])
+            return self._join_outer_tail(schema, probe, build, out_cols,
+                                         ok, bidx, live1)
         live = jnp.logical_and(probe.live, ok) if join_type == "inner" \
             else probe.live
         return DeviceTable(schema, out_cols, live)
+
+    def _join_expanded(self, probe, build, pkeys, bkeys, order,
+                       sorted_bh, ph, join_type, existence_name, K: int):
+        """K-way pair expansion: every probe row probes its full hash
+        range [lo, hi), emitting up to K pairs (static output capacity
+        probe.cap * K).  Ranges wider than K trip a runtime guard and
+        the driver falls back to the serial engine — the static-shape
+        answer to the reference's dynamic pair batches
+        (joins/bhj/full_join.rs)."""
+        from auron_tpu.ops.joins.exec import join_output_schema
+        cap = probe.capacity
+        capk = cap * K
+        lo = jnp.searchsorted(sorted_bh, ph, side="left") \
+            .astype(jnp.int32)
+        hi = jnp.searchsorted(sorted_bh, ph, side="right") \
+            .astype(jnp.int32)
+        count = hi - lo
+        over = jnp.any(jnp.logical_and(probe.live, count > K))
+        self.guards.append(
+            lax.psum(over.astype(jnp.int32), self.axis) > 0)
+        i = (jnp.arange(capk, dtype=jnp.int32) // K)
+        j = jnp.arange(capk, dtype=jnp.int32) % K
+        allv = jnp.ones(capk, bool)
+        pair_has = j < jnp.minimum(jnp.take(count, i), K)
+        bpos = jnp.clip(jnp.take(lo, i) + j, 0, build.capacity - 1)
+        bidx = jnp.take(order, bpos)
+        probe_live_r = jnp.take(probe.live, i)
+        pkeys_r = [k.gather(i, allv) for k in pkeys]
+        ok = self._exact_eq(pkeys_r, bkeys, bidx,
+                            jnp.logical_and(pair_has, probe_live_r))
+        matched_any = jnp.any(ok.reshape(cap, K), axis=1)
+        schema = join_output_schema(probe.schema, build.schema, join_type,
+                                    existence_name)
+        probe_cols_r = [c.gather(i, allv) for c in probe.cols]
+        bcols = [c.gather(bidx, ok) for c in build.cols]
+        out_cols = probe_cols_r + bcols
+        # unmatched probe rows emit exactly once (their j==0 slot)
+        emit_unmatched = jnp.logical_and(
+            jnp.logical_and(j == 0, probe_live_r),
+            jnp.logical_not(jnp.take(matched_any, i)))
+        if join_type == "inner":
+            return DeviceTable(schema, out_cols, ok)
+        if join_type == "left":
+            return DeviceTable(schema, out_cols,
+                               jnp.logical_or(ok, emit_unmatched))
+        # full / right
+        live1 = jnp.logical_or(ok, emit_unmatched) \
+            if join_type == "full" else ok
+        return self._join_outer_tail(schema, probe, build, out_cols, ok,
+                                     bidx, live1)
 
     # sort / limit -------------------------------------------------------
     #
@@ -822,7 +913,29 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     source_tables: rid -> pyarrow.Table for every FFI source the plan
     references (the C2N boundary inputs).  Returns a pyarrow.Table.
     Raises SpmdUnsupported when the plan shape cannot be expressed.
+
+    A tripped join guard (duplicate build keys past the current match
+    factor) retries ONCE with auron.spmd.join.match.factor pair
+    expansion before giving up — multi-match joins pay the K-wide
+    buffers only when the data actually needs them.
     """
+    from auron_tpu.config import conf as _conf
+    try:
+        return _execute_plan_spmd_once(plan, conv_ctx, mesh,
+                                       source_tables, axis,
+                                       match_factor=1)
+    except SpmdGuardTripped as e:
+        k = int(_conf.get("auron.spmd.join.match.factor"))
+        if not e.retryable or k <= 1:
+            raise
+        return _execute_plan_spmd_once(plan, conv_ctx, mesh,
+                                       source_tables, axis,
+                                       match_factor=k)
+
+
+def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
+                            source_tables: Dict[str, Any], axis,
+                            match_factor: int):
     import dataclasses
 
     import pyarrow as pa
@@ -880,7 +993,7 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     # jax.jit closure per call would re-trace+re-compile every time)
     from auron_tpu.config import conf as _conf
     cache_key = (
-        plan, axis, n_dev,
+        plan, axis, n_dev, match_factor,
         # trace-time config the compiled program bakes in
         float(_conf.get("auron.spmd.exchange.quota.margin")),
         tuple(sorted((rid, job.child, job.partitioning)
@@ -906,37 +1019,46 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
             tracer = _StageTracer(conv_ctx, bindings, axis, n_dev,
                                   shadow_sort=shadow_sort,
                                   scan_rids=scan_rids,
-                                  axis_sizes=axis_sizes)
+                                  axis_sizes=axis_sizes,
+                                  match_factor=match_factor)
             out = tracer.eval_node(plan)
             if not schema_box:
                 schema_box.append(out.schema)
             guards = jnp.stack(tracer.guards) if tracer.guards else \
                 jnp.zeros(0, bool)
-            return out.cols, out.live, guards
+            retry_guards = jnp.stack(tracer.retry_guards) \
+                if tracer.retry_guards else jnp.zeros(0, bool)
+            return out.cols, out.live, guards, retry_guards
 
         shard = jax.jit(jax.shard_map(
             program, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: PS(axis), host_inputs),),
-            out_specs=(PS(axis), PS(axis), PS()), check_vma=False))
+            out_specs=(PS(axis), PS(axis), PS(), PS()),
+            check_vma=False))
     else:
         shard, schema_box = cached
 
     put = {rid: (jax.tree.map(lambda x: jax.device_put(x, sharded), cols),
                  jax.device_put(live, sharded))
            for rid, (cols, live) in host_inputs.items()}
-    out_cols, out_live, guards = shard(put)
+    out_cols, out_live, guards, retry_guards = shard(put)
     if cached is None:
         _PROGRAM_CACHE[cache_key] = (shard, schema_box)
     out_schema = schema_box[0]
 
     # gather + compact on host (one batched fetch, guards included)
     from auron_tpu.ops.kernel_cache import host_sync
-    out_live_np, out_cols_np, guards_np = host_sync(
-        (out_live, out_cols, guards))
+    out_live_np, out_cols_np, guards_np, retry_np = host_sync(
+        (out_live, out_cols, guards, retry_guards))
     if np.any(np.asarray(guards_np)):
-        raise SpmdUnsupported(
-            "runtime guard tripped (duplicate-key build side or exchange "
-            "quota overflow): result discarded, serial engine takes over")
+        raise SpmdGuardTripped(
+            "runtime guard tripped (exchange quota overflow, or "
+            f"duplicate build keys past match factor {match_factor}): "
+            "result discarded", retryable=False)
+    if np.any(np.asarray(retry_np)):
+        raise SpmdGuardTripped(
+            "duplicate-key build side at match factor 1: result "
+            "discarded", retryable=True)
     live_np = np.asarray(out_live_np)
     arrays = []
     for f, c in zip(out_schema, out_cols_np):
