@@ -266,6 +266,12 @@ SPMD_JOIN_MATCH_FACTOR = conf.define(
     "the factor).  Builds with wider key runs fall back to the serial "
     "engine; <=1 disables the retry.",
 )
+ORC_SCHEMA_CASE_SENSITIVE = conf.define(
+    "auron.orc.schema.case.sensitive", False,
+    "Match ORC file columns to the read schema case-sensitively "
+    "(ORC_SCHEMA_CASE_SENSITIVE analogue, conf.rs:60; default matches "
+    "Spark's case-insensitive resolution).",
+)
 AGG_GROUPING_STRATEGY = conf.define(
     "auron.agg.grouping.strategy", "auto",
     "Group-id assignment inside the agg reduce kernel: 'sort' (lexsort + "

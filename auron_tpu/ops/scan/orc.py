@@ -49,8 +49,11 @@ class OrcScanExec(Operator):
                 yield Batch.from_arrow(rb, schema=self.schema)
 
     def _evolve(self, tbl: pa.Table) -> pa.Table:
+        from auron_tpu.config import conf
         arrays = []
-        fnames = [n.lower() for n in tbl.schema.names]
+        case_sensitive = bool(conf.get("auron.orc.schema.case.sensitive"))
+        fnames = list(tbl.schema.names) if case_sensitive else \
+            [n.lower() for n in tbl.schema.names]
         for out_pos, i in enumerate(self.projection):
             f = self.file_schema[i]
             at = to_arrow_type(f.dtype)
@@ -58,7 +61,8 @@ class OrcScanExec(Operator):
                 col = tbl.column(i) if i < tbl.num_columns else None
             else:
                 try:
-                    idx = fnames.index(f.name.lower())
+                    idx = fnames.index(f.name if case_sensitive
+                                       else f.name.lower())
                     col = tbl.column(idx)
                 except ValueError:
                     col = None

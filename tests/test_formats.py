@@ -192,3 +192,29 @@ def test_remote_fs_orc_roundtrip():
         file_groups=(P.FileGroup(paths=("memory://orcdata/f.orc",)),))
     out = execute_plan(scan).to_table()
     assert out.num_rows == 50
+
+
+def test_orc_schema_case_sensitivity(tmp_path):
+    """ORC_SCHEMA_CASE_SENSITIVE analogue: default resolution is
+    case-insensitive; the flag makes mismatched-case columns resolve to
+    nulls instead."""
+    import numpy as np
+    import pyarrow as pa
+    from pyarrow import orc
+
+    from auron_tpu.config import conf
+    from auron_tpu.ir import plan as P
+    from auron_tpu.ir.schema import DataType, Field, Schema
+    from auron_tpu.runtime.executor import execute_plan
+
+    path = str(tmp_path / "t.orc")
+    orc.write_table(pa.table({"KiloGrams": np.arange(5, dtype=np.int64)}),
+                    path)
+    scan = P.OrcScan(
+        schema=Schema((Field("kilograms", DataType.int64()),)),
+        file_groups=(P.FileGroup(paths=(path,)),))
+    out = execute_plan(scan).to_table()
+    assert out.column("kilograms").to_pylist() == [0, 1, 2, 3, 4]
+    with conf.scoped({"auron.orc.schema.case.sensitive": True}):
+        out2 = execute_plan(scan).to_table()
+    assert out2.column("kilograms").to_pylist() == [None] * 5
