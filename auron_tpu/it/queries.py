@@ -2240,3 +2240,417 @@ def q35a(cat: Catalog) -> ForeignNode:
         limit=100,
         project=[fcol("ca_state", STR), fcol("cnt", I64)],
         out=Schema((Field("ca_state", STR), Field("cnt", I64))))
+
+
+# ---------------------------------------------------------------------------
+# round-3 batch 2: window-share ratios, rank windows, customer growth
+# ---------------------------------------------------------------------------
+
+def _rev_share_by(cat: Catalog, table: str, prefix: str,
+                  part_col: str, sub_col: str):
+    """Revenue by (part, sub) with each sub's share of its part's total
+    via a whole-partition window sum (q12/q20 idiom)."""
+    sc = cat.scan(table, [f"{prefix}_item_sk", f"{prefix}_ext_sales_price"])
+    it = cat.scan("item", ["i_item_sk", part_col, sub_col])
+    j = bhj(sc, it, fcol(f"{prefix}_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j, grouping=[fcol(part_col, STR), fcol(sub_col, STR)],
+        group_fields=[Field(part_col, STR), Field(sub_col, STR)],
+        aggs=[("rev", agg("Sum", fcol(f"{prefix}_ext_sales_price", F64),
+                          F64),
+               Field("rev", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": 4,
+            "expressions": [fcol(part_col, STR)]}})
+    win_out = Schema(tuple(grouped.output.fields) +
+                     (Field("part_total", F64),))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "part_total", "fn": "agg", "args": [],
+                    "agg": agg("Sum", fcol("rev", F64), F64),
+                    "dtype": F64}],
+               "partition_spec": [fcol(part_col, STR)],
+               "order_spec": []})
+    share = fproject(
+        win, [fcol(part_col, STR), fcol(sub_col, STR), fcol("rev", F64),
+              falias(fcall("Multiply",
+                           fcall("Divide", fcol("rev", F64),
+                                 fcol("part_total", F64), dtype=F64),
+                           flit(100.0, F64), dtype=F64), "revshare")],
+        Schema((Field(part_col, STR), Field(sub_col, STR),
+                Field("rev", F64), Field("revshare", F64))))
+    return take_ordered(
+        share,
+        orders=[so(fcol(part_col, STR)), so(fcol("revshare", F64),
+                                            asc=False),
+                so(fcol(sub_col, STR))],
+        limit=100,
+        project=[fcol(part_col, STR), fcol(sub_col, STR),
+                 fcol("rev", F64), fcol("revshare", F64)],
+        out=share.output)
+
+
+@_q("q12w")
+def q12w(cat: Catalog) -> ForeignNode:
+    """q12 family: web class revenue share within its category."""
+    return _rev_share_by(cat, "web_sales", "ws", "i_category", "i_class")
+
+
+@_q("q20c")
+def q20c(cat: Catalog) -> ForeignNode:
+    """q20 family: catalog class revenue share within its category."""
+    return _rev_share_by(cat, "catalog_sales", "cs", "i_category",
+                         "i_class")
+
+
+@_q("q02w")
+def q02w(cat: Catalog) -> ForeignNode:
+    """q02 family: day-of-week revenue share across store+web."""
+    def chan(table, prefix):
+        sc = cat.scan(table, [f"{prefix}_sold_date_sk",
+                              f"{prefix}_ext_sales_price"])
+        dd = cat.scan("date_dim", ["d_date_sk", "d_day_name"])
+        j = bhj(sc, dd, fcol(f"{prefix}_sold_date_sk", I64),
+                fcol("d_date_sk", I64))
+        return fproject(
+            j, [fcol("d_day_name", STR),
+                falias(fcol(f"{prefix}_ext_sales_price", F64), "rev")],
+            Schema((Field("d_day_name", STR), Field("rev", F64))))
+    un = ForeignNode(
+        "UnionExec",
+        children=(chan("store_sales", "ss"), chan("web_sales", "ws")),
+        output=Schema((Field("d_day_name", STR), Field("rev", F64))))
+    daily = two_phase_agg(
+        un, grouping=[fcol("d_day_name", STR)],
+        group_fields=[Field("d_day_name", STR)],
+        aggs=[("rev", agg("Sum", fcol("rev", F64), F64),
+               Field("rev", F64))])
+    single = ForeignNode(
+        "ShuffleExchangeExec", children=(daily,), output=daily.output,
+        attrs={"partitioning": {"mode": "single", "num_partitions": 1}})
+    win_out = Schema(tuple(daily.output.fields) + (Field("total", F64),))
+    win = ForeignNode(
+        "WindowExec", children=(single,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "total", "fn": "agg", "args": [],
+                    "agg": agg("Sum", fcol("rev", F64), F64),
+                    "dtype": F64}],
+               "partition_spec": [], "order_spec": []})
+    share = fproject(
+        win, [fcol("d_day_name", STR), fcol("rev", F64),
+              falias(fcall("Divide", fcol("rev", F64),
+                           fcol("total", F64), dtype=F64), "share")],
+        Schema((Field("d_day_name", STR), Field("rev", F64),
+                Field("share", F64))))
+    return take_ordered(
+        share, orders=[so(fcol("d_day_name", STR))], limit=10,
+        project=[fcol("d_day_name", STR), fcol("rev", F64),
+                 fcol("share", F64)],
+        out=share.output)
+
+
+@_q("q08a")
+def q08a(cat: Catalog) -> ForeignNode:
+    """q08 family: store revenue restricted to stores in states that
+    actually have customers (LeftSemi against the address dim)."""
+    ss = cat.scan("store_sales", ["ss_store_sk", "ss_ext_sales_price"])
+    st = cat.scan("store", ["s_store_sk", "s_store_name", "s_state"])
+    j = bhj(ss, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    ca_states = two_phase_agg(
+        cat.scan("customer_address", ["ca_state"]),
+        grouping=[fcol("ca_state", STR)],
+        group_fields=[Field("ca_state", STR)],
+        aggs=[("n", agg("Count", None, I64), Field("n", I64))])
+    sel = smj(j, ca_states, [fcol("s_state", STR)],
+              [fcol("ca_state", STR)], join_type="LeftSemi")
+    grouped = two_phase_agg(
+        sel, grouping=[fcol("s_store_name", STR)],
+        group_fields=[Field("s_store_name", STR)],
+        aggs=[("rev", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("rev", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("rev", F64), asc=False),
+                so(fcol("s_store_name", STR))],
+        limit=100,
+        project=[fcol("s_store_name", STR), fcol("rev", F64)],
+        out=Schema((Field("s_store_name", STR), Field("rev", F64))))
+
+
+@_q("q11y")
+def q11y(cat: Catalog) -> ForeignNode:
+    """q11/q74 family: customers whose web spend grew year-over-year
+    (per-customer-year aggs self-joined on year+1)."""
+    ws = cat.scan("web_sales", ["ws_bill_customer_sk", "ws_sold_date_sk",
+                                "ws_ext_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_year"])
+    j = bhj(ws, dd, fcol("ws_sold_date_sk", I64), fcol("d_date_sk", I64))
+    yearly = two_phase_agg(
+        j, grouping=[fcol("ws_bill_customer_sk", I64),
+                     fcol("d_year", I32)],
+        group_fields=[Field("ws_bill_customer_sk", I64),
+                      Field("d_year", I32)],
+        aggs=[("spend", agg("Sum", fcol("ws_ext_sales_price", F64), F64),
+               Field("spend", F64))])
+    prev = fproject(
+        yearly,
+        [falias(fcol("ws_bill_customer_sk", I64), "pc"),
+         falias(fcall("Cast", fcall("Subtract", fcol("d_year", I32),
+                                    flit(-1)), dtype=I32), "ny"),
+         falias(fcol("spend", F64), "prev_spend")],
+        Schema((Field("pc", I64), Field("ny", I32),
+                Field("prev_spend", F64))))
+    grown = smj(yearly, prev,
+                [fcol("ws_bill_customer_sk", I64), fcol("d_year", I32)],
+                [fcol("pc", I64), fcol("ny", I32)],
+                out=Schema(tuple(yearly.output.fields) +
+                           tuple(prev.output.fields)))
+    up = ffilter(grown, fcall("GreaterThan", fcol("spend", F64),
+                              fcol("prev_spend", F64)))
+    total = two_phase_agg(
+        up, grouping=[fcol("d_year", I32)],
+        group_fields=[Field("d_year", I32)],
+        aggs=[("n_grown", agg("Count", None, I64), Field("n_grown", I64))])
+    return take_ordered(
+        total, orders=[so(fcol("d_year", I32))], limit=10,
+        project=[fcol("d_year", I32), fcol("n_grown", I64)],
+        out=Schema((Field("d_year", I32), Field("n_grown", I64))))
+
+
+@_q("q67r")
+def q67r(cat: Catalog) -> ForeignNode:
+    """q67 family: top revenue rows per category via a rank window over
+    a (category, class, moy) rollup."""
+    ss = cat.scan("store_sales", ["ss_item_sk", "ss_sold_date_sk",
+                                  "ss_ext_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_category", "i_class"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    pre = fproject(
+        j2, [fcol("i_category", STR), fcol("i_class", STR),
+             fcol("d_moy", I32), fcol("ss_ext_sales_price", F64)],
+        Schema((Field("i_category", STR), Field("i_class", STR),
+                Field("d_moy", I32), Field("ss_ext_sales_price", F64))))
+    expand_out = Schema(tuple(pre.output.fields) +
+                        (Field("spark_grouping_id", I64),))
+    expand = ForeignNode(
+        "ExpandExec", children=(pre,), output=expand_out,
+        attrs={"projections": [
+            [fcol("i_category", STR), fcol("i_class", STR),
+             fcol("d_moy", I32), fcol("ss_ext_sales_price", F64),
+             flit(0, I64)],
+            [fcol("i_category", STR), fcol("i_class", STR),
+             flit(None, I32), fcol("ss_ext_sales_price", F64),
+             flit(1, I64)],
+            [fcol("i_category", STR), flit(None, STR), flit(None, I32),
+             fcol("ss_ext_sales_price", F64), flit(3, I64)]]})
+    grouped = two_phase_agg(
+        expand,
+        grouping=[fcol("i_category", STR), fcol("i_class", STR),
+                  fcol("d_moy", I32), fcol("spark_grouping_id", I64)],
+        group_fields=[Field("i_category", STR), Field("i_class", STR),
+                      Field("d_moy", I32),
+                      Field("spark_grouping_id", I64)],
+        aggs=[("rev", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("rev", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,),
+        output=grouped.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": 4,
+            "expressions": [fcol("i_category", STR)]}})
+    win_out = Schema(tuple(grouped.output.fields) + (Field("rk", I64),))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "rk", "fn": "rank", "args": [],
+                    "dtype": I64}],
+               "partition_spec": [fcol("i_category", STR)],
+               "order_spec": [so(fcol("rev", F64), asc=False)]})
+    top = ffilter(win, fcall("LessThanOrEqual", fcol("rk", I64),
+                             flit(5)))
+    return take_ordered(
+        top,
+        orders=[so(fcol("i_category", STR), nulls_first=True),
+                so(fcol("rk", I64)),
+                so(fcol("i_class", STR), nulls_first=True),
+                so(fcol("d_moy", I32), nulls_first=True)],
+        limit=100,
+        project=[fcol("i_category", STR), fcol("i_class", STR),
+                 fcol("d_moy", I32), fcol("spark_grouping_id", I64),
+                 fcol("rev", F64), fcol("rk", I64)],
+        out=Schema((Field("i_category", STR), Field("i_class", STR),
+                    Field("d_moy", I32), Field("spark_grouping_id", I64),
+                    Field("rev", F64), Field("rk", I64))))
+
+
+@_q("q70r")
+def q70r(cat: Catalog) -> ForeignNode:
+    """q70 family: state profit rollup ranked by a whole-rollup-level
+    rank window."""
+    ss = cat.scan("store_sales", ["ss_store_sk", "ss_net_profit"])
+    st = cat.scan("store", ["s_store_sk", "s_state"])
+    j = bhj(ss, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    pre = fproject(
+        j, [fcol("s_state", STR), fcol("ss_net_profit", F64)],
+        Schema((Field("s_state", STR), Field("ss_net_profit", F64))))
+    expand_out = Schema(tuple(pre.output.fields) +
+                        (Field("spark_grouping_id", I64),))
+    expand = ForeignNode(
+        "ExpandExec", children=(pre,), output=expand_out,
+        attrs={"projections": [
+            [fcol("s_state", STR), fcol("ss_net_profit", F64),
+             flit(0, I64)],
+            [flit(None, STR), fcol("ss_net_profit", F64),
+             flit(1, I64)]]})
+    grouped = two_phase_agg(
+        expand,
+        grouping=[fcol("s_state", STR), fcol("spark_grouping_id", I64)],
+        group_fields=[Field("s_state", STR),
+                      Field("spark_grouping_id", I64)],
+        aggs=[("profit", agg("Sum", fcol("ss_net_profit", F64), F64),
+               Field("profit", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,),
+        output=grouped.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": 4,
+            "expressions": [fcol("spark_grouping_id", I64)]}})
+    win_out = Schema(tuple(grouped.output.fields) +
+                     (Field("rank_in_level", I64),))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "rank_in_level", "fn": "rank", "args": [],
+                    "dtype": I64}],
+               "partition_spec": [fcol("spark_grouping_id", I64)],
+               "order_spec": [so(fcol("profit", F64), asc=False)]})
+    return take_ordered(
+        win,
+        orders=[so(fcol("spark_grouping_id", I64)),
+                so(fcol("rank_in_level", I64)),
+                so(fcol("s_state", STR), nulls_first=True)],
+        limit=100,
+        project=[fcol("s_state", STR), fcol("spark_grouping_id", I64),
+                 fcol("profit", F64), fcol("rank_in_level", I64)],
+        out=win_out)
+
+
+@_q("q88c")
+def q88c(cat: Catalog) -> ForeignNode:
+    """q88 family: one row of global band counts (nested CASE flags
+    summed)."""
+    ss = cat.scan("store_sales", ["ss_quantity", "ss_sales_price"])
+    def flag(cond):
+        return fcall("CaseWhen", cond, flit(1, I64), flit(0, I64),
+                     dtype=I64)
+    marked = fproject(
+        ss, [falias(flag(fcall("LessThanOrEqual",
+                               fcol("ss_quantity", I32), flit(20))),
+                    "b1"),
+             falias(flag(fcall("And",
+                               fcall("GreaterThan",
+                                     fcol("ss_quantity", I32), flit(20)),
+                               fcall("LessThanOrEqual",
+                                     fcol("ss_quantity", I32),
+                                     flit(60)))), "b2"),
+             falias(flag(fcall("GreaterThan", fcol("ss_quantity", I32),
+                               flit(60))), "b3")],
+        Schema((Field("b1", I64), Field("b2", I64), Field("b3", I64))))
+    return two_phase_agg(
+        marked, grouping=[], group_fields=[],
+        aggs=[("n1", agg("Sum", fcol("b1", I64), I64), Field("n1", I64)),
+              ("n2", agg("Sum", fcol("b2", I64), I64), Field("n2", I64)),
+              ("n3", agg("Sum", fcol("b3", I64), I64), Field("n3", I64))])
+
+
+@_q("q44r")
+def q44r(cat: Catalog) -> ForeignNode:
+    """q44 family: best and worst items by average profit via two rank
+    windows joined on rank."""
+    base = two_phase_agg(
+        cat.scan("store_sales", ["ss_item_sk", "ss_net_profit"]),
+        grouping=[fcol("ss_item_sk", I64)],
+        group_fields=[Field("ss_item_sk", I64)],
+        aggs=[("avg_profit", agg("Average", fcol("ss_net_profit", F64),
+                                 F64),
+               Field("avg_profit", F64))])
+
+    def ranked(src, name, asc):
+        single = ForeignNode(
+            "ShuffleExchangeExec", children=(src,), output=src.output,
+            attrs={"partitioning": {"mode": "single",
+                                    "num_partitions": 1}})
+        win_out = Schema(tuple(src.output.fields) + (Field(name, I64),))
+        return ForeignNode(
+            "WindowExec", children=(single,), output=win_out,
+            attrs={"window_exprs": [
+                       {"name": name, "fn": "row_number", "args": [],
+                        "dtype": I64}],
+                   "partition_spec": [],
+                   "order_spec": [so(fcol("avg_profit", F64), asc=asc)]})
+
+    best = fproject(
+        ranked(base, "rk", False),
+        [falias(fcol("ss_item_sk", I64), "best_item"), fcol("rk", I64)],
+        Schema((Field("best_item", I64), Field("rk", I64))))
+    worst = fproject(
+        ranked(base, "wrk", True),
+        [falias(fcol("ss_item_sk", I64), "worst_item"),
+         fcol("wrk", I64)],
+        Schema((Field("worst_item", I64), Field("wrk", I64))))
+    best10 = ffilter(best, fcall("LessThanOrEqual", fcol("rk", I64),
+                                 flit(10)))
+    worst10 = ffilter(worst, fcall("LessThanOrEqual", fcol("wrk", I64),
+                                   flit(10)))
+    j = smj(best10, worst10, [fcol("rk", I64)], [fcol("wrk", I64)],
+            out=Schema(tuple(best10.output.fields) +
+                       tuple(worst10.output.fields)))
+    return take_ordered(
+        j, orders=[so(fcol("rk", I64))], limit=10,
+        project=[fcol("rk", I64), fcol("best_item", I64),
+                 fcol("worst_item", I64)],
+        out=Schema((Field("rk", I64), Field("best_item", I64),
+                    Field("worst_item", I64))))
+
+
+@_q("q59w")
+def q59w(cat: Catalog) -> ForeignNode:
+    """q59 family: store weekly revenue by day name pivoted via CASE
+    sums."""
+    ss = cat.scan("store_sales", ["ss_store_sk", "ss_sold_date_sk",
+                                  "ss_ext_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_day_name"])
+    j = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+
+    def day_rev(day, out):
+        return falias(
+            fcall("CaseWhen",
+                  fcall("EqualTo", fcol("d_day_name", STR),
+                        flit(day, STR)),
+                  fcol("ss_ext_sales_price", F64), flit(0.0, F64),
+                  dtype=F64), out)
+    pre = fproject(
+        j, [fcol("ss_store_sk", I64), day_rev("Monday", "mon"),
+            day_rev("Friday", "fri"), day_rev("Sunday", "sun")],
+        Schema((Field("ss_store_sk", I64), Field("mon", F64),
+                Field("fri", F64), Field("sun", F64))))
+    grouped = two_phase_agg(
+        pre, grouping=[fcol("ss_store_sk", I64)],
+        group_fields=[Field("ss_store_sk", I64)],
+        aggs=[("mon_rev", agg("Sum", fcol("mon", F64), F64),
+               Field("mon_rev", F64)),
+              ("fri_rev", agg("Sum", fcol("fri", F64), F64),
+               Field("fri_rev", F64)),
+              ("sun_rev", agg("Sum", fcol("sun", F64), F64),
+               Field("sun_rev", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("ss_store_sk", I64))], limit=100,
+        project=[fcol("ss_store_sk", I64), fcol("mon_rev", F64),
+                 fcol("fri_rev", F64), fcol("sun_rev", F64)],
+        out=Schema((Field("ss_store_sk", I64), Field("mon_rev", F64),
+                    Field("fri_rev", F64), Field("sun_rev", F64))))
