@@ -2654,3 +2654,345 @@ def q59w(cat: Catalog) -> ForeignNode:
                  fcol("fri_rev", F64), fcol("sun_rev", F64)],
         out=Schema((Field("ss_store_sk", I64), Field("mon_rev", F64),
                     Field("fri_rev", F64), Field("sun_rev", F64))))
+
+
+# ---------------------------------------------------------------------------
+# round-3 batch 3: cross-channel growth, exists-profiles, discount and
+# return-ratio families
+# ---------------------------------------------------------------------------
+
+@_q("q04y")
+def q04y(cat: Catalog) -> ForeignNode:
+    """q04 family: customers whose store spend grew faster than their
+    web spend year-over-year (two per-channel growth branches joined)."""
+    def yearly(table, prefix, cust_col, out):
+        sc = cat.scan(table, [cust_col, f"{prefix}_sold_date_sk",
+                              f"{prefix}_ext_sales_price"])
+        dd = cat.scan("date_dim", ["d_date_sk", "d_year"])
+        j = bhj(sc, dd, fcol(f"{prefix}_sold_date_sk", I64),
+                fcol("d_date_sk", I64))
+        g = two_phase_agg(
+            j, grouping=[fcol(cust_col, I64), fcol("d_year", I32)],
+            group_fields=[Field(cust_col, I64), Field("d_year", I32)],
+            aggs=[(out, agg("Sum", fcol(f"{prefix}_ext_sales_price",
+                                        F64), F64),
+                   Field(out, F64))])
+        return g, cust_col
+    ssy, ss_c = yearly("store_sales", "ss", "ss_customer_sk", "s_spend")
+    wsy, ws_c = yearly("web_sales", "ws", "ws_bill_customer_sk",
+                       "w_spend")
+    # right side's (cust, year) renamed via projection to avoid
+    # duplicate column names
+    wsy_renamed = fproject(
+        wsy, [falias(fcol(ws_c, I64), "wc"),
+              falias(fcol("d_year", I32), "wyear"),
+              fcol("w_spend", F64)],
+        Schema((Field("wc", I64), Field("wyear", I32),
+                Field("w_spend", F64))))
+    both = smj(ssy, wsy_renamed,
+               [fcol(ss_c, I64), fcol("d_year", I32)],
+               [fcol("wc", I64), fcol("wyear", I32)],
+               out=Schema(tuple(ssy.output.fields) +
+                          tuple(wsy_renamed.output.fields)))
+    fast = ffilter(both, fcall("GreaterThan", fcol("s_spend", F64),
+                               fcol("w_spend", F64)))
+    total = two_phase_agg(
+        fast, grouping=[fcol("d_year", I32)],
+        group_fields=[Field("d_year", I32)],
+        aggs=[("n", agg("Count", None, I64), Field("n", I64))])
+    return take_ordered(
+        total, orders=[so(fcol("d_year", I32))], limit=10,
+        project=[fcol("d_year", I32), fcol("n", I64)],
+        out=Schema((Field("d_year", I32), Field("n", I64))))
+
+
+@_q("q10x")
+def q10x(cat: Catalog) -> ForeignNode:
+    """q10 family: customer counts by birth country for customers active
+    on BOTH catalog and web channels (two LeftSemi restrictions)."""
+    cu = cat.scan("customer", ["c_customer_sk", "c_birth_country"])
+    cs = cat.scan("catalog_sales", ["cs_bill_customer_sk"])
+    ws = cat.scan("web_sales", ["ws_bill_customer_sk"])
+    on_cs = smj(cu, cs, [fcol("c_customer_sk", I64)],
+                [fcol("cs_bill_customer_sk", I64)], join_type="LeftSemi")
+    on_both = smj(on_cs, ws, [fcol("c_customer_sk", I64)],
+                  [fcol("ws_bill_customer_sk", I64)],
+                  join_type="LeftSemi")
+    grouped = two_phase_agg(
+        on_both, grouping=[fcol("c_birth_country", STR)],
+        group_fields=[Field("c_birth_country", STR)],
+        aggs=[("cnt", agg("Count", None, I64), Field("cnt", I64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("cnt", I64), asc=False),
+                so(fcol("c_birth_country", STR))],
+        limit=100,
+        project=[fcol("c_birth_country", STR), fcol("cnt", I64)],
+        out=Schema((Field("c_birth_country", STR), Field("cnt", I64))))
+
+
+@_q("q28b")
+def q28b(cat: Catalog) -> ForeignNode:
+    """q28 family: one row of per-band average prices over three
+    quantity bands (CASE-masked averages)."""
+    ss = cat.scan("store_sales", ["ss_quantity", "ss_sales_price"])
+    def band_price(lo, hi, out):
+        cond = fcall("And",
+                     fcall("GreaterThan", fcol("ss_quantity", I32),
+                           flit(lo)),
+                     fcall("LessThanOrEqual", fcol("ss_quantity", I32),
+                           flit(hi)))
+        return falias(fcall("CaseWhen", cond,
+                            fcol("ss_sales_price", F64),
+                            flit(None, F64), dtype=F64), out)
+    pre = fproject(
+        ss, [band_price(0, 25, "p1"), band_price(25, 60, "p2"),
+             band_price(60, 100, "p3")],
+        Schema((Field("p1", F64), Field("p2", F64), Field("p3", F64))))
+    return two_phase_agg(
+        pre, grouping=[], group_fields=[],
+        aggs=[("avg1", agg("Average", fcol("p1", F64), F64),
+               Field("avg1", F64)),
+              ("avg2", agg("Average", fcol("p2", F64), F64),
+               Field("avg2", F64)),
+              ("avg3", agg("Average", fcol("p3", F64), F64),
+               Field("avg3", F64))])
+
+
+@_q("q32e")
+def q32e(cat: Catalog) -> ForeignNode:
+    """q32/q92 family on catalog: excess-discount — revenue of sales
+    beating 1.3x their item's average (aggregate self-join)."""
+    cs = cat.scan("catalog_sales", ["cs_item_sk", "cs_ext_sales_price"])
+    avg_by_item = two_phase_agg(
+        cat.scan("catalog_sales", ["cs_item_sk", "cs_ext_sales_price"]),
+        grouping=[fcol("cs_item_sk", I64)],
+        group_fields=[Field("cs_item_sk", I64)],
+        aggs=[("avg_price", agg("Average", fcol("cs_ext_sales_price",
+                                                F64), F64),
+               Field("avg_price", F64))])
+    avg_renamed = fproject(
+        avg_by_item, [falias(fcol("cs_item_sk", I64), "ai"),
+                      fcol("avg_price", F64)],
+        Schema((Field("ai", I64), Field("avg_price", F64))))
+    j = smj(cs, avg_renamed, [fcol("cs_item_sk", I64)],
+            [fcol("ai", I64)],
+            out=Schema(tuple(cs.output.fields) +
+                       tuple(avg_renamed.output.fields)))
+    hot = ffilter(j, fcall(
+        "GreaterThan", fcol("cs_ext_sales_price", F64),
+        fcall("Multiply", flit(1.3, F64), fcol("avg_price", F64),
+              dtype=F64)))
+    return two_phase_agg(
+        hot, grouping=[], group_fields=[],
+        aggs=[("excess_rev", agg("Sum", fcol("cs_ext_sales_price", F64),
+                                 F64),
+               Field("excess_rev", F64)),
+              ("n", agg("Count", fcol("cs_ext_sales_price", F64), I64),
+               Field("n", I64))])
+
+
+@_q("q37i")
+def q37i(cat: Catalog) -> ForeignNode:
+    """q37/q82 family: items in a price band that actually sell on the
+    catalog channel (LeftSemi), listed by brand."""
+    it = cat.scan("item", ["i_item_sk", "i_brand", "i_current_price"])
+    banded = ffilter(it, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("i_current_price", F64),
+              flit(20.0)),
+        fcall("LessThanOrEqual", fcol("i_current_price", F64),
+              flit(50.0))))
+    cs = cat.scan("catalog_sales", ["cs_item_sk"])
+    sold = smj(banded, cs, [fcol("i_item_sk", I64)],
+               [fcol("cs_item_sk", I64)], join_type="LeftSemi")
+    grouped = two_phase_agg(
+        sold, grouping=[fcol("i_brand", STR)],
+        group_fields=[Field("i_brand", STR)],
+        aggs=[("n_items", agg("Count", None, I64), Field("n_items", I64)),
+              ("avg_price", agg("Average", fcol("i_current_price", F64),
+                                F64),
+               Field("avg_price", F64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("i_brand", STR))], limit=100,
+        project=[fcol("i_brand", STR), fcol("n_items", I64),
+                 fcol("avg_price", F64)],
+        out=Schema((Field("i_brand", STR), Field("n_items", I64),
+                    Field("avg_price", F64))))
+
+
+@_q("q49r")
+def q49r(cat: Catalog) -> ForeignNode:
+    """q49 family: worst return ratios — per-item return amount over
+    sales, top offenders via a rank window."""
+    sold = two_phase_agg(
+        cat.scan("store_sales", ["ss_item_sk", "ss_ext_sales_price"]),
+        grouping=[fcol("ss_item_sk", I64)],
+        group_fields=[Field("ss_item_sk", I64)],
+        aggs=[("rev", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("rev", F64))])
+    ret = two_phase_agg(
+        cat.scan("store_returns", ["sr_item_sk", "sr_return_amt"]),
+        grouping=[fcol("sr_item_sk", I64)],
+        group_fields=[Field("sr_item_sk", I64)],
+        aggs=[("ret_amt", agg("Sum", fcol("sr_return_amt", F64), F64),
+               Field("ret_amt", F64))])
+    j = smj(ret, sold, [fcol("sr_item_sk", I64)],
+            [fcol("ss_item_sk", I64)],
+            out=Schema(tuple(ret.output.fields) +
+                       tuple(sold.output.fields)))
+    ratio = fproject(
+        j, [fcol("sr_item_sk", I64), fcol("ret_amt", F64),
+            fcol("rev", F64),
+            falias(fcall("Divide", fcol("ret_amt", F64),
+                         fcol("rev", F64), dtype=F64), "ratio")],
+        Schema((Field("sr_item_sk", I64), Field("ret_amt", F64),
+                Field("rev", F64), Field("ratio", F64))))
+    single = ForeignNode(
+        "ShuffleExchangeExec", children=(ratio,), output=ratio.output,
+        attrs={"partitioning": {"mode": "single", "num_partitions": 1}})
+    win_out = Schema(tuple(ratio.output.fields) + (Field("rk", I64),))
+    win = ForeignNode(
+        "WindowExec", children=(single,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "rk", "fn": "rank", "args": [], "dtype": I64}],
+               "partition_spec": [],
+               "order_spec": [so(fcol("ratio", F64), asc=False)]})
+    worst = ffilter(win, fcall("LessThanOrEqual", fcol("rk", I64),
+                               flit(20)))
+    return take_ordered(
+        worst, orders=[so(fcol("rk", I64)), so(fcol("sr_item_sk", I64))],
+        limit=100,
+        project=[fcol("rk", I64), fcol("sr_item_sk", I64),
+                 fcol("ratio", F64)],
+        out=Schema((Field("rk", I64), Field("sr_item_sk", I64),
+                    Field("ratio", F64))))
+
+
+@_q("q54s")
+def q54s(cat: Catalog) -> ForeignNode:
+    """q54 family: store revenue from customers acquired on the web or
+    catalog channels (union of channel customer sets, LeftSemi)."""
+    webc = fproject(
+        cat.scan("web_sales", ["ws_bill_customer_sk"]),
+        [falias(fcol("ws_bill_customer_sk", I64), "ck")],
+        Schema((Field("ck", I64),)))
+    catc = fproject(
+        cat.scan("catalog_sales", ["cs_bill_customer_sk"]),
+        [falias(fcol("cs_bill_customer_sk", I64), "ck")],
+        Schema((Field("ck", I64),)))
+    un = ForeignNode("UnionExec", children=(webc, catc),
+                     output=Schema((Field("ck", I64),)))
+    acquirers = two_phase_agg(
+        un, grouping=[fcol("ck", I64)],
+        group_fields=[Field("ck", I64)],
+        aggs=[("n", agg("Count", None, I64), Field("n", I64))])
+    ss = cat.scan("store_sales", ["ss_customer_sk",
+                                  "ss_ext_sales_price"])
+    sel = smj(ss, acquirers, [fcol("ss_customer_sk", I64)],
+              [fcol("ck", I64)], join_type="LeftSemi")
+    return two_phase_agg(
+        sel, grouping=[], group_fields=[],
+        aggs=[("rev", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("rev", F64)),
+              ("n", agg("Count", fcol("ss_ext_sales_price", F64), I64),
+               Field("n", I64))])
+
+
+@_q("q72p")
+def q72p(cat: Catalog) -> ForeignNode:
+    """q72 family: store sales LEFT OUTER promotion — promo vs no-promo
+    revenue split."""
+    ss = cat.scan("store_sales", ["ss_promo_sk", "ss_ext_sales_price"])
+    pr = cat.scan("promotion", ["p_promo_sk", "p_channel_event"])
+    pr_y = ffilter(pr, fcall("EqualTo", fcol("p_channel_event", STR),
+                             flit("Y", STR)))
+    j = bhj(ss, pr_y, fcol("ss_promo_sk", I64), fcol("p_promo_sk", I64),
+            join_type="LeftOuter")
+    marked = fproject(
+        j, [falias(fcall("CaseWhen",
+                         fcall("IsNotNull", fcol("p_channel_event", STR)),
+                         flit("promo", STR), flit("no promo", STR),
+                         dtype=STR), "bucket"),
+            fcol("ss_ext_sales_price", F64)],
+        Schema((Field("bucket", STR), Field("ss_ext_sales_price", F64))))
+    grouped = two_phase_agg(
+        marked, grouping=[fcol("bucket", STR)],
+        group_fields=[Field("bucket", STR)],
+        aggs=[("rev", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("rev", F64)),
+              ("n", agg("Count", fcol("ss_ext_sales_price", F64), I64),
+               Field("n", I64))])
+    return take_ordered(
+        grouped, orders=[so(fcol("bucket", STR))], limit=10,
+        project=[fcol("bucket", STR), fcol("rev", F64), fcol("n", I64)],
+        out=Schema((Field("bucket", STR), Field("rev", F64),
+                    Field("n", I64))))
+
+
+@_q("q81r")
+def q81r(cat: Catalog) -> ForeignNode:
+    """q81/q30 family: customers whose returns exceed 1.2x their state's
+    average return (agg self-join on state)."""
+    ret = cat.scan("store_returns", ["sr_customer_sk", "sr_return_amt"])
+    cu = cat.scan("customer", ["c_customer_sk", "c_current_addr_sk"])
+    ca = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    j1 = bhj(ret, cu, fcol("sr_customer_sk", I64),
+             fcol("c_customer_sk", I64))
+    j2 = bhj(j1, ca, fcol("c_current_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    per_cust = two_phase_agg(
+        j2, grouping=[fcol("sr_customer_sk", I64), fcol("ca_state", STR)],
+        group_fields=[Field("sr_customer_sk", I64),
+                      Field("ca_state", STR)],
+        aggs=[("amt", agg("Sum", fcol("sr_return_amt", F64), F64),
+               Field("amt", F64))])
+    by_state = two_phase_agg(
+        per_cust, grouping=[fcol("ca_state", STR)],
+        group_fields=[Field("ca_state", STR)],
+        aggs=[("state_avg", agg("Average", fcol("amt", F64), F64),
+               Field("state_avg", F64))])
+    by_state_r = fproject(
+        by_state, [falias(fcol("ca_state", STR), "st"),
+                   fcol("state_avg", F64)],
+        Schema((Field("st", STR), Field("state_avg", F64))))
+    j3 = smj(per_cust, by_state_r, [fcol("ca_state", STR)],
+             [fcol("st", STR)],
+             out=Schema(tuple(per_cust.output.fields) +
+                        tuple(by_state_r.output.fields)))
+    heavy = ffilter(j3, fcall(
+        "GreaterThan", fcol("amt", F64),
+        fcall("Multiply", flit(1.2, F64), fcol("state_avg", F64),
+              dtype=F64)))
+    return take_ordered(
+        heavy,
+        orders=[so(fcol("amt", F64), asc=False),
+                so(fcol("sr_customer_sk", I64))],
+        limit=100,
+        project=[fcol("sr_customer_sk", I64), fcol("ca_state", STR),
+                 fcol("amt", F64), fcol("state_avg", F64)],
+        out=Schema((Field("sr_customer_sk", I64), Field("ca_state", STR),
+                    Field("amt", F64), Field("state_avg", F64))))
+
+
+@_q("q41d")
+def q41d(cat: Catalog) -> ForeignNode:
+    """q41 family: distinct brand/class combinations in a price band
+    (dedup via group-by)."""
+    it = cat.scan("item", ["i_brand", "i_class", "i_current_price"])
+    banded = ffilter(it, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("i_current_price", F64),
+              flit(30.0)),
+        fcall("LessThanOrEqual", fcol("i_current_price", F64),
+              flit(70.0))))
+    distinct = two_phase_agg(
+        banded, grouping=[fcol("i_brand", STR), fcol("i_class", STR)],
+        group_fields=[Field("i_brand", STR), Field("i_class", STR)],
+        aggs=[("n", agg("Count", None, I64), Field("n", I64))])
+    return take_ordered(
+        distinct,
+        orders=[so(fcol("i_brand", STR)), so(fcol("i_class", STR))],
+        limit=100,
+        project=[fcol("i_brand", STR), fcol("i_class", STR)],
+        out=Schema((Field("i_brand", STR), Field("i_class", STR))))
