@@ -441,6 +441,7 @@ def convert_sort_order(fe: ForeignExpr) -> SortExpr:
 # aggregate functions (NativeConverters.convertAggregateExpr:1228-1353)
 _AGG_FNS = {
     "Max": "max", "Min": "min", "Sum": "sum", "Average": "avg",
+    "StddevSamp": "stddev_samp", "VarianceSamp": "var_samp",
     "Count": "count", "First": "first", "CollectList": "collect_list",
     "CollectSet": "collect_set", "BloomFilterAggregate": "bloom_filter",
     "BrickhouseCollect": "brickhouse_collect",
@@ -457,6 +458,13 @@ def convert_agg_expr(fe: ForeignExpr) -> AggExpr:
         raise NotConvertible(f"expected AggregateExpression, got {fe.name}")
     agg = fe.children[0]
     distinct = bool(fe.attrs.get("distinct", False))
+    if distinct:
+        # the engine has no device distinct accumulation; Spark's
+        # optimizer rewrites distinct aggregates into two-level group-bys
+        # (RewriteDistinctAggregates) before plans reach the converter, so
+        # a surviving distinct flag means an unexpected plan shape — fall
+        # back rather than silently computing the non-distinct value
+        raise NotConvertible("distinct aggregates are not converted")
     if agg.name in _AGG_FNS:
         fn = _AGG_FNS[agg.name]
         if agg.name == "First" and agg.attrs.get("ignore_nulls"):

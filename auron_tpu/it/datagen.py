@@ -147,6 +147,9 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     cat.tables["store"] = _write_chunks(data_dir, "store", store, 1)
 
     # ---- customer + address ----------------------------------------------
+    # demographics table sizes (defined here: customer FKs reference them)
+    n_hd = 7200
+    n_cd = 19600
     n_cust = max(500, int(20_000 * sf))
     csk = np.arange(n_cust, dtype=np.int64) + 1
     addr_sk = rng.integers(1, n_cust + 1, n_cust).astype(np.int64)
@@ -154,6 +157,8 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "c_customer_sk": csk,
         "c_customer_id": pa.array([f"C{i:09d}" for i in csk]),
         "c_current_addr_sk": addr_sk,
+        "c_current_cdemo_sk": (csk % n_cd + 1).astype(np.int64),
+        "c_current_hdemo_sk": (csk % n_hd + 1).astype(np.int64),
         "c_birth_country": pa.array(
             [_COUNTRIES[int(i) % len(_COUNTRIES)] for i in csk]),
     })
@@ -167,6 +172,134 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     })
     cat.tables["customer_address"] = _write_chunks(
         data_dir, "customer_address", ca, 2)
+
+    # ---- warehouse / ship_mode / reason / call_center / web glue ---------
+    n_wh = max(3, int(5 * max(sf, 0.1)))
+    wsk = np.arange(n_wh, dtype=np.int64) + 1
+    warehouse = pa.table({
+        "w_warehouse_sk": wsk,
+        "w_warehouse_name": pa.array([f"Warehouse-{int(i)}" for i in wsk]),
+        "w_warehouse_sq_ft": rng.integers(50_000, 1_000_000,
+                                          n_wh).astype(np.int32),
+        "w_state": pa.array([_STATES[int(i) % len(_STATES)] for i in wsk]),
+    })
+    cat.tables["warehouse"] = _write_chunks(data_dir, "warehouse",
+                                            warehouse, 1)
+
+    _SM_TYPES = ("EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "LIBRARY")
+    _SM_CARRIERS = ("UPS", "FEDEX", "AIRBORNE", "USPS", "DHL")
+    smsk = np.arange(10, dtype=np.int64) + 1
+    ship_mode = pa.table({
+        "sm_ship_mode_sk": smsk,
+        "sm_type": pa.array([_SM_TYPES[int(i) % len(_SM_TYPES)]
+                             for i in smsk]),
+        "sm_carrier": pa.array([_SM_CARRIERS[int(i) % len(_SM_CARRIERS)]
+                                for i in smsk]),
+    })
+    cat.tables["ship_mode"] = _write_chunks(data_dir, "ship_mode",
+                                            ship_mode, 1)
+
+    _REASONS = ("Package was damaged", "Stopped working", "Did not fit",
+                "Not the product that was ordred", "Parts missing",
+                "Does not work with a product that I have",
+                "Gift exchange", "Did not like the color",
+                "Did not like the model", "Found a better price")
+    rsk = np.arange(len(_REASONS), dtype=np.int64) + 1
+    reason = pa.table({
+        "r_reason_sk": rsk,
+        "r_reason_desc": pa.array(list(_REASONS)),
+    })
+    cat.tables["reason"] = _write_chunks(data_dir, "reason", reason, 1)
+
+    n_cc = max(2, int(4 * max(sf, 0.1)))
+    ccsk = np.arange(n_cc, dtype=np.int64) + 1
+    call_center = pa.table({
+        "cc_call_center_sk": ccsk,
+        "cc_name": pa.array([f"call-center-{int(i)}" for i in ccsk]),
+        "cc_manager": pa.array([f"Manager{int(i) % 7}" for i in ccsk]),
+    })
+    cat.tables["call_center"] = _write_chunks(data_dir, "call_center",
+                                              call_center, 1)
+
+    n_web = max(2, int(4 * max(sf, 0.1)))
+    websk = np.arange(n_web, dtype=np.int64) + 1
+    web_site = pa.table({
+        "web_site_sk": websk,
+        "web_site_id": pa.array([f"WEB{i:04d}" for i in websk]),
+        "web_name": pa.array([f"site-{int(i)}" for i in websk]),
+    })
+    cat.tables["web_site"] = _write_chunks(data_dir, "web_site",
+                                           web_site, 1)
+
+    n_wp = max(4, int(10 * max(sf, 0.1)))
+    wpsk = np.arange(n_wp, dtype=np.int64) + 1
+    web_page = pa.table({
+        "wp_web_page_sk": wpsk,
+        "wp_char_count": rng.integers(100, 8000, n_wp).astype(np.int32),
+    })
+    cat.tables["web_page"] = _write_chunks(data_dir, "web_page",
+                                           web_page, 1)
+
+    n_cp = max(10, int(40 * max(sf, 0.1)))
+    cpsk = np.arange(n_cp, dtype=np.int64) + 1
+    catalog_page = pa.table({
+        "cp_catalog_page_sk": cpsk,
+        "cp_catalog_page_id": pa.array([f"CP{i:06d}" for i in cpsk]),
+    })
+    cat.tables["catalog_page"] = _write_chunks(data_dir, "catalog_page",
+                                               catalog_page, 1)
+
+    # ---- demographics ----------------------------------------------------
+    n_ib = 20
+    ibsk = np.arange(n_ib, dtype=np.int64) + 1
+    income_band = pa.table({
+        "ib_income_band_sk": ibsk,
+        "ib_lower_bound": (ibsk * 10_000 - 10_000).astype(np.int32),
+        "ib_upper_bound": (ibsk * 10_000).astype(np.int32),
+    })
+    cat.tables["income_band"] = _write_chunks(data_dir, "income_band",
+                                              income_band, 1)
+
+    _BUY_POTENTIAL = (">10000", "5001-10000", "1001-5000", "501-1000",
+                      "0-500", "Unknown")
+    hdsk = np.arange(n_hd, dtype=np.int64) + 1
+    hd = pa.table({
+        "hd_demo_sk": hdsk,
+        "hd_income_band_sk": (hdsk % n_ib + 1).astype(np.int64),
+        "hd_buy_potential": pa.array(
+            [_BUY_POTENTIAL[int(i) % len(_BUY_POTENTIAL)] for i in hdsk]),
+        "hd_dep_count": (hdsk % 10).astype(np.int32),
+        "hd_vehicle_count": (hdsk % 5).astype(np.int32),
+    })
+    cat.tables["household_demographics"] = _write_chunks(
+        data_dir, "household_demographics", hd, 1)
+
+    _GENDERS = ("M", "F")
+    _MARITAL = ("S", "M", "D", "W", "U")
+    _EDUCATION = ("Primary", "Secondary", "College", "2 yr Degree",
+                  "4 yr Degree", "Advanced Degree", "Unknown")
+    cdsk = np.arange(n_cd, dtype=np.int64) + 1
+    cd = pa.table({
+        "cd_demo_sk": cdsk,
+        "cd_gender": pa.array([_GENDERS[int(i) % 2] for i in cdsk]),
+        "cd_marital_status": pa.array(
+            [_MARITAL[int(i) % len(_MARITAL)] for i in cdsk]),
+        "cd_education_status": pa.array(
+            [_EDUCATION[int(i) % len(_EDUCATION)] for i in cdsk]),
+    })
+    cat.tables["customer_demographics"] = _write_chunks(
+        data_dir, "customer_demographics", cd, 2)
+
+    # ---- time_dim: per-minute granularity --------------------------------
+    n_min = 24 * 60
+    tsk = np.arange(n_min, dtype=np.int64)
+    time_dim = pa.table({
+        "t_time_sk": tsk,
+        "t_hour": (tsk // 60).astype(np.int32),
+        "t_minute": (tsk % 60).astype(np.int32),
+    })
+    cat.tables["time_dim"] = _write_chunks(data_dir, "time_dim",
+                                           time_dim, 1)
 
     # ---- promotion --------------------------------------------------------
     n_promo = max(10, int(30 * max(sf, 0.1)))
@@ -202,6 +335,10 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "ss_store_sk": ssk[rng.integers(0, n_store, n_ss)],
         "ss_promo_sk": psk[rng.integers(0, n_promo, n_ss)],
         "ss_ticket_number": np.arange(n_ss, dtype=np.int64) + 1,
+        "ss_hdemo_sk": hdsk[rng.integers(0, n_hd, n_ss)],
+        "ss_cdemo_sk": cdsk[rng.integers(0, n_cd, n_ss)],
+        "ss_addr_sk": csk[rng.integers(0, n_cust, n_ss)],
+        "ss_sold_time_sk": tsk[rng.integers(0, n_min, n_ss)],
     }, "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk")
     cat.tables["store_sales"] = _write_chunks(
         data_dir, "store_sales", ss, fact_chunks)
@@ -215,6 +352,9 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "sr_customer_sk": ss["ss_customer_sk"].to_numpy()[ridx],
         "sr_store_sk": ss["ss_store_sk"].to_numpy()[ridx],
         "sr_ticket_number": ss["ss_ticket_number"].to_numpy()[ridx],
+        # referential: the returning customer's current demographics
+        "sr_cdemo_sk": (ss["ss_customer_sk"].to_numpy()[ridx] % n_cd
+                        + 1).astype(np.int64),
         "sr_return_amt": np.round(
             ss["ss_ext_sales_price"].to_numpy()[ridx] *
             rng.uniform(0.1, 1.0, n_sr), 2),
@@ -223,15 +363,96 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         data_dir, "store_returns", sr, max(1, fact_chunks // 2))
 
     n_cs = max(1_000, n_ss // 2)
-    cs = fact(n_cs, "cs", {}, "cs_sold_date_sk", "cs_item_sk",
-              "cs_bill_customer_sk")
+    cs_sold = sk[rng.integers(0, n_days, n_cs)]
+    cs = fact(n_cs, "cs", {
+        # overrides fact()'s own draw (cols.update(extra) wins)
+        "cs_sold_date_sk": cs_sold,
+        # ~3 line items per order; ship a bounded number of days later
+        "cs_order_number": np.arange(n_cs, dtype=np.int64) // 3 + 1,
+        "cs_ship_date_sk": np.minimum(
+            cs_sold + rng.integers(1, 121, n_cs), sk[-1]),
+        "cs_warehouse_sk": wsk[rng.integers(0, n_wh, n_cs)],
+        "cs_ship_mode_sk": smsk[rng.integers(0, len(smsk), n_cs)],
+        "cs_call_center_sk": ccsk[rng.integers(0, n_cc, n_cs)],
+        "cs_catalog_page_sk": cpsk[rng.integers(0, n_cp, n_cs)],
+        "cs_promo_sk": psk[rng.integers(0, n_promo, n_cs)],
+    }, "cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk")
     cat.tables["catalog_sales"] = _write_chunks(
         data_dir, "catalog_sales", cs, max(1, fact_chunks // 2))
 
+    # catalog_returns: a subset of catalog order lines comes back
+    n_cr = max(100, n_cs // 10)
+    cridx = rng.choice(n_cs, n_cr, replace=False)
+    cr = pa.table({
+        "cr_returned_date_sk": sk[rng.integers(0, n_days, n_cr)],
+        "cr_item_sk": cs["cs_item_sk"].to_numpy()[cridx],
+        "cr_order_number": cs["cs_order_number"].to_numpy()[cridx],
+        "cr_returning_customer_sk":
+            cs["cs_bill_customer_sk"].to_numpy()[cridx],
+        "cr_call_center_sk": cs["cs_call_center_sk"].to_numpy()[cridx],
+        "cr_catalog_page_sk": cs["cs_catalog_page_sk"].to_numpy()[cridx],
+        "cr_reason_sk": rsk[rng.integers(0, len(rsk), n_cr)],
+        "cr_return_amount": np.round(
+            cs["cs_ext_sales_price"].to_numpy()[cridx] *
+            rng.uniform(0.1, 1.0, n_cr), 2),
+        "cr_net_loss": np.round(rng.uniform(0.5, 300.0, n_cr), 2),
+    })
+    cat.tables["catalog_returns"] = _write_chunks(
+        data_dir, "catalog_returns", cr, max(1, fact_chunks // 2))
+
     n_ws = max(1_000, n_ss // 4)
-    ws = fact(n_ws, "ws", {}, "ws_sold_date_sk", "ws_item_sk",
-              "ws_bill_customer_sk")
+    ws_sold = sk[rng.integers(0, n_days, n_ws)]
+    ws = fact(n_ws, "ws", {
+        "ws_sold_date_sk": ws_sold,
+        "ws_order_number": np.arange(n_ws, dtype=np.int64) // 3 + 1,
+        "ws_ship_date_sk": np.minimum(
+            ws_sold + rng.integers(1, 121, n_ws), sk[-1]),
+        "ws_ship_addr_sk": csk[rng.integers(0, n_cust, n_ws)],
+        "ws_web_site_sk": websk[rng.integers(0, n_web, n_ws)],
+        "ws_warehouse_sk": wsk[rng.integers(0, n_wh, n_ws)],
+        "ws_ship_mode_sk": smsk[rng.integers(0, len(smsk), n_ws)],
+        "ws_web_page_sk": wpsk[rng.integers(0, n_wp, n_ws)],
+        "ws_sold_time_sk": tsk[rng.integers(0, n_min, n_ws)],
+        "ws_ship_hdemo_sk": hdsk[rng.integers(0, n_hd, n_ws)],
+        "ws_promo_sk": psk[rng.integers(0, n_promo, n_ws)],
+    }, "ws_sold_date_sk", "ws_item_sk", "ws_bill_customer_sk")
     cat.tables["web_sales"] = _write_chunks(
         data_dir, "web_sales", ws, max(1, fact_chunks // 2))
+
+    # web_returns: a subset of web order lines comes back
+    n_wr = max(100, n_ws // 8)
+    wridx = rng.choice(n_ws, n_wr, replace=False)
+    wr = pa.table({
+        "wr_returned_date_sk": sk[rng.integers(0, n_days, n_wr)],
+        "wr_item_sk": ws["ws_item_sk"].to_numpy()[wridx],
+        "wr_order_number": ws["ws_order_number"].to_numpy()[wridx],
+        "wr_returning_customer_sk":
+            ws["ws_bill_customer_sk"].to_numpy()[wridx],
+        "wr_refunded_cdemo_sk": cdsk[rng.integers(0, n_cd, n_wr)],
+        "wr_refunded_addr_sk": csk[rng.integers(0, n_cust, n_wr)],
+        "wr_web_page_sk": ws["ws_web_page_sk"].to_numpy()[wridx],
+        "wr_reason_sk": rsk[rng.integers(0, len(rsk), n_wr)],
+        "wr_return_amt": np.round(
+            ws["ws_ext_sales_price"].to_numpy()[wridx] *
+            rng.uniform(0.1, 1.0, n_wr), 2),
+        "wr_fee": np.round(rng.uniform(0.5, 100.0, n_wr), 2),
+        "wr_refunded_cash": np.round(rng.uniform(0.0, 200.0, n_wr), 2),
+        "wr_net_loss": np.round(rng.uniform(0.5, 300.0, n_wr), 2),
+    })
+    cat.tables["web_returns"] = _write_chunks(
+        data_dir, "web_returns", wr, max(1, fact_chunks // 2))
+
+    # inventory: weekly quantity-on-hand snapshots per item x warehouse
+    inv_dates = sk[::7]
+    n_inv = len(inv_dates) * n_item * n_wh
+    inv = pa.table({
+        "inv_date_sk": np.repeat(inv_dates, n_item * n_wh),
+        "inv_item_sk": np.tile(np.repeat(isk, n_wh), len(inv_dates)),
+        "inv_warehouse_sk": np.tile(wsk, len(inv_dates) * n_item),
+        "inv_quantity_on_hand": rng.integers(
+            0, 1000, n_inv).astype(np.int32),
+    })
+    cat.tables["inventory"] = _write_chunks(
+        data_dir, "inventory", inv, fact_chunks)
 
     return cat

@@ -176,6 +176,24 @@ class _Eval:
             mask = np.where(decided, mask, vm)
         return _col(out, np.asarray(mask, bool))
 
+    def _coalesce(self, fe):
+        out, mask = self.eval(fe.children[0])
+        out = out.copy()
+        mask = mask.copy()
+        for ch in fe.children[1:]:
+            v, m = self.eval(ch)
+            take = mask & ~m
+            out = np.where(take, v, out)
+            mask = mask & m
+        return _col(out, mask)
+
+    def _if(self, fe):
+        c, cm = self.eval(fe.children[0])
+        t, tm = self.eval(fe.children[1])
+        f, fm = self.eval(fe.children[2])
+        take = c.astype(bool) & ~cm
+        return _col(np.where(take, t, f), np.where(take, tm, fm))
+
     def _substring(self, fe):
         a, am = self.eval(fe.children[0])
         pos = int(fe.children[1].value)
@@ -539,6 +557,12 @@ def _agg_value(name: str, vals: List) -> Any:
         return max(vals)
     if name == "First":
         return vals[0]
+    if name in ("StddevSamp", "VarianceSamp"):
+        if len(vals) == 1:
+            return float("nan")     # Spark: single row -> NaN
+        a = np.asarray(vals, np.float64)
+        var = float(a.var(ddof=1))
+        return var ** 0.5 if name == "StddevSamp" else var
     raise NotImplementedError(f"oracle aggregate {name}")
 
 

@@ -117,6 +117,10 @@ def two_phase_agg(child: ForeignNode, grouping: Sequence[ForeignExpr],
         if fn == "Average":
             state_fields += [Field(f"{name}#sum", F64),
                              Field(f"{name}#count", I64)]
+        elif fn in ("StddevSamp", "VarianceSamp"):
+            state_fields += [Field(f"{name}#sum", F64),
+                             Field(f"{name}#sumsq", F64),
+                             Field(f"{name}#count", I64)]
         elif fn == "Count":
             state_fields.append(Field(f"{name}#count", I64))
         else:
@@ -2996,3 +3000,1410 @@ def q41d(cat: Catalog) -> ForeignNode:
         limit=100,
         project=[fcol("i_brand", STR), fcol("i_class", STR)],
         out=Schema((Field("i_brand", STR), Field("i_class", STR))))
+
+
+# ---------------------------------------------------------------------------
+# round-3 batch 4: inventory / warehouse / ship-lag / demographics families
+# (tpcds-queries/q21,q39,q40,q46,q62,q73,q82,q99)
+# ---------------------------------------------------------------------------
+
+_INV_PIVOT = 2450815 + 1000     # mid-window d_date_sk pivot
+
+
+def _case(cond: ForeignExpr, then: ForeignExpr, other: ForeignExpr,
+          dtype: DataType) -> ForeignExpr:
+    return fcall("CaseWhen", cond, then, other, dtype=dtype)
+
+
+@_q("q21i")
+def q21i(cat: Catalog) -> ForeignNode:
+    """q21 family: per warehouse x item, inventory held before vs after a
+    pivot date inside a 60-day window, kept when the ratio stays within
+    [2/3, 3/2]."""
+    inv = cat.scan("inventory", ["inv_date_sk", "inv_item_sk",
+                                 "inv_warehouse_sk",
+                                 "inv_quantity_on_hand"])
+    wh = cat.scan("warehouse", ["w_warehouse_sk", "w_warehouse_name"])
+    it = cat.scan("item", ["i_item_sk", "i_item_id", "i_current_price"])
+    it = ffilter(it, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("i_current_price", F64),
+              flit(0.99)),
+        fcall("LessThanOrEqual", fcol("i_current_price", F64),
+              flit(80.0))))
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("GreaterThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT - 30)),
+              fcall("LessThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT + 30))),
+        ["d_date_sk"])
+    j1 = bhj(inv, wh, fcol("inv_warehouse_sk", I64),
+             fcol("w_warehouse_sk", I64))
+    j2 = bhj(j1, it, fcol("inv_item_sk", I64), fcol("i_item_sk", I64))
+    j3 = bhj(j2, dd, fcol("inv_date_sk", I64), fcol("d_date_sk", I64))
+    qty = fcall("Cast", fcol("inv_quantity_on_hand", I32), dtype=F64)
+    before = _case(fcall("LessThan", fcol("inv_date_sk", I64),
+                         flit(_INV_PIVOT)), qty, flit(0.0), F64)
+    after = _case(fcall("GreaterThanOrEqual", fcol("inv_date_sk", I64),
+                        flit(_INV_PIVOT)), qty, flit(0.0), F64)
+    grouped = two_phase_agg(
+        j3,
+        grouping=[fcol("w_warehouse_name", STR), fcol("i_item_id", STR)],
+        group_fields=[Field("w_warehouse_name", STR),
+                      Field("i_item_id", STR)],
+        aggs=[("inv_before", agg("Sum", before, F64),
+               Field("inv_before", F64)),
+              ("inv_after", agg("Sum", after, F64),
+               Field("inv_after", F64))])
+    ratio = fcall("Divide", fcol("inv_after", F64),
+                  fcol("inv_before", F64))
+    kept = ffilter(grouped, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", ratio, flit(2.0 / 3.0)),
+        fcall("LessThanOrEqual", ratio, flit(3.0 / 2.0))))
+    out = Schema((Field("w_warehouse_name", STR), Field("i_item_id", STR),
+                  Field("inv_before", F64), Field("inv_after", F64)))
+    return take_ordered(
+        kept,
+        orders=[so(fcol("w_warehouse_name", STR)),
+                so(fcol("i_item_id", STR))],
+        limit=100,
+        project=[fcol("w_warehouse_name", STR), fcol("i_item_id", STR),
+                 fcol("inv_before", F64), fcol("inv_after", F64)],
+        out=out)
+
+
+@_q("q39v")
+def q39v(cat: Catalog) -> ForeignNode:
+    """q39 family: monthly inventory mean/stddev per item x warehouse for
+    two consecutive months, self-joined on (warehouse, item) — the
+    StddevSamp-bearing query."""
+    def month_stats(moy: int, suffix: str) -> ForeignNode:
+        inv = cat.scan("inventory", ["inv_date_sk", "inv_item_sk",
+                                     "inv_warehouse_sk",
+                                     "inv_quantity_on_hand"])
+        dd = _dim_date(
+            cat,
+            fcall("And",
+                  fcall("EqualTo", fcol("d_moy", I32), flit(moy)),
+                  fcall("EqualTo", fcol("d_year", I32), flit(2000))),
+            ["d_date_sk", "d_moy", "d_year"])
+        j = bhj(inv, dd, fcol("inv_date_sk", I64), fcol("d_date_sk", I64))
+        qty = fcall("Cast", fcol("inv_quantity_on_hand", I32), dtype=F64)
+        grouped = two_phase_agg(
+            j,
+            grouping=[fcol("inv_warehouse_sk", I64),
+                      fcol("inv_item_sk", I64)],
+            group_fields=[Field("inv_warehouse_sk", I64),
+                          Field("inv_item_sk", I64)],
+            aggs=[("mean", agg("Average", qty, F64), Field("mean", F64)),
+                  ("sdev", agg("StddevSamp", qty, F64),
+                   Field("sdev", F64))])
+        out = Schema((Field(f"w{suffix}", I64), Field(f"i{suffix}", I64),
+                      Field(f"mean{suffix}", F64),
+                      Field(f"sdev{suffix}", F64)))
+        renamed = fproject(
+            grouped,
+            [falias(fcol("inv_warehouse_sk", I64), f"w{suffix}"),
+             falias(fcol("inv_item_sk", I64), f"i{suffix}"),
+             falias(fcol("mean", F64), f"mean{suffix}"),
+             falias(fcol("sdev", F64), f"sdev{suffix}")],
+            out)
+        # official q39: keep item-months whose coefficient of variation
+        # (stdev/mean) exceeds a threshold; 0.4 keeps the generated
+        # uniform-quantity corpus non-empty where the official 1.0 would
+        # filter everything
+        cov = fcall("Divide", fcol(f"sdev{suffix}", F64),
+                    fcol(f"mean{suffix}", F64))
+        return ffilter(renamed, fcall("GreaterThan", cov, flit(0.4)))
+
+    m1 = month_stats(1, "1")
+    m2 = month_stats(2, "2")
+    j = smj(m1, m2, [fcol("w1", I64), fcol("i1", I64)],
+            [fcol("w2", I64), fcol("i2", I64)])
+    out = Schema((Field("w1", I64), Field("i1", I64),
+                  Field("mean1", F64), Field("sdev1", F64),
+                  Field("mean2", F64), Field("sdev2", F64)))
+    return take_ordered(
+        j,
+        orders=[so(fcol("w1", I64)), so(fcol("i1", I64)),
+                so(fcol("mean1", F64)), so(fcol("mean2", F64))],
+        limit=100,
+        project=[fcol("w1", I64), fcol("i1", I64), fcol("mean1", F64),
+                 fcol("sdev1", F64), fcol("mean2", F64),
+                 fcol("sdev2", F64)],
+        out=out)
+
+
+@_q("q40c")
+def q40c(cat: Catalog) -> ForeignNode:
+    """q40 family: catalog sales net of returns (left-outer SMJ on
+    order+item) by warehouse state, split before/after a pivot date."""
+    cs = cat.scan("catalog_sales",
+                  ["cs_sold_date_sk", "cs_item_sk", "cs_order_number",
+                   "cs_warehouse_sk", "cs_sales_price"])
+    crt = cat.scan("catalog_returns",
+                   ["cr_order_number", "cr_item_sk", "cr_return_amount"])
+    j0 = smj(cs, crt,
+             [fcol("cs_order_number", I64), fcol("cs_item_sk", I64)],
+             [fcol("cr_order_number", I64), fcol("cr_item_sk", I64)],
+             join_type="LeftOuter")
+    wh = cat.scan("warehouse", ["w_warehouse_sk", "w_state"])
+    it = cat.scan("item", ["i_item_sk", "i_item_id", "i_current_price"])
+    it = ffilter(it, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("i_current_price", F64),
+              flit(0.99)),
+        fcall("LessThanOrEqual", fcol("i_current_price", F64),
+              flit(150.0))))
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("GreaterThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT - 30)),
+              fcall("LessThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT + 30))),
+        ["d_date_sk"])
+    j1 = bhj(j0, wh, fcol("cs_warehouse_sk", I64),
+             fcol("w_warehouse_sk", I64))
+    j2 = bhj(j1, it, fcol("cs_item_sk", I64), fcol("i_item_sk", I64))
+    j3 = bhj(j2, dd, fcol("cs_sold_date_sk", I64), fcol("d_date_sk", I64))
+    net = fcall("Subtract", fcol("cs_sales_price", F64),
+                fcall("Coalesce", fcol("cr_return_amount", F64),
+                      flit(0.0), dtype=F64))
+    before = _case(fcall("LessThan", fcol("cs_sold_date_sk", I64),
+                         flit(_INV_PIVOT)), net, flit(0.0), F64)
+    after = _case(fcall("GreaterThanOrEqual", fcol("cs_sold_date_sk", I64),
+                        flit(_INV_PIVOT)), net, flit(0.0), F64)
+    grouped = two_phase_agg(
+        j3,
+        grouping=[fcol("w_state", STR), fcol("i_item_id", STR)],
+        group_fields=[Field("w_state", STR), Field("i_item_id", STR)],
+        aggs=[("sales_before", agg("Sum", before, F64),
+               Field("sales_before", F64)),
+              ("sales_after", agg("Sum", after, F64),
+               Field("sales_after", F64))])
+    out = Schema((Field("w_state", STR), Field("i_item_id", STR),
+                  Field("sales_before", F64), Field("sales_after", F64)))
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("w_state", STR)), so(fcol("i_item_id", STR))],
+        limit=100,
+        project=[fcol("w_state", STR), fcol("i_item_id", STR),
+                 fcol("sales_before", F64), fcol("sales_after", F64)],
+        out=out)
+
+
+def _ship_lag_buckets(sold: str, ship: str,
+                      group_cols, group_fields, cat_scans) -> ForeignNode:
+    """Shared q62/q99 shape: join a sales fact to warehouse/ship_mode/
+    (site|call_center)/date and histogram ship-lag into 30-day buckets."""
+    node = cat_scans
+    lag = fcall("Subtract", fcol(ship, I64), fcol(sold, I64))
+    one, zero = flit(1), flit(0)
+
+    def bucket(name, cond):
+        return (name, agg("Sum", _case(cond, one, zero, I64), I64),
+                Field(name, I64))
+
+    grouped = two_phase_agg(
+        node, grouping=group_cols, group_fields=group_fields,
+        aggs=[bucket("d30", fcall("LessThanOrEqual", lag, flit(30))),
+              bucket("d60", fcall("And",
+                                  fcall("GreaterThan", lag, flit(30)),
+                                  fcall("LessThanOrEqual", lag,
+                                        flit(60)))),
+              bucket("d90", fcall("And",
+                                  fcall("GreaterThan", lag, flit(60)),
+                                  fcall("LessThanOrEqual", lag,
+                                        flit(90)))),
+              bucket("d120", fcall("And",
+                                   fcall("GreaterThan", lag, flit(90)),
+                                   fcall("LessThanOrEqual", lag,
+                                         flit(120)))),
+              bucket("dmore", fcall("GreaterThan", lag, flit(120)))])
+    out = Schema(tuple(group_fields) +
+                 (Field("d30", I64), Field("d60", I64), Field("d90", I64),
+                  Field("d120", I64), Field("dmore", I64)))
+    return take_ordered(
+        grouped,
+        orders=[so(fcol(f.name, f.dtype)) for f in group_fields],
+        limit=100,
+        project=[fcol(f.name, f.dtype) for f in group_fields] +
+                [fcol("d30", I64), fcol("d60", I64), fcol("d90", I64),
+                 fcol("d120", I64), fcol("dmore", I64)],
+        out=out)
+
+
+@_q("q62w")
+def q62w(cat: Catalog) -> ForeignNode:
+    """q62 family: web-sales ship-lag histogram by warehouse x ship mode x
+    web site."""
+    ws = cat.scan("web_sales",
+                  ["ws_sold_date_sk", "ws_ship_date_sk", "ws_warehouse_sk",
+                   "ws_ship_mode_sk", "ws_web_site_sk"])
+    wh = cat.scan("warehouse", ["w_warehouse_sk", "w_warehouse_name"])
+    sm = cat.scan("ship_mode", ["sm_ship_mode_sk", "sm_type"])
+    web = cat.scan("web_site", ["web_site_sk", "web_name"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32), flit(2000)),
+                   ["d_date_sk", "d_year"])
+    j1 = bhj(ws, wh, fcol("ws_warehouse_sk", I64),
+             fcol("w_warehouse_sk", I64))
+    j2 = bhj(j1, sm, fcol("ws_ship_mode_sk", I64),
+             fcol("sm_ship_mode_sk", I64))
+    j3 = bhj(j2, web, fcol("ws_web_site_sk", I64), fcol("web_site_sk", I64))
+    j4 = bhj(j3, dd, fcol("ws_ship_date_sk", I64), fcol("d_date_sk", I64))
+    return _ship_lag_buckets(
+        "ws_sold_date_sk", "ws_ship_date_sk",
+        [fcol("w_warehouse_name", STR), fcol("sm_type", STR),
+         fcol("web_name", STR)],
+        [Field("w_warehouse_name", STR), Field("sm_type", STR),
+         Field("web_name", STR)],
+        j4)
+
+
+@_q("q99c")
+def q99c(cat: Catalog) -> ForeignNode:
+    """q99 family: catalog-sales ship-lag histogram by warehouse x ship
+    mode x call center."""
+    cs = cat.scan("catalog_sales",
+                  ["cs_sold_date_sk", "cs_ship_date_sk", "cs_warehouse_sk",
+                   "cs_ship_mode_sk", "cs_call_center_sk"])
+    wh = cat.scan("warehouse", ["w_warehouse_sk", "w_warehouse_name"])
+    sm = cat.scan("ship_mode", ["sm_ship_mode_sk", "sm_type"])
+    cc = cat.scan("call_center", ["cc_call_center_sk", "cc_name"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32), flit(2000)),
+                   ["d_date_sk", "d_year"])
+    j1 = bhj(cs, wh, fcol("cs_warehouse_sk", I64),
+             fcol("w_warehouse_sk", I64))
+    j2 = bhj(j1, sm, fcol("cs_ship_mode_sk", I64),
+             fcol("sm_ship_mode_sk", I64))
+    j3 = bhj(j2, cc, fcol("cs_call_center_sk", I64),
+             fcol("cc_call_center_sk", I64))
+    j4 = bhj(j3, dd, fcol("cs_ship_date_sk", I64), fcol("d_date_sk", I64))
+    return _ship_lag_buckets(
+        "cs_sold_date_sk", "cs_ship_date_sk",
+        [fcol("w_warehouse_name", STR), fcol("sm_type", STR),
+         fcol("cc_name", STR)],
+        [Field("w_warehouse_name", STR), Field("sm_type", STR),
+         Field("cc_name", STR)],
+        j4)
+
+
+@_q("q73h")
+def q73h(cat: Catalog) -> ForeignNode:
+    """q73 family: tickets with 1-5 line items bought by high-potential
+    households, joined back to the customer."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk",
+                   "ss_customer_sk", "ss_ticket_number"])
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("GreaterThanOrEqual", fcol("d_dom", I32), flit(1)),
+              fcall("LessThanOrEqual", fcol("d_dom", I32), flit(2))),
+        ["d_date_sk", "d_dom"])
+    st = cat.scan("store", ["s_store_sk", "s_state"])
+    hd = cat.scan("household_demographics",
+                  ["hd_demo_sk", "hd_buy_potential", "hd_vehicle_count"])
+    hd = ffilter(hd, fcall(
+        "And",
+        fcall("In", fcol("hd_buy_potential", STR), flit(">10000"),
+              flit("Unknown")),
+        fcall("GreaterThan", fcol("hd_vehicle_count", I32), flit(0))))
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    j3 = bhj(j2, hd, fcol("ss_hdemo_sk", I64), fcol("hd_demo_sk", I64))
+    grouped = two_phase_agg(
+        j3,
+        grouping=[fcol("ss_ticket_number", I64),
+                  fcol("ss_customer_sk", I64)],
+        group_fields=[Field("ss_ticket_number", I64),
+                      Field("ss_customer_sk", I64)],
+        aggs=[("cnt", agg("Count", None, I64), Field("cnt", I64))])
+    sized = ffilter(grouped, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("cnt", I64), flit(1)),
+        fcall("LessThanOrEqual", fcol("cnt", I64), flit(5))))
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+    j4 = bhj(sized, cu, fcol("ss_customer_sk", I64),
+             fcol("c_customer_sk", I64))
+    out = Schema((Field("c_customer_id", STR),
+                  Field("ss_ticket_number", I64), Field("cnt", I64)))
+    return take_ordered(
+        j4,
+        orders=[so(fcol("cnt", I64), asc=False),
+                so(fcol("c_customer_id", STR)),
+                so(fcol("ss_ticket_number", I64))],
+        limit=100,
+        project=[fcol("c_customer_id", STR),
+                 fcol("ss_ticket_number", I64), fcol("cnt", I64)],
+        out=out)
+
+
+@_q("q46s")
+def q46s(cat: Catalog) -> ForeignNode:
+    """q46 family: weekend sales by dependent-heavy households where the
+    bought-at address state differs from the customer's current state
+    (double customer_address join with aliasing)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk",
+                   "ss_addr_sk", "ss_customer_sk", "ss_ticket_number",
+                   "ss_ext_sales_price"])
+    dd = _dim_date(cat, fcall("In", fcol("d_day_name", STR),
+                              flit("Friday"), flit("Saturday"),
+                              flit("Sunday")),
+                   ["d_date_sk", "d_day_name"])
+    hd = cat.scan("household_demographics",
+                  ["hd_demo_sk", "hd_dep_count", "hd_vehicle_count"])
+    hd = ffilter(hd, fcall(
+        "Or",
+        fcall("EqualTo", fcol("hd_dep_count", I32), flit(4)),
+        fcall("EqualTo", fcol("hd_vehicle_count", I32), flit(3))))
+    ca1 = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, hd, fcol("ss_hdemo_sk", I64), fcol("hd_demo_sk", I64))
+    j3 = bhj(j2, ca1, fcol("ss_addr_sk", I64), fcol("ca_address_sk", I64))
+    bought = fproject(
+        j3,
+        [fcol("ss_customer_sk", I64), fcol("ss_ticket_number", I64),
+         fcol("ss_ext_sales_price", F64),
+         falias(fcol("ca_state", STR), "bought_state")],
+        Schema((Field("ss_customer_sk", I64),
+                Field("ss_ticket_number", I64),
+                Field("ss_ext_sales_price", F64),
+                Field("bought_state", STR))))
+    grouped = two_phase_agg(
+        bought,
+        grouping=[fcol("ss_ticket_number", I64),
+                  fcol("ss_customer_sk", I64),
+                  fcol("bought_state", STR)],
+        group_fields=[Field("ss_ticket_number", I64),
+                      Field("ss_customer_sk", I64),
+                      Field("bought_state", STR)],
+        aggs=[("amt", agg("Sum", fcol("ss_ext_sales_price", F64), F64),
+               Field("amt", F64))])
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id",
+                               "c_current_addr_sk"])
+    ca2 = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    j4 = bhj(grouped, cu, fcol("ss_customer_sk", I64),
+             fcol("c_customer_sk", I64))
+    j5 = bhj(j4, ca2, fcol("c_current_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    moved = ffilter(j5, fcall(
+        "Not", fcall("EqualTo", fcol("bought_state", STR),
+                     fcol("ca_state", STR))))
+    out = Schema((Field("c_customer_id", STR),
+                  Field("bought_state", STR), Field("ca_state", STR),
+                  Field("amt", F64)))
+    return take_ordered(
+        moved,
+        orders=[so(fcol("c_customer_id", STR)),
+                so(fcol("amt", F64), asc=False),
+                so(fcol("bought_state", STR))],
+        limit=100,
+        project=[fcol("c_customer_id", STR), fcol("bought_state", STR),
+                 fcol("ca_state", STR), fcol("amt", F64)],
+        out=out)
+
+
+@_q("q82i")
+def q82i(cat: Catalog) -> ForeignNode:
+    """q82 family: items in a price band with mid-range inventory that
+    actually sold, deduped via group-by."""
+    it = cat.scan("item", ["i_item_sk", "i_item_id", "i_class",
+                           "i_current_price"])
+    it = ffilter(it, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("i_current_price", F64),
+              flit(20.0)),
+        fcall("LessThanOrEqual", fcol("i_current_price", F64),
+              flit(50.0))))
+    inv = cat.scan("inventory", ["inv_date_sk", "inv_item_sk",
+                                 "inv_quantity_on_hand"])
+    inv = ffilter(inv, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("inv_quantity_on_hand", I32),
+              flit(100)),
+        fcall("LessThanOrEqual", fcol("inv_quantity_on_hand", I32),
+              flit(500))))
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("GreaterThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT)),
+              fcall("LessThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT + 60))),
+        ["d_date_sk"])
+    j1 = bhj(inv, it, fcol("inv_item_sk", I64), fcol("i_item_sk", I64))
+    j2 = bhj(j1, dd, fcol("inv_date_sk", I64), fcol("d_date_sk", I64))
+    ss = cat.scan("store_sales", ["ss_item_sk"])
+    j3 = smj(j2, ss, [fcol("i_item_sk", I64)], [fcol("ss_item_sk", I64)],
+             join_type="LeftSemi")
+    dedup = two_phase_agg(
+        j3,
+        grouping=[fcol("i_item_id", STR), fcol("i_class", STR),
+                  fcol("i_current_price", F64)],
+        group_fields=[Field("i_item_id", STR), Field("i_class", STR),
+                      Field("i_current_price", F64)],
+        aggs=[])
+    out = Schema((Field("i_item_id", STR), Field("i_class", STR),
+                  Field("i_current_price", F64)))
+    return take_ordered(
+        dedup,
+        orders=[so(fcol("i_item_id", STR))],
+        limit=100,
+        project=[fcol("i_item_id", STR), fcol("i_class", STR),
+                 fcol("i_current_price", F64)],
+        out=out)
+
+
+# ---------------------------------------------------------------------------
+# round-3 batch 5: returns / demographics / order-exists families
+# (tpcds-queries/q24,q30,q83,q84,q85,q90,q91,q94,q95)
+# ---------------------------------------------------------------------------
+
+@_q("q30w")
+def q30w(cat: Catalog) -> ForeignNode:
+    """q30 family: customers whose WEB returns exceed 1.2x their state's
+    average (the web_returns twin of q81), joined back to the customer
+    id."""
+    ret = cat.scan("web_returns",
+                   ["wr_returning_customer_sk", "wr_return_amt"])
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id",
+                               "c_current_addr_sk"])
+    ca = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    j1 = bhj(ret, cu, fcol("wr_returning_customer_sk", I64),
+             fcol("c_customer_sk", I64))
+    j2 = bhj(j1, ca, fcol("c_current_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    per_cust = two_phase_agg(
+        j2, grouping=[fcol("c_customer_id", STR), fcol("ca_state", STR)],
+        group_fields=[Field("c_customer_id", STR),
+                      Field("ca_state", STR)],
+        aggs=[("amt", agg("Sum", fcol("wr_return_amt", F64), F64),
+               Field("amt", F64))])
+    by_state = two_phase_agg(
+        per_cust, grouping=[fcol("ca_state", STR)],
+        group_fields=[Field("ca_state", STR)],
+        aggs=[("state_avg", agg("Average", fcol("amt", F64), F64),
+               Field("state_avg", F64))])
+    by_state_r = fproject(
+        by_state, [falias(fcol("ca_state", STR), "st"),
+                   fcol("state_avg", F64)],
+        Schema((Field("st", STR), Field("state_avg", F64))))
+    j3 = smj(per_cust, by_state_r, [fcol("ca_state", STR)],
+             [fcol("st", STR)],
+             out=Schema(tuple(per_cust.output.fields) +
+                        tuple(by_state_r.output.fields)))
+    heavy = ffilter(j3, fcall(
+        "GreaterThan", fcol("amt", F64),
+        fcall("Multiply", flit(1.2, F64), fcol("state_avg", F64),
+              dtype=F64)))
+    return take_ordered(
+        heavy,
+        orders=[so(fcol("amt", F64), asc=False),
+                so(fcol("c_customer_id", STR))],
+        limit=100,
+        project=[fcol("c_customer_id", STR), fcol("ca_state", STR),
+                 fcol("amt", F64), fcol("state_avg", F64)],
+        out=Schema((Field("c_customer_id", STR), Field("ca_state", STR),
+                    Field("amt", F64), Field("state_avg", F64))))
+
+
+@_q("q24s")
+def q24s(cat: Catalog) -> ForeignNode:
+    """q24 family: net paid on returned tickets per customer x store x
+    item class, kept when above 5% of the overall average (global window
+    average + filter)."""
+    ss = cat.scan("store_sales",
+                  ["ss_ticket_number", "ss_item_sk", "ss_store_sk",
+                   "ss_customer_sk", "ss_sales_price"])
+    sr = cat.scan("store_returns", ["sr_ticket_number", "sr_item_sk"])
+    j0 = smj(ss, sr,
+             [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+             [fcol("sr_ticket_number", I64), fcol("sr_item_sk", I64)])
+    st = cat.scan("store", ["s_store_sk", "s_store_name"])
+    it = cat.scan("item", ["i_item_sk", "i_class"])
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+    j1 = bhj(j0, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    j3 = bhj(j2, cu, fcol("ss_customer_sk", I64),
+             fcol("c_customer_sk", I64))
+    grouped = two_phase_agg(
+        j3,
+        grouping=[fcol("c_customer_id", STR), fcol("s_store_name", STR),
+                  fcol("i_class", STR)],
+        group_fields=[Field("c_customer_id", STR),
+                      Field("s_store_name", STR), Field("i_class", STR)],
+        aggs=[("netpaid", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("netpaid", F64))])
+    single = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {"mode": "single", "num_partitions": 1}})
+    win_out = Schema(tuple(grouped.output.fields) +
+                     (Field("overall_avg", F64),))
+    win = ForeignNode(
+        "WindowExec", children=(single,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "overall_avg", "fn": "agg", "args": [],
+                    "agg": agg("Average", fcol("netpaid", F64), F64),
+                    "dtype": F64}],
+               "partition_spec": [], "order_spec": []})
+    heavy = ffilter(win, fcall(
+        "GreaterThan", fcol("netpaid", F64),
+        fcall("Multiply", flit(0.05, F64), fcol("overall_avg", F64),
+              dtype=F64)))
+    return take_ordered(
+        heavy,
+        orders=[so(fcol("c_customer_id", STR)),
+                so(fcol("netpaid", F64), asc=False),
+                so(fcol("s_store_name", STR)), so(fcol("i_class", STR))],
+        limit=100,
+        project=[fcol("c_customer_id", STR), fcol("s_store_name", STR),
+                 fcol("i_class", STR), fcol("netpaid", F64)],
+        out=Schema((Field("c_customer_id", STR),
+                    Field("s_store_name", STR), Field("i_class", STR),
+                    Field("netpaid", F64))))
+
+
+@_q("q83r")
+def q83r(cat: Catalog) -> ForeignNode:
+    """q83 family: per-item return amounts across the three return
+    channels, each expressed as a share of the channel-total average
+    (three aggs SMJ-joined on item id)."""
+    def channel(table: str, item_col: str, amt_col: str,
+                suffix: str) -> ForeignNode:
+        ret = cat.scan(table, [item_col, amt_col])
+        it = cat.scan("item", ["i_item_sk", "i_item_id"])
+        j = bhj(ret, it, fcol(item_col, I64), fcol("i_item_sk", I64))
+        grouped = two_phase_agg(
+            j, grouping=[fcol("i_item_id", STR)],
+            group_fields=[Field("i_item_id", STR)],
+            aggs=[(f"amt{suffix}", agg("Sum", fcol(amt_col, F64), F64),
+                   Field(f"amt{suffix}", F64))])
+        return fproject(
+            grouped,
+            [falias(fcol("i_item_id", STR), f"id{suffix}"),
+             fcol(f"amt{suffix}", F64)],
+            Schema((Field(f"id{suffix}", STR),
+                    Field(f"amt{suffix}", F64))))
+
+    sr = channel("store_returns", "sr_item_sk", "sr_return_amt", "_s")
+    cr = channel("catalog_returns", "cr_item_sk", "cr_return_amount",
+                 "_c")
+    wr = channel("web_returns", "wr_item_sk", "wr_return_amt", "_w")
+    j1 = smj(sr, cr, [fcol("id_s", STR)], [fcol("id_c", STR)],
+             out=Schema(tuple(sr.output.fields) +
+                        tuple(cr.output.fields)))
+    j2 = smj(j1, wr, [fcol("id_s", STR)], [fcol("id_w", STR)],
+             out=Schema(tuple(j1.output.fields) +
+                        tuple(wr.output.fields)))
+    total = fcall("Add", fcall("Add", fcol("amt_s", F64),
+                               fcol("amt_c", F64)),
+                  fcol("amt_w", F64))
+    third = fcall("Divide", total, flit(3.0))
+    proj_out = Schema((Field("item_id", STR), Field("sr_share", F64),
+                       Field("cr_share", F64), Field("wr_share", F64)))
+    shares = fproject(
+        j2,
+        [falias(fcol("id_s", STR), "item_id"),
+         falias(fcall("Divide", fcol("amt_s", F64), third), "sr_share"),
+         falias(fcall("Divide", fcol("amt_c", F64), third), "cr_share"),
+         falias(fcall("Divide", fcol("amt_w", F64), third), "wr_share")],
+        proj_out)
+    return take_ordered(
+        shares,
+        orders=[so(fcol("item_id", STR)),
+                so(fcol("sr_share", F64), asc=False)],
+        limit=100,
+        project=[fcol("item_id", STR), fcol("sr_share", F64),
+                 fcol("cr_share", F64), fcol("wr_share", F64)],
+        out=proj_out)
+
+
+@_q("q84d")
+def q84d(cat: Catalog) -> ForeignNode:
+    """q84 family: returning customers from one state in an income band,
+    resolved through the demographics chain (customer -> address ->
+    household demo -> income band -> customer demo -> store_returns)."""
+    cu = cat.scan("customer",
+                  ["c_customer_sk", "c_customer_id", "c_current_addr_sk",
+                   "c_current_cdemo_sk", "c_current_hdemo_sk"])
+    ca = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    ca = ffilter(ca, fcall("EqualTo", fcol("ca_state", STR), flit("CA")))
+    hd = cat.scan("household_demographics",
+                  ["hd_demo_sk", "hd_income_band_sk"])
+    ib = cat.scan("income_band",
+                  ["ib_income_band_sk", "ib_lower_bound",
+                   "ib_upper_bound"])
+    ib = ffilter(ib, fcall(
+        "And",
+        fcall("GreaterThanOrEqual", fcol("ib_lower_bound", I32),
+              flit(30_000)),
+        fcall("LessThanOrEqual", fcol("ib_upper_bound", I32),
+              flit(100_000))))
+    cd = cat.scan("customer_demographics", ["cd_demo_sk"])
+    sr = cat.scan("store_returns", ["sr_cdemo_sk"])
+    j1 = bhj(cu, ca, fcol("c_current_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    j2 = bhj(j1, hd, fcol("c_current_hdemo_sk", I64),
+             fcol("hd_demo_sk", I64))
+    j3 = bhj(j2, ib, fcol("hd_income_band_sk", I64),
+             fcol("ib_income_band_sk", I64))
+    j4 = bhj(j3, cd, fcol("c_current_cdemo_sk", I64),
+             fcol("cd_demo_sk", I64))
+    j5 = smj(j4, sr, [fcol("cd_demo_sk", I64)], [fcol("sr_cdemo_sk", I64)],
+             join_type="LeftSemi")
+    dedup = two_phase_agg(
+        j5, grouping=[fcol("c_customer_id", STR)],
+        group_fields=[Field("c_customer_id", STR)],
+        aggs=[])
+    return take_ordered(
+        dedup, orders=[so(fcol("c_customer_id", STR))], limit=100,
+        project=[fcol("c_customer_id", STR)],
+        out=Schema((Field("c_customer_id", STR),)))
+
+
+@_q("q85r")
+def q85r(cat: Catalog) -> ForeignNode:
+    """q85 family: reasons for web returns by matching demographics,
+    averaged per reason description."""
+    ws = cat.scan("web_sales",
+                  ["ws_item_sk", "ws_order_number", "ws_quantity",
+                   "ws_web_page_sk"])
+    wr = cat.scan("web_returns",
+                  ["wr_item_sk", "wr_order_number", "wr_refunded_cdemo_sk",
+                   "wr_refunded_addr_sk", "wr_reason_sk",
+                   "wr_refunded_cash", "wr_fee"])
+    j0 = smj(ws, wr,
+             [fcol("ws_order_number", I64), fcol("ws_item_sk", I64)],
+             [fcol("wr_order_number", I64), fcol("wr_item_sk", I64)])
+    wp = cat.scan("web_page", ["wp_web_page_sk"])
+    cd = cat.scan("customer_demographics",
+                  ["cd_demo_sk", "cd_marital_status",
+                   "cd_education_status"])
+    cd = ffilter(cd, fcall(
+        "Or",
+        fcall("And",
+              fcall("EqualTo", fcol("cd_marital_status", STR), flit("M")),
+              fcall("EqualTo", fcol("cd_education_status", STR),
+                    flit("4 yr Degree"))),
+        fcall("And",
+              fcall("EqualTo", fcol("cd_marital_status", STR), flit("S")),
+              fcall("EqualTo", fcol("cd_education_status", STR),
+                    flit("College")))))
+    ca = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    ca = ffilter(ca, fcall("In", fcol("ca_state", STR), flit("CA"),
+                           flit("TX"), flit("NY")))
+    rs = cat.scan("reason", ["r_reason_sk", "r_reason_desc"])
+    j1 = bhj(j0, wp, fcol("ws_web_page_sk", I64),
+             fcol("wp_web_page_sk", I64))
+    j2 = bhj(j1, cd, fcol("wr_refunded_cdemo_sk", I64),
+             fcol("cd_demo_sk", I64))
+    j3 = bhj(j2, ca, fcol("wr_refunded_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    j4 = bhj(j3, rs, fcol("wr_reason_sk", I64), fcol("r_reason_sk", I64))
+    grouped = two_phase_agg(
+        j4, grouping=[fcol("r_reason_desc", STR)],
+        group_fields=[Field("r_reason_desc", STR)],
+        aggs=[("avg_qty", agg("Average", fcall(
+                   "Cast", fcol("ws_quantity", I32), dtype=F64), F64),
+               Field("avg_qty", F64)),
+              ("avg_cash", agg("Average", fcol("wr_refunded_cash", F64),
+                               F64),
+               Field("avg_cash", F64)),
+              ("avg_fee", agg("Average", fcol("wr_fee", F64), F64),
+               Field("avg_fee", F64))])
+    out = Schema((Field("r_reason_desc", STR), Field("avg_qty", F64),
+                  Field("avg_cash", F64), Field("avg_fee", F64)))
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("r_reason_desc", STR))],
+        limit=100,
+        project=[fcol("r_reason_desc", STR), fcol("avg_qty", F64),
+                 fcol("avg_cash", F64), fcol("avg_fee", F64)],
+        out=out)
+
+
+@_q("q90r")
+def q90r(cat: Catalog) -> ForeignNode:
+    """q90 family: ratio of morning to evening web sales for
+    dependent-heavy households (two global counts joined on a literal
+    key)."""
+    def slot(h_lo: int, h_hi: int, name: str) -> ForeignNode:
+        ws = cat.scan("web_sales",
+                      ["ws_sold_time_sk", "ws_ship_hdemo_sk",
+                       "ws_web_page_sk"])
+        td = cat.scan("time_dim", ["t_time_sk", "t_hour"])
+        td = ffilter(td, fcall(
+            "And",
+            fcall("GreaterThanOrEqual", fcol("t_hour", I32), flit(h_lo)),
+            fcall("LessThanOrEqual", fcol("t_hour", I32), flit(h_hi))))
+        hd = cat.scan("household_demographics",
+                      ["hd_demo_sk", "hd_dep_count"])
+        hd = ffilter(hd, fcall("EqualTo", fcol("hd_dep_count", I32),
+                               flit(6)))
+        wp = cat.scan("web_page", ["wp_web_page_sk", "wp_char_count"])
+        wp = ffilter(wp, fcall(
+            "And",
+            fcall("GreaterThanOrEqual", fcol("wp_char_count", I32),
+                  flit(100)),
+            fcall("LessThanOrEqual", fcol("wp_char_count", I32),
+                  flit(8000))))
+        j1 = bhj(ws, td, fcol("ws_sold_time_sk", I64),
+                 fcol("t_time_sk", I64))
+        j2 = bhj(j1, hd, fcol("ws_ship_hdemo_sk", I64),
+                 fcol("hd_demo_sk", I64))
+        j3 = bhj(j2, wp, fcol("ws_web_page_sk", I64),
+                 fcol("wp_web_page_sk", I64))
+        counted = two_phase_agg(
+            j3, grouping=[], group_fields=[],
+            aggs=[(name, agg("Count", None, I64), Field(name, I64))])
+        return fproject(
+            counted,
+            [falias(flit(1, I64), f"k_{name}"), fcol(name, I64)],
+            Schema((Field(f"k_{name}", I64), Field(name, I64))))
+
+    am = slot(8, 9, "amc")
+    pm = slot(19, 20, "pmc")
+    j = bhj(am, pm, fcol("k_amc", I64), fcol("k_pmc", I64))
+    out = Schema((Field("am_pm_ratio", F64),))
+    ratio = fproject(
+        j,
+        [falias(fcall("Divide",
+                      fcall("Cast", fcol("amc", I64), dtype=F64),
+                      fcall("Cast", fcol("pmc", I64), dtype=F64)),
+                "am_pm_ratio")],
+        out)
+    return take_ordered(
+        ratio, orders=[so(fcol("am_pm_ratio", F64))], limit=10,
+        project=[fcol("am_pm_ratio", F64)], out=out)
+
+
+@_q("q91c")
+def q91c(cat: Catalog) -> ForeignNode:
+    """q91 family: call-center catalog-return losses by demographic
+    segment."""
+    cr = cat.scan("catalog_returns",
+                  ["cr_returned_date_sk", "cr_returning_customer_sk",
+                   "cr_call_center_sk", "cr_net_loss"])
+    cc = cat.scan("call_center",
+                  ["cc_call_center_sk", "cc_name", "cc_manager"])
+    dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32), flit(2000)),
+                   ["d_date_sk", "d_year"])
+    cu = cat.scan("customer",
+                  ["c_customer_sk", "c_current_cdemo_sk",
+                   "c_current_hdemo_sk", "c_current_addr_sk"])
+    cd = cat.scan("customer_demographics",
+                  ["cd_demo_sk", "cd_marital_status",
+                   "cd_education_status"])
+    cd = ffilter(cd, fcall(
+        "And",
+        fcall("In", fcol("cd_marital_status", STR), flit("M"),
+              flit("W")),
+        fcall("In", fcol("cd_education_status", STR), flit("Unknown"),
+              flit("Advanced Degree"), flit("College"))))
+    hd = cat.scan("household_demographics",
+                  ["hd_demo_sk", "hd_buy_potential"])
+    ca = cat.scan("customer_address", ["ca_address_sk", "ca_gmt_offset"])
+    ca = ffilter(ca, fcall("In", fcol("ca_gmt_offset", F64),
+                           flit(-5.0), flit(-6.0), flit(-7.0)))
+    j1 = bhj(cr, cc, fcol("cr_call_center_sk", I64),
+             fcol("cc_call_center_sk", I64))
+    j2 = bhj(j1, dd, fcol("cr_returned_date_sk", I64),
+             fcol("d_date_sk", I64))
+    j3 = bhj(j2, cu, fcol("cr_returning_customer_sk", I64),
+             fcol("c_customer_sk", I64))
+    j4 = bhj(j3, cd, fcol("c_current_cdemo_sk", I64),
+             fcol("cd_demo_sk", I64))
+    j5 = bhj(j4, hd, fcol("c_current_hdemo_sk", I64),
+             fcol("hd_demo_sk", I64))
+    j6 = bhj(j5, ca, fcol("c_current_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    grouped = two_phase_agg(
+        j6,
+        grouping=[fcol("cc_name", STR), fcol("cc_manager", STR),
+                  fcol("cd_marital_status", STR),
+                  fcol("cd_education_status", STR)],
+        group_fields=[Field("cc_name", STR), Field("cc_manager", STR),
+                      Field("cd_marital_status", STR),
+                      Field("cd_education_status", STR)],
+        aggs=[("loss", agg("Sum", fcol("cr_net_loss", F64), F64),
+               Field("loss", F64))])
+    out = Schema((Field("cc_name", STR), Field("cc_manager", STR),
+                  Field("cd_marital_status", STR),
+                  Field("cd_education_status", STR), Field("loss", F64)))
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("loss", F64), asc=False),
+                so(fcol("cc_name", STR))],
+        limit=100,
+        project=[fcol("cc_name", STR), fcol("cc_manager", STR),
+                 fcol("cd_marital_status", STR),
+                 fcol("cd_education_status", STR), fcol("loss", F64)],
+        out=out)
+
+
+def _multi_warehouse_orders(cat: Catalog, alias: str) -> ForeignNode:
+    """Orders shipped from more than one warehouse (the EXISTS in
+    q94/q95, rewritten as dedup -> count -> filter the way Spark's
+    optimizer lowers the correlated subquery)."""
+    ws = cat.scan("web_sales", ["ws_order_number", "ws_warehouse_sk"])
+    pairs = two_phase_agg(
+        ws,
+        grouping=[fcol("ws_order_number", I64),
+                  fcol("ws_warehouse_sk", I64)],
+        group_fields=[Field("ws_order_number", I64),
+                      Field("ws_warehouse_sk", I64)],
+        aggs=[])
+    counts = two_phase_agg(
+        pairs, grouping=[fcol("ws_order_number", I64)],
+        group_fields=[Field("ws_order_number", I64)],
+        aggs=[("n_wh", agg("Count", None, I64), Field("n_wh", I64))])
+    multi = ffilter(counts, fcall("GreaterThanOrEqual",
+                                  fcol("n_wh", I64), flit(2)))
+    return fproject(multi, [falias(fcol("ws_order_number", I64), alias)],
+                    Schema((Field(alias, I64),)))
+
+
+def _order_stats(base: ForeignNode) -> ForeignNode:
+    """Order-level rollup then the single-row summary q94/q95 report."""
+    per_order = two_phase_agg(
+        base, grouping=[fcol("ws_order_number", I64)],
+        group_fields=[Field("ws_order_number", I64)],
+        aggs=[("ship_cost", agg("Sum", fcol("ws_ext_sales_price", F64),
+                                F64),
+               Field("ship_cost", F64)),
+              ("profit", agg("Sum", fcol("ws_net_profit", F64), F64),
+               Field("profit", F64))])
+    return two_phase_agg(
+        per_order, grouping=[], group_fields=[],
+        aggs=[("order_count", agg("Count", None, I64),
+               Field("order_count", I64)),
+              ("total_ship", agg("Sum", fcol("ship_cost", F64), F64),
+               Field("total_ship", F64)),
+              ("total_profit", agg("Sum", fcol("profit", F64), F64),
+               Field("total_profit", F64))])
+
+
+@_q("q94n")
+def q94n(cat: Catalog) -> ForeignNode:
+    """q94 family: multi-warehouse web orders NOT returned (semi on the
+    rewritten exists, anti on web_returns), summarized."""
+    ws = cat.scan("web_sales",
+                  ["ws_order_number", "ws_ship_date_sk", "ws_ship_addr_sk",
+                   "ws_web_site_sk", "ws_ext_sales_price",
+                   "ws_net_profit"])
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("GreaterThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT)),
+              fcall("LessThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT + 60))),
+        ["d_date_sk"])
+    ca = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    ca = ffilter(ca, fcall("EqualTo", fcol("ca_state", STR), flit("TX")))
+    web = cat.scan("web_site", ["web_site_sk"])
+    j1 = bhj(ws, dd, fcol("ws_ship_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, ca, fcol("ws_ship_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    j3 = bhj(j2, web, fcol("ws_web_site_sk", I64), fcol("web_site_sk", I64))
+    multi = _multi_warehouse_orders(cat, "mo")
+    j4 = smj(j3, multi, [fcol("ws_order_number", I64)], [fcol("mo", I64)],
+             join_type="LeftSemi")
+    wr = cat.scan("web_returns", ["wr_order_number"])
+    j5 = smj(j4, wr, [fcol("ws_order_number", I64)],
+             [fcol("wr_order_number", I64)], join_type="LeftAnti")
+    total = _order_stats(j5)
+    out = Schema((Field("order_count", I64), Field("total_ship", F64),
+                  Field("total_profit", F64)))
+    return take_ordered(
+        total, orders=[so(fcol("order_count", I64))], limit=10,
+        project=[fcol("order_count", I64), fcol("total_ship", F64),
+                 fcol("total_profit", F64)],
+        out=out)
+
+
+@_q("q95w")
+def q95w(cat: Catalog) -> ForeignNode:
+    """q95 family: multi-warehouse web orders that WERE returned (semi on
+    both the rewritten exists and web_returns), summarized."""
+    ws = cat.scan("web_sales",
+                  ["ws_order_number", "ws_ship_date_sk", "ws_ship_addr_sk",
+                   "ws_web_site_sk", "ws_ext_sales_price",
+                   "ws_net_profit"])
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("GreaterThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT)),
+              fcall("LessThanOrEqual", fcol("d_date_sk", I64),
+                    flit(_INV_PIVOT + 60))),
+        ["d_date_sk"])
+    ca = cat.scan("customer_address", ["ca_address_sk", "ca_state"])
+    ca = ffilter(ca, fcall("EqualTo", fcol("ca_state", STR), flit("TX")))
+    j1 = bhj(ws, dd, fcol("ws_ship_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, ca, fcol("ws_ship_addr_sk", I64),
+             fcol("ca_address_sk", I64))
+    multi = _multi_warehouse_orders(cat, "mo")
+    j3 = smj(j2, multi, [fcol("ws_order_number", I64)], [fcol("mo", I64)],
+             join_type="LeftSemi")
+    wr = cat.scan("web_returns", ["wr_order_number"])
+    j4 = smj(j3, wr, [fcol("ws_order_number", I64)],
+             [fcol("wr_order_number", I64)], join_type="LeftSemi")
+    total = _order_stats(j4)
+    out = Schema((Field("order_count", I64), Field("total_ship", F64),
+                  Field("total_profit", F64)))
+    return take_ordered(
+        total, orders=[so(fcol("order_count", I64))], limit=10,
+        project=[fcol("order_count", I64), fcol("total_ship", F64),
+                 fcol("total_profit", F64)],
+        out=out)
+
+
+# ---------------------------------------------------------------------------
+# round-3 batch 6: cross-channel / rollup capstones
+# (tpcds-queries/q53,q56,q58,q64,q74,q78,q80)
+# ---------------------------------------------------------------------------
+
+@_q("q53m")
+def q53m(cat: Catalog) -> ForeignNode:
+    """q53 family: quarterly manufacturer sales vs their overall average
+    (the q63/q89 window shape keyed by manufacturer x quarter)."""
+    ss = cat.scan("store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk", "ss_sales_price"])
+    dd = cat.scan("date_dim", ["d_date_sk", "d_qoy"])
+    it = cat.scan("item", ["i_item_sk", "i_manufact_id"])
+    j1 = bhj(ss, dd, fcol("ss_sold_date_sk", I64), fcol("d_date_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j2, grouping=[fcol("i_manufact_id", I32), fcol("d_qoy", I32)],
+        group_fields=[Field("i_manufact_id", I32), Field("d_qoy", I32)],
+        aggs=[("sum_sales", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("sum_sales", F64))])
+    repart = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": 4,
+            "expressions": [fcol("i_manufact_id", I32)]}})
+    win_out = Schema((Field("i_manufact_id", I32), Field("d_qoy", I32),
+                      Field("sum_sales", F64), Field("avg_quarterly",
+                                                     F64)))
+    win = ForeignNode(
+        "WindowExec", children=(repart,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "avg_quarterly", "fn": "agg", "args": [],
+                    "agg": agg("Average", fcol("sum_sales", F64), F64),
+                    "dtype": F64}],
+               "partition_spec": [fcol("i_manufact_id", I32)],
+               "order_spec": []})
+    above = ffilter(win, fcall("GreaterThan", fcol("sum_sales", F64),
+                               fcol("avg_quarterly", F64)))
+    return take_ordered(
+        above,
+        orders=[so(fcol("avg_quarterly", F64), asc=False),
+                so(fcol("sum_sales", F64), asc=False),
+                so(fcol("i_manufact_id", I32)), so(fcol("d_qoy", I32))],
+        limit=100,
+        project=[fcol("i_manufact_id", I32), fcol("d_qoy", I32),
+                 fcol("sum_sales", F64), fcol("avg_quarterly", F64)],
+        out=win_out)
+
+
+def _channel_item_rev(cat: Catalog, table: str, date_col: str,
+                      item_col: str, cust_col: str, price_col: str,
+                      suffix: str, via_customer: bool = True
+                      ) -> ForeignNode:
+    """Shared q56/q58 shape: one channel's revenue per item id for
+    customers in the home timezone."""
+    cols = [date_col, item_col, price_col]
+    if via_customer:
+        cols.append(cust_col)
+    f = cat.scan(table, cols)
+    dd = _dim_date(
+        cat,
+        fcall("And",
+              fcall("EqualTo", fcol("d_year", I32), flit(2000)),
+              fcall("EqualTo", fcol("d_moy", I32), flit(2))),
+        ["d_date_sk", "d_year", "d_moy"])
+    it = cat.scan("item", ["i_item_sk", "i_item_id"])
+    j = bhj(f, dd, fcol(date_col, I64), fcol("d_date_sk", I64))
+    if via_customer:
+        cu = cat.scan("customer", ["c_customer_sk", "c_current_addr_sk"])
+        ca = cat.scan("customer_address",
+                      ["ca_address_sk", "ca_gmt_offset"])
+        ca = ffilter(ca, fcall("EqualTo", fcol("ca_gmt_offset", F64),
+                               flit(-5.0)))
+        j = bhj(j, cu, fcol(cust_col, I64), fcol("c_customer_sk", I64))
+        j = bhj(j, ca, fcol("c_current_addr_sk", I64),
+                fcol("ca_address_sk", I64))
+    j = bhj(j, it, fcol(item_col, I64), fcol("i_item_sk", I64))
+    grouped = two_phase_agg(
+        j, grouping=[fcol("i_item_id", STR)],
+        group_fields=[Field("i_item_id", STR)],
+        aggs=[(f"rev{suffix}", agg("Sum", fcol(price_col, F64), F64),
+               Field(f"rev{suffix}", F64))])
+    return fproject(
+        grouped,
+        [falias(fcol("i_item_id", STR), f"id{suffix}"),
+         fcol(f"rev{suffix}", F64)],
+        Schema((Field(f"id{suffix}", STR), Field(f"rev{suffix}", F64))))
+
+
+@_q("q56s")
+def q56s(cat: Catalog) -> ForeignNode:
+    """q56 family: per-item revenue summed across the three channels for
+    home-timezone customers (per-channel aggs unioned then re-agged)."""
+    ss = _channel_item_rev(cat, "store_sales", "ss_sold_date_sk",
+                           "ss_item_sk", "ss_customer_sk",
+                           "ss_ext_sales_price", "_u")
+    cs = _channel_item_rev(cat, "catalog_sales", "cs_sold_date_sk",
+                           "cs_item_sk", "cs_bill_customer_sk",
+                           "cs_ext_sales_price", "_u")
+    ws = _channel_item_rev(cat, "web_sales", "ws_sold_date_sk",
+                           "ws_item_sk", "ws_bill_customer_sk",
+                           "ws_ext_sales_price", "_u")
+    union = ForeignNode("UnionExec", children=(ss, cs, ws),
+                        output=ss.output)
+    total = two_phase_agg(
+        union, grouping=[fcol("id_u", STR)],
+        group_fields=[Field("id_u", STR)],
+        aggs=[("total_rev", agg("Sum", fcol("rev_u", F64), F64),
+               Field("total_rev", F64))])
+    out = Schema((Field("id_u", STR), Field("total_rev", F64)))
+    return take_ordered(
+        total,
+        orders=[so(fcol("total_rev", F64), asc=False),
+                so(fcol("id_u", STR))],
+        limit=100,
+        project=[fcol("id_u", STR), fcol("total_rev", F64)],
+        out=out)
+
+
+@_q("q58s")
+def q58s(cat: Catalog) -> ForeignNode:
+    """q58 family: items whose revenue in EACH channel stays within 10%
+    of the cross-channel average (three aggs SMJ-joined + band filter)."""
+    ss = _channel_item_rev(cat, "store_sales", "ss_sold_date_sk",
+                           "ss_item_sk", "ss_customer_sk",
+                           "ss_ext_sales_price", "_ss",
+                           via_customer=False)
+    cs = _channel_item_rev(cat, "catalog_sales", "cs_sold_date_sk",
+                           "cs_item_sk", "cs_bill_customer_sk",
+                           "cs_ext_sales_price", "_cs",
+                           via_customer=False)
+    ws = _channel_item_rev(cat, "web_sales", "ws_sold_date_sk",
+                           "ws_item_sk", "ws_bill_customer_sk",
+                           "ws_ext_sales_price", "_ws",
+                           via_customer=False)
+    j1 = smj(ss, cs, [fcol("id_ss", STR)], [fcol("id_cs", STR)],
+             out=Schema(tuple(ss.output.fields) +
+                        tuple(cs.output.fields)))
+    j2 = smj(j1, ws, [fcol("id_ss", STR)], [fcol("id_ws", STR)],
+             out=Schema(tuple(j1.output.fields) +
+                        tuple(ws.output.fields)))
+    average = fcall(
+        "Divide",
+        fcall("Add", fcall("Add", fcol("rev_ss", F64),
+                           fcol("rev_cs", F64)),
+              fcol("rev_ws", F64)),
+        flit(3.0))
+
+    def in_band(c):
+        # official q58 keeps channels within 10% of the average; the
+        # generated corpus sizes channels 4:2:1 by construction, so the
+        # family keeps the band-filter shape with a wider [0.2, 2.0] band
+        return fcall(
+            "And",
+            fcall("GreaterThanOrEqual", c,
+                  fcall("Multiply", flit(0.2, F64), average, dtype=F64)),
+            fcall("LessThanOrEqual", c,
+                  fcall("Multiply", flit(2.0, F64), average, dtype=F64)))
+
+    steady = ffilter(j2, fcall(
+        "And",
+        fcall("And", in_band(fcol("rev_ss", F64)),
+              in_band(fcol("rev_cs", F64))),
+        in_band(fcol("rev_ws", F64))))
+    out = Schema((Field("id_ss", STR), Field("rev_ss", F64),
+                  Field("rev_cs", F64), Field("rev_ws", F64)))
+    return take_ordered(
+        steady,
+        orders=[so(fcol("id_ss", STR)),
+                so(fcol("rev_ss", F64), asc=False)],
+        limit=100,
+        project=[fcol("id_ss", STR), fcol("rev_ss", F64),
+                 fcol("rev_cs", F64), fcol("rev_ws", F64)],
+        out=out)
+
+
+@_q("q64x")
+def q64x(cat: Catalog) -> ForeignNode:
+    """q64 family (reduced): items returned in store then cross-sold on
+    the catalog channel — ss joined to sr, per-item store stats SMJ-joined
+    to per-item catalog stats, dims on top."""
+    ss = cat.scan("store_sales",
+                  ["ss_ticket_number", "ss_item_sk", "ss_store_sk",
+                   "ss_sales_price"])
+    sr = cat.scan("store_returns", ["sr_ticket_number", "sr_item_sk"])
+    returned = smj(ss, sr,
+                   [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+                   [fcol("sr_ticket_number", I64),
+                    fcol("sr_item_sk", I64)])
+    store_stats = two_phase_agg(
+        returned, grouping=[fcol("ss_item_sk", I64)],
+        group_fields=[Field("ss_item_sk", I64)],
+        aggs=[("store_rev", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("store_rev", F64)),
+              ("n_ret", agg("Count", None, I64), Field("n_ret", I64))])
+    cs = cat.scan("catalog_sales",
+                  ["cs_item_sk", "cs_order_number", "cs_sales_price"])
+    cr = cat.scan("catalog_returns", ["cr_order_number", "cr_item_sk"])
+    kept = smj(cs, cr,
+               [fcol("cs_order_number", I64), fcol("cs_item_sk", I64)],
+               [fcol("cr_order_number", I64), fcol("cr_item_sk", I64)],
+               join_type="LeftAnti")
+    cat_stats = two_phase_agg(
+        kept, grouping=[fcol("cs_item_sk", I64)],
+        group_fields=[Field("cs_item_sk", I64)],
+        aggs=[("cat_rev", agg("Sum", fcol("cs_sales_price", F64), F64),
+               Field("cat_rev", F64))])
+    j = smj(store_stats, cat_stats, [fcol("ss_item_sk", I64)],
+            [fcol("cs_item_sk", I64)],
+            out=Schema(tuple(store_stats.output.fields) +
+                       tuple(cat_stats.output.fields)))
+    it = cat.scan("item", ["i_item_sk", "i_item_id", "i_current_price"])
+    j2 = bhj(j, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    richer = ffilter(j2, fcall("GreaterThan", fcol("cat_rev", F64),
+                               fcol("store_rev", F64)))
+    out = Schema((Field("i_item_id", STR), Field("i_current_price", F64),
+                  Field("store_rev", F64), Field("cat_rev", F64),
+                  Field("n_ret", I64)))
+    return take_ordered(
+        richer,
+        orders=[so(fcol("i_item_id", STR))],
+        limit=100,
+        project=[fcol("i_item_id", STR), fcol("i_current_price", F64),
+                 fcol("store_rev", F64), fcol("cat_rev", F64),
+                 fcol("n_ret", I64)],
+        out=out)
+
+
+@_q("q74y")
+def q74y(cat: Catalog) -> ForeignNode:
+    """q74 family: customers whose web spend grew faster year-over-year
+    than their store spend (two channel aggs with CaseWhen year pivots,
+    SMJ-joined)."""
+    def channel_pivot(table: str, date_col: str, cust_col: str,
+                      price_col: str, suffix: str) -> ForeignNode:
+        f = cat.scan(table, [date_col, cust_col, price_col])
+        dd = _dim_date(cat, fcall("In", fcol("d_year", I32), flit(2000),
+                                  flit(2001)),
+                       ["d_date_sk", "d_year"])
+        j = bhj(f, dd, fcol(date_col, I64), fcol("d_date_sk", I64))
+        y1 = _case(fcall("EqualTo", fcol("d_year", I32), flit(2000)),
+                   fcol(price_col, F64), flit(0.0), F64)
+        y2 = _case(fcall("EqualTo", fcol("d_year", I32), flit(2001)),
+                   fcol(price_col, F64), flit(0.0), F64)
+        grouped = two_phase_agg(
+            j, grouping=[fcol(cust_col, I64)],
+            group_fields=[Field(cust_col, I64)],
+            aggs=[(f"y1{suffix}", agg("Sum", y1, F64),
+                   Field(f"y1{suffix}", F64)),
+                  (f"y2{suffix}", agg("Sum", y2, F64),
+                   Field(f"y2{suffix}", F64))])
+        pos = ffilter(grouped, fcall(
+            "And",
+            fcall("GreaterThan", fcol(f"y1{suffix}", F64), flit(0.0)),
+            fcall("GreaterThan", fcol(f"y2{suffix}", F64), flit(0.0))))
+        return fproject(
+            pos,
+            [falias(fcol(cust_col, I64), f"c{suffix}"),
+             falias(fcall("Divide", fcol(f"y2{suffix}", F64),
+                          fcol(f"y1{suffix}", F64)), f"growth{suffix}")],
+            Schema((Field(f"c{suffix}", I64),
+                    Field(f"growth{suffix}", F64))))
+
+    store = channel_pivot("store_sales", "ss_sold_date_sk",
+                          "ss_customer_sk", "ss_ext_sales_price", "_s")
+    web = channel_pivot("web_sales", "ws_sold_date_sk",
+                        "ws_bill_customer_sk", "ws_ext_sales_price",
+                        "_w")
+    j = smj(store, web, [fcol("c_s", I64)], [fcol("c_w", I64)],
+            out=Schema(tuple(store.output.fields) +
+                       tuple(web.output.fields)))
+    faster = ffilter(j, fcall("GreaterThan", fcol("growth_w", F64),
+                              fcol("growth_s", F64)))
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+    j2 = bhj(faster, cu, fcol("c_s", I64), fcol("c_customer_sk", I64))
+    out = Schema((Field("c_customer_id", STR), Field("growth_s", F64),
+                  Field("growth_w", F64)))
+    return take_ordered(
+        j2,
+        orders=[so(fcol("growth_w", F64), asc=False),
+                so(fcol("c_customer_id", STR))],
+        limit=100,
+        project=[fcol("c_customer_id", STR), fcol("growth_s", F64),
+                 fcol("growth_w", F64)],
+        out=out)
+
+
+@_q("q78n")
+def q78n(cat: Catalog) -> ForeignNode:
+    """q78 family: per (year, item) sales kept after anti-joining returns
+    in all three channels; store revenue ratioed against web+catalog."""
+    def channel(table, date_col, item_col, price_col, anti, akeys, bkeys,
+                suffix):
+        f = cat.scan(table, [date_col, item_col, price_col] + akeys)
+        r = cat.scan(anti, bkeys)
+        j0 = smj(f, r, [fcol(k, I64) for k in akeys],
+                 [fcol(k, I64) for k in bkeys], join_type="LeftAnti")
+        dd = cat.scan("date_dim", ["d_date_sk", "d_year"])
+        j1 = bhj(j0, dd, fcol(date_col, I64), fcol("d_date_sk", I64))
+        grouped = two_phase_agg(
+            j1, grouping=[fcol("d_year", I32), fcol(item_col, I64)],
+            group_fields=[Field("d_year", I32), Field(item_col, I64)],
+            aggs=[(f"rev{suffix}", agg("Sum", fcol(price_col, F64), F64),
+                   Field(f"rev{suffix}", F64))])
+        return fproject(
+            grouped,
+            [falias(fcol("d_year", I32), f"y{suffix}"),
+             falias(fcol(item_col, I64), f"i{suffix}"),
+             fcol(f"rev{suffix}", F64)],
+            Schema((Field(f"y{suffix}", I32), Field(f"i{suffix}", I64),
+                    Field(f"rev{suffix}", F64))))
+
+    ss = channel("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                 "ss_sales_price", "store_returns",
+                 ["ss_ticket_number"], ["sr_ticket_number"], "_s")
+    ws = channel("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                 "ws_sales_price", "web_returns",
+                 ["ws_order_number"], ["wr_order_number"], "_w")
+    cs = channel("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                 "cs_sales_price", "catalog_returns",
+                 ["cs_order_number"], ["cr_order_number"], "_c")
+    j1 = smj(ss, ws, [fcol("y_s", I32), fcol("i_s", I64)],
+             [fcol("y_w", I32), fcol("i_w", I64)],
+             out=Schema(tuple(ss.output.fields) +
+                        tuple(ws.output.fields)))
+    j2 = smj(j1, cs, [fcol("y_s", I32), fcol("i_s", I64)],
+             [fcol("y_c", I32), fcol("i_c", I64)],
+             out=Schema(tuple(j1.output.fields) +
+                        tuple(cs.output.fields)))
+    ratio = fcall("Divide", fcol("rev_s", F64),
+                  fcall("Add", fcol("rev_w", F64), fcol("rev_c", F64)))
+    proj_out = Schema((Field("y_s", I32), Field("i_s", I64),
+                       Field("rev_s", F64), Field("rev_w", F64),
+                       Field("rev_c", F64), Field("store_ratio", F64)))
+    projected = fproject(
+        j2,
+        [fcol("y_s", I32), fcol("i_s", I64), fcol("rev_s", F64),
+         fcol("rev_w", F64), fcol("rev_c", F64),
+         falias(ratio, "store_ratio")],
+        proj_out)
+    return take_ordered(
+        projected,
+        orders=[so(fcol("store_ratio", F64), asc=False),
+                so(fcol("y_s", I32)), so(fcol("i_s", I64))],
+        limit=100,
+        project=[fcol("y_s", I32), fcol("i_s", I64), fcol("rev_s", F64),
+                 fcol("rev_w", F64), fcol("rev_c", F64),
+                 fcol("store_ratio", F64)],
+        out=proj_out)
+
+
+@_q("q80s")
+def q80s(cat: Catalog) -> ForeignNode:
+    """q80 family: sales / returns / net profit per channel id with a
+    rollup over (channel, id) — union of three channel aggs into an
+    ExpandExec grouping set."""
+    def channel(fact, date_col, item_col, promo_col, id_join):
+        id_table, id_fk, id_sk, id_col = id_join
+        cols = [date_col, item_col, promo_col, id_fk,
+                fact[1], fact[2], fact[3]]
+        f = cat.scan(fact[0], cols)
+        dd = _dim_date(cat, fcall("EqualTo", fcol("d_year", I32),
+                                  flit(2000)),
+                       ["d_date_sk", "d_year"])
+        pr = cat.scan("promotion", ["p_promo_sk", "p_channel_email"])
+        pr = ffilter(pr, fcall("EqualTo", fcol("p_channel_email", STR),
+                               flit("N")))
+        idt = cat.scan(id_table, [id_sk, id_col])
+        j = bhj(f, dd, fcol(date_col, I64), fcol("d_date_sk", I64))
+        j = bhj(j, pr, fcol(promo_col, I64), fcol("p_promo_sk", I64))
+        j = bhj(j, idt, fcol(id_fk, I64), fcol(id_sk, I64))
+        grouped = two_phase_agg(
+            j, grouping=[fcol(id_col, STR)],
+            group_fields=[Field(id_col, STR)],
+            aggs=[("sales", agg("Sum", fcol(fact[1], F64), F64),
+                   Field("sales", F64)),
+                  ("qty", agg("Sum", fcall(
+                      "Cast", fcol(fact[2], I32), dtype=F64), F64),
+                   Field("qty", F64)),
+                  ("profit", agg("Sum", fcol(fact[3], F64), F64),
+                   Field("profit", F64))])
+        return fproject(
+            grouped,
+            [falias(flit(fact[4]), "channel"),
+             falias(fcol(id_col, STR), "id"),
+             fcol("sales", F64), fcol("qty", F64), fcol("profit", F64)],
+            Schema((Field("channel", STR), Field("id", STR),
+                    Field("sales", F64), Field("qty", F64),
+                    Field("profit", F64))))
+
+    ss = channel(("store_sales", "ss_ext_sales_price", "ss_quantity",
+                  "ss_net_profit", "store channel"),
+                 "ss_sold_date_sk", "ss_item_sk", "ss_promo_sk",
+                 ("store", "ss_store_sk", "s_store_sk", "s_store_id"))
+    cs = channel(("catalog_sales", "cs_ext_sales_price", "cs_quantity",
+                  "cs_net_profit", "catalog channel"),
+                 "cs_sold_date_sk", "cs_item_sk", "cs_promo_sk",
+                 ("catalog_page", "cs_catalog_page_sk",
+                  "cp_catalog_page_sk", "cp_catalog_page_id"))
+    ws = channel(("web_sales", "ws_ext_sales_price", "ws_quantity",
+                  "ws_net_profit", "web channel"),
+                 "ws_sold_date_sk", "ws_item_sk", "ws_promo_sk",
+                 ("web_site", "ws_web_site_sk", "web_site_sk",
+                  "web_site_id"))
+    union = ForeignNode("UnionExec", children=(ss, cs, ws),
+                        output=ss.output)
+    expand_out = Schema(tuple(union.output.fields) +
+                        (Field("spark_grouping_id", I64),))
+    expand = ForeignNode(
+        "ExpandExec", children=(union,), output=expand_out,
+        attrs={"projections": [
+            [fcol("channel", STR), fcol("id", STR), fcol("sales", F64),
+             fcol("qty", F64), fcol("profit", F64), flit(0, I64)],
+            [fcol("channel", STR), flit(None, STR), fcol("sales", F64),
+             fcol("qty", F64), fcol("profit", F64), flit(1, I64)],
+            [flit(None, STR), flit(None, STR), fcol("sales", F64),
+             fcol("qty", F64), fcol("profit", F64), flit(3, I64)]]})
+    rolled = two_phase_agg(
+        expand,
+        grouping=[fcol("channel", STR), fcol("id", STR),
+                  fcol("spark_grouping_id", I64)],
+        group_fields=[Field("channel", STR), Field("id", STR),
+                      Field("spark_grouping_id", I64)],
+        aggs=[("total_sales", agg("Sum", fcol("sales", F64), F64),
+               Field("total_sales", F64)),
+              ("total_qty", agg("Sum", fcol("qty", F64), F64),
+               Field("total_qty", F64)),
+              ("total_profit", agg("Sum", fcol("profit", F64), F64),
+               Field("total_profit", F64))])
+    out = Schema((Field("channel", STR), Field("id", STR),
+                  Field("spark_grouping_id", I64),
+                  Field("total_sales", F64), Field("total_qty", F64),
+                  Field("total_profit", F64)))
+    return take_ordered(
+        rolled,
+        orders=[so(fcol("channel", STR)), so(fcol("id", STR)),
+                so(fcol("spark_grouping_id", I64))],
+        limit=100,
+        project=[fcol("channel", STR), fcol("id", STR),
+                 fcol("spark_grouping_id", I64), fcol("total_sales", F64),
+                 fcol("total_qty", F64), fcol("total_profit", F64)],
+        out=out)

@@ -206,6 +206,60 @@ class AvgSpec(AggSpec):
         return flat(DataType.float64(), avg, cnt.data > 0)
 
 
+class StddevSpec(AggSpec):
+    """stddev_samp / var_samp over (sum, sum-of-squares, count) power-sum
+    state.  The reference's central-moment accumulators (Spark's
+    StddevSamp lowered through agg.rs) update (n, mean, m2) row-at-a-time;
+    power sums carry the same information, are merge-associative, and
+    reduce in one segmented pass — the device-friendly formulation."""
+    n_states = 3
+
+    def __init__(self, fn, in_dtype, out_dtype, name):
+        super().__init__(fn, in_dtype, out_dtype, name)
+        self.is_std = fn == "stddev_samp"
+
+    def state_fields(self):
+        return [Field(f"{self.name}#sum", DataType.float64()),
+                Field(f"{self.name}#sumsq", DataType.float64()),
+                Field(f"{self.name}#count", DataType.int64(),
+                      nullable=False)]
+
+    def _pack(self, s, s2, cnt, n):
+        return [DeviceColumn(DataType.float64(), s, cnt > 0),
+                DeviceColumn(DataType.float64(), s2, cnt > 0),
+                DeviceColumn(DataType.int64(), cnt, jnp.ones(n, bool))]
+
+    def update_segments(self, cols, seg, n):
+        c = cols[0]
+        x = c.data.astype(jnp.float64)
+        s = _seg_sum(jnp.where(c.validity, x, 0.0), seg, n)
+        s2 = _seg_sum(jnp.where(c.validity, x * x, 0.0), seg, n)
+        cnt = _seg_sum(c.validity.astype(jnp.int64), seg, n)
+        return self._pack(s, s2, cnt, n)
+
+    def merge_segments(self, states, seg, n):
+        s = _seg_sum(jnp.where(states[0].validity, states[0].data, 0.0),
+                     seg, n)
+        s2 = _seg_sum(jnp.where(states[1].validity, states[1].data, 0.0),
+                      seg, n)
+        cnt = _seg_sum(jnp.where(states[2].validity, states[2].data, 0),
+                       seg, n)
+        return self._pack(s, s2, cnt, n)
+
+    def eval_final(self, states):
+        s, s2, cnt = states
+        nf = cnt.data.astype(jnp.float64)
+        # var_samp = (sum_sq - sum^2/n) / (n-1); clamped at 0 against
+        # catastrophic cancellation on near-constant groups
+        var = (s2.data - s.data * s.data / jnp.maximum(nf, 1.0)) / \
+            jnp.maximum(nf - 1.0, 1.0)
+        var = jnp.maximum(var, 0.0)
+        out = jnp.sqrt(var) if self.is_std else var
+        # Spark: one qualifying row -> NaN, zero -> NULL
+        out = jnp.where(cnt.data == 1, jnp.nan, out)
+        return flat(DataType.float64(), out, cnt.data > 0)
+
+
 class FirstSpec(AggSpec):
     """first / first_ignores_null: resolved by taking the value at the
     segment's first (qualifying) row index."""
@@ -363,6 +417,51 @@ class _HAvg(HostAcc):
         return float(acc[0]) / acc[1]
 
 
+class _HStddev(HostAcc):
+    """stddev_samp / var_samp over float power sums — the host twin of
+    StddevSpec (same (sum, sumsq, count) partial state).  The math lives
+    in one place, _StddevInner; this class only adapts it to the
+    flat-state HostAcc protocol."""
+    def __init__(self, spec, has_children):
+        super().__init__(spec, has_children)
+        self._inner = _StddevInner(spec.fn)
+    def init(self): return self._inner.init()
+    def update(self, acc, v): return self._inner.update(acc, v)
+    def merge_state(self, acc, st):
+        s, s2, c = st
+        if c:
+            return self._inner.merge(acc, [float(s or 0.0),
+                                           float(s2 or 0.0), int(c)])
+        return acc
+    def state(self, acc): return (acc[0], acc[1], acc[2])
+    def eval(self, acc): return self._inner.eval(acc)
+
+
+class _StddevInner:
+    """Power-sum stddev/variance over host-typed values (HostAggSpec
+    path, pickled partial state)."""
+    def __init__(self, fn: str):
+        self.fn = fn
+    def init(self): return [0.0, 0.0, 0]
+    def update(self, acc, v):
+        if v is not None:
+            f = float(v)
+            acc[0] += f
+            acc[1] += f * f
+            acc[2] += 1
+        return acc
+    def merge(self, a, b):
+        return [a[0] + b[0], a[1] + b[1], a[2] + b[2]]
+    def eval(self, acc):
+        s, s2, c = acc
+        if c == 0:
+            return None
+        if c == 1:
+            return float("nan")
+        var = max((s2 - s * s / c) / (c - 1), 0.0)
+        return var ** 0.5 if self.fn == "stddev_samp" else var
+
+
 class _HFirst(HostAcc):
     def init(self): return [False, None]   # (seen, value)
     def update(self, acc, v):
@@ -438,12 +537,17 @@ def host_accumulator(spec: "AggSpec", has_children: bool) -> HostAcc:
             # simple fns whose input type forced the host path (e.g. string
             # min/max, nested first); partial state is pickled
             inner = _SimpleInner(spec.fn)
+        elif spec.fn in ("stddev_samp", "var_samp"):
+            # non-flat input (e.g. decimal) forced the host path; the
+            # accumulator coerces to float like Spark's cast-to-double
+            inner = _StddevInner(spec.fn)
         else:
             raise NotImplementedError(f"host agg {spec.fn!r}")
         return _HPickled(spec, has_children, inner)
     return {
         "sum": _HSum, "count": _HCount, "min": _HMin, "max": _HMax,
         "avg": _HAvg, "first": _HFirst, "first_ignores_null": _HFirst,
+        "stddev_samp": _HStddev, "var_samp": _HStddev,
     }[spec.fn](spec, has_children)
 
 
@@ -523,7 +627,7 @@ _BUILTIN_HOST_AGGS = {
 }
 
 _DEVICE_AGG_FNS = {"sum", "count", "min", "max", "avg", "first",
-                   "first_ignores_null"}
+                   "first_ignores_null", "stddev_samp", "var_samp"}
 
 
 def make_spec(fn: str, in_dtype: DataType, out_dtype: DataType, name: str,
@@ -542,6 +646,8 @@ def make_spec(fn: str, in_dtype: DataType, out_dtype: DataType, name: str,
         return MinMaxSpec(fn, in_dtype, out_dtype, name)
     if fn == "avg" and flat_numeric(in_dtype):
         return AvgSpec(fn, in_dtype, out_dtype, name)
+    if fn in ("stddev_samp", "var_samp") and flat_numeric(in_dtype):
+        return StddevSpec(fn, in_dtype, out_dtype, name)
     if fn in ("first", "first_ignores_null") and is_device_type(in_dtype):
         return FirstSpec(fn, in_dtype, out_dtype, name)
     return HostAggSpec(fn, in_dtype, out_dtype, name, udaf_blob)

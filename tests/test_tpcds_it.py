@@ -69,7 +69,11 @@ def mesh_runner(small_catalog):
 # mesh only re-compiles the same fallback kernels at a second scale
 MESH_QUERIES = ["q03", "q07", "q42", "q55", "q13a", "q26a", "q48a",
                 "q19", "q65w", "q71u", "q27r", "q93s", "q76u", "q22r",
-                "q33b", "q60b", "q36r"]
+                "q33b", "q60b", "q36r",
+                # round-3 families: ship-lag histograms (CaseWhen-bucket
+                # aggs), stddev aggs, three-channel union, rollup-over-
+                # union capstone
+                "q62w", "q39v", "q56s", "q80s"]
 
 
 @pytest.mark.parametrize("query", MESH_QUERIES)
