@@ -17,7 +17,21 @@ def main() -> int:
                     help="comma-separated subset (default: all)")
     ap.add_argument("--golden-dir", default=None)
     ap.add_argument("--json", default=None, help="write results JSON here")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform to run on (default cpu: the IT "
+                         "differential suite is a correctness/CPU-gate "
+                         "harness; pass 'tpu' to drive the device)")
     args = ap.parse_args()
+
+    if args.platform:
+        # the TPU plugin overrides the JAX_PLATFORMS env var, so forcing
+        # a backend must go through jax.config (tests/conftest.py trick);
+        # the env var is still exported for any worker subprocesses
+        import os
+
+        import jax
+        os.environ["JAX_PLATFORMS"] = args.platform
+        jax.config.update("jax_platforms", args.platform)
 
     from auron_tpu.it.datagen import generate
     from auron_tpu.it.runner import QueryRunner

@@ -4407,3 +4407,211 @@ def q80s(cat: Catalog) -> ForeignNode:
                  fcol("spark_grouping_id", I64), fcol("total_sales", F64),
                  fcol("total_qty", F64), fcol("total_profit", F64)],
         out=out)
+
+
+# ---------------------------------------------------------------------------
+# second variants of the four families the reference ships twice
+# (tpcds-queries/ has q14a+q14b, q23a+q23b, q24a+q24b, q39a+q39b -> the
+# corpus carries both shapes too: 99 families + these 4 = 103 entries)
+# ---------------------------------------------------------------------------
+
+@_q("q14u")
+def q14u(cat: Catalog) -> ForeignNode:
+    """q14 second variant (q14a shape): union-all of the three sales
+    channels aggregated by brand, kept where channel-brand revenue beats
+    the cross-channel average (global window avg over the union)."""
+    def channel(tag, table, item_col, price_col):
+        sc = cat.scan(table, [item_col, price_col])
+        it = cat.scan("item", ["i_item_sk", "i_brand"])
+        j = bhj(sc, it, fcol(item_col, I64), fcol("i_item_sk", I64))
+        return fproject(
+            j, [falias(flit(tag, STR), "channel"),
+                fcol("i_brand", STR),
+                falias(fcol(price_col, F64), "ext_price")],
+            Schema((Field("channel", STR), Field("i_brand", STR),
+                    Field("ext_price", F64))))
+    un = ForeignNode(
+        "UnionExec",
+        children=(channel("store", "store_sales", "ss_item_sk",
+                          "ss_ext_sales_price"),
+                  channel("catalog", "catalog_sales", "cs_item_sk",
+                          "cs_ext_sales_price"),
+                  channel("web", "web_sales", "ws_item_sk",
+                          "ws_ext_sales_price")),
+        output=Schema((Field("channel", STR), Field("i_brand", STR),
+                       Field("ext_price", F64))))
+    grouped = two_phase_agg(
+        un, grouping=[fcol("channel", STR), fcol("i_brand", STR)],
+        group_fields=[Field("channel", STR), Field("i_brand", STR)],
+        aggs=[("sales", agg("Sum", fcol("ext_price", F64), F64),
+               Field("sales", F64))])
+    single = ForeignNode(
+        "ShuffleExchangeExec", children=(grouped,), output=grouped.output,
+        attrs={"partitioning": {"mode": "single", "num_partitions": 1}})
+    win_out = Schema(tuple(grouped.output.fields) +
+                     (Field("avg_sales", F64),))
+    win = ForeignNode(
+        "WindowExec", children=(single,), output=win_out,
+        attrs={"window_exprs": [
+                   {"name": "avg_sales", "fn": "agg", "args": [],
+                    "agg": agg("Average", fcol("sales", F64), F64),
+                    "dtype": F64}],
+               "partition_spec": [], "order_spec": []})
+    heavy = ffilter(win, fcall("GreaterThan", fcol("sales", F64),
+                               fcol("avg_sales", F64)))
+    return take_ordered(
+        heavy,
+        orders=[so(fcol("channel", STR)), so(fcol("i_brand", STR))],
+        limit=100,
+        project=[fcol("channel", STR), fcol("i_brand", STR),
+                 fcol("sales", F64)],
+        out=Schema((Field("channel", STR), Field("i_brand", STR),
+                    Field("sales", F64))))
+
+
+@_q("q23c")
+def q23c(cat: Catalog) -> ForeignNode:
+    """q23 second variant (q23b shape): catalog + web revenue of frequent
+    store items, grouped PER CUSTOMER (vs q23m's single scalar) and
+    unioned across the two channels."""
+    freq = two_phase_agg(
+        cat.scan("store_sales", ["ss_item_sk"]),
+        grouping=[fcol("ss_item_sk", I64)],
+        group_fields=[Field("ss_item_sk", I64)],
+        aggs=[("cnt", agg("Count", None, I64), Field("cnt", I64))])
+    freq = ffilter(freq, fcall("GreaterThan", fcol("cnt", I64), flit(5)))
+
+    def channel(table, item_col, cust_col, qty_col, price_col):
+        sc = cat.scan(table, [item_col, cust_col, qty_col, price_col])
+        sel = smj(sc, freq, [fcol(item_col, I64)],
+                  [fcol("ss_item_sk", I64)], join_type="LeftSemi")
+        cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+        j = bhj(sel, cu, fcol(cust_col, I64), fcol("c_customer_sk", I64))
+        pre = fproject(
+            j, [fcol("c_customer_id", STR),
+                falias(fcall("Multiply",
+                             fcall("Cast", fcol(qty_col, I32), dtype=F64),
+                             fcol(price_col, F64), dtype=F64), "sales")],
+            Schema((Field("c_customer_id", STR), Field("sales", F64))))
+        return two_phase_agg(
+            pre, grouping=[fcol("c_customer_id", STR)],
+            group_fields=[Field("c_customer_id", STR)],
+            aggs=[("sales", agg("Sum", fcol("sales", F64), F64),
+                   Field("sales", F64))])
+    un = ForeignNode(
+        "UnionExec",
+        children=(channel("catalog_sales", "cs_item_sk",
+                          "cs_bill_customer_sk", "cs_quantity",
+                          "cs_sales_price"),
+                  channel("web_sales", "ws_item_sk",
+                          "ws_bill_customer_sk", "ws_quantity",
+                          "ws_sales_price")),
+        output=Schema((Field("c_customer_id", STR), Field("sales", F64))))
+    return take_ordered(
+        un, orders=[so(fcol("c_customer_id", STR)),
+                    so(fcol("sales", F64), asc=False)],
+        limit=100,
+        project=[fcol("c_customer_id", STR), fcol("sales", F64)],
+        out=Schema((Field("c_customer_id", STR), Field("sales", F64))))
+
+
+@_q("q24c")
+def q24c(cat: Catalog) -> ForeignNode:
+    """q24 second variant (q24b shape: the literal-delta twin of q24s) —
+    net paid on returned tickets restricted to ONE item class before
+    aggregation, grouped by customer x store."""
+    ss = cat.scan("store_sales",
+                  ["ss_ticket_number", "ss_item_sk", "ss_store_sk",
+                   "ss_customer_sk", "ss_sales_price"])
+    sr = cat.scan("store_returns", ["sr_ticket_number", "sr_item_sk"])
+    j0 = smj(ss, sr,
+             [fcol("ss_ticket_number", I64), fcol("ss_item_sk", I64)],
+             [fcol("sr_ticket_number", I64), fcol("sr_item_sk", I64)])
+    st = cat.scan("store", ["s_store_sk", "s_store_name"])
+    it = cat.scan("item", ["i_item_sk", "i_class"])
+    it = ffilter(it, fcall("EqualTo", fcol("i_class", STR),
+                           flit("class#7")))
+    cu = cat.scan("customer", ["c_customer_sk", "c_customer_id"])
+    j1 = bhj(j0, st, fcol("ss_store_sk", I64), fcol("s_store_sk", I64))
+    j2 = bhj(j1, it, fcol("ss_item_sk", I64), fcol("i_item_sk", I64))
+    j3 = bhj(j2, cu, fcol("ss_customer_sk", I64),
+             fcol("c_customer_sk", I64))
+    grouped = two_phase_agg(
+        j3,
+        grouping=[fcol("c_customer_id", STR), fcol("s_store_name", STR)],
+        group_fields=[Field("c_customer_id", STR),
+                      Field("s_store_name", STR)],
+        aggs=[("netpaid", agg("Sum", fcol("ss_sales_price", F64), F64),
+               Field("netpaid", F64))])
+    return take_ordered(
+        grouped,
+        orders=[so(fcol("netpaid", F64), asc=False),
+                so(fcol("c_customer_id", STR)),
+                so(fcol("s_store_name", STR))],
+        limit=100,
+        project=[fcol("c_customer_id", STR), fcol("s_store_name", STR),
+                 fcol("netpaid", F64)],
+        out=Schema((Field("c_customer_id", STR),
+                    Field("s_store_name", STR), Field("netpaid", F64))))
+
+
+@_q("q39w")
+def q39w(cat: Catalog) -> ForeignNode:
+    """q39 second variant (q39b shape): identical to q39v except the
+    first month additionally requires cov > a tighter threshold
+    (reference delta: q39b.sql adds `inv1.cov > 1.5`)."""
+    def month_stats(moy: int, suffix: str, cov_min: float) -> ForeignNode:
+        inv = cat.scan("inventory", ["inv_date_sk", "inv_item_sk",
+                                     "inv_warehouse_sk",
+                                     "inv_quantity_on_hand"])
+        dd = _dim_date(
+            cat,
+            fcall("And",
+                  fcall("EqualTo", fcol("d_moy", I32), flit(moy)),
+                  fcall("EqualTo", fcol("d_year", I32), flit(2000))),
+            ["d_date_sk", "d_moy", "d_year"])
+        j = bhj(inv, dd, fcol("inv_date_sk", I64), fcol("d_date_sk", I64))
+        qty = fcall("Cast", fcol("inv_quantity_on_hand", I32), dtype=F64)
+        grouped = two_phase_agg(
+            j,
+            grouping=[fcol("inv_warehouse_sk", I64),
+                      fcol("inv_item_sk", I64)],
+            group_fields=[Field("inv_warehouse_sk", I64),
+                          Field("inv_item_sk", I64)],
+            aggs=[("mean", agg("Average", qty, F64), Field("mean", F64)),
+                  ("sdev", agg("StddevSamp", qty, F64),
+                   Field("sdev", F64))])
+        out = Schema((Field(f"w{suffix}", I64), Field(f"i{suffix}", I64),
+                      Field(f"mean{suffix}", F64),
+                      Field(f"sdev{suffix}", F64)))
+        renamed = fproject(
+            grouped,
+            [falias(fcol("inv_warehouse_sk", I64), f"w{suffix}"),
+             falias(fcol("inv_item_sk", I64), f"i{suffix}"),
+             falias(fcol("mean", F64), f"mean{suffix}"),
+             falias(fcol("sdev", F64), f"sdev{suffix}")],
+            out)
+        cov = fcall("Divide", fcol(f"sdev{suffix}", F64),
+                    fcol(f"mean{suffix}", F64))
+        return ffilter(renamed,
+                       fcall("GreaterThan", cov, flit(cov_min)))
+
+    # side 1 carries the extra tightened cov predicate; the generated
+    # corpus' uniform quantities put cov around 0.5-0.6, so 0.52/0.4
+    # keeps both the filter meaningful and the result non-empty
+    m1 = month_stats(1, "1", 0.52)
+    m2 = month_stats(2, "2", 0.4)
+    j = smj(m1, m2, [fcol("w1", I64), fcol("i1", I64)],
+            [fcol("w2", I64), fcol("i2", I64)])
+    out = Schema((Field("w1", I64), Field("i1", I64),
+                  Field("mean1", F64), Field("sdev1", F64),
+                  Field("mean2", F64), Field("sdev2", F64)))
+    return take_ordered(
+        j,
+        orders=[so(fcol("w1", I64)), so(fcol("i1", I64)),
+                so(fcol("mean1", F64)), so(fcol("mean2", F64))],
+        limit=100,
+        project=[fcol("w1", I64), fcol("i1", I64), fcol("mean1", F64),
+                 fcol("sdev1", F64), fcol("mean2", F64),
+                 fcol("sdev2", F64)],
+        out=out)

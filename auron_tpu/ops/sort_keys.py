@@ -25,8 +25,11 @@ import numpy as np
 from auron_tpu.columnar.batch import DeviceColumn, DeviceStringColumn
 from auron_tpu.ir.schema import TypeId
 
-SIGN64 = jnp.uint64(0x8000000000000000)
-MAXU64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+# numpy scalars, NOT jnp: module-level jnp constants would
+# materialize a device array at import and pin the backend
+# before a user/CLI can force a platform
+SIGN64 = np.uint64(0x8000000000000000)
+MAXU64 = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def _orderable_u64_from_i64(v):
@@ -56,7 +59,7 @@ def _orderable_u64_from_f32(v):
         jnp.uint64(0xFFFFFFFF00000000)
 
 
-SIGN32 = jnp.uint32(0x80000000)
+SIGN32 = np.uint32(0x80000000)
 
 _NARROW_INTS = (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.DATE32)
 

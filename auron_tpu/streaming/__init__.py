@@ -11,10 +11,15 @@ Here the same three pieces exist TPU-side: `StreamingCalcOperator`
 (element-at-a-time in, micro-batched device execution, eager drain on
 watermark/checkpoint), `rex` (RexNode-vocabulary conversion to the same
 foreign-expression form), and the Kafka scan op (ops/scan/kafka.py) driven
-by an assignment JSON."""
+by an assignment JSON — plus `StreamingWindowAggOperator`, the keyed
+event-time window aggregate (tumbling/sliding, watermark firing,
+late-row drop, pane-state checkpoints) the reference's agg-call
+converter prepares for but its runtime does not yet ship."""
 
 from auron_tpu.streaming.calc_operator import (Collector,
                                                StreamingCalcOperator)
+from auron_tpu.streaming.window_operator import StreamingWindowAggOperator
 from auron_tpu.streaming import rex
 
-__all__ = ["StreamingCalcOperator", "Collector", "rex"]
+__all__ = ["StreamingCalcOperator", "StreamingWindowAggOperator",
+           "Collector", "rex"]

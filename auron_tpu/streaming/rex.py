@@ -14,7 +14,7 @@ from typing import Any, Dict, Sequence
 
 from auron_tpu.frontend.expr_convert import NotConvertible
 from auron_tpu.frontend.foreign import ForeignExpr, fcall, fcol, flit
-from auron_tpu.ir.schema import DataType, Schema
+from auron_tpu.ir.schema import DataType, Field, Schema
 
 # SqlKind / SqlOperator names → Spark expression-class names
 _CALL_MAP = {
@@ -93,3 +93,49 @@ def convert_program(projections: Sequence[Dict[str, Any]],
     cond = convert_rex(condition, input_schema) \
         if condition is not None else None
     return projs, cond
+
+
+# SqlAggFunction kinds → Spark aggregate expression-class names
+# (FlinkAggCallConverter analogue).  $SUM0 (Calcite's null-as-zero sum)
+# is deliberately absent: mapping it to Sum would return NULL for
+# all-NULL groups where Flink returns 0 — it falls back instead.
+_AGG_CALL_MAP = {
+    "SUM": "Sum", "COUNT": "Count", "MIN": "Min",
+    "MAX": "Max", "AVG": "Average",
+    "STDDEV_SAMP": "StddevSamp", "VAR_SAMP": "VarianceSamp",
+    "FIRST_VALUE": "First", "COLLECT": "CollectList",
+}
+
+
+def convert_agg_call(call: Dict[str, Any], input_schema: Schema):
+    """One serialized Flink aggregate call → the window/agg operator's
+    (output_name, AggregateExpression ForeignExpr, output Field) triple.
+
+    Shape (what a Flink bridge serializes from an AggregateCall):
+      {"agg": "SUM", "operands": [{"rex": "input", "index": 2}],
+       "type": "DOUBLE", "distinct": false, "name": "revenue"}
+    COUNT(*) has no operands.
+    """
+    kind = call["agg"].upper()
+    if kind not in _AGG_CALL_MAP:
+        raise NotConvertible(f"agg call {kind!r}")
+    if call.get("distinct"):
+        # the engine rejects distinct aggregates (expr_convert.py raises
+        # on them) — fail at CONVERT time so the bridge falls back,
+        # instead of committing to a native operator that dies in open()
+        raise NotConvertible(f"distinct agg call {kind!r}")
+    dtype = rex_type(call["type"])
+    operands = [convert_rex(o, input_schema)
+                for o in call.get("operands", ())]
+    fn_attrs = {}
+    if kind == "FIRST_VALUE":
+        # Flink's FirstValueAggFunction only accumulates non-null values;
+        # Spark's plain First would surface a leading NULL
+        fn_attrs["ignore_nulls"] = True
+    fe = ForeignExpr(
+        "AggregateExpression",
+        children=(fcall(_AGG_CALL_MAP[kind], *operands, dtype=dtype,
+                        **fn_attrs),),
+        attrs={"distinct": False})
+    name = call.get("name") or kind.lower()
+    return name, fe, Field(name, dtype)

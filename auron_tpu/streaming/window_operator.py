@@ -1,0 +1,227 @@
+"""Streaming event-time window aggregation operator.
+
+Extends the Flink-analogue front-end beyond the reference's Calc-only
+runtime operator (FlinkAuronCalcOperator.java:87; the reference's planner
+already ships FlinkAggCallConverter for aggregate calls but has no native
+window runtime) with the TableStreamOperator the next Flink release would
+need: keyed tumbling/sliding event-time windows whose per-window
+aggregation runs through the SAME native engine plan
+(FFIReader -> single-mode Agg) the batch path uses.
+
+Semantics follow Flink's WindowOperator:
+- an element with timestamp `ts` is assigned to every window whose
+  half-open span [start, start+size) contains it (one window when
+  slide == size, i.e. tumbling);
+- windows fire when the watermark passes `window_end + allowed_lateness`;
+  fired panes are emitted in window order, each output row carrying
+  `window_start` / `window_end` columns in front of the group keys;
+- an element is dropped (and counted in `late_dropped`) only when EVERY
+  window it belongs to has already fired — Flink's per-window
+  `isWindowLate` check — so rows behind the watermark still join any
+  pane whose `end + allowed_lateness` the watermark has not passed;
+- checkpoint barriers snapshot PENDING state instead of flushing it
+  (unlike the stateless Calc operator, which drains): pending panes are
+  serialized as Arrow IPC blocks and restored byte-exactly, so a resumed
+  operator fires the same panes the failed one would have.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.ipc as pa_ipc
+
+from auron_tpu.frontend import expr_convert as EC
+from auron_tpu.frontend.foreign import ForeignExpr, fcol
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.schema import DataType, Field, Schema, to_arrow_schema
+from auron_tpu.runtime.executor import execute_plan
+from auron_tpu.runtime.resources import ResourceRegistry
+
+Collector = Callable[[dict], None]
+
+
+class StreamingWindowAggOperator:
+    """Keyed event-time window aggregate over the native engine.
+
+    `aggs` uses the corpus/foreign vocabulary: a sequence of
+    (output_name, AggregateExpression ForeignExpr, output Field) — the
+    shape `rex.convert_agg_call` produces from a serialized Flink
+    aggregate call.
+    """
+
+    def __init__(self, input_schema: Schema, ts_col: str,
+                 size_ms: int,
+                 grouping: Sequence[str],
+                 aggs: Sequence[Tuple[str, ForeignExpr, Field]],
+                 slide_ms: Optional[int] = None,
+                 allowed_lateness_ms: int = 0,
+                 collector: Optional[Collector] = None):
+        if size_ms <= 0:
+            raise ValueError("window size must be positive")
+        self.input_schema = input_schema
+        self.ts_col = ts_col
+        self.size_ms = int(size_ms)
+        self.slide_ms = int(slide_ms) if slide_ms is not None \
+            else int(size_ms)
+        if self.slide_ms <= 0:
+            raise ValueError("window slide must be positive")
+        self.allowed_lateness_ms = int(allowed_lateness_ms)
+        self.grouping = tuple(grouping)
+        self._fe_aggs = tuple(aggs)
+        self.collector = collector or (lambda row: None)
+
+        self.watermark: Optional[int] = None
+        self.emitted = 0
+        self.late_dropped = 0
+        # window start -> buffered input rows of that pane
+        self._panes: Dict[int, List[dict]] = {}
+        self._plan: Optional[P.PlanNode] = None
+        self._resources = ResourceRegistry()
+        self._rid = "window:pane"
+        by_name = {f.name: f for f in input_schema.fields}
+        missing = [c for c in (ts_col, *grouping) if c not in by_name]
+        if missing:
+            raise ValueError(f"columns {missing} not in input schema")
+        reserved = {"window_start", "window_end"}
+        clash = reserved & ({*self.grouping}
+                            | {n for n, _, _ in self._fe_aggs})
+        if clash:
+            raise ValueError(
+                f"output names {sorted(clash)} are reserved for the "
+                f"window bound columns")
+        self.output_schema = Schema(
+            (Field("window_start", DataType.int64()),
+             Field("window_end", DataType.int64()),
+             *(by_name[c] for c in self.grouping),
+             *(f for _, _, f in self._fe_aggs)))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self) -> "StreamingWindowAggOperator":
+        by_name = {f.name: f for f in self.input_schema.fields}
+        grouping_exprs = tuple(
+            EC.convert_expr_with_fallback(fcol(c, by_name[c].dtype))
+            for c in self.grouping)
+        agg_exprs = [EC.convert_agg_expr(fe) for _, fe, _ in self._fe_aggs]
+        self._plan = P.Agg(
+            child=P.FFIReader(schema=self.input_schema,
+                              resource_id=self._rid),
+            exec_mode="single",
+            grouping=grouping_exprs, grouping_names=self.grouping,
+            aggs=tuple(agg_exprs),
+            agg_names=tuple(n for n, _, _ in self._fe_aggs))
+        # pay first-compile inside open(), as the Calc operator does
+        self._resources.put(self._rid, self._empty_table())
+        execute_plan(self._plan, partition_id=0,
+                     resources=self._resources)
+        return self
+
+    def _empty_table(self) -> pa.Table:
+        return pa.Table.from_pylist(
+            [], schema=to_arrow_schema(self.input_schema))
+
+    # -- window assignment (TumblingEventTimeWindows / Sliding analogue) ---
+
+    def _assign(self, ts: int) -> List[int]:
+        last_start = ts - ((ts % self.slide_ms) + self.slide_ms) \
+            % self.slide_ms
+        starts = []
+        start = last_start
+        while start > ts - self.size_ms:
+            starts.append(start)
+            start -= self.slide_ms
+        return starts
+
+    # -- streaming surface -------------------------------------------------
+
+    def process_element(self, row: Dict[str, Any]) -> None:
+        assert self._plan is not None, "open() not called"
+        ts = int(row[self.ts_col])
+        starts = self._assign(ts)
+        added = False
+        for start in starts:
+            # per-window lateness (Flink's isWindowLate): a pane is gone
+            # only once the watermark passed ITS end + lateness — an
+            # element older than the watermark still lands in any of its
+            # windows that have not fired yet
+            if self.watermark is not None and \
+                    start + self.size_ms + self.allowed_lateness_ms \
+                    <= self.watermark:
+                continue
+            self._panes.setdefault(start, []).append(row)
+            added = True
+        # an element in a hopping-window gap (slide > size) belongs to NO
+        # window — discarded, but it is not LATE
+        if starts and not added:
+            self.late_dropped += 1
+
+    def process_watermark(self, ts: int) -> None:
+        self.watermark = ts if self.watermark is None \
+            else max(self.watermark, ts)
+        self._fire_until(self.watermark - self.allowed_lateness_ms)
+
+    def close(self) -> None:
+        # end of stream == watermark at +inf: every pending pane fires
+        self._fire_until(None)
+
+    # -- checkpointing -----------------------------------------------------
+
+    def prepare_snapshot_pre_barrier(self, checkpoint_id: int) -> dict:
+        """Snapshots pending panes (no flush — a window operator's state
+        IS its buffered panes) as Arrow IPC blocks."""
+        arrow_schema = to_arrow_schema(self.input_schema)
+        panes = {}
+        for start, rows in self._panes.items():
+            sink = io.BytesIO()
+            table = pa.Table.from_pylist(rows, schema=arrow_schema)
+            with pa_ipc.new_stream(sink, arrow_schema) as w:
+                w.write_table(table)
+            panes[str(start)] = sink.getvalue()
+        return {"checkpoint_id": checkpoint_id,
+                "watermark": self.watermark,
+                "emitted": self.emitted,
+                "late_dropped": self.late_dropped,
+                "panes": panes}
+
+    def restore(self, state: dict) -> "StreamingWindowAggOperator":
+        self.watermark = state["watermark"]
+        self.emitted = state["emitted"]
+        self.late_dropped = state["late_dropped"]
+        self._panes = {}
+        for start, blob in state["panes"].items():
+            with pa_ipc.open_stream(io.BytesIO(blob)) as r:
+                table = r.read_all()
+            self._panes[int(start)] = table.to_pylist()
+        return self
+
+    # -- internals ---------------------------------------------------------
+
+    def _fire_until(self, bound: Optional[int]) -> None:
+        """Fires every pane whose window end is <= bound (None = all),
+        in window order."""
+        if self._plan is None:
+            return
+        due = sorted(s for s in self._panes
+                     if bound is None or s + self.size_ms <= bound)
+        arrow_schema = to_arrow_schema(self.input_schema)
+        for start in due:
+            rows = self._panes.pop(start)
+            table = pa.Table.from_pylist(rows, schema=arrow_schema)
+            self._resources.put(self._rid, table)
+            res = execute_plan(self._plan, partition_id=0,
+                               resources=self._resources)
+            out_rows = []
+            for rb in res.batches:
+                out_rows.extend(rb.to_pylist())
+            # deterministic pane-internal order for test/replay stability
+            out_rows.sort(key=lambda r: tuple(
+                (r[c] is None, r[c]) for c in self.grouping))
+            for row in out_rows:
+                out = {"window_start": start,
+                       "window_end": start + self.size_ms}
+                out.update(row)
+                self.collector(out)
+                self.emitted += 1

@@ -143,3 +143,202 @@ def test_rex_not_equals_lowers_to_not_equalto():
                       {"rex": "literal", "value": 3, "type": "BIGINT"}]},
         IN)
     assert cond.name == "Not" and cond.children[0].name == "EqualTo"
+
+
+# ---------------------------------------------------------------------------
+# event-time window aggregation operator
+# ---------------------------------------------------------------------------
+
+from auron_tpu.frontend.foreign import ForeignExpr  # noqa: E402
+from auron_tpu.streaming import StreamingWindowAggOperator  # noqa: E402
+
+WIN_IN = Schema((Field("ts", I64), Field("k", STR), Field("v", F64)))
+
+
+def _sum_agg(name="total"):
+    fe = ForeignExpr(
+        "AggregateExpression",
+        children=(fcall("Sum", fcol("v", F64), dtype=F64),),
+        attrs={"distinct": False})
+    return (name, fe, Field(name, F64))
+
+
+def _win(collected, size=100, slide=None, lateness=0):
+    return StreamingWindowAggOperator(
+        input_schema=WIN_IN, ts_col="ts", size_ms=size, slide_ms=slide,
+        grouping=["k"], aggs=[_sum_agg()],
+        allowed_lateness_ms=lateness,
+        collector=collected.append).open()
+
+
+def test_tumbling_window_fires_on_watermark():
+    collected = []
+    op = _win(collected, size=100)
+    op.process_element({"ts": 10, "k": "a", "v": 1.0})
+    op.process_element({"ts": 90, "k": "a", "v": 2.0})
+    op.process_element({"ts": 110, "k": "b", "v": 5.0})
+    assert collected == []                      # nothing fires early
+    op.process_watermark(100)                   # closes [0, 100)
+    assert [(r["window_start"], r["k"], r["total"]) for r in collected] \
+        == [(0, "a", 3.0)]
+    op.process_watermark(200)                   # closes [100, 200)
+    assert collected[-1] == {"window_start": 100, "window_end": 200,
+                             "k": "b", "total": 5.0}
+
+
+def test_sliding_window_multi_assignment():
+    collected = []
+    op = _win(collected, size=100, slide=50)
+    # ts=60 belongs to [0,100) and [50,150)
+    op.process_element({"ts": 60, "k": "a", "v": 4.0})
+    op.process_watermark(150)
+    spans = [(r["window_start"], r["window_end"], r["total"])
+             for r in collected]
+    assert spans == [(0, 100, 4.0), (50, 150, 4.0)]
+
+
+def test_window_close_fires_pending_panes_in_order():
+    collected = []
+    op = _win(collected, size=100)
+    op.process_element({"ts": 250, "k": "z", "v": 1.0})
+    op.process_element({"ts": 20, "k": "a", "v": 2.0})
+    op.close()
+    assert [r["window_start"] for r in collected] == [0, 200]
+
+
+def test_window_multiple_groups_sorted_within_pane():
+    collected = []
+    op = _win(collected, size=100)
+    for k, v in (("b", 1.0), ("a", 2.0), ("b", 3.0)):
+        op.process_element({"ts": 5, "k": k, "v": v})
+    op.process_watermark(100)
+    assert [(r["k"], r["total"]) for r in collected] \
+        == [("a", 2.0), ("b", 4.0)]
+
+
+def test_window_late_rows_dropped_and_counted():
+    collected = []
+    op = _win(collected, size=100)
+    op.process_watermark(100)
+    op.process_element({"ts": 50, "k": "a", "v": 1.0})   # late: < wm
+    assert op.late_dropped == 1
+    op.close()
+    assert collected == []
+
+
+def test_window_allowed_lateness_admits_and_defers():
+    collected = []
+    op = _win(collected, size=100, lateness=50)
+    op.process_element({"ts": 10, "k": "a", "v": 1.0})
+    op.process_watermark(120)          # [0,100) not fired: 120 < 100+50
+    assert collected == []
+    op.process_element({"ts": 80, "k": "a", "v": 2.0})   # within lateness
+    assert op.late_dropped == 0
+    op.process_watermark(150)          # 150 >= 100+50 -> fires with both
+    assert [(r["window_start"], r["total"]) for r in collected] \
+        == [(0, 3.0)]
+
+
+def test_window_checkpoint_restores_pending_panes():
+    collected = []
+    op = _win(collected, size=100)
+    op.process_element({"ts": 10, "k": "a", "v": 1.0})
+    op.process_element({"ts": 110, "k": "b", "v": 2.0})
+    op.process_watermark(50)           # nothing fires; state pending
+    state = op.prepare_snapshot_pre_barrier(checkpoint_id=7)
+    assert state["checkpoint_id"] == 7 and len(state["panes"]) == 2
+
+    resumed_rows = []
+    resumed = _win(resumed_rows, size=100).restore(state)
+    assert resumed.watermark == 50
+    resumed.process_element({"ts": 130, "k": "b", "v": 3.0})
+    resumed.close()
+    assert [(r["window_start"], r["k"], r["total"])
+            for r in resumed_rows] == [(0, "a", 1.0), (100, "b", 5.0)]
+
+
+def test_agg_call_conversion_drives_window_operator():
+    """FlinkAggCallConverter analogue: serialized agg calls + rex keys
+    drive the window operator end-to-end."""
+    call = {"agg": "AVG",
+            "operands": [{"rex": "input", "index": 2}],
+            "type": "DOUBLE", "name": "mean_v"}
+    triple = rex.convert_agg_call(call, WIN_IN)
+    assert triple[0] == "mean_v" and triple[2].dtype == F64
+    collected = []
+    op = StreamingWindowAggOperator(
+        input_schema=WIN_IN, ts_col="ts", size_ms=100,
+        grouping=["k"], aggs=[triple],
+        collector=collected.append).open()
+    for v in (1.0, 3.0):
+        op.process_element({"ts": 40, "k": "a", "v": v})
+    op.process_watermark(100)
+    assert collected == [{"window_start": 0, "window_end": 100,
+                          "k": "a", "mean_v": 2.0}]
+
+
+def test_agg_call_count_star_and_unknown():
+    import pytest
+    from auron_tpu.frontend.expr_convert import NotConvertible
+    name, fe, f = rex.convert_agg_call(
+        {"agg": "COUNT", "type": "BIGINT", "name": "n"}, WIN_IN)
+    assert name == "n" and fe.children[0].name == "Count"
+    with pytest.raises(NotConvertible):
+        rex.convert_agg_call({"agg": "MEDIAN", "type": "DOUBLE"}, WIN_IN)
+
+
+def test_window_behind_watermark_but_pane_open_is_admitted():
+    """Flink's isWindowLate is per-window: an element older than the
+    watermark still joins any pane that has not fired yet."""
+    collected = []
+    op = _win(collected, size=100)
+    op.process_watermark(150)          # [0,100) fired (empty); [100,200) open
+    op.process_element({"ts": 120, "k": "a", "v": 2.0})   # ts < wm
+    assert op.late_dropped == 0
+    op.process_element({"ts": 40, "k": "a", "v": 9.0})    # all panes fired
+    assert op.late_dropped == 1
+    op.process_watermark(200)
+    assert [(r["window_start"], r["total"]) for r in collected] \
+        == [(100, 2.0)]
+
+
+def test_window_slide_zero_rejected():
+    import pytest
+    with pytest.raises(ValueError):
+        _win([], size=100, slide=0)
+
+
+def test_window_hopping_gap_row_not_counted_late():
+    collected = []
+    op = _win(collected, size=50, slide=100)
+    op.process_element({"ts": 60, "k": "a", "v": 1.0})   # gap: no window
+    op.process_element({"ts": 10, "k": "a", "v": 2.0})   # in [0,50)
+    assert op.late_dropped == 0
+    op.close()
+    assert [(r["window_start"], r["window_end"], r["total"])
+            for r in collected] == [(0, 50, 2.0)]
+
+
+def test_agg_call_distinct_fails_at_convert_time():
+    import pytest
+    from auron_tpu.frontend.expr_convert import NotConvertible
+    with pytest.raises(NotConvertible):
+        rex.convert_agg_call(
+            {"agg": "SUM", "operands": [{"rex": "input", "index": 2}],
+             "type": "DOUBLE", "distinct": True}, WIN_IN)
+
+
+def test_agg_call_first_value_ignores_nulls():
+    name, fe, _ = rex.convert_agg_call(
+        {"agg": "FIRST_VALUE", "operands": [{"rex": "input", "index": 2}],
+         "type": "DOUBLE", "name": "fv"}, WIN_IN)
+    from auron_tpu.frontend.expr_convert import convert_agg_expr
+    assert convert_agg_expr(fe).fn == "first_ignores_null"
+
+
+def test_window_reserved_output_names_rejected():
+    import pytest
+    with pytest.raises(ValueError):
+        StreamingWindowAggOperator(
+            input_schema=WIN_IN, ts_col="ts", size_ms=100,
+            grouping=["k"], aggs=[_sum_agg("window_start")])
