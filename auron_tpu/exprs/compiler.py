@@ -695,8 +695,8 @@ class CompiledExprs:
             fn = self._get_jit(tuple(run_exprs), dev_schema, batch.capacity,
                                tuple(self._shape_sig(c) for c in dev_in))
             outs = list(fn(dev_in, batch.num_rows_dev(),
-                           jnp.asarray(partition_id, jnp.int32),
-                           jnp.asarray(row_base, jnp.int64)))
+                           np.int32(partition_id),
+                           np.int64(row_base)))
         result: List[Col] = []
         it = iter(outs)
         for i in range(len(device_exprs)):

@@ -96,6 +96,9 @@ class AuronSession:
         to the serial per-partition path transparently."""
         if not config.ENABLE.get():
             return SessionResult(table=self._run_foreign_only(plan))
+        if mesh is None and config.SPMD_SINGLE_DEVICE.get():
+            from auron_tpu.parallel.mesh import data_mesh
+            mesh = data_mesh(1)
         tags = strategy.apply(plan)
         ctx = ConvertContext()
         converted = converters.convert_recursively(plan, tags, ctx)

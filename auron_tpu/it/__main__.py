@@ -21,6 +21,11 @@ def main() -> int:
                     help="jax platform to run on (default cpu: the IT "
                          "differential suite is a correctness/CPU-gate "
                          "harness; pass 'tpu' to drive the device)")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="run compilable plans as ONE shard_map stage "
+                         "program over an N-device mesh (N=1 compiles "
+                         "the whole pipeline for a single chip; serial "
+                         "fallback stays transparent)")
     args = ap.parse_args()
 
     if args.platform:
@@ -41,6 +46,9 @@ def main() -> int:
     cat = generate(args.data_dir, sf=args.sf)
 
     runner = QueryRunner(catalog=cat, golden_dir=args.golden_dir)
+    if args.mesh:
+        from auron_tpu.parallel.mesh import data_mesh
+        runner.mesh = data_mesh(args.mesh)
     names = args.queries.split(",") if args.queries else None
     runner.run_all(names)
     print(runner.report())

@@ -933,6 +933,95 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                                        match_factor=k)
 
 
+def _canonicalize_rids(plan, conv_ctx, source_tables):
+    """Rewrite every `resource_id` in the plan/exchange/broadcast trees to
+    a deterministic walk-order token ("#0", "#1", ...), returning
+    (plan, shim_ctx, source_tables) with all three views rekeyed
+    consistently.  Plans from different conversions of the same query then
+    compare (and hash) equal, which is what the compiled-program cache
+    keys on."""
+    import dataclasses
+    from types import SimpleNamespace
+
+    exchanges = getattr(conv_ctx, "exchanges", None) or {}
+    broadcasts = getattr(conv_ctx, "broadcasts", None) or {}
+    mapping: Dict[str, str] = {}
+
+    def tok(rid: str) -> str:
+        got = mapping.get(rid)
+        if got is None:
+            got = mapping[rid] = f"#{len(mapping)}"
+        return got
+
+    def canon_val(v):
+        if dataclasses.is_dataclass(v) and not isinstance(v, type) and \
+                type(v).__module__ == P.__name__:
+            return canon(v)
+        if isinstance(v, tuple):
+            vals = tuple(canon_val(x) for x in v)
+            if any(a is not b for a, b in zip(vals, v)):
+                return vals
+        return v
+
+    # fields that hold ConvertContext-minted ids (per-query uuid inside):
+    # resource_id names exchange/broadcast/source blocks; the bhm cache
+    # ids key the SERIAL engine's build-table registry, which the SPMD
+    # tracer never consults — both are name-independent here
+    _RID_FIELDS = ("resource_id", "cache_id", "cached_build_hash_map_id")
+
+    # memoized by identity: shared subtrees MUST stay shared — the union
+    # collapse (and any other id()-based dedup) distinguishes "same child
+    # referenced per partition" from "distinct children", and a rebuild
+    # that forks a shared node would replicate its rows
+    memo: Dict[int, Any] = {}
+
+    def canon(node):
+        got = memo.get(id(node))
+        if got is not None:
+            return got
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = tok(v) if f.name in _RID_FIELDS and v else canon_val(v)
+            if nv is not v:
+                changes[f.name] = nv
+        out = dataclasses.replace(node, **changes) if changes else node
+        memo[id(node)] = out
+        return out
+
+    new_plan = canon(plan)
+    # boundary jobs in token-discovery order; a job's child may reference
+    # further exchanges (chained stages), so iterate to a fixed point
+    new_ex: Dict[str, Any] = {}
+    new_bc: Dict[str, Any] = {}
+    done: set = set()
+    while True:
+        pending = [r for r in mapping if r not in done]
+        if not pending:
+            break
+        for rid in pending:
+            done.add(rid)
+            if rid in exchanges:
+                job = exchanges[rid]
+                new_ex[mapping[rid]] = dataclasses.replace(
+                    job, rid=mapping[rid],
+                    child=canon(job.child)
+                    if isinstance(job.child, P.PlanNode) else job.child)
+            elif rid in broadcasts:
+                job = broadcasts[rid]
+                new_bc[mapping[rid]] = dataclasses.replace(
+                    job, rid=mapping[rid],
+                    child=canon(job.child)
+                    if isinstance(job.child, P.PlanNode) else job.child)
+    new_sources = {}
+    for rid in sorted(source_tables):
+        new_sources[mapping[rid] if rid in mapping else tok(rid)] = \
+            source_tables[rid]
+    shim = SimpleNamespace(exchanges=new_ex, broadcasts=new_bc,
+                           sources=getattr(conv_ctx, "sources", {}))
+    return new_plan, shim, new_sources
+
+
 def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                             source_tables: Dict[str, Any], axis,
                             match_factor: int):
@@ -940,6 +1029,15 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
 
     import pyarrow as pa
     from auron_tpu.ir.schema import to_arrow_schema
+
+    # rid canonicalization: ConvertContext mints per-query-uuid resource
+    # ids, so byte-identical plans from two conversions never used to hit
+    # _PROGRAM_CACHE — every execute re-traced + re-compiled the shard_map
+    # program (~seconds of warm time per query).  Rewriting rids to
+    # walk-order tokens makes equal plans cache-equal AND gives the jitted
+    # program a stable input-pytree structure.
+    plan, conv_ctx, source_tables = _canonicalize_rids(
+        plan, conv_ctx, source_tables)
 
     if isinstance(axis, tuple):
         axis_sizes = tuple(mesh.shape[a] for a in axis)
@@ -994,8 +1092,16 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     from auron_tpu.config import conf as _conf
     cache_key = (
         plan, axis, n_dev, match_factor,
-        # trace-time config the compiled program bakes in
+        # EVERY config the tracer (or kernels it calls) reads at trace
+        # time must appear here: rid canonicalization makes equal plans
+        # cache-equal across conversions, so a flag flip between runs
+        # would otherwise reuse a program compiled under the old value
         float(_conf.get("auron.spmd.exchange.quota.margin")),
+        bool(_conf.get("auron.string.ascii.case.enable")),
+        bool(_conf.get("auron.segments.sorted.enable")),
+        bool(_conf.get("auron.pallas.enable")),
+        str(_conf.get("auron.agg.grouping.strategy")),
+        int(_conf.get("auron.string.device.max.width")),
         tuple(sorted((rid, job.child, job.partitioning)
                      for rid, job in (getattr(conv_ctx, "exchanges", None)
                                       or {}).items())),

@@ -363,17 +363,30 @@ def _run_worker(mode: str, env_extra=None, timeout=WORKER_TIMEOUT_S
                 ) -> dict:
     env = dict(os.environ)
     env.update(env_extra or {})
-    p = subprocess.run([sys.executable, os.path.abspath(__file__),
-                        "--worker", mode],
-                       capture_output=True, text=True,
-                       timeout=timeout, env=env,
-                       cwd=os.path.dirname(os.path.abspath(__file__)))
-    for line in reversed(p.stdout.strip().splitlines()):
+    p = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                          "--worker", mode],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env,
+                         cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        out, err = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # SIGTERM first: a hard SIGKILL mid-claim orphans the device
+        # lease pool-side and every later worker then hangs in backend
+        # init — give the PJRT client a window to release its grant
+        p.terminate()
+        try:
+            p.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+        raise
+    for line in reversed(out.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             return json.loads(line)
     raise RuntimeError(
-        f"worker {mode} rc={p.returncode}: {p.stderr.strip()[-400:]}")
+        f"worker {mode} rc={p.returncode}: {err.strip()[-400:]}")
 
 
 def _attempt(mode: str, diagnostics: list, force_cpu: bool = False,
