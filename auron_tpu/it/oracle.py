@@ -203,6 +203,16 @@ class _Eval:
                        object)
         return _col(out, am)
 
+    def _round(self, fe):
+        # Spark ROUND is HALF_UP (np.round is banker's): away-from-zero
+        # at the .5 boundary, independently per sign
+        a, am = self.eval(fe.children[0])
+        scale = int(fe.children[1].value) if len(fe.children) > 1 else 0
+        f = 10.0 ** scale
+        v = np.asarray(a, np.float64)
+        out = np.sign(v) * np.floor(np.abs(v) * f + 0.5) / f
+        return _col(out, am)
+
 
 def _to_table(cols: List[Tuple[np.ndarray, np.ndarray]], names: List[str],
               schema: Schema) -> pa.Table:

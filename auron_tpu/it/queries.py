@@ -4220,11 +4220,16 @@ def q74y(cat: Catalog) -> ForeignNode:
             "And",
             fcall("GreaterThan", fcol(f"y1{suffix}", F64), flit(0.0)),
             fcall("GreaterThan", fcol(f"y2{suffix}", F64), flit(0.0))))
+        # the ratio is rounded so cross-engine float jitter cannot
+        # reorder near-tied rows: ties become EXACT and the
+        # c_customer_id sort key then breaks them deterministically
         return fproject(
             pos,
             [falias(fcol(cust_col, I64), f"c{suffix}"),
-             falias(fcall("Divide", fcol(f"y2{suffix}", F64),
-                          fcol(f"y1{suffix}", F64)), f"growth{suffix}")],
+             falias(fcall("Round",
+                          fcall("Divide", fcol(f"y2{suffix}", F64),
+                                fcol(f"y1{suffix}", F64)),
+                          flit(6), dtype=F64), f"growth{suffix}")],
             Schema((Field(f"c{suffix}", I64),
                     Field(f"growth{suffix}", F64))))
 

@@ -75,8 +75,28 @@ def sorted_segment_sum(x, seg, num_segments: int):
     if not _use_sorted():
         return jax.ops.segment_sum(x, seg, num_segments=num_segments,
                                    indices_are_sorted=True)
-    csum = jnp.cumsum(x)
     starts, ends, nonempty = _segment_ranges(seg, num_segments)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        # floats must NOT use the global-cumsum difference: an all-zero
+        # segment differencing two ~equal multi-million cumsums comes
+        # back as ~1e-10, which flips `sum > 0` predicates (q74-shape
+        # year pivots) and explodes ratios.  A segmented scan resets the
+        # running sum at each segment start, so a segment's total only
+        # ever adds its OWN elements — exact zeros stay exact.
+        is_first = jnp.concatenate(
+            [jnp.ones((1,), bool), seg[1:] != seg[:-1]])
+
+        def combine(a, b):
+            a_flag, a_val = a
+            b_flag, b_val = b
+            val = jnp.where(b_flag, b_val, a_val + b_val)
+            return jnp.logical_or(a_flag, b_flag), val
+
+        _, run = jax.lax.associative_scan(combine, (is_first, x))
+        total = jnp.take(run, jnp.clip(ends - 1, 0), mode="clip")
+        return jnp.where(nonempty, total, jnp.zeros((), x.dtype))
+    # integer sums: modular cumsum difference is EXACT even on wrap
+    csum = jnp.cumsum(x)
     upper = jnp.take(csum, jnp.clip(ends - 1, 0), mode="clip")
     lower = jnp.where(starts > 0,
                       jnp.take(csum, jnp.clip(starts - 1, 0), mode="clip"),

@@ -1102,6 +1102,7 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         bool(_conf.get("auron.pallas.enable")),
         str(_conf.get("auron.agg.grouping.strategy")),
         int(_conf.get("auron.string.device.max.width")),
+        str(_conf.get("auron.string.width.buckets")),
         tuple(sorted((rid, job.child, job.partitioning)
                      for rid, job in (getattr(conv_ctx, "exchanges", None)
                                       or {}).items())),

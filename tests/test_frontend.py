@@ -510,3 +510,52 @@ def test_insert_into_hive_table_conversion(tmp_path):
     import os
     subdirs = sorted(os.listdir(loc + "/ds=2026-07-30"))
     assert any(d.startswith("k=") for d in subdirs), subdirs
+
+
+def test_single_device_conf_rides_stage_compiler():
+    """auron.spmd.singleDevice.enable: a mesh-less session offers the
+    plan to the stage compiler on a 1-device mesh (one compiled program),
+    producing the same rows as the serial walk, and repeat executes hit
+    the compiled-program cache."""
+    from auron_tpu import conf
+
+    src = local_table(sales_rows(500), SALES)
+    agg_exprs = [
+        ForeignExpr("AggregateExpression",
+                    children=(fcall("Sum", fcol("v", F64), dtype=F64),))]
+    partial = ForeignNode(
+        "HashAggregateExec", children=(src,),
+        output=Schema((Field("k", I64), Field("sv#sum", F64))),
+        attrs={"grouping": [fcol("k", I64)], "aggs": agg_exprs,
+               "agg_names": ["sv"], "mode": "partial"})
+    exchange = ForeignNode(
+        "ShuffleExchangeExec", children=(partial,), output=partial.output,
+        attrs={"partitioning": {
+            "mode": "hash", "num_partitions": 4,
+            "expressions": [fcol("k", I64)]}})
+    final = ForeignNode(
+        "HashAggregateExec", children=(exchange,),
+        output=Schema((Field("k", I64), Field("sv", F64))),
+        attrs={"grouping": [fcol("k", I64)], "aggs": agg_exprs,
+               "agg_names": ["sv"], "mode": "final"})
+
+    serial = AuronSession(foreign_engine=ToyEngine()).execute(final)
+    assert not serial.spmd
+    conf.set("auron.spmd.singleDevice.enable", True)
+    try:
+        from auron_tpu.parallel import stage as S
+        session = AuronSession(foreign_engine=ToyEngine())
+        staged = session.execute(final)
+        assert staged.spmd
+        n_programs = len(S._PROGRAM_CACHE)
+        again = session.execute(final)
+        # the re-converted plan must hit the compiled-program cache (rid
+        # canonicalization) — a recompile would add a new entry
+        assert again.spmd and len(S._PROGRAM_CACHE) == n_programs
+    finally:
+        conf.set("auron.spmd.singleDevice.enable", False)
+
+    def canon(res):
+        return sorted((r["k"], round(r["sv"], 6))
+                      for r in res.to_pylist())
+    assert canon(staged) == canon(serial) == canon(again)

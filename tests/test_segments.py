@@ -86,3 +86,32 @@ def test_scatter_fallback_path():
             np.asarray(segments.sorted_segment_sum(x, seg, 3)), [10, 0, 35])
     finally:
         conf.set("auron.segments.sorted.enable", old)
+
+
+def test_sorted_segment_sum_exact_zero_segments():
+    """Round-3 regression (q74-shape): an all-zero float segment embedded
+    among large-magnitude segments must sum to EXACTLY 0.0 — the
+    global-cumsum-difference form returned ~1e-10 residuals, flipping
+    `sum > 0` filters and exploding y2/y1 ratios."""
+    import numpy as np
+    import jax.numpy as jnp
+    from auron_tpu.ops.segments import sorted_segment_sum
+
+    rng = np.random.default_rng(11)
+    segs, vals = [], []
+    for s in range(64):
+        n = int(rng.integers(50, 200))
+        segs.append(np.full(n, s))
+        if s % 7 == 3:
+            vals.append(np.zeros(n))             # exact-zero segment
+        else:
+            vals.append(rng.uniform(1e4, 1e6, n))
+    seg = jnp.asarray(np.concatenate(segs), jnp.int32)
+    x = jnp.asarray(np.concatenate(vals), jnp.float64)
+    got = np.asarray(sorted_segment_sum(x, seg, 64))
+    for s in range(64):
+        expect = float(np.concatenate(vals)[np.concatenate(segs) == s].sum())
+        if s % 7 == 3:
+            assert got[s] == 0.0, f"segment {s}: {got[s]!r} != exact 0.0"
+        else:
+            assert abs(got[s] - expect) < 1e-6 * max(1.0, abs(expect))

@@ -738,3 +738,69 @@ def test_spmd_union_and_expand():
                              {"fact": fact}).to_pylist()
     exp2 = _serial_reference(serial2, {"fact": fact})
     assert _canon(got2) == _canon(exp2)
+
+
+def test_spmd_program_cache_across_conversions():
+    """Round-3 regression: two conversions of the same query mint
+    different uuid resource ids, but the compiled program must be shared
+    (rid canonicalization) — and shared union subtrees must STAY shared
+    through the rewrite (an identity-losing rebuild replicated each
+    union child's rows)."""
+    from auron_tpu.parallel import stage as S
+
+    fact = make_fact(n=2000, keys=16)
+    fact_schema = from_arrow_schema(fact.schema)
+
+    def build(uid):
+        src = P.FFIReader(schema=fact_schema, resource_id=f"fact:{uid}:0")
+        child = P.Projection(
+            child=src, exprs=(col("key"), col("amount")),
+            names=("key", "amount"))
+        # the same child referenced once per partition (3 partitions)
+        union = P.Union(
+            schema=fact_schema,
+            inputs=tuple(P.UnionInput(child=child, partition=p,
+                                      out_partition=p)
+                         for p in range(3)),
+            num_partitions=3)
+        partial = P.Agg(
+            child=union, exec_mode="partial", grouping=(col("key"),),
+            grouping_names=("key",),
+            aggs=(AggExpr(fn="sum", children=(col("amount"),),
+                          return_type=F64),),
+            agg_names=("s",))
+        ctx = _Ctx()
+        ctx.exchanges[f"ex:{uid}:1"] = ShuffleJob(
+            rid=f"ex:{uid}:1", child=partial,
+            partitioning=P.Partitioning(mode="hash", num_partitions=8,
+                                        expressions=(col("key"),)),
+            schema=None)
+        final = P.Agg(
+            child=P.IpcReader(schema=None, resource_id=f"ex:{uid}:1"),
+            exec_mode="final", grouping=(col("key"),),
+            grouping_names=("key",),
+            aggs=(AggExpr(fn="sum", children=(col("amount"),),
+                          return_type=F64),),
+            agg_names=("s",))
+        return final, ctx, {f"fact:{uid}:0": fact}
+
+    mesh = data_mesh(8)
+    n0 = len(S._PROGRAM_CACHE)
+    p1, c1, t1 = build("aaaa1111")
+    got1 = execute_plan_spmd(p1, c1, mesh, t1).to_pylist()
+    n1 = len(S._PROGRAM_CACHE)
+    p2, c2, t2 = build("bbbb2222")
+    got2 = execute_plan_spmd(p2, c2, mesh, t2).to_pylist()
+    n2 = len(S._PROGRAM_CACHE)
+    assert n1 == n0 + 1 and n2 == n1, "second conversion missed the cache"
+    assert _canon(got1) == _canon(got2)
+
+    # union semantics survived canonicalization: child counted ONCE per
+    # distinct object even though three partitions reference it
+    k = fact.column("key").to_numpy()
+    a = fact.column("amount").to_numpy()
+    exp = {int(key): float(a[k == key].sum()) for key in set(k.tolist())}
+    got = {int(r["key"]): float(r["s"]) for r in got1}
+    assert set(got) == set(exp)
+    for key in exp:
+        assert abs(got[key] - exp[key]) < 1e-6, (key, got[key], exp[key])
