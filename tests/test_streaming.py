@@ -342,3 +342,48 @@ def test_window_reserved_output_names_rejected():
         StreamingWindowAggOperator(
             input_schema=WIN_IN, ts_col="ts", size_ms=100,
             grouping=["k"], aggs=[_sum_agg("window_start")])
+
+
+def test_kafka_source_to_window_agg_pipeline():
+    """Kafka scan feeding the event-time window operator with rex-
+    converted keys/aggs — the windowed Flink job shape end to end,
+    watermarks interleaved with the record stream."""
+    from auron_tpu.ops.scan.kafka import KafkaScanExec
+    from auron_tpu.ops.base import TaskContext
+    from auron_tpu.runtime.resources import ResourceRegistry
+
+    records = [json.dumps({"ts": i * 40, "k": "ab"[i % 2],
+                           "v": float(i)}).encode()
+               for i in range(10)]                    # ts 0..360
+    scan = KafkaScanExec(WIN_IN, topic="orders",
+                         assignment_json=json.dumps(
+                             {"0": {"start": 0, "end": 10}}),
+                         mock_data=tuple(records))
+    call = {"agg": "SUM", "operands": [{"rex": "input", "index": 2}],
+            "type": "DOUBLE", "name": "total"}
+    collected = []
+    op = StreamingWindowAggOperator(
+        input_schema=WIN_IN, ts_col="ts", size_ms=100,
+        grouping=["k"], aggs=[rex.convert_agg_call(call, WIN_IN)],
+        collector=collected.append).open()
+    ctx = TaskContext(resources=ResourceRegistry())
+    seen = 0
+    for batch in scan.execute(ctx):
+        for row in batch.to_arrow().to_pylist():
+            op.process_element(row)
+            seen += 1
+            if seen == 5:
+                op.process_watermark(150)   # fires [0,100) mid-stream
+                assert len(collected) == 2, \
+                    "watermark must fire the closed pane immediately"
+    op.close()
+    # [0,100): ts 0,40,80 -> a:0+2? -> k alternates a,b,a,b..: ts0 a v0,
+    # ts40 b v1, ts80 a v2 -> a:2.0, b:1.0
+    assert collected[0] == {"window_start": 0, "window_end": 100,
+                            "k": "a", "total": 2.0}
+    assert collected[1] == {"window_start": 0, "window_end": 100,
+                            "k": "b", "total": 1.0}
+    total_emitted = sum(r["total"] for r in collected)
+    assert total_emitted == sum(range(10))
+    spans = {(r["window_start"], r["window_end"]) for r in collected}
+    assert spans == {(0, 100), (100, 200), (200, 300), (300, 400)}
