@@ -917,20 +917,43 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     A tripped join guard (duplicate build keys past the current match
     factor) retries ONCE with auron.spmd.join.match.factor pair
     expansion before giving up — multi-match joins pay the K-wide
-    buffers only when the data actually needs them.
+    buffers only when the data actually needs them.  The factor that
+    succeeded is remembered per (canonical program, mesh, configured k),
+    so repeat executes of a duplicate-key query start at the right width
+    instead of paying the trip-then-retry double execution every time;
+    the config in the key means re-tuning the factor drops stale hints
+    (a hint only ever widens buffers — correctness never depends on it).
     """
     from auron_tpu.config import conf as _conf
+    # canonicalize ONCE: the hint lookup, program cache and tracer all
+    # run on the rewritten (rid-token) views
+    plan, conv_ctx, source_tables = _canonicalize_rids(
+        plan, conv_ctx, source_tables)
+    k = int(_conf.get("auron.spmd.join.match.factor"))
+    hint_key = (
+        plan,
+        tuple(sorted((rid, job.child, job.partitioning)
+                     for rid, job in conv_ctx.exchanges.items())),
+        tuple(sorted((rid, job.child)
+                     for rid, job in conv_ctx.broadcasts.items())),
+        tuple(mesh.shape.items()), k)
+    start = _MATCH_FACTOR_HINT.get(hint_key, 1)
     try:
         return _execute_plan_spmd_once(plan, conv_ctx, mesh,
                                        source_tables, axis,
-                                       match_factor=1)
+                                       match_factor=start)
     except SpmdGuardTripped as e:
-        k = int(_conf.get("auron.spmd.join.match.factor"))
-        if not e.retryable or k <= 1:
+        # from a hinted start (>1) duplicate overflows trip the HARD
+        # guard, so escalate to the configured factor whenever it is
+        # wider than the attempt that failed; at start==1 only the
+        # retryable dup-key trip warrants the second attempt
+        if k <= start or (start == 1 and not e.retryable):
             raise
-        return _execute_plan_spmd_once(plan, conv_ctx, mesh,
-                                       source_tables, axis,
-                                       match_factor=k)
+        out = _execute_plan_spmd_once(plan, conv_ctx, mesh,
+                                      source_tables, axis,
+                                      match_factor=k)
+        _MATCH_FACTOR_HINT[hint_key] = k
+        return out
 
 
 def _canonicalize_rids(plan, conv_ctx, source_tables):
@@ -1030,14 +1053,13 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     import pyarrow as pa
     from auron_tpu.ir.schema import to_arrow_schema
 
-    # rid canonicalization: ConvertContext mints per-query-uuid resource
-    # ids, so byte-identical plans from two conversions never used to hit
-    # _PROGRAM_CACHE — every execute re-traced + re-compiled the shard_map
-    # program (~seconds of warm time per query).  Rewriting rids to
-    # walk-order tokens makes equal plans cache-equal AND gives the jitted
-    # program a stable input-pytree structure.
-    plan, conv_ctx, source_tables = _canonicalize_rids(
-        plan, conv_ctx, source_tables)
+    # inputs arrive rid-canonicalized from execute_plan_spmd:
+    # ConvertContext mints per-query-uuid resource ids, so byte-identical
+    # plans from two conversions would never hit _PROGRAM_CACHE — every
+    # execute re-traced + re-compiled the shard_map program (~seconds of
+    # warm time per query).  Walk-order rid tokens make equal plans
+    # cache-equal AND give the jitted program a stable input-pytree
+    # structure.
 
     if isinstance(axis, tuple):
         axis_sizes = tuple(mesh.shape[a] for a in axis)
@@ -1221,6 +1243,9 @@ def _walk_native(node, conv_ctx):
 
 
 _PROGRAM_CACHE: Dict[Any, Any] = {}
+# canonical plan -> join match factor that last succeeded (see
+# execute_plan_spmd's retry)
+_MATCH_FACTOR_HINT: Dict[Any, int] = {}
 
 # node kinds the tracer can (conditionally) express; anything else is
 # rejected by precheck_plan before source materialization

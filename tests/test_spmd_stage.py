@@ -804,3 +804,41 @@ def test_spmd_program_cache_across_conversions():
     assert set(got) == set(exp)
     for key in exp:
         assert abs(got[key] - exp[key]) < 1e-6, (key, got[key], exp[key])
+
+
+def test_spmd_match_factor_hint_remembered():
+    """Repeat executes of a duplicate-key join start at the remembered
+    pair-expansion factor instead of paying the factor-1 trip + retry
+    double execution every time."""
+    from auron_tpu.parallel import stage as S
+
+    fact = make_fact(n=400, keys=8)
+    dim = pa.table({"dkey": np.array([1, 1, 2], dtype=np.int64),
+                    "dval": np.array([10.0, 20.0, 30.0])})
+
+    def build():
+        ctx = _Ctx()
+        ctx.broadcasts["bcH"] = BroadcastJob(
+            rid="bcH",
+            child=P.FFIReader(schema=from_arrow_schema(dim.schema),
+                              resource_id="dimH"),
+            schema=None)
+        return P.BroadcastJoin(
+            left=P.FFIReader(schema=from_arrow_schema(fact.schema),
+                             resource_id="factH"),
+            right=P.IpcReader(schema=None, resource_id="bcH"),
+            on=JoinOn(left_keys=(col("key"),), right_keys=(col("dkey"),)),
+            join_type="inner", broadcast_side="right"), ctx
+
+    mesh = data_mesh(8)
+    tables = {"factH": fact, "dimH": dim}
+    join, ctx = build()
+    S._MATCH_FACTOR_HINT.clear()     # isolate from other tests' shapes
+    first = execute_plan_spmd(join, ctx, mesh, tables).to_pylist()
+    assert len(S._MATCH_FACTOR_HINT) == 1   # trip stored the factor
+    assert list(S._MATCH_FACTOR_HINT.values()) == [4]
+    join2, ctx2 = build()
+    second = execute_plan_spmd(join2, ctx2, mesh, tables).to_pylist()
+    assert _canon(first) == _canon(second)
+    # the hint key is rid-canonical: the second conversion found it
+    assert len(S._MATCH_FACTOR_HINT) == 1
