@@ -497,20 +497,27 @@ class _StageTracer:
                                    sorted_bh, ph, join_type,
                                    existence_name, K)
 
+    @staticmethod
+    def _cols_eq(a_cols, b_cols, ok):
+        """AND of null-safe per-column equality over aligned column
+        lists — THE key-equality rule (collision filter); every caller
+        must go through here so string/decimal semantics can never
+        diverge between the probe check and the build-run check."""
+        for a, b in zip(a_cols, b_cols):
+            if isinstance(a, DeviceStringColumn):
+                from auron_tpu.exprs import strings_device as S
+                eq = S.string_eq(a, b)
+            else:
+                eq = a.data == b.data
+            ok = jnp.logical_and(ok, jnp.logical_and(
+                eq, jnp.logical_and(a.validity, b.validity)))
+        return ok
+
     def _exact_eq(self, pkeys, bkeys, bidx, hit):
         """Exact key equality for candidate pairs (hash-collision
         filter); pkeys are already pair-aligned."""
-        ok = hit
-        for pk, bk in zip(pkeys, bkeys):
-            bg = bk.gather(bidx, hit)
-            if isinstance(pk, DeviceStringColumn):
-                from auron_tpu.exprs import strings_device as S
-                eq = S.string_eq(pk, bg)
-            else:
-                eq = pk.data == bg.data
-            ok = jnp.logical_and(ok, jnp.logical_and(
-                eq, jnp.logical_and(pk.validity, bg.validity)))
-        return ok
+        return self._cols_eq(
+            pkeys, [bk.gather(bidx, hit) for bk in bkeys], hit)
 
     def _join_outer_tail(self, schema, probe, build, out_cols, ok, bidx,
                          live1):
@@ -531,19 +538,32 @@ class _StageTracer:
                      ph, join_type, existence_name):
         """Single-candidate probe (match_factor=1): duplicate build keys
         would need pair expansion, so a runtime guard detects them
-        (adjacent equal non-sentinel hashes after the sort — which also
-        catches hash collisions).  For pair-emitting join types the trip
-        is RETRYABLE (the driver re-traces with the expansion factor);
-        semi/anti/existence stay at K=1, so their trip is hard."""
+        (adjacent equal non-sentinel hashes after the sort).  For
+        pair-emitting join types the trip is RETRYABLE (the driver
+        re-traces with the expansion factor).  Semi/anti/existence are
+        probe-preserving, so TRUE duplicate keys are harmless — the
+        leftmost candidate of an equal-hash run carries the same key —
+        and only a hash COLLISION (adjacent equal hashes whose exact
+        keys differ) trips their (hard) guard.  This is what lets the
+        TPC-DS semi/anti families (customer EXISTS over fact tables:
+        massively duplicate build keys) ride the mesh at K=1."""
         from auron_tpu.ops.joins.exec import join_output_schema
         from auron_tpu.ops.joins.kernel import _NULL_BUILD
-        dup = jnp.any(jnp.logical_and(sorted_bh[1:] == sorted_bh[:-1],
-                                      sorted_bh[1:] != _NULL_BUILD))
-        tripped = lax.psum(dup.astype(jnp.int32), self.axis) > 0
+        adj = jnp.logical_and(sorted_bh[1:] == sorted_bh[:-1],
+                              sorted_bh[1:] != _NULL_BUILD)
         if join_type in ("left_semi", "left_anti", "existence"):
-            self.guards.append(tripped)
+            keys_eq = self._cols_eq(
+                [bk.gather(order[:-1], adj) for bk in bkeys],
+                [bk.gather(order[1:], adj) for bk in bkeys],
+                jnp.ones(adj.shape, bool))
+            collision = jnp.any(jnp.logical_and(
+                adj, jnp.logical_not(keys_eq)))
+            self.guards.append(
+                lax.psum(collision.astype(jnp.int32), self.axis) > 0)
         else:
-            self.retry_guards.append(tripped)
+            dup = jnp.any(adj)
+            self.retry_guards.append(
+                lax.psum(dup.astype(jnp.int32), self.axis) > 0)
         pos = jnp.clip(jnp.searchsorted(sorted_bh, ph), 0,
                        build.capacity - 1)
         hit = jnp.take(sorted_bh, pos) == ph
@@ -943,11 +963,10 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                                        source_tables, axis,
                                        match_factor=start)
     except SpmdGuardTripped as e:
-        # from a hinted start (>1) duplicate overflows trip the HARD
-        # guard, so escalate to the configured factor whenever it is
-        # wider than the attempt that failed; at start==1 only the
-        # retryable dup-key trip warrants the second attempt
-        if k <= start or (start == 1 and not e.retryable):
+        # a stored hint always equals the k in its own key, so start is
+        # either 1 (no hint: retry the retryable dup-key trip at k) or
+        # k itself (hinted run failed: nothing wider to try)
+        if start > 1 or k <= 1 or not e.retryable:
             raise
         out = _execute_plan_spmd_once(plan, conv_ctx, mesh,
                                       source_tables, axis,

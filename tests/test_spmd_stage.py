@@ -842,3 +842,45 @@ def test_spmd_match_factor_hint_remembered():
     assert _canon(first) == _canon(second)
     # the hint key is rid-canonical: the second conversion found it
     assert len(S._MATCH_FACTOR_HINT) == 1
+
+
+def test_spmd_semi_like_joins_with_duplicate_build_keys():
+    """Semi/anti/existence are probe-preserving, so TRUE duplicate build
+    keys must ride the mesh at K=1 (no guard trip, no fallback) — the
+    TPC-DS customer-EXISTS-over-fact shape.  Only hash collisions trip."""
+    fact = make_fact(n=600, keys=16)
+    # heavily duplicated build side: every key appears ~25 times
+    rng = np.random.default_rng(9)
+    dup = pa.table({"dkey": np.sort(rng.integers(0, 8, 200)).astype(
+        np.int64)})
+
+    mesh = data_mesh(8)
+    for jt in ("LeftSemi", "LeftAnti", "ExistenceJoin"):
+        jt_ir = {"LeftSemi": "left_semi", "LeftAnti": "left_anti",
+                 "ExistenceJoin": "existence"}[jt]
+        def bc_join():
+            ctx = _Ctx()
+            ctx.broadcasts["bcD"] = BroadcastJob(
+                rid="bcD",
+                child=P.FFIReader(schema=from_arrow_schema(dup.schema),
+                                  resource_id="dupD"),
+                schema=None)
+            return P.BroadcastJoin(
+                left=P.FFIReader(schema=from_arrow_schema(fact.schema),
+                                 resource_id="factD"),
+                right=P.IpcReader(schema=None, resource_id="bcD"),
+                on=JoinOn(left_keys=(col("key"),),
+                          right_keys=(col("dkey"),)),
+                join_type=jt_ir, broadcast_side="right"), ctx
+        join, ctx = bc_join()
+        got = execute_plan_spmd(join, ctx, mesh,
+                                {"factD": fact, "dupD": dup}).to_pylist()
+        serial = P.BroadcastJoin(
+            left=P.FFIReader(schema=from_arrow_schema(fact.schema),
+                             resource_id="factD"),
+            right=P.FFIReader(schema=from_arrow_schema(dup.schema),
+                              resource_id="dupD"),
+            on=JoinOn(left_keys=(col("key"),), right_keys=(col("dkey"),)),
+            join_type=jt_ir, broadcast_side="right")
+        exp = _serial_reference(serial, {"factD": fact, "dupD": dup})
+        assert _canon(got) == _canon(exp), jt
