@@ -26,6 +26,11 @@ def main() -> int:
                          "program over an N-device mesh (N=1 compiles "
                          "the whole pipeline for a single chip; serial "
                          "fallback stays transparent)")
+    ap.add_argument("--stage-compare", action="store_true",
+                    help="instead of the differential run, execute every "
+                         "query through BOTH the serial walk and the "
+                         "1-device stage compiler and record warm times "
+                         "per query (the IT_STAGE.json generator)")
     args = ap.parse_args()
 
     if args.platform:
@@ -45,6 +50,12 @@ def main() -> int:
           flush=True)
     cat = generate(args.data_dir, sf=args.sf)
 
+    if args.stage_compare:
+        if args.mesh or args.golden_dir:
+            ap.error("--stage-compare is a 1-device serial-vs-stage "
+                     "comparison; --mesh/--golden-dir do not apply")
+        return _stage_compare(cat, args)
+
     runner = QueryRunner(catalog=cat, golden_dir=args.golden_dir)
     if args.mesh:
         from auron_tpu.parallel.mesh import data_mesh
@@ -56,6 +67,62 @@ def main() -> int:
         with open(args.json, "w") as f:
             f.write(runner.to_json())
     return 0 if all(r.ok for r in runner.results) else 1
+
+
+def _stage_compare(cat, args) -> int:
+    """Per-query serial vs 1-device-stage warm comparison (IT_STAGE.json
+    generator): each query runs cold + warm through the serial per-batch
+    walk, then cold + warm with auron.spmd.singleDevice.enable."""
+    import json
+    import time
+
+    import jax
+
+    from auron_tpu import conf
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it import queries
+    from auron_tpu.it.oracle import PyArrowEngine
+
+    names = args.queries.split(",") if args.queries else queries.names()
+    rows = []
+    for name in names:
+        rec = {"name": name}
+        try:
+            plan = queries.build(name, cat)
+            counts = {}
+            for mode, flag in (("serial", False), ("stage", True)):
+                with conf.scoped(
+                        {"auron.spmd.singleDevice.enable": flag}):
+                    s = AuronSession(foreign_engine=PyArrowEngine())
+                    s.execute(plan)
+                    t0 = time.perf_counter()
+                    r1 = s.execute(plan)
+                rec[f"{mode}_warm_s"] = round(time.perf_counter() - t0, 4)
+                if mode == "stage":
+                    rec["spmd"] = bool(r1.spmd)
+                counts[mode] = r1.table.num_rows
+            rec["rows"] = counts["serial"]
+            if counts["stage"] != counts["serial"]:
+                rec["error"] = (f"row-count divergence: serial "
+                                f"{counts['serial']} vs stage "
+                                f"{counts['stage']}")
+        except Exception as e:  # noqa: BLE001 — per-query isolation
+            rec["error"] = str(e)[:120]
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+        # accumulated CPU executables segfault this jaxlib eventually
+        # (see tests/test_tpcds_it.py runner note)
+        jax.clear_caches()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+    staged = [r for r in rows if r.get("spmd")]
+    sp = sorted(r["serial_warm_s"] / r["stage_warm_s"] for r in staged
+                if r.get("stage_warm_s"))
+    if sp:
+        print(f"# staged {len(staged)}/{len(rows)}; warm speedup "
+              f"median {sp[len(sp) // 2]:.2f}x")
+    return 0 if all("error" not in r for r in rows) else 1
 
 
 if __name__ == "__main__":
