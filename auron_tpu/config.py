@@ -259,12 +259,41 @@ SPMD_EXCHANGE_QUOTA_MARGIN = conf.define(
     "falls back to the serial engine.",
 )
 SPMD_SINGLE_DEVICE = conf.define(
-    "auron.spmd.singleDevice.enable", False,
+    "auron.spmd.singleDevice.enable", True,
     "Offer plans to the SPMD stage compiler on a 1-device mesh when "
     "the caller passes no mesh: the whole pipeline (exchanges included) "
     "compiles to ONE program instead of per-operator kernels, cutting "
     "compile-bound cold query time ~3x (CPU-measured); plans the stage "
-    "compiler rejects still run the serial per-batch path.",
+    "compiler rejects still run the serial per-batch path.  Default ON "
+    "since round 4 (the stage path IS the engine path, the serial walk "
+    "is its fallback — planner.rs:121-130 keeps one native path the "
+    "same way); device-resident source caching makes repeat executes "
+    "transfer nothing.",
+)
+SPMD_AGG_CAPACITY_HINT = conf.define(
+    "auron.spmd.agg.capacity.hint", 65536,
+    "Static per-device row capacity an SPMD agg output is cut down to "
+    "(aggs are the cardinality reducers, but mask-liveness keeps input "
+    "capacity — without the cut every downstream exchange/join/sort "
+    "pays input-scale cost for a handful of groups).  More groups than "
+    "the hint trips a runtime guard and the query retries at full "
+    "capacity (the working shape is remembered per program).  0 "
+    "disables.",
+)
+SPMD_SOURCE_CACHE_MB = conf.define(
+    "auron.spmd.source.cache.mb", 4096,
+    "Device-byte budget (MB) for the SPMD source shard cache: sharded + "
+    "padded source tables stay device-resident across executes keyed by "
+    "(table identity, mesh, string layout), so a repeat execute of the "
+    "same query transfers nothing host-to-device (the reference's hot "
+    "path does zero per-batch host work, rt.rs:141-238).  0 disables; "
+    "LRU eviction past the budget.",
+)
+SPMD_SCAN_CACHE_MB = conf.define(
+    "auron.spmd.scan.cache.mb", 2048,
+    "Host-byte budget (MB) for the SPMD materialized-scan cache: scan "
+    "leaves are re-read from disk only when a file's (mtime, size) "
+    "changes.  0 disables; LRU eviction past the budget.",
 )
 SPMD_JOIN_MATCH_FACTOR = conf.define(
     "auron.spmd.join.match.factor", 4,

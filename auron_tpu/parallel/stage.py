@@ -62,13 +62,16 @@ class SpmdUnsupported(Exception):
 
 class SpmdGuardTripped(SpmdUnsupported):
     """A runtime guard invalidated the SPMD result.  `retryable` marks
-    join duplicate-key trips a pair-expansion retry can fix; hard trips
-    (exchange quota overflow, dup keys past the factor or under a
-    semi-like join) fall straight back to the serial engine."""
+    join duplicate-key trips a pair-expansion retry can fix; `shrink`
+    marks agg capacity-shrink overflows a full-capacity retry fixes;
+    hard trips (exchange quota overflow, dup keys past the factor or
+    under a semi-like join) fall straight back to the serial engine."""
 
-    def __init__(self, message: str, retryable: bool = False):
+    def __init__(self, message: str, retryable: bool = False,
+                 shrink: bool = False):
         super().__init__(message)
         self.retryable = retryable
+        self.shrink = shrink
 
 
 @dataclass
@@ -93,7 +96,9 @@ class _StageTracer:
                  shadow_sort: Optional[P.Sort] = None,
                  scan_rids: Optional[Dict[int, str]] = None,
                  axis_sizes: Optional[Tuple[int, ...]] = None,
-                 match_factor: int = 1):
+                 match_factor: int = 1,
+                 agg_cap_hint: int = 0,
+                 hash_grouping: bool = False):
         self.exchanges = getattr(conv_ctx, "exchanges", None) or {}
         self.broadcasts = getattr(conv_ctx, "broadcasts", None) or {}
         self.bindings = bindings
@@ -115,8 +120,18 @@ class _StageTracer:
         # can fix.
         self.guards: List[Any] = []
         self.retry_guards: List[Any] = []
+        # `shrink_guards` trip when an agg's group count overflows the
+        # shrunk static capacity (auron.spmd.agg.capacity.hint); the
+        # driver retries once with shrinking disabled (full capacity).
+        self.shrink_guards: List[Any] = []
         # join pair-expansion factor (1 = single-candidate probe)
         self.match_factor = max(1, int(match_factor))
+        # post-agg static capacity (rows/device); 0 keeps input capacity
+        self.agg_cap_hint = max(0, int(agg_cap_hint))
+        # hash-table group reduce (CPU mesh only — mirrors
+        # AggExec._grouping_strategy: XLA's comparator sort is ~3x numpy
+        # on CPU; on TPU scatters serialize and sort wins)
+        self.hash_grouping = bool(hash_grouping)
 
     def _axis_index(self):
         """Global device id; for a (dcn, ici) mesh the layout is
@@ -368,7 +383,14 @@ class _StageTracer:
         return part.mode if part is not None else None
 
     def _do_agg(self, n: P.Agg) -> DeviceTable:
-        from auron_tpu.ops.agg.exec import _group_reduce_body
+        from auron_tpu.ops.agg.exec import (
+            _group_reduce_body, _group_reduce_body_hash,
+        )
+        if self.hash_grouping:
+            # downstream consumers never rely on key order: exchanges
+            # hash keys, final aggs re-group, joins sort hashes, and the
+            # driver-side shadow sort re-orders the gathered result
+            _group_reduce_body = _group_reduce_body_hash
         if n.exec_mode == "single" and self.n_dev > 1 and \
                 not _single_agg_ok(n, self.exchanges):
             # a single-mode agg is per-partition; on a sharded SOURCE its
@@ -422,8 +444,29 @@ class _StageTracer:
                 k = len(spec.state_fields())
                 final_cols.append(spec.eval_final(out_cols[off:off + k]))
                 off += k
-            return DeviceTable(agg.schema, final_cols, live)
-        return DeviceTable(agg._state_schema(), out_cols, live)
+            return self._shrink_front(
+                DeviceTable(agg.schema, final_cols, live), n_groups)
+        return self._shrink_front(
+            DeviceTable(agg._state_schema(), out_cols, live), n_groups)
+
+    def _shrink_front(self, t: DeviceTable, n_live) -> DeviceTable:
+        """Cut a front-compacted table (all live rows at indices
+        [0, n_live)) down to the static capacity hint.  Aggs are the
+        plan's cardinality reducers, but the mask-liveness model keeps
+        their INPUT capacity — so without this every downstream exchange
+        / join / sort pays input-scale cost for a handful of groups
+        (round-4 root cause of the stage path losing to serial at bench
+        scale).  Overflow (more groups than the hint) trips a
+        shrink-guard; the driver retries with shrinking disabled."""
+        new_cap = bucket_capacity(self.agg_cap_hint) \
+            if self.agg_cap_hint > 0 else 0
+        if new_cap <= 0 or new_cap >= t.capacity:
+            return t
+        over = n_live > new_cap
+        self.shrink_guards.append(
+            lax.psum(over.astype(jnp.int32), self.axis) > 0)
+        cols = [jax.tree.map(lambda x: x[:new_cap], c) for c in t.cols]
+        return DeviceTable(t.schema, cols, t.live[:new_cap])
 
     # joins ---------------------------------------------------------------------
 
@@ -926,6 +969,192 @@ def _shard_table(table, mesh: Mesh, axis: str) -> Tuple[Schema, List[Any],
     return schema, cols, jnp.asarray(live), cap
 
 
+# ---------------------------------------------------------------------------
+# device-resident source shard cache (round-4: kill the per-execute
+# re-materialize / re-pad / re-device_put cost that made the stage path
+# lose to serial at bench scale — the reference's hot path does zero
+# per-batch host work, rt.rs:141-238)
+# ---------------------------------------------------------------------------
+
+import collections  # noqa: E402
+import weakref  # noqa: E402
+
+
+def _mesh_fingerprint(mesh: Mesh) -> Tuple:
+    devs = [d for d in np.asarray(mesh.devices).flat]
+    return (tuple(mesh.shape.items()),
+            tuple((d.platform, d.id) for d in devs))
+
+
+def _string_cfg_fingerprint() -> Tuple:
+    from auron_tpu.config import conf as _conf
+    return (int(_conf.get("auron.string.device.max.width")),
+            str(_conf.get("auron.string.width.buckets")))
+
+
+class _ByteBudgetLRU:
+    """Byte-bounded LRU map: key -> (value, nbytes).  Eviction keeps at
+    least one entry so a single oversized value still caches (it would
+    thrash forever otherwise).  Subclasses supply the budget and layer
+    their keying semantics on top."""
+
+    def __init__(self):
+        self._entries: "collections.OrderedDict[Any, Tuple[Any, int]]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+
+    def _budget(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _lookup(self, key):
+        if self._entries and self._budget() <= 0:
+            # budget lowered to 0 ("disables"): release everything —
+            # serving retained entries would keep their device buffers
+            # alive past the user's memory-pressure request
+            self.clear()
+            return None
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        self._entries.move_to_end(key)
+        return e[0]
+
+    def _evict_key(self, key) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self._bytes -= e[1]
+
+    def _store(self, key, value, nbytes: int) -> bool:
+        budget = self._budget()
+        if budget <= 0:
+            return False
+        self._evict_key(key)
+        self._entries[key] = (value, nbytes)
+        self._bytes += nbytes
+        while self._bytes > budget and len(self._entries) > 1:
+            old_key, (_v, b) = self._entries.popitem(last=False)
+            self._bytes -= b
+            self._dropped(old_key)
+        return True
+
+    def _dropped(self, key) -> None:
+        """Hook: called for keys evicted by the byte budget."""
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0
+
+
+class _DeviceShardCache(_ByteBudgetLRU):
+    """LRU cache of sharded, device-resident source tables.
+
+    pyarrow Tables are immutable, so `id(table)` is a sound content key
+    while the table object is alive; a weakref finalizer evicts every
+    entry for a table the moment it is garbage collected (no stale-id
+    reuse window).  Entries are bounded by device bytes
+    (auron.spmd.source.cache.mb); eviction drops the JAX array
+    references and XLA frees the buffers once no running program holds
+    them."""
+
+    def __init__(self):
+        super().__init__()
+        self._tid_keys: Dict[int, set] = {}
+
+    def _budget(self) -> int:
+        from auron_tpu.config import conf as _conf
+        return int(_conf.get("auron.spmd.source.cache.mb")) << 20
+
+    def _dropped(self, key) -> None:
+        self._tid_keys.get(key[0], set()).discard(key)
+
+    def _evict_tid(self, tid: int) -> None:
+        for key in self._tid_keys.pop(tid, ()):
+            self._evict_key(key)
+
+    def get(self, table) -> Optional[dict]:
+        key = (id(table), *_current_shard_key())
+        e = self._lookup(key)
+        if e is None or e["ref"]() is not table:
+            return None
+        return e
+
+    def put(self, table, entry: dict) -> None:
+        tid = id(table)
+        key = (tid, *_current_shard_key())
+        nbytes = sum(
+            int(getattr(x, "nbytes", 0))
+            for x in jax.tree.leaves((entry["cols"], entry["live"])))
+        entry["ref"] = weakref.ref(
+            table, lambda _r, tid=tid: self._evict_tid(tid))
+        if self._store(key, entry, nbytes):
+            self._tid_keys.setdefault(tid, set()).add(key)
+
+    def clear(self) -> None:
+        super().clear()
+        self._tid_keys.clear()
+
+
+# thread-local-free: the shard key (mesh/axis/string-config) is set by the
+# executing driver right before cache access, single host thread per run
+_SHARD_KEY: List[Tuple] = [()]
+
+
+def _current_shard_key() -> Tuple:
+    return _SHARD_KEY[0]
+
+
+_DEVICE_SHARDS = _DeviceShardCache()
+
+
+def _scan_files_fp(node) -> Optional[Tuple]:
+    """(path, mtime_ns, size) for every file under a scan node; None when
+    any file is unstattable (such scans never cache)."""
+    import os
+    fp = []
+    for g in getattr(node, "file_groups", ()) or ():
+        for p in getattr(g, "paths", ()) or ():
+            try:
+                st = os.stat(p)
+            except OSError:
+                return None
+            fp.append((p, st.st_mtime_ns, st.st_size))
+    return tuple(fp)
+
+
+class _ScanTableCache(_ByteBudgetLRU):
+    """LRU cache of materialized scan leaves keyed by (scan node, file
+    stat fingerprint): repeat executes of the same query re-read nothing
+    from disk unless a file's (mtime_ns, size) changed.  The fingerprint
+    is taken BEFORE the scan reads (no stat-after-read TOCTOU: a file
+    rewritten mid-read changes the fingerprint the next get computes, so
+    the stale entry never matches).  Bounded by arrow bytes
+    (auron.spmd.scan.cache.mb)."""
+
+    def _budget(self) -> int:
+        from auron_tpu.config import conf as _conf
+        return int(_conf.get("auron.spmd.scan.cache.mb")) << 20
+
+    def get(self, node, fp: Optional[Tuple]):
+        if fp is None:
+            return None
+        return self._lookup((node, fp))
+
+    def put(self, node, fp: Optional[Tuple], table) -> None:
+        if fp is None:
+            return
+        self._store((node, fp), table, int(table.nbytes))
+
+
+_SCAN_TABLES = _ScanTableCache()
+
+
+def clear_source_caches() -> None:
+    """Drop all cached scan tables and device-resident shards (tests and
+    memory-pressure handling)."""
+    _DEVICE_SHARDS.clear()
+    _SCAN_TABLES.clear()
+
+
 def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                       source_tables: Dict[str, Any], axis: str = "parts"):
     """Compile + run `plan` as one shard_map program over `mesh`.
@@ -957,22 +1186,36 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         tuple(sorted((rid, job.child)
                      for rid, job in conv_ctx.broadcasts.items())),
         tuple(mesh.shape.items()), k)
-    start = _MATCH_FACTOR_HINT.get(hint_key, 1)
-    try:
-        return _execute_plan_spmd_once(plan, conv_ctx, mesh,
-                                       source_tables, axis,
-                                       match_factor=start)
-    except SpmdGuardTripped as e:
-        # a stored hint always equals the k in its own key, so start is
-        # either 1 (no hint: retry the retryable dup-key trip at k) or
-        # k itself (hinted run failed: nothing wider to try)
-        if start > 1 or k <= 1 or not e.retryable:
+    match = _MATCH_FACTOR_HINT.get(hint_key, 1)
+    # the shrink-off hint embeds the CONFIGURED cap (like hint_key embeds
+    # k): raising auron.spmd.agg.capacity.hint after an overflow gives
+    # the shrink a fresh chance instead of staying off forever
+    cap_hint = int(_conf.get("auron.spmd.agg.capacity.hint"))
+    shrink_key = (hint_key, cap_hint)
+    shrink = cap_hint > 0 and not _SHRINK_OFF_HINT.get(shrink_key, False)
+    # at most one retry per independent guard dimension (match factor,
+    # agg shrink); hints remember the working combination per canonical
+    # program so repeat executes skip the trip-then-retry double run
+    for _attempt in range(3):
+        try:
+            out = _execute_plan_spmd_once(plan, conv_ctx, mesh,
+                                          source_tables, axis,
+                                          match_factor=match,
+                                          agg_shrink=shrink)
+            if match > 1:
+                _MATCH_FACTOR_HINT[hint_key] = match
+            if cap_hint > 0 and not shrink:
+                _SHRINK_OFF_HINT[shrink_key] = True
+            return out
+        except SpmdGuardTripped as e:
+            if e.shrink and shrink:
+                shrink = False
+                continue
+            if e.retryable and match == 1 and k > 1:
+                match = k
+                continue
             raise
-        out = _execute_plan_spmd_once(plan, conv_ctx, mesh,
-                                      source_tables, axis,
-                                      match_factor=k)
-        _MATCH_FACTOR_HINT[hint_key] = k
-        return out
+    raise SpmdGuardTripped("guard retries exhausted")
 
 
 def _canonicalize_rids(plan, conv_ctx, source_tables):
@@ -1066,7 +1309,7 @@ def _canonicalize_rids(plan, conv_ctx, source_tables):
 
 def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                             source_tables: Dict[str, Any], axis,
-                            match_factor: int):
+                            match_factor: int, agg_shrink: bool = True):
     import dataclasses
 
     import pyarrow as pa
@@ -1119,20 +1362,37 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     scan_rids, scan_tables = _materialize_scans(plan, conv_ctx)
     source_tables.update(scan_tables)
 
+    # shard + device_put each source ONCE per (table, mesh, axis, string
+    # config): repeat executes of the same query hit device-resident
+    # shards and skip all host-side pad/concat/transfer work
+    sharded = NamedSharding(mesh, PS(axis))
+    _SHARD_KEY[0] = (_mesh_fingerprint(mesh), axis,
+                     _string_cfg_fingerprint())
     host_inputs = {}
     schemas = {}
     for rid, table in source_tables.items():
-        schema, cols, live, cap = _shard_table(table, mesh, axis)
-        host_inputs[rid] = (cols, live)
-        schemas[rid] = schema
-
-    sharded = NamedSharding(mesh, PS(axis))
+        e = _DEVICE_SHARDS.get(table)
+        if e is None:
+            schema, cols, live, _cap = _shard_table(table, mesh, axis)
+            e = {"schema": schema,
+                 "cols": jax.tree.map(
+                     lambda x: jax.device_put(x, sharded), cols),
+                 "live": jax.device_put(live, sharded)}
+            _DEVICE_SHARDS.put(table, e)
+        host_inputs[rid] = (e["cols"], e["live"])
+        schemas[rid] = e["schema"]
     # program cache: repeat executions of the SAME converted plan over the
     # same input shapes reuse the compiled shard_map program (a fresh
     # jax.jit closure per call would re-trace+re-compile every time)
     from auron_tpu.config import conf as _conf
+    agg_cap_hint = int(_conf.get("auron.spmd.agg.capacity.hint")) \
+        if agg_shrink else 0
+    hash_grouping = (
+        np.asarray(mesh.devices).flat[0].platform == "cpu" and
+        str(_conf.get("auron.agg.grouping.strategy")) in ("auto", "hash"))
     cache_key = (
-        plan, axis, n_dev, match_factor,
+        plan, axis, n_dev, match_factor, agg_cap_hint,
+        _mesh_fingerprint(mesh),
         # EVERY config the tracer (or kernels it calls) reads at trace
         # time must appear here: rid canonicalization makes equal plans
         # cache-equal across conversions, so a flag flip between runs
@@ -1168,7 +1428,9 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                                   shadow_sort=shadow_sort,
                                   scan_rids=scan_rids,
                                   axis_sizes=axis_sizes,
-                                  match_factor=match_factor)
+                                  match_factor=match_factor,
+                                  agg_cap_hint=agg_cap_hint,
+                                  hash_grouping=hash_grouping)
             out = tracer.eval_node(plan)
             if not schema_box:
                 schema_box.append(out.schema)
@@ -1176,33 +1438,37 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                 jnp.zeros(0, bool)
             retry_guards = jnp.stack(tracer.retry_guards) \
                 if tracer.retry_guards else jnp.zeros(0, bool)
-            return out.cols, out.live, guards, retry_guards
+            shrink_guards = jnp.stack(tracer.shrink_guards) \
+                if tracer.shrink_guards else jnp.zeros(0, bool)
+            return out.cols, out.live, guards, retry_guards, shrink_guards
 
         shard = jax.jit(jax.shard_map(
             program, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: PS(axis), host_inputs),),
-            out_specs=(PS(axis), PS(axis), PS(), PS()),
+            out_specs=(PS(axis), PS(axis), PS(), PS(), PS()),
             check_vma=False))
     else:
         shard, schema_box = cached
 
-    put = {rid: (jax.tree.map(lambda x: jax.device_put(x, sharded), cols),
-                 jax.device_put(live, sharded))
-           for rid, (cols, live) in host_inputs.items()}
-    out_cols, out_live, guards, retry_guards = shard(put)
+    out_cols, out_live, guards, retry_guards, shrink_guards = \
+        shard(host_inputs)
     if cached is None:
         _PROGRAM_CACHE[cache_key] = (shard, schema_box)
     out_schema = schema_box[0]
 
     # gather + compact on host (one batched fetch, guards included)
     from auron_tpu.ops.kernel_cache import host_sync
-    out_live_np, out_cols_np, guards_np, retry_np = host_sync(
-        (out_live, out_cols, guards, retry_guards))
+    out_live_np, out_cols_np, guards_np, retry_np, shrink_np = host_sync(
+        (out_live, out_cols, guards, retry_guards, shrink_guards))
     if np.any(np.asarray(guards_np)):
         raise SpmdGuardTripped(
             "runtime guard tripped (exchange quota overflow, or "
             f"duplicate build keys past match factor {match_factor}): "
             "result discarded", retryable=False)
+    if np.any(np.asarray(shrink_np)):
+        raise SpmdGuardTripped(
+            f"agg group count overflowed the capacity hint "
+            f"{agg_cap_hint}: result discarded", shrink=True)
     if np.any(np.asarray(retry_np)):
         raise SpmdGuardTripped(
             "duplicate-key build side at match factor 1: result "
@@ -1265,6 +1531,9 @@ _PROGRAM_CACHE: Dict[Any, Any] = {}
 # canonical plan -> join match factor that last succeeded (see
 # execute_plan_spmd's retry)
 _MATCH_FACTOR_HINT: Dict[Any, int] = {}
+# canonical plan -> True when the agg capacity shrink overflowed and the
+# full-capacity retry succeeded (skip the shrink next time)
+_SHRINK_OFF_HINT: Dict[Any, bool] = {}
 
 # node kinds the tracer can (conditionally) express; anything else is
 # rejected by precheck_plan before source materialization
@@ -1329,6 +1598,8 @@ def _materialize_scans(plan, conv_ctx):
 
     rids: Dict[int, str] = {}
     nodes: Dict[str, Any] = {}
+    fps: Dict[str, Optional[Tuple]] = {}
+    cached: Dict[str, Any] = {}
     jobs: List[Tuple[str, Any, int, int]] = []
     for node in _walk_native(plan, conv_ctx):
         if node.kind not in ("parquet_scan", "orc_scan"):
@@ -1338,6 +1609,16 @@ def _materialize_scans(plan, conv_ctx):
         rid = f"scan:{len(rids)}"
         rids[id(node)] = rid
         nodes[rid] = node
+        # fingerprint BEFORE reading (a rewrite during the read changes
+        # the fp the next lookup computes -> stale entry never matches)
+        fps[rid] = _scan_files_fp(node)
+        hit = _SCAN_TABLES.get(node, fps[rid])
+        if hit is not None:
+            # same table OBJECT across executes -> the device shard
+            # cache's id() key hits too, so a repeat execute reads no
+            # files AND transfers nothing
+            cached[rid] = hit
+            continue
         n_parts = max(1, len(getattr(node, "file_groups", ()) or ()))
         for pid in range(n_parts):
             jobs.append((rid, node, pid, n_parts))
@@ -1352,11 +1633,15 @@ def _materialize_scans(plan, conv_ctx):
     per_rid: Dict[str, List[Tuple[int, List[Any]]]] = {}
     for rid, pid, batches in results:
         per_rid.setdefault(rid, []).append((pid, batches))
-    tables: Dict[str, Any] = {}
+    tables: Dict[str, Any] = dict(cached)
     for rid, node in nodes.items():
+        if rid in cached:
+            continue
         batches = [b for _pid, bs in sorted(per_rid.get(rid, []))
                    for b in bs]
         schema = to_arrow_schema(node.schema)
-        tables[rid] = pa.Table.from_batches(batches, schema=schema) \
+        t = pa.Table.from_batches(batches, schema=schema) \
             if batches else pa.Table.from_batches([], schema=schema)
+        tables[rid] = t
+        _SCAN_TABLES.put(node, fps[rid], t)
     return rids, tables

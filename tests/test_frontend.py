@@ -475,12 +475,16 @@ def test_task_retry_model(monkeypatch):
         "LocalTableScanExec",
         output=Schema((Field("a", I64), Field("b", F64))),
         attrs={"rows": rows})
-    with conf.scoped({"auron.task.retries": 1}):
+    # pin the serial walk: this tests the per-partition task retry loop,
+    # which the SPMD stage path (default since round 4) bypasses
+    with conf.scoped({"auron.task.retries": 1,
+                      "auron.spmd.singleDevice.enable": False}):
         res = AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
     assert res.table.num_rows == 50
     # with retries off the same failure propagates
     fails["n"] = 1
-    with conf.scoped({"auron.task.retries": 0}):
+    with conf.scoped({"auron.task.retries": 0,
+                      "auron.spmd.singleDevice.enable": False}):
         with pytest.raises(RuntimeError, match="injected"):
             AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
 
@@ -539,21 +543,20 @@ def test_single_device_conf_rides_stage_compiler():
         attrs={"grouping": [fcol("k", I64)], "aggs": agg_exprs,
                "agg_names": ["sv"], "mode": "final"})
 
-    serial = AuronSession(foreign_engine=ToyEngine()).execute(final)
+    # default ON since round 4: the stage path IS the engine path; the
+    # serial walk is reached by disabling it
+    with conf.scoped({"auron.spmd.singleDevice.enable": False}):
+        serial = AuronSession(foreign_engine=ToyEngine()).execute(final)
     assert not serial.spmd
-    conf.set("auron.spmd.singleDevice.enable", True)
-    try:
-        from auron_tpu.parallel import stage as S
-        session = AuronSession(foreign_engine=ToyEngine())
-        staged = session.execute(final)
-        assert staged.spmd
-        n_programs = len(S._PROGRAM_CACHE)
-        again = session.execute(final)
-        # the re-converted plan must hit the compiled-program cache (rid
-        # canonicalization) — a recompile would add a new entry
-        assert again.spmd and len(S._PROGRAM_CACHE) == n_programs
-    finally:
-        conf.set("auron.spmd.singleDevice.enable", False)
+    from auron_tpu.parallel import stage as S
+    session = AuronSession(foreign_engine=ToyEngine())
+    staged = session.execute(final)
+    assert staged.spmd
+    n_programs = len(S._PROGRAM_CACHE)
+    again = session.execute(final)
+    # the re-converted plan must hit the compiled-program cache (rid
+    # canonicalization) — a recompile would add a new entry
+    assert again.spmd and len(S._PROGRAM_CACHE) == n_programs
 
     def canon(res):
         return sorted((r["k"], round(r["sv"], 6))
