@@ -130,6 +130,18 @@ def device_capable(expr: E.Expr, schema: Schema,
         src = infer_type(expr.child, schema)
         if not _device_cast_ok(src, expr.dtype):
             return False
+    if k == "wire_udf":
+        # args evaluate in the ENCLOSING schema, the body under the
+        # param schema — the generic children walk below would wrongly
+        # resolve the body's param references against the outer schema
+        from auron_tpu.exprs.typing import wire_udf_param_schema
+        try:
+            pschema = wire_udf_param_schema(expr, schema)  # validates
+        except (TypeError, KeyError):
+            return False
+        return (all(device_capable(a, schema, host_cols)
+                    for a in expr.args) and
+                device_capable(expr.body, pschema, frozenset()))
     try:
         dt = infer_type(expr, schema)
         if not (is_device_type(dt) or dt.id == TypeId.NULL):
@@ -240,6 +252,17 @@ def _eval_bound(e: E.BoundReference, ctx: EvalCtx) -> Col:
 def _eval_literal(e, ctx: EvalCtx) -> Col:
     dt = e.dtype
     return literal_column(e.value, dt, ctx.capacity)
+
+
+def _eval_wire_udf(e: "E.WireUdf", ctx: EvalCtx) -> Col:
+    from auron_tpu.exprs.typing import wire_udf_param_schema
+    pschema = wire_udf_param_schema(e, ctx.schema)
+    arg_cols = [evaluate(a, ctx) for a in e.args]
+    # fresh cse: the body's param names would collide across call sites
+    sub = EvalCtx(cols=arg_cols, schema=pschema, num_rows=ctx.num_rows,
+                  capacity=ctx.capacity, partition_id=ctx.partition_id,
+                  row_base=ctx.row_base)
+    return evaluate(e.body, sub)
 
 
 def _eval_is_null(e: E.IsNull, ctx: EvalCtx) -> Col:
@@ -601,6 +624,7 @@ _DISPATCH = {
     "monotonically_increasing_id": _eval_monotonic_id,
     "scalar_subquery": _eval_scalar_subquery,
     "bloom_filter_might_contain": _eval_bloom_might_contain,
+    "wire_udf": _eval_wire_udf,
 }
 
 # function dispatch lives in functions_device.py (registered lazily to keep

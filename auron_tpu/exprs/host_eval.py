@@ -17,7 +17,7 @@ from __future__ import annotations
 import math
 import re
 from dataclasses import dataclass
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import pyarrow as pa
@@ -116,14 +116,31 @@ def decimal_unscaled(v, scale: int) -> int:
 # ---------------------------------------------------------------------------
 
 def evaluate(expr: E.Expr, rb: pa.RecordBatch, schema: Schema,
-             partition_id: int = 0, row_base: int = 0) -> HV:
+             partition_id: int = 0, row_base: int = 0,
+             bindings: Optional[Dict[str, HV]] = None) -> HV:
+    """`bindings` pre-binds column names to already-evaluated HVs — the
+    wire_udf body scope (params resolve to argument values, NOT to the
+    batch), avoiding any synthetic RecordBatch (which cannot hold
+    NULL-typed columns and collapses to 0 rows with no arrays)."""
     n = rb.num_rows
     k = expr.kind
 
     def rec(x):
-        return evaluate(x, rb, schema, partition_id, row_base)
+        return evaluate(x, rb, schema, partition_id, row_base, bindings)
 
     if k == "column":
+        if bindings is not None:
+            # body scope: NEVER fall through to the enclosing batch — a
+            # case-folded miss would silently read an unrelated column
+            hit = bindings.get(expr.name)
+            if hit is None:
+                for bn, bv in bindings.items():
+                    if bn.lower() == expr.name.lower():
+                        hit = bv
+                        break
+            if hit is None:
+                raise KeyError(f"unbound wire_udf param {expr.name!r}")
+            return hit
         i = schema.index_of(expr.name)
         return arrow_to_hv(rb.column(i), schema[i].dtype)
     if k == "bound_reference":
@@ -175,6 +192,16 @@ def evaluate(expr: E.Expr, rb: pa.RecordBatch, schema: Schema,
         return functions_host.eval_function(expr, rec, n, schema)
     if k == "py_udf_wrapper":
         return _py_udf(expr, rec, n)
+    if k == "wire_udf":
+        # args evaluate HERE (enclosing schema + bindings = lexical
+        # scoping for nested calls); the body evaluates under the param
+        # schema with params pre-bound — mirror of the device compiler's
+        # _eval_wire_udf.  rb still rides along only for num_rows.
+        from auron_tpu.exprs.typing import wire_udf_param_schema
+        pschema = wire_udf_param_schema(expr, schema)   # validates
+        binds = {p: rec(a) for p, a in zip(expr.params, expr.args)}
+        return evaluate(expr.body, rb, pschema, partition_id, row_base,
+                        binds)
     if k == "string_starts_with":
         c = rec(expr.child)
         return _str_pred(c, lambda s: s.startswith(expr.prefix))

@@ -40,6 +40,29 @@ _BOOL_RESULT_FUNCS = {"is_nan", "starts_with", "ends_with", "contains",
                       "array_contains"}
 
 
+def wire_udf_param_schema(expr: "E.WireUdf", schema: Schema) -> Schema:
+    """Schema the UDF body evaluates under: one field per formal param,
+    typed by the corresponding (positionally bound) argument.  Validates
+    the wire-supplied shape: arity match, a present body, and unique
+    param names (duplicates would silently bind every reference to the
+    first argument — names also collide case-insensitively, matching the
+    engine's case-insensitive column resolution)."""
+    from auron_tpu.ir.schema import Field
+    if expr.body is None:
+        raise TypeError(f"wire_udf {expr.name!r}: missing body")
+    if len(expr.params) != len(expr.args):
+        raise TypeError(
+            f"wire_udf {expr.name!r}: {len(expr.params)} params but "
+            f"{len(expr.args)} args")
+    folded = [str(p).lower() for p in expr.params]
+    if len(set(folded)) != len(folded):
+        raise TypeError(
+            f"wire_udf {expr.name!r}: duplicate param names "
+            f"{tuple(expr.params)}")
+    return Schema(tuple(Field(p, infer_type(a, schema))
+                        for p, a in zip(expr.params, expr.args)))
+
+
 def infer_type(expr: E.Expr, schema: Schema) -> DataType:
     k = expr.kind
     if k == "column":
@@ -87,6 +110,8 @@ def infer_type(expr: E.Expr, schema: Schema) -> DataType:
         return _infer_function_type(expr, schema)
     if k == "py_udf_wrapper":
         return expr.return_type
+    if k == "wire_udf":
+        return infer_type(expr.body, wire_udf_param_schema(expr, schema))
     if k == "scalar_subquery":
         return expr.dtype
     if k == "get_indexed_field":

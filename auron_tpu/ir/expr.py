@@ -203,6 +203,27 @@ class PyUdfWrapper(Expr):
 
 @register
 @dataclass(frozen=True)
+class WireUdf(Expr):
+    """Wire-registerable UDF: the body is ITSELF an IR expression tree
+    over formal parameters — a restricted expression language instead of
+    pickled code, so any foreign host (C++/JVM — the engine-service
+    clients) can ship one over the wire, and unlike `PyUdfWrapper` it is
+    fully device-capable (it compiles into the jitted program and rides
+    the SPMD mesh).  Complements the reference's host round-trip UDF
+    (spark_udf_wrapper.rs:43) for hosts without a Python runtime.
+
+    `body` references its arguments as `column` exprs named after
+    `params`; `args` are evaluated in the ENCLOSING schema and bound
+    positionally."""
+    kind: ClassVar[str] = "wire_udf"
+    name: str = "udf"
+    params: Tuple[str, ...] = ()
+    body: Optional[Expr] = None
+    args: Tuple[Expr, ...] = ()
+
+
+@register
+@dataclass(frozen=True)
 class ScalarSubqueryWrapper(Expr):
     """Pre-computed scalar subquery result carried as a literal value
     (analogue of PhysicalSparkScalarSubqueryWrapperExprNode)."""

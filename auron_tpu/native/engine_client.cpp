@@ -204,6 +204,35 @@ std::string agg_over_ffi(const std::string& rid) {
   return p.str();
 }
 
+std::string wire_udf_affine(const std::string& arg_col) {
+  // udf(x) = x * 2 + 1 shipped AS AN EXPRESSION TREE (the wire_udf
+  // restricted expression language): no code crosses the boundary, the
+  // engine compiles the body into its jitted program (ir/expr.py
+  // WireUdf; the C++-host counterpart of spark_udf_wrapper.rs:43)
+  return "{\"@kind\":\"wire_udf\",\"name\":\"affine\",\"params\":[\"x\"],"
+         "\"body\":{\"@kind\":\"binary\",\"left\":{\"@kind\":\"binary\","
+         "\"left\":{\"@kind\":\"column\",\"name\":\"x\"},\"op\":\"*\","
+         "\"right\":{\"@kind\":\"literal\",\"value\":2.0,\"dtype\":"
+         "{\"@type\":\"FLOAT64\"}}},\"op\":\"+\",\"right\":{\"@kind\":"
+         "\"literal\",\"value\":1.0,\"dtype\":{\"@type\":\"FLOAT64\"}}},"
+         "\"args\":[" + col_ref(arg_col) + "]}";
+}
+
+std::string agg_udf_over_ffi(const std::string& rid) {
+  // Agg(single, group by k, sum(udf(v)) + count(v)) over FFIReader(rid)
+  std::ostringstream p;
+  p << "{\"@kind\":\"agg\",\"agg_names\":[\"s\",\"c\"],\"aggs\":["
+    << agg_expr("sum", wire_udf_affine("v"), "FLOAT64") << ","
+    << agg_expr("count", col_ref("v"), "INT64")
+    << "],\"child\":{\"@kind\":\"ffi_reader\",\"resource_id\":\"" << rid
+    << "\",\"schema\":{\"@schema\":[{\"@field\":\"k\",\"dtype\":"
+       "{\"@type\":\"INT64\"},\"nullable\":true},{\"@field\":\"v\","
+       "\"dtype\":{\"@type\":\"FLOAT64\"},\"nullable\":true}]}},"
+       "\"exec_mode\":\"single\",\"grouping\":[" << col_ref("k")
+    << "],\"grouping_names\":[\"k\"],\"supports_partial_skipping\":false}";
+  return p.str();
+}
+
 std::string task_definition(const std::string& plan) {
   std::string json =
       "{\"@kind\":\"task_definition\",\"host_threads\":0,"
@@ -328,6 +357,33 @@ int main(int argc, char** argv) {
   if (!bad.error) die("expected a ferried error for missing resource");
   send_msg(fd, "{\"cmd\":\"ping\"}", "");
   expect_ok(fd);
+
+  // 5. a WIRE-REGISTERED UDF (expression-tree body, no code): the C++
+  //    host ships udf(x)=2x+1 inside the plan and verifies sum(udf(v))
+  {
+    ExecResult ur = run_execute(
+        fd, task_definition(agg_udf_over_ffi("cppsrc")), "", "");
+    if (ur.error) die("wire_udf execute failed: " + ur.error_message);
+    double sum_s = 0.0;
+    int64_t sum_c = 0, groups = 0;
+    for (const auto& rb : ur.batches) {
+      auto s = std::static_pointer_cast<arrow::DoubleArray>(
+          rb->GetColumnByName("s"));
+      auto c = std::static_pointer_cast<arrow::Int64Array>(
+          rb->GetColumnByName("c"));
+      for (int64_t i = 0; i < rb->num_rows(); ++i) {
+        sum_s += s->Value(i);
+        sum_c += c->Value(i);
+        ++groups;
+      }
+    }
+    double want = 0.0;
+    for (int64_t i = 0; i < N; ++i)
+      want += 2.0 * (static_cast<double>(i % 8) * 1.5 + 1.0) + 1.0;
+    if (groups != 8) die("udf: expected 8 groups");
+    if (sum_c != N) die("udf: count mismatch");
+    if (std::abs(sum_s - want) > 1e-6) die("udf: sum(2v+1) mismatch");
+  }
 
   ::close(fd);
   std::cout << "CPP_CLIENT_OK" << std::endl;

@@ -429,3 +429,136 @@ def test_least_promotion():
     f = E.ScalarFunctionCall
     check_expr(f(name="least", args=(col("a"), col("b"))), rb)
     check_expr(f(name="greatest", args=(col("a"), col("b"))), rb)
+
+
+# ---------------------------------------------------------------------------
+# wire_udf: the wire-registerable (expression-tree-body) UDF
+# ---------------------------------------------------------------------------
+
+def _affine_udf(arg):
+    """udf(x) = x * 2 + 1 — the restricted-expression-language UDF a
+    foreign host ships over the engine service (ir/expr.py WireUdf; the
+    C++ twin lives in native/engine_client.cpp step 5)."""
+    return E.WireUdf(
+        name="affine", params=("x",),
+        body=E.BinaryExpr(
+            left=E.BinaryExpr(left=col("x"), op="*", right=lit(2.0)),
+            op="+", right=lit(1.0)),
+        args=(arg,))
+
+
+def test_wire_udf_device_host_agree():
+    # nulls propagate through the body's arithmetic; device == host
+    check_expr(_affine_udf(col("f64")), expect_device=True)
+    check_expr(_affine_udf(col("i32")), expect_device=True)
+
+
+def test_wire_udf_nested_and_multi_param():
+    dist2 = E.WireUdf(
+        name="dist2", params=("a", "b"),
+        body=E.BinaryExpr(
+            left=E.BinaryExpr(left=col("a"), op="*", right=col("a")),
+            op="+",
+            right=E.BinaryExpr(left=col("b"), op="*", right=col("b"))),
+        args=(col("i32"), _affine_udf(col("f64"))))
+    check_expr(dist2, expect_device=True)
+
+
+def test_wire_udf_host_body_falls_back():
+    # a body needing the host path (string upper without the ascii
+    # opt-in) makes the whole call a host island — still correct
+    up = E.WireUdf(
+        name="up", params=("t",),
+        body=E.ScalarFunctionCall(name="upper", args=(col("t"),),
+                                  return_type=DataType.string()),
+        args=(col("s"),))
+    from auron_tpu.config import conf
+    with conf.scoped({"auron.string.ascii.case.enable": False}):
+        check_expr(up, expect_device=False)
+
+
+def test_wire_udf_param_arity_mismatch_rejected():
+    bad = E.WireUdf(name="bad", params=("x", "y"),
+                    body=col("x"), args=(col("i32"),))
+    rb = make_batch()
+    schema = from_arrow_schema(rb.schema)
+    assert not device_capable(bad, schema, frozenset())
+    with pytest.raises(TypeError, match="params"):
+        infer_type(bad, schema)
+
+
+def test_wire_udf_serde_roundtrip():
+    from auron_tpu.ir import plan as P
+    from auron_tpu.ir import serde
+    u = _affine_udf(col("f64"))
+    td = P.TaskDefinition(
+        plan=P.Projection(
+            child=P.FFIReader(
+                schema=Schema((Field("f64", DataType.float64()),)),
+                resource_id="s"),
+            exprs=(u,), names=("u",)),
+        stage_id=0, partition_id=0, num_partitions=1, host_threads=0)
+    assert serde.deserialize(serde.serialize(td)) == td
+
+
+def test_wire_udf_rides_the_spmd_mesh():
+    # fully device-capable -> compiles into the shard_map stage program
+    import jax
+    from auron_tpu.ir import plan as P
+    from auron_tpu.parallel.mesh import data_mesh
+    from auron_tpu.parallel.stage import execute_plan_spmd
+
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    n = 4000
+    rng = np.random.default_rng(3)
+    t = pa.table({"k": rng.integers(0, 16, n).astype(np.int64),
+                  "v": rng.normal(0, 1, n).astype(np.float64)})
+    plan = P.Projection(
+        child=P.FFIReader(schema=from_arrow_schema(t.schema),
+                          resource_id="t"),
+        exprs=(col("k"), _affine_udf(col("v"))), names=("k", "u"))
+
+    class _C:
+        exchanges: dict = {}
+        broadcasts: dict = {}
+    out = execute_plan_spmd(plan, _C(), data_mesh(8), {"t": t})
+    got = np.asarray(out.column("u").to_pylist())
+    want = t.column("v").to_numpy() * 2.0 + 1.0
+    assert out.num_rows == n
+    assert np.allclose(np.sort(got), np.sort(want))
+
+
+def test_wire_udf_null_and_zero_arg_shapes():
+    # NULL-typed argument: propagates as all-null, host == device
+    nullarg = E.WireUdf(name="n", params=("x",),
+                        body=E.BinaryExpr(left=col("x"), op="+",
+                                          right=lit(1.0)),
+                        args=(E.Literal(value=None),))
+    check_expr(nullarg)
+    # zero-arg UDF: a constant over every row (host path must not
+    # collapse to a 0-row synthetic batch)
+    const = E.WireUdf(name="c", params=(), body=lit(7.5), args=())
+    rb = make_batch(n=13)
+    schema = from_arrow_schema(rb.schema)
+    hv = host_eval.evaluate_arrow(const, rb, schema)
+    assert hv.to_pylist() == [7.5] * 13
+    check_expr(const, rb)
+
+
+def test_wire_udf_wire_validation():
+    rb = make_batch()
+    schema = from_arrow_schema(rb.schema)
+    # duplicate params (incl. case-insensitive collision) are rejected
+    for params in (("x", "x"), ("a", "A")):
+        dup = E.WireUdf(name="d", params=params, body=col("x"),
+                        args=(col("i32"), col("i64")))
+        assert not device_capable(dup, schema, frozenset())
+        with pytest.raises(TypeError, match="duplicate"):
+            infer_type(dup, schema)
+    # a wire message without a body is a typed validation error, not an
+    # AttributeError from deep inside analysis
+    nobody = E.WireUdf(name="nb", params=(), body=None, args=())
+    assert not device_capable(nobody, schema, frozenset())
+    with pytest.raises(TypeError, match="body"):
+        infer_type(nobody, schema)
