@@ -131,9 +131,12 @@ def evaluate(expr: E.Expr, rb: pa.RecordBatch, schema: Schema,
     if k == "column":
         if bindings is not None:
             # body scope: NEVER fall through to the enclosing batch — a
-            # case-folded miss would silently read an unrelated column
+            # case-folded miss would silently read an unrelated column.
+            # Case folding honors auron.case.sensitive, matching
+            # Schema.index_of (the resolution every other column takes).
+            from auron_tpu.config import conf as _conf
             hit = bindings.get(expr.name)
-            if hit is None:
+            if hit is None and not _conf.get("auron.case.sensitive"):
                 for bn, bv in bindings.items():
                     if bn.lower() == expr.name.lower():
                         hit = bv

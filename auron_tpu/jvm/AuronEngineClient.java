@@ -1,0 +1,448 @@
+// AuronEngineClient: a JVM host driving the engine boundary service with
+// ZERO dependencies (no Arrow jars, no JSON library) — the JVM twin of
+// native/engine_client.cpp, mirroring its numbered steps:
+//   1. framed TCP (4-byte BE header length + JSON header + payload)
+//   2. an Arrow IPC batch assembled in Java (template metadata from
+//      jvm/ipc_template.py + little-endian body buffers written here)
+//      registered as a resource
+//   3. a TaskDefinition built in Java (raw-codec IR envelope: "ATPU" +
+//      version + codec 0 + canonical JSON)
+//   4. result batches parsed with a minimal flatbuffer reader (the
+//      transliteration of ipc_template.read_ksc_result, which the
+//      Python test suite validates against real pyarrow output)
+//   5. the mid-execution need_resource UPCALL served from Java
+//   6. an execution error ferried in-band with the connection reusable
+//   7. a wire_udf (expression-tree UDF) shipped inside the plan
+//
+// Usage: java AuronEngineClient HOST PORT TEMPLATE_DIR
+//   TEMPLATE_DIR holds schema_msg.bin / batch_meta.bin / eos.bin /
+//   meta.txt ("n_rows body_len"), produced by
+//   python -m auron_tpu.jvm.ipc_template OUT_DIR — the same generator
+//   the pytest harness validates byte-for-byte with pyarrow.
+//
+// Prints JVM_CLIENT_OK and exits 0 on success; any failure exits 1.
+// Reference analogue: JniBridge.java:49-55 / AuronCallNativeWrapper —
+// the engine driven by a JVM host over Arrow batches.
+
+import java.io.DataInputStream;
+import java.io.DataOutputStream;
+import java.io.IOException;
+import java.net.Socket;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.util.ArrayList;
+import java.util.List;
+
+public final class AuronEngineClient {
+
+  static void die(String msg) {
+    System.err.println("AuronEngineClient: " + msg);
+    System.exit(1);
+  }
+
+  // ---- framing ----------------------------------------------------------
+
+  static void sendMsg(DataOutputStream out, String header, byte[] payload)
+      throws IOException {
+    byte[] h = header.getBytes("UTF-8");
+    out.writeInt(h.length);              // 4-byte big-endian length
+    out.write(h);
+    if (payload != null && payload.length > 0) out.write(payload);
+    out.flush();
+  }
+
+  static final class Frame {
+    String header;
+    byte[] payload = new byte[0];
+  }
+
+  static Frame recvMsg(DataInputStream in) throws IOException {
+    int hlen = in.readInt();
+    if (hlen < 0 || hlen > (1 << 20)) die("oversized header " + hlen);
+    byte[] h = new byte[hlen];
+    in.readFully(h);
+    Frame f = new Frame();
+    f.header = new String(h, "UTF-8");
+    long plen = jsonInt(f.header, "len", 0);
+    if (plen > 0) {
+      f.payload = new byte[(int) plen];
+      in.readFully(f.payload);
+    }
+    return f;
+  }
+
+  // ---- minimal JSON probes (headers are small server-built objects) -----
+
+  static String jsonStr(String j, String key) {
+    int pos = j.indexOf("\"" + key + "\"");
+    if (pos < 0) return "";
+    pos = j.indexOf(':', pos);
+    pos = j.indexOf('"', pos);
+    if (pos < 0) return "";
+    StringBuilder out = new StringBuilder();
+    for (int i = pos + 1; i < j.length() && j.charAt(i) != '"'; i++) {
+      char c = j.charAt(i);
+      if (c == '\\' && i + 1 < j.length()) c = j.charAt(++i);
+      out.append(c);
+    }
+    return out.toString();
+  }
+
+  static long jsonInt(String j, String key, long dflt) {
+    int pos = j.indexOf("\"" + key + "\"");
+    if (pos < 0) return dflt;
+    pos = j.indexOf(':', pos);
+    if (pos < 0) return dflt;
+    int s = pos + 1;
+    while (s < j.length() && (j.charAt(s) == ' ')) s++;
+    int e = s;
+    while (e < j.length() && (Character.isDigit(j.charAt(e))
+        || j.charAt(e) == '-')) e++;
+    try {
+      return Long.parseLong(j.substring(s, e));
+    } catch (NumberFormatException ex) {
+      return dflt;
+    }
+  }
+
+  static boolean jsonTrue(String j, String key) {
+    int pos = j.indexOf("\"" + key + "\"");
+    if (pos < 0) return false;
+    pos = j.indexOf(':', pos);
+    return j.startsWith("true", pos + 1) || j.startsWith("true", pos + 2);
+  }
+
+  static void expectOk(DataInputStream in) throws IOException {
+    Frame f = recvMsg(in);
+    if (!jsonTrue(f.header, "ok")) die("server said not-ok: " + f.header);
+  }
+
+  // ---- Arrow IPC write: template metadata + Java-built body -------------
+  // Template bytes come from jvm/ipc_template.ipc_segments(n): the IPC
+  // stream for a fixed schema factors into [schema msg][batch metadata]
+  // [BODY][eos] where only the body carries values.  Body layout for
+  // (k int64, v float64), no nulls: k-data at 0, v-data at the next
+  // 64-byte-aligned offset (validity buffers empty).
+
+  static byte[] schemaMsg, batchMeta, eosMsg;
+  static int tmplRows, tmplBodyLen;
+
+  static void loadTemplates(String dir) throws IOException {
+    schemaMsg = Files.readAllBytes(Path.of(dir, "schema_msg.bin"));
+    batchMeta = Files.readAllBytes(Path.of(dir, "batch_meta.bin"));
+    eosMsg = Files.readAllBytes(Path.of(dir, "eos.bin"));
+    String[] meta =
+        new String(Files.readAllBytes(Path.of(dir, "meta.txt")), "UTF-8")
+            .trim().split(" ");
+    tmplRows = Integer.parseInt(meta[0]);
+    tmplBodyLen = Integer.parseInt(meta[1]);
+  }
+
+  static int align64(int n) {
+    return (n + 63) & ~63;
+  }
+
+  static byte[] kvBatchIpc(long[] k, double[] v) {
+    if (k.length != tmplRows) die("template is for " + tmplRows + " rows");
+    ByteBuffer body = ByteBuffer.allocate(tmplBodyLen)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    for (long x : k) body.putLong(x);
+    body.position(align64(8 * k.length));
+    for (double x : v) body.putDouble(x);
+    ByteBuffer out = ByteBuffer.allocate(
+        schemaMsg.length + batchMeta.length + tmplBodyLen + eosMsg.length);
+    out.put(schemaMsg).put(batchMeta).put(body.array()).put(eosMsg);
+    return out.array();
+  }
+
+  // ---- Arrow IPC read: minimal flatbuffer reader ------------------------
+  // Transliteration of ipc_template.py (fb_field / read_batch_message /
+  // read_ksc_result), validated there against pyarrow-produced streams.
+  // Flatbuffer layout: a table position holds a little-endian soffset to
+  // its vtable; vtable = [u16 vt_size][u16 table_size][u16 rel-offset
+  // per slot]; vectors are a u32 length then elements.
+
+  static int i32(ByteBuffer b, int o) { return b.getInt(o); }
+
+  static long i64(ByteBuffer b, int o) { return b.getLong(o); }
+
+  static int u16(ByteBuffer b, int o) { return b.getShort(o) & 0xFFFF; }
+
+  static int fbField(ByteBuffer b, int tablePos, int slot) {
+    int vt = tablePos - i32(b, tablePos);
+    int vtSize = u16(b, vt);
+    int fo = 4 + 2 * slot;
+    if (fo >= vtSize) return 0;
+    int rel = u16(b, vt + fo);
+    return rel == 0 ? 0 : tablePos + rel;
+  }
+
+  static int fbIndirect(ByteBuffer b, int pos) {
+    return pos + i32(b, pos);
+  }
+
+  static final class BatchMeta {
+    long numRows;
+    long[][] nodes;    // [i] = {length, null_count}
+    long[][] buffers;  // [i] = {offset, length}
+    long bodyLength;
+  }
+
+  /** Message.bodyLength only — safe for ANY message type (the Python
+   * transliteration's _msg_body_length; used for the schema message,
+   * whose header must NOT be parsed as a RecordBatch). */
+  static long readBodyLength(byte[] msg) {
+    ByteBuffer meta = ByteBuffer.wrap(msg, 8, msg.length - 8).slice()
+        .order(ByteOrder.LITTLE_ENDIAN);
+    int root = fbIndirect(meta, 0);
+    int blenPos = fbField(meta, root, 3);   // Message.bodyLength
+    return blenPos == 0 ? 0 : i64(meta, blenPos);
+  }
+
+  static BatchMeta readBatchMessage(byte[] msg) {
+    // msg: [0xFFFFFFFF][i32 metaLen][flatbuffer metadata]
+    ByteBuffer meta = ByteBuffer.wrap(msg, 8, msg.length - 8).slice()
+        .order(ByteOrder.LITTLE_ENDIAN);
+    BatchMeta out = new BatchMeta();
+    int root = fbIndirect(meta, 0);
+    int blenPos = fbField(meta, root, 3);   // Message.bodyLength
+    out.bodyLength = blenPos == 0 ? 0 : i64(meta, blenPos);
+    int header = fbField(meta, root, 2);    // Message.header (RecordBatch)
+    if (header == 0) return out;
+    int batch = fbIndirect(meta, header);
+    int lengthPos = fbField(meta, batch, 0);
+    out.numRows = lengthPos == 0 ? 0 : i64(meta, lengthPos);
+    int nodesPos = fbField(meta, batch, 1);
+    if (nodesPos != 0) {
+      int vec = fbIndirect(meta, nodesPos);
+      int n = i32(meta, vec);
+      out.nodes = new long[n][2];
+      for (int i = 0; i < n; i++) {        // FieldNode struct: 2 x i64
+        out.nodes[i][0] = i64(meta, vec + 4 + i * 16);
+        out.nodes[i][1] = i64(meta, vec + 4 + i * 16 + 8);
+      }
+    }
+    int bufsPos = fbField(meta, batch, 2);
+    if (bufsPos != 0) {
+      int vec = fbIndirect(meta, bufsPos);
+      int n = i32(meta, vec);
+      out.buffers = new long[n][2];
+      for (int i = 0; i < n; i++) {        // Buffer struct: 2 x i64
+        out.buffers[i][0] = i64(meta, vec + 4 + i * 16);
+        out.buffers[i][1] = i64(meta, vec + 4 + i * 16 + 8);
+      }
+    }
+    return out;
+  }
+
+  /** Result rows of the agg schema (k int64, s float64, c int64). */
+  static final class KscRows {
+    List<long[]> rows = new ArrayList<>();   // {k, Double.bits(s), c}
+  }
+
+  static void readKscStream(byte[] stream, KscRows acc) {
+    ByteBuffer bb = ByteBuffer.wrap(stream).order(ByteOrder.LITTLE_ENDIAN);
+    int off = 0;
+    boolean first = true;
+    while (off < stream.length) {
+      int cont = bb.getInt(off);
+      int mlen = bb.getInt(off + 4);
+      if (cont != 0xFFFFFFFF) die("bad continuation marker");
+      if (mlen == 0) break;                 // EOS
+      int metaEnd = off + 8 + mlen;
+      byte[] msg = new byte[8 + mlen];
+      System.arraycopy(stream, off, msg, 0, 8 + mlen);
+      if (first) {                          // schema message: read ONLY
+        first = false;                      // bodyLength (its header is
+        off = metaEnd + (int) readBodyLength(msg);   // not a RecordBatch)
+        continue;
+      }
+      BatchMeta bm = readBatchMessage(msg);
+      int body = metaEnd;
+      int n = (int) bm.numRows;
+      // 3 columns x (validity, data); null slots read as 0
+      long[] kcol = new long[n];
+      double[] scol = new double[n];
+      long[] ccol = new long[n];
+      for (int ci = 0; ci < 3; ci++) {
+        int vOff = (int) bm.buffers[2 * ci][0];
+        long vLen = bm.buffers[2 * ci][1];
+        int dOff = (int) bm.buffers[2 * ci + 1][0];
+        long nNull = bm.nodes[ci][1];
+        for (int i = 0; i < n; i++) {
+          boolean valid = true;
+          if (vLen > 0 && nNull > 0) {
+            int bit = stream[body + vOff + (i >> 3)] >> (i & 7) & 1;
+            valid = bit != 0;
+          }
+          long raw = valid ? bb.getLong(body + dOff + 8 * i) : 0L;
+          if (ci == 0) kcol[i] = raw;
+          else if (ci == 1) scol[i] = valid
+              ? Double.longBitsToDouble(raw) : 0.0;
+          else ccol[i] = raw;
+        }
+      }
+      for (int i = 0; i < n; i++) {
+        acc.rows.add(new long[] {
+            kcol[i], Double.doubleToLongBits(scol[i]), ccol[i]});
+      }
+      off = metaEnd + (int) bm.bodyLength;
+    }
+  }
+
+  // ---- TaskDefinition (IR envelope, raw codec) — mirrors the C++ -------
+
+  static String colRef(String name) {
+    return "{\"@kind\":\"column\",\"name\":\"" + name + "\"}";
+  }
+
+  static String aggExpr(String fn, String child, String rtype) {
+    return "{\"@kind\":\"agg_expr\",\"children\":[" + child
+        + "],\"distinct\":false,\"fn\":\"" + fn
+        + "\",\"return_type\":{\"@type\":\"" + rtype + "\"},\"udaf\":null}";
+  }
+
+  static String wireUdfAffine(String argCol) {
+    // udf(x) = x * 2 + 1 as an expression tree (wire_udf — ir/expr.py)
+    return "{\"@kind\":\"wire_udf\",\"name\":\"affine\",\"params\":[\"x\"],"
+        + "\"body\":{\"@kind\":\"binary\",\"left\":{\"@kind\":\"binary\","
+        + "\"left\":{\"@kind\":\"column\",\"name\":\"x\"},\"op\":\"*\","
+        + "\"right\":{\"@kind\":\"literal\",\"value\":2.0,\"dtype\":"
+        + "{\"@type\":\"FLOAT64\"}}},\"op\":\"+\",\"right\":{\"@kind\":"
+        + "\"literal\",\"value\":1.0,\"dtype\":{\"@type\":\"FLOAT64\"}}},"
+        + "\"args\":[" + colRef(argCol) + "]}";
+  }
+
+  static String aggOverFfi(String rid, String sumChild) {
+    return "{\"@kind\":\"agg\",\"agg_names\":[\"s\",\"c\"],\"aggs\":["
+        + aggExpr("sum", sumChild, "FLOAT64") + ","
+        + aggExpr("count", colRef("v"), "INT64")
+        + "],\"child\":{\"@kind\":\"ffi_reader\",\"resource_id\":\"" + rid
+        + "\",\"schema\":{\"@schema\":[{\"@field\":\"k\",\"dtype\":"
+        + "{\"@type\":\"INT64\"},\"nullable\":true},{\"@field\":\"v\","
+        + "\"dtype\":{\"@type\":\"FLOAT64\"},\"nullable\":true}]}},"
+        + "\"exec_mode\":\"single\",\"grouping\":[" + colRef("k")
+        + "],\"grouping_names\":[\"k\"],\"supports_partial_skipping\":false}";
+  }
+
+  static byte[] taskDefinition(String plan) throws IOException {
+    String json = "{\"@kind\":\"task_definition\",\"host_threads\":0,"
+        + "\"num_partitions\":1,\"partition_id\":0,\"plan\":" + plan
+        + ",\"stage_id\":0}";
+    byte[] j = json.getBytes("UTF-8");
+    byte[] out = new byte[6 + j.length];
+    out[0] = 'A'; out[1] = 'T'; out[2] = 'P'; out[3] = 'U';
+    out[4] = 1;   // version
+    out[5] = 0;   // codec raw
+    System.arraycopy(j, 0, out, 6, j.length);
+    return out;
+  }
+
+  // ---- execution --------------------------------------------------------
+
+  static final class ExecResult {
+    KscRows rows = new KscRows();
+    boolean error;
+    String errorMessage = "";
+  }
+
+  static ExecResult runExecute(DataInputStream in, DataOutputStream out,
+      byte[] td, String lazyKey, byte[] lazyIpc) throws IOException {
+    sendMsg(out, "{\"cmd\":\"execute\",\"len\":" + td.length + "}", td);
+    ExecResult res = new ExecResult();
+    while (true) {
+      Frame f = recvMsg(in);
+      String type = jsonStr(f.header, "type");
+      if (type.equals("batch")) {
+        readKscStream(f.payload, res.rows);
+      } else if (type.equals("done")) {
+        return res;
+      } else if (type.equals("error")) {
+        res.error = true;
+        res.errorMessage = jsonStr(f.header, "message");
+        return res;
+      } else if (type.equals("need_resource")) {
+        String key = jsonStr(f.header, "key");
+        if (key.equals(lazyKey) && lazyIpc != null) {
+          sendMsg(out, "{\"cmd\":\"resource_data\",\"kind\":\"arrow_ipc\","
+              + "\"len\":" + lazyIpc.length + "}", lazyIpc);
+        } else {
+          sendMsg(out, "{\"cmd\":\"resource_data\",\"kind\":\"missing\"}",
+              null);
+        }
+      } else {
+        die("unexpected frame: " + f.header);
+      }
+    }
+  }
+
+  static void verifyAgg(ExecResult res, int nRows, boolean udf) {
+    if (res.error) die("unexpected error: " + res.errorMessage);
+    double sumS = 0.0;
+    long sumC = 0, groups = 0;
+    for (long[] row : res.rows.rows) {
+      sumS += Double.longBitsToDouble(row[1]);
+      sumC += row[2];
+      groups++;
+    }
+    double want = 0.0;
+    for (int i = 0; i < nRows; i++) {
+      double v = (i % 8) * 1.5 + 1.0;
+      want += udf ? 2.0 * v + 1.0 : v;
+    }
+    if (groups != 8) die("expected 8 groups, got " + groups);
+    if (sumC != nRows) die("count mismatch: " + sumC);
+    if (Math.abs(sumS - want) > 1e-6) die("sum mismatch: " + sumS
+        + " want " + want);
+  }
+
+  public static void main(String[] args) throws Exception {
+    if (args.length != 3) die("usage: AuronEngineClient HOST PORT TMPL_DIR");
+    loadTemplates(args[2]);
+
+    try (Socket sock = new Socket(args[0], Integer.parseInt(args[1]))) {
+      DataInputStream in = new DataInputStream(sock.getInputStream());
+      DataOutputStream out = new DataOutputStream(sock.getOutputStream());
+
+      // 1. ping
+      sendMsg(out, "{\"cmd\":\"ping\"}", null);
+      expectOk(in);
+
+      // 2. put_resource with Java-assembled Arrow IPC, execute + verify
+      int n = tmplRows;
+      long[] k = new long[n];
+      double[] v = new double[n];
+      for (int i = 0; i < n; i++) {
+        k[i] = i % 8;
+        v[i] = (i % 8) * 1.5 + 1.0;
+      }
+      byte[] ipc = kvBatchIpc(k, v);
+      sendMsg(out, "{\"cmd\":\"put_resource\",\"key\":\"jvmsrc\",\"kind\":"
+          + "\"arrow_ipc\",\"len\":" + ipc.length + "}", ipc);
+      expectOk(in);
+      verifyAgg(runExecute(in, out,
+          taskDefinition(aggOverFfi("jvmsrc", colRef("v"))), "", null),
+          n, false);
+
+      // 3. the need_resource upcall served from Java
+      verifyAgg(runExecute(in, out,
+          taskDefinition(aggOverFfi("lazy", colRef("v"))), "lazy", ipc),
+          n, false);
+
+      // 4. error ferrying; connection stays usable
+      ExecResult bad = runExecute(in, out,
+          taskDefinition(aggOverFfi("nope", colRef("v"))), "", null);
+      if (!bad.error) die("expected a ferried error for missing resource");
+      sendMsg(out, "{\"cmd\":\"ping\"}", null);
+      expectOk(in);
+
+      // 5. wire_udf: sum(udf(v)) with udf(x)=2x+1 shipped as IR
+      verifyAgg(runExecute(in, out,
+          taskDefinition(aggOverFfi("jvmsrc", wireUdfAffine("v"))),
+          "", null), n, true);
+    }
+    System.out.println("JVM_CLIENT_OK");
+  }
+}

@@ -1,0 +1,4 @@
+"""JVM host integration: a dependency-free Java engine-service client
+(AuronEngineClient.java) plus the Arrow-IPC template toolkit
+(ipc_template.py) whose byte algorithms the Java transliterates and the
+test suite validates against pyarrow."""

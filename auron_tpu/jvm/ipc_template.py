@@ -1,0 +1,218 @@
+"""Arrow-IPC template toolkit for the dependency-free JVM engine client.
+
+The JVM client (AuronEngineClient.java) speaks the engine service's
+arrow_ipc resource format WITHOUT Arrow jars: the IPC stream for a fixed
+schema + row count factors into [schema message][record-batch metadata]
+[body][EOS], where only the BODY depends on the data values.  This module
+generates those template segments with pyarrow, and implements the SAME
+body-splice and flatbuffer-read algorithms the Java client transliterates
+— tests validate them here against real pyarrow, making the (JDK-gated)
+Java path correct by construction.
+
+Reference analogue: the JVM side of JniBridge ships Arrow batches through
+FFI (JniBridge.java:49-55); this is the out-of-process twin for hosts
+without libarrow.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+def kv_schema() -> pa.Schema:
+    """The fixed fact schema the JVM client registers (k int64, v f64) —
+    matches the C++ client's make_source_batch."""
+    return pa.schema([pa.field("k", pa.int64()), pa.field("v", pa.float64())])
+
+
+def ipc_segments(n_rows: int) -> Tuple[bytes, bytes, int, bytes]:
+    """-> (schema_msg, batch_meta, body_len, eos) for a kv batch of
+    n_rows with NO nulls.  body layout (64-byte aligned buffers):
+    k-validity (empty), k-data 8*n, v-validity (empty), v-data 8*n —
+    every offset/length is baked into batch_meta, so a client writes
+    [schema_msg][batch_meta][its own body][eos] to produce a valid
+    stream for ANY values."""
+    k = np.zeros(n_rows, np.int64)
+    v = np.zeros(n_rows, np.float64)
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array(k), pa.array(v)], schema=kv_schema())
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    stream = sink.getvalue().to_pybytes()
+    # walk encapsulated messages: [0xFFFFFFFF][int32 metalen][meta pad8]
+    off = 0
+    segs: List[Tuple[int, int, int]] = []   # (start, meta_end, body_len)
+    while off < len(stream):
+        cont, mlen = struct.unpack_from("<Ii", stream, off)
+        assert cont == 0xFFFFFFFF, hex(cont)
+        if mlen == 0:                        # EOS
+            segs.append((off, off + 8, 0))
+            off += 8
+            continue
+        meta_end = off + 8 + mlen
+        body_len = _msg_body_length(stream[off + 8:meta_end])
+        segs.append((off, meta_end, body_len))
+        off = meta_end + body_len
+    assert len(segs) == 3, f"expected schema+batch+eos, got {len(segs)}"
+    (s0, e0, b0), (s1, e1, b1), (s2, e2, _b2) = segs
+    assert b0 == 0
+    return (stream[s0:e0], stream[s1:e1], b1, stream[s2:e2])
+
+
+def splice_body(schema_msg: bytes, batch_meta: bytes, eos: bytes,
+                k: np.ndarray, v: np.ndarray, body_len: int) -> bytes:
+    """The Java client's write path: template + raw little-endian data.
+    Buffers sit at 64-byte-aligned offsets: k at 0, v after k (padded)."""
+    n = len(k)
+    body = bytearray(body_len)
+    kb = k.astype("<i8").tobytes()
+    off_v = _align64(len(kb))
+    body[0:len(kb)] = kb
+    vb = v.astype("<f8").tobytes()
+    body[off_v:off_v + len(vb)] = vb
+    return schema_msg + batch_meta + bytes(body) + eos
+
+
+def _align64(n: int) -> int:
+    return (n + 63) & ~63
+
+
+# ---------------------------------------------------------------------------
+# minimal flatbuffer READER (the Java transliteration source of truth)
+# ---------------------------------------------------------------------------
+
+def _i32(b: bytes, o: int) -> int:
+    return struct.unpack_from("<i", b, o)[0]
+
+
+def _i64(b: bytes, o: int) -> int:
+    return struct.unpack_from("<q", b, o)[0]
+
+
+def _u16(b: bytes, o: int) -> int:
+    return struct.unpack_from("<H", b, o)[0]
+
+
+def fb_field(b: bytes, table_pos: int, slot: int) -> int:
+    """Absolute position of field `slot` (0-based), or 0 if absent."""
+    vt = table_pos - _i32(b, table_pos)
+    vt_size = _u16(b, vt)
+    fo = 4 + 2 * slot
+    if fo >= vt_size:
+        return 0
+    rel = _u16(b, vt + fo)
+    return table_pos + rel if rel else 0
+
+
+def fb_indirect(b: bytes, pos: int) -> int:
+    """Follow a uoffset at pos."""
+    return pos + _i32(b, pos)
+
+
+def read_batch_message(msg: bytes) -> Tuple[int, List[Tuple[int, int]],
+                                            List[Tuple[int, int]]]:
+    """Parse an encapsulated record-batch MESSAGE (8-byte prefix + meta):
+    -> (num_rows, field_nodes [(length, null_count)], buffers
+    [(offset, length)]).  Org.apache.arrow.flatbuf schema: Message
+    {version:0, header_type:1, header:2, bodyLength:3}; RecordBatch
+    {length:0, nodes:1, buffers:2}."""
+    meta = msg[8:]
+    root = fb_indirect(meta, 0)
+    header = fb_field(meta, root, 2)
+    assert header, "message without header"
+    batch = fb_indirect(meta, header)
+    length_pos = fb_field(meta, batch, 0)
+    num_rows = _i64(meta, length_pos) if length_pos else 0
+    nodes_pos = fb_field(meta, batch, 1)
+    nodes: List[Tuple[int, int]] = []
+    if nodes_pos:
+        vec = fb_indirect(meta, nodes_pos)
+        n = _i32(meta, vec)
+        for i in range(n):               # FieldNode struct: 2 x int64
+            base = vec + 4 + i * 16
+            nodes.append((_i64(meta, base), _i64(meta, base + 8)))
+    bufs_pos = fb_field(meta, batch, 2)
+    bufs: List[Tuple[int, int]] = []
+    if bufs_pos:
+        vec = fb_indirect(meta, bufs_pos)
+        n = _i32(meta, vec)
+        for i in range(n):               # Buffer struct: 2 x int64
+            base = vec + 4 + i * 16
+            bufs.append((_i64(meta, base), _i64(meta, base + 8)))
+    return num_rows, nodes, bufs
+
+
+def _msg_body_length(meta: bytes) -> int:
+    root = fb_indirect(meta, 0)
+    blen_pos = fb_field(meta, root, 3)
+    return _i64(meta, blen_pos) if blen_pos else 0
+
+
+def read_ksc_result(stream: bytes) -> Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray]:
+    """The Java client's read path for the agg result schema
+    (k int64, s float64, c int64), nullable columns: parse every
+    record-batch message in an IPC stream body-by-buffer (validity
+    buffers honored) and concatenate."""
+    off = 0
+    ks, ss, cs = [], [], []
+    first = True
+    while off < len(stream):
+        cont, mlen = struct.unpack_from("<Ii", stream, off)
+        assert cont == 0xFFFFFFFF
+        if mlen == 0:
+            break
+        meta_end = off + 8 + mlen
+        msg = stream[off:meta_end]
+        body_len = _msg_body_length(stream[off + 8:meta_end])
+        if first:                        # schema message
+            first = False
+            off = meta_end + body_len
+            continue
+        body = stream[meta_end:meta_end + body_len]
+        num_rows, nodes, bufs = read_batch_message(msg)
+        # 3 columns x (validity, data)
+        cols = []
+        for ci, np_dtype in enumerate(("<i8", "<f8", "<i8")):
+            v_off, v_len = bufs[2 * ci]
+            d_off, d_len = bufs[2 * ci + 1]
+            data = np.frombuffer(body, np_dtype, count=num_rows,
+                                 offset=d_off)
+            n_null = nodes[ci][1]
+            if v_len and n_null:
+                bits = np.frombuffer(body, np.uint8,
+                                     count=(num_rows + 7) // 8,
+                                     offset=v_off)
+                valid = np.unpackbits(bits, bitorder="little")[:num_rows]
+                data = np.where(valid.astype(bool), data, 0)
+            cols.append(data)
+        ks.append(cols[0]); ss.append(cols[1]); cs.append(cols[2])
+        off = meta_end + body_len
+    cat = (np.concatenate(ks) if ks else np.zeros(0, np.int64),
+           np.concatenate(ss) if ss else np.zeros(0, np.float64),
+           np.concatenate(cs) if cs else np.zeros(0, np.int64))
+    return cat
+
+
+def write_templates(out_dir: str, n_rows: int = 1000) -> None:
+    """Emit the template segments AuronEngineClient.java loads:
+    schema_msg.bin / batch_meta.bin / eos.bin / meta.txt."""
+    import os
+    os.makedirs(out_dir, exist_ok=True)
+    schema_msg, batch_meta, body_len, eos = ipc_segments(n_rows)
+    for name, data in (("schema_msg.bin", schema_msg),
+                       ("batch_meta.bin", batch_meta), ("eos.bin", eos)):
+        with open(os.path.join(out_dir, name), "wb") as f:
+            f.write(data)
+    with open(os.path.join(out_dir, "meta.txt"), "w") as f:
+        f.write(f"{n_rows} {body_len}\n")
+
+
+if __name__ == "__main__":
+    import sys
+    write_templates(sys.argv[1] if len(sys.argv) > 1 else "ipc_templates")

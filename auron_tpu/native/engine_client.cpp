@@ -188,12 +188,13 @@ std::string agg_expr(const std::string& fn, const std::string& child,
          "\",\"return_type\":{\"@type\":\"" + rtype + "\"},\"udaf\":null}";
 }
 
-std::string agg_over_ffi(const std::string& rid) {
-  // Agg(single, group by k, sum(v) + count(v)) over FFIReader(rid) —
-  // the C++ analogue of the JVM building its protobuf plan
+std::string agg_over_ffi(const std::string& rid,
+                         const std::string& sum_child) {
+  // Agg(single, group by k, sum(sum_child) + count(v)) over
+  // FFIReader(rid) — the C++ analogue of the JVM building its plan
   std::ostringstream p;
   p << "{\"@kind\":\"agg\",\"agg_names\":[\"s\",\"c\"],\"aggs\":["
-    << agg_expr("sum", col_ref("v"), "FLOAT64") << ","
+    << agg_expr("sum", sum_child, "FLOAT64") << ","
     << agg_expr("count", col_ref("v"), "INT64")
     << "],\"child\":{\"@kind\":\"ffi_reader\",\"resource_id\":\"" << rid
     << "\",\"schema\":{\"@schema\":[{\"@field\":\"k\",\"dtype\":"
@@ -202,6 +203,10 @@ std::string agg_over_ffi(const std::string& rid) {
        "\"exec_mode\":\"single\",\"grouping\":[" << col_ref("k")
     << "],\"grouping_names\":[\"k\"],\"supports_partial_skipping\":false}";
   return p.str();
+}
+
+std::string agg_over_ffi(const std::string& rid) {
+  return agg_over_ffi(rid, col_ref("v"));
 }
 
 std::string wire_udf_affine(const std::string& arg_col) {
@@ -216,21 +221,6 @@ std::string wire_udf_affine(const std::string& arg_col) {
          "{\"@type\":\"FLOAT64\"}}},\"op\":\"+\",\"right\":{\"@kind\":"
          "\"literal\",\"value\":1.0,\"dtype\":{\"@type\":\"FLOAT64\"}}},"
          "\"args\":[" + col_ref(arg_col) + "]}";
-}
-
-std::string agg_udf_over_ffi(const std::string& rid) {
-  // Agg(single, group by k, sum(udf(v)) + count(v)) over FFIReader(rid)
-  std::ostringstream p;
-  p << "{\"@kind\":\"agg\",\"agg_names\":[\"s\",\"c\"],\"aggs\":["
-    << agg_expr("sum", wire_udf_affine("v"), "FLOAT64") << ","
-    << agg_expr("count", col_ref("v"), "INT64")
-    << "],\"child\":{\"@kind\":\"ffi_reader\",\"resource_id\":\"" << rid
-    << "\",\"schema\":{\"@schema\":[{\"@field\":\"k\",\"dtype\":"
-       "{\"@type\":\"INT64\"},\"nullable\":true},{\"@field\":\"v\","
-       "\"dtype\":{\"@type\":\"FLOAT64\"},\"nullable\":true}]}},"
-       "\"exec_mode\":\"single\",\"grouping\":[" << col_ref("k")
-    << "],\"grouping_names\":[\"k\"],\"supports_partial_skipping\":false}";
-  return p.str();
 }
 
 std::string task_definition(const std::string& plan) {
@@ -362,7 +352,8 @@ int main(int argc, char** argv) {
   //    host ships udf(x)=2x+1 inside the plan and verifies sum(udf(v))
   {
     ExecResult ur = run_execute(
-        fd, task_definition(agg_udf_over_ffi("cppsrc")), "", "");
+        fd, task_definition(agg_over_ffi("cppsrc", wire_udf_affine("v"))),
+        "", "");
     if (ur.error) die("wire_udf execute failed: " + ur.error_message);
     double sum_s = 0.0;
     int64_t sum_c = 0, groups = 0;

@@ -151,8 +151,12 @@ class AggExec(Operator, MemConsumer):
         from auron_tpu.ops.kernel_cache import cached_jit
         specs, orders = self.specs, self._key_orders()
         nk = len(self.grouping)
+        from auron_tpu.ops.sort_keys import multipass_enabled
         key = ("agg.group_reduce", self._spec_struct_key(), orders, merge,
-               nk, strategy)
+               nk, strategy,
+               # trace-time config the sort body reads: a flag flip must
+               # not reuse a kernel traced under the old lexsort form
+               multipass_enabled())
 
         def build():
             body = _group_reduce_body_hash if strategy == "hash" \
@@ -184,7 +188,8 @@ class AggExec(Operator, MemConsumer):
             return self._reduce_kernel(merge)(keys, vcols, live)
         orders = self._key_orders()
         nk = len(self.grouping)
-        base = cached_jit(("agg.sort_base", orders, nk),
+        from auron_tpu.ops.sort_keys import multipass_enabled
+        base = cached_jit(("agg.sort_base", orders, nk, multipass_enabled()),
                           lambda: _sort_base_builder(orders))
         perm, seg, n_groups, key_out = base(keys, live)
         out_cols: List[Any] = list(key_out)

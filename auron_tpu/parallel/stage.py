@@ -1400,6 +1400,7 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         float(_conf.get("auron.spmd.exchange.quota.margin")),
         bool(_conf.get("auron.string.ascii.case.enable")),
         bool(_conf.get("auron.segments.sorted.enable")),
+        str(_conf.get("auron.sort.multipass.enable")),
         bool(_conf.get("auron.pallas.enable")),
         str(_conf.get("auron.agg.grouping.strategy")),
         int(_conf.get("auron.string.device.max.width")),

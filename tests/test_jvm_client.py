@@ -1,0 +1,69 @@
+"""JVM host driving the engine boundary (VERDICT r3 #8).
+
+Two layers:
+- the Arrow-IPC byte algorithms the Java client transliterates
+  (template splice + minimal flatbuffer reader) validate here against
+  REAL pyarrow streams — these always run;
+- the end-to-end Java client (compile with javac, drive the live TCP
+  service, verify results incl. a wire_udf plan) runs when a JDK is
+  present (gated, like the reference's JVM-first CI).
+"""
+
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.jvm import ipc_template as T
+
+JAVA_SRC = __file__.rsplit("/", 2)[0] + "/auron_tpu/jvm/AuronEngineClient.java"
+
+
+def test_template_splice_readable_by_pyarrow():
+    schema_msg, batch_meta, body_len, eos = T.ipc_segments(1000)
+    k = (np.arange(1000) % 8).astype(np.int64)
+    v = k * 1.5 + 1.0
+    stream = T.splice_body(schema_msg, batch_meta, eos, k, v, body_len)
+    [rb] = list(pa.ipc.open_stream(stream))
+    assert rb.num_rows == 1000
+    assert rb.column("k").to_pylist() == k.tolist()
+    assert np.allclose(rb.column("v").to_numpy(), v)
+
+
+def test_flatbuffer_reader_parses_pyarrow_stream():
+    out = pa.record_batch({
+        "k": pa.array([1, 2, None], type=pa.int64()),
+        "s": pa.array([1.5, None, 3.25]),
+        "c": pa.array([10, 20, 30], type=pa.int64())})
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, out.schema) as w:
+        w.write_batch(out)
+        w.write_batch(out)               # multi-batch stream
+    ks, ss, cs = T.read_ksc_result(sink.getvalue().to_pybytes())
+    assert ks.tolist() == [1, 2, 0] * 2
+    assert cs.tolist() == [10, 20, 30] * 2
+    assert np.allclose(ss, [1.5, 0.0, 3.25] * 2)
+
+
+@pytest.mark.skipif(shutil.which("javac") is None or
+                    shutil.which("java") is None,
+                    reason="no JDK in this environment")
+def test_java_client_drives_engine_service(tmp_path):
+    from auron_tpu.service.engine import EngineServer
+
+    T.write_templates(str(tmp_path / "tmpl"))
+    subprocess.run(["javac", "-d", str(tmp_path), JAVA_SRC], check=True)
+    server = EngineServer().start()
+    try:
+        host, port = server.address
+        out = subprocess.run(
+            ["java", "-cp", str(tmp_path), "AuronEngineClient",
+             host, str(port), str(tmp_path / "tmpl")],
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "JVM_CLIENT_OK" in out.stdout
+    finally:
+        server.stop()
