@@ -290,6 +290,16 @@ SPMD_AGG_CAPACITY_HINT = conf.define(
     "capacity (the working shape is remembered per program).  0 "
     "disables.",
 )
+SPMD_JOIN_COMPACT = conf.define(
+    "auron.spmd.join.compact.enable", True,
+    "Compact K-expanded SPMD join outputs back to the pre-expansion "
+    "capacity (stable front-compaction of live rows): a join CHAIN "
+    "then stays at the probe capacity instead of growing K-fold per "
+    "join (a 5-join chain at K=4 otherwise pays 4^5=1024x row "
+    "capacity).  A join whose live output genuinely exceeds the "
+    "target trips a runtime guard and the query retries with "
+    "compaction off (independent of the agg shrink retry).",
+)
 SPMD_SOURCE_CACHE_MB = conf.define(
     "auron.spmd.source.cache.mb", 4096,
     "Device-byte budget (MB) for the SPMD source shard cache: sharded + "

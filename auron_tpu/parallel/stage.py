@@ -68,10 +68,13 @@ class SpmdGuardTripped(SpmdUnsupported):
     under a semi-like join) fall straight back to the serial engine."""
 
     def __init__(self, message: str, retryable: bool = False,
-                 shrink: bool = False):
+                 shrink: bool = False, join_compact: bool = False):
         super().__init__(message)
         self.retryable = retryable
         self.shrink = shrink
+        # the join-chain compaction overflowed: retry with compaction
+        # disabled (independent of the agg shrink dimension)
+        self.join_compact = join_compact
 
 
 @dataclass
@@ -98,7 +101,8 @@ class _StageTracer:
                  axis_sizes: Optional[Tuple[int, ...]] = None,
                  match_factor: int = 1,
                  agg_cap_hint: int = 0,
-                 hash_grouping: bool = False):
+                 hash_grouping: bool = False,
+                 join_compact: bool = True):
         self.exchanges = getattr(conv_ctx, "exchanges", None) or {}
         self.broadcasts = getattr(conv_ctx, "broadcasts", None) or {}
         self.bindings = bindings
@@ -124,10 +128,17 @@ class _StageTracer:
         # shrunk static capacity (auron.spmd.agg.capacity.hint); the
         # driver retries once with shrinking disabled (full capacity).
         self.shrink_guards: List[Any] = []
+        # `join_guards` trip when a K-expanded join's live output
+        # overflows the compaction target; the driver retries with join
+        # compaction disabled — an INDEPENDENT retry dimension so a
+        # genuinely fanning-out join doesn't also lose the agg shrink
+        self.join_guards: List[Any] = []
         # join pair-expansion factor (1 = single-candidate probe)
         self.match_factor = max(1, int(match_factor))
         # post-agg static capacity (rows/device); 0 keeps input capacity
         self.agg_cap_hint = max(0, int(agg_cap_hint))
+        # compact K-expanded join outputs back to pre-expansion capacity
+        self.join_compact = bool(join_compact)
         # hash-table group reduce (CPU mesh only — mirrors
         # AggExec._grouping_strategy: XLA's comparator sort is ~3x numpy
         # on CPU; on TPU scatters serialize and sort wins)
@@ -636,6 +647,27 @@ class _StageTracer:
             else probe.live
         return DeviceTable(schema, out_cols, live)
 
+    def _compact_live(self, t: DeviceTable, new_cap: int) -> DeviceTable:
+        """Stable-compact live rows to the front and cut capacity to
+        new_cap (a join-guard trips past it -> compaction-off retry).
+        Applied after K-expanded joins so a JOIN CHAIN stays near the
+        original probe capacity instead of growing K-fold per join
+        (q85r's 5-join chain at K=4 otherwise pays 4^5 = 1024x row
+        capacity — measured 107s warm for 10 output rows).  The stable
+        sort preserves live-row order, so per-device limit prefixes are
+        unchanged."""
+        if not self.join_compact or new_cap >= t.capacity:
+            return t
+        n_live = jnp.sum(t.live.astype(jnp.int32))
+        self.join_guards.append(
+            lax.psum((n_live > new_cap).astype(jnp.int32),
+                     self.axis) > 0)
+        perm = jnp.argsort(jnp.logical_not(t.live),
+                           stable=True).astype(jnp.int32)[:new_cap]
+        ok = jnp.take(t.live, perm)
+        cols = [c.gather(perm, ok) for c in t.cols]
+        return DeviceTable(t.schema, cols, ok)
+
     def _join_expanded(self, probe, build, pkeys, bkeys, order,
                        sorted_bh, ph, join_type, existence_name, K: int):
         """K-way pair expansion: every probe row probes its full hash
@@ -675,16 +707,24 @@ class _StageTracer:
         emit_unmatched = jnp.logical_and(
             jnp.logical_and(j == 0, probe_live_r),
             jnp.logical_not(jnp.take(matched_any, i)))
+        # compact back to the pre-expansion capacity (join-guarded; a
+        # genuine fan-out past it retries with compaction off)
         if join_type == "inner":
-            return DeviceTable(schema, out_cols, ok)
+            return self._compact_live(
+                DeviceTable(schema, out_cols, ok), cap)
         if join_type == "left":
-            return DeviceTable(schema, out_cols,
-                               jnp.logical_or(ok, emit_unmatched))
-        # full / right
+            return self._compact_live(
+                DeviceTable(schema, out_cols,
+                            jnp.logical_or(ok, emit_unmatched)),
+                cap)
+        # full / right: the outer tail appends build.capacity unmatched
+        # slots, so the target must cover probe + build rows
         live1 = jnp.logical_or(ok, emit_unmatched) \
             if join_type == "full" else ok
-        return self._join_outer_tail(schema, probe, build, out_cols, ok,
-                                     bidx, live1)
+        return self._compact_live(
+            self._join_outer_tail(schema, probe, build, out_cols, ok,
+                                  bidx, live1),
+            bucket_capacity(cap + build.capacity))
 
     # sort / limit -------------------------------------------------------
     #
@@ -1193,21 +1233,31 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     cap_hint = int(_conf.get("auron.spmd.agg.capacity.hint"))
     shrink_key = (hint_key, cap_hint)
     shrink = cap_hint > 0 and not _SHRINK_OFF_HINT.get(shrink_key, False)
+    join_compact = bool(_conf.get("auron.spmd.join.compact.enable")) \
+        and not _JOIN_COMPACT_OFF_HINT.get(hint_key, False)
     # at most one retry per independent guard dimension (match factor,
-    # agg shrink); hints remember the working combination per canonical
-    # program so repeat executes skip the trip-then-retry double run
-    for _attempt in range(3):
+    # agg shrink, join compaction); hints remember the working
+    # combination per canonical program so repeat executes skip the
+    # trip-then-retry double run
+    for _attempt in range(4):
         try:
             out = _execute_plan_spmd_once(plan, conv_ctx, mesh,
                                           source_tables, axis,
                                           match_factor=match,
-                                          agg_shrink=shrink)
+                                          agg_shrink=shrink,
+                                          join_compact=join_compact)
             if match > 1:
                 _MATCH_FACTOR_HINT[hint_key] = match
             if cap_hint > 0 and not shrink:
                 _SHRINK_OFF_HINT[shrink_key] = True
+            if bool(_conf.get("auron.spmd.join.compact.enable")) and \
+                    not join_compact:
+                _JOIN_COMPACT_OFF_HINT[hint_key] = True
             return out
         except SpmdGuardTripped as e:
+            if e.join_compact and join_compact:
+                join_compact = False
+                continue
             if e.shrink and shrink:
                 shrink = False
                 continue
@@ -1309,7 +1359,8 @@ def _canonicalize_rids(plan, conv_ctx, source_tables):
 
 def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                             source_tables: Dict[str, Any], axis,
-                            match_factor: int, agg_shrink: bool = True):
+                            match_factor: int, agg_shrink: bool = True,
+                            join_compact: bool = True):
     import dataclasses
 
     import pyarrow as pa
@@ -1391,7 +1442,7 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         np.asarray(mesh.devices).flat[0].platform == "cpu" and
         str(_conf.get("auron.agg.grouping.strategy")) in ("auto", "hash"))
     cache_key = (
-        plan, axis, n_dev, match_factor, agg_cap_hint,
+        plan, axis, n_dev, match_factor, agg_cap_hint, join_compact,
         _mesh_fingerprint(mesh),
         # EVERY config the tracer (or kernels it calls) reads at trace
         # time must appear here: rid canonicalization makes equal plans
@@ -1431,7 +1482,8 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                                   axis_sizes=axis_sizes,
                                   match_factor=match_factor,
                                   agg_cap_hint=agg_cap_hint,
-                                  hash_grouping=hash_grouping)
+                                  hash_grouping=hash_grouping,
+                                  join_compact=join_compact)
             out = tracer.eval_node(plan)
             if not schema_box:
                 schema_box.append(out.schema)
@@ -1441,31 +1493,39 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                 if tracer.retry_guards else jnp.zeros(0, bool)
             shrink_guards = jnp.stack(tracer.shrink_guards) \
                 if tracer.shrink_guards else jnp.zeros(0, bool)
-            return out.cols, out.live, guards, retry_guards, shrink_guards
+            join_guards = jnp.stack(tracer.join_guards) \
+                if tracer.join_guards else jnp.zeros(0, bool)
+            return (out.cols, out.live, guards, retry_guards,
+                    shrink_guards, join_guards)
 
         shard = jax.jit(jax.shard_map(
             program, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: PS(axis), host_inputs),),
-            out_specs=(PS(axis), PS(axis), PS(), PS(), PS()),
+            out_specs=(PS(axis), PS(axis), PS(), PS(), PS(), PS()),
             check_vma=False))
     else:
         shard, schema_box = cached
 
-    out_cols, out_live, guards, retry_guards, shrink_guards = \
-        shard(host_inputs)
+    (out_cols, out_live, guards, retry_guards, shrink_guards,
+     join_guards) = shard(host_inputs)
     if cached is None:
         _PROGRAM_CACHE[cache_key] = (shard, schema_box)
     out_schema = schema_box[0]
 
     # gather + compact on host (one batched fetch, guards included)
     from auron_tpu.ops.kernel_cache import host_sync
-    out_live_np, out_cols_np, guards_np, retry_np, shrink_np = host_sync(
-        (out_live, out_cols, guards, retry_guards, shrink_guards))
+    (out_live_np, out_cols_np, guards_np, retry_np, shrink_np,
+     join_np) = host_sync((out_live, out_cols, guards, retry_guards,
+                           shrink_guards, join_guards))
     if np.any(np.asarray(guards_np)):
         raise SpmdGuardTripped(
             "runtime guard tripped (exchange quota overflow, or "
             f"duplicate build keys past match factor {match_factor}): "
             "result discarded", retryable=False)
+    if np.any(np.asarray(join_np)):
+        raise SpmdGuardTripped(
+            "join output overflowed the compaction target (genuine "
+            "fan-out): result discarded", join_compact=True)
     if np.any(np.asarray(shrink_np)):
         raise SpmdGuardTripped(
             f"agg group count overflowed the capacity hint "
@@ -1535,6 +1595,9 @@ _MATCH_FACTOR_HINT: Dict[Any, int] = {}
 # canonical plan -> True when the agg capacity shrink overflowed and the
 # full-capacity retry succeeded (skip the shrink next time)
 _SHRINK_OFF_HINT: Dict[Any, bool] = {}
+# canonical plan -> True when the join compaction overflowed and the
+# compaction-off retry succeeded
+_JOIN_COMPACT_OFF_HINT: Dict[Any, bool] = {}
 
 # node kinds the tracer can (conditionally) express; anything else is
 # rejected by precheck_plan before source materialization

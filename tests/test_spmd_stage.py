@@ -884,3 +884,45 @@ def test_spmd_semi_like_joins_with_duplicate_build_keys():
             join_type=jt_ir, broadcast_side="right")
         exp = _serial_reference(serial, {"factD": fact, "dupD": dup})
         assert _canon(got) == _canon(exp), jt
+
+
+def test_expanded_join_compaction_and_fanout_retry():
+    """K-expanded joins compact back to probe capacity (the q85r
+    1024x-chain fix); a join that GENUINELY fans out past the target
+    trips the join guard and retries with compaction off — correct rows
+    either way, and the off-hint is remembered per program."""
+    import auron_tpu.parallel.stage as S
+
+    # per-device rows land EXACTLY on a capacity bucket (8192/8 = 1024),
+    # so a 2x fan-out overflows the compaction target for sure
+    n = 8192
+    rng = np.random.default_rng(23)
+    # every probe row matches exactly 2 build rows -> live output
+    # 2n > probe capacity -> fan-out
+    probe = pa.table({"k": rng.integers(0, 64, n).astype(np.int64),
+                      "v": rng.normal(0, 1, n).astype(np.float64)})
+    bk = np.repeat(np.arange(64, dtype=np.int64), 2)
+    build = pa.table({"bk": bk, "w": np.arange(len(bk), dtype=np.float64)})
+    mesh = data_mesh(8)
+    ctx = _Ctx()
+    ctx.exchanges = {}
+    from auron_tpu.frontend.converters import BroadcastJob
+    ctx.broadcasts = {"b": BroadcastJob(
+        rid="b", child=P.FFIReader(schema=from_arrow_schema(build.schema),
+                                   resource_id="build"), schema=None)}
+    join = P.BroadcastJoin(
+        left=P.FFIReader(schema=from_arrow_schema(probe.schema),
+                         resource_id="probe"),
+        right=P.IpcReader(schema=None, resource_id="b"),
+        on=P.JoinOn(left_keys=(col("k"),), right_keys=(col("bk"),)),
+        join_type="inner", broadcast_side="right")
+    out = execute_plan_spmd(join, ctx, mesh,
+                            {"probe": probe, "build": build})
+    assert out.num_rows == 2 * n        # every row matches 2 build rows
+    got = sorted(zip(out.column("k").to_pylist(),
+                     out.column("w").to_pylist()))
+    exp = sorted((int(k), float(w)) for k in probe.column("k").to_numpy()
+                 for w in (2 * int(k), 2 * int(k) + 1))
+    assert got == exp
+    # the fan-out tripped the compaction guard and the off-hint stuck
+    assert any(S._JOIN_COMPACT_OFF_HINT.values())

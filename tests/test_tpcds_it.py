@@ -29,19 +29,13 @@ def small_catalog(tmp_path_factory):
 
 @pytest.fixture(scope="module")
 def runner(catalog):
-    r = QueryRunner(catalog=catalog, perf_factor=3.0, perf_waivers={
-        # three chained SMJs over six exchanges: warm wall time is
-        # orchestration-bound (~2-3.5s vs a 0.3s oracle) and
-        # high-variance on shared CI hosts; correctness still runs
-        "q25m": "exchange-heaviest query; warm time is fixed-cost bound",
-        # same shape: three channel SMJ-anti pipelines + a ratio join —
-        # measured 3.4x on a quiet host, exchange fixed costs dominate
-        "q78n": "SMJ/anti-chain query; warm time is fixed-cost bound",
-        # the deepest SMJ chain in the corpus (aggregated self-join over
-        # two year branches); warm sits at the 2.4s budget boundary and
-        # flakes 2.0-3.1s with host load — fixed-cost bound, not compute
-        "q64x": "deepest SMJ-chain query; warm time is fixed-cost bound",
-    })
+    # round 4: the stage path (default on) + device-resident source
+    # caching killed the per-execute fixed cost the old 0.8s floor and
+    # the three SMJ-chain waivers excused (corpus median warm/oracle
+    # fell 1.65x -> 0.25x) — the gate now binds at 3x the ACTUAL oracle
+    # for effectively the whole corpus, with an empty waiver list
+    r = QueryRunner(catalog=catalog, perf_factor=3.0, perf_floor_s=0.2,
+                    perf_waivers={})
     yield r
     # per-query perf artifact for the driver to archive (VERDICT r2 #8):
     # native/oracle/warm seconds per corpus query
