@@ -417,6 +417,14 @@ def _run_worker(mode: str, env_extra=None, timeout=WORKER_TIMEOUT_S
                 ) -> dict:
     env = dict(os.environ)
     env.update(env_extra or {})
+    # persistent XLA compile cache: device compiles on the congested
+    # shared tunnel take minutes, and each worker is a fresh process —
+    # without this every bench run re-pays every compile (the round-4
+    # spmd worker needed ~28 min cold, ~none warm)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
     p = subprocess.Popen([sys.executable, os.path.abspath(__file__),
                           "--worker", mode],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
