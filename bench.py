@@ -420,11 +420,15 @@ def _run_worker(mode: str, env_extra=None, timeout=WORKER_TIMEOUT_S
     # persistent XLA compile cache: device compiles on the congested
     # shared tunnel take minutes, and each worker is a fresh process —
     # without this every bench run re-pays every compile (the round-4
-    # spmd worker needed ~28 min cold, ~none warm)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".jax_cache"))
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    # spmd worker needed ~28 min cold, ~none warm).  CPU-forced workers
+    # skip it (thousands of tiny fast programs — same policy as the IT
+    # CLI's platform gate)
+    if not env.get("AURON_BENCH_FORCE_CPU"):
+        env.setdefault(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
     p = subprocess.Popen([sys.executable, os.path.abspath(__file__),
                           "--worker", mode],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,

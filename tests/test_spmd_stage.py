@@ -926,3 +926,53 @@ def test_expanded_join_compaction_and_fanout_retry():
     assert got == exp
     # the fan-out tripped the compaction guard and the off-hint stuck
     assert any(S._JOIN_COMPACT_OFF_HINT.values())
+
+
+def test_source_cache_budget_zero_flushes_and_scan_fp_invalidates(tmp_path):
+    """Round-4 cache semantics: lowering auron.spmd.source.cache.mb to 0
+    releases retained device shards on the next lookup (memory-pressure
+    contract), and a rewritten scan file never serves a stale cached
+    table (pre-read fingerprint)."""
+    import pyarrow.parquet as pq
+
+    import auron_tpu.parallel.stage as S
+    from auron_tpu.config import conf
+
+    S.clear_source_caches()
+    t = pa.table({"k": np.arange(100, dtype=np.int64),
+                  "v": np.arange(100, dtype=np.float64)})
+    mesh = data_mesh(8)
+    ctx = _Ctx(); ctx.exchanges = {}; ctx.broadcasts = {}
+    proj = P.Projection(
+        child=P.FFIReader(schema=from_arrow_schema(t.schema),
+                          resource_id="t"),
+        exprs=(col("k"),), names=("k",))
+    execute_plan_spmd(proj, ctx, mesh, {"t": t})
+    assert len(S._DEVICE_SHARDS._entries) == 1
+    with conf.scoped({"auron.spmd.source.cache.mb": 0}):
+        # a lookup under budget 0 flushes the retained entries
+        assert S._DEVICE_SHARDS.get(t) is None
+        assert len(S._DEVICE_SHARDS._entries) == 0
+
+    # scan fingerprint: rewrite the file between executes -> re-read
+    path = str(tmp_path / "scan.parquet")
+    pq.write_table(pa.table({"a": np.arange(5, dtype=np.int64)}), path)
+    from auron_tpu.ir.plan import FileGroup
+    from auron_tpu.ir.schema import DataType, Field, Schema
+    scan = P.ParquetScan(
+        schema=Schema((Field("a", DataType.int64()),)),
+        file_groups=(FileGroup(paths=(path,)),))
+    sctx = _Ctx(); sctx.exchanges = {}; sctx.broadcasts = {}
+    out1 = execute_plan_spmd(
+        P.Projection(child=scan, exprs=(col("a"),), names=("a",)),
+        sctx, mesh, {})
+    assert sorted(out1.column("a").to_pylist()) == list(range(5))
+    import os, time as _t
+    _t.sleep(0.01)
+    pq.write_table(pa.table({"a": np.arange(7, dtype=np.int64)}), path)
+    sctx2 = _Ctx(); sctx2.exchanges = {}; sctx2.broadcasts = {}
+    out2 = execute_plan_spmd(
+        P.Projection(child=scan, exprs=(col("a"),), names=("a",)),
+        sctx2, mesh, {})
+    assert sorted(out2.column("a").to_pylist()) == list(range(7)), \
+        "stale scan table served after the file changed"
