@@ -95,9 +95,66 @@ def _write_chunks(out_dir: str, name: str, table: pa.Table,
     return TableDef(name=name, schema=from_arrow_schema(arrow), chunks=paths)
 
 
+def _manifest_path(data_dir: str) -> str:
+    return os.path.join(data_dir, "_MANIFEST.json")
+
+
+# bump when the generator's tables/columns/shapes change: persistent data
+# dirs from older code must regenerate, not serve stale data
+_DATAGEN_VERSION = 1
+
+
+def _load_cached(data_dir: str, sf: float, seed: int,
+                 fact_chunks: int) -> Optional[Catalog]:
+    """Reuse an existing generated dir when its manifest matches the
+    requested parameters and every chunk file still exists — sf>=1
+    generation takes tens of minutes of single-core Python, so repeat
+    runs (subsets, reruns after a kill) must not pay it twice."""
+    import json
+    try:
+        with open(_manifest_path(data_dir)) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (m.get("sf"), m.get("seed"), m.get("fact_chunks"),
+            m.get("version")) != (sf, seed, fact_chunks,
+                                  _DATAGEN_VERSION):
+        return None
+    from auron_tpu.ir.schema import from_arrow_schema
+    cat = Catalog(data_dir=data_dir)
+    for name, chunks in m.get("tables", {}).items():
+        if not chunks or not all(os.path.exists(p) for p in chunks):
+            return None
+        cat.tables[name] = TableDef(
+            name=name, schema=from_arrow_schema(pq.read_schema(chunks[0])),
+            chunks=list(chunks))
+    return cat if cat.tables else None
+
+
+def _write_manifest(cat: Catalog, sf: float, seed: int,
+                    fact_chunks: int) -> None:
+    import json
+    with open(_manifest_path(cat.data_dir), "w") as f:
+        json.dump({"sf": sf, "seed": seed, "fact_chunks": fact_chunks,
+                   "version": _DATAGEN_VERSION,
+                   "tables": {n: t.chunks
+                              for n, t in cat.tables.items()}}, f)
+
+
 def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
              fact_chunks: int = 4) -> Catalog:
-    """Generate the star schema at scale factor `sf` into data_dir."""
+    """Generate the star schema at scale factor `sf` into data_dir.
+    A matching previously-generated dir (manifest-verified) is reused
+    as-is."""
+    cached = _load_cached(data_dir, sf, seed, fact_chunks)
+    if cached is not None:
+        return cached
+    # a kill mid-regeneration must not leave an older manifest pointing
+    # at partially overwritten chunks
+    try:
+        os.remove(_manifest_path(data_dir))
+    except OSError:
+        pass
     rng = np.random.default_rng(seed)
     cat = Catalog(data_dir=data_dir)
 
@@ -455,4 +512,5 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     cat.tables["inventory"] = _write_chunks(
         data_dir, "inventory", inv, fact_chunks)
 
+    _write_manifest(cat, sf, seed, fact_chunks)
     return cat

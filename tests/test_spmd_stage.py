@@ -967,7 +967,7 @@ def test_source_cache_budget_zero_flushes_and_scan_fp_invalidates(tmp_path):
         P.Projection(child=scan, exprs=(col("a"),), names=("a",)),
         sctx, mesh, {})
     assert sorted(out1.column("a").to_pylist()) == list(range(5))
-    import os, time as _t
+    import time as _t
     _t.sleep(0.01)
     pq.write_table(pa.table({"a": np.arange(7, dtype=np.int64)}), path)
     sctx2 = _Ctx(); sctx2.exchanges = {}; sctx2.broadcasts = {}
