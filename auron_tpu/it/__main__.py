@@ -71,11 +71,19 @@ def main() -> int:
         from auron_tpu.parallel.mesh import data_mesh
         runner.mesh = data_mesh(args.mesh)
     names = args.queries.split(",") if args.queries else None
-    runner.run_all(names)
+    # per-query incremental flush: a crash (an sf10 run OOMed at query
+    # ~90 of 103 and lost 2h of results) or a driver kill still leaves
+    # every completed query's record on disk
+    import json as _json
+    from auron_tpu.it import queries as _queries
+    for name in names or _queries.names():
+        r = runner.run(name)
+        line = {k: v for k, v in r.to_dict().items() if v is not None}
+        print(_json.dumps(line), flush=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(runner.to_json())
     print(runner.report())
-    if args.json:
-        with open(args.json, "w") as f:
-            f.write(runner.to_json())
     return 0 if all(r.ok for r in runner.results) else 1
 
 
