@@ -281,14 +281,14 @@ SORT_MULTIPASS = conf.define(
     "faster); 'on'/'off' force one form.",
 )
 SPMD_AGG_CAPACITY_HINT = conf.define(
-    "auron.spmd.agg.capacity.hint", 65536,
+    "auron.spmd.agg.capacity.hint", 262144,
     "Static per-device row capacity an SPMD agg output is cut down to "
     "(aggs are the cardinality reducers, but mask-liveness keeps input "
     "capacity — without the cut every downstream exchange/join/sort "
     "pays input-scale cost for a handful of groups).  More groups than "
-    "the hint trips a runtime guard and the query retries at full "
-    "capacity (the working shape is remembered per program).  0 "
-    "disables.",
+    "the hint trips a runtime guard and the query climbs a capacity "
+    "ladder: 4x the hint per retry up to 16x, then shrink disabled "
+    "(the working rung is remembered per program).  0 disables.",
 )
 SPMD_JOIN_COMPACT = conf.define(
     "auron.spmd.join.compact.enable", True,

@@ -119,12 +119,16 @@ def hash_bytes(data, lengths, seed):
     rows, w = data.shape
     seed = jnp.broadcast_to(jnp.asarray(seed, jnp.uint32), (rows,))
     nblocks = lengths // 4
-    d32 = data.astype(jnp.uint32)
+    # cast per byte-column slice, NOT the whole [rows, w] array: the
+    # full u32 cast is a 4x temp XLA keeps live across every block use
+    # (same sf10 OOM family as encode_key_column's u64 cast)
+    def d32(i):
+        return data[:, i].astype(jnp.uint32)
     h = seed
     # full 4-byte blocks: iterate static W//4 positions, masked per row
     for b in range(w // 4):
-        k = (d32[:, 4 * b] | (d32[:, 4 * b + 1] << 8)
-             | (d32[:, 4 * b + 2] << 16) | (d32[:, 4 * b + 3] << 24))
+        k = (d32(4 * b) | (d32(4 * b + 1) << 8)
+             | (d32(4 * b + 2) << 16) | (d32(4 * b + 3) << 24))
         nh = _mix_h1(h, _mix_k1(k))
         h = jnp.where(b < nblocks, nh, h)
     # tail bytes (signed), one at a time

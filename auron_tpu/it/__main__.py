@@ -73,16 +73,21 @@ def main() -> int:
     names = args.queries.split(",") if args.queries else None
     # per-query incremental flush: a crash (an sf10 run OOMed at query
     # ~90 of 103 and lost 2h of results) or a driver kill still leaves
-    # every completed query's record on disk
+    # every completed query's record on disk.  Atomic tmp+rename: a kill
+    # mid-write must not truncate the records already saved.
     import json as _json
-    from auron_tpu.it import queries as _queries
-    for name in names or _queries.names():
-        r = runner.run(name)
+    import os as _os
+
+    def flush(r):
         line = {k: v for k, v in r.to_dict().items() if v is not None}
         print(_json.dumps(line), flush=True)
         if args.json:
-            with open(args.json, "w") as f:
+            tmp = args.json + ".tmp"
+            with open(tmp, "w") as f:
                 f.write(runner.to_json())
+            _os.replace(tmp, args.json)
+
+    runner.run_all(names, on_result=flush)
     print(runner.report())
     return 0 if all(r.ok for r in runner.results) else 1
 

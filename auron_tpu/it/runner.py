@@ -135,10 +135,12 @@ class QueryRunner:
         jax.clear_caches()
         return qr
 
-    def run_all(self, names: Optional[List[str]] = None
-                ) -> List[QueryResult]:
+    def run_all(self, names: Optional[List[str]] = None,
+                on_result=None) -> List[QueryResult]:
         for name in names or queries.names():
-            self.run(name)
+            r = self.run(name)
+            if on_result is not None:
+                on_result(r)
         return self.results
 
     def report(self) -> str:

@@ -79,12 +79,17 @@ def encode_key_column(col, asc: bool = True, nulls_first: bool = True
     words: List[Any] = []
     if isinstance(col, DeviceStringColumn):
         w = col.width
-        d = col.data.astype(jnp.uint64)
+        # cast PER byte-column slice: a whole-array u64 cast of the
+        # [cap, w] u8 data materializes an 8x temp that XLA keeps live
+        # (it feeds w slices) — at sf10 shapes that one buffer family
+        # OOMed the host (135GB total temps for q21i's string group
+        # keys); per-slice casts fuse into the shift-or chain instead
+        d = col.data
         for blk in range(0, w, 8):
             word = jnp.zeros(col.capacity, jnp.uint64)
             for j in range(8):
-                byte = d[:, blk + j] if blk + j < w else \
-                    jnp.zeros(col.capacity, jnp.uint64)
+                byte = d[:, blk + j].astype(jnp.uint64) if blk + j < w \
+                    else jnp.zeros(col.capacity, jnp.uint64)
                 word = (word << 8) | byte
             words.append(word)
         words.append(col.lengths.astype(jnp.uint32))

@@ -63,9 +63,11 @@ class SpmdUnsupported(Exception):
 class SpmdGuardTripped(SpmdUnsupported):
     """A runtime guard invalidated the SPMD result.  `retryable` marks
     join duplicate-key trips a pair-expansion retry can fix; `shrink`
-    marks agg capacity-shrink overflows a full-capacity retry fixes;
-    hard trips (exchange quota overflow, dup keys past the factor or
-    under a semi-like join) fall straight back to the serial engine."""
+    marks agg capacity-shrink overflows the capacity LADDER retries
+    (4x per step, then shrink off); `join_compact` marks join-chain
+    compaction overflows a compaction-off retry fixes; hard trips
+    (exchange quota overflow, dup keys past the factor or under a
+    semi-like join) fall straight back to the serial engine."""
 
     def __init__(self, message: str, retryable: bool = False,
                  shrink: bool = False, join_compact: bool = False):
@@ -216,6 +218,12 @@ class _StageTracer:
 
     def _exchange(self, t: DeviceTable, part: P.Partitioning) -> DeviceTable:
         n_dev = self.n_dev
+        if n_dev == 1:
+            # single-device axis: every row already lives on its
+            # destination — the exchange is an identity, and the quota
+            # machinery would only DOUBLE the buffer (capacity x margin)
+            # for nothing (a real cost at sf10 single-chip shapes)
+            return t
         if part.mode == "hash":
             keys = self._eval_exprs(part.expressions, t)
             h = H.hash_columns(keys, seed=42)
@@ -468,7 +476,8 @@ class _StageTracer:
         / join / sort pays input-scale cost for a handful of groups
         (round-4 root cause of the stage path losing to serial at bench
         scale).  Overflow (more groups than the hint) trips a
-        shrink-guard; the driver retries with shrinking disabled."""
+        shrink-guard; the driver climbs a capacity ladder (4x per
+        retry, then shrink off)."""
         new_cap = bucket_capacity(self.agg_cap_hint) \
             if self.agg_cap_hint > 0 else 0
         if new_cap <= 0 or new_cap >= t.capacity:
@@ -1227,29 +1236,32 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                      for rid, job in conv_ctx.broadcasts.items())),
         tuple(mesh.shape.items()), k)
     match = _MATCH_FACTOR_HINT.get(hint_key, 1)
-    # the shrink-off hint embeds the CONFIGURED cap (like hint_key embeds
-    # k): raising auron.spmd.agg.capacity.hint after an overflow gives
-    # the shrink a fresh chance instead of staying off forever
+    # agg-shrink capacity LADDER: start at the configured hint; each
+    # overflow retries 4x wider (x16 max) before giving up the shrink
+    # entirely — a high-cardinality agg (q21i at sf10: 1M groups/device)
+    # then lands on a 1M-row buffer instead of reverting every
+    # downstream op to full input capacity (the 135GB OOM shape).  The
+    # key embeds the CONFIGURED cap so re-tuning it restarts the ladder.
     cap_hint = int(_conf.get("auron.spmd.agg.capacity.hint"))
     shrink_key = (hint_key, cap_hint)
-    shrink = cap_hint > 0 and not _SHRINK_OFF_HINT.get(shrink_key, False)
+    cap_eff = _SHRINK_HINT.get(shrink_key, cap_hint)
     join_compact = bool(_conf.get("auron.spmd.join.compact.enable")) \
         and not _JOIN_COMPACT_OFF_HINT.get(hint_key, False)
-    # at most one retry per independent guard dimension (match factor,
-    # agg shrink, join compaction); hints remember the working
-    # combination per canonical program so repeat executes skip the
-    # trip-then-retry double run
-    for _attempt in range(4):
+    # bounded retries across the independent guard dimensions (match
+    # factor, shrink ladder, join compaction); hints remember the
+    # working combination per canonical program so repeat executes skip
+    # the trip-then-retry runs
+    for _attempt in range(6):
         try:
             out = _execute_plan_spmd_once(plan, conv_ctx, mesh,
                                           source_tables, axis,
                                           match_factor=match,
-                                          agg_shrink=shrink,
+                                          agg_cap_hint=cap_eff,
                                           join_compact=join_compact)
             if match > 1:
                 _MATCH_FACTOR_HINT[hint_key] = match
-            if cap_hint > 0 and not shrink:
-                _SHRINK_OFF_HINT[shrink_key] = True
+            if cap_eff != cap_hint:
+                _SHRINK_HINT[shrink_key] = cap_eff
             if bool(_conf.get("auron.spmd.join.compact.enable")) and \
                     not join_compact:
                 _JOIN_COMPACT_OFF_HINT[hint_key] = True
@@ -1258,8 +1270,9 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
             if e.join_compact and join_compact:
                 join_compact = False
                 continue
-            if e.shrink and shrink:
-                shrink = False
+            if e.shrink and cap_eff > 0:
+                cap_eff = cap_eff * 4 \
+                    if cap_eff < cap_hint * 16 else 0
                 continue
             if e.retryable and match == 1 and k > 1:
                 match = k
@@ -1359,7 +1372,8 @@ def _canonicalize_rids(plan, conv_ctx, source_tables):
 
 def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                             source_tables: Dict[str, Any], axis,
-                            match_factor: int, agg_shrink: bool = True,
+                            match_factor: int,
+                            agg_cap_hint: Optional[int] = None,
                             join_compact: bool = True):
     import dataclasses
 
@@ -1436,8 +1450,8 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     # same input shapes reuse the compiled shard_map program (a fresh
     # jax.jit closure per call would re-trace+re-compile every time)
     from auron_tpu.config import conf as _conf
-    agg_cap_hint = int(_conf.get("auron.spmd.agg.capacity.hint")) \
-        if agg_shrink else 0
+    if agg_cap_hint is None:
+        agg_cap_hint = int(_conf.get("auron.spmd.agg.capacity.hint"))
     hash_grouping = (
         np.asarray(mesh.devices).flat[0].platform == "cpu" and
         str(_conf.get("auron.agg.grouping.strategy")) in ("auto", "hash"))
@@ -1592,9 +1606,9 @@ _PROGRAM_CACHE: Dict[Any, Any] = {}
 # canonical plan -> join match factor that last succeeded (see
 # execute_plan_spmd's retry)
 _MATCH_FACTOR_HINT: Dict[Any, int] = {}
-# canonical plan -> True when the agg capacity shrink overflowed and the
-# full-capacity retry succeeded (skip the shrink next time)
-_SHRINK_OFF_HINT: Dict[Any, bool] = {}
+# canonical plan -> effective agg capacity hint that last succeeded on
+# the shrink ladder (0 = shrink off); keyed with the configured hint
+_SHRINK_HINT: Dict[Any, int] = {}
 # canonical plan -> True when the join compaction overflowed and the
 # compaction-off retry succeeded
 _JOIN_COMPACT_OFF_HINT: Dict[Any, bool] = {}
