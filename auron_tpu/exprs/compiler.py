@@ -737,7 +737,12 @@ class CompiledExprs:
         # module-global cache: operator instances are rebuilt per task, so a
         # per-instance cache would re-trace every execute_plan call
         from auron_tpu.ops.kernel_cache import cached_jit
-        key = ("exprs", device_exprs, dev_schema, capacity, sig)
+        from auron_tpu.config import conf as _conf
+        # case.sensitive is read at trace time (wire_udf param-dup
+        # validation + column resolution) — cache-key rule: every
+        # trace-time config read must appear in the kernel cache key
+        key = ("exprs", device_exprs, dev_schema, capacity, sig,
+               bool(_conf.get("auron.case.sensitive")))
 
         def build():
             def run(cols, num_rows, partition_id, row_base):

@@ -147,6 +147,17 @@ def evaluate(expr: E.Expr, rb: pa.RecordBatch, schema: Schema,
         i = schema.index_of(expr.name)
         return arrow_to_hv(rb.column(i), schema[i].dtype)
     if k == "bound_reference":
+        if bindings is not None:
+            # body scope: positional param binding, mirroring the device
+            # compiler's sub-EvalCtx (cols=arg_cols) — falling through to
+            # the ENCLOSING batch here would silently read an unrelated
+            # column and diverge from the device path (ADVICE r4).
+            vals = list(bindings.values())
+            if not 0 <= expr.index < len(vals):
+                raise IndexError(
+                    f"wire_udf body bound_reference #{expr.index} out of "
+                    f"range for {len(vals)} params")
+            return vals[expr.index]
         return arrow_to_hv(rb.column(expr.index), schema[expr.index].dtype)
     if k in ("literal", "scalar_subquery"):
         dt = expr.dtype

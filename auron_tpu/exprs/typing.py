@@ -45,8 +45,8 @@ def wire_udf_param_schema(expr: "E.WireUdf", schema: Schema) -> Schema:
     typed by the corresponding (positionally bound) argument.  Validates
     the wire-supplied shape: arity match, a present body, and unique
     param names (duplicates would silently bind every reference to the
-    first argument — names also collide case-insensitively, matching the
-    engine's case-insensitive column resolution)."""
+    first argument; whether names collide case-insensitively follows
+    auron.case.sensitive, the same rule column resolution uses)."""
     from auron_tpu.ir.schema import Field
     if expr.body is None:
         raise TypeError(f"wire_udf {expr.name!r}: missing body")
@@ -54,7 +54,14 @@ def wire_udf_param_schema(expr: "E.WireUdf", schema: Schema) -> Schema:
         raise TypeError(
             f"wire_udf {expr.name!r}: {len(expr.params)} params but "
             f"{len(expr.args)} args")
-    folded = [str(p).lower() for p in expr.params]
+    from auron_tpu.config import conf as _conf
+    names = [str(p) for p in expr.params]
+    # fold for the duplicate check only under case-INsensitive resolution
+    # — matching the binding-lookup semantics (host_eval + Schema.index_of
+    # both honor auron.case.sensitive); under case-sensitive mode params
+    # ('a','A') are distinct and must be accepted (ADVICE r4).
+    folded = (names if _conf.get("auron.case.sensitive")
+              else [n.lower() for n in names])
     if len(set(folded)) != len(folded):
         raise TypeError(
             f"wire_udf {expr.name!r}: duplicate param names "

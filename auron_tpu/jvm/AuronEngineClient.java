@@ -123,11 +123,14 @@ public final class AuronEngineClient {
   // Template bytes come from jvm/ipc_template.ipc_segments(n): the IPC
   // stream for a fixed schema factors into [schema msg][batch metadata]
   // [BODY][eos] where only the body carries values.  Body layout for
-  // (k int64, v float64), no nulls: k-data at 0, v-data at the next
-  // 64-byte-aligned offset (validity buffers empty).
+  // (k int64, v float64), no nulls: buffers = [k-validity (empty),
+  // k-data, v-validity (empty), v-data] at whatever offsets the
+  // generating pyarrow writer baked into batch_meta — parsed, never
+  // recomputed (alignment differs across pyarrow versions).
 
   static byte[] schemaMsg, batchMeta, eosMsg;
   static int tmplRows, tmplBodyLen;
+  static long[][] tmplBuffers;   // parsed from batchMeta at load
 
   static void loadTemplates(String dir) throws IOException {
     schemaMsg = Files.readAllBytes(Path.of(dir, "schema_msg.bin"));
@@ -138,18 +141,25 @@ public final class AuronEngineClient {
             .trim().split(" ");
     tmplRows = Integer.parseInt(meta[0]);
     tmplBodyLen = Integer.parseInt(meta[1]);
-  }
-
-  static int align64(int n) {
-    return (n + 63) & ~63;
+    tmplBuffers = readBatchMessage(batchMeta).buffers;
+    if (tmplBuffers == null || tmplBuffers.length != 4)
+      die("kv template expects 4 buffers");
+    // cross-check meta.txt against the baked buffer lengths (mixed/stale
+    // template files would otherwise splice short and ship zero rows)
+    if (tmplBuffers[1][1] != 8L * tmplRows
+        || tmplBuffers[3][1] != 8L * tmplRows)
+      die("template buffer lengths disagree with row count " + tmplRows);
   }
 
   static byte[] kvBatchIpc(long[] k, double[] v) {
-    if (k.length != tmplRows) die("template is for " + tmplRows + " rows");
+    if (k.length != tmplRows || v.length != tmplRows)
+      die("template is for " + tmplRows + " rows, got k=" + k.length
+          + " v=" + v.length);
     ByteBuffer body = ByteBuffer.allocate(tmplBodyLen)
         .order(ByteOrder.LITTLE_ENDIAN);
+    body.position((int) tmplBuffers[1][0]);   // k-data
     for (long x : k) body.putLong(x);
-    body.position(align64(8 * k.length));
+    body.position((int) tmplBuffers[3][0]);   // v-data
     for (double x : v) body.putDouble(x);
     ByteBuffer out = ByteBuffer.allocate(
         schemaMsg.length + batchMeta.length + tmplBodyLen + eosMsg.length);

@@ -67,19 +67,28 @@ def ipc_segments(n_rows: int) -> Tuple[bytes, bytes, int, bytes]:
 def splice_body(schema_msg: bytes, batch_meta: bytes, eos: bytes,
                 k: np.ndarray, v: np.ndarray, body_len: int) -> bytes:
     """The Java client's write path: template + raw little-endian data.
-    Buffers sit at 64-byte-aligned offsets: k at 0, v after k (padded)."""
-    n = len(k)
+
+    Buffer offsets come from the PARSED batch metadata, never from
+    recomputed alignment: the offsets baked into batch_meta are whatever
+    the generating pyarrow writer chose (64-byte aligned on current
+    versions, 8-byte on some older ones) and splicing at any other
+    offset silently corrupts the values (ADVICE r4).  kv no-null layout:
+    buffers = [k-validity, k-data, v-validity, v-data]."""
+    _rows, _nodes, bufs = read_batch_message(batch_meta)
+    if len(bufs) != 4:      # hard errors, not asserts: python -O must
+        raise ValueError(   # not revert this path to silent corruption
+            f"kv batch expects 4 buffers, got {len(bufs)}")
+    off_k, len_k = bufs[1]
+    off_v, len_v = bufs[3]
     body = bytearray(body_len)
     kb = k.astype("<i8").tobytes()
-    off_v = _align64(len(kb))
-    body[0:len(kb)] = kb
     vb = v.astype("<f8").tobytes()
-    body[off_v:off_v + len(vb)] = vb
+    if len(kb) != len_k or len(vb) != len_v:
+        raise ValueError(f"data/template length mismatch: "
+                         f"{len(kb)}/{len_k} {len(vb)}/{len_v}")
+    body[off_k:off_k + len_k] = kb
+    body[off_v:off_v + len_v] = vb
     return schema_msg + batch_meta + bytes(body) + eos
-
-
-def _align64(n: int) -> int:
-    return (n + 63) & ~63
 
 
 # ---------------------------------------------------------------------------

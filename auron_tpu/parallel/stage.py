@@ -70,13 +70,21 @@ class SpmdGuardTripped(SpmdUnsupported):
     semi-like join) fall straight back to the serial engine."""
 
     def __init__(self, message: str, retryable: bool = False,
-                 shrink: bool = False, join_compact: bool = False):
+                 shrink: bool = False, join_compact: bool = False,
+                 hard: bool = False):
         super().__init__(message)
         self.retryable = retryable
         self.shrink = shrink
         # the join-chain compaction overflowed: retry with compaction
         # disabled (independent of the agg shrink dimension)
         self.join_compact = join_compact
+        # hard quota/dup-key trip: normally falls straight back to
+        # serial, EXCEPT that while the agg capacity shrink is active the
+        # downstream exchange quotas were sized from the SHRUNK capacity,
+        # so a skewed routing that fit pre-shrink can overflow them — the
+        # ladder gives such trips shrink climbs while cap_eff > 0 before
+        # conceding (ADVICE r4)
+        self.hard = hard
 
 
 @dataclass
@@ -1120,16 +1128,21 @@ class _DeviceShardCache(_ByteBudgetLRU):
         for key in self._tid_keys.pop(tid, ()):
             self._evict_key(key)
 
-    def get(self, table) -> Optional[dict]:
-        key = (id(table), *_current_shard_key())
+    # the shard key (mesh/axis/string-config) is threaded through
+    # explicitly: a process-global "current key" would interleave under
+    # two concurrent sessions on different meshes and serve shards placed
+    # for the other run's mesh (ADVICE r4)
+
+    def get(self, table, shard_key: Tuple) -> Optional[dict]:
+        key = (id(table), *shard_key)
         e = self._lookup(key)
         if e is None or e["ref"]() is not table:
             return None
         return e
 
-    def put(self, table, entry: dict) -> None:
+    def put(self, table, entry: dict, shard_key: Tuple) -> None:
         tid = id(table)
-        key = (tid, *_current_shard_key())
+        key = (tid, *shard_key)
         nbytes = sum(
             int(getattr(x, "nbytes", 0))
             for x in jax.tree.leaves((entry["cols"], entry["live"])))
@@ -1141,15 +1154,6 @@ class _DeviceShardCache(_ByteBudgetLRU):
     def clear(self) -> None:
         super().clear()
         self._tid_keys.clear()
-
-
-# thread-local-free: the shard key (mesh/axis/string-config) is set by the
-# executing driver right before cache access, single host thread per run
-_SHARD_KEY: List[Tuple] = [()]
-
-
-def _current_shard_key() -> Tuple:
-    return _SHARD_KEY[0]
 
 
 _DEVICE_SHARDS = _DeviceShardCache()
@@ -1245,6 +1249,11 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     cap_hint = int(_conf.get("auron.spmd.agg.capacity.hint"))
     shrink_key = (hint_key, cap_hint)
     cap_eff = _SHRINK_HINT.get(shrink_key, cap_hint)
+    # the hard-fail hint embeds the configs that size the hard guard
+    # (quota margin + configured cap): re-tuning either restarts the
+    # hard-climb eligibility, same discipline as the shrink ladder
+    hard_key = (hint_key, cap_hint,
+                float(_conf.get("auron.spmd.exchange.quota.margin")))
     join_compact = bool(_conf.get("auron.spmd.join.compact.enable")) \
         and not _JOIN_COMPACT_OFF_HINT.get(hint_key, False)
     # bounded retries across the independent guard dimensions (match
@@ -1270,13 +1279,24 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
             if e.join_compact and join_compact:
                 join_compact = False
                 continue
-            if e.shrink and cap_eff > 0:
+            hard_climb = (e.hard and
+                          not _HARD_FAIL_HINT.get(hard_key, False))
+            if (e.shrink or hard_climb) and cap_eff > 0:
+                # hard trips climb too: post-agg exchange quotas are
+                # sized from the SHRUNK capacity, so a routing skew that
+                # fit pre-shrink can overflow the hard guard — the
+                # ladder must get to try wider rungs (-> shrink off =
+                # pre-shrink sizing) before falling back to serial.  A
+                # genuine dup-key failure survives every rung; the hint
+                # below makes repeat executes skip the climb entirely.
                 cap_eff = cap_eff * 4 \
                     if cap_eff < cap_hint * 16 else 0
                 continue
             if e.retryable and match == 1 and k > 1:
                 match = k
                 continue
+            if e.hard:
+                _HARD_FAIL_HINT[hard_key] = True
             raise
     raise SpmdGuardTripped("guard retries exhausted")
 
@@ -1431,19 +1451,19 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     # config): repeat executes of the same query hit device-resident
     # shards and skip all host-side pad/concat/transfer work
     sharded = NamedSharding(mesh, PS(axis))
-    _SHARD_KEY[0] = (_mesh_fingerprint(mesh), axis,
-                     _string_cfg_fingerprint())
+    shard_key = (_mesh_fingerprint(mesh), axis,
+                 _string_cfg_fingerprint())
     host_inputs = {}
     schemas = {}
     for rid, table in source_tables.items():
-        e = _DEVICE_SHARDS.get(table)
+        e = _DEVICE_SHARDS.get(table, shard_key)
         if e is None:
             schema, cols, live, _cap = _shard_table(table, mesh, axis)
             e = {"schema": schema,
                  "cols": jax.tree.map(
                      lambda x: jax.device_put(x, sharded), cols),
                  "live": jax.device_put(live, sharded)}
-            _DEVICE_SHARDS.put(table, e)
+            _DEVICE_SHARDS.put(table, e, shard_key)
         host_inputs[rid] = (e["cols"], e["live"])
         schemas[rid] = e["schema"]
     # program cache: repeat executions of the SAME converted plan over the
@@ -1464,6 +1484,7 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         # would otherwise reuse a program compiled under the old value
         float(_conf.get("auron.spmd.exchange.quota.margin")),
         bool(_conf.get("auron.string.ascii.case.enable")),
+        bool(_conf.get("auron.case.sensitive")),
         bool(_conf.get("auron.segments.sorted.enable")),
         str(_conf.get("auron.sort.multipass.enable")),
         bool(_conf.get("auron.pallas.enable")),
@@ -1535,7 +1556,7 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         raise SpmdGuardTripped(
             "runtime guard tripped (exchange quota overflow, or "
             f"duplicate build keys past match factor {match_factor}): "
-            "result discarded", retryable=False)
+            "result discarded", retryable=False, hard=True)
     if np.any(np.asarray(join_np)):
         raise SpmdGuardTripped(
             "join output overflowed the compaction target (genuine "
@@ -1612,6 +1633,10 @@ _SHRINK_HINT: Dict[Any, int] = {}
 # canonical plan -> True when the join compaction overflowed and the
 # compaction-off retry succeeded
 _JOIN_COMPACT_OFF_HINT: Dict[Any, bool] = {}
+# canonical plan -> True when a HARD trip survived the whole shrink
+# ladder (genuine dup-key/quota failure, not shrink-induced): repeat
+# executes then skip the expensive climb and fall straight to serial
+_HARD_FAIL_HINT: Dict[Any, bool] = {}
 
 # node kinds the tracer can (conditionally) express; anything else is
 # rejected by precheck_plan before source materialization

@@ -487,6 +487,41 @@ def test_wire_udf_param_arity_mismatch_rejected():
         infer_type(bad, schema)
 
 
+def test_wire_udf_bound_reference_body_positional():
+    # a body referencing params by ORDINAL must bind to the argument
+    # values on both paths — the host path used to read the enclosing
+    # batch's column at that index instead (ADVICE r4): with args=f64
+    # and enclosing column 0 = i32, the divergence is loud
+    by_ordinal = E.WireUdf(
+        name="bref", params=("x",),
+        body=E.BinaryExpr(left=E.BoundReference(index=0), op="+",
+                          right=lit(1.0)),
+        args=(col("f64"),))
+    check_expr(by_ordinal, expect_device=True)
+    # out-of-range ordinal: loud host error, not an enclosing-batch read
+    import auron_tpu.exprs.host_eval as host_eval_mod
+    rb = make_batch()
+    schema = from_arrow_schema(rb.schema)
+    bad = E.WireUdf(name="oob", params=("x",),
+                    body=E.BoundReference(index=3), args=(col("f64"),))
+    with pytest.raises(IndexError, match="out of range"):
+        host_eval_mod.evaluate_arrow(bad, rb, schema)
+
+
+def test_wire_udf_case_sensitive_param_dups():
+    from auron_tpu.config import conf
+    aA = E.WireUdf(
+        name="aA", params=("a", "A"),
+        body=E.BinaryExpr(left=col("a"), op="-", right=col("A")),
+        args=(col("f64"), col("i32")))
+    # case-insensitive (default): ('a','A') collide -> rejected
+    with pytest.raises(TypeError, match="duplicate param"):
+        infer_type(aA, from_arrow_schema(make_batch().schema))
+    # case-sensitive: distinct params, resolved per-case on both paths
+    with conf.scoped({"auron.case.sensitive": True}):
+        check_expr(aA, expect_device=True)
+
+
 def test_wire_udf_serde_roundtrip():
     from auron_tpu.ir import plan as P
     from auron_tpu.ir import serde

@@ -951,7 +951,7 @@ def test_source_cache_budget_zero_flushes_and_scan_fp_invalidates(tmp_path):
     assert len(S._DEVICE_SHARDS._entries) == 1
     with conf.scoped({"auron.spmd.source.cache.mb": 0}):
         # a lookup under budget 0 flushes the retained entries
-        assert S._DEVICE_SHARDS.get(t) is None
+        assert S._DEVICE_SHARDS.get(t, ()) is None
         assert len(S._DEVICE_SHARDS._entries) == 0
 
     # scan fingerprint: rewrite the file between executes -> re-read
