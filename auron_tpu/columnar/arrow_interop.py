@@ -94,7 +94,12 @@ def arrow_array_to_column(dt: DataType, arr: pa.Array, cap: int) -> Column:
                 arr = arr.dictionary_decode()
             vals = _primitive_values(arr, None).astype(npdt, copy=False)
         data[:n] = np.where(validity[:n], vals, 0)
-    return DeviceColumn(dt, jnp.asarray(data), jnp.asarray(validity))
+    bits = None
+    if dt.id == TypeId.FLOAT64:
+        from auron_tpu.ops.sort_keys import f64_exact_bits_enabled
+        if f64_exact_bits_enabled():
+            bits = jnp.asarray(data.view(np.uint64))
+    return DeviceColumn(dt, jnp.asarray(data), jnp.asarray(validity), bits)
 
 
 def _arrow_validity(arr: pa.Array) -> np.ndarray:
@@ -244,6 +249,11 @@ def column_to_arrow(dt: DataType, col: Column, n: int) -> pa.Array:
         return arr.cast(at) if arr.type != at else arr
     # flat
     data = np.asarray(col.data)[:n]
+    if dt.id == TypeId.FLOAT64 and getattr(col, "bits", None) is not None:
+        # reconstruct the exact doubles from the ingest-captured bit
+        # sidecar: the device value may be f32-demoted (TPU), and spill/
+        # output must round-trip what was ingested, not the demotion
+        data = np.asarray(col.bits)[:n].view(np.float64)
     valid = np.asarray(col.validity)[:n]
     mask = pa.py_buffer(np.packbits(valid, bitorder="little").tobytes())
     if dt.id == TypeId.DECIMAL:

@@ -52,10 +52,19 @@ def bucket_width(w: int) -> int:
 
 @dataclass
 class DeviceColumn:
-    """Flat (fixed-width) column: data[capacity], validity[capacity]."""
+    """Flat (fixed-width) column: data[capacity], validity[capacity].
+
+    `bits` (FLOAT64 only, optional): uint64[capacity] exact IEEE-754 bit
+    patterns captured on the HOST at ingest.  On backends that demote f64
+    (TPU), `data` is f32-granular — `bits` preserves full 64-bit ordering/
+    equality/hashing semantics (sort_keys.py consumes it).  None on
+    CPU/GPU (data itself is exact) and for device-COMPUTED columns (whose
+    values are f32-exact anyway, so their bits are recovered losslessly by
+    widening — sort_keys.f32_bits_to_f64_bits)."""
     dtype: DataType
     data: Array
     validity: Array  # bool[capacity]
+    bits: Optional[Array] = None  # uint64[capacity] | None
 
     @property
     def capacity(self) -> int:
@@ -67,7 +76,12 @@ class DeviceColumn:
                                       mode="fill", fill_value=0), 0)
         v = jnp.where(valid, jnp.take(self.validity, indices, axis=0,
                                       mode="fill", fill_value=False), False)
-        return DeviceColumn(self.dtype, d, v)
+        b = None
+        if self.bits is not None:
+            b = jnp.where(valid, jnp.take(self.bits, indices, axis=0,
+                                          mode="fill", fill_value=0),
+                          jnp.uint64(0))
+        return DeviceColumn(self.dtype, d, v, b)
 
     def astuple(self):
         return (self.data, self.validity)
@@ -283,7 +297,9 @@ class Batch:
             else:
                 cols.append(DeviceColumn(
                     c.dtype, jnp.where(mask, c.data, _zero_like(c.data)),
-                    jnp.logical_and(c.validity, mask)))
+                    jnp.logical_and(c.validity, mask),
+                    None if c.bits is None else
+                    jnp.where(mask, c.bits, jnp.uint64(0))))
         return Batch(self.schema, cols, n, self.capacity)
 
     def mem_bytes(self) -> int:
@@ -343,9 +359,16 @@ def concat_device_columns(parts: List[Any]):
             parts[0].dtype, jnp.concatenate(datas),
             jnp.concatenate([p.lengths for p in parts]),
             jnp.concatenate([p.validity for p in parts]))
+    bits = None
+    if any(p.bits is not None for p in parts):
+        # normalize: parts without exact bits widen from their (f32-exact)
+        # values so one column never mixes key spaces
+        from auron_tpu.ops.sort_keys import f64_bits_of_column
+        bits = jnp.concatenate([p.bits if p.bits is not None
+                                else f64_bits_of_column(p) for p in parts])
     return DeviceColumn(parts[0].dtype,
                         jnp.concatenate([p.data for p in parts]),
-                        jnp.concatenate([p.validity for p in parts]))
+                        jnp.concatenate([p.validity for p in parts]), bits)
 
 
 def is_device_type(dt: DataType) -> bool:
@@ -381,7 +404,15 @@ def _device_column_from_numpy(dt: DataType, a: np.ndarray, v: np.ndarray,
     data[:n] = np.where(v, a.astype(dt.numpy_dtype(), copy=False), 0)
     valid = np.zeros(cap, dtype=bool)
     valid[:n] = v
-    return DeviceColumn(dt, jnp.asarray(data), jnp.asarray(valid))
+    bits = None
+    if dt.id == TypeId.FLOAT64:
+        from auron_tpu.ops.sort_keys import f64_exact_bits_enabled
+        if f64_exact_bits_enabled():
+            # capture the exact IEEE bits on the host (free: a view) so
+            # TPU ordering/grouping/hashing stays 64-bit-exact even though
+            # the device value is demoted to f32 granularity
+            bits = jnp.asarray(data.view(np.uint64))
+    return DeviceColumn(dt, jnp.asarray(data), jnp.asarray(valid), bits)
 
 
 # ---------------------------------------------------------------------------
@@ -392,8 +423,11 @@ def _device_column_from_numpy(dt: DataType, a: np.ndarray, v: np.ndarray,
 
 jax.tree_util.register_pytree_node(
     DeviceColumn,
-    lambda c: ((c.data, c.validity), c.dtype),
-    lambda dtype, kids: DeviceColumn(dtype, *kids),
+    # aux carries whether `bits` rides along so the children tuple arity
+    # stays static per-structure (jit caches key on the treedef)
+    lambda c: (((c.data, c.validity) if c.bits is None
+                else (c.data, c.validity, c.bits)), (c.dtype, c.bits is not None)),
+    lambda aux, kids: DeviceColumn(aux[0], *kids),
 )
 jax.tree_util.register_pytree_node(
     DeviceStringColumn,
@@ -453,5 +487,13 @@ def concat_batches(schema: Schema, batches: List[Batch],
             data = jnp.pad(data, (0, cap - data.shape[0]))
             va = jnp.concatenate(vals)[:cap]
             va = jnp.pad(va, (0, cap - va.shape[0]))
-            cols.append(DeviceColumn(f.dtype, data, va))
+            bits = None
+            if any(p.bits is not None for p in parts):
+                from auron_tpu.ops.sort_keys import f64_bits_of_column
+                bs = [(p.bits if p.bits is not None
+                       else f64_bits_of_column(p))[:b.num_rows]
+                      for b, p in zip(batches, parts)]
+                bits = jnp.concatenate(bs)[:cap]
+                bits = jnp.pad(bits, (0, cap - bits.shape[0]))
+            cols.append(DeviceColumn(f.dtype, data, va, bits))
     return Batch(schema, cols, total, cap)

@@ -280,6 +280,30 @@ SORT_MULTIPASS = conf.define(
     "while on CPU the fused comparator sort compiles fast and runs "
     "faster); 'on'/'off' force one form.",
 )
+SPMD_GATHER_COMPACT = conf.define(
+    "auron.spmd.gather.compact", "auto",
+    "Two-phase result gather for SPMD stage programs: the program "
+    "compacts live rows to each shard's front and the host first syncs "
+    "only per-shard COUNTS + guard bits (bytes), then fetches a "
+    "bucket_capacity(max count) slice through a tiny cached slicing "
+    "program — instead of fetching every output column at full padded "
+    "capacity.  On a tunnel-attached TPU the capacity-sized fetch "
+    "dominated warm query time (~7MB for a 4k-row result at 8MB/s); "
+    "guard-tripped runs skip the output fetch entirely.  'auto' = "
+    "non-CPU backends only (CPU transfers are memcpy-cheap and the "
+    "extra dispatch would only add latency); 'on'/'off' force.",
+)
+SORT_F64_EXACTBITS = conf.define(
+    "auron.sort.f64.exactbits", "auto",
+    "Exact 64-bit ordering/grouping/hashing for FLOAT64 on backends that "
+    "demote f64 (TPU): ingest captures the IEEE bit pattern host-side as "
+    "a uint64 sidecar (free: a numpy view), key encoding orders by it, "
+    "and device-computed doubles (f32-exact by construction there) widen "
+    "losslessly via integer ops — so TPU sort/SMJ/window/group orders "
+    "match the oracle bit-for-bit instead of at f32 granularity.  'auto' "
+    "= only on demoting backends; 'on' forces the sidecar everywhere "
+    "(CPU differential tests); 'off' = legacy f32-granular demotion.",
+)
 SPMD_AGG_CAPACITY_HINT = conf.define(
     "auron.spmd.agg.capacity.hint", 262144,
     "Static per-device row capacity an SPMD agg output is cut down to "

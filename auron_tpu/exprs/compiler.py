@@ -742,7 +742,8 @@ class CompiledExprs:
         # validation + column resolution) — cache-key rule: every
         # trace-time config read must appear in the kernel cache key
         key = ("exprs", device_exprs, dev_schema, capacity, sig,
-               bool(_conf.get("auron.case.sensitive")))
+               bool(_conf.get("auron.case.sensitive")),
+               str(_conf.get("auron.sort.f64.exactbits")))
 
         def build():
             def run(cols, num_rows, partition_id, row_base):

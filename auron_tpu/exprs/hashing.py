@@ -84,6 +84,19 @@ def hash_float64(v, seed):
     return _fmix(h1, 8)
 
 
+def hash_f64_bits(bits, seed):
+    """hash_float64 from exact uint64 IEEE bits (the DeviceColumn.bits
+    sidecar): Spark-exact double hashing even where f64 is demoted.
+    Normalizes -0.0 like the value path."""
+    bits = jnp.where(bits == jnp.uint64(0x8000000000000000),
+                     jnp.uint64(0), bits)
+    lo = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (bits >> 32).astype(jnp.uint32)
+    h1 = _mix_h1(jnp.asarray(seed, jnp.uint32), _mix_k1(lo))
+    h1 = _mix_h1(h1, _mix_k1(hi))
+    return _fmix(h1, 8)
+
+
 def jax_bitcast_i32(v):
     import jax.lax as lax
     return lax.bitcast_convert_type(v, jnp.int32)
@@ -198,7 +211,17 @@ def hash_column(col, seed):
         elif tid == TypeId.FLOAT32:
             h = hash_float32(col.data, seed)
         elif tid == TypeId.FLOAT64:
-            h = hash_float64(col.data, seed)
+            from auron_tpu.ops.sort_keys import (f64_bits_of_column,
+                                                 f64_exact_bits_enabled)
+            if f64_exact_bits_enabled():
+                # ALL f64 hashing goes through the bits space when the
+                # sidecar is live (ingested: exact; computed: widened from
+                # the f32-exact stored value) — mixing bit-exact and
+                # f32-granular hashes for the same value would route join/
+                # shuffle sides to different partitions
+                h = hash_f64_bits(f64_bits_of_column(col), seed)
+            else:
+                h = hash_float64(col.data, seed)
         else:
             raise TypeError(f"unhashable device type {col.dtype}")
     bseed = jnp.broadcast_to(seed, h.shape)

@@ -70,6 +70,98 @@ def wire_udf_param_schema(expr: "E.WireUdf", schema: Schema) -> Schema:
                         for p, a in zip(expr.params, expr.args)))
 
 
+_WIRE_UDAF_OPS = ("sum", "min", "max", "count")
+
+
+def _check_refs_only(expr, allowed, what: str, owner: str) -> None:
+    """Every column-style reference in `expr` must name one of `allowed`;
+    positional/bound references are rejected outright (same rule the
+    wire_udf body follows after ADVICE r4: a bound_reference would reach
+    past the parameter scope into the enclosing batch)."""
+    k = getattr(expr, "kind", None)
+    if k == "column" and expr.name not in allowed:
+        raise TypeError(
+            f"wire_udaf {owner!r}: {what} references {expr.name!r} "
+            f"outside its scope {tuple(sorted(allowed))}")
+    if k in ("bound_reference", "wire_udf", "py_udf_wrapper",
+             "scalar_subquery", "row_num",
+             "monotonically_increasing_id"):
+        raise TypeError(
+            f"wire_udaf {owner!r}: {what} may not contain {k!r}")
+    for c in expr.children_nodes():
+        _check_refs_only(c, allowed, what, owner)
+
+
+def validate_wire_udaf(wire, in_dtypes) -> None:
+    """Structural validation of a wire-shipped UDAF definition: slot
+    arity/op whitelist, update expressions scoped to the formal params,
+    finalize scoped to the slot names."""
+    n = len(wire.slot_names)
+    if n == 0:
+        raise TypeError(f"wire_udaf {wire.name!r}: no state slots")
+    if not (len(wire.slot_ops) == len(wire.slot_types)
+            == len(wire.updates) == n):
+        raise TypeError(
+            f"wire_udaf {wire.name!r}: slot_names/slot_ops/slot_types/"
+            f"updates arity mismatch "
+            f"({n}/{len(wire.slot_ops)}/{len(wire.slot_types)}/"
+            f"{len(wire.updates)})")
+    for op in wire.slot_ops:
+        if op not in _WIRE_UDAF_OPS:
+            raise TypeError(
+                f"wire_udaf {wire.name!r}: unsupported slot op {op!r} "
+                f"(allowed: {_WIRE_UDAF_OPS})")
+    if wire.finalize is None:
+        raise TypeError(f"wire_udaf {wire.name!r}: missing finalize")
+    if len(set(wire.slot_names)) != n:
+        raise TypeError(
+            f"wire_udaf {wire.name!r}: duplicate slot names")
+    if len(set(wire.params)) != len(wire.params):
+        raise TypeError(
+            f"wire_udaf {wire.name!r}: duplicate param names")
+    if len(wire.params) != len(in_dtypes):
+        raise TypeError(
+            f"wire_udaf {wire.name!r}: {len(wire.params)} params but "
+            f"{len(in_dtypes)} argument columns")
+    for u in wire.updates:
+        _check_refs_only(u, set(wire.params), "update", wire.name)
+    _check_refs_only(wire.finalize, set(wire.slot_names), "finalize",
+                     wire.name)
+
+
+def validate_wire_udtf(wire, in_dtypes) -> None:
+    """Structural validation of a wire-shipped generator: static row
+    tuples of equal width, cells/guards scoped to the formal params."""
+    if not wire.rows:
+        raise TypeError(f"wire_udtf {wire.name!r}: no output rows")
+    width = len(wire.rows[0])
+    if width == 0:
+        raise TypeError(f"wire_udtf {wire.name!r}: empty output tuple")
+    for r in wire.rows:
+        if len(r) != width:
+            raise TypeError(
+                f"wire_udtf {wire.name!r}: ragged output tuples "
+                f"({len(r)} vs {width})")
+    if wire.whens and len(wire.whens) != len(wire.rows):
+        raise TypeError(
+            f"wire_udtf {wire.name!r}: {len(wire.whens)} whens for "
+            f"{len(wire.rows)} rows")
+    if len(set(wire.params)) != len(wire.params):
+        raise TypeError(
+            f"wire_udtf {wire.name!r}: duplicate param names")
+    if len(wire.params) != len(in_dtypes):
+        raise TypeError(
+            f"wire_udtf {wire.name!r}: {len(wire.params)} params but "
+            f"{len(in_dtypes)} argument columns")
+    scope = set(wire.params)
+    for r in wire.rows:
+        for cell in r:
+            _check_refs_only(cell, scope, "row cell", wire.name)
+    for w in wire.whens:
+        if w is not None:
+            _check_refs_only(w, scope, "when guard", wire.name)
+
+
 def infer_type(expr: E.Expr, schema: Schema) -> DataType:
     k = expr.kind
     if k == "column":

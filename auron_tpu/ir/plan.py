@@ -231,13 +231,15 @@ class Generate(PlanNode):
     (generate_exec.rs:50)."""
     kind: ClassVar[str] = "generate"
     child: PlanNode = None  # type: ignore[assignment]
-    generator: str = "explode"    # explode|posexplode|json_tuple|udtf
+    # explode|posexplode|json_tuple|udtf|wire_udtf
+    generator: str = "explode"
     args: Tuple[Expr, ...] = ()
     generator_output_names: Tuple[str, ...] = ()
     generator_output_types: Tuple[DataType, ...] = ()
     required_child_output: Tuple[int, ...] = ()
     outer: bool = False
     udtf: Optional[bytes] = None   # pickled python generator fn
+    wire: Optional[Node] = None    # ir.expr.WireUdtf for wire_udtf
 
 
 @register

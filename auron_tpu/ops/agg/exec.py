@@ -61,8 +61,20 @@ class AggExec(Operator, MemConsumer):
         self.specs: List[AggSpec] = []
         for a, name in zip(self.aggs, self.agg_names):
             in_dt = None if not a.children else _child_type(a, in_schema)
+            in_dts = None
+            if a.wire is not None:
+                # final mode: children carry the PARTIAL stage's input
+                # expressions, unresolvable against the state schema —
+                # and unneeded there (final only merges + finalizes)
+                def _t(c):
+                    try:
+                        return infer_type(c, in_schema)
+                    except Exception:
+                        return DataType.float64()
+                in_dts = tuple(_t(c) for c in a.children)
             self.specs.append(make_spec(a.fn, in_dt or DataType.int64(),
-                                        a.return_type, name, a.udaf))
+                                        a.return_type, name, a.udaf,
+                                        wire=a.wire, in_dtypes=in_dts))
 
         key_fields = tuple(
             Field(n, infer_type(g, in_schema))
@@ -122,7 +134,10 @@ class AggExec(Operator, MemConsumer):
         module-global kernel cache relies on this)."""
         return tuple(
             (type(s).__name__, getattr(s, "fn", None), s.in_dtype,
-             tuple(f.dtype for f in s.state_fields()))
+             tuple(f.dtype for f in s.state_fields()),
+             # wire UDAFs with equal dtypes but different bodies must not
+             # share a cached kernel
+             getattr(s, "wire", None))
             for s in self.specs)
 
     def _state_schema(self) -> Schema:
@@ -763,8 +778,9 @@ def _truncate_builder():
                     c.dtype, c.data[:out_cap], c.lengths[:out_cap],
                     c.validity[:out_cap]))
             else:
-                out.append(DeviceColumn(c.dtype, c.data[:out_cap],
-                                        c.validity[:out_cap]))
+                out.append(DeviceColumn(
+                    c.dtype, c.data[:out_cap], c.validity[:out_cap],
+                    None if c.bits is None else c.bits[:out_cap]))
         return out
     return run
 

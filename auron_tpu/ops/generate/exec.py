@@ -25,12 +25,18 @@ class GenerateExec(Operator):
     def __init__(self, child: Operator, generator: str, args,
                  generator_output_names, generator_output_types,
                  required_child_output=(), outer: bool = False,
-                 udtf: Optional[bytes] = None):
+                 udtf: Optional[bytes] = None, wire=None):
         in_schema = child.schema
         self.generator = generator
         self.args = tuple(args)
         self.outer = outer
         self.udtf = udtf
+        self.wire = wire
+        if generator == "wire_udtf":
+            from auron_tpu.exprs.typing import (infer_type,
+                                                validate_wire_udtf)
+            validate_wire_udtf(wire, tuple(
+                infer_type(a, in_schema) for a in args))
         self.required_child_output = tuple(required_child_output) or \
             tuple(range(len(in_schema)))
         child_fields = tuple(in_schema[i] for i in self.required_child_output)
@@ -50,14 +56,19 @@ class GenerateExec(Operator):
                         for a in self.args]
             src_idx: List[int] = []
             gen_rows: List[Tuple] = []
-            for i in range(b.num_rows):
-                outs = list(self._generate_row(
-                    [None if not a.mask[i] else a.vals[i] for a in arg_vals]))
-                if not outs and self.outer:
-                    outs = [tuple(None for _ in self._gen_fields)]
-                for o in outs:
-                    src_idx.append(i)
-                    gen_rows.append(o)
+            if self.generator == "wire_udtf":
+                self._wire_rows(b.num_rows, arg_vals, src_idx, gen_rows,
+                                ctx)
+            else:
+                for i in range(b.num_rows):
+                    outs = list(self._generate_row(
+                        [None if not a.mask[i] else a.vals[i]
+                         for a in arg_vals]))
+                    if not outs and self.outer:
+                        outs = [tuple(None for _ in self._gen_fields)]
+                    for o in outs:
+                        src_idx.append(i)
+                        gen_rows.append(o)
             if not gen_rows:
                 continue
             child_tbl = rb.select([in_schema[i].name
@@ -73,6 +84,43 @@ class GenerateExec(Operator):
                 schema=to_arrow_schema(self.schema))
             for off in range(0, out.num_rows, batch_size()):
                 yield Batch.from_arrow(out.slice(off, batch_size()))
+
+    def _wire_rows(self, n: int, arg_vals, src_idx, gen_rows, ctx):
+        """wire_udtf: evaluate every template cell/guard VECTORIZED over
+        the argument columns (bound to the formal params), then fan out
+        row-major — input row i emits template rows j in order, guarded
+        rows skipped (ir.expr.WireUdtf; the wire analogue of
+        generate/spark_udtf_wrapper.rs)."""
+        from auron_tpu.exprs.typing import infer_type
+        in_schema = self.children[0].schema
+        pschema = Schema(tuple(
+            Field(p, infer_type(a, in_schema))
+            for p, a in zip(self.wire.params, self.args)))
+        prb = pa.RecordBatch.from_arrays(
+            [hv_to_arrow(hv) for hv in arg_vals],
+            schema=to_arrow_schema(pschema))
+        cells = [[host_evaluate(c, prb, pschema,
+                                partition_id=ctx.partition_id)
+                  for c in row] for row in self.wire.rows]
+        whens = []
+        for j in range(len(self.wire.rows)):
+            w = self.wire.whens[j] if self.wire.whens else None
+            whens.append(None if w is None else
+                         host_evaluate(w, prb, pschema,
+                                       partition_id=ctx.partition_id))
+        for i in range(n):
+            emitted = False
+            for j, row in enumerate(cells):
+                w = whens[j]
+                if w is not None and not (w.mask[i] and bool(w.vals[i])):
+                    continue
+                src_idx.append(i)
+                gen_rows.append(tuple(
+                    hv.vals[i] if hv.mask[i] else None for hv in row))
+                emitted = True
+            if not emitted and self.outer:
+                src_idx.append(i)
+                gen_rows.append(tuple(None for _ in self._gen_fields))
 
     def _generate_row(self, args: List[Any]):
         g = self.generator

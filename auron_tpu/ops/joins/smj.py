@@ -180,8 +180,16 @@ def _scalar_key_column(col: Any, value: Any):
         return col, f
     dt = col.data.dtype
     v = 0 if value is None else value
+    bits = None
+    if col.dtype.id == TypeId.FLOAT64:
+        from auron_tpu.ops.sort_keys import f64_exact_bits_enabled
+        if f64_exact_bits_enabled():
+            # frontier value is an exact host double; without the sidecar
+            # its device copy would be f32-demoted on TPU and tie-adjacent
+            # rows would mis-split at the window frontier
+            bits = jnp.asarray(np.asarray([v], np.float64).view(np.uint64))
     f = DeviceColumn(col.dtype, jnp.asarray([v], dt),
-                     jnp.asarray([value is not None]))
+                     jnp.asarray([value is not None]), bits)
     return col, f
 
 

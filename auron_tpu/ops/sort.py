@@ -296,12 +296,21 @@ def _np_encode_key(hv, asc: bool, nulls_first: bool) -> List[np.ndarray]:
                 word = (word << np.uint64(8)) | mat[:, blk + j].astype(np.uint64)
             words.append(word)
         words.append(np.array([len(b) for b in bs], np.uint64))
-    elif dt.id == TypeId.FLOAT64 or dt.id == TypeId.FLOAT32:
-        v = hv.vals.astype(np.float64)
-        bits = v.view(np.uint64) if v.dtype == np.float64 else None
-        bits = v.astype(np.float64).view(np.uint64)
+    elif dt.id == TypeId.FLOAT64:
+        bits = hv.vals.astype(np.float64).view(np.uint64)
         neg = (bits & np.uint64(1 << 63)) != 0
         words = [np.where(neg, ~bits, bits ^ np.uint64(1 << 63))]
+    elif dt.id == TypeId.FLOAT32:
+        # MUST mirror the device encoding (_orderable_u64_from_f32: f32
+        # bits in the HIGH u32 word) — these host words are compared
+        # against device-encoded row words (range bounds, merges); the
+        # former f64-widened encoding lived in a different key space and
+        # made every f32 row-vs-bound comparison meaningless
+        bits = hv.vals.astype(np.float32).view(np.uint32) \
+            .astype(np.uint64) << np.uint64(32)
+        neg = (bits & np.uint64(1 << 63)) != 0
+        words = [np.where(neg, ~bits, bits ^ np.uint64(1 << 63))
+                 & np.uint64(0xFFFFFFFF00000000)]
     elif dt.id == TypeId.BOOL:
         words = [hv.vals.astype(np.uint32)]
     elif dt.id == TypeId.DECIMAL:

@@ -1013,12 +1013,18 @@ def _shard_table(table, mesh: Mesh, axis: str) -> Tuple[Schema, List[Any],
                 jnp.asarray(np.concatenate(
                     [np.asarray(p.validity) for p in parts]))))
         else:
+            bits = None
+            if all(p.bits is not None for p in parts):
+                # keep the exact-f64 sidecar across the shard stack (all
+                # parts come from Batch.from_arrow, so presence is uniform)
+                bits = jnp.asarray(np.concatenate(
+                    [np.asarray(p.bits) for p in parts]))
             cols.append(DeviceColumn(
                 f.dtype,
                 jnp.asarray(np.concatenate(
                     [np.asarray(p.data) for p in parts])),
                 jnp.asarray(np.concatenate(
-                    [np.asarray(p.validity) for p in parts]))))
+                    [np.asarray(p.validity) for p in parts])), bits))
     live = np.zeros(n_dev * cap, bool)
     for d in range(n_dev):
         got = min(max(n - d * per_dev, 0), per_dev)
@@ -1279,7 +1285,14 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
             if e.join_compact and join_compact:
                 join_compact = False
                 continue
-            hard_climb = (e.hard and
+            # the climb exists because post-agg exchange quotas are sized
+            # from the SHRUNK capacity — a plan with no Agg anywhere was
+            # never shrunk, so its hard trip is genuine (skew/dup keys)
+            # and climbing would only re-execute a failing program 4 more
+            # times before the serial fallback
+            has_agg = any(isinstance(nn, P.Agg)
+                          for nn in _walk_native(plan, conv_ctx))
+            hard_climb = (e.hard and cap_eff > 0 and has_agg and
                           not _HARD_FAIL_HINT.get(hard_key, False))
             if (e.shrink or hard_climb) and cap_eff > 0:
                 # hard trips climb too: post-agg exchange quotas are
@@ -1390,6 +1403,31 @@ def _canonicalize_rids(plan, conv_ctx, source_tables):
     return new_plan, shim, new_sources
 
 
+# last execute's device->host gather footprint (the IT runner and bench
+# record this per query: VERDICT r4 ask #2 "gather bytes logged")
+GATHER_STATS = {"bytes": 0, "rows": 0, "capacity": 0}
+
+_SLICER_CACHE: Dict[Tuple, Any] = {}
+
+
+def _gather_slicer(mesh: Mesh, axis, K: int, out_cols, out_live):
+    """Cached shard_map program slicing every output leaf to its shard's
+    first K rows — the device-side half of the two-phase compact gather."""
+    key = (_mesh_fingerprint(mesh),
+           axis if not isinstance(axis, tuple) else tuple(axis), K,
+           tuple((str(x.dtype), x.shape)
+                 for x in jax.tree.leaves((out_cols, out_live))))
+    got = _SLICER_CACHE.get(key)
+    if got is None:
+        def body(cols, live):
+            return (jax.tree.map(lambda a: a[:K], cols), live[:K])
+        got = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(PS(axis), PS(axis)),
+            out_specs=(PS(axis), PS(axis)), check_vma=False))
+        _SLICER_CACHE[key] = got
+    return got
+
+
 def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                             source_tables: Dict[str, Any], axis,
                             match_factor: int,
@@ -1475,8 +1513,13 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     hash_grouping = (
         np.asarray(mesh.devices).flat[0].platform == "cpu" and
         str(_conf.get("auron.agg.grouping.strategy")) in ("auto", "hash"))
+    _gmode = str(_conf.get("auron.spmd.gather.compact"))
+    compact_gather = _gmode == "on" or (
+        _gmode == "auto" and
+        np.asarray(mesh.devices).flat[0].platform != "cpu")
     cache_key = (
         plan, axis, n_dev, match_factor, agg_cap_hint, join_compact,
+        compact_gather,
         _mesh_fingerprint(mesh),
         # EVERY config the tracer (or kernels it calls) reads at trace
         # time must appear here: rid canonicalization makes equal plans
@@ -1487,6 +1530,7 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         bool(_conf.get("auron.case.sensitive")),
         bool(_conf.get("auron.segments.sorted.enable")),
         str(_conf.get("auron.sort.multipass.enable")),
+        str(_conf.get("auron.sort.f64.exactbits")),
         bool(_conf.get("auron.pallas.enable")),
         str(_conf.get("auron.agg.grouping.strategy")),
         int(_conf.get("auron.string.device.max.width")),
@@ -1530,28 +1574,51 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                 if tracer.shrink_guards else jnp.zeros(0, bool)
             join_guards = jnp.stack(tracer.join_guards) \
                 if tracer.join_guards else jnp.zeros(0, bool)
-            return (out.cols, out.live, guards, retry_guards,
+            cols, live = out.cols, out.live
+            count = jnp.sum(live.astype(jnp.int32))[None]
+            if compact_gather:
+                # compact live rows to the shard front so the host can
+                # fetch ONLY a bucket_capacity(count) slice instead of the
+                # full padded capacity — on a tunnel-attached TPU the
+                # capacity-sized fetch dominated warm query time (VERDICT
+                # r4 #2: "gather only final aggregated rows")
+                perm = jnp.argsort(jnp.logical_not(live),
+                                   stable=True).astype(jnp.int32)
+                ok = jnp.take(live, perm)
+                cols = [c.gather(perm, ok) for c in cols]
+                live = ok
+            return (cols, live, count, guards, retry_guards,
                     shrink_guards, join_guards)
 
         shard = jax.jit(jax.shard_map(
             program, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: PS(axis), host_inputs),),
-            out_specs=(PS(axis), PS(axis), PS(), PS(), PS(), PS()),
+            out_specs=(PS(axis), PS(axis), PS(axis), PS(), PS(), PS(),
+                       PS()),
             check_vma=False))
     else:
         shard, schema_box = cached
 
-    (out_cols, out_live, guards, retry_guards, shrink_guards,
+    (out_cols, out_live, counts, guards, retry_guards, shrink_guards,
      join_guards) = shard(host_inputs)
     if cached is None:
         _PROGRAM_CACHE[cache_key] = (shard, schema_box)
     out_schema = schema_box[0]
 
-    # gather + compact on host (one batched fetch, guards included)
     from auron_tpu.ops.kernel_cache import host_sync
-    (out_live_np, out_cols_np, guards_np, retry_np, shrink_np,
-     join_np) = host_sync((out_live, out_cols, guards, retry_guards,
-                           shrink_guards, join_guards))
+    if compact_gather:
+        # phase 1: a few BYTES decide everything — per-shard live counts
+        # + guard bits.  A tripped guard never pays the output fetch at
+        # all, and a clean run fetches only the compacted slice below.
+        (counts_np, guards_np, retry_np, shrink_np, join_np) = host_sync(
+            (counts, guards, retry_guards, shrink_guards, join_guards))
+    else:
+        # single batched fetch (CPU: transfers are memcpy-cheap, two
+        # round trips would only add dispatch latency)
+        (out_live_np, out_cols_np, counts_np, guards_np, retry_np,
+         shrink_np, join_np) = host_sync(
+            (out_live, out_cols, counts, guards, retry_guards,
+             shrink_guards, join_guards))
     if np.any(np.asarray(guards_np)):
         raise SpmdGuardTripped(
             "runtime guard tripped (exchange quota overflow, or "
@@ -1569,7 +1636,22 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
         raise SpmdGuardTripped(
             "duplicate-key build side at match factor 1: result "
             "discarded", retryable=True)
+    if compact_gather:
+        # phase 2: slice each shard to the smallest capacity bucket that
+        # holds its rows (one tiny cached program), then fetch that
+        per_cap = out_live.shape[0] // n_dev
+        kmax = max(int(np.max(np.asarray(counts_np))), 1)
+        K = min(bucket_capacity(kmax), per_cap)
+        if K < per_cap:
+            slicer = _gather_slicer(mesh, axis, K, out_cols, out_live)
+            out_cols, out_live = slicer(out_cols, out_live)
+        out_live_np, out_cols_np = host_sync((out_live, out_cols))
     live_np = np.asarray(out_live_np)
+    GATHER_STATS["rows"] = int(np.asarray(counts_np).sum())
+    GATHER_STATS["capacity"] = int(live_np.shape[0])
+    GATHER_STATS["bytes"] = int(sum(
+        np.asarray(x).nbytes for x in jax.tree.leaves(out_cols_np))) + \
+        live_np.nbytes
     arrays = []
     for f, c in zip(out_schema, out_cols_np):
         from auron_tpu.columnar.arrow_interop import column_to_arrow

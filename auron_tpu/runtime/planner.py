@@ -150,7 +150,8 @@ class PhysicalPlanner:
         return GenerateExec(self.create_plan(n.child), n.generator, n.args,
                             n.generator_output_names,
                             n.generator_output_types,
-                            n.required_child_output, n.outer, n.udtf)
+                            n.required_child_output, n.outer, n.udtf,
+                            wire=n.wire)
 
     def _rename_columns(self, n: P.RenameColumns) -> Operator:
         return RenameColumnsExec(self.create_plan(n.child), n.names)

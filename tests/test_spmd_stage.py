@@ -976,3 +976,82 @@ def test_source_cache_budget_zero_flushes_and_scan_fp_invalidates(tmp_path):
         sctx2, mesh, {})
     assert sorted(out2.column("a").to_pylist()) == list(range(7)), \
         "stale scan table served after the file changed"
+
+
+def test_spmd_compact_gather_matches_full_fetch():
+    """Two-phase compact gather (auron.spmd.gather.compact=on): identical
+    results to the full-capacity fetch, and the fetched footprint shrinks
+    to the smallest capacity bucket holding the live rows (VERDICT r4
+    ask #2: gather only final aggregated rows, log the bytes)."""
+    from auron_tpu import conf
+    from auron_tpu.parallel.stage import GATHER_STATS
+
+    # large enough that per-shard capacity (n/8 rows -> 32k bucket) sits
+    # far above the 1024-row minimum bucket the compacted slice lands on
+    fact = make_fact(n=200_000, keys=16)
+    fact_schema = from_arrow_schema(fact.schema)
+    src = P.FFIReader(schema=fact_schema, resource_id="fact")
+    partial = P.Agg(
+        child=src, exec_mode="partial", grouping=(col("key"),),
+        grouping_names=("key",),
+        aggs=(AggExpr(fn="sum", children=(col("amount"),),
+                      return_type=F64),),
+        agg_names=("s",))
+    ctx = _Ctx()
+    ctx.exchanges["ex0"] = ShuffleJob(
+        rid="ex0", child=partial,
+        partitioning=P.Partitioning(mode="hash", num_partitions=8,
+                                    expressions=(col("key"),)),
+        schema=None)
+    final = P.Agg(
+        child=P.IpcReader(schema=None, resource_id="ex0"),
+        exec_mode="final", grouping=(col("key"),),
+        grouping_names=("key",),
+        aggs=(AggExpr(fn="sum", children=(col("amount"),),
+                      return_type=F64),),
+        agg_names=("s",))
+    mesh = data_mesh(8)
+
+    with conf.scoped({"auron.spmd.gather.compact": "off"}):
+        ctx_a = _Ctx(); ctx_a.exchanges = dict(ctx.exchanges)
+        full = execute_plan_spmd(final, ctx_a, mesh,
+                                 {"fact": fact}).to_pylist()
+        full_bytes = GATHER_STATS["bytes"]
+    with conf.scoped({"auron.spmd.gather.compact": "on"}):
+        ctx_b = _Ctx(); ctx_b.exchanges = dict(ctx.exchanges)
+        compact = execute_plan_spmd(final, ctx_b, mesh,
+                                    {"fact": fact}).to_pylist()
+        compact_bytes = GATHER_STATS["bytes"]
+        assert GATHER_STATS["rows"] == len(compact)
+    assert _canon(compact) == _canon(full)
+    # 16 groups over 8 shards: the compacted fetch must be far below the
+    # full padded capacity fetch
+    assert compact_bytes < full_bytes / 4, (compact_bytes, full_bytes)
+
+
+def test_spmd_compact_gather_guard_skips_fetch():
+    """A guard-tripped compact-gather run must still raise (and retry/
+    fall back) exactly like the full-fetch path — phase 1 carries the
+    guard bits."""
+    from auron_tpu import conf
+    from auron_tpu.parallel.stage import SpmdGuardTripped
+
+    fact = make_fact(n=4000, keys=1)   # extreme skew: all rows one key
+    fact_schema = from_arrow_schema(fact.schema)
+    src = P.FFIReader(schema=fact_schema, resource_id="fact")
+    ctx = _Ctx()
+    ctx.exchanges["ex0"] = ShuffleJob(
+        rid="ex0", child=P.Projection(
+            child=src, exprs=(col("key"), col("amount")),
+            names=("key", "amount")),
+        partitioning=P.Partitioning(mode="hash", num_partitions=8,
+                                    expressions=(col("key"),)),
+        schema=None)
+    reread = P.Projection(
+        child=P.IpcReader(schema=None, resource_id="ex0"),
+        exprs=(col("key"),), names=("key",))
+    mesh = data_mesh(8)
+    with conf.scoped({"auron.spmd.gather.compact": "on",
+                      "auron.spmd.exchange.quota.margin": 1.0}):
+        with pytest.raises(SpmdGuardTripped):
+            execute_plan_spmd(reread, ctx, mesh, {"fact": fact})

@@ -1,0 +1,288 @@
+"""Wire-registerable UDAF/UDTF (VERDICT r4 ask #9): aggregate and table
+functions defined as pure IR expression trees a foreign host can ship
+over the wire — no Python pickle, no code runtime.  The expression-tree
+analogue of the reference's JVM-callback wrappers
+(agg/spark_udaf_wrapper.rs:52, generate/spark_udtf_wrapper.rs)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.ir import expr as E
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.expr import (AggExpr, WireUdaf, WireUdtf, col, lit)
+from auron_tpu.ir.schema import DataType, from_arrow_schema
+from auron_tpu.runtime.executor import execute_plan
+from auron_tpu.runtime.resources import ResourceRegistry
+
+F64 = DataType.float64()
+I64 = DataType.int64()
+
+
+def _run(plan, tables):
+    res = ResourceRegistry()
+    for rid, t in tables.items():
+        res.put(rid, t.to_batches(max_chunksize=64))
+    return execute_plan(plan, resources=res).to_pylist()
+
+
+def make_fact(n=500, keys=8, seed=5):
+    rng = np.random.default_rng(seed)
+    w = rng.uniform(0.1, 2.0, n)
+    return pa.table({
+        "key": rng.integers(0, keys, n).astype(np.int64),
+        "x": rng.normal(10, 3, n),
+        "w": w,
+    })
+
+
+def weighted_avg_udaf():
+    """weighted_avg(x, w) = sum(x*w) / sum(w) — the classic algebraic
+    UDAF no built-in covers."""
+    return WireUdaf(
+        name="weighted_avg",
+        params=("x", "w"),
+        slot_names=("sxw", "sw"),
+        slot_ops=("sum", "sum"),
+        slot_types=(F64, F64),
+        updates=(E.BinaryExpr(left=col("x"), op="*", right=col("w")),
+                 col("w")),
+        finalize=E.BinaryExpr(left=col("sxw"), op="/", right=col("sw")))
+
+
+def test_wire_udaf_single_mode():
+    t = make_fact()
+    src = P.FFIReader(schema=from_arrow_schema(t.schema), resource_id="t")
+    plan = P.Agg(
+        child=src, exec_mode="single", grouping=(col("key"),),
+        grouping_names=("key",),
+        aggs=(AggExpr(fn="wire_udaf", children=(col("x"), col("w")),
+                      return_type=F64, wire=weighted_avg_udaf()),),
+        agg_names=("wavg",))
+    got = {r["key"]: r["wavg"] for r in _run(plan, {"t": t})}
+    key = t.column("key").to_numpy()
+    x = t.column("x").to_numpy()
+    w = t.column("w").to_numpy()
+    for k in np.unique(key):
+        m = key == k
+        assert got[k] == pytest.approx(
+            float((x[m] * w[m]).sum() / w[m].sum()), rel=1e-9)
+
+
+def test_wire_udaf_partial_final_roundtrip():
+    """partial -> final must merge slot states correctly (sum-merge)."""
+    t = make_fact(n=300, keys=4)
+    src = P.FFIReader(schema=from_arrow_schema(t.schema), resource_id="t")
+    wire = weighted_avg_udaf()
+    partial = P.Agg(
+        child=src, exec_mode="partial", grouping=(col("key"),),
+        grouping_names=("key",),
+        aggs=(AggExpr(fn="wire_udaf", children=(col("x"), col("w")),
+                      return_type=F64, wire=wire),),
+        agg_names=("wavg",))
+    final = P.Agg(
+        child=partial, exec_mode="final", grouping=(col("key"),),
+        grouping_names=("key",),
+        aggs=(AggExpr(fn="wire_udaf", children=(col("x"), col("w")),
+                      return_type=F64, wire=wire),),
+        agg_names=("wavg",))
+    got = {r["key"]: r["wavg"] for r in _run(final, {"t": t})}
+    key = t.column("key").to_numpy()
+    x = t.column("x").to_numpy()
+    w = t.column("w").to_numpy()
+    for k in np.unique(key):
+        m = key == k
+        assert got[k] == pytest.approx(
+            float((x[m] * w[m]).sum() / w[m].sum()), rel=1e-9)
+
+
+def test_wire_udaf_minmax_count_slots():
+    """range_ratio(x) = (max-min)/count: exercises min/max/count slots."""
+    t = make_fact(n=200, keys=4)
+    wire = WireUdaf(
+        name="range_ratio", params=("x",),
+        slot_names=("mx", "mn", "cnt"),
+        slot_ops=("max", "min", "count"),
+        slot_types=(F64, F64, I64),
+        updates=(col("x"), col("x"), col("x")),
+        finalize=E.BinaryExpr(
+            left=E.BinaryExpr(left=col("mx"), op="-", right=col("mn")),
+            op="/", right=E.Cast(child=col("cnt"), dtype=F64)))
+    src = P.FFIReader(schema=from_arrow_schema(t.schema), resource_id="t")
+    plan = P.Agg(
+        child=src, exec_mode="single", grouping=(col("key"),),
+        grouping_names=("key",),
+        aggs=(AggExpr(fn="wire_udaf", children=(col("x"),),
+                      return_type=F64, wire=wire),),
+        agg_names=("rr",))
+    got = {r["key"]: r["rr"] for r in _run(plan, {"t": t})}
+    key = t.column("key").to_numpy()
+    x = t.column("x").to_numpy()
+    for k in np.unique(key):
+        m = key == k
+        assert got[k] == pytest.approx(
+            float((x[m].max() - x[m].min()) / m.sum()), rel=1e-9)
+
+
+def test_wire_udaf_rides_spmd_stage():
+    from auron_tpu.frontend.converters import ShuffleJob
+    from auron_tpu.parallel.mesh import data_mesh
+    from auron_tpu.parallel.stage import execute_plan_spmd
+
+    class _Ctx:
+        exchanges: dict
+        broadcasts: dict
+
+    t = make_fact(n=2000, keys=16)
+    src = P.FFIReader(schema=from_arrow_schema(t.schema), resource_id="t")
+    wire = weighted_avg_udaf()
+    agg_args = dict(
+        grouping=(col("key"),), grouping_names=("key",),
+        aggs=(AggExpr(fn="wire_udaf", children=(col("x"), col("w")),
+                      return_type=F64, wire=wire),),
+        agg_names=("wavg",))
+    partial = P.Agg(child=src, exec_mode="partial", **agg_args)
+    ctx = _Ctx()
+    ctx.exchanges = {"ex0": ShuffleJob(
+        rid="ex0", child=partial,
+        partitioning=P.Partitioning(mode="hash", num_partitions=8,
+                                    expressions=(col("key"),)),
+        schema=None)}
+    ctx.broadcasts = {}
+    final = P.Agg(child=P.IpcReader(schema=None, resource_id="ex0"),
+                  exec_mode="final", **agg_args)
+    got = {r["key"]: r["wavg"]
+           for r in execute_plan_spmd(final, ctx, data_mesh(8),
+                                      {"t": t}).to_pylist()}
+    key = t.column("key").to_numpy()
+    x = t.column("x").to_numpy()
+    w = t.column("w").to_numpy()
+    for k in np.unique(key):
+        m = key == k
+        assert got[k] == pytest.approx(
+            float((x[m] * w[m]).sum() / w[m].sum()), rel=1e-9)
+
+
+def test_wire_udaf_serde_roundtrip():
+    from auron_tpu.ir import serde
+    wire = weighted_avg_udaf()
+    agg = AggExpr(fn="wire_udaf", children=(col("x"), col("w")),
+                  return_type=F64, wire=wire)
+    back = serde.deserialize(serde.serialize(agg))
+    assert back == agg
+    assert back.wire.slot_ops == ("sum", "sum")
+
+
+def test_wire_udaf_validation():
+    from auron_tpu.exprs.typing import validate_wire_udaf
+    ok = weighted_avg_udaf()
+    validate_wire_udaf(ok, (F64, F64))
+    import dataclasses
+    bad_op = dataclasses.replace(ok, slot_ops=("sum", "product"))
+    with pytest.raises(TypeError, match="unsupported slot op"):
+        validate_wire_udaf(bad_op, (F64, F64))
+    bad_scope = dataclasses.replace(
+        ok, updates=(col("x"), col("not_a_param")))
+    with pytest.raises(TypeError, match="outside its scope"):
+        validate_wire_udaf(bad_scope, (F64, F64))
+    bad_final = dataclasses.replace(
+        ok, finalize=E.BinaryExpr(left=col("sxw"), op="/",
+                                  right=col("x")))
+    with pytest.raises(TypeError, match="outside its scope"):
+        validate_wire_udaf(bad_final, (F64, F64))
+    bad_bound = dataclasses.replace(
+        ok, updates=(E.BoundReference(index=0), col("w")))
+    with pytest.raises(TypeError, match="may not contain"):
+        validate_wire_udaf(bad_bound, (F64, F64))
+    bad_arity = dataclasses.replace(ok, params=("x",))
+    with pytest.raises(TypeError, match="params but"):
+        validate_wire_udaf(bad_arity, (F64, F64))
+
+
+# ---------------------------------------------------------------------------
+# wire UDTF
+# ---------------------------------------------------------------------------
+
+def stack_udtf():
+    """stack-style unpivot: (a, b) -> two rows (label, value), the b-row
+    guarded on b > 0."""
+    return WireUdtf(
+        name="unpivot_pos", params=("a", "b"),
+        rows=((lit("a"), col("a")),
+              (lit("b"), col("b"))),
+        whens=(None,
+               E.BinaryExpr(left=col("b"), op=">", right=lit(0.0))))
+
+
+def test_wire_udtf_generate():
+    t = pa.table({
+        "id": np.arange(4, dtype=np.int64),
+        "a": np.array([1.0, 2.0, 3.0, 4.0]),
+        "b": np.array([-1.0, 5.0, -2.0, 6.0]),
+    })
+    src = P.FFIReader(schema=from_arrow_schema(t.schema), resource_id="t")
+    gen = P.Generate(
+        child=src, generator="wire_udtf",
+        args=(col("a"), col("b")),
+        generator_output_names=("label", "value"),
+        generator_output_types=(DataType.string(), F64),
+        required_child_output=(0,),
+        wire=stack_udtf())
+    got = _run(gen, {"t": t})
+    # every row emits its 'a' tuple; 'b' tuples only where b > 0
+    want = []
+    for i in range(4):
+        want.append({"id": i, "label": "a", "value": float(i + 1)})
+        bv = [-1.0, 5.0, -2.0, 6.0][i]
+        if bv > 0:
+            want.append({"id": i, "label": "b", "value": bv})
+    assert got == want
+
+
+def test_wire_udtf_outer_emits_null_row():
+    t = pa.table({"id": np.array([0], np.int64),
+                  "a": np.array([1.0]), "b": np.array([2.0])})
+    wire = WireUdtf(
+        name="never", params=("a", "b"),
+        rows=((lit("x"), col("a")),),
+        whens=(E.BinaryExpr(left=col("b"), op="<", right=lit(0.0)),))
+    src = P.FFIReader(schema=from_arrow_schema(t.schema), resource_id="t")
+    gen = P.Generate(
+        child=src, generator="wire_udtf", args=(col("a"), col("b")),
+        generator_output_names=("label", "value"),
+        generator_output_types=(DataType.string(), F64),
+        required_child_output=(0,), outer=True, wire=wire)
+    got = _run(gen, {"t": t})
+    assert got == [{"id": 0, "label": None, "value": None}]
+
+
+def test_wire_udtf_validation():
+    from auron_tpu.exprs.typing import validate_wire_udtf
+    import dataclasses
+    ok = stack_udtf()
+    validate_wire_udtf(ok, (F64, F64))
+    with pytest.raises(TypeError, match="ragged"):
+        validate_wire_udtf(dataclasses.replace(
+            ok, rows=((lit("a"), col("a")), (lit("b"),))), (F64, F64))
+    with pytest.raises(TypeError, match="outside its scope"):
+        validate_wire_udtf(dataclasses.replace(
+            ok, rows=((lit("a"), col("zzz")), (lit("b"), col("b")))),
+            (F64, F64))
+    with pytest.raises(TypeError, match="whens for"):
+        validate_wire_udtf(dataclasses.replace(
+            ok, whens=(None,)), (F64, F64))
+
+
+def test_wire_udtf_serde_roundtrip():
+    from auron_tpu.ir import serde
+    t_schema = from_arrow_schema(pa.schema([("a", pa.float64()),
+                                            ("b", pa.float64())]))
+    gen = P.Generate(
+        child=P.FFIReader(schema=t_schema, resource_id="t"),
+        generator="wire_udtf", args=(col("a"), col("b")),
+        generator_output_names=("label", "value"),
+        generator_output_types=(DataType.string(), F64),
+        wire=stack_udtf())
+    back = serde.deserialize(serde.serialize(gen))
+    assert back == gen
+    assert back.wire.rows[0][0].value == "a"
