@@ -280,6 +280,18 @@ SORT_MULTIPASS = conf.define(
     "while on CPU the fused comparator sort compiles fast and runs "
     "faster); 'on'/'off' force one form.",
 )
+SMJ_WINDOW_MAX_ROWS = conf.define(
+    "auron.smj.window.max.rows", 1 << 20,
+    "Cap on the build rows one streaming-SMJ window may materialize on "
+    "device.  A window that exceeds it AND holds a single key (the "
+    "degenerate all-ties shape: every row one join key) escapes to a "
+    "bounded giant-group join — build chunks spill to storage and the "
+    "probe window re-streams per chunk, so resident memory stays "
+    "O(cap + one batch) instead of O(group).  Windows with multiple "
+    "keys keep the normal path (they are batch-bounded by the frontier "
+    "advance).  0 disables the cap.  (The role of the reference's "
+    "SMJ_FALLBACK_* knobs, conf.rs.)",
+)
 SPMD_GATHER_COMPACT = conf.define(
     "auron.spmd.gather.compact", "auto",
     "Two-phase result gather for SPMD stage programs: the program "

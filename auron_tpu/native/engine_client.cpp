@@ -223,6 +223,73 @@ std::string wire_udf_affine(const std::string& arg_col) {
          "\"args\":[" + col_ref(arg_col) + "]}";
 }
 
+std::string wire_udaf_wavg() {
+  // wavg(x, w) = sum(x*w)/sum(w) shipped AS EXPRESSION TREES (ir/expr.py
+  // WireUdaf): two sum slots + a finalize ratio — an aggregate the
+  // engine has no builtin for, crossing the boundary with zero code
+  // (the C++-host counterpart of agg/spark_udaf_wrapper.rs:52)
+  return "{\"@kind\":\"wire_udaf\",\"name\":\"wavg\","
+         "\"params\":[\"x\",\"w\"],"
+         "\"slot_names\":[\"sxw\",\"sw\"],"
+         "\"slot_ops\":[\"sum\",\"sum\"],"
+         "\"slot_types\":[{\"@type\":\"FLOAT64\"},{\"@type\":\"FLOAT64\"}],"
+         "\"updates\":[{\"@kind\":\"binary\",\"left\":{\"@kind\":\"column\","
+         "\"name\":\"x\"},\"op\":\"*\",\"right\":{\"@kind\":\"column\","
+         "\"name\":\"w\"}},{\"@kind\":\"column\",\"name\":\"w\"}],"
+         "\"finalize\":{\"@kind\":\"binary\",\"left\":{\"@kind\":\"column\","
+         "\"name\":\"sxw\"},\"op\":\"/\",\"right\":{\"@kind\":\"column\","
+         "\"name\":\"sw\"}}}";
+}
+
+std::string agg_wire_udaf_over_ffi(const std::string& rid) {
+  // Agg(single, group by k, wavg(v, v)) — per group v is constant, so
+  // sum(v*v)/sum(v) == v: exactly verifiable host-side
+  std::ostringstream p;
+  p << "{\"@kind\":\"agg\",\"agg_names\":[\"wavg\",\"c\"],\"aggs\":["
+       "{\"@kind\":\"agg_expr\",\"children\":[" << col_ref("v") << ","
+    << col_ref("v") << "],\"distinct\":false,\"fn\":\"wire_udaf\","
+       "\"return_type\":{\"@type\":\"FLOAT64\"},\"udaf\":null,\"wire\":"
+    << wire_udaf_wavg()
+    << "},{\"@kind\":\"agg_expr\",\"children\":[" << col_ref("v")
+    << "],\"distinct\":false,\"fn\":\"count\",\"return_type\":"
+       "{\"@type\":\"INT64\"},\"udaf\":null}],"
+       "\"child\":{\"@kind\":\"ffi_reader\",\"resource_id\":\"" << rid
+    << "\",\"schema\":{\"@schema\":[{\"@field\":\"k\",\"dtype\":"
+       "{\"@type\":\"INT64\"},\"nullable\":true},{\"@field\":\"v\","
+       "\"dtype\":{\"@type\":\"FLOAT64\"},\"nullable\":true}]}},"
+       "\"exec_mode\":\"single\",\"grouping\":[" << col_ref("k")
+    << "],\"grouping_names\":[\"k\"],\"supports_partial_skipping\":false}";
+  return p.str();
+}
+
+std::string generate_wire_udtf_over_ffi(const std::string& rid) {
+  // Generate(wire_udtf): per input row emit ("v", v) always and
+  // ("big", v) only where v > 4.0 — a stack/unpivot-style generator
+  // shipped as static row templates with a guard (ir/expr.py WireUdtf;
+  // the wire counterpart of generate/spark_udtf_wrapper.rs)
+  std::ostringstream p;
+  p << "{\"@kind\":\"generate\",\"args\":[" << col_ref("v") << "],"
+       "\"child\":{\"@kind\":\"ffi_reader\",\"resource_id\":\"" << rid
+    << "\",\"schema\":{\"@schema\":[{\"@field\":\"k\",\"dtype\":"
+       "{\"@type\":\"INT64\"},\"nullable\":true},{\"@field\":\"v\","
+       "\"dtype\":{\"@type\":\"FLOAT64\"},\"nullable\":true}]}},"
+       "\"generator\":\"wire_udtf\","
+       "\"generator_output_names\":[\"label\",\"value\"],"
+       "\"generator_output_types\":[{\"@type\":\"STRING\"},"
+       "{\"@type\":\"FLOAT64\"}],"
+       "\"required_child_output\":[0],\"outer\":false,\"udtf\":null,"
+       "\"wire\":{\"@kind\":\"wire_udtf\",\"name\":\"split\","
+       "\"params\":[\"a\"],"
+       "\"rows\":[[{\"@kind\":\"literal\",\"value\":\"v\",\"dtype\":"
+       "{\"@type\":\"STRING\"}},{\"@kind\":\"column\",\"name\":\"a\"}],"
+       "[{\"@kind\":\"literal\",\"value\":\"big\",\"dtype\":"
+       "{\"@type\":\"STRING\"}},{\"@kind\":\"column\",\"name\":\"a\"}]],"
+       "\"whens\":[null,{\"@kind\":\"binary\",\"left\":{\"@kind\":"
+       "\"column\",\"name\":\"a\"},\"op\":\">\",\"right\":{\"@kind\":"
+       "\"literal\",\"value\":4.0,\"dtype\":{\"@type\":\"FLOAT64\"}}}]}}";
+  return p.str();
+}
+
 std::string task_definition(const std::string& plan) {
   std::string json =
       "{\"@kind\":\"task_definition\",\"host_threads\":0,"
@@ -374,6 +441,67 @@ int main(int argc, char** argv) {
     if (groups != 8) die("udf: expected 8 groups");
     if (sum_c != N) die("udf: count mismatch");
     if (std::abs(sum_s - want) > 1e-6) die("udf: sum(2v+1) mismatch");
+  }
+
+  // 6. a WIRE-REGISTERED UDAF: wavg(v, v) = sum(v*v)/sum(v) shipped as
+  //    expression trees; per group v is constant so the result must be
+  //    exactly that group's v (k*1.5 + 1)
+  {
+    ExecResult ar = run_execute(
+        fd, task_definition(agg_wire_udaf_over_ffi("cppsrc")), "", "");
+    if (ar.error) die("wire_udaf execute failed: " + ar.error_message);
+    int64_t groups = 0, sum_c = 0;
+    for (const auto& rb : ar.batches) {
+      auto k = std::static_pointer_cast<arrow::Int64Array>(
+          rb->GetColumnByName("k"));
+      auto wv = std::static_pointer_cast<arrow::DoubleArray>(
+          rb->GetColumnByName("wavg"));
+      auto c = std::static_pointer_cast<arrow::Int64Array>(
+          rb->GetColumnByName("c"));
+      for (int64_t i = 0; i < rb->num_rows(); ++i) {
+        double want = static_cast<double>(k->Value(i)) * 1.5 + 1.0;
+        if (std::abs(wv->Value(i) - want) > 1e-9)
+          die("wire_udaf: wavg mismatch for group " +
+              std::to_string(k->Value(i)));
+        sum_c += c->Value(i);
+        ++groups;
+      }
+    }
+    if (groups != 8) die("wire_udaf: expected 8 groups");
+    if (sum_c != N) die("wire_udaf: count mismatch");
+  }
+
+  // 7. a WIRE-REGISTERED UDTF: per input row emit ("v", v) always and
+  //    ("big", v) where v > 4 — verify fan-out count and value sum
+  {
+    ExecResult gr = run_execute(
+        fd, task_definition(generate_wire_udtf_over_ffi("cppsrc")),
+        "", "");
+    if (gr.error) die("wire_udtf execute failed: " + gr.error_message);
+    int64_t rows = 0, bigs = 0;
+    double sum_v = 0.0;
+    for (const auto& rb : gr.batches) {
+      // engine strings ride as large_utf8 (ir/schema.py to_arrow_type)
+      auto lbl = std::static_pointer_cast<arrow::LargeStringArray>(
+          rb->GetColumnByName("label"));
+      auto val = std::static_pointer_cast<arrow::DoubleArray>(
+          rb->GetColumnByName("value"));
+      for (int64_t i = 0; i < rb->num_rows(); ++i) {
+        ++rows;
+        sum_v += val->Value(i);
+        if (lbl->GetString(i) == "big") ++bigs;
+      }
+    }
+    int64_t want_bigs = 0;
+    double want_sum = 0.0;
+    for (int64_t i = 0; i < N; ++i) {
+      double v = static_cast<double>(i % 8) * 1.5 + 1.0;
+      want_sum += v;
+      if (v > 4.0) { ++want_bigs; want_sum += v; }
+    }
+    if (rows != N + want_bigs) die("wire_udtf: row fan-out mismatch");
+    if (bigs != want_bigs) die("wire_udtf: guard mismatch");
+    if (std::abs(sum_v - want_sum) > 1e-6) die("wire_udtf: sum mismatch");
   }
 
   ::close(fd);

@@ -225,6 +225,32 @@ def rows_below_frontier(key_cols: List[Any], frontier: HostKey,
     return lt
 
 
+def rows_equal_key(key_cols: List[Any], key: HostKey,
+                   orders: Tuple[Tuple[bool, bool], ...],
+                   capacity: int):
+    """bool[capacity]: row key exactly equal to `key` under the engine's
+    key encoding (the giant-group escape classifies probe rows against
+    the window's single build key).  Host-column fallback mirrors
+    rows_below_frontier."""
+    if any(isinstance(c, HostColumn) for c in key_cols):
+        n = min(c.capacity for c in key_cols
+                if isinstance(c, HostColumn))
+        keys = host_keys_of_rows(key_cols, list(range(n)))
+        mask = np.zeros(capacity, bool)
+        for i, k in enumerate(keys):
+            mask[i] = cmp_keys(k, key, orders) == 0
+        return jnp.asarray(mask)
+    eq = None
+    for col, fval, (asc, nf) in zip(key_cols, key, orders):
+        col, fcol = _scalar_key_column(col, fval)
+        words = encode_key_column(col, asc, nf)
+        fwords = encode_key_column(fcol, asc, nf)
+        for w, fw in zip(words, fwords):
+            e = w == fw[0]
+            eq = e if eq is None else jnp.logical_and(eq, e)
+    return eq
+
+
 def split_batch(b: Batch, key_cols: List[Any], frontier: HostKey,
                 orders) -> Tuple[Optional[Batch], Optional[Batch]]:
     """-> (ready, keep): rows strictly below / at-or-above the frontier."""
