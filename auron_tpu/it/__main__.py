@@ -26,6 +26,11 @@ def main() -> int:
                          "program over an N-device mesh (N=1 compiles "
                          "the whole pipeline for a single chip; serial "
                          "fallback stays transparent)")
+    ap.add_argument("--perf-factor", type=float, default=0.0,
+                    help="arm the perf gate: warm native (best of two "
+                         "post-compile runs, recorded as native_warm_s) "
+                         "must stay within FACTOR x the oracle; 0 = "
+                         "cold-only (no warm runs)")
     ap.add_argument("--stage-compare", action="store_true",
                     help="instead of the differential run, execute every "
                          "query through BOTH the serial walk and the "
@@ -67,6 +72,8 @@ def main() -> int:
         return _stage_compare(cat, args)
 
     runner = QueryRunner(catalog=cat, golden_dir=args.golden_dir)
+    if args.perf_factor:
+        runner.perf_factor = args.perf_factor
     if args.mesh:
         from auron_tpu.parallel.mesh import data_mesh
         runner.mesh = data_mesh(args.mesh)

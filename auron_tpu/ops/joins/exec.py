@@ -660,7 +660,6 @@ class SortMergeJoinExec(_HashJoinBase, MemConsumer):
 
         # 3. pair flavors: bounded cross product over chunk x probe batch
         if jt in _PAIR_SIDES and matched_probe and p_k > 0:
-            from auron_tpu.columnar.batch import concat_batches
             bschema = self.children[
                 1 if self.probe_is_left else 0].schema
             for sp in build_spills:

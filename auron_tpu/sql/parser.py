@@ -1,0 +1,677 @@
+"""Recursive-descent SQL parser for the TPC-DS query class.
+
+Grammar (v1): WITH CTEs, SELECT [DISTINCT], FROM with comma-joins and
+explicit [INNER|LEFT|RIGHT|FULL] JOIN ... ON, WHERE, GROUP BY, HAVING,
+ORDER BY [ASC|DESC] [NULLS FIRST|LAST], LIMIT, UNION ALL; expressions
+with OR/AND/NOT, comparisons, BETWEEN, [NOT] IN (list|subquery),
+[NOT] EXISTS, [NOT] LIKE, IS [NOT] NULL, arithmetic, CASE WHEN, CAST,
+function calls, window functions (fn() OVER (PARTITION BY .. ORDER BY
+..)), scalar subqueries, qualified column refs and `*`.
+
+Pure syntax here — resolution/typing/planning live in sql/lower.py.
+The reference delegates this layer to Spark's own parser; standalone,
+the engine needs its own front door.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class Col(Expr):
+    name: str
+    table: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    table: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Lit(Expr):
+    value: Any
+    kind: str  # int | float | str | date | null | bool
+
+
+@dataclass(frozen=True)
+class Bin(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class Un(Expr):
+    op: str            # not | neg
+    child: Expr
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    child: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    child: Expr
+    lo: Expr
+    hi: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    child: Expr
+    values: Tuple[Expr, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InSubquery(Expr):
+    child: Expr
+    query: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Exists(Expr):
+    query: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    query: "Select"
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    child: Expr
+    pattern: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    branches: Tuple[Tuple[Expr, Expr], ...]
+    else_expr: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    child: Expr
+    type_name: str
+
+
+@dataclass(frozen=True)
+class Call(Expr):
+    name: str
+    args: Tuple[Expr, ...]
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class WindowCall(Expr):
+    call: Call
+    partition_by: Tuple[Expr, ...]
+    order_by: Tuple["SortItem", ...]
+
+
+@dataclass(frozen=True)
+class SortItem:
+    expr: Expr
+    asc: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class TableRef:
+    pass
+
+
+@dataclass(frozen=True)
+class BaseTable(TableRef):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubqueryTable(TableRef):
+    query: "Select"
+    alias: str
+
+
+@dataclass(frozen=True)
+class Join(TableRef):
+    left: TableRef
+    right: TableRef
+    kind: str                 # inner | left | right | full | cross
+    on: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class Select:
+    items: Tuple[SelectItem, ...]
+    from_: Optional[TableRef]
+    where: Optional[Expr] = None
+    group_by: Tuple[Expr, ...] = ()
+    having: Optional[Expr] = None
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    distinct: bool = False
+    ctes: Tuple[Tuple[str, "Select"], ...] = ()
+    union_all: Tuple["Select", ...] = ()   # additional UNION ALL branches
+
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<num>\d+\.\d*|\.\d+|\d+)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<op><>|!=|>=|<=|\|\||[(),.*+\-/%<>=])
+""", re.VERBOSE)
+
+
+class SqlError(ValueError):
+    pass
+
+
+def _lex(sql: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise SqlError(f"lex error at {sql[pos:pos + 30]!r}")
+        pos = m.end()
+        if m.lastgroup == "ws":
+            continue
+        out.append((m.lastgroup, m.group()))
+    out.append(("eof", ""))
+    return out
+
+
+_KEYWORDS = {
+    "select", "distinct", "from", "where", "group", "by", "having",
+    "order", "limit", "as", "and", "or", "not", "in", "is", "null",
+    "like", "between", "case", "when", "then", "else", "end", "cast",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on",
+    "union", "all", "exists", "with", "asc", "desc", "nulls", "first",
+    "last", "over", "partition", "date", "interval", "true", "false",
+}
+
+
+class _P:
+    def __init__(self, toks: List[Tuple[str, str]]):
+        self.toks = toks
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+
+    def peek(self) -> Tuple[str, str]:
+        return self.toks[self.i]
+
+    def kw(self, *words: str) -> bool:
+        """Next token is one of these keywords (case-insensitive)?"""
+        k, v = self.peek()
+        return k == "name" and v.lower() in words
+
+    def eat_kw(self, *words: str) -> bool:
+        if self.kw(*words):
+            self.i += 1
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.eat_kw(word):
+            raise SqlError(f"expected {word.upper()} at {self._ctx()}")
+
+    def op(self, *ops: str) -> bool:
+        k, v = self.peek()
+        return k == "op" and v in ops
+
+    def eat_op(self, *ops: str) -> Optional[str]:
+        if self.op(*ops):
+            v = self.peek()[1]
+            self.i += 1
+            return v
+        return None
+
+    def expect_op(self, o: str) -> None:
+        if not self.eat_op(o):
+            raise SqlError(f"expected {o!r} at {self._ctx()}")
+
+    def _ctx(self) -> str:
+        return " ".join(v for _, v in self.toks[self.i:self.i + 6])
+
+    def name(self) -> str:
+        k, v = self.peek()
+        if k != "name" or v.lower() in _KEYWORDS - {
+                "date", "first", "last", "left", "right"}:
+            raise SqlError(f"expected identifier at {self._ctx()}")
+        self.i += 1
+        return v
+
+    # -- entry -------------------------------------------------------------
+
+    def parse(self) -> Select:
+        s = self.select_stmt()
+        if self.peek()[0] != "eof":
+            raise SqlError(f"trailing input: {self._ctx()}")
+        return s
+
+    def select_stmt(self) -> Select:
+        ctes: List[Tuple[str, Select]] = []
+        if self.eat_kw("with"):
+            while True:
+                nm = self.name()
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.select_stmt()
+                self.expect_op(")")
+                ctes.append((nm.lower(), q))
+                if not self.eat_op(","):
+                    break
+        first = self.select_core()
+        branches: List[Select] = []
+        while self.kw("union"):
+            self.i += 1
+            self.expect_kw("all")
+            branches.append(self.select_core())
+        # ORDER BY / LIMIT after a union apply to the WHOLE union, but
+        # select_core greedily parses them into the last branch — lift
+        order, limit = self.order_limit()
+        if branches and (branches[-1].order_by or
+                         branches[-1].limit is not None):
+            last = branches[-1]
+            if order or limit is not None:
+                raise SqlError("duplicate ORDER BY/LIMIT")
+            order, limit = last.order_by, last.limit
+            branches[-1] = Select(
+                items=last.items, from_=last.from_, where=last.where,
+                group_by=last.group_by, having=last.having,
+                distinct=last.distinct)
+        if branches:
+            first = Select(items=first.items, from_=first.from_,
+                           where=first.where, group_by=first.group_by,
+                           having=first.having, order_by=first.order_by,
+                           limit=first.limit, distinct=first.distinct,
+                           union_all=tuple(branches))
+        if order or limit is not None:
+            if first.order_by or first.limit is not None:
+                raise SqlError("duplicate ORDER BY/LIMIT")
+            first = Select(items=first.items, from_=first.from_,
+                           where=first.where, group_by=first.group_by,
+                           having=first.having, order_by=order,
+                           limit=limit, distinct=first.distinct,
+                           union_all=first.union_all)
+        if ctes:
+            first = Select(items=first.items, from_=first.from_,
+                           where=first.where, group_by=first.group_by,
+                           having=first.having, order_by=first.order_by,
+                           limit=first.limit, distinct=first.distinct,
+                           ctes=tuple(ctes), union_all=first.union_all)
+        return first
+
+    def order_limit(self):
+        order: Tuple[SortItem, ...] = ()
+        limit: Optional[int] = None
+        if self.kw("order"):
+            self.i += 1
+            self.expect_kw("by")
+            order = tuple(self.sort_items())
+        if self.eat_kw("limit"):
+            k, v = self.peek()
+            if k != "num":
+                raise SqlError(f"expected LIMIT count at {self._ctx()}")
+            limit = int(v)
+            self.i += 1
+        return order, limit
+
+    def sort_items(self) -> List[SortItem]:
+        out = []
+        while True:
+            e = self.expr()
+            asc = True
+            if self.eat_kw("asc"):
+                pass
+            elif self.eat_kw("desc"):
+                asc = False
+            nf: Optional[bool] = None
+            if self.eat_kw("nulls"):
+                if self.eat_kw("first"):
+                    nf = True
+                elif self.eat_kw("last"):
+                    nf = False
+                else:
+                    raise SqlError("expected FIRST|LAST after NULLS")
+            out.append(SortItem(expr=e, asc=asc, nulls_first=nf))
+            if not self.eat_op(","):
+                return out
+
+    def select_core(self) -> Select:
+        self.expect_kw("select")
+        distinct = self.eat_kw("distinct")
+        items = [self.select_item()]
+        while self.eat_op(","):
+            items.append(self.select_item())
+        from_: Optional[TableRef] = None
+        if self.eat_kw("from"):
+            from_ = self.table_expr()
+        where = self.expr() if self.eat_kw("where") else None
+        group: Tuple[Expr, ...] = ()
+        if self.kw("group"):
+            self.i += 1
+            self.expect_kw("by")
+            g = [self.expr()]
+            while self.eat_op(","):
+                g.append(self.expr())
+            group = tuple(g)
+        having = self.expr() if self.eat_kw("having") else None
+        order, limit = self.order_limit()
+        return Select(items=tuple(items), from_=from_, where=where,
+                      group_by=group, having=having, order_by=order,
+                      limit=limit, distinct=distinct)
+
+    def select_item(self) -> SelectItem:
+        if self.op("*"):
+            self.i += 1
+            return SelectItem(expr=Star())
+        e = self.expr()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.name()
+        elif self.peek()[0] == "name" and \
+                self.peek()[1].lower() not in _KEYWORDS:
+            alias = self.name()
+        return SelectItem(expr=e, alias=alias)
+
+    # -- FROM --------------------------------------------------------------
+
+    def table_expr(self) -> TableRef:
+        left = self.table_join()
+        while self.eat_op(","):
+            right = self.table_join()
+            left = Join(left=left, right=right, kind="cross", on=None)
+        return left
+
+    def table_join(self) -> TableRef:
+        left = self.table_primary()
+        while True:
+            kind = None
+            if self.eat_kw("join") or self.eat_kw("inner"):
+                if self.kw("join"):
+                    self.i += 1
+                kind = "inner"
+            elif self.kw("left", "right", "full"):
+                kind = self.peek()[1].lower()
+                self.i += 1
+                self.eat_kw("outer")
+                self.expect_kw("join")
+            elif self.eat_kw("cross"):
+                self.expect_kw("join")
+                kind = "cross"
+            if kind is None:
+                return left
+            right = self.table_primary()
+            on = None
+            if kind != "cross":
+                self.expect_kw("on")
+                on = self.expr()
+            left = Join(left=left, right=right, kind=kind, on=on)
+
+    def table_primary(self) -> TableRef:
+        if self.eat_op("("):
+            q = self.select_stmt()
+            self.expect_op(")")
+            self.eat_kw("as")
+            alias = self.name()
+            return SubqueryTable(query=q, alias=alias.lower())
+        nm = self.name()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.name()
+        elif self.peek()[0] == "name" and \
+                self.peek()[1].lower() not in _KEYWORDS:
+            alias = self.name()
+        return BaseTable(name=nm.lower(),
+                         alias=alias.lower() if alias else None)
+
+    # -- expressions ---------------------------------------------------------
+
+    def expr(self) -> Expr:
+        return self.or_expr()
+
+    def or_expr(self) -> Expr:
+        e = self.and_expr()
+        while self.eat_kw("or"):
+            e = Bin(op="or", left=e, right=self.and_expr())
+        return e
+
+    def and_expr(self) -> Expr:
+        e = self.not_expr()
+        while self.eat_kw("and"):
+            e = Bin(op="and", left=e, right=self.not_expr())
+        return e
+
+    def not_expr(self) -> Expr:
+        if self.eat_kw("not"):
+            return Un(op="not", child=self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> Expr:
+        if self.kw("exists"):
+            self.i += 1
+            self.expect_op("(")
+            q = self.select_stmt()
+            self.expect_op(")")
+            return Exists(query=q)
+        e = self.add_expr()
+        while True:
+            if self.eat_kw("is"):
+                neg = self.eat_kw("not")
+                self.expect_kw("null")
+                e = IsNull(child=e, negated=neg)
+                continue
+            negated = False
+            save = self.i
+            if self.eat_kw("not"):
+                negated = True
+            if self.eat_kw("between"):
+                lo = self.add_expr()
+                self.expect_kw("and")
+                hi = self.add_expr()
+                e = Between(child=e, lo=lo, hi=hi, negated=negated)
+                continue
+            if self.eat_kw("in"):
+                self.expect_op("(")
+                if self.kw("select", "with"):
+                    q = self.select_stmt()
+                    self.expect_op(")")
+                    e = InSubquery(child=e, query=q, negated=negated)
+                else:
+                    vals = [self.expr()]
+                    while self.eat_op(","):
+                        vals.append(self.expr())
+                    self.expect_op(")")
+                    e = InList(child=e, values=tuple(vals),
+                               negated=negated)
+                continue
+            if self.eat_kw("like"):
+                e = Like(child=e, pattern=self.add_expr(),
+                         negated=negated)
+                continue
+            if negated:
+                self.i = save
+            op = self.eat_op("=", "<>", "!=", "<", "<=", ">", ">=")
+            if op:
+                rhs = self.add_expr()
+                e = Bin(op={"=": "==", "<>": "!=", "!=": "!="}.get(op, op),
+                        left=e, right=rhs)
+                continue
+            return e
+
+    def add_expr(self) -> Expr:
+        e = self.mul_expr()
+        while True:
+            op = self.eat_op("+", "-", "||")
+            if not op:
+                return e
+            e = Bin(op=op, left=e, right=self.mul_expr())
+
+    def mul_expr(self) -> Expr:
+        e = self.unary_expr()
+        while True:
+            op = self.eat_op("*", "/", "%")
+            if not op:
+                return e
+            e = Bin(op=op, left=e, right=self.unary_expr())
+
+    def unary_expr(self) -> Expr:
+        if self.eat_op("-"):
+            return Un(op="neg", child=self.unary_expr())
+        if self.eat_op("+"):
+            return self.unary_expr()
+        return self.primary()
+
+    def primary(self) -> Expr:
+        k, v = self.peek()
+        if self.eat_op("("):
+            if self.kw("select", "with"):
+                q = self.select_stmt()
+                self.expect_op(")")
+                return ScalarSubquery(query=q)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if k == "num":
+            self.i += 1
+            if "." in v:
+                return Lit(value=float(v), kind="float")
+            return Lit(value=int(v), kind="int")
+        if k == "str":
+            self.i += 1
+            return Lit(value=v[1:-1].replace("''", "'"), kind="str")
+        if self.kw("null"):
+            self.i += 1
+            return Lit(value=None, kind="null")
+        if self.kw("true", "false"):
+            self.i += 1
+            return Lit(value=v.lower() == "true", kind="bool")
+        if self.kw("date"):
+            # DATE 'yyyy-mm-dd'
+            save = self.i
+            self.i += 1
+            nk, nv = self.peek()
+            if nk == "str":
+                self.i += 1
+                return Lit(value=nv[1:-1], kind="date")
+            self.i = save
+        if self.kw("case"):
+            return self.case_expr()
+        if self.kw("cast"):
+            self.i += 1
+            self.expect_op("(")
+            e = self.expr()
+            self.expect_kw("as")
+            tn = self.name().lower()
+            # decimal(p,s) / varchar(n) style suffix
+            if self.eat_op("("):
+                args = [self.peek()[1]]
+                self.i += 1
+                while self.eat_op(","):
+                    args.append(self.peek()[1])
+                    self.i += 1
+                self.expect_op(")")
+                tn = f"{tn}({','.join(args)})"
+            self.expect_op(")")
+            return Cast(child=e, type_name=tn)
+        if k == "name":
+            nm = self.name()
+            if self.eat_op("("):
+                return self.call_tail(nm)
+            if self.eat_op("."):
+                if self.op("*"):
+                    self.i += 1
+                    return Star(table=nm.lower())
+                col = self.name()
+                return Col(name=col.lower(), table=nm.lower())
+            return Col(name=nm.lower())
+        raise SqlError(f"unexpected token at {self._ctx()}")
+
+    def case_expr(self) -> Expr:
+        self.expect_kw("case")
+        operand: Optional[Expr] = None
+        if not self.kw("when"):
+            operand = self.expr()
+        branches: List[Tuple[Expr, Expr]] = []
+        while self.eat_kw("when"):
+            cond = self.expr()
+            if operand is not None:
+                cond = Bin(op="==", left=operand, right=cond)
+            self.expect_kw("then")
+            branches.append((cond, self.expr()))
+        else_e = self.expr() if self.eat_kw("else") else None
+        self.expect_kw("end")
+        return Case(branches=tuple(branches), else_expr=else_e)
+
+    def call_tail(self, nm: str) -> Expr:
+        name = nm.lower()
+        distinct = self.eat_kw("distinct")
+        args: Tuple[Expr, ...] = ()
+        if self.op("*"):
+            self.i += 1
+            args = (Star(),)
+        elif not self.op(")"):
+            lst = [self.expr()]
+            while self.eat_op(","):
+                lst.append(self.expr())
+            args = tuple(lst)
+        self.expect_op(")")
+        call = Call(name=name, args=args, distinct=distinct)
+        if self.eat_kw("over"):
+            self.expect_op("(")
+            part: Tuple[Expr, ...] = ()
+            order: Tuple[SortItem, ...] = ()
+            if self.eat_kw("partition"):
+                self.expect_kw("by")
+                p = [self.expr()]
+                while self.eat_op(","):
+                    p.append(self.expr())
+                part = tuple(p)
+            if self.kw("order"):
+                self.i += 1
+                self.expect_kw("by")
+                order = tuple(self.sort_items())
+            self.expect_op(")")
+            return WindowCall(call=call, partition_by=part,
+                              order_by=order)
+        return call
+
+
+def parse_sql(sql: str) -> Select:
+    return _P(_lex(sql)).parse()

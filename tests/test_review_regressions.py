@@ -176,3 +176,21 @@ def test_partial_skipping_single_batch():
         out = list(agg.execute(TaskContext()))
         assert agg._passthrough, "all-distinct keys must trigger skipping"
         assert sum(bb.num_rows for bb in out) == n
+
+
+def test_skipped_rows_never_count_as_green():
+    """VERDICT r4 weak #8: a skipped query is NOT RUN — the report must
+    exclude it from the pass denominator and name it loudly, and a
+    default runner must carry no exclusions at all."""
+    from auron_tpu.it.runner import QueryResult, QueryRunner
+    r = QueryRunner(catalog=None)
+    assert r.exclusions == {}, "default skip list must stay empty"
+    r.results = [
+        QueryResult(name="q01", ok=True, native_s=1, oracle_s=1,
+                    rows=5, all_native=True),
+        QueryResult(name="q02", ok=True, native_s=0, oracle_s=0,
+                    rows=0, all_native=False, skipped="budget"),
+    ]
+    rep = r.report()
+    assert "1/1 passed" in rep
+    assert "SKIPPED (NOT RUN): q02" in rep

@@ -119,9 +119,11 @@ def test_null_key_giant_group():
 
 
 def test_giant_group_fuzz_tiny_budget():
-    """Randomized all-ties-heavy corpora under a tiny window cap and a
-    tiny spill-trigger memory budget: results must match the uncapped
-    run for every flavor drawn."""
+    """Randomized all-ties-heavy corpora under a tiny window cap AND a
+    tiny memory-manager budget (so cursor buffers actually spill under
+    pressure while the escape iterates): results must match the
+    uncapped, unconstrained run for every flavor drawn."""
+    from auron_tpu.memmgr.manager import reset_manager
     rng = np.random.default_rng(123)
     for trial in range(4):
         giant = int(rng.integers(150, 400))
@@ -138,7 +140,14 @@ def test_giant_group_fuzz_tiny_budget():
         plan = _smj_plan(lt, rt, flavor)
         with conf.scoped({"auron.smj.window.max.rows": 0}):
             want = _run(plan, lt, rt, chunk=33)
-        with conf.scoped({"auron.smj.window.max.rows": 48}):
-            got = _run(plan, lt, rt, chunk=33)
+        try:
+            with conf.scoped({"auron.smj.window.max.rows": 48,
+                              "auron.memory.budget.bytes": 64 * 1024,
+                              "auron.memory.spill.min.trigger.bytes":
+                                  4096}):
+                reset_manager()
+                got = _run(plan, lt, rt, chunk=33)
+        finally:
+            reset_manager()
         assert _canon(got) == _canon(want), \
             f"trial {trial} flavor={flavor} giant={giant}"
