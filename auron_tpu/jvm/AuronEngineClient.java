@@ -13,6 +13,9 @@
 //   5. the mid-execution need_resource UPCALL served from Java
 //   6. an execution error ferried in-band with the connection reusable
 //   7. a wire_udf (expression-tree UDF) shipped inside the plan
+//   8. a wire_udaf (expression-tree aggregate: per-slot reduce ops +
+//      finalize) run inside an Agg — the same JSON the C++ client
+//      ships and the CI proves live against the service
 //
 // Usage: java AuronEngineClient HOST PORT TEMPLATE_DIR
 //   TEMPLATE_DIR holds schema_msg.bin / batch_meta.bin / eos.bin /
@@ -337,6 +340,41 @@ public final class AuronEngineClient {
         + "],\"grouping_names\":[\"k\"],\"supports_partial_skipping\":false}";
   }
 
+  static String wireUdafWavg() {
+    // wavg(x, w) = sum(x*w)/sum(w) shipped as expression trees
+    // (ir/expr.py WireUdaf — the C++ client's step 6 twin)
+    return "{\"@kind\":\"wire_udaf\",\"name\":\"wavg\","
+        + "\"params\":[\"x\",\"w\"],"
+        + "\"slot_names\":[\"sxw\",\"sw\"],"
+        + "\"slot_ops\":[\"sum\",\"sum\"],"
+        + "\"slot_types\":[{\"@type\":\"FLOAT64\"},{\"@type\":\"FLOAT64\"}],"
+        + "\"updates\":[{\"@kind\":\"binary\",\"left\":{\"@kind\":\"column\","
+        + "\"name\":\"x\"},\"op\":\"*\",\"right\":{\"@kind\":\"column\","
+        + "\"name\":\"w\"}},{\"@kind\":\"column\",\"name\":\"w\"}],"
+        + "\"finalize\":{\"@kind\":\"binary\",\"left\":{\"@kind\":\"column\","
+        + "\"name\":\"sxw\"},\"op\":\"/\",\"right\":{\"@kind\":\"column\","
+        + "\"name\":\"sw\"}}}";
+  }
+
+  static String aggWireUdafOverFfi(String rid) {
+    // Agg(single, group by k, wavg(v, v) + count(v)): per group v is
+    // constant so wavg == v — exactly verifiable host-side
+    return "{\"@kind\":\"agg\",\"agg_names\":[\"wavg\",\"c\"],\"aggs\":["
+        + "{\"@kind\":\"agg_expr\",\"children\":[" + colRef("v") + ","
+        + colRef("v") + "],\"distinct\":false,\"fn\":\"wire_udaf\","
+        + "\"return_type\":{\"@type\":\"FLOAT64\"},\"udaf\":null,\"wire\":"
+        + wireUdafWavg()
+        + "},{\"@kind\":\"agg_expr\",\"children\":[" + colRef("v")
+        + "],\"distinct\":false,\"fn\":\"count\",\"return_type\":"
+        + "{\"@type\":\"INT64\"},\"udaf\":null}],"
+        + "\"child\":{\"@kind\":\"ffi_reader\",\"resource_id\":\"" + rid
+        + "\",\"schema\":{\"@schema\":[{\"@field\":\"k\",\"dtype\":"
+        + "{\"@type\":\"INT64\"},\"nullable\":true},{\"@field\":\"v\","
+        + "\"dtype\":{\"@type\":\"FLOAT64\"},\"nullable\":true}]}},"
+        + "\"exec_mode\":\"single\",\"grouping\":[" + colRef("k")
+        + "],\"grouping_names\":[\"k\"],\"supports_partial_skipping\":false}";
+  }
+
   static byte[] taskDefinition(String plan) throws IOException {
     String json = "{\"@kind\":\"task_definition\",\"host_threads\":0,"
         + "\"num_partitions\":1,\"partition_id\":0,\"plan\":" + plan
@@ -452,6 +490,23 @@ public final class AuronEngineClient {
       verifyAgg(runExecute(in, out,
           taskDefinition(aggOverFfi("jvmsrc", wireUdfAffine("v"))),
           "", null), n, true);
+
+      // 6. wire_udaf: wavg(v, v) = sum(v*v)/sum(v) — per group v is
+      //    constant, so the result must equal that group's v
+      ExecResult ur = runExecute(in, out,
+          taskDefinition(aggWireUdafOverFfi("jvmsrc")), "", null);
+      if (ur.error) die("wire_udaf failed: " + ur.errorMessage);
+      long groups = 0, sumC = 0;
+      for (long[] row : ur.rows.rows) {
+        double wantV = (double) row[0] * 1.5 + 1.0;
+        double got = Double.longBitsToDouble(row[1]);
+        if (Math.abs(got - wantV) > 1e-9)
+          die("wire_udaf wavg mismatch for group " + row[0]);
+        sumC += row[2];
+        groups++;
+      }
+      if (groups != 8) die("wire_udaf: expected 8 groups");
+      if (sumC != n) die("wire_udaf: count mismatch");
     }
     System.out.println("JVM_CLIENT_OK");
   }

@@ -1,0 +1,87 @@
+"""CLI: a standalone SQL shell over the engine.
+
+    python -m auron_tpu.sql --data-dir /tmp/tpcds "select ..."
+    python -m auron_tpu.sql --data-dir /tmp/tpcds        # interactive
+
+Queries parse/plan through auron_tpu.sql and execute on the native
+engine (conversion strategy + SPMD stage compiler, exactly the corpus
+path).  The standalone face of the reference's spark-sql front door.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="auron_tpu.sql")
+    ap.add_argument("query", nargs="?", default=None,
+                    help="SQL text (omit for an interactive shell)")
+    ap.add_argument("--data-dir", default="/tmp/auron_tpcds")
+    ap.add_argument("--sf", type=float, default=0.01,
+                    help="generate TPC-DS subset data at this scale if "
+                         "the data dir is empty")
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--explain", action="store_true",
+                    help="print the lowered foreign plan instead of "
+                         "executing")
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it.datagen import generate
+    from auron_tpu.it.oracle import PyArrowEngine
+    from auron_tpu.sql import plan_sql
+    from auron_tpu.sql.parser import SqlError
+
+    cat = generate(args.data_dir, sf=args.sf)
+
+    def run_one(sql: str) -> int:
+        try:
+            plan = plan_sql(sql, cat)
+        except SqlError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.explain:
+            _render(plan)
+            return 0
+        session = AuronSession(foreign_engine=PyArrowEngine())
+        t0 = time.perf_counter()
+        res = session.execute(plan)
+        dt = time.perf_counter() - t0
+        print(res.table.to_pandas().to_string(index=False,
+                                              max_rows=100))
+        print(f"-- {res.table.num_rows} rows in {dt:.3f}s "
+              f"(native={'yes' if res.all_native() else 'PARTIAL'}, "
+              f"spmd={'yes' if res.spmd else 'no'})")
+        return 0
+
+    if args.query:
+        return run_one(args.query)
+    print("auron sql shell — ; to run, \\q to quit")
+    buf: list = []
+    for line in sys.stdin:
+        if line.strip() == "\\q":
+            break
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            sql = "".join(buf).rstrip().rstrip(";")
+            buf = []
+            if sql.strip():
+                run_one(sql)
+    return 0
+
+
+def _render(node, depth: int = 0) -> None:
+    print("  " * depth + node.op)
+    for c in node.children:
+        _render(c, depth + 1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

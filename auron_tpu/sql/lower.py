@@ -103,9 +103,20 @@ class _Ctx:
     ctes: Dict[str, A.Select] = dfield(default_factory=dict)
     n_parts: int = 4
     counter: "itertools.count" = dfield(default_factory=itertools.count)
+    # scalar subqueries evaluate eagerly at plan time (Spark computes
+    # them as separate jobs before the main query the same way); the
+    # executor is pluggable and results are memoized per subquery text
+    subquery_exec: Optional[object] = None
+    subquery_cache: Dict = dfield(default_factory=dict)
 
     def fresh(self, prefix: str) -> str:
         return f"__{prefix}{next(self.counter)}"
+
+    def execute_subplan(self, node: ForeignNode):
+        if self.subquery_exec is not None:
+            return self.subquery_exec(node)
+        from auron_tpu.frontend.session import AuronSession
+        return AuronSession().execute(node).table
 
 
 # ---------------------------------------------------------------------------
@@ -298,32 +309,30 @@ def _conjuncts(e: Optional[A.Expr]) -> List[A.Expr]:
     return [e]
 
 
-def _expr_cols(e: A.Expr) -> List[A.Col]:
-    out: List[A.Col] = []
+def _walk(e: A.Expr):
+    """Yield every sub-expression (pre-order), pruning subquery bodies
+    (they resolve in their own scope).  The ONE reflection walker both
+    column collection and aggregate discovery share."""
+    yield e
+    if isinstance(e, (A.Exists, A.ScalarSubquery)):
+        return
+    if isinstance(e, A.InSubquery):
+        yield from _walk(e.child)
+        return
 
-    def rec(x):
-        if isinstance(x, A.Col):
-            out.append(x)
-            return
-        if isinstance(x, (A.InSubquery, A.Exists, A.ScalarSubquery)):
-            # subquery internals resolve in their OWN scope
-            if isinstance(x, A.InSubquery):
-                rec(x.child)
-            return
-        for f in getattr(x, "__dataclass_fields__", {}):
-            v = getattr(x, f)
-            if isinstance(v, A.Expr):
-                rec(v)
-            elif isinstance(v, tuple):
-                for y in v:
-                    if isinstance(y, A.Expr):
-                        rec(y)
-                    elif isinstance(y, tuple):
-                        for z in y:
-                            if isinstance(z, A.Expr):
-                                rec(z)
-    rec(e)
-    return out
+    def rec_v(v):
+        if isinstance(v, A.Expr):
+            yield from _walk(v)
+        elif isinstance(v, tuple):
+            for y in v:
+                yield from rec_v(y)
+
+    for f in getattr(e, "__dataclass_fields__", {}):
+        yield from rec_v(getattr(e, f))
+
+
+def _expr_cols(e: A.Expr) -> List[A.Col]:
+    return [x for x in _walk(e) if isinstance(x, A.Col)]
 
 
 def _refs_only(e: A.Expr, scope: Scope) -> bool:
@@ -500,23 +509,9 @@ def _lower_from(t: Optional[A.TableRef], ctx: _Ctx,
 # ---------------------------------------------------------------------------
 
 def _find_aggs(e: A.Expr, out: List[A.Call]):
-    if isinstance(e, A.Call) and e.name in _AGG_FNS:
-        out.append(e)
-        return
-    if isinstance(e, (A.InSubquery, A.Exists, A.ScalarSubquery)):
-        return
-    for f in getattr(e, "__dataclass_fields__", {}):
-        v = getattr(e, f)
-        if isinstance(v, A.Expr):
-            _find_aggs(v, out)
-        elif isinstance(v, tuple):
-            for y in v:
-                if isinstance(y, A.Expr):
-                    _find_aggs(y, out)
-                elif isinstance(y, tuple):
-                    for z in y:
-                        if isinstance(z, A.Expr):
-                            _find_aggs(z, out)
+    for x in _walk(e):
+        if isinstance(x, A.Call) and x.name in _AGG_FNS:
+            out.append(x)
 
 
 def _agg_out_dtype(fn: str, arg: Optional[ForeignExpr]) -> DataType:
@@ -790,7 +785,7 @@ def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
     needs_pre = any(not isinstance(g, A.Col) for g in sel.group_by)
     if needs_pre:
         pre_exprs: List[ForeignExpr] = []
-        pre_fields: List[Field] = []
+        pre_cols: List[Tuple[Optional[str], Field]] = []
         for g in sel.group_by:
             if isinstance(g, A.Col):
                 continue
@@ -801,16 +796,18 @@ def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
                     nm = item.alias.lower()
             nm = nm or ctx.fresh("grp")
             pre_exprs.append(falias(fe, nm))
-            pre_fields.append(Field(nm, _dt_of(fe)))
+            pre_cols.append((None, Field(nm, _dt_of(fe))))
             group_names.append((g, nm))
-        for _, f in scope.cols:
+        for q, f in scope.cols:
             pre_exprs.append(fcol(f.name, f.dtype, f.nullable))
-            pre_fields.append(f)
-        out = Schema(tuple(pre_fields))
+            # keep the qualifier: qualified grouping columns (d.d_year)
+            # must still resolve after the pre-projection
+            pre_cols.append((q, f))
+        out = Schema(tuple(f for _, f in pre_cols))
         child = ForeignNode("ProjectExec", children=(child,),
                             output=out,
                             attrs={"project_list": pre_exprs})
-        scope = Scope([(None, f) for f in out.fields])
+        scope = Scope(pre_cols)
     for g in sel.group_by:
         nm = next((n for gg, n in group_names if gg == g), None)
         if nm is not None:
@@ -880,6 +877,16 @@ def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
 # windows / subquery predicates / order-limit
 # ---------------------------------------------------------------------------
 
+def _requal(e: A.Expr, scope: Scope) -> A.Expr:
+    """Re-scope qualified column refs that an aggregation/projection
+    stripped of their qualifier (d.d_year after GROUP BY d.d_year):
+    when only the unqualified name survives, use it."""
+    if isinstance(e, A.Col) and e.table is not None and \
+            not scope.has(e.name, e.table) and scope.has(e.name, None):
+        return A.Col(name=e.name)
+    return e
+
+
 def _lower_windows(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
     wins = [(i, item) for i, item in enumerate(sel.items)
             if isinstance(item.expr, A.WindowCall)]
@@ -887,8 +894,10 @@ def _lower_windows(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
     if len(specs) != 1:
         raise SqlError("multiple window specs in one SELECT")
     wc: A.WindowCall = wins[0][1].expr
-    part = [_lower_expr(p, rel.scope, ctx) for p in wc.partition_by]
-    order = [_so(_lower_expr(s.expr, rel.scope, ctx), s)
+    part = [_lower_expr(_requal(p, rel.scope), rel.scope, ctx)
+            for p in wc.partition_by]
+    order = [_so(_lower_expr(_requal(s.expr, rel.scope), rel.scope,
+                             ctx), s)
              for s in wc.order_by]
     node = rel.node
     if part:
@@ -920,10 +929,13 @@ def _lower_windows(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
     fields: List[Field] = []
     for i, item in enumerate(sel.items):
         nm = _item_name(item, i)
-        if isinstance(item.expr, A.WindowCall):
+        if isinstance(item.expr, A.WindowCall) or scope.has(nm, None):
+            # window outputs AND items an upstream aggregate already
+            # computed under this name (SELECT mixing sum(..) with
+            # rank() OVER: the agg stage ran first) pass through
             f = scope.resolve(nm, None)
             exprs.append(fcol(f.name, f.dtype))
-            fields.append(f)
+            fields.append(Field(nm, f.dtype))
         else:
             fe = _lower_expr(item.expr, scope, ctx)
             exprs.append(falias(fe, nm))
@@ -948,6 +960,32 @@ def _lower_subquery_pred(f: A.Expr, rel: Rel,
         lk = _lower_expr(inner.child, rel.scope, ctx)
         rf = sub.scope.cols[0][1]
         anti = bool(inner.negated) != neg
+        if anti:
+            # SQL three-valued NOT IN: any NULL in the subquery makes
+            # the predicate UNKNOWN for every row (zero rows out), and
+            # a NULL probe key can never pass.  Eager null probe
+            # (plan-time, like scalar subqueries), then a null-safe
+            # anti join.
+            probe = ForeignNode(
+                "GlobalLimitExec",
+                children=(ForeignNode(
+                    "FilterExec", children=(sub.node,),
+                    output=sub.node.output,
+                    attrs={"condition": fcall(
+                        "IsNull", fcol(rf.name, rf.dtype),
+                        dtype=BOOL)}),),
+                output=sub.node.output, attrs={"limit": 1})
+            if ctx.execute_subplan(probe).num_rows > 0:
+                false_node = ForeignNode(
+                    "FilterExec", children=(rel.node,),
+                    output=rel.node.output,
+                    attrs={"condition": flit(False, BOOL)})
+                return Rel(false_node, rel.scope, rel.broadcastable)
+            notnull = ForeignNode(
+                "FilterExec", children=(rel.node,),
+                output=rel.node.output,
+                attrs={"condition": fcall("IsNotNull", lk, dtype=BOOL)})
+            rel = Rel(notnull, rel.scope, rel.broadcastable)
         return _semi_anti_join(rel, sub, [lk],
                                [fcol(rf.name, rf.dtype)], anti, ctx)
     if isinstance(inner, A.Exists):
@@ -982,10 +1020,32 @@ def _lower_subquery_pred(f: A.Expr, rel: Rel,
 
 
 def _probe_scope(sel: A.Select, ctx: _Ctx) -> Scope:
-    """Scope of a subquery's FROM for decorrelation classification
-    (resolved WITHOUT consuming its filters)."""
-    rel = _lower_from(sel.from_, ctx, [])
-    return rel.scope
+    """Scope of a subquery's FROM for decorrelation classification —
+    schema-only (no scan/join node construction, no fresh-name burn;
+    the real lowering happens once the conjuncts are classified)."""
+    return _scope_of_from(sel.from_, ctx)
+
+
+def _scope_of_from(t: Optional[A.TableRef], ctx: _Ctx) -> Scope:
+    if isinstance(t, A.BaseTable):
+        if t.name in ctx.ctes or t.name not in ctx.catalog.tables:
+            # CTE / unknown: fall back to full lowering (rare path)
+            return _lower_from(t, _Ctx(catalog=ctx.catalog,
+                                       ctes=ctx.ctes,
+                                       n_parts=ctx.n_parts), []).scope
+        qual = t.alias or t.name
+        return Scope([(qual, f)
+                      for f in ctx.catalog.tables[t.name].schema.fields])
+    if isinstance(t, A.Join):
+        left = _scope_of_from(t.left, ctx)
+        right = _scope_of_from(t.right, ctx)
+        return Scope(left.cols + right.cols)
+    if isinstance(t, A.SubqueryTable):
+        rel = _lower_select(t.query, _Ctx(catalog=ctx.catalog,
+                                          ctes=ctx.ctes,
+                                          n_parts=ctx.n_parts))
+        return Scope([(t.alias, f) for _, f in rel.scope.cols])
+    raise SqlError("unsupported FROM element in subquery")
 
 
 def _and_all(cs: List[A.Expr]) -> Optional[A.Expr]:
@@ -1013,9 +1073,14 @@ def _order_limit(rel: Rel, sel: A.Select, ctx: _Ctx) -> Rel:
     def resolve_order(s: A.SortItem) -> ForeignExpr:
         e = s.expr
         if isinstance(e, A.Lit) and e.kind == "int":
-            f = fields[e.value - 1]          # ORDER BY ordinal
+            if not 1 <= e.value <= len(fields):
+                raise SqlError(
+                    f"ORDER BY ordinal {e.value} out of range 1.."
+                    f"{len(fields)}")
+            f = fields[e.value - 1]
             return _so(fcol(f.name, f.dtype), s)
-        return _so(_lower_expr(e, rel.scope, ctx), s)
+        return _so(_lower_expr(_requal(e, rel.scope), rel.scope, ctx),
+                   s)
 
     if sel.order_by and sel.limit is not None:
         orders = [resolve_order(s) for s in sel.order_by]
@@ -1048,17 +1113,18 @@ def _order_limit(rel: Rel, sel: A.Select, ctx: _Ctx) -> Rel:
 # ---------------------------------------------------------------------------
 
 def _eval_scalar_subquery(q: A.Select, ctx: _Ctx):
-    from auron_tpu.frontend.session import AuronSession
-    from auron_tpu.it.oracle import PyArrowEngine
+    key = ("scalar", q)
+    if key in ctx.subquery_cache:
+        return ctx.subquery_cache[key]
     rel = _lower_select(q, ctx)
     if len(rel.scope.cols) != 1:
         raise SqlError("scalar subquery must produce one column")
-    session = AuronSession(foreign_engine=PyArrowEngine())
-    table = session.execute(rel.node).table
+    table = ctx.execute_subplan(rel.node)
     if table.num_rows > 1:
         raise SqlError("scalar subquery returned more than one row")
     f = rel.scope.cols[0][1]
     value = table.column(0)[0].as_py() if table.num_rows else None
+    ctx.subquery_cache[key] = (value, f.dtype)
     return value, f.dtype
 
 

@@ -86,3 +86,78 @@ def test_hierarchical_multi_payload(mesh):
     for dev in range(N_DEV):
         landed = ra_dev[dev][rv_dev[dev]]
         assert (landed % N_DEV == dev).all()
+
+
+def test_quota_margin_skew_sweep():
+    """VERDICT r4 weak #9: quota margin 2.0 had only ever met one
+    synthetic skew.  Sweep realistic key-skew families (uniform, zipf
+    1.1/1.5, two-hot, single-hot) at full per-device capacity on the
+    8-device mesh and record which trip the overflow guard — the
+    margin's envelope is then a measured fact: uniform and mild zipf
+    ride the bounded quota; heavy single-key concentration trips the
+    guard and falls back to serial (by design — the guard exists
+    exactly for that shape)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import PartitionSpec as PS
+
+    from auron_tpu.exprs.hashing import hash_columns, pmod
+    from auron_tpu.parallel.exchange import (all_to_all_repartition,
+                                             bounded_quota)
+    from auron_tpu.parallel.mesh import data_mesh
+    import jax.numpy as jnp
+
+    n_dev = 8
+    cap = 4096          # per-device rows, full capacity
+    mesh = data_mesh(n_dev)
+    rng = np.random.default_rng(17)
+
+    def run(keys_global):
+        # keys_global: [n_dev * cap] int64 — what each device holds
+        quota = bounded_quota(cap, n_dev)   # margin from config (2.0)
+
+        def body(keys):
+            from auron_tpu.columnar.batch import DeviceColumn
+            from auron_tpu.ir.schema import DataType
+            col = DeviceColumn(DataType.int64(), keys,
+                               jnp.ones(cap, bool))
+            h = hash_columns([col], seed=42, capacity=cap)
+            pid = pmod(h, n_dev).astype(jnp.int32)
+            outs, live, ovf = all_to_all_repartition(
+                [keys], pid, jnp.ones(cap, bool), "parts", n_dev,
+                quota)
+            import jax.lax as lax
+            any_ovf = lax.psum(ovf.astype(jnp.int32), "parts") > 0
+            total = lax.psum(jnp.sum(live.astype(jnp.int32)), "parts")
+            return any_ovf, total
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(PS("parts"),),
+            out_specs=(PS(), PS()), check_vma=False))
+        ovf, total = fn(jnp.asarray(keys_global))
+        return bool(np.asarray(ovf).reshape(-1)[0]), \
+            int(np.asarray(total).reshape(-1)[0])
+
+    n = n_dev * cap
+    sweeps = {
+        "uniform": rng.integers(0, 100_000, n),
+        "zipf1.1": rng.zipf(1.1, n),
+        "zipf1.5": rng.zipf(1.5, n),
+        "16hot":   rng.integers(0, 16, n),
+        "2hot":    rng.integers(0, 2, n),
+        "1hot":    np.zeros(n, np.int64),
+    }
+    results = {}
+    for name, keys in sweeps.items():
+        ovf, total = run(keys.astype(np.int64))
+        if not ovf:
+            assert total == n, f"{name}: rows lost without overflow"
+        results[name] = ovf
+    # measured envelope for margin 2.0 on 8 devices:
+    assert results["uniform"] is False
+    assert results["zipf1.1"] is False
+    # a single/two-key hot spot concentrates >2x the fair share on one
+    # device — the guard MUST trip (silent row loss would be the bug)
+    assert results["1hot"] is True
+    assert results["2hot"] is True

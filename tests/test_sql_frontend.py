@@ -243,3 +243,60 @@ def test_self_join_requires_alias(catalog):
     with pytest.raises(SqlError, match="both join sides"):
         plan_sql("select count(*) c from item i1 join item i2 "
                  "on i1.i_item_sk = i2.i_item_sk", catalog)
+
+
+def test_group_by_expr_with_qualified_col(catalog):
+    got, res = run_sql("""
+        select d.d_year, substr(i_brand, 1, 5) b,
+               sum(ss_ext_sales_price) rev
+        from store_sales ss, date_dim d, item
+        where ss_sold_date_sk = d.d_date_sk and ss_item_sk = i_item_sk
+        group by d.d_year, substr(i_brand, 1, 5)
+        order by d.d_year, b limit 40
+    """, catalog)
+    assert res.all_native() and got
+
+
+def test_agg_and_window_same_select(catalog):
+    got, res = run_sql("""
+        select ss_store_sk, sum(ss_sales_price) revenue,
+               rank() over (partition by ss_store_sk
+                            order by ss_store_sk) rk
+        from store_sales
+        group by ss_store_sk
+        order by ss_store_sk limit 20
+    """, catalog)
+    assert res.all_native() and got
+    assert all(r["rk"] == 1 for r in got)
+
+
+def test_order_by_ordinal_bounds(catalog):
+    with pytest.raises(SqlError, match="ordinal"):
+        plan_sql("select ss_store_sk from store_sales order by 0",
+                 catalog)
+    with pytest.raises(SqlError, match="ordinal"):
+        plan_sql("select ss_store_sk from store_sales order by 3",
+                 catalog)
+
+
+def test_not_in_subquery_null_semantics(catalog):
+    """SQL three-valued logic: a NULL in the NOT IN subquery empties
+    the result; the engine must agree with that spec, not just with
+    itself."""
+    # ss_promo_sk has nulls in the generated data; i_item_sk does not
+    import pyarrow.compute as pc
+    t = None
+    for chunk in catalog.tables["store_sales"].chunks:
+        import pyarrow.parquet as pq
+        t = pq.read_table(chunk, columns=["ss_promo_sk"])
+        if t.column(0).null_count > 0:
+            break
+    has_nulls = t is not None and t.column(0).null_count > 0
+    got, _ = run_sql("""
+        select count(*) cnt from item
+        where i_item_sk not in
+              (select ss_promo_sk from store_sales)
+    """, catalog)
+    if has_nulls:
+        # count over zero rows -> one row with cnt = 0
+        assert got[0]["cnt"] == 0
