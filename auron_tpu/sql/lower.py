@@ -685,9 +685,9 @@ def _lower_select(sel: A.Select, ctx: _Ctx) -> Rel:
 
 
 def _strip(sel: A.Select) -> A.Select:
-    return A.Select(items=sel.items, from_=sel.from_, where=sel.where,
-                    group_by=sel.group_by, having=sel.having,
-                    distinct=sel.distinct)
+    import dataclasses
+    return dataclasses.replace(sel, order_by=(), limit=None, ctes=(),
+                               union_all=())
 
 
 def _has_agg(e: A.Expr) -> bool:
@@ -818,6 +818,65 @@ def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
             group_names.append((g, f.name))
         grouping.append(fcol(f.name, f.dtype, f.nullable))
         group_fields.append(Field(f.name, f.dtype))
+
+    if sel.rollup:
+        # GROUP BY ROLLUP(g1..gN): ExpandExec replicates every row once
+        # per prefix, nulling the dropped suffix and tagging
+        # spark_grouping_id with bit (n_g-1-j) set when column j is
+        # nulled (Spark's convention: MSB = leftmost grouping column;
+        # corpus q27r gids 0,1,3) — expand_exec.rs:40
+        gset = {f.name for f in group_fields}
+        agg_calls: List[A.Call] = []
+        for item in sel.items:
+            if not isinstance(item.expr, A.WindowCall):
+                _find_aggs(item.expr, agg_calls)
+        if sel.having is not None:
+            _find_aggs(sel.having, agg_calls)
+        needed: set = set()
+        for c in agg_calls:
+            for col_ref in c.args:
+                if isinstance(col_ref, A.Star):
+                    continue
+                for cr in _expr_cols(col_ref):
+                    if cr.name in gset:
+                        raise SqlError(
+                            "aggregating a ROLLUP grouping column "
+                            "is not supported yet")
+                    needed.add(cr.name.lower())
+        n_g = len(group_fields)
+        # replicate ONLY the columns the aggregates read — Expand
+        # multiplies rows (n_g+1)x, so full-scope width here is pure
+        # wasted bandwidth (the corpus narrows before Expand the same
+        # way, q27r's pre-projection)
+        others = [(q, f) for q, f in scope.cols
+                  if f.name not in gset and f.name.lower() in needed]
+        gid_field = Field("spark_grouping_id", I64, nullable=False)
+        expand_fields = list(group_fields) + [f for _, f in others] + \
+            [gid_field]
+        projections = []
+        for keep in range(n_g, -1, -1):
+            gid = 0
+            proj: List[ForeignExpr] = []
+            for j, f in enumerate(group_fields):
+                if j < keep:
+                    proj.append(fcol(f.name, f.dtype))
+                else:
+                    proj.append(flit(None, f.dtype))
+                    gid |= 1 << (n_g - 1 - j)
+            for _, f in others:
+                proj.append(fcol(f.name, f.dtype, f.nullable))
+            proj.append(flit(gid, I64))
+            projections.append(proj)
+        expand_out = Schema(tuple(expand_fields))
+        child = ForeignNode("ExpandExec", children=(child,),
+                            output=expand_out,
+                            attrs={"projections": projections})
+        # keep qualifiers on the replicated columns (qualified agg args
+        # like ss.ss_quantity must still resolve)
+        scope = Scope([(None, f) for f in group_fields] + others +
+                      [(None, gid_field)])
+        grouping.append(fcol("spark_grouping_id", I64, False))
+        group_fields.append(gid_field)
 
     plan = _AggPlan()
     final_items: List[Tuple[str, A.Expr]] = []

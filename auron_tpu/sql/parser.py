@@ -181,6 +181,7 @@ class Select:
     distinct: bool = False
     ctes: Tuple[Tuple[str, "Select"], ...] = ()
     union_all: Tuple["Select", ...] = ()   # additional UNION ALL branches
+    rollup: bool = False                   # GROUP BY ROLLUP(...)
 
 
 # ---------------------------------------------------------------------------
@@ -305,36 +306,22 @@ class _P:
         # ORDER BY / LIMIT after a union apply to the WHOLE union, but
         # select_core greedily parses them into the last branch — lift
         order, limit = self.order_limit()
+        import dataclasses as _dc
         if branches and (branches[-1].order_by or
                          branches[-1].limit is not None):
             last = branches[-1]
             if order or limit is not None:
                 raise SqlError("duplicate ORDER BY/LIMIT")
             order, limit = last.order_by, last.limit
-            branches[-1] = Select(
-                items=last.items, from_=last.from_, where=last.where,
-                group_by=last.group_by, having=last.having,
-                distinct=last.distinct)
+            branches[-1] = _dc.replace(last, order_by=(), limit=None)
         if branches:
-            first = Select(items=first.items, from_=first.from_,
-                           where=first.where, group_by=first.group_by,
-                           having=first.having, order_by=first.order_by,
-                           limit=first.limit, distinct=first.distinct,
-                           union_all=tuple(branches))
+            first = _dc.replace(first, union_all=tuple(branches))
         if order or limit is not None:
             if first.order_by or first.limit is not None:
                 raise SqlError("duplicate ORDER BY/LIMIT")
-            first = Select(items=first.items, from_=first.from_,
-                           where=first.where, group_by=first.group_by,
-                           having=first.having, order_by=order,
-                           limit=limit, distinct=first.distinct,
-                           union_all=first.union_all)
+            first = _dc.replace(first, order_by=order, limit=limit)
         if ctes:
-            first = Select(items=first.items, from_=first.from_,
-                           where=first.where, group_by=first.group_by,
-                           having=first.having, order_by=first.order_by,
-                           limit=first.limit, distinct=first.distinct,
-                           ctes=tuple(ctes), union_all=first.union_all)
+            first = _dc.replace(first, ctes=tuple(ctes))
         return first
 
     def order_limit(self):
@@ -384,18 +371,24 @@ class _P:
             from_ = self.table_expr()
         where = self.expr() if self.eat_kw("where") else None
         group: Tuple[Expr, ...] = ()
+        rollup = False
         if self.kw("group"):
             self.i += 1
             self.expect_kw("by")
+            if self.eat_kw("rollup"):
+                rollup = True
+                self.expect_op("(")
             g = [self.expr()]
             while self.eat_op(","):
                 g.append(self.expr())
+            if rollup:
+                self.expect_op(")")
             group = tuple(g)
         having = self.expr() if self.eat_kw("having") else None
         order, limit = self.order_limit()
         return Select(items=tuple(items), from_=from_, where=where,
                       group_by=group, having=having, order_by=order,
-                      limit=limit, distinct=distinct)
+                      limit=limit, distinct=distinct, rollup=rollup)
 
     def select_item(self) -> SelectItem:
         if self.op("*"):

@@ -242,14 +242,19 @@ def worker_profile() -> dict:
     """Micro-profile of the engine's kernel families on the real device
     (VERDICT r1 #7: profile the q01 pipeline before writing Pallas).
     Times each candidate at bench scale so the recorded BENCH artifact
-    says which op family dominates — the Pallas budget goes there."""
+    says which op family dominates — the Pallas budget goes there.
+
+    AURON_PROFILE_ROWS overrides the row count: the MFU measurement
+    (VERDICT r4 ask #3) runs at 64M+ rows where families leave the
+    dispatch floor and achieved GB/s means something against the HBM
+    roofline."""
     import numpy as np
 
     import auron_tpu  # noqa: F401
     import jax
     import jax.numpy as jnp
 
-    n = 1 << 22
+    n = int(os.environ.get("AURON_PROFILE_ROWS", 1 << 22))
     n_groups = N_KEYS
     rng = np.random.default_rng(3)
     key64 = jnp.asarray(rng.integers(0, n_groups, n).astype(np.int64))

@@ -300,3 +300,52 @@ def test_not_in_subquery_null_semantics(catalog):
     if has_nulls:
         # count over zero rows -> one row with cnt = 0
         assert got[0]["cnt"] == 0
+
+
+def test_rollup_grouping_sets(catalog):
+    """GROUP BY ROLLUP -> ExpandExec (q27 family): per-prefix subtotal
+    rows with NULLed suffix columns, native matching the oracle."""
+    got, res = run_sql("""
+        select i_category, s_state, sum(ss_quantity) qty,
+               count(*) n
+        from store_sales, item, store
+        where ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+        group by rollup(i_category, s_state)
+        order by i_category nulls first, s_state nulls first
+        limit 300
+    """, catalog)
+    assert res.all_native()
+    # grand-total row: both grouping columns NULL
+    grand = [r for r in got
+             if r["i_category"] is None and r["s_state"] is None]
+    assert len(grand) == 1
+    # per-category subtotals exist with state NULL
+    subtotals = [r for r in got
+                 if r["i_category"] is not None and r["s_state"] is None]
+    assert subtotals
+    # subtotal consistency: category subtotal == sum of its leaves
+    for s in subtotals:
+        leaves = [r["qty"] for r in got
+                  if r["i_category"] == s["i_category"]
+                  and r["s_state"] is not None]
+        assert s["qty"] == sum(leaves)
+    assert grand[0]["qty"] == sum(r["qty"] for r in subtotals)
+
+
+def test_rollup_qualified_agg_arg_and_having_guard(catalog):
+    got, res = run_sql("""
+        select i_category, sum(ss.ss_quantity) qty
+        from store_sales ss, item
+        where ss.ss_item_sk = i_item_sk
+        group by rollup(i_category)
+        order by i_category nulls first
+    """, catalog)
+    assert res.all_native()
+    assert sum(1 for r in got if r["i_category"] is None) == 1
+    with pytest.raises(SqlError, match="ROLLUP grouping column"):
+        plan_sql("""
+            select i_category, count(*) n from store_sales, item
+            where ss_item_sk = i_item_sk
+            group by rollup(i_category, i_brand)
+            having count(i_brand) > 0
+        """, catalog)
