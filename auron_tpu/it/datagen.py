@@ -101,7 +101,7 @@ def _manifest_path(data_dir: str) -> str:
 
 # bump when the generator's tables/columns/shapes change: persistent data
 # dirs from older code must regenerate, not serve stale data
-_DATAGEN_VERSION = 1
+_DATAGEN_VERSION = 2
 
 
 def _load_cached(data_dir: str, sf: float, seed: int,
@@ -159,18 +159,34 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     cat = Catalog(data_dir=data_dir)
 
     # ---- date_dim: 5 years of days, 1998-2002 (TPC-DS's window) ----------
+    # d_date_sk 2450815 == 1998-01-01, the real dsdgen anchor, so the
+    # reference's query date literals land inside the generated window
     n_days = 5 * 365
     sk = np.arange(n_days, dtype=np.int64) + 2450815
-    doy = np.arange(n_days) % 365
-    year = 1998 + np.arange(n_days) // 365
+    day_idx = np.arange(n_days)
+    doy = day_idx % 365
+    year = 1998 + day_idx // 365
     moy = np.minimum(doy // 30 + 1, 12)
     dom = doy % 30 + 1
+    epoch_1998 = 10227          # days from 1970-01-01 to 1998-01-01
     date_dim = pa.table({
         "d_date_sk": sk,
+        "d_date": pa.array((day_idx + epoch_1998).astype(np.int32),
+                           type=pa.date32()),
         "d_year": year.astype(np.int32),
         "d_moy": moy.astype(np.int32),
         "d_dom": dom.astype(np.int32),
         "d_qoy": ((moy - 1) // 3 + 1).astype(np.int32),
+        "d_dow": (day_idx % 7).astype(np.int32),
+        # month/week sequence anchors from real dsdgen (1998-01 = 1177,
+        # week of 1998-01-01 = 5270) so +1..+11 month-window arithmetic
+        # in the reference queries stays in-domain
+        "d_month_seq": ((year - 1998) * 12 + moy - 1 + 1177)
+        .astype(np.int32),
+        "d_week_seq": (day_idx // 7 + 5270).astype(np.int32),
+        "d_quarter_name": pa.array(
+            [f"{int(y)}Q{int((m - 1) // 3 + 1)}"
+             for y, m in zip(year, moy)]),
         "d_day_name": pa.array([_DAY_NAMES[int(i) % 7] for i in doy]),
     })
     cat.tables["date_dim"] = _write_chunks(data_dir, "date_dim", date_dim, 1)
@@ -178,27 +194,69 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     # ---- item -------------------------------------------------------------
     n_item = max(200, int(2000 * max(sf, 0.01)))
     isk = np.arange(n_item, dtype=np.int64) + 1
+    i_price = np.round(rng.uniform(0.5, 100.0, n_item), 2)
+    i_manufact_id = rng.integers(1, 1001, n_item).astype(np.int32)
+    _COLORS = ("red", "blue", "green", "yellow", "black", "white",
+               "purple", "orange", "pink", "brown", "navy", "chartreuse")
+    _SIZES = ("small", "medium", "large", "extra large", "economy",
+              "N/A", "petite")
+    _UNITS = ("Each", "Dozen", "Case", "Pallet", "Gross", "Box")
     item = pa.table({
         "i_item_sk": isk,
         "i_item_id": pa.array([f"AAAAAAAA{i:08d}" for i in isk]),
+        "i_item_desc": pa.array([f"item description {int(i)}"
+                                 for i in isk]),
         "i_category": pa.array([_CATEGORIES[int(i) % len(_CATEGORIES)]
                                 for i in isk]),
+        "i_category_id": (isk % len(_CATEGORIES) + 1).astype(np.int32),
         "i_brand": pa.array([f"brand#{int(i) % 50}" for i in isk]),
+        "i_brand_id": (isk % 50 + 5001001).astype(np.int32),
         "i_class": pa.array([f"class#{int(i) % 20}" for i in isk]),
-        "i_current_price": np.round(rng.uniform(0.5, 100.0, n_item), 2),
+        "i_class_id": (isk % 20 + 1).astype(np.int32),
+        "i_current_price": i_price,
+        "i_wholesale_cost": np.round(i_price *
+                                     rng.uniform(0.3, 0.9, n_item), 2),
         "i_manager_id": rng.integers(1, 101, n_item).astype(np.int32),
-        "i_manufact_id": rng.integers(1, 1001, n_item).astype(np.int32),
+        "i_manufact_id": i_manufact_id,
+        "i_manufact": pa.array([f"manufact#{int(m)}"
+                                for m in i_manufact_id]),
+        "i_product_name": pa.array([f"product-{int(i)}" for i in isk]),
+        "i_color": pa.array([_COLORS[int(i) % len(_COLORS)]
+                             for i in isk]),
+        "i_size": pa.array([_SIZES[int(i) % len(_SIZES)] for i in isk]),
+        "i_units": pa.array([_UNITS[int(i) % len(_UNITS)]
+                             for i in isk]),
     })
     cat.tables["item"] = _write_chunks(data_dir, "item", item, 1)
 
     # ---- store ------------------------------------------------------------
     n_store = max(4, int(12 * max(sf, 0.1)))
     ssk = np.arange(n_store, dtype=np.int64) + 1
+    _CITIES = ("Midway", "Fairview", "Oak Grove", "Five Points",
+               "Pleasant Hill", "Centerville", "Riverside", "Salem")
+    _COUNTIES = ("Williamson County", "Franklin Parish", "Walker County",
+                 "Ziebach County", "Daviess County", "Barrow County")
+    _STREET_TYPES = ("Street", "Ave", "Blvd", "Ln", "Court", "Way")
     store = pa.table({
         "s_store_sk": ssk,
         "s_store_id": pa.array([f"S{i:04d}" for i in ssk]),
         "s_store_name": pa.array([f"store-{int(i)}" for i in ssk]),
         "s_state": pa.array([_STATES[int(i) % len(_STATES)] for i in ssk]),
+        "s_city": pa.array([_CITIES[int(i) % len(_CITIES)] for i in ssk]),
+        "s_county": pa.array([_COUNTIES[int(i) % len(_COUNTIES)]
+                              for i in ssk]),
+        "s_zip": pa.array([f"{35000 + int(i) * 7 % 60000:05d}"
+                           for i in ssk]),
+        "s_company_id": np.ones(n_store, dtype=np.int32),
+        "s_company_name": pa.array(["Unknown"] * n_store),
+        "s_market_id": (ssk % 10 + 1).astype(np.int32),
+        "s_number_employees": rng.integers(200, 301,
+                                           n_store).astype(np.int32),
+        "s_street_number": pa.array([str(100 + int(i)) for i in ssk]),
+        "s_street_name": pa.array([f"Main {int(i)}" for i in ssk]),
+        "s_street_type": pa.array(
+            [_STREET_TYPES[int(i) % len(_STREET_TYPES)] for i in ssk]),
+        "s_suite_number": pa.array([f"Suite {int(i) * 10}" for i in ssk]),
         "s_gmt_offset": np.full(n_store, -5.0),
     })
     cat.tables["store"] = _write_chunks(data_dir, "store", store, 1)
@@ -210,14 +268,36 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     n_cust = max(500, int(20_000 * sf))
     csk = np.arange(n_cust, dtype=np.int64) + 1
     addr_sk = rng.integers(1, n_cust + 1, n_cust).astype(np.int64)
+    _FIRST = ("James", "Mary", "John", "Linda", "Robert", "Susan",
+              "Michael", "Karen", "David", "Lisa", "Anna", "Paul")
+    _LAST = ("Smith", "Johnson", "Williams", "Brown", "Jones", "Davis",
+             "Miller", "Wilson", "Moore", "Taylor", "Lopez", "Lee")
+    _SALUT = ("Mr.", "Mrs.", "Ms.", "Dr.", "Miss", "Sir")
     customer = pa.table({
         "c_customer_sk": csk,
         "c_customer_id": pa.array([f"C{i:09d}" for i in csk]),
         "c_current_addr_sk": addr_sk,
         "c_current_cdemo_sk": (csk % n_cd + 1).astype(np.int64),
         "c_current_hdemo_sk": (csk % n_hd + 1).astype(np.int64),
+        "c_first_name": pa.array([_FIRST[int(i) % len(_FIRST)]
+                                  for i in csk]),
+        "c_last_name": pa.array([_LAST[(int(i) // 3) % len(_LAST)]
+                                 for i in csk]),
+        "c_salutation": pa.array([_SALUT[int(i) % len(_SALUT)]
+                                  for i in csk]),
+        "c_preferred_cust_flag": pa.array(
+            [_CHANNELS[int(i) % 2] for i in csk]),
+        "c_birth_day": (csk % 28 + 1).astype(np.int32),
+        "c_birth_month": (csk % 12 + 1).astype(np.int32),
+        "c_birth_year": (1924 + csk % 69).astype(np.int32),
         "c_birth_country": pa.array(
             [_COUNTRIES[int(i) % len(_COUNTRIES)] for i in csk]),
+        "c_login": pa.array([f"user{int(i)}" for i in csk]),
+        "c_email_address": pa.array(
+            [f"user{int(i)}@example.com" for i in csk]),
+        "c_first_sales_date_sk": sk[(csk * 13) % n_days],
+        "c_first_shipto_date_sk": sk[(csk * 13 + 30) % n_days],
+        "c_last_review_date_sk": sk[(csk * 17) % n_days],
     })
     cat.tables["customer"] = _write_chunks(data_dir, "customer", customer, 2)
     ca = pa.table({
@@ -225,6 +305,22 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "ca_state": pa.array([_STATES[int(rng.integers(len(_STATES)))]
                               for _ in range(n_cust)]),
         "ca_country": pa.array(["United States"] * n_cust),
+        "ca_city": pa.array([_CITIES[int(i) % len(_CITIES)]
+                             for i in csk]),
+        "ca_county": pa.array([_COUNTIES[int(i) % len(_COUNTIES)]
+                               for i in csk]),
+        "ca_zip": pa.array([f"{10000 + int(i) * 31 % 89999:05d}"
+                            for i in csk]),
+        "ca_street_number": pa.array([str(1 + int(i) % 999)
+                                      for i in csk]),
+        "ca_street_name": pa.array([f"Elm {int(i) % 40}" for i in csk]),
+        "ca_street_type": pa.array(
+            [_STREET_TYPES[int(i) % len(_STREET_TYPES)] for i in csk]),
+        "ca_suite_number": pa.array([f"Suite {int(i) % 100}"
+                                     for i in csk]),
+        "ca_location_type": pa.array(
+            [("apartment", "condo", "single family")[int(i) % 3]
+             for i in csk]),
         "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], n_cust),
     })
     cat.tables["customer_address"] = _write_chunks(
@@ -239,6 +335,10 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "w_warehouse_sq_ft": rng.integers(50_000, 1_000_000,
                                           n_wh).astype(np.int32),
         "w_state": pa.array([_STATES[int(i) % len(_STATES)] for i in wsk]),
+        "w_city": pa.array([_CITIES[int(i) % len(_CITIES)] for i in wsk]),
+        "w_county": pa.array([_COUNTIES[int(i) % len(_COUNTIES)]
+                              for i in wsk]),
+        "w_country": pa.array(["United States"] * n_wh),
     })
     cat.tables["warehouse"] = _write_chunks(data_dir, "warehouse",
                                             warehouse, 1)
@@ -272,8 +372,11 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     ccsk = np.arange(n_cc, dtype=np.int64) + 1
     call_center = pa.table({
         "cc_call_center_sk": ccsk,
+        "cc_call_center_id": pa.array([f"CC{i:06d}" for i in ccsk]),
         "cc_name": pa.array([f"call-center-{int(i)}" for i in ccsk]),
         "cc_manager": pa.array([f"Manager{int(i) % 7}" for i in ccsk]),
+        "cc_county": pa.array([_COUNTIES[int(i) % len(_COUNTIES)]
+                               for i in ccsk]),
     })
     cat.tables["call_center"] = _write_chunks(data_dir, "call_center",
                                               call_center, 1)
@@ -284,6 +387,9 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "web_site_sk": websk,
         "web_site_id": pa.array([f"WEB{i:04d}" for i in websk]),
         "web_name": pa.array([f"site-{int(i)}" for i in websk]),
+        "web_company_name": pa.array(
+            [("pri", "ought", "able", "ese", "anti")[int(i) % 5]
+             for i in websk]),
     })
     cat.tables["web_site"] = _write_chunks(data_dir, "web_site",
                                            web_site, 1)
@@ -343,6 +449,13 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
             [_MARITAL[int(i) % len(_MARITAL)] for i in cdsk]),
         "cd_education_status": pa.array(
             [_EDUCATION[int(i) % len(_EDUCATION)] for i in cdsk]),
+        "cd_purchase_estimate": ((cdsk % 20 + 1) * 500).astype(np.int32),
+        "cd_credit_rating": pa.array(
+            [("Good", "Low Risk", "High Risk", "Unknown")[int(i) % 4]
+             for i in cdsk]),
+        "cd_dep_count": (cdsk % 7).astype(np.int32),
+        "cd_dep_employed_count": (cdsk % 5).astype(np.int32),
+        "cd_dep_college_count": (cdsk % 4).astype(np.int32),
     })
     cat.tables["customer_demographics"] = _write_chunks(
         data_dir, "customer_demographics", cd, 2)
@@ -352,8 +465,14 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     tsk = np.arange(n_min, dtype=np.int64)
     time_dim = pa.table({
         "t_time_sk": tsk,
+        "t_time": (tsk * 60).astype(np.int32),
         "t_hour": (tsk // 60).astype(np.int32),
         "t_minute": (tsk % 60).astype(np.int32),
+        "t_meal_time": pa.array(
+            [("breakfast" if 6 <= h < 9 else
+              "lunch" if 11 <= h < 13 else
+              "dinner" if 17 <= h < 20 else None)
+             for h in (tsk // 60)]),
     })
     cat.tables["time_dim"] = _write_chunks(data_dir, "time_dim",
                                            time_dim, 1)
@@ -366,6 +485,10 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "p_channel_email": pa.array([_CHANNELS[int(i) % 2] for i in psk]),
         "p_channel_event": pa.array([_CHANNELS[(int(i) // 2) % 2]
                                      for i in psk]),
+        "p_channel_dmail": pa.array([_CHANNELS[(int(i) // 3) % 2]
+                                     for i in psk]),
+        "p_channel_tv": pa.array([_CHANNELS[(int(i) // 4) % 2]
+                                  for i in psk]),
     })
     cat.tables["promotion"] = _write_chunks(data_dir, "promotion", promo, 1)
 
@@ -374,13 +497,29 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
              date_col: str, item_col: str, cust_col: str) -> pa.Table:
         qty = rng.integers(1, 100, n_rows).astype(np.int32)
         price = np.round(rng.uniform(1.0, 200.0, n_rows), 2)
+        # sales_price <= list_price, the dsdgen discount invariant the
+        # reference queries' avg-comparison predicates rely on
+        list_price = np.round(price * rng.uniform(1.0, 1.5, n_rows), 2)
+        wholesale = np.round(price * rng.uniform(0.3, 0.9, n_rows), 2)
+        discount = np.round((list_price - price) * qty, 2)
+        ext_sales = np.round(price * qty, 2)
         cols = {
             date_col: sk[rng.integers(0, n_days, n_rows)],
             item_col: isk[rng.integers(0, n_item, n_rows)],
             cust_col: csk[rng.integers(0, n_cust, n_rows)],
             f"{prefix}_quantity": qty,
             f"{prefix}_sales_price": price,
-            f"{prefix}_ext_sales_price": np.round(price * qty, 2),
+            f"{prefix}_list_price": list_price,
+            f"{prefix}_wholesale_cost": wholesale,
+            f"{prefix}_ext_sales_price": ext_sales,
+            f"{prefix}_ext_list_price": np.round(list_price * qty, 2),
+            f"{prefix}_ext_wholesale_cost": np.round(wholesale * qty, 2),
+            f"{prefix}_ext_discount_amt": discount,
+            f"{prefix}_coupon_amt": np.round(
+                ext_sales * rng.choice([0.0, 0.0, 0.0, 0.1, 0.3],
+                                       n_rows), 2),
+            f"{prefix}_net_paid": np.round(
+                ext_sales * rng.uniform(0.7, 1.0, n_rows), 2),
             f"{prefix}_net_profit": np.round(
                 rng.normal(10, 40, n_rows), 2),
         }
@@ -396,6 +535,7 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "ss_cdemo_sk": cdsk[rng.integers(0, n_cd, n_ss)],
         "ss_addr_sk": csk[rng.integers(0, n_cust, n_ss)],
         "ss_sold_time_sk": tsk[rng.integers(0, n_min, n_ss)],
+        "ss_ext_tax": np.round(rng.uniform(0.0, 20.0, n_ss), 2),
     }, "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk")
     cat.tables["store_sales"] = _write_chunks(
         data_dir, "store_sales", ss, fact_chunks)
@@ -412,9 +552,14 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         # referential: the returning customer's current demographics
         "sr_cdemo_sk": (ss["ss_customer_sk"].to_numpy()[ridx] % n_cd
                         + 1).astype(np.int64),
+        "sr_reason_sk": rsk[rng.integers(0, len(rsk), n_sr)],
+        "sr_return_quantity": np.maximum(
+            1, ss["ss_quantity"].to_numpy()[ridx] //
+            rng.integers(1, 4, n_sr)).astype(np.int32),
         "sr_return_amt": np.round(
             ss["ss_ext_sales_price"].to_numpy()[ridx] *
             rng.uniform(0.1, 1.0, n_sr), 2),
+        "sr_net_loss": np.round(rng.uniform(0.5, 300.0, n_sr), 2),
     })
     cat.tables["store_returns"] = _write_chunks(
         data_dir, "store_returns", sr, max(1, fact_chunks // 2))
@@ -433,6 +578,18 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "cs_call_center_sk": ccsk[rng.integers(0, n_cc, n_cs)],
         "cs_catalog_page_sk": cpsk[rng.integers(0, n_cp, n_cs)],
         "cs_promo_sk": psk[rng.integers(0, n_promo, n_cs)],
+        "cs_sold_time_sk": tsk[rng.integers(0, n_min, n_cs)],
+        "cs_bill_cdemo_sk": cdsk[rng.integers(0, n_cd, n_cs)],
+        "cs_bill_hdemo_sk": hdsk[rng.integers(0, n_hd, n_cs)],
+        "cs_bill_addr_sk": csk[rng.integers(0, n_cust, n_cs)],
+        "cs_ship_customer_sk": csk[rng.integers(0, n_cust, n_cs)],
+        "cs_ship_addr_sk": csk[rng.integers(0, n_cust, n_cs)],
+        "cs_ship_cdemo_sk": cdsk[rng.integers(0, n_cd, n_cs)],
+        "cs_ship_hdemo_sk": hdsk[rng.integers(0, n_hd, n_cs)],
+        "cs_ext_ship_cost": np.round(rng.uniform(0.5, 80.0, n_cs), 2),
+        "cs_ext_tax": np.round(rng.uniform(0.0, 20.0, n_cs), 2),
+        "cs_net_paid_inc_tax": np.round(
+            rng.uniform(1.0, 250.0, n_cs), 2),
     }, "cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk")
     cat.tables["catalog_sales"] = _write_chunks(
         data_dir, "catalog_sales", cs, max(1, fact_chunks // 2))
@@ -440,18 +597,30 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
     # catalog_returns: a subset of catalog order lines comes back
     n_cr = max(100, n_cs // 10)
     cridx = rng.choice(n_cs, n_cr, replace=False)
+    cr_amount = np.round(
+        cs["cs_ext_sales_price"].to_numpy()[cridx] *
+        rng.uniform(0.1, 1.0, n_cr), 2)
     cr = pa.table({
         "cr_returned_date_sk": sk[rng.integers(0, n_days, n_cr)],
         "cr_item_sk": cs["cs_item_sk"].to_numpy()[cridx],
         "cr_order_number": cs["cs_order_number"].to_numpy()[cridx],
         "cr_returning_customer_sk":
             cs["cs_bill_customer_sk"].to_numpy()[cridx],
+        "cr_returning_addr_sk": csk[rng.integers(0, n_cust, n_cr)],
         "cr_call_center_sk": cs["cs_call_center_sk"].to_numpy()[cridx],
         "cr_catalog_page_sk": cs["cs_catalog_page_sk"].to_numpy()[cridx],
         "cr_reason_sk": rsk[rng.integers(0, len(rsk), n_cr)],
-        "cr_return_amount": np.round(
-            cs["cs_ext_sales_price"].to_numpy()[cridx] *
-            rng.uniform(0.1, 1.0, n_cr), 2),
+        "cr_return_quantity": np.maximum(
+            1, cs["cs_quantity"].to_numpy()[cridx] //
+            rng.integers(1, 4, n_cr)).astype(np.int32),
+        "cr_return_amount": cr_amount,
+        "cr_return_amt_inc_tax": np.round(cr_amount * 1.08, 2),
+        "cr_refunded_cash": np.round(
+            cr_amount * rng.uniform(0.0, 1.0, n_cr), 2),
+        "cr_reversed_charge": np.round(
+            cr_amount * rng.uniform(0.0, 0.5, n_cr), 2),
+        "cr_store_credit": np.round(
+            cr_amount * rng.uniform(0.0, 0.5, n_cr), 2),
         "cr_net_loss": np.round(rng.uniform(0.5, 300.0, n_cr), 2),
     })
     cat.tables["catalog_returns"] = _write_chunks(
@@ -465,6 +634,8 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "ws_ship_date_sk": np.minimum(
             ws_sold + rng.integers(1, 121, n_ws), sk[-1]),
         "ws_ship_addr_sk": csk[rng.integers(0, n_cust, n_ws)],
+        "ws_ship_customer_sk": csk[rng.integers(0, n_cust, n_ws)],
+        "ws_bill_addr_sk": csk[rng.integers(0, n_cust, n_ws)],
         "ws_web_site_sk": websk[rng.integers(0, n_web, n_ws)],
         "ws_warehouse_sk": wsk[rng.integers(0, n_wh, n_ws)],
         "ws_ship_mode_sk": smsk[rng.integers(0, len(smsk), n_ws)],
@@ -472,6 +643,7 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
         "ws_sold_time_sk": tsk[rng.integers(0, n_min, n_ws)],
         "ws_ship_hdemo_sk": hdsk[rng.integers(0, n_hd, n_ws)],
         "ws_promo_sk": psk[rng.integers(0, n_promo, n_ws)],
+        "ws_ext_ship_cost": np.round(rng.uniform(0.5, 80.0, n_ws), 2),
     }, "ws_sold_date_sk", "ws_item_sk", "ws_bill_customer_sk")
     cat.tables["web_sales"] = _write_chunks(
         data_dir, "web_sales", ws, max(1, fact_chunks // 2))
@@ -487,8 +659,13 @@ def generate(data_dir: str, sf: float = 0.01, seed: int = 7,
             ws["ws_bill_customer_sk"].to_numpy()[wridx],
         "wr_refunded_cdemo_sk": cdsk[rng.integers(0, n_cd, n_wr)],
         "wr_refunded_addr_sk": csk[rng.integers(0, n_cust, n_wr)],
+        "wr_returning_cdemo_sk": cdsk[rng.integers(0, n_cd, n_wr)],
+        "wr_returning_addr_sk": csk[rng.integers(0, n_cust, n_wr)],
         "wr_web_page_sk": ws["ws_web_page_sk"].to_numpy()[wridx],
         "wr_reason_sk": rsk[rng.integers(0, len(rsk), n_wr)],
+        "wr_return_quantity": np.maximum(
+            1, ws["ws_quantity"].to_numpy()[wridx] //
+            rng.integers(1, 4, n_wr)).astype(np.int32),
         "wr_return_amt": np.round(
             ws["ws_ext_sales_price"].to_numpy()[wridx] *
             rng.uniform(0.1, 1.0, n_wr), 2),

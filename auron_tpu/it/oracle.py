@@ -69,6 +69,13 @@ class _Eval:
     def _literal(self, fe):
         if fe.value is None:
             return _col(np.zeros(self.n), np.ones(self.n, bool))
+        if fe.dtype is not None and fe.dtype.id.name == "DATE32" and \
+                isinstance(fe.value, int):
+            # date literals carry epoch days; date columns load as
+            # datetime64[D]
+            v = np.full(self.n,
+                        np.datetime64("1970-01-01", "D") + fe.value)
+            return _col(v)
         v = np.full(self.n, fe.value,
                     dtype=object if isinstance(fe.value, str) else None)
         return _col(v)
@@ -97,6 +104,25 @@ class _Eval:
                            a.astype(np.float64) /
                            np.where(zero, 1, b).astype(np.float64))
         return _col(out, am | bm | zero)   # spark: x/0 -> null
+
+    def _abs(self, fe):
+        a, am = self.eval(fe.children[0])
+        return _col(np.abs(a), am)
+
+    def _bitwiseand(self, fe): return self._bin(fe, np.bitwise_and)
+    def _bitwiseor(self, fe): return self._bin(fe, np.bitwise_or)
+    def _shiftleft(self, fe): return self._bin(fe, np.left_shift)
+    def _shiftright(self, fe): return self._bin(fe, np.right_shift)
+
+    def _dateadd(self, fe):
+        (a, am), (b, bm) = self.eval(fe.children[0]), \
+            self.eval(fe.children[1])
+        return _col(a + b.astype("timedelta64[D]"), am | bm)
+
+    def _datesub(self, fe):
+        (a, am), (b, bm) = self.eval(fe.children[0]), \
+            self.eval(fe.children[1])
+        return _col(a - b.astype("timedelta64[D]"), am | bm)
 
     def _greaterthan(self, fe): return self._bin(fe, np.greater)
     def _greaterthanorequal(self, fe): return self._bin(fe,
@@ -194,6 +220,36 @@ class _Eval:
         take = c.astype(bool) & ~cm
         return _col(np.where(take, t, f), np.where(take, tm, fm))
 
+    def _concat(self, fe):
+        parts = [self.eval(c) for c in fe.children]
+        out = np.empty(self.n, object)
+        mask = np.zeros(self.n, bool)
+        for _, m in parts:
+            mask |= m
+        for i in range(self.n):
+            out[i] = "".join(str(v[i]) for v, _ in parts)
+        return _col(out, mask)
+
+    def _upper(self, fe):
+        a, am = self.eval(fe.children[0])
+        return _col(np.array([str(x).upper() for x in a], object), am)
+
+    def _lower(self, fe):
+        a, am = self.eval(fe.children[0])
+        return _col(np.array([str(x).lower() for x in a], object), am)
+
+    def _length(self, fe):
+        a, am = self.eval(fe.children[0])
+        return _col(np.array([len(str(x)) for x in a], np.int32), am)
+
+    def _year(self, fe):
+        a, am = self.eval(fe.children[0])
+        return _col(a.astype("datetime64[Y]").astype(int) + 1970, am)
+
+    def _month(self, fe):
+        a, am = self.eval(fe.children[0])
+        return _col(a.astype("datetime64[M]").astype(int) % 12 + 1, am)
+
     def _substring(self, fe):
         a, am = self.eval(fe.children[0])
         pos = int(fe.children[1].value)
@@ -239,6 +295,9 @@ def _norm(v):
         return float(v)
     if isinstance(v, np.str_):
         return str(v)
+    if isinstance(v, np.datetime64):
+        # pyarrow's from_pylist rejects np.datetime64 for date32 fields
+        return v.astype("datetime64[D]").item()
     return v
 
 
@@ -443,7 +502,14 @@ class PyArrowEngine:
             elif jt == "LeftAnti":
                 if not hits:
                     li.append(i)
+            elif jt == "ExistenceJoin":
+                li.append(i)
         lt = left.take(pa.array(li)) if li else left.slice(0, 0)
+        if jt == "ExistenceJoin":
+            flags = pa.array([bool(index.get(k, [])) if None not in k
+                              else False for k in lk])
+            return lt.append_column(
+                node.attrs.get("existence_name", "exists"), flags)
         if jt in ("LeftSemi", "LeftAnti"):
             return lt
         rtake = [j if j >= 0 else None for j in ri]
@@ -511,13 +577,72 @@ class PyArrowEngine:
                 elif fn == "agg":
                     agg = w["agg"]
                     fn_node = agg.children[0]
+                    distinct = bool(agg.attrs.get("distinct", False))
                     argv = ev.eval(fn_node.children[0]) if \
                         fn_node.children else _col(np.ones(t.num_rows))
                     v, m = argv
-                    vals = [_norm(v[i]) for i in idxs if not m[i]]
-                    res = _agg_value(fn_node.name, vals)
-                    for i in idxs:
-                        out[i] = res
+                    if not node.attrs.get("order_spec"):
+                        vals = [_norm(v[i]) for i in idxs if not m[i]]
+                        if distinct:
+                            vals = list(dict.fromkeys(vals))
+                        res = _agg_value(fn_node.name, vals)
+                        for i in idxs:
+                            out[i] = res
+                    else:
+                        # ordered agg: Spark's default RANGE frame —
+                        # running value, peers share the last row's.
+                        # Incremental accumulators (sum/count and
+                        # monotone running min/max are O(1) per row);
+                        # other fns recompute per prefix
+                        name = fn_node.name
+                        acc: List = []
+                        s = 0.0
+                        n_seen = 0
+                        mn = mx = None
+                        cur: List = []
+                        dseen: set = set()
+                        for i in idxs:
+                            if not m[i]:
+                                x = _norm(v[i])
+                                if distinct and x in dseen:
+                                    pass
+                                else:
+                                    if distinct:
+                                        dseen.add(x)
+                                    n_seen += 1
+                                    if name in ("Sum", "Average"):
+                                        s += x
+                                    elif name == "Min":
+                                        mn = x if mn is None else \
+                                            min(mn, x)
+                                    elif name == "Max":
+                                        mx = x if mx is None else \
+                                            max(mx, x)
+                                    elif name not in ("Count",):
+                                        cur.append(x)
+                            if name == "Count":
+                                acc.append(n_seen)
+                            elif name == "Sum":
+                                acc.append(s if n_seen else None)
+                            elif name == "Average":
+                                acc.append(s / n_seen if n_seen
+                                           else None)
+                            elif name == "Min":
+                                acc.append(mn)
+                            elif name == "Max":
+                                acc.append(mx)
+                            else:
+                                acc.append(_agg_value(name, list(cur)))
+                        r = 0
+                        while r < len(idxs):
+                            j = r
+                            while j + 1 < len(idxs) and \
+                                    okey_of[idxs[j + 1]] == \
+                                    okey_of[idxs[r]]:
+                                j += 1
+                            for k in range(r, j + 1):
+                                out[idxs[k]] = acc[j]
+                            r = j + 1
                 else:
                     raise NotImplementedError(f"window fn {fn}")
             extra_cols[w["name"]] = out

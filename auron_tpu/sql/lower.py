@@ -72,8 +72,15 @@ def _num_promote(a: DataType, b: DataType) -> DataType:
 
 @dataclass
 class Scope:
-    """Visible columns of one relation: (qualifier, Field) per column."""
+    """Visible columns of one relation: (qualifier, Field) per column.
+
+    `aliases` carries extra resolution entries (qualifier, logical name,
+    physical Field) for columns a self-join disambiguation renamed: the
+    SQL text still says d2.d_date_sk but the physical plan column is the
+    fresh unique name."""
     cols: List[Tuple[Optional[str], Field]]
+    aliases: List[Tuple[Optional[str], str, Field]] = \
+        dfield(default_factory=list)
 
     def schema(self) -> Schema:
         return Schema(tuple(f for _, f in self.cols))
@@ -82,6 +89,8 @@ class Scope:
         hits = [f for q, f in self.cols
                 if f.name.lower() == name.lower()
                 and (table is None or q == table)]
+        hits += [f for q, ln, f in self.aliases
+                 if ln == name.lower() and (table is None or q == table)]
         if not hits:
             raise SqlError(f"unknown column {table + '.' if table else ''}"
                            f"{name}")
@@ -108,6 +117,11 @@ class _Ctx:
     # executor is pluggable and results are memoized per subquery text
     subquery_exec: Optional[object] = None
     subquery_cache: Dict = dfield(default_factory=dict)
+    # decorrelated scalar subqueries: id(AST node) -> joined column ref
+    scalar_subst: Dict = dfield(default_factory=dict)
+    # computed window outputs: id(WindowCall) -> column ref (for window
+    # calls nested inside larger item expressions, q12's revenueratio)
+    window_subst: Dict = dfield(default_factory=dict)
 
     def fresh(self, prefix: str) -> str:
         return f"__{prefix}{next(self.counter)}"
@@ -178,13 +192,29 @@ def _lower_expr(e: A.Expr, scope: Scope, ctx: _Ctx) -> ForeignExpr:
             kids.append(els)
         return fcall("CaseWhen", *kids, dtype=out_dt)
     if isinstance(e, A.Cast):
-        return fcall("Cast", _lower_expr(e.child, scope, ctx),
-                     dtype=_parse_type(e.type_name))
+        child = _lower_expr(e.child, scope, ctx)
+        target = _parse_type(e.type_name)
+        if child.name == "Literal" and isinstance(child.value, str) \
+                and target.id.name == "DATE32":
+            # fold cast('yyyy-mm-dd' as date) so date +/- INTERVAL
+            # arithmetic folds to plain literals
+            import datetime
+            d = datetime.date.fromisoformat(child.value)
+            return flit((d - datetime.date(1970, 1, 1)).days,
+                        DataType.date32())
+        return fcall("Cast", child, dtype=target)
     if isinstance(e, A.Call):
         return _lower_call(e, scope, ctx)
     if isinstance(e, A.ScalarSubquery):
+        sub = ctx.scalar_subst.get(id(e))
+        if sub is not None:
+            return sub
         value, dtype = _eval_scalar_subquery(e.query, ctx)
         return flit(value, dtype)
+    if isinstance(e, A.WindowCall):
+        w = ctx.window_subst.get(id(e))
+        if w is not None:
+            return w
     raise SqlError(f"unsupported expression {type(e).__name__} here")
 
 
@@ -214,10 +244,37 @@ def _coerce(fe: ForeignExpr, target: Optional[DataType]) -> ForeignExpr:
             target.id.name in ("INT8", "INT16", "INT32", "INT64",
                                "FLOAT32", "FLOAT64"):
         return flit(fe.value, target)
+    if fe.name == "Literal" and fe.dtype is not None and \
+            fe.dtype.is_stringlike and target is not None and \
+            target.id.name == "DATE32" and \
+            isinstance(fe.value, str):
+        # Spark coerces string literals against date columns
+        import datetime
+        try:
+            d = datetime.date.fromisoformat(fe.value)
+        except ValueError:
+            return fe
+        return flit((d - datetime.date(1970, 1, 1)).days,
+                    DataType.date32())
     return fe
 
 
 def _lower_bin(e: A.Bin, scope: Scope, ctx: _Ctx) -> ForeignExpr:
+    # date +/- INTERVAL n days: fold when the date side is a literal,
+    # else DateAdd/DateSub
+    for a, b, flip in ((e.left, e.right, False), (e.right, e.left,
+                                                  True)):
+        if isinstance(b, A.Lit) and b.kind == "interval_days" and \
+                e.op in ("+", "-") and not (flip and e.op == "-"):
+            base = _lower_expr(a, scope, ctx)
+            days = int(b.value)
+            if base.name == "Literal" and base.dtype is not None and \
+                    base.dtype.id.name == "DATE32":
+                delta = days if e.op == "+" else -days
+                return flit(base.value + delta, DataType.date32())
+            return fcall("DateAdd" if e.op == "+" else "DateSub",
+                         base, flit(days, I32),
+                         dtype=DataType.date32())
     if e.op == "and":
         return fcall("And", _lower_expr(e.left, scope, ctx),
                      _lower_expr(e.right, scope, ctx), dtype=BOOL)
@@ -299,6 +356,13 @@ class Rel:
     node: ForeignNode
     scope: Scope
     broadcastable: bool = False
+    # aggregate with no GROUP BY: guaranteed exactly one row (lets the
+    # comma-join planner accept keyless joins against it)
+    single_row: bool = False
+    # leading visible columns; the rest are hidden ORDER BY carriers
+    # (grouping columns sorted on but not selected) projected away by
+    # _order_limit
+    visible: Optional[int] = None
 
 
 def _conjuncts(e: Optional[A.Expr]) -> List[A.Expr]:
@@ -336,7 +400,11 @@ def _expr_cols(e: A.Expr) -> List[A.Col]:
 
 
 def _refs_only(e: A.Expr, scope: Scope) -> bool:
-    if isinstance(e, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+    """Every column ref resolves in `scope` AND no subquery hides
+    anywhere inside (subquery predicates must reach the top-level
+    classification, never a single-table pushdown — their bodies may
+    correlate with other tables)."""
+    if _has_subquery(e):
         return False
     cols = _expr_cols(e)
     return all(scope.has(c.name, c.table) for c in cols)
@@ -349,7 +417,8 @@ def _lower_base(t: A.BaseTable, ctx: _Ctx,
         rel = _lower_select(ctx.ctes[t.name], ctx)
         qual = t.alias or t.name
         scope = Scope([(qual, f) for _, f in rel.scope.cols])
-        return Rel(rel.node, scope, rel.broadcastable)
+        return Rel(rel.node, scope, rel.broadcastable,
+                   single_row=rel.single_row)
     cat = ctx.catalog
     if t.name not in cat.tables:
         raise SqlError(f"unknown table {t.name}")
@@ -400,6 +469,34 @@ def _hash_exchange(child: ForeignNode, keys, ctx: _Ctx) -> ForeignNode:
                                 "expressions": list(keys)}})
 
 
+def _avoid_collisions(left_scope: Scope, right: Rel, ctx: _Ctx) -> Rel:
+    """Self-join disambiguation: physically rename right-side columns
+    whose names collide with the left side (a projection with fresh
+    names), keeping SQL-level resolution working through Scope.aliases.
+    The analogue of Spark's expression-ID attribute distinction that a
+    name-keyed plan format has to make explicit."""
+    taken = {f.name.lower() for _, f in left_scope.cols}
+    if not any(f.name.lower() in taken for _, f in right.scope.cols):
+        return right
+    proj: List[ForeignExpr] = []
+    new_cols: List[Tuple[Optional[str], Field]] = []
+    aliases = list(right.scope.aliases)
+    for q, f in right.scope.cols:
+        if f.name.lower() in taken:
+            nn = ctx.fresh(f"r_{f.name}")
+            nf = Field(nn, f.dtype, f.nullable)
+            proj.append(falias(fcol(f.name, f.dtype, f.nullable), nn))
+            new_cols.append((q, nf))
+            aliases.append((q, f.name.lower(), nf))
+        else:
+            proj.append(fcol(f.name, f.dtype, f.nullable))
+            new_cols.append((q, f))
+    out = Schema(tuple(f for _, f in new_cols))
+    node = ForeignNode("ProjectExec", children=(right.node,), output=out,
+                       attrs={"project_list": proj})
+    return Rel(node, Scope(new_cols, aliases), right.broadcastable)
+
+
 def _join(left: Rel, right: Rel, kind: str, lks, rks, ctx: _Ctx) -> Rel:
     for _, fa in left.scope.cols:
         for _, fb in right.scope.cols:
@@ -409,7 +506,8 @@ def _join(left: Rel, right: Rel, kind: str, lks, rks, ctx: _Ctx) -> Rel:
                     f"alias one side through a subquery (self-join "
                     f"outputs need distinct names)")
     jt = _JOIN_TYPES[kind]
-    out_scope = Scope(left.scope.cols + right.scope.cols)
+    out_scope = Scope(left.scope.cols + right.scope.cols,
+                      left.scope.aliases + right.scope.aliases)
     out = Schema(tuple(f for _, f in out_scope.cols))
     if right.broadcastable and kind in ("inner", "left"):
         bx = ForeignNode("BroadcastExchangeExec", children=(right.node,),
@@ -472,23 +570,15 @@ def _lower_from(t: Optional[A.TableRef], ctx: _Ctx,
     if isinstance(t, A.SubqueryTable):
         rel = _lower_select(t.query, ctx)
         scope = Scope([(t.alias, f) for _, f in rel.scope.cols])
-        return Rel(rel.node, scope, rel.broadcastable)
+        return Rel(rel.node, scope, rel.broadcastable,
+                   single_row=rel.single_row)
     if isinstance(t, A.Join):
-        left = _lower_from(t.left, ctx, filters)
-        right = _lower_from(t.right, ctx, filters)
         if t.kind == "cross":
-            # comma-join: equi conditions live in WHERE
-            both = Scope(left.scope.cols + right.scope.cols)
-            pool = [f for f in filters if _refs_only(f, both)]
-            lks, rks, rest = _equi_keys(pool, left.scope, right.scope,
-                                        ctx)
-            if not lks:
-                raise SqlError("cross join without an equi condition "
-                               "in WHERE is not supported")
-            for f in pool:
-                if f not in rest:
-                    filters.remove(f)
-            return _join(left, right, "inner", lks, rks, ctx)
+            return _lower_comma_join(t, ctx, filters)
+        left = _lower_from(t.left, ctx, filters)
+        right = _avoid_collisions(left.scope,
+                                  _lower_from(t.right, ctx, filters),
+                                  ctx)
         cond = _conjuncts(t.on)
         lks, rks, rest = _equi_keys(cond, left.scope, right.scope, ctx)
         if not lks:
@@ -504,14 +594,106 @@ def _lower_from(t: Optional[A.TableRef], ctx: _Ctx,
     raise SqlError(f"unsupported FROM element {type(t).__name__}")
 
 
+def _flatten_cross(t: A.TableRef) -> List[A.TableRef]:
+    if isinstance(t, A.Join) and t.kind == "cross":
+        return _flatten_cross(t.left) + _flatten_cross(t.right)
+    return [t]
+
+
+def _factored_equis(f: A.Expr, both: Scope) -> List[A.Expr]:
+    """Equality conjuncts present in EVERY disjunct of an OR (q13/q48:
+    the join keys live inside each arm of a disjunctive filter).
+    Joining on them is sound — each arm implies them — and the OR
+    itself still applies as a residual filter afterwards."""
+    if not (isinstance(f, A.Bin) and f.op == "or"):
+        return []
+    per = [[c for c in _conjuncts(d)
+            if isinstance(c, A.Bin) and c.op == "=="]
+           for d in _disjuncts(f)]
+    if not per or any(not p for p in per):
+        return []
+    common = [c for c in per[0] if all(c in p for p in per[1:])]
+    return [c for c in common if _refs_only(c, both)]
+
+
+def _lower_comma_join(t: A.Join, ctx: _Ctx,
+                      filters: List[A.Expr]) -> Rel:
+    """Comma-join list: equi conditions live in WHERE, and the textual
+    FROM order need not be join-connected pairwise (TPC-DS lists dims
+    and facts in arbitrary order).  Greedy join-graph walk: start from
+    the first relation and repeatedly attach any relation that has an
+    equi edge to the joined prefix — the connectivity-ordering half of
+    what Spark's cost-based join reordering does.  Single-row
+    aggregates (q28/q88's counting subqueries) may join keylessly on a
+    constant key."""
+    rels = [_lower_from(x, ctx, filters) for x in _flatten_cross(t)]
+    joined = rels.pop(0)
+    while rels:
+        progressed = False
+        for i, cand in enumerate(rels):
+            cand = _avoid_collisions(joined.scope, cand, ctx)
+            both = Scope(joined.scope.cols + cand.scope.cols,
+                         joined.scope.aliases + cand.scope.aliases)
+            pool = [f for f in filters if _refs_only(f, both)]
+            factored: List[A.Expr] = []
+            for f in filters:
+                factored.extend(_factored_equis(f, both))
+            lks, rks, rest = _equi_keys(pool + factored, joined.scope,
+                                        cand.scope, ctx)
+            if not lks:
+                continue
+            for f in pool:
+                if f not in rest:
+                    filters.remove(f)
+            joined = _join(joined, cand, "inner", lks, rks, ctx)
+            rels.pop(i)
+            progressed = True
+            break
+        if progressed:
+            continue
+        # no equi edge anywhere: a single-row side joins on a constant
+        # key (the 1x1 cartesian the reference plans as a broadcast
+        # nested loop with no condition)
+        i = next((i for i, r in enumerate(rels)
+                  if r.single_row or joined.single_row), None)
+        if i is None:
+            raise SqlError("cross join without an equi condition "
+                           "in WHERE is not supported")
+        cand = _avoid_collisions(joined.scope, rels.pop(i), ctx)
+        one = flit(1, I32)
+        joined = _join(joined, cand, "inner", [one], [one], ctx)
+    return joined
+
+
 # ---------------------------------------------------------------------------
 # aggregation
 # ---------------------------------------------------------------------------
 
 def _find_aggs(e: A.Expr, out: List[A.Call]):
-    for x in _walk(e):
-        if isinstance(x, A.Call) and x.name in _AGG_FNS:
-            out.append(x)
+    """Aggregate calls belonging to the GROUP BY stage — pruning window
+    calls (their own fn runs in the window stage; their ARGS are
+    slotted explicitly by _lower_aggregate)."""
+    if isinstance(e, (A.WindowCall, A.Exists, A.ScalarSubquery)):
+        return
+    if isinstance(e, A.InSubquery):
+        _find_aggs(e.child, out)
+        return
+    if isinstance(e, A.Call) and e.name in _AGG_FNS:
+        out.append(e)
+
+    def rec_v(v):
+        if isinstance(v, A.Expr):
+            _find_aggs(v, out)
+        elif isinstance(v, tuple):
+            for y in v:
+                rec_v(y)
+
+    for f in getattr(e, "__dataclass_fields__", {}):
+        rec_v(getattr(e, f))
+
+
+def _win_calls(e: A.Expr) -> List[A.WindowCall]:
+    return [x for x in _walk(e) if isinstance(x, A.WindowCall)]
 
 
 def _agg_out_dtype(fn: str, arg: Optional[ForeignExpr]) -> DataType:
@@ -625,6 +807,66 @@ def _rewrite_post_agg(e: A.Expr, plan: "_AggPlan", scope: Scope,
                      _rewrite_post_agg(e.child, plan, scope, group_names,
                                        ctx, post_scope),
                      dtype=_parse_type(e.type_name))
+    if isinstance(e, A.WindowCall):
+        w = ctx.window_subst.get(id(e))
+        if w is not None:
+            return w
+        raise SqlError("window call outside the window stage")
+    if isinstance(e, A.ScalarSubquery):
+        value, dtype = _eval_scalar_subquery(e.query, ctx)
+        return flit(value, dtype)
+    if isinstance(e, A.Call) and e.name == "grouping":
+        # grouping(col) after ROLLUP: extract the column's bit from
+        # spark_grouping_id (bit n_g-1-j for grouping column j, the
+        # Spark/ExpandExec convention encoded in _lower_aggregate)
+        nm = next((n for g, n in group_names if g == e.args[0]), None)
+        if nm is None and isinstance(e.args[0], A.Col):
+            nm = e.args[0].name
+        gnames = [f.name for _, f in post_scope.cols]
+        if "spark_grouping_id" not in gnames or nm is None:
+            raise SqlError("grouping() requires ROLLUP grouping sets")
+        lead = gnames[:gnames.index("spark_grouping_id")]
+        if nm not in lead:
+            raise SqlError(f"grouping() argument {nm} is not a "
+                           f"grouping column")
+        shift = len(lead) - 1 - lead.index(nm)
+        gid = fcol("spark_grouping_id", I64, False)
+        return fcall("BitwiseAnd",
+                     fcall("ShiftRight", gid, flit(shift, I32),
+                           dtype=I64),
+                     flit(1, I64), dtype=I64)
+    if isinstance(e, A.Call):
+        args = [_rewrite_post_agg(a, plan, scope, group_names, ctx,
+                                  post_scope) for a in e.args]
+        spark = _SCALAR_FNS.get(e.name)
+        if spark is None:
+            raise SqlError(f"unsupported post-agg function {e.name}()")
+        dt = {"Substring": STR, "Upper": STR, "Lower": STR,
+              "Concat": STR, "Length": I32, "Year": I32,
+              "Month": I32}.get(
+                  spark, _dt_of(args[0]) if args else F64)
+        return fcall(spark, *args, dtype=dt)
+    if isinstance(e, A.IsNull):
+        name = "IsNotNull" if e.negated else "IsNull"
+        return fcall(name,
+                     _rewrite_post_agg(e.child, plan, scope, group_names,
+                                       ctx, post_scope), dtype=BOOL)
+    if isinstance(e, A.Un) and e.op == "not":
+        return fcall("Not",
+                     _rewrite_post_agg(e.child, plan, scope, group_names,
+                                       ctx, post_scope), dtype=BOOL)
+    if isinstance(e, A.Between):
+        c = _rewrite_post_agg(e.child, plan, scope, group_names, ctx,
+                              post_scope)
+        lo = _coerce(_rewrite_post_agg(e.lo, plan, scope, group_names,
+                                       ctx, post_scope), _dt_of(c))
+        hi = _coerce(_rewrite_post_agg(e.hi, plan, scope, group_names,
+                                       ctx, post_scope), _dt_of(c))
+        rng = fcall("And",
+                    fcall("GreaterThanOrEqual", c, lo, dtype=BOOL),
+                    fcall("LessThanOrEqual", c, hi, dtype=BOOL),
+                    dtype=BOOL)
+        return fcall("Not", rng, dtype=BOOL) if e.negated else rng
     raise SqlError(
         f"post-aggregation expression {type(e).__name__} must reference "
         f"grouping columns or aggregates")
@@ -638,10 +880,17 @@ def _lower_select(sel: A.Select, ctx: _Ctx) -> Rel:
     if sel.ctes:
         ctx = _Ctx(catalog=ctx.catalog,
                    ctes={**ctx.ctes, **dict(sel.ctes)},
-                   n_parts=ctx.n_parts, counter=ctx.counter)
+                   n_parts=ctx.n_parts, counter=ctx.counter,
+                   subquery_exec=ctx.subquery_exec,
+                   subquery_cache=ctx.subquery_cache,
+                   scalar_subst=ctx.scalar_subst)
+    if sel.set_ops:
+        return _lower_set_ops(sel, ctx)
     if sel.union_all:
         rels = [_lower_select(_strip(sel), ctx)] + \
                [_lower_select(b, ctx) for b in sel.union_all]
+        target = _union_target(rels)
+        rels = [_align_branch(target, r, ctx) for r in rels]
         out = rels[0].scope.schema()
         node = ForeignNode("UnionExec",
                            children=tuple(r.node for r in rels),
@@ -671,23 +920,137 @@ def _lower_select(sel: A.Select, ctx: _Ctx) -> Rel:
         not isinstance(i.expr, (A.Star, A.WindowCall)) and
         _has_agg(i.expr) for i in sel.items)
     windows = [i for i in sel.items
-               if isinstance(i.expr, A.WindowCall)]
+               if not isinstance(i.expr, A.Star) and
+               _win_calls(i.expr)]
 
+    aggwin = None
     if has_aggs:
-        rel = _lower_aggregate(sel, rel, ctx)
+        rel, aggwin = _lower_aggregate(sel, rel, ctx,
+                                       for_windows=bool(windows))
+        if not sel.group_by and not sel.rollup and not windows:
+            rel.single_row = True
+            rel.broadcastable = True
     elif sel.distinct:
         rel = _lower_distinct(sel, rel, ctx)
     elif not windows:
         rel = _lower_project(sel, rel, ctx)
     if windows:
-        rel = _lower_windows(sel, rel, ctx)
+        rel = _lower_windows(sel, rel, ctx, aggwin)
     return _order_limit(rel, sel, ctx)
 
 
 def _strip(sel: A.Select) -> A.Select:
     import dataclasses
     return dataclasses.replace(sel, order_by=(), limit=None, ctes=(),
-                               union_all=())
+                               union_all=(), set_ops=())
+
+
+def _distinct_all(rel: Rel, ctx: _Ctx) -> Rel:
+    """DISTINCT over every output column (set-op semantics)."""
+    fields = [f for _, f in rel.scope.cols]
+    grouping = [fcol(f.name, f.dtype) for f in fields]
+    node = _two_phase(rel.node, grouping, fields, [], ctx)
+    return Rel(node, Scope([(None, f) for f in fields]), False)
+
+
+def _lct(a: DataType, b: DataType) -> DataType:
+    """Least common type for set-op column alignment (the relevant
+    slice of Spark's findWiderTypeForTwo): float beats decimal/int,
+    decimal beats int, wider int beats narrower."""
+    if a.is_decimal and b.is_decimal:
+        return a if (a.precision, a.scale) >= (b.precision, b.scale) \
+            else b
+    if a.id == b.id:
+        return a
+    ints = ("INT8", "INT16", "INT32", "INT64")
+    an, bn = a.id.name, b.id.name
+    if "FLOAT64" in (an, bn) or {an, bn} <= {"FLOAT32", "FLOAT64"}:
+        return F64
+    if an.startswith("FLOAT") or bn.startswith("FLOAT"):
+        return F64
+    if a.is_decimal and (bn in ints):
+        return a
+    if b.is_decimal and (an in ints):
+        return b
+    if an in ints and bn in ints:
+        return a if ints.index(an) >= ints.index(bn) else b
+    return a
+
+
+def _union_target(rels: List[Rel]) -> List[Field]:
+    target = [Field(f.name, f.dtype, f.nullable)
+              for _, f in rels[0].scope.cols]
+    for r in rels[1:]:
+        for j, (_, f) in enumerate(r.scope.cols[:len(target)]):
+            t = target[j]
+            target[j] = Field(t.name, _lct(t.dtype, f.dtype))
+    return target
+
+
+def _align_branch(target: List[Field], rel: Rel, ctx: _Ctx) -> Rel:
+    """Project a set-op branch onto the aligned column names and
+    least-common types (q5 unions float sales against cast-to-decimal
+    zeros; both engines run the same coercion)."""
+    mine = [f for _, f in rel.scope.cols]
+    if len(mine) != len(target):
+        raise SqlError(
+            f"set-op branches have {len(mine)} vs {len(target)} columns")
+
+    def same_type(a: DataType, b: DataType) -> bool:
+        # decimals with different precision/scale are different types
+        return a.id == b.id and (not a.is_decimal or
+                                 (a.precision, a.scale) ==
+                                 (b.precision, b.scale))
+
+    if all(a.name == b.name and same_type(a.dtype, b.dtype)
+           for a, b in zip(mine, target)):
+        return rel
+    proj: List[ForeignExpr] = []
+    for src, tf in zip(mine, target):
+        fe = fcol(src.name, src.dtype, src.nullable)
+        if not same_type(src.dtype, tf.dtype):
+            fe = fcall("Cast", fe, dtype=tf.dtype)
+        proj.append(falias(fe, tf.name))
+    out = Schema(tuple(Field(tf.name, tf.dtype) for tf in target))
+    node = ForeignNode("ProjectExec", children=(rel.node,), output=out,
+                       attrs={"project_list": proj})
+    return Rel(node, Scope([(None, f) for f in out.fields]), False)
+
+
+def _lower_set_ops(sel: A.Select, ctx: _Ctx) -> Rel:
+    """General left-associative set-op chain.  UNION = concat +
+    distinct; INTERSECT/EXCEPT = distinct left then semi/anti join on
+    every column (Spark rewrites them to exactly these joins).  NULL
+    keys never match, so NULL rows drop out of INTERSECT — the corpus
+    data is non-null on set-op columns."""
+    import dataclasses as _dc
+    # keep union_all: a parenthesized (A UNION ALL B) INTERSECT C arm
+    # carries its inner union in union_all with the intersect chained
+    rel = _lower_select(_dc.replace(sel, order_by=(), limit=None,
+                                    ctes=(), set_ops=()), ctx)
+    for kind, b in sel.set_ops:
+        other = _lower_select(b, ctx)
+        target = _union_target([rel, other])
+        rel = _align_branch(target, rel, ctx)
+        other = _align_branch(target, other, ctx)
+        if kind in ("union", "union_all"):
+            out = rel.scope.schema()
+            node = ForeignNode("UnionExec",
+                               children=(rel.node, other.node),
+                               output=out)
+            rel = Rel(node, Scope([(None, f) for f in out.fields]),
+                      False)
+            if kind == "union":
+                rel = _distinct_all(rel, ctx)
+        elif kind in ("intersect", "except"):
+            rel = _distinct_all(rel, ctx)
+            lks = [fcol(f.name, f.dtype) for _, f in rel.scope.cols]
+            rks = [fcol(f.name, f.dtype) for _, f in other.scope.cols]
+            rel = _semi_anti_join(rel, other, lks, rks,
+                                  kind == "except", ctx)
+        else:
+            raise SqlError(f"unsupported set operation {kind}")
+    return _order_limit(rel, sel, ctx)
 
 
 def _has_agg(e: A.Expr) -> bool:
@@ -708,24 +1071,41 @@ def _lower_project(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
     if len(sel.items) == 1 and isinstance(sel.items[0].expr, A.Star):
         return rel
     exprs: List[ForeignExpr] = []
-    fields: List[Field] = []
+    cols: List[Tuple[Optional[str], Field]] = []
+    aliases: List[Tuple[Optional[str], str, Field]] = []
+    seen: set = set()
     for i, item in enumerate(sel.items):
         if isinstance(item.expr, A.Star):
             for _, f in rel.scope.cols:
                 exprs.append(fcol(f.name, f.dtype, f.nullable))
-                fields.append(f)
+                cols.append((None, f))
+                seen.add(f.name.lower())
             continue
         nm = _item_name(item, i)
+        qual = item.expr.table if isinstance(item.expr, A.Col) \
+            and not item.alias else None
         fe = _lower_expr(item.expr, rel.scope, ctx)
         dt = _dt_of(fe)
-        exprs.append(falias(fe, nm)
-                     if (item.alias or not isinstance(item.expr, A.Col))
-                     else fe)
-        fields.append(Field(nm, dt))
-    out = Schema(tuple(fields))
+        if nm.lower() in seen:
+            # duplicate output name (q39 selects inv1.w_warehouse_sk
+            # AND inv2.w_warehouse_sk): rename physically, resolve
+            # logically through a scope alias
+            pn = ctx.fresh(f"d_{nm}")
+            f = Field(pn, dt)
+            exprs.append(falias(fe, pn))
+            cols.append((qual, f))
+            aliases.append((qual, nm.lower(), f))
+            continue
+        seen.add(nm.lower())
+        f = Field(nm, dt)
+        need_alias = item.alias or not isinstance(item.expr, A.Col) \
+            or (fe.name == "AttributeReference" and fe.value != nm)
+        exprs.append(falias(fe, nm) if need_alias else fe)
+        cols.append((qual, f))
+    out = Schema(tuple(f for _, f in cols))
     node = ForeignNode("ProjectExec", children=(rel.node,), output=out,
                        attrs={"project_list": exprs})
-    return Rel(node, Scope([(None, f) for f in out.fields]), False)
+    return Rel(node, Scope(cols, aliases), False)
 
 
 def _lower_distinct(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
@@ -776,17 +1156,37 @@ def _two_phase(child: ForeignNode, grouping, group_fields, entries,
                "agg_names": agg_names, "mode": "final"})
 
 
-def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
+@dataclass
+class _AggWin:
+    """Aggregation context threaded to window lowering when a SELECT
+    mixes GROUP BY aggregates with window functions: the final
+    projection is deferred until after the WindowExec stack so window
+    partition/order/args can reference agg outputs (and the ROLLUP
+    grouping id)."""
+    plan: "_AggPlan"
+    scope: Scope                 # pre-aggregation scope (for slotting)
+    group_names: List[Tuple[A.Expr, str]]
+
+
+def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx,
+                     for_windows: bool = False
+                     ) -> Tuple[Rel, Optional[_AggWin]]:
     group_names: List[Tuple[A.Expr, str]] = []
     group_fields: List[Field] = []
     grouping: List[ForeignExpr] = []
     scope = rel.scope
     child = rel.node
-    needs_pre = any(not isinstance(g, A.Col) for g in sel.group_by)
+    # dedupe grouping expressions (q11 lists d_year twice; Spark's
+    # analyzer collapses duplicates)
+    group_by: List[A.Expr] = []
+    for g in sel.group_by:
+        if g not in group_by:
+            group_by.append(g)
+    needs_pre = any(not isinstance(g, A.Col) for g in group_by)
     if needs_pre:
         pre_exprs: List[ForeignExpr] = []
         pre_cols: List[Tuple[Optional[str], Field]] = []
-        for g in sel.group_by:
+        for g in group_by:
             if isinstance(g, A.Col):
                 continue
             fe = _lower_expr(g, scope, ctx)
@@ -808,7 +1208,7 @@ def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
                             output=out,
                             attrs={"project_list": pre_exprs})
         scope = Scope(pre_cols)
-    for g in sel.group_by:
+    for g in group_by:
         nm = next((n for gg, n in group_names if gg == g), None)
         if nm is not None:
             f = scope.resolve(nm, None)
@@ -881,7 +1281,25 @@ def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
     plan = _AggPlan()
     final_items: List[Tuple[str, A.Expr]] = []
     for i, item in enumerate(sel.items):
-        if isinstance(item.expr, A.WindowCall):
+        wcs = [] if isinstance(item.expr, A.Star) else \
+            _win_calls(item.expr)
+        if wcs:
+            # aggs used inside window specs/args must be slotted into
+            # the aggregate BEFORE the two-phase plan is built; aggs in
+            # the surrounding expression (sum(x) * .. / win OVER ..)
+            # are found by the pruned _find_aggs below
+            win_aggs: List[A.Call] = []
+            for w in wcs:
+                for a in w.call.args:
+                    if not isinstance(a, A.Star):
+                        _find_aggs(a, win_aggs)
+                for p in w.partition_by:
+                    _find_aggs(p, win_aggs)
+                for s in w.order_by:
+                    _find_aggs(s.expr, win_aggs)
+            _find_aggs(item.expr, win_aggs)
+            for c in win_aggs:
+                plan.slot(c, scope, ctx)
             continue
         nm = _item_name(item, i)
         if isinstance(item.expr, A.Call) and \
@@ -910,6 +1328,11 @@ def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
                            output=node.output,
                            attrs={"condition": fe})
 
+    if for_windows:
+        return (Rel(node, agg_scope, False),
+                _AggWin(plan=plan, scope=scope,
+                        group_names=group_names))
+
     exprs: List[ForeignExpr] = []
     fields: List[Field] = []
     trivial = True
@@ -925,11 +1348,25 @@ def _lower_aggregate(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
     agg_out_names = [f.name for f in group_fields] + \
         [f.name for _, _, f in plan.entries]
     if trivial and [f.name for f in fields] == agg_out_names:
-        return Rel(node, agg_scope, False)
+        return Rel(node, agg_scope, False), None
+    n_visible = len(fields)
+    for s in sel.order_by:
+        # ORDER BY a grouping column the SELECT list dropped (q12):
+        # carry it hidden through the projection; _order_limit projects
+        # it away after sorting
+        e = s.expr
+        if isinstance(e, A.Col) and \
+                not any(f.name == e.name for f in fields) and \
+                agg_scope.has(e.name, None):
+            f = agg_scope.resolve(e.name, None)
+            exprs.append(fcol(f.name, f.dtype, f.nullable))
+            fields.append(Field(f.name, f.dtype))
     out = Schema(tuple(fields))
     node = ForeignNode("ProjectExec", children=(node,), output=out,
                        attrs={"project_list": exprs})
-    return Rel(node, Scope([(None, f) for f in out.fields]), False)
+    return (Rel(node, Scope([(None, f) for f in out.fields]), False,
+                visible=n_visible if len(fields) > n_visible else None),
+            None)
 
 
 # ---------------------------------------------------------------------------
@@ -946,44 +1383,91 @@ def _requal(e: A.Expr, scope: Scope) -> A.Expr:
     return e
 
 
-def _lower_windows(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
-    wins = [(i, item) for i, item in enumerate(sel.items)
-            if isinstance(item.expr, A.WindowCall)]
-    specs = {(w.expr.partition_by, w.expr.order_by) for _, w in wins}
-    if len(specs) != 1:
-        raise SqlError("multiple window specs in one SELECT")
-    wc: A.WindowCall = wins[0][1].expr
-    part = [_lower_expr(_requal(p, rel.scope), rel.scope, ctx)
-            for p in wc.partition_by]
-    order = [_so(_lower_expr(_requal(s.expr, rel.scope), rel.scope,
-                             ctx), s)
-             for s in wc.order_by]
-    node = rel.node
-    if part:
+def _lower_windows(sel: A.Select, rel: Rel, ctx: _Ctx,
+                   aggwin: Optional[_AggWin] = None) -> Rel:
+    """Window stage(s) after the optional aggregation: one
+    exchange+WindowExec per distinct (PARTITION BY, ORDER BY) spec,
+    rank family and agg-over-window both supported.  With `aggwin`
+    (SELECT mixing GROUP BY aggregates and windows) every expression
+    lowers through the post-aggregation rewriter, so window specs can
+    reference agg outputs and grouping()."""
+    wins: List[Tuple[str, A.WindowCall]] = []
+    for i, item in enumerate(sel.items):
+        if isinstance(item.expr, A.Star):
+            continue
+        wcs = _win_calls(item.expr)
+        if isinstance(item.expr, A.WindowCall):
+            wins.append((_item_name(item, i), item.expr))
+        else:
+            # window calls nested inside a larger expression compute
+            # under internal names; the final projection substitutes
+            for w in wcs:
+                wins.append((ctx.fresh("win"), w))
+
+    def lower_e(e: A.Expr) -> ForeignExpr:
+        if aggwin is not None:
+            return _rewrite_post_agg(e, aggwin.plan, aggwin.scope,
+                                     aggwin.group_names, ctx, rel.scope)
+        return _lower_expr(_requal(e, rel.scope), rel.scope, ctx)
+
+    # group windows by spec, preserving first-appearance order
+    spec_order: List[Tuple] = []
+    by_spec: Dict[Tuple, List[Tuple[str, A.WindowCall]]] = {}
+    for nm, w in wins:
+        key = (w.partition_by, w.order_by)
+        if key not in by_spec:
+            by_spec[key] = []
+            spec_order.append(key)
+        by_spec[key].append((nm, w))
+
+    for key in spec_order:
+        group = by_spec[key]
+        wc: A.WindowCall = group[0][1]
+        part = [lower_e(p) for p in wc.partition_by]
+        order = [_so(lower_e(s.expr), s) for s in wc.order_by]
+        node = rel.node
+        if part:
+            node = ForeignNode(
+                "ShuffleExchangeExec", children=(node,),
+                output=node.output,
+                attrs={"partitioning": {"mode": "hash",
+                                        "num_partitions": ctx.n_parts,
+                                        "expressions": part}})
+        wexprs = []
+        wfields = []
+        for nm, w in group:
+            if w.call.name in _WINDOW_FNS:
+                wexprs.append({"name": nm, "fn": w.call.name,
+                               "args": [], "agg": None, "dtype": I32})
+                wfields.append(Field(nm, I32))
+                ctx.window_subst[id(w)] = fcol(nm, I32)
+            elif w.call.name in _AGG_FNS:
+                fn = _AGG_FNS[w.call.name]
+                arg = None
+                if w.call.args and not isinstance(w.call.args[0],
+                                                  A.Star):
+                    arg = lower_e(w.call.args[0])
+                dt = _agg_out_dtype(fn, arg)
+                agg = _spark_agg(fn, arg, dt, w.call.distinct)
+                wexprs.append({"name": nm, "fn": "agg",
+                               "args": [arg] if arg is not None else [],
+                               "agg": agg, "dtype": dt})
+                wfields.append(Field(nm, dt))
+                ctx.window_subst[id(w)] = fcol(nm, dt)
+            else:
+                raise SqlError(f"unsupported window function "
+                               f"{w.call.name}()")
+        win_out = Schema(tuple(f for _, f in rel.scope.cols) +
+                         tuple(wfields))
         node = ForeignNode(
-            "ShuffleExchangeExec", children=(node,), output=node.output,
-            attrs={"partitioning": {"mode": "hash",
-                                    "num_partitions": ctx.n_parts,
-                                    "expressions": part}})
-    wexprs = []
-    wfields = []
-    for i, item in wins:
-        w: A.WindowCall = item.expr
-        if w.call.name not in _WINDOW_FNS:
-            raise SqlError(f"unsupported window function "
-                           f"{w.call.name}()")
-        nm = _item_name(item, i)
-        wexprs.append({"name": nm, "fn": w.call.name, "args": [],
-                       "agg": None, "dtype": I32})
-        wfields.append(Field(nm, I32))
-    win_out = Schema(tuple(f for _, f in rel.scope.cols) +
-                     tuple(wfields))
-    node = ForeignNode(
-        "WindowExec", children=(node,), output=win_out,
-        attrs={"window_exprs": wexprs, "partition_spec": part,
-               "order_spec": order})
-    scope = Scope(rel.scope.cols + [(None, f) for f in wfields])
-    rel = Rel(node, scope, False)
+            "WindowExec", children=(node,), output=win_out,
+            attrs={"window_exprs": wexprs, "partition_spec": part,
+                   "order_spec": order})
+        rel = Rel(node,
+                  Scope(rel.scope.cols + [(None, f) for f in wfields],
+                        rel.scope.aliases), False)
+
+    scope = rel.scope
     exprs: List[ForeignExpr] = []
     fields: List[Field] = []
     for i, item in enumerate(sel.items):
@@ -996,17 +1480,345 @@ def _lower_windows(sel: A.Select, rel: Rel, ctx: _Ctx) -> Rel:
             exprs.append(fcol(f.name, f.dtype))
             fields.append(Field(nm, f.dtype))
         else:
-            fe = _lower_expr(item.expr, scope, ctx)
+            fe = lower_e(item.expr)
             exprs.append(falias(fe, nm))
             fields.append(Field(nm, _dt_of(fe)))
+    n_visible = len(fields)
+    for s in sel.order_by:
+        # ORDER BY a grouping column the SELECT list dropped (q12):
+        # hidden carrier, projected away by _order_limit
+        e = s.expr
+        if isinstance(e, A.Col) and \
+                not any(f.name == e.name for f in fields) and \
+                scope.has(e.name, None):
+            f = scope.resolve(e.name, None)
+            exprs.append(fcol(f.name, f.dtype, f.nullable))
+            fields.append(Field(f.name, f.dtype))
     out = Schema(tuple(fields))
     node = ForeignNode("ProjectExec", children=(rel.node,), output=out,
                        attrs={"project_list": exprs})
-    return Rel(node, Scope([(None, f) for f in out.fields]), False)
+    return Rel(node, Scope([(None, f) for f in out.fields]), False,
+               visible=n_visible if len(fields) > n_visible else None)
+
+
+def _disjuncts(e: A.Expr) -> List[A.Expr]:
+    if isinstance(e, A.Bin) and e.op == "or":
+        return _disjuncts(e.left) + _disjuncts(e.right)
+    return [e]
+
+
+def _has_subquery(e: A.Expr) -> bool:
+    return any(isinstance(x, (A.Exists, A.InSubquery, A.ScalarSubquery))
+               for x in _walk(e)) or \
+        isinstance(e, (A.Exists, A.InSubquery, A.ScalarSubquery))
+
+
+def _outer_cols(e: A.Expr, sub_scope: Scope, outer: Scope) -> List[A.Col]:
+    """Columns in `e` that resolve in the OUTER scope but not the
+    subquery's own — the correlation references."""
+    out = []
+    for c in _expr_cols(e):
+        if not sub_scope.has(c.name, c.table) and \
+                outer.has(c.name, c.table):
+            out.append(c)
+    return out
+
+
+def _existence_join(rel: Rel, sub: Rel, lks, rks, name: str,
+                    ctx: _Ctx) -> Rel:
+    """Left-existence join: keep every left row, add a bool column
+    `name` that says whether a right match exists (Spark's
+    ExistenceJoin, the join type OR-of-subquery predicates plan to)."""
+    ex_field = Field(name, BOOL, nullable=False)
+    out = Schema(tuple(f for _, f in rel.scope.cols) + (ex_field,))
+    attrs = {"left_keys": lks, "right_keys": rks,
+             "join_type": "ExistenceJoin", "existence_name": name}
+    if sub.broadcastable:
+        bx = ForeignNode("BroadcastExchangeExec", children=(sub.node,),
+                         output=sub.node.output)
+        node = ForeignNode(
+            "BroadcastHashJoinExec", children=(rel.node, bx), output=out,
+            attrs={**attrs, "build_side": "right"})
+    else:
+        node = ForeignNode(
+            "SortMergeJoinExec",
+            children=(_hash_exchange(rel.node, lks, ctx),
+                      _hash_exchange(sub.node, rks, ctx)),
+            output=out, attrs=attrs)
+    scope = Scope(rel.scope.cols + [(None, ex_field)],
+                  rel.scope.aliases)
+    return Rel(node, scope, False)
+
+
+def _restore_scope(rel: Rel, orig: Scope) -> Rel:
+    """Project away helper columns (existence flags, decorrelation
+    keys), restoring the pre-predicate scope."""
+    proj = [fcol(f.name, f.dtype, f.nullable) for _, f in orig.cols]
+    node = ForeignNode("ProjectExec", children=(rel.node,),
+                       output=orig.schema(),
+                       attrs={"project_list": proj})
+    return Rel(node, orig, False)
+
+
+def _lower_or_subquery_pred(f: A.Expr, rel: Rel,
+                            ctx: _Ctx) -> Optional[Rel]:
+    """OR with subquery disjuncts: each EXISTS / IN-subquery leaf
+    becomes an existence join contributing a bool column, then one
+    filter ORs the columns together (how Spark plans disjunctive
+    subquery predicates — ExistenceJoin instead of semi/anti)."""
+    leaves = _disjuncts(f)
+    if len(leaves) < 2 or not any(_has_subquery(x) for x in leaves):
+        return None
+    orig_scope = rel.scope
+    conds: List[ForeignExpr] = []
+    for leaf in leaves:
+        neg = False
+        x = leaf
+        if isinstance(x, A.Un) and x.op == "not":
+            neg = True
+            x = x.child
+        if isinstance(x, A.InSubquery):
+            sub = _lower_select(x.query, ctx)
+            if len(sub.scope.cols) != 1:
+                raise SqlError("IN subquery must produce one column")
+            sub = _avoid_collisions(rel.scope, sub, ctx)
+            lk = _lower_expr(x.child, rel.scope, ctx)
+            rf = sub.scope.cols[0][1]
+            anti = bool(x.negated) != neg
+            if anti:
+                # three-valued NOT IN inside an OR: a NULL in the
+                # subquery makes the arm UNKNOWN for every row, and a
+                # NULL probe key can never pass — eager null probe
+                # (same policy as the conjunctive NOT IN path below)
+                probe = ForeignNode(
+                    "GlobalLimitExec",
+                    children=(ForeignNode(
+                        "FilterExec", children=(sub.node,),
+                        output=sub.node.output,
+                        attrs={"condition": fcall(
+                            "IsNull", fcol(rf.name, rf.dtype),
+                            dtype=BOOL)}),),
+                    output=sub.node.output, attrs={"limit": 1})
+                if ctx.execute_subplan(probe).num_rows > 0:
+                    conds.append(flit(False, BOOL))
+                    continue
+            nm = ctx.fresh("ex")
+            rel = _existence_join(rel, sub, [lk],
+                                  [fcol(rf.name, rf.dtype)], nm, ctx)
+            c: ForeignExpr = fcol(nm, BOOL, False)
+            if anti:
+                c = fcall("And",
+                          fcall("IsNotNull", lk, dtype=BOOL),
+                          fcall("Not", c, dtype=BOOL), dtype=BOOL)
+            conds.append(c)
+        elif isinstance(x, A.Exists):
+            sub, lks, rks = _decorrelate_exists(x.query, rel, ctx)
+            sub = _avoid_collisions(rel.scope, sub, ctx)
+            rks = [fcol(f.name, f.dtype) for _, f in sub.scope.cols]
+            nm = ctx.fresh("ex")
+            rel = _existence_join(rel, sub, lks, rks, nm, ctx)
+            c = fcol(nm, BOOL, False)
+            if bool(x.negated) != neg:
+                c = fcall("Not", c, dtype=BOOL)
+            conds.append(c)
+        else:
+            conds.append(_lower_expr(leaf, rel.scope, ctx))
+    cond = conds[0]
+    for c in conds[1:]:
+        cond = fcall("Or", cond, c, dtype=BOOL)
+    node = ForeignNode("FilterExec", children=(rel.node,),
+                       output=rel.node.output,
+                       attrs={"condition": cond})
+    return _restore_scope(Rel(node, rel.scope, False), orig_scope)
+
+
+def _decorrelate_exists(sub_sel: A.Select, rel: Rel,
+                        ctx: _Ctx) -> Tuple[Rel, List[ForeignExpr],
+                                            List[ForeignExpr]]:
+    """Pull the correlating equalities out of an EXISTS body; returns
+    (lowered subquery projecting the correlation keys, outer keys,
+    placeholder right keys — callers re-derive rks after collision
+    renames)."""
+    outer_eq: List[Tuple[A.Expr, A.Expr]] = []
+    residual: List[A.Expr] = []
+    sub_scope = _probe_scope(sub_sel, ctx)
+    for c in _conjuncts(sub_sel.where):
+        if isinstance(c, A.Bin) and c.op == "==":
+            a, b = c.left, c.right
+            if _refs_only(a, rel.scope) and _refs_only(b, sub_scope) \
+                    and _outer_cols(b, sub_scope, rel.scope) == []:
+                outer_eq.append((a, b))
+                continue
+            if _refs_only(b, rel.scope) and _refs_only(a, sub_scope) \
+                    and _outer_cols(a, sub_scope, rel.scope) == []:
+                outer_eq.append((b, a))
+                continue
+        residual.append(c)
+    if not outer_eq:
+        raise SqlError("EXISTS without a correlating equality is "
+                       "not supported")
+    inner_sel = A.Select(
+        items=tuple(A.SelectItem(expr=b, alias=f"__ck{i}")
+                    for i, (_, b) in enumerate(outer_eq)),
+        from_=sub_sel.from_,
+        where=_and_all(residual), ctes=sub_sel.ctes)
+    sub = _lower_select(inner_sel, ctx)
+    lks = [_lower_expr(a, rel.scope, ctx) for a, _ in outer_eq]
+    rks = [fcol(f.name, f.dtype) for _, f in sub.scope.cols]
+    return sub, lks, rks
+
+
+def _subst(e: A.Expr, mapping: List[Tuple[A.Col, A.Expr]]) -> A.Expr:
+    """Replace outer-column refs with their inner equivalents (from the
+    correlating equalities) — nested subquery bodies are left alone."""
+    import dataclasses
+    if isinstance(e, A.Col):
+        for a, b in mapping:
+            if e == a:
+                return b
+        return e
+    if not dataclasses.is_dataclass(e) or \
+            isinstance(e, (A.Exists, A.ScalarSubquery, A.InSubquery)):
+        return e
+    changes = {}
+    for fl in dataclasses.fields(e):
+        v = getattr(e, fl.name)
+        if isinstance(v, A.Expr):
+            nv = _subst(v, mapping)
+            if nv is not v:
+                changes[fl.name] = nv
+        elif isinstance(v, tuple):
+            nv = tuple(_subst(x, mapping) if isinstance(x, A.Expr)
+                       else x for x in v)
+            if nv != v:
+                changes[fl.name] = nv
+    return dataclasses.replace(e, **changes) if changes else e
+
+
+def _decorrelate_scalar(sq: A.ScalarSubquery, rel: Rel,
+                        ctx: _Ctx) -> Optional[Rel]:
+    """Decorrelate one correlated scalar subquery (single aggregate,
+    no GROUP BY): group the subquery on its correlation keys, join on
+    the outer sides, and register the agg output column as the
+    subquery's substitution — Spark's
+    RewriteCorrelatedScalarSubquery.  Inner join drops outer rows with
+    no group, matching NULL-comparison semantics for the agg result.
+    Correlating equalities may also live under an OR when every
+    disjunct carries them (q41's shape): they factor out, and the
+    outer refs in the residual are substituted with their inner
+    equivalents."""
+    sub_sel = sq.query
+    if len(sub_sel.items) != 1 or sub_sel.group_by or \
+            not _has_agg(sub_sel.items[0].expr):
+        return None
+    try:
+        sub_scope = _probe_scope(sub_sel, ctx)
+    except SqlError:
+        return None
+
+    def classify_eq(c):
+        if isinstance(c, A.Bin) and c.op == "==":
+            a, b = c.left, c.right
+            a_out = _outer_cols(a, sub_scope, rel.scope)
+            b_out = _outer_cols(b, sub_scope, rel.scope)
+            if a_out and not b_out and isinstance(a, A.Col) and all(
+                    sub_scope.has(x.name, x.table)
+                    for x in _expr_cols(b)):
+                return a, b
+            if b_out and not a_out and isinstance(b, A.Col) and all(
+                    sub_scope.has(x.name, x.table)
+                    for x in _expr_cols(a)):
+                return b, a
+        return None
+
+    corr: List[Tuple[A.Col, A.Expr]] = []
+    residual: List[A.Expr] = []
+    for c in _conjuncts(sub_sel.where):
+        pair = classify_eq(c)
+        if pair is not None:
+            if pair not in corr:
+                corr.append(pair)
+            continue
+        if isinstance(c, A.Bin) and c.op == "or":
+            # factor correlating equalities every disjunct shares
+            per = [[classify_eq(x) for x in _conjuncts(d)]
+                   for d in _disjuncts(c)]
+            common = [p for p in (per[0] or [])
+                      if p is not None and
+                      all(p in ps for ps in per[1:])]
+            for p in common:
+                if p not in corr:
+                    corr.append(p)
+        residual.append(c)
+    if not corr:
+        return None              # uncorrelated: eager path handles it
+    # outer refs surviving in the residual rewrite to their inner
+    # equivalents; anything else is a correlation we cannot handle
+    residual = [_subst(c, corr) for c in residual]
+    for c in residual + [_subst(sub_sel.items[0].expr, corr)]:
+        if _outer_cols(c, sub_scope, rel.scope):
+            return None
+    inner_sel = A.Select(
+        items=tuple(A.SelectItem(expr=b, alias=f"__ck{i}")
+                    for i, (_, b) in enumerate(corr)) +
+        (A.SelectItem(expr=_subst(sub_sel.items[0].expr, corr),
+                      alias="__sv"),),
+        from_=sub_sel.from_, where=_and_all(residual),
+        group_by=tuple(b for _, b in corr), ctes=sub_sel.ctes)
+    sub = _lower_select(inner_sel, ctx)
+    sub = _avoid_collisions(rel.scope, sub, ctx)
+    lks = [_lower_expr(a, rel.scope, ctx) for a, _ in corr]
+    rks = [fcol(f.name, f.dtype) for _, f in sub.scope.cols[:-1]]
+    joined = _join(rel, sub, "inner", lks, rks, ctx)
+    sv = sub.scope.cols[-1][1]
+    ctx.scalar_subst[id(sq)] = fcol(sv.name, sv.dtype)
+    return joined
+
+
+def _lower_corr_scalar_cmp(f: A.Expr, rel: Rel,
+                           ctx: _Ctx) -> Optional[Rel]:
+    """A WHERE conjunct containing correlated scalar subqueries
+    anywhere in its expression tree (x > 1.2 * (SELECT avg(..) ..)):
+    decorrelate each into a joined column, then lower the conjunct
+    with those columns substituted."""
+    sqs = [x for x in _walk(f) if isinstance(x, A.ScalarSubquery)]
+    if isinstance(f, A.ScalarSubquery):
+        sqs.append(f)
+    correlated = []
+    for sq in sqs:
+        try:
+            sub_scope = _probe_scope(sq.query, ctx)
+        except SqlError:
+            continue
+        outer = False
+        for c in _conjuncts(sq.query.where):
+            if _outer_cols(c, sub_scope, rel.scope):
+                outer = True
+        if outer:
+            correlated.append(sq)
+    if not correlated:
+        return None
+    orig_scope = rel.scope
+    for sq in correlated:
+        nxt = _decorrelate_scalar(sq, rel, ctx)
+        if nxt is None:
+            return None
+        rel = nxt
+    cond = _lower_expr(f, rel.scope, ctx)
+    node = ForeignNode("FilterExec", children=(rel.node,),
+                       output=rel.node.output,
+                       attrs={"condition": cond})
+    return _restore_scope(Rel(node, rel.scope, False), orig_scope)
 
 
 def _lower_subquery_pred(f: A.Expr, rel: Rel,
                          ctx: _Ctx) -> Optional[Rel]:
+    r = _lower_or_subquery_pred(f, rel, ctx)
+    if r is not None:
+        return r
+    r = _lower_corr_scalar_cmp(f, rel, ctx)
+    if r is not None:
+        return r
     neg = False
     inner = f
     if isinstance(inner, A.Un) and inner.op == "not":
@@ -1050,21 +1862,77 @@ def _lower_subquery_pred(f: A.Expr, rel: Rel,
     if isinstance(inner, A.Exists):
         sub_sel = inner.query
         outer_eq: List[Tuple[A.Expr, A.Expr]] = []
+        outer_neq: List[Tuple[A.Expr, A.Expr]] = []
         residual: List[A.Expr] = []
         sub_scope = _probe_scope(sub_sel, ctx)
         for c in _conjuncts(sub_sel.where):
-            if isinstance(c, A.Bin) and c.op == "==":
+            if isinstance(c, A.Bin) and c.op in ("==", "!="):
                 a, b = c.left, c.right
                 if _refs_only(a, rel.scope) and _refs_only(b, sub_scope):
-                    outer_eq.append((a, b))
+                    (outer_eq if c.op == "==" else
+                     outer_neq).append((a, b))
                     continue
                 if _refs_only(b, rel.scope) and _refs_only(a, sub_scope):
-                    outer_eq.append((b, a))
+                    (outer_eq if c.op == "==" else
+                     outer_neq).append((b, a))
                     continue
             residual.append(c)
         if not outer_eq:
             raise SqlError("EXISTS without a correlating equality is "
                            "not supported")
+        anti = bool(inner.negated) != neg
+        if outer_neq:
+            # correlated inequality (q16: cs1.cs_warehouse_sk <>
+            # cs2.cs_warehouse_sk): a differing row exists iff the
+            # per-key min or max of the inner side differs from the
+            # outer value — group the subquery and compare
+            if anti:
+                raise SqlError("NOT EXISTS with a correlated "
+                               "inequality is not supported")
+            items = [A.SelectItem(expr=b, alias=f"__ck{i}")
+                     for i, (_, b) in enumerate(outer_eq)]
+            for j, (_, ie) in enumerate(outer_neq):
+                items.append(A.SelectItem(
+                    expr=A.Call(name="min", args=(ie,)),
+                    alias=f"__mn{j}"))
+                items.append(A.SelectItem(
+                    expr=A.Call(name="max", args=(ie,)),
+                    alias=f"__mx{j}"))
+            inner_sel = A.Select(
+                items=tuple(items), from_=sub_sel.from_,
+                where=_and_all(residual),
+                group_by=tuple(b for _, b in outer_eq),
+                ctes=sub_sel.ctes)
+            sub = _lower_select(inner_sel, ctx)
+            sub = _avoid_collisions(rel.scope, sub, ctx)
+            orig_scope = rel.scope
+            lks = [_lower_expr(a, rel.scope, ctx) for a, _ in outer_eq]
+            n_k = len(outer_eq)
+            rks = [fcol(f.name, f.dtype)
+                   for _, f in sub.scope.cols[:n_k]]
+            joined = _join(rel, sub, "inner", lks, rks, ctx)
+            conds = []
+            for j, (oe, _) in enumerate(outer_neq):
+                o_fe = _lower_expr(oe, joined.scope, ctx)
+                mn = sub.scope.cols[n_k + 2 * j][1]
+                mx = sub.scope.cols[n_k + 2 * j + 1][1]
+                conds.append(fcall(
+                    "Or",
+                    fcall("Not", fcall("EqualTo", o_fe,
+                                       fcol(mn.name, mn.dtype),
+                                       dtype=BOOL), dtype=BOOL),
+                    fcall("Not", fcall("EqualTo", o_fe,
+                                       fcol(mx.name, mx.dtype),
+                                       dtype=BOOL), dtype=BOOL),
+                    dtype=BOOL))
+            cond = conds[0]
+            for c in conds[1:]:
+                cond = fcall("And", cond, c, dtype=BOOL)
+            node = ForeignNode("FilterExec", children=(joined.node,),
+                               output=joined.node.output,
+                               attrs={"condition": cond})
+            return _restore_scope(Rel(node, joined.scope, False),
+                                  orig_scope)
         inner_sel = A.Select(
             items=tuple(A.SelectItem(expr=b, alias=f"__ck{i}")
                         for i, (_, b) in enumerate(outer_eq)),
@@ -1073,7 +1941,6 @@ def _lower_subquery_pred(f: A.Expr, rel: Rel,
         sub = _lower_select(inner_sel, ctx)
         lks = [_lower_expr(a, rel.scope, ctx) for a, _ in outer_eq]
         rks = [fcol(f.name, f.dtype) for _, f in sub.scope.cols]
-        anti = bool(inner.negated) != neg
         return _semi_anti_join(rel, sub, lks, rks, anti, ctx)
     return None
 
@@ -1138,18 +2005,29 @@ def _order_limit(rel: Rel, sel: A.Select, ctx: _Ctx) -> Rel:
                     f"{len(fields)}")
             f = fields[e.value - 1]
             return _so(fcol(f.name, f.dtype), s)
+        # ORDER BY an expression the SELECT list already computed
+        # (ORDER BY sum(x) after GROUP BY): sort on its output column
+        for i, item in enumerate(sel.items):
+            if item.expr == e:
+                nm = _item_name(item, i)
+                if rel.scope.has(nm, None):
+                    f = rel.scope.resolve(nm, None)
+                    return _so(fcol(f.name, f.dtype), s)
         return _so(_lower_expr(_requal(e, rel.scope), rel.scope, ctx),
                    s)
 
+    vis = fields if rel.visible is None else fields[:rel.visible]
+    vis_scope = Scope([(None, f) for f in vis]) \
+        if rel.visible is not None else rel.scope
     if sel.order_by and sel.limit is not None:
         orders = [resolve_order(s) for s in sel.order_by]
         node = ForeignNode(
             "TakeOrderedAndProjectExec", children=(rel.node,),
-            output=rel.scope.schema(),
+            output=vis_scope.schema(),
             attrs={"sort_order": orders, "limit": sel.limit,
                    "project_list": [fcol(f.name, f.dtype)
-                                    for f in fields]})
-        return Rel(node, rel.scope, False)
+                                    for f in vis]})
+        return Rel(node, vis_scope, False)
     if sel.order_by:
         orders = [resolve_order(s) for s in sel.order_by]
         ex = ForeignNode(
@@ -1160,11 +2038,22 @@ def _order_limit(rel: Rel, sel: A.Select, ctx: _Ctx) -> Rel:
         node = ForeignNode("SortExec", children=(ex,),
                            output=rel.scope.schema(),
                            attrs={"sort_order": orders})
-        return Rel(node, rel.scope, False)
+        if rel.visible is not None:
+            node = ForeignNode(
+                "ProjectExec", children=(node,),
+                output=vis_scope.schema(),
+                attrs={"project_list": [fcol(f.name, f.dtype)
+                                        for f in vis]})
+        return Rel(node, vis_scope, False)
     node = ForeignNode("GlobalLimitExec", children=(rel.node,),
                        output=rel.scope.schema(),
                        attrs={"limit": sel.limit})
-    return Rel(node, rel.scope, False)
+    if rel.visible is not None:
+        node = ForeignNode(
+            "ProjectExec", children=(node,), output=vis_scope.schema(),
+            attrs={"project_list": [fcol(f.name, f.dtype)
+                                    for f in vis]})
+    return Rel(node, vis_scope, False)
 
 
 # ---------------------------------------------------------------------------

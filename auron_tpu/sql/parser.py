@@ -181,6 +181,10 @@ class Select:
     distinct: bool = False
     ctes: Tuple[Tuple[str, "Select"], ...] = ()
     union_all: Tuple["Select", ...] = ()   # additional UNION ALL branches
+    # general set-op chain (left-assoc): ("union"|"union_all"|
+    # "intersect"|"except", branch) — used when the chain is not pure
+    # UNION ALL
+    set_ops: Tuple[Tuple[str, "Select"], ...] = ()
     rollup: bool = False                   # GROUP BY ROLLUP(...)
 
 
@@ -192,8 +196,9 @@ _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+|--[^\n]*)
   | (?P<num>\d+\.\d*|\.\d+|\d+)
   | (?P<str>'(?:[^']|'')*')
+  | (?P<qname>`[^`]*`)
   | (?P<name>[A-Za-z_][A-Za-z_0-9]*)
-  | (?P<op><>|!=|>=|<=|\|\||[(),.*+\-/%<>=])
+  | (?P<op><>|!=|>=|<=|\|\||[(),.*+\-/%<>=;])
 """, re.VERBOSE)
 
 
@@ -211,6 +216,11 @@ def _lex(sql: str) -> List[Tuple[str, str]]:
         pos = m.end()
         if m.lastgroup == "ws":
             continue
+        if m.lastgroup == "qname":
+            # backtick-quoted identifier (aliases with spaces): a plain
+            # name token carrying the unquoted text
+            out.append(("name", m.group()[1:-1]))
+            continue
         out.append((m.lastgroup, m.group()))
     out.append(("eof", ""))
     return out
@@ -223,6 +233,8 @@ _KEYWORDS = {
     "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "union", "all", "exists", "with", "asc", "desc", "nulls", "first",
     "last", "over", "partition", "date", "interval", "true", "false",
+    "intersect", "except", "rows", "range", "unbounded", "preceding",
+    "following", "current",
 }
 
 
@@ -281,6 +293,8 @@ class _P:
 
     def parse(self) -> Select:
         s = self.select_stmt()
+        while self.eat_op(";"):
+            pass
         if self.peek()[0] != "eof":
             raise SqlError(f"trailing input: {self._ctx()}")
         return s
@@ -297,25 +311,39 @@ class _P:
                 ctes.append((nm.lower(), q))
                 if not self.eat_op(","):
                     break
-        first = self.select_core()
-        branches: List[Select] = []
-        while self.kw("union"):
+        # INTERSECT binds tighter than UNION/EXCEPT (standard SQL):
+        # parse intersect-chains as terms of the outer chain
+        first, first_paren = self.intersect_term()
+        branches: List[Tuple[str, Select]] = []
+        last_paren = first_paren
+        while self.kw("union", "except"):
+            kind = self.peek()[1].lower()
             self.i += 1
-            self.expect_kw("all")
-            branches.append(self.select_core())
+            if kind == "union":
+                kind = "union_all" if self.eat_kw("all") else "union"
+            arm, last_paren = self.intersect_term()
+            branches.append((kind, arm))
         # ORDER BY / LIMIT after a union apply to the WHOLE union, but
         # select_core greedily parses them into the last branch — lift
+        # (unless the last arm was parenthesized: its ORDER/LIMIT is
+        # explicitly scoped to that arm)
         order, limit = self.order_limit()
         import dataclasses as _dc
-        if branches and (branches[-1].order_by or
-                         branches[-1].limit is not None):
-            last = branches[-1]
+        if branches and not last_paren and \
+                (branches[-1][1].order_by or
+                 branches[-1][1].limit is not None):
+            kind, last = branches[-1]
             if order or limit is not None:
                 raise SqlError("duplicate ORDER BY/LIMIT")
             order, limit = last.order_by, last.limit
-            branches[-1] = _dc.replace(last, order_by=(), limit=None)
+            branches[-1] = (kind,
+                            _dc.replace(last, order_by=(), limit=None))
         if branches:
-            first = _dc.replace(first, union_all=tuple(branches))
+            if all(k == "union_all" for k, _ in branches):
+                first = _dc.replace(
+                    first, union_all=tuple(b for _, b in branches))
+            else:
+                first = _dc.replace(first, set_ops=tuple(branches))
         if order or limit is not None:
             if first.order_by or first.limit is not None:
                 raise SqlError("duplicate ORDER BY/LIMIT")
@@ -323,6 +351,35 @@ class _P:
         if ctes:
             first = _dc.replace(first, ctes=tuple(ctes))
         return first
+
+    def intersect_term(self) -> Tuple[Select, bool]:
+        """One arm of a UNION/EXCEPT chain: a select core (or
+        parenthesized statement) possibly INTERSECTed with more —
+        INTERSECT binds tighter.  Returns (select, was_parenthesized)."""
+        import dataclasses as _dc
+        first, paren = self.select_core_or_paren()
+        parts: List[Tuple[str, Select]] = []
+        while self.kw("intersect"):
+            self.i += 1
+            arm, paren = self.select_core_or_paren()
+            parts.append(("intersect", arm))
+        if parts:
+            first = _dc.replace(first, set_ops=first.set_ops +
+                                tuple(parts))
+        return first, paren
+
+    def select_core_or_paren(self) -> Tuple[Select, bool]:
+        """A set-op arm: SELECT core, or a parenthesized select
+        statement ((SELECT ...) UNION ALL (SELECT ...))."""
+        if self.op("("):
+            save = self.i
+            self.i += 1
+            if self.kw("select", "with") or self.op("("):
+                s = self.select_stmt()
+                self.expect_op(")")
+                return s, True
+            self.i = save
+        return self.select_core(), False
 
     def order_limit(self):
         order: Tuple[SortItem, ...] = ()
@@ -584,6 +641,21 @@ class _P:
                 self.i += 1
                 return Lit(value=nv[1:-1], kind="date")
             self.i = save
+        if self.kw("interval"):
+            # INTERVAL n DAY[S]: a day-count literal the date +/- fold
+            # in lowering consumes
+            self.i += 1
+            nk, nv = self.peek()
+            if nk == "str":
+                nv = nv[1:-1]          # INTERVAL '90' DAY
+            elif nk != "num":
+                raise SqlError(f"expected INTERVAL count at "
+                               f"{self._ctx()}")
+            self.i += 1
+            unit = self.name().lower()
+            if unit not in ("day", "days"):
+                raise SqlError(f"unsupported INTERVAL unit {unit}")
+            return Lit(value=int(nv), kind="interval_days")
         if self.kw("case"):
             return self.case_expr()
         if self.kw("cast"):
@@ -660,6 +732,18 @@ class _P:
                 self.i += 1
                 self.expect_kw("by")
                 order = tuple(self.sort_items())
+            if self.kw("rows", "range"):
+                # only the running frame (UNBOUNDED PRECEDING ..
+                # CURRENT ROW) is accepted — it is what the engine's
+                # ordered agg-over-window computes
+                self.i += 1
+                self.expect_kw("between")
+                if not (self.eat_kw("unbounded") and
+                        self.eat_kw("preceding")):
+                    raise SqlError("unsupported window frame start")
+                self.expect_kw("and")
+                if not (self.eat_kw("current") and self.eat_kw("row")):
+                    raise SqlError("unsupported window frame end")
             self.expect_op(")")
             return WindowCall(call=call, partition_by=part,
                               order_by=order)
