@@ -537,6 +537,43 @@ class PyArrowEngine:
 
     # -- window ------------------------------------------------------------
 
+    def _window_group_limit_exec(self, node, children):
+        """Group top-k prefilter (WindowGroupLimitExec): keep rows whose
+        rank-like value within their partition is <= limit, original row
+        order preserved (the reference's window-group-limit proto:590)."""
+        t = children[0]
+        ev = _Eval(t)
+        part = [ev.eval(e) for e in node.attrs.get("partition_spec", ())]
+        pkeys = [tuple(None if m[i] else _norm(v[i]) for v, m in part)
+                 for i in range(t.num_rows)] if part else \
+            [()] * t.num_rows
+        order_idx = self._sort_rows(t, node.attrs.get("order_spec", ()))
+        ocols = [ev.eval(s.children[0])
+                 for s in node.attrs.get("order_spec", ())]
+        okey_of = [tuple(None if m[i] else _norm(v[i]) for v, m in ocols)
+                   for i in range(t.num_rows)]
+        groups: Dict[Tuple, List[int]] = {}
+        for i in order_idx:
+            groups.setdefault(pkeys[i], []).append(int(i))
+        k = int(node.attrs.get("limit", 1))
+        fn = node.attrs.get("rank_like_function", "row_number")
+        keep = np.zeros(t.num_rows, dtype=bool)
+        for _, idxs in groups.items():
+            rank = 0
+            dense = 0
+            prev = object()
+            for r, i in enumerate(idxs):
+                key = okey_of[i]
+                if key != prev:
+                    rank = r + 1
+                    dense += 1
+                    prev = key
+                val = r + 1 if fn == "row_number" else (
+                    dense if fn == "dense_rank" else rank)
+                if val <= k:
+                    keep[i] = True
+        return t.filter(pa.array(keep))
+
     def _window_exec(self, node, children):
         t = children[0]
         ev = _Eval(t)

@@ -1,0 +1,54 @@
+#!/bin/bash
+# TPU tunnel recovery watcher (round-5 ops tool).
+#
+# The shared axon tunnel dies or wedges mid-session (rounds 2-5); chip
+# evidence must be banked the moment it revives.  Loop: cheap probe →
+# on success run the banked-evidence sequence, one chip process at a
+# time (the tunnel starves concurrent clients):
+#   1. bench spmd worker  — banks the stage-program compile into
+#      .jax_cache so the driver's end-of-round bench warm-compiles
+#   2. full bench.py      — the canonical BENCH_r5-shaped artifact
+#   3. 64M-row MFU profile — TPU_PROFILE_r05.json roofline numbers
+#   4. sf0.1 IT corpus on tpu — IT_TPU_r05.json per-query chip times
+# Logs to /tmp/tpu_recovery.log; artifacts land in the repo root.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_recovery.log
+echo "$(date -u +%H:%M:%S) watcher armed" >> "$LOG"
+
+probe() {
+  timeout 150 python -c "
+import jax, jax.numpy as jnp
+jax.devices()
+x = jnp.ones((256, 256)); (x @ x).block_until_ready()
+print('probe-ok')
+" 2>/dev/null | grep -q probe-ok
+}
+
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+
+while true; do
+  if probe; then
+    echo "$(date -u +%H:%M:%S) tunnel alive - banking evidence" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) [1/4] spmd worker" >> "$LOG"
+    timeout 4500 python bench.py --worker spmd \
+      > /tmp/r5_spmd_worker.out 2>&1
+    echo "$(date -u +%H:%M:%S) [1/4] rc=$? cache=$(ls .jax_cache | wc -l)" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) [2/4] full bench" >> "$LOG"
+    timeout 2400 python bench.py > /tmp/r5_bench_full.out 2>&1
+    echo "$(date -u +%H:%M:%S) [2/4] rc=$?" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) [3/4] profile 64M" >> "$LOG"
+    AURON_PROFILE_ROWS=$((1<<26)) timeout 3600 python bench.py \
+      --worker profile > /tmp/r5_profile64m.out 2>&1
+    echo "$(date -u +%H:%M:%S) [3/4] rc=$?" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) [4/4] IT sf0.1 on tpu" >> "$LOG"
+    timeout 7200 python -m auron_tpu.it --sf 0.1 --platform tpu \
+      --mesh 1 --json IT_TPU_r05.json > /tmp/r5_it_tpu.out 2>&1
+    echo "$(date -u +%H:%M:%S) [4/4] rc=$?" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) sequence done" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) tunnel down" >> "$LOG"
+  sleep 300
+done
