@@ -199,7 +199,11 @@ def _lower_expr(e: A.Expr, scope: Scope, ctx: _Ctx) -> ForeignExpr:
             # fold cast('yyyy-mm-dd' as date) so date +/- INTERVAL
             # arithmetic folds to plain literals
             import datetime
-            d = datetime.date.fromisoformat(child.value)
+            try:
+                d = datetime.date.fromisoformat(child.value)
+            except ValueError as ex:
+                raise SqlError(f"invalid date literal "
+                               f"{child.value!r}: {ex}") from ex
             return flit((d - datetime.date(1970, 1, 1)).days,
                         DataType.date32())
         return fcall("Cast", child, dtype=target)
@@ -958,8 +962,12 @@ def _lct(a: DataType, b: DataType) -> DataType:
     slice of Spark's findWiderTypeForTwo): float beats decimal/int,
     decimal beats int, wider int beats narrower."""
     if a.is_decimal and b.is_decimal:
-        return a if (a.precision, a.scale) >= (b.precision, b.scale) \
-            else b
+        # max integer digits + max scale (findWiderTypeForTwo), not a
+        # lexicographic pick — decimal(12,0) vs (10,2) must widen to
+        # (14,2) or the (10,2) side truncates its fraction
+        scale = max(a.scale, b.scale)
+        ints = max(a.precision - a.scale, b.precision - b.scale)
+        return DataType.decimal(min(ints + scale, 38), scale)
     if a.id == b.id:
         return a
     ints = ("INT8", "INT16", "INT32", "INT64")
@@ -1769,9 +1777,19 @@ def _decorrelate_scalar(sq: A.ScalarSubquery, rel: Rel,
     sub = _avoid_collisions(rel.scope, sub, ctx)
     lks = [_lower_expr(a, rel.scope, ctx) for a, _ in corr]
     rks = [fcol(f.name, f.dtype) for _, f in sub.scope.cols[:-1]]
-    joined = _join(rel, sub, "inner", lks, rks, ctx)
+    # count's empty-group result is 0, not NULL: outer rows with no
+    # matching group must survive with 0 (Spark special-cases count in
+    # RewriteCorrelatedScalarSubquery via left join + coalesce)
+    item = sub_sel.items[0].expr
+    is_count = isinstance(item, A.Call) and item.name.lower() == "count"
     sv = sub.scope.cols[-1][1]
-    ctx.scalar_subst[id(sq)] = fcol(sv.name, sv.dtype)
+    if is_count:
+        joined = _join(rel, sub, "left", lks, rks, ctx)
+        ctx.scalar_subst[id(sq)] = fcall(
+            "Coalesce", fcol(sv.name, sv.dtype), flit(0, sv.dtype))
+    else:
+        joined = _join(rel, sub, "inner", lks, rks, ctx)
+        ctx.scalar_subst[id(sq)] = fcol(sv.name, sv.dtype)
     return joined
 
 

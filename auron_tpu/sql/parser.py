@@ -339,11 +339,16 @@ class _P:
             branches[-1] = (kind,
                             _dc.replace(last, order_by=(), limit=None))
         if branches:
-            if all(k == "union_all" for k, _ in branches):
+            if all(k == "union_all" for k, _ in branches) and \
+                    not first.set_ops:
                 first = _dc.replace(
                     first, union_all=tuple(b for _, b in branches))
             else:
-                first = _dc.replace(first, set_ops=tuple(branches))
+                # append to any INTERSECT entries intersect_term already
+                # stored: the left-assoc set_ops fold then evaluates
+                # (A INTERSECT B) UNION C in the correct order
+                first = _dc.replace(
+                    first, set_ops=first.set_ops + tuple(branches))
         if order or limit is not None:
             if first.order_by or first.limit is not None:
                 raise SqlError("duplicate ORDER BY/LIMIT")
@@ -364,8 +369,23 @@ class _P:
             arm, paren = self.select_core_or_paren()
             parts.append(("intersect", arm))
         if parts:
+            # the last unparenthesized arm greedily parsed any trailing
+            # ORDER BY/LIMIT; those scope to the whole chain — lift
+            # them onto the chain's Select (select_stmt lifts further
+            # if a UNION/EXCEPT follows)
+            order: Tuple[SortItem, ...] = ()
+            limit = None
+            last = parts[-1][1]
+            if not paren and (last.order_by or last.limit is not None):
+                order, limit = last.order_by, last.limit
+                parts[-1] = ("intersect",
+                             _dc.replace(last, order_by=(), limit=None))
             first = _dc.replace(first, set_ops=first.set_ops +
                                 tuple(parts))
+            if order or limit is not None:
+                if first.order_by or first.limit is not None:
+                    raise SqlError("duplicate ORDER BY/LIMIT")
+                first = _dc.replace(first, order_by=order, limit=limit)
         return first, paren
 
     def select_core_or_paren(self) -> Tuple[Select, bool]:

@@ -358,3 +358,69 @@ def test_rollup_qualified_agg_arg_and_having_guard(catalog):
             group by rollup(i_category, i_brand)
             having count(i_brand) > 0
         """, catalog)
+
+
+def test_mixed_intersect_union_precedence(catalog):
+    """INTERSECT binds tighter than UNION; the branch must not be
+    dropped (review r5: select_stmt used to overwrite the intersect
+    entries intersect_term stored in set_ops)."""
+    got, _ = run_sql("""
+        select s_store_sk k from store where s_store_sk in (1,2)
+        intersect
+        select s_store_sk from store where s_store_sk in (2,3)
+        union
+        select s_store_sk from store where s_store_sk = 4
+        order by k
+    """, catalog)
+    assert [r["k"] for r in got] == [2, 4]
+    # union-all variant: (A INTERSECT B) UNION ALL C
+    got, _ = run_sql("""
+        select s_store_sk k from store where s_store_sk in (1,2)
+        intersect
+        select s_store_sk from store where s_store_sk in (2,3)
+        union all
+        select s_store_sk from store where s_store_sk = 2
+        order by k
+    """, catalog)
+    assert [r["k"] for r in got] == [2, 2]
+
+
+def test_intersect_trailing_order_limit(catalog):
+    """ORDER BY/LIMIT after a pure INTERSECT chain scope to the chain
+    result, not to the last arm."""
+    got, _ = run_sql("""
+        select s_store_sk k from store where s_store_sk <= 3
+        intersect
+        select s_store_sk from store where s_store_sk >= 2
+        order by k desc limit 1
+    """, catalog)
+    assert [r["k"] for r in got] == [3]
+
+
+def test_correlated_count_empty_group_is_zero(catalog):
+    """count(*) over an empty correlated group is 0, not NULL: outer
+    rows must survive the decorrelation (left join + coalesce)."""
+    got, _ = run_sql("""
+        select s_store_sk k from store s
+        where 0 = (select count(*) from store_sales ss
+                   where ss.ss_store_sk = s.s_store_sk
+                     and ss.ss_quantity > 1000000)
+        order by k
+    """, catalog)
+    n_stores, _ = run_sql("select count(*) n from store", catalog)
+    assert len(got) == n_stores[0]["n"] and len(got) > 0
+
+
+def test_decimal_widening_keeps_scale():
+    from auron_tpu.ir.schema import DataType
+    from auron_tpu.sql.lower import _lct
+    t = _lct(DataType.decimal(12, 0), DataType.decimal(10, 2))
+    assert (t.precision, t.scale) == (14, 2)
+    t = _lct(DataType.decimal(38, 2), DataType.decimal(20, 10))
+    assert (t.precision, t.scale) == (38, 10)
+
+
+def test_invalid_date_literal_raises_sql_error(catalog):
+    with pytest.raises(SqlError, match="invalid date literal"):
+        plan_sql("select s_store_sk from store "
+                 "where cast('oops' as date) is null", catalog)
