@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 CPU artifact queue (single-core box: strictly serialized,
+# niced so any revived-tunnel chip work preempts).
+#  1. wait for the in-flight refplans sweep (IT_REFPLANS.json)
+#  2. IT_REFSQL.json  - the reference's own SQL suite, warm recorded
+#  3. IT_SF10.json    - full sf=10 ladder rung: zero exclusions, warm
+#     best-of-2, perf gate armed at 3x (the sf=1 policy)
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/cpu_queue_r5.log
+echo "$(date -u +%H:%M:%S) queue start" >> "$LOG"
+
+while pgrep -f "auron_tpu.it.refplans --sf 0.01 --json IT_REFPLANS" \
+    > /dev/null; do
+  sleep 60
+done
+echo "$(date -u +%H:%M:%S) refplans done; refsql" >> "$LOG"
+nice -n 10 timeout 10800 python -m auron_tpu.it.refsql --sf 0.01 \
+  --json IT_REFSQL.json > /tmp/refsql_full.out 2>&1
+echo "$(date -u +%H:%M:%S) refsql rc=$?; sf10" >> "$LOG"
+nice -n 10 timeout 43200 python -m auron_tpu.it --sf 10 \
+  --data-dir /tmp/auron_tpcds_sf10 --perf-factor 3 \
+  --json IT_SF10.json > /tmp/it_sf10.out 2>&1
+echo "$(date -u +%H:%M:%S) sf10 rc=$?" >> "$LOG"
+echo "$(date -u +%H:%M:%S) queue done" >> "$LOG"
