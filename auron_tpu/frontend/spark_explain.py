@@ -77,6 +77,10 @@ class ExplainDump:
     children: Dict[int, List[int]]              # opid -> child opids
     details: Dict[int, Detail]
     subqueries: Dict[int, int]                  # subquery expr id -> root
+    # Initial-plan ref id -> defined id, when AQE renumbering makes an
+    # Initial section reference an id whose plan prints under the Final
+    # section's id (q14b: ref #114, definition #54)
+    subquery_alias: Dict[int, int] = dc_field(default_factory=dict)
 
 
 _TREE_RE = re.compile(r"^(?P<pre>[\s:+|-]*?)(?:\* )?"
@@ -202,8 +206,38 @@ def parse_explain(text: str) -> ExplainDump:
             sroot, sch = _parse_tree(_initial_tree_lines(chunk))
             subqueries[sid] = sroot
             children.update(sch)
+    # map Initial-plan subquery refs whose id never got a printed plan
+    # to the orphan definition (AQE renumbering): unique unmatched ref
+    # <-> unique unmatched definition
+    referenced: set = set()
+    contexts: Dict[int, set] = {}
+    for opid in children:
+        d = details.get(opid)
+        if d is None:
+            continue
+        for text in list(d.kv.values()) + [
+                x for lst in d.lists.values() for x in lst]:
+            for m in re.finditer(r"(?:scalar-)?subquery#(\d+)", text):
+                sid = int(m.group(1))
+                referenced.add(sid)
+                contexts.setdefault(sid, set()).add(
+                    re.sub(r"#\d+", "#", text))
+    missing = sorted(referenced - set(subqueries))
+    orphans = sorted(set(subqueries) - referenced)
+    alias: Dict[int, int] = {}
+    for mid in missing:
+        # same normalized surrounding text as a defined ref => the same
+        # subquery printed under a second AQE number (q14b's threshold
+        # filter appears in both channel branches as #54 and #114)
+        cands = [did for did in subqueries
+                 if contexts.get(did, set()) & contexts.get(mid, set())]
+        if len(cands) == 1:
+            alias[mid] = cands[0]
+    still = [m for m in missing if m not in alias]
+    if len(still) == 1 and len(orphans) == 1:
+        alias[still[0]] = orphans[0]
     return ExplainDump(root=root, children=children, details=details,
-                       subqueries=subqueries)
+                       subqueries=subqueries, subquery_alias=alias)
 
 
 # ---------------------------------------------------------------------------
@@ -1016,6 +1050,7 @@ class ExplainBinder:
 
     def subquery_literal(self, sid: int,
                          field_name: Optional[str] = None) -> ForeignExpr:
+        sid = self.dump.subquery_alias.get(sid, sid)
         memo = self._subq_memo.get((sid, field_name))
         if memo is not None:
             return memo

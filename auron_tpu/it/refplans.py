@@ -108,6 +108,10 @@ def main() -> int:
     ap.add_argument("--only", default=None,
                     help="comma-separated dump names (q1,q14a,..)")
     ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--resume", action="store_true",
+                    help="keep per-query results already in --json and "
+                         "run only the missing/failed queries (crash "
+                         "recovery for long sweeps)")
     args = ap.parse_args()
 
     import jax
@@ -122,10 +126,16 @@ def main() -> int:
     only = set(args.only.split(",")) if args.only else None
     cat = generate(args.data_dir, sf=args.sf)
     results = {}
+    if args.resume and os.path.exists(args.json):
+        with open(args.json) as fh:
+            prev = json.load(fh).get("results", {})
+        results = {q: r for q, r in prev.items() if r.get("ok")}
     t_start = time.time()
     for f in files:
         q = os.path.basename(f)[:-4]
         if only and q not in only:
+            continue
+        if q in results:
             continue
         t0 = time.time()
         if q in KNOWN_UNBINDABLE:

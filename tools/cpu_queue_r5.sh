@@ -1,25 +1,29 @@
 #!/bin/bash
-# Round-5 CPU artifact queue (single-core box: strictly serialized,
-# niced so any revived-tunnel chip work preempts).
-#  1. wait for the in-flight refplans sweep (IT_REFPLANS.json)
-#  2. IT_REFSQL.json  - the reference's own SQL suite, warm recorded
-#  3. IT_SF10.json    - full sf=10 ladder rung: zero exclusions, warm
+# Round-5 CPU artifact queue, take 2 (single-core box; strictly serial,
+# niced so revived-tunnel chip work preempts).  Runs everything itself:
+#  1. wait for any in-flight refplans process to exit
+#  2. resume the refplans sweep into IT_REFPLANS.json (crash-safe)
+#  3. IT_REFSQL.json  - the reference's own SQL suite
+#  4. IT_SF10.json    - full sf=10 rung: zero exclusions, warm
 #     best-of-2, perf gate armed at 3x (the sf=1 policy)
 set -u
 cd "$(dirname "$0")/.."
 LOG=/tmp/cpu_queue_r5.log
-echo "$(date -u +%H:%M:%S) queue start" >> "$LOG"
-
-while pgrep -f "auron_tpu.it.refplans --sf 0.01 --json IT_REFPLANS" \
-    > /dev/null; do
+echo "$(date -u +%H:%M:%S) queue2 start" >> "$LOG"
+while pgrep -f "python -m auron_tpu.it.refplans" > /dev/null; do
   sleep 60
 done
-echo "$(date -u +%H:%M:%S) refplans done; refsql" >> "$LOG"
+echo "$(date -u +%H:%M:%S) [2] refplans resume" >> "$LOG"
+nice -n 10 timeout 10800 python -m auron_tpu.it.refplans --sf 0.01 \
+  --resume --json IT_REFPLANS.json > /tmp/refplans_full.out 2>&1
+echo "$(date -u +%H:%M:%S) [2] rc=$?" >> "$LOG"
+echo "$(date -u +%H:%M:%S) [3] refsql" >> "$LOG"
 nice -n 10 timeout 10800 python -m auron_tpu.it.refsql --sf 0.01 \
   --json IT_REFSQL.json > /tmp/refsql_full.out 2>&1
-echo "$(date -u +%H:%M:%S) refsql rc=$?; sf10" >> "$LOG"
+echo "$(date -u +%H:%M:%S) [3] rc=$?" >> "$LOG"
+echo "$(date -u +%H:%M:%S) [4] sf10" >> "$LOG"
 nice -n 10 timeout 43200 python -m auron_tpu.it --sf 10 \
   --data-dir /tmp/auron_tpcds_sf10 --perf-factor 3 \
   --json IT_SF10.json > /tmp/it_sf10.out 2>&1
-echo "$(date -u +%H:%M:%S) sf10 rc=$?" >> "$LOG"
-echo "$(date -u +%H:%M:%S) queue done" >> "$LOG"
+echo "$(date -u +%H:%M:%S) [4] rc=$?" >> "$LOG"
+echo "$(date -u +%H:%M:%S) queue2 done" >> "$LOG"
