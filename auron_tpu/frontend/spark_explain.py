@@ -592,6 +592,28 @@ class ExprParser:
                 self._type_of(children[1]) if len(children) > 1 else None))
         if not self.eat_kw("END"):
             raise ExplainParseError("expected END")
+        # untyped `null` branch values take the type of a typed sibling
+        # value (q39: CASE WHEN m=0 THEN null ELSE s/m END must be f64,
+        # not null-typed — the device kernel needs a concrete dtype)
+        has_else = len(children) % 2 == 1
+        value_idx = set(range(1, len(children) - (1 if has_else else 0),
+                              2))
+        if has_else:
+            value_idx.add(len(children) - 1)
+        vtype = None
+        for i in sorted(value_idx):
+            t = self._type_of(children[i])
+            if t is not None and t.id != TypeId.NULL:
+                vtype = t
+                break
+        if vtype is not None:
+            children = [
+                flit(None, vtype)
+                if (i in value_idx and c.name == "Literal" and
+                    c.value is None and
+                    (c.dtype is None or c.dtype.id == TypeId.NULL))
+                else c
+                for i, c in enumerate(children)]
         return fcall("CaseWhen", *children)
 
     def _cast(self) -> ForeignExpr:
@@ -653,7 +675,11 @@ class ExprParser:
             # no coercion hint: positional args have heterogeneous types
             # (substr(str, 1, 5)); bare-word captures still yield strings
             args.append(self._operand(None, stop_paren=True))
-            self.eat_op(",")
+            if self.eat_op(",") and self.at_op(")"):
+                # trailing empty slot: an empty-STRING literal printed
+                # as nothing (`coalesce(c_last_name#8, )`, the null-safe
+                # join-key idiom)
+                args.append(flit("", STR))
         if name in _AGG_DUMP_FNS or prefix is not None:
             return self.b.agg_expr(_AGG_DUMP_FNS.get(name, name), args,
                                    distinct=distinct, prefix=prefix)
@@ -1318,6 +1344,15 @@ class ExplainBinder:
     def _op_Project(self, opid, d: Detail, kids, parent) -> ForeignNode:
         child = self._child(kids, opid)
         items = self.merge_items(d.lists.get("Output", []))
+        if not items and d.kv.get("Output", "").strip() in ("[]", ""):
+            # zero-column project (`Output: []`): Spark keeps row COUNT
+            # only (feeding count(1)); carry one constant column so the
+            # engine's batches preserve cardinality
+            one = falias(flit(1, I32), "__rowtag")
+            return ForeignNode(
+                "ProjectExec", children=(child,),
+                output=Schema((Field("__rowtag", I32),)),
+                attrs={"project_list": [one]})
         exprs: List[ForeignExpr] = []
         fields: List[Field] = []
         for item in items:

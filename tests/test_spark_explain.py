@@ -109,8 +109,8 @@ def _run_dump(q, catalog):
     return res
 
 
-@pytest.mark.parametrize("q", ["q3", "q7", "q13", "q42", "q52", "q55",
-                               "q96"])
+@pytest.mark.parametrize("q", ["q3", "q7", "q8", "q13", "q42", "q44",
+                               "q52", "q55", "q96"])
 def test_parsed_plan_executes(q, catalog):
     res = _run_dump(q, catalog)
     if q == "q96":                   # count(*): always exactly one row
