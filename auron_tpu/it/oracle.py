@@ -151,6 +151,10 @@ class _Eval:
         a, am = self.eval(fe.children[0])
         return _col(~a.astype(bool), am)
 
+    def _unaryminus(self, fe):
+        a, am = self.eval(fe.children[0])
+        return _col(-a, am)
+
     def _isnotnull(self, fe):
         _, am = self.eval(fe.children[0])
         return _col(~am)

@@ -131,12 +131,21 @@ def main() -> int:
             prev = json.load(fh).get("results", {})
         results = {q: r for q, r in prev.items() if r.get("ok")}
     t_start = time.time()
+    n_run = 0
     for f in files:
         q = os.path.basename(f)[:-4]
         if only and q not in only:
             continue
         if q in results:
             continue
+        n_run += 1
+        if n_run % 8 == 0:
+            # every query jits hundreds of programs; executables pin
+            # mmap regions and a 103-query sweep blows vm.max_map_count
+            # (LLVM 'Cannot allocate memory' at ~60 queries).  Dropping
+            # the in-process caches trades re-compiles for bounded maps.
+            import jax
+            jax.clear_caches()
         t0 = time.time()
         if q in KNOWN_UNBINDABLE:
             r = {"ok": None, "skipped": KNOWN_UNBINDABLE[q]}

@@ -88,6 +88,9 @@ def main() -> int:
     ap.add_argument("--only", default=None,
                     help="comma-separated query names (q1,q14a,..)")
     ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--resume", action="store_true",
+                    help="keep ok results already in --json and run "
+                         "only missing/failed queries")
     args = ap.parse_args()
 
     import jax
@@ -102,12 +105,26 @@ def main() -> int:
     only = set(args.only.split(",")) if args.only else None
     cat = generate(args.data_dir, sf=args.sf)
     results = {}
+    if args.resume and os.path.exists(args.json):
+        with open(args.json) as fh:
+            prev = json.load(fh).get("results", {})
+        results = {q: r for q, r in prev.items() if r.get("ok")}
     t_start = time.time()
+    n_run = 0
     for f in files:
         q = os.path.basename(f)[:-4]
         if only and q not in only:
             continue
+        if q in results:
+            continue
         sql = open(f).read()
+        n_run += 1
+        if n_run % 8 == 0:
+            # bound the process' mmap count: jitted executables pin
+            # regions and a full sweep crosses vm.max_map_count
+            # otherwise (see it/refplans.py)
+            import jax
+            jax.clear_caches()
         t0 = time.time()
         try:
             r = run_one(sql, cat)

@@ -137,7 +137,13 @@ class QueryRunner:
 
     def run_all(self, names: Optional[List[str]] = None,
                 on_result=None) -> List[QueryResult]:
-        for name in names or queries.names():
+        for i, name in enumerate(names or queries.names()):
+            if i and i % 8 == 0:
+                # bound the process' mmap count across a 103-query
+                # sweep: jitted executables pin regions and LLVM's JIT
+                # hits vm.max_map_count otherwise (it/refplans.py)
+                import jax
+                jax.clear_caches()
             r = self.run(name)
             if on_result is not None:
                 on_result(r)

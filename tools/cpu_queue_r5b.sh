@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-5 CPU artifact queue, take 3: wait for the in-flight refsql,
+# then loop refplans --resume until the sweep is complete (each pass a
+# fresh process; vm.max_map_count raised + periodic jax.clear_caches
+# bound the JIT mmap growth), then the full sf=10 rung.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/cpu_queue_r5.log
+echo "$(date -u +%H:%M:%S) queue3 start" >> "$LOG"
+while pgrep -f "python -m auron_tpu.it.refsql" > /dev/null; do
+  sleep 60
+done
+echo "$(date -u +%H:%M:%S) [3b] refsql finished" >> "$LOG"
+for i in 1 2 3 4 5 6; do
+  nice -n 10 timeout 10800 python -m auron_tpu.it.refplans --sf 0.01 \
+    --resume --json IT_REFPLANS.json > /tmp/refplans_full.out 2>&1
+  rc=$?
+  n=$(python3 -c "import json;d=json.load(open('IT_REFPLANS.json'));print(d['queries'],d['ok'])" 2>/dev/null)
+  echo "$(date -u +%H:%M:%S) [3b] refplans pass $i rc=$rc -> $n" >> "$LOG"
+  if [ "$rc" = "0" ]; then break; fi
+done
+echo "$(date -u +%H:%M:%S) [4] sf10" >> "$LOG"
+nice -n 10 timeout 43200 python -m auron_tpu.it --sf 10 \
+  --data-dir /tmp/auron_tpcds_sf10 --perf-factor 3 \
+  --json IT_SF10.json > /tmp/it_sf10.out 2>&1
+echo "$(date -u +%H:%M:%S) [4] rc=$?" >> "$LOG"
+echo "$(date -u +%H:%M:%S) queue3 done" >> "$LOG"
