@@ -465,14 +465,24 @@ def _eval_case(e: E.Case, ctx: EvalCtx) -> Col:
     branches = [(evaluate(b.when, ctx), evaluate(b.then, ctx))
                 for b in e.branches]
     else_col = evaluate(e.else_expr, ctx) if e.else_expr is not None else None
-    # result type: first non-null branch
-    out_dtype = None
-    for _, t in branches:
-        out_dtype = t.dtype
-        break
-    if isinstance(branches[0][1], DeviceStringColumn):
+    # result type: the first value (branch or else) that is not a null
+    # literal — a null first branch (CASE WHEN m=0 THEN null ELSE s/m
+    # END) must not poison the accumulator dtype to the bool
+    # placeholder literal_column materializes for untyped nulls
+    values = [t for _, t in branches] + \
+        ([else_col] if else_col is not None else [])
+    value_exprs = [b.then for b in e.branches] + \
+        ([e.else_expr] if e.else_expr is not None else [])
+    pick = values[0]
+    for xe, xc in zip(value_exprs, values):
+        if not (getattr(xe, "kind", None) == "literal" and
+                xe.value is None):
+            pick = xc
+            break
+    out_dtype = pick.dtype
+    if isinstance(pick, DeviceStringColumn):
         return _case_strings(branches, else_col, ctx)
-    data = jnp.zeros(ctx.capacity, dtype=branches[0][1].data.dtype)
+    data = jnp.zeros(ctx.capacity, dtype=pick.data.dtype)
     valid = jnp.zeros(ctx.capacity, bool)
     decided = jnp.zeros(ctx.capacity, bool)
     for w, t in branches:
@@ -489,10 +499,14 @@ def _eval_case(e: E.Case, ctx: EvalCtx) -> Col:
 
 
 def _case_strings(branches, else_col, ctx: EvalCtx) -> Col:
-    w_max = max(t.width for _, t in branches)
-    if else_col is not None:
-        w_max = max(w_max, else_col.width)
-    dt = branches[0][1].dtype
+    # null-literal branches carry a flat placeholder, not a string
+    # column: they contribute no bytes, only a decided+invalid slot
+    strs = [t for _, t in branches
+            if isinstance(t, DeviceStringColumn)]
+    if else_col is not None and isinstance(else_col, DeviceStringColumn):
+        strs.append(else_col)
+    w_max = max(t.width for t in strs)
+    dt = strs[0].dtype
     data = jnp.zeros((ctx.capacity, w_max), jnp.uint8)
     lens = jnp.zeros(ctx.capacity, jnp.int32)
     valid = jnp.zeros(ctx.capacity, bool)
@@ -500,12 +514,13 @@ def _case_strings(branches, else_col, ctx: EvalCtx) -> Col:
     for w, t in branches:
         fire = jnp.logical_and(jnp.logical_not(decided),
                                jnp.logical_and(w.validity, w.data.astype(bool)))
-        td = S._pad_width(t.data, w_max)
-        data = jnp.where(fire[:, None], td, data)
-        lens = jnp.where(fire, t.lengths, lens)
-        valid = jnp.where(fire, t.validity, valid)
+        if isinstance(t, DeviceStringColumn):
+            td = S._pad_width(t.data, w_max)
+            data = jnp.where(fire[:, None], td, data)
+            lens = jnp.where(fire, t.lengths, lens)
+            valid = jnp.where(fire, t.validity, valid)
         decided = jnp.logical_or(decided, fire)
-    if else_col is not None:
+    if else_col is not None and isinstance(else_col, DeviceStringColumn):
         rest = jnp.logical_not(decided)
         ed = S._pad_width(else_col.data, w_max)
         data = jnp.where(rest[:, None], ed, data)

@@ -42,15 +42,6 @@ KNOWN_UNBINDABLE = {
 }
 
 
-def canon(rows):
-    def norm(v):
-        if v is None:
-            return (0, "")
-        if isinstance(v, float):
-            return (1, round(v, 4))
-        return (1, v)
-    return sorted(tuple(norm(v) for v in r.values()) for r in rows)
-
 
 def _host_exec(plan):
     from auron_tpu import config
@@ -85,10 +76,14 @@ def run_one(text: str, cat, warm: bool = True):
     t0 = time.perf_counter()
     oracle = _host_exec(plan)
     oracle_s = time.perf_counter() - t0
-    got = canon(res.table.to_pylist())
-    want = canon(oracle.table.to_pylist())
+    # float-tolerant comparison (QueryResultComparator analogue):
+    # engine and oracle sum in different orders, so exact round(4)
+    # canonicalization false-positives on 1-ulp knife edges
+    from auron_tpu.it import compare
+    diff = compare.compare_tables(res.table, oracle.table)
     return {
-        "ok": got == want,
+        "ok": diff is None,
+        "diff": diff,
         "rows": res.table.num_rows,
         "oracle_rows": oracle.table.num_rows,
         "native_s": round(native_s, 4),

@@ -31,18 +31,6 @@ REF_QUERY_DIR = os.environ.get(
     "/root/reference/dev/auron-it/src/main/resources/tpcds-queries")
 
 
-def canon(rows):
-    def norm(v):
-        if v is None:
-            return (0, "")
-        if isinstance(v, float):
-            return (1, round(v, 4))
-        return (1, v)
-    # compare by position, not name: SQL output column names are
-    # cosmetic (backtick aliases, duplicate names) and the oracle runs
-    # the same plan anyway
-    return sorted(tuple(norm(v) for v in r.values()) for r in rows)
-
 
 def run_one(sql: str, cat, warm: bool = True):
     from auron_tpu import config
@@ -65,10 +53,13 @@ def run_one(sql: str, cat, warm: bool = True):
         oracle = AuronSession(
             foreign_engine=PyArrowEngine()).execute(plan)
         oracle_s = time.perf_counter() - t0
-    got = canon(res.table.to_pylist())
-    want = canon(oracle.table.to_pylist())
+    # float-tolerant comparison (QueryResultComparator analogue); exact
+    # round(4) canonicalization false-positives on 1-ulp knife edges
+    from auron_tpu.it import compare
+    diff = compare.compare_tables(res.table, oracle.table)
     return {
-        "ok": got == want,
+        "ok": diff is None,
+        "diff": diff,
         "rows": res.table.num_rows,
         "oracle_rows": oracle.table.num_rows,
         "native_s": round(native_s, 4),

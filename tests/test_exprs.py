@@ -134,6 +134,26 @@ def test_case_when():
     check_expr(e)
 
 
+def test_case_null_first_branch():
+    """A null-literal FIRST branch must not poison the Case dtype to
+    its bool placeholder (q39's cov: CASE WHEN m=0 THEN null ELSE s/m
+    END came back as a 1-byte column declared f64)."""
+    from auron_tpu.ir.schema import DataType
+    e = E.Case(branches=(
+        E.WhenThen(when=E.BinaryExpr(left=col("f64"), op="==",
+                                     right=lit(0.0)),
+                   then=lit(None, DataType.null())),
+    ), else_expr=E.BinaryExpr(left=col("f64"), op="*", right=lit(2.0)))
+    check_expr(e)
+    # string flavor: null branch beside a string else
+    e2 = E.Case(branches=(
+        E.WhenThen(when=E.BinaryExpr(left=col("i32"), op=">",
+                                     right=lit(10 ** 9)),
+                   then=lit(None, DataType.null())),
+    ), else_expr=col("s"))
+    check_expr(e2)
+
+
 def test_in_list():
     check_expr(E.InList(child=col("i32"), values=(lit(1), lit(2), lit(500))))
     check_expr(E.InList(child=col("s"), values=(lit("apple"), lit("дом")),
