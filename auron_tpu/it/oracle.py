@@ -165,7 +165,19 @@ class _Eval:
 
     def _in(self, fe):
         a, am = self.eval(fe.children[0])
-        vals = {c.value for c in fe.children[1:]}
+        vals = set()
+        for c in fe.children[1:]:
+            if c.name == "Literal":
+                if c.value is not None:
+                    vals.add(c.value)
+                continue
+            # non-literal list values (unfolded `1999 + 1`): evaluate
+            # and take the broadcast scalar — reading .value silently
+            # turned them into None and dropped every matching row
+            v, m = self.eval(c)
+            if len(v) and not m[0]:
+                val = v[0]
+                vals.add(val.item() if hasattr(val, "item") else val)
         hit = np.array([v in vals for v in a.tolist()], bool)
         return _col(hit, am)
 

@@ -309,6 +309,25 @@ def _lower_bin(e: A.Bin, scope: Scope, ctx: _Ctx) -> ForeignExpr:
             out = F64      # Spark SQL: non-decimal division is double
         else:
             out = _num_promote(_dt_of(left), _dt_of(right))
+        # constant folding (Spark's optimizer runs before the physical
+        # plan, so `1999 + 1` never reaches the converter unfolded)
+        if left.name == "Literal" and right.name == "Literal" and \
+                left.value is not None and right.value is not None and \
+                isinstance(left.value, (int, float)) and \
+                isinstance(right.value, (int, float)):
+            try:
+                v = {"+": lambda a, b: a + b,
+                     "-": lambda a, b: a - b,
+                     "*": lambda a, b: a * b,
+                     "/": lambda a, b: a / b if b != 0 else None,
+                     "%": lambda a, b: a % b if b != 0 else None,
+                     }[e.op](left.value, right.value)
+            except (ArithmeticError, KeyError):
+                v = None
+            if v is not None:
+                if out.id.name in ("INT8", "INT16", "INT32", "INT64"):
+                    v = int(v)
+                return flit(v, out)
         return fcall(_ARITH[e.op], left, right, dtype=out)
     raise SqlError(f"unsupported operator {e.op}")
 

@@ -424,3 +424,32 @@ def test_invalid_date_literal_raises_sql_error(catalog):
     with pytest.raises(SqlError, match="invalid date literal"):
         plan_sql("select s_store_sk from store "
                  "where cast('oops' as date) is null", catalog)
+
+
+def test_in_list_with_literal_arithmetic(catalog):
+    """`d_year IN (1999, 1999 + 1)` must fold (Spark optimizes before
+    the physical plan); the oracle's IN previously read .value off the
+    unfolded Add and silently matched None (q46/q68/q73/q79 family)."""
+    got, res = run_sql("""
+        select d_year, count(*) n from date_dim
+        where d_year in (1999, 1999 + 1, 1999 + 2)
+        group by d_year order by d_year
+    """, catalog)
+    assert [r["d_year"] for r in got] == [1999, 2000, 2001]
+    # the lowered IN carries only folded literals
+    from auron_tpu.sql import plan_sql
+    plan = plan_sql("select s_store_sk from store "
+                    "where s_store_sk in (1, 1 + 1)", catalog)
+    def find_in(n):
+        if n.op == "FilterExec":
+            c = n.attrs["condition"]
+            if c.name == "In":
+                return c
+        for ch in n.children:
+            r = find_in(ch)
+            if r is not None:
+                return r
+    c = find_in(plan)
+    assert c is not None
+    assert all(v.name == "Literal" for v in c.children[1:])
+    assert sorted(v.value for v in c.children[1:]) == [1, 2]

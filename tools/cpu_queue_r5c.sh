@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-5 CPU queue, final form: refsql (in-flight) -> refplans resume
+# loop -> refsql resume loop -> full pytest suite -> sf10 rung.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/cpu_queue_r5.log
+echo "$(date -u +%H:%M:%S) queue4 start" >> "$LOG"
+while pgrep -f "python -m auron_tpu.it.refsql" > /dev/null; do sleep 60; done
+for i in 1 2 3 4 5 6; do
+  nice -n 10 timeout 10800 python -m auron_tpu.it.refplans --sf 0.01 \
+    --resume --json IT_REFPLANS.json > /tmp/refplans_full.out 2>&1
+  rc=$?
+  echo "$(date -u +%H:%M:%S) refplans pass $i rc=$rc" >> "$LOG"
+  [ "$rc" = "0" ] && break
+done
+for i in 1 2 3; do
+  nice -n 10 timeout 10800 python -m auron_tpu.it.refsql --sf 0.01 \
+    --resume --json IT_REFSQL.json > /tmp/refsql_full.out 2>&1
+  rc=$?
+  echo "$(date -u +%H:%M:%S) refsql resume $i rc=$rc" >> "$LOG"
+  [ "$rc" = "0" ] && break
+done
+echo "$(date -u +%H:%M:%S) full pytest" >> "$LOG"
+nice -n 10 timeout 7200 python -m pytest tests/ -q \
+  > /tmp/pytest_full.out 2>&1
+echo "$(date -u +%H:%M:%S) pytest rc=$? ($(tail -1 /tmp/pytest_full.out | head -c 70))" >> "$LOG"
+echo "$(date -u +%H:%M:%S) sf10" >> "$LOG"
+nice -n 10 timeout 43200 python -m auron_tpu.it --sf 10 \
+  --data-dir /tmp/auron_tpcds_sf10 --perf-factor 3 \
+  --json IT_SF10.json > /tmp/it_sf10.out 2>&1
+echo "$(date -u +%H:%M:%S) sf10 rc=$?" >> "$LOG"
+echo "$(date -u +%H:%M:%S) queue4 done" >> "$LOG"
