@@ -968,8 +968,16 @@ class ExplainBinder:
             raise BindError(f"unknown attribute #{fid} ({base!r})")
         return fcol(f.name, f.dtype)
 
-    def define(self, fid: int, base: str, dtype: DataType) -> Field:
+    def define(self, fid: int, base: str, dtype: DataType,
+               fresh: bool = False) -> Field:
         name = f"{base}#{fid}" if base else f"_#{fid}"
+        if fresh and fid in self.fields:
+            # plan-stability normalization reuses attr ids across plan
+            # branches (q70 scans `store` twice, both printing
+            # s_state#13): a fresh SOURCE definition must not collide
+            # with the earlier branch's column when the branches join
+            self._dup = getattr(self, "_dup", 0) + 1
+            name = f"{name}@{self._dup}"
         f = Field(name, dtype)
         self.fields[fid] = f
         return f
@@ -1233,7 +1241,7 @@ class ExplainBinder:
                 dt = cf.dtype
             elif self.adapt and dt.id == TypeId.DECIMAL:
                 dt = F64
-            fields.append(self.define(fid, base, dt))
+            fields.append(self.define(fid, base, dt, fresh=True))
             bare_fields.append(Field(base, dt))
         out = Schema(tuple(fields))
         bare_out = Schema(tuple(bare_fields))

@@ -32,9 +32,12 @@ log = logging.getLogger("auron_tpu.runtime")
 class ExecutionResult:
     batches: List[pa.RecordBatch]
     metrics: MetricNode
+    schema: Optional["pa.Schema"] = None   # plan output (empty results)
 
     def to_table(self) -> pa.Table:
         if not self.batches:
+            if self.schema is not None:
+                return pa.Table.from_batches([], schema=self.schema)
             return pa.table({})
         return pa.Table.from_batches(self.batches)
 
@@ -108,7 +111,14 @@ def execute_task(task: P.TaskDefinition,
                if rb.num_rows > 0]
     with _TASKS_LOCK:
         _TASKS_COMPLETED += 1
-    return ExecutionResult(out, rt.finalize())
+    out_schema = None
+    try:
+        from auron_tpu.ir.schema import to_arrow_schema
+        if rt.root.schema is not None:
+            out_schema = to_arrow_schema(rt.root.schema)
+    except Exception:  # noqa: BLE001 - schema is advisory (empty case)
+        pass
+    return ExecutionResult(out, rt.finalize(), schema=out_schema)
 
 
 def execute_task_bytes(task_bytes: bytes,
