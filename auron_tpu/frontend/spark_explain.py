@@ -1363,14 +1363,40 @@ class ExplainBinder:
                 attrs={"project_list": [one]})
         exprs: List[ForeignExpr] = []
         fields: List[Field] = []
+        seen_fids: Dict[int, int] = {}
         for item in items:
             e, fid, base = self._out_item(item)
             if e is None:                        # plain attr passthrough
                 f = self.fields.get(fid)
                 if f is None:
                     raise BindError(f"unknown attr #{fid} in project")
+                n_seen = seen_fids.get(fid, 0)
+                seen_fids[fid] = n_seen + 1
+                if n_seen:
+                    # Spark plans may carry the same attribute twice in
+                    # one projection (q70's window-prep `[s_state#13,
+                    # s_state#13, ...]`); alias the repeat so name-based
+                    # consumers keep a unique schema (refs by id keep
+                    # resolving to the first copy)
+                    alias = Field(f"{f.name}@dup{n_seen}", f.dtype)
+                    exprs.append(falias(fcol(f.name, f.dtype),
+                                        alias.name))
+                    fields.append(alias)
+                    continue
                 exprs.append(fcol(f.name, f.dtype))
                 fields.append(f)
+            elif e.name == "named_struct":
+                # q9's subquery root packs its aggregates into ONE
+                # struct (`named_struct(count(1), count(1)#52, ...)`);
+                # unwrap to plain columns so the host oracle runs it
+                # and Subquery field access matches by base name
+                for i in range(1, len(e.children), 2):
+                    v = e.children[i]
+                    if v.name != "AttributeReference":
+                        raise BindError("named_struct value is not an "
+                                        "attribute")
+                    exprs.append(fcol(v.value, v.dtype))
+                    fields.append(Field(v.value, v.dtype))
             else:
                 dt = self.infer_or(e, F64)
                 f = self.define(fid, base, dt)
@@ -1515,6 +1541,7 @@ class ExplainBinder:
             exprs: List[ForeignExpr] = []
             res_fields: List[Field] = []
             identity = True
+            seen_fids: Dict[int, int] = {}
             for i, item in enumerate(results):
                 e, fid, base = self._out_item(item)
                 if e is None:
@@ -1527,6 +1554,17 @@ class ExplainBinder:
                         self.fields[fid] = f
                     elif f is None:
                         f = self.define(fid, base, F64)
+                    n_seen = seen_fids.get(fid, 0)
+                    seen_fids[fid] = n_seen + 1
+                    if n_seen:
+                        # repeated attr in Results (q70's window prep):
+                        # alias the copy so the schema stays unique
+                        alias = Field(f"{f.name}@dup{n_seen}", f.dtype)
+                        exprs.append(falias(fcol(f.name, f.dtype),
+                                            alias.name))
+                        res_fields.append(alias)
+                        identity = False
+                        continue
                     exprs.append(fcol(f.name, f.dtype))
                     res_fields.append(f)
                     if i >= len(agg_out.fields) or \

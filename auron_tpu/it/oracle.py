@@ -155,6 +155,49 @@ class _Eval:
         a, am = self.eval(fe.children[0])
         return _col(-a, am)
 
+    def _startswith(self, fe):
+        a, am = self.eval(fe.children[0])
+        p, pm = self.eval(fe.children[1])
+        pref = None if (len(p) and pm[0]) else (
+            p[0] if len(p) else None)
+        if pref is None:
+            return _col(np.zeros(len(a), bool), np.ones(len(a), bool))
+        hit = np.array([isinstance(v, str) and v.startswith(str(pref))
+                        for v in a.tolist()], bool)
+        return _col(hit, am)
+
+    def _endswith(self, fe):
+        a, am = self.eval(fe.children[0])
+        p, _pm = self.eval(fe.children[1])
+        suf = str(p[0]) if len(p) else ""
+        hit = np.array([isinstance(v, str) and v.endswith(suf)
+                        for v in a.tolist()], bool)
+        return _col(hit, am)
+
+    def _like(self, fe):
+        import re as _re
+        a, am = self.eval(fe.children[0])
+        pat = fe.children[1].value if len(fe.children) > 1 else \
+            fe.attrs.get("pattern")
+        if pat is None:
+            return _col(np.zeros(len(a), bool), np.ones(len(a), bool))
+        rx = _re.compile(
+            "^" + "".join(".*" if ch == "%" else "." if ch == "_"
+                          else _re.escape(ch) for ch in str(pat)) + "$",
+            _re.S)
+        neg = bool(fe.attrs.get("negated", False))
+        hit = np.array([isinstance(v, str) and bool(rx.match(v))
+                        for v in a.tolist()], bool)
+        return _col(~hit if neg else hit, am)
+
+    def _contains(self, fe):
+        a, am = self.eval(fe.children[0])
+        p, _pm = self.eval(fe.children[1])
+        sub = str(p[0]) if len(p) else ""
+        hit = np.array([isinstance(v, str) and sub in v
+                        for v in a.tolist()], bool)
+        return _col(hit, am)
+
     def _isnotnull(self, fe):
         _, am = self.eval(fe.children[0])
         return _col(~am)
