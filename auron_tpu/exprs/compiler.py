@@ -465,10 +465,12 @@ def _eval_case(e: E.Case, ctx: EvalCtx) -> Col:
     branches = [(evaluate(b.when, ctx), evaluate(b.then, ctx))
                 for b in e.branches]
     else_col = evaluate(e.else_expr, ctx) if e.else_expr is not None else None
-    # result type: the first value (branch or else) that is not a null
-    # literal — a null first branch (CASE WHEN m=0 THEN null ELSE s/m
-    # END) must not poison the accumulator dtype to the bool
-    # placeholder literal_column materializes for untyped nulls
+    # result type: the engine's own inference over ALL branch/else
+    # values (the host evaluator's policy).  Taking any single value's
+    # dtype is wrong twice over: a null-literal first branch poisons
+    # the accumulator to its bool placeholder, and an int THEN beside
+    # a float ELSE truncates the float (q39's `CASE mean WHEN 0 THEN 0
+    # ELSE stdev/mean END > 1` dropped every row).
     values = [t for _, t in branches] + \
         ([else_col] if else_col is not None else [])
     value_exprs = [b.then for b in e.branches] + \
@@ -480,9 +482,25 @@ def _eval_case(e: E.Case, ctx: EvalCtx) -> Col:
             pick = xc
             break
     out_dtype = pick.dtype
-    if isinstance(pick, DeviceStringColumn):
+    try:
+        from auron_tpu.exprs.typing import infer_type
+        inferred = infer_type(e, ctx.schema)
+        if inferred is not None and inferred.id.name != "NULL":
+            out_dtype = inferred
+    except Exception:  # noqa: BLE001 - fall back to the value pick
+        pass
+    if isinstance(pick, DeviceStringColumn) or out_dtype.is_stringlike:
         return _case_strings(branches, else_col, ctx)
-    data = jnp.zeros(ctx.capacity, dtype=pick.data.dtype)
+    # accumulator device dtype: jnp promotion across the non-null
+    # values (logical types like date32 have no jnp equivalent; their
+    # device data is already integral)
+    real = [c for xe, c in zip(value_exprs, values)
+            if not (getattr(xe, "kind", None) == "literal" and
+                    xe.value is None) and
+            not isinstance(c, DeviceStringColumn)]
+    acc_dt = jnp.result_type(*[c.data.dtype for c in real]) \
+        if real else pick.data.dtype
+    data = jnp.zeros(ctx.capacity, dtype=acc_dt)
     valid = jnp.zeros(ctx.capacity, bool)
     decided = jnp.zeros(ctx.capacity, bool)
     for w, t in branches:

@@ -196,13 +196,22 @@ def infer_type(expr: E.Expr, schema: Schema) -> DataType:
     if k == "negative":
         return infer_type(expr.child, schema)
     if k == "case":
-        for b in expr.branches:
-            t = infer_type(b.then, schema)
-            if t.id != TypeId.NULL:
-                return t
+        # promote across ALL branch/else value types (Spark coerces to
+        # the least common type): taking the first non-null branch made
+        # `CASE .. THEN 0 ELSE stdev/mean END` an int32 and truncated
+        # the else values (q39)
+        out = None
+        ts = [infer_type(b.then, schema) for b in expr.branches]
         if expr.else_expr is not None:
-            return infer_type(expr.else_expr, schema)
-        return DataType.null()
+            ts.append(infer_type(expr.else_expr, schema))
+        for t in ts:
+            if t.id == TypeId.NULL:
+                continue
+            if out is None:
+                out = t
+            elif out != t:
+                out = promote(out, t)
+        return out if out is not None else DataType.null()
     if k == "scalar_function":
         if expr.return_type.id != TypeId.NULL:
             return expr.return_type

@@ -465,14 +465,21 @@ def _equi_keys(cond: List[A.Expr], left: Scope, right: Scope,
     lks: List[ForeignExpr] = []
     rks: List[ForeignExpr] = []
     rest: List[A.Expr] = []
+    def _edge_side(e: A.Expr, scope: Scope) -> bool:
+        # a CROSS edge needs at least one actual column per side:
+        # literals are vacuously scope-only, and `inv1.d_moy = 1` must
+        # stay a filter, not become a literal join key (q39's CTE
+        # self-join lost every row through the SMJ's constant key)
+        return bool(_expr_cols(e)) and _refs_only(e, scope)
+
     for c in cond:
         if isinstance(c, A.Bin) and c.op == "==":
             a, b = c.left, c.right
-            if _refs_only(a, left) and _refs_only(b, right):
+            if _edge_side(a, left) and _edge_side(b, right):
                 lks.append(_lower_expr(a, left, ctx))
                 rks.append(_lower_expr(b, right, ctx))
                 continue
-            if _refs_only(b, left) and _refs_only(a, right):
+            if _edge_side(b, left) and _edge_side(a, right):
                 lks.append(_lower_expr(b, left, ctx))
                 rks.append(_lower_expr(a, right, ctx))
                 continue
