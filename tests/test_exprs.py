@@ -154,6 +154,24 @@ def test_case_null_first_branch():
     check_expr(e2)
 
 
+def test_case_branch_type_promotion():
+    """An int THEN beside a float ELSE promotes to float (q39's
+    `CASE mean WHEN 0 THEN 0 ELSE stdev/mean END > 1` truncated the
+    ratios to int and dropped every row)."""
+    from auron_tpu.exprs.typing import infer_type
+    from auron_tpu.ir.schema import DataType
+    e = E.Case(branches=(
+        E.WhenThen(when=E.BinaryExpr(left=col("f64"), op="==",
+                                     right=lit(0.0)),
+                   then=lit(0)),
+    ), else_expr=E.BinaryExpr(left=col("f64"), op="/", right=lit(3.0)))
+    rb = make_batch()
+    from auron_tpu.ir.schema import from_arrow_schema
+    assert infer_type(e, from_arrow_schema(rb.schema)) == \
+        DataType.float64()
+    check_expr(e)
+
+
 def test_in_list():
     check_expr(E.InList(child=col("i32"), values=(lit(1), lit(2), lit(500))))
     check_expr(E.InList(child=col("s"), values=(lit("apple"), lit("дом")),
