@@ -168,8 +168,10 @@ class _Eval:
 
     def _endswith(self, fe):
         a, am = self.eval(fe.children[0])
-        p, _pm = self.eval(fe.children[1])
-        suf = str(p[0]) if len(p) else ""
+        p, pm = self.eval(fe.children[1])
+        if not len(p) or pm[0]:
+            return _col(np.zeros(len(a), bool), np.ones(len(a), bool))
+        suf = str(p[0])
         hit = np.array([isinstance(v, str) and v.endswith(suf)
                         for v in a.tolist()], bool)
         return _col(hit, am)
@@ -192,8 +194,10 @@ class _Eval:
 
     def _contains(self, fe):
         a, am = self.eval(fe.children[0])
-        p, _pm = self.eval(fe.children[1])
-        sub = str(p[0]) if len(p) else ""
+        p, pm = self.eval(fe.children[1])
+        if not len(p) or pm[0]:
+            return _col(np.zeros(len(a), bool), np.ones(len(a), bool))
+        sub = str(p[0])
         hit = np.array([isinstance(v, str) and sub in v
                         for v in a.tolist()], bool)
         return _col(hit, am)
@@ -216,11 +220,16 @@ class _Eval:
                 continue
             # non-literal list values (unfolded `1999 + 1`): evaluate
             # and take the broadcast scalar — reading .value silently
-            # turned them into None and dropped every matching row
+            # turned them into None and dropped every matching row.
+            # Only CONSTANT entries are well-defined as a set member.
             v, m = self.eval(c)
-            if len(v) and not m[0]:
-                val = v[0]
-                vals.add(val.item() if hasattr(val, "item") else val)
+            if len(v) == 0 or m[0]:
+                continue
+            if len(v) > 1 and (not np.all(v == v[0]) or np.any(m)):
+                raise NotImplementedError(
+                    "oracle IN with a non-constant list entry")
+            val = v[0]
+            vals.add(val.item() if hasattr(val, "item") else val)
         hit = np.array([v in vals for v in a.tolist()], bool)
         return _col(hit, am)
 

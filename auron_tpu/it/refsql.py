@@ -98,8 +98,13 @@ def main() -> int:
     results = {}
     if args.resume and os.path.exists(args.json):
         with open(args.json) as fh:
-            prev = json.load(fh).get("results", {})
-        results = {q: r for q, r in prev.items() if r.get("ok")}
+            prev_doc = json.load(fh)
+        # a saved sweep at a different scale must not masquerade as
+        # this run's results
+        if prev_doc.get("sf") == args.sf:
+            results = {q: r for q, r in
+                       prev_doc.get("results", {}).items()
+                       if r.get("ok")}
     t_start = time.time()
     n_run = 0
     for f in files:

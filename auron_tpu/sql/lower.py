@@ -315,12 +315,19 @@ def _lower_bin(e: A.Bin, scope: Scope, ctx: _Ctx) -> ForeignExpr:
                 left.value is not None and right.value is not None and \
                 isinstance(left.value, (int, float)) and \
                 isinstance(right.value, (int, float)):
+            def _mod(a, b):
+                # Spark %: sign of the DIVIDEND (the runtime kernel's
+                # sign(a)*(|a| % |b|)), not Python's sign-of-divisor
+                if b == 0:
+                    return None
+                m = abs(a) % abs(b)
+                return -m if a < 0 else m
             try:
                 v = {"+": lambda a, b: a + b,
                      "-": lambda a, b: a - b,
                      "*": lambda a, b: a * b,
                      "/": lambda a, b: a / b if b != 0 else None,
-                     "%": lambda a, b: a % b if b != 0 else None,
+                     "%": _mod,
                      }[e.op](left.value, right.value)
             except (ArithmeticError, KeyError):
                 v = None

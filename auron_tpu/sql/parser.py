@@ -351,7 +351,10 @@ class _P:
                     first, set_ops=first.set_ops + tuple(branches))
         if order or limit is not None:
             if first.order_by or first.limit is not None:
-                raise SqlError("duplicate ORDER BY/LIMIT")
+                # first's clause is arm-scoped (a parenthesized arm
+                # carrying its own ORDER/LIMIT): wrap it as a subquery
+                # so the chain-level clause applies to the whole chain
+                first = _subquery_wrap(first)
             first = _dc.replace(first, order_by=order, limit=limit)
         if ctes:
             first = _dc.replace(first, ctes=tuple(ctes))
@@ -384,7 +387,7 @@ class _P:
                                 tuple(parts))
             if order or limit is not None:
                 if first.order_by or first.limit is not None:
-                    raise SqlError("duplicate ORDER BY/LIMIT")
+                    first = _subquery_wrap(first)
                 first = _dc.replace(first, order_by=order, limit=limit)
         return first, paren
 
@@ -768,6 +771,13 @@ class _P:
             return WindowCall(call=call, partition_by=part,
                               order_by=order)
         return call
+
+
+def _subquery_wrap(sel: Select) -> Select:
+    """SELECT * FROM (sel) — scopes an arm's own ORDER BY/LIMIT inside
+    a set-op chain so the chain-level clause can attach outside."""
+    return Select(items=(SelectItem(expr=Star()),),
+                  from_=SubqueryTable(query=sel, alias="__setop_arm"))
 
 
 def parse_sql(sql: str) -> Select:

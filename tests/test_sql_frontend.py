@@ -453,3 +453,25 @@ def test_in_list_with_literal_arithmetic(catalog):
     assert c is not None
     assert all(v.name == "Literal" for v in c.children[1:])
     assert sorted(v.value for v in c.children[1:]) == [1, 2]
+
+
+def test_setop_arm_scoped_limit_with_chain_order(catalog):
+    """A parenthesized arm's own LIMIT must not collide with the
+    chain's trailing ORDER BY (review r5: spurious 'duplicate ORDER
+    BY/LIMIT' on valid SQL)."""
+    got, _ = run_sql("""
+        (select s_store_sk k from store order by s_store_sk limit 2)
+        intersect
+        select s_store_sk from store where s_store_sk >= 1
+        order by k desc limit 1
+    """, catalog)
+    assert [r["k"] for r in got] == [2]
+
+
+def test_modulo_fold_sign_of_dividend(catalog):
+    """Folded % must match the engine kernel's Spark semantics (sign
+    of the dividend), not Python's sign-of-divisor."""
+    got, _ = run_sql("select s_store_sk k from store "
+                     "where s_store_sk = 3 + (5 - 9) % 3", catalog)
+    # Spark: (5-9) % 3 = -1 -> k = 2 (Python's % would give 2 -> k = 5)
+    assert [r["k"] for r in got] == [2]

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-5 CPU queue tail: after the sf10 rung, regenerate the
+# per-commit gate corpus (IT_PERF) with the final engine, then deepen
+# the real-plan differential to sf=0.1.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/cpu_queue_r5.log
+echo "$(date -u +%H:%M:%S) queue5 armed" >> "$LOG"
+while pgrep -f "python -m auron_tpu.it --sf 10" > /dev/null; do
+  sleep 120
+done
+echo "$(date -u +%H:%M:%S) [5] IT_PERF regen" >> "$LOG"
+nice -n 10 timeout 14400 python -m auron_tpu.it --sf 0.1 \
+  --data-dir /tmp/auron_tpcds_01 --perf-factor 3 \
+  --json IT_PERF.json > /tmp/it_perf_regen.out 2>&1
+echo "$(date -u +%H:%M:%S) [5] rc=$?" >> "$LOG"
+echo "$(date -u +%H:%M:%S) [6] refplans sf0.1" >> "$LOG"
+for i in 1 2 3; do
+  nice -n 10 timeout 14400 python -m auron_tpu.it.refplans --sf 0.1 \
+    --data-dir /tmp/auron_tpcds_01 --resume \
+    --json IT_REFPLANS_SF01.json > /tmp/refplans_sf01.out 2>&1
+  rc=$?
+  echo "$(date -u +%H:%M:%S) [6] pass $i rc=$rc" >> "$LOG"
+  [ "$rc" = "0" ] && break
+done
+echo "$(date -u +%H:%M:%S) queue5 done" >> "$LOG"
