@@ -400,13 +400,25 @@ class ExprParser:
             return fcall("In", e, *vals)
         if self.peek().kind == "name" and self.peek().text == "INSET":
             # InSet prints its values bare and unparenthesized:
-            # `x INSET 1200, 1201, ...` (runs to the enclosing delimiter)
+            # `x INSET 1200, 1201, 1202 AND ...` — values are literals
+            # only, so parse them at unary level (a full operand parse
+            # would swallow the trailing AND conjunct into the last
+            # value: In(x, ..., And(1202, isnotnull(x))))
             self.next()
             hint = self._type_of(e)
-            vals = [self._operand(hint)]
-            while self.at_op(","):
+            str_hint = hint is not None and hint.id == TypeId.STRING
+            vals: List[ForeignExpr] = []
+            while True:
+                if str_hint and self._span_is_bare_literal():
+                    vals.append(self._raw_string_span())
+                else:
+                    v = self.unary()
+                    if v.name == "Literal":
+                        v = self._coerce(v, hint)
+                    vals.append(v)
+                if not self.at_op(","):
+                    break
                 self.next()
-                vals.append(self._operand(hint))
             return fcall("In", e, *vals)
         if self.at_kw("IS"):
             self.next()

@@ -137,3 +137,85 @@ def test_parsed_plan_matches_sql_front_door(q, catalog):
     assert _canon(res.table.to_pylist()) == \
         _canon(sql_res.table.to_pylist()), \
         f"{q}: parsed Spark plan != SQL front door"
+
+
+# -- expression-print grammar quirks (each one broke a real dump) --------
+
+def _binder(**fields):
+    from auron_tpu.frontend.spark_explain import ExplainBinder, ExplainDump
+    from auron_tpu.ir.schema import DataType
+    b = ExplainBinder(ExplainDump(root=0, children={}, details={},
+                                  subqueries={}))
+    types = {"i": DataType.int32(), "l": DataType.int64(),
+             "f": DataType.float64(), "s": DataType.string()}
+    for fid, (base, t) in fields.items():
+        b.define(int(fid), base, types[t])
+    return b
+
+
+def test_expr_keyword_state_codes():
+    b = _binder(**{"1": ("ca_state", "s")})
+    e = b.expr("ca_state#1 IN (MS,IN,ND,OK,NM,VA,OR)")
+    assert e.name == "In"
+    assert [v.value for v in e.children[1:]] == \
+        ["MS", "IN", "ND", "OK", "NM", "VA", "OR"]
+
+
+def test_expr_gt_string_value():
+    b = _binder(**{"1": ("hd_buy_potential", "s")})
+    e = b.expr("(hd_buy_potential#1 = >10000)")
+    assert e.children[1].value == ">10000"
+
+
+def test_expr_multiword_and_slash_literals():
+    b = _binder(**{"1": ("ca_county", "s"), "2": ("i_size", "s")})
+    e = b.expr("(ca_county#1 = Williamson County AND i_size#2 = N/A)")
+    assert e.children[0].children[1].value == "Williamson County"
+    assert e.children[1].children[1].value == "N/A"
+
+
+def test_expr_inset_numeric():
+    b = _binder(**{"1": ("d_month_seq", "i")})
+    e = b.expr("(d_month_seq#1 INSET 1200, 1201, 1202 AND "
+               "isnotnull(d_month_seq#1))")
+    inlist = e.children[0]
+    assert inlist.name == "In"
+    assert [v.value for v in inlist.children[1:]] == [1200, 1201, 1202]
+
+
+def test_expr_empty_string_call_args():
+    b = _binder(**{"1": ("c_last_name", "s")})
+    e = b.expr("coalesce(c_last_name#1, )")
+    assert len(e.children) == 2 and e.children[1].value == ""
+    e2 = b.expr("concat(c_last_name#1, , , c_last_name#1)")
+    assert [c.value for c in e2.children[1:2]] == [", "]
+
+
+def test_expr_case_null_branch_typed():
+    b = _binder(**{"1": ("mean", "f"), "2": ("stdev", "f")})
+    e = b.expr("CASE WHEN (mean#1 = 0.0) THEN null "
+               "ELSE (stdev#2 / mean#1) END")
+    # null branch value took the else's float64
+    null_branch = e.children[1]
+    assert null_branch.value is None
+    assert null_branch.dtype is not None and \
+        null_branch.dtype.id.name == "FLOAT64"
+
+
+def test_expr_agg_attr_name_with_parens():
+    from auron_tpu.ir.schema import DataType
+    b = _binder(**{"4": ("sr_return_amt", "f")})
+    b.define(10, "sum(UnscaledValue(sr_return_amt#4))",
+             DataType.float64())
+    e = b.expr("(sum(UnscaledValue(sr_return_amt#4))#10 > 0.0)")
+    assert e.children[0].name == "AttributeReference"
+    assert e.children[0].value.endswith("#10")
+
+
+def test_expr_bitwise_and_shiftright():
+    b = _binder(**{"1": ("spark_grouping_id", "l")})
+    e = b.expr("cast((shiftright(spark_grouping_id#1, 2) & 1) as tinyint)")
+    assert e.name == "Cast"
+    band = e.children[0]
+    assert band.name == "BitwiseAnd"
+    assert band.children[0].name == "ShiftRight"
