@@ -966,6 +966,9 @@ class ExplainBinder:
         self.fields: Dict[int, Field] = {}
         self._subq_memo: Dict[int, ForeignExpr] = {}
         self._bound: Dict[int, ForeignNode] = {}
+        # column name -> ReadSchema decimal scale, recorded when adapt
+        # mode replaces a decimal scan column with the catalog's float
+        self._orig_scale: Dict[str, int] = {}
 
     # -- public ------------------------------------------------------------
 
@@ -1038,11 +1041,38 @@ class ExplainBinder:
                 return child                     # float->decimal: no-op
         return fcall("Cast", child, dtype=dtype)
 
+    def _dropped_scale(self, fe: ForeignExpr) -> Optional[int]:
+        """Max ReadSchema decimal scale among referenced columns whose
+        decimal type adapt mode replaced with float."""
+        best: Optional[int] = None
+        if fe.name == "AttributeReference":
+            s = self._orig_scale.get(fe.value)
+            if s is not None:
+                best = s
+        for c in fe.children:
+            s = self._dropped_scale(c)
+            if s is not None and (best is None or s > best):
+                best = s
+        return best
+
     def adapt_fn(self, fname: str, args: List[ForeignExpr]) -> ForeignExpr:
-        if self.adapt and fname in ("UnscaledValue", "MakeDecimal",
-                                    "CheckOverflow", "PromotePrecision"):
-            # scale factors cancel across the UnscaledValue/MakeDecimal
-            # pair; on the float64 warehouse both collapse to identity
+        if self.adapt and fname in ("CheckOverflow", "PromotePrecision"):
+            return args[0]
+        if self.adapt and fname == "UnscaledValue":
+            # true semantics on the float warehouse: x * 10^s (the
+            # plan's later / 10^s — a MakeDecimal OR a bare literal
+            # divide like `avg(UnscaledValue(p)) / 100.0` — then
+            # cancels exactly; a plain identity broke the literal form)
+            s = self._dropped_scale(args[0])
+            if s:
+                return fcall("Multiply", args[0],
+                             flit(float(10 ** s), F64), dtype=F64)
+            return args[0]
+        if self.adapt and fname == "MakeDecimal":
+            s = int(args[2].value) if len(args) > 2 else 0
+            if s:
+                return fcall("Divide", args[0],
+                             flit(float(10 ** s), F64), dtype=F64)
             return args[0]
         if fname == "CheckOverflow":
             # second arg is a DecimalType(p,s) spec printed as a call
@@ -1245,6 +1275,7 @@ class ExplainBinder:
         bare_fields = []     # parquet column names the scan reads
         for base, fid in zip(bases, ids):
             dt = dtypes.get(base, F64)
+            orig = dt
             if cat_t is not None:
                 cf = cat_fields.get(base)
                 if cf is None:
@@ -1253,7 +1284,13 @@ class ExplainBinder:
                 dt = cf.dtype
             elif self.adapt and dt.id == TypeId.DECIMAL:
                 dt = F64
-            fields.append(self.define(fid, base, dt, fresh=True))
+            f = self.define(fid, base, dt, fresh=True)
+            if self.adapt and orig.id == TypeId.DECIMAL and \
+                    dt.id != TypeId.DECIMAL:
+                # remember the dropped scale so UnscaledValue keeps its
+                # true x * 10^s meaning over the float column
+                self._orig_scale[f.name] = orig.scale
+            fields.append(f)
             bare_fields.append(Field(base, dt))
         out = Schema(tuple(fields))
         bare_out = Schema(tuple(bare_fields))

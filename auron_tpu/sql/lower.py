@@ -612,10 +612,17 @@ def _lower_from(t: Optional[A.TableRef], ctx: _Ctx,
     if isinstance(t, A.Join):
         if t.kind == "cross":
             return _lower_comma_join(t, ctx, filters)
-        left = _lower_from(t.left, ctx, filters)
-        right = _avoid_collisions(left.scope,
-                                  _lower_from(t.right, ctx, filters),
-                                  ctx)
+        # WHERE conjuncts must not push below an outer join's nullable
+        # side: `ws LEFT JOIN wr ... WHERE wr_return_amt > 10000`
+        # filters AFTER the join (null-rejecting semantics, q49's
+        # inner-ization), not the wr scan
+        null_left = t.kind in ("right", "full")
+        null_right = t.kind in ("left", "full")
+        left = _lower_from(t.left, ctx, [] if null_left else filters)
+        right = _avoid_collisions(
+            left.scope,
+            _lower_from(t.right, ctx, [] if null_right else filters),
+            ctx)
         cond = _conjuncts(t.on)
         lks, rks, rest = _equi_keys(cond, left.scope, right.scope, ctx)
         if not lks:
