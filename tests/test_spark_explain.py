@@ -124,7 +124,7 @@ _SQL_DIR = os.environ.get(
     "/root/reference/dev/auron-it/src/main/resources/tpcds-queries")
 
 
-@pytest.mark.parametrize("q", ["q3", "q42", "q52"])
+@pytest.mark.parametrize("q", ["q3", "q6", "q42", "q49", "q52"])
 def test_parsed_plan_matches_sql_front_door(q, catalog):
     if not os.path.isdir(_SQL_DIR):
         pytest.skip("reference SQL files not present")
