@@ -369,6 +369,19 @@ def _norm(v):
     return v
 
 
+def _take_chunked(table: pa.Table, indices, chunk: int = 1 << 22
+                  ) -> pa.Table:
+    """table.take in row slices: a single-chunk take of a fan-out join
+    can push a string column past 2GB and overflow its int32 offsets
+    ('Negative offsets in binary array' at sf=10) — per-slice takes
+    keep every output chunk bounded."""
+    if len(indices) <= chunk:
+        return table.take(pa.array(indices, type=pa.int64()))
+    parts = [table.take(pa.array(indices[i:i + chunk], type=pa.int64()))
+             for i in range(0, len(indices), chunk)]
+    return pa.concat_tables(parts)
+
+
 class PyArrowEngine:
     """ForeignEngine executing the corpus' op vocabulary on host."""
 
@@ -572,7 +585,7 @@ class PyArrowEngine:
                     li.append(i)
             elif jt == "ExistenceJoin":
                 li.append(i)
-        lt = left.take(pa.array(li)) if li else left.slice(0, 0)
+        lt = _take_chunked(left, li) if li else left.slice(0, 0)
         if jt == "ExistenceJoin":
             flags = pa.array([bool(index.get(k, [])) if None not in k
                               else False for k in lk])
@@ -581,7 +594,7 @@ class PyArrowEngine:
         if jt in ("LeftSemi", "LeftAnti"):
             return lt
         rtake = [j if j >= 0 else None for j in ri]
-        rt = right.take(pa.array(rtake, type=pa.int64())) if rtake else \
+        rt = _take_chunked(right, rtake) if rtake else \
             right.slice(0, 0)
         cols = list(lt.columns) + list(rt.columns)
         top = pa.Table.from_arrays(cols, names=_join_names(left, right))

@@ -144,7 +144,15 @@ class QueryRunner:
                 # hits vm.max_map_count otherwise (it/refplans.py)
                 import jax
                 jax.clear_caches()
-            r = self.run(name)
+            try:
+                r = self.run(name)
+            except Exception as e:  # noqa: BLE001 - one red row, not a
+                # dead sweep (an sf=10 oracle crash killed 28 queries)
+                r = QueryResult(
+                    name=name, ok=False, native_s=0.0, oracle_s=0.0,
+                    rows=0, all_native=False,
+                    error=f"{type(e).__name__}: {str(e)[:200]}")
+                self.results.append(r)
             if on_result is not None:
                 on_result(r)
         return self.results
