@@ -1,0 +1,78 @@
+"""auron_tpu.analysis — pass-based static analyzer for the plan IR.
+
+A compiler-style verifier over the serialized-plan contract (PAPER.md:
+intercept an optimized physical plan, serialize it, execute it
+natively): schema inference/checking, column resolution, partitioning
+contracts, TPU lints and serde round-trip run as ordered passes under a
+PassManager, producing structured Diagnostics instead of whatever would
+have crashed first at execution time.
+
+Entry points:
+- analyze(plan)          -> AnalysisResult (diagnostics, never raises)
+- verify(plan)           -> raises PlanVerificationError on errors
+- verify_task(task)      -> the executor's verify-before-execute gate
+                            (cached per plan identity, diagnostics
+                            logged through runtime/task_logging)
+- python -m auron_tpu.analysis [plan.json ...]   standalone CLI
+"""
+
+from __future__ import annotations
+
+import logging
+import weakref
+from typing import Dict, Optional
+
+from auron_tpu.analysis.diagnostics import (  # noqa: F401 - public API
+    AnalysisResult, Diagnostic, DiagnosticSink, PlanVerificationError,
+)
+from auron_tpu.analysis.passes import (  # noqa: F401 - public API
+    ColumnResolutionPass, PartitioningContractsPass, Pass, PassManager,
+    SchemaCheckPass, SerdeRoundTripPass, TpuLintPass, analyze,
+    default_passes, verify,
+)
+from auron_tpu.analysis.schema_infer import SchemaContext  # noqa: F401
+
+log = logging.getLogger("auron_tpu.analysis")
+
+# plans already verified this process, keyed by object identity with a
+# weakref guard against id reuse — re-executing the same TaskDefinition
+# plan across partitions/retries must not pay the analyzer again
+_VERIFIED: Dict[int, "weakref.ref"] = {}
+
+
+def _already_verified(node) -> bool:
+    r = _VERIFIED.get(id(node))
+    return r is not None and r() is node
+
+
+def _mark_verified(node) -> None:
+    try:
+        _VERIFIED[id(node)] = weakref.ref(
+            node, lambda _r, _i=id(node): _VERIFIED.pop(_i, None))
+    except TypeError:
+        pass   # non-weakrefable node: just re-verify next time
+
+
+def verify_task(task, emit_log: bool = True) -> Optional[AnalysisResult]:
+    """Verify a TaskDefinition (or bare plan) before execution.
+
+    Diagnostics are emitted through the `auron_tpu.analysis` logger —
+    inside a task scope they carry the [stage N part M] prefix
+    (runtime/task_logging.py), so a verify failure names the offending
+    node path, not just a stack trace.  Raises PlanVerificationError
+    when any error-severity diagnostic is present.
+    """
+    plan = getattr(task, "plan", task)
+    if plan is None or _already_verified(plan):
+        return None
+    res = analyze(task)
+    if emit_log:
+        level = {"error": logging.ERROR, "warning": logging.WARNING,
+                 "info": logging.DEBUG}
+        for d in res.diagnostics:
+            log.log(level.get(d.severity, logging.DEBUG),
+                    "plan verifier: %s", d)
+    if not res.ok:
+        raise PlanVerificationError(res.diagnostics)
+    _mark_verified(plan)
+    return res

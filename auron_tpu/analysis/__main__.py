@@ -1,0 +1,176 @@
+"""CLI: `python -m auron_tpu.analysis [plan.json ...]`.
+
+With no paths, lints every golden plan document under the IT reference
+set (tests/golden_plans, or $AURON_GOLDEN_PLANS).  A path may be a
+directory, a golden document ({"query": ..., "plans": {...}}), or a bare
+serialized node ({"@kind": ...} — the wire form ir/serde.py emits).
+
+    python -m auron_tpu.analysis                      # lint the golden set
+    python -m auron_tpu.analysis plan.json --strict   # warnings fail too
+    python -m auron_tpu.analysis --regen-golden       # rebuild the set
+
+--regen-golden re-derives the documents from the IT corpus: every
+query in auron_tpu.it.queries is converted exactly as the runner
+converts it, and the native root plus each exchange/broadcast producer
+subtree (wrapped in its ShuffleWriter so partitioning contracts stay
+checkable) is serialized into one JSON document per query.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, Iterator, List, Tuple
+
+from auron_tpu.analysis import analyze
+from auron_tpu.ir.node import Node
+
+
+def default_golden_dir() -> str:
+    env = os.environ.get("AURON_GOLDEN_PLANS")
+    if env:
+        return env
+    # repo-relative (…/auron_tpu/analysis/__main__.py -> repo root)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "tests", "golden_plans")
+
+
+def iter_documents(paths: List[str]) -> Iterator[Tuple[str, dict]]:
+    def load(f: str) -> dict:
+        with open(f) as fh:
+            return json.load(fh)
+
+    for p in paths:
+        if os.path.isdir(p):
+            for f in sorted(glob.glob(os.path.join(p, "*.json"))):
+                yield f, load(f)
+        else:
+            yield p, load(p)
+
+
+def plans_of(doc: dict) -> Iterator[Tuple[str, Node]]:
+    """(label, decoded plan) pairs of one document."""
+    if "@kind" in doc:
+        yield "plan", Node.from_dict(doc)
+        return
+    for label, d in doc.get("plans", {}).items():
+        yield label, Node.from_dict(d)
+
+
+def lint_paths(paths: List[str], strict: bool = False,
+               quiet: bool = False) -> int:
+    n_plans = n_err = n_warn = 0
+    failed: List[str] = []
+    for path, doc in iter_documents(paths):
+        name = doc.get("query") or os.path.basename(path)
+        for label, plan in plans_of(doc):
+            n_plans += 1
+            res = analyze(plan)
+            n_err += len(res.errors)
+            n_warn += len(res.warnings)
+            bad = bool(res.errors) or (strict and res.warnings)
+            if bad:
+                failed.append(f"{name}:{label}")
+            for d in res.diagnostics:
+                if d.severity == "info" and quiet:
+                    continue
+                if d.is_error or not quiet or strict:
+                    print(f"{name}:{label}: {d}")
+    status = "FAIL" if failed else "ok"
+    print(f"{status}: {n_plans} plans linted, {n_err} errors, "
+          f"{n_warn} warnings"
+          + (f"; failing: {', '.join(failed[:20])}" if failed else ""))
+    if failed:
+        return 2
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# golden regeneration (the IT reference set, serialized)
+# ---------------------------------------------------------------------------
+
+def regen_golden(out_dir: str, sf: float, data_dir: str) -> int:
+    from auron_tpu.frontend import converters, strategy
+    from auron_tpu.frontend.converters import ConvertContext, ForeignWrap
+    from auron_tpu.ir import plan as P
+    from auron_tpu.it import queries
+    from auron_tpu.it.datagen import generate
+
+    cat = generate(data_dir, sf=sf)
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    for name in queries.names():
+        plan = queries.build(name, cat)
+        tags = strategy.apply(plan)
+        ctx = ConvertContext()
+        ctx._uid = "golden00"   # deterministic resource ids for goldens
+        converted = converters.convert_recursively(plan, tags, ctx)
+
+        plans: Dict[str, dict] = {}
+
+        def native_roots(c) -> Iterator[P.PlanNode]:
+            if isinstance(c, P.PlanNode):
+                yield c
+            elif isinstance(c, ForeignWrap):
+                for ch in c.children:
+                    yield from native_roots(ch)
+
+        for i, root in enumerate(native_roots(converted)):
+            plans["root" if i == 0 and isinstance(converted, P.PlanNode)
+                  else f"native[{i}]"] = root.to_dict()
+        for i, job in enumerate(ctx.exchanges.values()):
+            if isinstance(job.child, P.PlanNode):
+                w = P.ShuffleWriter(child=job.child,
+                                    partitioning=job.partitioning)
+                plans[f"exchange[{i}]"] = w.to_dict()
+        for i, job in enumerate(ctx.broadcasts.values()):
+            if isinstance(job.child, P.PlanNode):
+                plans[f"broadcast[{i}]"] = job.child.to_dict()
+        for i, src in enumerate(ctx.sources.values()):
+            for j, root in enumerate(native_roots(src.node)):
+                plans[f"source[{i}][{j}]"] = root.to_dict()
+
+        doc = {"query": name, "sf": sf, "plans": plans}
+        with open(os.path.join(out_dir, f"{name}.json"), "w") as fh:
+            json.dump(doc, fh, sort_keys=True, separators=(",", ":"))
+            fh.write("\n")
+        n += 1
+        print(f"{name}: {len(plans)} plan sections", flush=True)
+    print(f"regenerated {n} golden plan documents in {out_dir}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="auron_tpu.analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="plan JSON files/dirs (default: the golden set)")
+    ap.add_argument("--strict", action="store_true",
+                    help="treat warnings as failures")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print errors only")
+    ap.add_argument("--regen-golden", action="store_true",
+                    help="rebuild the golden plan documents from the IT "
+                         "corpus")
+    ap.add_argument("--golden-dir", default=None)
+    ap.add_argument("--sf", type=float, default=0.001)
+    ap.add_argument("--data-dir", default="/tmp/auron_tpcds_lint")
+    args = ap.parse_args(argv)
+
+    golden = args.golden_dir or default_golden_dir()
+    if args.regen_golden:
+        return regen_golden(golden, args.sf, args.data_dir)
+    paths = args.paths or [golden]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such file or directory: {p}",
+                  file=sys.stderr)
+            return 1
+    return lint_paths(paths, strict=args.strict, quiet=args.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

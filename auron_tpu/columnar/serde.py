@@ -61,6 +61,10 @@ def write_one_batch(rb: pa.RecordBatch, out: BinaryIO,
                     codec: Optional[str] = None) -> int:
     """Write one frame; returns bytes written."""
     codec = codec or conf.get("auron.shuffle.compression.codec")
+    if codec == "zstd":
+        from auron_tpu.native import bindings
+        if not bindings.zstd_available():
+            codec = "zlib"   # self-describing: the frame header records it
     sink = io.BytesIO()
     with pa.ipc.new_stream(sink, rb.schema) as w:
         w.write_batch(rb)

@@ -488,6 +488,16 @@ SPILL_MIN_TRIGGER = conf.define(
     "Consumers below this size are never forced to spill "
     "(reference MIN_TRIGGER_SIZE, auron-memmgr/src/lib.rs:36).",
 )
+PLAN_VERIFY = conf.define(
+    "auron.plan.verify", False,
+    "Run the static plan verifier (auron_tpu.analysis: schema check, "
+    "column resolution, partitioning contracts, TPU lints, serde "
+    "round-trip) over every TaskDefinition before building its operator "
+    "tree; error diagnostics abort the task with the offending node "
+    "paths logged through runtime/task_logging.  Off by default in "
+    "production (the front-end is trusted); forced on under the test "
+    "suite (tests/conftest.py).",
+)
 PROFILING_HTTP_ENABLE = conf.define(
     "auron.profiling.http.enable", False,
     "Lazily start the HTTP profiling service on first task execution "

@@ -46,6 +46,11 @@ def _encode(v: Any) -> Any:
     if isinstance(v, bytes):
         import base64
         return {"@bytes": base64.b64encode(v).decode("ascii")}
+    import decimal
+    if isinstance(v, decimal.Decimal):
+        # decimal literals (p>18 hybrid plans) have no JSON form; tag the
+        # exact string representation
+        return {"@decimal": str(v)}
     if isinstance(v, float):
         # JSON has no inf/nan literal; tag them
         import math
@@ -73,9 +78,19 @@ def _decode(v: Any) -> Any:
         if "@bytes" in v:
             import base64
             return base64.b64decode(v["@bytes"])
+        if "@decimal" in v:
+            import decimal
+            return decimal.Decimal(v["@decimal"])
         if "@float" in v:
-            return float(v["@float"].replace("inf", "Infinity")
-                         if "inf" in v["@float"] else "nan")
+            # exact tag set _encode emits; anything else is a corrupt
+            # document and must not silently decode to nan
+            tag = v["@float"]
+            special = {"nan": float("nan"), "inf": float("inf"),
+                       "-inf": float("-inf")}
+            if tag not in special:
+                raise ValueError(f"bad @float tag {tag!r} "
+                                 f"(expected nan/inf/-inf)")
+            return special[tag]
         return {k: _decode(x) for k, x in v.items()}
     if isinstance(v, list):
         return tuple(_decode(x) for x in v)
@@ -120,7 +135,16 @@ class Node:
         """Bottom-up rewrite: rebuild with transformed children, then apply fn.
 
         Handles Nodes nested arbitrarily deep inside tuples (e.g.
-        Expand.projections is a tuple of tuples of exprs)."""
+        Expand.projections is a tuple of tuples of exprs).
+
+        Depth bound: the rewrite is inherently recursive (a rebuilt child
+        must exist before its parent is rebuilt), so tree depth is limited
+        by the Python recursion limit minus caller headroom — comfortably
+        thousands of plan levels, far past any real TPC-DS plan.  Pure
+        traversals must NOT be built on transform_up: use ir.plan.walk /
+        plan_children, which are iterative and unbounded.  A plan too deep
+        for the limit raises RecursionError annotated with the node kind
+        instead of an anonymous stack overflow."""
 
         def rec(v: Any) -> Any:
             if isinstance(v, Node):
@@ -133,7 +157,15 @@ class Node:
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
             if isinstance(v, (Node, tuple)):
-                nv = rec(v)
+                try:
+                    nv = rec(v)
+                except RecursionError as e:
+                    if e.args and "transform_up" in str(e.args[0]):
+                        raise
+                    raise RecursionError(
+                        f"transform_up exceeded the recursion limit below "
+                        f"a {self.kind!r} node; use ir.plan.walk for "
+                        f"traversals of very deep plans") from e
                 if nv != v:
                     changes[f.name] = nv
         node = dataclasses.replace(self, **changes) if changes else self

@@ -434,18 +434,26 @@ class TaskDefinition(Node):
 
 def plan_children(plan: Node):
     """Direct child plans, descending through wrapper Nodes (e.g. UnionInput)
-    but not through expressions."""
+    but not through expressions.  Iterative (explicit stack): wrapper
+    chains never touch the Python recursion limit."""
     out = []
-    for c in plan.children_nodes():
+    stack = list(reversed(plan.children_nodes()))
+    while stack:
+        c = stack.pop()
         if isinstance(c, PlanNode):
             out.append(c)
         elif isinstance(c, Node) and not isinstance(c, Expr):
-            out.extend(plan_children(c))
+            stack.extend(reversed(c.children_nodes()))
     return out
 
 
 def walk(plan: PlanNode):
-    """Pre-order traversal over plan nodes only (not exprs)."""
-    yield plan
-    for c in plan_children(plan):
-        yield from walk(c)
+    """Pre-order traversal over plan nodes only (not exprs).  Iterative
+    (explicit stack): a deep TPC-DS operator chain — thousands of unary
+    nodes — walks fine where the recursive form died at
+    sys.getrecursionlimit()."""
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(reversed(plan_children(node)))

@@ -27,11 +27,23 @@ def from_json(s: str) -> Node:
     return Node.from_dict(json.loads(s))
 
 
+def _zstd():
+    """The zstandard module, or None when not installed (the envelope
+    gates on it: zstd degrades to zlib, recorded in the codec byte, so
+    deserialize stays self-describing)."""
+    try:
+        import zstandard
+        return zstandard
+    except ImportError:
+        return None
+
+
 def serialize(node: Node, codec: str = "zstd") -> bytes:
     payload = to_json(node).encode("utf-8")
+    if codec == "zstd" and _zstd() is None:
+        codec = "zlib"
     if codec == "zstd":
-        import zstandard
-        body, cid = zstandard.ZstdCompressor(level=3).compress(payload), _CODEC_ZSTD
+        body, cid = _zstd().ZstdCompressor(level=3).compress(payload), _CODEC_ZSTD
     elif codec == "zlib":
         import zlib
         body, cid = zlib.compress(payload, 6), _CODEC_ZLIB
@@ -50,7 +62,10 @@ def deserialize(data: bytes) -> Node:
         raise ValueError(f"unsupported IR version {version}")
     body = data[6:]
     if cid == _CODEC_ZSTD:
-        import zstandard
+        zstandard = _zstd()
+        if zstandard is None:
+            raise RuntimeError("zstd-compressed IR envelope but the "
+                               "zstandard module is not installed")
         payload = zstandard.ZstdDecompressor().decompress(body)
     elif cid == _CODEC_ZLIB:
         import zlib

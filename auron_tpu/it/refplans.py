@@ -68,6 +68,11 @@ def run_one(text: str, cat, warm: bool = True):
     t0 = time.perf_counter()
     res = s.execute(plan)
     native_s = time.perf_counter() - t0
+    # static-analyzer gate over the converted Spark-emitted plan: a dump
+    # that binds but converts into a malformed native tree is a failure
+    # even when execution limps to matching results
+    from auron_tpu.it import stability
+    lint = stability.lint_converted(res.converted, res.ctx)
     native_warm = None
     if warm:
         t0 = time.perf_counter()
@@ -82,8 +87,9 @@ def run_one(text: str, cat, warm: bool = True):
     from auron_tpu.it import compare
     diff = compare.compare_tables(res.table, oracle.table)
     return {
-        "ok": diff is None,
+        "ok": diff is None and lint is None,
         "diff": diff,
+        "lint": lint,
         "rows": res.table.num_rows,
         "oracle_rows": oracle.table.num_rows,
         "native_s": round(native_s, 4),

@@ -97,8 +97,11 @@ class QueryRunner:
             oracle_s = time.perf_counter() - t0
 
         diff = compare.compare_tables(res.table, oracle.table)
-        plan_err = None
-        if self.golden_dir is not None:
+        # every converted plan is linted by the static analyzer (the
+        # golden gate's always-on sibling: schema/resolution/partitioning/
+        # serde errors fail the query even when results happen to match)
+        plan_err = stability.lint_converted(res.converted, res.ctx)
+        if self.golden_dir is not None and plan_err is None:
             text = stability.render_plan(res.converted, res.ctx)
             plan_err = stability.check_stability(name, text,
                                                 self.golden_dir)

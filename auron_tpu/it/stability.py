@@ -72,6 +72,49 @@ def _render(node, ctx, depth: int, lines: List[str]) -> None:
                 _render(c.child, ctx, depth + 1, lines)
 
 
+def lint_converted(converted, ctx: Optional[ConvertContext]
+                   ) -> Optional[str]:
+    """Static-analyzer gate over every native section of a converted
+    tree: the root (descending through ForeignWrap sections), each
+    exchange producer (wrapped in its ShuffleWriter so partitioning
+    contracts stay visible), each broadcast producer, and each C2N
+    source subtree.  Returns joined error text, or None when clean —
+    the same contract shape as check_stability, so the IT runner folds
+    both into `plan_error`."""
+    from auron_tpu.analysis import analyze
+
+    sections = []
+
+    def native_roots(c):
+        if isinstance(c, P.PlanNode):
+            yield c
+        elif isinstance(c, ForeignWrap):
+            for ch in c.children:
+                yield from native_roots(ch)
+
+    for i, root in enumerate(native_roots(converted)):
+        sections.append((f"native[{i}]" if i else "root", root))
+    if ctx is not None:
+        for i, job in enumerate(ctx.exchanges.values()):
+            if isinstance(job.child, P.PlanNode):
+                sections.append((
+                    f"exchange[{i}]",
+                    P.ShuffleWriter(child=job.child,
+                                    partitioning=job.partitioning)))
+        for i, job in enumerate(ctx.broadcasts.values()):
+            if isinstance(job.child, P.PlanNode):
+                sections.append((f"broadcast[{i}]", job.child))
+        for i, src in enumerate(ctx.sources.values()):
+            for j, root in enumerate(native_roots(src.node)):
+                sections.append((f"source[{i}][{j}]", root))
+
+    msgs: List[str] = []
+    for label, plan in sections:
+        res = analyze(plan)
+        msgs.extend(f"lint {label}: {d}" for d in res.errors)
+    return "\n".join(msgs) if msgs else None
+
+
 def check_stability(name: str, plan_text: str, golden_dir: str
                     ) -> Optional[str]:
     """None when stable; error message otherwise.  Writes the golden only

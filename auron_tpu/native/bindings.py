@@ -100,6 +100,17 @@ def available() -> bool:
 # native codec used by spill files.
 # ---------------------------------------------------------------------------
 
+def zstd_available() -> bool:
+    """The zstd codec needs the python zstandard module (itself a C
+    binding).  Callers that can record the codec per frame (columnar
+    serde, spills) degrade to zlib when it is absent."""
+    try:
+        import zstandard  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
 def compress(payload: bytes, level: int = 3) -> bytes:
     import zstandard
     return zstandard.ZstdCompressor(level=level).compress(payload)

@@ -53,7 +53,9 @@ class NativeExecutionRuntime:
                  resources: Optional[ResourceRegistry] = None):
         self.task = task
         self.planner = PhysicalPlanner()
-        self.root: Operator = self.planner.create_plan(task.plan)
+        # verify-before-execute (conf 'auron.plan.verify'): diagnostics
+        # log with the task prefix when built inside a task_scope
+        self.root: Operator = self.planner.create_verified_plan(task)
         self.ctx = TaskContext(
             stage_id=task.stage_id, partition_id=task.partition_id,
             num_partitions=task.num_partitions,
@@ -102,8 +104,11 @@ def execute_task(task: P.TaskDefinition,
 
     profiling.maybe_start_from_conf()   # lazy start (exec.rs:53-59)
     task_logging.install()              # idempotent (init_logging analogue)
-    rt = NativeExecutionRuntime(task, resources)
     with task_logging.task_scope(task.stage_id, task.partition_id):
+        # runtime construction sits inside the task scope so plan-verifier
+        # diagnostics (runtime/planner.py:create_verified_plan) and
+        # planner errors carry the [stage N part M] prefix
+        rt = NativeExecutionRuntime(task, resources)
         # convert BEFORE the row-count check: to_arrow fetches count +
         # columns in one round trip, while `b.num_rows` alone would pay a
         # separate sync for lazy batches

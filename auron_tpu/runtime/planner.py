@@ -73,6 +73,17 @@ class PhysicalPlanner:
             raise NotImplementedError(f"plan node {node.kind!r}")
         return arm(node)
 
+    def create_verified_plan(self, task: P.TaskDefinition) -> Operator:
+        """Verify-before-execute gate (conf `auron.plan.verify`): run the
+        static analyzer over the TaskDefinition, then build the operator
+        tree.  Mirrors the reference's convert-before-native contract —
+        a malformed plan is rejected with node-path diagnostics instead
+        of crashing inside whatever kernel touches it first."""
+        if conf.get("auron.plan.verify"):
+            from auron_tpu.analysis import verify_task
+            verify_task(task)
+        return self.create_plan(task.plan)
+
     # -- leaves --------------------------------------------------------------
 
     def _check(self, switch: str) -> None:

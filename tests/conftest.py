@@ -23,6 +23,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# installs the jax compat gates (jax.shard_map / jax.enable_x64 shims
+# for this image's jax) before any test module does `from jax import
+# shard_map` directly
+import auron_tpu  # noqa: E402,F401
+
+# verify-before-execute is ON for the whole suite (env fallback of the
+# `auron.plan.verify` option): every TaskDefinition any test executes is
+# statically checked by auron_tpu.analysis first, so a regression that
+# emits a malformed plan fails with node-path diagnostics here even when
+# its query would have limped through execution.
+os.environ.setdefault("AURON_TPU_AURON_PLAN_VERIFY", "1")
+
 # NOTE on the persistent XLA compilation cache: do NOT enable it here.
 # This jaxlib's CPU AOT serialization is unsound — cache WRITES and READS
 # of the engine's executables segfault nondeterministically mid-suite

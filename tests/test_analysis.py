@@ -1,0 +1,440 @@
+"""Plan verifier tests: every analyzer pass accepts a valid plan and
+rejects a seeded-broken variant, the executor's verify-before-execute
+gate fires, and the committed golden plan set lints clean."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import ClassVar
+
+import pytest
+
+from auron_tpu import config
+from auron_tpu.analysis import (
+    PlanVerificationError, analyze, verify, verify_task,
+)
+from auron_tpu.analysis.__main__ import (
+    default_golden_dir, lint_paths, main as cli_main,
+)
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.expr import (
+    AggExpr, BinaryExpr, BoundReference, Column, SortExpr, col, lit,
+)
+from auron_tpu.ir.schema import DataType, Field, Schema
+
+I64 = DataType.int64()
+F64 = DataType.float64()
+STR = DataType.string()
+
+
+def base_schema() -> Schema:
+    return Schema.of(Field("k", I64, nullable=False),
+                     Field("v", F64), Field("s", STR))
+
+
+def scan(schema=None) -> P.ParquetScan:
+    return P.ParquetScan(
+        schema=schema or base_schema(),
+        file_groups=(P.FileGroup(paths=("/tmp/t.parquet",)),))
+
+
+def passed(res, pass_id: str) -> bool:
+    return not any(d.pass_id == pass_id for d in res.errors)
+
+
+def errors_of(res, pass_id: str):
+    return [d for d in res.errors if d.pass_id == pass_id]
+
+
+# ---------------------------------------------------------------------------
+# a representative valid plan: every pass must accept it
+# ---------------------------------------------------------------------------
+
+def valid_two_phase_plan() -> P.TaskDefinition:
+    partial = P.Agg(
+        child=P.Filter(child=scan(),
+                       predicates=(BinaryExpr(left=col("k"), op=">",
+                                              right=lit(5)),)),
+        exec_mode="partial", grouping=(col("s"),), grouping_names=("s",),
+        aggs=(AggExpr(fn="avg", children=(col("v"),), return_type=F64),),
+        agg_names=("avg_v",))
+    writer = P.ShuffleWriter(
+        child=partial,
+        partitioning=P.Partitioning(mode="hash", num_partitions=4,
+                                    expressions=(col("s"),)))
+    return P.TaskDefinition(plan=writer, stage_id=1, partition_id=0,
+                            num_partitions=2)
+
+
+def test_valid_plan_is_clean():
+    res = analyze(valid_two_phase_plan())
+    assert res.ok, res.render()
+    assert not res.warnings, res.render()
+    verify(valid_two_phase_plan())   # must not raise
+
+
+# ---------------------------------------------------------------------------
+# schema-check
+# ---------------------------------------------------------------------------
+
+def test_schema_projection_arity_mismatch():
+    bad = P.Projection(child=scan(), exprs=(col("k"),), names=("a", "b"))
+    res = analyze(bad)
+    assert errors_of(res, "schema-check"), res.render()
+
+
+def test_schema_filter_predicate_not_boolean():
+    bad = P.Filter(child=scan(), predicates=(col("v"),))
+    res = analyze(bad)
+    assert any("not boolean" in d.message
+               for d in errors_of(res, "schema-check")), res.render()
+
+
+def test_schema_union_input_dtype_mismatch():
+    declared = Schema.of(Field("k", I64), Field("v", F64))
+    other = P.EmptyPartitions(
+        schema=Schema.of(Field("k", STR), Field("v", F64)))
+    bad = P.Union(schema=declared, num_partitions=1,
+                  inputs=(P.UnionInput(child=other, partition=0,
+                                       out_partition=0),))
+    res = analyze(bad)
+    assert any("declared" in d.message
+               for d in errors_of(res, "schema-check")), res.render()
+
+
+def test_schema_rename_arity():
+    bad = P.RenameColumns(child=scan(), names=("only_one",))
+    res = analyze(bad)
+    assert errors_of(res, "schema-check"), res.render()
+
+
+def test_schema_leaf_without_schema():
+    bad = P.IpcReader(schema=None, resource_id="x")
+    res = analyze(bad)
+    assert any("no declared schema" in d.message
+               for d in errors_of(res, "schema-check")), res.render()
+
+
+# ---------------------------------------------------------------------------
+# column-resolution
+# ---------------------------------------------------------------------------
+
+def test_resolution_bound_reference_out_of_range():
+    bad = P.Projection(child=scan(), exprs=(BoundReference(index=7),),
+                       names=("x",))
+    res = analyze(bad)
+    assert any("bound reference #7" in d.message
+               for d in errors_of(res, "column-resolution")), res.render()
+
+
+def test_resolution_unknown_column_name():
+    bad = P.Filter(child=scan(),
+                   predicates=(BinaryExpr(left=col("nope"), op=">",
+                                          right=lit(1)),))
+    res = analyze(bad)
+    errs = errors_of(res, "column-resolution")
+    assert any("'nope'" in d.message for d in errs), res.render()
+    # fix-hint names the available columns
+    assert any("available" in (d.hint or "") for d in errs)
+
+
+def test_resolution_scan_projection_index():
+    bad = P.ParquetScan(schema=base_schema(),
+                        file_groups=(P.FileGroup(paths=("/t",)),),
+                        projection=(0, 9))
+    res = analyze(bad)
+    assert errors_of(res, "column-resolution"), res.render()
+
+
+def test_resolution_generate_required_child_output():
+    bad = P.Generate(child=scan(), generator="explode",
+                     args=(col("s"),),
+                     generator_output_names=("g",),
+                     generator_output_types=(STR,),
+                     required_child_output=(0, 11))
+    res = analyze(bad)
+    assert any("required_child_output" in d.message
+               for d in errors_of(res, "column-resolution")), res.render()
+
+
+def test_resolution_join_keys_checked_per_side():
+    # right key resolves only against the LEFT side's schema: error
+    left = scan(Schema.of(Field("lk", I64)))
+    right = scan(Schema.of(Field("rk", I64)))
+    bad = P.HashJoin(left=left, right=right,
+                     on=P.JoinOn(left_keys=(col("lk"),),
+                                 right_keys=(col("lk"),)))
+    res = analyze(bad)
+    assert errors_of(res, "column-resolution"), res.render()
+    ok = P.HashJoin(left=left, right=right,
+                    on=P.JoinOn(left_keys=(col("lk"),),
+                                right_keys=(col("rk"),)))
+    assert passed(analyze(ok), "column-resolution")
+
+
+# ---------------------------------------------------------------------------
+# partitioning contracts
+# ---------------------------------------------------------------------------
+
+def test_partitioning_single_mode_with_many_partitions():
+    bad = P.ShuffleWriter(
+        child=scan(),
+        partitioning=P.Partitioning(mode="single", num_partitions=4))
+    res = analyze(bad)
+    assert errors_of(res, "partitioning"), res.render()
+
+
+def test_partitioning_hash_without_keys():
+    bad = P.ShuffleWriter(
+        child=scan(),
+        partitioning=P.Partitioning(mode="hash", num_partitions=4))
+    res = analyze(bad)
+    assert any("without key expressions" in d.message
+               for d in errors_of(res, "partitioning")), res.render()
+
+
+def test_partitioning_union_mapping_out_of_range():
+    inp = P.UnionInput(child=scan(), partition=0, out_partition=5)
+    bad = P.Union(schema=base_schema(), num_partitions=2, inputs=(inp,))
+    res = analyze(bad)
+    assert any("out_partition 5" in d.message
+               for d in errors_of(res, "partitioning")), res.render()
+
+
+def test_partitioning_smj_sort_options_arity():
+    s = scan()
+    bad = P.SortMergeJoin(
+        left=s, right=scan(),
+        on=P.JoinOn(left_keys=(col("k"),), right_keys=(col("k"),)),
+        sort_options=((True, True), (False, False)))
+    res = analyze(bad)
+    assert any("sort_options" in d.message
+               for d in errors_of(res, "partitioning")), res.render()
+
+
+def test_partitioning_join_key_arity_mismatch():
+    bad = P.HashJoin(
+        left=scan(), right=scan(),
+        on=P.JoinOn(left_keys=(col("k"), col("v")),
+                    right_keys=(col("k"),)))
+    res = analyze(bad)
+    assert any("left keys" in d.message
+               for d in errors_of(res, "partitioning")), res.render()
+
+
+def _partial_agg(child) -> P.Agg:
+    return P.Agg(child=child, exec_mode="partial", grouping=(col("s"),),
+                 grouping_names=("s",),
+                 aggs=(AggExpr(fn="sum", children=(col("v"),),
+                               return_type=F64),),
+                 agg_names=("sum_v",))
+
+
+def test_partitioning_final_over_final_agg():
+    final_inner = P.Agg(child=scan(), exec_mode="final",
+                        grouping=(col("s"),), grouping_names=("s",),
+                        aggs=(AggExpr(fn="sum", children=(col("v"),),
+                                      return_type=F64),),
+                        agg_names=("sum_v",))
+    bad = P.Agg(child=final_inner, exec_mode="final",
+                grouping=(col("s"),), grouping_names=("s",),
+                aggs=(AggExpr(fn="sum", children=(col("v"),),
+                              return_type=F64),),
+                agg_names=("sum_v",))
+    res = analyze(bad)
+    assert any("expected 'partial'" in d.message
+               for d in errors_of(res, "partitioning")), res.render()
+
+
+def test_partitioning_final_agg_state_arity():
+    # final avg needs key + (sum, count); a 2-column input is short
+    rdr = P.IpcReader(schema=Schema.of(Field("s", STR),
+                                       Field("avg_v#sum", F64)),
+                      resource_id="x")
+    bad = P.Agg(child=rdr, exec_mode="final",
+                grouping=(BoundReference(index=0),),
+                grouping_names=("s",),
+                aggs=(AggExpr(fn="avg", children=(col("v"),),
+                              return_type=F64),),
+                agg_names=("avg_v",))
+    res = analyze(bad)
+    assert any("state layout" in d.message
+               for d in errors_of(res, "partitioning")), res.render()
+    # and the correct 3-column layout is accepted
+    rdr3 = P.IpcReader(schema=Schema.of(
+        Field("s", STR), Field("avg_v#sum", F64),
+        Field("avg_v#count", I64, nullable=False)), resource_id="x")
+    ok = P.Agg(child=rdr3, exec_mode="final",
+               grouping=(BoundReference(index=0),),
+               grouping_names=("s",),
+               aggs=(AggExpr(fn="avg", children=(col("v"),),
+                             return_type=F64),),
+               agg_names=("avg_v",))
+    assert passed(analyze(ok), "partitioning")
+
+
+def test_partitioning_task_definition_partition_range():
+    bad = P.TaskDefinition(plan=scan(), partition_id=7, num_partitions=2)
+    res = analyze(bad)
+    assert any("partition_id 7" in d.message
+               for d in errors_of(res, "partitioning")), res.render()
+
+
+# ---------------------------------------------------------------------------
+# tpu-lint (advisory)
+# ---------------------------------------------------------------------------
+
+def warnings_of(res, pass_id: str):
+    return [d for d in res.warnings if d.pass_id == pass_id]
+
+
+def test_tpu_lint_tiny_batch_warns():
+    res = analyze(P.CoalesceBatches(child=scan(), target_batch_size=100))
+    assert warnings_of(res, "tpu-lint"), res.render()
+    assert res.ok   # advisory only — never an error
+
+
+def test_tpu_lint_lane_misaligned_batch_warns():
+    res = analyze(P.CoalesceBatches(child=scan(), target_batch_size=8200))
+    assert any("128" in d.message
+               for d in warnings_of(res, "tpu-lint")), res.render()
+
+
+def test_tpu_lint_aligned_batch_clean():
+    res = analyze(P.CoalesceBatches(child=scan(), target_batch_size=8192))
+    assert not warnings_of(res, "tpu-lint"), res.render()
+
+
+def test_tpu_lint_host_resident_sort_key_warns():
+    nested = Schema.of(Field("k", I64),
+                       Field("tags", DataType.list_(STR)))
+    res = analyze(P.Sort(child=scan(nested),
+                         sort_exprs=(SortExpr(child=col("tags")),)))
+    assert any("host-resident" in d.message
+               for d in warnings_of(res, "tpu-lint")), res.render()
+
+
+# ---------------------------------------------------------------------------
+# serde-roundtrip
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _RogueNode(P.PlanNode):
+    """Deliberately NOT @register-ed: to_dict works, from_dict cannot."""
+    kind: ClassVar[str] = "rogue_unregistered"
+    child: P.PlanNode = None  # type: ignore[assignment]
+
+
+def test_serde_pass_accepts_registered_tree():
+    assert passed(analyze(valid_two_phase_plan()), "serde-roundtrip")
+
+
+def test_serde_pass_rejects_unregistered_node():
+    bad = P.Limit(child=_RogueNode(child=scan()), limit=10)
+    res = analyze(bad)
+    errs = errors_of(res, "serde-roundtrip")
+    assert errs, res.render()
+    # localized to the offending subtree, not just the root
+    assert any("child" in d.path for d in errs), res.render()
+
+
+# ---------------------------------------------------------------------------
+# executor gate + logging
+# ---------------------------------------------------------------------------
+
+def test_verify_task_raises_with_node_paths():
+    bad = P.TaskDefinition(
+        plan=P.Projection(child=scan(), exprs=(BoundReference(index=9),),
+                          names=("x",)))
+    with pytest.raises(PlanVerificationError) as ei:
+        verify_task(bad)
+    assert "plan" in ei.value.paths()[0]
+
+
+def test_verify_task_caches_verified_plans():
+    task = valid_two_phase_plan()
+    assert verify_task(task) is not None
+    # second call on the SAME plan object short-circuits
+    assert verify_task(task) is None
+
+
+def test_executor_verify_gate(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from auron_tpu.runtime.executor import execute_plan
+    f = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": [1, 2], "v": [0.5, 1.5],
+                             "s": ["a", "b"]}), f)
+    sch = base_schema()
+    sc = P.ParquetScan(schema=sch, file_groups=(P.FileGroup(paths=(f,)),))
+    bad = P.Projection(child=sc, exprs=(BoundReference(index=9),),
+                       names=("x",))
+    with config.conf.scoped({"auron.plan.verify": True}):
+        with pytest.raises(PlanVerificationError):
+            execute_plan(bad)
+        good = P.Projection(child=sc, exprs=(col("k"),), names=("k",))
+        assert execute_plan(good).to_pylist() == [{"k": 1}, {"k": 2}]
+
+
+def test_verify_disabled_skips_gate(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from auron_tpu.runtime.planner import PhysicalPlanner
+    f = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": [1], "v": [0.5], "s": ["a"]}), f)
+    sc = P.ParquetScan(schema=base_schema(),
+                       file_groups=(P.FileGroup(paths=(f,)),))
+    bad = P.TaskDefinition(
+        plan=P.Projection(child=sc, exprs=(BoundReference(index=9),),
+                          names=("x",)))
+    with config.conf.scoped({"auron.plan.verify": False}):
+        # without the gate the same plan dies as a bare IndexError from
+        # whatever touches the bad ordinal first — the pre-verifier
+        # behavior the gate exists to replace with node-path diagnostics
+        with pytest.raises(IndexError):
+            PhysicalPlanner().create_verified_plan(bad)
+
+
+# ---------------------------------------------------------------------------
+# CLI + golden corpus
+# ---------------------------------------------------------------------------
+
+def test_cli_lints_bare_plan_document(tmp_path):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(valid_two_phase_plan().to_dict()))
+    assert cli_main([str(good)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        P.Projection(child=scan(), exprs=(BoundReference(index=9),),
+                     names=("x",)).to_dict()))
+    assert cli_main([str(bad)]) == 2
+    assert cli_main([str(tmp_path / "missing.json")]) == 1
+
+
+def test_tools_lint_script():
+    """tools/lint_plans.sh is the CI gate; keep it green from pytest so
+    a pipeline that only runs the suite still exercises it."""
+    import shutil
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "lint_plans.sh")
+    if not os.path.exists(script) or shutil.which("bash") is None:
+        pytest.skip("lint script or bash unavailable")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    out = subprocess.run(["bash", script], capture_output=True,
+                         text=True, timeout=240, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_golden_corpus_lints_clean():
+    """The committed IT reference set must stay analyzer-clean: this is
+    the fast-pytest hook of tools/lint_plans.sh (regen with
+    `python -m auron_tpu.analysis --regen-golden`)."""
+    d = default_golden_dir()
+    if not os.path.isdir(d) or not os.listdir(d):
+        pytest.skip(f"golden plan set not present at {d}")
+    assert lint_paths([d], quiet=True) == 0

@@ -48,7 +48,23 @@ def runner(catalog):
         pass
 
 
-@pytest.mark.parametrize("query", names())
+# tier-1 keeps a representative third of the corpus (every operator
+# family: scans+pushdown, BHJ/SMJ/SHJ, two-phase/rollup aggs, window,
+# expand, union, generate) under the 870s gate budget; the remaining
+# queries run with the same fixtures under -m slow (nightly / full
+# sweeps).  Every query here was red before the jax shard_map compat
+# gate landed, so the split only widens coverage vs the seed.
+_TIER1_QUERIES = set(names()[::4]) | {
+    "q03", "q07", "q42", "q55", "q13a", "q26a", "q48a", "q19", "q65w",
+    "q71u", "q27r", "q93s", "q76u", "q22r", "q33b", "q60b", "q36r",
+    "q62w", "q39v", "q56s", "q80s", "q01", "q16a", "q68s", "q98",
+}
+
+
+@pytest.mark.parametrize(
+    "query",
+    [q if q in _TIER1_QUERIES else
+     pytest.param(q, marks=pytest.mark.slow) for q in names()])
 def test_tpcds_query(runner, query):
     r = runner.run(query)
     assert r.error is None, f"{query}: {r.error}"
