@@ -32,6 +32,10 @@ from auron_tpu.analysis.passes import (  # noqa: F401 - public API
 )
 from auron_tpu.analysis.schema_infer import SchemaContext  # noqa: F401
 
+# SPMD stage-compiler rejection lint (analysis/spmd.py) is imported
+# lazily by its consumers — importing it here would pull jax via
+# parallel/stage at analyzer-CLI startup.
+
 log = logging.getLogger("auron_tpu.analysis")
 
 # plans already verified this process, keyed by object identity with a

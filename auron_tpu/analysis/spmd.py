@@ -1,0 +1,82 @@
+"""SPMD stage-compiler rejections as structured diagnostics.
+
+The stage compiler (parallel/stage.py) refuses plan shapes it cannot
+express as one shard_map program; historically each refusal surfaced as
+a free-text log line at fallback time.  This module lints those
+rejections into the analyzer's Diagnostic vocabulary (ROADMAP PR 1
+follow-up), so the chaos sweep, the IT runner and refplans all report
+"why did this query leave the mesh" the same way they report schema or
+partitioning errors — severity + pass id + node path + kind + message.
+
+Rejections are WARNING severity: a serial fallback is a supported
+degradation, not a malformed plan.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from auron_tpu.analysis.diagnostics import (
+    WARNING, AnalysisResult, Diagnostic,
+)
+
+PASS_ID = "spmd-stage"
+
+
+def _node_path(root, target) -> str:
+    """Dotted child-field path from `root` to `target` (best-effort,
+    identity-based; '' for the root, '?' when the node sits behind an
+    exchange boundary the plan-tree walk cannot address)."""
+    from auron_tpu.ir import plan as P
+
+    def walk(node, path: str) -> Optional[str]:
+        if node is target:
+            return path
+        if not isinstance(node, P.PlanNode):
+            return None
+        import dataclasses
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            kids = v if isinstance(v, tuple) else (v,)
+            for i, c in enumerate(kids):
+                if isinstance(c, P.UnionInput):
+                    c = c.child
+                if isinstance(c, P.PlanNode):
+                    sub = f"{path}.{f.name}" if path else f.name
+                    if isinstance(v, tuple):
+                        sub += f"[{i}]"
+                    got = walk(c, sub)
+                    if got is not None:
+                        return got
+        return None
+
+    got = walk(root, "")
+    return got if got is not None else "?"
+
+
+def lint_spmd(plan, conv_ctx) -> AnalysisResult:
+    """Enumerate every kind-level SPMD rejection in `plan` as warning
+    diagnostics (empty result = the plan prechecks clean for the mesh)."""
+    from auron_tpu.parallel.stage import iter_spmd_rejections
+
+    diags: List[Diagnostic] = []
+    for node, reason in iter_spmd_rejections(plan, conv_ctx):
+        diags.append(Diagnostic(
+            severity=WARNING, pass_id=PASS_ID,
+            path=_node_path(plan, node),
+            node_kind=getattr(node, "kind", type(node).__name__),
+            message=reason,
+            hint="plan section runs on the serial per-partition path"))
+    return AnalysisResult(diagnostics=diags)
+
+
+def rejection_diagnostic(exc: BaseException, plan) -> Diagnostic:
+    """Wrap one raised SpmdUnsupported into a Diagnostic (the session's
+    fallback path: the exception is the authoritative reason — guard
+    trips and trace-time rejections never went through the precheck
+    enumeration)."""
+    return Diagnostic(
+        severity=WARNING, pass_id=PASS_ID, path="",
+        node_kind=getattr(plan, "kind", type(plan).__name__),
+        message=str(exc) or type(exc).__name__,
+        hint="query degraded to the serial per-partition path")

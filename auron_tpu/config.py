@@ -197,7 +197,58 @@ TASK_RETRIES = conf.define(
     "auron.task.retries", 0,
     "Per-partition task retry count above the runtime (the Spark "
     "task-retry model the reference inherits; stage inputs are "
-    "materialized once, so a retry replays only the failed task).",
+    "materialized once, so a retry replays only the failed task). "
+    "Only retryable-classified failures (runtime/retry.py: transient "
+    "IO, injected device faults) are replayed; deterministic errors "
+    "ferry immediately.",
+)
+FAULTS_SPEC = conf.define(
+    "auron.faults.spec", "",
+    "Fault-injection spec armed at named fault_point(...) sites "
+    "(auron_tpu.faults): ';'-separated 'point:kind[:p=..,seed=..,"
+    "max=..,after=..]' rules, e.g. "
+    "'shuffle.push:io:p=0.2,seed=7;spill.write:io:p=0.1'.  Kinds: "
+    "io | timeout (retryable), device (retry then degrade to serial), "
+    "error (deterministic).  Empty (default) = every fault point is a "
+    "no-op check.",
+)
+NET_TIMEOUT_SECONDS = conf.define(
+    "auron.net.timeout.seconds", 30.0,
+    "Socket connect/read timeout for every network client (RSS shuffle "
+    "clients, engine-service client, kafka consumer) — replaces the "
+    "hard-coded per-client timeouts; <= 0 disables (blocking sockets).",
+)
+SERVICE_READ_TIMEOUT_SECONDS = conf.define(
+    "auron.service.read.timeout.seconds", 300.0,
+    "Server-side per-connection read timeout for the engine service and "
+    "the standalone shuffle server: a half-dead client that stops "
+    "sending mid-conversation is disconnected instead of pinning a "
+    "handler thread forever; <= 0 disables.",
+)
+RETRY_MAX_ATTEMPTS = conf.define(
+    "auron.retry.max.attempts", 3,
+    "Default attempt budget for the shared retry policy "
+    "(runtime/retry.py) used by the network clients and the device "
+    "degradation tier; per-task replay uses auron.task.retries instead.",
+)
+RETRY_BACKOFF_BASE_MS = conf.define(
+    "auron.retry.backoff.base.ms", 25.0,
+    "First-retry backoff in milliseconds; attempt N sleeps "
+    "min(base * 2^(N-1), max) * (1 + jitter * u).",
+)
+RETRY_BACKOFF_MAX_MS = conf.define(
+    "auron.retry.backoff.max.ms", 1000.0,
+    "Cap on the exponential retry backoff, in milliseconds.",
+)
+RETRY_JITTER = conf.define(
+    "auron.retry.jitter", 0.25,
+    "Jitter fraction added to each backoff; drawn from a seeded RNG "
+    "(auron.retry.seed) so schedules are deterministic.",
+)
+RETRY_SEED = conf.define(
+    "auron.retry.seed", 0,
+    "Seed for the retry-backoff jitter stream (determinism for tests "
+    "and chaos sweeps).",
 )
 LOG_LEVEL = conf.define(
     "auron.log.level", "INFO",

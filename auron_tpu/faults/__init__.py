@@ -1,0 +1,288 @@
+"""Config-driven, deterministically-seeded fault injection.
+
+The dynamic counterpart of the static plan verifier (auron_tpu.analysis):
+the analyzer proves a plan is well-formed, this module proves the runtime
+*recovers* when the world is not.  Named `fault_point(...)` call sites are
+threaded through every boundary that can fail in production — shuffle
+push/fetch, spill write/read, engine-service dispatch, kafka fetch,
+operator execute, SPMD stage launch — and a spec string
+(`auron.faults.spec`) arms a subset of them with seeded probabilistic
+faults, so chaos sweeps (it/stability.py) are exactly reproducible.
+
+Spec grammar (';'-separated rules)::
+
+    spec  := rule (';' rule)*
+    rule  := point ':' kind [':' param (',' param)*]
+    param := 'p=' float | 'seed=' int | 'max=' int | 'after=' int
+    kind  := 'io' | 'timeout' | 'device' | 'error'
+
+e.g. ``shuffle.push:io:p=0.2,seed=7;spill.write:io:p=0.1``.
+
+`point` matches fault-point names exactly or by `fnmatch` glob
+(``shuffle.*``).  `p` is the per-invocation injection probability
+(default 1.0), `seed` makes the Bernoulli draw sequence deterministic
+per rule, `max` caps the total injections a rule may fire (bounds the
+blast radius — a sweep can never storm), and `after` skips the first N
+matching invocations (deterministically hit "the second push").
+
+Kinds map to exception families the retry policy (runtime/retry.py)
+classifies: `io` -> InjectedIOError (retryable-IO, an OSError),
+`timeout` -> InjectedTimeout (a TimeoutError/OSError), `device` ->
+InjectedDeviceFault (the retry-then-degrade tier: re-execute, then fall
+back from SPMD to the serial path), `error` -> InjectedError (a
+deterministic RuntimeError — never retried).
+
+With the spec unset (the default) `fault_point` is a no-op check: one
+config read, no registry, no RNG — cheap enough for per-push/per-task
+call sites (the IT_PERF wall-clock gate holds).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from auron_tpu.config import conf
+
+__all__ = [
+    "FaultSpecError", "InjectedFault", "InjectedIOError",
+    "InjectedTimeout", "InjectedDeviceFault", "InjectedError",
+    "FaultRule", "FaultRegistry", "fault_point", "active_registry",
+    "injection_counts", "reset",
+]
+
+
+class FaultSpecError(ValueError):
+    """Malformed `auron.faults.spec` string."""
+
+
+class InjectedFault(Exception):
+    """Marker mixin: every injected exception carries the point name."""
+
+    def __init__(self, point: str, message: str):
+        super().__init__(message)
+        self.fault_point = point
+
+
+class InjectedIOError(InjectedFault, OSError):
+    """Retryable-IO fault (a lost connection, a short write)."""
+
+
+class InjectedTimeout(InjectedFault, TimeoutError):
+    """Retryable timeout fault (TimeoutError is an OSError)."""
+
+
+class InjectedDeviceFault(InjectedFault, RuntimeError):
+    """Device-tier fault: the retry policy re-executes the task, and the
+    SPMD driver degrades to the serial per-partition path when it
+    persists (the SpmdGuardTripped(retryable=True) family)."""
+
+    auron_retryable = True
+
+
+class InjectedError(InjectedFault, RuntimeError):
+    """Deterministic fault: classified non-retryable (a poison input
+    would fail the same way every attempt)."""
+
+
+_KINDS = {
+    "io": InjectedIOError,
+    "timeout": InjectedTimeout,
+    "device": InjectedDeviceFault,
+    "error": InjectedError,
+}
+
+
+@dataclass
+class FaultRule:
+    """One armed rule; mutable counters live here (lock-guarded by the
+    owning registry — call sites run on task-pool threads)."""
+
+    pattern: str
+    kind: str
+    p: float = 1.0
+    seed: int = 0
+    max_injections: Optional[int] = None
+    after: int = 0
+    # counters (registry lock held)
+    calls: int = 0
+    injected: int = 0
+    _rng: random.Random = field(default_factory=random.Random, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {self.kind!r} for {self.pattern!r} "
+                f"(expected one of {sorted(_KINDS)})")
+        if not 0.0 <= self.p <= 1.0:
+            raise FaultSpecError(
+                f"fault probability p={self.p} for {self.pattern!r} "
+                f"outside [0, 1]")
+        self._rng = random.Random(self.seed)
+
+    def matches(self, point: str) -> bool:
+        return point == self.pattern or \
+            fnmatch.fnmatchcase(point, self.pattern)
+
+    def draw(self, point: str) -> Optional[InjectedFault]:
+        """One matching invocation: advance the deterministic Bernoulli
+        stream and return the fault to raise, or None."""
+        self.calls += 1
+        if self.calls <= self.after:
+            return None
+        if self.max_injections is not None and \
+                self.injected >= self.max_injections:
+            return None
+        # the draw advances the stream even when p == 1 so `max`/`after`
+        # edits never shift sibling rules' sequences (each rule owns its
+        # own RNG)
+        if self._rng.random() >= self.p:
+            return None
+        self.injected += 1
+        exc_type = _KINDS[self.kind]
+        return exc_type(
+            point,
+            f"injected {self.kind} fault at {point!r} "
+            f"(rule {self.pattern!r}, injection #{self.injected})")
+
+    def reset(self) -> None:
+        self.calls = 0
+        self.injected = 0
+        self._rng = random.Random(self.seed)
+
+
+def parse_spec(spec: str) -> List[FaultRule]:
+    """Parse the `auron.faults.spec` grammar; raises FaultSpecError with
+    the offending fragment on malformed input."""
+    rules: List[FaultRule] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) < 2 or len(parts) > 3 or not parts[0].strip():
+            raise FaultSpecError(
+                f"bad fault rule {raw!r} (expected "
+                f"'point:kind[:p=..,seed=..,max=..,after=..]')")
+        kw: Dict[str, object] = {}
+        if len(parts) == 3 and parts[2].strip():
+            for p in parts[2].split(","):
+                if "=" not in p:
+                    raise FaultSpecError(
+                        f"bad fault param {p!r} in rule {raw!r}")
+                key, _, val = p.partition("=")
+                key = key.strip()
+                try:
+                    if key == "p":
+                        kw["p"] = float(val)
+                    elif key == "seed":
+                        kw["seed"] = int(val)
+                    elif key == "max":
+                        kw["max_injections"] = int(val)
+                    elif key == "after":
+                        kw["after"] = int(val)
+                    else:
+                        raise FaultSpecError(
+                            f"unknown fault param {key!r} in rule {raw!r}")
+                except ValueError as e:
+                    if isinstance(e, FaultSpecError):
+                        raise
+                    raise FaultSpecError(
+                        f"bad value for {key!r} in rule {raw!r}: {val!r}"
+                    ) from e
+        rules.append(FaultRule(pattern=parts[0].strip(),
+                               kind=parts[1].strip(), **kw))
+    return rules
+
+
+class FaultRegistry:
+    """Armed rules for one spec string; counters survive across queries
+    of a sweep (reset() starts a fresh deterministic sequence)."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.rules = parse_spec(spec)
+        self._lock = threading.Lock()
+
+    def check(self, point: str) -> None:
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(point):
+                    continue
+                fault = rule.draw(point)
+                if fault is not None:
+                    raise fault
+
+    def counts(self) -> Dict[str, Tuple[int, int]]:
+        """pattern -> (matching calls, injections fired)."""
+        with self._lock:
+            return {r.pattern: (r.calls, r.injected) for r in self.rules}
+
+    def injected_total(self) -> int:
+        with self._lock:
+            return sum(r.injected for r in self.rules)
+
+    def reset(self) -> None:
+        with self._lock:
+            for r in self.rules:
+                r.reset()
+
+
+# one registry per distinct spec string: `conf.scoped` re-entry of the
+# same spec keeps the rule counters/RNG streams (a sweep is one
+# deterministic sequence), while editing the spec re-arms fresh
+_REGISTRIES: Dict[str, FaultRegistry] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _registry_for(spec: str) -> FaultRegistry:
+    reg = _REGISTRIES.get(spec)
+    if reg is None:
+        with _REG_LOCK:
+            reg = _REGISTRIES.get(spec)
+            if reg is None:
+                reg = _REGISTRIES[spec] = FaultRegistry(spec)
+    return reg
+
+
+def fault_point(point: str) -> None:
+    """Named injection site.  No-op (one config read) unless
+    `auron.faults.spec` arms a rule matching `point`."""
+    spec = conf.get("auron.faults.spec")
+    if not spec:
+        return
+    _registry_for(spec).check(point)
+
+
+def registry_for(spec: str) -> FaultRegistry:
+    """The (cached) registry for a spec string — chaos harness hook."""
+    return _registry_for(spec)
+
+
+def active_registry() -> Optional[FaultRegistry]:
+    """The registry for the currently-configured spec, or None."""
+    spec = conf.get("auron.faults.spec")
+    return _registry_for(spec) if spec else None
+
+
+def injection_counts() -> Dict[str, Tuple[int, int]]:
+    reg = active_registry()
+    return reg.counts() if reg is not None else {}
+
+
+def reset(spec: Optional[str] = None) -> None:
+    """Restart the deterministic sequence: the given spec's registry (or
+    the active one); with no active spec, drop every cached registry."""
+    if spec is not None:
+        with _REG_LOCK:
+            _REGISTRIES.pop(spec, None)
+        return
+    reg = active_registry()
+    if reg is not None:
+        reg.reset()
+    else:
+        with _REG_LOCK:
+            _REGISTRIES.clear()

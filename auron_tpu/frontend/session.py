@@ -56,6 +56,9 @@ class SessionResult:
     metrics: List[MetricNode] = field(default_factory=list)
     ctx: Optional[ConvertContext] = None  # exchange/broadcast subtrees
     spmd: bool = False  # executed as one shard_map program over a mesh
+    # why the SPMD stage compiler degraded to the serial path, as a
+    # rendered analysis diagnostic (None when spmd ran or no mesh)
+    spmd_rejection: Optional[str] = None
 
     def to_pylist(self) -> List[dict]:
         return self.table.to_pylist()
@@ -103,6 +106,7 @@ class AuronSession:
         ctx = ConvertContext()
         converted = converters.convert_recursively(plan, tags, ctx)
         self._metrics = []
+        self._spmd_rejection = None
         if mesh is not None and isinstance(converted, P.PlanNode):
             from auron_tpu.parallel.stage import (
                 SpmdUnsupported, execute_plan_spmd, precheck_plan,
@@ -123,7 +127,20 @@ class AuronSession:
                     s.node.node.op != "LocalTableScanExec")
                 return res
             except SpmdUnsupported as e:
-                log.info("SPMD compile fell back to serial path: %s", e)
+                # degradation tier: the serial per-partition path below
+                # IS the recovery.  The rejection becomes a structured
+                # diagnostic (analysis/spmd.py) — the chaos sweep and
+                # refplans report it uniformly — and the fallback is
+                # counted (num_fallbacks in the run metrics).
+                from auron_tpu.analysis.spmd import rejection_diagnostic
+                from auron_tpu.runtime import retry as _retry
+                diag = rejection_diagnostic(e, converted)
+                log.info("SPMD stage fell back to serial path: %s", diag)
+                _retry.add_fallback()
+                fb = MetricNode("SpmdFallback")
+                fb.add("num_fallbacks", 1)
+                self._metrics.append(fb)
+                self._spmd_rejection = str(diag)
         try:
             table = self._run_converted(converted, ctx)
         finally:
@@ -136,7 +153,8 @@ class AuronSession:
                 except Exception:
                     log.warning("failed to clear shuffle %s", rid)
         res = SessionResult(table=table, converted=converted, tags=tags,
-                            metrics=self._metrics, ctx=ctx)
+                            metrics=self._metrics, ctx=ctx,
+                            spmd_rejection=self._spmd_rejection)
         # count foreign sections that needed the host engine (local-table
         # sources are data, not computation)
         res._foreign_sections = sum(  # type: ignore[attr-defined]
@@ -172,25 +190,16 @@ class AuronSession:
         resources = self._materialize_deps(plan, ctx)
         n_parts = ctx.parts(plan)
         batches: List[pa.RecordBatch] = []
-        max_attempts = 1 + int(config.conf.get("auron.task.retries"))
 
         def run_task(pid: int):
-            # task-retry model above the runtime (the Spark scheduler's
-            # role the reference inherits): a failed partition task
-            # re-executes from its inputs — stage inputs (exchanges,
-            # broadcasts) are already materialized, so the retry replays
-            # only this task's work
-            for attempt in range(max_attempts):
-                try:
-                    return execute_plan(plan, partition_id=pid,
-                                        resources=resources,
-                                        num_partitions=n_parts)
-                except Exception:
-                    if attempt + 1 >= max_attempts:
-                        raise
-                    log.warning("task for partition %d failed "
-                                "(attempt %d/%d); retrying",
-                                pid, attempt + 1, max_attempts)
+            # the task-retry model above the runtime (the Spark
+            # scheduler's role the reference inherits) now lives in
+            # run_tasks itself: retryable-classified failures replay
+            # with 1 + auron.task.retries attempts against the already-
+            # materialized stage inputs (runtime/retry.py)
+            return execute_plan(plan, partition_id=pid,
+                                resources=resources,
+                                num_partitions=n_parts)
 
         # one runtime per task, tasks in parallel across a thread pool —
         # the analogue of the reference running one native runtime per
@@ -314,9 +323,20 @@ class AuronSession:
         n_reduce = job.partitioning.num_partitions
         # reduce-side resource: partition-indexed block lists; the task
         # context picks its partition's list (resources.ResourceRegistry
-        # supports per-partition values via PartitionedResource)
+        # supports per-partition values via PartitionedResource).  The
+        # fetch rides the shared retry policy: it is a pure read (the
+        # remote clients dedup by id, the in-process store is committed),
+        # so replays after an injected/transport fault are idempotent.
+        from auron_tpu.runtime.retry import (
+            RetryPolicy, call_with_retry, task_classify,
+        )
+        policy = RetryPolicy.task_policy()
         resources.put(job.rid, PartitionedBlocks(
-            [self.shuffle_service.reduce_blocks(job.rid, pid)
+            [call_with_retry(
+                lambda rid=job.rid, p=pid:
+                    self.shuffle_service.reduce_blocks(rid, p),
+                policy=policy, classify=task_classify,
+                label=f"shuffle fetch {job.rid}:{pid}")
              for pid in range(n_reduce)]))
 
 

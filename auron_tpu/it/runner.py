@@ -35,6 +35,10 @@ class QueryResult:
     spmd: bool = False              # ran as one shard_map mesh program
     native_warm_s: Optional[float] = None   # second (post-compile) run
     perf_error: Optional[str] = None
+    # why the SPMD stage compiler degraded to serial, as a structured
+    # analysis diagnostic (analysis/spmd.py) — uniform with the chaos
+    # sweep's reporting
+    spmd_rejection: Optional[str] = None
 
     def to_dict(self) -> Dict:
         return {"name": self.name, "ok": self.ok,
@@ -45,7 +49,8 @@ class QueryResult:
                 "spmd": self.spmd,
                 "native_warm_s": (None if self.native_warm_s is None
                                   else round(self.native_warm_s, 4)),
-                "perf_error": self.perf_error}
+                "perf_error": self.perf_error,
+                "spmd_rejection": self.spmd_rejection}
 
 
 @dataclass
@@ -127,7 +132,8 @@ class QueryRunner:
             native_s=native_s, oracle_s=oracle_s,
             rows=res.table.num_rows, all_native=res.all_native(),
             error=diff, plan_error=plan_err, spmd=res.spmd,
-            native_warm_s=warm_s, perf_error=perf_err)
+            native_warm_s=warm_s, perf_error=perf_err,
+            spmd_rejection=res.spmd_rejection)
         self.results.append(qr)
         # drop compiled executables between queries: queries share few
         # kernels, and letting thousands of CPU executables accumulate in

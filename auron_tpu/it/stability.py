@@ -6,12 +6,21 @@ foreign engine, a join strategy flip) fails the IT run even when results
 still match.
 
 Regenerate goldens with AURON_REGEN_GOLDEN=1 (the reference uses the same
-convention for its approved-plans directories)."""
+convention for its approved-plans directories).
+
+The CHAOS SWEEP (`chaos_sweep`, `python -m auron_tpu.it.stability
+--chaos SPEC`) is the dynamic sibling: run corpus queries once
+fault-free and once under an `auron.faults.spec` fault-injection spec
+(auron_tpu.faults), assert the results are bit-identical and that the
+recovery tier stayed bounded — total task attempts under faults at most
+`max_attempt_factor` times the fault-free attempt count (no retry
+storms), with num_retries / num_fallbacks surfaced in the report."""
 
 from __future__ import annotations
 
 import os
-from typing import List, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 from auron_tpu.frontend.converters import ConvertContext, ForeignWrap
 from auron_tpu.ir import plan as P
@@ -137,3 +146,240 @@ def check_stability(name: str, plan_text: str, golden_dir: str
                 f"(set AURON_REGEN_GOLDEN=1 to approve):\n--- golden\n"
                 f"{golden}\n--- actual\n{plan_text}")
     return None
+
+
+# ---------------------------------------------------------------------------
+# chaos sweep: results must survive injected faults bit-identically
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ChaosQueryResult:
+    name: str
+    ok: bool
+    identical: bool = False
+    rows: int = 0
+    attempts_baseline: int = 0   # task attempts, fault-free run
+    attempts_fault: int = 0      # task attempts under injection
+    error: Optional[str] = None
+    spmd_rejection: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        return {"name": self.name, "ok": self.ok,
+                "identical": self.identical, "rows": self.rows,
+                "attempts_baseline": self.attempts_baseline,
+                "attempts_fault": self.attempts_fault,
+                "error": self.error,
+                "spmd_rejection": self.spmd_rejection}
+
+
+@dataclass
+class ChaosReport:
+    spec: str
+    max_attempt_factor: float
+    results: List[ChaosQueryResult] = field(default_factory=list)
+    injected: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    num_retries: int = 0
+    num_fallbacks: int = 0
+
+    @property
+    def attempts_baseline(self) -> int:
+        return sum(r.attempts_baseline for r in self.results)
+
+    @property
+    def attempts_fault(self) -> int:
+        return sum(r.attempts_fault for r in self.results)
+
+    @property
+    def bounded(self) -> bool:
+        """No retry storms: total attempts under faults stay within
+        max_attempt_factor x the fault-free task count."""
+        return self.attempts_fault <= \
+            self.max_attempt_factor * max(self.attempts_baseline, 1)
+
+    @property
+    def ok(self) -> bool:
+        return self.bounded and all(r.ok for r in self.results)
+
+    def injected_total(self) -> int:
+        return sum(n for _c, n in self.injected.values())
+
+    def render(self) -> str:
+        lines = [f"chaos sweep: spec={self.spec!r}",
+                 f"{'query':8} {'ok':4} {'identical':9} "
+                 f"{'attempts':>8} {'baseline':>8}"]
+        for r in self.results:
+            lines.append(
+                f"{r.name:8} {'PASS' if r.ok else 'FAIL':4} "
+                f"{'yes' if r.identical else 'NO':9} "
+                f"{r.attempts_fault:8d} {r.attempts_baseline:8d}")
+            if r.error:
+                lines.append(f"         error: {r.error}")
+        for point, (calls, fired) in sorted(self.injected.items()):
+            lines.append(f"  fault {point}: {fired} injected / "
+                         f"{calls} draws")
+        lines.append(
+            f"num_retries={self.num_retries} "
+            f"num_fallbacks={self.num_fallbacks} "
+            f"attempts={self.attempts_fault} "
+            f"(bound {self.max_attempt_factor:g}x of "
+            f"{self.attempts_baseline}: "
+            f"{'ok' if self.bounded else 'EXCEEDED'})")
+        lines.append(f"{sum(1 for r in self.results if r.ok)}"
+                     f"/{len(self.results)} passed")
+        return "\n".join(lines)
+
+    def to_dict(self) -> Dict:
+        return {"spec": self.spec,
+                "max_attempt_factor": self.max_attempt_factor,
+                "results": [r.to_dict() for r in self.results],
+                "injected": {k: list(v) for k, v in self.injected.items()},
+                "num_retries": self.num_retries,
+                "num_fallbacks": self.num_fallbacks,
+                "attempts_baseline": self.attempts_baseline,
+                "attempts_fault": self.attempts_fault,
+                "ok": self.ok}
+
+
+def _canonical_table(table):
+    """Row-order-insensitive canonical form for the bit-identical check
+    (a degradation retry may legitimately reorder partition output)."""
+    t = table.combine_chunks()
+    if t.num_rows and t.num_columns:
+        t = t.sort_by([(n, "ascending") for n in t.column_names])
+    return t
+
+
+def chaos_sweep(names: List[str], catalog, spec: str,
+                max_attempt_factor: float = 3.0,
+                task_retries: int = 2,
+                serial: bool = True,
+                mesh=None) -> ChaosReport:
+    """Run each query fault-free, then under `spec`, and require the
+    fault run to produce the bit-identical table with bounded attempts.
+
+    `serial=True` (default) scopes `auron.spmd.singleDevice.enable` off
+    for BOTH runs so exchanges/spills materialize through the shuffle
+    and spill tiers the spec targets (the single-device stage program
+    has neither); pass serial=False (optionally with a mesh) to sweep
+    device/stage fault kinds instead.  Task parallelism is pinned to 1
+    so the per-rule injection sequences (seeded Bernoulli streams,
+    auron_tpu.faults) are exactly reproducible run to run."""
+    import jax
+
+    from auron_tpu import faults
+    from auron_tpu.config import conf
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it import queries
+    from auron_tpu.it.oracle import PyArrowEngine
+    from auron_tpu.runtime import executor, retry
+
+    base_scope = {"auron.task.parallelism": 1}
+    if serial:
+        base_scope["auron.spmd.singleDevice.enable"] = False
+    fault_scope = dict(base_scope)
+    fault_scope.update({
+        "auron.faults.spec": spec,
+        "auron.task.retries": task_retries,
+        # keep the deterministic backoff schedule fast: a sweep measures
+        # recovery, not patience
+        "auron.retry.backoff.base.ms": 1.0,
+        "auron.retry.backoff.max.ms": 10.0,
+    })
+
+    faults.reset(spec)           # one deterministic sequence per sweep
+    stats0 = retry.stats_snapshot()
+    report = ChaosReport(spec=spec, max_attempt_factor=max_attempt_factor)
+    for name in names:
+        plan = queries.build(name, catalog)
+        try:
+            started0, _ = executor.task_attempt_counts()
+            with conf.scoped(base_scope):
+                session = AuronSession(foreign_engine=PyArrowEngine())
+                baseline = session.execute(plan, mesh=mesh)
+            started1, _ = executor.task_attempt_counts()
+            with conf.scoped(fault_scope):
+                session = AuronSession(foreign_engine=PyArrowEngine())
+                res = session.execute(plan, mesh=mesh)
+            started2, _ = executor.task_attempt_counts()
+            same = _canonical_table(baseline.table).equals(
+                _canonical_table(res.table))
+            qr = ChaosQueryResult(
+                name=name, ok=same, identical=same,
+                rows=res.table.num_rows,
+                attempts_baseline=started1 - started0,
+                attempts_fault=started2 - started1,
+                spmd_rejection=res.spmd_rejection,
+                error=None if same else
+                "results diverged from the fault-free run")
+        except Exception as e:  # noqa: BLE001 - one red row, not a dead sweep
+            qr = ChaosQueryResult(
+                name=name, ok=False,
+                error=f"{type(e).__name__}: {str(e)[:300]}")
+        report.results.append(qr)
+        jax.clear_caches()   # same executable-accumulation guard as the
+        #                      IT runner (it/runner.py)
+    stats1 = retry.stats_snapshot()
+    report.num_retries = stats1.get("retries", 0) - \
+        stats0.get("retries", 0)
+    report.num_fallbacks = stats1.get("fallbacks", 0) - \
+        stats0.get("fallbacks", 0)
+    reg = faults.registry_for(spec) if spec else None
+    if reg is not None:
+        report.injected = reg.counts()
+    return report
+
+
+def _chaos_main(argv: Optional[List[str]] = None) -> int:
+    """CLI: python -m auron_tpu.it.stability --chaos SPEC [--sf F]
+    [--queries q03,q42] [--json out.json] — the tools/chaos_check.sh
+    entry point."""
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(prog="auron_tpu.it.stability")
+    ap.add_argument("--chaos", required=True,
+                    help="auron.faults.spec string to sweep under")
+    ap.add_argument("--sf", type=float, default=0.002)
+    ap.add_argument("--data-dir", default=None,
+                    help="TPC-DS data dir (default: a temp dir)")
+    ap.add_argument("--queries", default=None,
+                    help="comma-separated subset (default: a small "
+                         "representative set)")
+    ap.add_argument("--max-attempt-factor", type=float, default=3.0)
+    ap.add_argument("--task-retries", type=int, default=2)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import tempfile
+
+    from auron_tpu.it.datagen import generate
+    data_dir = args.data_dir or tempfile.mkdtemp(prefix="auron_chaos_")
+    catalog = generate(data_dir, sf=args.sf, fact_chunks=3)
+    names = args.queries.split(",") if args.queries else \
+        ["q03", "q07", "q42", "q55"]
+    report = chaos_sweep(names, catalog, args.chaos,
+                         max_attempt_factor=args.max_attempt_factor,
+                         task_retries=args.task_retries)
+    print(report.render())
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report.to_dict(), f, indent=2)
+    if not report.ok:
+        print("chaos sweep FAILED", file=sys.stderr)
+        return 2
+    if report.injected_total() == 0 and report.spec:
+        # a sweep that injected nothing proved nothing — fail loudly so
+        # a renamed fault point cannot silently hollow out the gate
+        print("chaos sweep injected 0 faults (stale point names in the "
+              "spec?)", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover - CLI
+    raise SystemExit(_chaos_main())

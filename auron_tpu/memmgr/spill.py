@@ -12,12 +12,14 @@ import io
 import os
 import tempfile
 import threading
+import weakref
 from typing import Iterator, List, Optional
 
 import pyarrow as pa
 
 from auron_tpu.columnar import serde as batch_serde
 from auron_tpu.config import conf
+from auron_tpu.faults import fault_point
 
 
 class Spill:
@@ -44,6 +46,7 @@ class HostMemSpill(Spill):
         self._codec = codec or conf.get("auron.spill.compression.codec")
 
     def write_batches(self, batches) -> int:
+        fault_point("spill.write")
         sink = io.BytesIO()
         for rb in batches:
             batch_serde.write_one_batch(rb, sink, codec=self._codec)
@@ -51,6 +54,7 @@ class HostMemSpill(Spill):
         return len(self._buf)
 
     def read_batches(self):
+        fault_point("spill.read")
         yield from batch_serde.read_batches(io.BytesIO(self._buf))
 
     def release(self) -> None:
@@ -61,7 +65,22 @@ class HostMemSpill(Spill):
         return len(self._buf)
 
 
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
 class FileSpill(Spill):
+    """File-tier spill.  The temp file's lifetime is bound to the spill
+    OBJECT, not to a well-behaved caller: a `weakref.finalize` unlinks it
+    when the spill is garbage-collected (a task that died mid-shuffle
+    never calls release()) and, because finalizers run at interpreter
+    exit, no temp file survives the process either.  `release()` stays
+    the eager path — on Linux an unlinked-but-open file keeps serving a
+    partially-consumed `read_batches` iterator."""
+
     def __init__(self, directory: Optional[str] = None,
                  codec: Optional[str] = None):
         d = directory or conf.get("auron.spill.dir") or None
@@ -69,8 +88,10 @@ class FileSpill(Spill):
         os.close(fd)
         self._codec = codec or conf.get("auron.spill.compression.codec")
         self._size = 0
+        self._cleanup = weakref.finalize(self, _unlink_quiet, self.path)
 
     def write_batches(self, batches) -> int:
+        fault_point("spill.write")
         with open(self.path, "wb") as f:
             for rb in batches:
                 self._size += batch_serde.write_one_batch(
@@ -78,14 +99,12 @@ class FileSpill(Spill):
         return self._size
 
     def read_batches(self):
+        fault_point("spill.read")
         with open(self.path, "rb") as f:
             yield from batch_serde.read_batches(f)
 
     def release(self) -> None:
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+        self._cleanup()   # idempotent: detaches the finalizer + unlinks
 
     @property
     def size_bytes(self) -> int:

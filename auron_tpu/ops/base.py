@@ -59,6 +59,13 @@ class Operator:
         """Wraps execute() with output_rows/batches + compute-time metrics
         and task-cancellation checks."""
         import time
+
+        from auron_tpu.faults import fault_point
+        # one draw per operator instantiation (not per batch): a `device`
+        # fault here kills the task, which the executor's degradation
+        # tier re-runs (num_retries) — the dynamic proof that operator
+        # failure recovery works end to end
+        fault_point("op.execute")
         it = self.execute(ctx)
         while True:
             t0 = time.perf_counter_ns()

@@ -222,13 +222,55 @@ class InProcessShuffleService:
         svc = self
 
         class _W(RssPartitionWriter):
-            def write(self, partition_id: int, data: bytes) -> None:
+            """Stages locally, commits atomically in flush(): a map task
+            replayed by the retry tier (runtime/retry.py) re-creates its
+            writer and the commit REPLACES any blocks an earlier partial
+            attempt left behind — the in-process counterpart of the
+            remote services' push_id/block_id dedup.  Each push/commit is
+            itself retried like the remote clients retry their push RPCs
+            (the fault point raises BEFORE any mutation, so a replayed
+            push never double-stages)."""
+
+            def __init__(self) -> None:
+                self._staged: Dict[int, List[bytes]] = {}
+
+            def _push(self, partition_id: int, data: bytes) -> None:
+                from auron_tpu.faults import fault_point
+                fault_point("shuffle.push")
+                self._staged.setdefault(partition_id, []).append(data)
+
+            def _commit(self) -> None:
+                from auron_tpu.faults import fault_point
+                fault_point("shuffle.push")
                 with svc._lock:
-                    svc._blocks.setdefault((shuffle_id, partition_id),
-                                           []).append((map_id, data))
+                    for pid, frames in self._staged.items():
+                        blocks = svc._blocks.setdefault(
+                            (shuffle_id, pid), [])
+                        blocks[:] = [e for e in blocks if e[0] != map_id]
+                        blocks.extend((map_id, d) for d in frames)
+                self._staged = {}
+
+            def write(self, partition_id: int, data: bytes) -> None:
+                from auron_tpu.runtime.retry import (
+                    RetryPolicy, call_with_retry,
+                )
+                call_with_retry(
+                    lambda: self._push(partition_id, data),
+                    policy=RetryPolicy.from_conf(),
+                    label="in-process shuffle push")
+
+            def flush(self) -> None:
+                from auron_tpu.runtime.retry import (
+                    RetryPolicy, call_with_retry,
+                )
+                call_with_retry(self._commit,
+                                policy=RetryPolicy.from_conf(),
+                                label="in-process shuffle commit")
         return _W()
 
     def reduce_blocks(self, shuffle_id: str, reduce_pid: int) -> List[bytes]:
+        from auron_tpu.faults import fault_point
+        fault_point("shuffle.fetch")
         with self._lock:
             entries = list(self._blocks.get((shuffle_id, reduce_pid), []))
         return [d for _mid, d in sorted(entries, key=lambda e: e[0])]

@@ -1266,6 +1266,9 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     # factor, shrink ladder, join compaction); hints remember the
     # working combination per canonical program so repeat executes skip
     # the trip-then-retry runs
+    from auron_tpu.faults import InjectedDeviceFault
+    from auron_tpu.runtime import retry as _retry
+    device_budget = max(0, _retry.RetryPolicy.from_conf().max_attempts - 1)
     for _attempt in range(6):
         try:
             out = _execute_plan_spmd_once(plan, conv_ctx, mesh,
@@ -1281,9 +1284,22 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                     not join_compact:
                 _JOIN_COMPACT_OFF_HINT[hint_key] = True
             return out
+        except InjectedDeviceFault as e:
+            # device-fault tier: re-execute the stage program a bounded
+            # number of times, then DEGRADE — raise SpmdUnsupported so
+            # the session falls back to the serial per-partition path
+            # (the session counts the fallback)
+            if device_budget > 0:
+                device_budget -= 1
+                _retry.add_retry()
+                continue
+            raise SpmdUnsupported(
+                f"device fault persisted past the retry budget: {e}"
+            ) from e
         except SpmdGuardTripped as e:
             if e.join_compact and join_compact:
                 join_compact = False
+                _retry.add_retry()
                 continue
             # the climb exists because post-agg exchange quotas are sized
             # from the SHRUNK capacity — a plan with no Agg anywhere was
@@ -1304,9 +1320,11 @@ def execute_plan_spmd(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                 # below makes repeat executes skip the climb entirely.
                 cap_eff = cap_eff * 4 \
                     if cap_eff < cap_hint * 16 else 0
+                _retry.add_retry()
                 continue
             if e.retryable and match == 1 and k > 1:
                 match = k
+                _retry.add_retry()
                 continue
             if e.hard:
                 _HARD_FAIL_HINT[hard_key] = True
@@ -1436,7 +1454,13 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     import dataclasses
 
     import pyarrow as pa
+    from auron_tpu.faults import fault_point
     from auron_tpu.ir.schema import to_arrow_schema
+
+    # injected device fault for the whole stage program: the driver
+    # (execute_plan_spmd) re-runs a bounded number of times, then
+    # degrades to the serial per-partition path
+    fault_point("stage.execute")
 
     # inputs arrive rid-canonicalized from execute_plan_spmd:
     # ConvertContext mints per-query-uuid resource ids, so byte-identical
@@ -1730,39 +1754,45 @@ _PRECHECK_OK = frozenset({
 })
 
 
+def iter_spmd_rejections(plan, conv_ctx):
+    """Yield (node, reason) for EVERY kind-level SPMD compilability
+    problem in the tree — the enumerating form behind precheck_plan,
+    and the source the analysis-side lint (analysis/spmd.py) turns into
+    structured diagnostics instead of log lines."""
+    exchanges = getattr(conv_ctx, "exchanges", None) or {}
+    for node in _walk_native(plan, conv_ctx):
+        if node.kind not in _PRECHECK_OK:
+            yield node, f"operator not SPMD-compilable: {node.kind}"
+            continue
+        if node.kind == "broadcast_join" and \
+                node.join_type not in _StageTracer._JOIN_TYPES:
+            yield node, f"SPMD broadcast-join type {node.join_type!r}"
+        if node.kind in ("hash_join", "sort_merge_join"):
+            if node.join_type not in _StageTracer._JOIN_TYPES_COLOCATED:
+                yield node, f"SPMD join type {node.join_type!r}"
+            # shuffled joins are per-device correct only when both sides
+            # were hash-exchanged on the join keys
+            elif not _smj_colocated(node, exchanges):
+                yield (node,
+                       "join sides are not hash-colocated on the join "
+                       "keys")
+        if node.kind == "agg" and node.exec_mode == "single" and \
+                not _single_agg_ok(node, exchanges):
+            yield (node, "single-mode agg needs an exchange (or "
+                         "partial/final shape)")
+        if node.kind == "window" and not _window_ok(node, exchanges):
+            yield node, "window needs a colocating exchange under it"
+        # (limit-over-sort rejection lives in _do_limit — trace-time only,
+        # one authoritative copy)
+
+
 def precheck_plan(plan, conv_ctx) -> None:
     """Cheap kind-level SPMD compilability check (no tracing, no source
     materialization) — rejects the remaining fallbacks (smj, generate,
     sinks) up front; union/expand compile since round 2,
     window/limit/top-k-sort/range since round 3."""
-    exchanges = getattr(conv_ctx, "exchanges", None) or {}
-    for node in _walk_native(plan, conv_ctx):
-        if node.kind not in _PRECHECK_OK:
-            raise SpmdUnsupported(
-                f"operator not SPMD-compilable: {node.kind}")
-        if node.kind == "broadcast_join" and \
-                node.join_type not in _StageTracer._JOIN_TYPES:
-            raise SpmdUnsupported(
-                f"SPMD broadcast-join type {node.join_type!r}")
-        if node.kind in ("hash_join", "sort_merge_join"):
-            if node.join_type not in _StageTracer._JOIN_TYPES_COLOCATED:
-                raise SpmdUnsupported(
-                    f"SPMD join type {node.join_type!r}")
-            # shuffled joins are per-device correct only when both sides
-            # were hash-exchanged on the join keys
-            if not _smj_colocated(node, exchanges):
-                raise SpmdUnsupported(
-                    "join sides are not hash-colocated on the join keys")
-        if node.kind == "agg" and node.exec_mode == "single" and \
-                not _single_agg_ok(node, exchanges):
-            raise SpmdUnsupported(
-                "single-mode agg needs an exchange (or partial/final "
-                "shape)")
-        if node.kind == "window" and not _window_ok(node, exchanges):
-            raise SpmdUnsupported(
-                "window needs a colocating exchange under it")
-        # (limit-over-sort rejection lives in _do_limit — trace-time only,
-        # one authoritative copy)
+    for _node, reason in iter_spmd_rejections(plan, conv_ctx):
+        raise SpmdUnsupported(reason)
 
 
 def _materialize_scans(plan, conv_ctx):

@@ -93,27 +93,71 @@ def execute_plan(plan: P.PlanNode, partition_id: int = 0,
 
 
 _TASKS_COMPLETED = 0
+_TASKS_STARTED = 0
 _TASKS_LOCK = threading.Lock()
+
+
+def task_attempt_counts() -> tuple:
+    """(started, completed) task attempts this process — the chaos sweep
+    bounds started_with_faults <= factor * started_fault_free."""
+    with _TASKS_LOCK:
+        return _TASKS_STARTED, _TASKS_COMPLETED
+
+
+def _device_retryable(exc: BaseException) -> bool:
+    """The device degradation tier's classifier: injected device faults
+    and retryable SPMD guard trips — transient by construction (a
+    re-execution re-draws the fault / re-traces with a wider factor);
+    everything else ferries to the caller unchanged."""
+    from auron_tpu.faults import InjectedDeviceFault
+    from auron_tpu.parallel.stage import SpmdGuardTripped
+    if isinstance(exc, InjectedDeviceFault):
+        return True
+    return isinstance(exc, SpmdGuardTripped) and \
+        getattr(exc, "retryable", False) and \
+        not getattr(exc, "auron_retry_exhausted", False)
 
 
 def execute_task(task: P.TaskDefinition,
                  resources: Optional[ResourceRegistry] = None
                  ) -> ExecutionResult:
-    global _TASKS_COMPLETED
-    from auron_tpu.runtime import profiling, task_logging
+    global _TASKS_COMPLETED, _TASKS_STARTED
+    from auron_tpu.runtime import profiling, retry, task_logging
 
     profiling.maybe_start_from_conf()   # lazy start (exec.rs:53-59)
     task_logging.install()              # idempotent (init_logging analogue)
-    with task_logging.task_scope(task.stage_id, task.partition_id):
-        # runtime construction sits inside the task scope so plan-verifier
-        # diagnostics (runtime/planner.py:create_verified_plan) and
-        # planner errors carry the [stage N part M] prefix
-        rt = NativeExecutionRuntime(task, resources)
-        # convert BEFORE the row-count check: to_arrow fetches count +
-        # columns in one round trip, while `b.num_rows` alone would pay a
-        # separate sync for lazy batches
-        out = [rb for rb in (b.to_arrow() for b in rt.batches())
-               if rb.num_rows > 0]
+    rt_box: List[NativeExecutionRuntime] = []
+    retries_box = [0]
+
+    def _attempt():
+        global _TASKS_STARTED
+        with _TASKS_LOCK:
+            _TASKS_STARTED += 1
+        with task_logging.task_scope(task.stage_id, task.partition_id):
+            # runtime construction sits inside the task scope so
+            # plan-verifier diagnostics (create_verified_plan) and
+            # planner errors carry the [stage N part M] prefix
+            rt = NativeExecutionRuntime(task, resources)
+            rt_box[:] = [rt]
+            # convert BEFORE the row-count check: to_arrow fetches count
+            # + columns in one round trip, while `b.num_rows` alone would
+            # pay a separate sync for lazy batches
+            return [rb for rb in (b.to_arrow() for b in rt.batches())
+                    if rb.num_rows > 0]
+
+    def _count_retry(_attempt_no, _exc):
+        retries_box[0] += 1
+
+    # device-tier recovery: a task dying with an injected device fault
+    # (or a retryable SPMD guard trip that escaped the stage driver) is
+    # re-executed on this serial per-partition path with a fresh operator
+    # tree, bounded by the shared retry budget; the re-execution count
+    # lands in the task's metric tree (num_retries)
+    out = retry.call_with_retry(
+        _attempt, policy=retry.RetryPolicy.from_conf(),
+        label=f"task stage={task.stage_id} part={task.partition_id}",
+        classify=_device_retryable, on_retry=_count_retry)
+    rt = rt_box[0]
     with _TASKS_LOCK:
         _TASKS_COMPLETED += 1
     out_schema = None
@@ -123,7 +167,10 @@ def execute_task(task: P.TaskDefinition,
             out_schema = to_arrow_schema(rt.root.schema)
     except Exception:  # noqa: BLE001 - schema is advisory (empty case)
         pass
-    return ExecutionResult(out, rt.finalize(), schema=out_schema)
+    metrics = rt.finalize()
+    if retries_box[0]:
+        metrics.add("num_retries", retries_box[0])
+    return ExecutionResult(out, metrics, schema=out_schema)
 
 
 def execute_task_bytes(task_bytes: bytes,

@@ -25,6 +25,9 @@ STANDARD_METRICS = (
     "fallback_sort_merge_join_count",
     "input_rows", "input_batches",
     "parquet_row_groups_pruned", "parquet_row_groups_read",
+    # recovery tier (runtime/retry.py + the SPMD degradation path):
+    # device-fault task re-executions and SPMD->serial fallbacks
+    "num_retries", "num_fallbacks",
 )
 
 

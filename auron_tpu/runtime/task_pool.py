@@ -2,14 +2,28 @@
 runtime per task, tasks across cores).  Sizing policy lives HERE so the
 serial fallback, the exchange map side, and the SPMD scan feed cannot
 drift: auron.task.parallelism, 0 = auto (min(8, cpu count)),
-1 = sequential.  Results keep task order."""
+1 = sequential.  Results keep task order.
+
+Failure semantics (the Spark TaskSetManager contract): the FIRST failure
+is ferried to the caller, not-yet-started sibling tasks are cancelled,
+already-running siblings drain (their errors are logged, never lost
+silently), and each task gets a bounded retry budget for
+retryable-classified errors (runtime/retry.py; 1 + auron.task.retries
+attempts).  The old `pool.map` shape raised the first error while
+siblings kept running and swallowed their exceptions.
+"""
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Any, Callable, List, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 from auron_tpu.config import conf
+from auron_tpu.runtime.retry import RetryPolicy, call_with_retry, \
+    task_classify
+
+log = logging.getLogger("auron_tpu.runtime")
 
 
 def pool_size() -> int:
@@ -20,12 +34,49 @@ def pool_size() -> int:
 
 
 def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
-              prefix: str = "auron-task") -> List[Any]:
+              prefix: str = "auron-task",
+              retry_policy: Optional[RetryPolicy] = None) -> List[Any]:
     items = list(items)
+    policy = retry_policy if retry_policy is not None \
+        else RetryPolicy.task_policy()
+
+    if policy.max_attempts <= 1:
+        run = fn
+    else:
+        def run(item):
+            return call_with_retry(lambda: fn(item), policy=policy,
+                                   label=f"{prefix} task",
+                                   classify=task_classify)
+
     size = pool_size()
     if len(items) <= 1 or size <= 1:
-        return [fn(i) for i in items]
-    from concurrent.futures import ThreadPoolExecutor
+        return [run(i) for i in items]
+
+    from concurrent.futures import ThreadPoolExecutor, as_completed
+    results: List[Any] = [None] * len(items)
+    first_err: Optional[BaseException] = None
     with ThreadPoolExecutor(max_workers=min(size, len(items)),
                             thread_name_prefix=prefix) as pool:
-        return list(pool.map(fn, items))
+        futures = {pool.submit(run, item): i
+                   for i, item in enumerate(items)}
+        for fut in as_completed(futures):
+            idx = futures[fut]
+            if fut.cancelled():
+                continue
+            exc = fut.exception()
+            if exc is None:
+                results[idx] = fut.result()
+            elif first_err is None:
+                first_err = exc
+                # stop handing out queued work; running tasks drain
+                for other in futures:
+                    other.cancel()
+            else:
+                # sibling failures after the ferried one: logged, not
+                # lost (the pool.map shape dropped these on the floor)
+                log.warning("%s[%d] failed after the first ferried "
+                            "error: %s: %s", prefix, idx,
+                            type(exc).__name__, exc)
+    if first_err is not None:
+        raise first_err
+    return results

@@ -33,14 +33,16 @@ import logging
 import os
 import socket
 import socketserver
-import struct
 import threading
 import traceback
 from typing import Any, Iterator, List, Optional, Tuple
 
 import pyarrow as pa
 
-from auron_tpu.shuffle_rss.server import recv_msg, send_msg
+from auron_tpu.config import conf
+from auron_tpu.faults import fault_point
+from auron_tpu.runtime.retry import RetryPolicy, call_with_retry
+from auron_tpu.shuffle_rss.server import read_timeout, recv_msg, send_msg
 
 log = logging.getLogger("auron_tpu.service")
 
@@ -81,9 +83,16 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         server: "EngineServer" = self.server.engine  # type: ignore[attr-defined]
         sock = self.request
+        # read timeout (auron.service.read.timeout.seconds): a half-dead
+        # client that stops sending mid-conversation is disconnected
+        # instead of pinning this handler thread forever
+        sock.settimeout(read_timeout())
         while True:
             try:
                 header, payload = recv_msg(sock, MAX_REQUEST_PAYLOAD)
+                # injected dispatch fault: drops the connection so the
+                # client's retry policy (reconnect + replay) is exercised
+                fault_point("service.dispatch")
             except (ConnectionError, OSError):
                 return
             except ValueError:
@@ -266,11 +275,31 @@ class RemoteExecutionError(RuntimeError):
 
 
 class EngineClient:
-    """Foreign-host driver: the AuronCallNativeWrapper counterpart."""
+    """Foreign-host driver: the AuronCallNativeWrapper counterpart.
 
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+    Control-plane calls (ping/put/delete) ride the shared retry policy
+    with transparent reconnect — they are idempotent (puts overwrite,
+    deletes tolerate absence, and the server's resource registry
+    outlives connections).  `execute_stream` replays only while no batch
+    has been yielded yet: a mid-stream failure cannot be spliced, so it
+    ferries."""
+
+    def __init__(self, host: str, port: int,
+                 timeout: Optional[float] = None):
+        self.host, self.port = host, port
+        if timeout is None:
+            t = float(conf.get("auron.net.timeout.seconds"))
+            timeout = t if t > 0 else None
+        self._timeout = timeout
         self._provided: dict = {}
+        self._sock: Optional[socket.socket] = None
+        self._ensure_sock()
+
+    def _ensure_sock(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self._timeout)
+        return self._sock
 
     def provide(self, key: str, source) -> None:
         """Register a resource served ON DEMAND through the in-band
@@ -280,10 +309,12 @@ class EngineClient:
         self._provided[str(key)] = source
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def __enter__(self) -> "EngineClient":
         return self
@@ -292,8 +323,21 @@ class EngineClient:
         self.close()
 
     def _call(self, header: dict, payload: bytes = b"") -> dict:
-        send_msg(self._sock, header, payload)
-        resp, _ = recv_msg(self._sock)
+        def _once():
+            fault_point("service.call")
+            s = self._ensure_sock()
+            try:
+                send_msg(s, header, payload)
+                resp, _ = recv_msg(s)
+            except (OSError, EOFError):
+                self.close()   # next attempt reconnects
+                raise
+            return resp
+
+        resp = call_with_retry(
+            _once, policy=RetryPolicy.from_conf(),
+            label=f"engine {header.get('cmd')} to "
+                  f"{self.host}:{self.port}")
         if not resp.get("ok"):
             raise RemoteExecutionError(resp.get("error", "request failed"))
         return resp
@@ -318,37 +362,72 @@ class EngineClient:
     def execute_stream(self, task: Any) -> Iterator[pa.RecordBatch]:
         """Ship a TaskDefinition (object or serialized bytes), stream the
         result batches; raises RemoteExecutionError on a ferried failure.
-        Metrics from the final frame land in self.last_metrics."""
+        Metrics from the final frame land in self.last_metrics.  A
+        transport failure BEFORE the first batch reconnects and replays
+        the execute under the shared retry policy; after a batch has
+        been yielded the stream cannot be spliced, so it ferries."""
+        import random
+        import time as _time
+
         from auron_tpu.ir import serde as ir_serde
         data = task if isinstance(task, (bytes, bytearray)) \
             else ir_serde.serialize(task)
-        send_msg(self._sock, {"cmd": "execute", "len": len(data)}, data)
         self.last_metrics: dict = {}
+        policy = RetryPolicy.from_conf()
+        rng = random.Random(policy.seed)
+        attempts = max(1, policy.max_attempts)
+        attempt = 1
         while True:
-            header, payload = recv_msg(self._sock)
-            t = header.get("type")
-            if t == "batch":
-                yield from _batches_from_ipc(payload)
-            elif t == "done":
-                self.last_metrics = header.get("metrics", {})
-                return
-            elif t == "need_resource":
-                self._serve_resource(header.get("key"))
-            elif t == "error":
-                raise RemoteExecutionError(header.get("message", ""),
-                                           header.get("traceback", ""))
-            else:
-                raise RemoteExecutionError(f"unexpected frame {header!r}")
+            yielded = False
+            try:
+                fault_point("service.call")
+                s = self._ensure_sock()
+                send_msg(s, {"cmd": "execute", "len": len(data)}, data)
+                while True:
+                    header, payload = recv_msg(s)
+                    t = header.get("type")
+                    if t == "batch":
+                        yielded = True
+                        yield from _batches_from_ipc(payload)
+                    elif t == "done":
+                        self.last_metrics = header.get("metrics", {})
+                        return
+                    elif t == "need_resource":
+                        self._serve_resource(header.get("key"))
+                    elif t == "error":
+                        raise RemoteExecutionError(
+                            header.get("message", ""),
+                            header.get("traceback", ""))
+                    else:
+                        raise RemoteExecutionError(
+                            f"unexpected frame {header!r}")
+            except (OSError, EOFError) as e:
+                self.close()
+                if yielded or attempt >= attempts:
+                    if attempt >= attempts:
+                        # budget spent here: outer sites must not
+                        # multiply the replays (mid-stream failures stay
+                        # replayable by a full task re-run)
+                        e.auron_retry_exhausted = True  # type: ignore[attr-defined]
+                    raise
+                delay = policy.backoff_s(attempt, rng)
+                log.warning("engine execute to %s:%s failed before first "
+                            "batch (attempt %d/%d): %s; retrying in "
+                            "%.3fs", self.host, self.port, attempt,
+                            attempts, e, delay)
+                attempt += 1
+                if delay > 0:
+                    _time.sleep(delay)
 
     def _serve_resource(self, key: str) -> None:
+        s = self._ensure_sock()
         src = self._provided.get(str(key))
         if src is None:
-            send_msg(self._sock, {"cmd": "resource_data",
-                                  "kind": "missing"})
+            send_msg(s, {"cmd": "resource_data", "kind": "missing"})
             return
         data = _batches_to_ipc(src)
-        send_msg(self._sock, {"cmd": "resource_data", "kind": "arrow_ipc",
-                              "len": len(data)}, data)
+        send_msg(s, {"cmd": "resource_data", "kind": "arrow_ipc",
+                     "len": len(data)}, data)
 
     def execute(self, task: Any) -> pa.Table:
         batches = list(self.execute_stream(task))
@@ -357,9 +436,10 @@ class EngineClient:
         return pa.Table.from_batches(batches)
 
     def shutdown_server(self) -> None:
-        send_msg(self._sock, {"cmd": "shutdown"})
+        s = self._ensure_sock()
+        send_msg(s, {"cmd": "shutdown"})
         try:
-            recv_msg(self._sock)
+            recv_msg(s)
         except (ConnectionError, OSError, ValueError):
             pass
         self.close()

@@ -14,8 +14,23 @@ import socket
 import threading
 from typing import List
 
+from auron_tpu.config import conf
+from auron_tpu.faults import fault_point
 from auron_tpu.ops.shuffle.writer import RssPartitionWriter
+from auron_tpu.runtime.retry import RetryPolicy, call_with_retry
 from auron_tpu.shuffle_rss.server import recv_msg, send_msg
+
+# fault-point names per wire command: both transport models share the
+# push/fetch vocabulary the chaos specs target
+_FAULT_POINTS = {"push": "shuffle.push", "push_block": "shuffle.push",
+                 "fetch": "shuffle.fetch", "fetch_blocks": "shuffle.fetch"}
+
+
+def net_timeout() -> float:
+    """auron.net.timeout.seconds as create_connection expects it
+    (None = blocking)."""
+    t = float(conf.get("auron.net.timeout.seconds"))
+    return t if t > 0 else None
 
 
 class _Conn:
@@ -29,7 +44,8 @@ class _Conn:
     def sock(self) -> socket.socket:
         s = getattr(self._local, "sock", None)
         if s is None:
-            s = socket.create_connection((self.host, self.port), timeout=30)
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=net_timeout())
             self._local.sock = s
         return s
 
@@ -42,20 +58,27 @@ class _Conn:
                 self._local.sock = None
 
     def request(self, header: dict, payload: bytes = b""):
-        # one reconnect attempt: a dead/desynced cached socket (server
-        # restart, mid-stream failure) must not poison the thread forever.
+        # shared retry policy (replacing the old hand-rolled single
+        # reconnect): a dead/desynced cached socket (server restart,
+        # mid-stream failure) must not poison the thread forever.
         # Retried pushes are safe because every push carries a dedupable
         # id (push_id / block_id) the server applies at most once.
-        for attempt in (0, 1):
+        cmd = header.get("cmd", "")
+
+        def _once():
+            fault_point(_FAULT_POINTS.get(cmd, f"shuffle.{cmd}"))
             try:
                 s = self.sock()
                 send_msg(s, header, payload)
-                resp, body = recv_msg(s)
-                break
-            except OSError:
+                return recv_msg(s)
+            except (OSError, EOFError, ValueError):
+                # the cached socket is desynced/dead either way
                 self._invalidate()
-                if attempt:
-                    raise
+                raise
+
+        resp, body = call_with_retry(
+            _once, policy=RetryPolicy.from_conf(),
+            label=f"shuffle {cmd} to {self.host}:{self.port}")
         if not resp.get("ok"):
             raise RuntimeError(f"shuffle server error: {resp}")
         return resp, body

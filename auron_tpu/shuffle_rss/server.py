@@ -98,19 +98,36 @@ class _State:
         return spilled + bytes(self.agg.get(key, b""))
 
 
+def read_timeout() -> Optional[float]:
+    """Server-side per-connection read timeout
+    (auron.service.read.timeout.seconds; None = blocking): a half-dead
+    client that stops sending mid-conversation must not pin a handler
+    thread forever."""
+    from auron_tpu.config import conf
+    t = float(conf.get("auron.service.read.timeout.seconds"))
+    return t if t > 0 else None
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         state: _State = self.server.state  # type: ignore[attr-defined]
+        self.request.settimeout(read_timeout())
         try:
             self._serve(state)
         except (ConnectionError, OSError, ValueError):
-            # bad frame / oversized header: drop the connection quietly
+            # bad frame / oversized header / idle past the read timeout:
+            # drop the connection quietly
             return
 
     def _serve(self, state: "_State") -> None:
+        from auron_tpu.faults import fault_point
         while True:
             header, payload = recv_msg(self.request,
                                    max_payload=MAX_PAYLOAD_LEN)
+            # injected server-side fault: the connection drops mid-
+            # conversation and the client's retry policy must recover
+            # (push dedup by push_id keeps retries exactly-once)
+            fault_point("shuffle.server")
             cmd = header["cmd"]
             if cmd == "ping":
                 send_msg(self.request, {"ok": True})

@@ -5,7 +5,13 @@ carrying ids; delivery is at-least-once, so readers fetch the partition's
 block list and deduplicate by block id.  The client exercises that
 semantic for real: block ids are `{map_id}-{seq}`, a configurable
 duplicate-push factor simulates retries, and `reduce_blocks` drops
-duplicate ids before handing frames to the engine."""
+duplicate ids before handing frames to the engine.
+
+Transport robustness is inherited from `_Conn` (celeborn.py): the shared
+retry policy (runtime/retry.py) replays lost pushes/fetches with capped
+backoff, the `shuffle.push`/`shuffle.fetch` fault points arm under
+`auron.faults.spec`, and block-id dedup keeps the at-least-once replays
+invisible to the reducer."""
 
 from __future__ import annotations
 

@@ -342,10 +342,15 @@ class KafkaWireClient:
     APIs the scan needs."""
 
     def __init__(self, bootstrap_servers: str, client_id: str = "auron-tpu",
-                 timeout: float = 30.0, verify_crc: bool = True):
+                 timeout: Optional[float] = None, verify_crc: bool = True):
         self.bootstrap = [self._parse_addr(a)
                           for a in bootstrap_servers.split(",") if a]
         self.client_id = client_id
+        if timeout is None:
+            # auron.net.timeout.seconds, the shared client knob
+            from auron_tpu.config import conf
+            t = float(conf.get("auron.net.timeout.seconds"))
+            timeout = t if t > 0 else None
         self.timeout = timeout
         self.verify_crc = verify_crc
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
@@ -372,31 +377,50 @@ class KafkaWireClient:
             self._conns[addr] = s
         return s
 
+    _FAULT_POINTS = {API_FETCH: "kafka.fetch",
+                     API_METADATA: "kafka.metadata",
+                     API_LIST_OFFSETS: "kafka.list_offsets"}
+
     def _call(self, addr: Tuple[str, int], api_key: int, api_version: int,
               body: bytes) -> _Reader:
-        with self._lock:
-            self._corr += 1
-            corr = self._corr
-        header = _Writer()
-        header.i16(api_key).i16(api_version).i32(corr)
-        header.string(self.client_id)
-        frame = bytes(header.b) + body
-        s = self._conn(addr)
-        try:
-            s.sendall(struct.pack(">i", len(frame)) + frame)
-            raw = self._recv_frame(s)
-        except (OSError, EOFError):
-            # one reconnect per call (broker restarts, idle timeouts)
-            self._conns.pop(addr, None)
+        from auron_tpu.faults import fault_point
+        from auron_tpu.runtime.retry import RetryPolicy, call_with_retry
+
+        def _once() -> bytes:
+            fault_point(self._FAULT_POINTS.get(api_key, "kafka.call"))
+            with self._lock:
+                self._corr += 1
+                corr = self._corr
+            header = _Writer()
+            header.i16(api_key).i16(api_version).i32(corr)
+            header.string(self.client_id)
+            frame = bytes(header.b) + body
             s = self._conn(addr)
-            s.sendall(struct.pack(">i", len(frame)) + frame)
-            raw = self._recv_frame(s)
-        r = _Reader(raw)
-        got_corr = r.i32()
-        if got_corr != corr:
-            raise RuntimeError(f"kafka correlation mismatch: "
-                               f"{got_corr} != {corr}")
-        return r
+            try:
+                s.sendall(struct.pack(">i", len(frame)) + frame)
+                raw = self._recv_frame(s)
+            except (OSError, EOFError):
+                # broker restarts, idle timeouts: drop the cached socket
+                # so the next attempt reconnects
+                self._conns.pop(addr, None)
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                raise
+            r = _Reader(raw)
+            got_corr = r.i32()
+            if got_corr != corr:
+                raise RuntimeError(f"kafka correlation mismatch: "
+                                   f"{got_corr} != {corr}")
+            return r
+
+        # shared retry policy (replacing the old hand-rolled single
+        # reconnect): every request allocates a fresh correlation id, so
+        # replays can never match a stale in-flight response
+        return call_with_retry(
+            _once, policy=RetryPolicy.from_conf(),
+            label=f"kafka api {api_key} to {addr[0]}:{addr[1]}")
 
     @staticmethod
     def _recv_frame(s: socket.socket) -> bytes:

@@ -151,3 +151,69 @@ def test_engine_service_subprocess():
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def test_engine_client_retries_injected_dispatch_fault():
+    """A server-side dispatch fault severs the connection; the client's
+    retry reconnects and replays the (idempotent) control call."""
+    from auron_tpu import faults
+    from auron_tpu.config import conf
+    table = make_table(50)
+    server = EngineServer().start()
+    try:
+        host, port = server.address
+        with EngineClient(host, port) as cli:
+            assert cli.ping()
+            spec = "service.dispatch:io:p=1,max=1,seed=1"
+            faults.reset(spec)
+            with conf.scoped({"auron.faults.spec": spec,
+                              "auron.retry.backoff.base.ms": 1.0}):
+                cli.put_arrow("T", table)   # dropped once, then replayed
+            assert faults.registry_for(spec).injected_total() == 1
+            out = cli.execute(P.TaskDefinition(plan=agg_plan(table)))
+            assert canon(out.to_pylist()) == expected(table)
+    finally:
+        server.stop()
+
+
+def test_engine_client_retries_injected_client_fault_on_execute():
+    """An injected client-side fault before the first result batch
+    replays the execute on a fresh connection."""
+    from auron_tpu import faults
+    from auron_tpu.config import conf
+    table = make_table(50)
+    server = EngineServer().start()
+    try:
+        host, port = server.address
+        with EngineClient(host, port) as cli:
+            cli.put_arrow("T", table)
+            spec = "service.call:io:p=1,max=1,seed=1"
+            faults.reset(spec)
+            with conf.scoped({"auron.faults.spec": spec,
+                              "auron.retry.backoff.base.ms": 1.0}):
+                out = cli.execute(P.TaskDefinition(plan=agg_plan(table)))
+            assert canon(out.to_pylist()) == expected(table)
+    finally:
+        server.stop()
+
+
+def test_engine_server_read_timeout_disconnects_idle_client():
+    """A half-dead client is disconnected after the read timeout instead
+    of pinning a handler thread; the client's next call transparently
+    reconnects."""
+    import time
+
+    from auron_tpu.config import conf
+    with conf.scoped({"auron.service.read.timeout.seconds": 0.2}):
+        server = EngineServer().start()
+        try:
+            host, port = server.address
+            with EngineClient(host, port) as cli:
+                assert cli.ping()
+                first_sock = cli._sock
+                time.sleep(0.6)       # idle past the server read timeout
+                with conf.scoped({"auron.retry.backoff.base.ms": 1.0}):
+                    assert cli.ping()  # reconnected under the hood
+                assert cli._sock is not first_sock
+        finally:
+            server.stop()

@@ -1,0 +1,382 @@
+"""Fault-injection registry + shared retry policy + task pool + spill
+lifetime: the unit tier of the robustness harness (chaos sweeps live in
+test_chaos.py)."""
+
+import gc
+import glob
+import logging
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from auron_tpu import faults
+from auron_tpu.config import conf
+from auron_tpu.runtime import retry
+from auron_tpu.runtime.task_pool import run_tasks
+
+
+# ---------------------------------------------------------------------------
+# spec grammar + registry
+# ---------------------------------------------------------------------------
+
+def test_spec_parse_full_grammar():
+    rules = faults.parse_spec(
+        "shuffle.push:io:p=0.2,seed=7;spill.write:io:p=0.1;"
+        "op.execute:device:p=1,max=2,after=3;svc:error")
+    assert [(r.pattern, r.kind) for r in rules] == [
+        ("shuffle.push", "io"), ("spill.write", "io"),
+        ("op.execute", "device"), ("svc", "error")]
+    assert rules[0].p == 0.2 and rules[0].seed == 7
+    assert rules[2].max_injections == 2 and rules[2].after == 3
+    assert rules[3].p == 1.0          # default probability
+    assert faults.parse_spec("") == []
+    assert faults.parse_spec(" ; ") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "noseparator",                    # no kind
+    "x:badkind",                      # unknown kind
+    "x:io:p=nope",                    # bad float
+    "x:io:p=1.5",                     # probability out of range
+    "x:io:frobnicate=1",              # unknown param
+    "x:io:p",                         # param without '='
+    ":io",                            # empty point
+    "x:io:p=1:extra",                 # too many sections
+])
+def test_spec_parse_rejects_malformed(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(bad)
+
+
+def test_kind_to_exception_mapping():
+    for kind, exc_type, retryable in [
+            ("io", faults.InjectedIOError, True),
+            ("timeout", faults.InjectedTimeout, True),
+            ("device", faults.InjectedDeviceFault, True),
+            ("error", faults.InjectedError, False)]:
+        reg = faults.FaultRegistry(f"pt:{kind}")
+        with pytest.raises(exc_type) as ei:
+            reg.check("pt")
+        assert ei.value.fault_point == "pt"
+        assert retry.is_retryable(ei.value) is retryable
+
+
+def test_registry_deterministic_and_resettable():
+    reg = faults.FaultRegistry("shuffle.*:io:p=0.5,seed=7")
+
+    def sequence(n=20):
+        out = []
+        for _ in range(n):
+            try:
+                reg.check("shuffle.push")
+                out.append(0)
+            except faults.InjectedIOError:
+                out.append(1)
+        return out
+
+    first = sequence()
+    assert 0 < sum(first) < 20          # p=0.5 actually mixes
+    reg.reset()
+    assert sequence() == first          # same seed -> same stream
+    # a different seed diverges
+    other = faults.FaultRegistry("shuffle.*:io:p=0.5,seed=8")
+    seq8 = []
+    for _ in range(20):
+        try:
+            other.check("shuffle.push")
+            seq8.append(0)
+        except faults.InjectedIOError:
+            seq8.append(1)
+    assert seq8 != first
+
+
+def test_registry_max_and_after_budgets():
+    reg = faults.FaultRegistry("pt:io:max=2")
+    fired = 0
+    for _ in range(10):
+        try:
+            reg.check("pt")
+        except faults.InjectedIOError:
+            fired += 1
+    assert fired == 2                   # blast radius capped
+    assert reg.counts()["pt"] == (10, 2)
+
+    reg = faults.FaultRegistry("pt:io:after=3,max=1")
+    outcomes = []
+    for _ in range(6):
+        try:
+            reg.check("pt")
+            outcomes.append(0)
+        except faults.InjectedIOError:
+            outcomes.append(1)
+    assert outcomes == [0, 0, 0, 1, 0, 0]   # skips 3, fires the 4th
+
+
+def test_fault_point_noop_by_default_and_scoped_arming():
+    assert conf.get("auron.faults.spec") == ""
+    faults.fault_point("shuffle.push")      # no-op, no raise
+    assert faults.active_registry() is None
+    spec = "shuffle.push:io:p=1,max=1,seed=1"
+    faults.reset(spec)
+    with conf.scoped({"auron.faults.spec": spec}):
+        with pytest.raises(faults.InjectedIOError):
+            faults.fault_point("shuffle.push")
+        faults.fault_point("shuffle.fetch")  # non-matching point: no-op
+        faults.fault_point("shuffle.push")   # max=1 spent: draws, no fire
+        assert faults.injection_counts()["shuffle.push"] == (2, 1)
+    faults.fault_point("shuffle.push")      # disarmed again
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+def test_classification_table():
+    retryable = [ConnectionError("x"), ConnectionResetError("x"),
+                 BrokenPipeError("x"), TimeoutError("x"),
+                 socket.timeout("x"), EOFError("x"), OSError("x"),
+                 faults.InjectedIOError("p", "x"),
+                 faults.InjectedTimeout("p", "x"),
+                 faults.InjectedDeviceFault("p", "x")]
+    deterministic = [FileNotFoundError("x"), PermissionError("x"),
+                     FileExistsError("x"), IsADirectoryError("x"),
+                     NotADirectoryError("x"), ValueError("x"),
+                     TypeError("x"), KeyError("x"), RuntimeError("x"),
+                     faults.InjectedError("p", "x")]
+    for e in retryable:
+        assert retry.is_retryable(e), e
+    for e in deterministic:
+        assert not retry.is_retryable(e), e
+    # an exhausted inner budget is never retried again by an outer site
+    e = ConnectionError("spent")
+    e.auron_retry_exhausted = True
+    assert not retry.is_retryable(e)
+
+
+def test_backoff_bounds_and_jitter_determinism():
+    import random
+    pol = retry.RetryPolicy(max_attempts=8, backoff_base_s=0.01,
+                            backoff_max_s=0.08, jitter=0.5, seed=42)
+    rng = random.Random(pol.seed)
+    delays = [pol.backoff_s(a, rng) for a in range(1, 9)]
+    for a, d in enumerate(delays, start=1):
+        base = min(0.01 * 2 ** (a - 1), 0.08)
+        assert base <= d <= base * 1.5      # within [base, base*(1+jitter)]
+    assert delays[-1] <= 0.08 * 1.5          # cap holds forever
+    # seeded determinism: same seed -> same schedule; different differs
+    again = [pol.backoff_s(a, random.Random(42)) for a in (1,)]
+    assert again[0] == pytest.approx(
+        pol.backoff_s(1, random.Random(42)))
+    assert pol.backoff_s(1, random.Random(42)) != \
+        pol.backoff_s(1, random.Random(43))
+
+
+def test_call_with_retry_recovers_then_exhausts():
+    sleeps = []
+    pol = retry.RetryPolicy(max_attempts=3, backoff_base_s=0.001,
+                            backoff_max_s=0.004, jitter=0.0, seed=0)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert retry.call_with_retry(flaky, pol, sleep=sleeps.append) == "ok"
+    assert calls[0] == 3 and sleeps == [0.001, 0.002]
+
+    def perma():
+        raise ConnectionError("dead peer")
+
+    with pytest.raises(ConnectionError) as ei:
+        retry.call_with_retry(perma, pol, sleep=lambda _s: None)
+    e = ei.value
+    # the ORIGINAL error surfaces, with the attempt history attached and
+    # the budget marked spent
+    assert str(e) == "dead peer"
+    assert len(e.auron_attempts) == 3
+    assert all("ConnectionError" in h[1] for h in e.auron_attempts)
+    assert e.auron_retry_exhausted is True
+    assert not retry.is_retryable(e)
+
+
+def test_call_with_retry_deterministic_errors_fail_fast():
+    calls = [0]
+
+    def det():
+        calls[0] += 1
+        raise ValueError("poison")
+
+    with pytest.raises(ValueError) as ei:
+        retry.call_with_retry(det, retry.RetryPolicy(max_attempts=5))
+    assert calls[0] == 1                       # no replay
+    assert len(ei.value.auron_attempts) == 1
+    assert not hasattr(ei.value, "auron_retry_exhausted")
+
+
+def test_retry_policy_from_conf_and_task_policy():
+    with conf.scoped({"auron.retry.max.attempts": 7,
+                      "auron.retry.backoff.base.ms": 5.0,
+                      "auron.retry.backoff.max.ms": 20.0,
+                      "auron.retry.jitter": 0.0,
+                      "auron.retry.seed": 9,
+                      "auron.task.retries": 2}):
+        pol = retry.RetryPolicy.from_conf()
+        assert pol.max_attempts == 7
+        assert pol.backoff_base_s == pytest.approx(0.005)
+        assert pol.backoff_max_s == pytest.approx(0.02)
+        assert pol.seed == 9
+        assert retry.RetryPolicy.task_policy().max_attempts == 3
+
+
+# ---------------------------------------------------------------------------
+# task pool: first-error ferrying, cancellation, order, per-task retry
+# ---------------------------------------------------------------------------
+
+def test_run_tasks_preserves_order_and_parallelism():
+    with conf.scoped({"auron.task.parallelism": 4}):
+        assert run_tasks(lambda x: x * x, range(10)) == \
+            [x * x for x in range(10)]
+    with conf.scoped({"auron.task.parallelism": 1}):
+        assert run_tasks(lambda x: -x, [3, 1, 2]) == [-3, -1, -2]
+
+
+def test_run_tasks_ferries_first_error_and_cancels(caplog):
+    started = []
+    release = threading.Event()
+
+    def task(i):
+        started.append(i)
+        if i == 0:
+            raise ValueError("first failure")
+        release.wait(timeout=5)
+        if i == 1:
+            raise RuntimeError("sibling failure")
+        return i
+
+    with conf.scoped({"auron.task.parallelism": 2}):
+        with caplog.at_level(logging.WARNING, "auron_tpu.runtime"):
+            t = threading.Timer(0.2, release.set)
+            t.start()
+            try:
+                with pytest.raises(ValueError, match="first failure"):
+                    run_tasks(task, range(8))
+            finally:
+                t.cancel()
+                release.set()
+    # not-yet-started tasks were cancelled: with 2 workers and the
+    # failure firing immediately, most of the 8 never ran
+    assert len(started) < 8
+    # the already-running sibling's failure was logged, not lost
+    assert any("sibling failure" in r.message for r in caplog.records)
+
+
+def test_run_tasks_retries_retryable_per_task():
+    attempts = {}
+
+    def flaky(i):
+        n = attempts.get(i, 0) + 1
+        attempts[i] = n
+        if i == 2 and n == 1:
+            raise ConnectionError("drop")
+        return i
+
+    with conf.scoped({"auron.task.parallelism": 2,
+                      "auron.task.retries": 1,
+                      "auron.retry.backoff.base.ms": 0.1}):
+        assert run_tasks(flaky, range(4)) == [0, 1, 2, 3]
+    assert attempts[2] == 2
+
+    # with the budget at 0 the same fault ferries
+    attempts.clear()
+    with conf.scoped({"auron.task.parallelism": 2,
+                      "auron.task.retries": 0}):
+        with pytest.raises(ConnectionError):
+            run_tasks(flaky, range(4))
+
+
+# ---------------------------------------------------------------------------
+# spill-file lifetime
+# ---------------------------------------------------------------------------
+
+def _spill_files(d):
+    return glob.glob(os.path.join(d, "auron_spill_*"))
+
+
+def test_file_spill_cleans_up_without_release(tmp_path):
+    import pyarrow as pa
+
+    from auron_tpu.memmgr.spill import FileSpill
+    d = str(tmp_path)
+    s = FileSpill(directory=d)
+    s.write_batches(iter(pa.table({"a": [1, 2, 3]}).to_batches()))
+    assert len(_spill_files(d)) == 1
+    del s                          # never released, never fully read
+    gc.collect()
+    assert _spill_files(d) == []   # finalizer reclaimed the temp file
+
+
+def test_file_spill_release_with_partial_read(tmp_path):
+    import pyarrow as pa
+
+    from auron_tpu.memmgr.spill import FileSpill
+    d = str(tmp_path)
+    s = FileSpill(directory=d)
+    table = pa.table({"a": list(range(100))})
+    s.write_batches(iter(table.to_batches(max_chunksize=10)))
+    it = s.read_batches()
+    first = next(it)               # iterator NOT exhausted
+    assert first.num_rows > 0
+    s.release()
+    assert _spill_files(d) == []   # deleted even mid-read
+    s.release()                    # idempotent
+
+
+def test_no_spill_files_survive_a_failed_task(tmp_path):
+    """Regression: a task that dies mid-spill leaves no temp files."""
+    import pyarrow as pa
+
+    from auron_tpu.memmgr.spill import SpillManager
+    d = str(tmp_path)
+
+    def doomed_task():
+        mgr = SpillManager("doomed")
+        with conf.scoped({"auron.spill.host.memory.first": False,
+                          "auron.spill.dir": d}):
+            sp = mgr.new_spill()
+            sp.write_batches(iter(pa.table({"a": [1]}).to_batches()))
+            raise RuntimeError("task died after spilling")
+
+    with pytest.raises(RuntimeError):
+        doomed_task()
+    gc.collect()                   # the manager + spill went out of scope
+    assert _spill_files(d) == []
+
+
+# ---------------------------------------------------------------------------
+# recovery stats
+# ---------------------------------------------------------------------------
+
+def test_retry_stats_and_fallback_counters():
+    retry.reset_stats()
+    pol = retry.RetryPolicy(max_attempts=2, backoff_base_s=0.0,
+                            backoff_max_s=0.0)
+    calls = [0]
+
+    def once_flaky():
+        calls[0] += 1
+        if calls[0] == 1:
+            raise ConnectionError("x")
+        return True
+
+    retry.call_with_retry(once_flaky, pol)
+    retry.add_fallback()
+    s = retry.stats_snapshot()
+    assert s["attempts"] == 2 and s["retries"] == 1
+    assert s["fallbacks"] == 1
+    retry.reset_stats()
+    assert retry.stats_snapshot()["attempts"] == 0

@@ -453,7 +453,10 @@ def test_force_shj_falls_back_to_smj_when_shj_disabled():
 
 def test_task_retry_model(monkeypatch):
     """A failed partition task re-executes (auron.task.retries): the
-    scheduler-level retry the reference inherits from Spark."""
+    scheduler-level retry the reference inherits from Spark.  Since the
+    shared retry policy (runtime/retry.py) landed, only RETRYABLE
+    failures (transient IO, device faults) replay — deterministic errors
+    ferry immediately regardless of the budget."""
     import auron_tpu.frontend.session as sess_mod
     from auron_tpu.config import conf
     from auron_tpu.frontend.session import AuronSession
@@ -461,11 +464,12 @@ def test_task_retry_model(monkeypatch):
 
     real = sess_mod.execute_plan
     fails = {"n": 1}
+    fault = {"type": ConnectionError}
 
     def flaky(plan, partition_id=0, num_partitions=1, resources=None):
         if fails["n"] > 0:
             fails["n"] -= 1
-            raise RuntimeError("injected transient task failure")
+            raise fault["type"]("injected transient task failure")
         return real(plan, partition_id=partition_id,
                     num_partitions=num_partitions, resources=resources)
 
@@ -478,6 +482,7 @@ def test_task_retry_model(monkeypatch):
     # pin the serial walk: this tests the per-partition task retry loop,
     # which the SPMD stage path (default since round 4) bypasses
     with conf.scoped({"auron.task.retries": 1,
+                      "auron.retry.backoff.base.ms": 1.0,
                       "auron.spmd.singleDevice.enable": False}):
         res = AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
     assert res.table.num_rows == 50
@@ -485,8 +490,16 @@ def test_task_retry_model(monkeypatch):
     fails["n"] = 1
     with conf.scoped({"auron.task.retries": 0,
                       "auron.spmd.singleDevice.enable": False}):
+        with pytest.raises(ConnectionError, match="injected"):
+            AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
+    # a DETERMINISTIC failure never replays, even with budget to spare
+    fails["n"] = 1
+    fault["type"] = RuntimeError
+    with conf.scoped({"auron.task.retries": 3,
+                      "auron.spmd.singleDevice.enable": False}):
         with pytest.raises(RuntimeError, match="injected"):
             AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
+    assert fails["n"] == 0          # raised once, not retried
 
 
 def test_insert_into_hive_table_conversion(tmp_path):

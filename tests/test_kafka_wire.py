@@ -112,3 +112,30 @@ def test_wire_consumer_assignment_offsets():
         assert ids == [4, 5, 6, 7]
     finally:
         broker.stop()
+
+
+def test_injected_fetch_fault_retries_with_fresh_correlation():
+    """Injected io faults on the fetch path ride the shared retry
+    policy; every replay allocates a fresh correlation id so responses
+    can never cross-match."""
+    from auron_tpu import faults
+    from auron_tpu.config import conf
+
+    broker = MockKafkaBroker({"tf": {0: rows_for(6, 0)}}).start()
+    try:
+        spec = ("kafka.fetch:io:p=1,max=1,seed=5;"
+                "kafka.metadata:io:p=1,max=1,seed=6")
+        faults.reset(spec)
+        with conf.scoped({"auron.faults.spec": spec,
+                          "auron.retry.backoff.base.ms": 1.0,
+                          "auron.retry.max.attempts": 6}):
+            cli = KafkaWireClient(broker.address)
+            leaders = cli.metadata("tf")
+            addr = leaders[0]
+            records, hwm, _next = cli.fetch(addr, "tf", 0, 0)
+            cli.close()
+        assert [r.value for r in records] == \
+            [value for _ts, _key, value in rows_for(6, 0)]
+        assert faults.registry_for(spec).injected_total() > 0
+    finally:
+        broker.stop()

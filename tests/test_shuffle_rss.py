@@ -164,3 +164,45 @@ def test_push_retry_is_idempotent(server):
                         b"payload")
     assert client.reduce_blocks("sz", 0) == [b"payload"]
     client.clear("sz")
+
+
+def test_injected_push_faults_recover_with_dedup(server):
+    """Client-side injected io faults on push/fetch ride the shared
+    retry policy; push_id dedup keeps the at-least-once replays
+    invisible (the chaos contract for the remote transports)."""
+    from auron_tpu import faults
+    host, port = server.address
+    client = CelebornShuffleClient(host, port)
+    spec = "shuffle.push:io:p=0.5,seed=5;shuffle.fetch:io:p=0.5,seed=9"
+    faults.reset(spec)
+    with config.conf.scoped({"auron.faults.spec": spec,
+                             "auron.retry.backoff.base.ms": 1.0,
+                             "auron.retry.backoff.max.ms": 5.0,
+                             "auron.retry.max.attempts": 6}):
+        w = client.rss_writer("sf1", 0)
+        for i in range(8):
+            w.write(i % 2, b"x%d" % i)
+        w.flush()
+        got = {pid: b"".join(client.reduce_blocks("sf1", pid))
+               for pid in (0, 1)}
+    assert got[0] == b"x0x2x4x6" and got[1] == b"x1x3x5x7"
+    assert faults.registry_for(spec).injected_total() > 0
+    client.clear("sf1")
+
+
+def test_injected_server_fault_drops_connection_client_recovers(server):
+    """A server-side injected fault severs the connection mid-request;
+    the client's retry reconnects and the push applies exactly once."""
+    from auron_tpu import faults
+    host, port = server.address
+    client = CelebornShuffleClient(host, port)
+    spec = "shuffle.server:io:p=1,max=1,seed=1"
+    faults.reset(spec)
+    with config.conf.scoped({"auron.faults.spec": spec,
+                             "auron.retry.backoff.base.ms": 1.0}):
+        w = client.rss_writer("sf2", 0)
+        w.write(0, b"survives")
+        w.flush()
+    assert client.reduce_blocks("sf2", 0) == [b"survives"]
+    assert faults.registry_for(spec).injected_total() == 1
+    client.clear("sf2")

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI chaos gate (CPU-only, deterministic): run a small TPC-DS sweep
+# twice — fault-free and under a seeded fault-injection spec
+# (auron.faults.spec) — and require
+#   1. bit-identical results,
+#   2. bounded attempts (<= 3x the fault-free task count: no retry
+#      storms),
+#   3. at least one fault actually injected (a renamed fault point must
+#      not hollow the gate out silently).
+#
+# The sweep is exactly reproducible: per-rule seeded Bernoulli streams
+# plus task parallelism pinned to 1 (auron_tpu/faults, it/stability.py).
+# Heavier sweeps (the full tier-1 subset at p=0.05) run under
+# `pytest -m slow` (tests/test_chaos.py) — this script is the fast
+# always-on gate, wired like tools/lint_plans.sh.
+#
+# Usage: tools/chaos_check.sh [extra python -m auron_tpu.it.stability args]
+#   e.g. tools/chaos_check.sh --queries q03,q42 --json /tmp/chaos.json
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SPEC=${AURON_CHAOS_SPEC:-"shuffle.push:io:p=0.2,seed=7;shuffle.fetch:io:p=0.2,seed=11;spill.write:io:p=0.2,seed=3"}
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m auron_tpu.it.stability --chaos "$SPEC" "$@"
+
+echo "chaos_check.sh: ok"
