@@ -26,9 +26,9 @@ from auron_tpu.analysis.diagnostics import (  # noqa: F401 - public API
     AnalysisResult, Diagnostic, DiagnosticSink, PlanVerificationError,
 )
 from auron_tpu.analysis.passes import (  # noqa: F401 - public API
-    ColumnResolutionPass, PartitioningContractsPass, Pass, PassManager,
-    SchemaCheckPass, SerdeRoundTripPass, TpuLintPass, analyze,
-    default_passes, verify,
+    ColumnResolutionPass, FusionContractPass, PartitioningContractsPass,
+    Pass, PassManager, SchemaCheckPass, SerdeRoundTripPass, TpuLintPass,
+    analyze, default_passes, verify,
 )
 from auron_tpu.analysis.schema_infer import SchemaContext  # noqa: F401
 

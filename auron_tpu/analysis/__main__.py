@@ -99,6 +99,11 @@ def regen_golden(out_dir: str, sf: float, data_dir: str) -> int:
     from auron_tpu.ir import plan as P
     from auron_tpu.it import queries
     from auron_tpu.it.datagen import generate
+    # goldens record what the runtime EXECUTES: the fusion rewrite
+    # (runtime/fusion.py) is applied to every section, so fragment
+    # boundaries are part of the committed plan shape and the verifier's
+    # FusionContractPass lints them on every CI run
+    from auron_tpu.runtime.fusion import fuse_plan
 
     cat = generate(data_dir, sf=sf)
     os.makedirs(out_dir, exist_ok=True)
@@ -121,18 +126,18 @@ def regen_golden(out_dir: str, sf: float, data_dir: str) -> int:
 
         for i, root in enumerate(native_roots(converted)):
             plans["root" if i == 0 and isinstance(converted, P.PlanNode)
-                  else f"native[{i}]"] = root.to_dict()
+                  else f"native[{i}]"] = fuse_plan(root).to_dict()
         for i, job in enumerate(ctx.exchanges.values()):
             if isinstance(job.child, P.PlanNode):
                 w = P.ShuffleWriter(child=job.child,
                                     partitioning=job.partitioning)
-                plans[f"exchange[{i}]"] = w.to_dict()
+                plans[f"exchange[{i}]"] = fuse_plan(w).to_dict()
         for i, job in enumerate(ctx.broadcasts.values()):
             if isinstance(job.child, P.PlanNode):
-                plans[f"broadcast[{i}]"] = job.child.to_dict()
+                plans[f"broadcast[{i}]"] = fuse_plan(job.child).to_dict()
         for i, src in enumerate(ctx.sources.values()):
             for j, root in enumerate(native_roots(src.node)):
-                plans[f"source[{i}][{j}]"] = root.to_dict()
+                plans[f"source[{i}][{j}]"] = fuse_plan(root).to_dict()
 
         doc = {"query": name, "sf": sf, "plans": plans}
         with open(os.path.join(out_dir, f"{name}.json"), "w") as fh:

@@ -239,8 +239,11 @@ class ColumnResolutionPass(Pass):
 _PARTITIONING_MODES = ("hash", "round_robin", "single", "range")
 
 # nodes a partial->final agg pairing stays visible through (single-child,
-# row-preserving-enough); an exchange reader ends visibility
-_AGG_TRANSPARENT = ("coalesce_batches", "debug", "sort", "limit")
+# row-preserving-enough); an exchange reader ends visibility.
+# fused_fragment is transparent via its `child`: bodies hold only
+# row-local operators (FusionContractPass enforces it), never an agg.
+_AGG_TRANSPARENT = ("coalesce_batches", "debug", "sort", "limit",
+                    "fused_fragment")
 
 
 class PartitioningContractsPass(Pass):
@@ -566,7 +569,35 @@ class TpuLintPass(Pass):
 
 
 # ---------------------------------------------------------------------------
-# 5. serde round-trip
+# 5. fusion contract (FusedFragment structural legality)
+# ---------------------------------------------------------------------------
+
+class FusionContractPass(Pass):
+    """Verifies plans that contain FusedFragment nodes: bodies must be
+    pure row-local chains over one FragmentInput, and schemas must agree
+    across the fused boundary (rules in analysis/fusion.py).  Plans
+    without fragments pay one kind check per node."""
+
+    id = "fusion"
+
+    def run(self, ctx: SchemaContext, sink: DiagnosticSink) -> None:
+        from auron_tpu.analysis import fusion as F
+        inside: set = set()
+        for node, path in ctx.nodes():
+            if node.kind != "fused_fragment" or id(node) in inside:
+                continue
+            # bodies of well-formed fragments are checked as a unit;
+            # remember their nodes so a nested fragment (already an
+            # error on the outer node) is not double-reported
+            body = getattr(node, "body", None)
+            if body is not None:
+                for sub in P.walk(body):
+                    inside.add(id(sub))
+            F.check_fragment(ctx, node, path, sink)
+
+
+# ---------------------------------------------------------------------------
+# 6. serde round-trip
 # ---------------------------------------------------------------------------
 
 def _canonical_json(node: Node) -> str:
@@ -618,8 +649,8 @@ class SerdeRoundTripPass(Pass):
 
 def default_passes() -> List[Pass]:
     return [SchemaCheckPass(), ColumnResolutionPass(),
-            PartitioningContractsPass(), TpuLintPass(),
-            SerdeRoundTripPass()]
+            PartitioningContractsPass(), FusionContractPass(),
+            TpuLintPass(), SerdeRoundTripPass()]
 
 
 class PassManager:

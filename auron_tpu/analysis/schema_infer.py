@@ -479,6 +479,17 @@ def _r_task_definition(ctx, node: P.TaskDefinition, path):
     return ctx._child(node, "plan")
 
 
+def _r_fused_fragment(ctx, node: P.FusedFragment, path):
+    # the fragment produces whatever its fused chain (body) produces;
+    # boundary agreement with the declared schema is the fusion pass's
+    # finding, not an inference failure
+    body = ctx._child(node, "body")
+    if body is not None:
+        return body
+    return getattr(node, "schema", None) \
+        if isinstance(getattr(node, "schema", None), Schema) else None
+
+
 _RULES: Dict[str, Callable[[SchemaContext, Node, str], Optional[Schema]]] = {
     "parquet_scan": _r_parquet_scan,
     "orc_scan": _r_orc_scan,
@@ -508,4 +519,6 @@ _RULES: Dict[str, Callable[[SchemaContext, Node, str], Optional[Schema]]] = {
     "parquet_sink": _r_sink,
     "orc_sink": _r_sink,
     "task_definition": _r_task_definition,
+    "fragment_input": _r_declared_leaf,
+    "fused_fragment": _r_fused_fragment,
 }

@@ -416,6 +416,22 @@ ORC_SCHEMA_CASE_SENSITIVE = conf.define(
     "(ORC_SCHEMA_CASE_SENSITIVE analogue, conf.rs:60; default matches "
     "Spark's case-insensitive resolution).",
 )
+FFI_INGEST_CACHE_MB = conf.define(
+    "auron.ffi.ingest.cache.mb", 1024,
+    "Device-byte budget (MB) for the FFI-reader ingest cache: decoded "
+    "device batches are cached per source RecordBatch identity (weak "
+    "keys, FIFO eviction), so repeated executes over one materialized "
+    "source re-upload nothing — the serial-path sibling of "
+    "auron.spmd.source.cache.mb.  0 disables.",
+)
+AGG_HASH_TABLE_MAX_BITS = conf.define(
+    "auron.agg.hash.table.max.bits", 16,
+    "Cap (log2) on the hash-grouping scatter table (ops/hash_group.py, "
+    "CPU backend): 2^16 slots stay cache-resident, ~3x faster scatter "
+    "than a 2*capacity table at megarow batches; groups beyond the slot "
+    "count cost extra (cheap) probe rounds.  0 disables the cap "
+    "(table = 2*batch capacity).",
+)
 AGG_GROUPING_STRATEGY = conf.define(
     "auron.agg.grouping.strategy", "auto",
     "Group-id assignment inside the agg reduce kernel: 'sort' (lexsort + "
@@ -539,6 +555,24 @@ SPILL_MIN_TRIGGER = conf.define(
     "Consumers below this size are never forced to spill "
     "(reference MIN_TRIGGER_SIZE, auron-memmgr/src/lib.rs:36).",
 )
+FUSE_ENABLE = conf.define(
+    "auron.fuse.enable", True,
+    "Pipeline-fragment fusion (runtime/fusion.py): lower maximal chains "
+    "of row-local operators (projection, filter, coalesce_batches, "
+    "limit, expand, rename_columns) into single FusedFragment operators "
+    "whose device stages compile to ONE jitted program per fragment.  "
+    "Off restores the unfused per-operator planner output (bisection "
+    "switch).",
+)
+COMPILE_CACHE_DIR = conf.define(
+    "auron.compile.cache.dir", "auto",
+    "Persistent XLA compilation-cache directory for device backends "
+    "(jax_compilation_cache_dir): 'auto' = <repo>/.jax_cache on non-CPU "
+    "backends only (CPU compiles thousands of tiny programs fast, and "
+    "this jaxlib's CPU AOT serialization is unsound — see "
+    "tests/conftest.py); 'off' or '' disables; any other value is an "
+    "explicit cache path applied on every backend.",
+)
 PLAN_VERIFY = conf.define(
     "auron.plan.verify", False,
     "Run the static plan verifier (auron_tpu.analysis: schema check, "
@@ -555,6 +589,35 @@ PROFILING_HTTP_ENABLE = conf.define(
     "(reference feature http-service, exec.rs:53-59): /debug/profile "
     "(jax trace zip), /debug/pyspy (folded stacks), /metrics, /status.",
 )
+
+
+_COMPILE_CACHE_APPLIED: List[str] = []
+
+
+def apply_compile_cache() -> Optional[str]:
+    """Session-level default for the persistent XLA compilation cache
+    (`auron.compile.cache.dir`): device compiles over a congested TPU
+    tunnel take minutes, and without the cache every fresh process
+    re-pays every compile.  Called by AuronSession and the IT CLI;
+    idempotent.  Returns the applied cache dir, or None when disabled
+    (CPU backend under 'auto', or 'off'/'')."""
+    raw = str(conf.get("auron.compile.cache.dir")).strip()
+    if raw in ("", "off", "none", "false"):
+        return None
+    import jax
+    if jax.default_backend() == "cpu" and raw == "auto":
+        return None
+    if raw == "auto":
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(repo, ".jax_cache")
+    else:
+        path = raw
+    if _COMPILE_CACHE_APPLIED and _COMPILE_CACHE_APPLIED[-1] == path:
+        return path
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    _COMPILE_CACHE_APPLIED.append(path)
+    return path
 
 
 def _main() -> None:

@@ -78,6 +78,11 @@ class SessionResult:
 class AuronSession:
     def __init__(self, foreign_engine: Optional[ForeignEngine] = None,
                  shuffle_service=None):
+        # session-level default: arm the persistent XLA compilation
+        # cache on device backends (auron.compile.cache.dir) so every
+        # front-end entry point — not just the IT CLI — pays device
+        # compiles once across processes
+        config.apply_compile_cache()
         self.foreign_engine = foreign_engine
         if shuffle_service is None:
             # conf-selected transport: in-process (default) or a remote

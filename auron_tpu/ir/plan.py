@@ -416,6 +416,44 @@ class OrcSink(PlanNode):
 
 
 # ---------------------------------------------------------------------------
+# pipeline-fragment fusion (runtime/fusion.py lowers row-local operator
+# chains into one FusedFragment; ops/fused.py executes it as a single
+# jitted device program)
+# ---------------------------------------------------------------------------
+
+@register
+@dataclass(frozen=True)
+class FragmentInput(PlanNode):
+    """Leaf placeholder inside a FusedFragment body marking where the
+    fragment's real input (`FusedFragment.child`) enters the fused chain.
+    Carries the chain's input schema so the body stays independently
+    analyzable/serializable."""
+    kind: ClassVar[str] = "fragment_input"
+    schema: Schema = None  # type: ignore[assignment]
+
+
+@register
+@dataclass(frozen=True)
+class FusedFragment(PlanNode):
+    """A maximal chain of row-local operators (projection, filter,
+    coalesce_batches, limit, expand, rename_columns) lowered into ONE
+    operator whose device stages compile to a single jitted program —
+    the operator-fusion-plans shape of SystemML (PAPERS.md 1801.00829) /
+    Flare's pipeline compilation (1703.08219) adapted to XLA.
+
+    `body` is the ORIGINAL operator chain, unchanged except that the
+    deepest child is replaced by a FragmentInput leaf; `child` is the
+    fragment's real input.  Keeping the original chain in the IR means
+    serde, schema inference and the verifier all reuse the per-operator
+    rules, and `auron.fuse.enable=false` (or unfuse_plan) restores the
+    exact unfused tree."""
+    kind: ClassVar[str] = "fused_fragment"
+    child: PlanNode = None  # type: ignore[assignment]
+    body: PlanNode = None  # type: ignore[assignment]
+    schema: Schema = None  # type: ignore[assignment]
+
+
+# ---------------------------------------------------------------------------
 # task definition
 # ---------------------------------------------------------------------------
 

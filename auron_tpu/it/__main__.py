@@ -47,16 +47,10 @@ def main() -> int:
         import jax
         os.environ["JAX_PLATFORMS"] = args.platform
         jax.config.update("jax_platforms", args.platform)
-        if args.platform not in ("cpu",):
-            # device compiles over the shared tunnel take minutes; the
-            # persistent cache makes per-query chip compiles pay once
-            # across runs (kept off for CPU: thousands of tiny programs)
-            import pathlib
-            cache = str(pathlib.Path(__file__).resolve().parents[2] /
-                        ".jax_cache")
-            jax.config.update("jax_compilation_cache_dir", cache)
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 2)
+        # session-level persistent-compile-cache default
+        # (auron.compile.cache.dir: device backends only under 'auto')
+        from auron_tpu.config import apply_compile_cache
+        apply_compile_cache()
 
     from auron_tpu.it.datagen import generate
     from auron_tpu.it.runner import QueryRunner

@@ -53,6 +53,38 @@ def _sort_key(row: Tuple) -> Tuple:
     return tuple(out)
 
 
+# foreign plan roots that pass row order through to their output; the
+# walk below descends them looking for a top-level sort
+_ORDER_PRESERVING_ROOTS = (
+    "ProjectExec", "GlobalLimitExec", "LocalLimitExec",
+    "CollectLimitExec", "ColumnarToRowExec", "InputAdapter",
+    "WholeStageCodegenExec",
+)
+_ORDERED_ROOTS = ("TakeOrderedAndProjectExec", "SortExec")
+
+
+def plan_is_ordered(plan) -> bool:
+    """Does this foreign plan promise a total output order — a top-level
+    ORDER BY (Sort/TakeOrderedAndProject root, possibly under
+    order-preserving projections/limits)?  Ordered queries must compare
+    row-by-row: the reference's QueryResultComparator checks emitted
+    order, and row-sorting both sides would let a wrong-order engine
+    result pass the differential gate (ADVICE r5)."""
+    cur = plan
+    while cur is not None:
+        op = getattr(cur, "op", None)
+        if op is None:
+            return False
+        if op in _ORDERED_ROOTS:
+            return True
+        children = getattr(cur, "children", ())
+        if op in _ORDER_PRESERVING_ROOTS and len(children) == 1:
+            cur = children[0]
+            continue
+        return False
+    return False
+
+
 def compare_tables(actual: pa.Table, expected: pa.Table,
                    rel_tol: float = 1e-4, abs_tol: float = 1e-6,
                    ordered: bool = False) -> Optional[str]:

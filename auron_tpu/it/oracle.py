@@ -155,11 +155,25 @@ class _Eval:
         a, am = self.eval(fe.children[0])
         return _col(-a, am)
 
+    def _const_pattern(self, fe_child, what: str):
+        """Evaluate a pattern operand and require a broadcast CONSTANT —
+        the _in guard applied to the string predicates: silently taking
+        element [0] of a per-row pattern column would produce wrong
+        oracle verdicts (ADVICE r5).  Returns the scalar, or None when
+        the pattern is null (predicate result is null for every row)."""
+        p, pm = self.eval(fe_child)
+        if not len(p) or pm[0]:
+            return None
+        if len(p) > 1 and (np.any(pm) or
+                           not all(v == p[0] for v in p.tolist())):
+            raise NotImplementedError(
+                f"oracle {what} with a non-constant pattern operand")
+        v = p[0]
+        return v.item() if hasattr(v, "item") else v
+
     def _startswith(self, fe):
         a, am = self.eval(fe.children[0])
-        p, pm = self.eval(fe.children[1])
-        pref = None if (len(p) and pm[0]) else (
-            p[0] if len(p) else None)
+        pref = self._const_pattern(fe.children[1], "StartsWith")
         if pref is None:
             return _col(np.zeros(len(a), bool), np.ones(len(a), bool))
         hit = np.array([isinstance(v, str) and v.startswith(str(pref))
@@ -168,10 +182,10 @@ class _Eval:
 
     def _endswith(self, fe):
         a, am = self.eval(fe.children[0])
-        p, pm = self.eval(fe.children[1])
-        if not len(p) or pm[0]:
+        suf = self._const_pattern(fe.children[1], "EndsWith")
+        if suf is None:
             return _col(np.zeros(len(a), bool), np.ones(len(a), bool))
-        suf = str(p[0])
+        suf = str(suf)
         hit = np.array([isinstance(v, str) and v.endswith(suf)
                         for v in a.tolist()], bool)
         return _col(hit, am)
@@ -179,8 +193,13 @@ class _Eval:
     def _like(self, fe):
         import re as _re
         a, am = self.eval(fe.children[0])
-        pat = fe.children[1].value if len(fe.children) > 1 else \
-            fe.attrs.get("pattern")
+        if len(fe.children) > 1:
+            if fe.children[1].name == "Literal":
+                pat = fe.children[1].value
+            else:
+                pat = self._const_pattern(fe.children[1], "Like")
+        else:
+            pat = fe.attrs.get("pattern")
         if pat is None:
             return _col(np.zeros(len(a), bool), np.ones(len(a), bool))
         rx = _re.compile(
@@ -194,10 +213,10 @@ class _Eval:
 
     def _contains(self, fe):
         a, am = self.eval(fe.children[0])
-        p, pm = self.eval(fe.children[1])
-        if not len(p) or pm[0]:
+        sub = self._const_pattern(fe.children[1], "Contains")
+        if sub is None:
             return _col(np.zeros(len(a), bool), np.ones(len(a), bool))
-        sub = str(p[0])
+        sub = str(sub)
         hit = np.array([isinstance(v, str) and sub in v
                         for v in a.tolist()], bool)
         return _col(hit, am)

@@ -83,12 +83,16 @@ def run_one(text: str, cat, warm: bool = True):
     oracle_s = time.perf_counter() - t0
     # float-tolerant comparison (QueryResultComparator analogue):
     # engine and oracle sum in different orders, so exact round(4)
-    # canonicalization false-positives on 1-ulp knife edges
+    # canonicalization false-positives on 1-ulp knife edges.  Top-level
+    # ORDER BY dumps compare in emitted row order (ADVICE r5).
     from auron_tpu.it import compare
-    diff = compare.compare_tables(res.table, oracle.table)
+    ordered = compare.plan_is_ordered(plan)
+    diff = compare.compare_tables(res.table, oracle.table,
+                                  ordered=ordered)
     return {
         "ok": diff is None and lint is None,
         "diff": diff,
+        "ordered": ordered,
         "lint": lint,
         "rows": res.table.num_rows,
         "oracle_rows": oracle.table.num_rows,

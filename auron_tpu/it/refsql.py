@@ -54,12 +54,17 @@ def run_one(sql: str, cat, warm: bool = True):
             foreign_engine=PyArrowEngine()).execute(plan)
         oracle_s = time.perf_counter() - t0
     # float-tolerant comparison (QueryResultComparator analogue); exact
-    # round(4) canonicalization false-positives on 1-ulp knife edges
+    # round(4) canonicalization false-positives on 1-ulp knife edges.
+    # Top-level ORDER BY queries compare in emitted row order (the
+    # reference checks order too; ADVICE r5).
     from auron_tpu.it import compare
-    diff = compare.compare_tables(res.table, oracle.table)
+    ordered = compare.plan_is_ordered(plan)
+    diff = compare.compare_tables(res.table, oracle.table,
+                                  ordered=ordered)
     return {
         "ok": diff is None,
         "diff": diff,
+        "ordered": ordered,
         "rows": res.table.num_rows,
         "oracle_rows": oracle.table.num_rows,
         "native_s": round(native_s, 4),

@@ -101,7 +101,12 @@ class QueryRunner:
             oracle = oracle_session.execute(plan)
             oracle_s = time.perf_counter() - t0
 
-        diff = compare.compare_tables(res.table, oracle.table)
+        # top-level ORDER BY queries compare in emitted row order — the
+        # reference's comparator checks order, and row-sorting both
+        # sides would let wrong-order results pass (ADVICE r5)
+        diff = compare.compare_tables(
+            res.table, oracle.table,
+            ordered=compare.plan_is_ordered(plan))
         # every converted plan is linted by the static analyzer (the
         # golden gate's always-on sibling: schema/resolution/partitioning/
         # serde errors fail the query even when results happen to match)

@@ -45,6 +45,16 @@ def _render(node, ctx, depth: int, lines: List[str]) -> None:
     if not isinstance(node, Node):
         lines.append(f"{pad}{type(node).__name__}")
         return
+    if isinstance(node, P.FusedFragment):
+        # explain surface: fragment boundaries print as one line naming
+        # the fused chain, output-first (runtime/fusion.py:explain)
+        from auron_tpu.analysis.fusion import body_chain
+        chain, err = body_chain(node.body)
+        ops = " <- ".join(c.kind for c in reversed(chain)) \
+            if err is None else f"<malformed: {err}>"
+        lines.append(f"{pad}FusedFragment[{ops}]")
+        _render(node.child, ctx, depth + 1, lines)
+        return
     label = type(node).__name__
     detail = ""
     if isinstance(node, P.Agg):

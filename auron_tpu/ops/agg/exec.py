@@ -102,6 +102,7 @@ class AggExec(Operator, MemConsumer):
                 start = len(flat_inputs)
                 flat_inputs.extend(a.children)
                 self._agg_arg_slices.append((start, len(flat_inputs)))
+            self._flat_agg_inputs = tuple(flat_inputs)
             self._val_eval = build_evaluator(tuple(flat_inputs), in_schema) \
                 if flat_inputs else None
 
@@ -120,6 +121,30 @@ class AggExec(Operator, MemConsumer):
         self._passthrough = False
         self._has_host_aggs = any(isinstance(s, HostAggSpec)
                                   for s in self.specs)
+        # partial-agg prologue fusion: a composable FusedFragmentExec
+        # child (single lane, no limit window) splices its device stages
+        # into this operator's update kernel, so filter -> project ->
+        # key-encode -> group-reduce is ONE jitted program per batch and
+        # the fragment's output compaction disappears (the update runs
+        # on the fragment's live MASK directly).
+        self._fused_prologue = None
+        if exec_mode != "final" and not self._has_host_aggs and \
+                not self.supports_partial_skipping and \
+                bool(conf.get("auron.fuse.enable")):
+            from auron_tpu.ops.fused import FusedFragmentExec
+            if isinstance(child, FusedFragmentExec) and child.composable():
+                from auron_tpu.exprs.compiler import (
+                    _tree_has_row_base, device_capable,
+                )
+                from auron_tpu.runtime.fusion import _static_host_cols
+                host = _static_host_cols(in_schema)
+                exprs = list(self.grouping) + list(
+                    getattr(self, "_flat_agg_inputs", ()))
+                if all(not _tree_has_row_base(x) and
+                       device_capable(x, in_schema, host)
+                       for x in exprs):
+                    self._fused_prologue = child
+                    child.metrics.set("fused_into_parent", 1)
 
     # ------------------------------------------------------------------
     # device path
@@ -167,11 +192,13 @@ class AggExec(Operator, MemConsumer):
         specs, orders = self.specs, self._key_orders()
         nk = len(self.grouping)
         from auron_tpu.ops.sort_keys import multipass_enabled
+        from auron_tpu.ops.hash_group import table_bits_key
         key = ("agg.group_reduce", self._spec_struct_key(), orders, merge,
                nk, strategy,
-               # trace-time config the sort body reads: a flag flip must
-               # not reuse a kernel traced under the old lexsort form
-               multipass_enabled())
+               # trace-time config the bodies read: a flag flip must not
+               # reuse a kernel traced under the old lexsort form / hash
+               # table size
+               multipass_enabled(), table_bits_key())
 
         def build():
             body = _group_reduce_body_hash if strategy == "hash" \
@@ -179,6 +206,45 @@ class AggExec(Operator, MemConsumer):
 
             def run(keys, value_cols, live):
                 return body(keys, value_cols, live, specs, orders, merge)
+            return run
+        return cached_jit(key, build)
+
+    def _fused_update_kernel(self, capacity: int, sig, strategy: str):
+        """The prologue-fusion kernel: fragment stages + key/value
+        evaluation + group-reduce in ONE cached jitted program (the
+        partial-agg key-encode/update prologue fusion)."""
+        from auron_tpu.exprs.compiler import EvalCtx, evaluate
+        from auron_tpu.ops.kernel_cache import cached_jit
+        from auron_tpu.ops.sort_keys import multipass_enabled
+        frag = self._fused_prologue
+        specs, orders = self.specs, self._key_orders()
+        grouping = self.grouping
+        flat_inputs = self._flat_agg_inputs
+        slices = self._agg_arg_slices
+        out_schema = frag.schema
+        from auron_tpu.ops.hash_group import table_bits_key
+        key = ("agg.fused_update", frag.struct_key(),
+               self._key_eval._structural_key(),
+               None if self._val_eval is None
+               else self._val_eval._structural_key(),
+               self._spec_struct_key(), orders, strategy,
+               multipass_enabled(), table_bits_key(), capacity, sig,
+               frag._conf_key())
+        apply = frag.body_applier()
+
+        def build():
+            body = _group_reduce_body_hash if strategy == "hash" \
+                else _group_reduce_body
+
+            def run(cols, num_rows, pid):
+                frag_cols, live = apply(cols, num_rows, pid)
+                ectx = EvalCtx(cols=frag_cols, schema=out_schema,
+                               num_rows=num_rows, capacity=capacity,
+                               partition_id=pid)
+                keys = [evaluate(g, ectx) for g in grouping]
+                flat = [evaluate(v, ectx) for v in flat_inputs]
+                vcols = [flat[s:e] for s, e in slices]
+                return body(keys, vcols, live, specs, orders, False)
             return run
         return cached_jit(key, build)
 
@@ -475,8 +541,51 @@ class AggExec(Operator, MemConsumer):
             vcols = [flat_vals[s:e] for s, e in self._agg_arg_slices]
         return keys, vcols
 
+    def _update_device_batch(self, b: Batch, ctx: TaskContext) -> None:
+        """The plain (unfused) device update for one batch."""
+        keys, vcols = self._eval_vcols(b, ctx, False)
+        out_cols, n_dev = self._reduce(keys, vcols, b.row_mask(), False)
+        self._stage(out_cols, n_dev, b.capacity,
+                    unsorted=self._grouping_strategy() == "hash")
+
+    def _execute_fused(self, ctx: TaskContext) -> Iterator[Batch]:
+        """Prologue-fusion input loop: pull the fragment's RAW input
+        batches and run fragment+update as one kernel per batch; batches
+        with host-resident columns escape through the fragment's slow
+        path into the normal update (same results, no fusion win)."""
+        import numpy as np_
+        frag = self._fused_prologue
+        strategy = self._grouping_strategy()
+        for b in frag.child_stream(ctx):
+            if b.num_rows_known and b.num_rows == 0:
+                continue
+            if b.has_host_columns() or self._has_host_aggs:
+                for fb in frag.process_batch(b, ctx):
+                    if fb.num_rows_known and fb.num_rows == 0:
+                        continue
+                    if self._has_host_aggs or fb.has_host_columns():
+                        if not self._has_host_aggs:
+                            self._has_host_aggs = True
+                            self._absorb_device_acc_into_host()
+                        self._input_rows += fb.num_rows
+                        self._host_update(fb, False)
+                        continue
+                    self._update_device_batch(fb, ctx)
+                continue
+            kernel = self._fused_update_kernel(b.capacity, frag._sig(b),
+                                               strategy)
+            out_cols, n_dev = kernel(b.columns, b.num_rows_dev(),
+                                     np_.int32(ctx.partition_id))
+            frag.metrics.add("fused_batches", 1)
+            self._stage(out_cols, n_dev, b.capacity,
+                        unsorted=strategy == "hash")
+        yield from self._emit_tail()
+
     def _execute_inner(self, ctx: TaskContext) -> Iterator[Batch]:
         merge_input = self.exec_mode == "final"
+        if self._fused_prologue is not None:
+            yield from self._execute_fused(ctx)
+            return
         stream = self.child_stream(ctx)   # single iterator: both loops share
         for b in stream:
             if b.num_rows_known and b.num_rows == 0:
@@ -525,6 +634,10 @@ class AggExec(Operator, MemConsumer):
                 yield self._group_reduce(keys, vcols, b.capacity,
                                          b.num_rows_dev(), merge=False)
             return
+        yield from self._emit_tail()
+
+    def _emit_tail(self) -> Iterator[Batch]:
+        """Shared end-of-stream emission (plain + prologue-fused loops)."""
         if self._has_host_aggs:
             yield from self._host_emit()
             return

@@ -1,0 +1,435 @@
+"""FusedFragmentExec: one operator executing a fused row-local chain.
+
+The planner lowers a FusedFragment plan node (runtime/fusion.py) to this
+operator.  Its device stages — projections, filter masks, expand
+fan-out, the limit window and the final live-row compaction — trace
+into ONE jitted jnp program per (fragment structure, capacity, column
+signature), cached in ops/kernel_cache.  A batch therefore crosses the
+Python operator boundary once per fragment: no intermediate Batch
+materialization, no per-operator CompiledExprs dispatch, one XLA
+program launch instead of one per operator.
+
+Filters accumulate a live MASK instead of compacting per operator;
+projections after a filter evaluate element-wise over dead lanes too
+(masked away by the single terminal compaction), which is value-
+identical for the rows that survive — the reason row-position
+expressions are a fusion barrier (runtime/fusion.py legality).
+
+Host-stateful stages stay on the host side of the same operator:
+`limit` keeps skip/remaining counters (its per-batch window is computed
+on device from the live mask's running rank), `coalesce_batches`
+becomes the fragment's output staging.  Batches whose columns went
+host-resident at runtime (oversize strings, nested types) take a
+per-batch slow path that applies the stages exactly like the unfused
+operators would — same results, no fusion speedup.
+
+AggExec composes further: for a single-lane, limit-free fragment it
+splices `body_applier()` into its own update kernel, so
+filter -> project -> key-encode -> group-reduce is ONE program and the
+fragment's compaction disappears entirely (the partial-agg prologue
+fusion of the SystemML/Flare fused-pipeline designs).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from auron_tpu.analysis.fusion import body_chain
+from auron_tpu.columnar.batch import Batch, concat_batches
+from auron_tpu.config import conf
+from auron_tpu.exprs.compiler import EvalCtx, build_evaluator, evaluate
+from auron_tpu.exprs.typing import infer_type
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.schema import Field, Schema
+from auron_tpu.ops.base import Operator, TaskContext, compact_indices
+
+Col = Any
+
+
+class _Stage:
+    """One parsed body operator: kind + exprs + schemas."""
+
+    __slots__ = ("kind", "node", "in_schema", "out_schema")
+
+    def __init__(self, kind: str, node: P.PlanNode, in_schema: Schema,
+                 out_schema: Schema):
+        self.kind = kind
+        self.node = node
+        self.in_schema = in_schema
+        self.out_schema = out_schema
+
+
+def _stage_schema(node: P.PlanNode, in_schema: Schema) -> Schema:
+    """Output schema of one body operator — the operator-constructor
+    rules (ops/basic.py), so fused and unfused trees agree exactly."""
+    k = node.kind
+    if k == "projection":
+        return Schema(tuple(Field(n, infer_type(x, in_schema))
+                            for n, x in zip(node.names, node.exprs)))
+    if k == "rename_columns":
+        return in_schema.rename(tuple(node.names))
+    if k == "expand":
+        if node.types:
+            return Schema(tuple(Field(n, t)
+                                for n, t in zip(node.names, node.types)))
+        return Schema(tuple(
+            Field(n, infer_type(x, in_schema))
+            for n, x in zip(node.names, node.projections[0])))
+    return in_schema   # filter / limit / coalesce_batches
+
+
+class FusedFragmentExec(Operator):
+    def __init__(self, child: Operator, node: P.FusedFragment):
+        chain, err = body_chain(node.body)
+        if err is not None or not chain:
+            raise RuntimeError(f"malformed fused fragment: {err}")
+        self.node = node
+        self._in_schema = child.schema
+        self.stages: List[_Stage] = []
+        schema = child.schema
+        for op in chain:
+            out = _stage_schema(op, schema)
+            self.stages.append(_Stage(op.kind, op, schema, out))
+            schema = out
+        super().__init__(schema, [child])
+        self._device_stages = [s for s in self.stages
+                               if s.kind in ("projection", "filter",
+                                             "expand")]
+        self._limits = [s for s in self.stages if s.kind == "limit"]
+        coalesces = [s for s in self.stages
+                     if s.kind == "coalesce_batches"]
+        self._coalesce_target = \
+            (coalesces[-1].node.target_batch_size or None) \
+            if coalesces else 0    # 0 = no coalesce; None = conf default
+        self._has_filter = any(s.kind == "filter" for s in self.stages)
+        self._has_expand = any(s.kind == "expand" for s in self.stages)
+        # one canonical structural key per fragment — the cached_jit key
+        # piece that replaces hashing the whole node tree per batch
+        import json
+        self._struct_key = json.dumps(node.body.to_dict(), sort_keys=True,
+                                      separators=(",", ":"))
+        self._slow_evals: Dict[int, Any] = {}
+        self._seen_sigs: set = set()
+        self.metrics.set("ops_fused", len(self.stages))
+
+    # ------------------------------------------------------------------
+    # device program
+    # ------------------------------------------------------------------
+
+    def _sig(self, b: Batch) -> Tuple:
+        from auron_tpu.columnar.batch import DeviceStringColumn
+        out = []
+        for c in b.columns:
+            if isinstance(c, DeviceStringColumn):
+                out.append(("s", c.width))
+            else:
+                out.append(("f", str(c.data.dtype), c.bits is not None))
+        return tuple(out)
+
+    def _conf_key(self) -> Tuple:
+        # every trace-time config read must appear in the kernel cache
+        # key (the CompiledExprs._get_jit rule)
+        return (bool(conf.get("auron.case.sensitive")),
+                str(conf.get("auron.sort.f64.exactbits")),
+                bool(conf.get("auron.string.ascii.case.enable")))
+
+    def _apply_device_stages(self, cols: List[Col], live, num_rows,
+                             pid) -> List[Tuple[List[Col], Any]]:
+        """Trace the fused stage chain over one lane; returns the list of
+        output lanes as (cols, mask) — >1 lane only under expand.
+        limit/coalesce/rename do no device work here (limit is injected
+        by the program builder; rename is schema-only)."""
+        capacity = int(live.shape[0])
+        lanes: List[Tuple[List[Col], Any]] = [(list(cols), live)]
+        for stage in self.stages:
+            if stage.kind in ("projection", "filter", "expand"):
+                lanes = _apply_one(stage, lanes, num_rows, pid, capacity)
+        return lanes
+
+    def _program(self, capacity: int, sig: Tuple):
+        from auron_tpu.ops.kernel_cache import cached_jit
+        key = ("fused.fragment", self._struct_key, capacity, sig,
+               self._conf_key())
+        stages = self.stages
+        compact = self._has_filter or bool(self._limits)
+
+        def build():
+            def run(cols, num_rows, pid, limit_skip, limit_remaining):
+                live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+                # device stages run in chain order; a limit stage splices
+                # its rank window into the mask at its chain position
+                lanes: List[Tuple[List[Col], Any]] = [(list(cols), live)]
+                limit_stats = []
+                li = 0
+                for stage in stages:
+                    if stage.kind == "limit":
+                        (lcols, mask), = lanes   # limit => single lane
+                        rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+                        skip = limit_skip[li]
+                        rem = limit_remaining[li]
+                        live_before = jnp.sum(mask.astype(jnp.int32))
+                        keep = jnp.logical_and(
+                            mask, jnp.logical_and(rank >= skip,
+                                                  rank < skip + rem))
+                        limit_stats.append(
+                            (live_before,
+                             jnp.sum(keep.astype(jnp.int32))))
+                        lanes = [(lcols, keep)]
+                        li += 1
+                        continue
+                    if stage.kind in ("projection", "filter", "expand"):
+                        lanes = _apply_one(stage, lanes, num_rows, pid,
+                                           capacity)
+                out = []
+                for lcols, mask in lanes:
+                    if compact:
+                        idx, count = compact_indices(mask, capacity)
+                        valid = jnp.arange(capacity,
+                                           dtype=jnp.int32) < count
+                        out.append(([c.gather(idx, valid)
+                                     for c in lcols], count))
+                    else:
+                        out.append((lcols, None))
+                return out, limit_stats
+            return run
+        return cached_jit(key, build)
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
+    def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        from auron_tpu.ops.kernel_cache import cache_info, host_sync
+        skip = [s.node.offset for s in self._limits]
+        remaining = [s.node.limit for s in self._limits]
+        staged: List[Batch] = []
+        staged_rows = 0
+        target = self._coalesce_target
+        if target is None:
+            from auron_tpu.ops.base import batch_size
+            target = batch_size()
+
+        def flush():
+            nonlocal staged, staged_rows
+            if staged:
+                out = staged[0] if len(staged) == 1 else \
+                    concat_batches(self.schema, staged)
+                staged, staged_rows = [], 0
+                return out
+            return None
+
+        for b in self.child_stream(ctx):
+            if b.num_rows_known and b.num_rows == 0:
+                continue
+            if self._limits and remaining and remaining[-1] <= 0:
+                break
+            outs = self._run_batch(b, ctx, skip, remaining, host_sync,
+                                   cache_info)
+            for ob in outs:
+                self.metrics.add("fused_batches", 1)
+                if not target:
+                    yield ob
+                    continue
+                # coalesce epilogue (CoalesceBatchesExec semantics)
+                if ob.num_rows == 0:
+                    continue
+                if ob.num_rows >= target and not staged:
+                    yield ob
+                    continue
+                staged.append(ob)
+                staged_rows += ob.num_rows
+                if staged_rows >= target:
+                    yield concat_batches(self.schema, staged)
+                    staged, staged_rows = [], 0
+        out = flush()
+        if out is not None:
+            yield out
+
+    def _run_batch(self, b: Batch, ctx: TaskContext, skip: List[int],
+                   remaining: List[int], host_sync,
+                   cache_info) -> List[Batch]:
+        if b.has_host_columns() or not self._device_stages:
+            return list(self._slow_batch(b, ctx, skip, remaining))
+        sig = self._sig(b)
+        info0 = cache_info()
+        fn = self._program(b.capacity, sig)
+        t0 = time.perf_counter_ns() if sig not in self._seen_sigs else 0
+        lanes, limit_stats = fn(
+            b.columns, b.num_rows_dev(), np.int32(ctx.partition_id),
+            [np.int32(s) for s in skip],
+            [np.int32(r) for r in remaining])
+        if t0:
+            self._seen_sigs.add(sig)
+            self.metrics.add("fragment_trace_ns",
+                             time.perf_counter_ns() - t0)
+        info1 = cache_info()
+        self.metrics.add("kernel_cache_hits",
+                         info1["hits"] - info0["hits"])
+        self.metrics.add("kernel_cache_misses",
+                         info1["misses"] - info0["misses"])
+        if self._limits:
+            # one sync: the limit counters advance on true host counts
+            stats = host_sync(limit_stats)
+            for i, (live_before, kept) in enumerate(stats):
+                consumed = min(int(live_before), skip[i])
+                skip[i] -= consumed
+                remaining[i] -= int(kept)
+        out = []
+        for lcols, count in lanes:
+            n = count if count is not None else b.num_rows_raw
+            out.append(Batch(self.schema, list(lcols), n, b.capacity))
+        return out
+
+    # ------------------------------------------------------------------
+    # slow path: per-stage application (host columns / no device stages)
+    # ------------------------------------------------------------------
+
+    def _slow_eval(self, i: int, exprs, schema: Schema):
+        ev = self._slow_evals.get(i)
+        if ev is None:
+            ev = build_evaluator(tuple(exprs), schema)
+            self._slow_evals[i] = ev
+        return ev
+
+    def _slow_batch(self, b: Batch, ctx: TaskContext, skip: List[int],
+                    remaining: List[int]) -> Iterator[Batch]:
+        """Apply the stages one by one — CompiledExprs per stage (its
+        host-island machinery handles host-resident columns), explicit
+        compaction per filter.  Shares the limit counters with the fast
+        path so mixed streams stay correct."""
+        from auron_tpu.ops.kernel_cache import host_sync
+        lanes = [b]
+        li = 0
+        for si, stage in enumerate(self.stages):
+            k = stage.kind
+            if k == "projection":
+                ev = self._slow_eval(si, stage.node.exprs,
+                                     stage.in_schema)
+                lanes = [lb.with_columns(
+                    stage.out_schema,
+                    ev(lb, partition_id=ctx.partition_id))
+                    for lb in lanes]
+            elif k == "rename_columns":
+                lanes = [lb.rename(stage.out_schema.names())
+                         for lb in lanes]
+            elif k == "filter":
+                ev = self._slow_eval(si, (_conjoin(
+                    stage.node.predicates),), stage.in_schema)
+                nxt = []
+                for lb in lanes:
+                    [m] = ev(lb, partition_id=ctx.partition_id)
+                    keep = jnp.logical_and(
+                        jnp.logical_and(m.validity,
+                                        m.data.astype(bool)),
+                        lb.row_mask())
+                    idx, count = compact_indices(keep, lb.capacity)
+                    n = int(host_sync(count))
+                    if n:
+                        nxt.append(lb.gather(idx, n))
+                lanes = nxt
+            elif k == "expand":
+                nxt = []
+                for lb in lanes:
+                    for pi, proj in enumerate(stage.node.projections):
+                        ev = self._slow_eval(
+                            si * 1000 + pi, proj, stage.in_schema)
+                        nxt.append(lb.with_columns(
+                            stage.out_schema,
+                            ev(lb, partition_id=ctx.partition_id)))
+                lanes = nxt
+            elif k == "limit":
+                nxt = []
+                for lb in lanes:
+                    if remaining[li] <= 0:
+                        continue
+                    n = lb.num_rows
+                    if skip[li] >= n:
+                        skip[li] -= n
+                        continue
+                    if skip[li] > 0:
+                        idx = jnp.arange(lb.capacity,
+                                         dtype=jnp.int32) + skip[li]
+                        lb = lb.gather(idx, n - skip[li])
+                        skip[li] = 0
+                    if lb.num_rows > remaining[li]:
+                        lb = lb.head(remaining[li])
+                    remaining[li] -= lb.num_rows
+                    nxt.append(lb)
+                lanes = nxt
+                li += 1
+            # coalesce_batches: handled by the shared epilogue staging
+        for lb in lanes:
+            yield lb if lb.schema is self.schema else \
+                Batch(self.schema, lb.columns, lb.num_rows_raw,
+                      lb.capacity)
+
+    # ------------------------------------------------------------------
+    # composition surface (AggExec prologue fusion)
+    # ------------------------------------------------------------------
+
+    def composable(self) -> bool:
+        """Can this fragment splice into a consumer's own kernel?  Needs
+        a single lane (no expand) and no host-stateful limit window;
+        coalesce stages are pure batching and drop out."""
+        return not self._has_expand and not self._limits
+
+    def struct_key(self) -> str:
+        return self._struct_key
+
+    def body_applier(self):
+        """(cols, num_rows, pid) -> (out_cols, live_mask), traceable
+        inside a consumer's jitted program."""
+        assert self.composable()
+
+        def apply(cols, num_rows, pid):
+            capacity = int(cols[0].capacity) if cols else 0
+            live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+            lanes = self._apply_device_stages(cols, live, num_rows, pid)
+            (out_cols, mask), = lanes
+            return list(out_cols), mask
+        return apply
+
+    def process_batch(self, b: Batch, ctx: TaskContext
+                      ) -> Iterator[Batch]:
+        """Slow-path escape hatch for composing consumers: run ONE input
+        batch through the stages (host-column batches in an otherwise
+        fused stream)."""
+        yield from self._slow_batch(b, ctx, [0] * len(self._limits),
+                                    [1 << 62] * len(self._limits))
+
+
+def _apply_one(stage, lanes, num_rows, pid, capacity):
+    """Apply one device stage to every lane (helper kept at module level
+    so the traced closure stays small)."""
+    nxt = []
+    for lcols, mask in lanes:
+        ctx = EvalCtx(cols=lcols, schema=stage.in_schema,
+                      num_rows=num_rows, capacity=capacity,
+                      partition_id=pid)
+        if stage.kind == "projection":
+            nxt.append(([evaluate(x, ctx) for x in stage.node.exprs],
+                        mask))
+        elif stage.kind == "filter":
+            m2 = mask
+            for pred in stage.node.predicates:
+                m = evaluate(pred, ctx)
+                m2 = jnp.logical_and(
+                    m2, jnp.logical_and(m.validity,
+                                        m.data.astype(bool)))
+            nxt.append((lcols, m2))
+        else:   # expand
+            for proj in stage.node.projections:
+                nxt.append(([evaluate(x, ctx) for x in proj], mask))
+    return nxt
+
+
+def _conjoin(predicates):
+    from auron_tpu.ir import expr as E
+    pred = predicates[0]
+    for p in predicates[1:]:
+        pred = E.ScAnd(left=pred, right=p)
+    return pred

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Hashable, Tuple
 import jax
 
 _CACHE: Dict[Hashable, Any] = {}
+_STATS = {"hits": 0, "misses": 0}
 
 
 def cached_jit(key: Hashable, builder: Callable[[], Callable],
@@ -34,8 +35,11 @@ def cached_jit(key: Hashable, builder: Callable[[], Callable],
     under the same key."""
     fn = _CACHE.get(key)
     if fn is None:
+        _STATS["misses"] += 1
         fn = jax.jit(builder(), static_argnames=static_argnames)
         _CACHE[key] = fn
+    else:
+        _STATS["hits"] += 1
     return fn
 
 
@@ -48,9 +52,14 @@ def host_sync(x: Any) -> Any:
 
 
 def cache_info() -> Dict[str, int]:
-    return {"kernels": len(_CACHE)}
+    """Cache observability: resident kernel count plus cumulative lookup
+    hits/misses (misses == builds).  The task runtime snapshots these
+    around each task and reports the deltas in the metric tree."""
+    return {"kernels": len(_CACHE), "hits": _STATS["hits"],
+            "misses": _STATS["misses"]}
 
 
 def clear() -> None:
     """Test hook: drop every cached kernel (forces re-tracing)."""
     _CACHE.clear()
+    _STATS["hits"] = _STATS["misses"] = 0

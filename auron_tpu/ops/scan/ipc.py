@@ -17,6 +17,7 @@ import pyarrow as pa
 
 from auron_tpu.columnar import serde as batch_serde
 from auron_tpu.columnar.batch import Batch
+from auron_tpu.config import conf
 from auron_tpu.ir.schema import Schema
 from auron_tpu.ops.base import Operator, TaskContext
 
@@ -83,7 +84,13 @@ class IpcWriterExec(Operator):
 class FFIReaderExec(Operator):
     """Imports batches produced by a front-end: the resource may be a
     pyarrow RecordBatchReader, an iterable of RecordBatches, a Table, or a
-    pair of Arrow C-Data capsules."""
+    pair of Arrow C-Data capsules.
+
+    Decoded device batches are cached per RecordBatch identity (weak,
+    byte-budgeted by `auron.ffi.ingest.cache.mb`): repeated executes over
+    one materialized source — warm runs, multi-partition broadcast
+    rebuilds — re-upload nothing, the serial-path sibling of the SPMD
+    source shard cache ("batches stay on device across the fragment")."""
 
     def __init__(self, schema: Schema, resource_id: str):
         super().__init__(schema, [])
@@ -91,8 +98,59 @@ class FFIReaderExec(Operator):
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
         src = ctx.resources.get(self.resource_id)
+        budget_mb = int(conf.get("auron.ffi.ingest.cache.mb"))
         for rb in _iter_arrow(src):
-            yield Batch.from_arrow(rb, schema=self.schema)
+            if budget_mb <= 0 or not isinstance(rb, pa.RecordBatch):
+                yield Batch.from_arrow(rb, schema=self.schema)
+                continue
+            hit = _ingest_cache_get(rb)
+            if hit is not None and hit.schema == self.schema:
+                self.metrics.add("ffi_ingest_cache_hits", 1)
+                yield hit
+                continue
+            b = Batch.from_arrow(rb, schema=self.schema)
+            _ingest_cache_put(rb, b, budget_mb)
+            yield b
+
+
+# RecordBatch identity (id()) -> (weakref to the source, decoded Batch,
+# size).  pyarrow RecordBatches are weakref-able but not hashable, so
+# the dict keys by id with the weakref guarding against id reuse; a FIFO
+# byte budget bounds what pinned sources can hold in device memory.
+import weakref as _weakref
+
+_INGEST_CACHE: dict = {}
+_INGEST_ORDER: list = []     # ids in insertion order
+_INGEST_BYTES = [0]
+
+
+def _ingest_cache_get(rb) -> "Batch | None":
+    entry = _INGEST_CACHE.get(id(rb))
+    if entry is None or entry[0]() is not rb:
+        return None
+    return entry[1]
+
+
+def _ingest_cache_put(rb, batch: Batch, budget_mb: int) -> None:
+    size = batch.mem_bytes()
+    if size > budget_mb << 20:
+        return
+    try:
+        ref = _weakref.ref(rb, lambda _r, _i=id(rb):
+                           _ingest_cache_drop(_i))
+    except TypeError:
+        return
+    _INGEST_CACHE[id(rb)] = (ref, batch, size)
+    _INGEST_ORDER.append(id(rb))
+    _INGEST_BYTES[0] += size
+    while _INGEST_BYTES[0] > budget_mb << 20 and _INGEST_ORDER:
+        _ingest_cache_drop(_INGEST_ORDER.pop(0))
+
+
+def _ingest_cache_drop(key: int) -> None:
+    entry = _INGEST_CACHE.pop(key, None)
+    if entry is not None:
+        _INGEST_BYTES[0] -= entry[2]
 
 
 def _iter_arrow(src) -> Iterator[pa.RecordBatch]:

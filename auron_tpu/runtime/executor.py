@@ -153,10 +153,13 @@ def execute_task(task: P.TaskDefinition,
     # re-executed on this serial per-partition path with a fresh operator
     # tree, bounded by the shared retry budget; the re-execution count
     # lands in the task's metric tree (num_retries)
+    from auron_tpu.ops.kernel_cache import cache_info
+    cache0 = cache_info()
     out = retry.call_with_retry(
         _attempt, policy=retry.RetryPolicy.from_conf(),
         label=f"task stage={task.stage_id} part={task.partition_id}",
         classify=_device_retryable, on_retry=_count_retry)
+    cache1 = cache_info()
     rt = rt_box[0]
     with _TASKS_LOCK:
         _TASKS_COMPLETED += 1
@@ -170,6 +173,12 @@ def execute_task(task: P.TaskDefinition,
     metrics = rt.finalize()
     if retries_box[0]:
         metrics.add("num_retries", retries_box[0])
+    # kernel-cache observability: how many jitted-kernel lookups this
+    # task hit vs built (a repeated query shape should be ~all hits —
+    # the zero-re-trace contract the fused fragments key on)
+    metrics.add("kernel_cache_hits", cache1["hits"] - cache0["hits"])
+    metrics.add("kernel_cache_misses",
+                cache1["misses"] - cache0["misses"])
     return ExecutionResult(out, metrics, schema=out_schema)
 
 

@@ -28,6 +28,12 @@ STANDARD_METRICS = (
     # recovery tier (runtime/retry.py + the SPMD degradation path):
     # device-fault task re-executions and SPMD->serial fallbacks
     "num_retries", "num_fallbacks",
+    # pipeline-fragment fusion (runtime/fusion.py + ops/fused.py):
+    # per-fragment fused-op count, batches through the fused program,
+    # first-trace wall time, and jitted-kernel cache hit/miss deltas
+    "ops_fused", "fused_batches", "fragment_trace_ns",
+    "kernel_cache_hits", "kernel_cache_misses",
+    "ffi_ingest_cache_hits",
 )
 
 

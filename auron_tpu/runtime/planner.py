@@ -60,6 +60,7 @@ class PhysicalPlanner:
             "hash_join": self._hash_join,
             "broadcast_join": self._broadcast_join,
             "broadcast_join_build_hash_map": self._bhm,
+            "fused_fragment": self._fused_fragment,
             "shuffle_writer": self._shuffle_writer,
             "rss_shuffle_writer": self._rss_shuffle_writer,
             "ipc_writer": self._ipc_writer,
@@ -78,11 +79,27 @@ class PhysicalPlanner:
         static analyzer over the TaskDefinition, then build the operator
         tree.  Mirrors the reference's convert-before-native contract —
         a malformed plan is rejected with node-path diagnostics instead
-        of crashing inside whatever kernel touches it first."""
+        of crashing inside whatever kernel touches it first.
+
+        With `auron.fuse.enable` (default on) the verified plan is then
+        rewritten by the fusion pass (runtime/fusion.py): maximal
+        row-local chains lower to FusedFragment nodes, cached per plan
+        identity so repeated tasks of one plan fuse once.  Declined
+        chains surface as analysis diagnostics on the cached
+        FusionReport (logged at DEBUG through the analysis logger)."""
         if conf.get("auron.plan.verify"):
             from auron_tpu.analysis import verify_task
             verify_task(task)
-        return self.create_plan(task.plan)
+        plan = task.plan
+        if conf.get("auron.fuse.enable"):
+            from auron_tpu.runtime.fusion import fuse_plan_cached
+            plan, report = fuse_plan_cached(plan)
+            if report.declined:
+                import logging
+                alog = logging.getLogger("auron_tpu.analysis")
+                for d in report.declined:
+                    alog.debug("fusion: %s", d)
+        return self.create_plan(plan)
 
     # -- leaves --------------------------------------------------------------
 
@@ -170,6 +187,10 @@ class PhysicalPlanner:
     def _coalesce_batches(self, n: P.CoalesceBatches) -> Operator:
         return CoalesceBatchesExec(self.create_plan(n.child),
                                    n.target_batch_size)
+
+    def _fused_fragment(self, n: P.FusedFragment) -> Operator:
+        from auron_tpu.ops.fused import FusedFragmentExec
+        return FusedFragmentExec(self.create_plan(n.child), n)
 
     def _debug(self, n: P.Debug) -> Operator:
         return DebugExec(self.create_plan(n.child), n.debug_id)

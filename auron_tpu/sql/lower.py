@@ -1007,6 +1007,14 @@ def _lct(a: DataType, b: DataType) -> DataType:
         # (14,2) or the (10,2) side truncates its fraction
         scale = max(a.scale, b.scale)
         ints = max(a.precision - a.scale, b.precision - b.scale)
+        if ints + scale > 38:
+            # Spark DecimalPrecision.adjustPrecisionScale: when the sum
+            # overflows the 38-digit cap, sacrifice SCALE (down to a
+            # floor of min(scale, 6)) to preserve integer digits —
+            # capping precision while keeping the full scale silently
+            # truncated integer digits, overflowing large-decimal joins
+            # where Spark would not (ADVICE r5)
+            scale = max(38 - ints, min(scale, 6))
         return DataType.decimal(min(ints + scale, 38), scale)
     if a.id == b.id:
         return a

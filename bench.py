@@ -150,7 +150,16 @@ def worker_engine() -> dict:
             b.num_rows
         times.append(time.perf_counter() - t0)
     med = sorted(times)[1]
+    # fusion observability: how many fragments/ops the rewriter fused in
+    # this plan (runtime/fusion.py), so the artifact records whether the
+    # serial number ran fused and at what coverage
+    from auron_tpu.config import conf as _conf
+    from auron_tpu.runtime.fusion import fuse_plan_cached
+    _, fusion_rep = fuse_plan_cached(plan)
     return {"seconds": med, "rows": N_ROWS, "groups": int(n_out),
+            "fuse_enabled": bool(_conf.get("auron.fuse.enable")),
+            "fused_fragments": fusion_rep.n_fragments,
+            "fused_ops": fusion_rep.ops_fused,
             "platform": jax.devices()[0].platform}
 
 
@@ -518,9 +527,12 @@ def _summarize(results: dict, baseline_rps: float,
     spmd = results.get("spmd")
     # the SPMD stage compiler IS the engine path (planner IR -> one
     # shard_map program); the serial per-batch walk is its fallback.
-    # Headline = the faster of the two engine modes.
-    if spmd is not None and (
-            engine is None or spmd["seconds"] < engine["seconds"]):
+    # Headline = the faster of the two engine modes by ROWS/S — the
+    # spmd working set is platform-scaled, so comparing raw seconds
+    # across different row counts picked the wrong mode (ADVICE r5).
+    def _rps(r):
+        return r["rows"] / r["seconds"]
+    if spmd is not None and (engine is None or _rps(spmd) > _rps(engine)):
         engine_any, mode_name = spmd, "spmd_stage"
     else:
         engine_any, mode_name = engine, "serial"
@@ -537,9 +549,21 @@ def _summarize(results: dict, baseline_rps: float,
         if spmd is not None:
             out["spmd_rows_per_sec"] = round(spmd["rows"] /
                                              spmd["seconds"])
+            # the SPMD working set is scaled per platform (engine stays
+            # at 4M): cross-platform rows/s comparisons must account for
+            # the shape difference (ADVICE r5)
+            out["spmd_working_set_rows"] = spmd["rows"]
+            if spmd["rows"] != N_ROWS:
+                out["working_set_note"] = (
+                    f"spmd measured at {spmd['rows']} rows vs engine "
+                    f"{N_ROWS}; rows/s are not shape-comparable across "
+                    f"platforms")
         if engine is not None:
             out["serial_rows_per_sec"] = round(engine["rows"] /
                                                engine["seconds"])
+            out["fuse_enabled"] = engine.get("fuse_enabled")
+            out["fused_fragments"] = engine.get("fused_fragments")
+            out["fused_ops"] = engine.get("fused_ops")
     elif fused is not None:
         rps = fused["rows"] / fused["seconds"]
         out = {
@@ -558,6 +582,13 @@ def _summarize(results: dict, baseline_rps: float,
         }
     if fused is not None:
         out["fused_rows_per_sec"] = round(fused["rows"] / fused["seconds"])
+        # the remaining host-orchestration gap: single-fused-kernel
+        # ceiling vs the serial engine (the figure later PRs track; the
+        # pipeline-fusion PR closes it from ~80x)
+        if engine is not None:
+            out["fusion_gap"] = round(
+                (fused["rows"] / fused["seconds"]) /
+                (engine["rows"] / engine["seconds"]), 1)
     if profile is not None:
         if profile.get("platform") == "tpu":
             out["kernel_profile_ms"] = profile.get("profile")
@@ -607,13 +638,19 @@ def main() -> None:
     # exist is an on-chip engine number — aux workers must never cost it.
     order = ("engine", "spmd", "fused", "profile")
     # single attempt: the probe IS the flake detector, a second try
-    # would just re-burn its timeout on a wedged tunnel
+    # would just re-burn its timeout on a wedged tunnel.  Fail FAST: a
+    # wedged backend hangs in init, and every healthy probe in five
+    # rounds of artifacts came back in <10s — burning 120s per round
+    # bought nothing (ADVICE r5).  AURON_BENCH_PROBE_TIMEOUT overrides.
+    probe_timeout = int(os.environ.get("AURON_BENCH_PROBE_TIMEOUT", "45"))
     probe, probe_failed = _attempt("probe", diagnostics,
-                                   first_timeout=120, max_attempts=1)
+                                   first_timeout=probe_timeout,
+                                   max_attempts=1)
     if probe is None and probe_failed:
         force_cpu = True
         diagnostics.append(
-            "probe: device path unusable -> CPU backend for all workers")
+            f"probe: device path unusable within {probe_timeout}s -> "
+            f"CPU backend for all workers")
     elif probe is not None and probe["seconds"] > 8:
         # alive but congested: scale worker leashes by the observed
         # dispatch latency

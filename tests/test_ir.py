@@ -329,6 +329,15 @@ def _rich_samples():
         "orc_sink": P.OrcSink(child=scan, output_dir="/tmp/o",
                               partition_cols=("a",), compression="zlib"),
         "task_definition": make_plan(),
+        # pipeline-fragment fusion (runtime/fusion.py) ---------------------
+        "fragment_input": P.FragmentInput(schema=make_schema()),
+        "fused_fragment": P.FusedFragment(
+            child=scan,
+            body=Projection(
+                child=Filter(child=P.FragmentInput(schema=make_schema()),
+                             predicates=(IsNull(child=c),)),
+                exprs=(c,), names=("a",)),
+            schema=Schema((Field("a", i64),))),
     }
 
 

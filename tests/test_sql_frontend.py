@@ -416,8 +416,12 @@ def test_decimal_widening_keeps_scale():
     from auron_tpu.sql.lower import _lct
     t = _lct(DataType.decimal(12, 0), DataType.decimal(10, 2))
     assert (t.precision, t.scale) == (14, 2)
+    # 36 integer digits + 10 scale overflows the 38-digit cap: Spark's
+    # DecimalPrecision.adjustPrecisionScale sacrifices SCALE (floor
+    # min(scale, 6)) to preserve the integer digits — (38,10) here would
+    # silently truncate 8 integer digits (ADVICE r5)
     t = _lct(DataType.decimal(38, 2), DataType.decimal(20, 10))
-    assert (t.precision, t.scale) == (38, 10)
+    assert (t.precision, t.scale) == (38, 6)
 
 
 def test_invalid_date_literal_raises_sql_error(catalog):
