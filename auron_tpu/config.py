@@ -206,11 +206,12 @@ FAULTS_SPEC = conf.define(
     "auron.faults.spec", "",
     "Fault-injection spec armed at named fault_point(...) sites "
     "(auron_tpu.faults): ';'-separated 'point:kind[:p=..,seed=..,"
-    "max=..,after=..]' rules, e.g. "
+    "max=..,after=..,ms=..]' rules, e.g. "
     "'shuffle.push:io:p=0.2,seed=7;spill.write:io:p=0.1'.  Kinds: "
     "io | timeout (retryable), device (retry then degrade to serial), "
-    "error (deterministic).  Empty (default) = every fault point is a "
-    "no-op check.",
+    "error (deterministic), latency (sleep ms milliseconds instead of "
+    "failing — visible as span durations in a traced run).  Empty "
+    "(default) = every fault point is a no-op check.",
 )
 NET_TIMEOUT_SECONDS = conf.define(
     "auron.net.timeout.seconds", 30.0,
@@ -582,6 +583,29 @@ PLAN_VERIFY = conf.define(
     "paths logged through runtime/task_logging.  Off by default in "
     "production (the front-end is trusted); forced on under the test "
     "suite (tests/conftest.py).",
+)
+TRACE_ENABLE = conf.define(
+    "auron.trace.enable", False,
+    "Record a query-lifecycle trace per AuronSession.execute "
+    "(runtime/tracing.py): spans for plan conversion, analyzer verify, "
+    "fusion rewrite, SPMD stage compile/launch, per-(stage, partition) "
+    "task execution, shuffle push/fetch, spill write/read, "
+    "engine-service calls and retry/fallback events, exported as "
+    "Chrome-trace JSON on SessionResult.trace (validate/summarize with "
+    "`python -m auron_tpu.trace`).  Off (default) costs one contextvar "
+    "read per span site on the hot path.",
+)
+TRACE_MAX_EVENTS = conf.define(
+    "auron.trace.max.events", 100_000,
+    "Per-query span buffer bound (runtime/tracing.py): events past the "
+    "cap are counted as dropped instead of growing the recorder without "
+    "bound (a megarow scan with per-operator events stays O(cap)).",
+)
+METRICS_HISTORY_MAX = conf.define(
+    "auron.metrics.history.max", 64,
+    "Completed-query history ring size (runtime/tracing.py): records "
+    "feed the profiling server's /queries page and the cross-query "
+    "aggregates on the Prometheus /metrics view.",
 )
 PROFILING_HTTP_ENABLE = conf.define(
     "auron.profiling.http.enable", False,

@@ -14,7 +14,8 @@ Spec grammar (';'-separated rules)::
     spec  := rule (';' rule)*
     rule  := point ':' kind [':' param (',' param)*]
     param := 'p=' float | 'seed=' int | 'max=' int | 'after=' int
-    kind  := 'io' | 'timeout' | 'device' | 'error'
+           | 'ms=' float
+    kind  := 'io' | 'timeout' | 'device' | 'error' | 'latency'
 
 e.g. ``shuffle.push:io:p=0.2,seed=7;spill.write:io:p=0.1``.
 
@@ -30,7 +31,11 @@ classifies: `io` -> InjectedIOError (retryable-IO, an OSError),
 `timeout` -> InjectedTimeout (a TimeoutError/OSError), `device` ->
 InjectedDeviceFault (the retry-then-degrade tier: re-execute, then fall
 back from SPMD to the serial path), `error` -> InjectedError (a
-deterministic RuntimeError — never retried).
+deterministic RuntimeError — never retried).  `latency` injects
+SLOWNESS, not failure: the fault point sleeps `ms` milliseconds
+(default 25) and returns normally — the kind that exercises read
+timeouts and shows up as stretched span durations in a traced chaos
+run (runtime/tracing.py), never as an error.
 
 With the spec unset (the default) `fault_point` is a no-op check: one
 config read, no registry, no RNG — cheap enough for per-push/per-task
@@ -42,6 +47,7 @@ from __future__ import annotations
 import fnmatch
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -50,8 +56,8 @@ from auron_tpu.config import conf
 __all__ = [
     "FaultSpecError", "InjectedFault", "InjectedIOError",
     "InjectedTimeout", "InjectedDeviceFault", "InjectedError",
-    "FaultRule", "FaultRegistry", "fault_point", "active_registry",
-    "injection_counts", "reset",
+    "InjectedLatency", "FaultRule", "FaultRegistry", "fault_point",
+    "active_registry", "injection_counts", "reset",
 ]
 
 
@@ -88,11 +94,22 @@ class InjectedError(InjectedFault, RuntimeError):
     would fail the same way every attempt)."""
 
 
+class InjectedLatency:
+    """NOT an exception: a latency injection is a sleep performed by the
+    registry (outside its lock), visible only as stretched wall time —
+    and as span durations when the query is traced."""
+
+    def __init__(self, point: str, seconds: float):
+        self.fault_point = point
+        self.seconds = seconds
+
+
 _KINDS = {
     "io": InjectedIOError,
     "timeout": InjectedTimeout,
     "device": InjectedDeviceFault,
     "error": InjectedError,
+    "latency": None,   # handled in FaultRule.draw (sleep, not raise)
 }
 
 
@@ -107,6 +124,7 @@ class FaultRule:
     seed: int = 0
     max_injections: Optional[int] = None
     after: int = 0
+    delay_ms: float = 25.0   # latency kind: injected sleep
     # counters (registry lock held)
     calls: int = 0
     injected: int = 0
@@ -142,6 +160,8 @@ class FaultRule:
         if self._rng.random() >= self.p:
             return None
         self.injected += 1
+        if self.kind == "latency":
+            return InjectedLatency(point, self.delay_ms / 1000.0)
         exc_type = _KINDS[self.kind]
         return exc_type(
             point,
@@ -184,6 +204,8 @@ def parse_spec(spec: str) -> List[FaultRule]:
                         kw["max_injections"] = int(val)
                     elif key == "after":
                         kw["after"] = int(val)
+                    elif key == "ms":
+                        kw["delay_ms"] = float(val)
                     else:
                         raise FaultSpecError(
                             f"unknown fault param {key!r} in rule {raw!r}")
@@ -208,13 +230,21 @@ class FaultRegistry:
         self._lock = threading.Lock()
 
     def check(self, point: str) -> None:
+        sleeps = []
         with self._lock:
             for rule in self.rules:
                 if not rule.matches(point):
                     continue
                 fault = rule.draw(point)
-                if fault is not None:
+                if isinstance(fault, InjectedLatency):
+                    # sleep OUTSIDE the lock: a latency rule must slow
+                    # the matching call site, not serialize every fault
+                    # point in the process behind it
+                    sleeps.append(fault.seconds)
+                elif fault is not None:
                     raise fault
+        for s in sleeps:
+            time.sleep(s)
 
     def counts(self) -> Dict[str, Tuple[int, int]]:
         """pattern -> (matching calls, injections fired)."""

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol
 
@@ -59,9 +60,31 @@ class SessionResult:
     # why the SPMD stage compiler degraded to the serial path, as a
     # rendered analysis diagnostic (None when spmd ran or no mesh)
     spmd_rejection: Optional[str] = None
+    # observability (runtime/tracing.py): the per-execute query id, the
+    # driver wall time, and — when `auron.trace.enable` was set — the
+    # TraceRecorder whose .to_chrome_trace()/.save() export the query's
+    # lifecycle spans
+    query_id: Optional[str] = None
+    wall_s: float = 0.0
+    trace: Optional[object] = None   # runtime.tracing.TraceRecorder
 
     def to_pylist(self) -> List[dict]:
         return self.table.to_pylist()
+
+    def explain_analyze(self, normalize: bool = False) -> str:
+        """Render the executed plan annotated with the merged per-task
+        metric trees (runtime/explain_analyze.py).  `normalize=True`
+        yields the run-stable canonical form goldens compare against."""
+        from auron_tpu.runtime.explain_analyze import (
+            explain_analyze as _ea, metric_totals,
+        )
+        totals = metric_totals(self.metrics)
+        return _ea(self.metrics, query_id=self.query_id,
+                   wall_s=self.wall_s, rows=self.table.num_rows,
+                   spmd=self.spmd,
+                   retries=totals.get("num_retries", 0),
+                   fallbacks=totals.get("num_fallbacks", 0),
+                   normalize=normalize)
 
     def all_native(self) -> bool:
         """True when no foreign section remains (the
@@ -101,15 +124,68 @@ class AuronSession:
         first offered to the SPMD stage compiler (parallel/stage.py): the
         WHOLE pipeline — exchanges included — compiles to one shard_map
         program riding ICI collectives; plans it cannot express fall back
-        to the serial per-partition path transparently."""
+        to the serial per-partition path transparently.
+
+        Every execute runs under a query scope (runtime/tracing.py): a
+        fresh query id correlates log prefixes, span attributes and the
+        query-history record; with `auron.trace.enable` set the full
+        lifecycle trace lands on `SessionResult.trace`."""
+        from auron_tpu.runtime import counters, tracing
+        from auron_tpu.runtime import executor as _executor
+        from auron_tpu.runtime import retry as _retry
+        from auron_tpu.runtime.explain_analyze import metric_totals
+
+        scope = tracing.trace_scope()
+        counters.bump("queries_started")
+        stats0 = _retry.stats_snapshot()
+        started0, _ = _executor.task_attempt_counts()
+        t0 = time.perf_counter()
+        wall_start = time.time()
+        res: Optional[SessionResult] = None
+        error: Optional[str] = None
+        try:
+            with scope, tracing.span("query", cat="query",
+                                     query_id=scope.query_id):
+                res = self._execute_impl(plan, mesh, mesh_axis)
+        except BaseException as e:
+            counters.bump("queries_failed")
+            error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            wall_s = time.perf_counter() - t0
+            stats1 = _retry.stats_snapshot()
+            started1, _ = _executor.task_attempt_counts()
+            tracing.record_query(tracing.QueryRecord(
+                query_id=scope.query_id, wall_s=wall_s,
+                rows=res.table.num_rows if res is not None else 0,
+                spmd=res.spmd if res is not None else False,
+                attempts=started1 - started0,
+                retries=stats1.get("retries", 0) - stats0.get("retries", 0),
+                fallbacks=stats1.get("fallbacks", 0)
+                - stats0.get("fallbacks", 0),
+                error=error, started_at=wall_start,
+                metric_totals=metric_totals(res.metrics)
+                if res is not None else {},
+                trace=scope.recorder.to_chrome_trace()
+                if scope.recorder is not None else None))
+        counters.bump("queries_completed")
+        res.query_id = scope.query_id
+        res.wall_s = wall_s
+        res.trace = scope.recorder
+        return res
+
+    def _execute_impl(self, plan: ForeignNode, mesh,
+                      mesh_axis: str) -> SessionResult:
+        from auron_tpu.runtime import tracing
         if not config.ENABLE.get():
             return SessionResult(table=self._run_foreign_only(plan))
         if mesh is None and config.SPMD_SINGLE_DEVICE.get():
             from auron_tpu.parallel.mesh import data_mesh
             mesh = data_mesh(1)
-        tags = strategy.apply(plan)
-        ctx = ConvertContext()
-        converted = converters.convert_recursively(plan, tags, ctx)
+        with tracing.span("plan.convert", cat="plan"):
+            tags = strategy.apply(plan)
+            ctx = ConvertContext()
+            converted = converters.convert_recursively(plan, tags, ctx)
         self._metrics = []
         self._spmd_rejection = None
         if mesh is not None and isinstance(converted, P.PlanNode):
@@ -122,8 +198,9 @@ class AuronSession:
                 precheck_plan(converted, ctx)
                 sources = {rid: self._source_table(src, ctx)
                            for rid, src in ctx.sources.items()}
-                table = execute_plan_spmd(converted, ctx, mesh, sources,
-                                          axis=mesh_axis)
+                with tracing.span("spmd.execute", cat="spmd"):
+                    table = execute_plan_spmd(converted, ctx, mesh,
+                                              sources, axis=mesh_axis)
                 res = SessionResult(table=table, converted=converted,
                                     tags=tags, ctx=ctx, spmd=True)
                 res._foreign_sections = sum(  # type: ignore[attr-defined]
@@ -281,12 +358,15 @@ class AuronSession:
         import io
 
         from auron_tpu.columnar import serde as batch_serde
-        table = self._run_converted(job.child, ctx)
-        sink = io.BytesIO()
-        for rb in table.to_batches():
-            if rb.num_rows:
-                batch_serde.write_one_batch(rb, sink)
-        resources.put(job.rid, sink.getvalue())
+        from auron_tpu.runtime import tracing
+        with tracing.span("broadcast.collect", cat="exchange",
+                          rid=job.rid):
+            table = self._run_converted(job.child, ctx)
+            sink = io.BytesIO()
+            for rb in table.to_batches():
+                if rb.num_rows:
+                    batch_serde.write_one_batch(rb, sink)
+            resources.put(job.rid, sink.getvalue())
 
     def _materialize_exchange(self, job: ShuffleJob, ctx: ConvertContext,
                               resources: ResourceRegistry) -> None:
@@ -318,11 +398,15 @@ class AuronSession:
         # record pushes in arrival order, so concurrent maps would make
         # reduce-side streams nondeterministic there
         from auron_tpu.ops.shuffle.writer import InProcessShuffleService
+        from auron_tpu.runtime import tracing
         from auron_tpu.runtime.task_pool import run_tasks
-        if isinstance(self.shuffle_service, InProcessShuffleService):
-            results = run_tasks(map_task, range(map_parts), "auron-map")
-        else:
-            results = [map_task(pid) for pid in range(map_parts)]
+        with tracing.span("exchange.map", cat="exchange", rid=job.rid,
+                          parts=map_parts):
+            if isinstance(self.shuffle_service, InProcessShuffleService):
+                results = run_tasks(map_task, range(map_parts),
+                                    "auron-map")
+            else:
+                results = [map_task(pid) for pid in range(map_parts)]
         for res in results:
             self._metrics.append(res.metrics)
         n_reduce = job.partitioning.num_partitions
@@ -336,13 +420,15 @@ class AuronSession:
             RetryPolicy, call_with_retry, task_classify,
         )
         policy = RetryPolicy.task_policy()
-        resources.put(job.rid, PartitionedBlocks(
-            [call_with_retry(
-                lambda rid=job.rid, p=pid:
-                    self.shuffle_service.reduce_blocks(rid, p),
-                policy=policy, classify=task_classify,
-                label=f"shuffle fetch {job.rid}:{pid}")
-             for pid in range(n_reduce)]))
+        with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
+                          parts=n_reduce):
+            resources.put(job.rid, PartitionedBlocks(
+                [call_with_retry(
+                    lambda rid=job.rid, p=pid:
+                        self.shuffle_service.reduce_blocks(rid, p),
+                    policy=policy, classify=task_classify,
+                    label=f"shuffle fetch {job.rid}:{pid}")
+                 for pid in range(n_reduce)]))
 
 
 class PartitionedBlocks:

@@ -31,6 +31,15 @@ def main() -> int:
                          "post-compile runs, recorded as native_warm_s) "
                          "must stay within FACTOR x the oracle; 0 = "
                          "cold-only (no warm runs)")
+    ap.add_argument("--analyze", action="store_true",
+                    help="print EXPLAIN ANALYZE per query (merged "
+                         "per-task metric trees rendered against the "
+                         "executed plan; serial path shows per-operator "
+                         "rows/batches/compute)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="record a query-lifecycle trace per query "
+                         "(auron.trace.enable) and write Chrome-trace "
+                         "JSON files <dir>/<query>.trace.json")
     ap.add_argument("--stage-compare", action="store_true",
                     help="instead of the differential run, execute every "
                          "query through BOTH the serial walk and the "
@@ -71,6 +80,11 @@ def main() -> int:
     if args.mesh:
         from auron_tpu.parallel.mesh import data_mesh
         runner.mesh = data_mesh(args.mesh)
+    runner.analyze = args.analyze
+    if args.trace_dir:
+        from auron_tpu.config import conf as _conf
+        _conf.set("auron.trace.enable", True)
+        runner.trace_dir = args.trace_dir
     names = args.queries.split(",") if args.queries else None
     # per-query incremental flush: a crash (an sf10 run OOMed at query
     # ~90 of 103 and lost 2h of results) or a driver kill still leaves

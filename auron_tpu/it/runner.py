@@ -39,6 +39,9 @@ class QueryResult:
     # analysis diagnostic (analysis/spmd.py) — uniform with the chaos
     # sweep's reporting
     spmd_rejection: Optional[str] = None
+    # EXPLAIN ANALYZE text (runtime/explain_analyze.py) when the runner
+    # was asked to collect it (QueryRunner.analyze)
+    analyze: Optional[str] = None
 
     def to_dict(self) -> Dict:
         return {"name": self.name, "ok": self.ok,
@@ -50,7 +53,8 @@ class QueryResult:
                 "native_warm_s": (None if self.native_warm_s is None
                                   else round(self.native_warm_s, 4)),
                 "perf_error": self.perf_error,
-                "spmd_rejection": self.spmd_rejection}
+                "spmd_rejection": self.spmd_rejection,
+                "analyze": self.analyze}
 
 
 @dataclass
@@ -80,6 +84,12 @@ class QueryRunner:
     # analogue of the reference's per-suite .exclude(...) lists) —
     # correctness still runs and must pass
     perf_waivers: Dict[str, str] = field(default_factory=dict)
+    # collect EXPLAIN ANALYZE text per query (the merged per-task metric
+    # trees rendered against the executed plan) onto QueryResult.analyze
+    analyze: bool = False
+    # when set (and tracing is enabled via auron.trace.enable), each
+    # query's Chrome-trace JSON is written to <trace_dir>/<name>.trace.json
+    trace_dir: Optional[str] = None
 
     def run(self, name: str) -> QueryResult:
         if name in self.exclusions:
@@ -94,6 +104,11 @@ class QueryRunner:
         t0 = time.perf_counter()
         res = session.execute(plan, mesh=self.mesh)
         native_s = time.perf_counter() - t0
+        if self.trace_dir is not None and res.trace is not None:
+            import os
+            os.makedirs(self.trace_dir, exist_ok=True)
+            res.trace.save(os.path.join(self.trace_dir,
+                                        f"{name}.trace.json"))
 
         with config.conf.scoped({"auron.enable": False}):
             oracle_session = AuronSession(foreign_engine=PyArrowEngine())
@@ -138,7 +153,8 @@ class QueryRunner:
             rows=res.table.num_rows, all_native=res.all_native(),
             error=diff, plan_error=plan_err, spmd=res.spmd,
             native_warm_s=warm_s, perf_error=perf_err,
-            spmd_rejection=res.spmd_rejection)
+            spmd_rejection=res.spmd_rejection,
+            analyze=res.explain_analyze() if self.analyze else None)
         self.results.append(qr)
         # drop compiled executables between queries: queries share few
         # kernels, and letting thousands of CPU executables accumulate in

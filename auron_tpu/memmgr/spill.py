@@ -20,6 +20,7 @@ import pyarrow as pa
 from auron_tpu.columnar import serde as batch_serde
 from auron_tpu.config import conf
 from auron_tpu.faults import fault_point
+from auron_tpu.runtime.tracing import span
 
 
 class Spill:
@@ -46,15 +47,17 @@ class HostMemSpill(Spill):
         self._codec = codec or conf.get("auron.spill.compression.codec")
 
     def write_batches(self, batches) -> int:
-        fault_point("spill.write")
-        sink = io.BytesIO()
-        for rb in batches:
-            batch_serde.write_one_batch(rb, sink, codec=self._codec)
-        self._buf = sink.getvalue()
-        return len(self._buf)
+        with span("spill.write", cat="spill", tier="host"):
+            fault_point("spill.write")
+            sink = io.BytesIO()
+            for rb in batches:
+                batch_serde.write_one_batch(rb, sink, codec=self._codec)
+            self._buf = sink.getvalue()
+            return len(self._buf)
 
     def read_batches(self):
-        fault_point("spill.read")
+        with span("spill.read", cat="spill", tier="host"):
+            fault_point("spill.read")
         yield from batch_serde.read_batches(io.BytesIO(self._buf))
 
     def release(self) -> None:
@@ -91,15 +94,17 @@ class FileSpill(Spill):
         self._cleanup = weakref.finalize(self, _unlink_quiet, self.path)
 
     def write_batches(self, batches) -> int:
-        fault_point("spill.write")
-        with open(self.path, "wb") as f:
-            for rb in batches:
-                self._size += batch_serde.write_one_batch(
-                    rb, f, codec=self._codec)
-        return self._size
+        with span("spill.write", cat="spill", tier="file"):
+            fault_point("spill.write")
+            with open(self.path, "wb") as f:
+                for rb in batches:
+                    self._size += batch_serde.write_one_batch(
+                        rb, f, codec=self._codec)
+            return self._size
 
     def read_batches(self):
-        fault_point("spill.read")
+        with span("spill.read", cat="spill", tier="file"):
+            fault_point("spill.read")
         with open(self.path, "rb") as f:
             yield from batch_serde.read_batches(f)
 

@@ -61,6 +61,7 @@ class Operator:
         import time
 
         from auron_tpu.faults import fault_point
+        from auron_tpu.runtime import tracing
         # one draw per operator instantiation (not per batch): a `device`
         # fault here kills the task, which the executor's degradation
         # tier re-runs (num_retries) — the dynamic proof that operator
@@ -74,6 +75,14 @@ class Operator:
             except StopIteration:
                 self.metrics.add("elapsed_compute_ns",
                                  time.perf_counter_ns() - t0)
+                # stream end: one instant event per operator (never one
+                # per batch — generator frames interleave, so a span
+                # here would mis-nest).  Deferred device counters are
+                # NOT settled for this: metrics must not force a sync.
+                tracing.event(
+                    "op.complete", cat="op", op=self.name,
+                    rows=self.metrics.values.get("output_rows", 0),
+                    batches=self.metrics.values.get("output_batches", 0))
                 return
             self.metrics.add("elapsed_compute_ns", time.perf_counter_ns() - t0)
             if not ctx.is_running:

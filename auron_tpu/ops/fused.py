@@ -258,10 +258,24 @@ class FusedFragmentExec(Operator):
         info0 = cache_info()
         fn = self._program(b.capacity, sig)
         t0 = time.perf_counter_ns() if sig not in self._seen_sigs else 0
-        lanes, limit_stats = fn(
-            b.columns, b.num_rows_dev(), np.int32(ctx.partition_id),
-            [np.int32(s) for s in skip],
-            [np.int32(r) for r in remaining])
+        if t0:
+            # first call for this (capacity, signature): jax traces +
+            # compiles the fused program here — the serial path's
+            # compile span (runtime/tracing.py; the SPMD sibling is
+            # spmd.compile in parallel/stage.py)
+            from auron_tpu.runtime.tracing import span
+            with span("fragment.compile", cat="compile",
+                      fragment=self.name, capacity=b.capacity):
+                lanes, limit_stats = fn(
+                    b.columns, b.num_rows_dev(),
+                    np.int32(ctx.partition_id),
+                    [np.int32(s) for s in skip],
+                    [np.int32(r) for r in remaining])
+        else:
+            lanes, limit_stats = fn(
+                b.columns, b.num_rows_dev(), np.int32(ctx.partition_id),
+                [np.int32(s) for s in skip],
+                [np.int32(r) for r in remaining])
         if t0:
             self._seen_sigs.add(sig)
             self.metrics.add("fragment_trace_ns",

@@ -38,6 +38,12 @@ def cached_jit(key: Hashable, builder: Callable[[], Callable],
         _STATS["misses"] += 1
         fn = jax.jit(builder(), static_argnames=static_argnames)
         _CACHE[key] = fn
+        # a miss is a new jitted program: mark the build point in the
+        # trace (jax compiles lazily at first call, so this is an
+        # instant, not a duration — fragment.compile/spmd.compile carry
+        # the durations)
+        from auron_tpu.runtime.tracing import event
+        event("kernel.build", cat="compile")
     else:
         _STATS["hits"] += 1
     return fn

@@ -147,6 +147,12 @@ def _ingest_cache_put(rb, batch: Batch, budget_mb: int) -> None:
         _ingest_cache_drop(_INGEST_ORDER.pop(0))
 
 
+def ingest_cache_info() -> dict:
+    """Observability hook for the profiling server's /metrics view:
+    resident decoded-source entries and device bytes held."""
+    return {"entries": len(_INGEST_CACHE), "bytes": _INGEST_BYTES[0]}
+
+
 def _ingest_cache_drop(key: int) -> None:
     entry = _INGEST_CACHE.pop(key, None)
     if entry is not None:

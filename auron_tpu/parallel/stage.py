@@ -1623,8 +1623,16 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     else:
         shard, schema_box = cached
 
-    (out_cols, out_live, counts, guards, retry_guards, shrink_guards,
-     join_guards) = shard(host_inputs)
+    # jax.jit is lazy: on a cache miss the first call below traces +
+    # compiles the whole stage program, so the span is the compile span
+    # (first launch included); cache hits record a pure launch span
+    from auron_tpu.runtime import tracing
+    with tracing.span(
+            "spmd.compile" if cached is None else "spmd.launch",
+            cat="spmd", devices=n_dev,
+            first_launch_included=cached is None):
+        (out_cols, out_live, counts, guards, retry_guards, shrink_guards,
+         join_guards) = shard(host_inputs)
     if cached is None:
         _PROGRAM_CACHE[cache_key] = (shard, schema_box)
     out_schema = schema_box[0]

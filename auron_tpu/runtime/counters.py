@@ -1,0 +1,61 @@
+"""Process-level runtime counters — the ONE place task/query lifecycle
+totals live.
+
+Before this module the executor kept private `_TASKS_*` globals that
+`profiling._metrics_snapshot` read via `getattr(..., 0)` — a rename away
+from silently reporting zero forever (and `tasks_completed` was indeed
+dangling for a while).  Now the executor, the task pool and the session
+increment named counters here, and both the Prometheus `/metrics` view
+and the `/queries` page read the same snapshot.  `runtime/retry.py`
+keeps its own attempt/retry/fallback stats (they pre-date this module
+and the chaos sweep diffs them); `snapshot()` folds both sources into
+one flat dict so consumers never chase two registries.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["bump", "get", "snapshot", "reset"]
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, int] = {
+    "tasks_started": 0,
+    "tasks_completed": 0,
+    "tasks_failed": 0,
+    "tasks_retried": 0,
+    "queries_started": 0,
+    "queries_completed": 0,
+    "queries_failed": 0,
+}
+
+
+def bump(key: str, delta: int = 1) -> int:
+    with _LOCK:
+        _COUNTERS[key] = _COUNTERS.get(key, 0) + int(delta)
+        return _COUNTERS[key]
+
+
+def get(key: str) -> int:
+    with _LOCK:
+        return _COUNTERS.get(key, 0)
+
+
+def snapshot() -> Dict[str, int]:
+    """Flat counter snapshot: lifecycle counters here + the retry-policy
+    stats (prefixed `retry_`) so `/metrics` exports one namespace."""
+    from auron_tpu.runtime import retry
+    with _LOCK:
+        out = dict(_COUNTERS)
+    for k, v in retry.stats_snapshot().items():
+        out[f"retry_{k}"] = v
+    return out
+
+
+def reset() -> None:
+    """Test hook: zero the lifecycle counters (retry stats have their
+    own reset)."""
+    with _LOCK:
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0

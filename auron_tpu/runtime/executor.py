@@ -10,7 +10,6 @@ XLA's async dispatch already overlaps device compute with host work.
 from __future__ import annotations
 
 import logging
-import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterator, List, Optional
 
@@ -92,16 +91,13 @@ def execute_plan(plan: P.PlanNode, partition_id: int = 0,
     return execute_task(td, resources)
 
 
-_TASKS_COMPLETED = 0
-_TASKS_STARTED = 0
-_TASKS_LOCK = threading.Lock()
-
-
 def task_attempt_counts() -> tuple:
     """(started, completed) task attempts this process — the chaos sweep
-    bounds started_with_faults <= factor * started_fault_free."""
-    with _TASKS_LOCK:
-        return _TASKS_STARTED, _TASKS_COMPLETED
+    bounds started_with_faults <= factor * started_fault_free.  Counters
+    live in runtime/counters.py (the one registry /metrics and /queries
+    read too)."""
+    from auron_tpu.runtime import counters
+    return counters.get("tasks_started"), counters.get("tasks_completed")
 
 
 def _device_retryable(exc: BaseException) -> bool:
@@ -121,8 +117,9 @@ def _device_retryable(exc: BaseException) -> bool:
 def execute_task(task: P.TaskDefinition,
                  resources: Optional[ResourceRegistry] = None
                  ) -> ExecutionResult:
-    global _TASKS_COMPLETED, _TASKS_STARTED
-    from auron_tpu.runtime import profiling, retry, task_logging
+    from auron_tpu.runtime import (
+        counters, profiling, retry, task_logging, tracing,
+    )
 
     profiling.maybe_start_from_conf()   # lazy start (exec.rs:53-59)
     task_logging.install()              # idempotent (init_logging analogue)
@@ -130,9 +127,7 @@ def execute_task(task: P.TaskDefinition,
     retries_box = [0]
 
     def _attempt():
-        global _TASKS_STARTED
-        with _TASKS_LOCK:
-            _TASKS_STARTED += 1
+        counters.bump("tasks_started")
         with task_logging.task_scope(task.stage_id, task.partition_id):
             # runtime construction sits inside the task scope so
             # plan-verifier diagnostics (create_verified_plan) and
@@ -147,6 +142,7 @@ def execute_task(task: P.TaskDefinition,
 
     def _count_retry(_attempt_no, _exc):
         retries_box[0] += 1
+        counters.bump("tasks_retried")
 
     # device-tier recovery: a task dying with an injected device fault
     # (or a retryable SPMD guard trip that escaped the stage driver) is
@@ -155,14 +151,21 @@ def execute_task(task: P.TaskDefinition,
     # lands in the task's metric tree (num_retries)
     from auron_tpu.ops.kernel_cache import cache_info
     cache0 = cache_info()
-    out = retry.call_with_retry(
-        _attempt, policy=retry.RetryPolicy.from_conf(),
-        label=f"task stage={task.stage_id} part={task.partition_id}",
-        classify=_device_retryable, on_retry=_count_retry)
+    try:
+        with tracing.span("task.execute", cat="task",
+                          stage=task.stage_id,
+                          partition=task.partition_id):
+            out = retry.call_with_retry(
+                _attempt, policy=retry.RetryPolicy.from_conf(),
+                label=f"task stage={task.stage_id} "
+                      f"part={task.partition_id}",
+                classify=_device_retryable, on_retry=_count_retry)
+    except BaseException:
+        counters.bump("tasks_failed")
+        raise
     cache1 = cache_info()
     rt = rt_box[0]
-    with _TASKS_LOCK:
-        _TASKS_COMPLETED += 1
+    counters.bump("tasks_completed")
     out_schema = None
     try:
         from auron_tpu.ir.schema import to_arrow_schema

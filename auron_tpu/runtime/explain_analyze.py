@@ -1,0 +1,172 @@
+"""EXPLAIN ANALYZE: merge per-task metric trees, render the executed
+plan annotated per operator.
+
+The reference mirrors per-operator `MetricNode` trees back to the JVM
+where the Spark UI renders them against the SQL plan; our trees existed
+per task but were never rendered against anything.  Here the session's
+collected trees (one per (stage, partition) task, plus exchange map
+tasks) are merged BY STRUCTURE — metric trees mirror the operator tree,
+so tasks of one plan share a shape — and rendered indented with the
+rows/batches/compute/spill/cache metrics inline, `FusedFragmentExec`
+boundaries included (the fused chain is the node name the planner
+built).
+
+Two render modes:
+
+- human (default): every metric, durations in ms — the debugging view.
+- canonical (`normalize=True`): volatile values (wall-clock ns, cache
+  hit/miss deltas, codec-dependent spill bytes) are DROPPED so the text
+  is stable run-to-run — the committed-golden form
+  (tests/golden_plans/*.analyze.txt, regen via AURON_REGEN_GOLDEN=1).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from auron_tpu.runtime.metrics import MetricNode
+
+__all__ = ["merge_metric_trees", "metric_totals", "render_analyzed",
+           "explain_analyze"]
+
+# values that vary run-to-run (timings, process-global cache state,
+# codec-dependent byte counts): excluded from the canonical form
+_VOLATILE_KEYS = frozenset({
+    "kernel_cache_hits", "kernel_cache_misses", "ffi_ingest_cache_hits",
+    "mem_spill_size", "disk_spill_size",
+})
+
+# render order: row/batch flow first, then time, then the rest sorted
+_KEY_ORDER = ("output_rows", "output_batches", "input_rows",
+              "input_batches", "elapsed_compute_ns")
+
+
+def _volatile(key: str) -> bool:
+    return key.endswith("_ns") or key in _VOLATILE_KEYS
+
+
+def _signature(node: MetricNode) -> Tuple:
+    return (node.name, tuple(_signature(c) for c in node.children))
+
+
+def _merge_into(dst: MetricNode, src: MetricNode) -> None:
+    src._settle()
+    for k, v in src.values.items():
+        dst.add(k, v)
+    for dc, sc in zip(dst.children, src.children):
+        _merge_into(dc, sc)
+
+
+def _clone_shape(node: MetricNode) -> MetricNode:
+    out = MetricNode(node.name)
+    out.children = [_clone_shape(c) for c in node.children]
+    return out
+
+
+def merge_metric_trees(trees: List[MetricNode]
+                       ) -> List[Tuple[MetricNode, int]]:
+    """Group trees by structural signature (same plan => same shape) and
+    sum each group element-wise.  Returns [(merged tree, task count)]
+    in first-seen order: the root plan's group first, then exchange map
+    sides, then any marker nodes (SpmdFallback)."""
+    groups: Dict[Tuple, Tuple[MetricNode, int]] = {}
+    order: List[Tuple] = []
+    for t in trees:
+        sig = _signature(t)
+        if sig not in groups:
+            groups[sig] = (_clone_shape(t), 0)
+            order.append(sig)
+        merged, n = groups[sig]
+        _merge_into(merged, t)
+        groups[sig] = (merged, n + 1)
+    return [groups[sig] for sig in order]
+
+
+def metric_totals(trees: List[MetricNode]) -> Dict[str, int]:
+    """Flat sum of every metric over every node of every tree — the
+    per-query totals the query history records and Prometheus exports."""
+    totals: Dict[str, int] = {}
+
+    def walk(n: MetricNode) -> None:
+        n._settle()
+        for k, v in n.values.items():
+            totals[k] = totals.get(k, 0) + int(v)
+        for c in n.children:
+            walk(c)
+
+    for t in trees:
+        walk(t)
+    return totals
+
+
+def _fmt_value(key: str, value: int) -> str:
+    if key.endswith("_ns"):
+        short = key[:-3].replace("elapsed_compute", "compute")
+        return f"{short}={value / 1e6:.1f}ms"
+    return f"{key}={value}"
+
+
+def _render_node(node: MetricNode, depth: int, lines: List[str],
+                 normalize: bool) -> None:
+    node._settle()
+    keys = [k for k in _KEY_ORDER if k in node.values]
+    keys += sorted(k for k in node.values if k not in _KEY_ORDER)
+    parts = []
+    for k in keys:
+        v = node.values[k]
+        if normalize and _volatile(k):
+            continue
+        if v == 0 and k not in ("output_rows", "output_batches"):
+            continue
+        parts.append(_fmt_value(k, v) if not normalize
+                     else f"{k}={v}")
+    pad = "  " * depth
+    lines.append(f"{pad}{node.name}: " + (" ".join(parts) or "-"))
+    for c in node.children:
+        _render_node(c, depth + 1, lines, normalize)
+
+
+def render_analyzed(trees: List[MetricNode], normalize: bool = False
+                    ) -> str:
+    """Render merged metric trees; each group is headed by its task
+    count (`[N tasks]`)."""
+    lines: List[str] = []
+    for merged, n in merge_metric_trees(trees):
+        lines.append(f"[{n} task{'s' if n != 1 else ''}]")
+        _render_node(merged, 1, lines, normalize)
+    return "\n".join(lines)
+
+
+def explain_analyze(trees: List[MetricNode],
+                    query_id: Optional[str] = None,
+                    wall_s: Optional[float] = None,
+                    rows: Optional[int] = None,
+                    spmd: bool = False,
+                    retries: int = 0,
+                    fallbacks: int = 0,
+                    normalize: bool = False) -> str:
+    """The full EXPLAIN ANALYZE text: a summary header + the annotated
+    executed plan.  `normalize=True` omits the volatile header fields
+    (query id, wall time) and metric values — the golden-comparable
+    canonical form."""
+    head = ["== EXPLAIN ANALYZE"]
+    if not normalize:
+        if query_id:
+            head.append(f"query={query_id}")
+        if wall_s is not None:
+            head.append(f"wall={wall_s:.3f}s")
+    if rows is not None:
+        head.append(f"rows={rows}")
+    head.append(f"mode={'spmd' if spmd else 'serial'}")
+    head.append(f"retries={retries}")
+    head.append(f"fallbacks={fallbacks}")
+    out = [" ".join(head) + " =="]
+    if not trees:
+        out.append("(no per-operator metrics: the query compiled to one "
+                   "SPMD stage program; run with "
+                   "auron.spmd.singleDevice.enable=false for the "
+                   "per-operator serial view)" if spmd else
+                   "(no per-operator metrics collected)")
+        return "\n".join(out)
+    out.append(render_analyzed(trees, normalize=normalize))
+    return "\n".join(out)

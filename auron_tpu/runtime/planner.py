@@ -87,13 +87,18 @@ class PhysicalPlanner:
         identity so repeated tasks of one plan fuse once.  Declined
         chains surface as analysis diagnostics on the cached
         FusionReport (logged at DEBUG through the analysis logger)."""
+        from auron_tpu.runtime import tracing
         if conf.get("auron.plan.verify"):
             from auron_tpu.analysis import verify_task
-            verify_task(task)
+            with tracing.span("plan.verify", cat="plan",
+                              stage=task.stage_id,
+                              partition=task.partition_id):
+                verify_task(task)
         plan = task.plan
         if conf.get("auron.fuse.enable"):
             from auron_tpu.runtime.fusion import fuse_plan_cached
-            plan, report = fuse_plan_cached(plan)
+            with tracing.span("plan.fuse", cat="plan"):
+                plan, report = fuse_plan_cached(plan)
             if report.declined:
                 import logging
                 alog = logging.getLogger("auron_tpu.analysis")

@@ -10,7 +10,14 @@ TPU analogue on a free port, started lazily on first task execution when
   role; load into TensorBoard/XProf)
 - GET /debug/pyspy              — pure-python stack sample fallback
   (sys._current_frames), the CPU-profile analogue with zero deps
-- GET /metrics                  — memory-manager + task-counter snapshot
+- GET /metrics                  — Prometheus text-format view: process
+  counters (tasks/queries/retries/fallbacks from runtime/counters.py),
+  memory-manager + kernel-cache + FFI-ingest-cache stats, and
+  per-metric aggregates over the completed-query history
+  (?format=json keeps the raw JSON snapshot)
+- GET /queries                  — recent query history (id, wall time,
+  attempts, retries, fallbacks, rows, trace download when recorded);
+  /queries/<id>/trace serves the Chrome-trace JSON
 - GET /status                   — build info (the Auron UI tab analogue)
 """
 
@@ -87,16 +94,119 @@ def _stack_samples(seconds: float, hz: int = 50) -> bytes:
 
 def _metrics_snapshot() -> dict:
     from auron_tpu.memmgr import get_manager
-    from auron_tpu.runtime import executor
+    from auron_tpu.ops.kernel_cache import cache_info
+    from auron_tpu.ops.scan.ipc import ingest_cache_info
+    from auron_tpu.runtime import counters, tracing
 
     out = {"mem": get_manager().stats(),
-           "tasks_completed": getattr(executor, "_TASKS_COMPLETED", 0)}
+           "counters": counters.snapshot(),
+           "kernel_cache": cache_info(),
+           "ffi_ingest_cache": ingest_cache_info(),
+           "queries_recorded": len(tracing.query_history())}
     try:
         import jax
         out["devices"] = [str(d) for d in jax.devices()]
     except Exception:
         pass
     return out
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prometheus_text() -> str:
+    """Prometheus exposition (text format 0.0.4) over the same sources
+    as the JSON snapshot, plus per-metric-key totals aggregated across
+    the completed-query history — the one scrape endpoint a later perf
+    PR points its dashboard at."""
+    from auron_tpu.memmgr import get_manager
+    from auron_tpu.ops.kernel_cache import cache_info
+    from auron_tpu.ops.scan.ipc import ingest_cache_info
+    from auron_tpu.runtime import counters, tracing
+
+    lines: list = []
+
+    def emit(name: str, value, mtype: str = "counter",
+             help_: str = "", labels: str = "") -> None:
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name}{labels} {value}")
+
+    snap = counters.snapshot()
+    for key in ("tasks_started", "tasks_completed", "tasks_failed",
+                "tasks_retried", "queries_started", "queries_completed",
+                "queries_failed"):
+        emit(f"auron_{key}_total", snap.get(key, 0),
+             help_=f"process-level {key.replace('_', ' ')} count")
+    for key in ("attempts", "retries", "exhausted", "fallbacks"):
+        emit(f"auron_retry_{key}_total", snap.get(f"retry_{key}", 0),
+             help_=f"shared retry policy: {key}")
+    mem = get_manager().stats()
+    emit("auron_mem_budget_bytes", mem.get("budget", 0), "gauge",
+         "memory-manager byte budget")
+    emit("auron_mem_used_bytes", mem.get("total_used", 0), "gauge",
+         "memory-manager bytes in use")
+    emit("auron_mem_consumers", mem.get("num_consumers", 0), "gauge")
+    emit("auron_mem_spills_total", mem.get("num_spills", 0))
+    kc = cache_info()
+    emit("auron_kernel_cache_kernels", kc.get("kernels", 0), "gauge",
+         "resident jitted kernels")
+    emit("auron_kernel_cache_hits_total", kc.get("hits", 0))
+    emit("auron_kernel_cache_misses_total", kc.get("misses", 0))
+    ic = ingest_cache_info()
+    emit("auron_ffi_ingest_cache_entries", ic.get("entries", 0), "gauge")
+    emit("auron_ffi_ingest_cache_bytes", ic.get("bytes", 0), "gauge")
+    history = tracing.query_history()
+    emit("auron_query_wall_seconds_sum",
+         round(sum(r.wall_s for r in history), 6),
+         help_="wall seconds over the recorded query history")
+    emit("auron_query_wall_seconds_count", len(history))
+    emit("auron_query_rows_total", sum(r.rows for r in history))
+    totals = tracing.history_metric_totals()
+    if totals:
+        name = "auron_query_metric_total"
+        lines.append(f"# HELP {name} summed operator-metric values "
+                     f"across the recorded query history")
+        lines.append(f"# TYPE {name} counter")
+        for k in sorted(totals):
+            lines.append(
+                f'{name}{{key="{_prom_escape(k)}"}} {totals[k]}')
+    return "\n".join(lines) + "\n"
+
+
+def _queries_json() -> list:
+    from auron_tpu.runtime import tracing
+    return [r.to_dict() for r in reversed(tracing.query_history())]
+
+
+def _queries_html() -> str:
+    import html as _html
+    rows = []
+    for r in _queries_json():
+        trace_cell = (f'<a href="/queries/{r["query_id"]}/trace">json</a>'
+                      if r["traced"] else "-")
+        err = _html.escape(str(r["error"])[:80]) if r["error"] else ""
+        rows.append(
+            f"<tr><td><code>{_html.escape(r['query_id'])}</code></td>"
+            f"<td>{r['wall_s']:.3f}s</td><td>{r['rows']}</td>"
+            f"<td>{'spmd' if r['spmd'] else 'serial'}</td>"
+            f"<td>{r['attempts']}</td><td>{r['retries']}</td>"
+            f"<td>{r['fallbacks']}</td><td>{trace_cell}</td>"
+            f"<td>{err}</td></tr>")
+    return (
+        "<html><head><title>Auron queries</title><style>"
+        "body{font-family:sans-serif;margin:2em}"
+        "table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 10px}"
+        "</style></head><body><h2>Recent queries</h2>"
+        "<table><tr><th>query</th><th>wall</th><th>rows</th>"
+        "<th>mode</th><th>attempts</th><th>retries</th>"
+        "<th>fallbacks</th><th>trace</th><th>error</th></tr>"
+        + "".join(rows) +
+        "</table><p><a href='/'>home</a> · "
+        "<a href='/queries?format=json'>json</a></p></body></html>")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -128,7 +238,28 @@ class _Handler(BaseHTTPRequestHandler):
                 seconds = float(q.get("seconds", ["1"])[0])
                 self._send(200, _stack_samples(seconds), "text/plain")
             elif url.path == "/metrics":
-                self._send(200, json.dumps(_metrics_snapshot()).encode())
+                if q.get("format", [""])[0] == "json":
+                    self._send(200,
+                               json.dumps(_metrics_snapshot()).encode())
+                else:
+                    self._send(200, _prometheus_text().encode(),
+                               "text/plain; version=0.0.4; "
+                               "charset=utf-8")
+            elif url.path == "/queries":
+                if q.get("format", [""])[0] == "json":
+                    self._send(200, json.dumps(_queries_json()).encode())
+                else:
+                    self._send(200, _queries_html().encode(),
+                               "text/html")
+            elif url.path.startswith("/queries/") and \
+                    url.path.endswith("/trace"):
+                from auron_tpu.runtime import tracing
+                qid = url.path[len("/queries/"):-len("/trace")]
+                rec = tracing.find_query(qid)
+                if rec is None or rec.trace is None:
+                    self._send(404, b'{"error": "no trace for query"}')
+                else:
+                    self._send(200, json.dumps(rec.trace).encode())
             elif url.path == "/status":
                 from auron_tpu.build_info import build_info
                 self._send(200, json.dumps(build_info()).encode())
@@ -158,6 +289,7 @@ class _Handler(BaseHTTPRequestHandler):
                     f"<h3>Build</h3><table>{rows}</table>"
                     f"<h3>Runtime</h3><table>{mrows}</table>"
                     "<p><a href='/metrics'>metrics</a> · "
+                    "<a href='/queries'>queries</a> · "
                     "<a href='/status'>status</a> · "
                     "<a href='/debug/profile?seconds=1'>trace</a> · "
                     "<a href='/debug/pyspy?seconds=1'>stacks</a></p>"

@@ -138,12 +138,16 @@ def _bump(key: str, delta: int = 1) -> None:
 def add_fallback(n: int = 1) -> None:
     """Record a degradation event (SPMD -> serial path)."""
     _bump("fallbacks", n)
+    from auron_tpu.runtime import tracing
+    tracing.event("fallback", cat="retry", tier="spmd->serial")
 
 
 def add_retry(n: int = 1) -> None:
     """Record re-execution events that bypass call_with_retry (the SPMD
     stage driver's guard-trip / device-fault re-runs)."""
     _bump("retries", n)
+    from auron_tpu.runtime import tracing
+    tracing.event("retry", cat="retry", tier="spmd-stage")
 
 
 def stats_snapshot() -> Dict[str, int]:
@@ -189,6 +193,14 @@ def call_with_retry(fn: Callable[[], Any],
                 history.append((attempt, f"{type(e).__name__}: {e}",
                                 round(delay, 6)))
                 _bump("retries")
+                # the re-execution is a span EVENT carrying the
+                # classified error (runtime/tracing.py): a traced chaos
+                # run shows exactly which attempt re-drew which fault
+                from auron_tpu.runtime import tracing
+                tracing.event("retry", cat="retry", label=label or "call",
+                              attempt=attempt,
+                              error=f"{type(e).__name__}: {e}",
+                              backoff_s=round(delay, 6))
                 if on_retry is not None:
                     on_retry(attempt + 1, e)
                 log.warning("%s failed (attempt %d/%d, %s): %s; "
@@ -205,5 +217,9 @@ def call_with_retry(fn: Callable[[], Any],
                 # outer sites don't retry the retries
                 e.auron_retry_exhausted = True  # type: ignore[attr-defined]
                 _bump("exhausted")
+                from auron_tpu.runtime import tracing
+                tracing.event("retry.exhausted", cat="retry",
+                              label=label or "call", attempts=attempt,
+                              error=f"{type(e).__name__}: {e}")
             raise
     raise AssertionError("unreachable")   # pragma: no cover

@@ -4,7 +4,13 @@ The reference prefixes every native log line with the Spark
 stage/partition/task ids taken from thread-locals set at runtime start.
 Here a contextvar carries (stage_id, partition_id) across the task's
 generator frames, and a logging.Filter injects the prefix into every
-record emitted under the `auron_tpu` logger tree."""
+record emitted under the `auron_tpu` logger tree.
+
+The prefix also carries the QUERY id when one is ambient
+(runtime/tracing.py mints it per `AuronSession.execute`):
+``[q 3f2a9c stage 1 part 0]`` — the same key span attributes and the
+query-history record use, so a log line, a trace span and a metric tree
+correlate on one string."""
 
 from __future__ import annotations
 
@@ -20,7 +26,15 @@ _task: contextvars.ContextVar[Optional[Tuple[int, int]]] = \
 class TaskContextFilter(logging.Filter):
     def filter(self, record: logging.LogRecord) -> bool:
         ctx = _task.get()
-        record.task = f"[stage {ctx[0]} part {ctx[1]}] " if ctx else ""
+        from auron_tpu.runtime.tracing import current_query_id
+        qid = current_query_id()
+        if ctx is not None:
+            q = f"q {qid} " if qid else ""
+            record.task = f"[{q}stage {ctx[0]} part {ctx[1]}] "
+        elif qid:
+            record.task = f"[q {qid}] "
+        else:
+            record.task = ""
         return True
 
 
@@ -58,3 +72,12 @@ def task_scope(stage_id: int, partition_id: int) -> Iterator[None]:
 
 def current() -> Optional[Tuple[int, int]]:
     return _task.get()
+
+
+def current_ids() -> Tuple[Optional[str], Optional[int], Optional[int]]:
+    """(query_id, stage_id, partition_id) — the full correlation key."""
+    from auron_tpu.runtime.tracing import current_query_id
+    ctx = _task.get()
+    if ctx is None:
+        return current_query_id(), None, None
+    return current_query_id(), ctx[0], ctx[1]

@@ -15,6 +15,7 @@ siblings kept running and swallowed their exceptions.
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import os
 from typing import Any, Callable, List, Optional, Sequence
@@ -44,9 +45,13 @@ def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
         run = fn
     else:
         def run(item):
+            def _on_retry(_attempt, _exc):
+                from auron_tpu.runtime import counters
+                counters.bump("tasks_retried")
             return call_with_retry(lambda: fn(item), policy=policy,
                                    label=f"{prefix} task",
-                                   classify=task_classify)
+                                   classify=task_classify,
+                                   on_retry=_on_retry)
 
     size = pool_size()
     if len(items) <= 1 or size <= 1:
@@ -55,9 +60,14 @@ def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
     from concurrent.futures import ThreadPoolExecutor, as_completed
     results: List[Any] = [None] * len(items)
     first_err: Optional[BaseException] = None
+    # worker threads run each task inside a COPY of the submitting
+    # context: the ambient query id + trace recorder (runtime/tracing.py
+    # contextvars) propagate, so spans/log prefixes recorded on pool
+    # threads correlate with the driver's query scope
+    ctx = contextvars.copy_context()
     with ThreadPoolExecutor(max_workers=min(size, len(items)),
                             thread_name_prefix=prefix) as pool:
-        futures = {pool.submit(run, item): i
+        futures = {pool.submit(ctx.copy().run, run, item): i
                    for i, item in enumerate(items)}
         for fut in as_completed(futures):
             idx = futures[fut]

@@ -1,0 +1,447 @@
+"""Query tracing: a low-overhead span recorder + Chrome-trace export.
+
+The reference engine mirrors per-operator metric sets to the JVM and
+exposes a pprof HTTP service; what it never records is the query
+LIFECYCLE — where wall time went between "the driver saw a plan" and
+"the last batch crossed the FFI".  This module is that record: named
+spans for plan conversion, analyzer verify, fusion rewrite, SPMD stage
+compile/launch, per-(stage, partition) task execution, shuffle
+push/fetch, spill write/read, engine-service calls and retry/fallback
+attempts, exportable as Chrome-trace/Perfetto JSON (load in
+chrome://tracing or ui.perfetto.dev).
+
+Design constraints (the <2% serial-bench overhead gate):
+
+- OFF is the default and costs ONE contextvar read per span site:
+  ``span(...)`` returns a shared no-op context manager when no recorder
+  is armed, allocating nothing.
+- ON allocates one small Span record per site; timestamps are
+  ``perf_counter_ns`` deltas against the recorder's epoch (no wall-clock
+  reads on the hot path) and the recorder is bounded
+  (``auron.trace.max.events``; overflow increments ``dropped`` instead
+  of growing without bound).
+- Propagation is contextvar-based, seeded by a per-query id minted in
+  ``AuronSession.execute``: ``task_pool.run_tasks`` copies the ambient
+  context into its worker threads, so spans recorded on pool threads
+  land in the same recorder and carry the same query id as driver-side
+  spans (and as `task_logging` prefixes and metric trees — one
+  correlation key across all three).
+
+The recorder also owns the process-wide QUERY HISTORY ring
+(``auron.metrics.history.max``): every `AuronSession.execute` appends a
+QueryRecord (id, wall time, attempts, retries, fallbacks, merged metric
+totals, the trace when one was recorded) consumed by the profiling
+server's `/queries` page and the Prometheus `/metrics` aggregation.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from auron_tpu.config import conf
+
+__all__ = [
+    "Span", "TraceRecorder", "QueryRecord", "span", "event",
+    "current_recorder", "current_query_id", "start_query", "trace_scope",
+    "validate_chrome_trace", "summarize_chrome_trace", "query_history",
+    "record_query", "history_metric_totals", "clear_history",
+]
+
+
+# ---------------------------------------------------------------------------
+# span recording
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Span:
+    """One closed span; ts/dur in ns relative to the recorder epoch."""
+    name: str
+    cat: str
+    t0_ns: int
+    dur_ns: int
+    tid: int
+    thread: str
+    args: Optional[Dict[str, Any]] = None
+
+
+class TraceRecorder:
+    """Thread-safe bounded span/event sink for ONE query."""
+
+    def __init__(self, query_id: str, max_events: Optional[int] = None):
+        self.query_id = query_id
+        self.epoch_ns = time.perf_counter_ns()
+        self.wall_start = time.time()
+        self.max_events = int(conf.get("auron.trace.max.events")) \
+            if max_events is None else int(max_events)
+        self.spans: List[Span] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+
+    # hot path — called from _SpanCtx.__exit__ and event()
+    def add(self, name: str, cat: str, t0_ns: int, dur_ns: int,
+            args: Optional[Dict[str, Any]]) -> None:
+        t = threading.current_thread()
+        s = Span(name=name, cat=cat, t0_ns=t0_ns - self.epoch_ns,
+                 dur_ns=dur_ns, tid=t.ident or 0, thread=t.name,
+                 args=args or None)
+        with self._lock:
+            if len(self.spans) >= self.max_events:
+                self.dropped += 1
+                return
+            self.spans.append(s)
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self.spans)
+
+    # -- export -----------------------------------------------------------
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON (the `traceEvents` array form): spans
+        as complete ("X") events, instants as "i", thread names as "M"
+        metadata.  Valid for chrome://tracing and Perfetto."""
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"auron-tpu query {self.query_id}"}},
+        ]
+        threads_named = set()
+        for s in self.snapshot():
+            if s.tid not in threads_named:
+                threads_named.add(s.tid)
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": s.tid,
+                               "args": {"name": s.thread}})
+            ev: Dict[str, Any] = {
+                "name": s.name, "cat": s.cat,
+                "ph": "X" if s.dur_ns >= 0 else "i",
+                "ts": s.t0_ns / 1000.0, "pid": pid, "tid": s.tid,
+            }
+            if s.dur_ns >= 0:
+                ev["dur"] = s.dur_ns / 1000.0
+            else:
+                ev["s"] = "t"   # instant scope: thread
+            if s.args:
+                ev["args"] = s.args
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"query_id": self.query_id,
+                              "dropped_events": self.dropped,
+                              "wall_start": self.wall_start}}
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the OFF path allocates zero."""
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("_rec", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, rec: TraceRecorder, name: str, cat: str,
+                 args: Optional[Dict[str, Any]]):
+        self._rec = rec
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter_ns() - self._t0
+        if exc is not None:
+            args = dict(self._args or {})
+            args["error"] = f"{type(exc).__name__}: {exc}"
+            self._args = args
+        self._rec.add(self._name, self._cat, self._t0, dur, self._args)
+        return False
+
+
+_recorder: contextvars.ContextVar[Optional[TraceRecorder]] = \
+    contextvars.ContextVar("auron_trace_recorder", default=None)
+_query_id: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("auron_query_id", default=None)
+
+
+def current_recorder() -> Optional[TraceRecorder]:
+    return _recorder.get()
+
+
+def current_query_id() -> Optional[str]:
+    """The ambient query id — the ONE correlation key shared by span
+    attributes, `task_logging` prefixes and the query-history record."""
+    return _query_id.get()
+
+
+def span(name: str, cat: str = "runtime", **args: Any):
+    """Context manager timing a named span.  With no recorder armed
+    (tracing off — the default) this is one contextvar read and a shared
+    no-op object; `args` land in the Chrome-trace event's `args`."""
+    rec = _recorder.get()
+    if rec is None:
+        return _NOOP
+    return _SpanCtx(rec, name, cat, args or None)
+
+
+def event(name: str, cat: str = "runtime", **args: Any) -> None:
+    """Record an instant event (retry attempts, fallbacks, op
+    completions).  No-op when tracing is off."""
+    rec = _recorder.get()
+    if rec is None:
+        return
+    rec.add(name, cat, time.perf_counter_ns(), -1, args or None)
+
+
+def new_query_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+class trace_scope:
+    """Arm a recorder + query id for the duration of a query.
+
+    Used by `AuronSession.execute`: when `auron.trace.enable` is set a
+    TraceRecorder is created (or an explicit one is adopted), the
+    contextvars are set, and on exit they are restored.  When tracing is
+    disabled the scope still mints a query id (log correlation works
+    without tracing) but no recorder is armed."""
+
+    def __init__(self, query_id: Optional[str] = None,
+                 recorder: Optional[TraceRecorder] = None):
+        self.query_id = query_id or new_query_id()
+        if recorder is not None:
+            self.recorder: Optional[TraceRecorder] = recorder
+        elif conf.get("auron.trace.enable"):
+            self.recorder = TraceRecorder(self.query_id)
+        else:
+            self.recorder = None
+        self._tok_rec = None
+        self._tok_qid = None
+
+    def __enter__(self) -> "trace_scope":
+        self._tok_qid = _query_id.set(self.query_id)
+        if self.recorder is not None:
+            self._tok_rec = _recorder.set(self.recorder)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._tok_rec is not None:
+            _recorder.reset(self._tok_rec)
+        if self._tok_qid is not None:
+            _query_id.reset(self._tok_qid)
+        return False
+
+
+def start_query(query_id: Optional[str] = None) -> trace_scope:
+    """Alias kept for call sites that read better as a verb."""
+    return trace_scope(query_id)
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace validation + summary (the `python -m auron_tpu.trace` CLI)
+# ---------------------------------------------------------------------------
+
+_KNOWN_PHASES = frozenset("BEXiIMCbensTfPOND(){}")
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Structural validation of a Chrome-trace JSON document; returns a
+    list of error strings (empty = valid).  Checks the invariants the
+    Perfetto importer relies on: a traceEvents array of objects, string
+    names, known phase codes, numeric non-negative ts/dur, int pid/tid,
+    dict args."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"top level must be an object, got {type(doc).__name__}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing or non-array 'traceEvents'"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or ph not in _KNOWN_PHASES:
+            errors.append(f"{where}: bad phase {ph!r}")
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errors.append(f"{where}: missing name")
+        if ph != "M":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                errors.append(f"{where}: bad ts {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: bad dur {dur!r}")
+        for key in ("pid", "tid"):
+            if key in ev and not isinstance(ev[key], int):
+                errors.append(f"{where}: non-int {key}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errors.append(f"{where}: non-object args")
+        if len(errors) >= 50:
+            errors.append("... (further errors suppressed)")
+            break
+    return errors
+
+
+def _complete_events(doc: Dict) -> List[Dict]:
+    return [ev for ev in doc.get("traceEvents", [])
+            if isinstance(ev, dict) and ev.get("ph") == "X"]
+
+
+def _span_children(spans: List[Dict]) -> Dict[int, List[int]]:
+    """Containment tree over complete events: parent = smallest
+    enclosing span.  Stack-based over a (start, -dur) sort; overlapping
+    non-nested spans (thread interleavings) fall back to no parent."""
+    order = sorted(range(len(spans)),
+                   key=lambda i: (spans[i]["ts"], -spans[i].get("dur", 0)))
+    children: Dict[int, List[int]] = {i: [] for i in range(len(spans))}
+    stack: List[int] = []
+    for i in order:
+        s, e = spans[i]["ts"], spans[i]["ts"] + spans[i].get("dur", 0)
+        while stack:
+            top = spans[stack[-1]]
+            if top["ts"] + top.get("dur", 0) >= e and top["ts"] <= s:
+                break
+            stack.pop()
+        if stack:
+            children[stack[-1]].append(i)
+        stack.append(i)
+    return children
+
+
+def summarize_chrome_trace(doc: Dict, top: int = 10) -> str:
+    """Human summary: per-name aggregates (count/total/max) sorted by
+    total time, plus the critical path — from the longest span, the
+    chain of largest enclosed spans."""
+    spans = _complete_events(doc)
+    if not spans:
+        return "no complete spans in trace"
+    agg: Dict[str, List[float]] = {}
+    for ev in spans:
+        a = agg.setdefault(ev["name"], [0, 0.0, 0.0])
+        a[0] += 1
+        a[1] += ev.get("dur", 0)
+        a[2] = max(a[2], ev.get("dur", 0))
+    total_span = max(spans, key=lambda e: e.get("dur", 0))
+    lines = [f"{len(spans)} spans, "
+             f"{len(agg)} distinct names, "
+             f"longest: {total_span['name']} "
+             f"{total_span.get('dur', 0) / 1000.0:.3f}ms"]
+    lines.append(f"{'name':32} {'count':>6} {'total_ms':>10} {'max_ms':>10}")
+    by_total = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+    for name, (n, tot, mx) in by_total:
+        lines.append(f"{name[:32]:32} {n:6d} {tot / 1000.0:10.3f} "
+                     f"{mx / 1000.0:10.3f}")
+    # critical path: descend from the longest span into the largest
+    # enclosed span at each level
+    children = _span_children(spans)
+    idx = spans.index(total_span)
+    lines.append("critical path:")
+    depth = 0
+    while True:
+        ev = spans[idx]
+        lines.append(f"  {'  ' * depth}{ev['name']} "
+                     f"{ev.get('dur', 0) / 1000.0:.3f}ms")
+        kids = children.get(idx, [])
+        if not kids or depth >= 20:
+            break
+        idx = max(kids, key=lambda i: spans[i].get("dur", 0))
+        depth += 1
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# process-wide query history (the /queries page + /metrics aggregation)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class QueryRecord:
+    """One completed query: the driver-side summary the reference's
+    Spark UI tab shows per execution, plus the trace when recorded."""
+    query_id: str
+    wall_s: float
+    rows: int = 0
+    spmd: bool = False
+    attempts: int = 0
+    retries: int = 0
+    fallbacks: int = 0
+    error: Optional[str] = None
+    started_at: float = 0.0
+    metric_totals: Dict[str, int] = field(default_factory=dict)
+    trace: Optional[Dict[str, Any]] = None   # chrome-trace doc, if traced
+
+    def to_dict(self, with_trace: bool = False) -> Dict[str, Any]:
+        d = {"query_id": self.query_id, "wall_s": round(self.wall_s, 4),
+             "rows": self.rows, "spmd": self.spmd,
+             "attempts": self.attempts, "retries": self.retries,
+             "fallbacks": self.fallbacks, "error": self.error,
+             "started_at": self.started_at, "traced": self.trace is not None,
+             "metric_totals": dict(self.metric_totals)}
+        if with_trace:
+            d["trace"] = self.trace
+        return d
+
+
+_HISTORY: List[QueryRecord] = []
+_HISTORY_LOCK = threading.Lock()
+
+
+def record_query(rec: QueryRecord) -> None:
+    limit = max(1, int(conf.get("auron.metrics.history.max")))
+    with _HISTORY_LOCK:
+        _HISTORY.append(rec)
+        if len(_HISTORY) > limit:
+            del _HISTORY[:len(_HISTORY) - limit]
+
+
+def query_history() -> List[QueryRecord]:
+    with _HISTORY_LOCK:
+        return list(_HISTORY)
+
+
+def find_query(query_id: str) -> Optional[QueryRecord]:
+    with _HISTORY_LOCK:
+        for rec in reversed(_HISTORY):
+            if rec.query_id == query_id:
+                return rec
+    return None
+
+
+def history_metric_totals() -> Dict[str, int]:
+    """Summed per-operator metric values across recorded queries — the
+    Prometheus aggregation source (`auron_query_metric_total{key=...}`)."""
+    totals: Dict[str, int] = {}
+    with _HISTORY_LOCK:
+        for rec in _HISTORY:
+            for k, v in rec.metric_totals.items():
+                totals[k] = totals.get(k, 0) + int(v)
+    return totals
+
+
+def clear_history() -> None:
+    with _HISTORY_LOCK:
+        _HISTORY.clear()

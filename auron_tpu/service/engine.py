@@ -334,10 +334,13 @@ class EngineClient:
                 raise
             return resp
 
-        resp = call_with_retry(
-            _once, policy=RetryPolicy.from_conf(),
-            label=f"engine {header.get('cmd')} to "
-                  f"{self.host}:{self.port}")
+        from auron_tpu.runtime.tracing import span
+        with span("service.call", cat="service",
+                  cmd=str(header.get("cmd"))):
+            resp = call_with_retry(
+                _once, policy=RetryPolicy.from_conf(),
+                label=f"engine {header.get('cmd')} to "
+                      f"{self.host}:{self.port}")
         if not resp.get("ok"):
             raise RemoteExecutionError(resp.get("error", "request failed"))
         return resp
@@ -377,12 +380,16 @@ class EngineClient:
         rng = random.Random(policy.seed)
         attempts = max(1, policy.max_attempts)
         attempt = 1
+        from auron_tpu.runtime.tracing import span
         while True:
             yielded = False
             try:
-                fault_point("service.call")
-                s = self._ensure_sock()
-                send_msg(s, {"cmd": "execute", "len": len(data)}, data)
+                with span("service.execute.send", cat="service",
+                          attempt=attempt, nbytes=len(data)):
+                    fault_point("service.call")
+                    s = self._ensure_sock()
+                    send_msg(s, {"cmd": "execute", "len": len(data)},
+                             data)
                 while True:
                     header, payload = recv_msg(s)
                     t = header.get("type")

@@ -1,0 +1,134 @@
+"""Trace CLI: dump, validate and summarize query traces.
+
+    python -m auron_tpu.trace run --query q01 --sf 0.002 -o /tmp/q01.json
+    python -m auron_tpu.trace validate /tmp/q01.json
+    python -m auron_tpu.trace summary /tmp/q01.json --top 15
+
+`run` executes one TPC-DS corpus query with `auron.trace.enable` on and
+writes the Chrome-trace JSON (load in chrome://tracing or
+ui.perfetto.dev); `validate` re-checks the schema invariants the
+Perfetto importer relies on (exit 2 on any error); `summary` prints
+per-span aggregates and the critical path.  This is the command-line
+face of runtime/tracing.py, wired into CI by tools/trace_check.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from auron_tpu.runtime.tracing import (
+    summarize_chrome_trace, validate_chrome_trace,
+)
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _cmd_validate(args: argparse.Namespace) -> int:
+    doc = _load(args.file)
+    errors = validate_chrome_trace(doc)
+    if errors:
+        for e in errors:
+            print(f"trace: {e}", file=sys.stderr)
+        return 2
+    n = len(doc.get("traceEvents", []))
+    print(f"{args.file}: valid Chrome trace ({n} events)")
+    return 0
+
+
+def _cmd_summary(args: argparse.Namespace) -> int:
+    doc = _load(args.file)
+    print(summarize_chrome_trace(doc, top=args.top))
+    return 0
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    import jax
+    jax.config.update("jax_platforms", args.platform)
+
+    import tempfile
+
+    from auron_tpu.config import conf
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it import queries
+    from auron_tpu.it.datagen import generate
+    from auron_tpu.it.oracle import PyArrowEngine
+
+    data_dir = args.data_dir or tempfile.mkdtemp(prefix="auron_trace_")
+    catalog = generate(data_dir, sf=args.sf)
+    plan = queries.build(args.query, catalog)
+    scope = {"auron.trace.enable": True}
+    if args.serial:
+        # serial per-partition path: exchanges/spills materialize, so
+        # shuffle + task spans appear (the single-device SPMD stage
+        # program has neither)
+        scope["auron.spmd.singleDevice.enable"] = False
+    if args.faults:
+        scope["auron.faults.spec"] = args.faults
+        scope["auron.task.retries"] = 2
+        scope["auron.retry.backoff.base.ms"] = 1.0
+        scope["auron.retry.backoff.max.ms"] = 10.0
+    with conf.scoped(scope):
+        session = AuronSession(foreign_engine=PyArrowEngine())
+        res = session.execute(plan)
+    if res.trace is None:
+        print("no trace was recorded (auron.trace.enable did not take?)",
+              file=sys.stderr)
+        return 2
+    doc = res.trace.to_chrome_trace()
+    errors = validate_chrome_trace(doc)
+    if errors:
+        for e in errors:
+            print(f"trace: {e}", file=sys.stderr)
+        return 2
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    print(f"{args.query}: {res.table.num_rows} rows, "
+          f"{len(doc['traceEvents'])} trace events -> {args.out}")
+    if args.analyze:
+        print(res.explain_analyze())
+    print(summarize_chrome_trace(doc, top=args.top))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="auron_tpu.trace")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="trace one TPC-DS corpus query")
+    run.add_argument("--query", default="q01")
+    run.add_argument("--sf", type=float, default=0.002)
+    run.add_argument("--data-dir", default=None)
+    run.add_argument("-o", "--out", default="trace.json")
+    run.add_argument("--platform", default="cpu")
+    run.add_argument("--serial", action="store_true",
+                     help="force the serial per-partition path so "
+                          "shuffle/task spans materialize")
+    run.add_argument("--faults", default=None,
+                     help="auron.faults.spec to arm while tracing "
+                          "(retry spans in the output)")
+    run.add_argument("--analyze", action="store_true",
+                     help="also print EXPLAIN ANALYZE for the run")
+    run.add_argument("--top", type=int, default=10)
+    run.set_defaults(fn=_cmd_run)
+
+    val = sub.add_parser("validate", help="schema-check a trace file")
+    val.add_argument("file")
+    val.set_defaults(fn=_cmd_validate)
+
+    summ = sub.add_parser("summary", help="summarize a trace file")
+    summ.add_argument("file")
+    summ.add_argument("--top", type=int, default=10)
+    summ.set_defaults(fn=_cmd_summary)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

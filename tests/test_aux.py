@@ -19,10 +19,17 @@ def _get(url: str):
 def test_profiling_server_endpoints():
     srv = profiling.ProfilingServer().start()
     try:
+        # /metrics is Prometheus text by default since the unified
+        # export layer; the JSON snapshot moved to ?format=json
         code, body = _get(srv.url + "/metrics")
         assert code == 200
+        assert b"auron_tasks_completed_total" in body
+
+        code, body = _get(srv.url + "/metrics?format=json")
+        assert code == 200
         m = json.loads(body)
-        assert "mem" in m and "tasks_completed" in m
+        assert "mem" in m and "counters" in m
+        assert "tasks_completed" in m["counters"]
 
         code, body = _get(srv.url + "/status")
         assert code == 200
@@ -57,13 +64,18 @@ def test_profiling_lazy_start_from_conf():
 def test_task_counter_increments():
     from auron_tpu.ir import plan as P
     from auron_tpu.ir.schema import DataType, Field, Schema
-    from auron_tpu.runtime import executor
+    from auron_tpu.runtime import counters, executor
 
-    before = executor._TASKS_COMPLETED
+    # counters moved to runtime/counters.py — the one registry the
+    # executor, /metrics and /queries all share (no more dangling
+    # executor._TASKS_* globals read via getattr)
+    before_s, before_c = executor.task_attempt_counts()
     plan = P.EmptyPartitions(
         schema=Schema((Field("x", DataType.int64()),)), num_partitions=1)
     executor.execute_plan(plan)
-    assert executor._TASKS_COMPLETED == before + 1
+    after_s, after_c = executor.task_attempt_counts()
+    assert (after_s, after_c) == (before_s + 1, before_c + 1)
+    assert counters.get("tasks_completed") == after_c
 
 
 def test_task_logging_prefix(caplog):

@@ -1,0 +1,134 @@
+"""Profiling HTTP server coverage (runtime/profiling.py): endpoint
+status codes, Prometheus text-format parseability of /metrics, the
+/queries history page + per-query trace download, the /debug/pyspy
+smoke, and the concurrent-trace 429 path."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from auron_tpu.runtime import profiling, tracing
+
+# Prometheus exposition format 0.0.4: `name{labels} value` or comments
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.eE+-]+(\s[0-9]+)?$")
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read(), r.headers
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = profiling.ProfilingServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def recorded_query():
+    rec = tracing.TraceRecorder("qhttp01", max_events=10)
+    with tracing.trace_scope(recorder=rec, query_id="qhttp01"):
+        with tracing.span("query", cat="query"):
+            pass
+    qr = tracing.QueryRecord(
+        query_id="qhttp01", wall_s=0.25, rows=42, spmd=False,
+        attempts=3, retries=1, fallbacks=0, started_at=1.0,
+        metric_totals={"output_rows": 42, "num_retries": 1},
+        trace=rec.to_chrome_trace())
+    tracing.record_query(qr)
+    return qr
+
+
+def test_metrics_prometheus_parseable(server, recorded_query):
+    code, body, headers = _get(server.url + "/metrics")
+    assert code == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    text = body.decode()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    assert lines, "empty exposition"
+    for ln in lines:
+        if ln.startswith("#"):
+            assert ln.startswith("# HELP") or ln.startswith("# TYPE"), ln
+        else:
+            assert _PROM_LINE.match(ln), f"unparseable line: {ln!r}"
+    # the one counter registry: executor/session counters present
+    for name in ("auron_tasks_completed_total", "auron_tasks_failed_total",
+                 "auron_tasks_retried_total",
+                 "auron_queries_completed_total",
+                 "auron_retry_fallbacks_total",
+                 "auron_kernel_cache_hits_total",
+                 "auron_ffi_ingest_cache_entries",
+                 "auron_mem_used_bytes",
+                 "auron_query_wall_seconds_count"):
+        assert f"\n{name}" in "\n" + text or text.startswith(name), name
+    # history aggregation surfaces per-metric-key totals
+    assert 'auron_query_metric_total{key="output_rows"}' in text
+
+
+def test_metrics_json_snapshot(server):
+    code, body, _ = _get(server.url + "/metrics?format=json")
+    assert code == 200
+    snap = json.loads(body)
+    assert {"mem", "counters", "kernel_cache",
+            "ffi_ingest_cache"} <= set(snap)
+    assert "tasks_completed" in snap["counters"]
+    assert "retry_attempts" in snap["counters"]
+
+
+def test_queries_page_and_trace_download(server, recorded_query):
+    code, body, _ = _get(server.url + "/queries")
+    assert code == 200
+    page = body.decode()
+    assert "qhttp01" in page and "Recent queries" in page
+    assert "/queries/qhttp01/trace" in page
+
+    code, body, _ = _get(server.url + "/queries?format=json")
+    assert code == 200
+    rows = json.loads(body)
+    row = next(r for r in rows if r["query_id"] == "qhttp01")
+    assert row["rows"] == 42 and row["attempts"] == 3 and row["traced"]
+
+    code, body, _ = _get(server.url + "/queries/qhttp01/trace")
+    assert code == 200
+    doc = json.loads(body)
+    assert tracing.validate_chrome_trace(doc) == []
+
+    code, _, _ = _get(server.url + "/queries/no-such-query/trace")
+    assert code == 404
+
+
+def test_status_and_unknown_route(server):
+    code, body, _ = _get(server.url + "/status")
+    assert code == 200 and json.loads(body)["name"] == "auron-tpu"
+    code, _, _ = _get(server.url + "/definitely/not/here")
+    assert code == 404
+
+
+def test_pyspy_smoke(server):
+    code, body, _ = _get(server.url + "/debug/pyspy?seconds=0.1")
+    assert code == 200 and body
+    # folded-stacks shape: frame;frame;... count
+    first = body.decode().splitlines()[0]
+    assert " " in first and ";" in first
+
+
+def test_concurrent_trace_429(server):
+    """A second profile capture while one is in flight answers 429 —
+    the jax profiler is process-global and concurrent start_trace calls
+    can wedge it.  Holding the module lock simulates the in-flight
+    capture without paying a real jax trace."""
+    assert profiling._trace_lock.acquire(blocking=False)
+    try:
+        code, body, _ = _get(server.url + "/debug/profile?seconds=0.1")
+        assert code == 429
+        assert b"trace in progress" in body
+    finally:
+        profiling._trace_lock.release()

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# CI trace gate (CPU, no accelerator needed):
+#   1. run a tier-1 TPC-DS query with tracing ON through the serial
+#      path (shuffle/task spans materialize) and a latency fault armed,
+#      dumping Chrome-trace JSON (`python -m auron_tpu.trace run`
+#      validates the schema before writing)
+#   2. re-validate the dumped file through the standalone validator
+#   3. check the committed EXPLAIN ANALYZE goldens via the pytest hook
+#      (tests/test_observability.py; regen with AURON_REGEN_GOLDEN=1)
+#
+# The same checks run inside the suite (tests/test_observability.py::
+# test_tools_trace_check_script, marked slow), mirroring how
+# lint_plans.sh / chaos_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out_dir=$(mktemp -d /tmp/auron_trace_check.XXXXXX)
+trap 'rm -rf "$out_dir"' EXIT
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m auron_tpu.trace run \
+    --query q01 --sf 0.002 --serial \
+    --faults 'shuffle.push:latency:ms=20,max=2,seed=3' \
+    -o "$out_dir/q01.trace.json" --analyze
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m auron_tpu.trace validate \
+    "$out_dir/q01.trace.json"
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m pytest -q \
+    -p no:cacheprovider \
+    tests/test_observability.py::test_explain_analyze_golden_q03 \
+    tests/test_observability.py::test_explain_analyze_fused_fragment_boundary
+
+echo "trace_check.sh: ok"
