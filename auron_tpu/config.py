@@ -176,6 +176,15 @@ MEMORY_BUDGET_BYTES = conf.define(
     "Absolute memory budget override in bytes; 0 = derive from device memory "
     "and auron.memory.fraction.",
 )
+MEMORY_WATERMARK_FRACTIONS = conf.define(
+    "auron.memory.watermark.fractions", "0.5,0.8,0.95",
+    "Comma-separated budget fractions the memory manager watches: the "
+    "first time pool usage climbs past budget*fraction a watermark "
+    "crossing is recorded (memmgr stats, /memory endpoint) and a "
+    "mem.pressure trace event is emitted when the query is traced.  "
+    "Crossings fire once per fraction per manager lifetime "
+    "(reset_manager re-arms).  Empty disables watermark telemetry.",
+)
 SPILL_COMPRESSION_CODEC = conf.define(
     "auron.spill.compression.codec", "zstd", "Codec for spill files: zstd|zlib|none."
 )
@@ -206,12 +215,14 @@ FAULTS_SPEC = conf.define(
     "auron.faults.spec", "",
     "Fault-injection spec armed at named fault_point(...) sites "
     "(auron_tpu.faults): ';'-separated 'point:kind[:p=..,seed=..,"
-    "max=..,after=..,ms=..]' rules, e.g. "
+    "max=..,after=..,ms=..,bytes=..,frac=..]' rules, e.g. "
     "'shuffle.push:io:p=0.2,seed=7;spill.write:io:p=0.1'.  Kinds: "
     "io | timeout (retryable), device (retry then degrade to serial), "
     "error (deterministic), latency (sleep ms milliseconds instead of "
-    "failing — visible as span durations in a traced run).  Empty "
-    "(default) = every fault point is a no-op check.",
+    "failing — visible as span durations in a traced run), mem "
+    "(reserve bytes — or frac of the budget — out of the memory "
+    "manager's effective budget, forcing spill pressure instead of "
+    "failing).  Empty (default) = every fault point is a no-op check.",
 )
 NET_TIMEOUT_SECONDS = conf.define(
     "auron.net.timeout.seconds", 30.0,

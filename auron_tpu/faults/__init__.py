@@ -14,8 +14,8 @@ Spec grammar (';'-separated rules)::
     spec  := rule (';' rule)*
     rule  := point ':' kind [':' param (',' param)*]
     param := 'p=' float | 'seed=' int | 'max=' int | 'after=' int
-           | 'ms=' float
-    kind  := 'io' | 'timeout' | 'device' | 'error' | 'latency'
+           | 'ms=' float | 'bytes=' int | 'frac=' float
+    kind  := 'io' | 'timeout' | 'device' | 'error' | 'latency' | 'mem'
 
 e.g. ``shuffle.push:io:p=0.2,seed=7;spill.write:io:p=0.1``.
 
@@ -35,7 +35,14 @@ deterministic RuntimeError — never retried).  `latency` injects
 SLOWNESS, not failure: the fault point sleeps `ms` milliseconds
 (default 25) and returns normally — the kind that exercises read
 timeouts and shows up as stretched span durations in a traced chaos
-run (runtime/tracing.py), never as an error.
+run (runtime/tracing.py), never as an error.  `mem` injects MEMORY
+PRESSURE, not failure: the fault point reserves `bytes` (or
+`frac` of the configured budget, default 0.5) out of the global
+MemManager's effective budget, so spillable consumers start spilling
+— results must stay bit-identical, and the pressure is visible as
+`mem.pressure`/`mem.spill` events in a traced run.  Reservations
+persist until `reset_manager` (or `release_reservations`) — use
+`max=1` to shrink once rather than per matching call.
 
 With the spec unset (the default) `fault_point` is a no-op check: one
 config read, no registry, no RNG — cheap enough for per-push/per-task
@@ -56,8 +63,9 @@ from auron_tpu.config import conf
 __all__ = [
     "FaultSpecError", "InjectedFault", "InjectedIOError",
     "InjectedTimeout", "InjectedDeviceFault", "InjectedError",
-    "InjectedLatency", "FaultRule", "FaultRegistry", "fault_point",
-    "active_registry", "injection_counts", "reset",
+    "InjectedLatency", "InjectedMemPressure", "FaultRule",
+    "FaultRegistry", "fault_point", "active_registry",
+    "injection_counts", "reset",
 ]
 
 
@@ -104,12 +112,32 @@ class InjectedLatency:
         self.seconds = seconds
 
 
+class InjectedMemPressure:
+    """NOT an exception: a mem injection reserves bytes out of the global
+    MemManager's budget (outside the registry lock), forcing spill
+    pressure on every consumer — visible as `mem.pressure`/`mem.spill`
+    events when the query is traced, never as an error."""
+
+    def __init__(self, point: str, nbytes: Optional[int], frac: float):
+        self.fault_point = point
+        self.nbytes = nbytes
+        self.frac = frac
+
+    def apply(self) -> None:
+        from auron_tpu.memmgr import get_manager
+        mgr = get_manager()
+        nbytes = self.nbytes if self.nbytes is not None \
+            else int(mgr.budget * self.frac)
+        mgr.add_reservation(f"fault:{self.fault_point}", nbytes)
+
+
 _KINDS = {
     "io": InjectedIOError,
     "timeout": InjectedTimeout,
     "device": InjectedDeviceFault,
     "error": InjectedError,
     "latency": None,   # handled in FaultRule.draw (sleep, not raise)
+    "mem": None,       # handled in FaultRule.draw (reserve, not raise)
 }
 
 
@@ -125,6 +153,8 @@ class FaultRule:
     max_injections: Optional[int] = None
     after: int = 0
     delay_ms: float = 25.0   # latency kind: injected sleep
+    mem_bytes: Optional[int] = None   # mem kind: reservation size
+    mem_frac: float = 0.5    # mem kind: budget fraction when bytes unset
     # counters (registry lock held)
     calls: int = 0
     injected: int = 0
@@ -162,6 +192,9 @@ class FaultRule:
         self.injected += 1
         if self.kind == "latency":
             return InjectedLatency(point, self.delay_ms / 1000.0)
+        if self.kind == "mem":
+            return InjectedMemPressure(point, self.mem_bytes,
+                                       self.mem_frac)
         exc_type = _KINDS[self.kind]
         return exc_type(
             point,
@@ -206,6 +239,10 @@ def parse_spec(spec: str) -> List[FaultRule]:
                         kw["after"] = int(val)
                     elif key == "ms":
                         kw["delay_ms"] = float(val)
+                    elif key == "bytes":
+                        kw["mem_bytes"] = int(val)
+                    elif key == "frac":
+                        kw["mem_frac"] = float(val)
                     else:
                         raise FaultSpecError(
                             f"unknown fault param {key!r} in rule {raw!r}")
@@ -231,6 +268,7 @@ class FaultRegistry:
 
     def check(self, point: str) -> None:
         sleeps = []
+        reservations = []
         with self._lock:
             for rule in self.rules:
                 if not rule.matches(point):
@@ -241,8 +279,15 @@ class FaultRegistry:
                     # the matching call site, not serialize every fault
                     # point in the process behind it
                     sleeps.append(fault.seconds)
+                elif isinstance(fault, InjectedMemPressure):
+                    # applied OUTSIDE the lock: the reservation takes the
+                    # MemManager lock, and a consumer spill re-entering a
+                    # fault point must never deadlock on the registry
+                    reservations.append(fault)
                 elif fault is not None:
                     raise fault
+        for r in reservations:
+            r.apply()
         for s in sleeps:
             time.sleep(s)
 

@@ -130,15 +130,19 @@ class AuronSession:
         fresh query id correlates log prefixes, span attributes and the
         query-history record; with `auron.trace.enable` set the full
         lifecycle trace lands on `SessionResult.trace`."""
+        from auron_tpu.memmgr import get_manager
         from auron_tpu.runtime import counters, tracing
         from auron_tpu.runtime import executor as _executor
         from auron_tpu.runtime import retry as _retry
-        from auron_tpu.runtime.explain_analyze import metric_totals
+        from auron_tpu.runtime.explain_analyze import (
+            merge_metric_trees, metric_max, metric_totals,
+        )
 
         scope = tracing.trace_scope()
         counters.bump("queries_started")
         stats0 = _retry.stats_snapshot()
         started0, _ = _executor.task_attempt_counts()
+        mem0 = get_manager().stats()
         t0 = time.perf_counter()
         wall_start = time.time()
         res: Optional[SessionResult] = None
@@ -155,6 +159,8 @@ class AuronSession:
             wall_s = time.perf_counter() - t0
             stats1 = _retry.stats_snapshot()
             started1, _ = _executor.task_attempt_counts()
+            mem1 = get_manager().stats()
+            trees = res.metrics if res is not None else []
             tracing.record_query(tracing.QueryRecord(
                 query_id=scope.query_id, wall_s=wall_s,
                 rows=res.table.num_rows if res is not None else 0,
@@ -164,8 +170,16 @@ class AuronSession:
                 fallbacks=stats1.get("fallbacks", 0)
                 - stats0.get("fallbacks", 0),
                 error=error, started_at=wall_start,
-                metric_totals=metric_totals(res.metrics)
-                if res is not None else {},
+                metric_totals=metric_totals(trees),
+                # pool deltas are monotone counters, so they attribute
+                # to THIS query even when a reset_manager never happened
+                mem_peak=metric_max(trees, "mem_peak"),
+                mem_spills=max(0, mem1.get("num_spills", 0)
+                               - mem0.get("num_spills", 0)),
+                mem_spill_bytes=max(0, mem1.get("spill_bytes_freed", 0)
+                                    - mem0.get("spill_bytes_freed", 0)),
+                metric_trees=[{"tasks": n, "tree": t.to_dict()}
+                              for t, n in merge_metric_trees(trees)],
                 trace=scope.recorder.to_chrome_trace()
                 if scope.recorder is not None else None))
         counters.bump("queries_completed")

@@ -1,4 +1,4 @@
-"""MemManager: consumer registry + wait-or-spill arbitration.
+"""MemManager: consumer registry + wait-or-spill arbitration + accounting.
 
 Mirrors the decision structure of auron-memmgr/src/lib.rs:303-423
 (`Operation::{Spill, Wait, Nothing}`): when a consumer grows past its fair
@@ -6,19 +6,62 @@ share and the pool is exhausted, the largest spillable consumer is asked to
 spill; tiny consumers (< MIN_TRIGGER_SIZE) are never forced.  Single-process
 synchronous version: "Wait" (multi-task backpressure) degenerates into
 immediate spill of the requester.
+
+On top of the arbitration sits the resource-observability layer (Sparkle,
+arXiv:1708.05746: memory behavior, not compute, dominates Spark-class
+engines on big-memory machines — so memory is the one pool that must never
+be a black box):
+
+- per-consumer and pool-wide PEAK tracking (always on: two compares under
+  the lock already held for the usage update);
+- WATERMARK telemetry: `auron.memory.watermark.fractions` defines budget
+  fractions; the first time the pool's usage climbs past each one, a
+  crossing is recorded and a `mem.pressure` trace event is emitted
+  (runtime/tracing.py — one contextvar read when tracing is off).  Peaks
+  are monotone, so crossings fire at most once per fraction, in
+  increasing order, per manager lifetime (reset_manager re-arms);
+- SPILL ATTRIBUTION: every spill the manager triggers is recorded with
+  the spilling consumer, the consumer whose update requested memory, the
+  decision path (arbitration / self / fallback), the bytes the consumer
+  reported freed, and the spill's wall time — exported through `stats()`,
+  the profiling server's `/memory` endpoint and `mem.spill` trace events;
+- RESERVATIONS: `add_reservation` shrinks the effective budget (the `mem`
+  fault kind injects pressure this way; a production analogue is carving
+  out headroom for a co-tenant runtime).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
 
 from auron_tpu.config import conf
+
+# spill-size histogram bucket upper bounds (bytes); the last bucket is
+# open-ended.  Coarse powers-of-16: spill sizes span KBs (fuzz budgets)
+# to GBs (real pressure) and the histogram only needs the decade.
+SPILL_HIST_BOUNDS = (1 << 12, 1 << 16, 1 << 20, 1 << 24, 1 << 28)
+
 
 def min_trigger_size() -> int:
     """Consumers below this size are never forced to spill (lib.rs:36;
     configurable so tiny-budget fuzz tests can exercise spill paths)."""
     return int(conf.get("auron.memory.spill.min.trigger.bytes"))
+
+
+def watermark_fractions() -> List[float]:
+    raw = str(conf.get("auron.memory.watermark.fractions"))
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        f = float(part)
+        if 0.0 < f:
+            out.append(f)
+    return sorted(out)
 
 
 class MemConsumer:
@@ -29,26 +72,75 @@ class MemConsumer:
         self.name = name
         self.spillable = spillable
         self.mem_used = 0
+        self.mem_peak = 0
         self._manager: Optional["MemManager"] = None
+        self._metrics = None   # MetricNode sink for mem_peak (ops/base)
+
+    def bind_metrics(self, node) -> None:
+        """Attach the operator's MetricNode: on unregister the manager
+        flushes this consumer's peak into it (`mem_peak`), which is how
+        per-operator memory columns reach EXPLAIN ANALYZE."""
+        self._metrics = node
 
     def update_mem_used(self, new_bytes: int) -> None:
         if self._manager is not None:
             self._manager.update(self, int(new_bytes))
         else:
             self.mem_used = int(new_bytes)
+            if self.mem_used > self.mem_peak:
+                self.mem_peak = self.mem_used
 
     def spill(self) -> int:
         raise NotImplementedError
 
 
+@dataclass
+class SpillRecord:
+    """One attributed spill: who spilled, who asked, which decision path,
+    what it bought, and what it cost."""
+    consumer: str          # the consumer whose spill() ran
+    requested_by: str      # the consumer whose update() went over budget
+    path: str              # arbitration | self | fallback
+    freed_bytes: int       # the consumer's reported return value
+    wall_ns: int
+    total_used: int        # pool usage right after the spill
+    at: float = field(default_factory=time.time)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"consumer": self.consumer,
+                "requested_by": self.requested_by, "path": self.path,
+                "freed_bytes": self.freed_bytes, "wall_ns": self.wall_ns,
+                "total_used": self.total_used, "at": self.at}
+
+
 class MemManager:
+    # bounded attribution ring: enough to see a whole spill storm, small
+    # enough that accounting can stay always-on
+    MAX_SPILL_RECORDS = 256
+
     def __init__(self, budget_bytes: Optional[int] = None):
         self._lock = threading.RLock()
+        self._tls = threading.local()   # re-entrancy guard (see update)
         self._consumers: List[MemConsumer] = []
         self.budget = budget_bytes if budget_bytes is not None \
             else self._default_budget()
         self.total_used = 0
+        self.peak_used = 0
         self.num_spills = 0
+        self.reserved = 0
+        self._reservations: Dict[str, int] = {}
+        # watermark state: fractions sorted ascending, next index to fire
+        self._wm_fractions = watermark_fractions()
+        self._wm_next = 0
+        self._wm_crossings: List[Dict[str, Any]] = []
+        # spill attribution: ring of records + cumulative aggregates
+        self._spill_records: List[SpillRecord] = []
+        self.spill_bytes_freed = 0
+        self.spill_wall_ns = 0
+        self._spills_by_path: Dict[str, int] = {}
+        self._spill_hist = [0] * (len(SPILL_HIST_BOUNDS) + 1)
+        # cumulative per-consumer-name stats, surviving unregistration
+        self._by_name: Dict[str, Dict[str, int]] = {}
 
     @staticmethod
     def _default_budget() -> int:
@@ -67,6 +159,33 @@ class MemManager:
             pass
         return int(4 * (1 << 30) * frac)  # fallback: 4GB-class device
 
+    # -- effective budget / reservations ----------------------------------
+
+    @property
+    def effective_budget(self) -> int:
+        return self.budget - self.reserved
+
+    def add_reservation(self, label: str, nbytes: int) -> int:
+        """Carve `nbytes` out of the budget under `label` (repeat labels
+        accumulate).  The `mem` fault kind injects pressure through this:
+        consumers see a smaller effective budget and start spilling.
+        Returns the new effective budget."""
+        with self._lock:
+            self._reservations[label] = \
+                self._reservations.get(label, 0) + int(nbytes)
+            self.reserved += int(nbytes)
+            return self.effective_budget
+
+    def release_reservations(self, label: Optional[str] = None) -> None:
+        with self._lock:
+            if label is None:
+                self._reservations.clear()
+                self.reserved = 0
+            else:
+                self.reserved -= self._reservations.pop(label, 0)
+
+    # -- consumer registry -------------------------------------------------
+
     def register_consumer(self, consumer: MemConsumer) -> MemConsumer:
         with self._lock:
             consumer._manager = self
@@ -75,6 +194,11 @@ class MemManager:
             # partition tasks each register their own consumers)
             consumer._owner_thread = threading.get_ident()
             self._consumers.append(consumer)
+            ent = self._by_name.setdefault(
+                consumer.name, {"registrations": 0, "peak": 0,
+                                "spills": 0, "freed_bytes": 0,
+                                "wall_ns": 0})
+            ent["registrations"] += 1
         return consumer
 
     def unregister_consumer(self, consumer: MemConsumer) -> None:
@@ -84,44 +208,187 @@ class MemManager:
                 consumer.mem_used = 0
                 consumer._manager = None
                 self._consumers.remove(consumer)
+                ent = self._by_name.get(consumer.name)
+                if ent is not None and consumer.mem_peak > ent["peak"]:
+                    ent["peak"] = consumer.mem_peak
+        node = consumer._metrics
+        if node is not None and consumer.mem_peak:
+            # per-operator memory column for EXPLAIN ANALYZE (plain
+            # values dict access: node.get() may settle deferred device
+            # scalars and accounting must never force a sync)
+            prev = node.values.get("mem_peak", 0)
+            if consumer.mem_peak > prev:
+                node.values["mem_peak"] = consumer.mem_peak
+
+    # -- usage update + arbitration ---------------------------------------
+
+    def _check_watermarks(self, consumer: MemConsumer) -> List[Dict]:
+        """Fire pending watermark crossings (lock held).  Peaks are
+        monotone and each fraction fires once, so the emitted sequence is
+        monotone in the fraction too."""
+        fired: List[Dict] = []
+        budget = self.effective_budget
+        while self._wm_next < len(self._wm_fractions):
+            frac = self._wm_fractions[self._wm_next]
+            if self.total_used < budget * frac:
+                break
+            crossing = {"fraction": frac, "used": self.total_used,
+                        "budget": budget, "consumer": consumer.name,
+                        "at": time.time()}
+            self._wm_crossings.append(crossing)
+            fired.append(crossing)
+            self._wm_next += 1
+        return fired
+
+    def _record_spill(self, target: MemConsumer, requester: MemConsumer,
+                      path: str, freed: int, wall_ns: int) -> SpillRecord:
+        with self._lock:
+            rec = SpillRecord(consumer=target.name,
+                              requested_by=requester.name, path=path,
+                              freed_bytes=int(freed), wall_ns=int(wall_ns),
+                              total_used=self.total_used)
+            self.num_spills += 1
+            self.spill_bytes_freed += rec.freed_bytes
+            self.spill_wall_ns += rec.wall_ns
+            self._spills_by_path[path] = \
+                self._spills_by_path.get(path, 0) + 1
+            for i, bound in enumerate(SPILL_HIST_BOUNDS):
+                if rec.freed_bytes <= bound:
+                    self._spill_hist[i] += 1
+                    break
+            else:
+                self._spill_hist[-1] += 1
+            ent = self._by_name.get(target.name)
+            if ent is not None:
+                ent["spills"] += 1
+                ent["freed_bytes"] += rec.freed_bytes
+                ent["wall_ns"] += rec.wall_ns
+            self._spill_records.append(rec)
+            if len(self._spill_records) > self.MAX_SPILL_RECORDS:
+                del self._spill_records[
+                    :len(self._spill_records) - self.MAX_SPILL_RECORDS]
+        from auron_tpu.runtime import tracing
+        tracing.event("mem.spill", cat="mem", consumer=rec.consumer,
+                      requested_by=rec.requested_by, path=rec.path,
+                      freed_bytes=rec.freed_bytes,
+                      wall_ms=rec.wall_ns / 1e6)
+        return rec
+
+    def _timed_spill(self, target: MemConsumer, requester: MemConsumer,
+                     path: str) -> int:
+        # spill() re-enters update() (consumers account the batches they
+        # shed / re-stage); while it runs on this thread no FURTHER spill
+        # may be arbitrated — a nested spill of the same consumer would
+        # consume its staged state out from under the outer spill's feet
+        # (observed: AggExec._compact_staged mid-spill losing _staged)
+        self._tls.spilling = getattr(self._tls, "spilling", 0) + 1
+        t0 = time.perf_counter_ns()
+        try:
+            freed = target.spill()
+        finally:
+            self._tls.spilling -= 1
+        self._record_spill(target, requester, path, freed,
+                           time.perf_counter_ns() - t0)
+        return freed
 
     def update(self, consumer: MemConsumer, new_bytes: int) -> None:
         """Update usage; may synchronously trigger spills (of this consumer
         or a larger one) to stay under budget — the arbitration loop of
         lib.rs:303-423."""
         spill_target: Optional[MemConsumer] = None
+        pressure: List[Dict] = []
         with self._lock:
             self.total_used += new_bytes - consumer.mem_used
             consumer.mem_used = new_bytes
-            if self.total_used <= self.budget:
-                return
-            trigger = min_trigger_size()
-            # only consumers OWNED by this thread are safe to spill from
-            # here: spilling another task's operator mid-execute would
-            # race its buffered state (the reference's Wait arm covers
-            # the cross-task case; our degenerate form self-spills)
-            me = threading.get_ident()
-            candidates = [c for c in self._consumers
-                          if c.spillable and c.mem_used >= trigger and
-                          getattr(c, "_owner_thread", me) == me]
-            if not candidates:
-                # over budget but nothing is big enough to bother: allow
-                # (reference returns Nothing below MIN_TRIGGER_SIZE)
-                return
-            spill_target = max(candidates, key=lambda c: c.mem_used)
+            if new_bytes > consumer.mem_peak:
+                consumer.mem_peak = new_bytes
+            if self.total_used > self.peak_used:
+                self.peak_used = self.total_used
+            pressure = self._check_watermarks(consumer)
+            if self.total_used > self.effective_budget and \
+                    not getattr(self._tls, "spilling", 0):
+                trigger = min_trigger_size()
+                # only consumers OWNED by this thread are safe to spill
+                # from here: spilling another task's operator mid-execute
+                # would race its buffered state (the reference's Wait arm
+                # covers the cross-task case; our degenerate form
+                # self-spills)
+                me = threading.get_ident()
+                candidates = [c for c in self._consumers
+                              if c.spillable and c.mem_used >= trigger and
+                              getattr(c, "_owner_thread", me) == me]
+                if candidates:
+                    spill_target = max(candidates,
+                                       key=lambda c: c.mem_used)
+                # else: over budget but nothing is big enough to bother —
+                # allow (reference returns Nothing below MIN_TRIGGER_SIZE)
+        if pressure:
+            from auron_tpu.runtime import tracing
+            for p in pressure:
+                tracing.event("mem.pressure", cat="mem",
+                              fraction=p["fraction"], used=p["used"],
+                              budget=p["budget"], consumer=p["consumer"])
+        if spill_target is None:
+            return
         # spill outside the lock (spill() re-enters update())
-        freed = spill_target.spill()
-        with self._lock:
-            self.num_spills += 1
+        freed = self._timed_spill(
+            spill_target, consumer,
+            "arbitration" if spill_target is not consumer else "self")
         if freed <= 0 and spill_target is not consumer and consumer.spillable \
                 and consumer.mem_used >= min_trigger_size():
-            consumer.spill()
+            # fallback path: the chosen target had nothing to give, so the
+            # requester spills itself.  This spill was historically never
+            # counted (the num_spills bump sat on the arbitration path
+            # only); _timed_spill attributes and counts both uniformly.
+            self._timed_spill(consumer, consumer, "fallback")
 
-    def stats(self) -> Dict[str, int]:
+    # -- snapshots ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {"budget": self.budget, "total_used": self.total_used,
+            return {"budget": self.budget, "reserved": self.reserved,
+                    "effective_budget": self.effective_budget,
+                    "total_used": self.total_used,
+                    "peak_used": self.peak_used,
                     "num_consumers": len(self._consumers),
-                    "num_spills": self.num_spills}
+                    "num_spills": self.num_spills,
+                    "spill_bytes_freed": self.spill_bytes_freed,
+                    "spill_wall_ns": self.spill_wall_ns,
+                    "spills_by_path": dict(self._spills_by_path),
+                    "watermark_fractions": list(self._wm_fractions),
+                    "watermarks_crossed": [dict(c)
+                                           for c in self._wm_crossings]}
+
+    def consumer_snapshot(self, top_n: int = 0) -> List[Dict[str, Any]]:
+        """Live consumers sorted by current usage (largest first)."""
+        with self._lock:
+            rows = [{"name": c.name, "used": c.mem_used,
+                     "peak": c.mem_peak, "spillable": c.spillable}
+                    for c in self._consumers]
+        rows.sort(key=lambda r: (-r["used"], -r["peak"], r["name"]))
+        return rows[:top_n] if top_n else rows
+
+    def consumer_totals(self) -> Dict[str, Dict[str, int]]:
+        """Cumulative per-consumer-name aggregates (peak of peaks, spill
+        count/bytes/wall) surviving unregistration — the /memory view of
+        which OPERATOR CLASS holds or spills the pool."""
+        with self._lock:
+            return {name: dict(ent) for name, ent in self._by_name.items()}
+
+    def spill_records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.to_dict() for r in self._spill_records]
+
+    def spill_histogram(self) -> Dict[str, int]:
+        """Spill-size histogram over freed bytes, prometheus-style `le`
+        upper bounds (cumulative counts are the exporter's job)."""
+        with self._lock:
+            hist = list(self._spill_hist)
+        out = {}
+        for bound, n in zip(SPILL_HIST_BOUNDS, hist):
+            out[str(bound)] = n
+        out["+Inf"] = hist[-1]
+        return out
 
 
 _GLOBAL: Optional[MemManager] = None
@@ -138,7 +405,8 @@ def get_manager() -> MemManager:
 
 def reset_manager(budget_bytes: Optional[int] = None) -> MemManager:
     """Test/driver hook: install a fresh manager (e.g. tiny budget for the
-    spill fuzz tests, SURVEY §4)."""
+    spill fuzz tests, SURVEY §4).  Accounting (peaks, watermarks, spill
+    attribution) restarts with the new instance."""
     global _GLOBAL
     with _GLOBAL_LOCK:
         _GLOBAL = MemManager(budget_bytes)

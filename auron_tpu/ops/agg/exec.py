@@ -35,7 +35,7 @@ from auron_tpu.exprs.compiler import build_evaluator
 from auron_tpu.exprs.typing import infer_type
 from auron_tpu.ir.expr import AggExpr
 from auron_tpu.ir.schema import DataType, Field, Schema
-from auron_tpu.memmgr import MemConsumer, SpillManager, get_manager
+from auron_tpu.memmgr import MemConsumer, SpillManager
 from auron_tpu.ops.agg.functions import AggSpec, HostAggSpec, make_spec
 from auron_tpu.ops.base import Operator, TaskContext, batch_size
 from auron_tpu.ops.sort_keys import (
@@ -517,13 +517,11 @@ class AggExec(Operator, MemConsumer):
         return freed
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
-        mgr = ctx.mem_manager or get_manager()
-        mgr.register_consumer(self)
         try:
-            yield from self._execute_inner(ctx)
+            with self.mem_scope(ctx):
+                yield from self._execute_inner(ctx)
         finally:
             self._spills.release_all()
-            mgr.unregister_consumer(self)
 
     def _eval_vcols(self, b: Batch, ctx: TaskContext,
                     merge_input: bool) -> Tuple[List[Any], List[List[Any]]]:

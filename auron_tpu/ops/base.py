@@ -8,6 +8,7 @@ jitted jnp programs cached per (fragment, schema, capacity).
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -95,6 +96,23 @@ class Operator:
                                           batch.num_rows_dev())
             self.metrics.add("output_batches", 1)
             yield batch
+
+    @contextmanager
+    def mem_scope(self, ctx: TaskContext, consumer=None):
+        """Register a MemConsumer (default: the operator itself) with the
+        task's memory manager for the duration of the scope, binding this
+        operator's MetricNode so the consumer's peak usage lands in the
+        metric tree (`mem_peak`) on unregister — the one place memory
+        columns enter EXPLAIN ANALYZE and the /queries history."""
+        from auron_tpu.memmgr import get_manager
+        mgr = ctx.mem_manager or get_manager()
+        c = consumer if consumer is not None else self
+        c.bind_metrics(self.metrics)
+        mgr.register_consumer(c)
+        try:
+            yield mgr
+        finally:
+            mgr.unregister_consumer(c)
 
     def child_stream(self, ctx: TaskContext, i: int = 0) -> Iterator[Batch]:
         stream = self.children[i].execute_with_metrics(ctx)

@@ -459,11 +459,8 @@ class SortMergeJoinExec(_HashJoinBase, MemConsumer):
             yield from super().execute(ctx)
 
     def _execute_streaming(self, ctx: TaskContext) -> Iterator[Batch]:
-        from auron_tpu.memmgr import get_manager
         from auron_tpu.ops.joins.smj import SideCursor, cmp_keys
         orders = self.sort_options
-        mgr = ctx.mem_manager or get_manager()
-        mgr.register_consumer(self)
         key_evals = (self._left_keys, self._right_keys)
         cursors = [SideCursor(self.child_stream(ctx, i), key_evals[i],
                               orders, ctx.partition_id, self._spills,
@@ -473,32 +470,33 @@ class SortMergeJoinExec(_HashJoinBase, MemConsumer):
         build_cur = cursors[0 if self.build_side == "left" else 1]
         probe_cur = cursors[1 if self.build_side == "left" else 0]
         try:
-            for c in cursors:
-                c.advance()
-            self.update_mem_used(sum(c.mem_bytes for c in cursors))
-            while ctx.is_running:
-                if all(c.exhausted for c in cursors):
-                    if any(not c.empty for c in cursors):
-                        yield from self._join_window(ctx, build_cur,
-                                                     probe_cur, None)
-                    return
-                frontier = None
+            with self.mem_scope(ctx):
                 for c in cursors:
-                    if not c.exhausted and (
-                            frontier is None or
-                            cmp_keys(c.boundary, frontier, orders) < 0):
-                        frontier = c.boundary
-                yield from self._join_window(ctx, build_cur, probe_cur,
-                                             frontier)
-                for c in cursors:
-                    if not c.exhausted and \
-                            cmp_keys(c.boundary, frontier, orders) == 0:
-                        c.advance()
+                    c.advance()
                 self.update_mem_used(sum(c.mem_bytes for c in cursors))
+                while ctx.is_running:
+                    if all(c.exhausted for c in cursors):
+                        if any(not c.empty for c in cursors):
+                            yield from self._join_window(ctx, build_cur,
+                                                         probe_cur, None)
+                        return
+                    frontier = None
+                    for c in cursors:
+                        if not c.exhausted and (
+                                frontier is None or
+                                cmp_keys(c.boundary, frontier, orders) < 0):
+                            frontier = c.boundary
+                    yield from self._join_window(ctx, build_cur, probe_cur,
+                                                 frontier)
+                    for c in cursors:
+                        if not c.exhausted and \
+                                cmp_keys(c.boundary, frontier, orders) == 0:
+                            c.advance()
+                    self.update_mem_used(
+                        sum(c.mem_bytes for c in cursors))
         finally:
             self._cursors = []
             self._spills.release_all()
-            mgr.unregister_consumer(self)
 
     def _join_window(self, ctx: TaskContext, build_cur, probe_cur,
                      frontier) -> Iterator[Batch]:

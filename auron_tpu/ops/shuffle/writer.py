@@ -28,7 +28,7 @@ from auron_tpu.columnar.batch import Batch, bucket_capacity
 from auron_tpu.native import bindings
 from auron_tpu.ir.plan import Partitioning
 from auron_tpu.ir.schema import DataType, Field, Schema
-from auron_tpu.memmgr import MemConsumer, SpillManager, get_manager
+from auron_tpu.memmgr import MemConsumer, SpillManager
 from auron_tpu.ops.base import Operator, TaskContext
 from auron_tpu.ops.shuffle.partitioner import PartitionIdComputer
 
@@ -145,12 +145,10 @@ class ShuffleWriterExec(_ShuffleWriterBase):
         self.output_index_file = output_index_file
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
-        mgr = ctx.mem_manager or get_manager()
         bufs = _PartitionBuffers(self.partitioning.num_partitions,
                                  self.children[0].schema)
-        mgr.register_consumer(bufs)
         rows_per_pid: Dict[int, int] = {}
-        try:
+        with self.mem_scope(ctx, consumer=bufs):
             for pid, sub in self._partitioned_stream(ctx):
                 bufs.add(pid, sub)
                 rows_per_pid[pid] = rows_per_pid.get(pid, 0) + sub.num_rows
@@ -172,8 +170,6 @@ class ShuffleWriterExec(_ShuffleWriterBase):
             yield Batch.from_arrow(pa.Table.from_pylist(
                 out_rows, schema=to_arrow_schema(self.schema))
                 .combine_chunks().to_batches()[0])
-        finally:
-            mgr.unregister_consumer(bufs)
 
 
 class RssShuffleWriterExec(_ShuffleWriterBase):

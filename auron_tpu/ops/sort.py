@@ -21,7 +21,7 @@ from auron_tpu.columnar.batch import (
 from auron_tpu.exprs.compiler import build_evaluator
 from auron_tpu.ir.expr import SortExpr
 from auron_tpu.ir.schema import Schema
-from auron_tpu.memmgr import MemConsumer, SpillManager, get_manager
+from auron_tpu.memmgr import MemConsumer, SpillManager
 from auron_tpu.ops.base import Operator, TaskContext, batch_size
 from auron_tpu.ops.sort_keys import encode_sort_keys, lexsort_indices
 
@@ -97,30 +97,29 @@ class SortExec(Operator, MemConsumer):
     # -- execution ----------------------------------------------------------
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
-        mgr = ctx.mem_manager or get_manager()
-        mgr.register_consumer(self)
         try:
-            for b in self.child_stream(ctx):
-                if b.num_rows == 0:
-                    continue
-                self._staged.append(b)
-                self._staged_bytes += b.mem_bytes()
-                self.update_mem_used(self._staged_bytes)
-            if not len(self._spills):
-                out = self._sort_staged()
-                self._staged = []
-                self.update_mem_used(0)
-                yield from _apply_offset(iter(out), self.fetch_offset,
-                                         self.fetch_limit)
-                return
-            # final in-memory run joins the spilled runs
-            if self._staged:
-                self.spill()
-            yield from _apply_offset(
-                self._merge_spills(), self.fetch_offset, self.fetch_limit)
+            with self.mem_scope(ctx):
+                for b in self.child_stream(ctx):
+                    if b.num_rows == 0:
+                        continue
+                    self._staged.append(b)
+                    self._staged_bytes += b.mem_bytes()
+                    self.update_mem_used(self._staged_bytes)
+                if not len(self._spills):
+                    out = self._sort_staged()
+                    self._staged = []
+                    self.update_mem_used(0)
+                    yield from _apply_offset(iter(out), self.fetch_offset,
+                                             self.fetch_limit)
+                    return
+                # final in-memory run joins the spilled runs
+                if self._staged:
+                    self.spill()
+                yield from _apply_offset(
+                    self._merge_spills(), self.fetch_offset,
+                    self.fetch_limit)
         finally:
             self._spills.release_all()
-            mgr.unregister_consumer(self)
 
     def _merge_spills(self) -> Iterator[Batch]:
         runs = [s.read_batches() for s in self._spills.spills]

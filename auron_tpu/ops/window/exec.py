@@ -64,15 +64,12 @@ class WindowExec(Operator, MemConsumer):
             for wf in self.window_funcs]
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
-        from auron_tpu.memmgr import get_manager
-        mgr = ctx.mem_manager or get_manager()
-        mgr.register_consumer(self)
         try:
-            yield from self._execute_inner(ctx)
+            with self.mem_scope(ctx):
+                yield from self._execute_inner(ctx)
         finally:
             self._staged = []
             self._spills.release_all()
-            mgr.unregister_consumer(self)
 
     # -- spillable staging (window_exec.rs buffers per partition; here
     #    staged input spills as (partition, order)-sorted runs and whole

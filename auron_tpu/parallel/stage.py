@@ -1451,6 +1451,23 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                             match_factor: int,
                             agg_cap_hint: Optional[int] = None,
                             join_compact: bool = True):
+    # one `spmd.launch` span per stage attempt, with the host-visible
+    # internal phases (`spmd.ingest` scan IO, `spmd.shard` pad+transfer,
+    # `spmd.compile`/`spmd.run` program execution, `spmd.gather` result
+    # fetch) as child spans — stage time is decomposable in trace
+    # summaries instead of one opaque block
+    from auron_tpu.runtime import tracing
+    with tracing.span("spmd.launch", cat="spmd"):
+        return _execute_plan_spmd_once_impl(
+            plan, conv_ctx, mesh, source_tables, axis, match_factor,
+            agg_cap_hint=agg_cap_hint, join_compact=join_compact)
+
+
+def _execute_plan_spmd_once_impl(plan: P.PlanNode, conv_ctx, mesh: Mesh,
+                                 source_tables: Dict[str, Any], axis,
+                                 match_factor: int,
+                                 agg_cap_hint: Optional[int] = None,
+                                 join_compact: bool = True):
     import dataclasses
 
     import pyarrow as pa
@@ -1505,8 +1522,10 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
 
     # 3. materialize scan leaves (host IO through the serial engine) and
     # FFI sources, then shard row-wise over the mesh
+    from auron_tpu.runtime import tracing
     source_tables = dict(source_tables)
-    scan_rids, scan_tables = _materialize_scans(plan, conv_ctx)
+    with tracing.span("spmd.ingest", cat="spmd"):
+        scan_rids, scan_tables = _materialize_scans(plan, conv_ctx)
     source_tables.update(scan_tables)
 
     # shard + device_put each source ONCE per (table, mesh, axis, string
@@ -1517,17 +1536,19 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
                  _string_cfg_fingerprint())
     host_inputs = {}
     schemas = {}
-    for rid, table in source_tables.items():
-        e = _DEVICE_SHARDS.get(table, shard_key)
-        if e is None:
-            schema, cols, live, _cap = _shard_table(table, mesh, axis)
-            e = {"schema": schema,
-                 "cols": jax.tree.map(
-                     lambda x: jax.device_put(x, sharded), cols),
-                 "live": jax.device_put(live, sharded)}
-            _DEVICE_SHARDS.put(table, e, shard_key)
-        host_inputs[rid] = (e["cols"], e["live"])
-        schemas[rid] = e["schema"]
+    with tracing.span("spmd.shard", cat="spmd",
+                      sources=len(source_tables)):
+        for rid, table in source_tables.items():
+            e = _DEVICE_SHARDS.get(table, shard_key)
+            if e is None:
+                schema, cols, live, _cap = _shard_table(table, mesh, axis)
+                e = {"schema": schema,
+                     "cols": jax.tree.map(
+                         lambda x: jax.device_put(x, sharded), cols),
+                     "live": jax.device_put(live, sharded)}
+                _DEVICE_SHARDS.put(table, e, shard_key)
+            host_inputs[rid] = (e["cols"], e["live"])
+            schemas[rid] = e["schema"]
     # program cache: repeat executions of the SAME converted plan over the
     # same input shapes reuse the compiled shard_map program (a fresh
     # jax.jit closure per call would re-trace+re-compile every time)
@@ -1625,10 +1646,10 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
 
     # jax.jit is lazy: on a cache miss the first call below traces +
     # compiles the whole stage program, so the span is the compile span
-    # (first launch included); cache hits record a pure launch span
-    from auron_tpu.runtime import tracing
+    # (first launch included); cache hits record a pure run span (both
+    # are children of the enclosing spmd.launch)
     with tracing.span(
-            "spmd.compile" if cached is None else "spmd.launch",
+            "spmd.compile" if cached is None else "spmd.run",
             cat="spmd", devices=n_dev,
             first_launch_included=cached is None):
         (out_cols, out_live, counts, guards, retry_guards, shrink_guards,
@@ -1638,60 +1659,66 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     out_schema = schema_box[0]
 
     from auron_tpu.ops.kernel_cache import host_sync
-    if compact_gather:
-        # phase 1: a few BYTES decide everything — per-shard live counts
-        # + guard bits.  A tripped guard never pays the output fetch at
-        # all, and a clean run fetches only the compacted slice below.
-        (counts_np, guards_np, retry_np, shrink_np, join_np) = host_sync(
-            (counts, guards, retry_guards, shrink_guards, join_guards))
-    else:
-        # single batched fetch (CPU: transfers are memcpy-cheap, two
-        # round trips would only add dispatch latency)
-        (out_live_np, out_cols_np, counts_np, guards_np, retry_np,
-         shrink_np, join_np) = host_sync(
-            (out_live, out_cols, counts, guards, retry_guards,
-             shrink_guards, join_guards))
-    if np.any(np.asarray(guards_np)):
-        raise SpmdGuardTripped(
-            "runtime guard tripped (exchange quota overflow, or "
-            f"duplicate build keys past match factor {match_factor}): "
-            "result discarded", retryable=False, hard=True)
-    if np.any(np.asarray(join_np)):
-        raise SpmdGuardTripped(
-            "join output overflowed the compaction target (genuine "
-            "fan-out): result discarded", join_compact=True)
-    if np.any(np.asarray(shrink_np)):
-        raise SpmdGuardTripped(
-            f"agg group count overflowed the capacity hint "
-            f"{agg_cap_hint}: result discarded", shrink=True)
-    if np.any(np.asarray(retry_np)):
-        raise SpmdGuardTripped(
-            "duplicate-key build side at match factor 1: result "
-            "discarded", retryable=True)
-    if compact_gather:
-        # phase 2: slice each shard to the smallest capacity bucket that
-        # holds its rows (one tiny cached program), then fetch that
-        per_cap = out_live.shape[0] // n_dev
-        kmax = max(int(np.max(np.asarray(counts_np))), 1)
-        K = min(bucket_capacity(kmax), per_cap)
-        if K < per_cap:
-            slicer = _gather_slicer(mesh, axis, K, out_cols, out_live)
-            out_cols, out_live = slicer(out_cols, out_live)
-        out_live_np, out_cols_np = host_sync((out_live, out_cols))
-    live_np = np.asarray(out_live_np)
-    GATHER_STATS["rows"] = int(np.asarray(counts_np).sum())
-    GATHER_STATS["capacity"] = int(live_np.shape[0])
-    GATHER_STATS["bytes"] = int(sum(
-        np.asarray(x).nbytes for x in jax.tree.leaves(out_cols_np))) + \
-        live_np.nbytes
-    arrays = []
-    for f, c in zip(out_schema, out_cols_np):
-        from auron_tpu.columnar.arrow_interop import column_to_arrow
-        total = live_np.shape[0]
-        arr = column_to_arrow(f.dtype, c, total)
-        arrays.append(arr.filter(pa.array(live_np)))
-    table = pa.Table.from_arrays(
-        arrays, schema=to_arrow_schema(out_schema))
+    with tracing.span("spmd.gather", cat="spmd",
+                      compact=bool(compact_gather)):
+        if compact_gather:
+            # phase 1: a few BYTES decide everything — per-shard live
+            # counts + guard bits.  A tripped guard never pays the
+            # output fetch at all, and a clean run fetches only the
+            # compacted slice below.
+            (counts_np, guards_np, retry_np, shrink_np,
+             join_np) = host_sync(
+                (counts, guards, retry_guards, shrink_guards,
+                 join_guards))
+        else:
+            # single batched fetch (CPU: transfers are memcpy-cheap, two
+            # round trips would only add dispatch latency)
+            (out_live_np, out_cols_np, counts_np, guards_np, retry_np,
+             shrink_np, join_np) = host_sync(
+                (out_live, out_cols, counts, guards, retry_guards,
+                 shrink_guards, join_guards))
+        if np.any(np.asarray(guards_np)):
+            raise SpmdGuardTripped(
+                "runtime guard tripped (exchange quota overflow, or "
+                f"duplicate build keys past match factor {match_factor}): "
+                "result discarded", retryable=False, hard=True)
+        if np.any(np.asarray(join_np)):
+            raise SpmdGuardTripped(
+                "join output overflowed the compaction target (genuine "
+                "fan-out): result discarded", join_compact=True)
+        if np.any(np.asarray(shrink_np)):
+            raise SpmdGuardTripped(
+                f"agg group count overflowed the capacity hint "
+                f"{agg_cap_hint}: result discarded", shrink=True)
+        if np.any(np.asarray(retry_np)):
+            raise SpmdGuardTripped(
+                "duplicate-key build side at match factor 1: result "
+                "discarded", retryable=True)
+        if compact_gather:
+            # phase 2: slice each shard to the smallest capacity bucket
+            # that holds its rows (one tiny cached program), then fetch
+            per_cap = out_live.shape[0] // n_dev
+            kmax = max(int(np.max(np.asarray(counts_np))), 1)
+            K = min(bucket_capacity(kmax), per_cap)
+            if K < per_cap:
+                slicer = _gather_slicer(mesh, axis, K, out_cols,
+                                        out_live)
+                out_cols, out_live = slicer(out_cols, out_live)
+            out_live_np, out_cols_np = host_sync((out_live, out_cols))
+        live_np = np.asarray(out_live_np)
+        GATHER_STATS["rows"] = int(np.asarray(counts_np).sum())
+        GATHER_STATS["capacity"] = int(live_np.shape[0])
+        GATHER_STATS["bytes"] = int(sum(
+            np.asarray(x).nbytes
+            for x in jax.tree.leaves(out_cols_np))) + live_np.nbytes
+        arrays = []
+        for f, c in zip(out_schema, out_cols_np):
+            from auron_tpu.columnar.arrow_interop import column_to_arrow
+            total = live_np.shape[0]
+            arr = column_to_arrow(f.dtype, c, total)
+            arrays.append(arr.filter(pa.array(live_np)))
+        table = pa.Table.from_arrays(
+            arrays, schema=to_arrow_schema(out_schema))
 
     # 4. replay the peeled tail through the serial engine
     if tail:

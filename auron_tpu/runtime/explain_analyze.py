@@ -22,23 +22,31 @@ Two render modes:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from auron_tpu.runtime.metrics import MetricNode
 
-__all__ = ["merge_metric_trees", "metric_totals", "render_analyzed",
-           "explain_analyze"]
+__all__ = ["merge_metric_trees", "metric_totals", "metric_max",
+           "render_analyzed", "explain_analyze", "diff_metric_trees",
+           "render_diff"]
 
 # values that vary run-to-run (timings, process-global cache state,
-# codec-dependent byte counts): excluded from the canonical form
+# codec-dependent byte counts, memory peaks that move with padding/
+# platform): excluded from the canonical form.  The memory COLUMNS that
+# survive canonicalization are the deterministic counts (mem_spill_count)
 _VOLATILE_KEYS = frozenset({
     "kernel_cache_hits", "kernel_cache_misses", "ffi_ingest_cache_hits",
-    "mem_spill_size", "disk_spill_size",
+    "mem_spill_size", "disk_spill_size", "mem_peak",
 })
 
-# render order: row/batch flow first, then time, then the rest sorted
+# byte-valued metrics: rendered human-readable in the non-canonical form
+_BYTE_KEYS = frozenset({"mem_peak", "mem_spill_size", "disk_spill_size"})
+
+# render order: row/batch flow first, then time, then memory, then the
+# rest sorted
 _KEY_ORDER = ("output_rows", "output_batches", "input_rows",
-              "input_batches", "elapsed_compute_ns")
+              "input_batches", "elapsed_compute_ns", "mem_peak",
+              "mem_spill_count", "mem_spill_size")
 
 
 def _volatile(key: str) -> bool:
@@ -99,10 +107,40 @@ def metric_totals(trees: List[MetricNode]) -> Dict[str, int]:
     return totals
 
 
+def metric_max(trees: List[MetricNode], key: str) -> int:
+    """Largest single-node value of `key` over every tree — e.g. the
+    biggest per-operator memory peak of a query (summing peaks across
+    operators would overstate the pool: they rarely coincide)."""
+    best = 0
+
+    def walk(n: MetricNode) -> None:
+        nonlocal best
+        n._settle()
+        v = int(n.values.get(key, 0))
+        if v > best:
+            best = v
+        for c in n.children:
+            walk(c)
+
+    for t in trees:
+        walk(t)
+    return best
+
+
+def _fmt_bytes(value: int) -> str:
+    if value >= 1 << 20:
+        return f"{value / (1 << 20):.1f}MB"
+    if value >= 1 << 10:
+        return f"{value / (1 << 10):.1f}KB"
+    return f"{value}B"
+
+
 def _fmt_value(key: str, value: int) -> str:
     if key.endswith("_ns"):
         short = key[:-3].replace("elapsed_compute", "compute")
         return f"{short}={value / 1e6:.1f}ms"
+    if key in _BYTE_KEYS:
+        return f"{key}={_fmt_bytes(value)}"
     return f"{key}={value}"
 
 
@@ -170,3 +208,108 @@ def explain_analyze(trees: List[MetricNode],
         return "\n".join(out)
     out.append(render_analyzed(trees, normalize=normalize))
     return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# query diff: per-operator metric deltas between two runs of one plan
+# shape (the /queries/diff view — closes the ROADMAP PR 4 follow-up)
+# ---------------------------------------------------------------------------
+#
+# Works over the DICT form of merged metric trees (QueryRecord.
+# metric_trees: [{"tasks": n, "tree": MetricNode.to_dict()}]): records in
+# the history ring are already settled and serializable, and the diff
+# must not require the original MetricNode objects to still exist.
+
+def _dict_signature(tree: Dict[str, Any]) -> Tuple:
+    return (tree["name"],
+            tuple(_dict_signature(c) for c in tree.get("children", ())))
+
+
+def _flatten_nodes(tree: Dict[str, Any], depth: int = 0,
+                   out: Optional[List] = None) -> List:
+    if out is None:
+        out = []
+    out.append((depth, tree))
+    for c in tree.get("children", ()):
+        _flatten_nodes(c, depth + 1, out)
+    return out
+
+
+def diff_metric_trees(a: List[Dict[str, Any]], b: List[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
+    """Pair the two queries' merged metric-tree groups by structural
+    signature and compute per-node, per-key (a, b, delta) triples.
+
+    Raises ValueError when NO group shape matches — the two queries ran
+    different plan shapes and a per-operator diff is meaningless.
+    Partially matching runs (e.g. one run degraded SPMD->serial and grew
+    a marker group) diff the matching groups and count the rest."""
+    by_sig: Dict[Tuple, Dict[str, Any]] = {}
+    order: List[Tuple] = []
+    for g in a:
+        sig = _dict_signature(g["tree"])
+        if sig not in by_sig:
+            by_sig[sig] = {"a": g, "b": None}
+            order.append(sig)
+    matched_b = 0
+    for g in b:
+        sig = _dict_signature(g["tree"])
+        ent = by_sig.get(sig)
+        if ent is not None and ent["b"] is None:
+            ent["b"] = g
+            matched_b += 1
+    groups = []
+    for sig in order:
+        ent = by_sig[sig]
+        if ent["b"] is None:
+            continue
+        ga, gb = ent["a"], ent["b"]
+        nodes = []
+        for (depth, na), (_d, nb) in zip(_flatten_nodes(ga["tree"]),
+                                         _flatten_nodes(gb["tree"])):
+            keys = sorted(set(na.get("values", {}))
+                          | set(nb.get("values", {})))
+            metrics = {}
+            for k in keys:
+                va = int(na.get("values", {}).get(k, 0))
+                vb = int(nb.get("values", {}).get(k, 0))
+                if va or vb:
+                    metrics[k] = {"a": va, "b": vb, "delta": vb - va}
+            nodes.append({"name": na["name"], "depth": depth,
+                          "metrics": metrics})
+        groups.append({"tasks_a": ga.get("tasks", 1),
+                       "tasks_b": gb.get("tasks", 1), "nodes": nodes})
+    if not groups:
+        raise ValueError(
+            "no matching plan shape between the two queries — "
+            "per-operator diff requires runs of the same plan")
+    return {"groups": groups,
+            "unmatched_a": len(a) - len(groups),
+            "unmatched_b": len(b) - matched_b}
+
+
+def _fmt_delta(key: str, d: Dict[str, int]) -> str:
+    if key.endswith("_ns"):
+        return (f"{key[:-3]}={d['a'] / 1e6:.1f}ms->{d['b'] / 1e6:.1f}ms "
+                f"({d['delta'] / 1e6:+.1f}ms)")
+    if key in _BYTE_KEYS:
+        return (f"{key}={_fmt_bytes(d['a'])}->{_fmt_bytes(d['b'])} "
+                f"({d['delta']:+d}B)")
+    return f"{key}={d['a']}->{d['b']} ({d['delta']:+d})"
+
+
+def render_diff(diff: Dict[str, Any], query_a: str = "a",
+                query_b: str = "b") -> str:
+    lines = [f"== QUERY DIFF a={query_a} b={query_b} =="]
+    for g in diff["groups"]:
+        lines.append(f"[{g['tasks_a']} vs {g['tasks_b']} tasks]")
+        for node in g["nodes"]:
+            pad = "  " * (node["depth"] + 1)
+            parts = [_fmt_delta(k, d)
+                     for k, d in node["metrics"].items()]
+            lines.append(f"{pad}{node['name']}: "
+                         + (" ".join(parts) or "-"))
+    if diff["unmatched_a"] or diff["unmatched_b"]:
+        lines.append(f"(unmatched groups: {diff['unmatched_a']} in a, "
+                     f"{diff['unmatched_b']} in b)")
+    return "\n".join(lines)

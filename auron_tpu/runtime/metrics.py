@@ -34,6 +34,9 @@ STANDARD_METRICS = (
     "ops_fused", "fused_batches", "fragment_trace_ns",
     "kernel_cache_hits", "kernel_cache_misses",
     "ffi_ingest_cache_hits",
+    # memory observability (memmgr/manager.py): the consumer's peak
+    # registered bytes, flushed into the operator's node on unregister
+    "mem_peak",
 )
 
 

@@ -16,8 +16,15 @@ TPU analogue on a free port, started lazily on first task execution when
   per-metric aggregates over the completed-query history
   (?format=json keeps the raw JSON snapshot)
 - GET /queries                  — recent query history (id, wall time,
-  attempts, retries, fallbacks, rows, trace download when recorded);
-  /queries/<id>/trace serves the Chrome-trace JSON
+  attempts, retries, fallbacks, rows, memory peak/spill columns, trace
+  download when recorded); /queries/<id>/trace serves the Chrome-trace
+  JSON
+- GET /queries/diff?a=ID&b=ID   — per-operator metric deltas between two
+  runs of the same plan shape (rows, compute, memory columns);
+  ?format=json for the structured form
+- GET /memory                   — memory-observability JSON: pool budget/
+  used/peak/reserved, watermark crossings, per-consumer top-N (live and
+  cumulative), attributed spill records + size histogram
 - GET /status                   — build info (the Auron UI tab analogue)
 """
 
@@ -111,6 +118,18 @@ def _metrics_snapshot() -> dict:
     return out
 
 
+def _memory_snapshot(top_n: int = 10) -> dict:
+    """The /memory payload: everything the MemManager accounts for, in
+    one JSON document (the tools/mem_check.sh contract)."""
+    from auron_tpu.memmgr import get_manager
+    mgr = get_manager()
+    return {"pool": mgr.stats(),
+            "consumers": mgr.consumer_snapshot(top_n),
+            "consumer_totals": mgr.consumer_totals(),
+            "spills": {"records": mgr.spill_records(),
+                       "histogram": mgr.spill_histogram()}}
+
+
 def _prom_escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
@@ -143,13 +162,53 @@ def _prometheus_text() -> str:
     for key in ("attempts", "retries", "exhausted", "fallbacks"):
         emit(f"auron_retry_{key}_total", snap.get(f"retry_{key}", 0),
              help_=f"shared retry policy: {key}")
-    mem = get_manager().stats()
+    mgr = get_manager()
+    mem = mgr.stats()
     emit("auron_mem_budget_bytes", mem.get("budget", 0), "gauge",
          "memory-manager byte budget")
+    emit("auron_mem_reserved_bytes", mem.get("reserved", 0), "gauge",
+         "bytes carved out of the budget by reservations")
     emit("auron_mem_used_bytes", mem.get("total_used", 0), "gauge",
          "memory-manager bytes in use")
+    emit("auron_mem_peak_bytes", mem.get("peak_used", 0), "gauge",
+         "high-water mark of pool usage")
     emit("auron_mem_consumers", mem.get("num_consumers", 0), "gauge")
     emit("auron_mem_spills_total", mem.get("num_spills", 0))
+    emit("auron_mem_spill_bytes_total", mem.get("spill_bytes_freed", 0),
+         help_="bytes consumers reported freed by manager-driven spills")
+    emit("auron_mem_spill_seconds_total",
+         round(mem.get("spill_wall_ns", 0) / 1e9, 6),
+         help_="wall seconds spent inside consumer spill() calls")
+    by_path = mem.get("spills_by_path", {})
+    if by_path:
+        name = "auron_mem_spills_by_path_total"
+        lines.append(f"# HELP {name} spill count per decision path "
+                     f"(arbitration/self/fallback)")
+        lines.append(f"# TYPE {name} counter")
+        for path in sorted(by_path):
+            lines.append(
+                f'{name}{{path="{_prom_escape(path)}"}} {by_path[path]}')
+    crossings = mem.get("watermarks_crossed", ())
+    if crossings:
+        name = "auron_mem_watermark_crossed"
+        lines.append(f"# HELP {name} 1 once pool usage has crossed "
+                     f"budget*fraction (auron.memory.watermark.fractions)")
+        lines.append(f"# TYPE {name} gauge")
+        for c in crossings:
+            lines.append(f'{name}{{fraction="{c["fraction"]}"}} 1')
+    totals_by_consumer = mgr.consumer_totals()
+    if totals_by_consumer:
+        top = sorted(totals_by_consumer.items(),
+                     key=lambda kv: -kv[1]["peak"])[:10]
+        for metric, key, mtype in (
+                ("auron_mem_consumer_peak_bytes", "peak", "gauge"),
+                ("auron_mem_consumer_spills_total", "spills", "counter"),
+                ("auron_mem_consumer_spill_bytes_total", "freed_bytes",
+                 "counter")):
+            lines.append(f"# TYPE {metric} {mtype}")
+            for cname, ent in top:
+                lines.append(f'{metric}{{consumer='
+                             f'"{_prom_escape(cname)}"}} {ent[key]}')
     kc = cache_info()
     emit("auron_kernel_cache_kernels", kc.get("kernels", 0), "gauge",
          "resident jitted kernels")
@@ -181,6 +240,14 @@ def _queries_json() -> list:
     return [r.to_dict() for r in reversed(tracing.query_history())]
 
 
+def _fmt_mem(nbytes: int) -> str:
+    if nbytes >= 1 << 20:
+        return f"{nbytes / (1 << 20):.1f}MB"
+    if nbytes >= 1 << 10:
+        return f"{nbytes / (1 << 10):.1f}KB"
+    return f"{nbytes}B"
+
+
 def _queries_html() -> str:
     import html as _html
     rows = []
@@ -188,12 +255,17 @@ def _queries_html() -> str:
         trace_cell = (f'<a href="/queries/{r["query_id"]}/trace">json</a>'
                       if r["traced"] else "-")
         err = _html.escape(str(r["error"])[:80]) if r["error"] else ""
+        spilled = (f"{r.get('mem_spills', 0)} / "
+                   f"{_fmt_mem(r.get('mem_spill_bytes', 0))}"
+                   if r.get("mem_spills") else "-")
         rows.append(
             f"<tr><td><code>{_html.escape(r['query_id'])}</code></td>"
             f"<td>{r['wall_s']:.3f}s</td><td>{r['rows']}</td>"
             f"<td>{'spmd' if r['spmd'] else 'serial'}</td>"
             f"<td>{r['attempts']}</td><td>{r['retries']}</td>"
-            f"<td>{r['fallbacks']}</td><td>{trace_cell}</td>"
+            f"<td>{r['fallbacks']}</td>"
+            f"<td>{_fmt_mem(r.get('mem_peak', 0))}</td>"
+            f"<td>{spilled}</td><td>{trace_cell}</td>"
             f"<td>{err}</td></tr>")
     return (
         "<html><head><title>Auron queries</title><style>"
@@ -203,10 +275,51 @@ def _queries_html() -> str:
         "</style></head><body><h2>Recent queries</h2>"
         "<table><tr><th>query</th><th>wall</th><th>rows</th>"
         "<th>mode</th><th>attempts</th><th>retries</th>"
-        "<th>fallbacks</th><th>trace</th><th>error</th></tr>"
+        "<th>fallbacks</th><th>mem peak</th><th>spilled</th>"
+        "<th>trace</th><th>error</th></tr>"
         + "".join(rows) +
         "</table><p><a href='/'>home</a> · "
-        "<a href='/queries?format=json'>json</a></p></body></html>")
+        "<a href='/queries?format=json'>json</a> · "
+        "<a href='/memory'>memory</a> · diff two runs: "
+        "<code>/queries/diff?a=ID&amp;b=ID</code></p></body></html>")
+
+
+def _queries_diff(qa: str, qb: str, as_json: bool):
+    """(status, body, content_type) for /queries/diff."""
+    from auron_tpu.runtime import tracing
+    from auron_tpu.runtime.explain_analyze import (
+        diff_metric_trees, render_diff,
+    )
+    ra, rb = tracing.find_query(qa), tracing.find_query(qb)
+    missing = [qid for qid, r in ((qa, ra), (qb, rb)) if r is None]
+    if missing:
+        return 404, json.dumps(
+            {"error": f"unknown query id(s): {', '.join(missing)}"}
+        ).encode(), "application/json"
+    if not ra.metric_trees or not rb.metric_trees:
+        return 404, json.dumps(
+            {"error": "no per-operator metric trees recorded for one of "
+                      "the runs (SPMD stage programs have none — run "
+                      "with auron.spmd.singleDevice.enable=false)"}
+        ).encode(), "application/json"
+    try:
+        diff = diff_metric_trees(ra.metric_trees, rb.metric_trees)
+    except ValueError as e:
+        return 400, json.dumps({"error": str(e)}).encode(), \
+            "application/json"
+    if as_json:
+        return 200, json.dumps(
+            {"a": ra.to_dict(), "b": rb.to_dict(), "diff": diff}
+        ).encode(), "application/json"
+    import html as _html
+    text = render_diff(diff, query_a=qa, query_b=qb)
+    body = ("<html><head><title>Auron query diff</title></head><body>"
+            f"<h2>Query diff</h2><p><code>{_html.escape(qa)}</code> vs "
+            f"<code>{_html.escape(qb)}</code> "
+            f"(wall {ra.wall_s:.3f}s vs {rb.wall_s:.3f}s)</p>"
+            f"<pre>{_html.escape(text)}</pre>"
+            "<p><a href='/queries'>queries</a></p></body></html>")
+    return 200, body.encode(), "text/html"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -245,6 +358,19 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(200, _prometheus_text().encode(),
                                "text/plain; version=0.0.4; "
                                "charset=utf-8")
+            elif url.path == "/memory":
+                top_n = int(q.get("top", ["10"])[0])
+                self._send(200,
+                           json.dumps(_memory_snapshot(top_n)).encode())
+            elif url.path == "/queries/diff":
+                qa = q.get("a", [""])[0]
+                qb = q.get("b", [""])[0]
+                if not qa or not qb:
+                    self._send(400, b'{"error": "need a=<id>&b=<id>"}')
+                else:
+                    code, body, ctype = _queries_diff(
+                        qa, qb, q.get("format", [""])[0] == "json")
+                    self._send(code, body, ctype)
             elif url.path == "/queries":
                 if q.get("format", [""])[0] == "json":
                     self._send(200, json.dumps(_queries_json()).encode())
@@ -290,6 +416,7 @@ class _Handler(BaseHTTPRequestHandler):
                     f"<h3>Runtime</h3><table>{mrows}</table>"
                     "<p><a href='/metrics'>metrics</a> · "
                     "<a href='/queries'>queries</a> · "
+                    "<a href='/memory'>memory</a> · "
                     "<a href='/status'>status</a> · "
                     "<a href='/debug/profile?seconds=1'>trace</a> · "
                     "<a href='/debug/pyspy?seconds=1'>stacks</a></p>"

@@ -392,6 +392,14 @@ class QueryRecord:
     error: Optional[str] = None
     started_at: float = 0.0
     metric_totals: Dict[str, int] = field(default_factory=dict)
+    # memory accounting (memmgr/manager.py): largest single-operator
+    # peak, and the query's spill count / freed-byte delta on the pool
+    mem_peak: int = 0
+    mem_spills: int = 0
+    mem_spill_bytes: int = 0
+    # merged per-operator metric trees ([{"tasks": n, "tree": dict}]) —
+    # the structure /queries/diff pairs between two runs of one plan
+    metric_trees: Optional[List[Dict[str, Any]]] = None
     trace: Optional[Dict[str, Any]] = None   # chrome-trace doc, if traced
 
     def to_dict(self, with_trace: bool = False) -> Dict[str, Any]:
@@ -400,6 +408,8 @@ class QueryRecord:
              "attempts": self.attempts, "retries": self.retries,
              "fallbacks": self.fallbacks, "error": self.error,
              "started_at": self.started_at, "traced": self.trace is not None,
+             "mem_peak": self.mem_peak, "mem_spills": self.mem_spills,
+             "mem_spill_bytes": self.mem_spill_bytes,
              "metric_totals": dict(self.metric_totals)}
         if with_trace:
             d["trace"] = self.trace

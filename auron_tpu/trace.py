@@ -73,9 +73,31 @@ def _cmd_run(args: argparse.Namespace) -> int:
         scope["auron.task.retries"] = 2
         scope["auron.retry.backoff.base.ms"] = 1.0
         scope["auron.retry.backoff.max.ms"] = 10.0
-    with conf.scoped(scope):
-        session = AuronSession(foreign_engine=PyArrowEngine())
-        res = session.execute(plan)
+    if args.budget:
+        # tiny-budget traced run (tools/mem_check.sh): force spill
+        # pressure so the mem.* event families and the memory columns
+        # provably appear
+        scope["auron.memory.spill.min.trigger.bytes"] = \
+            args.spill_trigger
+    mgr = None
+    try:
+        if args.budget:
+            from auron_tpu.memmgr.manager import reset_manager
+            mgr = reset_manager(args.budget)
+        with conf.scoped(scope):
+            session = AuronSession(foreign_engine=PyArrowEngine())
+            res = session.execute(plan)
+    finally:
+        if args.budget:
+            from auron_tpu.memmgr.manager import reset_manager
+            stats = mgr.stats() if mgr is not None else {}
+            reset_manager()
+    if args.budget:
+        print(f"mem: budget={args.budget} "
+              f"peak={stats.get('peak_used', 0)} "
+              f"spills={stats.get('num_spills', 0)} "
+              f"freed={stats.get('spill_bytes_freed', 0)} "
+              f"watermarks={[c['fraction'] for c in stats.get('watermarks_crossed', [])]}")
     if res.trace is None:
         print("no trace was recorded (auron.trace.enable did not take?)",
               file=sys.stderr)
@@ -114,6 +136,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "(retry spans in the output)")
     run.add_argument("--analyze", action="store_true",
                      help="also print EXPLAIN ANALYZE for the run")
+    run.add_argument("--budget", type=int, default=0,
+                     help="run under a tiny memory-manager budget "
+                          "(bytes) so spill pressure and mem.* events "
+                          "materialize (tools/mem_check.sh)")
+    run.add_argument("--spill-trigger", type=int, default=1024,
+                     help="auron.memory.spill.min.trigger.bytes to use "
+                          "with --budget")
     run.add_argument("--top", type=int, default=10)
     run.set_defaults(fn=_cmd_run)
 

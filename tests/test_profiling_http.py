@@ -120,6 +120,137 @@ def test_pyspy_smoke(server):
     assert " " in first and ";" in first
 
 
+@pytest.fixture()
+def spilled_manager():
+    from auron_tpu.config import conf
+    from auron_tpu.memmgr.manager import (
+        MemConsumer, reset_manager,
+    )
+
+    class _C(MemConsumer):
+        def spill(self):
+            freed = self.mem_used
+            self.update_mem_used(0)
+            return freed
+
+    with conf.scoped({"auron.memory.spill.min.trigger.bytes": 1}):
+        mgr = reset_manager(1000)
+        c = mgr.register_consumer(_C("SortExec"))
+        c.update_mem_used(1500)      # crosses every watermark + spills
+        mgr.unregister_consumer(c)
+    yield mgr
+    reset_manager()
+
+
+def test_memory_endpoint(server, spilled_manager):
+    code, body, headers = _get(server.url + "/memory")
+    assert code == 200
+    assert headers["Content-Type"].startswith("application/json")
+    doc = json.loads(body)
+    assert {"pool", "consumers", "consumer_totals", "spills"} <= set(doc)
+    pool = doc["pool"]
+    assert pool["budget"] == 1000 and pool["peak_used"] == 1500
+    assert pool["num_spills"] == 1
+    assert [c["fraction"] for c in pool["watermarks_crossed"]] == \
+        [0.5, 0.8, 0.95]
+    assert doc["consumer_totals"]["SortExec"]["peak"] == 1500
+    (rec,) = doc["spills"]["records"]
+    assert rec["consumer"] == "SortExec" and rec["freed_bytes"] == 1500
+    assert sum(doc["spills"]["histogram"].values()) == 1
+
+
+def test_metrics_memory_gauges(server, spilled_manager):
+    code, body, _ = _get(server.url + "/metrics")
+    assert code == 200
+    text = body.decode()
+    for line in ("auron_mem_peak_bytes 1500",
+                 "auron_mem_spill_bytes_total 1500",
+                 'auron_mem_spills_by_path_total{path="self"} 1',
+                 'auron_mem_watermark_crossed{fraction="0.8"} 1',
+                 'auron_mem_consumer_peak_bytes{consumer="SortExec"} '
+                 '1500'):
+        assert line in text, f"missing {line!r} in /metrics"
+
+
+def _record_with_trees(qid: str, rows: int, spills: int = 0):
+    from auron_tpu.runtime.explain_analyze import merge_metric_trees
+    from auron_tpu.runtime.metrics import MetricNode
+    root = MetricNode("SortExec")
+    root.add("output_rows", rows)
+    root.add("mem_peak", 2048)
+    if spills:
+        root.add("mem_spill_count", spills)
+    root.child("ScanExec").add("output_rows", rows)
+    merged = merge_metric_trees([root])
+    rec = tracing.QueryRecord(
+        query_id=qid, wall_s=0.1, rows=rows,
+        mem_peak=2048, mem_spills=spills,
+        mem_spill_bytes=spills * 1024,
+        metric_totals={"output_rows": rows},
+        metric_trees=[{"tasks": n, "tree": t.to_dict()}
+                      for t, n in merged])
+    tracing.record_query(rec)
+    return rec
+
+
+def test_queries_page_memory_columns(server):
+    _record_with_trees("qmemcols", 10, spills=2)
+    code, body, _ = _get(server.url + "/queries")
+    assert code == 200
+    page = body.decode()
+    assert "mem peak" in page and "spilled" in page
+    assert "2.0KB" in page            # the fabricated 2048B peak
+    code, body, _ = _get(server.url + "/queries?format=json")
+    row = next(r for r in json.loads(body)
+               if r["query_id"] == "qmemcols")
+    assert row["mem_peak"] == 2048 and row["mem_spills"] == 2
+    assert row["mem_spill_bytes"] == 2048
+
+
+def test_queries_diff_endpoint(server):
+    _record_with_trees("qdiffa", 100)
+    _record_with_trees("qdiffb", 130, spills=3)
+
+    code, body, _ = _get(server.url + "/queries/diff?a=qdiffa&b=qdiffb")
+    assert code == 200
+    page = body.decode()
+    assert "output_rows=100-&gt;130 (+30)" in page
+    assert "mem_spill_count=0-&gt;3 (+3)" in page
+
+    code, body, _ = _get(server.url +
+                         "/queries/diff?a=qdiffa&b=qdiffb&format=json")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["a"]["query_id"] == "qdiffa"
+    (group,) = doc["diff"]["groups"]
+    by_name = {n["name"]: n for n in group["nodes"]}
+    assert by_name["SortExec"]["metrics"]["output_rows"]["delta"] == 30
+    assert by_name["ScanExec"]["metrics"]["output_rows"]["delta"] == 30
+
+    code, body, _ = _get(server.url + "/queries/diff?a=qdiffa")
+    assert code == 400
+    code, body, _ = _get(server.url +
+                         "/queries/diff?a=qdiffa&b=no-such-query")
+    assert code == 404
+
+
+def test_queries_diff_shape_mismatch(server):
+    from auron_tpu.runtime.metrics import MetricNode
+    from auron_tpu.runtime.explain_analyze import merge_metric_trees
+    _record_with_trees("qshape1", 10)
+    other = MetricNode("AggExec")
+    other.add("output_rows", 5)
+    merged = merge_metric_trees([other])
+    tracing.record_query(tracing.QueryRecord(
+        query_id="qshape2", wall_s=0.1, rows=5,
+        metric_trees=[{"tasks": n, "tree": t.to_dict()}
+                      for t, n in merged]))
+    code, body, _ = _get(server.url +
+                         "/queries/diff?a=qshape1&b=qshape2")
+    assert code == 400
+    assert b"plan shape" in body
+
+
 def test_concurrent_trace_429(server):
     """A second profile capture while one is in flight answers 429 —
     the jax profiler is process-global and concurrent start_trace calls

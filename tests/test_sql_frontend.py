@@ -193,7 +193,15 @@ QUERIES = {
 }
 
 
-@pytest.mark.parametrize("name", sorted(QUERIES))
+# PR 5 tier-1 budget split: the outer-join differential is the one 24s
+# straggler of this suite (the rest are <6s); nightly -m slow keeps it
+_SLOW_SQL = {"left_join"}
+
+
+@pytest.mark.parametrize(
+    "name",
+    [n if n not in _SLOW_SQL else
+     pytest.param(n, marks=pytest.mark.slow) for n in sorted(QUERIES)])
 def test_sql_native_matches_oracle(name, catalog):
     got, res = run_sql(QUERIES[name], catalog)
     assert res.all_native(), f"{name}: foreign sections left in plan"

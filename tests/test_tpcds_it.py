@@ -48,17 +48,31 @@ def runner(catalog):
         pass
 
 
-# tier-1 keeps a representative third of the corpus (every operator
+# tier-1 keeps a representative subset of the corpus (every operator
 # family: scans+pushdown, BHJ/SMJ/SHJ, two-phase/rollup aggs, window,
 # expand, union, generate) under the 870s gate budget; the remaining
 # queries run with the same fixtures under -m slow (nightly / full
 # sweeps).  Every query here was red before the jax shard_map compat
 # gate landed, so the split only widens coverage vs the seed.
-_TIER1_QUERIES = set(names()[::4]) | {
+#
+# PR 5 budget re-measure (2026-08-05): tier-1 clocked 971s against the
+# 870s timeout on a slow-drifted box (PR 4 measured 848s on a fast one;
+# this machine drifts ±30%), so the slowest stragglers — each >=9s
+# serial, families still covered by the remaining subset and by the
+# nightly -m slow sweep — moved out of the gate.  Measured serial costs:
+# q67r 20.2s, q39v 14.7s, q98 14.1s, q25m 13.8s, q76u 13.6s, q80s
+# 13.4s, q56s 12.3s, q20c 12.1s, q68s 11.9s, q22r 10.9s, q43 10.3s,
+# q79s 10.1s, q62w 9.1s (mesh variants of q80s/q56s/q62w/q39v add
+# another ~48s).  Post-split tier-1: 604-26=578ish tests in ~700s.
+_TIER1_STRAGGLERS = {
+    "q67r", "q39v", "q98", "q25m", "q76u", "q80s", "q56s", "q20c",
+    "q68s", "q22r", "q43", "q79s", "q62w",
+}
+_TIER1_QUERIES = (set(names()[::4]) | {
     "q03", "q07", "q42", "q55", "q13a", "q26a", "q48a", "q19", "q65w",
     "q71u", "q27r", "q93s", "q76u", "q22r", "q33b", "q60b", "q36r",
     "q62w", "q39v", "q56s", "q80s", "q01", "q16a", "q68s", "q98",
-}
+}) - _TIER1_STRAGGLERS
 
 
 @pytest.mark.parametrize(
@@ -93,7 +107,10 @@ MESH_QUERIES = ["q03", "q07", "q42", "q55", "q13a", "q26a", "q48a",
                 "q62w", "q39v", "q56s", "q80s"]
 
 
-@pytest.mark.parametrize("query", MESH_QUERIES)
+@pytest.mark.parametrize(
+    "query",
+    [q if q not in _TIER1_STRAGGLERS else
+     pytest.param(q, marks=pytest.mark.slow) for q in MESH_QUERIES])
 def test_tpcds_query_multi_device(mesh_runner, query):
     """Corpus queries offered to the SPMD stage compiler over the
     8-device mesh: SPMD-compilable plans run as one shard_map program

@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# CI memory-observability gate (CPU, no accelerator needed):
+#   1. run a tier-1 TPC-DS query traced under a tiny memory budget
+#      (serial path so consumers register) and dump the Chrome trace
+#   2. validate that mem.pressure / mem.spill event families appear
+#      with consumer attribution
+#   3. start the profiling server, force an attributed spill, and
+#      validate the /memory payload + the Prometheus memory gauges
+#   4. check the committed spill-sort EXPLAIN ANALYZE golden via the
+#      pytest hook
+#
+# The same checks run inside the suite (tests/test_memory_observability
+# .py::test_tools_mem_check_script, marked slow), mirroring how
+# lint_plans.sh / chaos_check.sh / trace_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out_dir=$(mktemp -d /tmp/auron_mem_check.XXXXXX)
+trap 'rm -rf "$out_dir"' EXIT
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m auron_tpu.trace run \
+    --query q01 --sf 0.002 --serial \
+    --budget 20000 --spill-trigger 1024 \
+    -o "$out_dir/q01.mem.trace.json"
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - "$out_dir/q01.mem.trace.json" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+events = [e for e in doc["traceEvents"] if isinstance(e, dict)]
+pressure = [e for e in events if e.get("name") == "mem.pressure"]
+spills = [e for e in events if e.get("name") == "mem.spill"]
+assert pressure, "no mem.pressure events in tiny-budget traced run"
+assert spills, "no mem.spill events in tiny-budget traced run"
+fracs = [e["args"]["fraction"] for e in pressure]
+assert fracs == sorted(fracs), f"watermark events not monotone: {fracs}"
+for e in spills:
+    args = e.get("args", {})
+    assert args.get("consumer") and args.get("path") in (
+        "arbitration", "self", "fallback"), f"unattributed spill: {e}"
+print(f"mem_check: {len(pressure)} pressure events "
+      f"(fractions {fracs}), {len(spills)} attributed spills")
+EOF
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import urllib.request
+
+from auron_tpu.config import conf
+from auron_tpu.memmgr.manager import MemConsumer, reset_manager
+from auron_tpu.runtime import profiling
+
+
+class C(MemConsumer):
+    def spill(self):
+        freed = self.mem_used
+        self.update_mem_used(0)
+        return freed
+
+
+with conf.scoped({"auron.memory.spill.min.trigger.bytes": 1}):
+    mgr = reset_manager(1000)
+    c = mgr.register_consumer(C("SortExec"))
+    c.update_mem_used(1500)
+    mgr.unregister_consumer(c)
+
+srv = profiling.ProfilingServer().start()
+try:
+    with urllib.request.urlopen(srv.url + "/memory", timeout=30) as r:
+        doc = json.load(r)
+    assert {"pool", "consumers", "consumer_totals", "spills"} <= set(doc)
+    assert doc["pool"]["num_spills"] == 1
+    assert doc["pool"]["peak_used"] == 1500
+    assert [c["fraction"] for c in doc["pool"]["watermarks_crossed"]] \
+        == doc["pool"]["watermark_fractions"]
+    (rec,) = doc["spills"]["records"]
+    assert rec["consumer"] == "SortExec" and rec["freed_bytes"] == 1500
+    with urllib.request.urlopen(srv.url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    for needle in ("auron_mem_peak_bytes 1500",
+                   "auron_mem_spill_bytes_total 1500",
+                   'auron_mem_consumer_peak_bytes{consumer="SortExec"}'):
+        assert needle in text, f"missing {needle!r} in /metrics"
+    print("mem_check: /memory payload + Prometheus gauges ok")
+finally:
+    srv.stop()
+    reset_manager()
+EOF
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m pytest -q \
+    -p no:cacheprovider \
+    tests/test_memory_observability.py::test_explain_analyze_memory_columns_and_golden
+
+echo "mem_check.sh: ok"
