@@ -12,6 +12,7 @@ Python runtime and (by name) the C++ host runtime.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 from dataclasses import dataclass, field
@@ -53,6 +54,18 @@ class Configuration:
         self._options: Dict[str, ConfigOption[Any]] = {}
         self._overrides: Dict[str, Any] = {}
         self._lock = threading.RLock()
+        # per-QUERY overlay: a contextvar-held dict consulted before the
+        # process-wide overrides, so concurrent queries served out of one
+        # process can carry different conf (the serving tier applies each
+        # submission's conf map here).  Propagation rides contextvars:
+        # task_pool copies the submitting context into worker threads, so
+        # a query's tasks see its overlay while other queries' tasks see
+        # theirs.  `scoped()` stays process-global (tests and drivers
+        # configure the whole engine); `query_scoped()` is the isolated
+        # form.
+        self._ctx_overlay: contextvars.ContextVar[
+            Optional[Dict[str, Any]]] = contextvars.ContextVar(
+                "auron_conf_overlay", default=None)
 
     def register(self, option: ConfigOption[T]) -> ConfigOption[T]:
         with self._lock:
@@ -69,6 +82,9 @@ class Configuration:
 
     def get(self, key: str) -> Any:
         opt = self._options[key]
+        overlay = self._ctx_overlay.get()
+        if overlay is not None and key in overlay:
+            return overlay[key]
         with self._lock:
             if key in self._overrides:
                 return self._overrides[key]
@@ -140,6 +156,45 @@ class Configuration:
         merged = dict(kv or {})
         merged.update({k.replace("_", "."): v for k, v in kv_underscored.items()})
         return Configuration._Scoped(self, merged)
+
+    class _QueryScoped:
+        """Context-local override scope (see _ctx_overlay): visible only
+        to the entering context and the contexts copied from it."""
+
+        def __init__(self, conf: "Configuration", kv: Dict[str, Any]):
+            self._conf = conf
+            # parse against the option types up front so a malformed
+            # submission conf fails at scope entry, not mid-query
+            parsed: Dict[str, Any] = {}
+            for k, v in kv.items():
+                opt = conf._options[k]   # KeyError = unknown option
+                if v is not None:
+                    v = opt.parse(v) if isinstance(v, str) and \
+                        opt.type is not str else opt.type(v)
+                parsed[k] = v
+            self._kv = parsed
+            self._token = None
+
+        def __enter__(self) -> "Configuration":
+            merged = dict(self._conf._ctx_overlay.get() or {})
+            merged.update(self._kv)   # nesting: inner keys win
+            self._token = self._conf._ctx_overlay.set(merged)
+            return self._conf
+
+        def __exit__(self, *exc) -> bool:
+            if self._token is not None:
+                self._conf._ctx_overlay.reset(self._token)
+            return False
+
+    def query_scoped(self, kv: Optional[Dict[str, Any]] = None
+                     ) -> "Configuration._QueryScoped":
+        """Temporarily override options for THIS context only (and any
+        context copied from it — task_pool worker tasks inherit).  Unlike
+        `scoped`, concurrent threads outside the scope keep their own
+        view; the serving tier wraps each query's driver in one of these
+        so per-query conf (priority, batch sizes, fault specs...) cannot
+        bleed between interleaved queries."""
+        return Configuration._QueryScoped(self, dict(kv or {}))
 
 
 _MISSING = object()
@@ -623,6 +678,81 @@ PROFILING_HTTP_ENABLE = conf.define(
     "Lazily start the HTTP profiling service on first task execution "
     "(reference feature http-service, exec.rs:53-59): /debug/profile "
     "(jax trace zip), /debug/pyspy (folded stacks), /metrics, /status.",
+)
+SPILL_VICTIM_STRATEGY = conf.define(
+    "auron.memory.spill.victim.strategy", "rate",
+    "How the memory manager ranks spill victims during arbitration: "
+    "'rate' prefers the consumer with the best observed freed-bytes-per-"
+    "wall-second from the spill attribution history (consumers with no "
+    "history rank by current size, i.e. fall back to largest-consumer, "
+    "and are tried first so they earn a history entry); 'largest' "
+    "restores the pure largest-consumer policy (lib.rs:303-423).",
+)
+QUERY_PRIORITY = conf.define(
+    "auron.query.priority", 1,
+    "Fair-share weight of a query's tasks in the shared task pool "
+    "(runtime/task_pool.py): per-query queues are drained weighted "
+    "round-robin, a weight-N query receiving N task slots per cycle.  "
+    "Set per query via the serving submission conf (or conf."
+    "query_scoped); clamped to [1, 64].",
+)
+SERVING_MAX_CONCURRENT = conf.define(
+    "auron.serving.max.concurrent", 4,
+    "Maximum queries the QueryScheduler (auron_tpu.serving) drives "
+    "concurrently; admitted submissions beyond it wait in the admission "
+    "queue.  Each running query gets its own driver thread and session; "
+    "their tasks share the fair-share task pool.",
+)
+SERVING_RESULT_MAX_ROWS = conf.define(
+    "auron.serving.result.max.rows", 65536,
+    "Row cap on the /result/<id> HTTP payload (JSON rows); larger "
+    "results are truncated with a 'truncated' marker in the response.",
+)
+ADMISSION_ENABLE = conf.define(
+    "auron.admission.enable", True,
+    "Gate query START on forecast memory peaks (auron_tpu.serving."
+    "admission): an admitted query's forecast is reserved out of the "
+    "MemManager budget (add_reservation) until it completes, and "
+    "submissions that do not fit wait in the admission queue (or are "
+    "shed / degraded to serial per the other auron.admission.* knobs).  "
+    "Off = every submission starts as soon as a driver slot is free.",
+)
+ADMISSION_DEFAULT_FORECAST_BYTES = conf.define(
+    "auron.admission.default.forecast.bytes", 64 << 20,
+    "Memory-peak forecast for a plan signature with no recorded "
+    "history (auron_tpu.serving.forecast).  Once a signature completes "
+    "a run, the observed per-operator mem_peak history replaces this.",
+)
+ADMISSION_FORECAST_MARGIN = conf.define(
+    "auron.admission.forecast.margin", 1.2,
+    "Multiplier applied to the recorded mem_peak history when "
+    "forecasting a submission's reservation (headroom for data growth "
+    "between runs of one plan signature).",
+)
+ADMISSION_MEMORY_FRACTION = conf.define(
+    "auron.admission.memory.fraction", 0.8,
+    "Fraction of the MemManager budget the admission controller may "
+    "promise to concurrently-running queries (sum of forecasts); a "
+    "submission pushing the ledger past it queues until a running "
+    "query releases its reservation.",
+)
+ADMISSION_QUEUE_MAX = conf.define(
+    "auron.admission.queue.max", 64,
+    "Admission queue length past which new submissions are SHED "
+    "(rejected with HTTP 429) instead of queued — bounded overload "
+    "behavior, the Sparkle-style arbitration backstop.",
+)
+ADMISSION_QUEUE_TIMEOUT_SECONDS = conf.define(
+    "auron.admission.queue.timeout.seconds", 300.0,
+    "A submission queued longer than this fails with an admission "
+    "timeout instead of waiting forever; <= 0 disables.",
+)
+ADMISSION_DEGRADE_SERIAL_FRACTION = conf.define(
+    "auron.admission.degrade.serial.fraction", 0.5,
+    "Forecasts above this fraction of the MemManager budget degrade "
+    "the query to SERIAL execution (task parallelism 1, no SPMD stage "
+    "program) so its concurrent-partition memory footprint shrinks "
+    "instead of being shed; 0 disables degradation.",
 )
 
 

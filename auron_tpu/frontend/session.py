@@ -119,7 +119,8 @@ class AuronSession:
     # -- public entry (preColumnarTransitions analogue) -------------------
 
     def execute(self, plan: ForeignNode,
-                mesh=None, mesh_axis: str = "parts") -> SessionResult:
+                mesh=None, mesh_axis: str = "parts",
+                query_id: Optional[str] = None) -> SessionResult:
         """Run a foreign plan.  With `mesh`, the converted native tree is
         first offered to the SPMD stage compiler (parallel/stage.py): the
         WHOLE pipeline — exchanges included — compiles to one shard_map
@@ -127,22 +128,24 @@ class AuronSession:
         to the serial per-partition path transparently.
 
         Every execute runs under a query scope (runtime/tracing.py): a
-        fresh query id correlates log prefixes, span attributes and the
-        query-history record; with `auron.trace.enable` set the full
-        lifecycle trace lands on `SessionResult.trace`."""
-        from auron_tpu.memmgr import get_manager
+        query id (minted fresh, or `query_id` — the serving tier passes
+        its submission id so `/queries` rows match `/status` ids)
+        correlates log prefixes, span attributes and the query-history
+        record; with `auron.trace.enable` set the full lifecycle trace
+        lands on `SessionResult.trace`.
+
+        Thread-safety: one execute per session instance at a time (the
+        serving scheduler creates a session per query); concurrent
+        executes MAY share the process (memory pool, task pool, shuffle
+        service are lock-protected, and attribution is contextvar-scoped
+        per query)."""
         from auron_tpu.runtime import counters, tracing
-        from auron_tpu.runtime import executor as _executor
-        from auron_tpu.runtime import retry as _retry
         from auron_tpu.runtime.explain_analyze import (
             merge_metric_trees, metric_max, metric_totals,
         )
 
-        scope = tracing.trace_scope()
+        scope = tracing.trace_scope(query_id=query_id)
         counters.bump("queries_started")
-        stats0 = _retry.stats_snapshot()
-        started0, _ = _executor.task_attempt_counts()
-        mem0 = get_manager().stats()
         t0 = time.perf_counter()
         wall_start = time.time()
         res: Optional[SessionResult] = None
@@ -157,27 +160,25 @@ class AuronSession:
             raise
         finally:
             wall_s = time.perf_counter() - t0
-            stats1 = _retry.stats_snapshot()
-            started1, _ = _executor.task_attempt_counts()
-            mem1 = get_manager().stats()
+            # per-query attribution sink (tracing.QueryStats): recovery
+            # and memory sites bumped the scope's own counters, so the
+            # record stays correct with other queries interleaving —
+            # the old global-counter diffs credited a query with every
+            # concurrent neighbor's retries and spills
+            st = scope.stats.snapshot()
             trees = res.metrics if res is not None else []
             tracing.record_query(tracing.QueryRecord(
                 query_id=scope.query_id, wall_s=wall_s,
                 rows=res.table.num_rows if res is not None else 0,
                 spmd=res.spmd if res is not None else False,
-                attempts=started1 - started0,
-                retries=stats1.get("retries", 0) - stats0.get("retries", 0),
-                fallbacks=stats1.get("fallbacks", 0)
-                - stats0.get("fallbacks", 0),
+                attempts=st.get("attempts", 0),
+                retries=st.get("retries", 0),
+                fallbacks=st.get("fallbacks", 0),
                 error=error, started_at=wall_start,
                 metric_totals=metric_totals(trees),
-                # pool deltas are monotone counters, so they attribute
-                # to THIS query even when a reset_manager never happened
                 mem_peak=metric_max(trees, "mem_peak"),
-                mem_spills=max(0, mem1.get("num_spills", 0)
-                               - mem0.get("num_spills", 0)),
-                mem_spill_bytes=max(0, mem1.get("spill_bytes_freed", 0)
-                                    - mem0.get("spill_bytes_freed", 0)),
+                mem_spills=st.get("mem_spills", 0),
+                mem_spill_bytes=st.get("mem_spill_bytes", 0),
                 metric_trees=[{"tasks": n, "tree": t.to_dict()}
                               for t, n in merge_metric_trees(trees)],
                 trace=scope.recorder.to_chrome_trace()

@@ -2,8 +2,11 @@
 
 Mirrors the decision structure of auron-memmgr/src/lib.rs:303-423
 (`Operation::{Spill, Wait, Nothing}`): when a consumer grows past its fair
-share and the pool is exhausted, the largest spillable consumer is asked to
-spill; tiny consumers (< MIN_TRIGGER_SIZE) are never forced.  Single-process
+share and the pool is exhausted, a spillable consumer is asked to spill —
+ranked by observed freed-bytes-per-wall-second from the attribution
+history, falling back to largest-consumer for classes with no history
+(`_pick_spill_victim`; `auron.memory.spill.victim.strategy`); tiny
+consumers (< MIN_TRIGGER_SIZE) are never forced.  Single-process
 synchronous version: "Wait" (multi-task backpressure) degenerates into
 immediate spill of the requester.
 
@@ -268,6 +271,11 @@ class MemManager:
                 del self._spill_records[
                     :len(self._spill_records) - self.MAX_SPILL_RECORDS]
         from auron_tpu.runtime import tracing
+        # attribute the spill to the query whose task triggered it (the
+        # spill runs on the task's thread, which carries the query's
+        # context) — /queries rows stay per-query under concurrency
+        tracing.stats_bump("mem_spills")
+        tracing.stats_bump("mem_spill_bytes", rec.freed_bytes)
         tracing.event("mem.spill", cat="mem", consumer=rec.consumer,
                       requested_by=rec.requested_by, path=rec.path,
                       freed_bytes=rec.freed_bytes,
@@ -290,6 +298,39 @@ class MemManager:
         self._record_spill(target, requester, path, freed,
                            time.perf_counter_ns() - t0)
         return freed
+
+    def _pick_spill_victim(self, candidates: List[MemConsumer]
+                           ) -> MemConsumer:
+        """Rank arbitration victims (lock held).
+
+        `auron.memory.spill.victim.strategy`:
+
+        - ``rate`` (default): prefer the consumer class with the best
+          observed freed-bytes-per-wall-second from the spill
+          attribution history (`_by_name`) — spilling a consumer that
+          historically frees a lot quickly buys the most headroom per
+          second of stall, and a "sticky" class that spills slowly or
+          frees nothing sinks to the bottom instead of being hammered
+          for being big.  Consumers with NO history rank ABOVE every
+          measured one (optimistic: unknown classes are tried once so
+          they earn a history entry), tie-broken by current size — i.e.
+          the no-history fallback IS the classic largest-consumer pick.
+        - ``largest``: the reference's pure largest-consumer policy
+          (lib.rs:303-423).
+        """
+        if str(conf.get("auron.memory.spill.victim.strategy")) \
+                == "largest":
+            return max(candidates, key=lambda c: c.mem_used)
+
+        def rank(c: MemConsumer):
+            ent = self._by_name.get(c.name)
+            if ent and ent.get("spills") and ent.get("wall_ns"):
+                rate = ent["freed_bytes"] / ent["wall_ns"]
+            else:
+                rate = float("inf")   # no history: try it, seed history
+            return (rate, c.mem_used, c.name)
+
+        return max(candidates, key=rank)
 
     def update(self, consumer: MemConsumer, new_bytes: int) -> None:
         """Update usage; may synchronously trigger spills (of this consumer
@@ -318,8 +359,7 @@ class MemManager:
                               if c.spillable and c.mem_used >= trigger and
                               getattr(c, "_owner_thread", me) == me]
                 if candidates:
-                    spill_target = max(candidates,
-                                       key=lambda c: c.mem_used)
+                    spill_target = self._pick_spill_victim(candidates)
                 # else: over budget but nothing is big enough to bother —
                 # allow (reference returns Nothing below MIN_TRIGGER_SIZE)
         if pressure:

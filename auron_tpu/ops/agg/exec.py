@@ -408,10 +408,21 @@ class AggExec(Operator, MemConsumer):
         self.update_mem_used(self._staged_mem_bytes())
 
     def _staged_batch(self) -> Optional[Batch]:
-        """Collapse staged entries to one grouped Batch (lazy count)."""
+        """Collapse staged entries to one grouped Batch (lazy count).
+
+        May return None even when entries were staged on entry: the
+        accounting update inside _compact_staged can push the pool over
+        budget, and arbitration may choose THIS consumer as the spill
+        victim — moving the collapsed groups into self._spills and
+        emptying _staged out from under the caller.  (With concurrent
+        queries sharing one pool, foreign pressure can land at ANY
+        update.)  Callers must treat None with non-empty self._spills
+        as "the state moved to the spill tier", never as data loss."""
         if not self._staged:
             return None
         self._compact_staged()
+        if not self._staged:
+            return None
         cols, n_dev, cap = self._staged[0]
         return Batch(self._state_schema(), cols, n_dev, cap)
 
@@ -507,6 +518,12 @@ class AggExec(Operator, MemConsumer):
         if not self._staged or self._has_host_aggs:
             return 0
         acc = self._staged_batch()
+        if acc is None:
+            # this spill ran OUTSIDE the manager's re-entrancy guard
+            # (_emit_tail calls spill() directly) and the collapse's own
+            # accounting update arbitrated a nested spill of this same
+            # consumer — the state is already on disk, nothing to write
+            return 0
         freed = self._staged_mem_bytes()
         spill = self._spills.new_spill()
         size = spill.write_batches([acc.to_arrow()])
@@ -615,10 +632,14 @@ class AggExec(Operator, MemConsumer):
                     "auron.partial.agg.skipping.skip.spill"))
                 if skip_ok and ratio >= float(conf.get(
                         "auron.partial.agg.skipping.ratio")):
-                    self._passthrough = True
                     acc = self._staged_batch()
-                    if acc is not None:
-                        yield acc
+                    if acc is None:
+                        # staged state was spilled out from under the
+                        # collapse (concurrent pool pressure): stay in
+                        # update mode, the spill-merge tail finalizes
+                        continue
+                    self._passthrough = True
+                    yield acc
                     self._staged = []
                     self.update_mem_used(0)
                     break
@@ -645,6 +666,13 @@ class AggExec(Operator, MemConsumer):
             yield from self._merge_spilled()
             return
         acc = self._staged_batch()
+        if acc is None and len(self._spills):
+            # the collapse itself was spilled out from under us (the
+            # accounting update in _compact_staged arbitrated this very
+            # consumer under concurrent pool pressure) — the groups are
+            # intact in the spill runs, merge them instead
+            yield from self._merge_spilled()
+            return
         if not self.grouping and self.exec_mode != "partial" and \
                 (acc is None or acc.num_rows == 0):
             # global agg over an empty (or fully-filtered, where staged

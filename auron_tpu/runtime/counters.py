@@ -28,6 +28,13 @@ _COUNTERS: Dict[str, int] = {
     "queries_started": 0,
     "queries_completed": 0,
     "queries_failed": 0,
+    # serving tier (auron_tpu.serving): submissions + admission outcomes
+    "queries_submitted": 0,
+    "queries_cancelled": 0,
+    "admission_admitted": 0,
+    "admission_queued": 0,
+    "admission_shed": 0,
+    "admission_degraded": 0,
 }
 
 

@@ -128,6 +128,10 @@ def execute_task(task: P.TaskDefinition,
 
     def _attempt():
         counters.bump("tasks_started")
+        # per-query attribution: the ambient QueryStats (trace_scope)
+        # counts this attempt for the query it belongs to — the global
+        # counter above keeps serving process totals
+        tracing.stats_bump("attempts")
         with task_logging.task_scope(task.stage_id, task.partition_id):
             # runtime construction sits inside the task scope so
             # plan-verifier diagnostics (create_verified_plan) and

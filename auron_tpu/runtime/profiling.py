@@ -26,6 +26,18 @@ TPU analogue on a free port, started lazily on first task execution when
   used/peak/reserved, watermark crossings, per-consumer top-N (live and
   cumulative), attributed spill records + size histogram
 - GET /status                   — build info (the Auron UI tab analogue)
+
+SERVING routes (auron_tpu.serving promotes this same server into the
+query-submission endpoint; 503 until a QueryScheduler is installed —
+QueryServer.start() or serving.install_scheduler()):
+
+- POST /submit                  — {"plan": <foreign-plan dict>} or
+  {"corpus": name, "sf": F}, plus optional "conf"/"priority"; replies
+  {"query_id": ...}; 429 when admission sheds the submission
+- GET /status/<id>              — submission state + admission info
+- GET /result/<id>              — result rows as JSON (row-capped)
+- POST /cancel/<id>             — cancel a queued/running query
+- GET /scheduler                — scheduler/admission/task-queue stats
 """
 
 from __future__ import annotations
@@ -162,6 +174,12 @@ def _prometheus_text() -> str:
     for key in ("attempts", "retries", "exhausted", "fallbacks"):
         emit(f"auron_retry_{key}_total", snap.get(f"retry_{key}", 0),
              help_=f"shared retry policy: {key}")
+    for key in ("queries_submitted", "queries_cancelled",
+                "admission_admitted", "admission_queued",
+                "admission_shed", "admission_degraded"):
+        emit(f"auron_{key}_total", snap.get(key, 0),
+             help_="serving tier: "
+                   f"{key.replace('_', ' ')} count")
     mgr = get_manager()
     mem = mgr.stats()
     emit("auron_mem_budget_bytes", mem.get("budget", 0), "gauge",
@@ -322,6 +340,23 @@ def _queries_diff(qa: str, qb: str, as_json: bool):
     return 200, body.encode(), "text/html"
 
 
+def _serving_scheduler():
+    from auron_tpu.serving.server import active_scheduler
+    return active_scheduler()
+
+
+def _result_payload(table) -> dict:
+    """JSON form of a result table, row-capped
+    (auron.serving.result.max.rows)."""
+    from auron_tpu import config
+    cap = int(config.conf.get("auron.serving.result.max.rows"))
+    truncated = table.num_rows > cap
+    rows = table.slice(0, cap).to_pylist() if truncated \
+        else table.to_pylist()
+    return {"num_rows": table.num_rows, "truncated": truncated,
+            "columns": table.column_names, "rows": rows}
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -333,6 +368,52 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_json(self, code: int, doc) -> None:
+        self._send(code, json.dumps(doc, default=str).encode())
+
+    # -- serving routes (POST /submit, /cancel/<id>) -----------------------
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+        url = urlparse(self.path)
+        try:
+            sched = _serving_scheduler()
+            if sched is None:
+                self._send_json(503, {"error": "no query scheduler "
+                                      "running (start a QueryServer)"})
+                return
+            if url.path == "/submit":
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except Exception as e:
+                    self._send_json(400, {"error": f"bad JSON body: {e}"})
+                    return
+                from auron_tpu.serving.scheduler import SubmissionRejected
+                from auron_tpu.serving.server import parse_submission
+                try:
+                    plan = parse_submission(body)
+                    qid = sched.submit(
+                        plan, conf=body.get("conf"),
+                        priority=body.get("priority"),
+                        query_id=body.get("query_id"))
+                except SubmissionRejected as e:
+                    self._send_json(429, {"error": str(e)})
+                    return
+                except (ValueError, KeyError) as e:
+                    # KeyError: unknown conf option in the overlay parse
+                    self._send_json(400, {"error": str(e)})
+                    return
+                self._send_json(200, {"query_id": qid,
+                                      "status_url": f"/status/{qid}"})
+            elif url.path.startswith("/cancel/"):
+                qid = url.path[len("/cancel/"):]
+                self._send_json(200, {"query_id": qid,
+                                      "cancelled": sched.cancel(qid)})
+            else:
+                self._send_json(404, {"error": "not found"})
+        except Exception as e:  # pragma: no cover - defensive
+            self._send_json(500, {"error": str(e)})
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib API)
         url = urlparse(self.path)
@@ -386,6 +467,41 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(404, b'{"error": "no trace for query"}')
                 else:
                     self._send(200, json.dumps(rec.trace).encode())
+            elif url.path.startswith("/status/"):
+                sched = _serving_scheduler()
+                if sched is None:
+                    self._send_json(503, {"error": "no query scheduler "
+                                          "running"})
+                    return
+                st = sched.status(url.path[len("/status/"):])
+                if st is None:
+                    self._send_json(404, {"error": "unknown query id"})
+                else:
+                    self._send_json(200, st)
+            elif url.path.startswith("/result/"):
+                sched = _serving_scheduler()
+                if sched is None:
+                    self._send_json(503, {"error": "no query scheduler "
+                                          "running"})
+                    return
+                qid = url.path[len("/result/"):]
+                st = sched.status(qid)
+                if st is None:
+                    self._send_json(404, {"error": "unknown query id"})
+                elif st["state"] != "succeeded":
+                    self._send_json(409, {"error": f"query is "
+                                          f"{st['state']}, not "
+                                          f"succeeded", "status": st})
+                else:
+                    self._send_json(200, _result_payload(
+                        sched.result(qid)))
+            elif url.path == "/scheduler":
+                sched = _serving_scheduler()
+                if sched is None:
+                    self._send_json(503, {"error": "no query scheduler "
+                                          "running"})
+                else:
+                    self._send_json(200, sched.stats())
             elif url.path == "/status":
                 from auron_tpu.build_info import build_info
                 self._send(200, json.dumps(build_info()).encode())

@@ -139,6 +139,7 @@ def add_fallback(n: int = 1) -> None:
     """Record a degradation event (SPMD -> serial path)."""
     _bump("fallbacks", n)
     from auron_tpu.runtime import tracing
+    tracing.stats_bump("fallbacks", n)
     tracing.event("fallback", cat="retry", tier="spmd->serial")
 
 
@@ -147,6 +148,7 @@ def add_retry(n: int = 1) -> None:
     stage driver's guard-trip / device-fault re-runs)."""
     _bump("retries", n)
     from auron_tpu.runtime import tracing
+    tracing.stats_bump("retries", n)
     tracing.event("retry", cat="retry", tier="spmd-stage")
 
 
@@ -197,6 +199,7 @@ def call_with_retry(fn: Callable[[], Any],
                 # classified error (runtime/tracing.py): a traced chaos
                 # run shows exactly which attempt re-drew which fault
                 from auron_tpu.runtime import tracing
+                tracing.stats_bump("retries")
                 tracing.event("retry", cat="retry", label=label or "call",
                               attempt=attempt,
                               error=f"{type(e).__name__}: {e}",

@@ -1,16 +1,44 @@
-"""Shared per-partition task pool (rt.rs:76-139 analogue: one native
-runtime per task, tasks across cores).  Sizing policy lives HERE so the
-serial fallback, the exchange map side, and the SPMD scan feed cannot
-drift: auron.task.parallelism, 0 = auto (min(8, cpu count)),
-1 = sequential.  Results keep task order.
+"""Shared fair-share task pool (rt.rs:76-139 analogue: one native
+runtime per task, tasks across cores) — now ONE process-wide worker pool
+serving EVERY concurrent query.
 
-Failure semantics (the Spark TaskSetManager contract): the FIRST failure
-is ferried to the caller, not-yet-started sibling tasks are cancelled,
-already-running siblings drain (their errors are logged, never lost
-silently), and each task gets a bounded retry budget for
+The pre-serving shape built a private ThreadPoolExecutor per run_tasks
+call and drained it FIFO: with several queries in flight a 1000-partition
+query monopolized every core until its queue emptied, starving a
+2-partition query submitted a millisecond later.  Now each query (keyed
+by the ambient query id, runtime/tracing.py) owns a task queue and the
+shared workers drain the queues weighted round-robin: a cycle hands each
+active query `auron.query.priority` task slots (default 1), so task
+*latency* is proportional to the number of running queries, never to the
+width of the widest one — the isolation contract of the reference's
+one-tokio-runtime-per-task inside a shared executor process (PAPER.md).
+
+Sizing policy lives HERE so the serial fallback, the exchange map side,
+and the SPMD scan feed cannot drift: auron.task.parallelism, 0 = auto
+(min(8, cpu count)), 1 = sequential.  The conf value at call time also
+caps a single run_tasks call's concurrent tasks (`max_active`), matching
+the old per-call pool bound.  Results keep task order.
+
+Failure semantics (the Spark TaskSetManager contract) are unchanged: the
+FIRST failure is ferried to the caller, not-yet-started sibling tasks
+are cancelled, already-running siblings drain (their errors are logged,
+never lost silently), and each task gets a bounded retry budget for
 retryable-classified errors (runtime/retry.py; 1 + auron.task.retries
-attempts).  The old `pool.map` shape raised the first error while
-siblings kept running and swallowed their exceptions.
+attempts).
+
+Query-level cancellation (the serving tier's `/cancel` path): marking a
+query id cancelled makes its queued tasks fail fast with QueryCancelled
+(deterministic — never retried) and rejects its future run_tasks calls;
+already-running tasks drain.
+
+Each task runs inside a COPY of the submitting context, so the ambient
+query id, trace recorder, per-query stats sink and per-query conf
+overlay (config.query_scoped) all propagate to worker threads no matter
+which query's task a worker ran previously.
+
+DEADLOCK GUARD: a run_tasks call issued FROM a pool worker runs inline
+(sequentially) instead of enqueueing — a saturated pool waiting on its
+own sub-tasks could otherwise wedge.
 """
 
 from __future__ import annotations
@@ -18,7 +46,9 @@ from __future__ import annotations
 import contextvars
 import logging
 import os
-from typing import Any, Callable, List, Optional, Sequence
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 from auron_tpu.config import conf
 from auron_tpu.runtime.retry import RetryPolicy, call_with_retry, \
@@ -26,12 +56,324 @@ from auron_tpu.runtime.retry import RetryPolicy, call_with_retry, \
 
 log = logging.getLogger("auron_tpu.runtime")
 
+__all__ = ["pool_size", "run_tasks", "QueryCancelled", "cancel_query",
+           "clear_cancelled", "is_cancelled", "shared_pool", "reset_pool"]
+
+# key used for work submitted outside any query scope (direct
+# execute_plan calls, tests) — still fair-shared as one queue
+_ANON = "_anon"
+
+
+class QueryCancelled(RuntimeError):
+    """The query owning this task was cancelled (serving /cancel).
+    Deterministic by classification: the task tier never retries it."""
+
 
 def pool_size() -> int:
     n = int(conf.get("auron.task.parallelism"))
     if n <= 0:
         n = min(8, os.cpu_count() or 4)
     return n
+
+
+def query_weight() -> int:
+    """Fair-share weight for the ambient query (auron.query.priority,
+    clamped to [1, 64]); read at submit time so the per-query conf
+    overlay decides it."""
+    try:
+        w = int(conf.get("auron.query.priority"))
+    except Exception:  # noqa: BLE001 - a bad override must not kill tasks
+        w = 1
+    return max(1, min(w, 64))
+
+
+# -- query-level cancellation (module-level: usable before/without a pool)
+
+_CANCELLED: Set[str] = set()
+_CANCELLED_LOCK = threading.Lock()
+
+
+def cancel_query(query_id: str) -> None:
+    """Mark a query id cancelled: its queued tasks fail fast with
+    QueryCancelled and future run_tasks calls under that id reject."""
+    with _CANCELLED_LOCK:
+        _CANCELLED.add(query_id)
+    pool = _POOL
+    if pool is not None:
+        pool.kick()
+
+
+def clear_cancelled(query_id: str) -> None:
+    with _CANCELLED_LOCK:
+        _CANCELLED.discard(query_id)
+
+
+def is_cancelled(query_id: Optional[str]) -> bool:
+    if query_id is None:
+        return False
+    with _CANCELLED_LOCK:
+        return query_id in _CANCELLED
+
+
+# ---------------------------------------------------------------------------
+# task groups (one per run_tasks call)
+# ---------------------------------------------------------------------------
+
+class _TaskGroup:
+    """Result slots + completion latch + first-error ferry for one
+    run_tasks call."""
+
+    __slots__ = ("prefix", "results", "first_err", "cancelled", "pending",
+                 "active", "max_active", "lock", "done")
+
+    def __init__(self, n: int, prefix: str, max_active: int):
+        self.prefix = prefix
+        self.results: List[Any] = [None] * n
+        self.first_err: Optional[BaseException] = None
+        self.cancelled = False        # stop handing out queued siblings
+        self.pending = n
+        self.active = 0               # running tasks (pool cv guards it)
+        self.max_active = max_active  # per-call parallelism cap
+        self.lock = threading.Lock()
+        self.done = threading.Event()
+
+    def _one_done_locked(self) -> None:
+        self.pending -= 1
+        if self.pending <= 0:
+            self.done.set()
+
+
+class _Task:
+    __slots__ = ("group", "idx", "fn", "item", "ctx", "key", "skip")
+
+    def __init__(self, group: _TaskGroup, idx: int, fn, item,
+                 ctx: contextvars.Context, key: str):
+        self.group = group
+        self.idx = idx
+        self.fn = fn
+        self.item = item
+        self.ctx = ctx
+        self.key = key
+        # decided ONCE at pop time (pool cv held, paired with the
+        # group.active increment) — re-evaluating later would race
+        # cancellation and unbalance the active count
+        self.skip = False
+
+
+# ---------------------------------------------------------------------------
+# the shared pool
+# ---------------------------------------------------------------------------
+
+class SharedTaskPool:
+    """Process-wide workers over per-query queues, drained weighted
+    round-robin (deficit-style: each queue spends `weight` credits per
+    rotation)."""
+
+    def __init__(self, size: int):
+        self._cv = threading.Condition()
+        self._queues: Dict[str, deque] = {}
+        self._weights: Dict[str, int] = {}
+        self._credits: Dict[str, int] = {}
+        self._refs: Dict[str, int] = {}      # active run_tasks calls/key
+        self._order: List[str] = []          # arrival order = RR rotation
+        self._cursor = 0
+        self._threads: List[threading.Thread] = []
+        self._shutdown = False
+        self._tls = threading.local()
+        with self._cv:
+            for _ in range(size):
+                self._spawn_worker_locked()
+
+    # -- workers -----------------------------------------------------------
+
+    def _spawn_worker_locked(self) -> None:
+        t = threading.Thread(target=self._worker, daemon=True,
+                             name=f"auron-pool-{len(self._threads)}")
+        self._threads.append(t)
+        t.start()
+
+    @property
+    def size(self) -> int:
+        return len(self._threads)
+
+    def ensure_size(self, n: int) -> None:
+        """Grow (never shrink) to at least n workers — a caller whose
+        conf asks for more parallelism than the pool was born with."""
+        with self._cv:
+            while len(self._threads) < n and not self._shutdown:
+                self._spawn_worker_locked()
+
+    def in_worker(self) -> bool:
+        return bool(getattr(self._tls, "worker", False))
+
+    def kick(self) -> None:
+        """Wake every worker (cancellation flipped task runnability)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def _worker(self) -> None:
+        self._tls.worker = True
+        while True:
+            with self._cv:
+                task = self._next_task_locked()
+                while task is None:
+                    if self._shutdown:
+                        return
+                    self._cv.wait()
+                    task = self._next_task_locked()
+            self._execute(task)
+
+    # -- weighted round-robin pick (cv held) -------------------------------
+
+    def _next_task_locked(self) -> Optional[_Task]:
+        order = self._order
+        if not order:
+            return None
+        # two sweeps worst case: the first may only refill spent credits
+        for _ in range(2 * len(order)):
+            key = self._order[self._cursor % len(self._order)]
+            q = self._queues.get(key)
+            if not q:
+                # idle queue: keep a full credit for when work arrives
+                self._credits[key] = self._weights.get(key, 1)
+                self._cursor += 1
+                continue
+            head = q[0]
+            g = head.group
+            skip = g.cancelled or is_cancelled(key)
+            if not skip and g.active >= g.max_active:
+                # head group is at its per-call parallelism cap — hand
+                # the slot to another query rather than busy-hold it
+                self._credits[key] = self._weights.get(key, 1)
+                self._cursor += 1
+                continue
+            if self._credits.get(key, 1) <= 0:
+                self._credits[key] = self._weights.get(key, 1)
+                self._cursor += 1
+                continue
+            self._credits[key] -= 1
+            q.popleft()
+            head.skip = skip
+            if not skip:
+                g.active += 1
+            return head
+        return None
+
+    # -- task execution (no pool lock held) --------------------------------
+
+    def _execute(self, t: _Task) -> None:
+        g = t.group
+        if t.skip:
+            # skipped task: sibling-ferry cancellations complete silently
+            # (results stay None behind the ferried error); query-level
+            # cancellation FAILS the group so run_tasks raises
+            with g.lock:
+                if is_cancelled(t.key) and g.first_err is None:
+                    g.first_err = QueryCancelled(
+                        f"query {t.key!r} cancelled")
+                    g.cancelled = True
+                g._one_done_locked()
+            self.kick()
+            return
+        try:
+            result = t.ctx.copy().run(t.fn, t.item)
+        except BaseException as e:  # noqa: BLE001 - ferried below
+            with g.lock:
+                if g.first_err is None:
+                    g.first_err = e
+                    g.cancelled = True   # queued siblings are skipped
+                else:
+                    # sibling failures after the ferried one: logged, not
+                    # lost (the old pool.map shape dropped these)
+                    log.warning("%s[%d] failed after the first ferried "
+                                "error: %s: %s", g.prefix, t.idx,
+                                type(e).__name__, e)
+                g._one_done_locked()
+        else:
+            with g.lock:
+                g.results[t.idx] = result
+                g._one_done_locked()
+        finally:
+            with self._cv:
+                g.active -= 1
+                self._cv.notify_all()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, key: str, weight: int, fn, items: Sequence[Any],
+               prefix: str, max_active: int) -> _TaskGroup:
+        group = _TaskGroup(len(items), prefix, max_active)
+        ctx = contextvars.copy_context()
+        tasks = [_Task(group, i, fn, item, ctx, key)
+                 for i, item in enumerate(items)]
+        with self._cv:
+            if key not in self._refs:
+                self._refs[key] = 0
+                self._order.append(key)
+                self._queues[key] = deque()
+                self._credits[key] = weight
+            self._refs[key] += 1
+            self._weights[key] = weight
+            self._queues[key].extend(tasks)
+            self._cv.notify_all()
+        return group
+
+    def finish(self, key: str) -> None:
+        """One run_tasks call under `key` ended; drop the queue once the
+        last concurrent call for the key is done and its queue drained."""
+        with self._cv:
+            self._refs[key] = self._refs.get(key, 1) - 1
+            if self._refs[key] <= 0 and not self._queues.get(key):
+                self._refs.pop(key, None)
+                self._queues.pop(key, None)
+                self._weights.pop(key, None)
+                self._credits.pop(key, None)
+                if key in self._order:
+                    self._order.remove(key)
+
+    def queue_snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Per-query queue depth/weight — the /scheduler debug view."""
+        with self._cv:
+            return {k: {"queued": len(self._queues.get(k, ())),
+                        "weight": self._weights.get(k, 1)}
+                    for k in self._order}
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+
+_POOL: Optional[SharedTaskPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def shared_pool() -> SharedTaskPool:
+    """The process-wide pool, created on first parallel use; grows if a
+    later caller's conf asks for more workers."""
+    global _POOL
+    n = pool_size()
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = SharedTaskPool(max(n, 2))
+        elif _POOL.size < n:
+            _POOL.ensure_size(n)
+        return _POOL
+
+
+def reset_pool() -> None:
+    """Test hook: retire the shared pool (idle workers exit; a fresh
+    pool spawns on next use)."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is not None:
+            _POOL.shutdown()
+            _POOL = None
+
+
+def _current_key() -> str:
+    from auron_tpu.runtime import tracing
+    return tracing.current_query_id() or _ANON
 
 
 def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
@@ -53,40 +395,30 @@ def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
                                    classify=task_classify,
                                    on_retry=_on_retry)
 
+    key = _current_key()
+    if is_cancelled(key):
+        raise QueryCancelled(f"query {key!r} cancelled")
     size = pool_size()
-    if len(items) <= 1 or size <= 1:
-        return [run(i) for i in items]
+    pool = _POOL
+    if len(items) <= 1 or size <= 1 or \
+            (pool is not None and pool.in_worker()):
+        # sequential: single task, parallelism pinned to 1, or a nested
+        # call on a pool worker (inline keeps the shared pool from
+        # deadlocking on itself)
+        out = []
+        for item in items:
+            if is_cancelled(key):
+                raise QueryCancelled(f"query {key!r} cancelled")
+            out.append(run(item))
+        return out
 
-    from concurrent.futures import ThreadPoolExecutor, as_completed
-    results: List[Any] = [None] * len(items)
-    first_err: Optional[BaseException] = None
-    # worker threads run each task inside a COPY of the submitting
-    # context: the ambient query id + trace recorder (runtime/tracing.py
-    # contextvars) propagate, so spans/log prefixes recorded on pool
-    # threads correlate with the driver's query scope
-    ctx = contextvars.copy_context()
-    with ThreadPoolExecutor(max_workers=min(size, len(items)),
-                            thread_name_prefix=prefix) as pool:
-        futures = {pool.submit(ctx.copy().run, run, item): i
-                   for i, item in enumerate(items)}
-        for fut in as_completed(futures):
-            idx = futures[fut]
-            if fut.cancelled():
-                continue
-            exc = fut.exception()
-            if exc is None:
-                results[idx] = fut.result()
-            elif first_err is None:
-                first_err = exc
-                # stop handing out queued work; running tasks drain
-                for other in futures:
-                    other.cancel()
-            else:
-                # sibling failures after the ferried one: logged, not
-                # lost (the pool.map shape dropped these on the floor)
-                log.warning("%s[%d] failed after the first ferried "
-                            "error: %s: %s", prefix, idx,
-                            type(exc).__name__, exc)
-    if first_err is not None:
-        raise first_err
-    return results
+    pool = shared_pool()
+    group = pool.submit(key, query_weight(), run, items, prefix,
+                        max_active=min(size, len(items)))
+    try:
+        group.done.wait()
+    finally:
+        pool.finish(key)
+    if group.first_err is not None:
+        raise group.first_err
+    return group.results

@@ -48,8 +48,9 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 from auron_tpu.config import conf
 
 __all__ = [
-    "Span", "TraceRecorder", "QueryRecord", "span", "event",
-    "current_recorder", "current_query_id", "start_query", "trace_scope",
+    "Span", "TraceRecorder", "QueryRecord", "QueryStats", "span", "event",
+    "current_recorder", "current_query_id", "current_stats", "stats_bump",
+    "start_query", "trace_scope",
     "validate_chrome_trace", "summarize_chrome_trace", "query_history",
     "record_query", "history_metric_totals", "clear_history",
 ]
@@ -180,10 +181,53 @@ class _SpanCtx:
         return False
 
 
+class QueryStats:
+    """Per-query attribution counters, armed by `trace_scope` alongside
+    the query id and propagated to task threads the same contextvar way.
+
+    Before the serving tier, `AuronSession.execute` attributed attempts/
+    retries/fallbacks/spills to a query by DIFFING the process-global
+    counters around the run — correct with one query in flight, garbage
+    with two (query A's retries landed in whichever record closed next).
+    Recovery and memory sites now ALSO bump the ambient QueryStats, so
+    `/queries` rows stay per-query under interleaving; the process-global
+    counters keep serving `/metrics` totals unchanged."""
+
+    __slots__ = ("_lock", "_counts")
+    KEYS = ("attempts", "retries", "fallbacks", "mem_spills",
+            "mem_spill_bytes")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = dict.fromkeys(self.KEYS, 0)
+
+    def bump(self, key: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + int(delta)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
 _recorder: contextvars.ContextVar[Optional[TraceRecorder]] = \
     contextvars.ContextVar("auron_trace_recorder", default=None)
 _query_id: contextvars.ContextVar[Optional[str]] = \
     contextvars.ContextVar("auron_query_id", default=None)
+_stats: contextvars.ContextVar[Optional[QueryStats]] = \
+    contextvars.ContextVar("auron_query_stats", default=None)
+
+
+def current_stats() -> Optional[QueryStats]:
+    return _stats.get()
+
+
+def stats_bump(key: str, delta: int = 1) -> None:
+    """Attribute a recovery/memory event to the ambient query (no-op
+    outside a query scope — one contextvar read, mirroring `event`)."""
+    sink = _stats.get()
+    if sink is not None:
+        sink.bump(key, delta)
 
 
 def current_recorder() -> Optional[TraceRecorder]:
@@ -237,11 +281,16 @@ class trace_scope:
             self.recorder = TraceRecorder(self.query_id)
         else:
             self.recorder = None
+        # always armed (cheap): the per-query attribution sink recovery
+        # and memory sites bump into (see QueryStats)
+        self.stats = QueryStats()
         self._tok_rec = None
         self._tok_qid = None
+        self._tok_stats = None
 
     def __enter__(self) -> "trace_scope":
         self._tok_qid = _query_id.set(self.query_id)
+        self._tok_stats = _stats.set(self.stats)
         if self.recorder is not None:
             self._tok_rec = _recorder.set(self.recorder)
         return self
@@ -249,6 +298,8 @@ class trace_scope:
     def __exit__(self, *exc) -> bool:
         if self._tok_rec is not None:
             _recorder.reset(self._tok_rec)
+        if self._tok_stats is not None:
+            _stats.reset(self._tok_stats)
         if self._tok_qid is not None:
             _query_id.reset(self._tok_qid)
         return False

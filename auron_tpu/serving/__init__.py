@@ -1,0 +1,37 @@
+"""Concurrent multi-query serving runtime.
+
+The tier above the executor: many queries against ONE process's memory
+pool and task pool, reproducing the reference's isolation contract (one
+tokio runtime per task inside a shared executor process, PAPER.md) in
+session-server form.
+
+- `serving.server.QueryServer` — the profiling HTTP server promoted to
+  a submission endpoint (POST /submit, /status/<id>, /result/<id>,
+  /cancel/<id>, /scheduler — same port as /metrics and /queries).
+- `serving.scheduler.QueryScheduler` — submission states, driver
+  threads, priority queue, cancellation.
+- `serving.admission` — memory admission control: forecast-gated start
+  (reservations through `MemManager.add_reservation`), queue / shed /
+  degrade-to-serial under overload (`auron.admission.*`).
+- `serving.forecast` — plan-signature keyed `mem_peak` history feeding
+  the forecasts (PR 5's accounting layer closing its loop).
+- fair-share task scheduling itself lives in `runtime/task_pool.py`
+  (per-query queues, weighted round-robin by `auron.query.priority`).
+"""
+
+from auron_tpu.serving.admission import AdmissionController
+from auron_tpu.serving.forecast import MemForecaster, plan_signature
+from auron_tpu.serving.scheduler import (
+    QueryScheduler, Submission, SubmissionRejected,
+)
+from auron_tpu.serving.server import (
+    QueryServer, active_scheduler, install_scheduler, parse_submission,
+    register_catalog, uninstall_scheduler,
+)
+
+__all__ = [
+    "AdmissionController", "MemForecaster", "plan_signature",
+    "QueryScheduler", "Submission", "SubmissionRejected",
+    "QueryServer", "active_scheduler", "install_scheduler",
+    "parse_submission", "register_catalog", "uninstall_scheduler",
+]

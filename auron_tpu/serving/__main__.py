@@ -1,0 +1,53 @@
+"""`python -m auron_tpu.serving` — run a standalone query server.
+
+Starts a QueryServer (submission + observability on one port) and
+blocks; with --demo it also generates a tiny catalog, submits a few
+corpus queries and prints their status (a liveness smoke for operators;
+the CI gate is tools/serve_check.sh)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m auron_tpu.serving",
+        description="Auron TPU query-serving HTTP server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = pick a free port (printed on stdout)")
+    ap.add_argument("--demo", action="store_true",
+                    help="submit a few tiny corpus queries and exit")
+    ap.add_argument("--sf", type=float, default=0.002,
+                    help="--demo catalog scale factor")
+    args = ap.parse_args(argv)
+
+    from auron_tpu.serving import QueryServer
+    srv = QueryServer(host=args.host, port=args.port).start()
+    print(f"auron-tpu query server listening on {srv.url}", flush=True)
+    try:
+        if args.demo:
+            from auron_tpu.serving.server import corpus_plan
+            qids = [srv.scheduler.submit(corpus_plan(n, args.sf))
+                    for n in ("q01", "q03", "q42")]
+            for qid in qids:
+                srv.scheduler.wait(qid, timeout=300)
+                print(json.dumps(srv.scheduler.status(qid)), flush=True)
+            bad = [q for q in qids
+                   if srv.scheduler.status(q)["state"] != "succeeded"]
+            return 1 if bad else 0
+        while True:   # serve until interrupted
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

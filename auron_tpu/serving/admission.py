@@ -1,0 +1,147 @@
+"""Memory admission control for concurrent query serving.
+
+Sparkle's observation (arXiv:1708.05746) is that on big-memory machines
+the contended resource is the shared pool, not compute — so the serving
+tier gates query START on memory, not on a thread count alone.  Each
+submission gets a forecast (serving/forecast.py: recorded `mem_peak`
+history for its plan signature, else the configured default) and the
+controller keeps a ledger of forecasts reserved for currently-running
+queries, enforced through `MemManager.add_reservation`: an admitted
+query's forecast is carved out of the budget every OTHER consumer sees,
+so concurrent queries spill toward their fair share instead of
+over-committing the pool (conservative by construction — a reservation
+also pressures its own query, which is safe: spills preserve results).
+
+Decisions (`auron.admission.*` knobs):
+
+- **admit** — ledger + forecast fits `memory.fraction * budget` (or the
+  pool is idle: one query is always allowed, clamped to the cap).
+- **degrade to serial** — a forecast above `degrade.serial.fraction *
+  budget` runs with task parallelism 1 and no SPMD stage program, so
+  its instantaneous footprint (concurrent partitions) shrinks instead
+  of the query being refused.
+- **queue** — does not fit now; waits for a running query to release
+  its reservation (bounded by `queue.timeout.seconds`).
+- **shed** — the queue itself is full (`queue.max`): reject with a
+  structured error (HTTP 429 at the server) — bounded overload.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from auron_tpu.config import conf
+from auron_tpu.serving.forecast import MemForecaster
+
+ADMIT = "admit"
+QUEUE = "queue"
+SHED = "shed"
+
+
+@dataclass
+class AdmissionDecision:
+    action: str            # admit | queue | shed
+    forecast_bytes: int
+    serial: bool = False   # degrade-to-serial overlay on admit
+    reason: str = ""
+
+
+class AdmissionController:
+    """Forecast ledger + MemManager reservations for running queries."""
+
+    def __init__(self, forecaster: Optional[MemForecaster] = None):
+        self.forecaster = forecaster or MemForecaster()
+        self._lock = threading.Lock()
+        self._held: Dict[str, int] = {}    # query id -> reserved bytes
+        # event counters (the serve_check gate asserts queue events)
+        self.events: Dict[str, int] = {"admitted": 0, "queued": 0,
+                                       "shed": 0, "degraded": 0}
+
+    # -- forecasting -------------------------------------------------------
+
+    def forecast_for(self, signature: str) -> int:
+        hist = self.forecaster.forecast(signature)
+        if hist is None:
+            return int(conf.get("auron.admission.default.forecast.bytes"))
+        margin = float(conf.get("auron.admission.forecast.margin"))
+        return int(hist * max(margin, 1.0))
+
+    def observe(self, signature: str, peak_bytes: int) -> None:
+        self.forecaster.record(signature, peak_bytes)
+
+    # -- the decision ------------------------------------------------------
+
+    def held_bytes(self) -> int:
+        with self._lock:
+            return sum(self._held.values())
+
+    def offer(self, query_id: str, signature: str, queue_len: int,
+              count_queue_event: bool = True) -> AdmissionDecision:
+        """Decide for one submission; on ADMIT the forecast is reserved
+        (release() MUST run when the query finishes).  The scheduler's
+        pump re-offers QUEUED submissions as capacity frees up and
+        passes count_queue_event=False so one submission counts one
+        queue event, however often it is re-evaluated."""
+        from auron_tpu.memmgr import get_manager
+        from auron_tpu.runtime import counters
+
+        if not conf.get("auron.admission.enable"):
+            return AdmissionDecision(ADMIT, 0, reason="admission off")
+        mgr = get_manager()
+        budget = max(1, mgr.budget)
+        forecast = self.forecast_for(signature)
+        serial_frac = float(
+            conf.get("auron.admission.degrade.serial.fraction"))
+        serial = bool(serial_frac > 0 and
+                      forecast > serial_frac * budget)
+        cap = float(conf.get("auron.admission.memory.fraction")) * budget
+        # a lone oversized query is admitted (clamped) rather than
+        # queued forever: the pool can only help it by letting it run
+        # and spill
+        reserve = min(forecast, int(cap))
+        with self._lock:
+            held = sum(self._held.values())
+            fits = held + reserve <= cap or not self._held
+            if fits:
+                self._held[query_id] = reserve
+        if fits:
+            mgr.add_reservation(f"admission:{query_id}", reserve)
+            counters.bump("admission_admitted")
+            self.events["admitted"] += 1
+            if serial:
+                counters.bump("admission_degraded")
+                self.events["degraded"] += 1
+            return AdmissionDecision(
+                ADMIT, forecast, serial=serial,
+                reason="fits" if not serial else
+                "fits; degraded to serial (forecast "
+                f"{forecast} > {serial_frac:g} * budget)")
+        if queue_len >= int(conf.get("auron.admission.queue.max")):
+            counters.bump("admission_shed")
+            self.events["shed"] += 1
+            return AdmissionDecision(
+                SHED, forecast,
+                reason=f"admission queue full ({queue_len})")
+        if count_queue_event:
+            counters.bump("admission_queued")
+            self.events["queued"] += 1
+        return AdmissionDecision(
+            QUEUE, forecast,
+            reason=f"ledger {held} + forecast {reserve} > cap {int(cap)}")
+
+    def release(self, query_id: str) -> None:
+        """Return the query's reservation to the pool (idempotent)."""
+        from auron_tpu.memmgr import get_manager
+        with self._lock:
+            held = self._held.pop(query_id, None)
+        if held is not None:
+            get_manager().release_reservations(f"admission:{query_id}")
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"held_bytes": sum(self._held.values()),
+                    "held_queries": len(self._held),
+                    "events": dict(self.events),
+                    "forecasts": self.forecaster.snapshot()}

@@ -1,0 +1,75 @@
+"""Memory-peak forecasting for admission control.
+
+A submission's reservation has to be decided BEFORE the query runs, so
+the only honest signal is history: PR 5's always-on accounting records
+each query's largest per-operator `mem_peak`, and this module keys those
+observations by a structural PLAN SIGNATURE so the next run of the same
+plan shape is forecast from what it actually used.  Signatures cover
+operator kinds, schemas, expressions and file groups but strip inline
+table DATA (LocalTableScan rows), so two submissions of one query over
+the same files share a history no matter how the literal payload was
+ordered.  A signature with no history falls back to
+`auron.admission.default.forecast.bytes`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import deque
+from typing import Any, Dict, Optional
+
+from auron_tpu.frontend.foreign import ForeignNode
+
+
+def _strip_data(d: Any) -> Any:
+    """Drop row payloads from a foreign-plan dict: the signature tracks
+    plan SHAPE + inputs, not inline data volume (which LocalTableScan
+    tests can make arbitrarily large)."""
+    if isinstance(d, dict):
+        return {k: (f"<{len(v)} rows>" if k == "rows"
+                    and isinstance(v, list) else _strip_data(v))
+                for k, v in d.items()}
+    if isinstance(d, list):
+        return [_strip_data(x) for x in d]
+    return d
+
+
+def plan_signature(plan: ForeignNode) -> str:
+    """Stable structural hash of a foreign plan (op tree + schemas +
+    attrs + file groups, minus inline row data)."""
+    doc = _strip_data(plan.to_dict())
+    blob = json.dumps(doc, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+class MemForecaster:
+    """Bounded per-signature history of observed memory peaks."""
+
+    def __init__(self, keep: int = 8):
+        self._keep = keep
+        self._lock = threading.Lock()
+        self._history: Dict[str, deque] = {}
+
+    def record(self, signature: str, peak_bytes: int) -> None:
+        if peak_bytes <= 0:
+            return   # SPMD stage programs report no per-operator peaks
+        with self._lock:
+            dq = self._history.get(signature)
+            if dq is None:
+                dq = self._history[signature] = deque(maxlen=self._keep)
+            dq.append(int(peak_bytes))
+
+    def forecast(self, signature: str) -> Optional[int]:
+        """Max of the recent observations, or None with no history (the
+        admission controller then applies the configured default)."""
+        with self._lock:
+            dq = self._history.get(signature)
+            return max(dq) if dq else None
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {sig: {"runs": len(dq), "max_peak": max(dq),
+                          "last_peak": dq[-1]}
+                    for sig, dq in self._history.items() if dq}

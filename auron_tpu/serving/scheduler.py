@@ -1,0 +1,335 @@
+"""QueryScheduler: concurrent query lifecycles over one process.
+
+The serving tier's state machine.  Each submission is a foreign plan +
+a per-query conf map + a priority; the scheduler drives admitted
+submissions on their own driver threads (one `AuronSession` per query —
+sessions are single-execute objects; the PROCESS-level pools they share
+are lock-protected), while the memory admission controller
+(serving/admission.py) and the fair-share task pool
+(runtime/task_pool.py) arbitrate the shared resources underneath.
+
+States::
+
+    queued -> running -> succeeded | failed | cancelled
+    queued ----------------------------------^ (cancel while waiting)
+    (submit) -> shed      (admission queue full — never started)
+
+Isolation per query: the driver enters `conf.query_scoped(submission
+conf)` (contextvar overlay — other queries never see it) and executes
+under the submission's query id, so trace spans, log prefixes, the
+`/queries` history row and the per-query attribution counters
+(tracing.QueryStats) all key on the id `/status/<id>` answers for.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from auron_tpu import config
+from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.runtime import task_pool
+from auron_tpu.serving.admission import ADMIT, AdmissionController
+from auron_tpu.serving.forecast import plan_signature
+
+log = logging.getLogger("auron_tpu.serving")
+
+QUEUED = "queued"
+RUNNING = "running"
+SUCCEEDED = "succeeded"
+FAILED = "failed"
+CANCELLED = "cancelled"
+SHED_STATE = "shed"
+
+
+class SubmissionRejected(RuntimeError):
+    """Raised by submit() when the submission is shed (queue full)."""
+
+
+@dataclass
+class Submission:
+    query_id: str
+    plan: ForeignNode
+    conf: Dict[str, Any]
+    priority: int
+    signature: str
+    state: str = QUEUED
+    seq: int = 0
+    submitted_at: float = field(default_factory=time.time)
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    forecast_bytes: int = 0
+    serial: bool = False          # degraded-to-serial admission
+    admission_reason: str = ""
+    error: Optional[str] = None
+    rows: int = 0
+    wall_s: float = 0.0
+    result: Optional[object] = None   # pa.Table on success
+    mem_peak: int = 0
+    done: threading.Event = field(default_factory=threading.Event)
+
+    def status(self) -> Dict[str, Any]:
+        waited = (self.started_at or self.finished_at or time.time()) \
+            - self.submitted_at
+        return {"query_id": self.query_id, "state": self.state,
+                "priority": self.priority, "signature": self.signature,
+                "submitted_at": self.submitted_at,
+                "queue_wait_s": round(max(0.0, waited), 4),
+                "forecast_bytes": self.forecast_bytes,
+                "degraded_serial": self.serial,
+                "admission": self.admission_reason,
+                "rows": self.rows, "wall_s": round(self.wall_s, 4),
+                "mem_peak": self.mem_peak, "error": self.error}
+
+
+def default_session_factory():
+    """One AuronSession per query, the host oracle attached for any
+    residual foreign sections (the IT runner's wiring)."""
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it.oracle import PyArrowEngine
+    return AuronSession(foreign_engine=PyArrowEngine())
+
+
+class QueryScheduler:
+    """Submission registry + admission queue + driver threads."""
+
+    def __init__(self,
+                 session_factory: Optional[Callable[[], Any]] = None,
+                 admission: Optional[AdmissionController] = None):
+        self._session_factory = session_factory or default_session_factory
+        self.admission = admission or AdmissionController()
+        self._lock = threading.Lock()
+        self._subs: Dict[str, Submission] = {}
+        self._queue: List[Submission] = []    # admission wait line
+        self._running = 0
+        self._seq = 0
+        self._shutdown = False
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, plan: ForeignNode,
+               conf: Optional[Dict[str, Any]] = None,
+               priority: Optional[int] = None,
+               query_id: Optional[str] = None) -> str:
+        """Register a query; returns its id immediately (poll `status`/
+        `wait`).  Raises SubmissionRejected when shed."""
+        from auron_tpu.runtime import counters, tracing
+        if self._shutdown:
+            raise SubmissionRejected("scheduler is shut down")
+        overrides = dict(conf or {})
+        # validate the per-query conf NOW (the _QueryScoped constructor
+        # parses against option types): a bad submission conf is a 400
+        # at submit, never a failed query minutes later
+        config.conf.query_scoped(overrides)
+        if priority is None:
+            priority = int(overrides.get("auron.query.priority",
+                                         config.conf.get(
+                                             "auron.query.priority")))
+        qid = query_id or tracing.new_query_id()
+        sub = Submission(query_id=qid, plan=plan, conf=overrides,
+                         priority=int(priority),
+                         signature=plan_signature(plan))
+        with self._lock:
+            if qid in self._subs:
+                raise SubmissionRejected(f"duplicate query id {qid!r}")
+            if len(self._queue) >= \
+                    int(config.conf.get("auron.admission.queue.max")):
+                sub.state = SHED_STATE
+                sub.error = "shed: admission queue full"
+                sub.done.set()
+                self._subs[qid] = sub
+                counters.bump("admission_shed")
+                self.admission.events["shed"] += 1
+                raise SubmissionRejected(sub.error)
+            self._seq += 1
+            sub.seq = self._seq
+            self._subs[qid] = sub
+            self._queue.append(sub)
+        counters.bump("queries_submitted")
+        self._pump()
+        return qid
+
+    # -- the pump: start whatever fits -------------------------------------
+
+    def _pump(self) -> None:
+        while True:
+            to_start: Optional[Submission] = None
+            with self._lock:
+                if self._shutdown or not self._queue:
+                    return
+                max_conc = int(config.conf.get(
+                    "auron.serving.max.concurrent"))
+                if self._running >= max_conc:
+                    return
+                self._expire_locked()
+                if not self._queue:
+                    return
+                # highest priority first, FIFO within a priority
+                head = min(self._queue,
+                           key=lambda s: (-s.priority, s.seq))
+                decision = self.admission.offer(
+                    head.query_id, head.signature,
+                    queue_len=len(self._queue) - 1,
+                    count_queue_event=head.admission_reason == "")
+                head.admission_reason = decision.reason
+                head.forecast_bytes = decision.forecast_bytes
+                if decision.action != ADMIT:
+                    # head-of-line blocking is deliberate: starting a
+                    # smaller later query over the head forever would
+                    # starve big queries (FIFO fairness within the gate)
+                    return
+                head.serial = decision.serial
+                self._queue.remove(head)
+                head.state = RUNNING
+                head.started_at = time.time()
+                self._running += 1
+                to_start = head
+            t = threading.Thread(target=self._drive, args=(to_start,),
+                                 name=f"auron-driver-{to_start.query_id}",
+                                 daemon=True)
+            t.start()
+
+    def _expire_locked(self) -> None:
+        timeout = float(config.conf.get(
+            "auron.admission.queue.timeout.seconds"))
+        if timeout <= 0:
+            return
+        now = time.time()
+        for sub in list(self._queue):
+            if now - sub.submitted_at > timeout:
+                self._queue.remove(sub)
+                sub.state = FAILED
+                sub.error = f"admission timeout after {timeout:g}s"
+                sub.finished_at = now
+                sub.done.set()
+
+    # -- driver thread -----------------------------------------------------
+
+    def _drive(self, sub: Submission) -> None:
+        from auron_tpu.runtime import counters
+        from auron_tpu.runtime.explain_analyze import metric_max
+        overlay = dict(sub.conf)
+        overlay["auron.query.priority"] = sub.priority
+        if sub.serial:
+            # admission degraded the query: shrink its instantaneous
+            # footprint (one partition at a time, no SPMD program)
+            overlay["auron.task.parallelism"] = 1
+            overlay["auron.spmd.singleDevice.enable"] = False
+        try:
+            session = self._session_factory()
+            with config.conf.query_scoped(overlay):
+                res = session.execute(sub.plan, query_id=sub.query_id)
+            sub.result = res.table
+            sub.rows = res.table.num_rows
+            sub.wall_s = res.wall_s
+            sub.mem_peak = metric_max(res.metrics, "mem_peak")
+            sub.state = SUCCEEDED
+            if sub.mem_peak:
+                self.admission.observe(sub.signature, sub.mem_peak)
+        except task_pool.QueryCancelled:
+            sub.state = CANCELLED
+            sub.error = "cancelled"
+            counters.bump("queries_cancelled")
+        except BaseException as e:  # noqa: BLE001 - one red row
+            sub.state = FAILED
+            sub.error = f"{type(e).__name__}: {str(e)[:500]}"
+            log.warning("query %s failed: %s", sub.query_id, sub.error)
+        finally:
+            sub.finished_at = time.time()
+            self.admission.release(sub.query_id)
+            task_pool.clear_cancelled(sub.query_id)
+            with self._lock:
+                self._running -= 1
+            sub.done.set()
+            self._pump()
+
+    # -- client surface ----------------------------------------------------
+
+    def get(self, query_id: str) -> Optional[Submission]:
+        with self._lock:
+            return self._subs.get(query_id)
+
+    def status(self, query_id: str) -> Optional[Dict[str, Any]]:
+        sub = self.get(query_id)
+        if sub is None:
+            return None
+        self._pump()   # piggyback: expire stale queue entries lazily
+        return sub.status()
+
+    def result(self, query_id: str):
+        """The result table (pa.Table) of a succeeded query, else None."""
+        sub = self.get(query_id)
+        return sub.result if sub is not None else None
+
+    def wait(self, query_id: str, timeout: Optional[float] = None) -> bool:
+        """Block until the query finishes (True) or `timeout` elapses
+        (False).  Polls the pump so queue timeouts expire even when no
+        other submission/completion event fires."""
+        sub = self.get(query_id)
+        if sub is None:
+            return False
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            remaining = None if deadline is None \
+                else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                return sub.done.is_set()
+            slice_s = 0.2 if remaining is None else min(0.2, remaining)
+            if sub.done.wait(slice_s):
+                return True
+            self._pump()
+
+    def cancel(self, query_id: str) -> bool:
+        """Cancel a queued (immediate) or running (fail-fast tasks)
+        query; False once it already finished or is unknown."""
+        from auron_tpu.runtime import counters
+        with self._lock:
+            sub = self._subs.get(query_id)
+            if sub is None or sub.done.is_set():
+                return False
+            if sub.state == QUEUED:
+                if sub in self._queue:
+                    self._queue.remove(sub)
+                sub.state = CANCELLED
+                sub.error = "cancelled while queued"
+                sub.finished_at = time.time()
+                sub.done.set()
+                counters.bump("queries_cancelled")
+                return True
+        # running: the task pool fails its remaining tasks fast; the
+        # driver thread ferries QueryCancelled and finishes the record
+        task_pool.cancel_query(query_id)
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            states: Dict[str, int] = {}
+            for sub in self._subs.values():
+                states[sub.state] = states.get(sub.state, 0) + 1
+            queued = len(self._queue)
+            running = self._running
+        pool = task_pool._POOL
+        return {"queued": queued, "running": running, "states": states,
+                "admission": self.admission.snapshot(),
+                "task_queues": pool.queue_snapshot()
+                if pool is not None else {}}
+
+    def shutdown(self, wait: bool = False,
+                 timeout: float = 30.0) -> None:
+        with self._lock:
+            self._shutdown = True
+            for sub in self._queue:
+                sub.state = CANCELLED
+                sub.error = "scheduler shut down"
+                sub.finished_at = time.time()
+                sub.done.set()
+            self._queue.clear()
+            running = [s for s in self._subs.values()
+                       if s.state == RUNNING]
+        if wait:
+            deadline = time.time() + timeout
+            for sub in running:
+                sub.done.wait(max(0.0, deadline - time.time()))

@@ -1,0 +1,128 @@
+"""QueryServer: the profiling HTTP server promoted to a serving endpoint.
+
+The reference ships a lazily-started HTTP service for profiling only;
+production Auron serves queries through Spark.  This single-process
+analogue promotes that same server (runtime/profiling.py — ONE port, one
+handler) into a query-submission surface backed by a QueryScheduler:
+
+- ``POST /submit``        — JSON body, either ``{"plan": <foreign-plan
+  dict>}`` (frontend/foreign.py serde) or ``{"corpus": "q01", "sf":
+  0.01}`` (an IT-corpus query over a process-cached generated catalog),
+  plus optional ``"conf"`` (per-query overrides, applied context-locally)
+  and ``"priority"``.  Replies ``{"query_id": ...}``; 429 when shed.
+- ``GET /status/<id>``    — submission state + admission info.
+- ``GET /result/<id>``    — result rows as JSON (capped by
+  ``auron.serving.result.max.rows``).
+- ``POST /cancel/<id>``   — cancel queued/running.
+- ``GET /scheduler``      — scheduler + admission + task-queue snapshot.
+
+The profiling endpoints (/metrics, /queries, /memory, ...) stay on the
+same port, so one scrape target covers submission AND observability; the
+serving routes answer 503 until a scheduler is installed (QueryServer
+.start() or install_scheduler())."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.runtime.profiling import ProfilingServer
+from auron_tpu.serving.scheduler import QueryScheduler
+
+_ACTIVE: Optional[QueryScheduler] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def install_scheduler(scheduler: QueryScheduler) -> QueryScheduler:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = scheduler
+    return scheduler
+
+
+def uninstall_scheduler(scheduler: Optional[QueryScheduler] = None) -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if scheduler is None or _ACTIVE is scheduler:
+            _ACTIVE = None
+
+
+def active_scheduler() -> Optional[QueryScheduler]:
+    return _ACTIVE
+
+
+# -- corpus submissions (the serve_check / demo path) -----------------------
+
+_CATALOGS: Dict[float, object] = {}
+_CATALOG_LOCK = threading.Lock()
+
+
+def corpus_plan(name: str, sf: float = 0.002) -> ForeignNode:
+    """Build an IT-corpus query plan over a generated catalog cached per
+    scale factor for the process lifetime (tempdir-backed parquet)."""
+    import tempfile
+
+    from auron_tpu.it import datagen, queries
+    with _CATALOG_LOCK:
+        catalog = _CATALOGS.get(sf)
+        if catalog is None:
+            d = tempfile.mkdtemp(prefix=f"auron-serve-sf{sf}-")
+            catalog = datagen.generate(d, sf=sf)
+            _CATALOGS[sf] = catalog
+    return queries.build(name, catalog)
+
+
+def register_catalog(sf: float, catalog) -> None:
+    """Pre-register a generated catalog (tests reuse their fixture
+    instead of generating a second copy)."""
+    with _CATALOG_LOCK:
+        _CATALOGS[sf] = catalog
+
+
+def parse_submission(body: Dict[str, Any]) -> ForeignNode:
+    """Submission body -> foreign plan; ValueError on a bad body."""
+    if not isinstance(body, dict):
+        raise ValueError("submission body must be a JSON object")
+    if "plan" in body:
+        try:
+            return ForeignNode.from_dict(body["plan"])
+        except Exception as e:
+            raise ValueError(f"bad plan document: {e}") from e
+    if "corpus" in body:
+        name = str(body["corpus"])
+        from auron_tpu.it import queries
+        if name not in queries.names():
+            raise ValueError(f"unknown corpus query {name!r}")
+        return corpus_plan(name, float(body.get("sf", 0.002)))
+    raise ValueError("submission needs 'plan' or 'corpus'")
+
+
+class QueryServer:
+    """One port serving submissions + observability: a ProfilingServer
+    with a QueryScheduler installed for the serving routes."""
+
+    def __init__(self, scheduler: Optional[QueryScheduler] = None,
+                 session_factory=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.scheduler = scheduler or \
+            QueryScheduler(session_factory=session_factory)
+        self._http = ProfilingServer(host, port)
+
+    @property
+    def url(self) -> str:
+        return self._http.url
+
+    @property
+    def address(self):
+        return self._http.address
+
+    def start(self) -> "QueryServer":
+        install_scheduler(self.scheduler)
+        self._http.start()
+        return self
+
+    def stop(self, wait: bool = True) -> None:
+        self.scheduler.shutdown(wait=wait)
+        uninstall_scheduler(self.scheduler)
+        self._http.stop()

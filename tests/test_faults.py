@@ -248,11 +248,18 @@ def test_run_tasks_preserves_order_and_parallelism():
 def test_run_tasks_ferries_first_error_and_cancels(caplog):
     started = []
     release = threading.Event()
+    sibling_running = threading.Event()
 
     def task(i):
         started.append(i)
         if i == 0:
+            # fail only once a sibling is genuinely RUNNING: the shared
+            # pool hands tasks out one by one, so an instant failure
+            # could cancel the whole queue before any sibling starts
+            # (the contract under test is running-siblings-drain-logged)
+            sibling_running.wait(timeout=5)
             raise ValueError("first failure")
+        sibling_running.set()
         release.wait(timeout=5)
         if i == 1:
             raise RuntimeError("sibling failure")

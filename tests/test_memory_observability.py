@@ -406,3 +406,144 @@ def test_tools_mem_check_script():
     out = subprocess.run(["bash", script], capture_output=True,
                          text=True, timeout=540, env=env)
     assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# spill-victim ranking (freed-bytes-per-wall-second, serving PR satellite)
+# ---------------------------------------------------------------------------
+
+def test_spill_victim_ranked_by_freed_rate():
+    """Unit ranking contract (_pick_spill_victim): consumers with spill
+    history rank by freed-bytes-per-wall-second; no-history consumers
+    rank ABOVE measured ones (tried once to earn history) tie-broken by
+    size; 'largest' restores the pure size policy."""
+    with conf.scoped(TINY_TRIGGER):
+        mgr = reset_manager(10_000)
+        # fabricated history: Slow freed 1KB over 1s, Fast 1MB over 1ms
+        mgr._by_name["Slow"] = {"registrations": 1, "peak": 0, "spills": 2,
+                                "freed_bytes": 1000,
+                                "wall_ns": 1_000_000_000}
+        mgr._by_name["Fast"] = {"registrations": 1, "peak": 0, "spills": 2,
+                                "freed_bytes": 1_000_000,
+                                "wall_ns": 1_000_000}
+        slow = mgr.register_consumer(FakeConsumer("Slow"))
+        fast = mgr.register_consumer(FakeConsumer("Fast"))
+        slow.mem_used = 5000      # bigger, but historically a bad victim
+        fast.mem_used = 2000
+        assert mgr._pick_spill_victim([slow, fast]) is fast
+        # an unmeasured consumer is tried before any measured one
+        new = mgr.register_consumer(FakeConsumer("Fresh"))
+        new.mem_used = 1500
+        assert mgr._pick_spill_victim([slow, fast, new]) is new
+        # several unmeasured: largest-consumer fallback between them
+        new2 = mgr.register_consumer(FakeConsumer("Fresh2"))
+        new2.mem_used = 1600
+        assert mgr._pick_spill_victim([slow, new, new2]) is new2
+        with conf.scoped({"auron.memory.spill.victim.strategy":
+                          "largest"}):
+            assert mgr._pick_spill_victim([slow, fast, new]) is slow
+
+
+def test_spill_victim_learns_from_history_end_to_end():
+    """A consumer class that spills but frees nothing ('sticky') is
+    chosen once (no history: largest-consumer), then sinks below a
+    class with a real freed-rate — the arbitration stops hammering the
+    victim that never helps."""
+    with conf.scoped(TINY_TRIGGER):
+        mgr = reset_manager(1000)
+        sticky = mgr.register_consumer(FakeConsumer("Sticky",
+                                                    sticky=True))
+        sticky.update_mem_used(900)
+        good = mgr.register_consumer(FakeConsumer("Good"))
+        good.update_mem_used(500)    # over budget, nobody has history:
+        # largest (Sticky) tried, freed 0 -> fallback self-spill of Good
+        assert [r["consumer"] for r in mgr.spill_records()] == \
+            ["Sticky", "Good"]
+        # second pressure event: Good's positive rate now outranks the
+        # bigger zero-rate Sticky — Sticky is left alone
+        good.update_mem_used(600)
+        last = mgr.spill_records()[-1]
+        assert last["consumer"] == "Good"
+        assert mgr.consumer_totals()["Sticky"]["spills"] == 1
+
+
+def test_spill_victim_largest_strategy_preserved():
+    """auron.memory.spill.victim.strategy=largest keeps the reference
+    policy: the sticky big consumer keeps getting chosen."""
+    with conf.scoped({**TINY_TRIGGER,
+                      "auron.memory.spill.victim.strategy": "largest"}):
+        mgr = reset_manager(1000)
+        sticky = mgr.register_consumer(FakeConsumer("Sticky",
+                                                    sticky=True))
+        sticky.update_mem_used(900)
+        good = mgr.register_consumer(FakeConsumer("Good"))
+        good.update_mem_used(500)
+        good.update_mem_used(600)
+        targets = [r["consumer"] for r in mgr.spill_records()
+                   if r["path"] == "arbitration"]
+        assert targets == ["Sticky", "Sticky"]
+
+
+# ---------------------------------------------------------------------------
+# agg staged-state spilled mid-collapse (concurrent-pressure regression)
+# ---------------------------------------------------------------------------
+
+def _agg_plan(table):
+    from auron_tpu.ir import plan as P
+    from auron_tpu.ir.expr import AggExpr, col
+    from auron_tpu.ir.schema import DataType, from_arrow_schema
+    return P.Agg(
+        child=P.FFIReader(schema=from_arrow_schema(table.schema),
+                          resource_id="src"),
+        exec_mode="single", grouping=(col("k"),), grouping_names=("k",),
+        aggs=(AggExpr(fn="sum", children=(col("v"),),
+                      return_type=DataType.float64()),),
+        agg_names=("s",))
+
+
+def _run_agg(table):
+    from auron_tpu.runtime.executor import execute_plan
+    from auron_tpu.runtime.resources import ResourceRegistry
+    res = ResourceRegistry()
+    res.put("src", table)
+    return execute_plan(_agg_plan(table), resources=res)
+
+
+def test_agg_staged_spilled_mid_collapse_not_lost(monkeypatch):
+    """Serving-PR regression: with concurrent queries sharing the pool,
+    the accounting update INSIDE AggExec._compact_staged can push usage
+    over budget and arbitration may pick the agg itself — emptying
+    _staged between the collapse and the read (_staged[0] IndexError,
+    observed in the 8-query stress).  Simulate that exact window by
+    spilling right after the first real collapse: the rows must come
+    back through the spill-merge tail, bit-identical."""
+    from auron_tpu.ops.agg.exec import AggExec
+
+    table = _sorted_table(n=20_000)
+    reset_manager()
+    baseline = _canonical(_run_agg(table).to_table())
+
+    fired = {"n": 0}
+    orig = AggExec._compact_staged
+
+    def compact_then_arbitrated_spill(self):
+        orig(self)
+        if fired["n"] == 0 and self._staged and not self._has_host_aggs:
+            fired["n"] = 1
+            # what manager arbitration does when it picks this consumer
+            self.spill()
+
+    with conf.scoped(TINY_TRIGGER):
+        mgr = reset_manager(50_000_000)
+        monkeypatch.setattr(AggExec, "_compact_staged",
+                            compact_then_arbitrated_spill)
+        out = _canonical(_run_agg(table).to_table())
+    assert fired["n"] == 1, "the mid-collapse window never opened"
+    assert out.equals(baseline), \
+        "rows were lost when staged state spilled mid-collapse"
+
+
+def _canonical(t):
+    t = t.combine_chunks()
+    return t.sort_by([(n, "ascending") for n in t.column_names]) \
+        if t.num_rows and t.num_columns else t

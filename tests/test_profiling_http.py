@@ -263,3 +263,79 @@ def test_concurrent_trace_429(server):
         assert b"trace in progress" in body
     finally:
         profiling._trace_lock.release()
+
+
+def test_endpoints_thread_safe_under_concurrent_queries(server):
+    """Satellite gate (serving PR): hammer /queries, /memory and
+    /metrics from several threads WHILE query records and memory
+    consumers churn — every response parses, no torn reads, no 500s.
+    The history ring, the counter registry and the memory manager all
+    mutate under their own locks; a handler reading a half-updated
+    structure would surface as a 500 or unparseable payload here."""
+    import threading
+
+    from auron_tpu.config import conf
+    from auron_tpu.memmgr.manager import MemConsumer, reset_manager
+
+    class _Churn(MemConsumer):
+        def spill(self):
+            freed = self.mem_used
+            self.update_mem_used(0)
+            return freed
+
+    stop = threading.Event()
+    errors = []
+
+    def hammer(path, check):
+        while not stop.is_set():
+            try:
+                code, body, _ = _get(server.url + path)
+                if code != 200:
+                    errors.append((path, code, body[:200]))
+                    return
+                check(body)
+            except Exception as e:  # noqa: BLE001 - recorded, not raised
+                errors.append((path, repr(e)))
+                return
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            tracing.record_query(tracing.QueryRecord(
+                query_id=f"qhammer{i}", wall_s=0.01, rows=i,
+                metric_totals={"output_rows": i}))
+            c = mgr.register_consumer(_Churn(f"Hammer{i % 4}"))
+            c.update_mem_used(2000)
+            mgr.unregister_consumer(c)
+
+    def _json_ok(body):
+        json.loads(body)
+
+    def _prom_ok(body):
+        for ln in body.decode().splitlines():
+            if ln.strip() and not ln.startswith("#"):
+                assert _PROM_LINE.match(ln), ln
+
+    with conf.scoped({"auron.memory.spill.min.trigger.bytes": 1}):
+        mgr = reset_manager(10_000)
+        threads = [
+            threading.Thread(target=hammer,
+                             args=("/queries?format=json", _json_ok)),
+            threading.Thread(target=hammer, args=("/memory", _json_ok)),
+            threading.Thread(target=hammer,
+                             args=("/metrics?format=json", _json_ok)),
+            threading.Thread(target=hammer, args=("/metrics", _prom_ok)),
+            threading.Thread(target=churn),
+            threading.Thread(target=churn),
+        ]
+        for t in threads:
+            t.start()
+        import time as _time
+        _time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    from auron_tpu.memmgr.manager import reset_manager as _reset
+    _reset()
+    assert not errors, errors[:5]

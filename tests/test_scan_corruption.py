@@ -1,0 +1,106 @@
+"""`auron.ignore.corrupted.files` coverage (PR 2 follow-up): the knob
+has been wired at ops/scan/parquet.py and ops/scan/orc.py since the
+fault harness landed, but no test ever fed either reader a broken file.
+Both readers, both polarities, two corruption shapes (truncated valid
+file, arbitrary garbage bytes), plus the good-files-still-read contract
+and multi-file groups where only the middle file is bad."""
+
+import os
+
+import pyarrow as pa
+import pyarrow.orc as orc
+import pyarrow.parquet as pq
+import pytest
+
+from auron_tpu.config import conf
+from auron_tpu.ir import plan as P
+from auron_tpu.ir.schema import from_arrow_schema
+from auron_tpu.runtime.executor import execute_plan
+
+ROWS = [{"id": i, "name": f"r{i}"} for i in range(100)]
+
+
+def _write_good(path: str, fmt: str) -> None:
+    table = pa.Table.from_pylist(ROWS)
+    if fmt == "parquet":
+        pq.write_table(table, path)
+    else:
+        orc.write_table(table, path)
+
+
+def _truncate(src: str, dst: str) -> None:
+    with open(src, "rb") as f:
+        blob = f.read()
+    with open(dst, "wb") as f:
+        f.write(blob[: len(blob) // 3])   # footer gone: unreadable
+
+
+def _garbage(dst: str) -> None:
+    with open(dst, "wb") as f:
+        f.write(b"\x00\xff not a columnar file \x13\x37" * 64)
+
+
+def _scan_plan(fmt: str, paths, schema) -> P.PlanNode:
+    group = P.FileGroup(paths=tuple(paths))
+    if fmt == "parquet":
+        return P.ParquetScan(schema=schema, file_groups=(group,))
+    return P.OrcScan(schema=schema, file_groups=(group,))
+
+
+@pytest.fixture(params=["parquet", "orc"])
+def corpus(request, tmp_path):
+    fmt = request.param
+    good = str(tmp_path / f"good.{fmt}")
+    good2 = str(tmp_path / f"good2.{fmt}")
+    truncated = str(tmp_path / f"trunc.{fmt}")
+    garbage = str(tmp_path / f"garbage.{fmt}")
+    _write_good(good, fmt)
+    _write_good(good2, fmt)
+    _truncate(good, truncated)
+    _garbage(garbage)
+    schema = from_arrow_schema(pa.Table.from_pylist(ROWS).schema)
+    return fmt, schema, good, good2, truncated, garbage
+
+
+def test_corrupted_file_raises_by_default(corpus):
+    fmt, schema, good, _good2, truncated, garbage = corpus
+    for bad in (truncated, garbage):
+        with pytest.raises(Exception):
+            execute_plan(_scan_plan(fmt, [bad], schema))
+
+
+def test_corrupted_file_skipped_when_ignored(corpus):
+    """With the knob on, broken files are skipped and the good files in
+    the same group still stream — including a bad file in the MIDDLE of
+    the group (the skip must continue, not abort the loop)."""
+    fmt, schema, good, good2, truncated, garbage = corpus
+    with conf.scoped({"auron.ignore.corrupted.files": True}):
+        # bad-only group: empty result, no error
+        res = execute_plan(_scan_plan(fmt, [truncated, garbage], schema))
+        assert res.to_table().num_rows == 0
+        # good + bad + good: both good files' rows survive
+        res = execute_plan(
+            _scan_plan(fmt, [good, garbage, good2], schema))
+        table = res.to_table()
+        assert table.num_rows == 2 * len(ROWS)
+        ids = sorted(table.column("id").to_pylist())
+        assert ids == sorted(r["id"] for r in ROWS for _ in range(2))
+
+
+def test_corrupted_file_off_fails_even_with_good_neighbors(corpus):
+    fmt, schema, good, _good2, _truncated, garbage = corpus
+    with conf.scoped({"auron.ignore.corrupted.files": False}):
+        with pytest.raises(Exception):
+            execute_plan(_scan_plan(fmt, [good, garbage], schema))
+
+
+def test_missing_file_respects_ignore_knob(corpus):
+    """A vanished split is operationally the same failure class as a
+    corrupt one: skipped when ignoring, raised otherwise."""
+    fmt, schema, good, _g2, _t, _g = corpus
+    missing = os.path.join(os.path.dirname(good), f"gone.{fmt}")
+    with conf.scoped({"auron.ignore.corrupted.files": True}):
+        res = execute_plan(_scan_plan(fmt, [missing, good], schema))
+        assert res.to_table().num_rows == len(ROWS)
+    with pytest.raises(Exception):
+        execute_plan(_scan_plan(fmt, [missing, good], schema))

@@ -1,0 +1,745 @@
+"""Serving-runtime tests (auron_tpu.serving + the fair-share task pool):
+
+- fair-share scheduling in the shared task pool (narrow queries are not
+  starved by wide ones, `auron.query.priority` weights drain order,
+  nested calls run inline, cancellation fails tasks fast),
+- the per-query conf overlay (conf.query_scoped) staying context-local,
+- plan-signature forecasting + the admission controller's
+  admit/queue/shed/degrade ledger against MemManager reservations,
+- QueryScheduler lifecycles (states, priorities, cancel, timeout, shed),
+- the HTTP serving routes on the promoted profiling server,
+- END-TO-END ISOLATION: concurrent queries against one process whose
+  /queries records, traces and results never bleed — including the
+  acceptance stress (>= 8 concurrent queries x io/latency/mem faults x
+  tiny shared memory budget, each bit-identical to its solo fault-free
+  run, with per-query attribution).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu.config import conf
+from auron_tpu.it.datagen import generate
+from auron_tpu.runtime import counters, task_pool, tracing
+from auron_tpu.runtime.task_pool import QueryCancelled, run_tasks
+from auron_tpu.serving import (
+    AdmissionController, MemForecaster, QueryScheduler, QueryServer,
+    SubmissionRejected, plan_signature, register_catalog,
+)
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    cat = generate(str(tmp_path_factory.mktemp("serving_tpcds")), sf=SF,
+                   fact_chunks=3)
+    register_catalog(SF, cat)
+    return cat
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    """Serving tests mutate process singletons (manager, pool, history);
+    leave clean defaults behind."""
+    yield
+    from auron_tpu import faults
+    from auron_tpu.memmgr.manager import reset_manager
+    faults.reset()
+    reset_manager()
+    task_pool.reset_pool()
+
+
+def _canon(table: pa.Table) -> pa.Table:
+    t = table.combine_chunks()
+    if t.num_rows and t.num_columns:
+        t = t.sort_by([(n, "ascending") for n in t.column_names])
+    return t
+
+
+# ---------------------------------------------------------------------------
+# fair-share task pool
+# ---------------------------------------------------------------------------
+
+def test_fair_share_narrow_query_not_starved():
+    """A 2-task query submitted after a 12-task query must interleave
+    (round-robin), not wait for the wide queue to drain (the old global
+    FIFO shape)."""
+    done = []
+    task_pool.reset_pool()
+    errs = []
+
+    def run_wide():
+        try:
+            with tracing.trace_scope("qwide"):
+                out = run_tasks(
+                    lambda i: (time.sleep(0.05), done.append(("A", i)))[0]
+                    or i, range(12))
+                assert out == list(range(12))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    def run_narrow():
+        time.sleep(0.12)   # arrive while the wide query is mid-flight
+        try:
+            with tracing.trace_scope("qnarrow"):
+                run_tasks(lambda i: done.append(("B", i)), range(2))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    with conf.scoped({"auron.task.parallelism": 2}):
+        ts = [threading.Thread(target=run_wide),
+              threading.Thread(target=run_narrow)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert not errs, errs
+    b_last = max(i for i, (q, _) in enumerate(done) if q == "B")
+    a_before = sum(1 for q, _ in done[:b_last] if q == "A")
+    # with strict FIFO the narrow query would see all 12 A-tasks first
+    assert a_before <= 9, done
+
+
+def test_priority_weight_drains_faster():
+    """auron.query.priority weights the round-robin: a weight-3 query
+    finishes ahead of an equal-size weight-1 query started together."""
+    done = []
+    task_pool.reset_pool()
+
+    def runner(tag, weight):
+        def go():
+            with tracing.trace_scope("q" + tag), \
+                    conf.query_scoped({"auron.query.priority": weight}):
+                run_tasks(lambda i: (time.sleep(0.02),
+                                     done.append((tag, i)))[0] or i,
+                          range(10))
+        return go
+
+    with conf.scoped({"auron.task.parallelism": 2}):
+        t1 = threading.Thread(target=runner("W", 3))
+        t2 = threading.Thread(target=runner("L", 1))
+        t1.start()
+        time.sleep(0.005)
+        t2.start()
+        t1.join()
+        t2.join()
+    assert max(i for i, (q, _) in enumerate(done) if q == "W") < \
+        max(i for i, (q, _) in enumerate(done) if q == "L"), done
+
+
+def test_nested_run_tasks_runs_inline():
+    """A run_tasks call issued from a pool worker must execute inline
+    (deadlock guard) and still produce ordered results."""
+    task_pool.reset_pool()
+
+    def outer(i):
+        # nested call on the worker thread
+        inner = run_tasks(lambda j: i * 10 + j, range(3))
+        assert inner == [i * 10, i * 10 + 1, i * 10 + 2]
+        return sum(inner)
+
+    with conf.scoped({"auron.task.parallelism": 4}):
+        out = run_tasks(outer, range(6))
+    assert out == [sum((i * 10 + j) for j in range(3)) for i in range(6)]
+
+
+def test_cancel_query_fails_tasks_fast():
+    """Cancelling a query id mid-flight fails its remaining queued tasks
+    with QueryCancelled; an unrelated query is untouched."""
+    task_pool.reset_pool()
+    started = []
+    release = threading.Event()
+
+    def slow(i):
+        started.append(i)
+        release.wait(timeout=5)
+        return i
+
+    result = {}
+
+    def victim():
+        try:
+            with tracing.trace_scope("qvictim"):
+                run_tasks(slow, range(8))
+        except QueryCancelled:
+            result["cancelled"] = True
+
+    with conf.scoped({"auron.task.parallelism": 2}):
+        t = threading.Thread(target=victim)
+        t.start()
+        time.sleep(0.1)          # let a couple of tasks start
+        task_pool.cancel_query("qvictim")
+        release.set()
+        t.join(timeout=10)
+        assert not t.is_alive()
+    assert result.get("cancelled"), "run_tasks should ferry QueryCancelled"
+    assert len(started) < 8      # queued tail never ran
+    task_pool.clear_cancelled("qvictim")
+    # future calls under the id work again after clearing
+    with tracing.trace_scope("qvictim"):
+        assert run_tasks(lambda x: x, [1, 2]) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# per-query conf overlay
+# ---------------------------------------------------------------------------
+
+def test_query_scoped_overlay_is_context_local():
+    seen = {}
+    barrier = threading.Barrier(2, timeout=10)
+
+    def a():
+        with conf.query_scoped({"auron.batch.size": 1111}):
+            barrier.wait()
+            seen["a"] = conf.get("auron.batch.size")
+            barrier.wait()
+
+    def b():
+        barrier.wait()          # a() holds its overlay right now
+        seen["b"] = conf.get("auron.batch.size")
+        barrier.wait()
+
+    ts = [threading.Thread(target=a), threading.Thread(target=b)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert seen["a"] == 1111
+    assert seen["b"] == conf.get("auron.batch.size") != 1111
+
+
+def test_query_scoped_propagates_to_pool_tasks():
+    task_pool.reset_pool()
+    with conf.scoped({"auron.task.parallelism": 4}):
+        with tracing.trace_scope("qoverlay"), \
+                conf.query_scoped({"auron.batch.size": 2222}):
+            vals = run_tasks(
+                lambda _i: conf.get("auron.batch.size"), range(6))
+    assert vals == [2222] * 6
+
+
+def test_query_scoped_parses_and_rejects():
+    with conf.query_scoped({"auron.batch.size": "4096"}):
+        assert conf.get("auron.batch.size") == 4096
+    with pytest.raises(KeyError):
+        with conf.query_scoped({"auron.not.a.key": 1}):
+            pass
+    # nesting: inner wins, outer restored
+    with conf.query_scoped({"auron.batch.size": 100}):
+        with conf.query_scoped({"auron.batch.size": 200}):
+            assert conf.get("auron.batch.size") == 200
+        assert conf.get("auron.batch.size") == 100
+
+
+# ---------------------------------------------------------------------------
+# forecasting + admission
+# ---------------------------------------------------------------------------
+
+def _tiny_plan(rows=3, tag="t"):
+    from auron_tpu.frontend.foreign import ForeignNode, fcol
+    from auron_tpu.ir.schema import DataType, Field, Schema
+    schema = Schema((Field("x", DataType.int64()),))
+    scan = ForeignNode("LocalTableScanExec", output=schema,
+                       attrs={"rows": [{"x": i} for i in range(rows)]})
+    return ForeignNode("ProjectExec", children=(scan,), output=schema,
+                       attrs={"exprs": (fcol("x", DataType.int64()),),
+                              "tag": tag})
+
+
+def test_plan_signature_ignores_row_data_not_shape():
+    a = plan_signature(_tiny_plan(rows=3))
+    b = plan_signature(_tiny_plan(rows=3))
+    assert a == b
+    # same shape, different inline data volume -> different row COUNT is
+    # part of the stripped marker; same count different values is not
+    p1, p2 = _tiny_plan(rows=3), _tiny_plan(rows=3)
+    p2.children[0].attrs["rows"] = [{"x": i * 7} for i in range(3)]
+    assert plan_signature(p1) == plan_signature(p2)
+    assert plan_signature(_tiny_plan(tag="other")) != a
+
+
+def test_forecaster_history_window():
+    f = MemForecaster(keep=3)
+    assert f.forecast("sig") is None
+    for peak in (100, 900, 200, 300):
+        f.record("sig", peak)
+    # window keeps the last 3 observations: (900, 200, 300)
+    assert f.forecast("sig") == 900
+    f.record("sig", 400)          # 900 falls out of the window
+    assert f.forecast("sig") == 400
+    f.record("sig", 0)            # zero peaks (SPMD) are not recorded
+    assert f.forecast("sig") == 400
+    snap = f.snapshot()
+    assert snap["sig"]["runs"] == 3 and snap["sig"]["last_peak"] == 400
+
+
+def test_admission_admit_queue_shed_and_release():
+    from auron_tpu.memmgr.manager import reset_manager
+    mgr = reset_manager(1_000_000)
+    ctl = AdmissionController()
+    with conf.scoped({"auron.admission.default.forecast.bytes": 300_000,
+                      "auron.admission.memory.fraction": 0.8,
+                      "auron.admission.queue.max": 1}):
+        d1 = ctl.offer("q1", "sigA", queue_len=0)
+        d2 = ctl.offer("q2", "sigA", queue_len=0)
+        assert (d1.action, d2.action) == ("admit", "admit")
+        assert mgr.reserved == 600_000
+        # 900k > 0.8 * 1M: third query queues...
+        d3 = ctl.offer("q3", "sigA", queue_len=0)
+        assert d3.action == "queue"
+        # ...and with the queue full, the next one sheds
+        d4 = ctl.offer("q4", "sigA", queue_len=1)
+        assert d4.action == "shed"
+        assert ctl.events["queued"] == 1 and ctl.events["shed"] == 1
+        ctl.release("q1")
+        assert mgr.reserved == 300_000
+        assert ctl.offer("q3", "sigA", queue_len=0,
+                         count_queue_event=False).action == "admit"
+        ctl.release("q2")
+        ctl.release("q3")
+        assert mgr.reserved == 0
+        ctl.release("q3")         # idempotent
+
+
+def test_admission_uses_history_and_degrades_serial():
+    from auron_tpu.memmgr.manager import reset_manager
+    reset_manager(1_000_000)
+    ctl = AdmissionController()
+    ctl.observe("sigBig", 700_000)
+    with conf.scoped({"auron.admission.forecast.margin": 1.0,
+                      "auron.admission.degrade.serial.fraction": 0.5}):
+        d = ctl.offer("qbig", "sigBig", queue_len=0)
+    assert d.action == "admit" and d.serial, d
+    assert d.forecast_bytes == 700_000
+    assert ctl.events["degraded"] == 1
+    ctl.release("qbig")
+    # an unknown signature takes the configured default instead
+    with conf.scoped({"auron.admission.default.forecast.bytes": 1234}):
+        assert ctl.forecast_for("sigNew") == 1234
+
+
+def test_admission_lone_oversized_query_admitted_clamped():
+    from auron_tpu.memmgr.manager import reset_manager
+    mgr = reset_manager(100_000)
+    ctl = AdmissionController()
+    with conf.scoped({"auron.admission.default.forecast.bytes": 10**9,
+                      "auron.admission.memory.fraction": 0.8}):
+        d = ctl.offer("qhuge", "sig", queue_len=0)
+        assert d.action == "admit"        # idle pool: run it, let it spill
+        assert mgr.reserved <= 80_000     # reservation clamped to the cap
+    ctl.release("qhuge")
+
+
+def test_admission_disabled_admits_without_reservation():
+    from auron_tpu.memmgr.manager import reset_manager
+    mgr = reset_manager(1000)
+    ctl = AdmissionController()
+    with conf.scoped({"auron.admission.enable": False}):
+        assert ctl.offer("q", "s", queue_len=0).action == "admit"
+    assert mgr.reserved == 0
+
+
+# ---------------------------------------------------------------------------
+# scheduler lifecycles (fake sessions: no engine, fast)
+# ---------------------------------------------------------------------------
+
+class _FakeResult:
+    def __init__(self, table):
+        self.table = table
+        self.wall_s = 0.01
+        self.metrics = []
+
+
+class _FakeSession:
+    """Looks enough like AuronSession for the scheduler: records the
+    execution under the query scope so history attribution is real."""
+
+    def __init__(self, delay=0.0, fail=False, log=None):
+        self.delay = delay
+        self.fail = fail
+        self.log = log if log is not None else []
+
+    def execute(self, plan, mesh=None, mesh_axis="parts", query_id=None):
+        self.log.append((query_id, time.time()))
+        if self.delay:
+            # cancellable sleep shaped like task execution
+            with tracing.trace_scope(query_id=query_id):
+                deadline = time.time() + self.delay
+                while time.time() < deadline:
+                    if task_pool.is_cancelled(query_id):
+                        raise QueryCancelled(query_id)
+                    time.sleep(0.01)
+        if self.fail:
+            raise ValueError("fake failure")
+        return _FakeResult(pa.table({"x": [1, 2, 3]}))
+
+
+def test_scheduler_lifecycle_success_failure():
+    log = []
+    sched = QueryScheduler(session_factory=lambda: _FakeSession(log=log))
+    qid = sched.submit(_tiny_plan(), conf={"auron.batch.size": 4096})
+    assert sched.wait(qid, timeout=30)
+    st = sched.status(qid)
+    assert st["state"] == "succeeded" and st["rows"] == 3
+    assert sched.result(qid).num_rows == 3
+    assert log and log[0][0] == qid     # executed under the serving id
+
+    sched2 = QueryScheduler(session_factory=lambda: _FakeSession(fail=True))
+    qid2 = sched2.submit(_tiny_plan())
+    assert sched2.wait(qid2, timeout=30)
+    st2 = sched2.status(qid2)
+    assert st2["state"] == "failed" and "fake failure" in st2["error"]
+    assert sched2.result(qid2) is None
+    # the failed query released its admission reservation
+    assert sched2.admission.held_bytes() == 0
+
+
+def test_scheduler_priority_starts_high_first():
+    log = []
+    sched = QueryScheduler(
+        session_factory=lambda: _FakeSession(delay=0.15, log=log))
+    with conf.scoped({"auron.serving.max.concurrent": 1}):
+        q_low = sched.submit(_tiny_plan(tag="low"), priority=1)
+        q_mid = sched.submit(_tiny_plan(tag="mid"), priority=2)
+        q_high = sched.submit(_tiny_plan(tag="high"), priority=5)
+        for q in (q_low, q_mid, q_high):
+            assert sched.wait(q, timeout=30)
+    started = [q for q, _ in log]
+    # q_low starts immediately (empty queue); the waiters start by priority
+    assert started[0] == q_low and started[1:] == [q_high, q_mid]
+
+
+def test_scheduler_cancel_queued_and_running():
+    sched = QueryScheduler(
+        session_factory=lambda: _FakeSession(delay=10.0))
+    with conf.scoped({"auron.serving.max.concurrent": 1}):
+        q_run = sched.submit(_tiny_plan())
+        time.sleep(0.1)                      # let it start
+        q_wait = sched.submit(_tiny_plan())
+        assert sched.status(q_wait)["state"] == "queued"
+        assert sched.cancel(q_wait)          # cancel while queued
+        assert sched.status(q_wait)["state"] == "cancelled"
+        assert sched.cancel(q_run)           # cancel while running
+        assert sched.wait(q_run, timeout=30)
+        assert sched.status(q_run)["state"] == "cancelled"
+        assert not sched.cancel(q_run)       # already finished
+    assert counters.get("queries_cancelled") >= 2
+    assert sched.admission.held_bytes() == 0
+
+
+def test_scheduler_queue_timeout_and_shed():
+    sched = QueryScheduler(
+        session_factory=lambda: _FakeSession(delay=5.0))
+    with conf.scoped({"auron.serving.max.concurrent": 1,
+                      "auron.admission.queue.max": 1,
+                      "auron.admission.queue.timeout.seconds": 0.2}):
+        q_run = sched.submit(_tiny_plan())
+        q_wait = sched.submit(_tiny_plan())
+        with pytest.raises(SubmissionRejected):
+            sched.submit(_tiny_plan())       # queue full -> shed
+        assert sched.wait(q_wait, timeout=10)
+        st = sched.status(q_wait)
+        assert st["state"] == "failed" and "timeout" in st["error"]
+        sched.cancel(q_run)
+        sched.wait(q_run, timeout=10)
+    assert sched.admission.events["shed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP serving routes
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=60) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def _post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def test_http_routes_503_without_scheduler():
+    from auron_tpu.runtime import profiling
+    from auron_tpu.serving.server import uninstall_scheduler
+    uninstall_scheduler()
+    srv = profiling.ProfilingServer().start()
+    try:
+        assert _post(srv.url + "/submit", {})[0] == 503
+        assert _get(srv.url + "/status/xyz")[0] == 503
+        assert _get(srv.url + "/scheduler")[0] == 503
+        # the plain profiling surface is untouched
+        assert _get(srv.url + "/status")[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_http_submit_status_result_cancel(catalog):
+    srv = QueryServer(
+        session_factory=lambda: _FakeSession()).start()
+    try:
+        code, doc = _post(srv.url + "/submit", {"corpus": "nope"})
+        assert code == 400 and "unknown corpus" in doc["error"]
+        code, doc = _post(srv.url + "/submit",
+                          {"plan": _tiny_plan().to_dict(),
+                           "conf": {"auron.batch.size": 1024},
+                           "priority": 2})
+        assert code == 200, doc
+        qid = doc["query_id"]
+        assert srv.scheduler.wait(qid, timeout=60)
+        code, st = _get(srv.url + f"/status/{qid}")
+        assert code == 200 and st["state"] == "succeeded"
+        assert st["priority"] == 2
+        code, res = _get(srv.url + f"/result/{qid}")
+        assert code == 200 and res["num_rows"] == 3
+        assert res["rows"][0] == {"x": 1}
+        # unknown ids 404, unfinished results 409-free sanity
+        assert _get(srv.url + "/status/zzz")[0] == 404
+        assert _get(srv.url + "/result/zzz")[0] == 404
+        code, doc = _post(srv.url + f"/cancel/{qid}", {})
+        assert code == 200 and doc["cancelled"] is False  # already done
+        code, stats = _get(srv.url + "/scheduler")
+        assert code == 200 and stats["states"].get("succeeded", 0) >= 1
+        # bad conf key in the submission -> 400, not a wedged query
+        code, doc = _post(srv.url + "/submit",
+                          {"plan": _tiny_plan().to_dict(),
+                           "conf": {"auron.bogus": 1}})
+        assert code == 400
+    finally:
+        srv.stop()
+
+
+def test_http_result_row_cap(catalog):
+    class _Wide(_FakeSession):
+        def execute(self, plan, mesh=None, mesh_axis="parts",
+                    query_id=None):
+            return _FakeResult(pa.table({"x": list(range(100))}))
+
+    srv = QueryServer(session_factory=_Wide).start()
+    try:
+        with conf.scoped({"auron.serving.result.max.rows": 10}):
+            _, doc = _post(srv.url + "/submit",
+                           {"plan": _tiny_plan().to_dict()})
+            qid = doc["query_id"]
+            srv.scheduler.wait(qid, timeout=30)
+            code, res = _get(srv.url + f"/result/{qid}")
+        assert code == 200 and res["truncated"] and len(res["rows"]) == 10
+        assert res["num_rows"] == 100
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end isolation + the acceptance stress
+# ---------------------------------------------------------------------------
+
+SERIAL_SCOPE = {
+    # serial per-partition path: per-operator metric trees + memory
+    # consumers register (the SPMD stage program has neither)
+    "auron.spmd.singleDevice.enable": False,
+}
+
+
+def _solo_baselines(names, catalog):
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it import queries
+    from auron_tpu.it.oracle import PyArrowEngine
+    out = {}
+    with conf.scoped(SERIAL_SCOPE):
+        for name in set(names):
+            session = AuronSession(foreign_engine=PyArrowEngine())
+            out[name] = _canon(
+                session.execute(queries.build(name, catalog)).table)
+    return out
+
+
+def test_concurrent_queries_isolated_records(catalog):
+    """Two interleaved traced queries: each /queries record carries its
+    own rows/attempts, each trace only its own spans, and per-query conf
+    overlays never bleed."""
+    from auron_tpu.it import queries
+    from auron_tpu.serving.scheduler import default_session_factory
+    names = ["q03", "q42"]
+    baselines = _solo_baselines(names, catalog)
+    sched = QueryScheduler(session_factory=default_session_factory)
+    with conf.scoped({**SERIAL_SCOPE, "auron.trace.enable": True,
+                      "auron.serving.max.concurrent": 2}):
+        qids = {n: sched.submit(queries.build(n, catalog),
+                                conf={"auron.batch.size": 4096 + 512 * i})
+                for i, n in enumerate(names)}
+        for qid in qids.values():
+            assert sched.wait(qid, timeout=300)
+    for name, qid in qids.items():
+        st = sched.status(qid)
+        assert st["state"] == "succeeded", st
+        assert _canon(sched.result(qid)).equals(baselines[name])
+        rec = tracing.find_query(qid)
+        assert rec is not None
+        assert rec.rows == sched.result(qid).num_rows
+        assert rec.attempts > 0
+        # the trace only carries this query's id on its query span
+        qspans = [e for e in rec.trace["traceEvents"]
+                  if e.get("name") == "query"]
+        assert len(qspans) == 1
+        assert qspans[0]["args"]["query_id"] == qid
+
+
+def test_concurrent_stress_faults(catalog):
+    """THE acceptance gate: >= 8 concurrent queries under injected
+    faults (io, latency, mem) and a tiny shared memory budget — every
+    query's result bit-identical to its solo fault-free run, per-query
+    /queries records attributed to the right id, and the recovery
+    totals consistent (sum of per-query retries == the process delta:
+    nothing bled between records, nothing was lost)."""
+    from auron_tpu import faults
+    from auron_tpu.it import queries
+    from auron_tpu.memmgr.manager import get_manager, reset_manager
+    from auron_tpu.runtime import retry
+    from auron_tpu.serving.scheduler import default_session_factory
+
+    names = ["q03", "q42", "q01", "q03", "q42", "q01", "q03", "q42"]
+    baselines = _solo_baselines(names, catalog)
+
+    # io rules carry max= bounds: across eight interleaved queries the
+    # unbounded streams can land three hits inside one task's attempt
+    # budget and legitimately fail a query — the gate is recovery under
+    # faults, not survival of unbounded adversity (chaos_check owns the
+    # calibrated unbounded sweeps)
+    spec = ("shuffle.push:io:p=0.08,max=10,seed=7;"
+            "shuffle.fetch:io:p=0.08,max=10,seed=11;"
+            "shuffle.push:latency:p=0.15,seed=5,ms=5;"
+            "op.execute:mem:bytes=65536,max=2,seed=9")
+    faults.reset(spec)
+    stress_scope = {
+        **SERIAL_SCOPE,
+        "auron.faults.spec": spec,
+        "auron.task.retries": 2,
+        "auron.retry.backoff.base.ms": 1.0,
+        "auron.retry.backoff.max.ms": 10.0,
+        # tiny shared pool: all eight queries fight for ~2MB and spill
+        "auron.memory.spill.min.trigger.bytes": 1024,
+        "auron.serving.max.concurrent": 8,
+        "auron.admission.default.forecast.bytes": 131072,
+    }
+    task_pool.reset_pool()
+    tracing.clear_history()
+    with conf.scoped(stress_scope):
+        mgr = reset_manager(2 << 20)
+        stats0 = retry.stats_snapshot()
+        sched = QueryScheduler(session_factory=default_session_factory)
+        qids = [sched.submit(queries.build(n, catalog),
+                             priority=1 + (i % 3))
+                for i, n in enumerate(names)]
+        assert len(set(qids)) == 8
+        for qid in qids:
+            assert sched.wait(qid, timeout=600), sched.status(qid)
+        stats1 = retry.stats_snapshot()
+
+    # the sweep must actually have injected (hollow-gate guard)
+    reg = faults.registry_for(spec)
+    assert reg.injected_total() > 0, reg.counts()
+
+    recs = {}
+    for qid, name in zip(qids, names):
+        st = sched.status(qid)
+        assert st["state"] == "succeeded", (name, st)
+        table = _canon(sched.result(qid))
+        assert table.equals(baselines[name]), \
+            f"{name} ({qid}) diverged from its solo fault-free run"
+        rec = tracing.find_query(qid)
+        assert rec is not None, f"no /queries record for {qid}"
+        recs[qid] = rec
+        # attribution: the record's row count is THIS query's result
+        assert rec.rows == sched.result(qid).num_rows
+        assert rec.wall_s > 0 and rec.attempts > 0
+        assert rec.error is None
+
+    # conservation: per-query recovery/memory counters sum to the
+    # process-wide deltas — no double counting, no cross-query bleed
+    retries_delta = stats1["retries"] - stats0["retries"]
+    assert sum(r.retries for r in recs.values()) == retries_delta
+    assert retries_delta > 0, "io faults must drive visible retries"
+    assert sum(r.mem_spills for r in recs.values()) == mgr.num_spills
+    assert mgr.num_spills > 0, "tiny budget must force spills"
+    # per-operator memory peaks attributed into the records (serial path)
+    assert any(r.mem_peak > 0 for r in recs.values())
+
+
+@pytest.mark.slow
+def test_concurrent_stress_heavy(catalog):
+    """Nightly-sized sweep: 12 queries over 4 shapes, faults on spill
+    write too, several admission waves (max 3 concurrent + small
+    admission cap so queue events fire)."""
+    from auron_tpu import faults
+    from auron_tpu.it import queries
+    from auron_tpu.memmgr.manager import reset_manager
+    from auron_tpu.serving.scheduler import default_session_factory
+
+    names = ["q03", "q42", "q01", "q55"] * 3
+    baselines = _solo_baselines(names, catalog)
+    # spill.write is bounded (max=): the tiny budget makes spills so
+    # frequent that an unbounded p=0.05 stream eventually lands three
+    # faults inside ONE task's attempt budget and legitimately fails
+    # the query — the gate tests recovery, not unbounded adversity
+    spec = ("shuffle.push:io:p=0.1,seed=3;"
+            "shuffle.fetch:io:p=0.1,seed=5;"
+            "spill.write:io:p=0.05,max=6,seed=13;"
+            "shuffle.fetch:latency:p=0.2,seed=21,ms=10;"
+            "op.execute:mem:bytes=131072,max=3,seed=2")
+    faults.reset(spec)
+    task_pool.reset_pool()
+    with conf.scoped({**SERIAL_SCOPE,
+                      "auron.faults.spec": spec,
+                      "auron.task.retries": 2,
+                      "auron.retry.backoff.base.ms": 1.0,
+                      "auron.retry.backoff.max.ms": 10.0,
+                      "auron.memory.spill.min.trigger.bytes": 1024,
+                      "auron.serving.max.concurrent": 3,
+                      "auron.admission.default.forecast.bytes": 1 << 20,
+                      "auron.admission.memory.fraction": 0.9}):
+        reset_manager(3 << 20)
+        sched = QueryScheduler(session_factory=default_session_factory)
+        qids = [sched.submit(queries.build(n, catalog)) for n in names]
+        for qid in qids:
+            assert sched.wait(qid, timeout=900), sched.status(qid)
+    for qid, name in zip(qids, names):
+        assert sched.status(qid)["state"] == "succeeded"
+        assert _canon(sched.result(qid)).equals(baselines[name]), name
+    # several waves => the admission gate visibly queued submissions
+    assert sched.admission.events["queued"] >= 1 or \
+        sched.admission.events["admitted"] == len(names)
+
+
+@pytest.mark.slow
+def test_tools_serve_check_script():
+    """tools/serve_check.sh is the CI serving gate; keep it green from
+    pytest (mirrors chaos_check/mem_check wiring)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serve_check.sh")
+    if not os.path.exists(script) or shutil.which("bash") is None:
+        pytest.skip("serve script or bash unavailable")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    out = subprocess.run(["bash", script], capture_output=True,
+                         text=True, timeout=540, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr

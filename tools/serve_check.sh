@@ -1,0 +1,130 @@
+#!/usr/bin/env bash
+# CI serving gate (CPU, no accelerator needed):
+#   1. start a QueryServer (the profiling HTTP server promoted to a
+#      submission endpoint) over a small memory budget with admission
+#      knobs tight enough that four concurrent submissions cannot all
+#      be admitted at once
+#   2. POST four concurrent /submit requests (IT-corpus queries), wait
+#      via /status, fetch /result
+#   3. assert every query succeeds with results value-identical to its
+#      solo fault-free run, and that the admission gate visibly QUEUED
+#      at least one submission (/scheduler events + the Prometheus
+#      auron_admission_queued_total counter)
+#
+# The same check runs inside the suite (tests/test_serving.py::
+# test_tools_serve_check_script, marked slow), mirroring how
+# chaos_check.sh / mem_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import threading
+import urllib.request
+
+from auron_tpu.config import conf
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.it import datagen, queries
+from auron_tpu.it.oracle import PyArrowEngine
+from auron_tpu.memmgr.manager import reset_manager
+from auron_tpu.serving import QueryServer, register_catalog
+
+import tempfile
+
+SF = 0.002
+NAMES = ["q01", "q03", "q42", "q55"]
+
+catalog = datagen.generate(
+    tempfile.mkdtemp(prefix="auron-serve-check-"), sf=SF)
+register_catalog(SF, catalog)
+
+# solo fault-free baselines (value-identical gate)
+def canon(t):
+    t = t.combine_chunks()
+    return t.sort_by([(n, "ascending") for n in t.column_names]) \
+        if t.num_rows and t.num_columns else t
+
+serial = {"auron.spmd.singleDevice.enable": False}
+baselines = {}
+with conf.scoped(serial):
+    for name in NAMES:
+        s = AuronSession(foreign_engine=PyArrowEngine())
+        baselines[name] = canon(s.execute(queries.build(name, catalog)).table)
+
+# small budget + tight admission: forecasts of 45% of the budget against
+# a 0.8 cap mean at most two queries hold reservations at once, so four
+# concurrent submissions MUST produce >= 1 admission-queue event
+budget = 32 << 20
+scope = {**serial,
+         "auron.serving.max.concurrent": 4,
+         "auron.admission.default.forecast.bytes": int(budget * 0.45),
+         "auron.admission.memory.fraction": 0.8,
+         "auron.memory.spill.min.trigger.bytes": 64 << 10}
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.load(r)
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=300) as r:
+        return r.read()
+
+with conf.scoped(scope):
+    reset_manager(budget)
+    srv = QueryServer().start()
+    try:
+        qids = {}
+        errs = []
+
+        def submit(name):
+            try:
+                doc = post(srv.url + "/submit",
+                           {"corpus": name, "sf": SF})
+                qids[name] = doc["query_id"]
+            except Exception as e:   # noqa: BLE001
+                errs.append((name, repr(e)))
+
+        threads = [threading.Thread(target=submit, args=(n,))
+                   for n in NAMES]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert len(qids) == 4
+
+        for name, qid in qids.items():
+            assert srv.scheduler.wait(qid, timeout=600), \
+                f"{name} did not finish"
+            st = json.loads(get(srv.url + f"/status/{qid}"))
+            assert st["state"] == "succeeded", (name, st)
+            res = json.loads(get(srv.url + f"/result/{qid}"))
+            assert not res["truncated"]
+            import pyarrow as pa
+            got = canon(pa.Table.from_pylist(
+                res["rows"], schema=baselines[name].schema))
+            assert got.equals(baselines[name]), \
+                f"{name} served result diverged from its solo run"
+
+        stats = json.loads(get(srv.url + "/scheduler"))
+        queued = stats["admission"]["events"]["queued"]
+        assert queued >= 1, f"admission gate never queued: {stats}"
+        prom = get(srv.url + "/metrics").decode()
+        for needle in ("auron_admission_queued_total",
+                       "auron_admission_admitted_total",
+                       "auron_queries_submitted_total 4"):
+            assert needle in prom, f"missing {needle!r} in /metrics"
+        line = [ln for ln in prom.splitlines()
+                if ln.startswith("auron_admission_queued_total")][0]
+        assert int(line.split()[-1]) >= 1
+        print(f"serve_check: 4/4 queries value-identical to solo runs, "
+              f"{queued} admission-queue event(s)")
+    finally:
+        srv.stop()
+        reset_manager()
+EOF
+
+echo "serve_check.sh: ok"
