@@ -755,6 +755,87 @@ ADMISSION_DEGRADE_SERIAL_FRACTION = conf.define(
     "instead of being shed; 0 disables degradation.",
 )
 
+# -- kernel-strategy layer (ops/strategy.py) --------------------------------
+
+KERNEL_SORT_STRATEGY = conf.define(
+    "auron.kernel.sort.strategy", "auto",
+    "Device argsort family for the encoded-sort-key kernels (Sort, "
+    "Window, SMJ windows, join build, agg sort path, SPMD exchanges): "
+    "'radix' = pack-sort (row index packed into the low bits of greedily "
+    "word-packed keys, composed LSD value sorts — ops/radix_sort.py; "
+    "measured 2.4x on u64 and 5x on u32 keys vs the XLA-CPU comparator "
+    "argsort at 4M rows), 'argsort' = the legacy comparator form, "
+    "'auto' = radix on the CPU backend above "
+    "auron.kernel.sort.radix.min.rows, argsort elsewhere (no recorded "
+    "chip numbers for pack-sort yet; the bench profile times both).  "
+    "Either way the permutation is bit-identical (stable order).",
+)
+KERNEL_SORT_RADIX_MIN_ROWS = conf.define(
+    "auron.kernel.sort.radix.min.rows", 1 << 15,
+    "Capacity floor below which 'auto' keeps the legacy argsort: small "
+    "sorts sit at the dispatch floor where the pack-sort's extra "
+    "shift/mask work and pass composition buy nothing.",
+)
+KERNEL_JOIN_PROBE_STRATEGY = conf.define(
+    "auron.kernel.join.probe.strategy", "auto",
+    "Hash-join probe kernel (ops/joins/kernel.py): 'partitioned' = "
+    "bucket-partitioned probe index (high radix bits of the u64 key "
+    "hash pick a bucket; a bounded binary search over the build side's "
+    "DEDUPLICATED hashes runs only within the bucket span, with the "
+    "iteration count fixed at build time from the measured max span), "
+    "'searchsorted' = the legacy double-searchsorted range scan, "
+    "'auto' = partitioned on the CPU backend for build capacities in "
+    "[auron.kernel.join.partitioned.min.rows, ...max.rows] (measured "
+    "3.1x at a 4k build table, 1.9x at 4M, 4M probes each).",
+)
+KERNEL_JOIN_PARTITIONED_MIN_ROWS = conf.define(
+    "auron.kernel.join.partitioned.min.rows", 1 << 10,
+    "Build-capacity floor for the 'auto' partitioned probe: below it "
+    "the legacy double searchsorted is already dispatch-bound and the "
+    "index build (plus its one max-span host sync per build table) "
+    "cannot pay for itself.",
+)
+KERNEL_JOIN_PARTITIONED_MAX_ROWS = conf.define(
+    "auron.kernel.join.partitioned.max.rows", 0,
+    "Build-capacity CEILING past which 'auto' falls back to the sorted "
+    "searchsorted path (the documented high-cardinality escape).  0 = "
+    "no ceiling; the recorded CPU measurements show the partitioned "
+    "probe still winning at 4M-row builds, so the default leaves it "
+    "open.",
+)
+KERNEL_JOIN_BUCKET_BITS = conf.define(
+    "auron.kernel.join.bucket.bits", 0,
+    "Radix width (log2 bucket count) of the partitioned-probe bucket "
+    "index.  0 = auto-size from the build capacity: "
+    "clamp(log2(capacity), 16, 20) — 2^16 buckets keep dim-table spans "
+    "at 1-3 entries, 2^20 holds megarow builds to ~5 search iterations.",
+)
+KERNEL_GROUP_STRATEGY = conf.define(
+    "auron.kernel.group.strategy", "auto",
+    "Unsorted (hash-grouped) segment-reduction kernel "
+    "(ops/hash_group.py via ops/segments.py): 'onehot' = chunked "
+    "one-hot/matmul reduction (sums ride the MXU on TPU-class "
+    "backends; min/max use a chunked masked reduce), 'scatter' = "
+    "jax.ops.segment_* scatter kernels, 'auto' = onehot only on "
+    "TPU-class backends AND only for static segment counts <= "
+    "auron.kernel.group.onehot.max.segments; on CPU the scatter floor "
+    "WINS and auto keeps it (measured 4M rows: G=64 scatter 158ms vs "
+    "onehot 225ms, G=256 155ms vs 831ms).",
+)
+KERNEL_GROUP_ONEHOT_MAX_SEGMENTS = conf.define(
+    "auron.kernel.group.onehot.max.segments", 1 << 10,
+    "Static segment-count ceiling for the one-hot group reduction: the "
+    "one-hot expansion costs n*G multiply-accumulates, so it is a "
+    "LOW-cardinality strategy by construction.",
+)
+KERNEL_COST_PROFILE_PATH = conf.define(
+    "auron.kernel.cost.profile.path", "",
+    "Path to a recorded kernel-profile artifact (a BENCH_r0x.json or a "
+    "raw worker-profile dict) that seeds the strategy cost model "
+    "(ops/strategy.py KernelCostModel).  Empty = the embedded "
+    "BENCH_r05 CPU numbers.",
+)
+
 
 _COMPILE_CACHE_APPLIED: List[str] = []
 

@@ -193,12 +193,14 @@ class AggExec(Operator, MemConsumer):
         nk = len(self.grouping)
         from auron_tpu.ops.sort_keys import multipass_enabled
         from auron_tpu.ops.hash_group import table_bits_key
+        from auron_tpu.ops.strategy import strategy_fingerprint
         key = ("agg.group_reduce", self._spec_struct_key(), orders, merge,
                nk, strategy,
                # trace-time config the bodies read: a flag flip must not
                # reuse a kernel traced under the old lexsort form / hash
-               # table size
-               multipass_enabled(), table_bits_key())
+               # table size / kernel strategy
+               multipass_enabled(), table_bits_key(),
+               strategy_fingerprint())
 
         def build():
             body = _group_reduce_body_hash if strategy == "hash" \
@@ -223,13 +225,14 @@ class AggExec(Operator, MemConsumer):
         slices = self._agg_arg_slices
         out_schema = frag.schema
         from auron_tpu.ops.hash_group import table_bits_key
+        from auron_tpu.ops.strategy import strategy_fingerprint
         key = ("agg.fused_update", frag.struct_key(),
                self._key_eval._structural_key(),
                None if self._val_eval is None
                else self._val_eval._structural_key(),
                self._spec_struct_key(), orders, strategy,
                multipass_enabled(), table_bits_key(), capacity, sig,
-               frag._conf_key())
+               frag._conf_key(), strategy_fingerprint())
         apply = frag.body_applier()
 
         def build():
@@ -270,7 +273,9 @@ class AggExec(Operator, MemConsumer):
         orders = self._key_orders()
         nk = len(self.grouping)
         from auron_tpu.ops.sort_keys import multipass_enabled
-        base = cached_jit(("agg.sort_base", orders, nk, multipass_enabled()),
+        from auron_tpu.ops.strategy import strategy_fingerprint
+        base = cached_jit(("agg.sort_base", orders, nk, multipass_enabled(),
+                           strategy_fingerprint()),
                           lambda: _sort_base_builder(orders))
         perm, seg, n_groups, key_out = base(keys, live)
         out_cols: List[Any] = list(key_out)
@@ -795,10 +800,11 @@ def _group_reduce_body(keys: List[Any], value_cols: List[List[Any]],
     """Pure-jax sort-based group reduction over an explicit live mask.
     Live rows sort first (pad rank), so sorted-live = arange < sum(live).
     Returns (out_cols, n_groups) with n_groups a device scalar."""
+    from auron_tpu.ops.sort_keys import encode_sort_keys_bits
     capacity = live.shape[0]
     n_live = jnp.sum(live.astype(jnp.int32))
     words = encode_sort_keys(keys, orders)
-    perm = lexsort_indices_live(words, live)
+    perm = lexsort_indices_live(words, live, encode_sort_keys_bits(keys))
     slive = jnp.arange(capacity, dtype=jnp.int32) < n_live
     sorted_words = [jnp.take(w, perm) for w in words]
     if sorted_words:
@@ -861,10 +867,12 @@ def _sort_base_builder(orders):
     """Shared half of the split merge reduction: sort + segment structure
     + key gather (no per-spec state math)."""
     def run(keys, live):
+        from auron_tpu.ops.sort_keys import encode_sort_keys_bits
         capacity = live.shape[0]
         n_live = jnp.sum(live.astype(jnp.int32))
         words = encode_sort_keys(keys, orders)
-        perm = lexsort_indices_live(words, live)
+        perm = lexsort_indices_live(words, live,
+                                    encode_sort_keys_bits(keys))
         slive = jnp.arange(capacity, dtype=jnp.int32) < n_live
         sorted_words = [jnp.take(w, perm) for w in words]
         if sorted_words:

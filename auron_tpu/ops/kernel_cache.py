@@ -25,6 +25,17 @@ import jax
 
 _CACHE: Dict[Hashable, Any] = {}
 _STATS = {"hits": 0, "misses": 0}
+_FAMILY_BUILDS: Dict[str, int] = {}
+
+
+def _family(key: Hashable) -> str:
+    """Kernel family = the leading string of a structured cache key
+    ("agg.group_reduce", "join.range.part", ...) — the unit the strategy
+    layer swaps implementations at, and the granularity kernel_check and
+    cache_info report builds by."""
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        return key[0]
+    return str(key)
 
 
 def cached_jit(key: Hashable, builder: Callable[[], Callable],
@@ -36,6 +47,8 @@ def cached_jit(key: Hashable, builder: Callable[[], Callable],
     fn = _CACHE.get(key)
     if fn is None:
         _STATS["misses"] += 1
+        fam = _family(key)
+        _FAMILY_BUILDS[fam] = _FAMILY_BUILDS.get(fam, 0) + 1
         fn = jax.jit(builder(), static_argnames=static_argnames)
         _CACHE[key] = fn
         # a miss is a new jitted program: mark the build point in the
@@ -65,7 +78,15 @@ def cache_info() -> Dict[str, int]:
             "misses": _STATS["misses"]}
 
 
+def family_builds() -> Dict[str, int]:
+    """Cumulative kernel BUILDS by family — how a strategy flip shows up
+    in the cache (e.g. both a "join.range" and a "join.range.part" build
+    in one process means both probe strategies ran).  Copy, not view."""
+    return dict(_FAMILY_BUILDS)
+
+
 def clear() -> None:
     """Test hook: drop every cached kernel (forces re-tracing)."""
     _CACHE.clear()
     _STATS["hits"] = _STATS["misses"] = 0
+    _FAMILY_BUILDS.clear()

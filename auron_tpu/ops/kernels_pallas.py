@@ -111,3 +111,66 @@ def hash_partition_ids_i64(data, validity, n_parts: int,
             interpret=interpret,
         )(lo2, hi2, va2)
     return out.reshape(cap)
+
+
+# ---------------------------------------------------------------------------
+# radix-partition staging kernel (the TPU half of the pack-sort strategy)
+# ---------------------------------------------------------------------------
+#
+# The CPU radix strategy (ops/radix_sort.py) rides XLA's value sort; on a
+# real TPU the equivalent partition pass is a per-tile bucket HISTOGRAM
+# (digit extract + count) that a stitch pass turns into scatter offsets.
+# This kernel is that histogram, fused into one VMEM pass per row tile —
+# staged here under the module's measured-negative-control policy: the
+# bench profile can head-to-head it against the XLA twin on a chip before
+# any production path adopts it (the round-3 lesson: the hash-pid pallas
+# kernel LOST 2.3x to XLA's fusion; numbers first).
+
+_HIST_MAX_BUCKETS = 256
+
+
+def _radix_hist_kernel(hi_ref, out_ref, *, b_bits: int):
+    hi = hi_ref[:]
+    digit = (hi >> np.uint32(32 - b_bits)).astype(jnp.int32)
+    # B is small and static: the bucket loop unrolls into B vector
+    # compare+reduce chains over the tile — pure VPU work, no scatter
+    for b in range(1 << b_bits):
+        out_ref[0, b] = jnp.sum((digit == b).astype(jnp.int32))
+
+
+def radix_bucket_hist_xla(hi, b_bits: int, tile_rows: int = _MAX_TILE_ROWS):
+    """jnp reference twin: per-tile bucket histogram of the u32 key high
+    word, [n_tiles, 2^b_bits] (tile = tile_rows*128 keys)."""
+    digit = (hi.astype(jnp.uint32) >> np.uint32(32 - b_bits)) \
+        .astype(jnp.int32)
+    tiles = digit.reshape(-1, tile_rows * _LANES)
+    gids = jnp.arange(1 << b_bits, dtype=jnp.int32)
+    return jnp.sum((tiles[:, :, None] == gids[None, None, :])
+                   .astype(jnp.int32), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("b_bits", "interpret"))
+def radix_bucket_hist(hi, b_bits: int, interpret: bool = False):
+    """Per-tile radix bucket histogram as one pallas pass.  hi:
+    uint32[cap] key high words, cap % (tile_rows*128) == 0; returns
+    int32[n_tiles, 2^b_bits]."""
+    if not 1 <= (1 << b_bits) <= _HIST_MAX_BUCKETS:
+        raise ValueError(f"b_bits {b_bits} outside staging range")
+    cap = hi.shape[0]
+    rows = cap // _LANES
+    tile_rows = min(rows, _MAX_TILE_ROWS)
+    while rows % tile_rows:
+        tile_rows -= 1
+    hi2 = hi.astype(jnp.uint32).reshape(rows, _LANES)
+    grid = (rows // tile_rows,)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_radix_hist_kernel, b_bits=b_bits),
+            out_shape=jax.ShapeDtypeStruct(
+                (rows // tile_rows, 1 << b_bits), jnp.int32),
+            grid=grid,
+            in_specs=[pl.BlockSpec((tile_rows, _LANES), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((1, 1 << b_bits), lambda i: (i, 0)),
+            interpret=interpret,
+        )(hi2)
+    return out

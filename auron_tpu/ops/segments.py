@@ -71,6 +71,15 @@ def sorted_segment_sum(x, seg, num_segments: int):
     if x.shape[0] == 0:
         return jnp.zeros((num_segments,), x.dtype)
     if _unsorted_mode():
+        # kernel-strategy dispatch (auron.kernel.group.strategy): the
+        # one-hot/matmul reduction replaces the scatter for small STATIC
+        # segment counts on TPU-class backends (ops/hash_group.py);
+        # trace-time read — jitted callers carry strategy_fingerprint()
+        # in their cache keys
+        from auron_tpu.ops.strategy import group_strategy
+        if group_strategy(num_segments) == "onehot":
+            from auron_tpu.ops.hash_group import onehot_segment_sum
+            return onehot_segment_sum(x, seg, num_segments)
         return jax.ops.segment_sum(x, seg, num_segments=num_segments)
     if not _use_sorted():
         return jax.ops.segment_sum(x, seg, num_segments=num_segments,
@@ -129,6 +138,10 @@ def _sorted_segment_extreme(x, seg, num_segments: int, op_is_min: bool):
     if x.shape[0] == 0:
         return jnp.full((num_segments,), fill, x.dtype)
     if _unsorted_mode():
+        from auron_tpu.ops.strategy import group_strategy
+        if group_strategy(num_segments) == "onehot":
+            from auron_tpu.ops.hash_group import onehot_segment_extreme
+            return onehot_segment_extreme(x, seg, num_segments, op_is_min)
         f = jax.ops.segment_min if op_is_min else jax.ops.segment_max
         return f(x, seg, num_segments=num_segments)
     if not _use_sorted():

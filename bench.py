@@ -320,6 +320,30 @@ def worker_profile() -> dict:
         "gather_rows": (vals, idx), "filter_compact": (mask,),
         "hash_pid_xla": (key64, valid),
     }
+    # per-STRATEGY timings (the kernel-floor PR): the radix pack-sort vs
+    # the comparator argsort it replaces, and the bucket-partitioned
+    # probe vs the double searchsorted — so the bench trajectory can SEE
+    # the swap (argsort_u64_ms vs radix_sort_u64_ms) instead of inferring
+    # it from the headline
+    from auron_tpu.ops import strategy as KS
+    from auron_tpu.ops.joins.kernel import bounded_probe, build_probe_index
+    from auron_tpu.ops.radix_sort import radix_sort_indices
+    cands["radix_sort_u64"] = jax.jit(
+        lambda k: radix_sort_indices([k.astype(jnp.uint64)], [64]))
+    args["radix_sort_u64"] = (key64,)
+    cands["radix_sort_u32"] = jax.jit(
+        lambda k: radix_sort_indices([k.astype(jnp.uint32)], [32]))
+    args["radix_sort_u32"] = (key64,)
+    # the partitioned probe sees what join probes see: uniform 64-bit
+    # murmur HASHES (the 2^40-bounded `table` above would collapse every
+    # key into radix bucket 0 and measure the degenerate span instead)
+    jtable = jnp.sort(jnp.asarray(
+        rng.integers(0, 1 << 63, n_groups).astype(np.uint64)))
+    jprobe = jnp.asarray(rng.integers(0, 1 << 63, n).astype(np.uint64))
+    probe_index = build_probe_index(jtable)
+    cands["probe_partitioned"] = jax.jit(
+        lambda p: bounded_probe(probe_index, p)[0])
+    args["probe_partitioned"] = (jprobe,)
     try:
         from auron_tpu.ops import kernels_pallas as KP
         if KP.supported([DeviceColumn(DataType.int64(), key64, valid)]):
@@ -336,8 +360,11 @@ def worker_profile() -> dict:
     bytes_model = {
         "argsort_u64": n * 8 + n * 4,
         "argsort_u32": n * 4 + n * 4,
+        "radix_sort_u64": n * 8 + n * 4,
+        "radix_sort_u32": n * 4 + n * 4,
         "segment_sum_sorted": n * 8 + n * 4 + g * 8,
         "probe_searchsorted": n * 8 + g * 8 + n * 4,
+        "probe_partitioned": n * 8 + g * 8 + n * 4,
         "gather_rows": n * 8 + n * 4 + n * 8,
         "filter_compact": n * 1 + n * 4,
         "hash_pid_xla": n * 8 + n * 4,
@@ -375,6 +402,13 @@ def worker_profile() -> dict:
     return {"profile": prof, "rows": n, "roofline": roofline,
             "hbm_roofline_gbps": hbm_gbps,
             "device_kind": getattr(dev, "device_kind", ""),
+            # what `auto` resolves to on THIS backend at the profiled
+            # shapes — the artifact records which strategy the engine
+            # actually ran with, next to both strategies' timings
+            "kernel_strategy": {
+                "sort": KS.sort_strategy(n),
+                "join_probe": KS.join_probe_strategy(n_groups),
+                "group": KS.group_strategy(256)},
             "platform": dev.platform}
 
 
@@ -590,18 +624,21 @@ def _summarize(results: dict, baseline_rps: float,
                 (fused["rows"] / fused["seconds"]) /
                 (engine["rows"] / engine["seconds"]), 1)
     if profile is not None:
-        if profile.get("platform") == "tpu":
-            out["kernel_profile_ms"] = profile.get("profile")
-            out["kernel_profile_platform"] = "tpu"
-            if profile.get("roofline"):
-                out["kernel_roofline"] = profile["roofline"]
-                out["hbm_roofline_gbps"] = profile.get("hbm_roofline_gbps")
-                out["device_kind"] = profile.get("device_kind")
-        else:
-            # CPU-fallback kernel numbers say NOTHING about the chip
-            # (VERDICT r4 weak #1): keep them, but under a name no
-            # reader can mistake for device evidence, with no roofline
-            out["kernel_profile_cpu_fallback_ms"] = profile.get("profile")
+        # ONE stable key across platforms (r04 used kernel_profile_ms,
+        # r05 renamed the CPU run kernel_profile_cpu_fallback_ms and the
+        # trajectory reader had to know both): the profile always lands
+        # under kernel_profile_ms and kernel_profile_platform is the
+        # device-evidence qualifier — cpu numbers still say NOTHING
+        # about the chip (VERDICT r4 weak #1), the qualifier is how a
+        # reader knows
+        out["kernel_profile_ms"] = profile.get("profile")
+        out["kernel_profile_platform"] = profile.get("platform")
+        if profile.get("kernel_strategy"):
+            out["kernel_strategy"] = profile["kernel_strategy"]
+        if profile.get("roofline"):
+            out["kernel_roofline"] = profile["roofline"]
+            out["hbm_roofline_gbps"] = profile.get("hbm_roofline_gbps")
+            out["device_kind"] = profile.get("device_kind")
     # top-level platform = whatever produced the HEADLINE metric
     headline = engine_any if engine_any is not None else fused
     if headline is not None:
@@ -611,6 +648,65 @@ def _summarize(results: dict, baseline_rps: float,
     if diagnostics:
         out["diagnostics"] = diagnostics[:6]
     return out
+
+
+# ---------------------------------------------------------------------------
+# probe-verdict cache: the device probe is a per-PLATFORM fact, not a
+# per-run one.  Five rounds of artifacts burned the full probe leash
+# (120s under the driver's AURON_BENCH_PROBE_TIMEOUT) re-discovering the
+# same dead tunnel; the verdict now persists in .jax_cache and is reused
+# within a TTL, and a JAX_PLATFORMS=cpu pin skips the probe outright
+# (there is no device path to probe).
+# ---------------------------------------------------------------------------
+
+PROBE_CACHE_TTL_S = 6 * 3600   # override: AURON_BENCH_PROBE_CACHE_TTL_S
+
+
+def _probe_cache_file() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".jax_cache", "probe_verdict.json")
+
+
+def _probe_cache_key() -> str:
+    # one verdict per platform pin (the thing that decides which backend
+    # the probe would exercise)
+    return "platforms=" + os.environ.get("JAX_PLATFORMS", "<unset>")
+
+
+def _load_probe_verdict() -> dict | None:
+    if os.environ.get("AURON_BENCH_PROBE_CACHE", "1") == "0":
+        return None
+    try:
+        with open(_probe_cache_file()) as f:
+            ent = json.load(f).get(_probe_cache_key())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(ent, dict):
+        return None
+    ttl = float(os.environ.get("AURON_BENCH_PROBE_CACHE_TTL_S",
+                               PROBE_CACHE_TTL_S))
+    if time.time() - float(ent.get("ts", 0)) > ttl:
+        return None
+    return ent
+
+
+def _save_probe_verdict(verdict: str, seconds: float | None) -> None:
+    if os.environ.get("AURON_BENCH_PROBE_CACHE", "1") == "0":
+        return
+    path = _probe_cache_file()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        doc[_probe_cache_key()] = {"verdict": verdict, "seconds": seconds,
+                                   "ts": time.time()}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    except OSError:
+        pass  # cache is best-effort; the probe still decided this run
 
 
 def main() -> None:
@@ -643,15 +739,42 @@ def main() -> None:
     # rounds of artifacts came back in <10s — burning 120s per round
     # bought nothing (ADVICE r5).  AURON_BENCH_PROBE_TIMEOUT overrides.
     probe_timeout = int(os.environ.get("AURON_BENCH_PROBE_TIMEOUT", "45"))
-    probe, probe_failed = _attempt("probe", diagnostics,
-                                   first_timeout=probe_timeout,
-                                   max_attempts=1)
-    if probe is None and probe_failed:
-        force_cpu = True
+    pinned = os.environ.get("JAX_PLATFORMS", "")
+    cached = _load_probe_verdict()
+    probe = None
+    probe_failed = False
+    if pinned and "tpu" not in pinned:
+        # backend pinned away from the device: there is nothing to
+        # probe — every worker runs the pinned platform anyway
+        force_cpu = pinned.strip() == "cpu"
         diagnostics.append(
-            f"probe: device path unusable within {probe_timeout}s -> "
-            f"CPU backend for all workers")
-    elif probe is not None and probe["seconds"] > 8:
+            f"probe: skipped (JAX_PLATFORMS={pinned} pinned)")
+    elif cached is not None:
+        if cached.get("verdict") == "dead":
+            force_cpu = True
+            age = time.time() - float(cached.get("ts", 0))
+            diagnostics.append(
+                f"probe: cached device-unusable verdict ({age / 60:.0f}m "
+                f"old, .jax_cache/probe_verdict.json) -> CPU backend for "
+                f"all workers without re-burning the probe leash")
+        else:
+            probe = {"seconds": float(cached.get("seconds") or 0.0)}
+            diagnostics.append(
+                f"probe: cached ok verdict (dispatch "
+                f"{probe['seconds']:.1f}s)")
+    else:
+        probe, probe_failed = _attempt("probe", diagnostics,
+                                       first_timeout=probe_timeout,
+                                       max_attempts=1)
+        if probe is None and probe_failed:
+            force_cpu = True
+            _save_probe_verdict("dead", None)
+            diagnostics.append(
+                f"probe: device path unusable within {probe_timeout}s -> "
+                f"CPU backend for all workers (verdict cached)")
+        elif probe is not None:
+            _save_probe_verdict("ok", probe["seconds"])
+    if probe is not None and probe["seconds"] > 8:
         # alive but congested: scale worker leashes by the observed
         # dispatch latency
         scale = min(3.0, max(1.0, probe["seconds"] / 8.0))

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI kernel-strategy gate (CPU-only, deterministic), the ISSUE 7 sibling
+# of chaos_check.sh / mem_check.sh:
+#
+#   1. the kernel-equivalence property suite (radix pack-sort vs
+#      np.lexsort, partitioned probe vs double searchsorted, one-hot
+#      group reduce vs scatter, sort spill-merge invariant) must pass;
+#   2. the strategy microbench (python -m auron_tpu.ops.strategy) must
+#      show the `auto` pick beating or tying the legacy kernel on the
+#      profiled shapes — a regression that makes `auto` the SLOWER
+#      choice fails the gate instead of silently shipping.
+#
+# Usage: tools/kernel_check.sh [extra python -m auron_tpu.ops.strategy args]
+#   AURON_KERNEL_CHECK_ROWS shrinks the microbench shape (CI boxes).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROWS=${AURON_KERNEL_CHECK_ROWS:-$((1 << 21))}
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m pytest tests/test_kernel_strategies.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:randomly
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m auron_tpu.ops.strategy --rows "$ROWS" "$@"
+
+echo "kernel_check.sh: ok"
