@@ -8,6 +8,9 @@ serialized node ({"@kind": ...} — the wire form ir/serde.py emits).
     python -m auron_tpu.analysis                      # lint the golden set
     python -m auron_tpu.analysis plan.json --strict   # warnings fail too
     python -m auron_tpu.analysis --regen-golden       # rebuild the set
+    python -m auron_tpu.analysis --concurrency        # static lock lint
+    python -m auron_tpu.analysis --concurrency --regen-golden
+                                      # rebuild the lock-order golden
 
 --regen-golden re-derives the documents from the IT corpus: every
 query in auron_tpu.it.queries is converted exactly as the runner
@@ -149,6 +152,36 @@ def regen_golden(out_dir: str, sf: float, data_dir: str) -> int:
     return 0
 
 
+def run_concurrency(regen: bool, golden_dir: str) -> int:
+    """The static concurrency pass (`--concurrency`): raw-lock lint,
+    static lock-order graph + cycle check, blocking-under-lock lint,
+    golden comparison."""
+    from auron_tpu.analysis import concurrency as conc
+
+    report = conc.analyze_concurrency()
+    golden = os.path.join(golden_dir, "lock_order.txt")
+    if regen:
+        text = conc.render_golden(report)
+        os.makedirs(golden_dir, exist_ok=True)
+        with open(golden, "w") as fh:
+            fh.write(text)
+        print(f"wrote {golden}: {len(report.locks)} locks, "
+              f"{len(report.edge_set())} edges, "
+              f"{len(set(report.waivers))} waivers")
+    problems = [] if regen else conc.check_against_golden(report, golden)
+    for d in report.result.diagnostics:
+        print(d)
+    for p in problems:
+        print(f"error[concurrency-golden] {p}")
+    n_err = len(report.result.errors) + len(problems)
+    status = "FAIL" if n_err else "ok"
+    print(f"{status}: {len(report.locks)} locks, "
+          f"{len(report.edge_set())} static edges, "
+          f"{len(set(report.waivers))} waivers, "
+          f"{n_err} unwaived errors")
+    return 2 if n_err else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="auron_tpu.analysis")
     ap.add_argument("paths", nargs="*",
@@ -157,15 +190,23 @@ def main(argv=None) -> int:
                     help="treat warnings as failures")
     ap.add_argument("--quiet", action="store_true",
                     help="print errors only")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the static concurrency pass instead of the "
+                         "plan lint (raw-lock registry bypass, static "
+                         "lock-order graph vs the committed golden, "
+                         "blocking-under-lock)")
     ap.add_argument("--regen-golden", action="store_true",
                     help="rebuild the golden plan documents from the IT "
-                         "corpus")
+                         "corpus (with --concurrency: rebuild the "
+                         "lock-order graph golden)")
     ap.add_argument("--golden-dir", default=None)
     ap.add_argument("--sf", type=float, default=0.001)
     ap.add_argument("--data-dir", default="/tmp/auron_tpcds_lint")
     args = ap.parse_args(argv)
 
     golden = args.golden_dir or default_golden_dir()
+    if args.concurrency:
+        return run_concurrency(args.regen_golden, golden)
     if args.regen_golden:
         return regen_golden(golden, args.sf, args.data_dir)
     paths = args.paths or [golden]

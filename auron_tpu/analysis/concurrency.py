@@ -1,0 +1,724 @@
+"""Static concurrency lint: the compile-time half of lockcheck.
+
+The dynamic checker (runtime/lockcheck.py) sees only the interleavings a
+run actually executes; this pass sees every lexical path.  It scans
+`auron_tpu/` source (AST, no imports executed) and
+
+1. errors on RAW ``threading.Lock()/RLock()/Condition()`` constructions
+   that bypass the named-lock registry (the registry is what makes the
+   order graph exhaustive rather than advisory);
+2. extracts a STATIC LOCK-ORDER GRAPH: lexical ``with <lock>:`` nesting
+   plus a bounded call-closure (same-module calls, imported-module
+   attribute calls, and package-unique bare names) so ``with
+   self._lock: self.admission.offer(...)`` contributes the locks
+   `offer` may take.  The graph is committed as a golden
+   (`tests/golden_plans/lock_order.txt`) and cross-checked against the
+   dynamic graph by the lockcheck test suite;
+3. flags LEXICALLY-BLOCKING calls under a lock — sleeps, socket ops,
+   `open`, subprocess, device sync — directly or through the same
+   call-closure.  Deliberate sites carry a ``# lockcheck: waive``
+   comment on the offending line (the static analogue of
+   ``lockcheck.waive_blocking``).
+
+The closure is deliberately conservative-but-partial: an attribute call
+whose bare name is defined more than once in the package is skipped
+(resolving it by name would fabricate edges), so the static graph is a
+subset of reality and the dynamic graph fills the gap — the cross-check
+asserts their UNION is cycle-free and that no dynamic edge reverses a
+committed static one.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from auron_tpu.analysis.diagnostics import AnalysisResult, DiagnosticSink
+
+PASS_ID = "concurrency"
+
+# files allowed to construct raw threading primitives (the checker's own
+# internals must not track themselves)
+RAW_ALLOWLIST = ("runtime/lockcheck.py",)
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# attribute / name tokens treated as blocking when called under a lock.
+# Curated — generic names (read/write/join/wait) would drown the signal.
+BLOCKING_ATTRS = {
+    "sleep": "sleep",
+    "sendall": "socket", "recv": "socket", "recv_into": "socket",
+    "accept": "socket", "create_connection": "socket",
+    "block_until_ready": "device-sync",
+    "urlopen": "network",
+    "run": None,            # blocking only as subprocess.run (see below)
+    "check_call": None, "check_output": None, "Popen": None,
+    "system": None,         # os.system
+}
+SUBPROCESS_ONLY = {"run", "check_call", "check_output", "Popen", "system"}
+BLOCKING_NAMES = {"open": "file-io", "sleep": "sleep"}
+
+WAIVE_COMMENT = "lockcheck: waive"
+
+# generic method names excluded from the unique-bare-name call fallback:
+# `f.write(...)` resolving to SOME package function named `write` would
+# fabricate edges.  Module-qualified (`counters.bump`) and self-method
+# calls still resolve exactly; only the last-resort fallback is gated.
+GENERIC_NAMES = frozenset({
+    "get", "set", "put", "pop", "add", "run", "read", "write", "open",
+    "close", "send", "recv", "push", "pull", "next", "flush", "clear",
+    "reset", "start", "stop", "wait", "notify", "release", "acquire",
+    "submit", "apply", "check", "build", "load", "save", "parse",
+    "update", "execute", "drain", "emit", "copy", "join", "split",
+    "strip", "extend", "append", "remove", "insert", "sort", "index",
+    "count", "encode", "decode", "format", "match", "search", "group",
+    "status", "result", "cancel", "call", "draw", "fetch", "delete",
+    "items", "keys", "values", "names", "name", "commit", "collect",
+})
+
+MAX_CLOSURE_DEPTH = 8
+
+
+@dataclass
+class LockDecl:
+    name: str
+    kind: str                # lock | rlock | condition
+    reentrant: bool
+    file: str
+    line: int
+
+
+@dataclass
+class _FuncInfo:
+    qualname: str            # Class.method or function name
+    module: str              # repo-relative file path
+    cls: Optional[str]
+    node: ast.AST
+    # filled by the summary walk:
+    direct_locks: Set[str] = field(default_factory=set)
+    calls: List[Tuple[Tuple[str, ...], ast.Call, int]] = \
+        field(default_factory=list)      # (locks held, call node, line)
+    blocking: List[Tuple[Tuple[str, ...], str, int, bool]] = \
+        field(default_factory=list)      # (locks, kind, line, waived)
+    nested_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+
+
+@dataclass
+class ConcurrencyReport:
+    locks: Dict[str, LockDecl] = field(default_factory=dict)
+    # a -> {b: "file:line (provenance)"}
+    edges: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    waivers: List[Tuple[str, str]] = field(default_factory=list)
+    result: AnalysisResult = field(default_factory=AnalysisResult)
+
+    def edge_set(self) -> Set[Tuple[str, str]]:
+        return {(a, b) for a, bs in self.edges.items() for b in bs}
+
+
+def _is_call_to(node: ast.AST, value_name: str, attrs: Set[str]
+                ) -> Optional[str]:
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and isinstance(node.func.value, ast.Name) \
+            and node.func.value.id == value_name \
+            and node.func.attr in attrs:
+        return node.func.attr
+    return None
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _line_has_waiver(src_lines: List[str], lineno: int) -> bool:
+    if 1 <= lineno <= len(src_lines):
+        return WAIVE_COMMENT in src_lines[lineno - 1]
+    return False
+
+
+def _blocking_kind_of(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return BLOCKING_NAMES.get(f.id)
+    if isinstance(f, ast.Attribute):
+        if f.attr in SUBPROCESS_ONLY:
+            if isinstance(f.value, ast.Name) and \
+                    f.value.id in ("subprocess", "os"):
+                return "subprocess"
+            return None
+        return BLOCKING_ATTRS.get(f.attr, None)
+    return None
+
+
+class _ModuleScan:
+    """Per-file collection: lock declarations, raw constructions,
+    waiver registrations, function defs + import map."""
+
+    def __init__(self, path: str, rel: str, tree: ast.Module,
+                 src_lines: List[str]):
+        self.rel = rel
+        self.tree = tree
+        self.src_lines = src_lines
+        self.global_locks: Dict[str, str] = {}      # global var -> name
+        self.attr_locks: Dict[str, Set[str]] = {}   # attr -> {names}
+        self.class_attr_locks: Dict[str, Dict[str, str]] = {}
+        self.decls: List[LockDecl] = []
+        self.raw_ctors: List[Tuple[int, bool]] = []  # (line, waived)
+        self.waivers: List[Tuple[str, str]] = []
+        self.funcs: List[_FuncInfo] = []
+        self.import_modules: Dict[str, str] = {}    # local -> dotted mod
+
+    def scan(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_modules[a.asname or
+                                        a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.import_modules[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+            elif isinstance(node, ast.Call):
+                self._scan_call(node)
+        self._scan_assignments()
+        self._scan_functions()
+
+    def _scan_call(self, node: ast.Call) -> None:
+        if _is_call_to(node, "threading", LOCK_FACTORIES):
+            waived = any(self.rel.endswith(p) for p in RAW_ALLOWLIST) \
+                or _line_has_waiver(self.src_lines, node.lineno)
+            self.raw_ctors.append((node.lineno, waived))
+        if (_is_call_to(node, "lockcheck", {"waive_blocking"})
+                and len(node.args) >= 2):
+            site = _const_str(node.args[0])
+            lock = _const_str(node.args[1])
+            if site and lock:
+                self.waivers.append((site, lock))
+
+    def _lock_factory_call(self, node: ast.AST
+                           ) -> Optional[Tuple[str, str, bool]]:
+        """(registry name, kind, reentrant) for lockcheck.X(...) calls."""
+        attr = _is_call_to(node, "lockcheck", LOCK_FACTORIES)
+        if attr is None:
+            return None
+        assert isinstance(node, ast.Call)
+        name = _const_str(node.args[0]) if node.args else None
+        if name is None:
+            return None
+        reentrant = any(kw.arg == "reentrant" and
+                        isinstance(kw.value, ast.Constant) and
+                        kw.value.value is True for kw in node.keywords)
+        return name, attr.lower(), reentrant
+
+    def _scan_assignments(self) -> None:
+        def record(target: ast.AST, info: Tuple[str, str, bool],
+                   cls: Optional[str], line: int) -> None:
+            name, kind, reentrant = info
+            self.decls.append(LockDecl(name, kind, reentrant, self.rel,
+                                       line))
+            if isinstance(target, ast.Name):
+                self.global_locks[target.id] = name
+                self.attr_locks.setdefault(target.id, set()).add(name)
+            elif isinstance(target, ast.Attribute):
+                self.attr_locks.setdefault(target.attr, set()).add(name)
+                if cls is not None:
+                    self.class_attr_locks.setdefault(
+                        cls, {})[target.attr] = name
+
+        def walk(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                nxt_cls = cls
+                if isinstance(child, ast.ClassDef):
+                    nxt_cls = child.name
+                if isinstance(child, ast.Assign):
+                    info = self._lock_factory_call(child.value)
+                    if info is not None:
+                        for t in child.targets:
+                            record(t, info, cls, child.lineno)
+                walk(child, nxt_cls)
+
+        walk(self.tree, None)
+
+    def _scan_functions(self) -> None:
+        def walk(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = f"{cls}.{child.name}" if cls else child.name
+                    self.funcs.append(_FuncInfo(qual, self.rel, cls,
+                                                child))
+                    walk(child, cls)   # nested defs get own summaries
+                else:
+                    walk(child, cls)
+
+        walk(self.tree, None)
+
+    # -- lock-expression resolution ----------------------------------------
+
+    def resolve_lock_expr(self, expr: ast.AST, cls: Optional[str]
+                          ) -> Optional[str]:
+        """`with <expr>:` -> registry lock name, or None (not a lock /
+        unresolvable).  Resolution order: module global, enclosing-class
+        attribute, unique module-wide attribute."""
+        if isinstance(expr, ast.Name):
+            return self.global_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if cls is not None:
+                hit = self.class_attr_locks.get(cls, {}).get(expr.attr)
+                if hit is not None and isinstance(expr.value, ast.Name) \
+                        and expr.value.id == "self":
+                    return hit
+            names = self.attr_locks.get(expr.attr, set())
+            if len(names) == 1:
+                return next(iter(names))
+        return None
+
+
+class _FuncSummary(ast.NodeVisitor):
+    """Walk ONE function body tracking the stack of lexically-held
+    locks; record with-nesting edges, calls under locks, and blocking
+    calls under locks.  Does not descend into nested function defs."""
+
+    def __init__(self, scan: _ModuleScan, info: _FuncInfo):
+        self.scan = scan
+        self.info = info
+        self.stack: List[str] = []
+
+    def run(self) -> None:
+        node = self.info.node
+        for stmt in node.body:  # type: ignore[attr-defined]
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass   # separate summary
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass   # deferred execution: not under the current lock context
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            lock = self.scan.resolve_lock_expr(item.context_expr,
+                                               self.info.cls)
+            if lock is not None:
+                if self.stack:
+                    self.info.nested_edges.append(
+                        (self.stack[-1], lock, node.lineno))
+                self.stack.append(lock)
+                acquired.append(lock)
+                self.info.direct_locks.add(lock)
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.stack.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.stack:
+            held = tuple(dict.fromkeys(self.stack))
+            kind = _blocking_kind_of(node)
+            if kind is not None:
+                waived = _line_has_waiver(self.scan.src_lines,
+                                          node.lineno)
+                self.info.blocking.append((held, kind, node.lineno,
+                                           waived))
+            else:
+                self.info.calls.append((held, node, node.lineno))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# whole-package analysis
+# ---------------------------------------------------------------------------
+
+class PackageAnalysis:
+    def __init__(self, root: str):
+        self.root = root
+        self.scans: List[_ModuleScan] = []
+        # bare function/class name -> [_FuncInfo]; classes map to __init__
+        self.by_name: Dict[str, List[_FuncInfo]] = {}
+        self.by_module_name: Dict[Tuple[str, str], _FuncInfo] = {}
+        self.by_class_method: Dict[Tuple[str, str, str], _FuncInfo] = {}
+        self._closure_locks: Dict[int, Set[str]] = {}
+        self._closure_blocking: Dict[int, List[Tuple[str, str, int, bool]]] \
+            = {}
+
+    def load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.root)
+                with open(path) as fh:
+                    src = fh.read()
+                try:
+                    tree = ast.parse(src, filename=rel)
+                except SyntaxError:
+                    continue   # ruff's department
+                scan = _ModuleScan(path, rel, tree, src.splitlines())
+                scan.scan()
+                self.scans.append(scan)
+        for scan in self.scans:
+            for fi in scan.funcs:
+                self.by_module_name.setdefault((scan.rel, fi.qualname
+                                                .split(".")[-1]), fi)
+                self.by_name.setdefault(
+                    fi.qualname.split(".")[-1], []).append(fi)
+                if fi.cls is not None:
+                    self.by_class_method[(scan.rel, fi.cls,
+                                          fi.qualname.split(".")[-1])] = fi
+            # classes resolve to their __init__ (instantiation under a
+            # lock runs the constructor under that lock)
+            for (rel, cls, meth), fi in list(self.by_class_method.items()):
+                if rel == scan.rel and meth == "__init__":
+                    self.by_name.setdefault(cls, []).append(fi)
+        for scan in self.scans:
+            for fi in scan.funcs:
+                _FuncSummary(scan, fi).run()
+
+    # -- call resolution ---------------------------------------------------
+
+    def _resolve_call(self, scan: _ModuleScan, info: _FuncInfo,
+                      node: ast.Call) -> Optional[_FuncInfo]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            hit = self.by_module_name.get((scan.rel, f.id))
+            if hit is not None:
+                return hit
+            if f.id in GENERIC_NAMES:
+                return None
+            cands = self.by_name.get(f.id, [])
+            return cands[0] if len(cands) == 1 else None
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                base = f.value.id
+                if base == "self" and info.cls is not None:
+                    hit = self.by_class_method.get(
+                        (scan.rel, info.cls, f.attr))
+                    if hit is not None:
+                        return hit
+                mod = scan.import_modules.get(base)
+                if mod is not None:
+                    # imported module paths carry the package prefix;
+                    # scan rels are package-root-relative
+                    suffix = mod.replace(".", "/")
+                    for s in self.scans:
+                        base = s.rel[:-3] if s.rel.endswith(".py") else s.rel
+                        base = base[:-9] if base.endswith("/__init__") \
+                            else base
+                        if suffix.endswith(base):
+                            hit = self.by_module_name.get((s.rel, f.attr))
+                            if hit is not None:
+                                return hit
+            if f.attr in GENERIC_NAMES:
+                return None
+            cands = self.by_name.get(f.attr, [])
+            return cands[0] if len(cands) == 1 else None
+        return None
+
+    @staticmethod
+    def _is_conf_access(node: ast.Call) -> bool:
+        """conf.get/set/unset — the config registry lock, accessed
+        through the imported `conf` object (or `config.conf`)."""
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and
+                f.attr in ("get", "set", "unset")):
+            return False
+        v = f.value
+        return (isinstance(v, ast.Name) and v.id == "conf") or \
+            (isinstance(v, ast.Attribute) and v.attr == "conf")
+
+    # -- closures ----------------------------------------------------------
+
+    def closure_locks(self, info: _FuncInfo, _depth: int = 0,
+                      _stack: Optional[Set[int]] = None) -> Set[str]:
+        key = id(info)
+        if key in self._closure_locks:
+            return self._closure_locks[key]
+        if _depth > MAX_CLOSURE_DEPTH:
+            return set()
+        stack = _stack or set()
+        if key in stack:
+            return set()
+        stack.add(key)
+        out = set(info.direct_locks)
+        scan = next(s for s in self.scans if s.rel == info.module)
+        # EVERY call in the body contributes (a caller holding a lock
+        # runs all of this function, whatever its own lock context)
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call):
+                if self._is_conf_access(node):
+                    out.add("config")
+                else:
+                    callee = self._resolve_call(scan, info, node)
+                    if callee is not None and callee is not info:
+                        out |= self.closure_locks(callee, _depth + 1,
+                                                  stack)
+        stack.discard(key)
+        self._closure_locks[key] = out
+        return out
+
+    def closure_blocking(self, info: _FuncInfo, _depth: int = 0,
+                         _stack: Optional[Set[int]] = None
+                         ) -> List[Tuple[str, str, int, bool]]:
+        """(kind, module:qualname, line, waived) reachable from `info`
+        regardless of this function's own lock context."""
+        key = id(info)
+        if key in self._closure_blocking:
+            return self._closure_blocking[key]
+        if _depth > MAX_CLOSURE_DEPTH:
+            return []
+        stack = _stack or set()
+        if key in stack:
+            return []
+        stack.add(key)
+        out: List[Tuple[str, str, int, bool]] = []
+        scan = next(s for s in self.scans if s.rel == info.module)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _blocking_kind_of(node)
+            if kind is not None:
+                out.append((kind, f"{info.module}:{info.qualname}",
+                            node.lineno,
+                            _line_has_waiver(scan.src_lines,
+                                             node.lineno)))
+            else:
+                callee = self._resolve_call(scan, info, node)
+                if callee is not None and callee is not info:
+                    out.extend(self.closure_blocking(callee, _depth + 1,
+                                                     stack))
+        stack.discard(key)
+        self._closure_blocking[key] = out
+        return out
+
+
+def _find_static_cycle(edges: Dict[str, Dict[str, str]]
+                       ) -> Optional[List[str]]:
+    graph = {a: set(bs) for a, bs in edges.items()}
+    color: Dict[str, int] = {}
+
+    def dfs(node: str, path: List[str]) -> Optional[List[str]]:
+        color[node] = 1
+        path.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            c = color.get(nxt, 0)
+            if c == 1:
+                return path[path.index(nxt):] + [nxt]
+            if c == 0:
+                hit = dfs(nxt, path)
+                if hit is not None:
+                    return hit
+        color[node] = 2
+        path.pop()
+        return None
+
+    for root in sorted(graph):
+        if color.get(root, 0) == 0:
+            hit = dfs(root, [])
+            if hit is not None:
+                return hit
+    return None
+
+
+def analyze_concurrency(root: Optional[str] = None) -> ConcurrencyReport:
+    """Run the full static pass over the auron_tpu package root."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = PackageAnalysis(root)
+    pkg.load()
+    report = ConcurrencyReport()
+    sink = DiagnosticSink()
+
+    # lock declarations (same name may be declared at several sites —
+    # kind/reentrancy must agree)
+    for scan in pkg.scans:
+        for d in scan.decls:
+            prev = report.locks.get(d.name)
+            if prev is None:
+                report.locks[d.name] = d
+            elif (prev.kind, prev.reentrant) != (d.kind, d.reentrant):
+                sink.error(PASS_ID, f"{d.file}:{d.line}", None,
+                           f"lock {d.name!r} re-declared as "
+                           f"{d.kind}/reentrant={d.reentrant} "
+                           f"(first: {prev.kind}/reentrant="
+                           f"{prev.reentrant} at {prev.file}:{prev.line})",
+                           hint="one registry name = one lock class")
+        for site, lock in scan.waivers:
+            report.waivers.append((site, lock))
+        for line, waived in scan.raw_ctors:
+            if not waived:
+                sink.error(PASS_ID, f"{scan.rel}:{line}", None,
+                           "raw threading.Lock/RLock/Condition "
+                           "construction bypasses the named-lock "
+                           "registry",
+                           hint="use lockcheck.Lock/RLock/Condition "
+                                "with a registry name")
+
+    def add_edge(a: str, b: str, site: str) -> None:
+        if a == b:
+            decl = report.locks.get(a)
+            if decl is not None and not decl.reentrant:
+                sink.error(PASS_ID, site, None,
+                           f"lock {a!r} may be re-acquired while held "
+                           f"(static self-edge) without a "
+                           f"reentrant=True declaration")
+            return
+        report.edges.setdefault(a, {}).setdefault(b, site)
+
+    for scan in pkg.scans:
+        for fi in scan.funcs:
+            for a, b, line in fi.nested_edges:
+                add_edge(a, b, f"{scan.rel}:{line}")
+            for held, call, line in fi.calls:
+                targets: Set[str] = set()
+                if pkg._is_conf_access(call):
+                    targets.add("config")
+                else:
+                    callee = pkg._resolve_call(scan, fi, call)
+                    if callee is not None:
+                        targets = pkg.closure_locks(callee)
+                for a in held:
+                    for b in targets:
+                        add_edge(a, b, f"{scan.rel}:{line}")
+                # blocking reached through the call while a lock is held
+                if not pkg._is_conf_access(call):
+                    callee = pkg._resolve_call(scan, fi, call)
+                    if callee is None:
+                        continue
+                    if _line_has_waiver(scan.src_lines, line):
+                        continue
+                    for kind, where, bline, waived in \
+                            pkg.closure_blocking(callee):
+                        if waived:
+                            continue
+                        sink.error(
+                            PASS_ID, f"{scan.rel}:{line}", None,
+                            f"call under lock(s) {', '.join(held)} "
+                            f"reaches blocking {kind} at {where}:"
+                            f"{bline}",
+                            hint="move the blocking work outside the "
+                                 "lock, or annotate the line with "
+                                 "'# lockcheck: waive (<reason>)'")
+            for held, kind, line, waived in fi.blocking:
+                if waived:
+                    continue
+                sink.error(
+                    PASS_ID, f"{scan.rel}:{line}", None,
+                    f"blocking {kind} call under lock(s) "
+                    f"{', '.join(held)}",
+                    hint="move it outside the lock, or annotate with "
+                         "'# lockcheck: waive (<reason>)'")
+
+    cycle = _find_static_cycle(report.edges)
+    if cycle is not None:
+        sink.error(PASS_ID, "<graph>", None,
+                   f"static lock-order cycle: {' -> '.join(cycle)}",
+                   hint="pick one global order for these locks and "
+                        "restructure the minority site")
+
+    report.result = AnalysisResult(diagnostics=sink.diagnostics)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# golden lock-order graph (tests/golden_plans/lock_order.txt)
+# ---------------------------------------------------------------------------
+
+GOLDEN_HEADER = (
+    "# Static lock-order graph over auron_tpu/ — the committed contract\n"
+    "# the dynamic checker (runtime/lockcheck.py) cross-checks against.\n"
+    "# Regenerate: python -m auron_tpu.analysis --concurrency "
+    "--regen-golden\n")
+
+
+def render_golden(report: ConcurrencyReport) -> str:
+    lines = [GOLDEN_HEADER.rstrip()]
+    for name in sorted(report.locks):
+        d = report.locks[name]
+        suffix = " reentrant" if d.reentrant else ""
+        lines.append(f"lock {name} {d.kind}{suffix}")
+    for a in sorted(report.edges):
+        for b in sorted(report.edges[a]):
+            lines.append(f"edge {a} -> {b}")
+    for site, lock in sorted(set(report.waivers)):
+        lines.append(f"waive {site} @ {lock}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_golden(text: str) -> Tuple[Dict[str, Tuple[str, bool]],
+                                     Set[Tuple[str, str]],
+                                     Set[Tuple[str, str]]]:
+    """-> (locks {name: (kind, reentrant)}, edges, waivers)."""
+    locks: Dict[str, Tuple[str, bool]] = {}
+    edges: Set[Tuple[str, str]] = set()
+    waivers: Set[Tuple[str, str]] = set()
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if parts[0] == "lock" and len(parts) >= 3:
+            locks[parts[1]] = (parts[2], "reentrant" in parts[3:])
+        elif parts[0] == "edge" and len(parts) == 4 and parts[2] == "->":
+            edges.add((parts[1], parts[3]))
+        elif parts[0] == "waive" and len(parts) == 4 and parts[2] == "@":
+            waivers.add((parts[1], parts[3]))
+    return locks, edges, waivers
+
+
+def golden_path() -> str:
+    env = os.environ.get("AURON_GOLDEN_PLANS")
+    base = env or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tests", "golden_plans")
+    return os.path.join(base, "lock_order.txt")
+
+
+def check_against_golden(report: ConcurrencyReport,
+                         path: Optional[str] = None) -> List[str]:
+    """Mismatch descriptions ([] = clean).  A drifted graph is an error
+    with a regen hint, exactly like the plan goldens."""
+    path = path or golden_path()
+    if not os.path.exists(path):
+        return [f"missing golden lock-order graph {path} "
+                f"(regen: python -m auron_tpu.analysis --concurrency "
+                f"--regen-golden)"]
+    with open(path) as fh:
+        locks, edges, waivers = parse_golden(fh.read())
+    problems: List[str] = []
+    cur_locks = {n: (d.kind, d.reentrant)
+                 for n, d in report.locks.items()}
+    cur_edges = report.edge_set()
+    cur_waivers = set(report.waivers)
+    for n in sorted(set(cur_locks) - set(locks)):
+        problems.append(f"lock {n!r} not in golden")
+    for n in sorted(set(locks) - set(cur_locks)):
+        problems.append(f"golden lock {n!r} no longer declared")
+    for n in sorted(set(locks) & set(cur_locks)):
+        if locks[n] != cur_locks[n]:
+            problems.append(f"lock {n!r} changed: golden {locks[n]} "
+                            f"vs current {cur_locks[n]}")
+    for e in sorted(cur_edges - edges):
+        problems.append(f"new static edge {e[0]} -> {e[1]} not in golden")
+    for e in sorted(edges - cur_edges):
+        problems.append(f"golden edge {e[0]} -> {e[1]} no longer found")
+    for w in sorted(cur_waivers - waivers):
+        problems.append(f"new waiver {w[0]} @ {w[1]} not in golden")
+    for w in sorted(waivers - cur_waivers):
+        problems.append(f"golden waiver {w[0]} @ {w[1]} no longer "
+                        f"declared")
+    if problems:
+        problems.append("regen: python -m auron_tpu.analysis "
+                        "--concurrency --regen-golden")
+    return problems

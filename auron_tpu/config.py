@@ -14,9 +14,10 @@ from __future__ import annotations
 
 import contextvars
 import os
-import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+from auron_tpu.runtime import lockcheck
 
 T = TypeVar("T")
 
@@ -53,7 +54,10 @@ class Configuration:
     def __init__(self) -> None:
         self._options: Dict[str, ConfigOption[Any]] = {}
         self._overrides: Dict[str, Any] = {}
-        self._lock = threading.RLock()
+        # reentrant declared: nothing nests it today, but the RLock
+        # contract predates lockcheck and option parsers may read other
+        # options while an override write holds it
+        self._lock = lockcheck.RLock("config", reentrant=True)
         # per-QUERY overlay: a contextvar-held dict consulted before the
         # process-wide overrides, so concurrent queries served out of one
         # process can carry different conf (the serving tier applies each
@@ -827,6 +831,27 @@ KERNEL_GROUP_ONEHOT_MAX_SEGMENTS = conf.define(
     "Static segment-count ceiling for the one-hot group reduction: the "
     "one-hot expansion costs n*G multiply-accumulates, so it is a "
     "LOW-cardinality strategy by construction.",
+)
+LOCKCHECK_ENABLE = conf.define(
+    "auron.lockcheck.enable", False,
+    "Dynamic concurrency checking (runtime/lockcheck.py): every lock "
+    "created through the named-lock registry tracks a per-thread "
+    "held-lock stack and a process-wide acquisition-order graph, "
+    "diagnosing lock-order cycles (potential deadlocks) at acquire "
+    "time, undeclared re-entrant acquisition, and blocking surfaces "
+    "(fault points, retry backoff sleeps, spill IO, socket calls, "
+    "condition waits) reached while a lock is held.  Decided at lock "
+    "CONSTRUCTION: set the env fallback (AURON_TPU_AURON_LOCKCHECK_"
+    "ENABLE=1) at process start; off (default) the factories return "
+    "raw threading primitives — zero added cost.  Forced on under the "
+    "test suite (tests/conftest.py), like auron.plan.verify.",
+)
+LOCKCHECK_RAISE = conf.define(
+    "auron.lockcheck.raise", True,
+    "Raise LockcheckError at the violating acquire/blocking site "
+    "(keeps program state consistent: the diagnostic fires BEFORE the "
+    "acquisition proceeds).  Off = record structured diagnostics "
+    "(lockcheck.diagnostics()) without raising.",
 )
 KERNEL_COST_PROFILE_PATH = conf.define(
     "auron.kernel.cost.profile.path", "",

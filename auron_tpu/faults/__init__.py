@@ -53,12 +53,12 @@ from __future__ import annotations
 
 import fnmatch
 import random
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck
 
 __all__ = [
     "FaultSpecError", "InjectedFault", "InjectedIOError",
@@ -264,7 +264,7 @@ class FaultRegistry:
     def __init__(self, spec: str):
         self.spec = spec
         self.rules = parse_spec(spec)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("faults.registry")
 
     def check(self, point: str) -> None:
         sleeps = []
@@ -289,6 +289,12 @@ class FaultRegistry:
         for r in reservations:
             r.apply()
         for s in sleeps:
+            # PR 4 deliberately moved this sleep OUTSIDE the registry
+            # lock (a latency rule slows the matching call site, never
+            # every fault point in the process); the blocked() check
+            # pins that — were the sleep hoisted back under _lock, it
+            # would fire with "faults.registry" held
+            lockcheck.blocked("faults.latency.sleep")
             time.sleep(s)
 
     def counts(self) -> Dict[str, Tuple[int, int]]:
@@ -310,7 +316,7 @@ class FaultRegistry:
 # same spec keeps the rule counters/RNG streams (a sweep is one
 # deterministic sequence), while editing the spec re-arms fresh
 _REGISTRIES: Dict[str, FaultRegistry] = {}
-_REG_LOCK = threading.Lock()
+_REG_LOCK = lockcheck.Lock("faults.registries")
 
 
 def _registry_for(spec: str) -> FaultRegistry:
@@ -325,7 +331,13 @@ def _registry_for(spec: str) -> FaultRegistry:
 
 def fault_point(point: str) -> None:
     """Named injection site.  No-op (one config read) unless
-    `auron.faults.spec` arms a rule matching `point`."""
+    `auron.faults.spec` arms a rule matching `point`.
+
+    Every fault point is by construction a boundary that can block or
+    fail in production (shuffle push/fetch, spill IO, service dispatch,
+    kafka RPCs), so each doubles as a blocking-under-lock probe for the
+    concurrency checker — one flag read when lockcheck is off."""
+    lockcheck.blocked(point)
     spec = conf.get("auron.faults.spec")
     if not spec:
         return

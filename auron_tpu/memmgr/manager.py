@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck
 
 # spill-size histogram bucket upper bounds (bytes); the last bucket is
 # open-ended.  Coarse powers-of-16: spill sizes span KBs (fuzz budgets)
@@ -122,7 +123,11 @@ class MemManager:
     MAX_SPILL_RECORDS = 256
 
     def __init__(self, budget_bytes: Optional[int] = None):
-        self._lock = threading.RLock()
+        # re-entrancy DECLARED (the PR 5 scar made it explicit): a
+        # consumer's spill() re-enters update() to account what it
+        # shed; the arbitration itself runs outside the lock, but the
+        # nested accounting path may touch it while held
+        self._lock = lockcheck.RLock("mem.manager", reentrant=True)
         self._tls = threading.local()   # re-entrancy guard (see update)
         self._consumers: List[MemConsumer] = []
         self.budget = budget_bytes if budget_bytes is not None \
@@ -432,7 +437,7 @@ class MemManager:
 
 
 _GLOBAL: Optional[MemManager] = None
-_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_LOCK = lockcheck.Lock("mem.global")
 
 
 def get_manager() -> MemManager:

@@ -11,7 +11,6 @@ from __future__ import annotations
 import io
 import os
 import tempfile
-import threading
 import weakref
 from typing import Iterator, List, Optional
 
@@ -20,6 +19,7 @@ import pyarrow as pa
 from auron_tpu.columnar import serde as batch_serde
 from auron_tpu.config import conf
 from auron_tpu.faults import fault_point
+from auron_tpu.runtime import lockcheck
 from auron_tpu.runtime.tracing import span
 
 
@@ -122,7 +122,7 @@ class SpillManager:
     def __init__(self, name: str = "spill"):
         self.name = name
         self.spills: List[Spill] = []
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("spill.manager")
 
     def new_spill(self, prefer_host: Optional[bool] = None) -> Spill:
         if prefer_host is None:

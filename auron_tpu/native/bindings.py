@@ -10,14 +10,21 @@ from __future__ import annotations
 
 import ctypes
 import os
-import threading
 from typing import Optional
 
 import numpy as np
 
 from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck
 
-_LIB_LOCK = threading.Lock()
+_LIB_LOCK = lockcheck.Lock("native.lib")
+# the one-shot native build (subprocess make) runs under the lib lock
+# ON PURPOSE: concurrent first-callers must not race the compiler, and
+# every later call takes the fast already-tried path
+lockcheck.waive_blocking(
+    "native.build", "native.lib",
+    "one-shot native toolchain build is serialized by design; all "
+    "subsequent loads are a dict read")
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_TRIED = False
 
@@ -39,8 +46,10 @@ def _load() -> Optional[ctypes.CDLL]:
             # try a one-shot build if the toolchain is present
             try:
                 import subprocess
-                subprocess.run(["make", "-s", "-C", os.path.dirname(__file__)],
-                               check=True, capture_output=True, timeout=300)
+                lockcheck.blocked("native.build")
+                subprocess.run(  # lockcheck: waive (serialized build)
+                    ["make", "-s", "-C", os.path.dirname(__file__)],
+                    check=True, capture_output=True, timeout=300)
             except Exception:
                 return None
         if not os.path.exists(path):

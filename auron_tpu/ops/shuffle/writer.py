@@ -17,13 +17,13 @@ from __future__ import annotations
 import io
 import os
 import struct
-import threading
 from typing import Any, Dict, Iterator, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from auron_tpu.columnar import serde as batch_serde
+from auron_tpu.runtime import lockcheck
 from auron_tpu.columnar.batch import Batch, bucket_capacity
 from auron_tpu.native import bindings
 from auron_tpu.ir.plan import Partitioning
@@ -212,7 +212,7 @@ class InProcessShuffleService:
         # block order deterministic (differential tests compare per-
         # partition streams)
         self._blocks: Dict[tuple, List[tuple]] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("shuffle.inproc")
 
     def rss_writer(self, shuffle_id: str, map_id: int) -> RssPartitionWriter:
         svc = self

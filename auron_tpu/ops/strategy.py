@@ -258,6 +258,10 @@ def _time(fn, *a, reps: int = 3) -> float:
     import time
 
     import jax
+    from auron_tpu.runtime import lockcheck
+    # device sync is a blocking surface (a sync under a lock would stall
+    # every peer for a whole device round-trip)
+    lockcheck.blocked("device.sync")
     jax.block_until_ready(fn(*a))
     ts = []
     for _ in range(reps):

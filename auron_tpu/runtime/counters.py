@@ -14,12 +14,13 @@ one flat dict so consumers never chase two registries.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict
+
+from auron_tpu.runtime import lockcheck
 
 __all__ = ["bump", "get", "snapshot", "reset"]
 
-_LOCK = threading.Lock()
+_LOCK = lockcheck.Lock("counters")
 _COUNTERS: Dict[str, int] = {
     "tasks_started": 0,
     "tasks_completed": 0,

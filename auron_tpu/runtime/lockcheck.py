@@ -1,0 +1,533 @@
+"""Named-lock registry + dynamic concurrency checking (lockcheck).
+
+The reference Auron gets its concurrency guarantees from Rust's
+compile-time aliasing rules (~55k LoC of `native-engine/` share one
+process with zero data races by construction).  This Python runtime is
+genuinely concurrent since the serving tier — one SharedTaskPool, one
+MemManager, scheduler driver threads, HTTP readers — and its two
+concurrency scars (the PR 5 spill-re-entering-update crash, the PR 6
+submit-timing race) were both found by crashing, not by checking.  This
+module is the checking: the dynamic half of the net whose static half is
+`auron_tpu/analysis/concurrency.py`.
+
+Every lock in `auron_tpu/` is created through the factories here and
+carries a registry NAME (a lock *class*: all `_TaskGroup` locks share
+``pool.group``).  When checking is enabled, acquisitions maintain
+
+- a per-thread HELD-LOCK STACK, and
+- a process-wide LOCK-ACQUISITION-ORDER GRAPH: acquiring B while
+  holding A records the edge ``A -> B``.  An edge whose reverse path
+  already exists is a potential deadlock — diagnosed AT ACQUIRE TIME
+  with the cycle path, instead of as a wedged process in production.
+
+Three violation kinds (`LockDiagnostic.kind`):
+
+- ``order-cycle``      — the new edge closes a cycle in the order graph.
+- ``undeclared-reentry`` — a thread re-acquired a lock it already holds
+  without that lock declaring ``reentrant=True`` (the PR 5 bug class:
+  re-entrancy must be an explicit per-lock decision, e.g. MemManager's
+  RLock).  For a plain ``Lock`` this ALSO converts a guaranteed
+  self-deadlock into an exception raised *before* the hang.
+- ``blocking-under-lock`` — ``blocked(site)`` (called from the known
+  blocking surfaces: every `fault_point`, retry backoff sleeps, spill
+  file IO, socket send/recv boundaries, device sync, `Condition.wait`)
+  ran while this thread held a registered lock.  Deliberate sites are
+  waived via ``waive_blocking(site, lock, reason)`` next to the code.
+
+COST CONTRACT: with ``auron.lockcheck.enable`` off (the default) the
+factories return RAW ``threading`` primitives — the production lock
+path is bit-identical to the unchecked one — and ``blocked()`` is one
+module-global flag read.  Enablement is decided at lock construction
+time from the env fallback (``AURON_TPU_AURON_LOCKCHECK_ENABLE``), so
+it must be set at process start; the test suite forces it on in
+`tests/conftest.py` exactly like `auron.plan.verify`.  `configure()`
+can silence/re-arm checking on already-tracked locks mid-process, but
+cannot retro-instrument locks constructed while disabled.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Lock", "RLock", "Condition", "blocked", "waive_blocking",
+    "LockDiagnostic", "LockcheckError", "enabled", "configure",
+    "diagnostics", "clear_diagnostics", "held_locks", "order_graph",
+    "lock_registry", "blocking_waivers", "reset_state",
+]
+
+MAX_DIAGNOSTICS = 256
+
+
+def _env_bool(key: str, default: bool = False) -> bool:
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+# decided at import: lock factories consult this at CONSTRUCTION time
+# (off => raw threading primitives, zero added cost); the per-acquire
+# checks consult it too so configure(False) silences tracked locks.
+_ENABLED = _env_bool("AURON_TPU_AURON_LOCKCHECK_ENABLE")
+_RAISE = _env_bool("AURON_TPU_AURON_LOCKCHECK_RAISE", True)
+
+# the checker's own guard is deliberately a RAW lock (it must not track
+# itself) and is LEAF-ONLY: no code path acquires any other lock while
+# holding it, so it can never participate in an order cycle.
+_GUARD = threading.Lock()
+_TLS = threading.local()
+
+# name -> {"kind": lock|rlock|condition, "reentrant": bool, "instances": n}
+_REGISTRY: Dict[str, Dict[str, Any]] = {}
+# acquisition-order edges: a -> {b: first-observed site "file:line"}
+_EDGES: Dict[str, Dict[str, str]] = {}
+_DIAGNOSTICS: List["LockDiagnostic"] = []
+_SEEN_KEYS: set = set()          # diagnostic dedupe keys
+# (site glob, lock name, reason) — deliberate blocking-under-lock sites
+_BLOCK_WAIVERS: List[Tuple[str, str, str]] = []
+
+
+class LockcheckError(RuntimeError):
+    """A lockcheck violation (raised before the acquisition/blocking op
+    proceeds, so the program state stays consistent)."""
+
+    def __init__(self, diagnostic: "LockDiagnostic"):
+        self.diagnostic = diagnostic
+        super().__init__(str(diagnostic))
+
+
+@dataclass(frozen=True)
+class LockDiagnostic:
+    """One structured finding of the dynamic checker."""
+    kind: str                 # order-cycle | undeclared-reentry |
+    #                           blocking-under-lock
+    lock: str                 # the lock being acquired / held
+    thread: str
+    site: str                 # code location or blocking-site name
+    message: str
+    held: Tuple[str, ...] = ()
+    cycle: Tuple[str, ...] = ()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "lock": self.lock,
+                "thread": self.thread, "site": self.site,
+                "message": self.message, "held": list(self.held),
+                "cycle": list(self.cycle)}
+
+    def __str__(self) -> str:
+        s = f"lockcheck[{self.kind}] {self.lock} @ {self.site} " \
+            f"(thread {self.thread}): {self.message}"
+        if self.cycle:
+            s += f"  cycle: {' -> '.join(self.cycle)}"
+        return s
+
+
+def _caller_site() -> str:
+    """file:line of the first frame outside this module (slow path only:
+    new edges and diagnostics, never the per-acquire fast path)."""
+    f = sys._getframe(1)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "?"
+    fn = os.path.relpath(f.f_code.co_filename, os.getcwd()) \
+        if f.f_code.co_filename.startswith("/") else f.f_code.co_filename
+    return f"{fn}:{f.f_lineno}"
+
+
+def _held_stack() -> List[Any]:
+    stack = getattr(_TLS, "held", None)
+    if stack is None:
+        stack = _TLS.held = []
+    return stack
+
+
+def _report(diag: LockDiagnostic, dedupe_key: Optional[tuple]) -> None:
+    with _GUARD:
+        if dedupe_key is not None:
+            if dedupe_key in _SEEN_KEYS and not _RAISE:
+                return
+            _SEEN_KEYS.add(dedupe_key)
+        if len(_DIAGNOSTICS) < MAX_DIAGNOSTICS:
+            _DIAGNOSTICS.append(diag)
+    if _RAISE:
+        raise LockcheckError(diag)
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> dst over _EDGES (caller holds _GUARD)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _EDGES.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_edge(a: str, b: str) -> None:
+    # fast path: known edge (dict reads are GIL-atomic; a benign race
+    # only sends us to the guarded slow path)
+    eb = _EDGES.get(a)
+    if eb is not None and b in eb:
+        return
+    site = _caller_site()
+    cycle: Optional[List[str]] = None
+    with _GUARD:
+        eb = _EDGES.setdefault(a, {})
+        if b in eb:
+            return
+        # a path b ->* a means inserting a -> b closes a cycle
+        path = _find_path(b, a)
+        eb[b] = site
+        if path is not None:
+            cycle = [a] + path
+    if cycle is not None:
+        t = threading.current_thread().name
+        _report(LockDiagnostic(
+            kind="order-cycle", lock=b, thread=t, site=site,
+            message=f"acquiring {b!r} while holding {a!r} closes a "
+                    f"lock-order cycle (potential deadlock)",
+            held=tuple(l.name for l in _held_stack()),
+            cycle=tuple(cycle)), dedupe_key=("cycle", a, b))
+
+
+def _before_blocking_acquire(lock: "_TrackedLock") -> None:
+    held = _held_stack()
+    for h in held:
+        if h is lock or h.name == lock.name:
+            # same lock object (or another instance of the same class)
+            # already held by this thread
+            if h is lock and lock.reentrant:
+                return   # declared re-entrancy: no new order info
+            kind = "re-acquired" if h is lock else \
+                f"acquired while an instance of the same class is held"
+            _report(LockDiagnostic(
+                kind="undeclared-reentry", lock=lock.name,
+                thread=threading.current_thread().name,
+                site=_caller_site(),
+                message=f"lock {lock.name!r} {kind} without a "
+                        f"reentrant=True declaration (declare it, or "
+                        f"restructure so the outer scope releases "
+                        f"first)",
+                held=tuple(l.name for l in held)),
+                dedupe_key=("reentry", lock.name))
+            return
+    seen_names = set()
+    for h in held:
+        if h.name not in seen_names:
+            seen_names.add(h.name)
+            _note_edge(h.name, lock.name)
+
+
+def _push(lock: "_TrackedLock") -> None:
+    _held_stack().append(lock)
+
+
+def _pop(lock: "_TrackedLock") -> None:
+    stack = getattr(_TLS, "held", None)
+    if not stack:
+        return   # enabled mid-process: tolerate unbalanced release
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] is lock:
+            del stack[i]
+            return
+
+
+def _register(name: str, kind: str, reentrant: bool) -> None:
+    with _GUARD:
+        info = _REGISTRY.get(name)
+        if info is None:
+            _REGISTRY[name] = {"kind": kind, "reentrant": reentrant,
+                               "instances": 1}
+        else:
+            info["instances"] += 1
+
+
+class _TrackedLock:
+    """Lock/RLock wrapper feeding the held stack + order graph."""
+
+    __slots__ = ("_raw", "name", "reentrant")
+
+    def __init__(self, name: str, raw, reentrant: bool):
+        self._raw = raw
+        self.name = name
+        self.reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _ENABLED and blocking:
+            # a non-blocking try-acquire can fail but never deadlock:
+            # order edges and re-entrancy checks apply to blocking
+            # acquisitions only (the /debug/profile 429 trylock pattern)
+            _before_blocking_acquire(self)
+        ok = self._raw.acquire(blocking, timeout)
+        if ok and _ENABLED:
+            _push(self)
+        return ok
+
+    def release(self) -> None:
+        if _ENABLED:
+            _pop(self)
+        self._raw.release()
+
+    def __enter__(self) -> "_TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __repr__(self) -> str:
+        return f"<lockcheck.{type(self).__name__} {self.name!r}>"
+
+
+class _TrackedCondition(_TrackedLock):
+    """Condition wrapper: the underlying cv lock is the tracked unit,
+    and wait() is itself a blocking surface — waiting on a cv while
+    holding ANY OTHER registered lock is diagnosed (the scheduler-lock
+    vs pool-cv hazard class)."""
+
+    __slots__ = ("_cond",)
+
+    def __init__(self, name: str):
+        cond = threading.Condition(threading.Lock())
+        super().__init__(name, cond._lock, False)
+        self._cond = cond
+
+    def _wait_impl(self, waiter, timeout):
+        if _ENABLED:
+            held = _held_stack()
+            others = [l.name for l in held if l is not self]
+            site = f"cv.wait:{self.name}"
+            for ln in dict.fromkeys(others):
+                if not _is_waived(site, ln):
+                    _report(LockDiagnostic(
+                        kind="blocking-under-lock", lock=ln,
+                        thread=threading.current_thread().name,
+                        site=site,
+                        message=f"waiting on condition {self.name!r} "
+                                f"while holding {ln!r} (the wait "
+                                f"releases only its own lock)",
+                        held=tuple(l.name for l in held)),
+                        dedupe_key=("cvwait", self.name, ln))
+            # wait() releases the cv lock while sleeping
+            _pop(self)
+        try:
+            return waiter(timeout)
+        finally:
+            if _ENABLED:
+                _push(self)
+
+    def wait(self, timeout: Optional[float] = None):
+        return self._wait_impl(self._cond.wait, timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        return self._wait_impl(
+            lambda t: self._cond.wait_for(predicate, t), timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# factories — the ONLY way auron_tpu code creates locks (the static pass
+# analysis/concurrency.py errors on raw threading.Lock() constructions)
+# ---------------------------------------------------------------------------
+
+def Lock(name: str):
+    """A named mutual-exclusion lock.  Off: a raw threading.Lock."""
+    _register(name, "lock", False)
+    if not _ENABLED:
+        return threading.Lock()
+    return _TrackedLock(name, threading.Lock(), False)
+
+
+def RLock(name: str, reentrant: bool = False):
+    """A named re-entrant lock.  Re-entrancy is NOT implied by the type:
+    it must be declared (`reentrant=True`) for checking to allow nested
+    acquisition — an RLock chosen "to be safe" that silently re-enters
+    is exactly how the PR 5 spill-re-entrancy bug hid."""
+    _register(name, "rlock", reentrant)
+    if not _ENABLED:
+        return threading.RLock()
+    return _TrackedLock(name, threading.RLock(), reentrant)
+
+
+def Condition(name: str):
+    """A named condition variable (own internal lock)."""
+    _register(name, "condition", False)
+    if not _ENABLED:
+        return threading.Condition()
+    return _TrackedCondition(name)
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock detection
+# ---------------------------------------------------------------------------
+
+def _is_waived(site: str, lock_name: str) -> bool:
+    for pat, ln, _reason in _BLOCK_WAIVERS:
+        if (ln == lock_name or ln == "*") and \
+                (site == pat or fnmatch.fnmatchcase(site, pat)):
+            return True
+    return False
+
+
+def blocked(site: str) -> None:
+    """Declare that the caller is about to block (IO, sleep, device
+    sync).  One flag read when checking is off; diagnoses execution
+    while any registered lock is held, unless (site, lock) is waived."""
+    if not _ENABLED:
+        return
+    held = getattr(_TLS, "held", None)
+    if not held:
+        return
+    for name in dict.fromkeys(l.name for l in held):
+        if not _is_waived(site, name):
+            _report(LockDiagnostic(
+                kind="blocking-under-lock", lock=name,
+                thread=threading.current_thread().name, site=site,
+                message=f"blocking surface {site!r} reached while "
+                        f"holding {name!r} (move the blocking work "
+                        f"outside the lock, or waive_blocking() it "
+                        f"with a reason)",
+                held=tuple(l.name for l in held)),
+                dedupe_key=("block", site, name))
+
+
+def waive_blocking(site: str, lock_name: str, reason: str) -> None:
+    """Declare a deliberate blocking-under-lock site (glob `site`
+    against the blocked() name; `lock_name` or '*').  Waivers are part
+    of the committed lock-order golden, so adding one is a reviewed
+    decision, not a silent escape."""
+    with _GUARD:
+        entry = (site, lock_name, reason)
+        if entry not in _BLOCK_WAIVERS:
+            _BLOCK_WAIVERS.append(entry)
+
+
+# ---------------------------------------------------------------------------
+# introspection / control
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def configure(enabled: Optional[bool] = None,
+              raise_on_violation: Optional[bool] = None) -> bool:
+    """Flip checking at runtime.  `enabled=None` re-reads
+    `auron.lockcheck.enable` from the config registry.  NOTE: locks
+    constructed while checking was off are raw primitives and stay
+    untracked — enable via the env fallback at process start for full
+    coverage."""
+    global _ENABLED, _RAISE
+    if enabled is None:
+        from auron_tpu.config import conf
+        enabled = bool(conf.get("auron.lockcheck.enable"))
+    if raise_on_violation is None and enabled is not None:
+        from auron_tpu.config import conf
+        raise_on_violation = bool(conf.get("auron.lockcheck.raise"))
+    _ENABLED = bool(enabled)
+    if raise_on_violation is not None:
+        _RAISE = bool(raise_on_violation)
+    return _ENABLED
+
+
+def diagnostics() -> List[LockDiagnostic]:
+    with _GUARD:
+        return list(_DIAGNOSTICS)
+
+
+def clear_diagnostics() -> None:
+    with _GUARD:
+        _DIAGNOSTICS.clear()
+        _SEEN_KEYS.clear()
+
+
+def held_locks() -> List[str]:
+    """Names held by the CURRENT thread (innermost last)."""
+    return [l.name for l in getattr(_TLS, "held", ())]
+
+
+def order_graph() -> Dict[str, Dict[str, str]]:
+    """The dynamic acquisition-order graph observed so far:
+    {a: {b: first-observed-site}}."""
+    with _GUARD:
+        return {a: dict(bs) for a, bs in _EDGES.items()}
+
+
+def lock_registry() -> Dict[str, Dict[str, Any]]:
+    with _GUARD:
+        return {n: dict(i) for n, i in _REGISTRY.items()}
+
+
+def blocking_waivers() -> List[Tuple[str, str, str]]:
+    with _GUARD:
+        return list(_BLOCK_WAIVERS)
+
+
+def find_cycle(extra_edges: Optional[Dict[str, set]] = None
+               ) -> Optional[List[str]]:
+    """A cycle over the dynamic graph unioned with `extra_edges`
+    ({a: {b, ...}}), or None.  The static/dynamic cross-check unions
+    the committed static graph in here."""
+    graph: Dict[str, set] = {}
+    with _GUARD:
+        for a, bs in _EDGES.items():
+            graph.setdefault(a, set()).update(bs)
+    for a, bs in (extra_edges or {}).items():
+        graph.setdefault(a, set()).update(bs)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = dict.fromkeys(graph, WHITE)
+    for root in graph:
+        if color[root] != WHITE:
+            continue
+        stack: List[Tuple[str, Any]] = [(root, iter(graph.get(root, ())))]
+        color[root] = GRAY
+        path = [root]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                c = color.get(nxt, WHITE)
+                if c == GRAY:
+                    return path[path.index(nxt):] + [nxt]
+                if c == WHITE:
+                    color[nxt] = GRAY
+                    path.append(nxt)
+                    stack.append((nxt, iter(graph.get(nxt, ()))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                path.pop()
+                stack.pop()
+    return None
+
+
+def reset_state() -> None:
+    """Test hook: drop observed edges + diagnostics (the lock registry
+    and waivers describe code, not a run — they persist)."""
+    with _GUARD:
+        _EDGES.clear()
+        _DIAGNOSTICS.clear()
+        _SEEN_KEYS.clear()

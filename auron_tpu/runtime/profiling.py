@@ -53,11 +53,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from auron_tpu.runtime import lockcheck
+
 _server: Optional["ProfilingServer"] = None
-_lock = threading.Lock()
+_lock = lockcheck.Lock("profiling.server")
 # the jax profiler is process-global: concurrent start_trace calls collide
 # and can wedge it, so trace capture is serialized (busy -> 429)
-_trace_lock = threading.Lock()
+_trace_lock = lockcheck.Lock("profiling.trace")
+# the capture SLEEPS while holding the trace lock — that serialization
+# is the feature (concurrent jax.profiler.start_trace wedges the
+# process-global profiler; busy callers get 429 from the trylock above
+# _trace_zip), so the blocking-under-lock detector waives it here
+lockcheck.waive_blocking(
+    "profiling.trace.capture", "profiling.trace",
+    "trace capture is deliberately serialized; concurrent callers get "
+    "429 via the non-blocking acquire instead of queueing")
 
 
 def ensure_started() -> "ProfilingServer":
@@ -80,6 +90,7 @@ def _trace_zip(seconds: float) -> bytes:
     import jax
 
     with tempfile.TemporaryDirectory(prefix="auron-trace-") as d:
+        lockcheck.blocked("profiling.trace.capture")
         jax.profiler.start_trace(d)
         time.sleep(min(seconds, 30.0))
         jax.profiler.stop_trace()

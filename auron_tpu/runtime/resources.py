@@ -8,13 +8,17 @@ ids referenced by plan nodes (IpcReader.resource_id, FFIReader.resource_id).
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
+
+from auron_tpu.runtime import lockcheck
 
 
 class ResourceRegistry:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        # reentrant declared: value factories stored here may look up
+        # sibling resources on materialization (the JniBridge map the
+        # reference mirrors allows the same)
+        self._lock = lockcheck.RLock("resources", reentrant=True)
         self._map: Dict[str, Any] = {}
 
     def put(self, key: str, value: Any) -> None:

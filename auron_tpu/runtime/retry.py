@@ -36,12 +36,12 @@ from __future__ import annotations
 
 import logging
 import random
-import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
 from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck
 
 log = logging.getLogger("auron_tpu.retry")
 
@@ -125,7 +125,7 @@ class RetryPolicy:
 
 # process-wide recovery counters — the chaos sweep reads deltas of these
 # for its run report ("num_retries / num_fallbacks visible")
-_STATS_LOCK = threading.Lock()
+_STATS_LOCK = lockcheck.Lock("retry.stats")
 _STATS: Dict[str, int] = {"attempts": 0, "retries": 0, "exhausted": 0,
                           "fallbacks": 0}
 
@@ -211,6 +211,10 @@ def call_with_retry(fn: Callable[[], Any],
                             label or "call", attempt, attempts,
                             type(e).__name__, e, delay)
                 if delay > 0:
+                    # backoff sleeps are a known blocking surface: a
+                    # retry loop entered with a lock held would stall
+                    # every peer of that lock for the whole schedule
+                    lockcheck.blocked("retry.backoff")
                     sleep(delay)
                 continue
             history.append((attempt, f"{type(e).__name__}: {e}", 0.0))

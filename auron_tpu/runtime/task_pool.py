@@ -51,6 +51,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck
 from auron_tpu.runtime.retry import RetryPolicy, call_with_retry, \
     task_classify
 
@@ -90,7 +91,7 @@ def query_weight() -> int:
 # -- query-level cancellation (module-level: usable before/without a pool)
 
 _CANCELLED: Set[str] = set()
-_CANCELLED_LOCK = threading.Lock()
+_CANCELLED_LOCK = lockcheck.Lock("pool.cancelled")
 
 
 def cancel_query(query_id: str) -> None:
@@ -134,7 +135,7 @@ class _TaskGroup:
         self.pending = n
         self.active = 0               # running tasks (pool cv guards it)
         self.max_active = max_active  # per-call parallelism cap
-        self.lock = threading.Lock()
+        self.lock = lockcheck.Lock("pool.group")
         self.done = threading.Event()
 
     def _one_done_locked(self) -> None:
@@ -170,7 +171,7 @@ class SharedTaskPool:
     rotation)."""
 
     def __init__(self, size: int):
-        self._cv = threading.Condition()
+        self._cv = lockcheck.Condition("pool.cv")
         self._queues: Dict[str, deque] = {}
         self._weights: Dict[str, int] = {}
         self._credits: Dict[str, int] = {}
@@ -345,7 +346,7 @@ class SharedTaskPool:
 
 
 _POOL: Optional[SharedTaskPool] = None
-_POOL_LOCK = threading.Lock()
+_POOL_LOCK = lockcheck.Lock("pool.global")
 
 
 def shared_pool() -> SharedTaskPool:

@@ -46,6 +46,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck
 
 __all__ = [
     "Span", "TraceRecorder", "QueryRecord", "QueryStats", "span", "event",
@@ -83,7 +84,7 @@ class TraceRecorder:
             if max_events is None else int(max_events)
         self.spans: List[Span] = []
         self.dropped = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("trace.recorder")
 
     # hot path — called from _SpanCtx.__exit__ and event()
     def add(self, name: str, cat: str, t0_ns: int, dur_ns: int,
@@ -198,7 +199,7 @@ class QueryStats:
             "mem_spill_bytes")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("trace.stats")
         self._counts = dict.fromkeys(self.KEYS, 0)
 
     def bump(self, key: str, delta: int = 1) -> None:
@@ -468,7 +469,7 @@ class QueryRecord:
 
 
 _HISTORY: List[QueryRecord] = []
-_HISTORY_LOCK = threading.Lock()
+_HISTORY_LOCK = lockcheck.Lock("trace.history")
 
 
 def record_query(rec: QueryRecord) -> None:

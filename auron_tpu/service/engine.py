@@ -424,6 +424,8 @@ class EngineClient:
                             attempts, e, delay)
                 attempt += 1
                 if delay > 0:
+                    from auron_tpu.runtime import lockcheck
+                    lockcheck.blocked("retry.backoff")
                     _time.sleep(delay)
 
     def _serve_resource(self, key: str) -> None:

@@ -28,11 +28,11 @@ Decisions (`auron.admission.*` knobs):
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
 from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck
 from auron_tpu.serving.forecast import MemForecaster
 
 ADMIT = "admit"
@@ -53,7 +53,7 @@ class AdmissionController:
 
     def __init__(self, forecaster: Optional[MemForecaster] = None):
         self.forecaster = forecaster or MemForecaster()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("serving.admission")
         self._held: Dict[str, int] = {}    # query id -> reserved bytes
         # event counters (the serve_check gate asserts queue events)
         self.events: Dict[str, int] = {"admitted": 0, "queued": 0,

@@ -16,11 +16,11 @@ from __future__ import annotations
 
 import hashlib
 import json
-import threading
 from collections import deque
 from typing import Any, Dict, Optional
 
 from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.runtime import lockcheck
 
 
 def _strip_data(d: Any) -> Any:
@@ -49,7 +49,7 @@ class MemForecaster:
 
     def __init__(self, keep: int = 8):
         self._keep = keep
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("serving.forecast")
         self._history: Dict[str, deque] = {}
 
     def record(self, signature: str, peak_bytes: int) -> None:

@@ -31,7 +31,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from auron_tpu import config
 from auron_tpu.frontend.foreign import ForeignNode
-from auron_tpu.runtime import task_pool
+from auron_tpu.runtime import lockcheck, task_pool
 from auron_tpu.serving.admission import ADMIT, AdmissionController
 from auron_tpu.serving.forecast import plan_signature
 
@@ -101,7 +101,7 @@ class QueryScheduler:
                  admission: Optional[AdmissionController] = None):
         self._session_factory = session_factory or default_session_factory
         self.admission = admission or AdmissionController()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("serving.scheduler")
         self._subs: Dict[str, Submission] = {}
         self._queue: List[Submission] = []    # admission wait line
         self._running = 0

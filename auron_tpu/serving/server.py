@@ -23,15 +23,15 @@ serving routes answer 503 until a scheduler is installed (QueryServer
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
 
 from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.runtime import lockcheck
 from auron_tpu.runtime.profiling import ProfilingServer
 from auron_tpu.serving.scheduler import QueryScheduler
 
 _ACTIVE: Optional[QueryScheduler] = None
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = lockcheck.Lock("serving.active")
 
 
 def install_scheduler(scheduler: QueryScheduler) -> QueryScheduler:
@@ -55,7 +55,7 @@ def active_scheduler() -> Optional[QueryScheduler]:
 # -- corpus submissions (the serve_check / demo path) -----------------------
 
 _CATALOGS: Dict[float, object] = {}
-_CATALOG_LOCK = threading.Lock()
+_CATALOG_LOCK = lockcheck.Lock("serving.catalog")
 
 
 def corpus_plan(name: str, sf: float = 0.002) -> ForeignNode:
@@ -68,7 +68,10 @@ def corpus_plan(name: str, sf: float = 0.002) -> ForeignNode:
         catalog = _CATALOGS.get(sf)
         if catalog is None:
             d = tempfile.mkdtemp(prefix=f"auron-serve-sf{sf}-")
-            catalog = datagen.generate(d, sf=sf)
+            # catalog generation does file IO under the catalog lock ON
+            # PURPOSE: concurrent first submissions for one scale factor
+            # must wait for a single generation, not race two
+            catalog = datagen.generate(d, sf=sf)  # lockcheck: waive (once-per-sf generation)
             _CATALOGS[sf] = catalog
     return queries.build(name, catalog)
 

@@ -23,6 +23,19 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from auron_tpu.runtime import lockcheck
+
+# deliberate blocking-under-lock (see _State._maybe_spill / read_agg):
+# the state lock is the append-order and torn-read serialization point
+lockcheck.waive_blocking(
+    "rss.spill.write", "rss.state",
+    "spill append order must match buffer order; the state lock is the "
+    "only serialization between handler threads")
+lockcheck.waive_blocking(
+    "rss.spill.read", "rss.state",
+    "reading outside the lock would tear the spilled-file/live-buffer "
+    "split against a concurrent spill of the same key")
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
@@ -64,7 +77,7 @@ def recv_msg(sock: socket.socket,
 
 class _State:
     def __init__(self, spill_dir: Optional[str], spill_threshold: int):
-        self.lock = threading.Lock()
+        self.lock = lockcheck.Lock("rss.state")
         # aggregate model: (shuffle, partition) -> bytearray | spill path
         self.agg: Dict[Tuple[str, int], bytearray] = {}
         self.agg_spilled: Dict[Tuple[str, int], str] = {}
@@ -82,10 +95,15 @@ class _State:
         buf = self.agg.get(key)
         if buf is None or len(buf) < self.spill_threshold:
             return
+        # file IO under the state lock is DELIBERATE here (waived
+        # below): append order into the per-key spill file must match
+        # buffer order, and the state lock is the only serialization
+        # point between concurrent handler threads spilling one key
+        lockcheck.blocked("rss.spill.write")
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir,
                             f"{key[0].replace(':', '_')}-{key[1]}.agg")
-        with open(path, "ab") as f:
+        with open(path, "ab") as f:  # lockcheck: waive (append order)
             f.write(bytes(buf))
         self.agg_spilled[key] = path
         self.agg[key] = bytearray()
@@ -93,7 +111,10 @@ class _State:
     def read_agg(self, key: Tuple[str, int]) -> bytes:
         spilled = b""
         if key in self.agg_spilled:
-            with open(self.agg_spilled[key], "rb") as f:
+            # read under the lock (waived): a concurrent spill of the
+            # same key would tear the spilled-file/live-buffer split
+            lockcheck.blocked("rss.spill.read")
+            with open(self.agg_spilled[key], "rb") as f:  # lockcheck: waive (torn-read guard)
                 spilled = f.read()
         return spilled + bytes(self.agg.get(key, b""))
 

@@ -15,9 +15,10 @@ from __future__ import annotations
 import io
 import socket
 import struct
-import threading
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from auron_tpu.runtime import lockcheck
 
 API_METADATA = 3
 API_LIST_OFFSETS = 2
@@ -355,7 +356,7 @@ class KafkaWireClient:
         self.verify_crc = verify_crc
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
         self._corr = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.Lock("kafka.client")
 
     @staticmethod
     def _parse_addr(a: str) -> Tuple[str, int]:

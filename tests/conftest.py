@@ -19,6 +19,15 @@ if "xla_force_host_platform_device_count" not in xla_flags:
                  " --xla_force_host_platform_device_count=8").strip()
 os.environ["XLA_FLAGS"] = xla_flags
 
+# concurrency checking is ON for the whole suite (env fallback of
+# `auron.lockcheck.enable`) — and it must be set BEFORE auron_tpu is
+# imported: the lock factories (runtime/lockcheck.py) decide tracked
+# vs raw at CONSTRUCTION time, and module-level locks are constructed
+# at import.  Every lock-order cycle, undeclared re-entrant acquire
+# and blocking-under-lock the suite exercises raises a structured
+# LockcheckError at the offending site instead of deadlocking CI.
+os.environ.setdefault("AURON_TPU_AURON_LOCKCHECK_ENABLE", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

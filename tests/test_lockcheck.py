@@ -1,0 +1,672 @@
+"""Concurrency-correctness layer tests (runtime/lockcheck.py +
+analysis/concurrency.py):
+
+- UNIT: lock-order cycle detection (A->B in one thread vs B->A in
+  another), undeclared re-entrancy (plain-Lock self-deadlock converted
+  to an exception; RLock re-entry only with a declaration), same-class
+  instance nesting, blocking-under-lock + waiver behavior, condition
+  wait-under-other-lock, off-mode zero-diagnostic/zero-cost path,
+  non-blocking try-acquires exempt from ordering.
+- STATIC: the AST pass catches raw threading constructions, lexical
+  with-nesting edges, blocking calls under locks (direct and through
+  the call closure) and honors `# lockcheck: waive` comments; the
+  committed golden lock-order graph matches the tree and is cycle-free.
+- CROSS-CHECK: a real workload's dynamic order graph unioned with the
+  static golden graph stays acyclic, and no dynamic edge reverses a
+  committed static edge.
+- PINS: the faults latency sleep stays OUTSIDE the registry lock
+  (PR 4's deliberate choice), spill IO runs with no manager lock held.
+- HAMMER: concurrent QueryScheduler shutdown vs submit vs cancel vs
+  profiling readers — no deadlock diagnostics, no torn states, driver
+  threads joined.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu.analysis import concurrency
+from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck, task_pool, tracing
+from auron_tpu.runtime.lockcheck import LockcheckError
+
+
+@pytest.fixture(autouse=True)
+def _clean_lockcheck():
+    """Each test starts with raising enabled and no recorded state, and
+    leaves no artificial edges/diagnostics behind for later tests."""
+    lockcheck.configure(True, True)
+    lockcheck.reset_state()
+    yield
+    lockcheck.configure(True, True)
+    lockcheck.reset_state()
+
+
+# ---------------------------------------------------------------------------
+# unit: order-cycle detection
+# ---------------------------------------------------------------------------
+
+def test_cycle_detected_across_two_threads():
+    a = lockcheck.Lock("tst.A")
+    b = lockcheck.Lock("tst.B")
+
+    with a:
+        with b:
+            pass   # edge t.A -> t.B
+
+    caught = []
+
+    def reversed_order():
+        try:
+            with b:
+                with a:   # t.B -> t.A closes the cycle
+                    pass
+        except LockcheckError as e:
+            caught.append(e)
+
+    t = threading.Thread(target=reversed_order)
+    t.start()
+    t.join(10)
+    assert len(caught) == 1
+    d = caught[0].diagnostic
+    assert d.kind == "order-cycle"
+    assert set(d.cycle) >= {"tst.A", "tst.B"}
+    # the diagnostic is also recorded for non-raising consumers
+    assert any(x.kind == "order-cycle" for x in lockcheck.diagnostics())
+
+
+def test_cycle_path_through_intermediate_lock():
+    a, b, c = (lockcheck.Lock(f"tst3.{n}") for n in "ABC")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(LockcheckError) as ei:
+        with c:
+            with a:
+                pass
+    assert ei.value.diagnostic.kind == "order-cycle"
+    assert list(ei.value.diagnostic.cycle)[0] == "tst3.C"
+
+
+def test_consistent_order_is_clean():
+    a = lockcheck.Lock("tst2.A")
+    b = lockcheck.Lock("tst2.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockcheck.diagnostics() == []
+    assert lockcheck.find_cycle() is None
+
+
+# ---------------------------------------------------------------------------
+# unit: re-entrancy declarations
+# ---------------------------------------------------------------------------
+
+def test_plain_lock_reentry_raises_instead_of_deadlocking():
+    lk = lockcheck.Lock("tst.reentry.plain")
+    with lk:
+        with pytest.raises(LockcheckError) as ei:
+            lk.acquire()   # would deadlock forever without the checker
+    assert ei.value.diagnostic.kind == "undeclared-reentry"
+
+
+def test_rlock_reentry_requires_declaration():
+    undeclared = lockcheck.RLock("tst.reentry.undeclared")
+    with undeclared:
+        with pytest.raises(LockcheckError) as ei:
+            with undeclared:
+                pass
+    assert ei.value.diagnostic.kind == "undeclared-reentry"
+
+    declared = lockcheck.RLock("tst.reentry.declared", reentrant=True)
+    with declared:
+        with declared:
+            with declared:
+                pass
+    assert not [d for d in lockcheck.diagnostics()
+                if d.lock == "tst.reentry.declared"]
+
+
+def test_same_class_instance_nesting_flagged():
+    l1 = lockcheck.Lock("tst.sameclass")
+    l2 = lockcheck.Lock("tst.sameclass")
+    with l1:
+        with pytest.raises(LockcheckError) as ei:
+            with l2:
+                pass
+    assert ei.value.diagnostic.kind == "undeclared-reentry"
+
+
+# ---------------------------------------------------------------------------
+# unit: blocking-under-lock + waivers
+# ---------------------------------------------------------------------------
+
+def test_blocked_under_lock_and_waiver():
+    lk = lockcheck.Lock("tst.blocker")
+    lockcheck.blocked("tst.site.free")   # no lock held: clean
+    with lk:
+        with pytest.raises(LockcheckError) as ei:
+            lockcheck.blocked("tst.site.io")
+    assert ei.value.diagnostic.kind == "blocking-under-lock"
+    assert ei.value.diagnostic.lock == "tst.blocker"
+
+    lockcheck.clear_diagnostics()   # drop the expected finding above
+    lockcheck.waive_blocking("tst.site.io", "tst.blocker", "test waiver")
+    with lk:
+        lockcheck.blocked("tst.site.io")   # waived: clean
+    # waivers are exact-or-glob on the site and exact on the lock
+    lockcheck.waive_blocking("tst.glob.*", "tst.blocker", "glob waiver")
+    with lk:
+        lockcheck.blocked("tst.glob.anything")
+    assert not [d for d in lockcheck.diagnostics()
+                if d.site.startswith(("tst.site.io", "tst.glob."))]
+
+
+def test_condition_wait_under_other_lock_flagged():
+    cv = lockcheck.Condition("tst.cv")
+    outer = lockcheck.Lock("tst.cv.outer")
+
+    # waiting while holding only the cv itself is the normal pattern
+    def waker():
+        time.sleep(0.05)
+        with cv:
+            cv.notify_all()
+
+    t = threading.Thread(target=waker)
+    t.start()
+    with cv:
+        cv.wait(timeout=5)
+    t.join(10)
+    assert lockcheck.diagnostics() == []
+
+    with outer:
+        with cv:
+            with pytest.raises(LockcheckError) as ei:
+                cv.wait(timeout=0.01)
+    assert ei.value.diagnostic.kind == "blocking-under-lock"
+    assert ei.value.diagnostic.lock == "tst.cv.outer"
+
+
+def test_nonblocking_acquire_exempt_from_ordering():
+    a = lockcheck.Lock("tst.try.A")
+    b = lockcheck.Lock("tst.try.B")
+    with a:
+        with b:
+            pass
+    with b:
+        assert a.acquire(blocking=False)   # trylock: no cycle diagnostic
+        a.release()
+    assert lockcheck.diagnostics() == []
+
+
+# ---------------------------------------------------------------------------
+# unit: off mode
+# ---------------------------------------------------------------------------
+
+def test_off_mode_records_nothing():
+    lockcheck.configure(False)
+    try:
+        a = lockcheck.Lock("tst.off.A")
+        b = lockcheck.Lock("tst.off.B")
+        # off at construction => RAW threading primitives (the zero-cost
+        # production path: not even a wrapper call per acquire)
+        assert type(a).__module__ == "_thread"
+        assert type(b).__module__ == "_thread"
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass   # reversed order: nobody watches, nobody raises
+        lockcheck.blocked("tst.off.site")
+        assert lockcheck.diagnostics() == []
+        assert "tst.off.A" not in lockcheck.order_graph()
+    finally:
+        lockcheck.configure(True, True)
+
+
+def test_configure_silences_tracked_locks():
+    lk = lockcheck.Lock("tst.silence")
+    lockcheck.configure(False)
+    try:
+        with lk:
+            lk2 = lockcheck.Lock("tst.silence")   # raw while off
+            del lk2
+            lockcheck.blocked("tst.silence.site")
+        assert lockcheck.diagnostics() == []
+    finally:
+        lockcheck.configure(True, True)
+
+
+def test_conf_knobs_registered():
+    assert conf.get("auron.lockcheck.enable") is True   # env-forced here
+    assert conf.get("auron.lockcheck.raise") is True
+
+
+# ---------------------------------------------------------------------------
+# static pass: units over a synthetic tree
+# ---------------------------------------------------------------------------
+
+def _scan_tree(tmp_path, sources):
+    root = tmp_path / "pkg"
+    root.mkdir()
+    for rel, src in sources.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return concurrency.analyze_concurrency(str(root))
+
+
+def test_static_raw_lock_construction_is_error(tmp_path):
+    rep = _scan_tree(tmp_path, {"m.py": """
+        import threading
+        L = threading.Lock()
+    """})
+    errs = [d for d in rep.result.errors]
+    assert len(errs) == 1 and "bypasses the named-lock registry" in \
+        errs[0].message
+
+
+def test_static_nesting_edges_and_blocking(tmp_path):
+    rep = _scan_tree(tmp_path, {"m.py": """
+        import time
+        from auron_tpu.runtime import lockcheck
+        A = lockcheck.Lock("s.A")
+        B = lockcheck.Lock("s.B")
+
+        def f():
+            with A:
+                with B:
+                    time.sleep(1)
+    """})
+    assert ("s.A", "s.B") in rep.edge_set()
+    errs = rep.result.errors
+    assert any("blocking sleep" in d.message for d in errs)
+
+
+def test_static_blocking_through_call_closure_and_waiver(tmp_path):
+    rep = _scan_tree(tmp_path, {"m.py": """
+        from auron_tpu.runtime import lockcheck
+        A = lockcheck.Lock("c.A")
+
+        def slow_helper():
+            open("/dev/null")
+
+        def f():
+            with A:
+                slow_helper()
+
+        def g():
+            with A:
+                slow_helper()  # lockcheck: waive (test)
+    """})
+    errs = [d for d in rep.result.errors]
+    assert len(errs) == 1 and "file-io" in errs[0].message
+    assert "slow_helper" in errs[0].message
+
+
+def test_static_cycle_detection(tmp_path):
+    rep = _scan_tree(tmp_path, {"m.py": """
+        from auron_tpu.runtime import lockcheck
+        A = lockcheck.Lock("y.A")
+        B = lockcheck.Lock("y.B")
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    """})
+    assert any("lock-order cycle" in d.message for d in rep.result.errors)
+
+
+def test_static_self_edge_requires_reentrant(tmp_path):
+    rep = _scan_tree(tmp_path, {"m.py": """
+        from auron_tpu.runtime import lockcheck
+
+        class C:
+            def __init__(self):
+                self._lock = lockcheck.Lock("z.self")
+
+            def inner(self):
+                with self._lock:
+                    pass
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+    """})
+    assert any("re-acquired while held" in d.message
+               for d in rep.result.errors)
+
+
+# ---------------------------------------------------------------------------
+# the real tree: golden + 0 unwaived errors (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tree_report():
+    return concurrency.analyze_concurrency()
+
+
+def test_tree_has_zero_unwaived_errors(tree_report):
+    assert [str(d) for d in tree_report.result.errors] == []
+
+
+def test_tree_matches_committed_golden(tree_report):
+    if os.environ.get("AURON_REGEN_GOLDEN"):
+        with open(concurrency.golden_path(), "w") as fh:
+            fh.write(concurrency.render_golden(tree_report))
+    problems = concurrency.check_against_golden(tree_report)
+    assert problems == [], "\n".join(problems)
+
+
+def test_golden_graph_is_cycle_free(tree_report):
+    with open(concurrency.golden_path()) as fh:
+        _locks, edges, _waivers = concurrency.parse_golden(fh.read())
+    as_dict = {}
+    for a, b in edges:
+        as_dict.setdefault(a, {})[b] = "golden"
+    assert concurrency._find_static_cycle(as_dict) is None
+
+
+def test_tree_locks_cover_runtime_registry(tree_report):
+    """Every lock class the running process registered must be declared
+    in the static scan (imports above constructed most of them)."""
+    import auron_tpu.serving  # noqa: F401 - construct the module locks
+    runtime_names = set(lockcheck.lock_registry())
+    static_names = set(tree_report.locks)
+    missing = {n for n in runtime_names
+               if not n.startswith("tst")} - static_names
+    assert missing == set(), missing
+
+
+# ---------------------------------------------------------------------------
+# static/dynamic cross-check
+# ---------------------------------------------------------------------------
+
+def test_static_dynamic_cross_check(tree_report):
+    """Drive a real workload (parallel task pool, memory pressure with
+    spills, latency faults, counters, tracing), then require: (1) the
+    dynamic order graph unioned with the committed static graph is
+    acyclic; (2) no dynamic edge REVERSES a static edge (a would-be
+    deadlock pair the static pass promised the other way)."""
+    from auron_tpu.memmgr.manager import MemConsumer, reset_manager
+
+    lockcheck.reset_state()
+    task_pool.reset_pool()
+    try:
+        _cross_check_workload(MemConsumer, reset_manager)
+    finally:
+        reset_manager()      # restore the default-budget manager
+        task_pool.reset_pool()
+
+    assert lockcheck.diagnostics() == []
+    dynamic = lockcheck.order_graph()
+    assert dynamic, "workload recorded no dynamic edges"
+
+    with open(concurrency.golden_path()) as fh:
+        _locks, static_edges, _w = concurrency.parse_golden(fh.read())
+    static_as_sets = {}
+    for a, b in static_edges:
+        static_as_sets.setdefault(a, set()).add(b)
+    # union is acyclic
+    cycle = lockcheck.find_cycle(extra_edges=static_as_sets)
+    assert cycle is None, f"static+dynamic cycle: {cycle}"
+    # no dynamic edge reverses a static one
+    reversed_pairs = [(a, b) for a, bs in dynamic.items() for b in bs
+                      if (b, a) in static_edges]
+    assert reversed_pairs == [], reversed_pairs
+
+
+def _cross_check_workload(MemConsumer, reset_manager):
+    with conf.scoped({"auron.task.parallelism": 4,
+                      "auron.faults.spec":
+                          "xcheck.point:latency:ms=1,seed=3"}):
+        from auron_tpu.faults import fault_point, reset as faults_reset
+        faults_reset()
+
+        mgr = reset_manager(4096)
+        with conf.scoped({"auron.memory.spill.min.trigger.bytes": 1}):
+            class _C(MemConsumer):
+                def spill(self) -> int:
+                    freed = self.mem_used
+                    self.update_mem_used(0)
+                    return freed
+
+            cons = mgr.register_consumer(_C("xcheck", True))
+            with tracing.trace_scope("qxcheck"):
+                def work(i):
+                    fault_point("xcheck.point")
+                    cons.update_mem_used(8192)   # forces a spill path
+                    return i * i
+
+                # consumer spills are owner-thread-only: run the memory
+                # work inline, the pool work separately
+                assert [work(i) for i in range(4)] == [0, 1, 4, 9]
+                out = task_pool.run_tasks(lambda i: i + 1, range(16),
+                                          prefix="xcheck")
+                assert out == list(range(1, 17))
+
+
+# ---------------------------------------------------------------------------
+# pins: the known-risky pairs from PRs 4-6
+# ---------------------------------------------------------------------------
+
+def test_faults_latency_sleep_outside_registry_lock():
+    """PR 4 moved the latency sleep OUTSIDE the faults registry lock;
+    the `faults.latency.sleep` blocked() probe pins it: were the sleep
+    hoisted back under `faults.registry`, this raises at the probe."""
+    from auron_tpu.faults import fault_point, reset as faults_reset
+    with conf.scoped({"auron.faults.spec":
+                      "pin.latency:latency:ms=1,seed=1"}):
+        faults_reset()
+        for _ in range(3):
+            fault_point("pin.latency")
+    assert [d for d in lockcheck.diagnostics()
+            if d.site == "faults.latency.sleep"] == []
+
+
+def test_spill_io_runs_without_manager_lock():
+    """The MemManager arbitration spills OUTSIDE its lock (PR 5); the
+    spill.write/read fault points double as blocked() probes, so a
+    regression that spilled under `mem.manager` raises here."""
+    from auron_tpu.memmgr.manager import MemConsumer, reset_manager
+    from auron_tpu.memmgr.spill import SpillManager
+
+    try:
+        mgr = reset_manager(2048)
+        with conf.scoped({"auron.memory.spill.min.trigger.bytes": 1}):
+            class _Spiller(MemConsumer):
+                def __init__(self):
+                    super().__init__("pin.spiller", True)
+                    self.sm = SpillManager("pin.spiller")
+
+                def spill(self) -> int:
+                    s = self.sm.new_spill(prefer_host=False)
+                    s.write_batches(iter([pa.record_batch(
+                        {"x": pa.array([1, 2, 3])})]))
+                    list(s.read_batches())
+                    freed = self.mem_used
+                    self.update_mem_used(0)
+                    return freed
+
+            c = mgr.register_consumer(_Spiller())
+            c.update_mem_used(5000)
+            assert mgr.num_spills >= 1
+    finally:
+        reset_manager()      # restore the default-budget manager
+    assert [d for d in lockcheck.diagnostics()
+            if d.site in ("spill.write", "spill.read")] == []
+
+
+def test_scheduler_lock_never_held_across_pool_cv():
+    """The scheduler `_lock` vs pool `_cv` pair: the static golden must
+    not contain an edge serving.scheduler -> pool.cv (stats() snapshots
+    under the lock, then reads the pool OUTSIDE it)."""
+    with open(concurrency.golden_path()) as fh:
+        _locks, edges, _w = concurrency.parse_golden(fh.read())
+    assert ("serving.scheduler", "pool.cv") not in edges
+    assert ("pool.cv", "serving.scheduler") not in edges
+
+
+def test_profiling_locks_not_ordered_against_history():
+    """profiling `_lock`/`_trace_lock` vs the trace history lock: the
+    HTTP readers snapshot outside their locks, so no order edge may
+    exist in either direction."""
+    with open(concurrency.golden_path()) as fh:
+        _locks, edges, _w = concurrency.parse_golden(fh.read())
+    for a in ("profiling.server", "profiling.trace"):
+        assert (a, "trace.history") not in edges
+        assert ("trace.history", a) not in edges
+
+
+# ---------------------------------------------------------------------------
+# hammer: shutdown vs submit vs cancel vs profiling readers
+# ---------------------------------------------------------------------------
+
+def _tiny_plan(rows=3, tag="t"):
+    from auron_tpu.frontend.foreign import ForeignNode, fcol
+    from auron_tpu.ir.schema import DataType, Field, Schema
+    schema = Schema((Field("x", DataType.int64()),))
+    scan = ForeignNode("LocalTableScanExec", output=schema,
+                       attrs={"rows": [{"x": i} for i in range(rows)]})
+    return ForeignNode("ProjectExec", children=(scan,), output=schema,
+                       attrs={"exprs": (fcol("x", DataType.int64()),),
+                              "tag": tag})
+
+
+class _HammerSession:
+    def execute(self, plan, mesh=None, mesh_axis="parts", query_id=None):
+        with tracing.trace_scope(query_id=query_id):
+            deadline = time.time() + 0.03
+            while time.time() < deadline:
+                if task_pool.is_cancelled(query_id):
+                    raise task_pool.QueryCancelled(query_id)
+                time.sleep(0.003)
+
+        class _R:
+            table = pa.table({"x": [1, 2, 3]})
+            wall_s = 0.03
+            metrics = []
+        return _R()
+
+
+def test_shutdown_race_hammer():
+    from auron_tpu.runtime.profiling import (
+        _metrics_snapshot, _prometheus_text,
+    )
+    from auron_tpu.serving.scheduler import (
+        QueryScheduler, SubmissionRejected,
+    )
+
+    lockcheck.reset_state()
+    sched = QueryScheduler(session_factory=_HammerSession)
+    stop = threading.Event()
+    errors = []
+    submitted = []
+
+    def submitter():
+        i = 0
+        while not stop.is_set():
+            try:
+                qid = sched.submit(_tiny_plan(tag=f"h{i}"),
+                                   priority=(i % 3) + 1)
+                submitted.append(qid)
+            except SubmissionRejected:
+                pass   # post-shutdown / shed: expected
+            except BaseException as e:  # noqa: BLE001
+                errors.append(("submit", e))
+            i += 1
+            time.sleep(0.002)
+
+    def canceller():
+        i = 0
+        while not stop.is_set():
+            try:
+                if submitted:
+                    sched.cancel(submitted[i % len(submitted)])
+            except BaseException as e:  # noqa: BLE001
+                errors.append(("cancel", e))
+            i += 1
+            time.sleep(0.003)
+
+    def reader():
+        while not stop.is_set():
+            try:
+                _metrics_snapshot()
+                _prometheus_text()
+                sched.stats()
+                for qid in submitted[-5:]:
+                    sched.status(qid)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(("read", e))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=f, name=f"hammer-{f.__name__}-{i}",
+                                daemon=True)
+               for i, f in enumerate(
+                   [submitter, canceller, reader, reader])]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    sched.shutdown(wait=False)   # shutdown races the live traffic
+    time.sleep(0.15)
+    stop.set()
+    for t in threads:
+        t.join(20)
+        assert not t.is_alive(), f"{t.name} wedged"
+
+    sched.shutdown(wait=True, timeout=30)
+    assert errors == [], errors
+    assert [str(d) for d in lockcheck.diagnostics()] == []
+
+    # no torn states: every submission reached a terminal state
+    with sched._lock:
+        nonterminal = [s.query_id for s in sched._subs.values()
+                       if s.state in ("queued", "running")]
+    assert nonterminal == [], nonterminal
+
+    # driver threads joined (daemon threads must not leak past shutdown)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        drivers = [t for t in threading.enumerate()
+                   if t.name.startswith("auron-driver-") and t.is_alive()]
+        if not drivers:
+            break
+        time.sleep(0.05)
+    assert not drivers, [t.name for t in drivers]
+
+
+# ---------------------------------------------------------------------------
+# CI script (slow lane, like chaos/kernel/serve checks)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_tools_lockcheck_script():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [os.path.join(repo, "tools", "lockcheck.sh")],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "lockcheck.sh: ok" in proc.stdout
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI concurrency-correctness gate (CPU-only, fast):
+#   1. the STATIC pass — raw-lock registry bypass lint, static
+#      lock-order graph vs the committed golden
+#      (tests/golden_plans/lock_order.txt), lexically-blocking calls
+#      under locks — must report 0 unwaived errors;
+#   2. the golden graph must be CYCLE-FREE and in sync (drift fails
+#      with a regen hint, exactly like the plan goldens);
+#   3. the DYNAMIC suite — cycle/re-entrancy/waiver units, the
+#      static/dynamic cross-check and the shutdown-race hammer — runs
+#      under `auron.lockcheck.enable` (forced on by tests/conftest.py).
+#
+# Regen after intentional lock-graph changes:
+#   python -m auron_tpu.analysis --concurrency --regen-golden
+#
+# Usage: tools/lockcheck.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m auron_tpu.analysis --concurrency
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m pytest tests/test_lockcheck.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "lockcheck.sh: ok"
