@@ -11,6 +11,10 @@ serialized node ({"@kind": ...} — the wire form ir/serde.py emits).
     python -m auron_tpu.analysis --concurrency        # static lock lint
     python -m auron_tpu.analysis --concurrency --regen-golden
                                       # rebuild the lock-order golden
+    python -m auron_tpu.analysis --compilation        # compile-hygiene lint
+    python -m auron_tpu.analysis --compilation --regen-golden
+                                      # rerun q01+q03, rebuild the
+                                      # compile manifest
 
 --regen-golden re-derives the documents from the IT corpus: every
 query in auron_tpu.it.queries is converted exactly as the runner
@@ -182,6 +186,45 @@ def run_concurrency(regen: bool, golden_dir: str) -> int:
     return 2 if n_err else 0
 
 
+def run_compilation(regen: bool, golden_dir: str) -> int:
+    """The static compilation pass (`--compilation`): raw-jit lint,
+    host-materialization inside jitted bodies, mutable-capture lint,
+    the strategy-fingerprint cache-key rule, the config-knob lint, and
+    (with --regen-golden) the canonical-run compile manifest."""
+    from auron_tpu.analysis import compilation as comp
+
+    report = comp.analyze_compilation()
+    for d in report.result.diagnostics:
+        print(d)
+    n_err = len(report.result.errors)
+    manifest_note = ""
+    if regen:
+        # the canonical run needs the CPU backend and jitcheck armed
+        # (sites wrapped while checking is off stay raw): force both
+        # BEFORE any kernel module imports
+        import jax
+
+        from auron_tpu.runtime import jitcheck
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass   # backend already initialized (e.g. under pytest)
+        jitcheck.configure(True, True)
+        snapshot = comp.collect_compile_manifest()
+        path = os.path.join(golden_dir, "compile_manifest.txt")
+        os.makedirs(golden_dir, exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(comp.render_manifest(snapshot))
+        total = sum(c for _s, c in snapshot.values())
+        print(f"wrote {path}: {len(snapshot)} sites, {total} compiles")
+        manifest_note = f", manifest {len(snapshot)} sites"
+    status = "FAIL" if n_err else "ok"
+    print(f"{status}: {len(report.jit_sites)} jit bodies resolved, "
+          f"{report.conf_keys_checked} conf-key sites checked"
+          f"{manifest_note}, {n_err} unwaived errors")
+    return 2 if n_err else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="auron_tpu.analysis")
     ap.add_argument("paths", nargs="*",
@@ -195,10 +238,18 @@ def main(argv=None) -> int:
                          "plan lint (raw-lock registry bypass, static "
                          "lock-order graph vs the committed golden, "
                          "blocking-under-lock)")
+    ap.add_argument("--compilation", action="store_true",
+                    help="run the static compilation-hygiene pass "
+                         "instead of the plan lint (raw-jit registry "
+                         "bypass, host materialization inside jitted "
+                         "bodies, mutable-capture, strategy-fingerprint "
+                         "cache keys, config-knob lint)")
     ap.add_argument("--regen-golden", action="store_true",
                     help="rebuild the golden plan documents from the IT "
                          "corpus (with --concurrency: rebuild the "
-                         "lock-order graph golden)")
+                         "lock-order graph golden; with --compilation: "
+                         "rerun the canonical q01+q03 and rebuild the "
+                         "compile manifest)")
     ap.add_argument("--golden-dir", default=None)
     ap.add_argument("--sf", type=float, default=0.001)
     ap.add_argument("--data-dir", default="/tmp/auron_tpcds_lint")
@@ -207,6 +258,8 @@ def main(argv=None) -> int:
     golden = args.golden_dir or default_golden_dir()
     if args.concurrency:
         return run_concurrency(args.regen_golden, golden)
+    if args.compilation:
+        return run_compilation(args.regen_golden, golden)
     if args.regen_golden:
         return regen_golden(golden, args.sf, args.data_dir)
     paths = args.paths or [golden]

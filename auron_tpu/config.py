@@ -853,6 +853,44 @@ LOCKCHECK_RAISE = conf.define(
     "acquisition proceeds).  Off = record structured diagnostics "
     "(lockcheck.diagnostics()) without raising.",
 )
+JITCHECK_ENABLE = conf.define(
+    "auron.jitcheck.enable", False,
+    "Compilation-hygiene checking (runtime/jitcheck.py): every jitted "
+    "program constructed through the named jit-site registry carries a "
+    "trace probe that counts compiles per (site, abstract signature), "
+    "diagnosing retrace storms (one program re-traced past "
+    "auron.jitcheck.retrace.max distinct signatures) and, with the "
+    "transfer guard, undeclared implicit device->host transfers inside "
+    "hot execution regions.  Decided when a site WRAPS a program: set "
+    "the env fallback (AURON_TPU_AURON_JITCHECK_ENABLE=1) at process "
+    "start; off (default) the sites return raw jax.jit products — "
+    "zero added cost.  Forced on under the test suite "
+    "(tests/conftest.py), like auron.lockcheck.enable.",
+)
+JITCHECK_RAISE = conf.define(
+    "auron.jitcheck.raise", True,
+    "Raise JitcheckError at the violating trace/transfer site.  Off = "
+    "record structured diagnostics (jitcheck.diagnostics()) without "
+    "raising.",
+)
+JITCHECK_RETRACE_MAX = conf.define(
+    "auron.jitcheck.retrace.max", 8,
+    "Distinct abstract signatures ONE program at a jit site may "
+    "accumulate before the retrace-storm diagnostic fires (the shape-"
+    "polymorphic-cache-key bug class; the diagnostic includes the "
+    "signature diff between the last two traces).  <= 0 disables the "
+    "storm check (compile counting stays on).",
+)
+JITCHECK_TRANSFER_GUARD = conf.define(
+    "auron.jitcheck.transfer.guard", True,
+    "With jitcheck enabled, wrap task execution and SPMD stage "
+    "execution in jax.transfer_guard_device_to_host('disallow'): "
+    "implicit device->host transfers (np.asarray on a device array, "
+    "float() on a device scalar) raise as undeclared-transfer "
+    "diagnostics.  Deliberate syncs route through "
+    "kernel_cache.host_sync or jitcheck.declared_transfer(site) with "
+    "a '# jitcheck: waive' comment.",
+)
 KERNEL_COST_PROFILE_PATH = conf.define(
     "auron.kernel.cost.profile.path", "",
     "Path to a recorded kernel-profile artifact (a BENCH_r0x.json or a "

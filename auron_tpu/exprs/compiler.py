@@ -803,7 +803,10 @@ class CompiledExprs:
     def _get_jit(self, device_exprs, dev_schema: Schema, capacity: int,
                  sig: Tuple, host_cols: frozenset = frozenset()):
         # module-global cache: operator instances are rebuilt per task, so a
-        # per-instance cache would re-trace every execute_plan call
+        # per-instance cache would re-trace every execute_plan call.
+        # cached_jit routes the `exprs` family through the jit-site
+        # registry (runtime/jitcheck.py): a key regression that re-traces
+        # per execute shows up as compile-manifest drift by site name
         from auron_tpu.ops.kernel_cache import cached_jit
         from auron_tpu.config import conf as _conf
         # case.sensitive is read at trace time (wire_udf param-dup

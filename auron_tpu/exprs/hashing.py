@@ -156,7 +156,7 @@ def hash_bytes(data, lengths, seed):
     return _fmix(h, lengths.astype(jnp.uint32))
 
 
-def _hash_host_column(col, seed):
+def _hash_host_column(col, seed):  # jitcheck: waive (HostColumn arm: hash_columns dispatches here only for host-resident columns, which the jitted paths exclude upstream)
     """Host-resident rows (oversized strings, hybrid batches): Spark
     murmur3 computed on host (spark_hash.rs StringType/BinaryType arm);
     null and padding rows keep the incoming per-row seed."""

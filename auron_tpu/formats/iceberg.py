@@ -184,7 +184,7 @@ def write_table(table_path: str, batches, partition_by: Optional[str] = None,
                     "location": table_path, "snapshots": [],
                     "current-snapshot-id": None}
 
-    table = pa.Table.from_batches([b for b in batches]) \
+    table = pa.Table.from_batches(list(batches)) \
         if not isinstance(batches, pa.Table) else batches
     snap_id = len(metadata["snapshots"]) + 1
     seq = snap_id

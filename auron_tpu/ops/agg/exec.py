@@ -41,6 +41,29 @@ from auron_tpu.ops.base import Operator, TaskContext, batch_size
 from auron_tpu.ops.sort_keys import (
     encode_sort_keys, keys_equal_prev, lexsort_indices_live,
 )
+from auron_tpu.runtime import jitcheck
+
+# deliberately signature-polymorphic kernel families: these cached_jit
+# keys are COARSE on purpose (one concat/truncate/sort-base program
+# serves every agg column structure through jax.jit's own per-aval
+# cache), so their distinct-signature counts scale with workload
+# diversity, not with a retrace bug.  The second-run-compiles-zero test
+# still pins the reuse contract: a repeated shape must trace 0 times.
+jitcheck.waive_retraces(
+    "agg.concat_staged", 0,
+    "one concat program per column structure+arity by design")
+jitcheck.waive_retraces(
+    "agg.truncate", 0, "one truncate program per (structure, out_cap)")
+jitcheck.waive_retraces(
+    "agg.sort_base", 0,
+    "keyed per (orders, nk): key dtypes/capacities vary per query")
+jitcheck.waive_retraces(
+    "agg.spec_merge", 0,
+    "keyed per spec struct: state capacities vary per merge")
+jitcheck.waive_retraces(
+    "agg.group_reduce", 0,
+    "keyed per spec struct/orders/strategy: input capacities vary "
+    "across staged-merge truncation rungs")
 
 
 class AggExec(Operator, MemConsumer):
@@ -281,7 +304,12 @@ class AggExec(Operator, MemConsumer):
         out_cols: List[Any] = list(key_out)
         for spec, skey, cols in zip(self.specs, self._spec_struct_key(),
                                     vcols):
-            k = cached_jit(("agg.spec_merge", skey),
+            # the spec bodies reach the segment/group strategy layer at
+            # trace time (found by the static --compilation pass): the
+            # fingerprint keeps a strategy flip from reusing a program
+            # traced under the old kernel family
+            k = cached_jit(("agg.spec_merge", skey,
+                            strategy_fingerprint()),
                            lambda spec=spec: _spec_merge_builder(spec))
             out_cols.extend(k(cols, perm, seg, n_groups))
         return out_cols, n_groups

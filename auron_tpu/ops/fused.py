@@ -287,7 +287,9 @@ class FusedFragmentExec(Operator):
                          info1["misses"] - info0["misses"])
         if self._limits:
             # one sync: the limit counters advance on true host counts
-            stats = host_sync(limit_stats)
+            from auron_tpu.runtime import jitcheck
+            with jitcheck.declared_transfer("fused.limit.counters"):  # jitcheck: waive (limit state is host-sequential by design: skip/remaining advance per batch)
+                stats = host_sync(limit_stats)
             for i, (live_before, kept) in enumerate(stats):
                 consumed = min(int(live_before), skip[i])
                 skip[i] -= consumed

@@ -42,13 +42,28 @@ from auron_tpu.columnar.batch import (
 from auron_tpu.exprs import hashing as H
 from auron_tpu.exprs import strings_device as S
 from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.runtime import jitcheck
+
+# the probe/pair kernel families are keyed per static-flag combination
+# (emit/track/side/b_bits/iters) and reused across every join of that
+# shape — key/payload column structures and capacities vary per query
+# by DESIGN (jax.jit's per-aval cache holds each signature's program)
+jitcheck.waive_retraces(
+    "join.range*", 0,
+    "one range kernel per flag combination; key structures vary")
+jitcheck.waive_retraces(
+    "join.pair", 0,
+    "one pair kernel per flag combination; column structures vary")
+jitcheck.waive_retraces(
+    "join.probe_index", 0,
+    "keyed per b_bits; build capacities vary per table")
 
 # hash-sentinels: null join keys never match (SQL equi-join semantics)
 _NULL_BUILD = np.uint64(0xFFFFFFFFFFFFFFFF)
 _NULL_PROBE = np.uint64(0xFFFFFFFFFFFFFFFE)
 
 
-def _key_validity(c: Any, capacity: int):
+def _key_validity(c: Any, capacity: int):  # jitcheck: waive (HostColumn arm: trace-time-dead — the fused/jitted paths are all-device; eager callers hit it with concrete arrays)
     if isinstance(c, HostColumn):
         v = np.zeros(capacity, bool)
         v[:len(c.array)] = ~np.asarray(c.array.is_null())
@@ -128,7 +143,8 @@ def build_probe_index(sorted_hashes, b_bits: Optional[int] = None
     k = cached_jit(("join.probe_index", b_bits),
                    lambda: _build_probe_index_kernel(b_bits))
     uvals, ustart, ucnt, bs, max_span = k(sorted_hashes)
-    span = int(host_sync(max_span))
+    with jitcheck.declared_transfer("join.probe_index.span"):  # jitcheck: waive (the partitioned strategy's ONE build-time sync: bakes the bounded search's static iteration count)
+        span = int(host_sync(max_span))
     iters = (max(span, 1) - 1).bit_length()
     return ProbeIndex(uvals=uvals, ustart=ustart, ucnt=ucnt,
                       bucket_start=bs, b_bits=b_bits, iters=iters)
@@ -216,7 +232,7 @@ def probe_ranges(sorted_hashes, probe_hash, probe_valid, probe_live):
     return lo.astype(jnp.int32), counts
 
 
-def _host_key_values(c: Any, idx: np.ndarray) -> List[Any]:
+def _host_key_values(c: Any, idx: np.ndarray) -> List[Any]:  # jitcheck: waive (host-key verification helper: only reached via _verify_pairs_host, never on the traced all-device path)
     """Python values of column `c` at rows idx (None = null/out-of-range);
     strings normalized to bytes so host (str) and device (padded bytes)
     representations compare equal."""
@@ -236,7 +252,7 @@ def _host_key_values(c: Any, idx: np.ndarray) -> List[Any]:
             for i in idx]
 
 
-def _verify_pairs_host(probe_keys, build_keys, probe_idx, build_idx,
+def _verify_pairs_host(probe_keys, build_keys, probe_idx, build_idx,  # jitcheck: waive (host-key fallback: verify_pairs dispatches here only when a key column is host-resident, which the fused/jitted probe path excludes upstream)
                        pair_live):
     """Exact-equality fallback when any key column is host-resident
     (oversized strings / hybrid rows): values may live in different

@@ -118,7 +118,6 @@ def host_keys_of_rows(key_cols: List[Any], rows: List[int]
     """Fetch the key values of a few rows in ONE device round trip (the
     cursor needs first+last keys per batch; per-scalar fetches would put
     several serialized RTTs on every SMJ input batch)."""
-    import jax
     refs: List[Any] = []
     for c in key_cols:
         if isinstance(c, HostColumn):
@@ -132,7 +131,10 @@ def host_keys_of_rows(key_cols: List[Any], rows: List[int]
             idx = jnp.asarray(rows, jnp.int32)
             refs.append((jnp.take(c.data, idx), None,
                          jnp.take(c.validity, idx)))
-    fetched = jax.device_get([r for r in refs if r is not None])
+    # single-sync policy: the one-batch fetch goes through host_sync so
+    # it is counted (raw device_get predates the sanctioned channel)
+    from auron_tpu.ops.kernel_cache import host_sync
+    fetched = host_sync([r for r in refs if r is not None])
     it = iter(fetched)
     out: List[List[Any]] = [[] for _ in rows]
     for c, r in zip(key_cols, refs):

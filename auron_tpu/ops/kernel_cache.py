@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, Hashable, Tuple
 
 import jax
 
+from auron_tpu.runtime import jitcheck
+
 _CACHE: Dict[Hashable, Any] = {}
 _STATS = {"hits": 0, "misses": 0}
 _FAMILY_BUILDS: Dict[str, int] = {}
@@ -49,7 +51,11 @@ def cached_jit(key: Hashable, builder: Callable[[], Callable],
         _STATS["misses"] += 1
         fam = _family(key)
         _FAMILY_BUILDS[fam] = _FAMILY_BUILDS.get(fam, 0) + 1
-        fn = jax.jit(builder(), static_argnames=static_argnames)
+        # the kernel family IS the jit-site name: every cached_jit
+        # program funnels through the jitcheck registry, so per-family
+        # compile counts land in /metrics and the compile manifest
+        fn = jitcheck.site(fam).jit(builder(),
+                                    static_argnames=static_argnames)
         _CACHE[key] = fn
         # a miss is a new jitted program: mark the build point in the
         # trace (jax compiles lazily at first call, so this is an
@@ -66,6 +72,7 @@ def host_sync(x: Any) -> Any:
     """The sanctioned device->host fetch (see module docstring).  Returns
     numpy/python values; accepts any pytree (fetched as one unit so a
     packed scalar pair costs one round trip)."""
+    jitcheck.note_sync("host_sync")
     with jax.transfer_guard("allow"):
         return jax.device_get(x)
 

@@ -41,6 +41,7 @@ from auron_tpu.config import conf
 # reuse the exact jnp murmur3 primitives — bit-parity between this kernel
 # and the fallback path is load-bearing (supported() picks per batch)
 from auron_tpu.exprs.hashing import _fmix, _mix_h1, _mix_k1
+from auron_tpu.runtime import jitcheck
 
 _SEED = np.uint32(42)
 
@@ -81,7 +82,10 @@ def supported(keys, platform: str | None = None) -> bool:
     return c.data.shape[0] % _LANES == 0
 
 
-@functools.partial(jax.jit, static_argnames=("n_parts", "interpret"))
+# jit-site wrap happens at import: the env fallback must be set at
+# process start for these module-level kernels to be probed (conftest)
+@functools.partial(jitcheck.site("pallas.hash_pid").jit,
+                   static_argnames=("n_parts", "interpret"))
 def hash_partition_ids_i64(data, validity, n_parts: int,
                            interpret: bool = False):
     """pid = pmod(murmur3_spark(int64 key, seed=42), n_parts) as one pallas
@@ -149,7 +153,8 @@ def radix_bucket_hist_xla(hi, b_bits: int, tile_rows: int = _MAX_TILE_ROWS):
                    .astype(jnp.int32), axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("b_bits", "interpret"))
+@functools.partial(jitcheck.site("pallas.radix_hist").jit,
+                   static_argnames=("b_bits", "interpret"))
 def radix_bucket_hist(hi, b_bits: int, interpret: bool = False):
     """Per-tile radix bucket histogram as one pallas pass.  hi:
     uint32[cap] key high words, cap % (tile_rows*128) == 0; returns

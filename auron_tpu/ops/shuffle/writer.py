@@ -121,7 +121,13 @@ class _ShuffleWriterBase(Operator):
             pids = self._computer(b, partition_id=ctx.partition_id,
                                   row_start=row_start)
             row_start += b.num_rows
-            host_pids = np.asarray(pids)[:b.num_rows].astype(np.int32)
+            # the documented once-per-batch pid fetch, through the
+            # sanctioned channel (np.asarray on the device vector was
+            # an IMPLICIT transfer: uncounted, and a diagnostic under
+            # the jitcheck transfer guard on accelerator backends)
+            from auron_tpu.ops.kernel_cache import host_sync
+            host_pids = np.asarray(
+                host_sync(pids))[:b.num_rows].astype(np.int32)
             perm, offsets = bindings.partition_sort(host_pids, n)
             for pid in range(n):
                 lo, hi = int(offsets[pid]), int(offsets[pid + 1])

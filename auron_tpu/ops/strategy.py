@@ -232,10 +232,13 @@ def group_strategy(num_segments: int) -> str:
 
 
 def strategy_fingerprint() -> tuple:
-    """Every auron.kernel.* value a kernel body may read at trace time —
-    include in any kernel-cache / program-cache key whose trace calls
-    into the strategy layer (agg reduce kernels, SPMD programs, join
-    range kernels)."""
+    """Every kernel-family-selecting value a kernel body may read at
+    trace time — include in any kernel-cache / program-cache key whose
+    trace calls into the strategy layer (agg reduce kernels, SPMD
+    programs, join range kernels).  `auron.segments.sorted.enable`
+    rides along: it picks the segment-reduce kernel family
+    (gather-cumulative vs scatter) inside the same traced bodies, and
+    the serial kernel keys had no other record of it."""
     from auron_tpu.config import conf
     return (
         str(conf.get("auron.kernel.sort.strategy")),
@@ -247,6 +250,7 @@ def strategy_fingerprint() -> tuple:
         str(conf.get("auron.kernel.group.strategy")),
         int(conf.get("auron.kernel.group.onehot.max.segments")),
         str(conf.get("auron.kernel.cost.profile.path")),
+        bool(conf.get("auron.segments.sorted.enable")),
     )
 
 
@@ -275,12 +279,14 @@ def run_check(rows: int, tolerance: float = 1.05) -> dict:
     """Measure legacy vs strategy kernels on the bench shapes and return
     the report; raises AssertionError when the `auto` pick loses by more
     than `tolerance` on any family (the kernel_check CI gate)."""
-    import jax
     import jax.numpy as jnp
     import numpy as np
 
     from auron_tpu.ops.joins.kernel import build_probe_index, bounded_probe
     from auron_tpu.ops.radix_sort import radix_sort_indices
+    from auron_tpu.runtime import jitcheck
+
+    bench_site = jitcheck.site("strategy.bench")
 
     rng = np.random.default_rng(11)
     report: dict = {"rows": rows, "backend": _backend(),
@@ -302,22 +308,24 @@ def run_check(rows: int, tolerance: float = 1.05) -> dict:
     k64 = jnp.asarray(rng.integers(0, 1 << 63, rows).astype(np.uint64))
     k32 = jnp.asarray(rng.integers(0, 1 << 31, rows).astype(np.uint32))
     auto_radix = sort_strategy(rows) == "radix"
-    legacy = _time(jax.jit(lambda k: jnp.argsort(k)), k64)
-    new = _time(jax.jit(lambda k: radix_sort_indices([k], [64])), k64)
+    legacy = _time(bench_site.jit(lambda k: jnp.argsort(k)), k64)
+    new = _time(bench_site.jit(lambda k: radix_sort_indices([k], [64])),
+                k64)
     record("sort_u64", legacy * 1e3, new * 1e3, auto_radix)
-    legacy = _time(jax.jit(lambda k: jnp.argsort(k)), k32)
-    new = _time(jax.jit(lambda k: radix_sort_indices([k], [32])), k32)
+    legacy = _time(bench_site.jit(lambda k: jnp.argsort(k)), k32)
+    new = _time(bench_site.jit(lambda k: radix_sort_indices([k], [32])),
+                k32)
     record("sort_u32", legacy * 1e3, new * 1e3, auto_radix)
 
     # join probe at the dim-table shape the bench profiles (4096 build)
     table = jnp.sort(jnp.asarray(
         rng.integers(0, 1 << 63, 4096).astype(np.uint64)))
     probes = k64
-    legacy = _time(jax.jit(
+    legacy = _time(bench_site.jit(
         lambda t, p: (jnp.searchsorted(t, p, side="left"),
                       jnp.searchsorted(t, p, side="right"))), table, probes)
     idx = build_probe_index(table)
-    new = _time(jax.jit(lambda p: bounded_probe(idx, p)), probes)
+    new = _time(bench_site.jit(lambda p: bounded_probe(idx, p)), probes)
     record("join_probe_4k", legacy * 1e3, new * 1e3,
            join_probe_strategy(4096) == "partitioned")
     return report

@@ -214,10 +214,11 @@ def segment_context(sp, so, live, cap):
 
     row_number = (idx - seg_start + 1).astype(jnp.int64)
     rank = (og_start - seg_start + 1).astype(jnp.int64)
-    return dict(row_number=row_number, rank=rank, idx=idx,
-                seg_start=seg_start, seg_end=seg_end, part_n=part_n,
-                seg_id=seg_id, og_start=og_start, order_bound=order_bound,
-                part_bound=part_bound, live=live, cap=cap)
+    return {"row_number": row_number, "rank": rank, "idx": idx,
+            "seg_start": seg_start, "seg_end": seg_end, "part_n": part_n,
+            "seg_id": seg_id, "og_start": og_start,
+            "order_bound": order_bound, "part_bound": part_bound,
+            "live": live, "cap": cap}
 
 
 def group_limit_rank(rank_fn: str, c):

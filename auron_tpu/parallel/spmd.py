@@ -21,6 +21,7 @@ from auron_tpu.ops import segments
 from auron_tpu.parallel.exchange import (
     all_to_all_repartition, broadcast_all_gather, global_sum,
 )
+from auron_tpu.runtime import jitcheck
 
 
 class QueryStepOut(NamedTuple):
@@ -78,12 +79,11 @@ def make_query_step(mesh: Mesh, axis: str = "parts",
         out_specs=(PS(axis), PS(axis), PS(axis), PS(axis), PS()),
         check_vma=False)
 
-    @jax.jit
     def step(key, amount, disc, valid, dim_key, dim_val) -> QueryStepOut:
         g, s, j, c, t = shard(key, amount, disc, valid, dim_key, dim_val)
         return QueryStepOut(g, s, j, c, t)
 
-    return step
+    return jitcheck.site("spmd.query_step").jit(step)
 
 
 def local_group_aggregate(key, value, live, dim_key, dim_val):
@@ -125,7 +125,6 @@ def make_single_chip_step():
     sized entirely by its input shapes.  Used for compile checks and as the
     bench kernel."""
 
-    @jax.jit
     def step(key, amount, disc, valid, dim_key, dim_val):
         keep = jnp.logical_and(valid, amount > 0)
         net = jnp.where(keep, amount * (1.0 - disc), 0.0)
@@ -133,7 +132,7 @@ def make_single_chip_step():
             key, net, keep, dim_key, dim_val)
         return gkeys, sums, joined, counts, jnp.sum(keep.astype(jnp.int64))
 
-    return step
+    return jitcheck.site("spmd.single_chip").jit(step)
 
 
 class _FakeCol:

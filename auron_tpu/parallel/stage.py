@@ -51,6 +51,7 @@ from auron_tpu.parallel.exchange import (
     all_to_all_repartition, bounded_quota, broadcast_all_gather,
     hierarchical_repartition,
 )
+from auron_tpu.runtime import jitcheck
 
 Array = Any
 
@@ -1058,7 +1059,7 @@ import weakref  # noqa: E402
 
 
 def _mesh_fingerprint(mesh: Mesh) -> Tuple:
-    devs = [d for d in np.asarray(mesh.devices).flat]
+    devs = list(np.asarray(mesh.devices).flat)
     return (tuple(mesh.shape.items()),
             tuple((d.platform, d.id) for d in devs))
 
@@ -1453,7 +1454,7 @@ def _gather_slicer(mesh: Mesh, axis, K: int, out_cols, out_live):
     if got is None:
         def body(cols, live):
             return (jax.tree.map(lambda a: a[:K], cols), live[:K])
-        got = jax.jit(jax.shard_map(
+        got = jitcheck.site("spmd.slicer").jit(jax.shard_map(
             body, mesh=mesh, in_specs=(PS(axis), PS(axis)),
             out_specs=(PS(axis), PS(axis)), check_vma=False))
         _SLICER_CACHE[key] = got
@@ -1472,9 +1473,14 @@ def _execute_plan_spmd_once(plan: P.PlanNode, conv_ctx, mesh: Mesh,
     # summaries instead of one opaque block
     from auron_tpu.runtime import tracing
     with tracing.span("spmd.launch", cat="spmd"):
-        return _execute_plan_spmd_once_impl(
-            plan, conv_ctx, mesh, source_tables, axis, match_factor,
-            agg_cap_hint=agg_cap_hint, join_compact=join_compact)
+        # the SPMD stage is a hot path: any implicit device->host fetch
+        # (the compact-gather contract routes them all through
+        # host_sync) is an undeclared-transfer diagnostic when jitcheck
+        # is on
+        with jitcheck.transfer_guard("spmd.execute"):
+            return _execute_plan_spmd_once_impl(
+                plan, conv_ctx, mesh, source_tables, axis, match_factor,
+                agg_cap_hint=agg_cap_hint, join_compact=join_compact)
 
 
 def _execute_plan_spmd_once_impl(plan: P.PlanNode, conv_ctx, mesh: Mesh,
@@ -1658,7 +1664,7 @@ def _execute_plan_spmd_once_impl(plan: P.PlanNode, conv_ctx, mesh: Mesh,
             return (cols, live, count, guards, retry_guards,
                     shrink_guards, join_guards)
 
-        shard = jax.jit(jax.shard_map(
+        shard = jitcheck.site("spmd.stage").jit(jax.shard_map(
             program, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: PS(axis), host_inputs),),
             out_specs=(PS(axis), PS(axis), PS(axis), PS(), PS(), PS(),
@@ -1683,7 +1689,8 @@ def _execute_plan_spmd_once_impl(plan: P.PlanNode, conv_ctx, mesh: Mesh,
 
     from auron_tpu.ops.kernel_cache import host_sync
     with tracing.span("spmd.gather", cat="spmd",
-                      compact=bool(compact_gather)):
+                      compact=bool(compact_gather)), \
+            jitcheck.declared_transfer("spmd.gather"):  # jitcheck: waive (THE per-stage result fetch: counts+guards first, compacted slice second)
         if compact_gather:
             # phase 1: a few BYTES decide everything — per-shard live
             # counts + guard bits.  A tripped guard never pays the

@@ -52,12 +52,16 @@ def get(key: str) -> int:
 
 def snapshot() -> Dict[str, int]:
     """Flat counter snapshot: lifecycle counters here + the retry-policy
-    stats (prefixed `retry_`) so `/metrics` exports one namespace."""
-    from auron_tpu.runtime import retry
+    stats (prefixed `retry_`) + per-site jit compile counts (prefixed
+    `jit_compiles_`, runtime/jitcheck.py) so `/metrics` exports one
+    namespace."""
+    from auron_tpu.runtime import jitcheck, retry
     with _LOCK:
         out = dict(_COUNTERS)
     for k, v in retry.stats_snapshot().items():
         out[f"retry_{k}"] = v
+    for site, n in jitcheck.compile_counts().items():
+        out[f"jit_compiles_{site}"] = n
     return out
 
 

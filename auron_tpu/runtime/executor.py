@@ -118,7 +118,7 @@ def execute_task(task: P.TaskDefinition,
                  resources: Optional[ResourceRegistry] = None
                  ) -> ExecutionResult:
     from auron_tpu.runtime import (
-        counters, profiling, retry, task_logging, tracing,
+        counters, jitcheck, profiling, retry, task_logging, tracing,
     )
 
     profiling.maybe_start_from_conf()   # lazy start (exec.rs:53-59)
@@ -138,11 +138,15 @@ def execute_task(task: P.TaskDefinition,
             # planner errors carry the [stage N part M] prefix
             rt = NativeExecutionRuntime(task, resources)
             rt_box[:] = [rt]
-            # convert BEFORE the row-count check: to_arrow fetches count
-            # + columns in one round trip, while `b.num_rows` alone would
-            # pay a separate sync for lazy batches
-            return [rb for rb in (b.to_arrow() for b in rt.batches())
-                    if rb.num_rows > 0]
+            # the per-batch pull loop is THE hot path: every implicit
+            # device->host transfer in it must route through host_sync
+            # (the single-sync policy) — jitcheck audits that here
+            with jitcheck.transfer_guard("task.execute"):
+                # convert BEFORE the row-count check: to_arrow fetches
+                # count + columns in one round trip, while `b.num_rows`
+                # alone would pay a separate sync for lazy batches
+                return [rb for rb in (b.to_arrow() for b in rt.batches())
+                        if rb.num_rows > 0]
 
     def _count_retry(_attempt_no, _exc):
         retries_box[0] += 1
@@ -155,6 +159,7 @@ def execute_task(task: P.TaskDefinition,
     # lands in the task's metric tree (num_retries)
     from auron_tpu.ops.kernel_cache import cache_info
     cache0 = cache_info()
+    jit0 = sum(jitcheck.compile_counts().values())
     try:
         with tracing.span("task.execute", cat="task",
                           stage=task.stage_id,
@@ -186,6 +191,11 @@ def execute_task(task: P.TaskDefinition,
     metrics.add("kernel_cache_hits", cache1["hits"] - cache0["hits"])
     metrics.add("kernel_cache_misses",
                 cache1["misses"] - cache0["misses"])
+    # compilation observability: jitted-program TRACES this task caused
+    # (a warm repeat of the same shape must add zero — the jitcheck
+    # second-run-compiles-zero contract); per-site totals ride /metrics
+    metrics.add("jit_compiles",
+                sum(jitcheck.compile_counts().values()) - jit0)
     return ExecutionResult(out, metrics, schema=out_schema)
 
 

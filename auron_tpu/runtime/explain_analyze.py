@@ -37,6 +37,8 @@ __all__ = ["merge_metric_trees", "metric_totals", "metric_max",
 _VOLATILE_KEYS = frozenset({
     "kernel_cache_hits", "kernel_cache_misses", "ffi_ingest_cache_hits",
     "mem_spill_size", "disk_spill_size", "mem_peak",
+    # cold-vs-warm process state: a first run traces, a repeat traces 0
+    "jit_compiles",
 })
 
 # byte-valued metrics: rendered human-readable in the non-canonical form

@@ -11,6 +11,10 @@ jitted jnp program (ops/fused.py) — a batch crosses the Python operator
 boundary once per FRAGMENT instead of once per operator, intermediate
 Batch materializations disappear, and the fragment keys into
 ops/kernel_cache.cached_jit so repeated shapes re-trace zero times.
+(That zero is now a checked contract: cached_jit funnels the
+`fused.fragment` family through the jit-site registry
+(runtime/jitcheck.py), and the second-run-compiles-zero test fails if
+a fragment cache key goes shape-polymorphic.)
 This is the operator-fusion-plans approach of SystemML (PAPERS.md
 1801.00829) and Flare's pipeline compilation (1703.08219) adapted to
 XLA stage programs.

@@ -1,0 +1,472 @@
+"""Jit-site registry + compilation-hygiene checking (jitcheck).
+
+The engine's whole performance story rides on compiled-program REUSE:
+plans execute as cached vectorized programs (PAPER.md's native engine),
+and the fragment/kernel/SPMD caches (PRs 3, 7) are the jax_graft form
+of that contract.  Yet nothing verified compilation behavior — a
+shape-polymorphic cache key, a Python branch on a traced value, or a
+stray implicit host transfer silently turns one compile into hundreds
+of retraces, and only a wall-clock regression would notice.  This
+module is the dynamic half of the net whose static half is
+`auron_tpu/analysis/compilation.py` — the compilation-hygiene twin of
+PR 8's lockcheck.
+
+Every jit/compile site in the program-building modules constructs its
+jitted callable through a named SITE here (``jitcheck.site("name")``;
+the kernel cache funnels `cached_jit` families through their family
+name).  When checking is enabled, each wrapped program carries a TRACE
+PROBE: jax calls the wrapped Python function only when it traces (a
+cache miss), so the probe counts COMPILES exactly, with zero steady-
+state overhead — and records the abstract signature (avals + static
+args + pytree structure) of every trace.
+
+Two violation kinds (`JitDiagnostic.kind`):
+
+- ``retrace-storm`` — one program at a site accumulated more than
+  ``auron.jitcheck.retrace.max`` DISTINCT abstract signatures: the
+  shape-polymorphic-cache-key bug class.  The diagnostic includes the
+  signature diff (which leaves changed between the last two traces).
+- ``undeclared-transfer`` — an IMPLICIT device->host transfer
+  (np.asarray on a device array, float()/iteration on a device scalar)
+  happened inside a ``transfer_guard(...)`` region (the executor wraps
+  task execution, the stage driver wraps SPMD execution).  Deliberate
+  syncs route through `kernel_cache.host_sync` or a
+  ``declared_transfer(site)`` block and carry a ``# jitcheck: waive``
+  comment for the static pass — exactly like lockcheck's blocking
+  waivers.  CAVEAT: on the CPU backend jax arrays ARE host memory and
+  the underlying jax guard never fires (np.asarray is a zero-copy
+  view, not a transfer) — the guard's teeth are on accelerator
+  backends, where each stray fetch costs a device round trip; CI
+  coverage of the sync discipline on CPU comes from the static pass
+  plus the host_sync call counting (tests/test_sync_budget.py).
+
+COST CONTRACT: with ``auron.jitcheck.enable`` off (the default) the
+site factories hand back RAW ``jax.jit`` products — the production
+compile path is bit-identical to the unchecked one — and
+``transfer_guard`` is one module-global flag read.  Enablement is
+decided when a site WRAPS a program, from the env fallback
+(``AURON_TPU_AURON_JITCHECK_ENABLE``), so it must be set at process
+start (module-level jits wrap at import); the test suite forces it on
+in `tests/conftest.py` exactly like lockcheck and `auron.plan.verify`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from auron_tpu.runtime import lockcheck
+
+__all__ = [
+    "site", "JitSite", "JitDiagnostic", "JitcheckError", "enabled",
+    "configure", "transfer_guard", "declared_transfer", "note_sync",
+    "waive_retraces", "retrace_waivers", "diagnostics",
+    "clear_diagnostics", "compile_counts", "signature_counts",
+    "sync_counts", "site_registry", "manifest_snapshot",
+    "retrace_sites", "reset_state",
+]
+
+import os as _os
+
+MAX_DIAGNOSTICS = 256
+DEFAULT_RETRACE_MAX = 8
+
+
+def _env_bool(key: str, default: bool = False) -> bool:
+    raw = _os.environ.get(key)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+# decided at import: site.jit() consults this at WRAP time (off => raw
+# jax.jit output, zero added cost); the per-trace probe consults it too
+# so configure(False) silences already-wrapped programs.
+_ENABLED = _env_bool("AURON_TPU_AURON_JITCHECK_ENABLE")
+_RAISE = _env_bool("AURON_TPU_AURON_JITCHECK_RAISE", True)
+
+# leaf-only guard (never held across a conf read or any other lock)
+_GUARD = lockcheck.Lock("jitcheck")
+
+_REGISTRY: Dict[str, "JitSite"] = {}
+_DIAGNOSTICS: List["JitDiagnostic"] = []
+_SEEN_KEYS: set = set()
+_SYNC_COUNTS: Dict[str, int] = {}     # declared device->host sync sites
+# (site glob, limit, reason) — deliberately signature-polymorphic sites
+# (a coarse-keyed kernel family whose ONE program serves every column
+# structure through jax.jit's own per-aval cache) declare their own
+# retrace ceiling; 0 = unbounded (compile counting stays on)
+_RETRACE_WAIVERS: List[Tuple[str, int, str]] = []
+
+
+class JitcheckError(RuntimeError):
+    """A jitcheck violation (carries the structured diagnostic)."""
+
+    def __init__(self, diagnostic: "JitDiagnostic"):
+        self.diagnostic = diagnostic
+        super().__init__(str(diagnostic))
+
+
+@dataclass(frozen=True)
+class JitDiagnostic:
+    """One structured finding of the dynamic checker."""
+    kind: str                 # retrace-storm | undeclared-transfer
+    site: str                 # registry site name (or guard region name)
+    program: str              # wrapped-program label ('' for transfers)
+    message: str
+    signatures: Tuple[str, ...] = ()   # distinct signatures seen (storm)
+    diff: Tuple[str, ...] = ()         # leaf-level last-two-traces diff
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "site": self.site,
+                "program": self.program, "message": self.message,
+                "signatures": list(self.signatures),
+                "diff": list(self.diff)}
+
+    def __str__(self) -> str:
+        s = f"jitcheck[{self.kind}] {self.site}"
+        if self.program:
+            s += f" ({self.program})"
+        s += f": {self.message}"
+        if self.diff:
+            s += "  signature diff: " + "; ".join(self.diff)
+        return s
+
+
+def _report(diag: JitDiagnostic, dedupe_key: Optional[tuple]) -> None:
+    with _GUARD:
+        if dedupe_key is not None:
+            if dedupe_key in _SEEN_KEYS and not _RAISE:
+                return
+            _SEEN_KEYS.add(dedupe_key)
+        if len(_DIAGNOSTICS) < MAX_DIAGNOSTICS:
+            _DIAGNOSTICS.append(diag)
+    if _RAISE:
+        raise JitcheckError(diag)
+
+
+def _retrace_max() -> int:
+    try:
+        from auron_tpu.config import conf
+        return int(conf.get("auron.jitcheck.retrace.max"))
+    except Exception:  # noqa: BLE001 - config not imported yet
+        return DEFAULT_RETRACE_MAX
+
+
+def _transfer_guard_on() -> bool:
+    try:
+        from auron_tpu.config import conf
+        return bool(conf.get("auron.jitcheck.transfer.guard"))
+    except Exception:  # noqa: BLE001 - config not imported yet
+        return True
+
+
+# ---------------------------------------------------------------------------
+# abstract signatures
+# ---------------------------------------------------------------------------
+
+def _describe_leaf(x: Any) -> str:
+    aval = getattr(x, "aval", None)
+    if aval is not None:
+        return str(aval)                    # e.g. float32[8192]
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if isinstance(x, (bool, int, float, str, bytes, type(None))):
+        return repr(x)[:64]                 # static-arg values
+    return type(x).__name__
+
+
+def _signature(args: tuple, kwargs: dict) -> Tuple[str, ...]:
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return tuple([_describe_leaf(x) for x in leaves] + [str(treedef)])
+
+
+def _sig_diff(old: Tuple[str, ...], new: Tuple[str, ...]) -> Tuple[str, ...]:
+    """Leaf-level diff between two trace signatures — the 'what changed
+    between the last two traces' the storm diagnostic names."""
+    out: List[str] = []
+    n = max(len(old), len(new))
+    for i in range(n):
+        a = old[i] if i < len(old) else "<absent>"
+        b = new[i] if i < len(new) else "<absent>"
+        if a != b:
+            out.append(f"leaf[{i}]: {a} -> {b}")
+        if len(out) >= 8:
+            out.append("...")
+            break
+    return tuple(out)
+
+
+class _ProgramState:
+    """Per-wrapped-program trace bookkeeping (one per site.jit call)."""
+
+    __slots__ = ("label", "signatures", "last_sig")
+
+    def __init__(self, label: str):
+        self.label = label
+        self.signatures: Dict[Tuple[str, ...], int] = {}
+        self.last_sig: Optional[Tuple[str, ...]] = None
+
+
+class JitSite:
+    """One named compile site: all programs this site wraps share its
+    compile counters (the manifest/metrics unit); retrace-storm checking
+    is per PROGRAM (one program re-tracing many shapes is the bug; many
+    distinct programs under one family name is normal)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.programs: List[_ProgramState] = []
+        self.compiles = 0
+
+    def _note_trace(self, prog: _ProgramState, args: tuple,
+                    kwargs: dict) -> None:
+        if not _ENABLED:
+            return
+        sig = _signature(args, kwargs)
+        limit = _waived_limit(self.name)
+        if limit is None:
+            limit = _retrace_max()
+        storm: Optional[Tuple[Tuple[str, ...], ...]] = None
+        prev_sig = None
+        with _GUARD:
+            self.compiles += 1
+            if sig not in prog.signatures:
+                prog.signatures[sig] = 0
+                if limit > 0 and len(prog.signatures) > limit:
+                    storm = tuple(prog.signatures)
+                    prev_sig = prog.last_sig
+            prog.signatures[sig] += 1
+            prog.last_sig = sig
+        if storm is not None:
+            _report(JitDiagnostic(
+                kind="retrace-storm", site=self.name, program=prog.label,
+                message=f"{len(storm)} distinct abstract signatures "
+                        f"(> auron.jitcheck.retrace.max={limit}): one "
+                        f"program is being re-traced per input shape — "
+                        f"a shape-polymorphic cache key or a traced-"
+                        f"value-dependent Python branch",
+                signatures=tuple(" ".join(s[:4]) + " ..." if len(s) > 4
+                                 else " ".join(s) for s in storm[:8]),
+                diff=_sig_diff(prev_sig or (), sig)),
+                dedupe_key=("storm", self.name, prog.label))
+
+    def jit(self, fn: Callable, static_argnames: Tuple[str, ...] = (),
+            **jit_kw: Any) -> Callable:
+        """`jax.jit(fn, ...)` through this site.  Off: the raw jitted
+        callable (bit-identical production path).  On: the traced
+        Python function is wrapped in a probe that fires once per
+        actual trace — jax never calls it again for cached shapes."""
+        if static_argnames:
+            jit_kw["static_argnames"] = static_argnames
+        if not _ENABLED:
+            return jax.jit(fn, **jit_kw)
+        with _GUARD:
+            prog = _ProgramState(
+                f"{getattr(fn, '__name__', type(fn).__name__)}"
+                f"#{len(self.programs)}")
+            self.programs.append(prog)
+
+        @functools.wraps(fn)
+        def probe(*args: Any, **kwargs: Any):
+            self._note_trace(prog, args, kwargs)
+            return fn(*args, **kwargs)
+
+        return jax.jit(probe, **jit_kw)
+
+    def __repr__(self) -> str:
+        return f"<jitcheck.JitSite {self.name!r} " \
+               f"programs={len(self.programs)} compiles={self.compiles}>"
+
+
+def site(name: str) -> JitSite:
+    """The named-site factory — the ONLY way auron_tpu code jits (the
+    static pass analysis/compilation.py errors on raw jax.jit calls)."""
+    with _GUARD:
+        s = _REGISTRY.get(name)
+        if s is None:
+            s = JitSite(name)
+            _REGISTRY[name] = s
+        return s
+
+
+# ---------------------------------------------------------------------------
+# transfer auditing
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def transfer_guard(region: str):
+    """Audit a hot execution region: IMPLICIT device->host transfers
+    inside it raise as structured diagnostics.  Deliberate syncs route
+    through `kernel_cache.host_sync` (explicit, allowed) or a
+    `declared_transfer(site)` block.  Off: a single flag read."""
+    if not _ENABLED or not _transfer_guard_on():
+        yield
+        return
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+    except JitcheckError:
+        raise
+    except Exception as e:  # noqa: BLE001 - classify, then re-raise
+        msg = str(e)
+        if "transfer" in msg.lower() and "disallow" in msg.lower():
+            _report(JitDiagnostic(
+                kind="undeclared-transfer", site=region, program="",
+                message=f"implicit device->host transfer inside "
+                        f"{region!r}: {msg[:300]} — fetch through "
+                        f"kernel_cache.host_sync, or declare the sync "
+                        f"with jitcheck.declared_transfer(site) and a "
+                        f"'# jitcheck: waive' comment"),
+                dedupe_key=None)
+        raise
+
+
+@contextlib.contextmanager
+def declared_transfer(sync_site: str):
+    """A deliberate device->host sync OUTSIDE host_sync (the probe-index
+    span sync class).  Counted per site; pairs with an in-code
+    `# jitcheck: waive (<reason>)` comment for the static pass."""
+    if not _ENABLED:
+        yield
+        return
+    note_sync(sync_site)
+    with jax.transfer_guard("allow"):
+        yield
+
+
+def _waived_limit(site_name: str) -> Optional[int]:
+    import fnmatch
+    for pat, limit, _reason in _RETRACE_WAIVERS:
+        if site_name == pat or fnmatch.fnmatchcase(site_name, pat):
+            return limit
+    return None
+
+
+def waive_retraces(site_glob: str, limit: int, reason: str) -> None:
+    """Declare a deliberately signature-polymorphic jit site: `limit`
+    replaces `auron.jitcheck.retrace.max` for matching sites (0 =
+    unbounded).  Declared next to the kernel it describes — a reviewed
+    decision, not a silent escape; the static pass collects these and
+    the second-run-compiles-zero test still pins the reuse contract."""
+    with _GUARD:
+        entry = (site_glob, int(limit), reason)
+        if entry not in _RETRACE_WAIVERS:
+            _RETRACE_WAIVERS.append(entry)
+
+
+def retrace_waivers() -> List[Tuple[str, int, str]]:
+    with _GUARD:
+        return list(_RETRACE_WAIVERS)
+
+
+def note_sync(sync_site: str) -> None:
+    """Count a sanctioned device->host fetch (host_sync calls this).
+    One flag read when checking is off."""
+    if not _ENABLED:
+        return
+    with _GUARD:
+        _SYNC_COUNTS[sync_site] = _SYNC_COUNTS.get(sync_site, 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# introspection / control
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def configure(enabled: Optional[bool] = None,
+              raise_on_violation: Optional[bool] = None) -> bool:
+    """Flip checking at runtime.  `enabled=None` re-reads
+    `auron.jitcheck.enable` from the config registry.  NOTE: programs
+    wrapped while checking was off are raw jitted callables and stay
+    unprobed — enable via the env fallback at process start for full
+    coverage (module-level jits wrap at import)."""
+    global _ENABLED, _RAISE
+    if enabled is None:
+        from auron_tpu.config import conf
+        enabled = bool(conf.get("auron.jitcheck.enable"))
+    if raise_on_violation is None:
+        from auron_tpu.config import conf
+        raise_on_violation = bool(conf.get("auron.jitcheck.raise"))
+    _ENABLED = bool(enabled)
+    _RAISE = bool(raise_on_violation)
+    return _ENABLED
+
+
+def diagnostics() -> List[JitDiagnostic]:
+    with _GUARD:
+        return list(_DIAGNOSTICS)
+
+
+def clear_diagnostics() -> None:
+    with _GUARD:
+        _DIAGNOSTICS.clear()
+        _SEEN_KEYS.clear()
+
+
+def compile_counts() -> Dict[str, int]:
+    """{site: total compiles (traces) since start/reset} — the unit
+    counters.snapshot folds into /metrics as `jit_compiles_<site>`."""
+    with _GUARD:
+        return {n: s.compiles for n, s in _REGISTRY.items()}
+
+
+def signature_counts() -> Dict[str, int]:
+    """{site: distinct (program, signature) pairs} — the compile-
+    manifest unit: how many distinct programs a site traced."""
+    with _GUARD:
+        return {n: sum(len(p.signatures) for p in s.programs)
+                for n, s in _REGISTRY.items()}
+
+
+def sync_counts() -> Dict[str, int]:
+    with _GUARD:
+        return dict(_SYNC_COUNTS)
+
+
+def site_registry() -> Dict[str, JitSite]:
+    with _GUARD:
+        return dict(_REGISTRY)
+
+
+def retrace_sites(baseline: Optional[Dict[str, int]] = None) -> List[str]:
+    """Sites whose compile count grew past `baseline` (default: any
+    compile at all) — bench rounds record this to tell 'kernel got
+    slower' from 'kernel got recompiled'."""
+    base = baseline or {}
+    with _GUARD:
+        return sorted(n for n, s in _REGISTRY.items()
+                      if s.compiles > base.get(n, 0))
+
+
+def manifest_snapshot() -> Dict[str, Tuple[int, int]]:
+    """{site: (distinct signatures, compiles)} with zero-compile sites
+    dropped — the committed compile-manifest form."""
+    with _GUARD:
+        return {n: (sum(len(p.signatures) for p in s.programs),
+                    s.compiles)
+                for n, s in sorted(_REGISTRY.items()) if s.compiles}
+
+
+def reset_state() -> None:
+    """Test hook: zero compile counts, per-program signatures, sync
+    counts and diagnostics (the site registry describes code, not a
+    run — sites persist)."""
+    with _GUARD:
+        for s in _REGISTRY.values():
+            s.compiles = 0
+            for p in s.programs:
+                p.signatures.clear()
+                p.last_sig = None
+        _SYNC_COUNTS.clear()
+        _DIAGNOSTICS.clear()
+        _SEEN_KEYS.clear()

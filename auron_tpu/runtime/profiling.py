@@ -243,6 +243,19 @@ def _prometheus_text() -> str:
          "resident jitted kernels")
     emit("auron_kernel_cache_hits_total", kc.get("hits", 0))
     emit("auron_kernel_cache_misses_total", kc.get("misses", 0))
+    from auron_tpu.runtime import jitcheck
+    jc = jitcheck.compile_counts()
+    if jc:
+        name = "auron_jit_compiles_total"
+        lines.append(f"# HELP {name} jitted-program traces per "
+                     f"registered jit site (runtime/jitcheck.py)")
+        lines.append(f"# TYPE {name} counter")
+        for s in sorted(jc):
+            lines.append(f'{name}{{site="{_prom_escape(s)}"}} {jc[s]}')
+    emit("auron_jit_retrace_storms_total",
+         sum(1 for d in jitcheck.diagnostics()
+             if d.kind == "retrace-storm"),
+         help_="retrace-storm diagnostics recorded this process")
     ic = ingest_cache_info()
     emit("auron_ffi_ingest_cache_entries", ic.get("entries", 0), "gauge")
     emit("auron_ffi_ingest_cache_bytes", ic.get("bytes", 0), "gauge")

@@ -141,6 +141,8 @@ def worker_engine() -> dict:
 
     out = execute_plan(plan, resources=res)      # compile + warm
     n_out = sum(b.num_rows for b in out.batches)
+    from auron_tpu.runtime import jitcheck
+    warm_counts = jitcheck.compile_counts()
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -150,6 +152,9 @@ def worker_engine() -> dict:
             b.num_rows
         times.append(time.perf_counter() - t0)
     med = sorted(times)[1]
+    # a site recompiling INSIDE the timed loop is a broken cache key,
+    # not a slower kernel — name it in the artifact
+    retrace_sites = jitcheck.retrace_sites(baseline=warm_counts)
     # fusion observability: how many fragments/ops the rewriter fused in
     # this plan (runtime/fusion.py), so the artifact records whether the
     # serial number ran fused and at what coverage
@@ -160,6 +165,8 @@ def worker_engine() -> dict:
             "fuse_enabled": bool(_conf.get("auron.fuse.enable")),
             "fused_fragments": fusion_rep.n_fragments,
             "fused_ops": fusion_rep.ops_fused,
+            "compile_count": sum(jitcheck.compile_counts().values()),
+            "retrace_sites": retrace_sites,
             "platform": jax.devices()[0].platform}
 
 
@@ -243,6 +250,8 @@ def worker_spmd() -> dict:
     sources = {"src": t, "dim": dim}
     out = execute_plan_spmd(join, ctx, mesh, sources)   # compile + warm
     n_out = out.num_rows
+    from auron_tpu.runtime import jitcheck
+    warm_counts = jitcheck.compile_counts()
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -252,6 +261,9 @@ def worker_spmd() -> dict:
     from auron_tpu.parallel.stage import GATHER_STATS
     return {"seconds": med, "rows": n_rows, "groups": int(n_out),
             "n_dev": n_dev, "gather_bytes": GATHER_STATS["bytes"],
+            "compile_count": sum(jitcheck.compile_counts().values()),
+            "retrace_sites": jitcheck.retrace_sites(
+                baseline=warm_counts),
             "platform": jax.devices()[0].platform}
 
 
@@ -476,6 +488,12 @@ def _run_worker(mode: str, env_extra=None, timeout=WORKER_TIMEOUT_S
                 ) -> dict:
     env = dict(os.environ)
     env.update(env_extra or {})
+    # compilation observability (runtime/jitcheck.py): workers count
+    # jitted-program traces per site so each round's artifact can tell
+    # "kernel got slower" from "kernel got recompiled".  Probes fire at
+    # TRACE time only — the warm timed loops run the compiled path and
+    # pay nothing.
+    env.setdefault("AURON_TPU_AURON_JITCHECK_ENABLE", "1")
     # persistent XLA compile cache: device compiles on the congested
     # shared tunnel take minutes, and each worker is a fresh process —
     # without this every bench run re-pays every compile (the round-4

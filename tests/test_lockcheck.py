@@ -272,7 +272,7 @@ def test_static_raw_lock_construction_is_error(tmp_path):
         import threading
         L = threading.Lock()
     """})
-    errs = [d for d in rep.result.errors]
+    errs = list(rep.result.errors)
     assert len(errs) == 1 and "bypasses the named-lock registry" in \
         errs[0].message
 
@@ -310,7 +310,7 @@ def test_static_blocking_through_call_closure_and_waiver(tmp_path):
             with A:
                 slow_helper()  # lockcheck: waive (test)
     """})
-    errs = [d for d in rep.result.errors]
+    errs = list(rep.result.errors)
     assert len(errs) == 1 and "file-io" in errs[0].message
     assert "slow_helper" in errs[0].message
 

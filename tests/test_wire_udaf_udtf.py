@@ -136,7 +136,7 @@ def test_wire_udaf_rides_spmd_stage():
     t = make_fact(n=2000, keys=16)
     src = P.FFIReader(schema=from_arrow_schema(t.schema), resource_id="t")
     wire = weighted_avg_udaf()
-    agg_args = dict(
+    agg_args = dict(  # noqa: C408 - kwargs mirror the Agg ctor signature
         grouping=(col("key"),), grouping_names=("key",),
         aggs=(AggExpr(fn="wire_udaf", children=(col("x"), col("w")),
                       return_type=F64, wire=wire),),

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# CI compilation-hygiene gate (CPU-only, fast):
+#   1. the STATIC pass — raw-jit registry bypass lint, host
+#      materialization inside jitted bodies (bounded call closure),
+#      traced-parameter casts, mutable-capture, strategy-fingerprint
+#      cache keys, config-knob lint vs the registry + CONFIG.md — must
+#      report 0 unwaived errors;
+#   2. the HYGIENE suite — trace-probe/storm/waiver/transfer units,
+#      the committed compile manifest
+#      (tests/golden_plans/compile_manifest.txt) vs a fresh canonical
+#      q01+q03 run, and the second-run-compiles-zero regression —
+#      runs under `auron.jitcheck.enable` (forced on by
+#      tests/conftest.py).
+#
+# Regen after intentional compile-path changes:
+#   python -m auron_tpu.analysis --compilation --regen-golden
+#
+# Usage: tools/jitcheck.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m auron_tpu.analysis --compilation
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m pytest tests/test_jitcheck.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "jitcheck.sh: ok"
