@@ -690,7 +690,30 @@ SPILL_VICTIM_STRATEGY = conf.define(
     "wall-second from the spill attribution history (consumers with no "
     "history rank by current size, i.e. fall back to largest-consumer, "
     "and are tried first so they earn a history entry); 'largest' "
-    "restores the pure largest-consumer policy (lib.rs:303-423).",
+    "restores the pure largest-consumer policy (lib.rs:303-423); "
+    "'query' prefers the consumer belonging to the most-over-budget "
+    "QUERY in the per-query ledger (auron.memory.query.budget.bytes) — "
+    "the overload-survival policy that charges pressure to the query "
+    "causing it instead of the globally best-rate consumer.",
+)
+MEMORY_QUERY_BUDGET_BYTES = conf.define(
+    "auron.memory.query.budget.bytes", 0,
+    "Per-QUERY memory budget enforced inside the MemManager "
+    "(memmgr/manager.py): consumers carry the query tag of the ambient "
+    "query id, usage is ledgered per query, and a query over this "
+    "budget has one of its own consumers spilled even while the shared "
+    "pool is under budget.  0 disables per-query enforcement (the "
+    "ledger is still maintained for /memory and the preemption "
+    "victim ranking).",
+)
+MEMORY_QUERY_KILL_GRACE_SPILLS = conf.define(
+    "auron.memory.query.kill.grace.spills", 3,
+    "Grace allowance before the memory manager KILLS an over-budget "
+    "query: a query still over auron.memory.query.budget.bytes after "
+    "this many of its spills is preempted through the task pool's "
+    "cancel fast-fail path (task_pool.preempt_query — the serving "
+    "scheduler requeues it; without a scheduler the query fails with "
+    "QueryCancelled).  <= 0 disables manager-initiated kills.",
 )
 QUERY_PRIORITY = conf.define(
     "auron.query.priority", 1,
@@ -757,6 +780,41 @@ ADMISSION_DEGRADE_SERIAL_FRACTION = conf.define(
     "the query to SERIAL execution (task parallelism 1, no SPMD stage "
     "program) so its concurrent-partition memory footprint shrinks "
     "instead of being shed; 0 disables degradation.",
+)
+ADMISSION_AGING_SECONDS = conf.define(
+    "auron.admission.aging.seconds", 30.0,
+    "Priority aging interval for queued submissions (serving/"
+    "scheduler.py): every full interval a submission has waited in the "
+    "admission queue bumps its EFFECTIVE priority by one (clamped to "
+    "64), so requeued and long-queued submissions cannot starve behind "
+    "a stream of high-priority arrivals.  The submission's declared "
+    "priority (fair-share task weight) is unchanged; <= 0 disables "
+    "aging.",
+)
+SERVING_PREEMPT_WATERMARK = conf.define(
+    "auron.serving.preempt.watermark", 0.95,
+    "Pool-usage fraction of the effective MemManager budget past which "
+    "the QueryScheduler preempts a running victim (lowest effective "
+    "priority, most over forecast): the victim is cancelled through "
+    "the task pool's fast-fail path, its reservation released, and the "
+    "submission requeued with its original conf overlay — re-execution "
+    "is bit-identical to a solo run.  Requires >= 2 running queries "
+    "(preempting the only query cannot relieve pressure); <= 0 "
+    "disables preemption.",
+)
+SERVING_PREEMPT_MAX_PER_QUERY = conf.define(
+    "auron.serving.preempt.max.per.query", 2,
+    "Preemption cap per submission: a query preempted this many times "
+    "is no longer selected as a pressure victim, and a manager-"
+    "initiated kill past the cap FAILS the query instead of requeueing "
+    "forever — guaranteed forward progress under sustained overload.",
+)
+SERVING_PREEMPT_COOLDOWN_SECONDS = conf.define(
+    "auron.serving.preempt.cooldown.seconds", 2.0,
+    "Minimum seconds between scheduler-initiated preemptions: memory "
+    "pressure is re-evaluated on every accounting update, so the "
+    "cooldown keeps one crossing from cascading into a preemption "
+    "storm before the first victim's memory is even released.",
 )
 
 # -- kernel-strategy layer (ops/strategy.py) --------------------------------

@@ -5,10 +5,18 @@ with a fair per-consumer budget and wait-or-spill arbitration
 (auron-memmgr/src/lib.rs:46,82,303-423), where "spill" means device->host
 transfer of a consumer's batches, optionally compressed to files
 (spill.rs:89 FileSpill / spill.rs:180 OnHeapSpill -> here HostMemSpill).
+
+Overload survival (PR 10) lives in `manager`: a per-query usage ledger
+(consumers carry the ambient query tag), per-query budgets with
+kill-past-grace (`set_kill_hook`), and the pressure hook the serving
+scheduler uses for watermark preemption (`set_pressure_hook`).
 """
 
-from auron_tpu.memmgr.manager import MemConsumer, MemManager, get_manager
+from auron_tpu.memmgr.manager import (
+    MemConsumer, MemManager, get_manager, set_kill_hook,
+    set_pressure_hook,
+)
 from auron_tpu.memmgr.spill import Spill, SpillManager
 
 __all__ = ["MemConsumer", "MemManager", "get_manager", "Spill",
-           "SpillManager"]
+           "SpillManager", "set_kill_hook", "set_pressure_hook"]

@@ -30,7 +30,21 @@ be a black box):
   the profiling server's `/memory` endpoint and `mem.spill` trace events;
 - RESERVATIONS: `add_reservation` shrinks the effective budget (the `mem`
   fault kind injects pressure this way; a production analogue is carving
-  out headroom for a co-tenant runtime).
+  out headroom for a co-tenant runtime);
+- PER-QUERY LEDGER (overload survival): every consumer registered inside
+  a query scope carries the ambient query id (runtime/tracing.py), and
+  usage/peak/spill counts are ledgered per query.  With
+  `auron.memory.query.budget.bytes` set, a query over its own budget has
+  one of its OWN consumers spilled even while the shared pool is under
+  budget, and — past `auron.memory.query.kill.grace.spills` spills that
+  leave it still over budget — is KILLED through the task pool's
+  cancel fast-fail path (`set_kill_hook`; the serving scheduler requeues
+  the victim, a bare session fails it with QueryCancelled).  The
+  `query` spill-victim strategy charges arbitration to the most-over-
+  budget query instead of the globally best-rate consumer — the
+  reference's per-query Wait/Spill arm.  A PRESSURE HOOK
+  (`set_pressure_hook`) lets the serving scheduler watch pool usage
+  cross its preemption watermark without polling.
 """
 
 from __future__ import annotations
@@ -38,7 +52,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from auron_tpu.config import conf
 from auron_tpu.runtime import lockcheck
@@ -53,6 +67,60 @@ def min_trigger_size() -> int:
     """Consumers below this size are never forced to spill (lib.rs:36;
     configurable so tiny-budget fuzz tests can exercise spill paths)."""
     return int(conf.get("auron.memory.spill.min.trigger.bytes"))
+
+
+def query_budget_bytes() -> int:
+    """Per-query budget (0 = per-query enforcement off; the ledger is
+    maintained regardless)."""
+    return int(conf.get("auron.memory.query.budget.bytes"))
+
+
+def kill_grace_spills() -> int:
+    return int(conf.get("auron.memory.query.kill.grace.spills"))
+
+
+# -- overload hooks (module-level: survive reset_manager) -------------------
+#
+# kill hook: invoked OUTSIDE the manager lock with (query_id, reason)
+# when an over-budget query has exhausted its spill grace.  The default
+# routes through the task pool's preemption path; the serving scheduler
+# turns the resulting QueryCancelled into a requeue.
+#
+# pressure hook: (callback, fraction) — invoked OUTSIDE the manager lock
+# with (total_used, effective_budget) whenever an accounting update
+# leaves pool usage above fraction * effective budget.  The serving
+# scheduler installs this to drive watermark preemption without polling.
+
+_KILL_HOOK: Optional[Callable[[str, str], None]] = None
+_PRESSURE_HOOK: Optional[Tuple[Callable[[int, int], None], float]] = None
+
+
+def _default_kill_hook(query_id: str, reason: str) -> None:
+    from auron_tpu.runtime import task_pool
+    task_pool.preempt_query(query_id, reason)
+
+
+def set_kill_hook(fn: Optional[Callable[[str, str], None]]) -> None:
+    """Override how over-budget queries are killed (None restores the
+    task-pool preemption default)."""
+    global _KILL_HOOK
+    _KILL_HOOK = fn
+
+
+def set_pressure_hook(fn: Callable[[int, int], None],
+                      fraction: float) -> None:
+    global _PRESSURE_HOOK
+    _PRESSURE_HOOK = (fn, float(fraction))
+
+
+def clear_pressure_hook(fn: Optional[Callable[[int, int], None]] = None
+                        ) -> None:
+    """Remove the pressure hook (only if it is `fn`, when given — a
+    shut-down scheduler must not uninstall its successor's hook)."""
+    global _PRESSURE_HOOK
+    if fn is None or (_PRESSURE_HOOK is not None
+                      and _PRESSURE_HOOK[0] is fn):
+        _PRESSURE_HOOK = None
 
 
 def watermark_fractions() -> List[float]:
@@ -79,6 +147,7 @@ class MemConsumer:
         self.mem_peak = 0
         self._manager: Optional["MemManager"] = None
         self._metrics = None   # MetricNode sink for mem_peak (ops/base)
+        self._query_id: Optional[str] = None   # set at register time
 
     def bind_metrics(self, node) -> None:
         """Attach the operator's MetricNode: on unregister the manager
@@ -121,6 +190,10 @@ class MemManager:
     # bounded attribution ring: enough to see a whole spill storm, small
     # enough that accounting can stay always-on
     MAX_SPILL_RECORDS = 256
+    # bounded per-query ledger: drained (used == 0) entries are evicted
+    # oldest-first past this, so a long-lived serving process never
+    # grows the ledger without bound
+    MAX_QUERY_LEDGER = 256
 
     def __init__(self, budget_bytes: Optional[int] = None):
         # re-entrancy DECLARED (the PR 5 scar made it explicit): a
@@ -149,6 +222,11 @@ class MemManager:
         self._spill_hist = [0] * (len(SPILL_HIST_BOUNDS) + 1)
         # cumulative per-consumer-name stats, surviving unregistration
         self._by_name: Dict[str, Dict[str, int]] = {}
+        # per-QUERY ledger: usage/peak/spills keyed by the query id the
+        # consumer was registered under (insertion-ordered; drained
+        # entries are pruned past MAX_QUERY_LEDGER)
+        self._queries: Dict[str, Dict[str, int]] = {}
+        self._killed_queries: set = set()   # kill hook fired once per id
 
     @staticmethod
     def _default_budget() -> int:
@@ -195,8 +273,14 @@ class MemManager:
     # -- consumer registry -------------------------------------------------
 
     def register_consumer(self, consumer: MemConsumer) -> MemConsumer:
+        # the consumer is charged to the AMBIENT query (the task thread
+        # carries the query's context — the PR 6 attribution contract);
+        # read outside the lock, one contextvar access
+        from auron_tpu.runtime import tracing
+        qid = tracing.current_query_id()
         with self._lock:
             consumer._manager = self
+            consumer._query_id = qid
             # spill() mutates operator internals, so only the thread
             # running the operator's task may invoke it (parallel
             # partition tasks each register their own consumers)
@@ -207,12 +291,31 @@ class MemManager:
                                 "spills": 0, "freed_bytes": 0,
                                 "wall_ns": 0})
             ent["registrations"] += 1
+            if qid is not None:
+                self._query_ent_locked(qid)
         return consumer
+
+    def _query_ent_locked(self, qid: str) -> Dict[str, int]:
+        ent = self._queries.get(qid)
+        if ent is None:
+            ent = self._queries[qid] = {"used": 0, "peak": 0,
+                                        "spills": 0, "kills": 0}
+            if len(self._queries) > self.MAX_QUERY_LEDGER:
+                for old, old_ent in list(self._queries.items()):
+                    if old_ent["used"] == 0 and old != qid:
+                        del self._queries[old]
+                        self._killed_queries.discard(old)
+                        if len(self._queries) <= self.MAX_QUERY_LEDGER:
+                            break
+        return ent
 
     def unregister_consumer(self, consumer: MemConsumer) -> None:
         with self._lock:
             if consumer in self._consumers:
                 self.total_used -= consumer.mem_used
+                qid = consumer._query_id
+                if qid is not None and qid in self._queries:
+                    self._queries[qid]["used"] -= consumer.mem_used
                 consumer.mem_used = 0
                 consumer._manager = None
                 self._consumers.remove(consumer)
@@ -271,6 +374,8 @@ class MemManager:
                 ent["spills"] += 1
                 ent["freed_bytes"] += rec.freed_bytes
                 ent["wall_ns"] += rec.wall_ns
+            if target._query_id is not None:
+                self._query_ent_locked(target._query_id)["spills"] += 1
             self._spill_records.append(rec)
             if len(self._spill_records) > self.MAX_SPILL_RECORDS:
                 del self._spill_records[
@@ -322,10 +427,29 @@ class MemManager:
           the no-history fallback IS the classic largest-consumer pick.
         - ``largest``: the reference's pure largest-consumer policy
           (lib.rs:303-423).
+        - ``query``: prefer the consumer belonging to the most-over-
+          budget QUERY in the per-query ledger (overage against
+          `auron.memory.query.budget.bytes`; with no per-query budget
+          the ranking degrades to most-total-usage-per-query).  Ties
+          break by consumer size.  This is the overload-survival
+          policy: arbitration charges the query CAUSING the pressure,
+          not whichever consumer class spills fastest.
         """
-        if str(conf.get("auron.memory.spill.victim.strategy")) \
-                == "largest":
+        strategy = str(conf.get("auron.memory.spill.victim.strategy"))
+        if strategy == "largest":
             return max(candidates, key=lambda c: c.mem_used)
+        if strategy == "query":
+            qbudget = query_budget_bytes()
+
+            def q_rank(c: MemConsumer):
+                qid = c._query_id
+                if qid is None:
+                    # anonymous work sinks below every real query
+                    return (float("-inf"), c.mem_used, c.name)
+                used = self._queries.get(qid, {}).get("used", 0)
+                return (used - qbudget, c.mem_used, c.name)
+
+            return max(candidates, key=q_rank)
 
         def rank(c: MemConsumer):
             ent = self._by_name.get(c.name)
@@ -340,39 +464,74 @@ class MemManager:
     def update(self, consumer: MemConsumer, new_bytes: int) -> None:
         """Update usage; may synchronously trigger spills (of this consumer
         or a larger one) to stay under budget — the arbitration loop of
-        lib.rs:303-423."""
+        lib.rs:303-423, extended with per-query budgets: a query over
+        `auron.memory.query.budget.bytes` spills its OWN memory even
+        while the shared pool is under budget, and is killed past the
+        spill grace (`auron.memory.query.kill.grace.spills`)."""
         spill_target: Optional[MemConsumer] = None
         pressure: List[Dict] = []
+        fire_pressure: Optional[Tuple] = None
+        qid = consumer._query_id
+        qbudget = 0
         with self._lock:
-            self.total_used += new_bytes - consumer.mem_used
+            delta = new_bytes - consumer.mem_used
+            self.total_used += delta
             consumer.mem_used = new_bytes
             if new_bytes > consumer.mem_peak:
                 consumer.mem_peak = new_bytes
             if self.total_used > self.peak_used:
                 self.peak_used = self.total_used
+            if qid is not None and delta:
+                ent = self._query_ent_locked(qid)
+                ent["used"] += delta
+                if ent["used"] > ent["peak"]:
+                    ent["peak"] = ent["used"]
             pressure = self._check_watermarks(consumer)
-            if self.total_used > self.effective_budget and \
-                    not getattr(self._tls, "spilling", 0):
-                trigger = min_trigger_size()
-                # only consumers OWNED by this thread are safe to spill
-                # from here: spilling another task's operator mid-execute
-                # would race its buffered state (the reference's Wait arm
-                # covers the cross-task case; our degenerate form
-                # self-spills)
-                me = threading.get_ident()
-                candidates = [c for c in self._consumers
-                              if c.spillable and c.mem_used >= trigger and
-                              getattr(c, "_owner_thread", me) == me]
-                if candidates:
-                    spill_target = self._pick_spill_victim(candidates)
-                # else: over budget but nothing is big enough to bother —
-                # allow (reference returns Nothing below MIN_TRIGGER_SIZE)
+            hook = _PRESSURE_HOOK
+            if hook is not None:
+                eb = max(1, self.effective_budget)
+                if self.total_used > hook[1] * eb:
+                    fire_pressure = (hook[0], self.total_used, eb)
+            if not getattr(self._tls, "spilling", 0):
+                over_pool = self.total_used > self.effective_budget
+                qbudget = query_budget_bytes()
+                q_over = (qbudget > 0 and qid is not None and
+                          self._queries.get(qid, {}).get("used", 0)
+                          > qbudget)
+                if over_pool or q_over:
+                    trigger = min_trigger_size()
+                    # only consumers OWNED by this thread are safe to
+                    # spill from here: spilling another task's operator
+                    # mid-execute would race its buffered state (the
+                    # reference's Wait arm covers the cross-task case;
+                    # our degenerate form self-spills)
+                    me = threading.get_ident()
+                    candidates = [
+                        c for c in self._consumers
+                        if c.spillable and c.mem_used >= trigger and
+                        getattr(c, "_owner_thread", me) == me]
+                    if q_over and not over_pool:
+                        # per-query enforcement relieves the over-budget
+                        # query with ITS OWN memory — spilling a
+                        # neighbor would punish a query that is inside
+                        # its budget
+                        candidates = [c for c in candidates
+                                      if c._query_id == qid]
+                    if candidates:
+                        spill_target = self._pick_spill_victim(candidates)
+                    # else: over budget but nothing is big enough to
+                    # bother — allow (reference returns Nothing below
+                    # MIN_TRIGGER_SIZE)
         if pressure:
             from auron_tpu.runtime import tracing
             for p in pressure:
                 tracing.event("mem.pressure", cat="mem",
                               fraction=p["fraction"], used=p["used"],
                               budget=p["budget"], consumer=p["consumer"])
+        if fire_pressure is not None:
+            # outside the lock: the hook takes scheduler-side locks
+            fn, used, eb = fire_pressure
+            fn(used, eb)
         if spill_target is None:
             return
         # spill outside the lock (spill() re-enters update())
@@ -386,6 +545,43 @@ class MemManager:
             # counted (the num_spills bump sat on the arbitration path
             # only); _timed_spill attributes and counts both uniformly.
             self._timed_spill(consumer, consumer, "fallback")
+        if qbudget > 0 and qid is not None:
+            self._maybe_kill(qid, qbudget)
+
+    def _maybe_kill(self, qid: str, qbudget: int) -> None:
+        """After a spill, kill the query if it remains over its budget
+        past the spill grace (decision under the lock, hook outside)."""
+        grace = kill_grace_spills()
+        if grace <= 0:
+            return
+        reason = None
+        with self._lock:
+            ent = self._queries.get(qid)
+            if (ent is not None and ent["used"] > qbudget and
+                    ent["spills"] >= grace and
+                    qid not in self._killed_queries):
+                self._killed_queries.add(qid)
+                ent["kills"] += 1
+                reason = (f"query memory budget exceeded: used "
+                          f"{ent['used']} > budget {qbudget} after "
+                          f"{ent['spills']} spill(s)")
+        if reason is not None:
+            hook = _KILL_HOOK or _default_kill_hook
+            hook(qid, reason)
+
+    # -- per-query ledger --------------------------------------------------
+
+    def query_usage(self, query_id: str) -> int:
+        with self._lock:
+            ent = self._queries.get(query_id)
+            return ent["used"] if ent is not None else 0
+
+    def query_ledger(self) -> Dict[str, Dict[str, int]]:
+        """Per-query usage/peak/spill/kill snapshot — the /memory view
+        of WHO holds the pool, and the preemption victim ranking's
+        overage source."""
+        with self._lock:
+            return {qid: dict(ent) for qid, ent in self._queries.items()}
 
     # -- snapshots ---------------------------------------------------------
 

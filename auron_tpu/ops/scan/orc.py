@@ -35,14 +35,20 @@ class OrcScanExec(Operator):
         if ctx.partition_id >= len(self.file_groups):
             return  # extra partitions are empty
         gi = ctx.partition_id
+        from auron_tpu.faults import fault_point
         from auron_tpu.ops.scan.parquet import _open_for_read
         for path in self.file_groups[gi].paths:
+            # outside the corrupted-file catch, mirroring the parquet
+            # scan: injected io faults go to the retry tier, they are
+            # never swallowed as skipped files
+            fault_point("scan.orc.open")
             try:
                 f = orc.ORCFile(_open_for_read(path))
             except Exception:
                 if conf.get("auron.ignore.corrupted.files"):
                     continue
                 raise
+            fault_point("scan.orc.read")
             tbl = f.read()
             out = self._evolve(tbl)
             for rb in out.to_batches(max_chunksize=batch_size()):

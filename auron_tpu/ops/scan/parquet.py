@@ -71,13 +71,20 @@ class ParquetScanExec(Operator):
         if self.predicate is not None and \
                 conf.get("auron.parquet.enable.page.filtering"):
             filt = expr_to_arrow_filter(self.predicate, self.file_schema)
+        from auron_tpu.faults import fault_point
         for path in group.paths:
+            # injectable site OUTSIDE the corrupted-file catch: an
+            # injected io fault must reach the retry tier (task replay),
+            # never be swallowed as a skipped "corrupted" file — that
+            # would silently change results under chaos
+            fault_point("scan.parquet.open")
             try:
                 pf = pq.ParquetFile(_open_for_read(path))
             except Exception:
                 if conf.get("auron.ignore.corrupted.files"):
                     continue
                 raise
+            fault_point("scan.parquet.read")
             row_groups = self._prune_row_groups(pf, filt)
             self.metrics.add("parquet_row_groups_pruned",
                              pf.num_row_groups - len(row_groups))

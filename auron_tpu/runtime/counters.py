@@ -36,6 +36,10 @@ _COUNTERS: Dict[str, int] = {
     "admission_queued": 0,
     "admission_shed": 0,
     "admission_degraded": 0,
+    # overload survival: preemptive kill-and-requeue (task_pool
+    # .preempt_query / QueryScheduler requeue path)
+    "preemptions": 0,
+    "requeues": 0,
 }
 
 

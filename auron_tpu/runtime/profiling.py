@@ -149,6 +149,7 @@ def _memory_snapshot(top_n: int = 10) -> dict:
     return {"pool": mgr.stats(),
             "consumers": mgr.consumer_snapshot(top_n),
             "consumer_totals": mgr.consumer_totals(),
+            "queries": mgr.query_ledger(),
             "spills": {"records": mgr.spill_records(),
                        "histogram": mgr.spill_histogram()}}
 
@@ -187,7 +188,8 @@ def _prometheus_text() -> str:
              help_=f"shared retry policy: {key}")
     for key in ("queries_submitted", "queries_cancelled",
                 "admission_admitted", "admission_queued",
-                "admission_shed", "admission_degraded"):
+                "admission_shed", "admission_degraded",
+                "preemptions", "requeues"):
         emit(f"auron_{key}_total", snap.get(key, 0),
              help_="serving tier: "
                    f"{key.replace('_', ' ')} count")
@@ -386,15 +388,20 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, code: int, body: bytes,
-              ctype: str = "application/json") -> None:
+              ctype: str = "application/json",
+              headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code: int, doc) -> None:
-        self._send(code, json.dumps(doc, default=str).encode())
+    def _send_json(self, code: int, doc,
+                   headers: Optional[dict] = None) -> None:
+        self._send(code, json.dumps(doc, default=str).encode(),
+                   headers=headers)
 
     # -- serving routes (POST /submit, /cancel/<id>) -----------------------
 
@@ -422,7 +429,17 @@ class _Handler(BaseHTTPRequestHandler):
                         priority=body.get("priority"),
                         query_id=body.get("query_id"))
                 except SubmissionRejected as e:
-                    self._send_json(429, {"error": str(e)})
+                    # shed: tell the client when the admission ledger
+                    # should have drained a wave (satellite of the
+                    # overload-survival layer)
+                    retry_after = getattr(e, "retry_after_s", None)
+                    doc = {"error": str(e)}
+                    headers = None
+                    if retry_after is not None:
+                        doc["retry_after_s"] = round(retry_after, 1)
+                        headers = {"Retry-After":
+                                   max(1, int(round(retry_after)))}
+                    self._send_json(429, doc, headers=headers)
                     return
                 except (ValueError, KeyError) as e:
                     # KeyError: unknown conf option in the overlay parse
@@ -513,9 +530,21 @@ class _Handler(BaseHTTPRequestHandler):
                 if st is None:
                     self._send_json(404, {"error": "unknown query id"})
                 elif st["state"] != "succeeded":
-                    self._send_json(409, {"error": f"query is "
-                                          f"{st['state']}, not "
-                                          f"succeeded", "status": st})
+                    doc = {"error": f"query is {st['state']}, not "
+                                    f"succeeded", "status": st}
+                    headers = None
+                    # in-flight states and admission timeouts are
+                    # worth retrying: hint when the ledger drains
+                    timed_out = (st["state"] == "failed" and
+                                 "admission timeout"
+                                 in str(st.get("error") or ""))
+                    if st["state"] in ("queued", "running") or timed_out:
+                        ra = sched.admission.drain_estimate_s(
+                            sched.stats().get("queued", 0))
+                        doc["retry_after_s"] = round(ra, 1)
+                        headers = {"Retry-After":
+                                   max(1, int(round(ra)))}
+                    self._send_json(409, doc, headers=headers)
                 else:
                     self._send_json(200, _result_payload(
                         sched.result(qid)))

@@ -60,6 +60,10 @@ _DETERMINISTIC_OSERRORS = (
 
 def is_retryable(exc: BaseException) -> bool:
     """The classification table (see module docstring)."""
+    if getattr(exc, "auron_deterministic", False):
+        return False      # declared never-retryable (QueryCancelled:
+        #                   a preempted query must not consume retry
+        #                   budgets — its requeue re-arms them fresh)
     if getattr(exc, "auron_retry_exhausted", False):
         return False      # an inner policy already spent the budget
     if getattr(exc, "auron_retryable", False):
@@ -78,6 +82,8 @@ def task_classify(exc: BaseException) -> bool:
     errors keep respecting the exhausted marker — the executor's inner
     re-executions already count as task attempts, so replaying them
     again would break the chaos sweep's attempts <= 3x bound."""
+    if getattr(exc, "auron_deterministic", False):
+        return False      # QueryCancelled-family: never a task replay
     if getattr(exc, "auron_retryable", False):
         return not getattr(exc, "auron_retry_exhausted", False)
     if isinstance(exc, _DETERMINISTIC_OSERRORS):

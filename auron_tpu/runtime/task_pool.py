@@ -58,7 +58,8 @@ from auron_tpu.runtime.retry import RetryPolicy, call_with_retry, \
 log = logging.getLogger("auron_tpu.runtime")
 
 __all__ = ["pool_size", "run_tasks", "QueryCancelled", "cancel_query",
-           "clear_cancelled", "is_cancelled", "shared_pool", "reset_pool"]
+           "clear_cancelled", "is_cancelled", "shared_pool", "reset_pool",
+           "preempt_query", "preempt_reason"]
 
 # key used for work submitted outside any query scope (direct
 # execute_plan calls, tests) — still fair-shared as one queue
@@ -66,8 +67,14 @@ _ANON = "_anon"
 
 
 class QueryCancelled(RuntimeError):
-    """The query owning this task was cancelled (serving /cancel).
-    Deterministic by classification: the task tier never retries it."""
+    """The query owning this task was cancelled (serving /cancel) or
+    preempted (overload kill-and-requeue).  Deterministic by
+    classification: the task tier never retries it, it never consumes
+    an `auron.task.retries` budget and never carries the
+    `auron_retry_exhausted` marker — a preempted query's requeued
+    re-execution starts with every retry budget intact."""
+
+    auron_deterministic = True   # runtime/retry.py early-out
 
 
 def pool_size() -> int:
@@ -91,6 +98,7 @@ def query_weight() -> int:
 # -- query-level cancellation (module-level: usable before/without a pool)
 
 _CANCELLED: Set[str] = set()
+_PREEMPTED: Dict[str, str] = {}   # query id -> preemption reason
 _CANCELLED_LOCK = lockcheck.Lock("pool.cancelled")
 
 
@@ -104,9 +112,41 @@ def cancel_query(query_id: str) -> None:
         pool.kick()
 
 
+def preempt_query(query_id: str, reason: str) -> bool:
+    """Preempt a running query: same fast-fail cancellation path as
+    cancel_query, but tagged with a reason so the serving scheduler
+    REQUEUES the submission instead of finishing it as cancelled (the
+    overload kill-and-requeue arm; memmgr's over-budget kill hook and
+    the scheduler's watermark preemption both land here).  Returns
+    False when the id is already preempted/cancelled (idempotent —
+    counted once)."""
+    from auron_tpu.runtime import counters
+    with _CANCELLED_LOCK:
+        if query_id in _CANCELLED:
+            return False
+        _CANCELLED.add(query_id)
+        _PREEMPTED[query_id] = reason
+    counters.bump("preemptions")
+    log.info("preempting query %s: %s", query_id, reason)
+    pool = _POOL
+    if pool is not None:
+        pool.kick()
+    return True
+
+
+def preempt_reason(query_id: Optional[str]) -> Optional[str]:
+    """The preemption reason for a cancelled query id, or None for a
+    plain cancellation / unknown id."""
+    if query_id is None:
+        return None
+    with _CANCELLED_LOCK:
+        return _PREEMPTED.get(query_id)
+
+
 def clear_cancelled(query_id: str) -> None:
     with _CANCELLED_LOCK:
         _CANCELLED.discard(query_id)
+        _PREEMPTED.pop(query_id, None)
 
 
 def is_cancelled(query_id: Optional[str]) -> bool:
@@ -377,6 +417,21 @@ def _current_key() -> str:
     return tracing.current_query_id() or _ANON
 
 
+def _cancelled_error(key: str) -> QueryCancelled:
+    """Build the QueryCancelled to ferry for `key`, emitting the
+    `query.preempt` trace event when the cancellation is a preemption
+    (the raise runs in the victim's context, so the event lands in the
+    victim's own recorder)."""
+    from auron_tpu.runtime import tracing
+    reason = preempt_reason(key)
+    if reason is not None:
+        tracing.event("query.preempt", cat="query", query_id=key,
+                      reason=reason)
+        return QueryCancelled(
+            f"query {key!r} preempted: {reason}")
+    return QueryCancelled(f"query {key!r} cancelled")
+
+
 def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
               prefix: str = "auron-task",
               retry_policy: Optional[RetryPolicy] = None) -> List[Any]:
@@ -398,7 +453,7 @@ def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
 
     key = _current_key()
     if is_cancelled(key):
-        raise QueryCancelled(f"query {key!r} cancelled")
+        raise _cancelled_error(key)
     size = pool_size()
     pool = _POOL
     if len(items) <= 1 or size <= 1 or \
@@ -409,7 +464,7 @@ def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
         out = []
         for item in items:
             if is_cancelled(key):
-                raise QueryCancelled(f"query {key!r} cancelled")
+                raise _cancelled_error(key)
             out.append(run(item))
         return out
 
@@ -421,5 +476,9 @@ def run_tasks(fn: Callable[[Any], Any], items: Sequence[Any],
     finally:
         pool.finish(key)
     if group.first_err is not None:
+        if isinstance(group.first_err, QueryCancelled):
+            # re-derive on THIS (the caller's) context so a preemption
+            # is visible as a query.preempt event in the victim's trace
+            raise _cancelled_error(key) from group.first_err
         raise group.first_err
     return group.results

@@ -441,6 +441,10 @@ class QueryRecord:
     attempts: int = 0
     retries: int = 0
     fallbacks: int = 0
+    # times this submission was preempted (kill-and-requeue) before the
+    # run this record describes; patched by the serving scheduler, 0
+    # for direct session executes
+    preemptions: int = 0
     error: Optional[str] = None
     started_at: float = 0.0
     metric_totals: Dict[str, int] = field(default_factory=dict)
@@ -458,7 +462,8 @@ class QueryRecord:
         d = {"query_id": self.query_id, "wall_s": round(self.wall_s, 4),
              "rows": self.rows, "spmd": self.spmd,
              "attempts": self.attempts, "retries": self.retries,
-             "fallbacks": self.fallbacks, "error": self.error,
+             "fallbacks": self.fallbacks,
+             "preemptions": self.preemptions, "error": self.error,
              "started_at": self.started_at, "traced": self.trace is not None,
              "mem_peak": self.mem_peak, "mem_spills": self.mem_spills,
              "mem_spill_bytes": self.mem_spill_bytes,

@@ -17,6 +17,12 @@ session-server form.
   the forecasts (PR 5's accounting layer closing its loop).
 - fair-share task scheduling itself lives in `runtime/task_pool.py`
   (per-query queues, weighted round-robin by `auron.query.priority`).
+- overload survival (PR 10): the scheduler preempts a running victim
+  on memory-watermark pressure and REQUEUES it (kill-and-requeue,
+  `auron.serving.preempt.*`), per-query budgets/kills live in
+  `memmgr/manager.py`, queued submissions age
+  (`auron.admission.aging.seconds`), and shed/timeout responses carry
+  `Retry-After` drain estimates.
 """
 
 from auron_tpu.serving.admission import AdmissionController

@@ -131,6 +131,25 @@ class AdmissionController:
             QUEUE, forecast,
             reason=f"ledger {held} + forecast {reserve} > cap {int(cap)}")
 
+    def drain_estimate_s(self, queue_len: int = 0) -> float:
+        """Seconds until the ledger has plausibly drained enough to
+        admit one more submission — the `Retry-After` hint on shed and
+        queue-timeout HTTP responses.  Estimate: the average wall time
+        of recently completed queries times the number of scheduling
+        'waves' ahead of the caller (running reservations + queue
+        depth over the concurrency), clamped to [1, 600]."""
+        import math
+
+        from auron_tpu.runtime import tracing
+        recent = [r.wall_s for r in tracing.query_history()[-8:]
+                  if r.wall_s > 0]
+        avg = sum(recent) / len(recent) if recent else 2.0
+        with self._lock:
+            held = len(self._held)
+        slots = max(1, int(conf.get("auron.serving.max.concurrent")))
+        waves = math.ceil((held + max(0, queue_len) + 1) / slots)
+        return max(1.0, min(600.0, avg * waves))
+
     def release(self, query_id: str) -> None:
         """Return the query's reservation to the pool (idempotent)."""
         from auron_tpu.memmgr import get_manager

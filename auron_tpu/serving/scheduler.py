@@ -13,6 +13,19 @@ States::
     queued -> running -> succeeded | failed | cancelled
     queued ----------------------------------^ (cancel while waiting)
     (submit) -> shed      (admission queue full — never started)
+    running -> queued     (PREEMPTED: kill-and-requeue under overload)
+
+Overload survival (this layer's half; memmgr/manager.py owns the
+per-query budgets): the scheduler installs a memory PRESSURE HOOK —
+when pool usage crosses `auron.serving.preempt.watermark` of the
+effective budget it selects a running victim (lowest effective
+priority, most over forecast), cancels it through the task pool's
+fast-fail path and REQUEUES the submission with its original conf
+overlay; re-execution is bit-identical to a solo run (the chaos
+contract).  Requeued and long-queued submissions age
+(`auron.admission.aging.seconds` bumps effective priority per waited
+interval, clamped) so a stream of high-priority arrivals cannot
+starve them.
 
 Isolation per query: the driver enters `conf.query_scoped(submission
 conf)` (contextvar overlay — other queries never see it) and executes
@@ -59,6 +72,9 @@ class Submission:
     state: str = QUEUED
     seq: int = 0
     submitted_at: float = field(default_factory=time.time)
+    # queue-entry time: == submitted_at at first, reset on requeue —
+    # the clock priority aging and the queue timeout run against
+    queued_since: float = 0.0
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
     forecast_bytes: int = 0
@@ -69,20 +85,41 @@ class Submission:
     wall_s: float = 0.0
     result: Optional[object] = None   # pa.Table on success
     mem_peak: int = 0
+    num_preemptions: int = 0      # kill-and-requeue count
     done: threading.Event = field(default_factory=threading.Event)
+
+    def __post_init__(self) -> None:
+        if not self.queued_since:
+            self.queued_since = self.submitted_at
+
+    def effective_priority(self, aging_s: float,
+                           now: Optional[float] = None) -> int:
+        """Declared priority plus one per full `aging_s` interval waited
+        in the queue (clamped to the task pool's weight ceiling of 64);
+        aging off (<= 0) returns the declared priority."""
+        if aging_s <= 0:
+            return self.priority
+        waited = (now if now is not None else time.time()) \
+            - self.queued_since
+        return min(64, self.priority + max(0, int(waited / aging_s)))
 
     def status(self) -> Dict[str, Any]:
         waited = (self.started_at or self.finished_at or time.time()) \
             - self.submitted_at
+        aging = float(config.conf.get("auron.admission.aging.seconds"))
         return {"query_id": self.query_id, "state": self.state,
-                "priority": self.priority, "signature": self.signature,
+                "priority": self.priority,
+                "effective_priority": self.effective_priority(aging),
+                "signature": self.signature,
                 "submitted_at": self.submitted_at,
                 "queue_wait_s": round(max(0.0, waited), 4),
                 "forecast_bytes": self.forecast_bytes,
                 "degraded_serial": self.serial,
                 "admission": self.admission_reason,
                 "rows": self.rows, "wall_s": round(self.wall_s, 4),
-                "mem_peak": self.mem_peak, "error": self.error}
+                "mem_peak": self.mem_peak,
+                "preemptions": self.num_preemptions,
+                "error": self.error}
 
 
 def default_session_factory():
@@ -107,6 +144,16 @@ class QueryScheduler:
         self._running = 0
         self._seq = 0
         self._shutdown = False
+        self._last_preempt = 0.0   # monotonic; preemption cooldown
+        # watermark preemption: the memory manager calls _on_pressure
+        # (outside its lock) whenever an accounting update leaves pool
+        # usage above watermark * effective budget; <= 0 disables.
+        # Last-constructed scheduler wins the hook; shutdown() releases
+        # it (only if still ours).
+        frac = float(config.conf.get("auron.serving.preempt.watermark"))
+        if frac > 0:
+            from auron_tpu.memmgr import manager as mem_manager
+            mem_manager.set_pressure_hook(self._on_pressure, frac)
 
     # -- submission --------------------------------------------------------
 
@@ -143,7 +190,12 @@ class QueryScheduler:
                 self._subs[qid] = sub
                 counters.bump("admission_shed")
                 self.admission.events["shed"] += 1
-                raise SubmissionRejected(sub.error)
+                exc = SubmissionRejected(sub.error)
+                # Retry-After hint for the 429: how long until the
+                # admission ledger has likely drained one wave
+                exc.retry_after_s = self.admission.drain_estimate_s(
+                    len(self._queue))
+                raise exc
             self._seq += 1
             sub.seq = self._seq
             self._subs[qid] = sub
@@ -160,16 +212,28 @@ class QueryScheduler:
             with self._lock:
                 if self._shutdown or not self._queue:
                     return
+                # expire BEFORE the concurrency check: a queued
+                # submission times out on schedule even while every
+                # driver slot is busy (its /status and /result flip to
+                # the timeout failure immediately, not when a slot
+                # happens to free up)
+                self._expire_locked()
+                if not self._queue:
+                    return
                 max_conc = int(config.conf.get(
                     "auron.serving.max.concurrent"))
                 if self._running >= max_conc:
                     return
-                self._expire_locked()
-                if not self._queue:
-                    return
-                # highest priority first, FIFO within a priority
+                # highest EFFECTIVE priority first (declared priority +
+                # aging, so requeued/long-queued submissions climb past
+                # fresher high-priority arrivals), FIFO within a level
+                aging = float(config.conf.get(
+                    "auron.admission.aging.seconds"))
+                now = time.time()
                 head = min(self._queue,
-                           key=lambda s: (-s.priority, s.seq))
+                           key=lambda s: (-s.effective_priority(aging,
+                                                                now),
+                                          s.seq))
                 decision = self.admission.offer(
                     head.query_id, head.signature,
                     queue_len=len(self._queue) - 1,
@@ -199,7 +263,7 @@ class QueryScheduler:
             return
         now = time.time()
         for sub in list(self._queue):
-            if now - sub.submitted_at > timeout:
+            if now - sub.queued_since > timeout:
                 self._queue.remove(sub)
                 sub.state = FAILED
                 sub.error = f"admission timeout after {timeout:g}s"
@@ -209,7 +273,7 @@ class QueryScheduler:
     # -- driver thread -----------------------------------------------------
 
     def _drive(self, sub: Submission) -> None:
-        from auron_tpu.runtime import counters
+        from auron_tpu.runtime import counters, tracing
         from auron_tpu.runtime.explain_analyze import metric_max
         overlay = dict(sub.conf)
         overlay["auron.query.priority"] = sub.priority
@@ -218,6 +282,7 @@ class QueryScheduler:
             # footprint (one partition at a time, no SPMD program)
             overlay["auron.task.parallelism"] = 1
             overlay["auron.spmd.singleDevice.enable"] = False
+        requeue = False
         try:
             session = self._session_factory()
             with config.conf.query_scoped(overlay):
@@ -230,21 +295,116 @@ class QueryScheduler:
             if sub.mem_peak:
                 self.admission.observe(sub.signature, sub.mem_peak)
         except task_pool.QueryCancelled:
-            sub.state = CANCELLED
-            sub.error = "cancelled"
-            counters.bump("queries_cancelled")
+            reason = task_pool.preempt_reason(sub.query_id)
+            if reason is not None:
+                # PREEMPTED (watermark pressure / over-budget kill) —
+                # requeue with the ORIGINAL conf overlay and priority:
+                # the re-execution is a fresh session over the same
+                # plan, bit-identical to a solo run.  Past the per-
+                # query cap the kill is final (forward progress).
+                sub.num_preemptions += 1
+                cap = int(config.conf.get(
+                    "auron.serving.preempt.max.per.query"))
+                if sub.num_preemptions <= cap:
+                    requeue = True
+                    log.info("query %s preempted (%d/%d): %s — "
+                             "requeueing", sub.query_id,
+                             sub.num_preemptions, cap, reason)
+                else:
+                    sub.state = FAILED
+                    sub.error = (f"killed after {sub.num_preemptions} "
+                                 f"preemptions: {reason}")
+                    log.warning("query %s %s", sub.query_id, sub.error)
+            else:
+                sub.state = CANCELLED
+                sub.error = "cancelled"
+                counters.bump("queries_cancelled")
         except BaseException as e:  # noqa: BLE001 - one red row
             sub.state = FAILED
             sub.error = f"{type(e).__name__}: {str(e)[:500]}"
             log.warning("query %s failed: %s", sub.query_id, sub.error)
         finally:
-            sub.finished_at = time.time()
+            # reservation released and the cancel/preempt mark cleared
+            # BEFORE a requeue makes the submission runnable again —
+            # a requeued run must start with a clean slate
             self.admission.release(sub.query_id)
             task_pool.clear_cancelled(sub.query_id)
+            rec = tracing.find_query(sub.query_id)
+            if rec is not None:
+                # surface the kill-and-requeue count on the /queries row
+                rec.preemptions = sub.num_preemptions
             with self._lock:
                 self._running -= 1
-            sub.done.set()
+                if requeue and not self._shutdown:
+                    sub.state = QUEUED
+                    sub.started_at = None
+                    sub.error = None
+                    sub.admission_reason = ""   # fresh admission pass
+                    sub.queued_since = time.time()
+                    self._queue.append(sub)
+                elif requeue:
+                    # shut down between kill and requeue: terminal
+                    requeue = False
+                    sub.state = CANCELLED
+                    sub.error = "scheduler shut down during requeue"
+            if requeue:
+                counters.bump("requeues")
+            else:
+                sub.finished_at = time.time()
+                sub.done.set()
             self._pump()
+
+    # -- watermark preemption ----------------------------------------------
+
+    def _on_pressure(self, total_used: int, effective_budget: int) -> None:
+        """Memory-manager pressure hook (called OUTSIDE the manager
+        lock on whatever thread's accounting update crossed the
+        watermark): select a running victim — lowest effective
+        priority first, most over forecast within a level — and
+        preempt it through the task pool's fast-fail path.  The
+        driver thread turns the resulting QueryCancelled into a
+        requeue (_drive)."""
+        if self._shutdown:
+            return
+        now = time.monotonic()
+        cooldown = float(config.conf.get(
+            "auron.serving.preempt.cooldown.seconds"))
+        if now - self._last_preempt < cooldown:
+            return   # cheap early-out before taking any lock
+        victim: Optional[Submission] = None
+        with self._lock:
+            if self._shutdown or now - self._last_preempt < cooldown:
+                return
+            running = [s for s in self._subs.values()
+                       if s.state == RUNNING and not s.done.is_set()]
+            if len(running) < 2:
+                # preempting the only running query cannot relieve
+                # pressure — it would restart into the same pool
+                return
+            cap = int(config.conf.get(
+                "auron.serving.preempt.max.per.query"))
+            eligible = [s for s in running if s.num_preemptions < cap]
+            if not eligible:
+                return
+            from auron_tpu.memmgr import get_manager
+            ledger = get_manager().query_ledger()
+
+            def overage(s: Submission) -> int:
+                return ledger.get(s.query_id, {}).get("used", 0) \
+                    - s.forecast_bytes
+
+            aging = float(config.conf.get(
+                "auron.admission.aging.seconds"))
+            victim = min(eligible,
+                         key=lambda s: (s.effective_priority(aging),
+                                        -overage(s), -s.seq))
+            self._last_preempt = now
+        # outside the scheduler lock: preempt_query takes the pool's
+        # cancellation lock and kicks the workers
+        task_pool.preempt_query(
+            victim.query_id,
+            f"memory pressure: pool {total_used}B over watermark of "
+            f"effective budget {effective_budget}B")
 
     # -- client surface ----------------------------------------------------
 
@@ -307,18 +467,23 @@ class QueryScheduler:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             states: Dict[str, int] = {}
+            preemptions = 0
             for sub in self._subs.values():
                 states[sub.state] = states.get(sub.state, 0) + 1
+                preemptions += sub.num_preemptions
             queued = len(self._queue)
             running = self._running
         pool = task_pool._POOL
         return {"queued": queued, "running": running, "states": states,
+                "preemptions": preemptions,
                 "admission": self.admission.snapshot(),
                 "task_queues": pool.queue_snapshot()
                 if pool is not None else {}}
 
     def shutdown(self, wait: bool = False,
                  timeout: float = 30.0) -> None:
+        from auron_tpu.memmgr import manager as mem_manager
+        mem_manager.clear_pressure_hook(self._on_pressure)
         with self._lock:
             self._shutdown = True
             for sub in self._queue:

@@ -9,7 +9,9 @@ handler) into a query-submission surface backed by a QueryScheduler:
   dict>}`` (frontend/foreign.py serde) or ``{"corpus": "q01", "sf":
   0.01}`` (an IT-corpus query over a process-cached generated catalog),
   plus optional ``"conf"`` (per-query overrides, applied context-locally)
-  and ``"priority"``.  Replies ``{"query_id": ...}``; 429 when shed.
+  and ``"priority"``.  Replies ``{"query_id": ...}``; 429 when shed,
+  carrying a ``Retry-After`` header from the admission ledger's drain
+  estimate (queue-timeout ``/result`` 409s carry it too).
 - ``GET /status/<id>``    — submission state + admission info.
 - ``GET /result/<id>``    — result rows as JSON (capped by
   ``auron.serving.result.max.rows``).

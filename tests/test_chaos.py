@@ -82,6 +82,64 @@ def test_chaos_sweep_op_device_fault_retries(catalog):
     assert report.num_retries >= 1, report.render()
 
 
+def test_chaos_sweep_scan_faults_identical(catalog):
+    """PR 2 follow-up closed: the parquet reader carries named
+    fault_point sites (scan.parquet.open / scan.parquet.read — OUTSIDE
+    the corrupted-file catch, so injected io faults reach the retry
+    tier instead of being swallowed as skipped files).  A latency +
+    io-faulted scan profile must still produce bit-identical results
+    with the delays only visible as wall time."""
+    report = chaos_sweep(
+        ["q42"], catalog,
+        "scan.parquet.open:io:p=0.3,max=4,seed=3;"
+        "scan.parquet.read:latency:p=0.5,seed=9,ms=2")
+    assert report.ok, report.render()
+    assert report.injected_total() > 0, report.render()
+    assert all(r.identical for r in report.results), report.render()
+    assert report.num_retries > 0, report.render()
+
+
+def test_orc_scan_fault_sites_armed(tmp_path):
+    """The orc reader's named sites (scan.orc.open / scan.orc.read)
+    inject like every other fault point: io raises a retryable
+    InjectedIOError, latency sleeps and leaves the rows identical."""
+    import pyarrow as pa
+    from pyarrow import orc
+
+    from auron_tpu import faults
+    from auron_tpu.config import conf
+    from auron_tpu.ir.plan import FileGroup
+    from auron_tpu.ir.schema import DataType, Field, Schema
+    from auron_tpu.ops.base import TaskContext
+    from auron_tpu.ops.scan.orc import OrcScanExec
+
+    path = str(tmp_path / "t.orc")
+    orc.write_table(pa.table({"x": list(range(10))}), path)
+    schema = Schema((Field("x", DataType.int64()),))
+
+    def scan_rows():
+        op = OrcScanExec(schema, (FileGroup(paths=(path,)),))
+        return [r for b in op.execute(TaskContext())
+                for r in b.to_arrow().to_pylist()]
+
+    baseline = scan_rows()
+    assert [r["x"] for r in baseline] == list(range(10))
+
+    io_spec = "scan.orc.open:io:p=1,max=1,seed=1"
+    faults.reset(io_spec)
+    with conf.scoped({"auron.faults.spec": io_spec}):
+        with pytest.raises(faults.InjectedIOError):
+            scan_rows()
+        assert scan_rows() == baseline       # max=1: replay recovers
+
+    lat_spec = "scan.orc.read:latency:p=1,max=2,seed=1,ms=1"
+    faults.reset(lat_spec)
+    with conf.scoped({"auron.faults.spec": lat_spec}):
+        assert scan_rows() == baseline       # slowness, not failure
+    assert faults.registry_for(lat_spec).injected_total() > 0
+    faults.reset()
+
+
 @pytest.mark.slow
 def test_chaos_sweep_tier1_subset_p005(catalog):
     """The acceptance-gate sweep: the tier-1 TPC-DS subset under p=0.05

@@ -133,7 +133,11 @@ def sum_agg(name="s", child="v", dtype=DataType.int64()):
     return AggExpr(fn="sum", children=(col(child),), return_type=dtype)
 
 
+@pytest.mark.slow
 def test_agg_single_mode():
+    # PR 10 tier-1 re-split: 12.2s measured — nightly slow lane (the
+    # partial/final pipeline test + the TPC-DS subset keep single-agg
+    # kernels covered in tier-1)
     rows = [{"k": i % 7, "v": i} for i in range(1000)]
     a = AggExec(scan_of(rows), "single", [col("k")], ["k"],
                 [AggExpr(fn="sum", children=(col("v"),),

@@ -1,0 +1,738 @@
+"""Overload-survival tests (the PR 10 layer):
+
+- per-query memory ledger + budgets inside MemManager (consumers carry
+  the ambient query tag, a query over `auron.memory.query.budget.bytes`
+  spills its OWN memory even under a healthy pool, and is KILLED past
+  the spill grace),
+- the `query` spill-victim strategy (arbitration charges the most-over-
+  budget query, not the global best-rate consumer),
+- preemptive kill-and-requeue: `task_pool.preempt_query` -> the
+  scheduler requeues the submission with its original conf overlay;
+  preemption counters/trace events/QueryRecord.preemptions surface it,
+- requeue-vs-retry accounting: QueryCancelled is deterministic — it
+  never consumes an `auron.task.retries` budget and never carries the
+  `auron_retry_exhausted` marker,
+- priority aging (`auron.admission.aging.seconds`) so requeued and
+  long-queued submissions cannot starve,
+- `Retry-After` on shed / queue-timeout HTTP responses,
+- THE acceptance stress: 10 concurrent fault-injected queries under a
+  budget tight enough to force >= 1 preemption — every result
+  bit-identical to its solo fault-free run, every reservation released,
+  no leaked consumers, all driver threads joined.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu.config import conf
+from auron_tpu.it.datagen import generate
+from auron_tpu.memmgr import manager as mem_manager
+from auron_tpu.memmgr.manager import MemConsumer, reset_manager
+from auron_tpu.runtime import counters, task_pool, tracing
+from auron_tpu.runtime.task_pool import QueryCancelled, run_tasks
+from auron_tpu.serving import QueryScheduler, QueryServer, register_catalog
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    cat = generate(str(tmp_path_factory.mktemp("overload_tpcds")), sf=SF,
+                   fact_chunks=3)
+    register_catalog(SF, cat)
+    return cat
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    """Overload tests mutate process singletons; leave clean defaults
+    behind (incl. the memmgr kill/pressure hooks)."""
+    yield
+    from auron_tpu import faults
+    faults.reset()
+    mem_manager.set_kill_hook(None)
+    mem_manager.clear_pressure_hook()
+    reset_manager()
+    task_pool.reset_pool()
+
+
+def _canon(table: pa.Table) -> pa.Table:
+    t = table.combine_chunks()
+    if t.num_rows and t.num_columns:
+        t = t.sort_by([(n, "ascending") for n in t.column_names])
+    return t
+
+
+class _Spilly(MemConsumer):
+    """Spills everything it holds."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.spill_calls = 0
+
+    def spill(self) -> int:
+        self.spill_calls += 1
+        freed = self.mem_used
+        self.update_mem_used(0)
+        return freed
+
+
+class _Sticky(MemConsumer):
+    """Spills nothing (a consumer with no reclaimable state)."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.spill_calls = 0
+
+    def spill(self) -> int:
+        self.spill_calls += 1
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# per-query ledger + budgets (memmgr/manager.py)
+# ---------------------------------------------------------------------------
+
+def test_query_ledger_tracks_usage_peak_and_drain():
+    mgr = reset_manager(1 << 30)
+    with tracing.trace_scope("qled"):
+        c = MemConsumer("op", spillable=False)
+        mgr.register_consumer(c)
+        c.update_mem_used(1000)
+        c.update_mem_used(700)
+    # the consumer keeps its tag after the scope exits
+    c.update_mem_used(400)
+    ledger = mgr.query_ledger()
+    assert ledger["qled"]["used"] == 400
+    assert ledger["qled"]["peak"] == 1000
+    assert mgr.query_usage("qled") == 400
+    mgr.unregister_consumer(c)
+    assert mgr.query_usage("qled") == 0
+    # anonymous consumers never enter the ledger
+    a = MemConsumer("anon", spillable=False)
+    mgr.register_consumer(a)
+    a.update_mem_used(50)
+    assert set(mgr.query_ledger()) == {"qled"}
+    mgr.unregister_consumer(a)
+
+
+def test_query_budget_spills_own_consumer_under_healthy_pool():
+    """A query over its per-query budget spills its OWN memory even when
+    the shared pool is far under budget — and never a neighbor inside
+    its budget."""
+    mgr = reset_manager(1 << 30)
+    with conf.scoped({"auron.memory.query.budget.bytes": 1000,
+                      "auron.memory.spill.min.trigger.bytes": 1,
+                      "auron.memory.query.kill.grace.spills": 0}):
+        with tracing.trace_scope("qneighbor"):
+            b = _Spilly("b")
+            mgr.register_consumer(b)
+            b.update_mem_used(500)         # inside budget
+        with tracing.trace_scope("qbig"):
+            a = _Spilly("a")
+            mgr.register_consumer(a)
+            a.update_mem_used(2000)        # over the per-query budget
+    assert a.spill_calls == 1
+    assert b.spill_calls == 0
+    assert mgr.query_usage("qbig") == 0
+    assert mgr.query_usage("qneighbor") == 500
+    assert mgr.num_spills == 1
+    mgr.unregister_consumer(a)
+    mgr.unregister_consumer(b)
+
+
+def test_query_budget_zero_disables_enforcement():
+    mgr = reset_manager(1 << 30)
+    with conf.scoped({"auron.memory.spill.min.trigger.bytes": 1}):
+        with tracing.trace_scope("qfree"):
+            a = _Spilly("a")
+            mgr.register_consumer(a)
+            a.update_mem_used(10 << 20)
+    assert a.spill_calls == 0              # ledgered, not enforced
+    assert mgr.query_usage("qfree") == 10 << 20
+    mgr.unregister_consumer(a)
+
+
+def test_query_kill_fires_once_past_grace():
+    mgr = reset_manager(1 << 30)
+    kills = []
+    mem_manager.set_kill_hook(lambda qid, why: kills.append((qid, why)))
+    with conf.scoped({"auron.memory.query.budget.bytes": 1000,
+                      "auron.memory.spill.min.trigger.bytes": 1,
+                      "auron.memory.query.kill.grace.spills": 2}):
+        with tracing.trace_scope("qkill"):
+            c = _Sticky("s")
+            mgr.register_consumer(c)
+            c.update_mem_used(2000)        # spill #1 (frees nothing)
+            assert kills == []             # inside grace
+            c.update_mem_used(2100)        # spill #2 -> grace exhausted
+        assert len(kills) == 1
+        qid, why = kills[0]
+        assert qid == "qkill" and "budget" in why
+        with tracing.trace_scope("qkill"):
+            c.update_mem_used(2200)        # still over: no second kill
+        assert len(kills) == 1
+    assert mgr.query_ledger()["qkill"]["kills"] == 1
+    mgr.unregister_consumer(c)
+
+
+def test_query_victim_strategy_ranks_by_overage():
+    mgr = reset_manager(1 << 30)
+    with tracing.trace_scope("qA"):
+        a = _Spilly("a")
+        mgr.register_consumer(a)
+        a.update_mem_used(300)
+    with tracing.trace_scope("qB"):
+        b = _Spilly("b")
+        mgr.register_consumer(b)
+        b.update_mem_used(400)
+    anon = _Spilly("anon")
+    mgr.register_consumer(anon)
+    anon.update_mem_used(10_000)           # huge but query-less
+    with conf.scoped({"auron.memory.spill.victim.strategy": "query"}):
+        # no per-query budget: overage degrades to per-query usage;
+        # anonymous consumers sink below every real query
+        assert mgr._pick_spill_victim([a, b, anon]) is b
+        with conf.scoped({"auron.memory.query.budget.bytes": 350}):
+            # qA overage -50, qB overage +50
+            assert mgr._pick_spill_victim([a, b]) is b
+    # default 'rate' strategy still works with the ledger present
+    assert mgr._pick_spill_victim([a, b]) in (a, b)
+    for c in (a, b, anon):
+        mgr.unregister_consumer(c)
+
+
+def test_query_strategy_arbitration_end_to_end():
+    """Pool pressure with the `query` strategy spills a consumer of the
+    most-over-budget query."""
+    mgr = reset_manager(1000)
+    with conf.scoped({"auron.memory.spill.victim.strategy": "query",
+                      "auron.memory.spill.min.trigger.bytes": 1,
+                      "auron.memory.query.kill.grace.spills": 0}):
+        with tracing.trace_scope("qsmall"):
+            a = _Spilly("a")
+            mgr.register_consumer(a)
+            a.update_mem_used(400)
+        with tracing.trace_scope("qlarge"):
+            b = _Spilly("b")
+            mgr.register_consumer(b)
+            b.update_mem_used(700)         # pool 1100 > 1000: arbitrate
+    assert b.spill_calls == 1 and a.spill_calls == 0
+    mgr.unregister_consumer(a)
+    mgr.unregister_consumer(b)
+
+
+# ---------------------------------------------------------------------------
+# preemption plumbing (task_pool + retry accounting)
+# ---------------------------------------------------------------------------
+
+def test_preempt_query_idempotent_and_counted():
+    p0 = counters.get("preemptions")
+    assert task_pool.preempt_query("qp1", "pressure") is True
+    assert task_pool.preempt_query("qp1", "again") is False
+    assert counters.get("preemptions") == p0 + 1
+    assert task_pool.is_cancelled("qp1")
+    assert task_pool.preempt_reason("qp1") == "pressure"
+    task_pool.clear_cancelled("qp1")
+    assert task_pool.preempt_reason("qp1") is None
+    assert not task_pool.is_cancelled("qp1")
+    # plain cancellation carries no preemption reason
+    task_pool.cancel_query("qp2")
+    assert task_pool.preempt_reason("qp2") is None
+    task_pool.clear_cancelled("qp2")
+
+
+def test_query_cancelled_is_deterministic_never_exhausted():
+    """Satellite pin: QueryCancelled consumes NO retry budget and never
+    trips the exhausted marker — a requeued query re-arms every tier
+    fresh."""
+    from auron_tpu.runtime.retry import (
+        RetryPolicy, call_with_retry, is_retryable, stats_snapshot,
+        task_classify,
+    )
+    exc = QueryCancelled("q")
+    assert not is_retryable(exc)
+    assert not task_classify(exc)
+    # the declaration beats even a (bogus) retryable flag
+    exc.auron_retryable = True
+    assert not is_retryable(exc)
+    assert not task_classify(exc)
+
+    calls = []
+    s0 = stats_snapshot()
+
+    def boom():
+        calls.append(1)
+        raise QueryCancelled("q")
+
+    with pytest.raises(QueryCancelled) as ei:
+        call_with_retry(boom, policy=RetryPolicy(max_attempts=5))
+    s1 = stats_snapshot()
+    assert len(calls) == 1, "QueryCancelled must never be re-attempted"
+    assert s1["retries"] == s0["retries"]
+    assert s1["exhausted"] == s0["exhausted"]
+    assert not getattr(ei.value, "auron_retry_exhausted", False)
+
+
+def test_preempted_run_tasks_consumes_no_task_retries():
+    task_pool.reset_pool()
+    with conf.scoped({"auron.task.parallelism": 2,
+                      "auron.task.retries": 3}):
+        r0 = counters.get("tasks_retried")
+        task_pool.preempt_query("qpre", "test preemption")
+        try:
+            with tracing.trace_scope("qpre"):
+                with pytest.raises(QueryCancelled) as ei:
+                    run_tasks(lambda i: i, range(4))
+            assert "preempted" in str(ei.value)
+            assert counters.get("tasks_retried") == r0
+        finally:
+            task_pool.clear_cancelled("qpre")
+
+
+def test_preemption_emits_trace_event():
+    task_pool.reset_pool()
+    scope = tracing.trace_scope(
+        "qev", recorder=tracing.TraceRecorder("qev"))
+    try:
+        with scope:
+            task_pool.preempt_query("qev", "pressure test")
+            with pytest.raises(QueryCancelled):
+                run_tasks(lambda i: i, [1, 2])
+        events = [s for s in scope.recorder.snapshot()
+                  if s.name == "query.preempt"]
+        assert events, "preemption must land in the victim's trace"
+        assert events[0].args["reason"] == "pressure test"
+    finally:
+        task_pool.clear_cancelled("qev")
+
+
+# ---------------------------------------------------------------------------
+# scheduler kill-and-requeue + aging
+# ---------------------------------------------------------------------------
+
+class _FakeResult:
+    def __init__(self, table):
+        self.table = table
+        self.wall_s = 0.01
+        self.metrics = []
+
+
+class _BlockFirst:
+    """Per-query: the FIRST execute blocks until the query is cancelled
+    or `release` is set; re-executes return immediately.  Runs under
+    the query scope so /queries attribution is real."""
+
+    def __init__(self):
+        self.runs = {}
+        self.release = threading.Event()
+
+    def execute(self, plan, mesh=None, mesh_axis="parts", query_id=None):
+        first = query_id not in self.runs
+        self.runs[query_id] = self.runs.get(query_id, 0) + 1
+        with tracing.trace_scope(query_id=query_id):
+            if first:
+                deadline = time.time() + 20
+                while time.time() < deadline and \
+                        not self.release.is_set():
+                    if task_pool.is_cancelled(query_id):
+                        raise QueryCancelled(query_id)
+                    time.sleep(0.01)
+            # record a history row like the real session does (the
+            # scheduler patches .preemptions onto it)
+            tracing.record_query(tracing.QueryRecord(
+                query_id=query_id, wall_s=0.01, rows=3))
+            return _FakeResult(pa.table({"x": [1, 2, 3]}))
+
+
+def _tiny_plan(rows=3, tag="t"):
+    from auron_tpu.frontend.foreign import ForeignNode, fcol
+    from auron_tpu.ir.schema import DataType, Field, Schema
+    schema = Schema((Field("x", DataType.int64()),))
+    scan = ForeignNode("LocalTableScanExec", output=schema,
+                       attrs={"rows": [{"x": i} for i in range(rows)]})
+    return ForeignNode("ProjectExec", children=(scan,), output=schema,
+                       attrs={"exprs": (fcol("x", DataType.int64()),),
+                              "tag": tag})
+
+
+def _wait_running(sched, qid, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if sched.status(qid)["state"] == "running":
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_scheduler_requeues_preempted_query():
+    sess = _BlockFirst()
+    sched = QueryScheduler(session_factory=lambda: sess)
+    rq0 = counters.get("requeues")
+    cc0 = counters.get("queries_cancelled")
+    qid = sched.submit(_tiny_plan(), conf={"auron.batch.size": 2048})
+    assert _wait_running(sched, qid)
+    assert task_pool.preempt_query(qid, "unit-test pressure")
+    assert sched.wait(qid, timeout=30)
+    st = sched.status(qid)
+    assert st["state"] == "succeeded", st
+    assert st["preemptions"] == 1
+    assert sess.runs[qid] == 2                   # killed once, rerun once
+    assert counters.get("requeues") == rq0 + 1
+    # a preemption is NOT a cancellation
+    assert counters.get("queries_cancelled") == cc0
+    # the /queries record surfaces the preemption count
+    rec = tracing.find_query(qid)
+    assert rec is not None and rec.preemptions == 1
+    assert rec.error is None
+    # reservation fully released, preempt mark cleared
+    assert sched.admission.held_bytes() == 0
+    assert task_pool.preempt_reason(qid) is None
+    assert sched.stats()["preemptions"] == 1
+
+
+def test_scheduler_preemption_cap_fails_query():
+    sess = _BlockFirst()
+    sched = QueryScheduler(session_factory=lambda: sess)
+    with conf.scoped({"auron.serving.preempt.max.per.query": 0}):
+        qid = sched.submit(_tiny_plan())
+        assert _wait_running(sched, qid)
+        task_pool.preempt_query(qid, "over budget")
+        assert sched.wait(qid, timeout=30)
+        st = sched.status(qid)
+    assert st["state"] == "failed"
+    assert "killed after 1 preemptions" in st["error"]
+    assert sched.admission.held_bytes() == 0
+
+
+def test_on_pressure_picks_lowest_priority_most_over_forecast():
+    sess = _BlockFirst()
+    with conf.scoped({"auron.serving.preempt.watermark": 0.9,
+                      "auron.serving.preempt.cooldown.seconds": 0.0,
+                      "auron.serving.max.concurrent": 2}):
+        sched = QueryScheduler(session_factory=lambda: sess)
+        q_low = sched.submit(_tiny_plan(tag="low"), priority=1)
+        q_high = sched.submit(_tiny_plan(tag="high"), priority=5)
+        assert _wait_running(sched, q_low)
+        assert _wait_running(sched, q_high)
+        sched._on_pressure(1000, 1000)
+        assert task_pool.preempt_reason(q_low) is not None
+        assert task_pool.preempt_reason(q_high) is None
+        # the victim observes the kill, requeues, and re-runs to
+        # completion BEFORE the release (its second run returns
+        # immediately); then the survivor is released
+        assert sched.wait(q_low, timeout=30)
+        sess.release.set()
+        assert sched.wait(q_high, timeout=30)
+        assert sched.status(q_low)["state"] == "succeeded"
+        assert sched.status(q_high)["state"] == "succeeded"
+        assert sched.status(q_low)["preemptions"] == 1
+        sched.shutdown()
+
+
+def test_on_pressure_never_preempts_lone_query():
+    sess = _BlockFirst()
+    with conf.scoped({"auron.serving.preempt.watermark": 0.9,
+                      "auron.serving.preempt.cooldown.seconds": 0.0}):
+        sched = QueryScheduler(session_factory=lambda: sess)
+        qid = sched.submit(_tiny_plan())
+        assert _wait_running(sched, qid)
+        sched._on_pressure(10**9, 1)
+        assert task_pool.preempt_reason(qid) is None
+        sess.release.set()
+        assert sched.wait(qid, timeout=30)
+        assert sched.status(qid)["state"] == "succeeded"
+        sched.shutdown()
+
+
+def test_priority_aging_unstarves_queued_submission():
+    """With aging on, an old low-priority submission overtakes a fresh
+    high-priority one; with aging off it would wait forever behind it."""
+    from auron_tpu.serving.scheduler import Submission
+    sub = Submission(query_id="q", plan=None, conf={}, priority=1,
+                     signature="s")
+    assert sub.effective_priority(0.0) == 1           # aging off
+    sub.queued_since = time.time() - 10.0
+    assert sub.effective_priority(2.0) == 1 + 5
+    assert sub.effective_priority(0.001) == 64        # clamped
+
+    sess = _BlockFirst()
+    log = []
+
+    class _Logger(_BlockFirst):
+        def execute(self, plan, mesh=None, mesh_axis="parts",
+                    query_id=None):
+            log.append(query_id)
+            return _FakeResult(pa.table({"x": [1]}))
+
+    runner = _Logger()
+    with conf.scoped({"auron.serving.max.concurrent": 1,
+                      "auron.admission.aging.seconds": 2.0}):
+        sched = QueryScheduler(session_factory=lambda: sess)
+        blocker = sched.submit(_tiny_plan(tag="blk"))
+        assert _wait_running(sched, blocker)
+        q_old_low = sched.submit(_tiny_plan(tag="old"), priority=1)
+        q_new_high = sched.submit(_tiny_plan(tag="new"), priority=3)
+        # simulate a long queue wait: the low-priority submission has
+        # aged 10s -> effective 1 + 5 = 6 > 3
+        sched.get(q_old_low).queued_since -= 10.0
+        sched._session_factory = lambda: runner
+        sess.release.set()
+        for q in (blocker, q_old_low, q_new_high):
+            assert sched.wait(q, timeout=30)
+        sched.shutdown()
+    assert log == [q_old_low, q_new_high], log
+
+
+# ---------------------------------------------------------------------------
+# Retry-After (shed / queue timeout)
+# ---------------------------------------------------------------------------
+
+def _http(url, method="GET", doc=None):
+    """(status, headers, json) without raising on HTTP errors."""
+    data = json.dumps(doc).encode() if doc is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"}, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, dict(r.headers), \
+                json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"null")
+
+
+def test_drain_estimate_bounds():
+    from auron_tpu.serving import AdmissionController
+    ctl = AdmissionController()
+    est = ctl.drain_estimate_s(0)
+    assert 1.0 <= est <= 600.0
+    assert ctl.drain_estimate_s(10_000) <= 600.0
+
+
+def test_retry_after_on_shed_and_unfinished_result():
+    sess = _BlockFirst()
+    srv = QueryServer(session_factory=lambda: sess).start()
+    try:
+        with conf.scoped({"auron.admission.queue.max": 1,
+                          "auron.serving.max.concurrent": 1}):
+            code, _, doc = _http(srv.url + "/submit", "POST",
+                                 {"plan": _tiny_plan().to_dict()})
+            assert code == 200
+            qid = doc["query_id"]
+            assert _wait_running(srv.scheduler, qid)
+            # one waiter fills the queue; the next submission sheds
+            code, _, doc2 = _http(srv.url + "/submit", "POST",
+                                  {"plan": _tiny_plan().to_dict()})
+            assert code == 200
+            q_wait = doc2["query_id"]
+            code, headers, doc = _http(srv.url + "/submit", "POST",
+                                       {"plan": _tiny_plan().to_dict()})
+            assert code == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert doc["retry_after_s"] >= 1.0
+            # an unfinished /result carries the hint too
+            code, headers, doc = _http(srv.url + f"/result/{qid}")
+            assert code == 409
+            assert int(headers["Retry-After"]) >= 1
+            sess.release.set()
+            assert srv.scheduler.wait(qid, timeout=30)
+            assert srv.scheduler.wait(q_wait, timeout=30)
+            # finished results carry no Retry-After
+            code, headers, _ = _http(srv.url + f"/result/{qid}")
+            assert code == 200 and "Retry-After" not in headers
+    finally:
+        srv.stop()
+
+
+def test_retry_after_on_queue_timeout_result():
+    sess = _BlockFirst()
+    srv = QueryServer(session_factory=lambda: sess).start()
+    try:
+        with conf.scoped({"auron.serving.max.concurrent": 1,
+                          "auron.admission.queue.timeout.seconds": 0.2}):
+            q_run = srv.scheduler.submit(_tiny_plan())
+            assert _wait_running(srv.scheduler, q_run)
+            q_wait = srv.scheduler.submit(_tiny_plan())
+            assert srv.scheduler.wait(q_wait, timeout=10)
+            st = srv.scheduler.status(q_wait)
+            assert st["state"] == "failed" and "timeout" in st["error"]
+            code, headers, doc = _http(srv.url + f"/result/{q_wait}")
+            assert code == 409
+            assert int(headers["Retry-After"]) >= 1
+            sess.release.set()
+            srv.scheduler.wait(q_run, timeout=30)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance stress: 10 concurrent fault-injected queries with a
+# budget tight enough to force >= 1 preemption
+# ---------------------------------------------------------------------------
+
+SERIAL_SCOPE = {
+    # serial per-partition path: per-operator metric trees + memory
+    # consumers register (the SPMD stage program has neither)
+    "auron.spmd.singleDevice.enable": False,
+}
+
+
+def _solo_baselines(names, catalog):
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it import queries
+    from auron_tpu.it.oracle import PyArrowEngine
+    out = {}
+    with conf.scoped(SERIAL_SCOPE):
+        for name in set(names):
+            session = AuronSession(foreign_engine=PyArrowEngine())
+            out[name] = _canon(
+                session.execute(queries.build(name, catalog)).table)
+    return out
+
+
+def test_overload_stress_preempt_requeue_bit_identical(catalog):
+    """THE acceptance gate: 10 concurrent queries under io+latency+mem
+    faults against a tiny shared pool with watermark preemption armed —
+    at least one query is preempted and requeued, EVERY query's final
+    result is bit-identical to its solo fault-free run, per-query
+    ledger entries drain to zero, no consumer leaks, no admission
+    reservation survives, and every driver thread exits."""
+    from auron_tpu import faults
+    from auron_tpu.it import queries
+    from auron_tpu.runtime import profiling
+    from auron_tpu.serving.scheduler import default_session_factory
+
+    names = ["q03", "q42", "q01", "q03", "q42",
+             "q01", "q03", "q42", "q01", "q03"]
+    baselines = _solo_baselines(names, catalog)
+
+    # io rules carry max= bounds (the PR 6 lesson): the gate tests
+    # recovery + preemption, not unbounded adversity
+    spec = ("shuffle.push:io:p=0.06,max=8,seed=7;"
+            "shuffle.fetch:io:p=0.06,max=8,seed=11;"
+            "scan.parquet.open:io:p=0.04,max=6,seed=19;"
+            "shuffle.push:latency:p=0.1,seed=5,ms=4;"
+            "op.execute:mem:bytes=65536,max=2,seed=9")
+    faults.reset(spec)
+    stress_scope = {
+        **SERIAL_SCOPE,
+        "auron.faults.spec": spec,
+        "auron.task.retries": 2,
+        "auron.retry.backoff.base.ms": 1.0,
+        "auron.retry.backoff.max.ms": 10.0,
+        # tiny shared pool: ten queries fight for ~2MB and spill
+        "auron.memory.spill.min.trigger.bytes": 1024,
+        "auron.serving.max.concurrent": 10,
+        "auron.admission.default.forecast.bytes": 131072,
+        # the overload-survival layer under test: preempt at half the
+        # effective budget (the tiny pool crosses it early and often),
+        # at most one kill-and-requeue per query, spaced >= 3s
+        "auron.serving.preempt.watermark": 0.5,
+        "auron.serving.preempt.cooldown.seconds": 3.0,
+        "auron.serving.preempt.max.per.query": 1,
+        "auron.admission.aging.seconds": 5.0,
+    }
+    task_pool.reset_pool()
+    tracing.clear_history()
+    p0 = counters.get("preemptions")
+    r0 = counters.get("requeues")
+    with conf.scoped(stress_scope):
+        mgr = reset_manager(2 << 20)
+        sched = QueryScheduler(session_factory=default_session_factory)
+        qids = [sched.submit(queries.build(n, catalog),
+                             priority=1 + (i % 3))
+                for i, n in enumerate(names)]
+        assert len(set(qids)) == 10
+        for qid in qids:
+            assert sched.wait(qid, timeout=600), sched.status(qid)
+        sched.shutdown()
+
+    # the sweep must actually have injected (hollow-gate guard)
+    reg = faults.registry_for(spec)
+    assert reg.injected_total() > 0, reg.counts()
+
+    # >= 1 preemption was forced, and every preemption that requeued
+    # came back: bit-identical results below prove re-execution safety
+    preemptions = counters.get("preemptions") - p0
+    requeues = counters.get("requeues") - r0
+    assert preemptions >= 1, \
+        "the tight budget must force at least one preemption"
+    assert requeues >= 1
+    assert sum(s.num_preemptions
+               for s in (sched.get(q) for q in qids)) >= 1
+
+    for qid, name in zip(qids, names):
+        st = sched.status(qid)
+        assert st["state"] == "succeeded", (name, st)
+        table = _canon(sched.result(qid))
+        assert table.equals(baselines[name]), \
+            f"{name} ({qid}) diverged from its solo fault-free run"
+        rec = tracing.find_query(qid)
+        assert rec is not None, f"no /queries record for {qid}"
+        assert rec.rows == sched.result(qid).num_rows
+        assert rec.error is None
+        # QueryRecord surfaces the kill-and-requeue count
+        assert rec.preemptions == st["preemptions"]
+
+    # every reservation released: no admission holds, no admission:*
+    # label left on the manager (fault 'mem' reservations persist by
+    # design until reset_manager)
+    assert sched.admission.held_bytes() == 0
+    assert not any(label.startswith("admission:")
+                   for label in mgr._reservations)
+    # per-query ledger drained to zero, no leaked consumers
+    ledger = mgr.query_ledger()
+    assert sum(ent["used"] for ent in ledger.values()) == 0, ledger
+    assert mgr.stats()["num_consumers"] == 0
+    # preemption marks all cleared
+    assert all(task_pool.preempt_reason(q) is None for q in qids)
+
+    # counters visible on /metrics (prometheus text), on the scheduler
+    # stats, and as query.preempt in at least one victim's trace
+    prom = profiling._prometheus_text()
+    assert "auron_preemptions_total" in prom
+    assert "auron_requeues_total" in prom
+    pre_line = [ln for ln in prom.splitlines()
+                if ln.startswith("auron_preemptions_total")][0]
+    assert int(pre_line.split()[-1]) >= 1
+    victims = [q for q in qids if sched.get(q).num_preemptions]
+    assert victims
+    for q in victims:
+        rec = tracing.find_query(q)
+        assert rec.preemptions >= 1
+
+    # all driver threads joined (requeues spawn fresh ones per run)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        drivers = [t for t in threading.enumerate()
+                   if t.name.startswith("auron-driver-")]
+        if not drivers:
+            break
+        time.sleep(0.05)
+    assert not drivers, f"driver threads alive: {drivers}"
+
+
+@pytest.mark.slow
+def test_tools_overload_check_script():
+    """tools/overload_check.sh is the CI overload gate; keep it green
+    from pytest (mirrors serve_check wiring)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "overload_check.sh")
+    if not os.path.exists(script) or shutil.which("bash") is None:
+        pytest.skip("overload script or bash unavailable")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    out = subprocess.run(["bash", script], capture_output=True,
+                         text=True, timeout=540, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -1,0 +1,147 @@
+#!/usr/bin/env bash
+# CI overload-survival gate (CPU, no accelerator needed):
+#   1. start a QueryServer over a TINY memory budget with watermark
+#      preemption armed (auron.serving.preempt.*) and io+latency+mem
+#      faults injected
+#   2. POST six concurrent /submit requests (IT-corpus queries)
+#   3. assert >= 1 preemption fired (kill-and-requeue), every query
+#      still succeeds with results value-identical to its solo
+#      fault-free run, every admission reservation drained, and the
+#      auron_preemptions_total / auron_requeues_total Prometheus
+#      counters are present on /metrics
+#
+# The same check runs inside the suite (tests/test_overload.py::
+# test_tools_overload_check_script, marked slow), mirroring how
+# serve_check.sh / chaos_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import threading
+import urllib.request
+
+from auron_tpu import faults
+from auron_tpu.config import conf
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.it import datagen, queries
+from auron_tpu.it.oracle import PyArrowEngine
+from auron_tpu.memmgr.manager import reset_manager
+from auron_tpu.serving import QueryServer, register_catalog
+
+import tempfile
+
+SF = 0.002
+NAMES = ["q01", "q03", "q42", "q03", "q42", "q01"]
+
+catalog = datagen.generate(
+    tempfile.mkdtemp(prefix="auron-overload-check-"), sf=SF)
+register_catalog(SF, catalog)
+
+
+def canon(t):
+    t = t.combine_chunks()
+    return t.sort_by([(n, "ascending") for n in t.column_names]) \
+        if t.num_rows and t.num_columns else t
+
+
+serial = {"auron.spmd.singleDevice.enable": False}
+baselines = {}
+with conf.scoped(serial):
+    for name in set(NAMES):
+        s = AuronSession(foreign_engine=PyArrowEngine())
+        baselines[name] = canon(s.execute(queries.build(name, catalog)).table)
+
+# tiny pool + low watermark + bounded faults: six concurrent queries
+# MUST cross the preemption watermark while >= 2 run
+spec = ("shuffle.push:io:p=0.05,max=6,seed=7;"
+        "shuffle.push:latency:p=0.1,seed=5,ms=3;"
+        "op.execute:mem:bytes=65536,max=2,seed=9")
+faults.reset(spec)
+budget = 2 << 20
+scope = {**serial,
+         "auron.faults.spec": spec,
+         "auron.task.retries": 2,
+         "auron.retry.backoff.base.ms": 1.0,
+         "auron.retry.backoff.max.ms": 10.0,
+         "auron.memory.spill.min.trigger.bytes": 1024,
+         "auron.serving.max.concurrent": 6,
+         "auron.admission.default.forecast.bytes": 131072,
+         "auron.serving.preempt.watermark": 0.5,
+         "auron.serving.preempt.cooldown.seconds": 3.0,
+         "auron.serving.preempt.max.per.query": 1,
+         "auron.admission.aging.seconds": 5.0}
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.load(r)
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=300) as r:
+        return r.read()
+
+
+with conf.scoped(scope):
+    reset_manager(budget)
+    srv = QueryServer().start()
+    try:
+        qids = {}
+        errs = []
+
+        def submit(i, name):
+            try:
+                doc = post(srv.url + "/submit",
+                           {"corpus": name, "sf": SF,
+                            "priority": 1 + (i % 3)})
+                qids[i] = (name, doc["query_id"])
+            except Exception as e:   # noqa: BLE001
+                errs.append((name, repr(e)))
+
+        threads = [threading.Thread(target=submit, args=(i, n))
+                   for i, n in enumerate(NAMES)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert len(qids) == len(NAMES)
+
+        for i, (name, qid) in sorted(qids.items()):
+            assert srv.scheduler.wait(qid, timeout=600), \
+                f"{name} did not finish"
+            st = json.loads(get(srv.url + f"/status/{qid}"))
+            assert st["state"] == "succeeded", (name, st)
+            res = json.loads(get(srv.url + f"/result/{qid}"))
+            assert not res["truncated"]
+            import pyarrow as pa
+            got = canon(pa.Table.from_pylist(
+                res["rows"], schema=baselines[name].schema))
+            assert got.equals(baselines[name]), \
+                f"{name} served result diverged from its solo run"
+
+        stats = json.loads(get(srv.url + "/scheduler"))
+        preemptions = stats["preemptions"]
+        assert preemptions >= 1, \
+            f"tight budget never forced a preemption: {stats}"
+        assert srv.scheduler.admission.held_bytes() == 0
+        prom = get(srv.url + "/metrics").decode()
+        for needle in ("auron_preemptions_total", "auron_requeues_total"):
+            assert needle in prom, f"missing {needle!r} in /metrics"
+        line = [ln for ln in prom.splitlines()
+                if ln.startswith("auron_preemptions_total")][0]
+        assert int(line.split()[-1]) >= 1
+        print(f"overload_check: {len(NAMES)}/{len(NAMES)} queries "
+              f"value-identical to solo runs through {preemptions} "
+              f"preemption(s)")
+    finally:
+        srv.stop()
+        reset_manager()
+        faults.reset()
+EOF
+
+echo "overload_check.sh: ok"

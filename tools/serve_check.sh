@@ -59,7 +59,10 @@ scope = {**serial,
          "auron.serving.max.concurrent": 4,
          "auron.admission.default.forecast.bytes": int(budget * 0.45),
          "auron.admission.memory.fraction": 0.8,
-         "auron.memory.spill.min.trigger.bytes": 64 << 10}
+         "auron.memory.spill.min.trigger.bytes": 64 << 10,
+         # this gate is about ADMISSION; preemption has its own gate
+         # (tools/overload_check.sh)
+         "auron.serving.preempt.watermark": 0.0}
 
 def post(url, doc):
     req = urllib.request.Request(
