@@ -817,6 +817,80 @@ SERVING_PREEMPT_COOLDOWN_SECONDS = conf.define(
     "storm before the first victim's memory is even released.",
 )
 
+# -- executor fleet (auron_tpu/serving/fleet.py) ----------------------------
+
+FLEET_EXECUTORS = conf.define(
+    "auron.fleet.executors", 0,
+    "Executor-process count for fleet serving (`python -m "
+    "auron_tpu.serving` / serving.fleet.FleetManager.spawn): N > 0 "
+    "spawns N worker processes each running a slim executor server "
+    "(serving/executor_endpoint.py) behind ONE front-door "
+    "admission ledger, with heartbeat-driven failover and "
+    "cross-process kill-and-requeue.  0 (default) keeps the "
+    "single-process QueryScheduler path — the fleet code stays "
+    "dormant.",
+)
+FLEET_HEARTBEAT_SECONDS = conf.define(
+    "auron.fleet.heartbeat.seconds", 2.0,
+    "Heartbeat probe cadence per executor while it is healthy "
+    "(serving/fleet.py).  A SUSPECT executor is re-probed faster — "
+    "capped exponential backoff starting at a quarter of this "
+    "interval (see auron.fleet.probe.backoff.max.seconds) — so a "
+    "dead executor is declared within ~auron.fleet.death.probes "
+    "heartbeat intervals.  The heartbeat reply also carries the "
+    "executor's in-flight query states, so result latency in fleet "
+    "mode is bounded by this interval too.",
+)
+FLEET_DEATH_PROBES = conf.define(
+    "auron.fleet.death.probes", 3,
+    "Consecutive failed heartbeat probes before an executor is "
+    "declared DEAD: its in-flight queries are requeued on a "
+    "DIFFERENT executor (per-query excluded-executor list, admission "
+    "reservation released first, no `auron.task.retries` budget "
+    "consumed) and its process is killed as a fence against double "
+    "execution.  DEAD is sticky — a restarted executor joins as a "
+    "fresh endpoint, it never resurrects the old identity.",
+)
+FLEET_PROBE_BACKOFF_MAX_SECONDS = conf.define(
+    "auron.fleet.probe.backoff.max.seconds", 0.0,
+    "Cap on the suspect re-probe backoff (base = heartbeat/4, doubled "
+    "per consecutive failure).  0 (default) caps at "
+    "auron.fleet.heartbeat.seconds, keeping worst-case death "
+    "detection within ~3 heartbeat intervals.",
+)
+FLEET_FLAP_MAX = conf.define(
+    "auron.fleet.flap.max", 3,
+    "Alive->suspect transitions within auron.fleet.flap.window."
+    "seconds past which a FLAPPING executor is circuit-broken out of "
+    "routing for auron.fleet.circuit.break.seconds: it keeps its "
+    "running queries and keeps answering heartbeats, but receives no "
+    "new dispatches until the breaker closes.",
+)
+FLEET_FLAP_WINDOW_SECONDS = conf.define(
+    "auron.fleet.flap.window.seconds", 60.0,
+    "Sliding window over which alive->suspect transitions count "
+    "toward the flap circuit-breaker (auron.fleet.flap.max).",
+)
+FLEET_CIRCUIT_BREAK_SECONDS = conf.define(
+    "auron.fleet.circuit.break.seconds", 30.0,
+    "How long a flapping executor stays out of routing once its "
+    "circuit-breaker opens.",
+)
+FLEET_MEMORY_BUDGET_BYTES = conf.define(
+    "auron.fleet.memory.budget.bytes", 0,
+    "Global memory budget federated across the executor fleet: each "
+    "spawned worker process gets an equal slice as its own MemManager "
+    "budget, and the front-door admission ledger gates against the "
+    "TOTAL.  0 (default) federates the driver process's MemManager "
+    "budget instead.",
+)
+FLEET_BOOT_TIMEOUT_SECONDS = conf.define(
+    "auron.fleet.boot.timeout.seconds", 120.0,
+    "How long FleetManager.spawn waits for a worker process to print "
+    "its listening line before declaring the boot failed (the worker "
+    "is killed and its log tail surfaced in the error).",
+)
+
 # -- kernel-strategy layer (ops/strategy.py) --------------------------------
 
 KERNEL_SORT_STRATEGY = conf.define(

@@ -9,14 +9,19 @@ transfer of a consumer's batches, optionally compressed to files
 Overload survival (PR 10) lives in `manager`: a per-query usage ledger
 (consumers carry the ambient query tag), per-query budgets with
 kill-past-grace (`set_kill_hook`), and the pressure hook the serving
-scheduler uses for watermark preemption (`set_pressure_hook`).
+scheduler uses for watermark preemption (`set_pressure_hook`).  Hooks
+are per-manager registrations (`MemManager.set_kill_hook` /
+`set_pressure_hook` / `reset_hooks`) since the fleet tier (PR 11) runs
+one manager per executor process; the module-level names are compat
+shims that survive `reset_manager`.
 """
 
 from auron_tpu.memmgr.manager import (
-    MemConsumer, MemManager, get_manager, set_kill_hook,
+    MemConsumer, MemManager, get_manager, reset_hooks, set_kill_hook,
     set_pressure_hook,
 )
 from auron_tpu.memmgr.spill import Spill, SpillManager
 
 __all__ = ["MemConsumer", "MemManager", "get_manager", "Spill",
-           "SpillManager", "set_kill_hook", "set_pressure_hook"]
+           "SpillManager", "reset_hooks", "set_kill_hook",
+           "set_pressure_hook"]

@@ -79,7 +79,7 @@ def kill_grace_spills() -> int:
     return int(conf.get("auron.memory.query.kill.grace.spills"))
 
 
-# -- overload hooks (module-level: survive reset_manager) -------------------
+# -- overload hooks ---------------------------------------------------------
 #
 # kill hook: invoked OUTSIDE the manager lock with (query_id, reason)
 # when an over-budget query has exhausted its spill grace.  The default
@@ -90,9 +90,20 @@ def kill_grace_spills() -> int:
 # with (total_used, effective_budget) whenever an accounting update
 # leaves pool usage above fraction * effective budget.  The serving
 # scheduler installs this to drive watermark preemption without polling.
+#
+# Hooks are PER-MANAGER registrations (MemManager.set_kill_hook /
+# set_pressure_hook / reset_hooks): the fleet tier runs one manager per
+# executor process, and a module-level singleton would wire every
+# manager in a test process to whichever scheduler registered last.
+# The module-level functions below are thin COMPATIBILITY SHIMS with
+# the pre-fleet semantics — a shim-installed hook is remembered and
+# re-applied across reset_manager (the serving scheduler registers at
+# construction and tests reset the manager afterwards), where a
+# per-manager registration dies with its manager.
 
-_KILL_HOOK: Optional[Callable[[str, str], None]] = None
-_PRESSURE_HOOK: Optional[Tuple[Callable[[int, int], None], float]] = None
+_COMPAT_KILL_HOOK: Optional[Callable[[str, str], None]] = None
+_COMPAT_PRESSURE_HOOK: Optional[
+    Tuple[Callable[[int, int], None], float]] = None
 
 
 def _default_kill_hook(query_id: str, reason: str) -> None:
@@ -101,26 +112,45 @@ def _default_kill_hook(query_id: str, reason: str) -> None:
 
 
 def set_kill_hook(fn: Optional[Callable[[str, str], None]]) -> None:
-    """Override how over-budget queries are killed (None restores the
-    task-pool preemption default)."""
-    global _KILL_HOOK
-    _KILL_HOOK = fn
+    """Module-level shim: override how over-budget queries are killed
+    (None restores the task-pool preemption default) on the CURRENT
+    manager and every manager reset_manager installs after it."""
+    global _COMPAT_KILL_HOOK
+    _COMPAT_KILL_HOOK = fn
+    get_manager().set_kill_hook(fn)
 
 
 def set_pressure_hook(fn: Callable[[int, int], None],
                       fraction: float) -> None:
-    global _PRESSURE_HOOK
-    _PRESSURE_HOOK = (fn, float(fraction))
+    """Module-level shim: install the watermark pressure hook on the
+    current manager and every manager reset_manager installs after it."""
+    global _COMPAT_PRESSURE_HOOK
+    _COMPAT_PRESSURE_HOOK = (fn, float(fraction))
+    get_manager().set_pressure_hook(fn, fraction)
 
 
 def clear_pressure_hook(fn: Optional[Callable[[int, int], None]] = None
                         ) -> None:
     """Remove the pressure hook (only if it is `fn`, when given — a
     shut-down scheduler must not uninstall its successor's hook)."""
-    global _PRESSURE_HOOK
-    if fn is None or (_PRESSURE_HOOK is not None
-                      and _PRESSURE_HOOK[0] is fn):
-        _PRESSURE_HOOK = None
+    global _COMPAT_PRESSURE_HOOK
+    if fn is None or (_COMPAT_PRESSURE_HOOK is not None
+                      and _COMPAT_PRESSURE_HOOK[0] is fn):
+        _COMPAT_PRESSURE_HOOK = None
+    get_manager().clear_pressure_hook(fn)
+
+
+def reset_hooks() -> None:
+    """The hook RESET API: drop the compat slots AND the current
+    manager's registrations.  Test fixtures call this so a hook
+    installed by one test can never fire inside the next."""
+    global _COMPAT_KILL_HOOK, _COMPAT_PRESSURE_HOOK
+    _COMPAT_KILL_HOOK = None
+    _COMPAT_PRESSURE_HOOK = None
+    with _GLOBAL_LOCK:
+        mgr = _GLOBAL
+    if mgr is not None:
+        mgr.reset_hooks()
 
 
 def watermark_fractions() -> List[float]:
@@ -227,6 +257,36 @@ class MemManager:
         # entries are pruned past MAX_QUERY_LEDGER)
         self._queries: Dict[str, Dict[str, int]] = {}
         self._killed_queries: set = set()   # kill hook fired once per id
+        # per-MANAGER overload hooks (None kill hook = the task-pool
+        # preemption default); plain attribute writes — hooks are read
+        # under the accounting lock and invoked outside it
+        self._kill_hook: Optional[Callable[[str, str], None]] = None
+        self._pressure_hook: Optional[
+            Tuple[Callable[[int, int], None], float]] = None
+
+    # -- overload hook registration (per manager) ---------------------------
+
+    def set_kill_hook(self,
+                      fn: Optional[Callable[[str, str], None]]) -> None:
+        """Override how this manager kills over-budget queries (None
+        restores the task-pool preemption default)."""
+        self._kill_hook = fn
+
+    def set_pressure_hook(self, fn: Callable[[int, int], None],
+                          fraction: float) -> None:
+        self._pressure_hook = (fn, float(fraction))
+
+    def clear_pressure_hook(
+            self, fn: Optional[Callable[[int, int], None]] = None) -> None:
+        """Remove this manager's pressure hook (only if it is `fn`,
+        when given)."""
+        if fn is None or (self._pressure_hook is not None
+                          and self._pressure_hook[0] is fn):
+            self._pressure_hook = None
+
+    def reset_hooks(self) -> None:
+        self._kill_hook = None
+        self._pressure_hook = None
 
     @staticmethod
     def _default_budget() -> int:
@@ -487,7 +547,7 @@ class MemManager:
                 if ent["used"] > ent["peak"]:
                     ent["peak"] = ent["used"]
             pressure = self._check_watermarks(consumer)
-            hook = _PRESSURE_HOOK
+            hook = self._pressure_hook
             if hook is not None:
                 eb = max(1, self.effective_budget)
                 if self.total_used > hook[1] * eb:
@@ -566,7 +626,7 @@ class MemManager:
                           f"{ent['used']} > budget {qbudget} after "
                           f"{ent['spills']} spill(s)")
         if reason is not None:
-            hook = _KILL_HOOK or _default_kill_hook
+            hook = self._kill_hook or _default_kill_hook
             hook(qid, reason)
 
     # -- per-query ledger --------------------------------------------------
@@ -636,19 +696,32 @@ _GLOBAL: Optional[MemManager] = None
 _GLOBAL_LOCK = lockcheck.Lock("mem.global")
 
 
+def _new_manager(budget_bytes: Optional[int]) -> MemManager:
+    """Construct a manager with the compat-shim hooks (if any) carried
+    over — the pre-fleet module-level semantics for shim users."""
+    mgr = MemManager(budget_bytes)
+    if _COMPAT_KILL_HOOK is not None:
+        mgr.set_kill_hook(_COMPAT_KILL_HOOK)
+    if _COMPAT_PRESSURE_HOOK is not None:
+        mgr.set_pressure_hook(*_COMPAT_PRESSURE_HOOK)
+    return mgr
+
+
 def get_manager() -> MemManager:
     global _GLOBAL
     with _GLOBAL_LOCK:
         if _GLOBAL is None:
-            _GLOBAL = MemManager()
+            _GLOBAL = _new_manager(None)
         return _GLOBAL
 
 
 def reset_manager(budget_bytes: Optional[int] = None) -> MemManager:
     """Test/driver hook: install a fresh manager (e.g. tiny budget for the
     spill fuzz tests, SURVEY §4).  Accounting (peaks, watermarks, spill
-    attribution) restarts with the new instance."""
+    attribution) restarts with the new instance.  Hooks installed via the
+    module-level shims are re-applied; per-manager registrations die with
+    the old instance (see the overload-hooks comment above)."""
     global _GLOBAL
     with _GLOBAL_LOCK:
-        _GLOBAL = MemManager(budget_bytes)
+        _GLOBAL = _new_manager(budget_bytes)
         return _GLOBAL

@@ -40,6 +40,14 @@ _COUNTERS: Dict[str, int] = {
     # .preempt_query / QueryScheduler requeue path)
     "preemptions": 0,
     "requeues": 0,
+    # executor fleet (serving/fleet.py): multi-process serving —
+    # dispatches to executors, executor deaths declared by the health
+    # machine, and cross-process kill-and-requeue events
+    "fleet_submissions": 0,
+    "fleet_dispatches": 0,
+    "fleet_completions": 0,
+    "fleet_deaths": 0,
+    "fleet_requeues": 0,
 }
 
 

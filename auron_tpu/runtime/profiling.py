@@ -193,6 +193,21 @@ def _prometheus_text() -> str:
         emit(f"auron_{key}_total", snap.get(key, 0),
              help_="serving tier: "
                    f"{key.replace('_', ' ')} count")
+    for key in ("fleet_submissions", "fleet_dispatches",
+                "fleet_completions", "fleet_deaths", "fleet_requeues"):
+        emit(f"auron_{key}_total", snap.get(key, 0),
+             help_="executor fleet: "
+                   f"{key.replace('_', ' ')} count")
+    sched = _serving_scheduler()
+    up_fn = getattr(sched, "executor_up", None)
+    if callable(up_fn):
+        name = "auron_fleet_executor_up"
+        lines.append(f"# HELP {name} 1 while the executor is part of "
+                     f"fleet routing, 0 once declared dead")
+        lines.append(f"# TYPE {name} gauge")
+        for eid, v in sorted(up_fn().items()):
+            lines.append(
+                f'{name}{{executor="{_prom_escape(eid)}"}} {v}')
     mgr = get_manager()
     mem = mgr.stats()
     emit("auron_mem_budget_bytes", mem.get("budget", 0), "gauge",

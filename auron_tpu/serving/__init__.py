@@ -23,9 +23,29 @@ session-server form.
   `memmgr/manager.py`, queued submissions age
   (`auron.admission.aging.seconds`), and shed/timeout responses carry
   `Retry-After` drain estimates.
+- crash-surviving multi-process serving (PR 11): `serving.fleet.
+  FleetManager` supervises N executor processes behind the SAME
+  front-door admission ledger — heartbeat-driven alive/suspect/dead
+  health states, flap circuit-breaking, graceful drain, and the PR 10
+  kill-and-requeue generalized across the process boundary (an
+  executor killed with `kill -9` has its in-flight queries requeued on
+  a different executor, bit-identically, without consuming retry
+  budgets).  `serving.executor_endpoint` is the process seam: the
+  `ExecutorEndpoint` interface, the in-process `LocalExecutor`
+  (default — the fleet code stays dormant), the worker-side
+  `ExecutorServer` and the driver-side `ProcessExecutor` client.
 """
 
-from auron_tpu.serving.admission import AdmissionController
+from auron_tpu.serving.admission import (
+    AdmissionController, PassThroughAdmission,
+)
+from auron_tpu.serving.executor_endpoint import (
+    EndpointError, ExecutorEndpoint, ExecutorServer, LocalExecutor,
+    ProcessExecutor,
+)
+from auron_tpu.serving.fleet import (
+    ExecutorHealth, FleetManager, FleetSubmission,
+)
 from auron_tpu.serving.forecast import MemForecaster, plan_signature
 from auron_tpu.serving.scheduler import (
     QueryScheduler, Submission, SubmissionRejected,
@@ -36,8 +56,11 @@ from auron_tpu.serving.server import (
 )
 
 __all__ = [
-    "AdmissionController", "MemForecaster", "plan_signature",
-    "QueryScheduler", "Submission", "SubmissionRejected",
-    "QueryServer", "active_scheduler", "install_scheduler",
-    "parse_submission", "register_catalog", "uninstall_scheduler",
+    "AdmissionController", "PassThroughAdmission", "MemForecaster",
+    "plan_signature", "QueryScheduler", "Submission",
+    "SubmissionRejected", "QueryServer", "active_scheduler",
+    "install_scheduler", "parse_submission", "register_catalog",
+    "uninstall_scheduler", "EndpointError", "ExecutorEndpoint",
+    "ExecutorServer", "LocalExecutor", "ProcessExecutor",
+    "ExecutorHealth", "FleetManager", "FleetSubmission",
 ]

@@ -25,11 +25,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="submit a few tiny corpus queries and exit")
     ap.add_argument("--sf", type=float, default=0.002,
                     help="--demo catalog scale factor")
+    ap.add_argument("--executors", type=int, default=None,
+                    help="fleet mode: spawn N executor worker "
+                         "processes behind one admission ledger "
+                         "(default = auron.fleet.executors; 0 keeps "
+                         "the in-process scheduler)")
     args = ap.parse_args(argv)
 
+    from auron_tpu.config import conf
     from auron_tpu.serving import QueryServer
-    srv = QueryServer(host=args.host, port=args.port).start()
-    print(f"auron-tpu query server listening on {srv.url}", flush=True)
+    n = args.executors if args.executors is not None \
+        else int(conf.get("auron.fleet.executors"))
+    if n > 0:
+        from auron_tpu.serving.fleet import FleetManager
+        fleet = FleetManager.spawn(n)
+        srv = QueryServer(scheduler=fleet,
+                          host=args.host, port=args.port).start()
+        print(f"auron-tpu fleet server ({n} executors) listening on "
+              f"{srv.url}", flush=True)
+    else:
+        srv = QueryServer(host=args.host, port=args.port).start()
+        print(f"auron-tpu query server listening on {srv.url}",
+              flush=True)
     try:
         if args.demo:
             from auron_tpu.serving.server import corpus_plan

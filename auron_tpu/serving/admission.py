@@ -29,7 +29,7 @@ Decisions (`auron.admission.*` knobs):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from auron_tpu.config import conf
 from auron_tpu.runtime import lockcheck
@@ -49,15 +49,38 @@ class AdmissionDecision:
 
 
 class AdmissionController:
-    """Forecast ledger + MemManager reservations for running queries."""
+    """Forecast ledger + MemManager reservations for running queries.
 
-    def __init__(self, forecaster: Optional[MemForecaster] = None):
+    One controller is the SINGLE front-door ledger however many
+    executors sit behind it: the fleet tier (serving/fleet.py) passes
+    `budget_fn` (the federated total of the per-process MemManager
+    budgets) and `executors_fn` (healthy executor count, so drain
+    estimates account for fleet-wide wave width); the defaults — the
+    local manager's budget and one executor — are the single-process
+    serving shape."""
+
+    def __init__(self, forecaster: Optional[MemForecaster] = None,
+                 budget_fn: Optional[Callable[[], int]] = None,
+                 executors_fn: Optional[Callable[[], int]] = None):
         self.forecaster = forecaster or MemForecaster()
+        self._budget_fn = budget_fn
+        self._executors_fn = executors_fn
         self._lock = lockcheck.Lock("serving.admission")
         self._held: Dict[str, int] = {}    # query id -> reserved bytes
         # event counters (the serve_check gate asserts queue events)
         self.events: Dict[str, int] = {"admitted": 0, "queued": 0,
                                        "shed": 0, "degraded": 0}
+
+    def _budget(self) -> int:
+        if self._budget_fn is not None:
+            return max(1, int(self._budget_fn()))
+        from auron_tpu.memmgr import get_manager
+        return max(1, get_manager().budget)
+
+    def _executors(self) -> int:
+        if self._executors_fn is not None:
+            return max(1, int(self._executors_fn()))
+        return 1
 
     # -- forecasting -------------------------------------------------------
 
@@ -90,7 +113,7 @@ class AdmissionController:
         if not conf.get("auron.admission.enable"):
             return AdmissionDecision(ADMIT, 0, reason="admission off")
         mgr = get_manager()
-        budget = max(1, mgr.budget)
+        budget = self._budget()
         forecast = self.forecast_for(signature)
         serial_frac = float(
             conf.get("auron.admission.degrade.serial.fraction"))
@@ -137,7 +160,10 @@ class AdmissionController:
         queue-timeout HTTP responses.  Estimate: the average wall time
         of recently completed queries times the number of scheduling
         'waves' ahead of the caller (running reservations + queue
-        depth over the concurrency), clamped to [1, 600]."""
+        depth over the concurrency), clamped to [1, 600].  A wave is
+        `auron.serving.max.concurrent` slots on EVERY healthy executor
+        — with N executors behind the front door a single-worker wave
+        width would make the hint ~N× pessimistic."""
         import math
 
         from auron_tpu.runtime import tracing
@@ -146,7 +172,8 @@ class AdmissionController:
         avg = sum(recent) / len(recent) if recent else 2.0
         with self._lock:
             held = len(self._held)
-        slots = max(1, int(conf.get("auron.serving.max.concurrent")))
+        slots = max(1, int(conf.get("auron.serving.max.concurrent"))) \
+            * self._executors()
         waves = math.ceil((held + max(0, queue_len) + 1) / slots)
         return max(1.0, min(600.0, avg * waves))
 
@@ -164,3 +191,18 @@ class AdmissionController:
                     "held_queries": len(self._held),
                     "events": dict(self.events),
                     "forecasts": self.forecaster.snapshot()}
+
+
+class PassThroughAdmission(AdmissionController):
+    """Admit everything, reserve nothing: the controller a per-executor
+    QueryScheduler runs with when a FleetManager's controller is the
+    single front-door ledger — gating (and reserving) a second time
+    inside the executor would double-count every forecast."""
+
+    def offer(self, query_id: str, signature: str, queue_len: int,
+              count_queue_event: bool = True) -> AdmissionDecision:
+        return AdmissionDecision(ADMIT, 0,
+                                 reason="fleet front-door admission")
+
+    def release(self, query_id: str) -> None:
+        pass

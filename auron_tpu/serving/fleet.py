@@ -1,0 +1,831 @@
+"""Executor fleet: crash-surviving multi-process serving behind one
+admission ledger.
+
+The reference runs its native engine inside many JVM executor processes
+and treats executor death as routine — the driver schedules around it
+(PAPER.md: NativeRDD rides Spark's task retry and shuffle-service
+side-cars).  This module is that driver tier for the TPU engine: a
+``FleetManager`` supervises N ``ExecutorEndpoint``s
+(serving/executor_endpoint.py — LocalExecutor in-process, or
+ProcessExecutor worker processes it spawned), keeps ONE
+AdmissionController as the front-door ledger (per-process MemManager
+budgets federate under one global budget via `budget_fn`), routes each
+admitted submission to the least-loaded healthy executor, and survives
+crashes:
+
+- **Heartbeats** (`auron.fleet.heartbeat.seconds`): a monitor thread
+  probes every executor on a fixed cadence; the reply carries the
+  executor's in-flight query states, so completion/result handling
+  rides the same RPC.
+- **Health state machine** (``ExecutorHealth``): alive -> suspect ->
+  dead.  Only heartbeat probes move an executor toward death
+  (`auron.fleet.death.probes` consecutive failures, re-probed with
+  capped exponential backoff); a non-heartbeat RPC failure marks it
+  SUSPECT and pulls the next probe forward but never kills on its own
+  — that is the heartbeat-vs-RPC precedence contract.  DEAD is sticky:
+  a late heartbeat from a restarted incarnation must not resurrect an
+  id whose queries were already requeued elsewhere.
+- **Cross-process kill-and-requeue**: on executor death (including
+  ``kill -9``) every in-flight query on it is requeued on a DIFFERENT
+  executor — the dead id joins the submission's
+  ``excluded_executors``, its admission reservation is released and
+  its fleet marks cleared BEFORE it re-enters the queue, and no
+  `auron.task.retries` budget is consumed (the re-dispatch is a fresh
+  execution, the PR 10 deterministic-cancel contract generalized
+  across the process boundary).  Re-execution is bit-identical to a
+  solo run.
+- **Flap damping**: an executor that oscillates alive/suspect is
+  circuit-broken out of routing (`auron.fleet.flap.*`,
+  `auron.fleet.circuit.break.seconds`).
+- **Graceful drain** (``decommission``): the executor stops accepting
+  dispatches, its queued-but-not-started work is rerouted, running
+  queries finish where they are.
+
+The FleetManager presents the QueryScheduler surface (submit / status /
+result / wait / cancel / stats / shutdown), so `QueryServer(scheduler=
+FleetManager(...))` serves the same HTTP routes over a fleet.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from auron_tpu import config
+from auron_tpu.runtime import counters, lockcheck
+from auron_tpu.serving.admission import ADMIT, AdmissionController
+from auron_tpu.serving.executor_endpoint import (
+    EndpointError, ExecutorEndpoint, LocalExecutor, ProcessExecutor,
+)
+from auron_tpu.serving.forecast import plan_signature
+from auron_tpu.serving.scheduler import (
+    CANCELLED, FAILED, QUEUED, RUNNING, SHED_STATE, SUCCEEDED,
+    Submission, SubmissionRejected,
+)
+
+log = logging.getLogger("auron_tpu.serving.fleet")
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+
+class ExecutorHealth:
+    """Per-executor liveness state machine (alive -> suspect -> dead).
+
+    Evidence rules (the heartbeat-vs-RPC-failure precedence contract):
+
+    - only HEARTBEAT probe outcomes move the machine toward death:
+      `death_probes` consecutive probe failures declare DEAD, with the
+      re-probe delay backing off exponentially from a quarter of the
+      heartbeat interval up to `backoff_max_s` — fast confirmation,
+      bounded probe pressure, death within ~3 heartbeat intervals at
+      the defaults;
+    - a non-heartbeat RPC failure makes an ALIVE executor SUSPECT and
+      pulls the next probe forward to NOW, but never counts toward
+      death on its own — a transport blip on a busy data path must not
+      kill an executor whose heartbeats still answer;
+    - a successful heartbeat outranks everything except death: it
+      clears the failure count and restores ALIVE;
+    - DEAD is STICKY: the fleet already requeued the executor's
+      in-flight queries, so a late heartbeat (a half-dead or restarted
+      incarnation) must not resurrect the id — that would double-run
+      queries.  Replace the endpoint to rejoin the fleet;
+    - flap damping: more than `flap_max` alive->suspect transitions
+      inside `flap_window_s` opens a routing circuit breaker for
+      `circuit_s` (`routable()` goes False while the state may still
+      be ALIVE).
+
+    `clock` is injectable so the transitions are unit-testable without
+    wall-clock sleeps.
+    """
+
+    def __init__(self, heartbeat_s: float = 2.0, death_probes: int = 3,
+                 backoff_max_s: float = 0.0, flap_max: int = 3,
+                 flap_window_s: float = 60.0, circuit_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.heartbeat_s = max(0.01, float(heartbeat_s))
+        self.death_probes = max(1, int(death_probes))
+        self.backoff_max_s = float(backoff_max_s) \
+            if backoff_max_s > 0 else self.heartbeat_s
+        self.flap_max = max(1, int(flap_max))
+        self.flap_window_s = float(flap_window_s)
+        self.circuit_s = float(circuit_s)
+        self._clock = clock
+        self.state = ALIVE
+        self.failures = 0              # consecutive failed probes
+        self.last_ok: Optional[float] = None
+        self.next_probe_at = self._clock() + self.heartbeat_s
+        self.circuit_until = 0.0
+        self.circuit_opens = 0
+        self._suspect_times: deque = deque()
+
+    @classmethod
+    def from_conf(cls,
+                  clock: Callable[[], float] = time.monotonic
+                  ) -> "ExecutorHealth":
+        conf = config.conf
+        return cls(
+            heartbeat_s=float(conf.get("auron.fleet.heartbeat.seconds")),
+            death_probes=int(conf.get("auron.fleet.death.probes")),
+            backoff_max_s=float(
+                conf.get("auron.fleet.probe.backoff.max.seconds")),
+            flap_max=int(conf.get("auron.fleet.flap.max")),
+            flap_window_s=float(
+                conf.get("auron.fleet.flap.window.seconds")),
+            circuit_s=float(
+                conf.get("auron.fleet.circuit.break.seconds")),
+            clock=clock)
+
+    def due(self) -> bool:
+        return self.state != DEAD and self._clock() >= self.next_probe_at
+
+    def probe_ok(self) -> str:
+        """A heartbeat answered.  Heartbeat success outranks RPC
+        suspicion — but never death (sticky)."""
+        if self.state == DEAD:
+            return DEAD
+        now = self._clock()
+        self.failures = 0
+        self.state = ALIVE
+        self.last_ok = now
+        self.next_probe_at = now + self.heartbeat_s
+        return self.state
+
+    def probe_failed(self) -> str:
+        """A heartbeat probe failed (after its RPC retry budget)."""
+        if self.state == DEAD:
+            return DEAD
+        now = self._clock()
+        self.failures += 1
+        self._mark_suspect(now)
+        if self.failures >= self.death_probes:
+            self.state = DEAD
+        else:
+            # capped exponential backoff between confirmation probes:
+            # base = heartbeat/4 (suspicion is confirmed FASTER than
+            # the healthy cadence), doubled per consecutive failure
+            delay = min(self.heartbeat_s / 4.0
+                        * (2 ** (self.failures - 1)),
+                        self.backoff_max_s)
+            self.next_probe_at = now + delay
+        return self.state
+
+    def rpc_failed(self) -> str:
+        """A non-heartbeat RPC failed: suspicion, an immediate probe —
+        but by itself never a step toward death (heartbeat precedence)."""
+        if self.state == DEAD:
+            return DEAD
+        now = self._clock()
+        self._mark_suspect(now)
+        self.next_probe_at = now
+        return self.state
+
+    def _mark_suspect(self, now: float) -> None:
+        if self.state != ALIVE:
+            return
+        self.state = SUSPECT
+        self._suspect_times.append(now)
+        horizon = now - self.flap_window_s
+        while self._suspect_times and self._suspect_times[0] < horizon:
+            self._suspect_times.popleft()
+        if len(self._suspect_times) >= self.flap_max:
+            self.circuit_until = now + self.circuit_s
+            self.circuit_opens += 1
+            self._suspect_times.clear()
+
+    def routable(self) -> bool:
+        return self.state == ALIVE and \
+            self._clock() >= self.circuit_until
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = self._clock()
+        return {"state": self.state, "failures": self.failures,
+                "routable": self.routable(),
+                "circuit_open": now < self.circuit_until,
+                "circuit_opens": self.circuit_opens,
+                "last_ok_age_s": (round(now - self.last_ok, 3)
+                                  if self.last_ok is not None else None)}
+
+
+@dataclass
+class FleetSubmission(Submission):
+    """A Submission plus its fleet placement: which executor holds it,
+    under which dispatch id (unique per attempt, so a rerouted query
+    can never collide with its own terminal record on a scheduler that
+    saw an earlier attempt), and which executors are excluded after a
+    death/drain requeue."""
+
+    executor_id: Optional[str] = None
+    dispatch_id: Optional[str] = None
+    excluded_executors: Set[str] = field(default_factory=set)
+    requeues: int = 0
+
+    def status(self) -> Dict[str, Any]:
+        doc = super().status()
+        doc.update({"executor": self.executor_id,
+                    "requeues": self.requeues,
+                    "excluded_executors":
+                        sorted(self.excluded_executors)})
+        return doc
+
+
+@dataclass
+class _ExecHandle:
+    """Fleet-side bookkeeping for one endpoint (guarded by the fleet
+    lock except where noted; RPCs always run outside it)."""
+
+    endpoint: ExecutorEndpoint
+    health: ExecutorHealth
+    inflight: Dict[str, str] = field(default_factory=dict)
+    # ^ dispatch id -> fleet query id; statuses for ids not in here are
+    # stale by definition (requeued away) and are ignored
+    dispatched: int = 0
+    draining: bool = False
+    dead: bool = False
+    load: Dict[str, Any] = field(default_factory=dict)
+
+    def snapshot(self) -> Dict[str, Any]:
+        doc = {"inflight": len(self.inflight),
+               "dispatched": self.dispatched,
+               "draining": self.draining, "dead": self.dead,
+               "load": dict(self.load)}
+        doc.update(self.health.snapshot())
+        if self.dead:
+            doc["state"] = DEAD
+            doc["routable"] = False
+        doc.update(self.endpoint.describe())
+        return doc
+
+
+class FleetManager:
+    """Submission registry + front-door admission + executor routing +
+    failure supervision.  Presents the QueryScheduler client surface so
+    QueryServer/profiling serve it unchanged."""
+
+    def __init__(self, endpoints: Optional[List[ExecutorEndpoint]] = None,
+                 session_factory=None,
+                 admission: Optional[AdmissionController] = None,
+                 budget_bytes: int = 0):
+        if endpoints is None:
+            endpoints = [LocalExecutor(session_factory=session_factory)]
+        self._budget_bytes = int(budget_bytes)
+        self.admission = admission or AdmissionController(
+            budget_fn=self._fleet_budget,
+            executors_fn=self._routable_count)
+        self._lock = lockcheck.Lock("fleet.manager")
+        self._handles: Dict[str, _ExecHandle] = {}
+        for ep in endpoints:
+            if ep.executor_id in self._handles:
+                raise ValueError(
+                    f"duplicate executor id {ep.executor_id!r}")
+            self._handles[ep.executor_id] = _ExecHandle(
+                endpoint=ep, health=ExecutorHealth.from_conf())
+        self._subs: Dict[str, FleetSubmission] = {}
+        self._queue: List[FleetSubmission] = []
+        self._seq = 0
+        self._shutdown = False
+        self._wake = threading.Event()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="auron-fleet-monitor")
+        self._monitor.start()
+
+    # -- construction helpers ----------------------------------------------
+
+    @classmethod
+    def spawn(cls, n: int, conf_map: Optional[Dict[str, Any]] = None,
+              budget_bytes: int = 0,
+              log_dir: Optional[str] = None) -> "FleetManager":
+        """Launch N worker processes, each with an equal slice of the
+        federated memory budget (`auron.fleet.memory.budget.bytes`,
+        else the driver manager's budget)."""
+        from auron_tpu.memmgr import get_manager
+        n = max(1, int(n))
+        total = int(budget_bytes) or \
+            int(config.conf.get("auron.fleet.memory.budget.bytes")) or \
+            get_manager().budget
+        endpoints: List[ExecutorEndpoint] = []
+        try:
+            for i in range(n):
+                endpoints.append(ProcessExecutor.spawn(
+                    f"exec-{i}", conf_map=conf_map,
+                    budget_bytes=max(1, total // n), log_dir=log_dir))
+        except BaseException:
+            for ep in endpoints:
+                ep.kill()
+            raise
+        return cls(endpoints=endpoints, budget_bytes=total)
+
+    def _fleet_budget(self) -> int:
+        if self._budget_bytes:
+            return self._budget_bytes
+        from auron_tpu.memmgr import get_manager
+        return get_manager().budget
+
+    def _routable_count(self) -> int:
+        with self._lock:
+            return max(1, len(self._routable_locked()))
+
+    # -- submission (the QueryScheduler surface) ---------------------------
+
+    def submit(self, plan, conf: Optional[Dict[str, Any]] = None,
+               priority: Optional[int] = None,
+               query_id: Optional[str] = None) -> str:
+        from auron_tpu.runtime import tracing
+        if self._shutdown:
+            raise SubmissionRejected("fleet is shut down")
+        overrides = dict(conf or {})
+        # validate the per-query conf NOW (400 at submit, the
+        # scheduler.submit contract) — it also travels to the executor
+        config.conf.query_scoped(overrides)
+        if priority is None:
+            priority = int(overrides.get(
+                "auron.query.priority",
+                config.conf.get("auron.query.priority")))
+        qid = query_id or tracing.new_query_id()
+        sub = FleetSubmission(query_id=qid, plan=plan, conf=overrides,
+                              priority=int(priority),
+                              signature=plan_signature(plan))
+        with self._lock:
+            if qid in self._subs:
+                raise SubmissionRejected(f"duplicate query id {qid!r}")
+            if len(self._queue) >= \
+                    int(config.conf.get("auron.admission.queue.max")):
+                sub.state = SHED_STATE
+                sub.error = "shed: admission queue full"
+                sub.done.set()
+                self._subs[qid] = sub
+                self.admission.events["shed"] += 1
+                queue_len = len(self._queue)
+            else:
+                self._seq += 1
+                sub.seq = self._seq
+                self._subs[qid] = sub
+                self._queue.append(sub)
+                queue_len = -1
+        if queue_len >= 0:
+            counters.bump("admission_shed")
+            exc = SubmissionRejected(sub.error)
+            exc.retry_after_s = self.admission.drain_estimate_s(queue_len)
+            raise exc
+        counters.bump("fleet_submissions")
+        self._pump()
+        return qid
+
+    # -- the pump: admit + route + dispatch --------------------------------
+
+    def _pump(self) -> None:
+        while True:
+            target: Optional[_ExecHandle] = None
+            head: Optional[FleetSubmission] = None
+            with self._lock:
+                if self._shutdown or not self._queue:
+                    return
+                self._expire_locked()
+                if not self._queue:
+                    return
+                cands = self._routable_locked()
+                if not cands:
+                    self._fail_if_fleet_dead_locked()
+                    return
+                # fleet-wide slot cap: max.concurrent driver slots on
+                # every routable executor.  Only ROUTABLE executors'
+                # in-flight work counts — queries finishing on a
+                # draining executor must not starve dispatches to
+                # healthy ones
+                slots = max(1, int(config.conf.get(
+                    "auron.serving.max.concurrent"))) * len(cands)
+                inflight = sum(len(h.inflight) for h in cands)
+                if inflight >= slots:
+                    return
+                aging = float(config.conf.get(
+                    "auron.admission.aging.seconds"))
+                now = time.time()
+                head = min(self._queue,
+                           key=lambda s: (-s.effective_priority(aging,
+                                                                now),
+                                          s.seq))
+                decision = self.admission.offer(
+                    head.query_id, head.signature,
+                    queue_len=len(self._queue) - 1,
+                    count_queue_event=head.admission_reason == "")
+                head.admission_reason = decision.reason
+                head.forecast_bytes = decision.forecast_bytes
+                if decision.action != ADMIT:
+                    return
+                head.serial = decision.serial
+                # requeued queries go to a DIFFERENT executor; if every
+                # routable executor is excluded, progress beats
+                # placement preference (documented fallback)
+                preferred = [h for h in cands
+                             if h.endpoint.executor_id
+                             not in head.excluded_executors]
+                pool = preferred or cands
+                target = min(pool,
+                             key=lambda h: (len(h.inflight),
+                                            h.dispatched,
+                                            h.endpoint.executor_id))
+                self._queue.remove(head)
+                head.state = RUNNING
+                head.started_at = time.time()
+                head.executor_id = target.endpoint.executor_id
+                head.dispatch_id = head.query_id if not head.requeues \
+                    else f"{head.query_id}~r{head.requeues}"
+                target.inflight[head.dispatch_id] = head.query_id
+                target.dispatched += 1
+            # RPC outside the lock
+            try:
+                target.endpoint.dispatch(
+                    head.dispatch_id, head.plan, head.conf,
+                    head.priority, serial=head.serial)
+                counters.bump("fleet_dispatches")
+            except BaseException as e:  # noqa: BLE001 - classified below
+                self._dispatch_failed(target, head, e)
+
+    def _routable_locked(self) -> List[_ExecHandle]:
+        return [h for h in self._handles.values()
+                if not h.dead and not h.draining
+                and h.health.routable()]
+
+    def _fail_if_fleet_dead_locked(self) -> None:
+        """With EVERY executor dead there is nothing to wait for —
+        queued submissions fail loudly instead of aging forever.
+        (Suspect/circuit-broken executors can recover; dead cannot.)"""
+        if any(not h.dead for h in self._handles.values()):
+            return
+        for sub in list(self._queue):
+            self._queue.remove(sub)
+            sub.state = FAILED
+            sub.error = "no live executors in the fleet"
+            sub.finished_at = time.time()
+            sub.done.set()
+
+    def _expire_locked(self) -> None:
+        timeout = float(config.conf.get(
+            "auron.admission.queue.timeout.seconds"))
+        if timeout <= 0:
+            return
+        now = time.time()
+        for sub in list(self._queue):
+            if now - sub.queued_since > timeout:
+                self._queue.remove(sub)
+                sub.state = FAILED
+                sub.error = f"admission timeout after {timeout:g}s"
+                sub.finished_at = now
+                sub.done.set()
+
+    def _dispatch_failed(self, handle: _ExecHandle,
+                         sub: FleetSubmission, exc: BaseException) -> None:
+        draining = isinstance(exc, EndpointError) and exc.draining
+        deterministic = isinstance(exc, EndpointError) \
+            and exc.auron_deterministic and not draining
+        with self._lock:
+            handle.inflight.pop(sub.dispatch_id, None)
+            if draining:
+                handle.draining = True
+            elif not deterministic:
+                # transport trouble: suspicion + an immediate probe —
+                # the health machine (not this dispatch) decides death
+                handle.health.rpc_failed()
+        if deterministic:
+            # the executor answered and refused (bad plan, duplicate):
+            # rerouting cannot change the answer — one red row
+            sub.state = FAILED
+            sub.error = f"{type(exc).__name__}: {exc}"
+            self.admission.release(sub.query_id)
+            sub.finished_at = time.time()
+            sub.done.set()
+            log.warning("fleet dispatch of %s to %s refused: %s",
+                        sub.query_id, handle.endpoint.executor_id,
+                        sub.error)
+            return
+        log.warning("fleet dispatch of %s to %s failed (%s); requeueing",
+                    sub.query_id, handle.endpoint.executor_id, exc)
+        self._requeue(sub, handle, exclude=False)
+
+    # -- requeue (the cross-process kill-and-requeue arm) ------------------
+
+    def _requeue(self, sub: FleetSubmission, handle: _ExecHandle,
+                 exclude: bool = True) -> None:
+        """Move a submission back to the fleet queue.  Order is
+        load-bearing (the PR 10 contract): reservation released and
+        marks cleared BEFORE the submission becomes runnable again, so
+        a requeued run starts with a clean slate.  Requeues never
+        consume `auron.task.retries` budgets — the re-dispatch is a
+        fresh execution on a fresh scheduler."""
+        with self._lock:
+            handle.inflight.pop(sub.dispatch_id, None)
+            if sub.done.is_set() or sub.state not in (RUNNING, QUEUED):
+                return
+            if sub in self._queue:
+                return
+            sub.state = "requeueing"   # invisible outside the lock
+        self.admission.release(sub.query_id)
+        with self._lock:
+            if self._shutdown:
+                sub.state = CANCELLED
+                sub.error = "fleet shut down during requeue"
+                sub.finished_at = time.time()
+                sub.done.set()
+                return
+            if exclude:
+                sub.excluded_executors.add(handle.endpoint.executor_id)
+            sub.requeues += 1
+            sub.state = QUEUED
+            sub.started_at = None
+            sub.error = None
+            sub.admission_reason = ""
+            sub.executor_id = None
+            sub.queued_since = time.time()
+            self._queue.append(sub)
+        counters.bump("fleet_requeues")
+        self._pump()
+
+    # -- the monitor: heartbeats, status absorption, death -----------------
+
+    def _tick_s(self) -> float:
+        hb = min((h.health.heartbeat_s
+                  for h in self._handles.values()), default=2.0)
+        return max(0.02, min(0.5, hb / 4.0))
+
+    def _monitor_loop(self) -> None:
+        while True:
+            self._wake.wait(self._tick_s())
+            self._wake.clear()
+            if self._shutdown:
+                return
+            for handle in list(self._handles.values()):
+                if self._shutdown:
+                    return
+                with self._lock:
+                    due = not handle.dead and handle.health.due()
+                if due:
+                    self._probe(handle)
+            # timeouts/aging/late capacity make progress even when no
+            # submit/completion event fires
+            self._pump()
+
+    def _probe(self, handle: _ExecHandle) -> None:
+        with self._lock:
+            ids = list(handle.inflight)
+        try:
+            resp = handle.endpoint.heartbeat(ids)
+        except BaseException as e:  # noqa: BLE001 - health-classified
+            with self._lock:
+                state = handle.health.probe_failed()
+            if state == DEAD:
+                self._on_executor_death(handle, reason=str(e))
+            return
+        with self._lock:
+            handle.health.probe_ok()
+            handle.load = dict(resp.get("load") or {})
+            if handle.load.get("draining"):
+                handle.draining = True
+        queries = resp.get("queries") or {}
+        for did in ids:
+            self._absorb_status(handle, did, queries.get(did))
+
+    def _absorb_status(self, handle: _ExecHandle, dispatch_id: str,
+                       status: Optional[Dict[str, Any]]) -> None:
+        with self._lock:
+            qid = handle.inflight.get(dispatch_id)
+            sub = self._subs.get(qid) if qid is not None else None
+        if sub is None:
+            return
+        if status is None:
+            # the executor does not know the query (a restarted
+            # incarnation answering under the old address): lost work,
+            # reroute it
+            log.warning("executor %s lost query %s; requeueing",
+                        handle.endpoint.executor_id, sub.query_id)
+            self._requeue(sub, handle)
+            return
+        state = status.get("state")
+        # executor-internal preemptions (PR 10 inside the worker)
+        # surface on the fleet row
+        sub.num_preemptions = int(status.get("preemptions") or 0)
+        if state == SUCCEEDED:
+            self._finish_success(handle, sub, status)
+        elif state in (FAILED, CANCELLED, SHED_STATE):
+            self._finish_failure(handle, sub, status, state)
+
+    def _finish_success(self, handle: _ExecHandle, sub: FleetSubmission,
+                        status: Dict[str, Any]) -> None:
+        try:
+            table = handle.endpoint.result(sub.dispatch_id)
+        except BaseException as e:  # noqa: BLE001 - next round decides
+            # transient: leave it in flight — the next heartbeat
+            # retries, and a real death requeues (re-execution is
+            # bit-identical, so fetch-vs-rerun cannot diverge)
+            with self._lock:
+                handle.health.rpc_failed()
+            log.warning("result fetch for %s from %s failed: %s",
+                        sub.query_id, handle.endpoint.executor_id, e)
+            return
+        self.admission.release(sub.query_id)
+        mem_peak = int(status.get("mem_peak") or 0)
+        if mem_peak:
+            self.admission.observe(sub.signature, mem_peak)
+        with self._lock:
+            handle.inflight.pop(sub.dispatch_id, None)
+            if sub.done.is_set():
+                return
+            sub.result = table
+            sub.rows = table.num_rows
+            sub.wall_s = float(status.get("wall_s") or 0.0)
+            sub.mem_peak = mem_peak
+            sub.state = SUCCEEDED
+            sub.finished_at = time.time()
+            sub.done.set()
+        counters.bump("fleet_completions")
+        self._pump()
+
+    def _finish_failure(self, handle: _ExecHandle, sub: FleetSubmission,
+                        status: Dict[str, Any], state: str) -> None:
+        self.admission.release(sub.query_id)
+        with self._lock:
+            handle.inflight.pop(sub.dispatch_id, None)
+            if sub.done.is_set():
+                return
+            sub.state = state
+            sub.error = status.get("error") or state
+            sub.finished_at = time.time()
+            sub.done.set()
+        if state == CANCELLED:
+            counters.bump("queries_cancelled")
+        self._pump()
+
+    def _on_executor_death(self, handle: _ExecHandle,
+                           reason: str) -> None:
+        with self._lock:
+            if handle.dead:
+                return
+            handle.dead = True
+            victims = [(did, qid)
+                       for did, qid in handle.inflight.items()]
+            handle.inflight.clear()
+        counters.bump("fleet_deaths")
+        log.warning("executor %s declared DEAD (%s); requeueing %d "
+                    "in-flight query(ies) on surviving executors",
+                    handle.endpoint.executor_id, reason, len(victims))
+        # fence: a half-alive incarnation must not keep executing work
+        # that is about to run elsewhere
+        handle.endpoint.kill()
+        for _did, qid in victims:
+            with self._lock:
+                sub = self._subs.get(qid)
+            if sub is not None:
+                self._requeue(sub, handle)
+        self._pump()
+
+    # -- decommission (graceful drain) -------------------------------------
+
+    def decommission(self, executor_id: str) -> List[str]:
+        """Drain an executor: stop routing to it, move its queued (not
+        yet started) work to other executors, let running queries
+        finish where they are.  Returns the rerouted query ids."""
+        handle = self._handles.get(executor_id)
+        if handle is None:
+            raise KeyError(f"unknown executor {executor_id!r}")
+        with self._lock:
+            handle.draining = True
+        moved_dispatch_ids = handle.endpoint.drain()
+        rerouted = []
+        for did in moved_dispatch_ids:
+            with self._lock:
+                qid = handle.inflight.get(did)
+                sub = self._subs.get(qid) if qid is not None else None
+            if sub is not None:
+                self._requeue(sub, handle)
+                rerouted.append(sub.query_id)
+        self._pump()
+        return rerouted
+
+    # -- client surface ----------------------------------------------------
+
+    def get(self, query_id: str) -> Optional[FleetSubmission]:
+        with self._lock:
+            return self._subs.get(query_id)
+
+    def status(self, query_id: str) -> Optional[Dict[str, Any]]:
+        sub = self.get(query_id)
+        if sub is None:
+            return None
+        self._pump()
+        return sub.status()
+
+    def result(self, query_id: str):
+        sub = self.get(query_id)
+        return sub.result if sub is not None else None
+
+    def wait(self, query_id: str,
+             timeout: Optional[float] = None) -> bool:
+        sub = self.get(query_id)
+        if sub is None:
+            return False
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            remaining = None if deadline is None \
+                else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                return sub.done.is_set()
+            slice_s = 0.1 if remaining is None else min(0.1, remaining)
+            if sub.done.wait(slice_s):
+                return True
+            self._wake.set()
+
+    def cancel(self, query_id: str) -> bool:
+        with self._lock:
+            sub = self._subs.get(query_id)
+            if sub is None or sub.done.is_set():
+                return False
+            if sub.state == QUEUED:
+                if sub in self._queue:
+                    self._queue.remove(sub)
+                sub.state = CANCELLED
+                sub.error = "cancelled while queued"
+                sub.finished_at = time.time()
+                sub.done.set()
+                counters.bump("queries_cancelled")
+                return True
+            handle = self._handles.get(sub.executor_id or "")
+            dispatch_id = sub.dispatch_id
+        if handle is None or dispatch_id is None:
+            return False
+        self.admission.release(query_id)
+        try:
+            handle.endpoint.cancel(dispatch_id)
+        except BaseException as e:  # noqa: BLE001 - health-classified
+            with self._lock:
+                handle.health.rpc_failed()
+            log.warning("cancel RPC for %s to %s failed: %s", query_id,
+                        handle.endpoint.executor_id, e)
+        # the terminal 'cancelled' state is absorbed from the next
+        # heartbeat (or the executor's death requeues — and a
+        # cancelled fleet row is never requeued: done wins)
+        return True
+
+    def executor_up(self) -> Dict[str, int]:
+        """1/0 liveness per executor — the `auron_fleet_executor_up`
+        gauge on /metrics."""
+        with self._lock:
+            return {eid: 0 if h.dead else 1
+                    for eid, h in self._handles.items()}
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {eid: h.snapshot()
+                    for eid, h in self._handles.items()}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            states: Dict[str, int] = {}
+            requeues = 0
+            preemptions = 0
+            for sub in self._subs.values():
+                states[sub.state] = states.get(sub.state, 0) + 1
+                requeues += sub.requeues
+                preemptions += sub.num_preemptions
+            queued = len(self._queue)
+            running = states.get(RUNNING, 0)
+        return {"queued": queued, "running": running, "states": states,
+                "preemptions": preemptions, "requeues": requeues,
+                "admission": self.admission.snapshot(),
+                "fleet": {"executors": self.fleet_snapshot()},
+                "task_queues": {}}
+
+    def shutdown(self, wait: bool = False,
+                 timeout: float = 30.0) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            for sub in self._queue:
+                sub.state = CANCELLED
+                sub.error = "fleet shut down"
+                sub.finished_at = time.time()
+                sub.done.set()
+            self._queue.clear()
+            handles = list(self._handles.values())
+        self._wake.set()
+        self._monitor.join(timeout=10)
+        for handle in handles:
+            try:
+                handle.endpoint.close()
+            except BaseException as e:  # noqa: BLE001 - best effort
+                log.warning("closing executor %s failed: %s",
+                            handle.endpoint.executor_id, e)
+        if wait:
+            deadline = time.time() + timeout
+            for handle in handles:
+                proc = getattr(handle.endpoint, "proc", None)
+                if proc is not None and proc.poll() is None:
+                    try:
+                        proc.wait(max(0.1, deadline - time.time()))
+                    except Exception:
+                        proc.kill()

@@ -412,6 +412,14 @@ class QueryScheduler:
         with self._lock:
             return self._subs.get(query_id)
 
+    def queued_ids(self) -> List[str]:
+        """Query ids still waiting in the admission queue (oldest
+        first) — the executor-server drain RPC reports these so a
+        FleetManager can move them to another executor without
+        touching running queries."""
+        with self._lock:
+            return [sub.query_id for sub in self._queue]
+
     def status(self, query_id: str) -> Optional[Dict[str, Any]]:
         sub = self.get(query_id)
         if sub is None:

@@ -105,10 +105,11 @@ def parse_submission(body: Dict[str, Any]) -> ForeignNode:
 
 class QueryServer:
     """One port serving submissions + observability: a ProfilingServer
-    with a QueryScheduler installed for the serving routes."""
+    with a QueryScheduler (or a serving.fleet.FleetManager — same
+    client surface, multi-process execution) installed for the serving
+    routes."""
 
-    def __init__(self, scheduler: Optional[QueryScheduler] = None,
-                 session_factory=None,
+    def __init__(self, scheduler=None, session_factory=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.scheduler = scheduler or \
             QueryScheduler(session_factory=session_factory)

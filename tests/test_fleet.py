@@ -1,0 +1,929 @@
+"""Fleet-serving tests (PR 11): crash-surviving multi-process serving.
+
+- ExecutorHealth unit suite: alive -> suspect -> dead transitions,
+  capped probe backoff, flap -> circuit-break, heartbeat-vs-RPC-failure
+  precedence, dead stickiness.
+- Endpoint RPC classification: transport failures are retryable-IO
+  through the ONE retry policy (named `fleet.*` fault points), answered
+  failures are deterministic EndpointErrors, and the
+  `auron_retry_exhausted` marker propagates across the process boundary
+  so outer retry sites never multiply a spent budget.
+- ExecutorServer/ProcessExecutor wire roundtrips + graceful drain.
+- FleetManager: least-loaded routing, cross-process kill-and-requeue on
+  executor death (requeued on a DIFFERENT executor, reservation
+  released and marks cleared first), decommission moves queued work
+  without killing running queries, HTTP surface (/scheduler fleet
+  view, auron_fleet_* metrics).
+- THE acceptance stress: 6 concurrent corpus queries across 2 worker
+  PROCESSES under io+latency faults, one worker killed with `kill -9`
+  mid-query — death detected within 3 heartbeat intervals, its
+  in-flight queries requeued on the survivor, every result
+  bit-identical to its solo fault-free run, zero task-retry budget
+  consumed by the requeues, ledgers drained, no leaked processes or
+  threads.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu import faults
+from auron_tpu.config import conf
+from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.it.datagen import generate
+from auron_tpu.memmgr import manager as mem_manager
+from auron_tpu.memmgr.manager import reset_manager
+from auron_tpu.runtime import counters, retry, task_pool
+from auron_tpu.serving import (
+    EndpointError, ExecutorHealth, ExecutorServer, FleetManager,
+    LocalExecutor, ProcessExecutor, QueryServer, register_catalog,
+)
+from auron_tpu.serving.fleet import ALIVE, DEAD, SUSPECT
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    cat = generate(str(tmp_path_factory.mktemp("fleet_tpcds")), sf=SF,
+                   fact_chunks=3)
+    register_catalog(SF, cat)
+    return cat
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    """Fleet tests mutate process singletons; leave clean defaults
+    behind (incl. the per-manager + compat memmgr hooks)."""
+    yield
+    faults.reset()
+    mem_manager.reset_hooks()
+    reset_manager()
+    task_pool.reset_pool()
+
+
+def _canon(table: pa.Table) -> pa.Table:
+    t = table.combine_chunks()
+    if t.num_rows and t.num_columns:
+        t = t.sort_by([(n, "ascending") for n in t.column_names])
+    return t
+
+
+def _tiny_plan(tag="t") -> ForeignNode:
+    return ForeignNode.from_dict(
+        {"op": "LocalTableScan",
+         "schema": [{"name": "x", "type": "long"}],
+         "attrs": {"tag": tag}, "rows": [[1], [2], [3]],
+         "children": []})
+
+
+class _FakeResult:
+    def __init__(self, table):
+        self.table = table
+        self.wall_s = 0.01
+        self.metrics = []
+
+
+class _FastSession:
+    def execute(self, plan, mesh=None, mesh_axis="parts",
+                query_id=None):
+        return _FakeResult(pa.table({"x": [1, 2, 3]}))
+
+
+class _BlockingFactory:
+    """Sessions block until `release` is set (keeps queries in flight
+    so drains/kills land mid-query)."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.started = threading.Event()
+
+    def __call__(self):
+        outer = self
+
+        class _S:
+            def execute(self, plan, mesh=None, mesh_axis="parts",
+                        query_id=None):
+                outer.started.set()
+                outer.release.wait(60)
+                return _FakeResult(pa.table({"x": [1, 2, 3]}))
+
+        return _S()
+
+
+# ---------------------------------------------------------------------------
+# ExecutorHealth: the alive -> suspect -> dead state machine
+# ---------------------------------------------------------------------------
+
+def _health(**kw):
+    t = [0.0]
+    defaults = dict(heartbeat_s=1.0, death_probes=3, backoff_max_s=0.0,
+                    flap_max=99, flap_window_s=100.0, circuit_s=5.0,
+                    clock=lambda: t[0])
+    defaults.update(kw)
+    return ExecutorHealth(**defaults), t
+
+
+def test_health_alive_to_suspect_to_dead():
+    h, _t = _health()
+    assert h.state == ALIVE and h.routable()
+    assert h.probe_failed() == SUSPECT
+    assert not h.routable()            # suspects receive no new work
+    assert h.probe_failed() == SUSPECT
+    assert h.probe_failed() == DEAD
+
+
+def test_health_probe_ok_recovers_and_resets_failures():
+    h, _t = _health()
+    h.probe_failed()
+    h.probe_failed()
+    assert h.state == SUSPECT and h.failures == 2
+    assert h.probe_ok() == ALIVE
+    assert h.failures == 0 and h.routable()
+    # the count restarts: death still needs death_probes CONSECUTIVE
+    h.probe_failed()
+    h.probe_failed()
+    assert h.state == SUSPECT
+
+
+def test_health_dead_is_sticky():
+    h, _t = _health(death_probes=1)
+    assert h.probe_failed() == DEAD
+    # a late heartbeat from a half-dead/restarted incarnation must not
+    # resurrect the id — its queries were already requeued elsewhere
+    assert h.probe_ok() == DEAD
+    assert h.rpc_failed() == DEAD
+    assert not h.routable() and not h.due()
+
+
+def test_health_rpc_failure_precedence():
+    """RPC failures mark SUSPECT and pull the probe forward, but only
+    heartbeat probes move the machine toward death; heartbeat success
+    outranks RPC suspicion."""
+    h, t = _health()
+    t[0] = 0.5
+    for _ in range(10):                 # 10 RPC failures: never dead
+        assert h.rpc_failed() == SUSPECT
+    assert h.failures == 0              # no death credit
+    assert h.due()                      # probe pulled forward to NOW
+    assert h.probe_ok() == ALIVE        # heartbeat wins
+    assert h.routable()
+
+
+def test_health_backoff_caps():
+    h, t = _health(death_probes=10, backoff_max_s=0.0)  # cap = heartbeat
+    delays = []
+    for _ in range(5):
+        h.probe_failed()
+        delays.append(round(h.next_probe_at - t[0], 6))
+    # base hb/4, doubling, capped at the heartbeat interval
+    assert delays == [0.25, 0.5, 1.0, 1.0, 1.0]
+    h2, t2 = _health(death_probes=10, backoff_max_s=0.4)
+    for _ in range(3):
+        h2.probe_failed()
+    assert round(h2.next_probe_at - t2[0], 6) == 0.4
+
+
+def test_health_flap_circuit_breaks_routing():
+    h, t = _health(flap_max=2, flap_window_s=100.0, circuit_s=5.0)
+    h.probe_failed()                    # flap 1
+    h.probe_ok()
+    h.probe_failed()                    # flap 2 -> circuit opens
+    h.probe_ok()
+    assert h.state == ALIVE
+    assert not h.routable()             # alive but circuit-broken
+    assert h.circuit_opens == 1
+    t[0] += 5.1
+    assert h.routable()                 # breaker closes
+
+
+def test_health_flap_window_expires():
+    h, t = _health(flap_max=2, flap_window_s=1.0, circuit_s=5.0)
+    h.probe_failed()
+    h.probe_ok()
+    t[0] += 2.0                         # first flap leaves the window
+    h.probe_failed()
+    h.probe_ok()
+    assert h.routable()
+
+
+def test_health_due_follows_heartbeat_cadence():
+    h, t = _health()
+    assert not h.due()
+    t[0] = 1.0
+    assert h.due()
+    h.probe_ok()
+    assert not h.due()
+
+
+def test_health_from_conf_reads_fleet_knobs():
+    with conf.scoped({"auron.fleet.heartbeat.seconds": 0.5,
+                      "auron.fleet.death.probes": 7,
+                      "auron.fleet.flap.max": 4,
+                      "auron.fleet.circuit.break.seconds": 9.0}):
+        h = ExecutorHealth.from_conf()
+    assert h.heartbeat_s == 0.5
+    assert h.death_probes == 7
+    assert h.flap_max == 4
+    assert h.circuit_s == 9.0
+    assert h.backoff_max_s == 0.5       # 0 -> capped at the heartbeat
+
+
+# ---------------------------------------------------------------------------
+# endpoint RPC classification (retryable IO vs deterministic, exhausted
+# markers across the process boundary)
+# ---------------------------------------------------------------------------
+
+def test_endpoint_error_is_deterministic_for_both_classifiers():
+    e = EndpointError("refused")
+    assert e.auron_deterministic
+    assert not retry.is_retryable(e)
+    assert not retry.task_classify(e)
+
+
+def test_endpoint_error_carries_exhausted_marker():
+    e = EndpointError("spent", exhausted=True)
+    assert e.auron_retry_exhausted
+    # an outer retry site must ferry it, never replay it
+    calls = []
+
+    def _fn():
+        calls.append(1)
+        raise e
+
+    with pytest.raises(EndpointError):
+        retry.call_with_retry(_fn, label="outer")
+    assert len(calls) == 1
+
+
+def _start_server(session_factory=None, executor_id="srv"):
+    srv = ExecutorServer(session_factory=session_factory or _FastSession,
+                         executor_id=executor_id).start()
+    return srv, ProcessExecutor(executor_id, *srv.address)
+
+
+def test_rpc_transport_faults_ride_the_shared_retry_policy():
+    srv, ep = _start_server()
+    spec = "fleet.heartbeat:io:p=1,max=2,seed=3"
+    try:
+        with conf.scoped({"auron.faults.spec": spec,
+                          "auron.retry.backoff.base.ms": 1.0,
+                          "auron.retry.backoff.max.ms": 5.0}):
+            faults.reset(spec)
+            resp = ep.heartbeat()      # 2 injected failures, 3 attempts
+            assert resp["executor_id"] == "srv"
+            assert faults.registry_for(spec).injected_total() == 2
+    finally:
+        srv.stop()
+
+
+def test_rpc_exhaustion_marks_budget_spent():
+    srv, ep = _start_server()
+    spec = "fleet.heartbeat:io:p=1,seed=3"   # unbounded: every attempt
+    try:
+        with conf.scoped({"auron.faults.spec": spec,
+                          "auron.retry.backoff.base.ms": 1.0,
+                          "auron.retry.backoff.max.ms": 5.0}):
+            faults.reset(spec)
+            with pytest.raises(faults.InjectedIOError) as ei:
+                ep.heartbeat()
+            assert getattr(ei.value, "auron_retry_exhausted", False)
+            assert len(ei.value.auron_attempts) == 3
+    finally:
+        srv.stop()
+
+
+def test_worker_exhausted_marker_propagates_over_the_wire():
+    """A worker whose own retry budget is spent ferries the marker
+    in-band; the client-side EndpointError carries it so an outer site
+    never multiplies the budget."""
+    import socket as _socket
+
+    from auron_tpu.shuffle_rss.server import recv_msg, send_msg
+    lst = _socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    host, port = lst.getsockname()
+
+    def _serve_one():
+        s, _ = lst.accept()
+        recv_msg(s)
+        send_msg(s, {"ok": False, "error": "inner budget spent",
+                     "deterministic": False, "exhausted": True})
+        s.close()
+
+    t = threading.Thread(target=_serve_one, daemon=True)
+    t.start()
+    ep = ProcessExecutor("stub", host, port)
+    try:
+        with pytest.raises(EndpointError) as ei:
+            ep.heartbeat()
+        assert getattr(ei.value, "auron_retry_exhausted", False)
+        # exhausted beats non-deterministic: is_retryable ferries it
+        assert not retry.is_retryable(ei.value)
+        t.join(5)
+    finally:
+        lst.close()
+
+
+def test_unknown_command_and_missing_result_are_deterministic():
+    srv, ep = _start_server()
+    try:
+        with pytest.raises(EndpointError) as ei:
+            ep.result("no-such-query")
+        assert ei.value.auron_deterministic
+        with pytest.raises(EndpointError):
+            ep._rpc("status", {"cmd": "frobnicate"})
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# endpoint roundtrips + graceful drain
+# ---------------------------------------------------------------------------
+
+def test_local_executor_endpoint_roundtrip():
+    ep = LocalExecutor(session_factory=_FastSession)
+    try:
+        ep.dispatch("q-1", _tiny_plan(), {}, 1)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = ep.status("q-1")
+            if st and st["state"] == "succeeded":
+                break
+            time.sleep(0.02)
+        assert st["state"] == "succeeded"
+        assert ep.result("q-1").num_rows == 3
+        hb = ep.heartbeat(["q-1", "nope"])
+        assert hb["queries"]["q-1"]["state"] == "succeeded"
+        assert hb["queries"]["nope"] is None
+    finally:
+        ep.close()
+
+
+def test_process_executor_wire_roundtrip_and_cancel():
+    blocky = _BlockingFactory()
+    srv, ep = _start_server(session_factory=blocky)
+    try:
+        with conf.scoped({"auron.serving.max.concurrent": 1}):
+            ep.dispatch("q-1", _tiny_plan(), {}, 1)
+            assert blocky.started.wait(30)
+            assert ep.status("q-1")["state"] == "running"
+            ep.dispatch("q-2", _tiny_plan("b"), {}, 1)
+            assert ep.status("q-2")["state"] == "queued"
+            assert ep.cancel("q-2")
+            assert ep.status("q-2")["state"] == "cancelled"
+            assert not ep.cancel("q-2")      # already terminal
+            blocky.release.set()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                st = ep.status("q-1")
+                if st["state"] in ("cancelled", "failed", "succeeded"):
+                    break
+                time.sleep(0.02)
+            assert st["state"] == "succeeded"
+        # per-query conf travels with the dispatch; an unknown option
+        # key is ferried as a deterministic refusal, not a dead query
+        with pytest.raises(EndpointError):
+            ep.dispatch("q-bad", _tiny_plan(),
+                        {"auron.not.a.real.option": 1}, 1)
+    finally:
+        srv.stop()
+
+
+def test_drain_moves_queued_work_not_running_queries():
+    blocky = _BlockingFactory()
+    srv, ep = _start_server(session_factory=blocky)
+    try:
+        with conf.scoped({"auron.serving.max.concurrent": 1}):
+            ep.dispatch("q-run", _tiny_plan("a"), {}, 1)
+            assert blocky.started.wait(30)
+            ep.dispatch("q-w1", _tiny_plan("b"), {}, 1)
+            ep.dispatch("q-w2", _tiny_plan("c"), {}, 1)
+            moved = ep.drain()
+            assert sorted(moved) == ["q-w1", "q-w2"]
+            # draining refuses new dispatches with the structured flag
+            with pytest.raises(EndpointError) as ei:
+                ep.dispatch("q-late", _tiny_plan("d"), {}, 1)
+            assert ei.value.draining
+            # the running query was untouched and completes
+            blocky.release.set()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                st = ep.status("q-run")
+                if st["state"] == "succeeded":
+                    break
+                time.sleep(0.02)
+            assert st["state"] == "succeeded"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# FleetManager over in-process executor servers
+# ---------------------------------------------------------------------------
+
+FAST_FLEET_CONF = {
+    "auron.fleet.heartbeat.seconds": 0.1,
+    "auron.retry.backoff.base.ms": 1.0,
+    "auron.retry.backoff.max.ms": 5.0,
+    "auron.net.timeout.seconds": 5.0,
+}
+
+
+def test_fleet_routes_across_executors_least_loaded():
+    srv1, ep1 = _start_server(executor_id="e1")
+    srv2, ep2 = _start_server(executor_id="e2")
+    fleet = None
+    try:
+        with conf.scoped(FAST_FLEET_CONF):
+            fleet = FleetManager(endpoints=[ep1, ep2])
+            qids = [fleet.submit(_tiny_plan(f"t{i}")) for i in range(6)]
+            for q in qids:
+                assert fleet.wait(q, timeout=30), fleet.status(q)
+            used = {fleet.status(q)["executor"] for q in qids}
+            assert used == {"e1", "e2"}
+            snap = fleet.fleet_snapshot()
+            assert snap["e1"]["dispatched"] == 3
+            assert snap["e2"]["dispatched"] == 3
+    finally:
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv1.stop()
+        srv2.stop()
+
+
+def test_fleet_death_requeues_on_surviving_executor():
+    """Kill one of two executors with queries in flight: death declared
+    by the health machine, every in-flight query requeued on the OTHER
+    executor (excluded list), results correct, counters visible."""
+    blocky = _BlockingFactory()
+    srv1, ep1 = _start_server(session_factory=blocky, executor_id="e1")
+    srv2, ep2 = _start_server(executor_id="e2")
+    fleet = None
+    r0 = counters.get("fleet_requeues")
+    d0 = counters.get("fleet_deaths")
+    hb = 0.15
+    try:
+        with conf.scoped({**FAST_FLEET_CONF,
+                          "auron.fleet.heartbeat.seconds": hb,
+                          "auron.fleet.death.probes": 2,
+                          "auron.net.timeout.seconds": 2.0}):
+            fleet = FleetManager(endpoints=[ep1, ep2])
+            qids = [fleet.submit(_tiny_plan(f"t{i}")) for i in range(4)]
+            assert blocky.started.wait(30)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                on_e1 = [q for q in qids
+                         if fleet.get(q).executor_id == "e1"
+                         and not fleet.get(q).done.is_set()]
+                if on_e1:
+                    break
+                time.sleep(0.02)
+            assert on_e1, "nothing routed to e1"
+            t_kill = time.monotonic()
+            srv1.stop()                     # connections now refused
+            for q in qids:
+                assert fleet.wait(q, timeout=30), fleet.status(q)
+            detect_s = None
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if fleet.fleet_snapshot()["e1"]["state"] == DEAD:
+                    detect_s = time.monotonic() - t_kill
+                    break
+                time.sleep(0.02)
+            assert detect_s is not None, "death never declared"
+            for q in qids:
+                st = fleet.status(q)
+                assert st["state"] == "succeeded", st
+                assert fleet.result(q).num_rows == 3
+            for q in on_e1:
+                st = fleet.status(q)
+                assert st["executor"] == "e2", st
+                assert st["requeues"] >= 1
+                assert "e1" in st["excluded_executors"]
+            assert counters.get("fleet_requeues") - r0 >= len(on_e1)
+            assert counters.get("fleet_deaths") - d0 == 1
+            assert fleet.executor_up() == {"e1": 0, "e2": 1}
+            assert fleet.admission.held_bytes() == 0
+            # requeues never consume PR 10 requeue/preemption budgets
+            assert fleet.stats()["preemptions"] == 0
+    finally:
+        blocky.release.set()
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv2.stop()
+
+
+def test_fleet_fails_queued_when_every_executor_is_dead():
+    srv1, ep1 = _start_server(executor_id="e1")
+    fleet = None
+    try:
+        with conf.scoped({**FAST_FLEET_CONF,
+                          "auron.fleet.death.probes": 1,
+                          "auron.net.timeout.seconds": 1.0}):
+            fleet = FleetManager(endpoints=[ep1])
+            srv1.stop()
+            qid = fleet.submit(_tiny_plan())
+            assert fleet.wait(qid, timeout=30), fleet.status(qid)
+            st = fleet.status(qid)
+            assert st["state"] == "failed"
+            assert "no live executors" in st["error"]
+    finally:
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv1.stop()
+
+
+def test_fleet_decommission_moves_queued_keeps_running():
+    blocky = _BlockingFactory()
+    srv1, ep1 = _start_server(session_factory=blocky, executor_id="e1")
+    srv2, ep2 = _start_server(executor_id="e2")
+    fleet = None
+    try:
+        with conf.scoped({**FAST_FLEET_CONF,
+                          "auron.serving.max.concurrent": 1}):
+            fleet = FleetManager(endpoints=[ep1, ep2])
+            # 2 per executor: one runs (blocked on e1), one queues
+            qids = [fleet.submit(_tiny_plan(f"t{i}")) for i in range(4)]
+            assert blocky.started.wait(30)
+            deadline = time.time() + 10
+            stuck = []
+            while time.time() < deadline:
+                stuck = [q for q in qids
+                         if fleet.get(q).executor_id == "e1"
+                         and not fleet.get(q).done.is_set()]
+                if len(stuck) >= 2:
+                    break
+                time.sleep(0.02)
+            moved = fleet.decommission("e1")
+            # queued-but-not-started work moved; the running query
+            # stays on e1 (blocked until released)
+            for q in moved:
+                assert fleet.wait(q, timeout=30), fleet.status(q)
+                st = fleet.status(q)
+                assert st["state"] == "succeeded"
+                assert st["executor"] == "e2", st
+            # new submissions never route to the draining executor
+            q_new = fleet.submit(_tiny_plan("new"))
+            assert fleet.wait(q_new, timeout=30)
+            assert fleet.status(q_new)["executor"] == "e2"
+            blocky.release.set()
+            for q in qids:
+                assert fleet.wait(q, timeout=30), fleet.status(q)
+                assert fleet.status(q)["state"] == "succeeded"
+            running_on_e1 = [q for q in qids
+                             if fleet.status(q)["executor"] == "e1"]
+            assert running_on_e1, \
+                "the running query should have finished on e1"
+    finally:
+        blocky.release.set()
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv1.stop()
+        srv2.stop()
+
+
+def test_local_fleet_matches_direct_scheduler_and_leaves_no_threads():
+    """The dormant-default contract: a fleet of one LocalExecutor
+    produces the same results as the plain QueryScheduler path, and
+    shutdown leaves no fleet threads behind."""
+    from auron_tpu.serving import QueryScheduler
+    sched = QueryScheduler(session_factory=_FastSession)
+    qid = sched.submit(_tiny_plan("direct"))
+    assert sched.wait(qid, timeout=30)
+    direct = _canon(sched.result(qid))
+    sched.shutdown()
+
+    with conf.scoped(FAST_FLEET_CONF):
+        fleet = FleetManager(session_factory=_FastSession)
+        fq = fleet.submit(_tiny_plan("fleet"))
+        assert fleet.wait(fq, timeout=30), fleet.status(fq)
+        st = fleet.status(fq)
+        assert st["state"] == "succeeded"
+        assert st["executor"] == "local-0"
+        assert _canon(fleet.result(fq)).equals(direct)
+        # ONE front-door ledger: the fleet's controller admitted it
+        assert fleet.admission.events["admitted"] >= 1
+        assert fleet.admission.held_bytes() == 0
+        fleet.shutdown(wait=True)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        alive = [t.name for t in threading.enumerate()
+                 if t.name.startswith("auron-fleet-")]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, f"fleet threads leaked: {alive}"
+
+
+def _http(url, method="GET", doc=None):
+    data = json.dumps(doc).encode() if doc is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"}, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_fleet_http_surface_scheduler_and_metrics():
+    srv1, ep1 = _start_server(executor_id="e1")
+    srv2, ep2 = _start_server(executor_id="e2")
+    http = None
+    try:
+        with conf.scoped(FAST_FLEET_CONF):
+            fleet = FleetManager(endpoints=[ep1, ep2])
+            http = QueryServer(scheduler=fleet).start()
+            code, body = _http(http.url + "/submit", "POST",
+                               {"plan": _tiny_plan().to_dict()})
+            assert code == 200
+            qid = json.loads(body)["query_id"]
+            assert fleet.wait(qid, timeout=30)
+            code, body = _http(http.url + f"/status/{qid}")
+            st = json.loads(body)
+            assert code == 200 and st["state"] == "succeeded"
+            assert st["executor"] in ("e1", "e2")
+            assert st["requeues"] == 0
+            code, body = _http(http.url + f"/result/{qid}")
+            assert code == 200
+            assert json.loads(body)["num_rows"] == 3
+            # /scheduler surfaces per-executor health + queue depth
+            code, body = _http(http.url + "/scheduler")
+            stats = json.loads(body)
+            assert code == 200
+            execs = stats["fleet"]["executors"]
+            assert set(execs) == {"e1", "e2"}
+            for doc in execs.values():
+                assert doc["state"] == ALIVE
+                assert "inflight" in doc and "load" in doc
+            # /metrics: executor-up gauge + fleet counters
+            code, body = _http(http.url + "/metrics")
+            prom = body.decode()
+            assert 'auron_fleet_executor_up{executor="e1"} 1' in prom
+            assert 'auron_fleet_executor_up{executor="e2"} 1' in prom
+            assert "auron_fleet_requeues_total" in prom
+            assert "auron_fleet_dispatches_total" in prom
+    finally:
+        if http is not None:
+            http.stop()
+        srv1.stop()
+        srv2.stop()
+
+
+def test_drain_estimate_accounts_for_executor_count():
+    """The Retry-After satellite: with N executors behind the front
+    door a wave is N * max.concurrent wide, so the hint must shrink
+    ~Nx (it assumed one worker's wave size before)."""
+    from auron_tpu.runtime import tracing
+    from auron_tpu.serving import AdmissionController
+    tracing.clear_history()
+    solo = AdmissionController()
+    fleet4 = AdmissionController(executors_fn=lambda: 4)
+    with conf.scoped({"auron.serving.max.concurrent": 2}):
+        # 16 queued waves ahead: avg 2s default, solo = ceil(17/2)*2
+        est_solo = solo.drain_estimate_s(16)
+        est_fleet = fleet4.drain_estimate_s(16)
+    assert est_solo == pytest.approx(18.0)
+    assert est_fleet == pytest.approx(6.0)   # ceil(17/8) * 2
+    assert est_fleet < est_solo
+
+
+# ---------------------------------------------------------------------------
+# memmgr hook de-globalization (satellite)
+# ---------------------------------------------------------------------------
+
+def test_per_manager_hooks_do_not_cross_reset():
+    fired = []
+    mgr = reset_manager(1 << 20)
+    mgr.set_kill_hook(lambda qid, why: fired.append(qid))
+    mgr.set_pressure_hook(lambda used, eb: fired.append("p"), 0.5)
+    assert mgr._kill_hook is not None
+    fresh = reset_manager(1 << 20)
+    # per-manager registrations die with their manager
+    assert fresh._kill_hook is None
+    assert fresh._pressure_hook is None
+
+
+def test_module_shim_hooks_survive_reset_and_reset_hooks_clears():
+    fired = []
+    mem_manager.set_kill_hook(lambda qid, why: fired.append(qid))
+    mem_manager.set_pressure_hook(lambda used, eb: fired.append("p"),
+                                  0.5)
+    mgr = reset_manager(1 << 20)
+    # compat semantics: shim-installed hooks re-apply to new managers
+    assert mgr._kill_hook is not None
+    assert mgr._pressure_hook is not None and \
+        mgr._pressure_hook[1] == 0.5
+    mem_manager.reset_hooks()
+    assert mgr._kill_hook is None and mgr._pressure_hook is None
+    assert reset_manager(1 << 20)._kill_hook is None
+
+
+def test_clear_pressure_hook_only_clears_own_fn():
+    mgr = reset_manager(1 << 20)
+    fn_a = lambda used, eb: None      # noqa: E731
+    fn_b = lambda used, eb: None      # noqa: E731
+    mgr.set_pressure_hook(fn_a, 0.5)
+    mgr.clear_pressure_hook(fn_b)     # someone else's: no-op
+    assert mgr._pressure_hook is not None
+    mgr.clear_pressure_hook(fn_a)
+    assert mgr._pressure_hook is None
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance stress: kill -9 a worker process mid-query
+# ---------------------------------------------------------------------------
+
+STRESS_NAMES = ["q01", "q42", "q01", "q42", "q01", "q42"]
+SERIAL_SCOPE = {"auron.spmd.singleDevice.enable": False}
+
+
+def _solo_baselines(names, catalog):
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it import queries
+    from auron_tpu.it.oracle import PyArrowEngine
+    out = {}
+    with conf.scoped(SERIAL_SCOPE):
+        for name in set(names):
+            session = AuronSession(foreign_engine=PyArrowEngine())
+            out[name] = _canon(
+                session.execute(queries.build(name, catalog)).table)
+    return out
+
+
+def test_fleet_kill9_acceptance_stress(catalog, tmp_path):
+    """THE acceptance gate: 6 concurrent corpus queries across 2 worker
+    PROCESSES under io+latency faults; one worker is killed with
+    `kill -9` mid-query.  Death is detected within 3 heartbeat
+    intervals, its in-flight queries are requeued on the surviving
+    executor, every result is bit-identical to its solo fault-free
+    run, requeues consume no task-retry budget, the admission ledger
+    drains to zero, and no worker process or fleet thread leaks."""
+    from auron_tpu.it import queries
+
+    baselines = _solo_baselines(STRESS_NAMES, catalog)
+
+    hb = 1.5
+    # worker-side chaos: bounded io + latency on the shuffle path, plus
+    # operator latency so queries stay in flight long enough to be
+    # killed mid-query (the PR 6 lesson: io rules carry max= bounds)
+    worker_spec = ("shuffle.push:io:p=0.05,max=6,seed=7;"
+                   "shuffle.push:latency:p=0.15,seed=5,ms=4;"
+                   "op.execute:latency:p=0.5,ms=150,max=60,seed=11")
+    worker_conf = {
+        **SERIAL_SCOPE,
+        "auron.faults.spec": worker_spec,
+        "auron.task.retries": 2,
+        "auron.retry.backoff.base.ms": 1.0,
+        "auron.retry.backoff.max.ms": 10.0,
+        "auron.serving.preempt.watermark": 0.0,
+        "auron.serving.max.concurrent": 4,
+    }
+    # driver-side chaos: the fleet RPC boundary itself is exercised
+    # (bounded io on dispatch/result; latency on heartbeats — io on
+    # heartbeats would fake executor death, which is its own test)
+    driver_spec = ("fleet.dispatch:io:p=0.25,max=2,seed=5;"
+                   "fleet.result:io:p=0.2,max=2,seed=9;"
+                   "fleet.heartbeat:latency:p=0.3,ms=10,seed=3")
+    faults.reset(driver_spec)
+    driver_scope = {
+        "auron.faults.spec": driver_spec,
+        "auron.retry.backoff.base.ms": 1.0,
+        "auron.retry.backoff.max.ms": 10.0,
+        "auron.net.timeout.seconds": 10.0,
+        "auron.fleet.heartbeat.seconds": hb,
+        "auron.fleet.death.probes": 3,
+        "auron.admission.default.forecast.bytes": 1 << 20,
+        "auron.serving.max.concurrent": 4,
+    }
+    t_retried0 = counters.get("tasks_retried")
+    requeues0 = counters.get("fleet_requeues")
+    pr_requeues0 = counters.get("requeues")     # the PR 10 counter
+    fleet = None
+    with conf.scoped(driver_scope):
+        mgr = reset_manager(1 << 30)
+        fleet = FleetManager.spawn(2, conf_map=worker_conf,
+                                   budget_bytes=1 << 29,
+                                   log_dir=str(tmp_path))
+        try:
+            qids = [fleet.submit(queries.build(n, catalog),
+                                 priority=1 + (i % 3))
+                    for i, n in enumerate(STRESS_NAMES)]
+
+            # wait until one executor holds >= 2 queries with >= 1
+            # actually running in the worker, then kill -9 it
+            victim = survivor = None
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                snap = fleet.fleet_snapshot()
+                busy = sorted(snap.items(),
+                              key=lambda kv: -kv[1]["inflight"])
+                eid, doc = busy[0]
+                if doc["inflight"] >= 2 and \
+                        doc["load"].get("running", 0) >= 1:
+                    victim, survivor = eid, busy[1][0]
+                    break
+                time.sleep(0.1)
+            assert victim is not None, \
+                f"no executor got busy: {fleet.fleet_snapshot()}"
+            victim_qids = [q for q in qids
+                           if fleet.get(q).executor_id == victim
+                           and not fleet.get(q).done.is_set()]
+            assert victim_qids
+            pid = fleet._handles[victim].endpoint.pid
+            os.kill(pid, signal.SIGKILL)
+            t_kill = time.monotonic()
+
+            # death detected within 3 heartbeat intervals (+1 tick of
+            # monitor scheduling slack)
+            detect_s = None
+            while time.monotonic() - t_kill < 30:
+                if fleet.fleet_snapshot()[victim]["state"] == DEAD:
+                    detect_s = time.monotonic() - t_kill
+                    break
+                time.sleep(0.05)
+            assert detect_s is not None, "death never declared"
+            assert detect_s <= 3 * hb + hb / 2, \
+                f"death took {detect_s:.2f}s (> 3 heartbeats of {hb}s)"
+
+            for q in qids:
+                assert fleet.wait(q, timeout=600), fleet.status(q)
+
+            # every query succeeded bit-identical to its solo run
+            for q, name in zip(qids, STRESS_NAMES):
+                st = fleet.status(q)
+                assert st["state"] == "succeeded", (name, st)
+                got = _canon(fleet.result(q))
+                assert got.equals(baselines[name]), \
+                    f"{name} ({q}) diverged from its solo run"
+
+            # the victim's in-flight queries were requeued on the
+            # survivor with the dead executor excluded
+            for q in victim_qids:
+                st = fleet.status(q)
+                assert st["requeues"] >= 1, st
+                assert st["executor"] == survivor, st
+                assert victim in st["excluded_executors"], st
+            assert counters.get("fleet_requeues") - requeues0 >= \
+                len(victim_qids)
+
+            # requeues consumed NO retry budgets: no driver-side task
+            # retries, and the PR 10 preemption/requeue counters are
+            # untouched (this is a fresh-dispatch, not a retry)
+            assert counters.get("tasks_retried") - t_retried0 == 0
+            assert counters.get("requeues") - pr_requeues0 == 0
+            assert fleet.stats()["preemptions"] == 0
+
+            # the fleet RPC boundary actually saw injected faults
+            assert faults.registry_for(driver_spec).injected_total() \
+                > 0
+
+            # admission reservations + per-query ledgers drained
+            assert fleet.admission.held_bytes() == 0
+            assert not any(label.startswith("admission:")
+                           for label in mgr._reservations)
+            assert fleet.executor_up()[victim] == 0
+            assert fleet.executor_up()[survivor] == 1
+        finally:
+            procs = [h.endpoint.proc for h in fleet._handles.values()
+                     if getattr(h.endpoint, "proc", None) is not None]
+            fleet.shutdown(wait=True)
+            for p in procs:
+                assert p.poll() is not None, "worker process leaked"
+    # no fleet/driver threads left behind
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        alive = [t.name for t in threading.enumerate()
+                 if t.name.startswith(("auron-fleet-", "auron-driver-"))]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, f"threads leaked: {alive}"
+
+
+@pytest.mark.slow
+def test_tools_fleet_check_script():
+    """tools/fleet_check.sh is the CI multi-process gate; keep it green
+    from pytest (mirrors overload_check wiring)."""
+    import shutil
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "fleet_check.sh")
+    if not os.path.exists(script) or shutil.which("bash") is None:
+        pytest.skip("fleet script or bash unavailable")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    out = subprocess.run(["bash", script], capture_output=True,
+                         text=True, timeout=540, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -1,0 +1,200 @@
+#!/usr/bin/env bash
+# CI fleet-survival gate (CPU, no accelerator needed) — the
+# multi-process promotion of tools/overload_check.sh:
+#   1. spawn a 2-executor fleet (worker PROCESSES running the slim
+#      executor server, serving/executor_endpoint.py) behind one
+#      QueryServer + admission ledger, with io+latency faults injected
+#      inside the workers AND on the fleet RPC boundary
+#   2. POST six concurrent /submit requests (IT-corpus queries)
+#   3. kill -9 the busiest executor mid-flight
+#   4. assert the death is detected, every in-flight query is requeued
+#      on the surviving executor, EVERY query still succeeds with
+#      results value-identical to its solo fault-free run, the
+#      admission ledger drains, auron_fleet_requeues_total /
+#      auron_fleet_executor_up are visible on /metrics, and no worker
+#      process outlives the fleet
+#
+# The same check runs inside the suite (tests/test_fleet.py::
+# test_tools_fleet_check_script, marked slow), mirroring how
+# overload_check.sh / serve_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pyarrow as pa
+
+from auron_tpu import faults
+from auron_tpu.config import conf
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.it import datagen, queries
+from auron_tpu.it.oracle import PyArrowEngine
+from auron_tpu.memmgr.manager import reset_manager
+from auron_tpu.serving import FleetManager, QueryServer, register_catalog
+
+SF = 0.002
+NAMES = ["q01", "q42", "q01", "q42", "q01", "q42"]
+
+catalog = datagen.generate(
+    tempfile.mkdtemp(prefix="auron-fleet-check-"), sf=SF)
+register_catalog(SF, catalog)
+
+
+def canon(t):
+    t = t.combine_chunks()
+    return t.sort_by([(n, "ascending") for n in t.column_names]) \
+        if t.num_rows and t.num_columns else t
+
+
+serial = {"auron.spmd.singleDevice.enable": False}
+baselines = {}
+with conf.scoped(serial):
+    for name in set(NAMES):
+        s = AuronSession(foreign_engine=PyArrowEngine())
+        baselines[name] = canon(s.execute(queries.build(name, catalog)).table)
+
+worker_spec = ("shuffle.push:io:p=0.05,max=6,seed=7;"
+               "shuffle.push:latency:p=0.15,seed=5,ms=4;"
+               "op.execute:latency:p=0.5,ms=150,max=60,seed=11")
+worker_conf = {**serial,
+               "auron.faults.spec": worker_spec,
+               "auron.task.retries": 2,
+               "auron.retry.backoff.base.ms": 1.0,
+               "auron.retry.backoff.max.ms": 10.0,
+               "auron.serving.preempt.watermark": 0.0,
+               "auron.serving.max.concurrent": 4}
+driver_spec = ("fleet.dispatch:io:p=0.25,max=2,seed=5;"
+               "fleet.result:io:p=0.2,max=2,seed=9;"
+               "fleet.heartbeat:latency:p=0.3,ms=10,seed=3")
+faults.reset(driver_spec)
+hb = 1.5
+scope = {"auron.faults.spec": driver_spec,
+         "auron.retry.backoff.base.ms": 1.0,
+         "auron.retry.backoff.max.ms": 10.0,
+         "auron.net.timeout.seconds": 10.0,
+         "auron.fleet.heartbeat.seconds": hb,
+         "auron.fleet.death.probes": 3,
+         "auron.admission.default.forecast.bytes": 1 << 20,
+         "auron.serving.max.concurrent": 4}
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.load(r)
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=300) as r:
+        return r.read()
+
+
+with conf.scoped(scope):
+    reset_manager(1 << 30)
+    fleet = FleetManager.spawn(2, conf_map=worker_conf,
+                               budget_bytes=1 << 29)
+    srv = QueryServer(scheduler=fleet).start()
+    try:
+        qids = {}
+        errs = []
+
+        def submit(i, name):
+            try:
+                doc = post(srv.url + "/submit",
+                           {"corpus": name, "sf": SF,
+                            "priority": 1 + (i % 3)})
+                qids[i] = (name, doc["query_id"])
+            except Exception as e:   # noqa: BLE001
+                errs.append((name, repr(e)))
+
+        threads = [threading.Thread(target=submit, args=(i, n))
+                   for i, n in enumerate(NAMES)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert len(qids) == len(NAMES)
+
+        # kill -9 the busiest executor once it is actually running work
+        victim = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            snap = fleet.fleet_snapshot()
+            busy = sorted(snap.items(), key=lambda kv: -kv[1]["inflight"])
+            eid, doc = busy[0]
+            if doc["inflight"] >= 2 and doc["load"].get("running", 0) >= 1:
+                victim, survivor = eid, busy[1][0]
+                break
+            time.sleep(0.1)
+        assert victim is not None, fleet.fleet_snapshot()
+        victim_qids = [qid for _, qid in qids.values()
+                       if fleet.get(qid).executor_id == victim
+                       and not fleet.get(qid).done.is_set()]
+        os.kill(fleet._handles[victim].endpoint.pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+
+        detect_s = None
+        while time.monotonic() - t_kill < 30:
+            if fleet.fleet_snapshot()[victim]["state"] == "dead":
+                detect_s = time.monotonic() - t_kill
+                break
+            time.sleep(0.05)
+        assert detect_s is not None, "death never declared"
+        assert detect_s <= 3 * hb + hb / 2, \
+            f"death took {detect_s:.2f}s (> 3 heartbeats of {hb}s)"
+
+        for i, (name, qid) in sorted(qids.items()):
+            assert fleet.wait(qid, timeout=600), \
+                f"{name} did not finish: {fleet.status(qid)}"
+            st = json.loads(get(srv.url + f"/status/{qid}"))
+            assert st["state"] == "succeeded", (name, st)
+            res = json.loads(get(srv.url + f"/result/{qid}"))
+            assert not res["truncated"]
+            got = canon(pa.Table.from_pylist(
+                res["rows"], schema=baselines[name].schema))
+            assert got.equals(baselines[name]), \
+                f"{name} served result diverged from its solo run"
+
+        assert fleet.fleet_snapshot()[victim]["state"] == "dead"
+        requeued = [q for q in victim_qids
+                    if fleet.status(q)["requeues"] >= 1]
+        assert requeued, "the killed executor's queries never requeued"
+        for q in requeued:
+            st = fleet.status(q)
+            assert st["executor"] != victim, st
+            assert victim in st["excluded_executors"], st
+        assert fleet.admission.held_bytes() == 0
+
+        prom = get(srv.url + "/metrics").decode()
+        for needle in ("auron_fleet_requeues_total",
+                       "auron_fleet_deaths_total",
+                       f'auron_fleet_executor_up{{executor="{victim}"}} 0'):
+            assert needle in prom, f"missing {needle!r} in /metrics"
+        line = [ln for ln in prom.splitlines()
+                if ln.startswith("auron_fleet_requeues_total")][0]
+        assert int(line.split()[-1]) >= 1
+        print(f"fleet_check: {len(NAMES)}/{len(NAMES)} queries "
+              f"value-identical to solo runs; executor {victim} killed "
+              f"-9 mid-flight, {len(requeued)} query(ies) requeued on "
+              f"{survivor} (death detected {detect_s:.1f}s after kill)")
+    finally:
+        procs = [h.endpoint.proc for h in fleet._handles.values()
+                 if getattr(h.endpoint, "proc", None) is not None]
+        srv.stop()
+        for p in procs:
+            assert p.poll() is not None, "worker process leaked"
+        reset_manager()
+        faults.reset()
+EOF
+
+echo "fleet_check.sh: ok"
