@@ -252,11 +252,44 @@ SPILL_DIR = conf.define(
 )
 SHUFFLE_SERVICE = conf.define(
     "auron.shuffle.service", "inprocess",
-    "Exchange transport: inprocess | celeborn | uniffle (remote shuffle "
-    "service, AuronShuffleManager selection analogue).")
+    "Exchange transport: inprocess | celeborn | uniffle | durable "
+    "(remote shuffle service, AuronShuffleManager selection analogue; "
+    "`durable` speaks the side-car commit protocol — committed "
+    "map-output manifests, stage resume, integrity-checked fetch).")
 SHUFFLE_SERVICE_ADDRESS = conf.define(
     "auron.shuffle.service.address", "",
-    "host:port of the remote shuffle server for celeborn/uniffle modes.")
+    "host:port of the remote shuffle server for celeborn/uniffle/"
+    "durable modes.")
+RSS_TAG = conf.define(
+    "auron.rss.tag", "",
+    "Stable namespace for durable side-car shuffle ids ('' = this "
+    "execute's query id).  The fleet sets it to the front-door query "
+    "id on every dispatch so a requeued attempt (whose executor-side "
+    "query id carries a ~rN suffix) finds the earlier attempt's "
+    "committed map outputs and RESUMES instead of recomputing.")
+RSS_RESUME_ENABLE = conf.define(
+    "auron.rss.resume.enable", True,
+    "Consult side-car manifests before running an exchange's map "
+    "side: map tasks whose outputs are already committed are skipped "
+    "(whole stages when the seal covers every map).  Off forces every "
+    "attempt to recompute (the commit protocol still applies).")
+RSS_DEFER_CLEANUP = conf.define(
+    "auron.rss.defer.cleanup", False,
+    "Leave durable side-car blocks in place when a session finishes "
+    "(the fleet deletes them by query tag once the submission is "
+    "TERMINAL).  Required for resume: a killed attempt cannot clean "
+    "up, and a successful one must not delete blocks the fleet still "
+    "tracks.  The fleet sets this on every dispatch; standalone "
+    "sessions default to cleaning up after themselves.")
+RSS_SIDECAR_ENABLE = conf.define(
+    "auron.rss.sidecar.enable", False,
+    "FleetManager.spawn also launches a shuffle side-car process "
+    "(python -m auron_tpu.shuffle_rss.server) that OUTLIVES executors "
+    "and routes every worker's exchanges through it "
+    "(auron.shuffle.service=durable injected per dispatch).  Executor "
+    "death then turns whole-query recompute into partial-stage "
+    "resume; side-car death degrades workers back to executor-local "
+    "shuffle with a structured diagnostic.")
 SHUFFLE_COMPRESSION_CODEC = conf.define(
     "auron.shuffle.compression.codec", "zstd",
     "Codec for shuffle/spill blocks: zstd, zlib, lz4, none."
@@ -781,6 +814,21 @@ ADMISSION_DEGRADE_SERIAL_FRACTION = conf.define(
     "program) so its concurrent-partition memory footprint shrinks "
     "instead of being shed; 0 disables degradation.",
 )
+ADMISSION_REFORECAST_ENABLE = conf.define(
+    "auron.admission.reforecast.enable", True,
+    "Let the fleet re-forecast a RUNNING query's admission "
+    "reservation from live heartbeat memory telemetry instead of only "
+    "learning at completion: a query observed well under its forecast "
+    "releases the difference early (queue drains sooner), one over it "
+    "grows its reservation (neighbors stop over-admitting).  Shrinks "
+    "are gated on auron.admission.reforecast.min.age.seconds.",
+)
+ADMISSION_REFORECAST_MIN_AGE_SECONDS = conf.define(
+    "auron.admission.reforecast.min.age.seconds", 5.0,
+    "A running query younger than this never has its reservation "
+    "SHRUNK by a live re-forecast (its peak may simply not have "
+    "happened yet); growth applies immediately.",
+)
 ADMISSION_AGING_SECONDS = conf.define(
     "auron.admission.aging.seconds", 30.0,
     "Priority aging interval for queued submissions (serving/"
@@ -889,6 +937,37 @@ FLEET_BOOT_TIMEOUT_SECONDS = conf.define(
     "How long FleetManager.spawn waits for a worker process to print "
     "its listening line before declaring the boot failed (the worker "
     "is killed and its log tail surfaced in the error).",
+)
+FLEET_SCALE_UP_QUEUE_DEPTH = conf.define(
+    "auron.fleet.scale.up.queue.depth", 0,
+    "Elastic fleet sizing, scale-up half: when the fleet queue depth "
+    "exceeds this, the monitor spawns one more worker (bounded by "
+    "auron.fleet.scale.max.workers and the scale cooldown).  0 "
+    "(default) disables scale-up.  Only active when the fleet knows "
+    "how to build workers (FleetManager.spawn / a worker_factory).",
+)
+FLEET_SCALE_IDLE_SECONDS = conf.define(
+    "auron.fleet.scale.idle.seconds", 0.0,
+    "Elastic fleet sizing, scale-down half: a worker with no in-flight "
+    "work for this long is retired through the decommission drain "
+    "(queued work rerouted, then the endpoint closed), bounded below "
+    "by auron.fleet.scale.min.workers.  0 (default) disables "
+    "scale-down.",
+)
+FLEET_SCALE_MIN_WORKERS = conf.define(
+    "auron.fleet.scale.min.workers", 1,
+    "Idle retirement never shrinks the fleet below this many live "
+    "workers.",
+)
+FLEET_SCALE_MAX_WORKERS = conf.define(
+    "auron.fleet.scale.max.workers", 8,
+    "Queue-depth scale-up never grows the fleet beyond this many live "
+    "workers.",
+)
+FLEET_SCALE_COOLDOWN_SECONDS = conf.define(
+    "auron.fleet.scale.cooldown.seconds", 5.0,
+    "Minimum spacing between elastic scaling actions (up or down) so "
+    "a bursty queue cannot spawn a worker storm.",
 )
 
 # -- kernel-strategy layer (ops/strategy.py) --------------------------------

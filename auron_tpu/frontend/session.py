@@ -115,6 +115,14 @@ class AuronSession:
                 InProcessShuffleService()
         self.shuffle_service = shuffle_service
         self._metrics: List[MetricNode] = []
+        # durable-shuffle bookkeeping (shuffle_rss/durable.py): rid ->
+        # side-car shuffle id for exchanges pushed durably, rids that
+        # (also) hold executor-local fallback data, and the sticky
+        # degrade flag once the side-car proved unreachable
+        self._local_shuffle: Optional[InProcessShuffleService] = None
+        self._exchange_sids: Dict[str, str] = {}
+        self._exchange_local: set = set()
+        self._rss_degraded = False
 
     # -- public entry (preColumnarTransitions analogue) -------------------
 
@@ -203,6 +211,8 @@ class AuronSession:
             converted = converters.convert_recursively(plan, tags, ctx)
         self._metrics = []
         self._spmd_rejection = None
+        self._exchange_sids = {}
+        self._exchange_local = set()
         if mesh is not None and isinstance(converted, P.PlanNode):
             from auron_tpu.parallel.stage import (
                 SpmdUnsupported, execute_plan_spmd, precheck_plan,
@@ -243,12 +253,13 @@ class AuronSession:
         finally:
             # release exchange blocks (local or remote shuffle server —
             # the shuffle-cleanup the reference delegates to Spark's
-            # ShuffleManager.unregisterShuffle)
+            # ShuffleManager.unregisterShuffle).  Durable side-car
+            # blocks are kept when `auron.rss.defer.cleanup` is set:
+            # the fleet deletes them by query tag once the submission
+            # is TERMINAL, so a kill -9'd executor's committed map
+            # outputs survive for the requeued attempt to resume from.
             for rid in ctx.exchanges:
-                try:
-                    self.shuffle_service.clear(rid)
-                except Exception:
-                    log.warning("failed to clear shuffle %s", rid)
+                self._clear_exchange(rid)
         res = SessionResult(table=table, converted=converted, tags=tags,
                             metrics=self._metrics, ctx=ctx,
                             spmd_rejection=self._spmd_rejection)
@@ -386,8 +397,70 @@ class AuronSession:
     def _materialize_exchange(self, job: ShuffleJob, ctx: ConvertContext,
                               resources: ResourceRegistry) -> None:
         """Shuffle: run the map side through RssShuffleWriter into the
-        in-process shuffle service, then register per-reduce block lists
-        (AuronShuffleManager.getWriter/getReader analogue)."""
+        shuffle service, then register per-reduce block lists
+        (AuronShuffleManager.getWriter/getReader analogue).  A durable
+        side-car service takes the commit-protocol path (manifest
+        consult, stage/map resume, integrity-checked fetch); when the
+        side-car is unreachable the exchange DEGRADES to executor-local
+        shuffle with a structured diagnostic instead of hanging."""
+        from auron_tpu.shuffle_rss.durable import (
+            DurableShuffleClient, RssUnavailable,
+        )
+        if isinstance(self.shuffle_service, DurableShuffleClient) \
+                and not self._rss_degraded:
+            from auron_tpu.runtime import counters, tracing
+            try:
+                self._materialize_exchange_durable(job, ctx, resources)
+                return
+            except RssUnavailable as e:
+                # the degrade path back to executor-local shuffle: the
+                # side-car is down — upstream stages recompute locally,
+                # results stay bit-identical, and the diagnostic is
+                # structured (counter + trace event + one log line),
+                # never a hang (every RPC rode bounded retries)
+                self._rss_degraded = True
+                counters.bump("rss_degrades")
+                tracing.event("rss.degrade", cat="shuffle",
+                              rid=job.rid, error=str(e))
+                log.warning(
+                    "durable shuffle degraded to executor-local for "
+                    "this query (rid %s): %s", job.rid, e)
+        self._materialize_exchange_via(job, ctx, resources,
+                                       self._exchange_service(job.rid))
+
+    def _exchange_service(self, rid: str):
+        """The service an executor-local exchange uses: the session's
+        own (in-process/celeborn/uniffle), or a lazily-built in-process
+        fallback once the durable side-car degraded."""
+        from auron_tpu.shuffle_rss.durable import DurableShuffleClient
+        if not isinstance(self.shuffle_service, DurableShuffleClient):
+            return self.shuffle_service
+        if self._local_shuffle is None:
+            self._local_shuffle = InProcessShuffleService()
+        self._exchange_local.add(rid)
+        return self._local_shuffle
+
+    def _clear_exchange(self, rid: str) -> None:
+        try:
+            if rid in self._exchange_local and \
+                    self._local_shuffle is not None:
+                self._local_shuffle.clear(rid)
+            sid = self._exchange_sids.get(rid)
+            if sid is not None:
+                # the fleet owns durable cleanup when deferred (it
+                # deletes by query tag at TERMINAL state — resume
+                # depends on blocks surviving a killed attempt)
+                if not config.conf.get("auron.rss.defer.cleanup"):
+                    self.shuffle_service.clear(sid)
+            elif rid not in self._exchange_local:
+                self.shuffle_service.clear(rid)
+        except Exception:
+            log.warning("failed to clear shuffle %s", rid)
+
+    def _materialize_exchange_via(self, job: ShuffleJob,
+                                  ctx: ConvertContext,
+                                  resources: ResourceRegistry,
+                                  service) -> None:
         # job.child is always native: convert_recursively runs every
         # foreign subtree through convert_to_native (FFI source) before a
         # converter sees it
@@ -398,7 +471,7 @@ class AuronSession:
         def map_task(map_pid: int):
             writer_rid = f"{job.rid}:writer:{map_pid}"
             map_deps.put(writer_rid,
-                         self.shuffle_service.rss_writer(job.rid, map_pid))
+                         service.rss_writer(job.rid, map_pid))
             writer = P.RssShuffleWriter(child=map_plan,
                                         partitioning=job.partitioning,
                                         rss_resource_id=writer_rid)
@@ -412,12 +485,11 @@ class AuronSession:
         # (celeborn aggregate buffers, uniffle arrival-order blocks)
         # record pushes in arrival order, so concurrent maps would make
         # reduce-side streams nondeterministic there
-        from auron_tpu.ops.shuffle.writer import InProcessShuffleService
         from auron_tpu.runtime import tracing
         from auron_tpu.runtime.task_pool import run_tasks
         with tracing.span("exchange.map", cat="exchange", rid=job.rid,
                           parts=map_parts):
-            if isinstance(self.shuffle_service, InProcessShuffleService):
+            if isinstance(service, InProcessShuffleService):
                 results = run_tasks(map_task, range(map_parts),
                                     "auron-map")
             else:
@@ -440,10 +512,140 @@ class AuronSession:
             resources.put(job.rid, PartitionedBlocks(
                 [call_with_retry(
                     lambda rid=job.rid, p=pid:
-                        self.shuffle_service.reduce_blocks(rid, p),
+                        service.reduce_blocks(rid, p),
                     policy=policy, classify=task_classify,
                     label=f"shuffle fetch {job.rid}:{pid}")
                  for pid in range(n_reduce)]))
+
+    # -- the durable side-car exchange (commit protocol + resume) ---------
+
+    def _durable_sid(self, rid: str) -> str:
+        """The side-car shuffle id: a STABLE (query tag, exchange
+        ordinal) key.  Conversion rids embed a random per-context uid
+        for cross-query isolation on shared servers, so a requeued
+        attempt would never match them — the tag (`auron.rss.tag`, set
+        by the fleet to the front-door query id; else this execute's
+        query id) plus the deterministic conversion ordinal is what
+        both attempts agree on."""
+        from auron_tpu.runtime import tracing
+        tag = str(config.conf.get("auron.rss.tag") or "") or \
+            tracing.current_query_id() or "untagged"
+        return f"{tag}|x{rid.rsplit(':', 1)[-1]}"
+
+    def _materialize_exchange_durable(self, job: ShuffleJob,
+                                      ctx: ConvertContext,
+                                      resources: ResourceRegistry
+                                      ) -> None:
+        """The commit-protocol exchange: consult the manifest, SKIP map
+        tasks whose outputs a previous attempt already committed (whole
+        stages when sealed), run only the uncommitted remainder, seal,
+        then fetch with manifest integrity checks — a damaged block
+        regenerates exactly its map output (targeted re-dispatch), not
+        a blind replay."""
+        from auron_tpu.runtime import counters, tracing
+        svc = self.shuffle_service
+        sid = self._durable_sid(job.rid)
+        self._exchange_sids[job.rid] = sid
+        map_parts = ctx.parts(job.child)
+        n_reduce = job.partitioning.num_partitions
+        resume = bool(config.conf.get("auron.rss.resume.enable"))
+        man = svc.manifest(sid) if resume \
+            else {"sealed": None, "maps": {}}
+        committed = {int(m) for m in man["maps"]}
+        to_run = [p for p in range(map_parts) if p not in committed]
+        skipped = map_parts - len(to_run)
+        if skipped:
+            counters.bump("rss_map_tasks_skipped", skipped)
+        if not to_run and man["sealed"] == map_parts:
+            # the whole map stage is committed: RESUME — reduce fetches
+            # from the side-car, the map subtree (and every exchange
+            # under it) is never materialized
+            counters.bump("rss_stage_skips")
+            tracing.event("rss.resume", cat="shuffle", rid=job.rid,
+                          sid=sid, maps=map_parts)
+            log.info("durable shuffle %s: stage resumed from side-car "
+                     "(%d committed map output(s) reused)", sid,
+                     map_parts)
+        else:
+            self._run_durable_map_stage(job, ctx, sid, to_run)
+            svc.seal(sid, map_parts)
+            man = svc.manifest(sid)
+        with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
+                          parts=n_reduce):
+            blocks, bad = self._durable_fetch(sid, n_reduce, man)
+            if bad:
+                # missing/corrupt committed block: deterministic, so
+                # regenerate those map outputs and fetch once more
+                counters.bump("rss_fetch_regens")
+                tracing.event("rss.fetch.regen", cat="shuffle",
+                              rid=job.rid, sid=sid, maps=sorted(bad))
+                log.warning(
+                    "durable shuffle %s: fetch failed integrity for "
+                    "map output(s) %s; regenerating via targeted "
+                    "re-dispatch", sid, sorted(bad))
+                self._run_durable_map_stage(
+                    job, ctx, sid,
+                    [m for m in sorted(bad) if m < map_parts])
+                svc.seal(sid, map_parts)
+                man = svc.manifest(sid)
+                blocks, bad = self._durable_fetch(sid, n_reduce, man)
+                if bad:
+                    from auron_tpu.shuffle_rss.durable import (
+                        FetchFailedError,
+                    )
+                    raise FetchFailedError(
+                        sid, sorted(bad),
+                        detail="regeneration did not converge")
+        resources.put(job.rid, PartitionedBlocks(blocks))
+
+    def _run_durable_map_stage(self, job: ShuffleJob,
+                               ctx: ConvertContext, sid: str,
+                               pids: List[int]) -> None:
+        """Run the listed map tasks against the side-car.  Frames per
+        (map, attempt) are isolated and fetch orders by map id, so
+        concurrent map tasks stay deterministic (unlike the aggregate/
+        block transports)."""
+        from auron_tpu.runtime import counters, tracing
+        from auron_tpu.runtime.task_pool import run_tasks
+        if not pids:
+            return
+        map_plan = job.child
+        map_parts = ctx.parts(map_plan)
+        map_deps = self._materialize_deps(map_plan, ctx)
+
+        def map_task(map_pid: int):
+            writer_rid = f"{job.rid}:writer:{map_pid}"
+            map_deps.put(writer_rid,
+                         self.shuffle_service.rss_writer(sid, map_pid))
+            writer = P.RssShuffleWriter(child=map_plan,
+                                        partitioning=job.partitioning,
+                                        rss_resource_id=writer_rid)
+            return execute_plan(writer, partition_id=map_pid,
+                                resources=map_deps,
+                                num_partitions=map_parts)
+
+        with tracing.span("exchange.map", cat="exchange", rid=job.rid,
+                          parts=len(pids), sid=sid):
+            results = run_tasks(map_task, pids, "auron-map")
+        counters.bump("rss_map_tasks_run", len(pids))
+        for res in results:
+            self._metrics.append(res.metrics)
+
+    def _durable_fetch(self, sid: str, n_reduce: int, man: dict):
+        """Fetch every reduce partition, validating against the
+        manifest; returns (per-partition frame lists, bad map ids) so
+        ONE regeneration round covers every damaged map output."""
+        from auron_tpu.shuffle_rss.durable import FetchFailedError
+        blocks: List[List[bytes]] = []
+        bad: set = set()
+        for pid in range(n_reduce):
+            try:
+                blocks.append(self.shuffle_service.reduce_blocks(
+                    sid, pid, expect=man))
+            except FetchFailedError as e:
+                bad.update(e.map_ids)
+                blocks.append([])
+        return blocks, bad
 
 
 class PartitionedBlocks:

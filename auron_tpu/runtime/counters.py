@@ -48,6 +48,24 @@ _COUNTERS: Dict[str, int] = {
     "fleet_completions": 0,
     "fleet_deaths": 0,
     "fleet_requeues": 0,
+    # elastic fleet sizing (queue-depth scale-up / idle retirement)
+    "fleet_scale_ups": 0,
+    "fleet_scale_downs": 0,
+    # live-heartbeat admission re-forecasts (grow/shrink of a running
+    # query's reservation from worker memory telemetry)
+    "admission_reforecasts": 0,
+    # durable shuffle (shuffle_rss/durable.py + the session's
+    # commit-protocol exchange): stages resumed from committed side-car
+    # manifests instead of recomputed, per-map skip/run splits, fetch
+    # regenerations (targeted re-dispatch after an integrity failure),
+    # and degrades back to executor-local shuffle
+    "rss_stage_skips": 0,
+    "rss_map_tasks_skipped": 0,
+    "rss_map_tasks_run": 0,
+    "rss_fetch_regens": 0,
+    "rss_degrades": 0,
+    "rss_sidecar_deaths": 0,
+    "rss_cleanups": 0,
 }
 
 

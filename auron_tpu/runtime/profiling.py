@@ -194,9 +194,18 @@ def _prometheus_text() -> str:
              help_="serving tier: "
                    f"{key.replace('_', ' ')} count")
     for key in ("fleet_submissions", "fleet_dispatches",
-                "fleet_completions", "fleet_deaths", "fleet_requeues"):
+                "fleet_completions", "fleet_deaths", "fleet_requeues",
+                "fleet_scale_ups", "fleet_scale_downs",
+                "admission_reforecasts", "rss_sidecar_deaths",
+                "rss_cleanups"):
         emit(f"auron_{key}_total", snap.get(key, 0),
              help_="executor fleet: "
+                   f"{key.replace('_', ' ')} count")
+    for key in ("rss_stage_skips", "rss_map_tasks_skipped",
+                "rss_map_tasks_run", "rss_fetch_regens",
+                "rss_degrades"):
+        emit(f"auron_{key}_total", snap.get(key, 0),
+             help_="durable shuffle (this process): "
                    f"{key.replace('_', ' ')} count")
     sched = _serving_scheduler()
     up_fn = getattr(sched, "executor_up", None)
@@ -208,6 +217,22 @@ def _prometheus_text() -> str:
         for eid, v in sorted(up_fn().items()):
             lines.append(
                 f'{name}{{executor="{_prom_escape(eid)}"}} {v}')
+    totals_fn = getattr(sched, "fleet_counter_totals", None)
+    if callable(totals_fn):
+        # worker-process counters aggregated from heartbeat loads: the
+        # driver cannot read another process's registry, and the
+        # stage-resume evidence (rss_check.sh) lives in the WORKERS
+        for key, val in sorted(totals_fn().items()):
+            emit(f"auron_fleet_worker_{key}_total", val,
+                 help_="fleet-aggregated worker counter "
+                       f"{key.replace('_', ' ')} (last heartbeat)")
+    side_fn = getattr(sched, "rss_sidecar_up", None)
+    if callable(side_fn):
+        up = side_fn()
+        if up is not None:
+            emit("auron_rss_sidecar_up", 1 if up else 0, "gauge",
+                 "1 while the durable-shuffle side-car answers "
+                 "health probes, 0 once declared dead")
     mgr = get_manager()
     mem = mgr.stats()
     emit("auron_mem_budget_bytes", mem.get("budget", 0), "gauge",

@@ -61,15 +61,20 @@ class AdmissionController:
 
     def __init__(self, forecaster: Optional[MemForecaster] = None,
                  budget_fn: Optional[Callable[[], int]] = None,
-                 executors_fn: Optional[Callable[[], int]] = None):
+                 executors_fn: Optional[Callable[[], int]] = None,
+                 inflight_fn: Optional[Callable[[], int]] = None):
         self.forecaster = forecaster or MemForecaster()
         self._budget_fn = budget_fn
         self._executors_fn = executors_fn
+        # live fleet-wide running count (heartbeat telemetry) — drain
+        # estimates prefer it over the ledger when it is larger
+        self._inflight_fn = inflight_fn
         self._lock = lockcheck.Lock("serving.admission")
         self._held: Dict[str, int] = {}    # query id -> reserved bytes
         # event counters (the serve_check gate asserts queue events)
         self.events: Dict[str, int] = {"admitted": 0, "queued": 0,
-                                       "shed": 0, "degraded": 0}
+                                       "shed": 0, "degraded": 0,
+                                       "reforecast": 0}
 
     def _budget(self) -> int:
         if self._budget_fn is not None:
@@ -154,6 +159,46 @@ class AdmissionController:
             QUEUE, forecast,
             reason=f"ledger {held} + forecast {reserve} > cap {int(cap)}")
 
+    def reforecast(self, query_id: str, live_peak_bytes: int,
+                   age_s: float = 0.0) -> Optional[int]:
+        """Adjust a RUNNING query's reservation from live heartbeat
+        memory telemetry (the fleet calls this per probe) instead of
+        only learning at completion: growth applies immediately (its
+        neighbors must stop over-admitting against a forecast the
+        query already exceeded), a shrink waits until the query is at
+        least `auron.admission.reforecast.min.age.seconds` old (its
+        peak may not have happened yet) and never drops below the
+        observed live peak.  Returns the new reservation, or None when
+        nothing changed."""
+        if not conf.get("auron.admission.reforecast.enable") or \
+                live_peak_bytes <= 0:
+            return None
+        margin = max(1.0, float(
+            conf.get("auron.admission.forecast.margin")))
+        target = int(live_peak_bytes * margin)
+        cap = int(float(conf.get("auron.admission.memory.fraction"))
+                  * self._budget())
+        target = min(target, cap)
+        min_age = float(
+            conf.get("auron.admission.reforecast.min.age.seconds"))
+        with self._lock:
+            held = self._held.get(query_id)
+            if held is None:
+                return None            # finished/released concurrently
+            if target <= held and age_s < min_age:
+                return None
+            if target == held:
+                return None
+            self._held[query_id] = target
+            self.events["reforecast"] += 1
+        from auron_tpu.memmgr import get_manager
+        from auron_tpu.runtime import counters
+        mgr = get_manager()
+        mgr.release_reservations(f"admission:{query_id}")
+        mgr.add_reservation(f"admission:{query_id}", target)
+        counters.bump("admission_reforecasts")
+        return target
+
     def drain_estimate_s(self, queue_len: int = 0) -> float:
         """Seconds until the ledger has plausibly drained enough to
         admit one more submission — the `Retry-After` hint on shed and
@@ -172,6 +217,13 @@ class AdmissionController:
         avg = sum(recent) / len(recent) if recent else 2.0
         with self._lock:
             held = len(self._held)
+        if self._inflight_fn is not None:
+            # live heartbeat telemetry beats the ledger when it sees
+            # more work in flight (e.g. pass-through executor queues)
+            try:
+                held = max(held, int(self._inflight_fn()))
+            except Exception:
+                pass
         slots = max(1, int(conf.get("auron.serving.max.concurrent"))) \
             * self._executors()
         waves = math.ceil((held + max(0, queue_len) + 1) / slots)

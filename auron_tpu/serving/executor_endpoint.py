@@ -94,6 +94,38 @@ def _table_from_ipc(data: bytes) -> pa.Table:
         return r.read_all()
 
 
+# worker-side process counters mirrored into heartbeat loads: the fleet
+# aggregates them for /metrics (the driver cannot read another
+# process's counter registry) and tests assert resume-vs-recompute
+# through them
+_REPORTED_COUNTERS = (
+    "rss_stage_skips", "rss_map_tasks_skipped", "rss_map_tasks_run",
+    "rss_fetch_regens", "rss_degrades", "tasks_retried",
+)
+
+
+def endpoint_load(scheduler, draining: bool = False) -> Dict[str, Any]:
+    """One executor's live load telemetry: scheduler queue depth +
+    running count, memory-pool usage, per-query memory peaks (the
+    admission re-forecast feed) and the mirrored process counters."""
+    from auron_tpu.memmgr import get_manager
+    from auron_tpu.runtime import counters
+    stats = scheduler.stats()
+    mgr = get_manager()
+    mem = mgr.stats()
+    return {"running": stats.get("running", 0),
+            "queued": stats.get("queued", 0),
+            "states": stats.get("states", {}),
+            "draining": draining,
+            "mem": {"used": mem.get("total_used", 0),
+                    "budget": mem.get("budget", 0)},
+            "query_mem": {qid: int(ent.get("peak") or
+                                   ent.get("used") or 0)
+                          for qid, ent in mgr.query_ledger().items()},
+            "counters": {k: counters.get(k)
+                         for k in _REPORTED_COUNTERS}}
+
+
 def _serial_overlay(conf_map: Dict[str, Any],
                     serial: bool) -> Dict[str, Any]:
     """The degrade-to-serial conf the admission controller decided,
@@ -189,10 +221,8 @@ class LocalExecutor(ExecutorEndpoint):
 
     def heartbeat(self, ids: Optional[List[str]] = None
                   ) -> Dict[str, Any]:
-        stats = self.scheduler.stats()
         return {"executor_id": self.executor_id, "pid": os.getpid(),
-                "load": {"running": stats.get("running", 0),
-                         "queued": stats.get("queued", 0)},
+                "load": endpoint_load(self.scheduler),
                 "queries": {i: self.scheduler.status(i)
                             for i in (ids or [])}}
 
@@ -371,11 +401,7 @@ class ExecutorServer:
             self._draining = True
 
     def load(self) -> Dict[str, Any]:
-        stats = self.scheduler.stats()
-        return {"running": stats.get("running", 0),
-                "queued": stats.get("queued", 0),
-                "states": stats.get("states", {}),
-                "draining": self.draining}
+        return endpoint_load(self.scheduler, draining=self.draining)
 
     def start(self) -> "ExecutorServer":
         self._thread = threading.Thread(

@@ -48,6 +48,7 @@ FleetManager(...))` serves the same HTTP routes over a fleet.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -247,18 +248,47 @@ class _ExecHandle:
     dispatched: int = 0
     draining: bool = False
     dead: bool = False
+    retired: bool = False          # idle scale-down, not a death
+    last_active: float = 0.0       # monotonic; last time it held work
     load: Dict[str, Any] = field(default_factory=dict)
 
     def snapshot(self) -> Dict[str, Any]:
         doc = {"inflight": len(self.inflight),
                "dispatched": self.dispatched,
                "draining": self.draining, "dead": self.dead,
+               "retired": self.retired,
                "load": dict(self.load)}
         doc.update(self.health.snapshot())
         if self.dead:
             doc["state"] = DEAD
             doc["routable"] = False
         doc.update(self.endpoint.describe())
+        return doc
+
+
+@dataclass
+class _SidecarState:
+    """Fleet-side supervision of the durable-shuffle side-car: the
+    process handle (anything with .address/.kill/.close), the control
+    client (shuffle_rss.durable.DurableShuffleClient) and its own
+    health machine — the same alive/suspect/dead evidence rules as an
+    executor, with DEAD equally sticky (new dispatches DEGRADE to
+    executor-local shuffle; nothing is requeued)."""
+
+    proc: Any
+    control: Any
+    health: ExecutorHealth
+    dead: bool = False
+
+    def snapshot(self) -> Dict[str, Any]:
+        doc = {"dead": self.dead}
+        doc.update(self.health.snapshot())
+        if self.dead:
+            doc["state"] = DEAD
+            doc["routable"] = False
+        describe = getattr(self.proc, "describe", None)
+        doc.update(describe() if callable(describe)
+                   else {"address": getattr(self.proc, "address", None)})
         return doc
 
 
@@ -270,21 +300,43 @@ class FleetManager:
     def __init__(self, endpoints: Optional[List[ExecutorEndpoint]] = None,
                  session_factory=None,
                  admission: Optional[AdmissionController] = None,
-                 budget_bytes: int = 0):
+                 budget_bytes: int = 0,
+                 rss_sidecar: Any = None,
+                 worker_factory: Optional[
+                     Callable[[str], ExecutorEndpoint]] = None):
         if endpoints is None:
             endpoints = [LocalExecutor(session_factory=session_factory)]
         self._budget_bytes = int(budget_bytes)
         self.admission = admission or AdmissionController(
             budget_fn=self._fleet_budget,
-            executors_fn=self._routable_count)
+            executors_fn=self._routable_count,
+            inflight_fn=self._live_running)
         self._lock = lockcheck.Lock("fleet.manager")
         self._handles: Dict[str, _ExecHandle] = {}
+        now = time.monotonic()
         for ep in endpoints:
             if ep.executor_id in self._handles:
                 raise ValueError(
                     f"duplicate executor id {ep.executor_id!r}")
             self._handles[ep.executor_id] = _ExecHandle(
-                endpoint=ep, health=ExecutorHealth.from_conf())
+                endpoint=ep, health=ExecutorHealth.from_conf(),
+                last_active=now)
+        # durable-shuffle side-car (anything with .address (host, port)
+        # + best-effort .kill()/.close()); supervised by its own health
+        # machine, consulted by every dispatch overlay
+        self._sidecar: Optional[_SidecarState] = None
+        if rss_sidecar is not None:
+            from auron_tpu.shuffle_rss.durable import DurableShuffleClient
+            host, port = rss_sidecar.address
+            self._sidecar = _SidecarState(
+                proc=rss_sidecar,
+                control=DurableShuffleClient(host, port),
+                health=ExecutorHealth.from_conf())
+        # elastic sizing (auron.fleet.scale.*): only active when the
+        # fleet knows how to build a worker
+        self._worker_factory = worker_factory
+        self._scale_seq = itertools.count()
+        self._last_scale = 0.0
         self._subs: Dict[str, FleetSubmission] = {}
         self._queue: List[FleetSubmission] = []
         self._seq = 0
@@ -300,26 +352,48 @@ class FleetManager:
     @classmethod
     def spawn(cls, n: int, conf_map: Optional[Dict[str, Any]] = None,
               budget_bytes: int = 0,
-              log_dir: Optional[str] = None) -> "FleetManager":
+              log_dir: Optional[str] = None,
+              rss_sidecar: Optional[bool] = None) -> "FleetManager":
         """Launch N worker processes, each with an equal slice of the
         federated memory budget (`auron.fleet.memory.budget.bytes`,
-        else the driver manager's budget)."""
+        else the driver manager's budget).  With `rss_sidecar` (default
+        `auron.rss.sidecar.enable`) a durable-shuffle side-car process
+        is launched first and every dispatch routes its exchanges
+        through it.  The spawn template doubles as the elastic-scaling
+        worker factory (`auron.fleet.scale.*`)."""
         from auron_tpu.memmgr import get_manager
         n = max(1, int(n))
         total = int(budget_bytes) or \
             int(config.conf.get("auron.fleet.memory.budget.bytes")) or \
             get_manager().budget
+        if rss_sidecar is None:
+            rss_sidecar = bool(
+                config.conf.get("auron.rss.sidecar.enable"))
+        sidecar = None
         endpoints: List[ExecutorEndpoint] = []
         try:
+            if rss_sidecar:
+                from auron_tpu.shuffle_rss.sidecar import SidecarProcess
+                sidecar = SidecarProcess.spawn(log_dir=log_dir)
+            slice_bytes = max(1, total // n)
             for i in range(n):
                 endpoints.append(ProcessExecutor.spawn(
                     f"exec-{i}", conf_map=conf_map,
-                    budget_bytes=max(1, total // n), log_dir=log_dir))
+                    budget_bytes=slice_bytes, log_dir=log_dir))
         except BaseException:
             for ep in endpoints:
                 ep.kill()
+            if sidecar is not None:
+                sidecar.kill()
             raise
-        return cls(endpoints=endpoints, budget_bytes=total)
+
+        def factory(executor_id: str) -> ExecutorEndpoint:
+            return ProcessExecutor.spawn(
+                executor_id, conf_map=conf_map,
+                budget_bytes=slice_bytes, log_dir=log_dir)
+
+        return cls(endpoints=endpoints, budget_bytes=total,
+                   rss_sidecar=sidecar, worker_factory=factory)
 
     def _fleet_budget(self) -> int:
         if self._budget_bytes:
@@ -330,6 +404,13 @@ class FleetManager:
     def _routable_count(self) -> int:
         with self._lock:
             return max(1, len(self._routable_locked()))
+
+    def _live_running(self) -> int:
+        """Fleet-wide running count from the last heartbeat loads (the
+        live half of the drain estimate)."""
+        with self._lock:
+            return sum(int(h.load.get("running") or 0)
+                       for h in self._handles.values() if not h.dead)
 
     # -- submission (the QueryScheduler surface) ---------------------------
 
@@ -438,14 +519,38 @@ class FleetManager:
                     else f"{head.query_id}~r{head.requeues}"
                 target.inflight[head.dispatch_id] = head.query_id
                 target.dispatched += 1
+                target.last_active = time.monotonic()
+                dispatch_conf = self._dispatch_conf_locked(head)
             # RPC outside the lock
             try:
                 target.endpoint.dispatch(
-                    head.dispatch_id, head.plan, head.conf,
+                    head.dispatch_id, head.plan, dispatch_conf,
                     head.priority, serial=head.serial)
                 counters.bump("fleet_dispatches")
             except BaseException as e:  # noqa: BLE001 - classified below
                 self._dispatch_failed(target, head, e)
+
+    def _dispatch_conf_locked(self, sub: FleetSubmission
+                              ) -> Dict[str, Any]:
+        """The per-dispatch conf overlay: the submission's own conf
+        plus durable-shuffle routing while the side-car is healthy.
+        The tag is the FLEET query id (stable across requeues — the
+        executor-side id carries a ~rN suffix) so a requeued attempt
+        finds its predecessor's committed map outputs; cleanup is
+        deferred to the fleet's terminal-state hook.  A dead side-car
+        simply stops appearing here: new dispatches degrade to
+        executor-local shuffle."""
+        conf_map = dict(sub.conf)
+        sc = self._sidecar
+        if sc is not None and not sc.dead:
+            host, port = sc.proc.address
+            conf_map.update({
+                "auron.shuffle.service": "durable",
+                "auron.shuffle.service.address": f"{host}:{port}",
+                "auron.rss.tag": sub.query_id,
+                "auron.rss.defer.cleanup": True,
+            })
+        return conf_map
 
     def _routable_locked(self) -> List[_ExecHandle]:
         return [h for h in self._handles.values()
@@ -503,6 +608,7 @@ class FleetManager:
             log.warning("fleet dispatch of %s to %s refused: %s",
                         sub.query_id, handle.endpoint.executor_id,
                         sub.error)
+            self._rss_cleanup(sub.query_id)
             return
         log.warning("fleet dispatch of %s to %s failed (%s); requeueing",
                     sub.query_id, handle.endpoint.executor_id, exc)
@@ -566,6 +672,8 @@ class FleetManager:
                     due = not handle.dead and handle.health.due()
                 if due:
                     self._probe(handle)
+            self._probe_sidecar()
+            self._autoscale()
             # timeouts/aging/late capacity make progress even when no
             # submit/completion event fires
             self._pump()
@@ -581,14 +689,171 @@ class FleetManager:
             if state == DEAD:
                 self._on_executor_death(handle, reason=str(e))
             return
+        now = time.monotonic()
         with self._lock:
             handle.health.probe_ok()
             handle.load = dict(resp.get("load") or {})
+            if handle.inflight:
+                handle.last_active = now
             if handle.load.get("draining"):
                 handle.draining = True
+            inflight = dict(handle.inflight)
         queries = resp.get("queries") or {}
+        # live admission re-forecast: the heartbeat carries per-query
+        # memory peaks, so the front-door ledger learns DURING a run
+        # instead of only at completion
+        query_mem = handle.load.get("query_mem") or {}
+        for did, qid in inflight.items():
+            peak = int(query_mem.get(did) or 0)
+            if peak <= 0:
+                continue
+            with self._lock:
+                sub = self._subs.get(qid)
+                started = sub.started_at if sub is not None else None
+            if started is None:
+                continue
+            self.admission.reforecast(qid, peak,
+                                      age_s=time.time() - started)
         for did in ids:
             self._absorb_status(handle, did, queries.get(did))
+
+    # -- the side-car: health, degrade, cleanup ----------------------------
+
+    def _probe_sidecar(self) -> None:
+        sc = self._sidecar
+        if sc is None:
+            return
+        with self._lock:
+            due = not sc.dead and sc.health.due()
+        if not due:
+            return
+        try:
+            sc.control.ping()
+        except BaseException as e:  # noqa: BLE001 - health-classified
+            with self._lock:
+                state = sc.health.probe_failed()
+            if state == DEAD:
+                self._on_sidecar_death(sc, reason=str(e))
+            return
+        with self._lock:
+            sc.health.probe_ok()
+
+    def _on_sidecar_death(self, sc: _SidecarState, reason: str) -> None:
+        with self._lock:
+            if sc.dead:
+                return
+            sc.dead = True
+        counters.bump("rss_sidecar_deaths")
+        log.warning(
+            "rss side-car declared DEAD (%s): new dispatches degrade "
+            "to executor-local shuffle; in-flight queries degrade "
+            "through their own bounded RPC budgets (no requeue — "
+            "executor state is intact)", reason)
+        # fence a half-alive incarnation, mirroring executor death
+        try:
+            sc.proc.kill()
+        except BaseException as e:  # noqa: BLE001 - best effort
+            log.warning("killing dead rss side-car failed: %s", e)
+
+    def _rss_cleanup(self, query_id: str) -> None:
+        """Terminal-state manifest/ledger cleanup: delete every durable
+        shuffle the query's attempts committed (keyed by the fleet
+        query tag).  Never called on requeue — resume depends on the
+        blocks surviving the killed attempt."""
+        sc = self._sidecar
+        if sc is None or sc.dead:
+            return
+        try:
+            sc.control.clear_prefix(f"{query_id}|")
+            counters.bump("rss_cleanups")
+        except BaseException as e:  # noqa: BLE001 - best effort
+            log.warning("rss cleanup for %s failed: %s", query_id, e)
+
+    # -- elastic sizing (auron.fleet.scale.*) ------------------------------
+
+    def _autoscale(self) -> None:
+        """Queue-depth scale-up / idle scale-down, one action per
+        cooldown window.  Scale-up needs a worker factory (the spawn
+        template); scale-down retires through the decommission drain —
+        queued work rerouted, running queries never killed (only
+        workers with NO in-flight work are eligible)."""
+        if self._shutdown:
+            return
+        up_depth = int(config.conf.get(
+            "auron.fleet.scale.up.queue.depth"))
+        idle_s = float(config.conf.get("auron.fleet.scale.idle.seconds"))
+        if up_depth <= 0 and idle_s <= 0:
+            return
+        now = time.monotonic()
+        cooldown = float(config.conf.get(
+            "auron.fleet.scale.cooldown.seconds"))
+        victim: Optional[_ExecHandle] = None
+        spawn_up = False
+        with self._lock:
+            if now - self._last_scale < cooldown:
+                return
+            alive = [h for h in self._handles.values() if not h.dead]
+            routable = [h for h in alive if not h.draining]
+            if up_depth > 0 and self._worker_factory is not None \
+                    and len(self._queue) > up_depth \
+                    and len(alive) < int(config.conf.get(
+                        "auron.fleet.scale.max.workers")):
+                spawn_up = True
+            elif idle_s > 0 and len(routable) > int(config.conf.get(
+                    "auron.fleet.scale.min.workers")):
+                for h in routable:
+                    if not h.inflight and \
+                            now - h.last_active > idle_s:
+                        victim = h
+                        break
+            if not spawn_up and victim is None:
+                return
+            self._last_scale = now
+            if victim is not None:
+                victim.draining = True   # out of routing immediately
+        if spawn_up:
+            eid = f"exec-s{next(self._scale_seq)}"
+            try:
+                ep = self._worker_factory(eid)
+            except BaseException as e:  # noqa: BLE001 - scale is best effort
+                log.warning("fleet scale-up spawn failed: %s", e)
+                return
+            with self._lock:
+                if self._shutdown or ep.executor_id in self._handles:
+                    stale = True
+                else:
+                    stale = False
+                    self._handles[ep.executor_id] = _ExecHandle(
+                        endpoint=ep, health=ExecutorHealth.from_conf(),
+                        last_active=time.monotonic())
+            if stale:
+                try:
+                    ep.close()
+                except BaseException:  # noqa: BLE001 - best effort
+                    pass
+                return
+            counters.bump("fleet_scale_ups")
+            log.info("fleet scaled UP: spawned %s (queue depth > %d)",
+                     ep.executor_id, up_depth)
+            self._pump()
+            return
+        # scale-down: drain (reroutes anything that raced in), close
+        try:
+            victim.endpoint.drain()
+        except BaseException as e:  # noqa: BLE001 - already retiring
+            log.warning("drain of idle executor %s failed: %s",
+                        victim.endpoint.executor_id, e)
+        try:
+            victim.endpoint.close()
+        except BaseException as e:  # noqa: BLE001 - best effort
+            log.warning("close of idle executor %s failed: %s",
+                        victim.endpoint.executor_id, e)
+        with self._lock:
+            victim.retired = True
+            victim.dead = True
+        counters.bump("fleet_scale_downs")
+        log.info("fleet scaled DOWN: retired idle executor %s "
+                 "(idle > %.3gs)", victim.endpoint.executor_id, idle_s)
 
     def _absorb_status(self, handle: _ExecHandle, dispatch_id: str,
                        status: Optional[Dict[str, Any]]) -> None:
@@ -643,6 +908,7 @@ class FleetManager:
             sub.finished_at = time.time()
             sub.done.set()
         counters.bump("fleet_completions")
+        self._rss_cleanup(sub.query_id)
         self._pump()
 
     def _finish_failure(self, handle: _ExecHandle, sub: FleetSubmission,
@@ -658,6 +924,7 @@ class FleetManager:
             sub.done.set()
         if state == CANCELLED:
             counters.bump("queries_cancelled")
+        self._rss_cleanup(sub.query_id)
         self._pump()
 
     def _on_executor_death(self, handle: _ExecHandle,
@@ -777,6 +1044,27 @@ class FleetManager:
             return {eid: 0 if h.dead else 1
                     for eid, h in self._handles.items()}
 
+    def rss_sidecar_up(self) -> Optional[bool]:
+        """None without a side-car; else its liveness — the
+        `auron_rss_sidecar_up` gauge on /metrics."""
+        sc = self._sidecar
+        if sc is None:
+            return None
+        with self._lock:
+            return not sc.dead
+
+    def fleet_counter_totals(self) -> Dict[str, int]:
+        """Worker-process counters summed from the last heartbeat
+        loads (dead executors keep their final numbers): the driver's
+        view of worker-side resume/degrade evidence — `/metrics`
+        `auron_fleet_worker_*_total`."""
+        with self._lock:
+            totals: Dict[str, int] = {}
+            for h in self._handles.values():
+                for key, val in (h.load.get("counters") or {}).items():
+                    totals[key] = totals.get(key, 0) + int(val)
+            return totals
+
     def fleet_snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {eid: h.snapshot()
@@ -793,10 +1081,17 @@ class FleetManager:
                 preemptions += sub.num_preemptions
             queued = len(self._queue)
             running = states.get(RUNNING, 0)
+            sidecar = self._sidecar.snapshot() \
+                if self._sidecar is not None else None
+        fleet: Dict[str, Any] = {"executors": self.fleet_snapshot(),
+                                 "worker_counters":
+                                     self.fleet_counter_totals()}
+        if sidecar is not None:
+            fleet["rss_sidecar"] = sidecar
         return {"queued": queued, "running": running, "states": states,
                 "preemptions": preemptions, "requeues": requeues,
                 "admission": self.admission.snapshot(),
-                "fleet": {"executors": self.fleet_snapshot()},
+                "fleet": fleet,
                 "task_queues": {}}
 
     def shutdown(self, wait: bool = False,
@@ -820,6 +1115,14 @@ class FleetManager:
             except BaseException as e:  # noqa: BLE001 - best effort
                 log.warning("closing executor %s failed: %s",
                             handle.endpoint.executor_id, e)
+        sc = self._sidecar
+        if sc is not None:
+            close = getattr(sc.proc, "close", None)
+            try:
+                if callable(close):
+                    close()
+            except BaseException as e:  # noqa: BLE001 - best effort
+                log.warning("closing rss side-car failed: %s", e)
         if wait:
             deadline = time.time() + timeout
             for handle in handles:

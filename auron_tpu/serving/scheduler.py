@@ -284,8 +284,12 @@ class QueryScheduler:
             overlay["auron.spmd.singleDevice.enable"] = False
         requeue = False
         try:
-            session = self._session_factory()
+            # session construction INSIDE the overlay: the per-query
+            # conf governs construction-time choices too (e.g. the
+            # fleet's durable-shuffle routing selects the session's
+            # shuffle service)
             with config.conf.query_scoped(overlay):
+                session = self._session_factory()
                 res = session.execute(sub.plan, query_id=sub.query_id)
             sub.result = res.table
             sub.rows = res.table.num_rows

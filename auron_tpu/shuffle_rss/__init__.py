@@ -18,10 +18,15 @@ registry analogue."""
 
 from auron_tpu.shuffle_rss.server import ShuffleServer
 from auron_tpu.shuffle_rss.celeborn import CelebornShuffleClient
+from auron_tpu.shuffle_rss.durable import (
+    DurableShuffleClient, FetchFailedError,
+)
+from auron_tpu.shuffle_rss.sidecar import SidecarProcess
 from auron_tpu.shuffle_rss.uniffle import UniffleShuffleClient
 
 __all__ = ["ShuffleServer", "CelebornShuffleClient",
-           "UniffleShuffleClient", "service_from_conf"]
+           "UniffleShuffleClient", "DurableShuffleClient",
+           "FetchFailedError", "SidecarProcess", "service_from_conf"]
 
 
 def service_from_conf():
@@ -44,4 +49,6 @@ def service_from_conf():
         return CelebornShuffleClient(host, int(port))
     if kind == "uniffle":
         return UniffleShuffleClient(host, int(port))
+    if kind == "durable":
+        return DurableShuffleClient(host, int(port))
     raise ValueError(f"unknown shuffle service {kind!r}")

@@ -2,15 +2,28 @@
 
 Plays the role of the Celeborn/Uniffle worker for the client modules: a
 threaded socket server storing pushed partition data in memory (optionally
-spilling large partitions to disk), with both storage models:
+spilling large partitions to disk), with three storage models:
 
 - aggregate model (Celeborn): PUSH appends to one per-partition buffer
 - block model (Uniffle): PUSH_BLOCK stores (block_id, bytes) per partition
+- durable map-output model (the side-car commit protocol,
+  shuffle_rss/durable.py): MPUSH stages frames under (shuffle, map,
+  attempt), MCOMMIT makes one map task's whole output visible atomically
+  (REPLACING any earlier attempt of the same map id), MSEAL records the
+  expected map count once a stage's map side finished, MANIFEST /
+  MFETCH / STATS let executors and supervisors decide whether a stage's
+  outputs already exist — the piece that turns kill-and-requeue
+  recompute into resume.
 
 Wire protocol: 4-byte big-endian header length, JSON header, raw payload.
-Requests: {"cmd": "push"|"push_block"|"fetch"|"fetch_blocks"|"delete"|
+Requests: {"cmd": "push"|"push_block"|"fetch"|"fetch_blocks"|"mpush"|
+"mcommit"|"mseal"|"manifest"|"mfetch"|"stats"|"delete"|"delete_prefix"|
 "ping", "shuffle": str, "partition": int, "block_id": str, "len": int}.
-Responses: JSON header (+ payload for fetch).
+Responses: JSON header (+ payload for fetch/mfetch).
+
+Run one as a fleet side-car process with ``python -m
+auron_tpu.shuffle_rss.server`` (prints a ``{"event": "listening"}``
+line like the executor worker does).
 """
 
 from __future__ import annotations
@@ -21,7 +34,8 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Dict, List, Optional, Tuple
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
 
 from auron_tpu.runtime import lockcheck
 
@@ -75,6 +89,18 @@ def recv_msg(sock: socket.socket,
     return header, payload
 
 
+def _remove_spill_files(paths: List[str]) -> None:
+    """weakref.finalize target: spill files must not survive the state
+    that wrote them (the PR 2 spill-lifetime contract — a stopped or
+    garbage-collected server leaves no temp files behind)."""
+    for path in list(paths):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    paths.clear()
+
+
 class _State:
     def __init__(self, spill_dir: Optional[str], spill_threshold: int):
         self.lock = lockcheck.Lock("rss.state")
@@ -86,8 +112,40 @@ class _State:
         self.agg_seen: Dict[Tuple[str, int], set] = {}
         # block model: (shuffle, partition) -> [(block_id, bytes)]
         self.blocks: Dict[Tuple[str, int], List[Tuple[str, bytes]]] = {}
+        # durable map-output model (the commit protocol): pushes stage
+        # under (shuffle, map_id, attempt) and become visible atomically
+        # at commit.  `manifest` records committed map outputs with
+        # per-partition frame/byte counts (fetch integrity checks),
+        # `sealed` the expected map count once a stage's map side
+        # completed, and `totals` per-shuffle cumulative commit/seal
+        # counters that SURVIVE delete (bounded ring) so a supervisor
+        # can assert "resumed, not recomputed" after cleanup.
+        self.pending: Dict[Tuple[str, int, str],
+                           Dict[int, List[Tuple[str, bytes]]]] = {}
+        self.committed: Dict[Tuple[str, int],
+                             Dict[int, List[bytes]]] = {}
+        self.manifest: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        self.sealed: Dict[str, int] = {}
+        self.totals: Dict[str, Dict[str, int]] = {}
         self.spill_dir = spill_dir
         self.spill_threshold = spill_threshold
+        # spill files die with the state: explicitly at server stop, by
+        # finalizer on GC/interpreter exit (mirrors the PR 2
+        # weakref.finalize fix for operator spill files)
+        self._spill_paths: List[str] = []
+        self._spill_finalizer = weakref.finalize(
+            self, _remove_spill_files, self._spill_paths)
+
+    def cleanup_spills(self) -> None:
+        self._spill_finalizer()
+
+    def _bump_total(self, sid: str, key: str, n: int = 1) -> None:
+        ent = self.totals.get(sid)
+        if ent is None:
+            if len(self.totals) >= 256:        # bounded: drop oldest
+                self.totals.pop(next(iter(self.totals)))
+            ent = self.totals[sid] = {"commits": 0, "seals": 0}
+        ent[key] = ent.get(key, 0) + n
 
     def _maybe_spill(self, key: Tuple[str, int]) -> None:
         if self.spill_dir is None:
@@ -105,6 +163,8 @@ class _State:
                             f"{key[0].replace(':', '_')}-{key[1]}.agg")
         with open(path, "ab") as f:  # lockcheck: waive (append order)
             f.write(bytes(buf))
+        if key not in self.agg_spilled:
+            self._spill_paths.append(path)
         self.agg_spilled[key] = path
         self.agg[key] = bytearray()
 
@@ -117,6 +177,99 @@ class _State:
             with open(self.agg_spilled[key], "rb") as f:  # lockcheck: waive (torn-read guard)
                 spilled = f.read()
         return spilled + bytes(self.agg.get(key, b""))
+
+    # -- durable map-output model (caller holds self.lock) -----------------
+
+    def mpush(self, sid: str, mid: int, attempt: str, pid: int,
+              push_id: Optional[str], data: bytes) -> None:
+        att = self.pending.setdefault((sid, mid, attempt), {})
+        frames = att.setdefault(pid, [])
+        if push_id is not None and any(p == push_id for p, _ in frames):
+            return                       # at-least-once replay: dedup
+        frames.append((push_id or "", data))
+
+    def mcommit(self, sid: str, mid: int, attempt: str) -> int:
+        """Atomically publish one map task's staged output, REPLACING
+        any earlier attempt of the same map id (retried / rerouted map
+        tasks replace rather than duplicate).  Idempotent per attempt:
+        a commit replayed after a lost response is a no-op."""
+        entry = self.manifest.get(sid, {}).get(mid)
+        if entry is not None and entry["attempt"] == attempt:
+            return len(self.manifest[sid])
+        staged = self.pending.pop((sid, mid, attempt), {})
+        # drop any other staged attempts of this map id (stale retries)
+        for key in [k for k in self.pending
+                    if k[0] == sid and k[1] == mid]:
+            del self.pending[key]
+        if entry is not None:            # replace the earlier attempt
+            for pid in entry["parts"]:
+                maps = self.committed.get((sid, int(pid)))
+                if maps is not None:
+                    maps.pop(mid, None)
+        parts: Dict[str, Dict[str, int]] = {}
+        for pid, frames in staged.items():
+            data = [d for _, d in frames]
+            self.committed.setdefault((sid, pid), {})[mid] = data
+            parts[str(pid)] = {"n": len(data),
+                               "bytes": sum(len(d) for d in data)}
+        self.manifest.setdefault(sid, {})[mid] = {
+            "attempt": attempt, "parts": parts}
+        self._bump_total(sid, "commits")
+        return len(self.manifest[sid])
+
+    def mfetch(self, sid: str, pid: int
+               ) -> Tuple[List[Dict[str, Any]], bytes]:
+        """One reduce partition's committed frames in map-id order
+        (deterministic reduce-side stream, the in-process service's
+        sort-by-map-id contract) plus per-map frame metadata the client
+        validates against the manifest."""
+        maps = self.committed.get((sid, pid), {})
+        blocks: List[Dict[str, Any]] = []
+        body = bytearray()
+        for mid in sorted(maps):
+            frames = maps[mid]
+            blocks.append({"map": mid,
+                           "lens": [len(d) for d in frames]})
+            for d in frames:
+                body.extend(d)
+        return blocks, bytes(body)
+
+    def manifest_doc(self, sid: str) -> Dict[str, Any]:
+        return {"sealed": self.sealed.get(sid),
+                "maps": {str(mid): {"attempt": ent["attempt"],
+                                    "parts": ent["parts"]}
+                         for mid, ent in
+                         self.manifest.get(sid, {}).items()}}
+
+    def delete_shuffles(self, sids: List[str]) -> None:
+        for sid in sids:
+            for k in [k for k in self.agg if k[0] == sid]:
+                del self.agg[k]
+            for k in [k for k in self.agg_spilled if k[0] == sid]:
+                try:
+                    os.remove(self.agg_spilled[k])
+                except OSError:
+                    pass
+                if self.agg_spilled[k] in self._spill_paths:
+                    self._spill_paths.remove(self.agg_spilled[k])
+                del self.agg_spilled[k]
+            for k in [k for k in self.agg_seen if k[0] == sid]:
+                del self.agg_seen[k]
+            for k in [k for k in self.blocks if k[0] == sid]:
+                del self.blocks[k]
+            for k in [k for k in self.pending if k[0] == sid]:
+                del self.pending[k]
+            for k in [k for k in self.committed if k[0] == sid]:
+                del self.committed[k]
+            self.manifest.pop(sid, None)
+            self.sealed.pop(sid, None)
+
+    def all_sids(self) -> List[str]:
+        sids = {k[0] for k in self.agg} | {k[0] for k in self.blocks} \
+            | {k[0] for k in self.committed} \
+            | {k[0] for k in self.pending} | set(self.manifest) \
+            | set(self.sealed)
+        return sorted(sids)
 
 
 def read_timeout() -> Optional[float]:
@@ -132,7 +285,13 @@ def read_timeout() -> Optional[float]:
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         state: _State = self.server.state  # type: ignore[attr-defined]
-        self.request.settimeout(read_timeout())
+        # per-connection read timeout: the server-level override wins
+        # (the side-car CLI arms one even when the process conf is
+        # default-blocking), so a half-dead client can never pin a
+        # handler thread — and through it the state and its spill
+        # files — past the timeout
+        t = getattr(self.server, "read_timeout_s", None)
+        self.request.settimeout(t if t is not None else read_timeout())
         try:
             self._serve(state)
         except (ConnectionError, OSError, ValueError):
@@ -185,23 +344,61 @@ class _Handler(socketserver.BaseRequestHandler):
                     "ok": True, "len": len(body),
                     "blocks": [{"id": bid, "len": len(b)}
                                for bid, b in blocks]}, body)
-            elif cmd == "delete":
+            elif cmd == "mpush":
+                with state.lock:
+                    state.mpush(header["shuffle"], int(header["map"]),
+                                str(header["attempt"]),
+                                int(header["partition"]),
+                                header.get("push_id"), payload)
+                send_msg(self.request, {"ok": True})
+            elif cmd == "mcommit":
+                with state.lock:
+                    n = state.mcommit(header["shuffle"],
+                                      int(header["map"]),
+                                      str(header["attempt"]))
+                send_msg(self.request, {"ok": True, "maps": n})
+            elif cmd == "mseal":
                 sid = header["shuffle"]
                 with state.lock:
-                    for k in [k for k in state.agg if k[0] == sid]:
-                        del state.agg[k]
-                    for k in [k for k in state.agg_spilled
-                              if k[0] == sid]:
-                        try:
-                            os.remove(state.agg_spilled[k])
-                        except OSError:
-                            pass
-                        del state.agg_spilled[k]
-                    for k in [k for k in state.agg_seen
-                              if k[0] == sid]:
-                        del state.agg_seen[k]
-                    for k in [k for k in state.blocks if k[0] == sid]:
-                        del state.blocks[k]
+                    state.sealed[sid] = int(header["maps"])
+                    state._bump_total(sid, "seals")
+                send_msg(self.request, {"ok": True})
+            elif cmd == "manifest":
+                with state.lock:
+                    doc = state.manifest_doc(header["shuffle"])
+                doc["ok"] = True
+                send_msg(self.request, doc)
+            elif cmd == "mfetch":
+                with state.lock:
+                    blocks, body = state.mfetch(
+                        header["shuffle"], int(header["partition"]))
+                send_msg(self.request, {"ok": True, "len": len(body),
+                                        "blocks": blocks}, body)
+            elif cmd == "stats":
+                prefix = header.get("prefix") or ""
+                with state.lock:
+                    shuffles = {
+                        sid: {"maps": len(state.manifest.get(sid, {})),
+                              "sealed": state.sealed.get(sid)}
+                        for sid in state.all_sids()
+                        if sid.startswith(prefix)}
+                    totals = {sid: dict(t)
+                              for sid, t in state.totals.items()
+                              if sid.startswith(prefix)}
+                send_msg(self.request, {"ok": True,
+                                        "shuffles": shuffles,
+                                        "totals": totals})
+            elif cmd == "delete":
+                with state.lock:
+                    state.delete_shuffles([header["shuffle"]])
+                send_msg(self.request, {"ok": True})
+            elif cmd == "delete_prefix":
+                prefix = header["prefix"]
+                with state.lock:
+                    if prefix:
+                        state.delete_shuffles(
+                            [s for s in state.all_sids()
+                             if s.startswith(prefix)])
                 send_msg(self.request, {"ok": True})
             else:
                 send_msg(self.request,
@@ -224,10 +421,12 @@ class ShuffleServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  spill_dir: Optional[str] = None,
-                 spill_threshold: int = 64 << 20):
+                 spill_threshold: int = 64 << 20,
+                 read_timeout_s: Optional[float] = None):
         self._srv = _TCPServer((host, port), _Handler,
                                bind_and_activate=True)
         self._srv.state = _State(spill_dir, spill_threshold)  # type: ignore
+        self._srv.read_timeout_s = read_timeout_s  # type: ignore
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
 
@@ -242,9 +441,63 @@ class ShuffleServer:
     def stop(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        # spill files die with the server — even while a stuck handler
+        # thread still holds a reference to the state
+        self._srv.state.cleanup_spills()  # type: ignore[attr-defined]
 
     def __enter__(self) -> "ShuffleServer":
         return self.start()
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """`python -m auron_tpu.shuffle_rss.server` — run a standalone
+    shuffle side-car (the FleetManager's RSS spawn target).  Prints a
+    ``{"event": "listening", ...}`` line and serves until terminated;
+    SIGTERM cleans up spill files on the way out."""
+    import argparse
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m auron_tpu.shuffle_rss.server",
+        description="Auron TPU remote-shuffle side-car server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--spill-dir", default="",
+                    help="spill oversize aggregate partitions here "
+                         "(default: no spilling)")
+    ap.add_argument("--spill-threshold", type=int, default=64 << 20)
+    ap.add_argument("--read-timeout", type=float, default=60.0,
+                    help="per-connection read timeout seconds (0 = "
+                         "blocking); half-dead clients are dropped "
+                         "past it")
+    args = ap.parse_args(argv)
+    srv = ShuffleServer(
+        host=args.host, port=args.port,
+        spill_dir=args.spill_dir or None,
+        spill_threshold=args.spill_threshold,
+        read_timeout_s=args.read_timeout if args.read_timeout > 0
+        else None).start()
+    host, port = srv.address
+    print(json.dumps({"event": "listening", "host": host, "port": port,
+                      "pid": os.getpid()}), flush=True)
+
+    def _term(signum, frame):
+        srv.stop()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+    try:
+        while True:
+            threading.Event().wait(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

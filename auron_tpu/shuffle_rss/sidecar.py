@@ -1,0 +1,143 @@
+"""Side-car process supervision: spawn and fence a standalone shuffle
+server (`python -m auron_tpu.shuffle_rss.server`).
+
+The FleetManager runs one of these next to its executor fleet: the
+side-car OUTLIVES executors, so a dead executor's committed map outputs
+survive and its requeued queries resume instead of recomputing
+(serving/fleet.py wires the health machine and the degrade path).  This
+module deliberately imports nothing from `auron_tpu.serving` — the
+serving tier imports it, not the other way around.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+class SidecarProcess:
+    """One spawned shuffle side-car: address + process handle.  The
+    control-plane RPCs (ping/stats/delete_prefix) live on
+    `shuffle_rss.durable.DurableShuffleClient`."""
+
+    def __init__(self, host: str, port: int,
+                 proc: Optional[subprocess.Popen] = None,
+                 log_path: Optional[str] = None):
+        self.host, self.port = host, int(port)
+        self.proc = proc
+        self.log_path = log_path
+        self._log_file = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.host, self.port
+
+    @property
+    def address_str(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    @classmethod
+    def spawn(cls, log_dir: Optional[str] = None,
+              spill_dir: Optional[str] = None,
+              boot_timeout_s: float = 60.0) -> "SidecarProcess":
+        cmd = [sys.executable, "-m", "auron_tpu.shuffle_rss.server",
+               "--port", "0"]
+        if spill_dir:
+            cmd += ["--spill-dir", spill_dir]
+        if log_dir is None:
+            log_dir = tempfile.mkdtemp(prefix="auron-rss-")
+        log_path = os.path.join(log_dir, "rss-sidecar.log")
+        log_file = open(log_path, "wb")  # noqa: SIM115 - sidecar lifetime
+        env = dict(os.environ)
+        # the package root on PYTHONPATH: the side-car must boot even
+        # when the driver was launched from outside the repo
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=log_file, text=True, env=env)
+        info = cls._await_listening(proc, boot_timeout_s, log_path)
+        sc = cls(info["host"], info["port"], proc=proc,
+                 log_path=log_path)
+        sc._log_file = log_file
+        return sc
+
+    @staticmethod
+    def _await_listening(proc: subprocess.Popen, timeout: float,
+                         log_path: str) -> Dict[str, Any]:
+        box: Dict[str, Any] = {}
+
+        def _read():
+            for line in proc.stdout:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if doc.get("event") == "listening":
+                    box["info"] = doc
+                    return
+
+        t = threading.Thread(target=_read, daemon=True)
+        t.start()
+        t.join(timeout)
+        if "info" not in box:
+            proc.kill()
+            tail = ""
+            try:
+                with open(log_path, "rb") as f:
+                    tail = f.read()[-2000:].decode("utf-8", "replace")
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"rss side-car did not report listening within "
+                f"{timeout:g}s; log tail:\n{tail}")
+        return box["info"]
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+        self._reap()
+
+    def close(self) -> None:
+        """Graceful teardown: SIGTERM (the server cleans its spill
+        files in its handler), escalate to SIGKILL."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self._reap()
+
+    def _reap(self) -> None:
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            except OSError:
+                pass
+            self._log_file = None
+
+    def describe(self) -> Dict[str, Any]:
+        return {"host": self.host, "port": self.port, "pid": self.pid,
+                "log": self.log_path}

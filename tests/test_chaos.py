@@ -73,6 +73,8 @@ def test_chaos_sweep_device_fault_degrades_to_serial(catalog):
         "device fault" in rej
 
 
+@pytest.mark.slow   # PR 12 tier-1 re-split (13.9s; nightly via
+#                     chaos_check + the slow sweeps keep the gate)
 def test_chaos_sweep_op_device_fault_retries(catalog):
     """A transient device fault at operator execute is re-executed by
     the executor's retry tier (num_retries), no degradation needed."""
@@ -82,6 +84,8 @@ def test_chaos_sweep_op_device_fault_retries(catalog):
     assert report.num_retries >= 1, report.render()
 
 
+@pytest.mark.slow   # PR 12 tier-1 re-split (12.4s; chaos_check + the
+#                     slow sweeps + the scan-site unit tests keep it)
 def test_chaos_sweep_scan_faults_identical(catalog):
     """PR 2 follow-up closed: the parquet reader carries named
     fault_point sites (scan.parquet.open / scan.parquet.read — OUTSIDE

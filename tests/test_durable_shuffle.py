@@ -1,0 +1,857 @@
+"""Durable-shuffle tests (PR 12): cross-process RSS side-car with
+committed map-output manifests, fetch-failure recovery, and requeues
+that RESUME instead of recompute.
+
+- Commit-protocol units against the side-car wire: push/commit/fetch
+  roundtrips, attempt REPLACE semantics, push_id dedup on replay,
+  commit idempotency, manifest atomicity (uncommitted attempts are
+  invisible — a map task killed between its last push and its commit
+  correctly re-runs), integrity-checked fetch with deterministic
+  FetchFailedError classification.
+- Celeborn/Uniffle/durable client PARITY: the same session query over
+  each transport against ONE side-car server is bit-identical.
+- Session resume: a second attempt under the same tag SKIPS committed
+  stages (stage-skip counters, no map re-runs), partially-committed
+  stages re-run only the missing map tasks, corrupt committed blocks
+  regenerate via targeted re-dispatch, and a dead side-car DEGRADES to
+  executor-local shuffle with a structured diagnostic — never a hang.
+- Satellite bugfixes pinned: server spill files die with the server
+  (stop AND gc), half-dead clients cannot pin handler threads past the
+  read timeout.
+- Fleet integration: dispatch overlays route exchanges through the
+  side-car with the FLEET query id as the stable tag, terminal states
+  clean the side-car ledger, side-car death degrades new dispatches.
+- THE acceptance stress: kill -9 an executor after >= 1 stage's map
+  outputs are committed+sealed on the side-car => the requeued query
+  SKIPS that stage on the survivor (stage-skip counters + unchanged
+  side-car commit totals prove its map tasks never re-ran), fetches
+  its shuffle blocks from the side-car, every result is bit-identical
+  to the solo fault-free run, and zero `auron.task.retries` budget is
+  consumed.
+"""
+
+import gc
+import json
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu import config, faults
+from auron_tpu.frontend.foreign import ForeignExpr, ForeignNode, fcall, fcol
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.memmgr import manager as mem_manager
+from auron_tpu.memmgr.manager import reset_manager
+from auron_tpu.runtime import counters, retry, task_pool
+from auron_tpu.shuffle_rss import (
+    CelebornShuffleClient, DurableShuffleClient, ShuffleServer,
+    UniffleShuffleClient,
+)
+from auron_tpu.shuffle_rss.durable import FetchFailedError, RssUnavailable
+
+I64 = DataType.int64()
+F64 = DataType.float64()
+SF = 0.002
+SERIAL = {"auron.spmd.singleDevice.enable": False}
+FAST_RETRY = {"auron.retry.backoff.base.ms": 1.0,
+              "auron.retry.backoff.max.ms": 5.0}
+
+
+@pytest.fixture(scope="module")
+def server():
+    with ShuffleServer() as srv:
+        yield srv
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+def _canon(table: pa.Table) -> pa.Table:
+    t = table.combine_chunks()
+    if t.num_rows and t.num_columns:
+        t = t.sort_by([(n, "ascending") for n in t.column_names])
+    return t
+
+
+def _agg_query(rows, partitions=4):
+    schema = Schema((Field("k", I64), Field("v", F64)))
+    src = ForeignNode("LocalTableScanExec", output=schema,
+                      attrs={"rows": rows})
+    aggs = [ForeignExpr("AggregateExpression",
+                        children=(fcall("Sum", fcol("v", F64),
+                                        dtype=F64),))]
+    partial = ForeignNode(
+        "HashAggregateExec", children=(src,),
+        output=Schema((Field("k", I64), Field("s#sum", F64))),
+        attrs={"grouping": [fcol("k", I64)], "aggs": aggs,
+               "agg_names": ["s"], "mode": "partial"})
+    exchange = ForeignNode(
+        "ShuffleExchangeExec", children=(partial,),
+        output=partial.output,
+        attrs={"partitioning": {"mode": "hash",
+                                "num_partitions": partitions,
+                                "expressions": [fcol("k", I64)]}})
+    return ForeignNode(
+        "HashAggregateExec", children=(exchange,),
+        output=Schema((Field("k", I64), Field("s", F64))),
+        attrs={"grouping": [fcol("k", I64)], "aggs": aggs,
+               "agg_names": ["s"], "mode": "final"})
+
+
+def _two_stage_query(rows):
+    """partial->EX1(4)->final->partial->EX2(2)->final: the second
+    exchange's map side has 4 partitions, so partial-resume paths have
+    something to split."""
+    inner = _agg_query(rows, partitions=4)
+    aggs = [ForeignExpr("AggregateExpression",
+                        children=(fcall("Sum", fcol("s", F64),
+                                        dtype=F64),))]
+    partial2 = ForeignNode(
+        "HashAggregateExec", children=(inner,),
+        output=Schema((Field("k", I64), Field("t#sum", F64))),
+        attrs={"grouping": [fcol("k", I64)], "aggs": aggs,
+               "agg_names": ["t"], "mode": "partial"})
+    exchange2 = ForeignNode(
+        "ShuffleExchangeExec", children=(partial2,),
+        output=partial2.output,
+        attrs={"partitioning": {"mode": "hash", "num_partitions": 2,
+                                "expressions": [fcol("k", I64)]}})
+    return ForeignNode(
+        "HashAggregateExec", children=(exchange2,),
+        output=Schema((Field("k", I64), Field("t", F64))),
+        attrs={"grouping": [fcol("k", I64)], "aggs": aggs,
+               "agg_names": ["t"], "mode": "final"})
+
+
+def _rows(n=400, seed=5):
+    rng = np.random.default_rng(seed)
+    return [{"k": int(rng.integers(0, 9)), "v": float(i % 13)}
+            for i in range(n)]
+
+
+def _durable_scope(server, tag, **extra):
+    host, port = server.address
+    return {**SERIAL,
+            "auron.shuffle.service": "durable",
+            "auron.shuffle.service.address": f"{host}:{port}",
+            "auron.rss.tag": tag, **extra}
+
+
+# ---------------------------------------------------------------------------
+# commit-protocol units
+# ---------------------------------------------------------------------------
+
+def test_push_commit_fetch_roundtrip(server):
+    c = DurableShuffleClient(*server.address)
+    w0 = c.rss_writer("u|x0", 0)
+    w0.write(0, b"aa")
+    w0.write(1, b"bb")
+    w0.flush()
+    w1 = c.rss_writer("u|x0", 1)
+    w1.write(0, b"cc")
+    w1.flush()
+    c.seal("u|x0", 2)
+    man = c.manifest("u|x0")
+    assert man["sealed"] == 2
+    assert set(man["maps"]) == {"0", "1"}
+    # map-id order, validated against the manifest
+    assert c.reduce_blocks("u|x0", 0, expect=man) == [b"aa", b"cc"]
+    assert c.reduce_blocks("u|x0", 1, expect=man) == [b"bb"]
+    assert c.reduce_blocks("u|x0", 2, expect=man) == []
+    c.clear("u|x0")
+    assert c.manifest("u|x0")["maps"] == {}
+
+
+def test_commit_replaces_earlier_attempt(server):
+    """A retried/rerouted map task REPLACES its earlier attempt —
+    never duplicates (the commit-protocol core)."""
+    c = DurableShuffleClient(*server.address)
+    w = c.rss_writer("u|x1", 0)
+    w.write(0, b"first")
+    w.flush()
+    w2 = c.rss_writer("u|x1", 0)      # the replay: fresh attempt id
+    w2.write(0, b"first")
+    w2.write(1, b"extra")
+    w2.flush()
+    man = c.manifest("u|x1")
+    assert man["maps"]["0"]["attempt"] == w2.attempt
+    assert c.reduce_blocks("u|x1", 0, expect=man) == [b"first"]
+    assert c.reduce_blocks("u|x1", 1, expect=man) == [b"extra"]
+    c.clear("u|x1")
+
+
+def test_push_id_dedup_on_replay(server):
+    """A push replayed after a lost response (same push_id, same
+    attempt) applies exactly once."""
+    c = DurableShuffleClient(*server.address)
+    w = c.rss_writer("u|x2", 0)
+    w.write(0, b"zz")
+    w.conn.request({"cmd": "mpush", "shuffle": "u|x2", "map": 0,
+                    "attempt": w.attempt, "partition": 0,
+                    "push_id": f"{w.attempt}-0", "len": 2}, b"zz")
+    w.flush()
+    man = c.manifest("u|x2")
+    assert c.reduce_blocks("u|x2", 0, expect=man) == [b"zz"]
+    # a replayed COMMIT of the same attempt is a no-op too
+    w.flush()
+    assert c.reduce_blocks("u|x2", 0,
+                           expect=c.manifest("u|x2")) == [b"zz"]
+    c.clear("u|x2")
+
+
+def test_uncommitted_attempt_is_invisible(server):
+    """Manifest atomicity: a map task killed between its last push and
+    its commit leaves NOTHING visible — the stage re-runs it."""
+    c = DurableShuffleClient(*server.address)
+    ghost = c.rss_writer("u|x3", 0)
+    ghost.write(0, b"ghost")           # ... and the task dies here
+    assert c.manifest("u|x3")["maps"] == {}
+    assert c.reduce_blocks("u|x3", 0) == []
+    # the re-run commits; the ghost attempt's staging is dropped
+    redo = c.rss_writer("u|x3", 0)
+    redo.write(0, b"real")
+    redo.flush()
+    man = c.manifest("u|x3")
+    assert c.reduce_blocks("u|x3", 0, expect=man) == [b"real"]
+    with server._srv.state.lock:
+        assert not server._srv.state.pending
+    c.clear("u|x3")
+
+
+def test_fetch_integrity_failure_is_deterministic(server):
+    c = DurableShuffleClient(*server.address)
+    w = c.rss_writer("u|x4", 0)
+    w.write(0, b"payload")
+    w.flush()
+    st = server._srv.state
+    with st.lock:
+        st.committed[("u|x4", 0)][0] = [b"pay"]   # truncated
+    with pytest.raises(FetchFailedError) as ei:
+        c.reduce_blocks("u|x4", 0, expect=c.manifest("u|x4"))
+    assert ei.value.map_ids == [0]
+    # deterministic for BOTH classifiers: a transport replay cannot
+    # restore bytes the server lost — recovery is regeneration
+    assert not retry.is_retryable(ei.value)
+    assert not retry.task_classify(ei.value)
+    c.clear("u|x4")
+
+
+def test_stats_and_totals_survive_delete(server):
+    c = DurableShuffleClient(*server.address)
+    w = c.rss_writer("u|x5", 0)
+    w.write(0, b"d")
+    w.flush()
+    c.seal("u|x5", 1)
+    stats = c.stats(prefix="u|x5")
+    assert stats["shuffles"]["u|x5"] == {"maps": 1, "sealed": 1}
+    assert stats["totals"]["u|x5"]["commits"] == 1
+    c.clear_prefix("u|x5")
+    stats = c.stats(prefix="u|x5")
+    assert stats["shuffles"] == {}
+    # cumulative totals survive cleanup: a supervisor can still prove
+    # "resumed, not recomputed" after the fleet deleted the blocks
+    assert stats["totals"]["u|x5"] == {"commits": 1, "seals": 1}
+
+
+def test_durable_rpcs_recover_under_faults(server):
+    """push/commit/fetch/manifest under io+latency+timeout faults ride
+    the shared retry policy; push_id/attempt dedup keeps the
+    at-least-once replays invisible."""
+    spec = ("rss.push:io:p=0.4,seed=5;"
+            "rss.commit:timeout:p=0.4,seed=7;"
+            "rss.fetch:io:p=0.4,seed=9;"
+            "rss.manifest:latency:p=0.5,ms=2,seed=11")
+    faults.reset(spec)
+    c = DurableShuffleClient(*server.address)
+    with config.conf.scoped({"auron.faults.spec": spec, **FAST_RETRY,
+                             "auron.retry.max.attempts": 6}):
+        for mid in range(3):
+            w = c.rss_writer("u|xf", mid)
+            for i in range(4):
+                w.write(i % 2, b"m%d-%d" % (mid, i))
+            w.flush()
+        c.seal("u|xf", 3)
+        man = c.manifest("u|xf")
+        got0 = c.reduce_blocks("u|xf", 0, expect=man)
+        got1 = c.reduce_blocks("u|xf", 1, expect=man)
+    assert got0 == [b"m0-0", b"m0-2", b"m1-0", b"m1-2",
+                    b"m2-0", b"m2-2"]
+    assert got1 == [b"m0-1", b"m0-3", b"m1-1", b"m1-3",
+                    b"m2-1", b"m2-3"]
+    assert faults.registry_for(spec).injected_total() > 0
+    c.clear_prefix("u|xf")
+
+
+# ---------------------------------------------------------------------------
+# celeborn / uniffle / durable parity against one side-car server
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind,client_cls", [
+    ("celeborn", CelebornShuffleClient),
+    ("uniffle", UniffleShuffleClient),
+    ("durable", DurableShuffleClient),
+])
+def test_session_parity_across_transports(server, kind, client_cls):
+    """The same query over every transport model against ONE side-car
+    server is bit-identical (the wire speaks all three)."""
+    host, port = server.address
+    plan = _agg_query(_rows())
+    with config.conf.scoped(SERIAL):
+        base = _canon(AuronSession().execute(plan).table)
+    with config.conf.scoped({**SERIAL,
+                             "auron.shuffle.service": kind,
+                             "auron.shuffle.service.address":
+                             f"{host}:{port}"}):
+        session = AuronSession()
+        assert isinstance(session.shuffle_service, client_cls)
+        res = session.execute(plan)
+    assert _canon(res.table).equals(base)
+    assert res.all_native()
+    # post-query cleanup released the server-side state
+    st = server._srv.state
+    with st.lock:
+        assert not st.agg and not st.blocks and not st.committed
+
+
+# ---------------------------------------------------------------------------
+# session resume: skip committed stages, partial re-run, regeneration
+# ---------------------------------------------------------------------------
+
+def test_session_stage_resume_skips_committed_maps(server):
+    plan = _two_stage_query(_rows())
+    with config.conf.scoped(SERIAL):
+        base = _canon(AuronSession().execute(plan).table)
+    scope = _durable_scope(server, "rq1",
+                           **{"auron.rss.defer.cleanup": True})
+    with config.conf.scoped(scope):
+        s1 = AuronSession()
+        assert _canon(s1.execute(plan).table).equals(base)
+        runs0 = counters.get("rss_map_tasks_run")
+        skips0 = counters.get("rss_stage_skips")
+        mskip0 = counters.get("rss_map_tasks_skipped")
+        # second attempt, same tag: both stages resume — the INNER
+        # exchange is never even consulted (its consumer was skipped)
+        s2 = AuronSession()
+        assert _canon(s2.execute(plan).table).equals(base)
+        assert counters.get("rss_stage_skips") == skips0 + 1
+        assert counters.get("rss_map_tasks_run") == runs0
+        assert counters.get("rss_map_tasks_skipped") == mskip0 + 4
+        client = s2.shuffle_service
+        assert client.stats(prefix="rq1|")["shuffles"]
+        client.clear_prefix("rq1|")
+
+
+def test_session_partial_commit_reruns_only_missing_maps(server):
+    """Kill-between-push-and-commit, stage half: with one map's commit
+    missing the stage re-runs ONLY that map task."""
+    plan = _two_stage_query(_rows())
+    scope = _durable_scope(server, "rq2",
+                           **{"auron.rss.defer.cleanup": True})
+    with config.conf.scoped(scope):
+        s1 = AuronSession()
+        t1 = _canon(s1.execute(plan).table)
+        client = s1.shuffle_service
+        stats = client.stats(prefix="rq2|")["shuffles"]
+        (outer_sid,) = [s for s, doc in stats.items()
+                        if doc["maps"] == 4]
+        # simulate the mid-stage kill: drop ONE map's committed output
+        st = server._srv.state
+        with st.lock:
+            ent = st.manifest[outer_sid].pop(2)
+            for pid in ent["parts"]:
+                st.committed[(outer_sid, int(pid))].pop(2, None)
+        runs0 = counters.get("rss_map_tasks_run")
+        skips0 = counters.get("rss_stage_skips")
+        s2 = AuronSession()
+        assert _canon(s2.execute(plan).table).equals(t1)
+        # only map 2 re-ran.  Its deps materialize the INNER exchange,
+        # which legitimately whole-stage-resumes (+1 skip); the damaged
+        # OUTER stage claims no whole-stage skip (so exactly one).
+        assert counters.get("rss_map_tasks_run") == runs0 + 1
+        assert counters.get("rss_stage_skips") == skips0 + 1
+        client.clear_prefix("rq2|")
+
+
+def test_session_fetch_corruption_targeted_regen(server):
+    """A corrupt committed block fails the manifest integrity check and
+    regenerates exactly its map output — results stay bit-identical."""
+    plan = _two_stage_query(_rows())
+    scope = _durable_scope(server, "rq3",
+                           **{"auron.rss.defer.cleanup": True})
+    with config.conf.scoped(scope):
+        s1 = AuronSession()
+        t1 = _canon(s1.execute(plan).table)
+        client = s1.shuffle_service
+        stats = client.stats(prefix="rq3|")["shuffles"]
+        (outer_sid,) = [s for s, doc in stats.items()
+                        if doc["maps"] == 4]
+        st = server._srv.state
+        with st.lock:
+            for (sid, pid), maps in st.committed.items():
+                if sid == outer_sid and maps.get(1):
+                    # truncate map 1's first frame: bytes no longer
+                    # match the committed manifest stats
+                    maps[1][0] = maps[1][0][:-1]
+        regens0 = counters.get("rss_fetch_regens")
+        runs0 = counters.get("rss_map_tasks_run")
+        s2 = AuronSession()
+        assert _canon(s2.execute(plan).table).equals(t1)
+        assert counters.get("rss_fetch_regens") == regens0 + 1
+        # targeted: only the damaged map re-ran
+        assert counters.get("rss_map_tasks_run") == runs0 + 1
+        client.clear_prefix("rq3|")
+
+
+def test_session_degrades_to_local_when_sidecar_down():
+    plan = _agg_query(_rows())
+    with config.conf.scoped(SERIAL):
+        base = _canon(AuronSession().execute(plan).table)
+    srv = ShuffleServer().start()
+    host, port = srv.address
+    srv.stop()                          # side-car is gone
+    d0 = counters.get("rss_degrades")
+    with config.conf.scoped({**SERIAL, **FAST_RETRY,
+                             "auron.shuffle.service": "durable",
+                             "auron.shuffle.service.address":
+                             f"{host}:{port}",
+                             "auron.rss.tag": "rq4",
+                             "auron.net.timeout.seconds": 2.0}):
+        session = AuronSession()
+        res = session.execute(plan)
+    assert _canon(res.table).equals(base)
+    assert counters.get("rss_degrades") == d0 + 1
+    assert session._rss_degraded
+
+
+def test_rss_unavailable_classification():
+    e = RssUnavailable("down")
+    assert e.auron_deterministic and e.auron_retry_exhausted
+    assert not retry.is_retryable(e)
+    assert not retry.task_classify(e)
+
+
+def test_resume_disabled_recomputes(server):
+    plan = _agg_query(_rows())
+    scope = _durable_scope(server, "rq5",
+                           **{"auron.rss.defer.cleanup": True,
+                              "auron.rss.resume.enable": False})
+    with config.conf.scoped(scope):
+        s1 = AuronSession()
+        t1 = _canon(s1.execute(plan).table)
+        runs0 = counters.get("rss_map_tasks_run")
+        skips0 = counters.get("rss_stage_skips")
+        s2 = AuronSession()
+        assert _canon(s2.execute(plan).table).equals(t1)
+        assert counters.get("rss_stage_skips") == skips0
+        assert counters.get("rss_map_tasks_run") > runs0
+        s1.shuffle_service.clear_prefix("rq5|")
+
+
+# ---------------------------------------------------------------------------
+# satellite bugfixes: spill-file lifetime + half-dead clients
+# ---------------------------------------------------------------------------
+
+def test_spill_files_do_not_survive_server_stop(tmp_path):
+    spill_dir = str(tmp_path / "spill")
+    srv = ShuffleServer(spill_dir=spill_dir, spill_threshold=8).start()
+    c = CelebornShuffleClient(*srv.address)
+    w = c.rss_writer("sp1", 0)
+    w.write(0, b"x" * 64)
+    w.flush()
+    files = os.listdir(spill_dir)
+    assert files, "expected a spill file"
+    srv.stop()
+    assert os.listdir(spill_dir) == [], \
+        "spill files survived server stop"
+
+
+def test_spill_files_do_not_survive_state_gc(tmp_path):
+    from auron_tpu.shuffle_rss.server import _State
+    spill_dir = str(tmp_path / "spill")
+    st = _State(spill_dir, 8)
+    key = ("sgc", 0)
+    with st.lock:
+        st.agg.setdefault(key, bytearray()).extend(b"y" * 64)
+        st._maybe_spill(key)
+    assert os.listdir(spill_dir)
+    del st
+    gc.collect()
+    assert os.listdir(spill_dir) == [], \
+        "spill files survived state garbage collection"
+
+
+def test_half_dead_client_cannot_pin_handler_thread():
+    """A client that stops sending mid-frame is dropped once the read
+    timeout fires — the handler thread exits and the server keeps
+    serving (the side-car CLI arms this even with default conf)."""
+    srv = ShuffleServer(read_timeout_s=0.3).start()
+    host, port = srv.address
+    try:
+        stuck = socket.create_connection((host, port), timeout=5)
+        stuck.sendall(struct.pack(">I", 64)[:2])   # half a header
+        # the server must CLOSE the connection at the timeout, not
+        # hold the thread forever
+        stuck.settimeout(5)
+        assert stuck.recv(1) == b"", "server did not drop the client"
+        stuck.close()
+        # and it still answers fresh clients afterwards
+        assert DurableShuffleClient(host, port).ping()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: dispatch overlay, terminal cleanup, side-car death
+# ---------------------------------------------------------------------------
+
+class _SidecarShim:
+    """Duck-typed side-car handle over an in-process ShuffleServer (no
+    subprocess needed for fast tests)."""
+
+    def __init__(self, srv: ShuffleServer):
+        self.srv = srv
+
+    @property
+    def address(self):
+        return self.srv.address
+
+    def kill(self):
+        try:
+            self.srv.stop()
+        except Exception:
+            pass
+
+    def close(self):
+        self.kill()
+
+    def describe(self):
+        return {"address": f"{self.srv.address}"}
+
+
+FAST_FLEET_CONF = {
+    "auron.fleet.heartbeat.seconds": 0.1,
+    **FAST_RETRY,
+    "auron.net.timeout.seconds": 5.0,
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    yield
+    faults.reset()
+    mem_manager.reset_hooks()
+    reset_manager()
+    task_pool.reset_pool()
+
+
+def test_fleet_routes_exchanges_through_sidecar_and_cleans_up():
+    from auron_tpu.serving import FleetManager, LocalExecutor
+    rss = ShuffleServer().start()
+    shim = _SidecarShim(rss)
+    plan = _agg_query(_rows())
+    with config.conf.scoped(SERIAL):
+        base = _canon(AuronSession().execute(plan).table)
+    fleet = None
+    c0 = counters.get("rss_cleanups")
+    try:
+        with config.conf.scoped(FAST_FLEET_CONF):
+            fleet = FleetManager(
+                endpoints=[LocalExecutor()], rss_sidecar=shim)
+            qid = fleet.submit(plan, conf=dict(SERIAL))
+            assert fleet.wait(qid, timeout=60), fleet.status(qid)
+            assert fleet.status(qid)["state"] == "succeeded"
+            assert _canon(fleet.result(qid)).equals(base)
+            # the worker really pushed through the side-car (totals
+            # outlive the terminal cleanup) ...
+            control = fleet._sidecar.control
+            totals = control.stats(prefix=f"{qid}|")["totals"]
+            assert totals, "no commits reached the side-car"
+            # ... and the terminal state cleaned the ledger
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if not control.stats(prefix=f"{qid}|")["shuffles"] \
+                        and counters.get("rss_cleanups") > c0:
+                    break
+                time.sleep(0.05)
+            assert not control.stats(prefix=f"{qid}|")["shuffles"]
+            assert counters.get("rss_cleanups") > c0
+            assert fleet.rss_sidecar_up() is True
+            assert fleet.stats()["fleet"]["rss_sidecar"]["state"] == \
+                "alive"
+    finally:
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+
+
+def test_fleet_sidecar_death_degrades_new_dispatches():
+    from auron_tpu.serving import FleetManager, LocalExecutor
+    from auron_tpu.serving.fleet import DEAD
+    rss = ShuffleServer().start()
+    shim = _SidecarShim(rss)
+    plan = _agg_query(_rows())
+    fleet = None
+    d0 = counters.get("rss_sidecar_deaths")
+    try:
+        with config.conf.scoped({**FAST_FLEET_CONF,
+                                 "auron.fleet.death.probes": 2,
+                                 "auron.net.timeout.seconds": 1.0}):
+            fleet = FleetManager(
+                endpoints=[LocalExecutor()], rss_sidecar=shim)
+            rss.stop()
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if fleet.rss_sidecar_up() is False:
+                    break
+                time.sleep(0.05)
+            assert fleet.rss_sidecar_up() is False, "death not declared"
+            assert counters.get("rss_sidecar_deaths") == d0 + 1
+            assert fleet.stats()["fleet"]["rss_sidecar"]["state"] == \
+                DEAD
+            # new dispatches degrade to executor-local shuffle: the
+            # query succeeds without the side-car
+            qid = fleet.submit(plan, conf=dict(SERIAL))
+            assert fleet.wait(qid, timeout=60), fleet.status(qid)
+            assert fleet.status(qid)["state"] == "succeeded"
+    finally:
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance stress: kill -9 an executor, the requeued query RESUMES
+# ---------------------------------------------------------------------------
+
+STRESS_NAMES = ["q01", "q42", "q01", "q42", "q01", "q42"]
+
+
+@pytest.fixture(scope="module")
+def catalog(tmp_path_factory):
+    from auron_tpu.it.datagen import generate
+    from auron_tpu.serving import register_catalog
+    cat = generate(str(tmp_path_factory.mktemp("rss_tpcds")), sf=SF,
+                   fact_chunks=3)
+    register_catalog(SF, cat)
+    return cat
+
+
+def _solo_baselines(names, catalog):
+    from auron_tpu.it import queries
+    from auron_tpu.it.oracle import PyArrowEngine
+    out = {}
+    with config.conf.scoped(SERIAL):
+        for name in set(names):
+            session = AuronSession(foreign_engine=PyArrowEngine())
+            out[name] = _canon(
+                session.execute(queries.build(name, catalog)).table)
+    return out
+
+
+def test_rss_kill9_resume_acceptance_stress(catalog, tmp_path):
+    """THE acceptance gate: 6 concurrent corpus queries across 2
+    worker PROCESSES pushing shuffle through a side-car process; the
+    busiest executor is killed with `kill -9` after >= 1 of its
+    queries' stages is committed+sealed on the side-car.  The requeued
+    query SKIPS that stage on the survivor — proven by stage-skip
+    counters AND the side-car's cumulative commit totals staying flat
+    (its map tasks never re-ran) — fetches the committed blocks, every
+    result is bit-identical to its solo fault-free run, zero
+    `auron.task.retries` consumed anywhere, ledgers drained, no
+    process leaks."""
+    from auron_tpu.it import queries
+    from auron_tpu.serving import FleetManager
+
+    baselines = _solo_baselines(STRESS_NAMES, catalog)
+
+    hb = 1.5
+    # worker-side chaos: latency only (the zero-retries assertion
+    # covers EVERY worker; io faults would consume retry budget by
+    # design) — op latency keeps queries in flight past their first
+    # sealed stage, rss latency exercises the side-car wire
+    worker_spec = ("op.execute:latency:p=0.5,ms=150,max=60,seed=11;"
+                   "rss.push:latency:p=0.2,ms=3,max=40,seed=5")
+    worker_conf = {
+        **SERIAL,
+        "auron.faults.spec": worker_spec,
+        "auron.task.retries": 2,
+        **FAST_RETRY,
+        "auron.retry.backoff.max.ms": 10.0,
+        "auron.serving.preempt.watermark": 0.0,
+        "auron.serving.max.concurrent": 4,
+    }
+    driver_spec = ("fleet.dispatch:io:p=0.25,max=2,seed=5;"
+                   "fleet.result:io:p=0.2,max=2,seed=9")
+    faults.reset(driver_spec)
+    driver_scope = {
+        "auron.faults.spec": driver_spec,
+        **FAST_RETRY,
+        "auron.retry.backoff.max.ms": 10.0,
+        "auron.net.timeout.seconds": 10.0,
+        "auron.fleet.heartbeat.seconds": hb,
+        "auron.fleet.death.probes": 3,
+        "auron.admission.default.forecast.bytes": 1 << 20,
+        "auron.serving.max.concurrent": 4,
+    }
+    t_retried0 = counters.get("tasks_retried")
+    requeues0 = counters.get("fleet_requeues")
+    pr_requeues0 = counters.get("requeues")
+    fleet = None
+    with config.conf.scoped(driver_scope):
+        mgr = reset_manager(1 << 30)
+        fleet = FleetManager.spawn(2, conf_map=worker_conf,
+                                   budget_bytes=1 << 29,
+                                   log_dir=str(tmp_path),
+                                   rss_sidecar=True)
+        control = fleet._sidecar.control
+        try:
+            qids = [fleet.submit(queries.build(n, catalog),
+                                 priority=1 + (i % 3))
+                    for i, n in enumerate(STRESS_NAMES)]
+
+            # kill once an executor holds >= 2 in-flight queries, one
+            # of which has a SEALED stage on the side-car (the resume
+            # precondition the acceptance is about)
+            victim = survivor = None
+            resumed_qid = sealed_sid = None
+            commits_before = maps_expected = None
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                snap = fleet.fleet_snapshot()
+                busy = sorted(snap.items(),
+                              key=lambda kv: -kv[1]["inflight"])
+                eid, doc = busy[0]
+                if doc["inflight"] >= 2 and \
+                        doc["load"].get("running", 0) >= 1:
+                    inflight_qids = [
+                        q for q in qids
+                        if fleet.get(q).executor_id == eid
+                        and not fleet.get(q).done.is_set()]
+                    stats = control.stats()
+                    for q in inflight_qids:
+                        for sid, sdoc in stats["shuffles"].items():
+                            if sid.startswith(f"{q}|") and \
+                                    sdoc["sealed"] is not None and \
+                                    sdoc["maps"] >= sdoc["sealed"]:
+                                victim, survivor = eid, busy[1][0]
+                                resumed_qid, sealed_sid = q, sid
+                                maps_expected = sdoc["sealed"]
+                                commits_before = stats["totals"][
+                                    sid]["commits"]
+                                break
+                        if victim:
+                            break
+                if victim:
+                    break
+                time.sleep(0.1)
+            assert victim is not None, \
+                f"no sealed stage on a busy executor: " \
+                f"{fleet.fleet_snapshot()} / {control.stats()}"
+            victim_qids = [q for q in qids
+                           if fleet.get(q).executor_id == victim
+                           and not fleet.get(q).done.is_set()]
+            pid = fleet._handles[victim].endpoint.pid
+            os.kill(pid, signal.SIGKILL)
+            t_kill = time.monotonic()
+
+            detect_s = None
+            while time.monotonic() - t_kill < 30:
+                if fleet.fleet_snapshot()[victim]["state"] == "dead":
+                    detect_s = time.monotonic() - t_kill
+                    break
+                time.sleep(0.05)
+            assert detect_s is not None, "death never declared"
+            assert detect_s <= 3 * hb + hb / 2
+
+            for q in qids:
+                assert fleet.wait(q, timeout=600), fleet.status(q)
+
+            # bit-identical to solo runs
+            for q, name in zip(qids, STRESS_NAMES):
+                st = fleet.status(q)
+                assert st["state"] == "succeeded", (name, st)
+                got = _canon(fleet.result(q))
+                assert got.equals(baselines[name]), \
+                    f"{name} ({q}) diverged from its solo run"
+
+            # the victim's queries were requeued on the survivor
+            for q in victim_qids:
+                st = fleet.status(q)
+                assert st["requeues"] >= 1, st
+                assert st["executor"] == survivor, st
+                assert victim in st["excluded_executors"], st
+            assert counters.get("fleet_requeues") - requeues0 >= \
+                len(victim_qids)
+
+            # RESUME, not recompute: the survivor skipped >= 1 stage
+            # (worker counters aggregated over heartbeats) and the
+            # sealed stage's cumulative commit total never moved — its
+            # map tasks did not run again
+            worker_totals = fleet.fleet_counter_totals()
+            assert worker_totals.get("rss_stage_skips", 0) >= 1, \
+                worker_totals
+            post = control.stats(prefix=f"{resumed_qid}|")
+            assert post["totals"][sealed_sid]["commits"] == \
+                commits_before, \
+                f"map tasks re-ran for sealed stage {sealed_sid}"
+            assert maps_expected == commits_before
+
+            # terminal cleanup emptied the side-car ledger
+            for q in qids:
+                assert not control.stats(
+                    prefix=f"{q}|")["shuffles"], q
+
+            # zero retry budget consumed: driver-side AND worker-side
+            assert counters.get("tasks_retried") - t_retried0 == 0
+            assert worker_totals.get("tasks_retried", 0) == 0
+            assert counters.get("requeues") - pr_requeues0 == 0
+            assert fleet.stats()["preemptions"] == 0
+
+            assert fleet.admission.held_bytes() == 0
+            assert not any(label.startswith("admission:")
+                           for label in mgr._reservations)
+            assert fleet.rss_sidecar_up() is True
+        finally:
+            procs = [h.endpoint.proc for h in fleet._handles.values()
+                     if getattr(h.endpoint, "proc", None) is not None]
+            sc_proc = fleet._sidecar.proc
+            fleet.shutdown(wait=True)
+            for p in procs:
+                assert p.poll() is not None, "worker process leaked"
+            assert sc_proc.proc.poll() is not None, \
+                "side-car process leaked"
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        alive = [t.name for t in threading.enumerate()
+                 if t.name.startswith(("auron-fleet-",
+                                       "auron-driver-"))]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, f"threads leaked: {alive}"
+
+
+@pytest.mark.slow
+def test_tools_rss_check_script():
+    """tools/rss_check.sh is the CI durable-shuffle gate; keep it
+    green from pytest (mirrors fleet_check wiring)."""
+    import shutil
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "rss_check.sh")
+    if not os.path.exists(script) or shutil.which("bash") is None:
+        pytest.skip("rss script or bash unavailable")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    out = subprocess.run(["bash", script], capture_output=True,
+                         text=True, timeout=540, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -680,6 +680,202 @@ def test_fleet_http_surface_scheduler_and_metrics():
         srv2.stop()
 
 
+def test_heartbeat_load_carries_live_memory_and_counters():
+    """PR 12 satellite: heartbeat payloads carry live per-worker
+    memory usage, queue depth, per-query memory peaks and the mirrored
+    worker counters (the admission re-forecast + /metrics feed)."""
+    ep = LocalExecutor(session_factory=_FastSession)
+    try:
+        hb = ep.heartbeat()
+        load = hb["load"]
+        assert set(load) >= {"running", "queued", "mem", "query_mem",
+                             "counters", "draining"}
+        assert set(load["mem"]) == {"used", "budget"}
+        assert load["mem"]["budget"] > 0
+        assert "rss_stage_skips" in load["counters"]
+        assert "tasks_retried" in load["counters"]
+    finally:
+        ep.close()
+
+
+def test_admission_reforecast_grows_and_shrinks():
+    """Live re-forecast: growth applies immediately, shrink waits for
+    the min-age gate, both update the MemManager reservation; a
+    released query is never touched."""
+    from auron_tpu.serving import AdmissionController
+    mgr = reset_manager(1 << 30)
+    ac = AdmissionController()
+    with conf.scoped({"auron.admission.default.forecast.bytes": 1 << 20,
+                      "auron.admission.forecast.margin": 1.0,
+                      "auron.admission.memory.fraction": 0.5}):
+        dec = ac.offer("q1", "sig-x", queue_len=0)
+        assert dec.action == "admit"
+        assert ac.held_bytes() == 1 << 20
+        # growth: immediate, reservation follows
+        assert ac.reforecast("q1", 4 << 20, age_s=0.0) == 4 << 20
+        assert ac.held_bytes() == 4 << 20
+        assert mgr._reservations.get("admission:q1") == 4 << 20
+        # shrink: gated on age
+        assert ac.reforecast("q1", 1 << 20, age_s=0.0) is None
+        assert ac.held_bytes() == 4 << 20
+        assert ac.reforecast("q1", 1 << 20, age_s=60.0) == 1 << 20
+        assert ac.held_bytes() == 1 << 20
+        # disabled knob: no-op
+        with conf.scoped({"auron.admission.reforecast.enable": False}):
+            assert ac.reforecast("q1", 8 << 20, age_s=60.0) is None
+        # unknown / released queries are never touched
+        ac.release("q1")
+        assert ac.reforecast("q1", 8 << 20, age_s=60.0) is None
+        assert ac.held_bytes() == 0
+        assert "admission:q1" not in mgr._reservations
+        assert ac.events["reforecast"] == 2
+
+
+def test_drain_estimate_prefers_live_inflight():
+    """The live half of the drain estimate: heartbeat-reported running
+    counts beat the ledger when larger."""
+    from auron_tpu.runtime import tracing
+    from auron_tpu.serving import AdmissionController
+    tracing.clear_history()
+    ledger_only = AdmissionController()
+    live = AdmissionController(inflight_fn=lambda: 5)
+    with conf.scoped({"auron.serving.max.concurrent": 1}):
+        assert ledger_only.drain_estimate_s(0) == pytest.approx(2.0)
+        # 5 live + 1 ahead = 6 waves x 2s avg
+        assert live.drain_estimate_s(0) == pytest.approx(12.0)
+
+
+def test_fleet_reforecast_from_heartbeat_telemetry():
+    """The fleet feeds per-query heartbeat memory peaks into the
+    front-door ledger: a running query's reservation grows past its
+    forecast DURING the run, not at completion."""
+
+    class _Endpoint(LocalExecutor):
+        def heartbeat(self, ids=None):
+            doc = super().heartbeat(ids)
+            doc["load"]["query_mem"] = {i: 64 << 20 for i in ids or []}
+            return doc
+
+    blocky = _BlockingFactory()
+    ep = _Endpoint(session_factory=blocky)
+    fleet = None
+    try:
+        with conf.scoped({**FAST_FLEET_CONF,
+                          "auron.admission.default.forecast.bytes":
+                              1 << 20,
+                          "auron.admission.forecast.margin": 1.0,
+                          "auron.admission.memory.fraction": 0.9}):
+            reset_manager(1 << 30)
+            fleet = FleetManager(endpoints=[ep])
+            qid = fleet.submit(_tiny_plan())
+            assert blocky.started.wait(30)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if fleet.admission.held_bytes() == 64 << 20:
+                    break
+                time.sleep(0.02)
+            assert fleet.admission.held_bytes() == 64 << 20, \
+                "live reforecast never applied"
+            blocky.release.set()
+            assert fleet.wait(qid, timeout=30)
+            assert fleet.admission.held_bytes() == 0
+    finally:
+        blocky.release.set()
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------------------
+# elastic fleet sizing (PR 12 satellite)
+# ---------------------------------------------------------------------------
+
+def test_fleet_autoscale_up_on_queue_depth_and_down_when_idle():
+    """Queue depth past `auron.fleet.scale.up.queue.depth` spawns
+    workers through the factory (bounded by max.workers); workers idle
+    past `auron.fleet.scale.idle.seconds` retire through the drain
+    (bounded by min.workers)."""
+    blocky = _BlockingFactory()
+
+    def factory(eid):
+        return LocalExecutor(executor_id=eid, session_factory=blocky)
+
+    ups0 = counters.get("fleet_scale_ups")
+    downs0 = counters.get("fleet_scale_downs")
+    fleet = None
+    try:
+        with conf.scoped({**FAST_FLEET_CONF,
+                          "auron.fleet.heartbeat.seconds": 0.05,
+                          "auron.serving.max.concurrent": 1,
+                          "auron.fleet.scale.up.queue.depth": 1,
+                          "auron.fleet.scale.max.workers": 3,
+                          "auron.fleet.scale.min.workers": 1,
+                          "auron.fleet.scale.idle.seconds": 0.4,
+                          "auron.fleet.scale.cooldown.seconds": 0.05}):
+            fleet = FleetManager(
+                endpoints=[factory("w0")], worker_factory=factory)
+            qids = [fleet.submit(_tiny_plan(f"t{i}")) for i in range(5)]
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if counters.get("fleet_scale_ups") - ups0 >= 2:
+                    break
+                time.sleep(0.02)
+            assert counters.get("fleet_scale_ups") - ups0 >= 2
+            with fleet._lock:
+                alive = [h for h in fleet._handles.values()
+                         if not h.dead]
+            assert len(alive) == 3          # max.workers binds
+            blocky.release.set()
+            for q in qids:
+                assert fleet.wait(q, timeout=30), fleet.status(q)
+                assert fleet.status(q)["state"] == "succeeded"
+            # idle retirement back down to min.workers
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                with fleet._lock:
+                    alive = [h for h in fleet._handles.values()
+                             if not h.dead]
+                if len(alive) == 1:
+                    break
+                time.sleep(0.05)
+            assert len(alive) == 1, "idle workers never retired"
+            assert counters.get("fleet_scale_downs") - downs0 >= 2
+            with fleet._lock:
+                retired = [h for h in fleet._handles.values()
+                           if h.retired]
+            assert len(retired) >= 2
+            snap = fleet.fleet_snapshot()
+            assert sum(1 for d in snap.values()
+                       if not d["dead"]) == 1
+    finally:
+        blocky.release.set()
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+
+
+def test_fleet_autoscale_dormant_without_knobs():
+    """Defaults keep elastic sizing dormant: no factory calls, no
+    scaling counters, even with a queue."""
+    calls = []
+
+    def factory(eid):
+        calls.append(eid)
+        return LocalExecutor(executor_id=eid,
+                             session_factory=_FastSession)
+
+    ups0 = counters.get("fleet_scale_ups")
+    with conf.scoped(FAST_FLEET_CONF):
+        fleet = FleetManager(
+            endpoints=[LocalExecutor(session_factory=_FastSession)],
+            worker_factory=factory)
+        qids = [fleet.submit(_tiny_plan(f"t{i}")) for i in range(4)]
+        for q in qids:
+            assert fleet.wait(q, timeout=30)
+        time.sleep(0.3)
+        assert not calls
+        assert counters.get("fleet_scale_ups") == ups0
+        fleet.shutdown(wait=True)
+
+
 def test_drain_estimate_accounts_for_executor_count():
     """The Retry-After satellite: with N executors behind the front
     door a wave is N * max.concurrent wide, so the hint must shrink
@@ -761,6 +957,11 @@ def _solo_baselines(names, catalog):
     return out
 
 
+# PR 12 tier-1 re-split: superseded in tier-1 by test_durable_shuffle's
+# kill-9 RESUME stress (same 2-process kill -9 + requeue machinery plus
+# the side-car resume assertions); this one still runs nightly via
+# -m slow and tools/fleet_check.sh.
+@pytest.mark.slow
 def test_fleet_kill9_acceptance_stress(catalog, tmp_path):
     """THE acceptance gate: 6 concurrent corpus queries across 2 worker
     PROCESSES under io+latency faults; one worker is killed with

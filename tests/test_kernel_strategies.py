@@ -72,6 +72,9 @@ def _np_reference_perm(words, bits, live):
     return np.lexsort(tuple(reversed([padr] + keys)))
 
 
+@pytest.mark.slow   # PR 12 tier-1 re-split (7.4s; dispatch-parity +
+#                     stable-argsort + sort-exec tests keep the gate,
+#                     kernel_check.sh runs the full suite nightly)
 def test_radix_sort_matches_np_lexsort_randomized():
     rng = np.random.default_rng(42)
     for trial in range(25):
@@ -295,6 +298,8 @@ def _agg_result(scope):
                   key=lambda r: r["k"])
 
 
+@pytest.mark.slow   # PR 12 tier-1 re-split (7.4s; the randomized
+#                     onehot-vs-scatter reducer test stays in tier-1)
 def test_agg_forced_onehot_matches_scatter():
     """A real agg plan under the forced one-hot strategy (batch
     capacities here sit under the max.segments ceiling, so the dispatch

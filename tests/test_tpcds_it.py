@@ -64,9 +64,19 @@ def runner(catalog):
 # 13.4s, q56s 12.3s, q20c 12.1s, q68s 11.9s, q22r 10.9s, q43 10.3s,
 # q79s 10.1s, q62w 9.1s (mesh variants of q80s/q56s/q62w/q39v add
 # another ~48s).  Post-split tier-1: 604-26=578ish tests in ~700s.
+# PR 12 budget re-measure (2026-08-05): tier-1 clocked 845s/870 on
+# this box with the durable-shuffle additions (the rss kill-9 resume
+# stress replaced the PR 11 fleet stress in tier-1 at ~same cost, the
+# fast durable suite added ~15s), so five more stragglers move out —
+# measured serial costs: q23c 10.9s, q27r 8.3s, q24s 7.9s, q74y 5.8s,
+# q53m 5.8s (~39s) — plus the op-device chaos sweep (test_chaos.py,
+# 13.9s).  q36r (8.0s) deliberately STAYS: it is the remaining
+# in-tier rollup/sort query test_some_queries_ride_the_mesh pins.
+# Post-split tier-1: 769 tests in ~725s on this box.
 _TIER1_STRAGGLERS = {
     "q67r", "q39v", "q98", "q25m", "q76u", "q80s", "q56s", "q20c",
     "q68s", "q22r", "q43", "q79s", "q62w",
+    "q23c", "q27r", "q24s", "q74y", "q53m",
 }
 _TIER1_QUERIES = (set(names()[::4]) | {
     "q03", "q07", "q42", "q55", "q13a", "q26a", "q48a", "q19", "q65w",
