@@ -704,6 +704,23 @@ TRACE_MAX_EVENTS = conf.define(
     "cap are counted as dropped instead of growing the recorder without "
     "bound (a megarow scan with per-operator events stays O(cap)).",
 )
+TRACE_STITCH_ENABLE = conf.define(
+    "auron.trace.stitch.enable", True,
+    "Fleet trace stitching (serving/fleet.py + runtime/tracing.py): "
+    "with tracing on, the driver harvests span increments from worker "
+    "processes over heartbeats and from the RSS side-car at terminal "
+    "states, aligns them with heartbeat RTT-midpoint clock offsets, "
+    "and records ONE per-query Chrome trace with per-process lanes on "
+    "its own /queries history.  Off keeps tracing process-local (each "
+    "process still records and exports its own spans).",
+)
+EVENTS_MAX = conf.define(
+    "auron.events.max", 512,
+    "Fleet flight-recorder ring size (runtime/events.py): structured "
+    "causal events — executor death, kill-and-requeue, side-car "
+    "degrade, preemption, scale up/down, circuit-break, shed — kept "
+    "for GET /events; the oldest events fall off past the bound.",
+)
 METRICS_HISTORY_MAX = conf.define(
     "auron.metrics.history.max", 64,
     "Completed-query history ring size (runtime/tracing.py): records "

@@ -175,6 +175,12 @@ class AuronSession:
             # concurrent neighbor's retries and spills
             st = scope.stats.snapshot()
             trees = res.metrics if res is not None else []
+            # the minimal lifecycle timeline of a direct execute (the
+            # serving schedulers patch/record the full queued ->
+            # admitted -> ... machine over this)
+            timeline = [{"state": "running", "t": wall_start},
+                        {"state": "failed" if error else "succeeded",
+                         "t": wall_start + wall_s}]
             tracing.record_query(tracing.QueryRecord(
                 query_id=scope.query_id, wall_s=wall_s,
                 rows=res.table.num_rows if res is not None else 0,
@@ -189,6 +195,7 @@ class AuronSession:
                 mem_spill_bytes=st.get("mem_spill_bytes", 0),
                 metric_trees=[{"tasks": n, "tree": t.to_dict()}
                               for t, n in merge_metric_trees(trees)],
+                timeline=timeline,
                 trace=scope.recorder.to_chrome_trace()
                 if scope.recorder is not None else None))
         counters.bump("queries_completed")

@@ -14,11 +14,11 @@ one flat dict so consumers never chase two registries.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Tuple
 
 from auron_tpu.runtime import lockcheck
 
-__all__ = ["bump", "get", "snapshot", "reset"]
+__all__ = ["bump", "get", "snapshot", "reset", "observe", "histograms"]
 
 _LOCK = lockcheck.Lock("counters")
 _COUNTERS: Dict[str, int] = {
@@ -66,7 +66,66 @@ _COUNTERS: Dict[str, int] = {
     "rss_degrades": 0,
     "rss_sidecar_deaths": 0,
     "rss_cleanups": 0,
+    # tracing: spans dropped past auron.trace.max.events (per-recorder
+    # `dropped` counts feed trace_truncated on the exported trace; this
+    # is the process total `auron_trace_dropped_events_total` exports)
+    "trace_dropped_events": 0,
 }
+
+# -- latency histograms (the /metrics `auron_query_*_seconds` family) -------
+#
+# Fixed-bucket seconds histograms in the Prometheus exposition shape
+# (cumulative `_bucket{le=}` counts + `_sum` + `_count`).  Pre-seeded
+# names always appear on /metrics — a scrape target that only exists
+# once a query has run is a dashboard hole.
+
+_HIST_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0)
+_HIST_NAMES = ("query_wall_seconds", "query_queue_wait_seconds",
+               "query_admission_wait_seconds", "query_exec_seconds")
+_HISTS: Dict[str, Dict[str, object]] = {
+    name: {"counts": [0] * (len(_HIST_BUCKETS) + 1),
+           "sum": 0.0, "count": 0}
+    for name in _HIST_NAMES
+}
+
+
+def observe(name: str, value: float) -> None:
+    """Record one observation into the named seconds histogram (created
+    on first use for non-preseeded names)."""
+    v = float(value)
+    with _LOCK:
+        h = _HISTS.get(name)
+        if h is None:
+            h = _HISTS[name] = {
+                "counts": [0] * (len(_HIST_BUCKETS) + 1),
+                "sum": 0.0, "count": 0}
+        idx = len(_HIST_BUCKETS)
+        for i, le in enumerate(_HIST_BUCKETS):
+            if v <= le:
+                idx = i
+                break
+        h["counts"][idx] += 1          # type: ignore[index]
+        h["sum"] += v                  # type: ignore[operator]
+        h["count"] += 1                # type: ignore[operator]
+
+
+def histograms() -> Dict[str, Dict[str, object]]:
+    """{name: {"buckets": [(le, cumulative_count)], "sum", "count"}} —
+    cumulative per-bucket counts, ready for text-format exposition."""
+    with _LOCK:
+        out: Dict[str, Dict[str, object]] = {}
+        for name, h in _HISTS.items():
+            cum = 0
+            buckets: List[Tuple[float, int]] = []
+            for le, c in zip(_HIST_BUCKETS, h["counts"]):  # type: ignore
+                cum += c
+                buckets.append((le, cum))
+            out[name] = {"buckets": buckets,
+                         "sum": float(h["sum"]),      # type: ignore[arg-type]
+                         "count": int(h["count"])}    # type: ignore[arg-type]
+        return out
 
 
 def bump(key: str, delta: int = 1) -> int:
@@ -96,8 +155,12 @@ def snapshot() -> Dict[str, int]:
 
 
 def reset() -> None:
-    """Test hook: zero the lifecycle counters (retry stats have their
-    own reset)."""
+    """Test hook: zero the lifecycle counters and histograms (retry
+    stats have their own reset)."""
     with _LOCK:
         for k in _COUNTERS:
             _COUNTERS[k] = 0
+        for h in _HISTS.values():
+            h["counts"] = [0] * (len(_HIST_BUCKETS) + 1)
+            h["sum"] = 0.0
+            h["count"] = 0

@@ -1,0 +1,68 @@
+"""Fleet flight recorder: a bounded ring of structured causal events.
+
+Counters say HOW MANY workers died; a postmortem needs to know WHICH
+worker died, WHEN, and WHICH queries it took down.  Every
+fleet/serving-layer incident — executor death, kill-and-requeue,
+side-car degrade, preemption, elastic scale up/down, routing
+circuit-break, admission shed — lands here as one structured event
+(monotone sequence number, wall timestamp, kind, human message,
+affected query ids, free-form attributes), served at ``GET /events``
+on the profiling server and mirrored as trace instants into any armed
+per-query recorder by the emitter.
+
+The ring is bounded (``auron.events.max``) and process-wide; emitting
+is a dict append under one lock — cheap enough to stay always-on (the
+emit sites are failure/scaling paths, never per-batch)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+from auron_tpu.runtime import lockcheck
+
+__all__ = ["emit", "snapshot", "clear"]
+
+_LOCK = lockcheck.Lock("events")
+_EVENTS: List[Dict[str, Any]] = []
+_SEQ = 0
+
+
+def emit(kind: str, message: str = "",
+         query_ids: Iterable[str] = (), **attrs: Any) -> Dict[str, Any]:
+    """Record one causal event; returns the stored dict (its ``seq`` is
+    the cursor `snapshot(since=)` pages by)."""
+    from auron_tpu.config import conf
+    global _SEQ
+    limit = max(1, int(conf.get("auron.events.max")))
+    ev = {"kind": kind, "message": message, "t": time.time(),
+          "query_ids": [str(q) for q in query_ids]}
+    if attrs:
+        ev["attrs"] = {k: v for k, v in attrs.items() if v is not None}
+    with _LOCK:
+        _SEQ += 1
+        ev["seq"] = _SEQ
+        _EVENTS.append(ev)
+        if len(_EVENTS) > limit:
+            del _EVENTS[:len(_EVENTS) - limit]
+    return ev
+
+
+def snapshot(since: int = 0, kind: Optional[str] = None,
+             query_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Events with seq > `since`, oldest first, optionally filtered by
+    kind prefix and/or affected query id."""
+    with _LOCK:
+        evs = [dict(e) for e in _EVENTS if e["seq"] > int(since)]
+    if kind:
+        evs = [e for e in evs if str(e["kind"]).startswith(kind)]
+    if query_id:
+        evs = [e for e in evs if query_id in e.get("query_ids", ())]
+    return evs
+
+
+def clear() -> None:
+    """Test hook: empty the ring (the sequence keeps counting so
+    `since` cursors stay monotone across a clear)."""
+    with _LOCK:
+        _EVENTS.clear()

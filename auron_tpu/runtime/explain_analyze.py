@@ -27,8 +27,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from auron_tpu.runtime.metrics import MetricNode
 
 __all__ = ["merge_metric_trees", "metric_totals", "metric_max",
-           "render_analyzed", "explain_analyze", "diff_metric_trees",
-           "render_diff"]
+           "render_analyzed", "render_analyzed_dicts", "explain_analyze",
+           "diff_metric_trees", "render_diff"]
 
 # values that vary run-to-run (timings, process-global cache state,
 # codec-dependent byte counts, memory peaks that move with padding/
@@ -174,6 +174,41 @@ def render_analyzed(trees: List[MetricNode], normalize: bool = False
     for merged, n in merge_metric_trees(trees):
         lines.append(f"[{n} task{'s' if n != 1 else ''}]")
         _render_node(merged, 1, lines, normalize)
+    return "\n".join(lines)
+
+
+def _render_dict_node(node: Dict[str, Any], depth: int,
+                      lines: List[str], normalize: bool) -> None:
+    values = node.get("values") or {}
+    keys = [k for k in _KEY_ORDER if k in values]
+    keys += sorted(k for k in values if k not in _KEY_ORDER)
+    parts = []
+    for k in keys:
+        v = values[k]
+        if normalize and _volatile(k):
+            continue
+        if v == 0 and k not in ("output_rows", "output_batches"):
+            continue
+        parts.append(_fmt_value(k, v) if not normalize
+                     else f"{k}={v}")
+    pad = "  " * depth
+    lines.append(f"{pad}{node.get('name')}: " + (" ".join(parts) or "-"))
+    for c in node.get("children") or ():
+        _render_dict_node(c, depth + 1, lines, normalize)
+
+
+def render_analyzed_dicts(groups: List[Dict[str, Any]],
+                          normalize: bool = False) -> str:
+    """Render merged metric trees from their SERIALIZED form
+    (QueryRecord.metric_trees: [{"tasks": n, "tree": dict}]) — the
+    shape that crosses the fleet harvest wire and lives in the history
+    ring, so `/queries/<id>` renders fleet-executed queries exactly
+    like local ones without the original MetricNode objects."""
+    lines: List[str] = []
+    for g in groups:
+        n = int(g.get("tasks", 1))
+        lines.append(f"[{n} task{'s' if n != 1 else ''}]")
+        _render_dict_node(g.get("tree") or {}, 1, lines, normalize)
     return "\n".join(lines)
 
 

@@ -207,6 +207,11 @@ def _prometheus_text() -> str:
         emit(f"auron_{key}_total", snap.get(key, 0),
              help_="durable shuffle (this process): "
                    f"{key.replace('_', ' ')} count")
+    emit("auron_trace_dropped_events_total",
+         snap.get("trace_dropped_events", 0),
+         help_="spans dropped past auron.trace.max.events across all "
+               "recorders (per-query drops flag trace_truncated on "
+               "the exported trace)")
     sched = _serving_scheduler()
     up_fn = getattr(sched, "executor_up", None)
     if callable(up_fn):
@@ -301,11 +306,20 @@ def _prometheus_text() -> str:
     ic = ingest_cache_info()
     emit("auron_ffi_ingest_cache_entries", ic.get("entries", 0), "gauge")
     emit("auron_ffi_ingest_cache_bytes", ic.get("bytes", 0), "gauge")
+    # query-latency histograms (runtime/counters.observe): wall time
+    # for every recorded query, plus the serving tier's queue-wait /
+    # admission-wait / execution breakdown
+    for hname, h in sorted(counters.histograms().items()):
+        full = f"auron_{hname}"
+        lines.append(f"# HELP {full} seconds histogram "
+                     f"({hname.replace('_', ' ')})")
+        lines.append(f"# TYPE {full} histogram")
+        for le, cum in h["buckets"]:
+            lines.append(f'{full}_bucket{{le="{le:g}"}} {cum}')
+        lines.append(f'{full}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{full}_sum {round(h['sum'], 6)}")
+        lines.append(f"{full}_count {h['count']}")
     history = tracing.query_history()
-    emit("auron_query_wall_seconds_sum",
-         round(sum(r.wall_s for r in history), 6),
-         help_="wall seconds over the recorded query history")
-    emit("auron_query_wall_seconds_count", len(history))
     emit("auron_query_rows_total", sum(r.rows for r in history))
     totals = tracing.history_metric_totals()
     if totals:
@@ -342,8 +356,10 @@ def _queries_html() -> str:
         spilled = (f"{r.get('mem_spills', 0)} / "
                    f"{_fmt_mem(r.get('mem_spill_bytes', 0))}"
                    if r.get("mem_spills") else "-")
+        qid_esc = _html.escape(r["query_id"])
         rows.append(
-            f"<tr><td><code>{_html.escape(r['query_id'])}</code></td>"
+            f'<tr><td><a href="/queries/{qid_esc}">'
+            f"<code>{qid_esc}</code></a></td>"
             f"<td>{r['wall_s']:.3f}s</td><td>{r['rows']}</td>"
             f"<td>{'spmd' if r['spmd'] else 'serial'}</td>"
             f"<td>{r['attempts']}</td><td>{r['retries']}</td>"
@@ -403,6 +419,60 @@ def _queries_diff(qa: str, qb: str, as_json: bool):
             f"(wall {ra.wall_s:.3f}s vs {rb.wall_s:.3f}s)</p>"
             f"<pre>{_html.escape(text)}</pre>"
             "<p><a href='/queries'>queries</a></p></body></html>")
+    return 200, body.encode(), "text/html"
+
+
+def _query_detail(qid: str, as_json: bool):
+    """(status, body, content_type) for /queries/<id>: the full record
+    — lifecycle timeline with per-state durations, and the merged
+    per-operator metric trees rendered EXPLAIN-ANALYZE style.  Works
+    identically for local and fleet-executed queries (the fleet
+    harvests worker metric trees into the driver's history)."""
+    from auron_tpu.runtime import tracing
+    from auron_tpu.runtime.explain_analyze import render_analyzed_dicts
+    rec = tracing.find_query(qid)
+    if rec is None:
+        return 404, json.dumps(
+            {"error": f"unknown query id {qid!r}"}).encode(), \
+            "application/json"
+    durations = {k: round(v, 4) for k, v in
+                 tracing.timeline_durations(rec.timeline).items()}
+    analyzed = render_analyzed_dicts(rec.metric_trees) \
+        if rec.metric_trees else None
+    if as_json:
+        doc = rec.to_dict(with_trees=True)
+        doc["state_durations"] = durations
+        doc["analyzed"] = analyzed
+        return 200, json.dumps(doc).encode(), "application/json"
+    import html as _html
+    tl_rows = "".join(
+        f"<tr><td>{_html.escape(e['state'])}</td>"
+        f"<td>{e['t']:.3f}</td>"
+        f"<td>{durations.get(e['state'], 0.0):.4f}s</td></tr>"
+        for e in (rec.timeline or []))
+    trace_link = (f'<a href="/queries/{_html.escape(qid)}/trace">'
+                  f"chrome trace</a>" if rec.trace is not None else "-")
+    body = (
+        "<html><head><title>Auron query "
+        f"{_html.escape(qid)}</title><style>"
+        "body{font-family:sans-serif;margin:2em}"
+        "table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 10px}"
+        "</style></head><body>"
+        f"<h2>Query <code>{_html.escape(qid)}</code></h2>"
+        f"<p>wall {rec.wall_s:.3f}s · {rec.rows} rows · "
+        f"{'spmd' if rec.spmd else 'serial'} · "
+        f"retries {rec.retries} · fallbacks {rec.fallbacks} · "
+        f"preemptions {rec.preemptions} · "
+        f"mem peak {_fmt_mem(rec.mem_peak)} · trace {trace_link}"
+        + (f" · <b>error:</b> {_html.escape(str(rec.error)[:200])}"
+           if rec.error else "") + "</p>"
+        "<h3>Lifecycle</h3><table><tr><th>state</th><th>t</th>"
+        f"<th>duration</th></tr>{tl_rows}</table>"
+        "<h3>Per-operator metrics</h3><pre>"
+        + _html.escape(analyzed or "(no per-operator metric trees "
+                       "recorded)") +
+        "</pre><p><a href='/queries'>queries</a></p></body></html>")
     return 200, body.encode(), "text/html"
 
 
@@ -543,11 +613,39 @@ class _Handler(BaseHTTPRequestHandler):
                     url.path.endswith("/trace"):
                 from auron_tpu.runtime import tracing
                 qid = url.path[len("/queries/"):-len("/trace")]
+                since_q = q.get("since", [None])[0]
+                live = tracing.active_recorder(qid) \
+                    if since_q is not None else None
+                if live is not None:
+                    # incremental drain for a RUNNING query (the
+                    # streaming-trace follow-up): spans below `since`
+                    # were acknowledged by the previous poll and are
+                    # freed; the reply carries the next cursor
+                    spans, _first, nxt = live.drain_since(int(since_q))
+                    self._send(200, json.dumps(
+                        live.export_spans(spans,
+                                          next_since=nxt)).encode())
+                    return
                 rec = tracing.find_query(qid)
                 if rec is None or rec.trace is None:
                     self._send(404, b'{"error": "no trace for query"}')
                 else:
                     self._send(200, json.dumps(rec.trace).encode())
+            elif url.path.startswith("/queries/"):
+                code, body, ctype = _query_detail(
+                    url.path[len("/queries/"):],
+                    q.get("format", [""])[0] == "json")
+                self._send(code, body, ctype)
+            elif url.path == "/events":
+                from auron_tpu.runtime import events
+                evs = events.snapshot(
+                    since=int(q.get("since", ["0"])[0]),
+                    kind=q.get("kind", [None])[0],
+                    query_id=q.get("query", [None])[0])
+                self._send(200, json.dumps(
+                    {"events": evs,
+                     "next_since": evs[-1]["seq"] if evs
+                     else int(q.get("since", ["0"])[0])}).encode())
             elif url.path.startswith("/status/"):
                 sched = _serving_scheduler()
                 if sched is None:

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextvars
 import json
+import logging
 import os
 import threading
 import time
@@ -48,10 +49,13 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 from auron_tpu.config import conf
 from auron_tpu.runtime import lockcheck
 
+log = logging.getLogger("auron_tpu.tracing")
+
 __all__ = [
     "Span", "TraceRecorder", "QueryRecord", "QueryStats", "span", "event",
     "current_recorder", "current_query_id", "current_stats", "stats_bump",
-    "start_query", "trace_scope",
+    "start_query", "trace_scope", "active_recorder", "harvest_query",
+    "stitch_traces", "timeline_mark", "timeline_durations",
     "validate_chrome_trace", "summarize_chrome_trace", "query_history",
     "record_query", "history_metric_totals", "clear_history",
 ]
@@ -84,6 +88,11 @@ class TraceRecorder:
             if max_events is None else int(max_events)
         self.spans: List[Span] = []
         self.dropped = 0
+        # spans removed by drain()/drain_since() so far: the absolute
+        # sequence number of self.spans[0] (the incremental-export
+        # cursor long-running queries page through)
+        self._base_seq = 0
+        self._drop_warned = False
         self._lock = lockcheck.Lock("trace.recorder")
 
     # hot path — called from _SpanCtx.__exit__ and event()
@@ -93,29 +102,71 @@ class TraceRecorder:
         s = Span(name=name, cat=cat, t0_ns=t0_ns - self.epoch_ns,
                  dur_ns=dur_ns, tid=t.ident or 0, thread=t.name,
                  args=args or None)
+        first_drop = False
         with self._lock:
             if len(self.spans) >= self.max_events:
                 self.dropped += 1
+                first_drop = not self._drop_warned
+                self._drop_warned = True
+            else:
+                self.spans.append(s)
                 return
-            self.spans.append(s)
+        # past the cap: count the loss where it is visible — on the
+        # process counter (`auron_trace_dropped_events_total`) and, via
+        # `dropped`, on the exported trace's `trace_truncated` flag —
+        # and say so once per query instead of dropping silently
+        from auron_tpu.runtime import counters
+        counters.bump("trace_dropped_events")
+        if first_drop:
+            log.warning(
+                "trace for query %s reached auron.trace.max.events=%d; "
+                "further spans are dropped (the exported trace carries "
+                "trace_truncated plus the drop count)",
+                self.query_id, self.max_events)
 
     def snapshot(self) -> List[Span]:
         with self._lock:
             return list(self.spans)
 
+    # -- incremental export (long-running / streaming queries) ------------
+
+    def drain(self) -> Tuple[List[Span], int]:
+        """Return-and-CLEAR the completed spans recorded so far, plus
+        the next absolute sequence cursor.  Periodic drains keep a
+        long-running query's recorder from growing toward the event cap
+        (the PR 4 follow-up: streaming queries export trace increments
+        instead of buffering a query that never ends)."""
+        with self._lock:
+            spans = self.spans
+            self.spans = []
+            self._base_seq += len(spans)
+            return spans, self._base_seq
+
+    def drain_since(self, since: int) -> Tuple[List[Span], int, int]:
+        """Cursor-acknowledged drain: spans below `since` were received
+        by the caller (a previous response's `next_since`) and are
+        FREED; everything still buffered is returned without clearing,
+        so a lost response is re-served on the next poll.  Returns
+        (spans, first_seq, next_since)."""
+        with self._lock:
+            drop = max(0, min(int(since) - self._base_seq,
+                              len(self.spans)))
+            if drop:
+                del self.spans[:drop]
+                self._base_seq += drop
+            return (list(self.spans), self._base_seq,
+                    self._base_seq + len(self.spans))
+
     # -- export -----------------------------------------------------------
 
-    def to_chrome_trace(self) -> Dict[str, Any]:
-        """Chrome trace-event JSON (the `traceEvents` array form): spans
-        as complete ("X") events, instants as "i", thread names as "M"
-        metadata.  Valid for chrome://tracing and Perfetto."""
-        pid = os.getpid()
+    def _span_events(self, spans: List[Span],
+                     pid: int) -> List[Dict[str, Any]]:
         events: List[Dict[str, Any]] = [
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": f"auron-tpu query {self.query_id}"}},
         ]
         threads_named = set()
-        for s in self.snapshot():
+        for s in spans:
             if s.tid not in threads_named:
                 threads_named.add(s.tid)
                 events.append({"name": "thread_name", "ph": "M",
@@ -133,10 +184,35 @@ class TraceRecorder:
             if s.args:
                 ev["args"] = s.args
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ms",
+        return events
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON (the `traceEvents` array form): spans
+        as complete ("X") events, instants as "i", thread names as "M"
+        metadata.  Valid for chrome://tracing and Perfetto."""
+        return {"traceEvents": self._span_events(self.snapshot(),
+                                                 os.getpid()),
+                "displayTimeUnit": "ms",
                 "otherData": {"query_id": self.query_id,
                               "dropped_events": self.dropped,
+                              "trace_truncated": self.dropped > 0,
                               "wall_start": self.wall_start}}
+
+    def export_spans(self, spans: List[Span],
+                     next_since: Optional[int] = None) -> Dict[str, Any]:
+        """Chrome-trace document over an explicit span batch (the
+        drain()/drain_since() incremental-export form): flagged partial,
+        carrying the cursor the next poll should pass as `since`."""
+        doc = {"traceEvents": self._span_events(spans, os.getpid()),
+               "displayTimeUnit": "ms",
+               "otherData": {"query_id": self.query_id,
+                             "dropped_events": self.dropped,
+                             "trace_truncated": self.dropped > 0,
+                             "wall_start": self.wall_start,
+                             "partial": True}}
+        if next_since is not None:
+            doc["otherData"]["next_since"] = int(next_since)
+        return doc
 
     def save(self, path: str) -> str:
         with open(path, "w") as f:
@@ -218,6 +294,31 @@ _query_id: contextvars.ContextVar[Optional[str]] = \
 _stats: contextvars.ContextVar[Optional[QueryStats]] = \
     contextvars.ContextVar("auron_query_stats", default=None)
 
+# recorders of queries currently IN FLIGHT, keyed by query id — the
+# incremental trace drain (`GET /queries/<id>/trace?since=`) and the
+# fleet's harvest RPC read a running query's spans through here;
+# trace_scope registers on entry and unregisters on exit
+_ACTIVE: Dict[str, TraceRecorder] = {}
+_ACTIVE_LOCK = lockcheck.Lock("trace.active")
+
+
+def _register_active(query_id: str, rec: TraceRecorder) -> None:
+    with _ACTIVE_LOCK:
+        _ACTIVE[query_id] = rec
+
+
+def _unregister_active(query_id: str, rec: TraceRecorder) -> None:
+    with _ACTIVE_LOCK:
+        if _ACTIVE.get(query_id) is rec:
+            del _ACTIVE[query_id]
+
+
+def active_recorder(query_id: str) -> Optional[TraceRecorder]:
+    """The recorder of a query still inside its trace_scope, else None
+    (finished queries live in the history ring instead)."""
+    with _ACTIVE_LOCK:
+        return _ACTIVE.get(query_id)
+
 
 def current_stats() -> Optional[QueryStats]:
     return _stats.get()
@@ -294,11 +395,13 @@ class trace_scope:
         self._tok_stats = _stats.set(self.stats)
         if self.recorder is not None:
             self._tok_rec = _recorder.set(self.recorder)
+            _register_active(self.query_id, self.recorder)
         return self
 
     def __exit__(self, *exc) -> bool:
         if self._tok_rec is not None:
             _recorder.reset(self._tok_rec)
+            _unregister_active(self.query_id, self.recorder)
         if self._tok_stats is not None:
             _stats.reset(self._tok_stats)
         if self._tok_qid is not None:
@@ -456,9 +559,16 @@ class QueryRecord:
     # merged per-operator metric trees ([{"tasks": n, "tree": dict}]) —
     # the structure /queries/diff pairs between two runs of one plan
     metric_trees: Optional[List[Dict[str, Any]]] = None
+    # lifecycle timeline ([{"state": s, "t": wall}] in transition order:
+    # submitted -> queued -> admitted -> dispatched -> running ->
+    # preempted/requeued -> resumed -> terminal); serving schedulers
+    # patch/record the full machine, direct executes a running/terminal
+    # pair
+    timeline: Optional[List[Dict[str, Any]]] = None
     trace: Optional[Dict[str, Any]] = None   # chrome-trace doc, if traced
 
-    def to_dict(self, with_trace: bool = False) -> Dict[str, Any]:
+    def to_dict(self, with_trace: bool = False,
+                with_trees: bool = False) -> Dict[str, Any]:
         d = {"query_id": self.query_id, "wall_s": round(self.wall_s, 4),
              "rows": self.rows, "spmd": self.spmd,
              "attempts": self.attempts, "retries": self.retries,
@@ -467,7 +577,10 @@ class QueryRecord:
              "started_at": self.started_at, "traced": self.trace is not None,
              "mem_peak": self.mem_peak, "mem_spills": self.mem_spills,
              "mem_spill_bytes": self.mem_spill_bytes,
+             "timeline": self.timeline,
              "metric_totals": dict(self.metric_totals)}
+        if with_trees:
+            d["metric_trees"] = self.metric_trees
         if with_trace:
             d["trace"] = self.trace
         return d
@@ -478,6 +591,11 @@ _HISTORY_LOCK = lockcheck.Lock("trace.history")
 
 
 def record_query(rec: QueryRecord) -> None:
+    from auron_tpu.runtime import counters
+    # latency histogram feed (auron_query_wall_seconds on /metrics):
+    # observed here so every entry point — direct executes, the serving
+    # scheduler, fleet-harvested records — lands in the same buckets
+    counters.observe("query_wall_seconds", rec.wall_s)
     limit = max(1, int(conf.get("auron.metrics.history.max")))
     with _HISTORY_LOCK:
         _HISTORY.append(rec)
@@ -512,3 +630,175 @@ def history_metric_totals() -> Dict[str, int]:
 def clear_history() -> None:
     with _HISTORY_LOCK:
         _HISTORY.clear()
+
+
+# ---------------------------------------------------------------------------
+# cross-process harvest + stitching (the fleet observability plane)
+# ---------------------------------------------------------------------------
+#
+# A fleet query executes in a WORKER process (and pushes shuffle through
+# the RSS side-car), so its spans are recorded against per-process
+# recorder epochs the driver cannot compare directly.  The harvest wire
+# therefore ships spans with ABSOLUTE source-process wall-clock
+# timestamps (µs) — recorder epoch + relative offset — and the driver
+# maps them onto its own timeline with a per-process clock offset
+# estimated at heartbeat RTT midpoints, clamping each lane so no span
+# precedes its wire-parent (the dispatch that created the work).
+
+def _span_abs(rec: TraceRecorder, s: Span) -> Dict[str, Any]:
+    """One recorder span as a harvest dict with absolute wall-µs ts."""
+    return {"name": s.name, "cat": s.cat,
+            "ts_us": rec.wall_start * 1e6 + s.t0_ns / 1e3,
+            "dur_us": s.dur_ns / 1e3 if s.dur_ns >= 0 else -1,
+            "tid": s.tid, "thread": s.thread, "args": s.args}
+
+
+def _doc_abs_spans(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """A chrome doc's X/i events as harvest dicts (absolute wall µs),
+    thread names recovered from the M metadata."""
+    wall0_us = float(doc.get("otherData", {}).get("wall_start", 0.0)) * 1e6
+    names: Dict[int, str] = {}
+    out: List[Dict[str, Any]] = []
+    for ev in doc.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph == "M":
+            if ev.get("name") == "thread_name":
+                names[ev.get("tid", 0)] = \
+                    (ev.get("args") or {}).get("name", "")
+            continue
+        if ph not in ("X", "i"):
+            continue
+        out.append({"name": ev.get("name"), "cat": ev.get("cat", ""),
+                    "ts_us": wall0_us + float(ev.get("ts", 0)),
+                    "dur_us": float(ev["dur"]) if ph == "X" else -1,
+                    "tid": ev.get("tid", 0),
+                    "thread": names.get(ev.get("tid", 0), ""),
+                    "args": ev.get("args")})
+    return out
+
+
+def harvest_query(query_id: str) -> Optional[Dict[str, Any]]:
+    """The worker-side half of the fleet harvest RPC.
+
+    For a query still in flight (active recorder): DRAIN its spans —
+    repeated harvests riding heartbeats move trace data to the driver
+    incrementally, so a worker killed mid-query loses only the spans
+    since the last heartbeat, not the whole lane.  For a finished query
+    (history ring): the residual trace plus the QueryRecord summary
+    (metric trees included — the driver cannot read this process's
+    metric state any other way).  None when the query is unknown."""
+    rec = active_recorder(query_id)
+    if rec is not None:
+        spans, _ = rec.drain()
+        return {"complete": False, "dropped": rec.dropped,
+                "spans": [_span_abs(rec, s) for s in spans]}
+    qrec = find_query(query_id)
+    if qrec is None:
+        return None
+    out: Dict[str, Any] = {"complete": True,
+                           "record": qrec.to_dict(with_trees=True)}
+    if qrec.trace is not None:
+        other = qrec.trace.get("otherData", {})
+        out["dropped"] = int(other.get("dropped_events", 0))
+        out["spans"] = _doc_abs_spans(qrec.trace)
+    return out
+
+
+def stitch_traces(base_doc: Dict[str, Any],
+                  lanes: List[Dict[str, Any]],
+                  incomplete: Iterator[str] = ()) -> Dict[str, Any]:
+    """Merge harvested per-process span lanes into ONE chrome trace.
+
+    `base_doc` is the driver recorder's export — its `wall_start` is
+    the stitched timebase and its events keep their pid.  Each lane is
+    ``{"label", "pid", "spans", "offset_s", "anchor_us"}``: spans carry
+    absolute source-process wall-µs timestamps; `offset_s` is the
+    estimated (source_wall - driver_wall) clock offset (heartbeat RTT
+    midpoint); `anchor_us` is the wire-parent start in the driver
+    timeline — the whole lane is shifted forward (never backward) so no
+    span precedes the dispatch that caused it and the merged trace
+    stays monotone under clock skew.  `incomplete` lists processes
+    whose final harvest was lost (a dead worker): the stitched doc is
+    flagged rather than silently partial."""
+    other0 = base_doc.get("otherData", {})
+    base_wall_us = float(other0.get("wall_start", 0.0)) * 1e6
+    events: List[Dict[str, Any]] = list(base_doc.get("traceEvents", []))
+    dropped = int(other0.get("dropped_events", 0))
+    for lane in lanes:
+        spans = lane.get("spans") or []
+        pid = int(lane.get("pid") or 0)
+        label = lane.get("label") or f"pid {pid}"
+        off_us = float(lane.get("offset_s") or 0.0) * 1e6
+        dropped += int(lane.get("dropped") or 0)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        shifted = [float(s["ts_us"]) - off_us - base_wall_us
+                   for s in spans]
+        floor = max(0.0, float(lane.get("anchor_us") or 0.0))
+        lane_shift = 0.0
+        if shifted:
+            lo = min(shifted)
+            if lo < floor:
+                lane_shift = floor - lo
+        threads_named = set()
+        for s, ts in zip(spans, shifted):
+            tid = int(s.get("tid") or 0)
+            if tid not in threads_named:
+                threads_named.add(tid)
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": tid,
+                               "args": {"name": s.get("thread")
+                                        or f"tid {tid}"}})
+            dur = float(s.get("dur_us", -1))
+            ev: Dict[str, Any] = {"name": s.get("name"),
+                                  "cat": s.get("cat", ""),
+                                  "ph": "X" if dur >= 0 else "i",
+                                  "ts": ts + lane_shift,
+                                  "pid": pid, "tid": tid}
+            if dur >= 0:
+                ev["dur"] = dur
+            else:
+                ev["s"] = "t"
+            if s.get("args"):
+                ev["args"] = s["args"]
+            events.append(ev)
+    other = dict(other0)
+    other.update({"stitched": True, "dropped_events": dropped,
+                  "trace_truncated": dropped > 0,
+                  "incomplete": sorted(incomplete)})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
+# ---------------------------------------------------------------------------
+# lifecycle timelines (submitted -> ... -> terminal)
+# ---------------------------------------------------------------------------
+
+def timeline_mark(timeline: List[Dict[str, Any]], state: str,
+                  t: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Append a state transition; consecutive duplicates collapse."""
+    if not timeline or timeline[-1]["state"] != state:
+        timeline.append({"state": state,
+                         "t": time.time() if t is None else float(t)})
+    return timeline
+
+
+def timeline_durations(timeline: Optional[List[Dict[str, Any]]],
+                       now: Optional[float] = None) -> Dict[str, float]:
+    """Seconds spent per state: each entry lasts until the next
+    transition; the final entry runs to `now` unless it is terminal."""
+    if not timeline:
+        return {}
+    terminal = {"succeeded", "failed", "cancelled", "shed"}
+    out: Dict[str, float] = {}
+    for ent, nxt in zip(timeline, timeline[1:]):
+        d = max(0.0, float(nxt["t"]) - float(ent["t"]))
+        out[ent["state"]] = out.get(ent["state"], 0.0) + d
+    last = timeline[-1]
+    if last["state"] not in terminal:
+        end = time.time() if now is None else float(now)
+        out[last["state"]] = out.get(last["state"], 0.0) + \
+            max(0.0, end - float(last["t"]))
+    else:
+        out.setdefault(last["state"], 0.0)
+    return out

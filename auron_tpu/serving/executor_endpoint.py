@@ -46,6 +46,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import pyarrow as pa
@@ -101,6 +102,7 @@ def _table_from_ipc(data: bytes) -> pa.Table:
 _REPORTED_COUNTERS = (
     "rss_stage_skips", "rss_map_tasks_skipped", "rss_map_tasks_run",
     "rss_fetch_regens", "rss_degrades", "tasks_retried",
+    "trace_dropped_events",
 )
 
 
@@ -145,6 +147,11 @@ class ExecutorEndpoint:
     the work runs; the fleet only ever talks in query ids."""
 
     executor_id: str
+    # True when harvest() actually crosses a process boundary (the
+    # fleet only stitches/records driver-side QueryRecords for remote
+    # executors — an in-process LocalExecutor already records into the
+    # driver's own history ring)
+    supports_harvest = False
 
     def dispatch(self, query_id: str, plan, conf_map: Dict[str, Any],
                  priority: Optional[int], serial: bool = False) -> None:
@@ -168,6 +175,14 @@ class ExecutorEndpoint:
 
     def cancel(self, query_id: str) -> bool:
         raise NotImplementedError
+
+    def harvest(self, ids: List[str]) -> Dict[str, Any]:
+        """Trace/record harvest riding the heartbeat cadence: for each
+        requested query id, the executor's span increments (a running
+        traced query is DRAINED — runtime/tracing.harvest_query) or its
+        finished QueryRecord summary with residual spans.  Default: no
+        cross-process state to ship ({})."""
+        return {}
 
     def drain(self) -> List[str]:
         """Stop accepting dispatches and hand back the queued (never
@@ -222,6 +237,7 @@ class LocalExecutor(ExecutorEndpoint):
     def heartbeat(self, ids: Optional[List[str]] = None
                   ) -> Dict[str, Any]:
         return {"executor_id": self.executor_id, "pid": os.getpid(),
+                "now": time.time(),
                 "load": endpoint_load(self.scheduler),
                 "queries": {i: self.scheduler.status(i)
                             for i in (ids or [])}}
@@ -303,9 +319,24 @@ class _ExecHandler(socketserver.BaseRequestHandler):
             send_msg(sock, {"ok": True,
                             "executor_id": server.executor_id,
                             "pid": os.getpid(),
+                            "now": time.time(),
                             "load": server.load(),
                             "queries": {i: sched.status(i)
                                         for i in ids}})
+            return True
+        if cmd == "harvest":
+            from auron_tpu.runtime import tracing
+            traces = {}
+            for qid in header.get("ids") or []:
+                doc = tracing.harvest_query(str(qid))
+                if doc is not None:
+                    traces[qid] = doc
+            # span batches ride the PAYLOAD: a traced query can carry
+            # far more span JSON than the (untrusted-ingress) 1 MiB
+            # header cap allows
+            body = json.dumps(traces).encode()
+            send_msg(sock, {"ok": True, "pid": os.getpid(),
+                            "now": time.time(), "len": len(body)}, body)
             return True
         if cmd == "dispatch":
             if server.draining:
@@ -428,6 +459,8 @@ class ProcessExecutor(ExecutorEndpoint):
     process it spawned.  Connections are per-RPC (no shared socket
     state to corrupt when the worker dies mid-call), and every RPC
     rides the shared retry policy behind its named fault point."""
+
+    supports_harvest = True
 
     def __init__(self, executor_id: str, host: str, port: int,
                  proc: Optional[subprocess.Popen] = None,
@@ -591,6 +624,11 @@ class ProcessExecutor(ExecutorEndpoint):
         resp, _ = self._rpc("cancel",
                             {"cmd": "cancel", "query_id": query_id})
         return bool(resp.get("cancelled"))
+
+    def harvest(self, ids: List[str]) -> Dict[str, Any]:
+        _, data = self._rpc("harvest",
+                            {"cmd": "harvest", "ids": list(ids)})
+        return json.loads(data) if data else {}
 
     def drain(self) -> List[str]:
         resp, _ = self._rpc("drain", {"cmd": "drain"})

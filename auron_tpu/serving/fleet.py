@@ -57,7 +57,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set
 
 from auron_tpu import config
-from auron_tpu.runtime import counters, lockcheck
+from auron_tpu.runtime import counters, events, lockcheck, tracing
 from auron_tpu.serving.admission import ADMIT, AdmissionController
 from auron_tpu.serving.executor_endpoint import (
     EndpointError, ExecutorEndpoint, LocalExecutor, ProcessExecutor,
@@ -219,12 +219,31 @@ class FleetSubmission(Submission):
     under which dispatch id (unique per attempt, so a rerouted query
     can never collide with its own terminal record on a scheduler that
     saw an earlier attempt), and which executors are excluded after a
-    death/drain requeue."""
+    death/drain requeue.
+
+    Observability state (the distributed tracing plane): with tracing
+    armed the driver keeps a per-query TraceRecorder for its OWN lane
+    (dispatch spans, requeue/death instants) plus one harvested span
+    lane per executor the query touched; `harvest_record` is the
+    worker-side QueryRecord summary (metric trees, retries, memory
+    columns) the terminal harvest ships back so `/queries/<id>` works
+    for fleet-executed queries."""
 
     executor_id: Optional[str] = None
     dispatch_id: Optional[str] = None
     excluded_executors: Set[str] = field(default_factory=set)
     requeues: int = 0
+    recorder: Optional[Any] = None           # tracing.TraceRecorder
+    # executor id -> {"label", "pid", "spans", "dropped", "anchor_us",
+    # "complete"}; guarded by the fleet lock
+    lanes: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    lane_final: Set[str] = field(default_factory=set)  # harvested dids
+    harvest_record: Optional[Dict[str, Any]] = None
+    recorded: bool = False      # driver-side QueryRecord emitted
+
+    # fleet placement inserts a `dispatched` state in the lifecycle
+    # timeline (the RPC hop to the worker process)
+    dispatched_marker = True
 
     def status(self) -> Dict[str, Any]:
         doc = super().status()
@@ -251,6 +270,13 @@ class _ExecHandle:
     retired: bool = False          # idle scale-down, not a death
     last_active: float = 0.0       # monotonic; last time it held work
     load: Dict[str, Any] = field(default_factory=dict)
+    pid: Optional[int] = None      # worker os pid (from heartbeats)
+    # wall-clock offset (worker - driver) estimated at heartbeat RTT
+    # midpoints; the minimum-RTT sample wins (least queueing skew) —
+    # the trace stitcher aligns harvested span lanes with it
+    clock_off: float = 0.0
+    clock_rtt: float = float("inf")
+    last_circuit: int = 0          # circuit_opens already event-logged
 
     def snapshot(self) -> Dict[str, Any]:
         doc = {"inflight": len(self.inflight),
@@ -279,6 +305,8 @@ class _SidecarState:
     control: Any
     health: ExecutorHealth
     dead: bool = False
+    clock_off: float = 0.0         # ping RTT-midpoint estimate
+    clock_rtt: float = float("inf")
 
     def snapshot(self) -> Dict[str, Any]:
         doc = {"dead": self.dead}
@@ -417,13 +445,13 @@ class FleetManager:
     def submit(self, plan, conf: Optional[Dict[str, Any]] = None,
                priority: Optional[int] = None,
                query_id: Optional[str] = None) -> str:
-        from auron_tpu.runtime import tracing
         if self._shutdown:
             raise SubmissionRejected("fleet is shut down")
         overrides = dict(conf or {})
         # validate the per-query conf NOW (400 at submit, the
         # scheduler.submit contract) — it also travels to the executor
-        config.conf.query_scoped(overrides)
+        with config.conf.query_scoped(overrides):
+            traced = bool(config.conf.get("auron.trace.enable"))
         if priority is None:
             priority = int(overrides.get(
                 "auron.query.priority",
@@ -432,6 +460,14 @@ class FleetManager:
         sub = FleetSubmission(query_id=qid, plan=plan, conf=overrides,
                               priority=int(priority),
                               signature=plan_signature(plan))
+        if traced:
+            # the driver-lane recorder: dispatch spans and
+            # requeue/death instants land here; worker and side-car
+            # lanes are harvested and stitched in at terminal states
+            sub.recorder = tracing.TraceRecorder(qid)
+            sub.recorder.add("fleet.submit", "fleet",
+                             time.perf_counter_ns(), -1,
+                             {"priority": sub.priority})
         with self._lock:
             if qid in self._subs:
                 raise SubmissionRejected(f"duplicate query id {qid!r}")
@@ -439,6 +475,7 @@ class FleetManager:
                     int(config.conf.get("auron.admission.queue.max")):
                 sub.state = SHED_STATE
                 sub.error = "shed: admission queue full"
+                sub.mark(SHED_STATE)
                 sub.done.set()
                 self._subs[qid] = sub
                 self.admission.events["shed"] += 1
@@ -451,6 +488,8 @@ class FleetManager:
                 queue_len = -1
         if queue_len >= 0:
             counters.bump("admission_shed")
+            events.emit("query.shed", sub.error, [qid],
+                        queue_len=queue_len)
             exc = SubmissionRejected(sub.error)
             exc.retry_after_s = self.admission.drain_estimate_s(queue_len)
             raise exc
@@ -498,6 +537,8 @@ class FleetManager:
                 head.admission_reason = decision.reason
                 head.forecast_bytes = decision.forecast_bytes
                 if decision.action != ADMIT:
+                    if head.admission_blocked_at is None:
+                        head.admission_blocked_at = now
                     return
                 head.serial = decision.serial
                 # requeued queries go to a DIFFERENT executor; if every
@@ -514,21 +555,46 @@ class FleetManager:
                 self._queue.remove(head)
                 head.state = RUNNING
                 head.started_at = time.time()
+                head.mark_started()
                 head.executor_id = target.endpoint.executor_id
                 head.dispatch_id = head.query_id if not head.requeues \
                     else f"{head.query_id}~r{head.requeues}"
                 target.inflight[head.dispatch_id] = head.query_id
                 target.dispatched += 1
                 target.last_active = time.monotonic()
+                if head.recorder is not None:
+                    # the wire-parent anchor: harvested worker spans of
+                    # this lane are clamped to start no earlier than
+                    # the dispatch that caused them
+                    lane = self._lane_locked(head, target)
+                    if lane.get("anchor_us") is None:
+                        lane["anchor_us"] = \
+                            (time.perf_counter_ns()
+                             - head.recorder.epoch_ns) / 1e3
                 dispatch_conf = self._dispatch_conf_locked(head)
             # RPC outside the lock
+            t0p = time.perf_counter_ns()
             try:
                 target.endpoint.dispatch(
                     head.dispatch_id, head.plan, dispatch_conf,
                     head.priority, serial=head.serial)
                 counters.bump("fleet_dispatches")
             except BaseException as e:  # noqa: BLE001 - classified below
+                if head.recorder is not None:
+                    head.recorder.add(
+                        "fleet.dispatch", "fleet", t0p,
+                        time.perf_counter_ns() - t0p,
+                        {"executor": target.endpoint.executor_id,
+                         "dispatch_id": head.dispatch_id,
+                         "error": f"{type(e).__name__}: {e}"})
                 self._dispatch_failed(target, head, e)
+            else:
+                if head.recorder is not None:
+                    head.recorder.add(
+                        "fleet.dispatch", "fleet", t0p,
+                        time.perf_counter_ns() - t0p,
+                        {"executor": target.endpoint.executor_id,
+                         "dispatch_id": head.dispatch_id})
 
     def _dispatch_conf_locked(self, sub: FleetSubmission
                               ) -> Dict[str, Any]:
@@ -541,6 +607,12 @@ class FleetManager:
         simply stops appearing here: new dispatches degrade to
         executor-local shuffle."""
         conf_map = dict(sub.conf)
+        if sub.recorder is not None:
+            # trace-context propagation: the dispatch overlay arms the
+            # worker's recorder for this query (the worker's
+            # trace_scope reads per-query conf), so its spans exist to
+            # harvest back over heartbeats
+            conf_map["auron.trace.enable"] = True
         sc = self._sidecar
         if sc is not None and not sc.dead:
             host, port = sc.proc.address
@@ -551,6 +623,24 @@ class FleetManager:
                 "auron.rss.defer.cleanup": True,
             })
         return conf_map
+
+    def _lane_locked(self, sub: FleetSubmission,
+                     handle: _ExecHandle) -> Dict[str, Any]:
+        """The harvested-span lane of one executor for one submission
+        (fleet lock held)."""
+        eid = handle.endpoint.executor_id
+        lane = sub.lanes.get(eid)
+        if lane is None:
+            lane = sub.lanes[eid] = {
+                "label": eid, "pid": 0, "spans": [], "dropped": 0,
+                "anchor_us": None, "complete": False}
+        if handle.pid:
+            lane["pid"] = int(handle.pid)
+            lane["label"] = f"{eid} (pid {handle.pid})"
+        elif not lane["pid"]:
+            # a stable synthetic lane pid distinct from the driver's
+            lane["pid"] = 100000 + abs(hash(eid)) % 100000
+        return lane
 
     def _routable_locked(self) -> List[_ExecHandle]:
         return [h for h in self._handles.values()
@@ -568,6 +658,7 @@ class FleetManager:
             sub.state = FAILED
             sub.error = "no live executors in the fleet"
             sub.finished_at = time.time()
+            sub.mark(FAILED, sub.finished_at)
             sub.done.set()
 
     def _expire_locked(self) -> None:
@@ -582,6 +673,7 @@ class FleetManager:
                 sub.state = FAILED
                 sub.error = f"admission timeout after {timeout:g}s"
                 sub.finished_at = now
+                sub.mark(FAILED, now)
                 sub.done.set()
 
     def _dispatch_failed(self, handle: _ExecHandle,
@@ -597,6 +689,7 @@ class FleetManager:
                 # transport trouble: suspicion + an immediate probe —
                 # the health machine (not this dispatch) decides death
                 handle.health.rpc_failed()
+        self._note_circuit(handle)
         if deterministic:
             # the executor answered and refused (bad plan, duplicate):
             # rerouting cannot change the answer — one red row
@@ -604,6 +697,7 @@ class FleetManager:
             sub.error = f"{type(exc).__name__}: {exc}"
             self.admission.release(sub.query_id)
             sub.finished_at = time.time()
+            sub.mark(FAILED, sub.finished_at)
             sub.done.set()
             log.warning("fleet dispatch of %s to %s refused: %s",
                         sub.query_id, handle.endpoint.executor_id,
@@ -637,6 +731,7 @@ class FleetManager:
                 sub.state = CANCELLED
                 sub.error = "fleet shut down during requeue"
                 sub.finished_at = time.time()
+                sub.mark(CANCELLED, sub.finished_at)
                 sub.done.set()
                 return
             if exclude:
@@ -646,10 +741,23 @@ class FleetManager:
             sub.started_at = None
             sub.error = None
             sub.admission_reason = ""
+            sub.admission_blocked_at = None
             sub.executor_id = None
             sub.queued_since = time.time()
+            sub.mark("requeued", sub.queued_since)
             self._queue.append(sub)
         counters.bump("fleet_requeues")
+        events.emit("query.requeue",
+                    f"query {sub.query_id} requeued off "
+                    f"{handle.endpoint.executor_id}",
+                    [sub.query_id],
+                    executor=handle.endpoint.executor_id,
+                    requeues=sub.requeues)
+        if sub.recorder is not None:
+            sub.recorder.add("event.query.requeue", "event",
+                             time.perf_counter_ns(), -1,
+                             {"executor": handle.endpoint.executor_id,
+                              "requeues": sub.requeues})
         self._pump()
 
     # -- the monitor: heartbeats, status absorption, death -----------------
@@ -681,23 +789,40 @@ class FleetManager:
     def _probe(self, handle: _ExecHandle) -> None:
         with self._lock:
             ids = list(handle.inflight)
+        t0_wall = time.time()
         try:
             resp = handle.endpoint.heartbeat(ids)
         except BaseException as e:  # noqa: BLE001 - health-classified
             with self._lock:
                 state = handle.health.probe_failed()
+            self._note_circuit(handle)
             if state == DEAD:
                 self._on_executor_death(handle, reason=str(e))
             return
+        t1_wall = time.time()
         now = time.monotonic()
         with self._lock:
             handle.health.probe_ok()
             handle.load = dict(resp.get("load") or {})
+            if resp.get("pid"):
+                handle.pid = int(resp["pid"])
+            remote_now = resp.get("now")
+            if remote_now is not None:
+                # clock-offset sample at the RTT midpoint; the
+                # minimum-RTT sample wins (least queueing skew in the
+                # midpoint assumption) — trace stitching aligns the
+                # worker's harvested span lanes with it
+                rtt = max(0.0, t1_wall - t0_wall)
+                if rtt <= handle.clock_rtt:
+                    handle.clock_rtt = rtt
+                    handle.clock_off = \
+                        float(remote_now) - (t0_wall + t1_wall) / 2.0
             if handle.inflight:
                 handle.last_active = now
             if handle.load.get("draining"):
                 handle.draining = True
             inflight = dict(handle.inflight)
+        self._harvest_running(handle, inflight)
         queries = resp.get("queries") or {}
         # live admission re-forecast: the heartbeat carries per-query
         # memory peaks, so the front-door ledger learns DURING a run
@@ -717,6 +842,188 @@ class FleetManager:
         for did in ids:
             self._absorb_status(handle, did, queries.get(did))
 
+    # -- the harvest plane: spans + records back from the workers ----------
+
+    def _note_circuit(self, handle: _ExecHandle) -> None:
+        """Flight-recorder visibility for flap circuit-breaking: emit
+        once per circuit the health machine opened."""
+        with self._lock:
+            opens = handle.health.circuit_opens
+            if opens <= handle.last_circuit:
+                return
+            handle.last_circuit = opens
+        events.emit("executor.circuit.break",
+                    f"executor {handle.endpoint.executor_id} circuit-"
+                    f"broken out of routing (flap damping)",
+                    executor=handle.endpoint.executor_id, opens=opens)
+
+    def _harvest_running(self, handle: _ExecHandle,
+                         inflight: Dict[str, str]) -> None:
+        """The harvest RPC riding the heartbeat cadence: drain span
+        increments of traced in-flight queries, so a worker killed
+        mid-query loses only the spans since the last beat.  Harvest
+        loss is tolerated (suspicion, never a hang): the stitched
+        trace is flagged incomplete instead."""
+        if not handle.endpoint.supports_harvest or \
+                not bool(config.conf.get("auron.trace.stitch.enable")):
+            return
+        with self._lock:
+            dids = []
+            for did, qid in inflight.items():
+                sub = self._subs.get(qid)
+                if sub is not None and sub.recorder is not None \
+                        and did not in sub.lane_final:
+                    dids.append(did)
+        if not dids:
+            return
+        try:
+            traces = handle.endpoint.harvest(dids)
+        except BaseException as e:  # noqa: BLE001 - loss-tolerant
+            with self._lock:
+                handle.health.rpc_failed()
+            log.warning("trace harvest from %s failed: %s",
+                        handle.endpoint.executor_id, e)
+            return
+        with self._lock:
+            for did, doc in traces.items():
+                qid = inflight.get(did)
+                sub = self._subs.get(qid) if qid is not None else None
+                if sub is None or did in sub.lane_final:
+                    continue
+                self._absorb_harvest_locked(handle, sub, did, doc)
+
+    def _absorb_harvest_locked(self, handle: _ExecHandle,
+                               sub: FleetSubmission, did: str,
+                               doc: Dict[str, Any]) -> None:
+        lane = self._lane_locked(sub, handle)
+        lane["spans"].extend(doc.get("spans") or [])
+        lane["dropped"] = max(int(lane["dropped"]),
+                              int(doc.get("dropped") or 0))
+        if doc.get("complete"):
+            lane["complete"] = True
+            sub.lane_final.add(did)
+            if doc.get("record") is not None:
+                sub.harvest_record = doc["record"]
+
+    def _harvest_final(self, handle: _ExecHandle,
+                       sub: FleetSubmission) -> None:
+        """One terminal harvest for the finished dispatch: the worker's
+        QueryRecord summary (metric trees — EXPLAIN ANALYZE for fleet
+        queries) plus residual spans.  Runs for every remote dispatch,
+        traced or not; failure marks the lane incomplete."""
+        with self._lock:
+            did = sub.dispatch_id
+            needed = did is not None and did not in sub.lane_final
+        if not needed:
+            return
+        try:
+            traces = handle.endpoint.harvest([did])
+        except BaseException as e:  # noqa: BLE001 - loss-tolerant
+            with self._lock:
+                handle.health.rpc_failed()
+            log.warning("final harvest of %s from %s failed: %s",
+                        sub.query_id, handle.endpoint.executor_id, e)
+            return
+        doc = traces.get(did)
+        if doc is None:
+            return
+        with self._lock:
+            if did not in sub.lane_final:
+                self._absorb_harvest_locked(handle, sub, did, doc)
+
+    def _record_fleet_query(self, handle: _ExecHandle,
+                            sub: FleetSubmission,
+                            status: Dict[str, Any]) -> None:
+        """Driver-side QueryRecord for a fleet-executed query: the
+        worker's harvested metric trees/attribution plus — when traced —
+        ONE stitched Chrome trace with per-process lanes (driver,
+        executors, RSS side-car), clock-aligned and clamped so no span
+        precedes its dispatch.  Lands in the driver's history ring, so
+        `/queries/<id>`, `/queries/diff` and trace download work
+        identically to local execution."""
+        if not handle.endpoint.supports_harvest or sub.recorded:
+            return
+        sub.recorded = True
+        self._harvest_final(handle, sub)
+        hr = sub.harvest_record or {}
+        trace_doc = None
+        incomplete: List[str] = []
+        if sub.recorder is not None and \
+                bool(config.conf.get("auron.trace.stitch.enable")):
+            # terminal lifecycle instant on the driver lane
+            sub.recorder.add(f"query.{sub.state}", "fleet",
+                             time.perf_counter_ns(), -1, None)
+            sidecar_lane = self._sidecar_lane(sub)
+            with self._lock:
+                lanes = []
+                for eid, lane in sub.lanes.items():
+                    h = self._handles.get(eid)
+                    lanes.append({
+                        "label": lane["label"], "pid": lane["pid"],
+                        "spans": lane["spans"],
+                        "dropped": lane["dropped"],
+                        "anchor_us": lane["anchor_us"],
+                        "offset_s": h.clock_off if h is not None
+                        else 0.0})
+                    if not lane["complete"]:
+                        incomplete.append(eid)
+            if sidecar_lane is not None:
+                lanes.append(sidecar_lane)
+            trace_doc = tracing.stitch_traces(
+                sub.recorder.to_chrome_trace(), lanes,
+                incomplete=incomplete)
+        totals = hr.get("metric_totals") or {}
+        rec = tracing.QueryRecord(
+            query_id=sub.query_id,
+            wall_s=float(status.get("wall_s") or hr.get("wall_s")
+                         or sub.wall_s or 0.0),
+            rows=int(status.get("rows") or hr.get("rows") or 0),
+            spmd=bool(hr.get("spmd", False)),
+            attempts=int(hr.get("attempts") or 0),
+            retries=int(hr.get("retries") or 0),
+            fallbacks=int(hr.get("fallbacks") or 0),
+            preemptions=sub.num_preemptions,
+            error=sub.error,
+            started_at=sub.started_at or hr.get("started_at") or 0.0,
+            metric_totals=dict(totals),
+            mem_peak=int(status.get("mem_peak")
+                         or hr.get("mem_peak") or 0),
+            mem_spills=int(hr.get("mem_spills") or 0),
+            mem_spill_bytes=int(hr.get("mem_spill_bytes") or 0),
+            metric_trees=hr.get("metric_trees"),
+            timeline=list(sub.timeline),
+            trace=trace_doc)
+        tracing.record_query(rec)
+
+    def _sidecar_lane(self, sub: FleetSubmission
+                      ) -> Optional[Dict[str, Any]]:
+        """Harvest the side-car's server-side spans for this query tag
+        (before terminal cleanup deletes them)."""
+        sc = self._sidecar
+        if sc is None or sc.dead:
+            return None
+        try:
+            ts = sc.control.trace_spans(sub.query_id)
+        except BaseException as e:  # noqa: BLE001 - loss-tolerant
+            log.warning("side-car span harvest for %s failed: %s",
+                        sub.query_id, e)
+            return None
+        if not ts["spans"]:
+            return None
+        pid = getattr(sc.proc, "pid", None) or 0
+        with self._lock:
+            off = sc.clock_off
+            # anchor on the earliest executor dispatch: the side-car
+            # only sees work that some dispatch caused
+            anchors = [lane["anchor_us"]
+                       for lane in sub.lanes.values()
+                       if lane.get("anchor_us") is not None]
+        return {"label": f"rss-sidecar (pid {pid})" if pid
+                else "rss-sidecar",
+                "pid": pid or 99999, "spans": ts["spans"],
+                "dropped": ts["dropped"], "offset_s": off,
+                "anchor_us": min(anchors) if anchors else None}
+
     # -- the side-car: health, degrade, cleanup ----------------------------
 
     def _probe_sidecar(self) -> None:
@@ -727,16 +1034,25 @@ class FleetManager:
             due = not sc.dead and sc.health.due()
         if not due:
             return
+        t0_wall = time.time()
         try:
-            sc.control.ping()
+            resp = sc.control.ping_info()
         except BaseException as e:  # noqa: BLE001 - health-classified
             with self._lock:
                 state = sc.health.probe_failed()
             if state == DEAD:
                 self._on_sidecar_death(sc, reason=str(e))
             return
+        t1_wall = time.time()
         with self._lock:
             sc.health.probe_ok()
+            remote_now = resp.get("now")
+            if remote_now is not None:
+                rtt = max(0.0, t1_wall - t0_wall)
+                if rtt <= sc.clock_rtt:
+                    sc.clock_rtt = rtt
+                    sc.clock_off = \
+                        float(remote_now) - (t0_wall + t1_wall) / 2.0
 
     def _on_sidecar_death(self, sc: _SidecarState, reason: str) -> None:
         with self._lock:
@@ -744,6 +1060,9 @@ class FleetManager:
                 return
             sc.dead = True
         counters.bump("rss_sidecar_deaths")
+        events.emit("sidecar.death",
+                    f"rss side-car declared dead: {reason}; new "
+                    f"dispatches degrade to executor-local shuffle")
         log.warning(
             "rss side-car declared DEAD (%s): new dispatches degrade "
             "to executor-local shuffle; in-flight queries degrade "
@@ -833,6 +1152,9 @@ class FleetManager:
                     pass
                 return
             counters.bump("fleet_scale_ups")
+            events.emit("fleet.scale.up",
+                        f"spawned {ep.executor_id} (queue depth > "
+                        f"{up_depth})", executor=ep.executor_id)
             log.info("fleet scaled UP: spawned %s (queue depth > %d)",
                      ep.executor_id, up_depth)
             self._pump()
@@ -852,6 +1174,10 @@ class FleetManager:
             victim.retired = True
             victim.dead = True
         counters.bump("fleet_scale_downs")
+        events.emit("fleet.scale.down",
+                    f"retired idle executor "
+                    f"{victim.endpoint.executor_id} (idle > {idle_s:g}s)",
+                    executor=victim.endpoint.executor_id)
         log.info("fleet scaled DOWN: retired idle executor %s "
                  "(idle > %.3gs)", victim.endpoint.executor_id, idle_s)
 
@@ -906,7 +1232,17 @@ class FleetManager:
             sub.mem_peak = mem_peak
             sub.state = SUCCEEDED
             sub.finished_at = time.time()
-            sub.done.set()
+            started = sub.started_at
+            sub.mark(SUCCEEDED, sub.finished_at)
+        if started is not None:
+            counters.observe("query_exec_seconds",
+                             max(0.0, sub.finished_at - started))
+        # stitch + driver-side record BEFORE the terminal side-car
+        # cleanup deletes this query's server spans, and before done
+        # flips (a client polling /queries/<id> right after /result
+        # sees the record)
+        self._record_fleet_query(handle, sub, status)
+        sub.done.set()
         counters.bump("fleet_completions")
         self._rss_cleanup(sub.query_id)
         self._pump()
@@ -921,7 +1257,13 @@ class FleetManager:
             sub.state = state
             sub.error = status.get("error") or state
             sub.finished_at = time.time()
-            sub.done.set()
+            started = sub.started_at
+            sub.mark(state, sub.finished_at)
+        if started is not None:
+            counters.observe("query_exec_seconds",
+                             max(0.0, sub.finished_at - started))
+        self._record_fleet_query(handle, sub, status)
+        sub.done.set()
         if state == CANCELLED:
             counters.bump("queries_cancelled")
         self._rss_cleanup(sub.query_id)
@@ -940,6 +1282,12 @@ class FleetManager:
         log.warning("executor %s declared DEAD (%s); requeueing %d "
                     "in-flight query(ies) on surviving executors",
                     handle.endpoint.executor_id, reason, len(victims))
+        events.emit("worker.death",
+                    f"executor {handle.endpoint.executor_id} declared "
+                    f"dead: {reason}",
+                    [qid for _did, qid in victims],
+                    executor=handle.endpoint.executor_id,
+                    inflight=len(victims))
         # fence: a half-alive incarnation must not keep executing work
         # that is about to run elsewhere
         handle.endpoint.kill()
@@ -947,6 +1295,12 @@ class FleetManager:
             with self._lock:
                 sub = self._subs.get(qid)
             if sub is not None:
+                if sub.recorder is not None:
+                    sub.recorder.add(
+                        "event.worker.death", "event",
+                        time.perf_counter_ns(), -1,
+                        {"executor": handle.endpoint.executor_id,
+                         "reason": str(reason)[:200]})
                 self._requeue(sub, handle)
         self._pump()
 
@@ -1017,6 +1371,7 @@ class FleetManager:
                 sub.state = CANCELLED
                 sub.error = "cancelled while queued"
                 sub.finished_at = time.time()
+                sub.mark(CANCELLED, sub.finished_at)
                 sub.done.set()
                 counters.bump("queries_cancelled")
                 return True
@@ -1104,6 +1459,7 @@ class FleetManager:
                 sub.state = CANCELLED
                 sub.error = "fleet shut down"
                 sub.finished_at = time.time()
+                sub.mark(CANCELLED, sub.finished_at)
                 sub.done.set()
             self._queue.clear()
             handles = list(self._handles.values())

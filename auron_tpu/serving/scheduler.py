@@ -87,10 +87,25 @@ class Submission:
     mem_peak: int = 0
     num_preemptions: int = 0      # kill-and-requeue count
     done: threading.Event = field(default_factory=threading.Event)
+    # lifecycle timeline: ordered state transitions with wall times
+    # (submitted -> queued -> admitted -> dispatched -> running ->
+    # preempted/requeued -> resumed -> terminal), surfaced with
+    # per-state durations on /status/<id> and the QueryRecord
+    timeline: List[Dict[str, Any]] = field(default_factory=list)
+    # wall time of the first blocked admission offer (feeds the
+    # auron_query_admission_wait_seconds histogram); reset on requeue
+    admission_blocked_at: Optional[float] = None
 
     def __post_init__(self) -> None:
         if not self.queued_since:
             self.queued_since = self.submitted_at
+        from auron_tpu.runtime.tracing import timeline_mark
+        timeline_mark(self.timeline, "submitted", self.submitted_at)
+        timeline_mark(self.timeline, "queued", self.queued_since)
+
+    def mark(self, state: str, t: Optional[float] = None) -> None:
+        from auron_tpu.runtime.tracing import timeline_mark
+        timeline_mark(self.timeline, state, t)
 
     def effective_priority(self, aging_s: float,
                            now: Optional[float] = None) -> int:
@@ -104,6 +119,7 @@ class Submission:
         return min(64, self.priority + max(0, int(waited / aging_s)))
 
     def status(self) -> Dict[str, Any]:
+        from auron_tpu.runtime.tracing import timeline_durations
         waited = (self.started_at or self.finished_at or time.time()) \
             - self.submitted_at
         aging = float(config.conf.get("auron.admission.aging.seconds"))
@@ -119,7 +135,36 @@ class Submission:
                 "rows": self.rows, "wall_s": round(self.wall_s, 4),
                 "mem_peak": self.mem_peak,
                 "preemptions": self.num_preemptions,
+                "timeline": list(self.timeline),
+                "state_durations": {
+                    k: round(v, 4) for k, v in
+                    timeline_durations(self.timeline).items()},
                 "error": self.error}
+
+    def mark_started(self) -> None:
+        """Timeline + latency-histogram bookkeeping at the queued ->
+        running transition: queue wait (and the admission-blocked slice
+        of it) land in the /metrics histograms; a submission that was
+        preempted or requeued re-enters as `resumed`."""
+        from auron_tpu.runtime import counters
+        now = self.started_at or time.time()
+        counters.observe("query_queue_wait_seconds",
+                         max(0.0, now - self.queued_since))
+        if self.admission_blocked_at is not None:
+            counters.observe("query_admission_wait_seconds",
+                             max(0.0, now - self.admission_blocked_at))
+            self.admission_blocked_at = None
+        resumed = any(e["state"] in ("preempted", "requeued")
+                      for e in self.timeline)
+        self.mark("admitted", now)
+        if self.dispatched_marker:
+            self.mark("dispatched", now)
+        self.mark("resumed" if resumed else "running", now)
+
+    # fleet submissions insert a `dispatched` state between admission
+    # and running (the RPC hop to a worker process); the in-process
+    # scheduler has no such hop
+    dispatched_marker = False
 
 
 def default_session_factory():
@@ -186,10 +231,14 @@ class QueryScheduler:
                     int(config.conf.get("auron.admission.queue.max")):
                 sub.state = SHED_STATE
                 sub.error = "shed: admission queue full"
+                sub.mark(SHED_STATE)
                 sub.done.set()
                 self._subs[qid] = sub
                 counters.bump("admission_shed")
                 self.admission.events["shed"] += 1
+                from auron_tpu.runtime import events
+                events.emit("query.shed", sub.error, [qid],
+                            queue_len=len(self._queue))
                 exc = SubmissionRejected(sub.error)
                 # Retry-After hint for the 429: how long until the
                 # admission ledger has likely drained one wave
@@ -244,11 +293,14 @@ class QueryScheduler:
                     # head-of-line blocking is deliberate: starting a
                     # smaller later query over the head forever would
                     # starve big queries (FIFO fairness within the gate)
+                    if head.admission_blocked_at is None:
+                        head.admission_blocked_at = now
                     return
                 head.serial = decision.serial
                 self._queue.remove(head)
                 head.state = RUNNING
                 head.started_at = time.time()
+                head.mark_started()
                 self._running += 1
                 to_start = head
             t = threading.Thread(target=self._drive, args=(to_start,),
@@ -268,6 +320,7 @@ class QueryScheduler:
                 sub.state = FAILED
                 sub.error = f"admission timeout after {timeout:g}s"
                 sub.finished_at = now
+                sub.mark(FAILED, now)
                 sub.done.set()
 
     # -- driver thread -----------------------------------------------------
@@ -307,6 +360,7 @@ class QueryScheduler:
                 # plan, bit-identical to a solo run.  Past the per-
                 # query cap the kill is final (forward progress).
                 sub.num_preemptions += 1
+                sub.mark("preempted")
                 cap = int(config.conf.get(
                     "auron.serving.preempt.max.per.query"))
                 if sub.num_preemptions <= cap:
@@ -319,6 +373,10 @@ class QueryScheduler:
                     sub.error = (f"killed after {sub.num_preemptions} "
                                  f"preemptions: {reason}")
                     log.warning("query %s %s", sub.query_id, sub.error)
+                    from auron_tpu.runtime import events
+                    events.emit("query.kill", sub.error,
+                                [sub.query_id],
+                                preemptions=sub.num_preemptions)
             else:
                 sub.state = CANCELLED
                 sub.error = "cancelled"
@@ -333,10 +391,7 @@ class QueryScheduler:
             # a requeued run must start with a clean slate
             self.admission.release(sub.query_id)
             task_pool.clear_cancelled(sub.query_id)
-            rec = tracing.find_query(sub.query_id)
-            if rec is not None:
-                # surface the kill-and-requeue count on the /queries row
-                rec.preemptions = sub.num_preemptions
+            started = sub.started_at
             with self._lock:
                 self._running -= 1
                 if requeue and not self._shutdown:
@@ -344,7 +399,9 @@ class QueryScheduler:
                     sub.started_at = None
                     sub.error = None
                     sub.admission_reason = ""   # fresh admission pass
+                    sub.admission_blocked_at = None
                     sub.queued_since = time.time()
+                    sub.mark("requeued", sub.queued_since)
                     self._queue.append(sub)
                 elif requeue:
                     # shut down between kill and requeue: terminal
@@ -353,9 +410,24 @@ class QueryScheduler:
                     sub.error = "scheduler shut down during requeue"
             if requeue:
                 counters.bump("requeues")
+                from auron_tpu.runtime import events
+                events.emit("query.requeue",
+                            f"preempted query {sub.query_id} requeued",
+                            [sub.query_id],
+                            preemptions=sub.num_preemptions)
             else:
                 sub.finished_at = time.time()
+                sub.mark(sub.state, sub.finished_at)
+                if started is not None:
+                    counters.observe("query_exec_seconds",
+                                     max(0.0, sub.finished_at - started))
                 sub.done.set()
+            rec = tracing.find_query(sub.query_id)
+            if rec is not None:
+                # surface the kill-and-requeue count + the lifecycle
+                # timeline on the /queries row
+                rec.preemptions = sub.num_preemptions
+                rec.timeline = list(sub.timeline)
             self._pump()
 
     # -- watermark preemption ----------------------------------------------
@@ -405,10 +477,13 @@ class QueryScheduler:
             self._last_preempt = now
         # outside the scheduler lock: preempt_query takes the pool's
         # cancellation lock and kicks the workers
-        task_pool.preempt_query(
-            victim.query_id,
-            f"memory pressure: pool {total_used}B over watermark of "
-            f"effective budget {effective_budget}B")
+        reason = (f"memory pressure: pool {total_used}B over watermark "
+                  f"of effective budget {effective_budget}B")
+        from auron_tpu.runtime import events
+        events.emit("query.preempt", reason, [victim.query_id],
+                    pool_used=total_used,
+                    effective_budget=effective_budget)
+        task_pool.preempt_query(victim.query_id, reason)
 
     # -- client surface ----------------------------------------------------
 
@@ -468,6 +543,7 @@ class QueryScheduler:
                 sub.state = CANCELLED
                 sub.error = "cancelled while queued"
                 sub.finished_at = time.time()
+                sub.mark(CANCELLED, sub.finished_at)
                 sub.done.set()
                 counters.bump("queries_cancelled")
                 return True
@@ -502,6 +578,7 @@ class QueryScheduler:
                 sub.state = CANCELLED
                 sub.error = "scheduler shut down"
                 sub.finished_at = time.time()
+                sub.mark(CANCELLED, sub.finished_at)
                 sub.done.set()
             self._queue.clear()
             running = [s for s in self._subs.values()

@@ -29,6 +29,7 @@ rides the ONE retry policy behind the named `rss.*` fault points above.
 
 from __future__ import annotations
 
+import json
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -45,6 +46,7 @@ _FAULT_POINTS.update({
     "manifest": "rss.manifest",
     "stats": "rss.manifest",
     "delete_prefix": "rss.manifest",
+    "tspans": "rss.manifest",
     "ping": "rss.ping",
 })
 
@@ -118,7 +120,13 @@ def _guarded_request(conn: _Conn, header: Dict[str, Any],
                      payload: bytes = b""):
     """One RPC with the transport failure surface narrowed to
     RssUnavailable: operator/scan errors keep their own types (the
-    session's degrade path must only ever catch side-car trouble)."""
+    session's degrade path must only ever catch side-car trouble).
+    With an armed trace recorder the request carries the trace flag, so
+    the server records its own handling span for the stitched query
+    trace (one contextvar read, mirroring the span-site contract)."""
+    from auron_tpu.runtime import tracing
+    if tracing.current_recorder() is not None:
+        header.setdefault("trace", 1)
     try:
         return conn.request(header, payload)
     except FetchFailedError:
@@ -218,6 +226,25 @@ class DurableShuffleClient:
         return {"shuffles": resp.get("shuffles") or {},
                 "totals": resp.get("totals") or {}}
 
+    def trace_spans(self, tag: str, clear: bool = True
+                    ) -> Dict[str, Any]:
+        """Harvest the side-car's server-side spans for one query tag
+        ({"spans": [...absolute wall-µs dicts...], "dropped": n,
+        "now": server wall clock}); cleared by default — the driver
+        stitches them into the query's trace at terminal states."""
+        resp, body = _guarded_request(self.conn,
+                                      {"cmd": "tspans", "prefix": tag,
+                                       "clear": bool(clear)})
+        return {"spans": json.loads(body) if body else [],
+                "dropped": int(resp.get("dropped") or 0),
+                "now": resp.get("now")}
+
     def ping(self) -> bool:
         resp, _ = _guarded_request(self.conn, {"cmd": "ping"})
         return bool(resp.get("ok"))
+
+    def ping_info(self) -> Dict[str, Any]:
+        """Ping plus the server's wall clock (`now`) — the RTT-midpoint
+        clock-offset sample the fleet's trace stitching uses."""
+        resp, _ = _guarded_request(self.conn, {"cmd": "ping"})
+        return resp

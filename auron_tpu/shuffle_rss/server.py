@@ -34,6 +34,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -127,6 +128,13 @@ class _State:
         self.manifest: Dict[str, Dict[int, Dict[str, Any]]] = {}
         self.sealed: Dict[str, int] = {}
         self.totals: Dict[str, Dict[str, int]] = {}
+        # server-side trace spans per query TAG (the prefix before the
+        # first '|' of a durable sid): recorded only for requests whose
+        # header carries the trace flag, harvested-and-cleared by the
+        # driver's TSPANS at terminal states so a stitched query trace
+        # shows the side-car's own rss.server.* handling lane.  Bounded
+        # per tag and in tag count; overflow counts as dropped.
+        self.tspans: Dict[str, Dict[str, Any]] = {}
         self.spill_dir = spill_dir
         self.spill_threshold = spill_threshold
         # spill files die with the state: explicitly at server stop, by
@@ -138,6 +146,37 @@ class _State:
 
     def cleanup_spills(self) -> None:
         self._spill_finalizer()
+
+    TSPAN_TAGS_MAX = 64
+    TSPANS_PER_TAG_MAX = 4096
+
+    def add_tspan(self, tag: str, span: Dict[str, Any]) -> None:
+        ent = self.tspans.get(tag)
+        if ent is None:
+            if len(self.tspans) >= self.TSPAN_TAGS_MAX:
+                self.tspans.pop(next(iter(self.tspans)))
+            ent = self.tspans[tag] = {"spans": [], "dropped": 0}
+        if len(ent["spans"]) >= self.TSPANS_PER_TAG_MAX:
+            ent["dropped"] += 1
+            return
+        ent["spans"].append(span)
+
+    def pop_tspans(self, prefix: str,
+                   clear: bool = True) -> Tuple[List[Dict[str, Any]], int]:
+        """Spans of every tag matching `prefix` (a tag itself, or a
+        `tag|`-style cleanup prefix), cleared by default."""
+        spans: List[Dict[str, Any]] = []
+        dropped = 0
+        for tag in [t for t in self.tspans
+                    if t.startswith(prefix)
+                    or (t + "|").startswith(prefix)]:
+            ent = self.tspans[tag]
+            spans.extend(ent["spans"])
+            dropped += ent["dropped"]
+            if clear:
+                del self.tspans[tag]
+        spans.sort(key=lambda s: s.get("ts_us", 0))
+        return spans, dropped
 
     def _bump_total(self, sid: str, key: str, n: int = 1) -> None:
         ent = self.totals.get(sid)
@@ -309,8 +348,20 @@ class _Handler(socketserver.BaseRequestHandler):
             # (push dedup by push_id keeps retries exactly-once)
             fault_point("shuffle.server")
             cmd = header["cmd"]
+            # server-side span recording for the durable commit
+            # protocol: armed per REQUEST by the client's trace flag
+            # (zero cost otherwise), keyed by the sid's query tag,
+            # absolute wall-µs timestamps (the driver aligns them with
+            # its ping-RTT clock offset when stitching)
+            tkey = None
+            if header.get("trace") and cmd in (
+                    "mpush", "mcommit", "mseal", "manifest", "mfetch"):
+                sid = str(header.get("shuffle") or "")
+                tkey = sid.split("|", 1)[0] if "|" in sid else sid
+                t0_wall = time.time()
+                t0_perf = time.perf_counter_ns()
             if cmd == "ping":
-                send_msg(self.request, {"ok": True})
+                send_msg(self.request, {"ok": True, "now": time.time()})
             elif cmd == "push":
                 key = (header["shuffle"], int(header["partition"]))
                 push_id = header.get("push_id")
@@ -399,10 +450,32 @@ class _Handler(socketserver.BaseRequestHandler):
                         state.delete_shuffles(
                             [s for s in state.all_sids()
                              if s.startswith(prefix)])
+                        state.pop_tspans(prefix)
                 send_msg(self.request, {"ok": True})
+            elif cmd == "tspans":
+                with state.lock:
+                    spans, dropped = state.pop_tspans(
+                        header.get("prefix") or "",
+                        clear=bool(header.get("clear", True)))
+                # spans in the payload: a busy tag's span JSON can
+                # exceed the header cap
+                body = json.dumps(spans).encode()
+                send_msg(self.request, {"ok": True, "len": len(body),
+                                        "dropped": dropped,
+                                        "now": time.time()}, body)
             else:
                 send_msg(self.request,
                          {"ok": False, "error": f"bad cmd {cmd}"})
+            if tkey is not None:
+                dur_us = (time.perf_counter_ns() - t0_perf) / 1e3
+                t = threading.current_thread()
+                with state.lock:
+                    state.add_tspan(tkey, {
+                        "name": f"rss.server.{cmd}", "cat": "rss",
+                        "ts_us": t0_wall * 1e6, "dur_us": dur_us,
+                        "tid": t.ident or 0, "thread": t.name,
+                        "args": {"shuffle": header.get("shuffle"),
+                                 "partition": header.get("partition")}})
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):

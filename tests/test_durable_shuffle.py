@@ -49,7 +49,7 @@ from auron_tpu.frontend.session import AuronSession
 from auron_tpu.ir.schema import DataType, Field, Schema
 from auron_tpu.memmgr import manager as mem_manager
 from auron_tpu.memmgr.manager import reset_manager
-from auron_tpu.runtime import counters, retry, task_pool
+from auron_tpu.runtime import counters, events, retry, task_pool, tracing
 from auron_tpu.shuffle_rss import (
     CelebornShuffleClient, DurableShuffleClient, ShuffleServer,
     UniffleShuffleClient,
@@ -700,6 +700,12 @@ def test_rss_kill9_resume_acceptance_stress(catalog, tmp_path):
         "auron.fleet.death.probes": 3,
         "auron.admission.default.forecast.bytes": 1 << 20,
         "auron.serving.max.concurrent": 4,
+        # TRACING ON for the whole stress (the PR 13 acceptance): the
+        # driver arms a recorder per submission, propagates trace
+        # context in every dispatch overlay, harvests worker spans
+        # over heartbeats and side-car spans at terminal states, and
+        # stitches ONE chrome trace per query
+        "auron.trace.enable": True,
     }
     t_retried0 = counters.get("tasks_retried")
     requeues0 = counters.get("fleet_requeues")
@@ -814,6 +820,57 @@ def test_rss_kill9_resume_acceptance_stress(catalog, tmp_path):
             assert worker_totals.get("tasks_retried", 0) == 0
             assert counters.get("requeues") - pr_requeues0 == 0
             assert fleet.stats()["preemptions"] == 0
+
+            # ---- PR 13 acceptance: the stitched distributed trace --
+            # ONE validated chrome trace for the resumed query with
+            # per-process lanes — driver, BOTH executor processes
+            # (the victim's spans were drained over heartbeats before
+            # the kill), and the RSS side-car — the kill -9 -> requeue
+            # -> durable RESUME readable as ordered events on one
+            # timeline
+            rec = tracing.find_query(resumed_qid)
+            assert rec is not None and rec.trace is not None, \
+                "no stitched driver-side record for the resumed query"
+            assert tracing.validate_chrome_trace(rec.trace) == []
+            other = rec.trace["otherData"]
+            assert other["stitched"] is True
+            ev_spans = [e for e in rec.trace["traceEvents"]
+                        if e.get("ph") in ("X", "i")]
+            pids = {e["pid"] for e in ev_spans}
+            driver_pid = os.getpid()
+            sidecar_pid = fleet._sidecar.proc.pid
+            exec_pids = pids - {driver_pid, sidecar_pid}
+            assert driver_pid in pids, pids
+            assert sidecar_pid in pids, \
+                f"no side-car lane: {pids} vs sidecar {sidecar_pid}"
+            assert len(exec_pids) >= 2, \
+                f"expected both executor processes in the trace: {pids}"
+            names = {e["name"] for e in ev_spans}
+            assert "fleet.dispatch" in names
+            assert any(n.startswith("rss.server.") for n in names)
+            # ordering: the requeue precedes the survivor's resume
+            req_ts = min(e["ts"] for e in ev_spans
+                         if e["name"] == "event.query.requeue")
+            res_ts = [e["ts"] for e in ev_spans
+                      if e["name"] == "rss.resume"]
+            assert res_ts, "no rss.resume instant in the stitched trace"
+            assert min(res_ts) >= req_ts, (min(res_ts), req_ts)
+            # the kill -9'd victim could not answer its final harvest:
+            # flagged incomplete, never silently partial
+            assert victim in other["incomplete"], other
+            # distributed EXPLAIN ANALYZE: the survivor's metric trees
+            # landed on the driver record
+            assert rec.metric_trees, "no harvested metric trees"
+            # flight recorder: the death names the affected queries
+            deaths = events.snapshot(kind="worker.death")
+            assert deaths, "no worker.death flight-recorder event"
+            assert deaths[-1]["attrs"]["executor"] == victim
+            assert set(victim_qids) <= set(deaths[-1]["query_ids"])
+            # every query got a driver-side record with a full timeline
+            for q in qids:
+                qrec = tracing.find_query(q)
+                assert qrec is not None and qrec.timeline
+                assert qrec.timeline[-1]["state"] == "succeeded"
 
             assert fleet.admission.held_bytes() == 0
             assert not any(label.startswith("admission:")

@@ -1,0 +1,421 @@
+"""Fleet observability (PR 13): cross-process trace stitching,
+harvest-RPC loss tolerance, distributed EXPLAIN ANALYZE records,
+lifecycle timelines and the flight recorder.
+
+- stitch_traces units: skewed fake clocks still yield a monotone
+  merged trace whose lanes never precede their wire-parent anchor;
+  incomplete/truncation flags propagate.
+- TraceRecorder.drain()/drain_since(): the incremental-export cursor
+  contract (return-and-clear, cursor-acknowledged frees, re-served
+  unacked spans).
+- A traced fleet query against a real executor server produces ONE
+  stitched driver-side Chrome trace + QueryRecord with the worker's
+  harvested metric trees and the full lifecycle timeline.
+- Harvest loss (dead/broken worker): the query still completes, the
+  stitched trace is flagged `incomplete` — never a hang.
+- Cross-process conservation: worker-reported retries on the driver's
+  /queries record equal the task-retry counter delta.
+- Executor death writes `worker.death`/`query.requeue` flight-recorder
+  events naming the affected query ids.
+"""
+
+import threading
+import time
+
+import pytest
+
+from auron_tpu import faults
+from auron_tpu.config import conf
+from auron_tpu.frontend.foreign import ForeignNode
+from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.memmgr import manager as mem_manager
+from auron_tpu.memmgr.manager import reset_manager
+from auron_tpu.runtime import counters, events, task_pool, tracing
+from auron_tpu.serving import FleetManager, ProcessExecutor
+from auron_tpu.serving.executor_endpoint import ExecutorServer
+from auron_tpu.serving.scheduler import default_session_factory
+
+FAST_FLEET_CONF = {
+    "auron.fleet.heartbeat.seconds": 0.1,
+    "auron.retry.backoff.base.ms": 1.0,
+    "auron.retry.backoff.max.ms": 5.0,
+    "auron.net.timeout.seconds": 5.0,
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    yield
+    faults.reset()
+    mem_manager.reset_hooks()
+    reset_manager()
+    task_pool.reset_pool()
+
+
+def _scan_plan(tag="t", rows=3):
+    schema = Schema((Field("x", DataType.int64()),))
+    return ForeignNode("LocalTableScanExec", output=schema,
+                       attrs={"rows": [{"x": i} for i in range(rows)],
+                              "tag": tag})
+
+
+def _start_server(executor_id="e1", session_factory=None):
+    srv = ExecutorServer(
+        session_factory=session_factory or default_session_factory,
+        executor_id=executor_id).start()
+    return srv, ProcessExecutor(executor_id, *srv.address)
+
+
+# ---------------------------------------------------------------------------
+# stitching units (fake clocks, no processes)
+# ---------------------------------------------------------------------------
+
+def _lane(pid, wall_base, offset_s, anchor_us, names,
+          step_us=10.0, dur_us=5.0):
+    spans = []
+    for i, name in enumerate(names):
+        spans.append({"name": name, "cat": "c",
+                      "ts_us": wall_base * 1e6 + i * step_us,
+                      "dur_us": dur_us, "tid": 7, "thread": "w"})
+    return {"label": f"lane-{pid}", "pid": pid, "spans": spans,
+            "dropped": 0, "offset_s": offset_s, "anchor_us": anchor_us}
+
+
+def test_stitch_skewed_clocks_monotone_and_anchored():
+    """A worker clock running 100s AHEAD and a side-car clock 50s
+    BEHIND both land on the driver timeline: offsets undo the skew,
+    and each lane is clamped so no span precedes its dispatch
+    anchor."""
+    base = tracing.TraceRecorder("q1")
+    t0 = time.perf_counter_ns()
+    base.add("fleet.dispatch", "fleet", t0, 2000, {"executor": "e0"})
+    doc = base.to_chrome_trace()
+    wall = base.wall_start
+    fast = _lane(101, wall + 100.0, 100.0, 500.0, ["a", "b", "c"])
+    slow = _lane(102, wall - 50.0, -50.0, 800.0, ["d", "e"])
+    st = tracing.stitch_traces(doc, [fast, slow])
+    assert tracing.validate_chrome_trace(st) == []
+    by_pid = {}
+    for ev in st["traceEvents"]:
+        if ev.get("ph") in ("X", "i"):
+            by_pid.setdefault(ev["pid"], []).append(ev["ts"])
+    # lane-internal order preserved, monotone, and >= the anchor
+    for pid, anchor in ((101, 500.0), (102, 800.0)):
+        ts = by_pid[pid]
+        assert ts == sorted(ts)
+        assert all(t >= anchor for t in ts), (pid, ts)
+    # offsets actually cancelled the skew: with perfect offsets the
+    # two lanes land within the same few ms as the driver span, not
+    # 100s/50s away
+    drv_ts = by_pid[list(by_pid)[0]]
+    assert max(max(v) for v in by_pid.values()) < 1e6, by_pid
+    assert st["otherData"]["stitched"] is True
+    assert st["otherData"]["incomplete"] == []
+    assert drv_ts  # driver lane survived
+
+
+def test_stitch_incomplete_and_truncation_flags():
+    base = tracing.TraceRecorder("q2")
+    doc = base.to_chrome_trace()
+    lane = _lane(103, base.wall_start, 0.0, None, ["a"])
+    lane["dropped"] = 4
+    st = tracing.stitch_traces(doc, [lane], incomplete=["exec-9"])
+    assert st["otherData"]["incomplete"] == ["exec-9"]
+    assert st["otherData"]["dropped_events"] == 4
+    assert st["otherData"]["trace_truncated"] is True
+    assert tracing.validate_chrome_trace(st) == []
+
+
+def test_stitch_negative_shift_clamps_to_zero():
+    """A lane with no anchor whose shifted times would go negative is
+    clamped to ts >= 0 (validate requires non-negative ts)."""
+    base = tracing.TraceRecorder("q3")
+    doc = base.to_chrome_trace()
+    lane = _lane(104, base.wall_start - 5.0, 0.0, None, ["a", "b"])
+    st = tracing.stitch_traces(doc, [lane])
+    assert tracing.validate_chrome_trace(st) == []
+    ts = [ev["ts"] for ev in st["traceEvents"]
+          if ev.get("ph") in ("X", "i")]
+    assert min(ts) >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# incremental drain (the PR 4 streaming-trace follow-up)
+# ---------------------------------------------------------------------------
+
+def test_recorder_drain_returns_and_clears():
+    rec = tracing.TraceRecorder("qd", max_events=100)
+    for i in range(4):
+        rec.add(f"s{i}", "c", 1000 + i, 10, None)
+    spans, nxt = rec.drain()
+    assert [s.name for s in spans] == ["s0", "s1", "s2", "s3"]
+    assert nxt == 4
+    assert rec.snapshot() == []
+    rec.add("s4", "c", 2000, 10, None)
+    spans, nxt = rec.drain()
+    assert [s.name for s in spans] == ["s4"] and nxt == 5
+
+
+def test_recorder_drain_since_cursor_ack():
+    """drain_since frees only ACKNOWLEDGED spans: a repeated poll with
+    the same cursor re-serves the unacked tail (lost-response
+    tolerance), an advanced cursor frees it."""
+    rec = tracing.TraceRecorder("qc", max_events=100)
+    for i in range(3):
+        rec.add(f"s{i}", "c", 1000 + i, 10, None)
+    spans, first, nxt = rec.drain_since(0)
+    assert len(spans) == 3 and first == 0 and nxt == 3
+    # same cursor again: nothing freed, same spans re-served
+    spans2, first2, _ = rec.drain_since(0)
+    assert [s.name for s in spans2] == [s.name for s in spans]
+    assert first2 == 0
+    # acked: freed, new spans continue the sequence
+    rec.add("s3", "c", 2000, 10, None)
+    spans3, first3, nxt3 = rec.drain_since(3)
+    assert [s.name for s in spans3] == ["s3"]
+    assert first3 == 3 and nxt3 == 4
+    # capacity is reclaimed by draining (long-running queries)
+    assert len(rec.snapshot()) == 1
+
+
+def test_drop_cap_counts_warns_once_and_flags_export(caplog):
+    """Satellite bugfix: the event cap no longer drops silently — the
+    per-recorder count, the process counter, the exported
+    trace_truncated flag and ONE warning per query all fire."""
+    import logging
+    c0 = counters.get("trace_dropped_events")
+    rec = tracing.TraceRecorder("qcap", max_events=2)
+    with caplog.at_level(logging.WARNING, logger="auron_tpu.tracing"):
+        for i in range(5):
+            rec.add(f"s{i}", "c", 1000 + i, 10, None)
+    assert rec.dropped == 3
+    assert counters.get("trace_dropped_events") - c0 == 3
+    warns = [r for r in caplog.records
+             if "auron.trace.max.events" in r.getMessage()]
+    assert len(warns) == 1
+    doc = rec.to_chrome_trace()
+    assert doc["otherData"]["trace_truncated"] is True
+    assert doc["otherData"]["dropped_events"] == 3
+    # draining reopens capacity and further spans record again
+    rec.drain()
+    rec.add("late", "c", 9000, 10, None)
+    assert [s.name for s in rec.snapshot()] == ["late"]
+
+
+# ---------------------------------------------------------------------------
+# fleet end-to-end: stitched record, timelines, conservation, loss
+# ---------------------------------------------------------------------------
+
+def test_traced_fleet_query_stitched_record_and_metric_trees():
+    """A traced fleet query against a real executor server yields a
+    driver-side QueryRecord whose trace is ONE validated stitched doc
+    (driver + worker lanes), whose metric trees are the worker's
+    harvested per-operator merge (serial path => non-empty, same
+    structure as local execution), and whose timeline walks
+    submitted -> queued -> admitted -> dispatched -> running ->
+    succeeded."""
+    srv, ep = _start_server("e1")
+    fleet = None
+    try:
+        with conf.scoped({**FAST_FLEET_CONF,
+                          "auron.trace.enable": True}):
+            fleet = FleetManager(endpoints=[ep])
+            qid = fleet.submit(
+                _scan_plan("traced"),
+                conf={"auron.spmd.singleDevice.enable": False})
+            assert fleet.wait(qid, timeout=60), fleet.status(qid)
+            st = fleet.status(qid)
+            assert st["state"] == "succeeded", st
+            assert [e["state"] for e in st["timeline"]] == [
+                "submitted", "queued", "admitted", "dispatched",
+                "running", "succeeded"]
+            assert set(st["state_durations"]) == set(
+                e["state"] for e in st["timeline"])
+            rec = tracing.find_query(qid)
+            assert rec is not None, "no driver-side QueryRecord"
+            assert rec.trace is not None
+            assert rec.trace["otherData"]["stitched"] is True
+            assert rec.trace["otherData"]["incomplete"] == []
+            assert tracing.validate_chrome_trace(rec.trace) == []
+            names = {e["name"] for e in rec.trace["traceEvents"]}
+            # driver lane + worker lane span families both present
+            assert "fleet.dispatch" in names
+            assert "plan.convert" in names and "query" in names
+            # distributed EXPLAIN ANALYZE: worker metric trees arrived
+            assert rec.metric_trees, "no harvested metric trees"
+            from auron_tpu.runtime.explain_analyze import (
+                render_analyzed_dicts,
+            )
+            text = render_analyzed_dicts(rec.metric_trees)
+            assert "output_rows" in text
+            assert rec.timeline[-1]["state"] == "succeeded"
+    finally:
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv.stop()
+
+
+def test_untraced_fleet_query_still_records_metric_trees():
+    """Distributed EXPLAIN ANALYZE does not require tracing: the
+    terminal harvest ships the worker's QueryRecord summary either
+    way, so /queries/<id> works for fleet queries with tracing off."""
+    srv, ep = _start_server("e1")
+    fleet = None
+    try:
+        with conf.scoped(FAST_FLEET_CONF):
+            fleet = FleetManager(endpoints=[ep])
+            qid = fleet.submit(
+                _scan_plan("plain"),
+                conf={"auron.spmd.singleDevice.enable": False})
+            assert fleet.wait(qid, timeout=60), fleet.status(qid)
+            assert fleet.status(qid)["state"] == "succeeded"
+            rec = tracing.find_query(qid)
+            assert rec is not None
+            assert rec.trace is None          # tracing was off
+            assert rec.metric_trees           # trees still harvested
+            assert rec.rows == 3
+    finally:
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv.stop()
+
+
+def test_cross_process_retry_conservation():
+    """The conservation gate extended across the dispatch boundary:
+    the retries on the driver's harvested /queries record equal the
+    worker's task-retry counter delta (here exactly two injected
+    op.execute failures with a 2-retry budget)."""
+    srv, ep = _start_server("e1")
+    fleet = None
+    spec = "op.execute:io:p=1,max=2,seed=3"
+    retried0 = counters.get("tasks_retried")
+    try:
+        with conf.scoped(FAST_FLEET_CONF):
+            fleet = FleetManager(endpoints=[ep])
+            qid = fleet.submit(
+                _scan_plan("conserve"),
+                conf={"auron.spmd.singleDevice.enable": False,
+                      "auron.faults.spec": spec,
+                      "auron.task.retries": 2,
+                      "auron.retry.backoff.base.ms": 1.0,
+                      "auron.retry.backoff.max.ms": 5.0})
+            assert fleet.wait(qid, timeout=60), fleet.status(qid)
+            assert fleet.status(qid)["state"] == "succeeded"
+            rec = tracing.find_query(qid)
+            assert rec is not None
+            retried = counters.get("tasks_retried") - retried0
+            assert retried >= 1
+            assert rec.retries == retried, (rec.retries, retried)
+    finally:
+        faults.reset(spec)
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv.stop()
+
+
+class _HarvestlessExecutor(ProcessExecutor):
+    """A remote executor whose harvest RPC always dies — the
+    loss-tolerance surface (a worker that crashes between completion
+    and harvest looks exactly like this)."""
+
+    def harvest(self, ids):
+        raise ConnectionError("harvest wire down")
+
+
+def test_harvest_loss_marks_trace_incomplete_never_hangs():
+    srv = ExecutorServer(session_factory=default_session_factory,
+                         executor_id="e1").start()
+    ep = _HarvestlessExecutor("e1", *srv.address)
+    fleet = None
+    try:
+        with conf.scoped({**FAST_FLEET_CONF,
+                          "auron.trace.enable": True}):
+            fleet = FleetManager(endpoints=[ep])
+            qid = fleet.submit(
+                _scan_plan("lossy"),
+                conf={"auron.spmd.singleDevice.enable": False})
+            t0 = time.monotonic()
+            assert fleet.wait(qid, timeout=60), fleet.status(qid)
+            assert time.monotonic() - t0 < 30, "harvest loss hung"
+            assert fleet.status(qid)["state"] == "succeeded"
+            rec = tracing.find_query(qid)
+            assert rec is not None and rec.trace is not None
+            # the worker's lane never arrived: flagged, not silent
+            assert "e1" in rec.trace["otherData"]["incomplete"]
+            assert tracing.validate_chrome_trace(rec.trace) == []
+            # no worker record harvested => driver record falls back
+            # to the status fields
+            assert rec.rows == 3
+    finally:
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv.stop()
+
+
+def test_death_emits_flight_recorder_events():
+    """An executor death lands in the flight recorder as
+    `worker.death` naming the affected query ids, followed by
+    `query.requeue` — the /events postmortem trail."""
+    from test_fleet import _BlockingFactory
+    blocky = _BlockingFactory()
+    srv1, ep1 = _start_server("e1", session_factory=blocky)
+    srv2, ep2 = _start_server("e2", session_factory=blocky)
+    fleet = None
+    seq0 = (events.snapshot()[-1]["seq"]
+            if events.snapshot() else 0)
+    try:
+        with conf.scoped({**FAST_FLEET_CONF,
+                          "auron.fleet.heartbeat.seconds": 0.15,
+                          "auron.fleet.death.probes": 2,
+                          "auron.net.timeout.seconds": 2.0}):
+            fleet = FleetManager(endpoints=[ep1, ep2])
+            qids = [fleet.submit(_scan_plan(f"t{i}")) for i in range(4)]
+            assert blocky.started.wait(30)
+            deadline = time.time() + 10
+            on_e1 = []
+            while time.time() < deadline:
+                on_e1 = [q for q in qids
+                         if fleet.get(q).executor_id == "e1"
+                         and not fleet.get(q).done.is_set()]
+                if on_e1:
+                    break
+                time.sleep(0.02)
+            assert on_e1, "nothing routed to e1"
+            srv1.stop()
+            blocky.release.set()
+            for q in qids:
+                assert fleet.wait(q, timeout=30), fleet.status(q)
+            deaths = events.snapshot(since=seq0, kind="worker.death")
+            assert deaths, "no worker.death event"
+            ev = deaths[-1]
+            assert ev["attrs"]["executor"] == "e1"
+            assert set(on_e1) <= set(ev["query_ids"]), (on_e1, ev)
+            requeues = events.snapshot(since=seq0, kind="query.requeue")
+            assert {q for e in requeues for q in e["query_ids"]} >= \
+                set(on_e1)
+            # ordering: the death precedes its requeues
+            assert deaths[0]["seq"] < requeues[-1]["seq"]
+    finally:
+        blocky.release.set()
+        if fleet is not None:
+            fleet.shutdown(wait=True)
+        srv2.stop()
+
+
+@pytest.mark.slow
+def test_tools_obs_check_script():
+    """tools/obs_check.sh is the CI fleet-observability gate; keep it
+    green from pytest (mirrors rss_check wiring)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "obs_check.sh")
+    if not os.path.exists(script) or shutil.which("bash") is None:
+        pytest.skip("obs script or bash unavailable")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    out = subprocess.run(["bash", script], capture_output=True,
+                         text=True, timeout=540, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -251,6 +251,122 @@ def test_queries_diff_shape_mismatch(server):
     assert b"plan shape" in body
 
 
+def test_query_detail_page_timeline_and_trees(server):
+    """/queries/<id>: the lifecycle timeline with per-state durations
+    plus the merged per-operator metric trees rendered EXPLAIN-ANALYZE
+    style — identical for local and fleet-harvested records."""
+    rec = _record_with_trees("qdetail", 25, spills=1)
+    rec.timeline = [{"state": "submitted", "t": 10.0},
+                    {"state": "queued", "t": 10.0},
+                    {"state": "admitted", "t": 10.5},
+                    {"state": "running", "t": 10.5},
+                    {"state": "succeeded", "t": 12.5}]
+    code, body, _ = _get(server.url + "/queries/qdetail?format=json")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["state_durations"]["queued"] == 0.5
+    assert doc["state_durations"]["running"] == 2.0
+    assert [e["state"] for e in doc["timeline"]][-1] == "succeeded"
+    assert doc["metric_trees"][0]["tree"]["name"] == "SortExec"
+    assert "SortExec" in doc["analyzed"]
+    code, body, _ = _get(server.url + "/queries/qdetail")
+    page = body.decode()
+    assert code == 200 and "Lifecycle" in page and "SortExec" in page
+    code, _, _ = _get(server.url + "/queries/no-such-query")
+    assert code == 404
+
+
+def test_events_endpoint_filters_and_cursor(server):
+    from auron_tpu.runtime import events
+    e1 = events.emit("worker.death", "exec-9 died", ["qev1"],
+                     executor="exec-9")
+    events.emit("query.requeue", "qev1 requeued", ["qev1"],
+                executor="exec-9")
+    events.emit("fleet.scale.up", "spawned exec-s0")
+    code, body, _ = _get(server.url + "/events")
+    assert code == 200
+    doc = json.loads(body)
+    kinds = [e["kind"] for e in doc["events"]]
+    assert {"worker.death", "query.requeue",
+            "fleet.scale.up"} <= set(kinds)
+    assert doc["next_since"] == doc["events"][-1]["seq"]
+    # kind + affected-query filters
+    code, body, _ = _get(server.url + "/events?kind=worker.death")
+    evs = json.loads(body)["events"]
+    assert evs and all(e["kind"] == "worker.death" for e in evs)
+    assert "qev1" in evs[-1]["query_ids"]
+    code, body, _ = _get(server.url + "/events?query=qev1")
+    evs = json.loads(body)["events"]
+    assert {e["kind"] for e in evs} == {"worker.death",
+                                        "query.requeue"}
+    # cursor: nothing before e1 is re-served
+    code, body, _ = _get(server.url + f"/events?since={e1['seq']}")
+    evs = json.loads(body)["events"]
+    assert all(e["seq"] > e1["seq"] for e in evs)
+
+
+def test_running_query_trace_incremental_drain(server):
+    """GET /queries/<id>/trace?since= on a RUNNING query drains span
+    increments with an acknowledgement cursor (the streaming-trace
+    follow-up); the finished query falls back to the history doc."""
+    import time as _time
+    rec = tracing.TraceRecorder("qstream", max_events=50)
+    tracing._register_active("qstream", rec)
+    try:
+        rec.add("s0", "c", _time.perf_counter_ns(), 10, None)
+        rec.add("s1", "c", _time.perf_counter_ns(), 10, None)
+        code, body, _ = _get(server.url +
+                             "/queries/qstream/trace?since=0")
+        assert code == 200
+        doc = json.loads(body)
+        assert tracing.validate_chrome_trace(doc) == []
+        other = doc["otherData"]
+        assert other["partial"] is True and other["next_since"] == 2
+        names = [e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "X"]
+        assert names == ["s0", "s1"]
+        # acked cursor frees the buffer; new spans continue
+        rec.add("s2", "c", _time.perf_counter_ns(), 10, None)
+        code, body, _ = _get(server.url +
+                             "/queries/qstream/trace?since=2")
+        doc = json.loads(body)
+        assert [e["name"] for e in doc["traceEvents"]
+                if e.get("ph") == "X"] == ["s2"]
+        assert doc["otherData"]["next_since"] == 3
+    finally:
+        tracing._unregister_active("qstream", rec)
+    # no active recorder + not in history => 404 even with since
+    code, _, _ = _get(server.url + "/queries/qstream/trace?since=0")
+    assert code == 404
+
+
+def test_metrics_latency_histograms(server):
+    from auron_tpu.runtime import counters
+    counters.observe("query_wall_seconds", 0.07)
+    counters.observe("query_queue_wait_seconds", 0.3)
+    code, body, _ = _get(server.url + "/metrics")
+    assert code == 200
+    text = body.decode()
+    for needle in ("auron_query_wall_seconds_bucket{le=",
+                   "auron_query_wall_seconds_sum",
+                   "auron_query_wall_seconds_count",
+                   "auron_query_queue_wait_seconds_bucket",
+                   "auron_query_admission_wait_seconds_count",
+                   "auron_query_exec_seconds_count",
+                   'auron_query_wall_seconds_bucket{le="+Inf"}',
+                   "auron_trace_dropped_events_total"):
+        assert needle in text, f"missing {needle!r}"
+    # buckets are cumulative and end at the total count
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("auron_query_wall_seconds_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert counts == sorted(counts)
+    total = int([ln for ln in text.splitlines()
+                 if ln.startswith("auron_query_wall_seconds_count")
+                 ][0].rsplit(" ", 1)[1])
+    assert counts[-1] == total
+
+
 def test_concurrent_trace_429(server):
     """A second profile capture while one is in flight answers 429 —
     the jax profiler is process-global and concurrent start_trace calls

@@ -19,6 +19,11 @@
 # overload_check.sh / serve_check.sh are wired.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+source tools/prom_assert.sh
+PROM_OUT="$(mktemp)"
+PROM_NEEDLES="$(mktemp)"
+export PROM_OUT PROM_NEEDLES
+trap 'rm -f "$PROM_OUT" "$PROM_NEEDLES"' EXIT
 
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
 import json
@@ -175,14 +180,12 @@ with conf.scoped(scope):
             assert victim in st["excluded_executors"], st
         assert fleet.admission.held_bytes() == 0
 
-        prom = get(srv.url + "/metrics").decode()
-        for needle in ("auron_fleet_requeues_total",
-                       "auron_fleet_deaths_total",
-                       f'auron_fleet_executor_up{{executor="{victim}"}} 0'):
-            assert needle in prom, f"missing {needle!r} in /metrics"
-        line = [ln for ln in prom.splitlines()
-                if ln.startswith("auron_fleet_requeues_total")][0]
-        assert int(line.split()[-1]) >= 1
+        # Prometheus assertions: shared tools/prom_assert.sh helper —
+        # the run-dependent victim label travels via the needle file
+        with open(os.environ["PROM_OUT"], "w") as f:
+            f.write(get(srv.url + "/metrics").decode())
+        with open(os.environ["PROM_NEEDLES"], "w") as f:
+            f.write(f'auron_fleet_executor_up{{executor="{victim}"}} 0\n')
         print(f"fleet_check: {len(NAMES)}/{len(NAMES)} queries "
               f"value-identical to solo runs; executor {victim} killed "
               f"-9 mid-flight, {len(requeued)} query(ies) requeued on "
@@ -196,5 +199,11 @@ with conf.scoped(scope):
         reset_manager()
         faults.reset()
 EOF
+
+prom_assert_contains "$PROM_OUT" \
+  "auron_fleet_requeues_total" \
+  "auron_fleet_deaths_total"
+prom_assert_needles "$PROM_OUT" "$PROM_NEEDLES"
+prom_assert_ge "$PROM_OUT" auron_fleet_requeues_total 1
 
 echo "fleet_check.sh: ok"

@@ -1,0 +1,201 @@
+#!/usr/bin/env bash
+# CI fleet-observability gate (CPU, no accelerator needed) — the
+# tracing promotion of tools/rss_check.sh:
+#   1. spawn a 2-executor fleet WITH the durable-shuffle side-car and
+#      TRACING ON (`auron.trace.enable`): every dispatch overlay
+#      propagates trace context, workers/side-car record spans
+#      locally, the driver harvests them over heartbeats and stitches
+#      ONE Chrome trace per query with clock-aligned per-process lanes
+#   2. POST four concurrent /submit requests (IT-corpus queries)
+#   3. kill -9 the busiest executor mid-flight (the injected worker
+#      death)
+#   4. assert: every query succeeds; the requeued query's stitched
+#      trace VALIDATES and contains spans from >= 3 processes with
+#      the dead victim flagged `incomplete`; /events names the worker
+#      death with the affected query ids; /queries/<id> serves the
+#      harvested per-operator metric trees + lifecycle timeline; the
+#      latency histograms and trace-drop counter are on /metrics
+#
+# The same check runs inside the suite (tests/test_fleet_observability
+# .py::test_tools_obs_check_script, marked slow), mirroring how
+# rss_check.sh / fleet_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source tools/prom_assert.sh
+PROM_OUT="$(mktemp)"
+export PROM_OUT
+trap 'rm -f "$PROM_OUT"' EXIT
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+import urllib.request
+
+from auron_tpu import faults
+from auron_tpu.config import conf
+from auron_tpu.it import datagen
+from auron_tpu.memmgr.manager import reset_manager
+from auron_tpu.runtime import tracing
+from auron_tpu.serving import FleetManager, QueryServer, register_catalog
+
+SF = 0.002
+NAMES = ["q01", "q42", "q01", "q42"]
+
+catalog = datagen.generate(
+    tempfile.mkdtemp(prefix="auron-obs-check-"), sf=SF)
+register_catalog(SF, catalog)
+
+# latency-only worker chaos keeps queries in flight long enough for
+# the kill to land mid-query (and for heartbeat harvests to drain the
+# victim's spans before it dies)
+worker_spec = "op.execute:latency:p=0.5,ms=150,max=60,seed=11"
+worker_conf = {"auron.spmd.singleDevice.enable": False,
+               "auron.faults.spec": worker_spec,
+               "auron.task.retries": 2,
+               "auron.retry.backoff.base.ms": 1.0,
+               "auron.retry.backoff.max.ms": 10.0,
+               "auron.serving.preempt.watermark": 0.0,
+               "auron.serving.max.concurrent": 4}
+hb = 1.5
+scope = {"auron.retry.backoff.base.ms": 1.0,
+         "auron.retry.backoff.max.ms": 10.0,
+         "auron.net.timeout.seconds": 10.0,
+         "auron.fleet.heartbeat.seconds": hb,
+         "auron.fleet.death.probes": 3,
+         "auron.admission.default.forecast.bytes": 1 << 20,
+         "auron.serving.max.concurrent": 4,
+         "auron.trace.enable": True}
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.load(r)
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=300) as r:
+        return r.read()
+
+
+with conf.scoped(scope):
+    reset_manager(1 << 30)
+    fleet = FleetManager.spawn(2, conf_map=worker_conf,
+                               budget_bytes=1 << 29, rss_sidecar=True)
+    srv = QueryServer(scheduler=fleet).start()
+    try:
+        qids = {}
+        errs = []
+
+        def submit(i, name):
+            try:
+                doc = post(srv.url + "/submit",
+                           {"corpus": name, "sf": SF,
+                            "priority": 1 + (i % 3)})
+                qids[i] = (name, doc["query_id"])
+            except Exception as e:   # noqa: BLE001
+                errs.append((name, repr(e)))
+
+        threads = [threading.Thread(target=submit, args=(i, n))
+                   for i, n in enumerate(NAMES)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert len(qids) == len(NAMES)
+
+        # kill -9 the busiest executor once it is running work
+        victim = survivor = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            snap = fleet.fleet_snapshot()
+            busy = sorted(snap.items(), key=lambda kv: -kv[1]["inflight"])
+            eid, doc = busy[0]
+            if doc["inflight"] >= 2 and doc["load"].get("running", 0) >= 1:
+                victim, survivor = eid, busy[1][0]
+                break
+            time.sleep(0.1)
+        assert victim is not None, fleet.fleet_snapshot()
+        victim_qids = [q for _, q in qids.values()
+                       if fleet.get(q).executor_id == victim
+                       and not fleet.get(q).done.is_set()]
+        os.kill(fleet._handles[victim].endpoint.pid, signal.SIGKILL)
+
+        for _, (name, qid) in sorted(qids.items()):
+            assert fleet.wait(qid, timeout=600), \
+                f"{name} did not finish: {fleet.status(qid)}"
+            st = json.loads(get(srv.url + f"/status/{qid}"))
+            assert st["state"] == "succeeded", (name, st)
+            assert st["timeline"][-1]["state"] == "succeeded", st
+
+        # the flight recorder names the injected death + its victims
+        evs = json.loads(get(srv.url + "/events"))["events"]
+        deaths = [e for e in evs if e["kind"] == "worker.death"]
+        assert deaths, f"no worker.death on /events: {evs}"
+        death = deaths[-1]
+        assert death["attrs"]["executor"] == victim, death
+        assert set(victim_qids) <= set(death["query_ids"]), \
+            (victim_qids, death)
+        requeues = [e for e in evs if e["kind"] == "query.requeue"]
+        assert requeues and deaths[0]["seq"] < requeues[-1]["seq"]
+
+        # ONE stitched trace per query: validate the requeued query's
+        requeued = [q for q in victim_qids
+                    if fleet.status(q)["requeues"] >= 1]
+        assert requeued, "the killed executor's queries never requeued"
+        q = requeued[0]
+        doc = json.loads(get(srv.url + f"/queries/{q}/trace"))
+        errors = tracing.validate_chrome_trace(doc)
+        assert errors == [], errors
+        other = doc["otherData"]
+        assert other["stitched"] is True, other
+        pids = {e["pid"] for e in doc["traceEvents"]
+                if e.get("ph") in ("X", "i")}
+        assert len(pids) >= 3, \
+            f"stitched trace spans fewer than 3 processes: {pids}"
+        assert victim in other["incomplete"], other
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "fleet.dispatch" in names and \
+            "event.query.requeue" in names, sorted(names)[:40]
+
+        # /queries/<id>: harvested per-operator trees + timeline
+        det = json.loads(get(srv.url + f"/queries/{q}?format=json"))
+        assert det["analyzed"] and "output_rows" in det["analyzed"]
+        assert det["timeline"][-1]["state"] == "succeeded"
+        assert "queued" in det["state_durations"]
+
+        with open(os.environ["PROM_OUT"], "w") as f:
+            f.write(get(srv.url + "/metrics").decode())
+        print(f"obs_check: {len(NAMES)}/{len(NAMES)} queries traced; "
+              f"executor {victim} killed -9 mid-flight; stitched "
+              f"trace for {q} spans {len(pids)} processes "
+              f"(victim flagged incomplete), worker death on /events "
+              f"with {len(death['query_ids'])} affected query id(s)")
+    finally:
+        procs = [h.endpoint.proc for h in fleet._handles.values()
+                 if getattr(h.endpoint, "proc", None) is not None]
+        sc = fleet._sidecar.proc
+        srv.stop()
+        for p in procs:
+            assert p.poll() is not None, "worker process leaked"
+        assert sc.proc.poll() is not None, "side-car process leaked"
+        reset_manager()
+        faults.reset()
+EOF
+
+prom_assert_contains "$PROM_OUT" \
+  "auron_query_wall_seconds_bucket" \
+  "auron_query_queue_wait_seconds_count" \
+  "auron_trace_dropped_events_total" \
+  "auron_fleet_worker_trace_dropped_events_total"
+prom_assert_ge "$PROM_OUT" auron_fleet_deaths_total 1
+prom_assert_ge "$PROM_OUT" auron_query_wall_seconds_count 1
+
+echo "obs_check.sh: ok"

@@ -15,9 +15,14 @@
 # serve_check.sh / chaos_check.sh are wired.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+source tools/prom_assert.sh
+PROM_OUT="$(mktemp)"
+export PROM_OUT
+trap 'rm -f "$PROM_OUT"' EXIT
 
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
 import json
+import os
 import threading
 import urllib.request
 
@@ -129,12 +134,9 @@ with conf.scoped(scope):
         assert preemptions >= 1, \
             f"tight budget never forced a preemption: {stats}"
         assert srv.scheduler.admission.held_bytes() == 0
-        prom = get(srv.url + "/metrics").decode()
-        for needle in ("auron_preemptions_total", "auron_requeues_total"):
-            assert needle in prom, f"missing {needle!r} in /metrics"
-        line = [ln for ln in prom.splitlines()
-                if ln.startswith("auron_preemptions_total")][0]
-        assert int(line.split()[-1]) >= 1
+        # Prometheus assertions: shared tools/prom_assert.sh helper
+        with open(os.environ["PROM_OUT"], "w") as f:
+            f.write(get(srv.url + "/metrics").decode())
         print(f"overload_check: {len(NAMES)}/{len(NAMES)} queries "
               f"value-identical to solo runs through {preemptions} "
               f"preemption(s)")
@@ -143,5 +145,10 @@ with conf.scoped(scope):
         reset_manager()
         faults.reset()
 EOF
+
+prom_assert_contains "$PROM_OUT" \
+  "auron_preemptions_total" \
+  "auron_requeues_total"
+prom_assert_ge "$PROM_OUT" auron_preemptions_total 1
 
 echo "overload_check.sh: ok"

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Shared Prometheus-text assertions for the tools/*_check.sh CI gates.
+#
+# Each gate's Python driver dumps the final /metrics scrape to a file
+# (usually "$PROM_OUT"), optionally plus a needle file of
+# run-dependent lines (victim executor labels etc.); the gate then
+# sources this helper and asserts on the dump — ONE implementation of
+# the grep-based metric checks instead of four copies.
+#
+#   prom_assert_contains FILE NEEDLE...   every NEEDLE is a literal
+#                                         substring of FILE
+#   prom_assert_needles FILE NEEDLE_FILE  every non-empty line of
+#                                         NEEDLE_FILE appears in FILE
+#   prom_assert_ge FILE METRIC MIN        the first sample line
+#                                         `METRIC <value>` has
+#                                         value >= MIN
+
+prom_assert_contains() {
+  local file=$1 needle
+  shift
+  for needle in "$@"; do
+    if ! grep -qF -- "$needle" "$file"; then
+      echo "prom_assert: missing '$needle' in $file" >&2
+      return 1
+    fi
+  done
+}
+
+prom_assert_needles() {
+  local file=$1 needles=$2 line
+  while IFS= read -r line; do
+    [ -n "$line" ] || continue
+    if ! grep -qF -- "$line" "$file"; then
+      echo "prom_assert: missing '$line' in $file" >&2
+      return 1
+    fi
+  done < "$needles"
+}
+
+prom_assert_ge() {
+  local file=$1 metric=$2 min=$3 value
+  value=$(awk -v m="$metric" '$1 == m { print $2; exit }' "$file")
+  if [ -z "$value" ]; then
+    echo "prom_assert: no sample for $metric in $file" >&2
+    return 1
+  fi
+  if ! awk -v v="$value" -v m="$min" 'BEGIN { exit !(v + 0 >= m + 0) }'
+  then
+    echo "prom_assert: $metric = $value < $min" >&2
+    return 1
+  fi
+}

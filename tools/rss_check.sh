@@ -22,6 +22,10 @@
 # fleet_check.sh / overload_check.sh are wired.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+source tools/prom_assert.sh
+PROM_OUT="$(mktemp)"
+export PROM_OUT
+trap 'rm -f "$PROM_OUT"' EXIT
 
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
 import json
@@ -195,18 +199,16 @@ with conf.scoped(scope):
 
         # RESUME, not recompute: >= 1 stage skipped on the survivor
         # (visible on /metrics via the fleet-aggregated worker
-        # counters) and the sealed stage's commit total never moved
+        # counters — asserted by the shared tools/prom_assert.sh
+        # helper after this block) and the sealed stage's commit
+        # total never moved
         prom = get(srv.url + "/metrics").decode()
-        for needle in ("auron_fleet_worker_rss_stage_skips_total",
-                       "auron_rss_sidecar_up 1",
-                       "auron_fleet_deaths_total",
-                       "auron_rss_cleanups_total"):
-            assert needle in prom, f"missing {needle!r} in /metrics"
-        line = [ln for ln in prom.splitlines()
-                if ln.startswith("auron_fleet_worker_rss_stage_skips"
-                                 "_total ")][0]
-        skips = int(line.split()[-1])
-        assert skips >= 1, f"no stage resumed from the side-car: {line}"
+        with open(os.environ["PROM_OUT"], "w") as f:
+            f.write(prom)
+        lines = [ln for ln in prom.splitlines()
+                 if ln.startswith("auron_fleet_worker_rss_stage_skips"
+                                  "_total ")]
+        skips = int(lines[0].split()[-1]) if lines else 0
         post_stats = control.stats(prefix=f"{resumed_qid}|")
         assert post_stats["totals"][sealed_sid]["commits"] == \
             commits_before, "map tasks re-ran for the sealed stage"
@@ -237,5 +239,12 @@ with conf.scoped(scope):
         reset_manager()
         faults.reset()
 EOF
+
+prom_assert_contains "$PROM_OUT" \
+  "auron_fleet_worker_rss_stage_skips_total" \
+  "auron_rss_sidecar_up 1" \
+  "auron_fleet_deaths_total" \
+  "auron_rss_cleanups_total"
+prom_assert_ge "$PROM_OUT" auron_fleet_worker_rss_stage_skips_total 1
 
 echo "rss_check.sh: ok"

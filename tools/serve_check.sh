@@ -16,9 +16,14 @@
 # chaos_check.sh / mem_check.sh are wired.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+source tools/prom_assert.sh
+PROM_OUT="$(mktemp)"
+export PROM_OUT
+trap 'rm -f "$PROM_OUT"' EXIT
 
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
 import json
+import os
 import threading
 import urllib.request
 
@@ -115,19 +120,21 @@ with conf.scoped(scope):
         stats = json.loads(get(srv.url + "/scheduler"))
         queued = stats["admission"]["events"]["queued"]
         assert queued >= 1, f"admission gate never queued: {stats}"
-        prom = get(srv.url + "/metrics").decode()
-        for needle in ("auron_admission_queued_total",
-                       "auron_admission_admitted_total",
-                       "auron_queries_submitted_total 4"):
-            assert needle in prom, f"missing {needle!r} in /metrics"
-        line = [ln for ln in prom.splitlines()
-                if ln.startswith("auron_admission_queued_total")][0]
-        assert int(line.split()[-1]) >= 1
+        # the Prometheus assertions live in tools/prom_assert.sh —
+        # dump the final scrape for the shared bash helper
+        with open(os.environ["PROM_OUT"], "w") as f:
+            f.write(get(srv.url + "/metrics").decode())
         print(f"serve_check: 4/4 queries value-identical to solo runs, "
               f"{queued} admission-queue event(s)")
     finally:
         srv.stop()
         reset_manager()
 EOF
+
+prom_assert_contains "$PROM_OUT" \
+  "auron_admission_queued_total" \
+  "auron_admission_admitted_total" \
+  "auron_queries_submitted_total 4"
+prom_assert_ge "$PROM_OUT" auron_admission_queued_total 1
 
 echo "serve_check.sh: ok"
