@@ -72,7 +72,8 @@ GENERIC_NAMES = frozenset({
     "reset", "start", "stop", "wait", "notify", "release", "acquire",
     "submit", "apply", "check", "build", "load", "save", "parse",
     "update", "execute", "drain", "emit", "copy", "join", "split",
-    "strip", "extend", "append", "remove", "insert", "sort", "index",
+    "strip", "extend", "append", "remove", "discard", "insert", "sort",
+    "index",
     "count", "encode", "decode", "format", "match", "search", "group",
     "status", "result", "cancel", "call", "draw", "fetch", "delete",
     "items", "keys", "values", "names", "name", "commit", "collect",

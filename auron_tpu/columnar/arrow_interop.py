@@ -53,12 +53,14 @@ def arrow_to_batch(rb: pa.RecordBatch, capacity: Optional[int] = None,
 def arrow_array_to_column(dt: DataType, arr: pa.Array, cap: int) -> Column:
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
+    from auron_tpu.columnar.serde import note_copy
     n = len(arr)
     if not is_device_type(dt):
         return HostColumn(dt, arr)
     validity = np.zeros(cap, dtype=bool)
     validity[:n] = _arrow_validity(arr)
     if dt.is_stringlike:
+        note_copy("ingest.arrow.string")
         lengths, flat = _arrow_string_parts(arr)
         max_len = int(lengths.max()) if n else 0
         if max_len > int(conf.get("auron.string.device.max.width")):
@@ -80,6 +82,7 @@ def arrow_array_to_column(dt: DataType, arr: pa.Array, cap: int) -> Column:
     npdt = dt.numpy_dtype()
     data = np.zeros(cap, dtype=npdt)
     if n:
+        note_copy("ingest.arrow.fixed")
         if dt.id == TypeId.DECIMAL:
             vals = _decimal128_unscaled_int64(arr)
         elif dt.id == TypeId.TIMESTAMP_US:

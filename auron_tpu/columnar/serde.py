@@ -2,21 +2,44 @@
 
 Analogue of datafusion-ext-commons' compact batch serde + IpcCompression
 (io/batch_serde.rs:68,81; io/ipc_compression.rs:35,115): length-prefixed
-compressed Arrow IPC frames.  When the C++ host runtime is built
+compressed frames.  When the C++ host runtime is built
 (auron_tpu.native), its codec is used; otherwise python zstandard/zlib.
 
-Frame layout (one or more per stream):
+Two frame formats share one stream (`auron.serde.format.version`):
+
+v1 (the original, still written for spills and readable everywhere):
   u32 LE compressed-payload length | u8 codec id | payload
-Payload = Arrow IPC stream (schema + single batch) compressed whole.
-An empty stream is valid (zero frames).
+  Payload = Arrow IPC stream (schema + single batch) compressed whole.
+
+v2 (the zero-copy exchange format): the stream opens with a schema
+header emitted ONCE —
+  u32 0xFFFFFFFF (magic) | u8 2 (version) | u32 len | arrow-schema bytes
+— and each frame carries the *device* column layout raw:
+  u32 payload length | u8 (codec id | 0x80) | payload
+  payload = u32 num_rows | u32 capacity | u16 ncols | per-column
+  sections of length-prefixed, 64-byte-aligned raw buffers (data /
+  validity / string matrix+lengths / f64 exact-bits sidecar; host
+  columns embed a single-column Arrow IPC stream).
+Because the buffers ARE the padded device representation, a reader
+wraps them as numpy views and `device_put`s them without a pyarrow
+decode — no per-column materialization copy (asserted by the
+`copy_count` instrumentation below, not assumed).  The codec bit keeps
+v1 and v2 frames distinguishable per frame, so mixed-version streams
+(rolling upgrades, spilled v1 runs next to v2 pushes) read cleanly.
+A stream whose first frame is a v1 frame needs no header; a v2 header
+may also appear MID-stream (per-map shuffle streams concatenate on the
+reduce side), re-arming the schema for the frames that follow.
+
+An empty stream is valid (zero frames, with or without a v2 header).
 """
 
 from __future__ import annotations
 
 import io
 import struct
-from typing import BinaryIO, Iterator, List, Optional
+from typing import Any, BinaryIO, Dict, Iterator, List, Optional, Union
 
+import numpy as np
 import pyarrow as pa
 
 from auron_tpu.config import conf
@@ -24,6 +47,51 @@ from auron_tpu.config import conf
 _CODEC_IDS = {"none": 0, "zstd": 1, "zlib": 2, "lz4": 3}
 _CODEC_NAMES = {v: k for k, v in _CODEC_IDS.items()}
 
+# v2 stream framing: the magic is an impossible v1 payload length, so a
+# v1 reader can never mistake a header for a frame and vice versa
+_V2_MAGIC = 0xFFFFFFFF
+_V2_VERSION = 2
+_V2_CODEC_BIT = 0x80
+_ALIGN = 64
+
+# column-section kinds inside a v2 frame
+_KIND_FIXED = 0
+_KIND_STRING = 1
+_KIND_HOST = 2
+
+
+# ---------------------------------------------------------------------------
+# copy accounting: the zero-copy claim is asserted, not assumed.  Every
+# serde/ingest site that MATERIALIZES column data (pyarrow decode into a
+# padded array, string matrix scatter, host-column IPC decode) notes a
+# copy here; the v2 fixed-width fetch->device path notes none.  Plain
+# GIL-guarded ints: the hook must stay ~free on the hot path.
+# ---------------------------------------------------------------------------
+
+_COPY_SITES: Dict[str, int] = {}
+
+
+def note_copy(site: str, n: int = 1) -> None:
+    _COPY_SITES[site] = _COPY_SITES.get(site, 0) + n
+
+
+def copy_count() -> int:
+    """Total decode/encode materialization copies since the last reset."""
+    return sum(_COPY_SITES.values())
+
+
+def copy_counts() -> Dict[str, int]:
+    """Per-site copy counts (copy, not view)."""
+    return dict(_COPY_SITES)
+
+
+def reset_copy_count() -> None:
+    _COPY_SITES.clear()
+
+
+# ---------------------------------------------------------------------------
+# codecs (shared by both formats)
+# ---------------------------------------------------------------------------
 
 def _compress(payload: bytes, codec: str) -> bytes:
     if codec == "zstd":
@@ -57,17 +125,27 @@ def _decompress(payload: bytes, codec: str) -> bytes:
     return payload
 
 
-def write_one_batch(rb: pa.RecordBatch, out: BinaryIO,
-                    codec: Optional[str] = None) -> int:
-    """Write one frame; returns bytes written."""
+def _resolve_codec(codec: Optional[str]) -> str:
     codec = codec or conf.get("auron.shuffle.compression.codec")
     if codec == "zstd":
         from auron_tpu.native import bindings
         if not bindings.zstd_available():
             codec = "zlib"   # self-describing: the frame header records it
+    return codec
+
+
+# ---------------------------------------------------------------------------
+# v1: arrow-IPC frames
+# ---------------------------------------------------------------------------
+
+def write_one_batch(rb: pa.RecordBatch, out: BinaryIO,
+                    codec: Optional[str] = None) -> int:
+    """Write one v1 frame; returns bytes written."""
+    codec = _resolve_codec(codec)
     sink = io.BytesIO()
     with pa.ipc.new_stream(sink, rb.schema) as w:
         w.write_batch(rb)
+    note_copy("serde.v1.encode")
     payload = _compress(sink.getvalue(), codec)
     header = struct.pack("<IB", len(payload), _CODEC_IDS[codec])
     out.write(header)
@@ -76,24 +154,81 @@ def write_one_batch(rb: pa.RecordBatch, out: BinaryIO,
 
 
 def read_one_batch(inp: BinaryIO) -> Optional[pa.RecordBatch]:
-    header = inp.read(5)
-    if len(header) < 5:
+    """Read one v1 frame (None at clean end of stream).  Raises
+    EOFError on a truncated header or payload, and ValueError if the
+    stream is v2 (use read_batches, which speaks both)."""
+    got = _read_frame(inp, _StreamState())
+    if got is None:
         return None
-    n, cid = struct.unpack("<IB", header)
-    payload = inp.read(n)
-    if len(payload) < n:
-        raise EOFError("truncated batch frame")
-    data = _decompress(payload, _CODEC_NAMES[cid])
-    with pa.ipc.open_stream(io.BytesIO(data)) as r:
-        return r.read_next_batch()
+    if not isinstance(got, pa.RecordBatch):
+        raise ValueError("v2 frame in a v1-only read_one_batch stream")
+    return got
 
 
-def read_batches(inp: BinaryIO) -> Iterator[pa.RecordBatch]:
+class _StreamState:
+    """Per-stream reader state: the schema armed by the last v2 header."""
+
+    __slots__ = ("schema", "arrow_schema")
+
+    def __init__(self) -> None:
+        self.schema = None          # ir.schema.Schema
+        self.arrow_schema = None    # pa.Schema
+
+
+def _read_exact(inp: BinaryIO, n: int, what: str) -> bytes:
+    data = inp.read(n)
+    if len(data) < n:
+        raise EOFError(f"truncated {what}: wanted {n} bytes, "
+                       f"got {len(data)}")
+    return data
+
+
+def _read_frame(inp: BinaryIO, state: _StreamState):
+    """One frame (RecordBatch for v1, Batch for v2) or None at end.
+    Consumes v2 schema headers transparently."""
     while True:
-        rb = read_one_batch(inp)
-        if rb is None:
+        header = inp.read(5)
+        if len(header) == 0:
+            return None
+        if len(header) < 5:
+            raise EOFError("truncated frame header: "
+                           f"got {len(header)} of 5 bytes")
+        n, cid = struct.unpack("<IB", header)
+        if n == _V2_MAGIC:
+            if cid != _V2_VERSION:
+                raise ValueError(f"unsupported serde stream version {cid}")
+            (slen,) = struct.unpack("<I", _read_exact(
+                inp, 4, "v2 schema header"))
+            sbytes = _read_exact(inp, slen, "v2 schema payload")
+            from auron_tpu.ir.schema import from_arrow_schema
+            state.arrow_schema = pa.ipc.read_schema(pa.py_buffer(sbytes))
+            state.schema = from_arrow_schema(state.arrow_schema)
+            continue
+        payload = _read_exact(inp, n, "batch frame payload")
+        if cid & _V2_CODEC_BIT:
+            codec = _CODEC_NAMES[cid & ~_V2_CODEC_BIT]
+            if state.schema is None:
+                raise ValueError("v2 frame before any v2 schema header")
+            data = _decompress(payload, codec)
+            return _decode_v2_frame(data, state.schema)
+        data = _decompress(payload, _CODEC_NAMES[cid])
+        note_copy("serde.v1.decode")
+        with pa.ipc.open_stream(io.BytesIO(data)) as r:
+            return r.read_next_batch()
+
+
+def read_batches(inp: BinaryIO) -> Iterator[Union[pa.RecordBatch, "Any"]]:
+    """Frames in stream order: pa.RecordBatch for v1 frames, device
+    Batch (columnar.batch.Batch) for v2 frames.  Consumers that only
+    ever read streams they wrote in v1 (spill files) keep seeing
+    RecordBatches; format-agnostic readers (IpcReaderExec) dispatch on
+    type."""
+    state = _StreamState()
+    while True:
+        got = _read_frame(inp, state)
+        if got is None:
             return
-        yield rb
+        yield got
 
 
 def serialize_batches(batches: List[pa.RecordBatch],
@@ -106,3 +241,203 @@ def serialize_batches(batches: List[pa.RecordBatch],
 
 def deserialize_batches(data: bytes) -> List[pa.RecordBatch]:
     return list(read_batches(io.BytesIO(data)))
+
+
+# ---------------------------------------------------------------------------
+# v2: raw device-layout frames
+# ---------------------------------------------------------------------------
+
+def format_version() -> int:
+    """The configured exchange wire format (`auron.serde.format.version`)."""
+    return int(conf.get("auron.serde.format.version"))
+
+
+def encode_stream_header(schema) -> bytes:
+    """The once-per-stream v2 schema header."""
+    from auron_tpu.ir.schema import to_arrow_schema
+    sbytes = to_arrow_schema(schema).serialize().to_pybytes()
+    return struct.pack("<IBI", _V2_MAGIC, _V2_VERSION, len(sbytes)) + sbytes
+
+
+def _pad_to(out: io.BytesIO, align: int) -> None:
+    rem = out.tell() % align
+    if rem:
+        out.write(b"\x00" * (align - rem))
+
+
+def _put_buffer(out: io.BytesIO, buf) -> None:
+    """Length prefix, pad to the 64-byte grid, raw bytes."""
+    mv = memoryview(buf)
+    out.write(struct.pack("<I", mv.nbytes))
+    _pad_to(out, _ALIGN)
+    out.write(mv)
+
+
+def encode_batch_v2(batch, codec: Optional[str] = None,
+                    out: Optional[BinaryIO] = None) -> bytes:
+    """One v2 frame from a device Batch.  Device buffers (plus a lazy
+    row count) are fetched in ONE host_sync, then written raw — no
+    arrow materialization, no per-column copies beyond the wire write
+    itself.  Returns the frame bytes (also written to `out` if given)."""
+    from auron_tpu.columnar.batch import (
+        DeviceStringColumn, HostColumn, bucket_capacity,
+    )
+    from auron_tpu.ir.schema import TypeId
+    from auron_tpu.ops.kernel_cache import host_sync
+
+    codec = _resolve_codec(codec)
+    dev_idx = [i for i, c in enumerate(batch.columns)
+               if not isinstance(c, HostColumn)]
+    count, fetched = host_sync((batch.num_rows_raw,
+                                [batch.columns[i] for i in dev_idx]))
+    n = int(count)
+    batch._num_rows = n
+    cols = list(batch.columns)
+    for i, c in zip(dev_idx, fetched):
+        cols[i] = c
+    # right-size: the serialized capacity is the smallest bucket >= n
+    # (numpy slicing below is a view, not a copy); anything the batch
+    # over-allocated never hits the wire
+    cap = min(batch.capacity, bucket_capacity(n))
+
+    body = io.BytesIO()
+    body.write(struct.pack("<IIH", n, cap, len(cols)))
+    for f, c in zip(batch.schema, cols):
+        if isinstance(c, HostColumn):
+            a = c.array
+            if isinstance(a, pa.ChunkedArray):
+                a = a.combine_chunks()
+            a = a.slice(0, n)
+            sink = io.BytesIO()
+            rb = pa.RecordBatch.from_arrays([a], names=[f.name])
+            with pa.ipc.new_stream(sink, rb.schema) as w:
+                w.write_batch(rb)
+            blob = sink.getvalue()
+            note_copy("serde.v2.encode.host")
+            body.write(struct.pack("<BI", _KIND_HOST, len(blob)))
+            body.write(blob)
+        elif isinstance(c, DeviceStringColumn):
+            data = np.asarray(c.data)[:cap]
+            body.write(struct.pack("<BI", _KIND_STRING, data.shape[1]))
+            _put_buffer(body, np.ascontiguousarray(data))
+            _put_buffer(body, np.asarray(c.lengths)[:cap])
+            _put_buffer(body, np.asarray(c.validity)[:cap])
+        else:
+            data = np.asarray(c.data)[:cap]
+            bits = None if c.bits is None else np.asarray(c.bits)[:cap]
+            if bits is not None and f.dtype.id == TypeId.FLOAT64:
+                # the exact-bits sidecar IS the authoritative payload
+                # for f64 (on TPU `data` is the f32-demoted shadow);
+                # data reconstructs as a free view on decode
+                data = None
+            flags = (1 if bits is not None else 0)
+            body.write(struct.pack("<BB", _KIND_FIXED, flags))
+            if bits is not None:
+                _put_buffer(body, bits)
+            else:
+                _put_buffer(body, np.ascontiguousarray(data))
+            _put_buffer(body, np.asarray(c.validity)[:cap])
+    payload = body.getvalue()
+    if codec != "none":
+        payload = _compress(payload, codec)
+    frame = struct.pack("<IB", len(payload),
+                        _CODEC_IDS[codec] | _V2_CODEC_BIT) + payload
+    if out is not None:
+        out.write(frame)
+    return frame
+
+
+def _get_buffer(payload: bytes, off: int, dtype, count: int,
+                what: str):
+    """(numpy view over the payload, next offset).  The view IS the
+    received buffer — no decode copy."""
+    if off + 4 > len(payload):
+        raise EOFError(f"truncated v2 {what} buffer length")
+    (nbytes,) = struct.unpack_from("<I", payload, off)
+    off += 4
+    off += (-off) % _ALIGN
+    want = int(np.dtype(dtype).itemsize) * count
+    if nbytes != want:
+        raise EOFError(f"corrupt v2 {what} buffer: recorded {nbytes} "
+                       f"bytes, layout wants {want}")
+    if off + nbytes > len(payload):
+        raise EOFError(f"truncated v2 {what} buffer payload")
+    arr = np.frombuffer(payload, dtype=dtype, count=count, offset=off)
+    return arr, off + nbytes
+
+
+def _decode_v2_frame(payload: bytes, schema):
+    """v2 payload -> device Batch: numpy views over the received bytes,
+    device_put per buffer, zero decode copies for device columns."""
+    import jax.numpy as jnp
+
+    from auron_tpu.columnar.batch import (
+        Batch, DeviceColumn, DeviceStringColumn, HostColumn,
+    )
+    from auron_tpu.ir.schema import TypeId
+
+    if len(payload) < 10:
+        raise EOFError("truncated v2 frame body")
+    n, cap, ncols = struct.unpack_from("<IIH", payload, 0)
+    if ncols != len(schema):
+        raise EOFError(f"v2 frame has {ncols} columns, stream schema "
+                       f"has {len(schema)}")
+    off = 10
+    cols = []
+    for f in schema:
+        if off + 1 > len(payload):
+            raise EOFError("truncated v2 column section")
+        kind = payload[off]
+        off += 1
+        if kind == _KIND_HOST:
+            (blen,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            if off + blen > len(payload):
+                raise EOFError("truncated v2 host column payload")
+            with pa.ipc.open_stream(io.BytesIO(payload[off:off + blen])) \
+                    as r:
+                rb = r.read_next_batch()
+            note_copy("serde.v2.decode.host")
+            off += blen
+            cols.append(HostColumn(f.dtype, rb.column(0)))
+        elif kind == _KIND_STRING:
+            (width,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            flat, off = _get_buffer(payload, off, np.uint8, cap * width,
+                                    "string data")
+            mat = flat.reshape(cap, width)
+            lens, off = _get_buffer(payload, off, np.int32, cap,
+                                    "string lengths")
+            valid, off = _get_buffer(payload, off, np.bool_, cap,
+                                     "string validity")
+            cols.append(DeviceStringColumn(
+                f.dtype, jnp.asarray(mat), jnp.asarray(lens),
+                jnp.asarray(valid)))
+        elif kind == _KIND_FIXED:
+            flags = payload[off]
+            off += 1
+            has_bits = bool(flags & 1)
+            bits = None
+            if has_bits:
+                raw, off = _get_buffer(payload, off, np.uint64, cap,
+                                       "f64 bits")
+                # the doubles themselves are a free reinterpret view of
+                # the exact-bits buffer
+                data = raw.view(np.float64)
+                bits = jnp.asarray(raw)
+            else:
+                data, off = _get_buffer(payload, off, f.dtype.numpy_dtype(),
+                                        cap, "column data")
+                if f.dtype.id == TypeId.FLOAT64:
+                    from auron_tpu.ops.sort_keys import (
+                        f64_exact_bits_enabled,
+                    )
+                    if f64_exact_bits_enabled():
+                        bits = jnp.asarray(data.view(np.uint64))
+            valid, off = _get_buffer(payload, off, np.bool_, cap,
+                                     "column validity")
+            cols.append(DeviceColumn(f.dtype, jnp.asarray(data),
+                                     jnp.asarray(valid), bits))
+        else:
+            raise EOFError(f"unknown v2 column kind {kind}")
+    return Batch(schema, cols, n, cap)

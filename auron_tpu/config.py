@@ -294,6 +294,42 @@ SHUFFLE_COMPRESSION_CODEC = conf.define(
     "auron.shuffle.compression.codec", "zstd",
     "Codec for shuffle/spill blocks: zstd, zlib, lz4, none."
 )
+SERDE_FORMAT_VERSION = conf.define(
+    "auron.serde.format.version", 2,
+    "Exchange wire format written by the shuffle writers: 2 (default) "
+    "streams the schema once per (map, partition) stream and frames "
+    "the padded DEVICE column layout raw, so the fetch side wraps "
+    "received buffers as numpy views and device_puts them with ZERO "
+    "per-column decode copies (columnar/serde.py copy_count asserts "
+    "it); 1 writes the original per-frame compressed Arrow IPC.  "
+    "Readers speak both regardless (frames are self-describing), so "
+    "mixed-version streams and spilled v1 runs always decode."
+)
+SHUFFLE_PIPELINE_DEPTH = conf.define(
+    "auron.shuffle.pipeline.depth", 4,
+    "Bounded async window for remote-shuffle push AND fetch "
+    "(shuffle_rss clients): up to this many pushes ride a per-writer "
+    "sender thread while the map task keeps computing, and reduce "
+    "fetches for different partitions overlap across this many "
+    "connections.  Order per (map, partition) stream is preserved "
+    "(one sender, submission order) so push_id dedup, the commit "
+    "protocol and reduce-side determinism are untouched; errors "
+    "surface at the next push or at flush with their retry "
+    "classification intact.  <= 1 restores fully synchronous "
+    "push/fetch."
+)
+SHUFFLE_PID_FUSE = conf.define(
+    "auron.shuffle.pid.fuse.enable", True,
+    "Splice the exchange's partition-id computation into the "
+    "producing FusedFragment's device program as an extra output "
+    "column (ops/fused.py `fused.fragment.pid` jit site): the shuffle "
+    "writer consumes (batch, pid) from ONE jitted program instead of "
+    "dispatching a standalone PartitionIdComputer pass over the "
+    "materialized fragment output.  Applies when the writer's child "
+    "is a fused fragment and the partitioning keys are device-"
+    "capable; host-column batches fall back to the standalone "
+    "computer per batch (bit-identical either way)."
+)
 TASK_RETRIES = conf.define(
     "auron.task.retries", 0,
     "Per-partition task retry count above the runtime (the Spark "
@@ -783,7 +819,33 @@ SERVING_MAX_CONCURRENT = conf.define(
 SERVING_RESULT_MAX_ROWS = conf.define(
     "auron.serving.result.max.rows", 65536,
     "Row cap on the /result/<id> HTTP payload (JSON rows); larger "
-    "results are truncated with a 'truncated' marker in the response.",
+    "results are truncated with a 'truncated' marker in the response.  "
+    "The Arrow result stream (?format=arrow) is NOT capped — large "
+    "results flow to clients as chunked Arrow IPC frames.",
+)
+SERVING_RESULT_FORMAT = conf.define(
+    "auron.serving.result.format", "json",
+    "Default GET /result/<id> representation when the request names "
+    "none: 'json' (row-capped rows) or 'arrow' (chunked Arrow IPC "
+    "stream).  A request's ?format= query arg or an Accept: "
+    "application/vnd.apache.arrow.stream header overrides it per "
+    "call.",
+)
+SERVING_RESULT_STREAM_ENABLE = conf.define(
+    "auron.serving.result.stream.enable", True,
+    "Publish result partitions into the per-query result stream "
+    "(runtime/result_stream.py) AS TASKS COMPLETE, so GET "
+    "/result/<id>?format=arrow&since=N serves incremental Arrow IPC "
+    "frames for a RUNNING query (the PR 13 ack-cursor drain shape).  "
+    "Off: results are only available whole, after the query "
+    "succeeds.",
+)
+SERVING_RESULT_STREAM_MAX_MB = conf.define(
+    "auron.serving.result.stream.max.mb", 64,
+    "Byte budget for buffered, not-yet-drained result-stream frames "
+    "per query; past it new frames are dropped from the stream with a "
+    "'truncated' flag (the terminal ?format=arrow fetch still serves "
+    "the FULL stored table).",
 )
 ADMISSION_ENABLE = conf.define(
     "auron.admission.enable", True,

@@ -123,6 +123,7 @@ class AuronSession:
         self._exchange_sids: Dict[str, str] = {}
         self._exchange_local: set = set()
         self._rss_degraded = False
+        self._stream_root: Optional[int] = None
 
     # -- public entry (preColumnarTransitions analogue) -------------------
 
@@ -220,6 +221,12 @@ class AuronSession:
         self._spmd_rejection = None
         self._exchange_sids = {}
         self._exchange_local = set()
+        # result streaming (runtime/result_stream.py): only the ROOT
+        # native plan's partitions are the query result — exchange map
+        # sides and broadcast subtrees run through the same _run_native
+        # machinery and must never publish
+        self._stream_root = id(converted) \
+            if isinstance(converted, P.PlanNode) else None
         if mesh is not None and isinstance(converted, P.PlanNode):
             from auron_tpu.parallel.stage import (
                 SpmdUnsupported, execute_plan_spmd, precheck_plan,
@@ -302,9 +309,15 @@ class AuronSession:
         return self._run_native(c, ctx)
 
     def _run_native(self, plan: P.PlanNode, ctx: ConvertContext) -> pa.Table:
+        from auron_tpu.runtime import result_stream, tracing
         resources = self._materialize_deps(plan, ctx)
         n_parts = ctx.parts(plan)
         batches: List[pa.RecordBatch] = []
+        stream_qid = None
+        if self._stream_root is not None and id(plan) == self._stream_root:
+            qid = tracing.current_query_id()
+            if result_stream.active(qid):
+                stream_qid = qid
 
         def run_task(pid: int):
             # the task-retry model above the runtime (the Spark
@@ -312,9 +325,16 @@ class AuronSession:
             # run_tasks itself: retryable-classified failures replay
             # with 1 + auron.task.retries attempts against the already-
             # materialized stage inputs (runtime/retry.py)
-            return execute_plan(plan, partition_id=pid,
-                                resources=resources,
-                                num_partitions=n_parts)
+            res = execute_plan(plan, partition_id=pid,
+                               resources=resources,
+                               num_partitions=n_parts)
+            if stream_qid is not None:
+                # the streaming-result drain (?format=arrow&since=N)
+                # sees this partition as soon as its task completes —
+                # published AFTER the successful return, so a retried
+                # task can never double-publish
+                result_stream.publish(stream_qid, pid, res.batches)
+            return res
 
         # one runtime per task, tasks in parallel across a thread pool —
         # the analogue of the reference running one native runtime per
@@ -513,16 +533,22 @@ class AuronSession:
         from auron_tpu.runtime.retry import (
             RetryPolicy, call_with_retry, task_classify,
         )
+        from auron_tpu.shuffle_rss.pipeline import run_windowed
         policy = RetryPolicy.task_policy()
+
+        def fetch_one(pid: int):
+            return call_with_retry(
+                lambda: service.reduce_blocks(job.rid, pid),
+                policy=policy, classify=task_classify,
+                label=f"shuffle fetch {job.rid}:{pid}")
+
+        # pipelined fetch: up to auron.shuffle.pipeline.depth partition
+        # fetches in flight, results in partition order, the smallest-
+        # pid error raised first (the sequential loop's error)
         with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
                           parts=n_reduce):
             resources.put(job.rid, PartitionedBlocks(
-                [call_with_retry(
-                    lambda rid=job.rid, p=pid:
-                        service.reduce_blocks(rid, p),
-                    policy=policy, classify=task_classify,
-                    label=f"shuffle fetch {job.rid}:{pid}")
-                 for pid in range(n_reduce)]))
+                run_windowed(fetch_one, range(n_reduce))))
 
     # -- the durable side-car exchange (commit protocol + resume) ---------
 
@@ -641,17 +667,27 @@ class AuronSession:
     def _durable_fetch(self, sid: str, n_reduce: int, man: dict):
         """Fetch every reduce partition, validating against the
         manifest; returns (per-partition frame lists, bad map ids) so
-        ONE regeneration round covers every damaged map output."""
+        ONE regeneration round covers every damaged map output.
+        Partition fetches ride the bounded pipeline window (transport
+        errors — RssUnavailable — still raise in partition order)."""
         from auron_tpu.shuffle_rss.durable import FetchFailedError
+        from auron_tpu.shuffle_rss.pipeline import run_windowed
+
+        def fetch_one(pid: int):
+            try:
+                return self.shuffle_service.reduce_blocks(
+                    sid, pid, expect=man)
+            except FetchFailedError as e:
+                return e
+
         blocks: List[List[bytes]] = []
         bad: set = set()
-        for pid in range(n_reduce):
-            try:
-                blocks.append(self.shuffle_service.reduce_blocks(
-                    sid, pid, expect=man))
-            except FetchFailedError as e:
-                bad.update(e.map_ids)
+        for got in run_windowed(fetch_one, range(n_reduce)):
+            if isinstance(got, FetchFailedError):
+                bad.update(got.map_ids)
                 blocks.append([])
+            else:
+                blocks.append(got)
         return blocks, bad
 
 

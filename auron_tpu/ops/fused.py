@@ -39,15 +39,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from auron_tpu.analysis.fusion import body_chain
-from auron_tpu.columnar.batch import Batch, concat_batches
+from auron_tpu.columnar.batch import Batch, DeviceColumn, concat_batches
 from auron_tpu.config import conf
 from auron_tpu.exprs.compiler import EvalCtx, build_evaluator, evaluate
 from auron_tpu.exprs.typing import infer_type
 from auron_tpu.ir import plan as P
-from auron_tpu.ir.schema import Field, Schema
+from auron_tpu.ir.schema import DataType, Field, Schema
 from auron_tpu.ops.base import Operator, TaskContext, compact_indices
 
 Col = Any
+
+# the extra output column a pid-fused fragment appends (ops/shuffle/
+# writer.py pops it; it never crosses an operator boundary otherwise)
+PID_FIELD = "__auron_pid__"
 
 
 class _Stage:
@@ -114,7 +118,104 @@ class FusedFragmentExec(Operator):
                                       separators=(",", ":"))
         self._slow_evals: Dict[int, Any] = {}
         self._seen_sigs: set = set()
+        # pid fusion (PR 3 follow-up): a shuffle writer parent may
+        # splice its partition-id computation into this fragment's
+        # program as one extra int32 output column
+        self._pid_part = None
+        self._pid_exprs: Tuple = ()
+        self._pid_orders = None
+        self._pid_bounds = None
+        self._pid_key: Tuple = ()
+        self._pid_schema: Optional[Schema] = None
+        self._pid_slow_computer = None
         self.metrics.set("ops_fused", len(self.stages))
+
+    # ------------------------------------------------------------------
+    # pid fusion surface (consumed by ops/shuffle/writer.py)
+    # ------------------------------------------------------------------
+
+    def enable_pid_fusion(self, partitioning) -> bool:
+        """Splice `partitioning`'s partition-id computation into this
+        fragment's device program: output batches carry one extra
+        int32 PID_FIELD column computed over the fragment's OWN output
+        rows inside the same jitted program (`fused.fragment.pid` jit
+        site) — the shuffle writer consumes (batch, pid) without a
+        standalone PartitionIdComputer dispatch.  hash and range modes
+        only (single is constant, round_robin is a host-row-offset
+        arange the fusion could not cheapen); returns False when the
+        keys are not device-capable over the fragment output schema,
+        in which case the writer keeps the standalone computer."""
+        if self._pid_part is not None:
+            return True
+        if partitioning.mode not in ("hash", "range"):
+            return False
+        if partitioning.mode == "hash":
+            exprs = tuple(partitioning.expressions)
+            orders = None
+        else:
+            exprs = tuple(s.child for s in partitioning.sort_orders)
+            orders = tuple((s.asc, s.nulls_first)
+                           for s in partitioning.sort_orders)
+        from auron_tpu.runtime.fusion import _exprs_fusable
+        if _exprs_fusable(exprs, self.schema) is not None:
+            return False
+        bounds = None
+        if partitioning.mode == "range":
+            from auron_tpu.ops.shuffle.partitioner import (
+                encoded_range_bounds,
+            )
+            bounds = encoded_range_bounds(
+                partitioning.range_bounds, partitioning.sort_orders,
+                orders)
+        import json
+        self._pid_part = partitioning
+        self._pid_exprs = exprs
+        self._pid_orders = orders
+        self._pid_bounds = bounds
+        # cache-key extension: everything the pid computation bakes
+        # into the trace (mode, fan-out, key exprs, sort orders, and
+        # the bounds SHAPE — bound values ride in as a traced arg so
+        # re-sampled bounds of the same shape re-trace zero times)
+        self._pid_key = (
+            "pid", partitioning.mode, partitioning.num_partitions,
+            json.dumps([x.to_dict() for x in exprs], sort_keys=True,
+                       default=str),
+            orders,
+            None if bounds is None else tuple(bounds.shape))
+        self._pid_schema = Schema(self.schema.fields + (
+            Field(PID_FIELD, DataType.int32(), False),))
+        return True
+
+    def pid_fused(self) -> bool:
+        return self._pid_part is not None
+
+    def _out_schema(self) -> Schema:
+        return self._pid_schema if self._pid_schema is not None \
+            else self.schema
+
+    def _trace_pid_column(self, cols, num_rows, pid, capacity,
+                          pid_bounds) -> DeviceColumn:
+        """Trace the partition-id computation over one output lane's
+        final columns — the exact device math of PartitionIdComputer
+        (ops/shuffle/partitioner.py), so fused and standalone ids are
+        bit-identical."""
+        ctx = EvalCtx(cols=list(cols), schema=self.schema,
+                      num_rows=num_rows, capacity=capacity,
+                      partition_id=pid)
+        keys = [evaluate(x, ctx) for x in self._pid_exprs]
+        if self._pid_part.mode == "hash":
+            from auron_tpu.exprs import hashing as H
+            ids = H.pmod(H.hash_columns(keys, seed=42, capacity=capacity),
+                         self._pid_part.num_partitions)
+        else:
+            from auron_tpu.ops.shuffle.partitioner import (
+                range_ids_from_words,
+            )
+            from auron_tpu.ops.sort_keys import encode_sort_keys
+            words = encode_sort_keys(keys, self._pid_orders)
+            ids = range_ids_from_words(words, pid_bounds, capacity)
+        live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        return DeviceColumn(DataType.int32(), ids.astype(jnp.int32), live)
 
     # ------------------------------------------------------------------
     # device program
@@ -152,13 +253,23 @@ class FusedFragmentExec(Operator):
 
     def _program(self, capacity: int, sig: Tuple):
         from auron_tpu.ops.kernel_cache import cached_jit
-        key = ("fused.fragment", self._struct_key, capacity, sig,
-               self._conf_key())
+        pid_fused = self._pid_part is not None
+        if pid_fused:
+            # a NAMED jit site of its own ("fused.fragment.pid"): the
+            # compile manifest proves pid-fused exchanges trace here
+            # while the standalone partitioner pass never dispatches
+            key = ("fused.fragment.pid", self._struct_key, capacity,
+                   sig, self._conf_key(), self._pid_key)
+        else:
+            key = ("fused.fragment", self._struct_key, capacity, sig,
+                   self._conf_key())
         stages = self.stages
         compact = self._has_filter or bool(self._limits)
+        trace_pid = self._trace_pid_column
 
         def build():
-            def run(cols, num_rows, pid, limit_skip, limit_remaining):
+            def run(cols, num_rows, pid, limit_skip, limit_remaining,
+                    pid_bounds):
                 live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
                 # device stages run in chain order; a limit stage splices
                 # its rank window into the mask at its chain position
@@ -190,10 +301,17 @@ class FusedFragmentExec(Operator):
                         idx, count = compact_indices(mask, capacity)
                         valid = jnp.arange(capacity,
                                            dtype=jnp.int32) < count
-                        out.append(([c.gather(idx, valid)
-                                     for c in lcols], count))
+                        ocols = [c.gather(idx, valid) for c in lcols]
+                        if pid_fused:
+                            ocols.append(trace_pid(ocols, count, pid,
+                                                   capacity, pid_bounds))
+                        out.append((ocols, count))
                     else:
-                        out.append((lcols, None))
+                        ocols = list(lcols)
+                        if pid_fused:
+                            ocols.append(trace_pid(ocols, num_rows, pid,
+                                                   capacity, pid_bounds))
+                        out.append((ocols, None))
                 return out, limit_stats
             return run
         return cached_jit(key, build)
@@ -213,11 +331,13 @@ class FusedFragmentExec(Operator):
             from auron_tpu.ops.base import batch_size
             target = batch_size()
 
+        out_schema = self._out_schema()
+
         def flush():
             nonlocal staged, staged_rows
             if staged:
                 out = staged[0] if len(staged) == 1 else \
-                    concat_batches(self.schema, staged)
+                    concat_batches(out_schema, staged)
                 staged, staged_rows = [], 0
                 return out
             return None
@@ -243,7 +363,7 @@ class FusedFragmentExec(Operator):
                 staged.append(ob)
                 staged_rows += ob.num_rows
                 if staged_rows >= target:
-                    yield concat_batches(self.schema, staged)
+                    yield concat_batches(out_schema, staged)
                     staged, staged_rows = [], 0
         out = flush()
         if out is not None:
@@ -257,6 +377,9 @@ class FusedFragmentExec(Operator):
         sig = self._sig(b)
         info0 = cache_info()
         fn = self._program(b.capacity, sig)
+        bounds = self._pid_bounds
+        if bounds is None:
+            bounds = np.zeros((0, 0), dtype=np.uint64)
         t0 = time.perf_counter_ns() if sig not in self._seen_sigs else 0
         if t0:
             # first call for this (capacity, signature): jax traces +
@@ -270,12 +393,12 @@ class FusedFragmentExec(Operator):
                     b.columns, b.num_rows_dev(),
                     np.int32(ctx.partition_id),
                     [np.int32(s) for s in skip],
-                    [np.int32(r) for r in remaining])
+                    [np.int32(r) for r in remaining], bounds)
         else:
             lanes, limit_stats = fn(
                 b.columns, b.num_rows_dev(), np.int32(ctx.partition_id),
                 [np.int32(s) for s in skip],
-                [np.int32(r) for r in remaining])
+                [np.int32(r) for r in remaining], bounds)
         if t0:
             self._seen_sigs.add(sig)
             self.metrics.add("fragment_trace_ns",
@@ -297,7 +420,10 @@ class FusedFragmentExec(Operator):
         out = []
         for lcols, count in lanes:
             n = count if count is not None else b.num_rows_raw
-            out.append(Batch(self.schema, list(lcols), n, b.capacity))
+            out.append(Batch(self._out_schema(), list(lcols), n,
+                             b.capacity))
+        if self._pid_part is not None:
+            self.metrics.add("pid_fused_batches", len(out))
         return out
 
     # ------------------------------------------------------------------
@@ -379,9 +505,27 @@ class FusedFragmentExec(Operator):
                 li += 1
             # coalesce_batches: handled by the shared epilogue staging
         for lb in lanes:
-            yield lb if lb.schema is self.schema else \
-                Batch(self.schema, lb.columns, lb.num_rows_raw,
-                      lb.capacity)
+            if lb.schema is not self.schema:
+                lb = Batch(self.schema, lb.columns, lb.num_rows_raw,
+                           lb.capacity)
+            if self._pid_part is not None:
+                # host-column escape hatch: the standalone computer
+                # supplies the pid column the fast path would have
+                # fused (bit-identical by the partitioner contract)
+                if self._pid_slow_computer is None:
+                    from auron_tpu.ops.shuffle.partitioner import (
+                        PartitionIdComputer,
+                    )
+                    self._pid_slow_computer = PartitionIdComputer(
+                        self._pid_part, self.schema)
+                ids = self._pid_slow_computer(
+                    lb, partition_id=ctx.partition_id)
+                lb = Batch(self._out_schema(),
+                           list(lb.columns) + [DeviceColumn(
+                               DataType.int32(),
+                               ids.astype(jnp.int32), lb.row_mask())],
+                           lb.num_rows_raw, lb.capacity)
+            yield lb
 
     # ------------------------------------------------------------------
     # composition surface (AggExec prologue fusion)

@@ -29,22 +29,82 @@ class IpcReaderExec(Operator):
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
         src = ctx.resources.get(self.resource_id)
-        if hasattr(src, "for_partition"):
+        fetched_from_shuffle = hasattr(src, "for_partition")
+        if fetched_from_shuffle:
             # partition-indexed source (shuffle reduce side): pick this
             # task's block list (the per-task segment-iterator contract of
             # AuronBlockStoreShuffleReader.readBlocks)
             src = src.for_partition(ctx.partition_id)
+            nbytes = sum(len(b) for b in _flat_blocks(src))
+            if nbytes:
+                from auron_tpu.runtime import counters
+                counters.bump("shuffle_bytes_fetched", nbytes)
+                self.metrics.add("shuffle_read_bytes", nbytes)
         import time
         t0 = time.perf_counter_ns()
         n = 0
-        for rb in _iter_ipc(src):
-            n += rb.num_rows
-            yield Batch.from_arrow(rb, schema=self.schema)
+        for item in _iter_ipc(src):
+            if isinstance(item, Batch):
+                # v2 frame: already the device representation — rename
+                # to this reader's declared schema, no arrow decode
+                n += item.num_rows
+                yield item if item.schema == self.schema else \
+                    Batch(self.schema, item.columns, item.num_rows_raw,
+                          item.capacity)
+            else:
+                n += item.num_rows
+                yield Batch.from_arrow(item, schema=self.schema)
         self.metrics.add("shuffle_read_rows", n)
         self.metrics.add("shuffle_read_time_ns", time.perf_counter_ns() - t0)
 
 
-def _iter_ipc(src) -> Iterator[pa.RecordBatch]:
+def _flat_blocks(src) -> list:
+    """Flatten nested block lists to leaf byte blocks."""
+    if isinstance(src, (bytes, bytearray, memoryview)):
+        return [src]
+    if isinstance(src, (list, tuple)):
+        out = []
+        for b in src:
+            out.extend(_flat_blocks(b))
+        return out
+    return []
+
+
+class _ChainedBlocks:
+    """File-like over a sequence of byte blocks: the reduce side of one
+    exchange reads the CONCATENATION of a map stream's pushed chunks
+    (v2 emits its schema header once per stream, so chunks after the
+    first are frame-only and cannot be parsed block-by-block)."""
+
+    __slots__ = ("_blocks", "_i", "_off")
+
+    def __init__(self, blocks) -> None:
+        self._blocks = [memoryview(b) for b in blocks if len(b)]
+        self._i = 0
+        self._off = 0
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            parts = [self._blocks[self._i][self._off:]]
+            parts += self._blocks[self._i + 1:]
+            self._i, self._off = len(self._blocks), 0
+            return b"".join(parts)
+        out = bytearray()
+        while n > 0 and self._i < len(self._blocks):
+            blk = self._blocks[self._i]
+            take = blk[self._off:self._off + n]
+            out += take
+            n -= len(take)
+            self._off += len(take)
+            if self._off >= len(blk):
+                self._i += 1
+                self._off = 0
+        return bytes(out)
+
+
+def _iter_ipc(src) -> Iterator[Any]:
+    """Frames from any IPC source: pa.RecordBatch (v1) or device Batch
+    (v2), via columnar.serde.read_batches."""
     if isinstance(src, (bytes, bytearray, memoryview)):
         yield from batch_serde.read_batches(io.BytesIO(bytes(src)))
     elif isinstance(src, str) and os.path.exists(src):
@@ -53,8 +113,8 @@ def _iter_ipc(src) -> Iterator[pa.RecordBatch]:
     elif hasattr(src, "read"):
         yield from batch_serde.read_batches(src)
     elif isinstance(src, (list, tuple)):
-        for block in src:
-            yield from _iter_ipc(block)
+        yield from batch_serde.read_batches(
+            _ChainedBlocks(_flat_blocks(src)))
     else:
         raise TypeError(f"unsupported IPC source {type(src)}")
 

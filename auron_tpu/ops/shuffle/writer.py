@@ -47,12 +47,17 @@ class RssPartitionWriter:
 
 class _PartitionBuffers(MemConsumer):
     """Staged per-partition rows (BufferedData analogue) with spill to
-    per-partition compressed runs."""
+    per-partition compressed runs.  With wire format v2
+    (auron.serde.format.version) frames carry the raw device layout and
+    each partition's stream opens with one schema header."""
 
     def __init__(self, n: int, schema: Schema):
         super().__init__("ShuffleWriter")
         self.n = n
         self.schema = schema
+        self.v2 = batch_serde.format_version() >= 2
+        self._header = batch_serde.encode_stream_header(schema) \
+            if self.v2 else b""
         self.runs: List[Dict[int, bytes]] = []   # spilled run: pid -> frames
         self.staged: Dict[int, List[Batch]] = {}
         self.staged_bytes = 0
@@ -62,6 +67,12 @@ class _PartitionBuffers(MemConsumer):
         self.staged_bytes += b.mem_bytes()
         self.update_mem_used(self.staged_bytes)
 
+    def _frame(self, b: Batch, sink) -> None:
+        if self.v2:
+            batch_serde.encode_batch_v2(b, out=sink)
+        else:
+            batch_serde.write_one_batch(b.to_arrow(), sink)
+
     def spill(self) -> int:
         if not self.staged:
             return 0
@@ -70,7 +81,7 @@ class _PartitionBuffers(MemConsumer):
         for pid, batches in sorted(self.staged.items()):
             sink = io.BytesIO()
             for b in batches:
-                batch_serde.write_one_batch(b.to_arrow(), sink)
+                self._frame(b, sink)
             run[pid] = sink.getvalue()
         self.runs.append(run)
         self.staged = {}
@@ -80,13 +91,18 @@ class _PartitionBuffers(MemConsumer):
 
     def partition_bytes(self, pid: int) -> bytes:
         """All frames for a partition (spilled runs + staged), concatenated
-        — IPC frames are self-delimiting so concatenation is valid."""
+        — frames are self-delimiting so concatenation is valid.  A v2
+        partition stream opens with the schema header (once)."""
         out = io.BytesIO()
         for run in self.runs:
             if pid in run:
+                if self.v2 and not out.tell():
+                    out.write(self._header)
                 out.write(run[pid])
         for b in self.staged.get(pid, []):
-            batch_serde.write_one_batch(b.to_arrow(), out)
+            if self.v2 and not out.tell():
+                out.write(self._header)
+            self._frame(b, out)
         return out.getvalue()
 
 
@@ -98,7 +114,20 @@ class _ShuffleWriterBase(Operator):
                              Field("rows", DataType.int64())))
         Operator.__init__(self, out_schema, [child], name=name)
         self.partitioning = partitioning
+        self.child_schema = child.schema
         self._computer = PartitionIdComputer(partitioning, child.schema)
+        # pid fusion (auron.shuffle.pid.fuse.enable): when the child is
+        # a fused fragment with device-capable keys, splice the pid
+        # computation into its program — batches arrive with one extra
+        # PID_FIELD column instead of paying a standalone computer
+        # dispatch over the materialized fragment output
+        self._pid_fused = False
+        from auron_tpu.config import conf
+        if partitioning.num_partitions > 1 and \
+                bool(conf.get("auron.shuffle.pid.fuse.enable")):
+            from auron_tpu.ops.fused import FusedFragmentExec
+            if isinstance(child, FusedFragmentExec):
+                self._pid_fused = child.enable_pid_fusion(partitioning)
 
     def _partitioned_stream(self, ctx: TaskContext):
         """Yields (pid, sub_batch) pairs per input batch.
@@ -112,14 +141,26 @@ class _ShuffleWriterBase(Operator):
         """
         import time
 
+        from auron_tpu.ops.fused import PID_FIELD
+
         row_start = 0
         n = self.partitioning.num_partitions
         for b in self.child_stream(ctx):
             if b.num_rows == 0:
                 continue
             t0 = time.perf_counter_ns()
-            pids = self._computer(b, partition_id=ctx.partition_id,
-                                  row_start=row_start)
+            pids = None
+            if self._pid_fused and b.schema.fields and \
+                    b.schema.fields[-1].name == PID_FIELD:
+                # the producing fragment already computed the ids in
+                # ITS program — pop the column, no extra dispatch
+                pids = b.columns[-1].data
+                b = Batch(b.schema.select(range(len(b.schema) - 1)),
+                          b.columns[:-1], b.num_rows_raw, b.capacity)
+                self.metrics.add("pid_fused_batches", 1)
+            if pids is None:
+                pids = self._computer(b, partition_id=ctx.partition_id,
+                                      row_start=row_start)
             row_start += b.num_rows
             # the documented once-per-batch pid fetch, through the
             # sanctioned channel (np.asarray on the device vector was
@@ -185,14 +226,28 @@ class RssShuffleWriterExec(_ShuffleWriterBase):
         self.rss_resource_id = rss_resource_id
 
     def execute(self, ctx: TaskContext) -> Iterator[Batch]:
+        from auron_tpu.runtime import counters
         writer: RssPartitionWriter = ctx.resources.get(self.rss_resource_id)
         rows_per_pid: Dict[int, int] = {}
         bytes_per_pid: Dict[int, int] = {}
+        v2 = batch_serde.format_version() >= 2
+        header = batch_serde.encode_stream_header(self.child_schema) \
+            if v2 else b""
+        started: set = set()
         for pid, sub in self._partitioned_stream(ctx):
-            sink = io.BytesIO()
-            batch_serde.write_one_batch(sub.to_arrow(), sink)
-            data = sink.getvalue()
+            if v2:
+                # schema once per (map, partition) stream, then raw
+                # device-layout frames — no arrow materialization
+                frame = batch_serde.encode_batch_v2(sub)
+                data = frame if pid in started else header + frame
+                started.add(pid)
+            else:
+                sink = io.BytesIO()
+                batch_serde.write_one_batch(sub.to_arrow(), sink)
+                data = sink.getvalue()
             writer.write(pid, data)
+            counters.bump("shuffle_bytes_pushed", len(data))
+            self.metrics.add("shuffle_write_bytes", len(data))
             rows_per_pid[pid] = rows_per_pid.get(pid, 0) + sub.num_rows
             bytes_per_pid[pid] = bytes_per_pid.get(pid, 0) + len(data)
         writer.flush()

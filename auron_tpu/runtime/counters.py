@@ -66,6 +66,11 @@ _COUNTERS: Dict[str, int] = {
     "rss_degrades": 0,
     "rss_sidecar_deaths": 0,
     "rss_cleanups": 0,
+    # data plane (PR 14): exchange bytes through the shuffle writers /
+    # readers (all transports), for the BENCH_r06 delta and the
+    # dataplane_check gate
+    "shuffle_bytes_pushed": 0,
+    "shuffle_bytes_fetched": 0,
     # tracing: spans dropped past auron.trace.max.events (per-recorder
     # `dropped` counts feed trace_truncated on the exported trace; this
     # is the process total `auron_trace_dropped_events_total` exports)

@@ -39,10 +39,13 @@ _VOLATILE_KEYS = frozenset({
     "mem_spill_size", "disk_spill_size", "mem_peak",
     # cold-vs-warm process state: a first run traces, a repeat traces 0
     "jit_compiles",
+    # exchange wire bytes: codec- and format-version-dependent
+    "shuffle_write_bytes", "shuffle_read_bytes",
 })
 
 # byte-valued metrics: rendered human-readable in the non-canonical form
-_BYTE_KEYS = frozenset({"mem_peak", "mem_spill_size", "disk_spill_size"})
+_BYTE_KEYS = frozenset({"mem_peak", "mem_spill_size", "disk_spill_size",
+                        "shuffle_write_bytes", "shuffle_read_bytes"})
 
 # render order: row/batch flow first, then time, then memory, then the
 # rest sorted

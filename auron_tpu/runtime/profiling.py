@@ -207,6 +207,10 @@ def _prometheus_text() -> str:
         emit(f"auron_{key}_total", snap.get(key, 0),
              help_="durable shuffle (this process): "
                    f"{key.replace('_', ' ')} count")
+    for key in ("shuffle_bytes_pushed", "shuffle_bytes_fetched"):
+        emit(f"auron_{key}_total", snap.get(key, 0),
+             help_="exchange data plane (this process): "
+                   f"{key.replace('_', ' ')}")
     emit("auron_trace_dropped_events_total",
          snap.get("trace_dropped_events", 0),
          help_="spans dropped past auron.trace.max.events across all "
@@ -493,6 +497,22 @@ def _result_payload(table) -> dict:
             "columns": table.column_names, "rows": rows}
 
 
+ARROW_STREAM_CT = "application/vnd.apache.arrow.stream"
+
+
+def _arrow_stream_bytes(schema, frames) -> bytes:
+    """Self-contained Arrow IPC stream of `frames` (one incremental
+    /result drain response)."""
+    import io as _io
+
+    import pyarrow as _pa
+    sink = _io.BytesIO()
+    with _pa.ipc.new_stream(sink, schema) as w:
+        for rb in frames:
+            w.write_batch(rb)
+    return sink.getvalue()
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -512,6 +532,77 @@ class _Handler(BaseHTTPRequestHandler):
                    headers: Optional[dict] = None) -> None:
         self._send(code, json.dumps(doc, default=str).encode(),
                    headers=headers)
+
+    # -- streamed Arrow results (GET /result/<id>?format=arrow) ------------
+
+    def _wants_arrow(self, q) -> bool:
+        """Content negotiation: ?format= wins, then the Accept header,
+        then the auron.serving.result.format default."""
+        fmt = q.get("format", [""])[0]
+        if fmt:
+            return fmt == "arrow"
+        if ARROW_STREAM_CT in (self.headers.get("Accept") or ""):
+            return True
+        from auron_tpu import config
+        return str(config.conf.get(
+            "auron.serving.result.format")) == "arrow"
+
+    def _send_arrow_table(self, table) -> None:
+        """The terminal result as a CHUNKED Arrow IPC stream: record
+        batches flow straight from the stored table to the socket —
+        no whole-payload buffering, no row cap."""
+        import pyarrow as pa
+        self.protocol_version = "HTTP/1.1"   # chunked needs 1.1 framing
+        self.send_response(200)
+        self.send_header("Content-Type", ARROW_STREAM_CT)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        wfile = self.wfile
+
+        class _Chunked:
+            closed = False
+
+            def write(self, data) -> int:
+                data = bytes(data)
+                if data:
+                    wfile.write(f"{len(data):x}\r\n".encode())
+                    wfile.write(data)
+                    wfile.write(b"\r\n")
+                return len(data)
+
+            def flush(self) -> None:
+                wfile.flush()
+
+            def writable(self) -> bool:
+                return True
+
+        sink = _Chunked()
+        with pa.ipc.new_stream(sink, table.schema) as w:
+            for rb in table.to_batches():
+                w.write_batch(rb)
+        wfile.write(b"0\r\n\r\n")
+        self.close_connection = True
+
+    def _drain_running_result(self, qid: str, q, st) -> bool:
+        """Incremental frames for a RUNNING query (the PR 13 drain
+        shape: ?since=N cursor, X-Auron-Next-Since in the reply).
+        False when the query has no registered result stream (the
+        caller answers 409 + Retry-After as before)."""
+        from auron_tpu.runtime import result_stream
+        drained = result_stream.drain(
+            qid, since=int(q.get("since", ["0"])[0]))
+        if drained is None:
+            return False
+        schema, frames, nxt, done, truncated = drained
+        body = b"" if schema is None else \
+            _arrow_stream_bytes(schema, frames)
+        self._send(200, body, ARROW_STREAM_CT, headers={
+            "X-Auron-Next-Since": nxt,
+            "X-Auron-Complete": int(bool(done)),
+            "X-Auron-Truncated": int(bool(truncated)),
+            "X-Auron-State": st["state"]})
+        return True
 
     # -- serving routes (POST /submit, /cancel/<id>) -----------------------
 
@@ -665,9 +756,19 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 qid = url.path[len("/result/"):]
                 st = sched.status(qid)
+                arrow = self._wants_arrow(q)
                 if st is None:
                     self._send_json(404, {"error": "unknown query id"})
-                elif st["state"] != "succeeded":
+                elif st["state"] == "succeeded":
+                    if arrow:
+                        self._send_arrow_table(sched.result(qid))
+                    else:
+                        self._send_json(200, _result_payload(
+                            sched.result(qid)))
+                elif arrow and st["state"] == "running" and \
+                        self._drain_running_result(qid, q, st):
+                    pass   # incremental frames served
+                else:
                     doc = {"error": f"query is {st['state']}, not "
                                     f"succeeded", "status": st}
                     headers = None
@@ -683,9 +784,6 @@ class _Handler(BaseHTTPRequestHandler):
                         headers = {"Retry-After":
                                    max(1, int(round(ra)))}
                     self._send_json(409, doc, headers=headers)
-                else:
-                    self._send_json(200, _result_payload(
-                        sched.result(qid)))
             elif url.path == "/scheduler":
                 sched = _serving_scheduler()
                 if sched is None:

@@ -1,0 +1,132 @@
+"""Per-query result streams: partitions published as tasks complete.
+
+The serving tier's missing half of the zero-copy data plane: results
+used to leave the server only as row-capped JSON, whole, after the
+query succeeded.  This module is the drain machinery for
+``GET /result/<id>?format=arrow`` — the result-side sibling of the
+PR 13 trace drain (`TraceRecorder.drain_since`):
+
+- the session PUBLISHES each top-level partition's record batches as
+  its task completes (frontend/session.py `_run_native`); out-of-order
+  completions are held back so the emitted frame sequence is always in
+  partition order — the exact row order of the final table;
+- a client polls ``?format=arrow&since=N`` while the query RUNS and
+  receives the frames it has not acknowledged yet as a self-contained
+  Arrow IPC stream plus the next cursor (`X-Auron-Next-Since`);
+- the buffered-frame byte budget (`auron.serving.result.stream.max.mb`)
+  bounds what a slow client can pin; past it the stream marks itself
+  `truncated` and the client falls back to the terminal fetch, which
+  always serves the FULL stored table.
+
+Registration is scoped by the serving scheduler (register on admission,
+re-register on requeue so a preempted attempt's partial frames never
+leak into the re-execution, mark_done/discard at terminal states).
+Everything here is host-side pyarrow — no jax, usable from any thread.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from auron_tpu.runtime import lockcheck
+
+_LOCK = lockcheck.Lock("result.stream")
+_STREAMS: "Dict[str, _Stream]" = {}
+_MAX_STREAMS = 64
+
+
+class _Stream:
+    __slots__ = ("query_id", "max_bytes", "schema", "frames", "pending",
+                 "next_pid", "nbytes", "truncated", "done", "rows")
+
+    def __init__(self, query_id: str, max_bytes: int):
+        self.query_id = query_id
+        self.max_bytes = max_bytes
+        self.schema = None                       # pa.Schema
+        self.frames: List = []                   # emitted, partition order
+        self.pending: Dict[int, List] = {}       # held out-of-order parts
+        self.next_pid = 0
+        self.nbytes = 0
+        self.truncated = False
+        self.done = False
+        self.rows = 0
+
+
+def register(query_id: str) -> None:
+    """Create (or reset — requeue) the stream for one query attempt."""
+    from auron_tpu.config import conf
+    max_bytes = int(conf.get("auron.serving.result.stream.max.mb")) << 20
+    with _LOCK:
+        _STREAMS[query_id] = _Stream(query_id, max_bytes)
+        while len(_STREAMS) > _MAX_STREAMS:
+            _STREAMS.pop(next(iter(_STREAMS)))
+
+
+def discard(query_id: str) -> None:
+    with _LOCK:
+        _STREAMS.pop(query_id, None)
+
+
+def active(query_id: Optional[str]) -> bool:
+    if not query_id:
+        return False
+    with _LOCK:
+        s = _STREAMS.get(query_id)
+        return s is not None and not s.done
+
+
+def publish(query_id: Optional[str], pid: int, batches) -> None:
+    """One completed partition's record batches.  No-op without a
+    registered stream; frames emit in partition order regardless of
+    task completion order."""
+    if not query_id:
+        return
+    with _LOCK:
+        s = _STREAMS.get(query_id)
+        if s is None or s.done:
+            return
+        s.pending[pid] = [rb for rb in batches if rb.num_rows]
+        while s.next_pid in s.pending:
+            for rb in s.pending.pop(s.next_pid):
+                if s.schema is None:
+                    s.schema = rb.schema
+                if s.truncated or s.nbytes + rb.nbytes > s.max_bytes:
+                    s.truncated = True
+                    continue
+                s.frames.append(rb)
+                s.nbytes += rb.nbytes
+                s.rows += rb.num_rows
+            s.next_pid += 1
+
+
+def mark_done(query_id: Optional[str]) -> None:
+    if not query_id:
+        return
+    with _LOCK:
+        s = _STREAMS.get(query_id)
+        if s is not None:
+            s.done = True
+
+
+def drain(query_id: str, since: int = 0
+          ) -> Optional[Tuple[object, List, int, bool, bool]]:
+    """(schema, frames[since:], next_cursor, done, truncated) — frames
+    stay buffered (the cursor is the client's ack, re-polls re-serve),
+    or None when the query has no stream."""
+    with _LOCK:
+        s = _STREAMS.get(query_id)
+        if s is None:
+            return None
+        since = max(0, int(since))
+        return (s.schema, list(s.frames[since:]),
+                max(since, len(s.frames)), s.done, s.truncated)
+
+
+def stats(query_id: str) -> Optional[Dict[str, int]]:
+    with _LOCK:
+        s = _STREAMS.get(query_id)
+        if s is None:
+            return None
+        return {"frames": len(s.frames), "rows": s.rows,
+                "bytes": s.nbytes, "done": s.done,
+                "truncated": s.truncated}

@@ -102,7 +102,8 @@ def _table_from_ipc(data: bytes) -> pa.Table:
 _REPORTED_COUNTERS = (
     "rss_stage_skips", "rss_map_tasks_skipped", "rss_map_tasks_run",
     "rss_fetch_regens", "rss_degrades", "tasks_retried",
-    "trace_dropped_events",
+    "trace_dropped_events", "shuffle_bytes_pushed",
+    "shuffle_bytes_fetched",
 )
 
 

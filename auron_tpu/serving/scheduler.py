@@ -342,6 +342,14 @@ class QueryScheduler:
             # fleet's durable-shuffle routing selects the session's
             # shuffle service)
             with config.conf.query_scoped(overlay):
+                if bool(config.conf.get(
+                        "auron.serving.result.stream.enable")):
+                    # arm (or RESET, on a requeued attempt — a
+                    # preempted run's partial frames must never leak
+                    # into the re-execution) the incremental result
+                    # stream the /result/<id>?format=arrow drain serves
+                    from auron_tpu.runtime import result_stream
+                    result_stream.register(sub.query_id)
                 session = self._session_factory()
                 res = session.execute(sub.plan, query_id=sub.query_id)
             sub.result = res.table
@@ -389,6 +397,12 @@ class QueryScheduler:
             # reservation released and the cancel/preempt mark cleared
             # BEFORE a requeue makes the submission runnable again —
             # a requeued run must start with a clean slate
+            from auron_tpu.runtime import result_stream
+            if sub.state == SUCCEEDED:
+                result_stream.mark_done(sub.query_id)
+            elif not requeue:
+                # failed/cancelled: nothing further will drain it
+                result_stream.discard(sub.query_id)
             self.admission.release(sub.query_id)
             task_pool.clear_cancelled(sub.query_id)
             started = sub.started_at

@@ -86,17 +86,23 @@ class _Conn:
 
 class _CelebornPartitionWriter(RssPartitionWriter):
     """Buffers pushes per partition and flushes batched (Celeborn's
-    client-side push buffering), at-most batch_bytes per push RPC."""
+    client-side push buffering), at-most batch_bytes per push RPC.
+    Push RPCs ride the bounded send window (shuffle_rss/pipeline.py):
+    submission order is preserved per writer, so the server-side
+    aggregate receives the synchronous byte sequence."""
 
     def __init__(self, conn: _Conn, shuffle_id: str,
                  batch_bytes: int = 1 << 20):
         import uuid
+
+        from auron_tpu.shuffle_rss.pipeline import PushPipeline
         self.conn = conn
         self.shuffle_id = shuffle_id
         self.batch_bytes = batch_bytes
         self._buf = {}
         self._writer_id = uuid.uuid4().hex[:12]
         self._seq = 0
+        self._pipe = PushPipeline(name="auron-rss-push")
 
     def write(self, partition_id: int, data: bytes) -> None:
         buf = self._buf.setdefault(partition_id, bytearray())
@@ -110,15 +116,17 @@ class _CelebornPartitionWriter(RssPartitionWriter):
             return
         push_id = f"{self._writer_id}-{self._seq}"
         self._seq += 1
-        self.conn.request({"cmd": "push", "shuffle": self.shuffle_id,
-                           "partition": partition_id, "len": len(buf),
-                           "push_id": push_id},
-                          bytes(buf))
+        header = {"cmd": "push", "shuffle": self.shuffle_id,
+                  "partition": partition_id, "len": len(buf),
+                  "push_id": push_id}
+        body = bytes(buf)
         self._buf[partition_id] = bytearray()
+        self._pipe.submit(lambda: self.conn.request(header, body))
 
     def flush(self) -> None:
         for pid in list(self._buf):
             self._push(pid)
+        self._pipe.close()
 
 
 class CelebornShuffleClient:

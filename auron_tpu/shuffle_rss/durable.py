@@ -86,14 +86,23 @@ class _DurableMapWriter(RssPartitionWriter):
     """One map task's writer: stage pushes under a fresh attempt id,
     publish atomically in flush().  A replayed task builds a NEW writer
     (new attempt) whose commit replaces the earlier attempt — the
-    at-least-once push replays inside one attempt dedup by push_id."""
+    at-least-once push replays inside one attempt dedup by push_id.
+
+    Pushes ride the bounded send window (shuffle_rss/pipeline.py,
+    `auron.shuffle.pipeline.depth`): the map task keeps computing while
+    up to `depth` pushes are in flight on one sender thread, in
+    submission order — the server observes exactly the synchronous push
+    sequence, and flush() DRAINS the window before the commit RPC so
+    the manifest can never publish ahead of its frames."""
 
     def __init__(self, conn: _Conn, shuffle_id: str, map_id: int):
+        from auron_tpu.shuffle_rss.pipeline import PushPipeline
         self.conn = conn
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.attempt = uuid.uuid4().hex[:12]
         self._seq = 0
+        self._pipe = PushPipeline(name="auron-rss-push")
 
     def _request(self, header: Dict[str, Any],
                  payload: bytes = b"") -> None:
@@ -104,13 +113,14 @@ class _DurableMapWriter(RssPartitionWriter):
             return
         push_id = f"{self.attempt}-{self._seq}"
         self._seq += 1
-        self._request(
-            {"cmd": "mpush", "shuffle": self.shuffle_id,
-             "map": self.map_id, "attempt": self.attempt,
-             "partition": partition_id, "push_id": push_id,
-             "len": len(data)}, data)
+        header = {"cmd": "mpush", "shuffle": self.shuffle_id,
+                  "map": self.map_id, "attempt": self.attempt,
+                  "partition": partition_id, "push_id": push_id,
+                  "len": len(data)}
+        self._pipe.submit(lambda: self._request(header, data))
 
     def flush(self) -> None:
+        self._pipe.close()   # every staged push answered BEFORE commit
         self._request(
             {"cmd": "mcommit", "shuffle": self.shuffle_id,
              "map": self.map_id, "attempt": self.attempt})

@@ -1,0 +1,159 @@
+"""Bounded async pipelining for the remote-shuffle clients.
+
+The copy tax was only half the exchange cost; the other half is the map
+task WAITING for each push RPC and the reduce side fetching partitions
+one after another.  This module overlaps compute with network under a
+small bounded window (`auron.shuffle.pipeline.depth`) without touching
+any recovery invariant:
+
+- ``PushPipeline``: pushes run on ONE sender thread per writer in
+  submission order, so the server observes exactly the synchronous
+  order — push_id dedup, commit-replaces-attempt atomicity and
+  reduce-side determinism are untouched.  The window bounds in-flight
+  pushes (submit blocks when full — a `lockcheck.blocked` probe marks
+  the wait site); the first error is held and re-raised, original
+  exception object intact, at the next submit or at ``drain()`` so the
+  retry tiers classify it exactly as they would the synchronous raise.
+- ``run_windowed``: fetch fan-out — up to `depth` partition fetches in
+  flight at once, results in item order, the smallest-index error
+  re-raised first (the sequential loop's error, deterministically).
+
+Depth <= 1 is fully synchronous: no threads, byte-identical to the
+pre-pipelining paths.  Each submitted call runs under a
+contextvars copy of the submitter's context, so per-query tracing /
+fault scoping / log prefixes follow the work onto the sender threads
+(the task_pool contract).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import queue
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+from auron_tpu.config import conf
+from auron_tpu.runtime import lockcheck
+
+
+def pipeline_depth() -> int:
+    return int(conf.get("auron.shuffle.pipeline.depth"))
+
+
+class PushPipeline:
+    """One writer's bounded async sender (see module docstring)."""
+
+    _STOP = object()
+
+    def __init__(self, depth: Optional[int] = None,
+                 name: str = "auron-rss-push"):
+        self.depth = pipeline_depth() if depth is None else int(depth)
+        self._name = name
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._err: Optional[BaseException] = None
+
+    @property
+    def async_enabled(self) -> bool:
+        return self.depth > 1
+
+    def _check(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None:
+            import weakref
+            self._q = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._run, name=self._name, daemon=True)
+            self._thread.start()
+            # a writer abandoned mid-task (task failure between pushes)
+            # never reaches flush(): stop the sender when the pipeline
+            # is collected so no thread outlives its writer
+            weakref.finalize(self, queue.Queue.put, self._q, self._STOP)
+
+    def _run(self) -> None:
+        q = self._q
+        while True:
+            item = q.get()
+            try:
+                if item is self._STOP:
+                    return
+                ctx, fn = item
+                if self._err is None:
+                    # first error wins; later submissions are skipped
+                    # (their task will fail/replay from the held error)
+                    ctx.run(fn)
+            except BaseException as e:  # noqa: BLE001 — ferried to caller
+                if self._err is None:
+                    self._err = e
+            finally:
+                q.task_done()
+
+    def submit(self, fn: Callable[[], Any]) -> None:
+        """Queue one push.  Synchronous when depth <= 1; otherwise
+        blocks while `depth` pushes are in flight."""
+        if not self.async_enabled:
+            fn()
+            return
+        self._check()
+        self._ensure_thread()
+        lockcheck.blocked("shuffle.pipeline.submit")
+        self._q.put((contextvars.copy_context(), fn))
+        self._check()
+
+    def drain(self) -> None:
+        """Wait until every queued push completed; re-raise the first
+        held error (original exception object, classification intact)."""
+        if self._thread is not None:
+            lockcheck.blocked("shuffle.pipeline.drain")
+            self._q.join()
+        self._check()
+
+    def close(self) -> None:
+        """Drain and stop the sender thread (writers are per map task —
+        flush() closes so no thread outlives its task)."""
+        try:
+            self.drain()
+        finally:
+            if self._thread is not None:
+                self._q.put(self._STOP)
+                self._thread.join(timeout=30)
+                self._thread = None
+                self._q = None
+
+
+def run_windowed(fn: Callable[[Any], Any], items: Sequence[Any],
+                 depth: Optional[int] = None,
+                 name: str = "auron-rss-fetch") -> List[Any]:
+    """`[fn(item) for item in items]` with up to `depth` calls in
+    flight.  Results keep item order; on failures the SMALLEST-index
+    error is raised (what the sequential loop would have raised).
+    Depth <= 1 (or a single item) runs inline."""
+    items = list(items)
+    depth = pipeline_depth() if depth is None else int(depth)
+    if depth <= 1 or len(items) <= 1:
+        return [fn(it) for it in items]
+    from concurrent.futures import ThreadPoolExecutor
+    results: List[Any] = [None] * len(items)
+    errors: List[Optional[BaseException]] = [None] * len(items)
+
+    def run_one(i: int, it, ctx) -> None:
+        try:
+            results[i] = ctx.run(fn, it)
+        except BaseException as e:  # noqa: BLE001 — re-raised in order
+            errors[i] = e
+
+    with ThreadPoolExecutor(max_workers=min(depth, len(items)),
+                            thread_name_prefix=name) as pool:
+        lockcheck.blocked("shuffle.pipeline.fetch")
+        futs = [pool.submit(run_one, i, it, contextvars.copy_context())
+                for i, it in enumerate(items)]
+        for f in futs:
+            f.result()
+    for e in errors:
+        if e is not None:
+            raise e
+    return results

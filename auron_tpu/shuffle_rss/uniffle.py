@@ -24,24 +24,34 @@ from auron_tpu.shuffle_rss.celeborn import _Conn
 class _UnifflePartitionWriter(RssPartitionWriter):
     def __init__(self, conn: _Conn, shuffle_id: str, map_id: int,
                  duplicate_pushes: int = 1):
+        from auron_tpu.shuffle_rss.pipeline import PushPipeline
         self.conn = conn
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.seq = 0
         self.duplicate_pushes = max(1, duplicate_pushes)
+        self._pipe = PushPipeline(name="auron-rss-push")
 
     def write(self, partition_id: int, data: bytes) -> None:
         if not data:
             return
         block_id = f"{self.map_id}-{self.seq}"
         self.seq += 1
-        # at-least-once: a retrying client may push the same block twice;
-        # the reader's dedup must make this invisible
-        for _ in range(self.duplicate_pushes):
-            self.conn.request(
-                {"cmd": "push_block", "shuffle": self.shuffle_id,
-                 "partition": partition_id, "block_id": block_id,
-                 "len": len(data)}, data)
+
+        def push() -> None:
+            # at-least-once: a retrying client may push the same block
+            # twice; the reader's dedup must make this invisible.  The
+            # duplicates stay adjacent on the one sender thread —
+            # exactly the synchronous arrival order.
+            for _ in range(self.duplicate_pushes):
+                self.conn.request(
+                    {"cmd": "push_block", "shuffle": self.shuffle_id,
+                     "partition": partition_id, "block_id": block_id,
+                     "len": len(data)}, data)
+        self._pipe.submit(push)
+
+    def flush(self) -> None:
+        self._pipe.close()
 
 
 class UniffleShuffleClient:

@@ -484,6 +484,141 @@ def worker_fused() -> dict:
 # orchestrator
 # ---------------------------------------------------------------------------
 
+def worker_serde() -> dict:
+    """Exchange data-plane numbers (the PR 14 headline pair):
+
+    1. serde microbench — v1 (arrow-IPC frames) vs v2 (raw device
+       layout, schema once) ROUND TRIP (serialize + deserialize +
+       device ingest) on a 1M-row multi-column batch, at codec none
+       (the serde itself) and at the configured shuffle codec; plus
+       the copy_count proof that the v2 fixed-width fetch->device
+       path performs ZERO decode copies.
+    2. exchange A/B — an exchange-heavy corpus query (q94n: two
+       hash exchanges whose map roots fuse) run serial-path with the
+       full data plane ON (v2 + pid fusion + pipelining) vs OFF,
+       interleaved in ONE process, results bit-identical.
+    """
+    import io as _io
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    import auron_tpu  # noqa: F401
+    import jax
+    from auron_tpu.columnar import serde
+    from auron_tpu.columnar.batch import Batch
+    from auron_tpu.config import conf
+    from auron_tpu.ir.schema import DataType, Field, Schema
+
+    n = 1 << 20
+    rng = np.random.default_rng(7)
+    schema = Schema((Field("k", DataType.int64()),
+                     Field("v", DataType.float64()),
+                     Field("d", DataType.int32()),
+                     Field("s", DataType.string())))
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array(rng.integers(0, 1 << 40, n)), pa.array(rng.random(n)),
+         pa.array(rng.integers(0, 100, n).astype(np.int32)),
+         pa.array([f"cat{i % 97:04d}" for i in range(n)])],
+        names=["k", "v", "d", "s"])
+    b = Batch.from_arrow(rb, schema=schema)
+    raw_bytes = b.mem_bytes()
+
+    def touch(x):
+        for c in x.columns:
+            if hasattr(c, "data") and hasattr(c.data, "block_until_ready"):
+                c.data.block_until_ready()
+
+    def v1_rt():
+        sink = _io.BytesIO()
+        serde.write_one_batch(b.to_arrow(), sink)
+        sink.seek(0)
+        out = [Batch.from_arrow(x, schema=schema)
+               if isinstance(x, pa.RecordBatch) else x
+               for x in serde.read_batches(sink)]
+        touch(out[0])
+
+    def v2_rt():
+        sink = _io.BytesIO()
+        sink.write(serde.encode_stream_header(schema))
+        serde.encode_batch_v2(b, out=sink)
+        sink.seek(0)
+        out = list(serde.read_batches(sink))
+        touch(out[0])
+
+    def best_ms(fn, iters=3):
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter_ns()
+            fn()
+            times.append((time.perf_counter_ns() - t0) / 1e6)
+        return min(times)
+
+    out: dict = {"rows": n, "batch_bytes": raw_bytes,
+                 "platform": jax.devices()[0].platform}
+    for codec in ("none", str(conf.get("auron.shuffle.compression.codec"))):
+        with conf.scoped({"auron.shuffle.compression.codec": codec}):
+            v1_rt(); v2_rt()   # warm (compiles nothing, primes allocs)
+            t1, t2 = best_ms(v1_rt), best_ms(v2_rt)
+        key = "none" if codec == "none" else "codec"
+        out[f"serde_v1_ms_{key}"] = round(t1, 1)
+        out[f"serde_v2_ms_{key}"] = round(t2, 1)
+        out[f"serde_speedup_v2_{key}"] = round(t1 / t2, 2)
+    out["shuffle_serde_mbps"] = round(
+        raw_bytes / (out["serde_v2_ms_none"] / 1e3) / (1 << 20))
+    out["shuffle_serde_mbps_v1"] = round(
+        raw_bytes / (out["serde_v1_ms_none"] / 1e3) / (1 << 20))
+    # the zero-decode-copy proof on the fetch->device path
+    sink = _io.BytesIO()
+    sink.write(serde.encode_stream_header(schema))
+    with conf.scoped({"auron.shuffle.compression.codec": "none"}):
+        serde.encode_batch_v2(b, out=sink)
+    sink.seek(0)
+    serde.reset_copy_count()
+    touch(list(serde.read_batches(sink))[0])
+    out["exchange_copy_count"] = serde.copy_count()
+    serde.reset_copy_count()
+
+    # exchange-heavy interleaved A/B (serial path = the exchange path)
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it import datagen, oracle, queries
+    catalog = datagen.generate(tempfile.mkdtemp(prefix="auron-serde-ab-"),
+                               sf=0.01)
+    OFF = {"auron.serde.format.version": 1,
+           "auron.shuffle.pid.fuse.enable": False,
+           "auron.shuffle.pipeline.depth": 1}
+    BASE = {"auron.spmd.singleDevice.enable": False}
+
+    def run_q(extra):
+        with conf.scoped({**BASE, **extra}):
+            sess = AuronSession(foreign_engine=oracle.PyArrowEngine())
+            t0 = time.perf_counter()
+            res = sess.execute(queries.build("q94n", catalog))
+            return time.perf_counter() - t0, res.table
+
+    run_q({}); run_q(OFF)     # warm both paths
+    on_t, off_t = [], []
+    identical = True
+    for _ in range(5):
+        dt_on, tab_on = run_q({})
+        dt_off, tab_off = run_q(OFF)
+        on_t.append(dt_on)
+        off_t.append(dt_off)
+        identical = identical and tab_on.equals(tab_off)
+    on_t.sort(); off_t.sort()
+    out["exchange_ab_query"] = "q94n"
+    out["exchange_ab_on_ms"] = round(on_t[len(on_t) // 2] * 1e3)
+    out["exchange_ab_off_ms"] = round(off_t[len(off_t) // 2] * 1e3)
+    out["exchange_ab_ratio"] = round(
+        off_t[len(off_t) // 2] / on_t[len(on_t) // 2], 3)
+    out["exchange_ab_identical"] = identical
+    from auron_tpu.runtime import counters
+    out["exchange_bytes_pushed"] = counters.get("shuffle_bytes_pushed")
+    out["exchange_bytes_fetched"] = counters.get("shuffle_bytes_fetched")
+    return out
+
+
 def _run_worker(mode: str, env_extra=None, timeout=WORKER_TIMEOUT_S
                 ) -> dict:
     env = dict(os.environ)
@@ -657,6 +792,18 @@ def _summarize(results: dict, baseline_rps: float,
             out["kernel_roofline"] = profile["roofline"]
             out["hbm_roofline_gbps"] = profile.get("hbm_roofline_gbps")
             out["device_kind"] = profile.get("device_kind")
+    sd = results.get("serde")
+    if sd is not None:
+        # the PR 14 data-plane numbers (BENCH_r06 reads the delta):
+        # v2-vs-v1 round-trip throughput, the zero-copy proof, and the
+        # interleaved exchange A/B with the whole plane on vs off
+        for k in ("shuffle_serde_mbps", "shuffle_serde_mbps_v1",
+                  "serde_speedup_v2_none", "serde_speedup_v2_codec",
+                  "exchange_copy_count", "exchange_ab_query",
+                  "exchange_ab_ratio", "exchange_ab_identical",
+                  "exchange_bytes_pushed", "exchange_bytes_fetched"):
+            if k in sd:
+                out[k] = sd[k]
     # top-level platform = whatever produced the HEADLINE metric
     headline = engine_any if engine_any is not None else fused
     if headline is not None:
@@ -750,7 +897,7 @@ def main() -> None:
     # worker (profile) wedged on a congested tunnel and the old policy
     # then forced CPU for everything after it.  The artifact's reason to
     # exist is an on-chip engine number — aux workers must never cost it.
-    order = ("engine", "spmd", "fused", "profile")
+    order = ("engine", "spmd", "fused", "profile", "serde")
     # single attempt: the probe IS the flake detector, a second try
     # would just re-burn its timeout on a wedged tunnel.  Fail FAST: a
     # wedged backend hangs in init, and every healthy probe in five
@@ -845,7 +992,7 @@ if __name__ == "__main__":
         mode = sys.argv[2]
         fn = {"engine": worker_engine, "fused": worker_fused,
               "profile": worker_profile, "spmd": worker_spmd,
-              "probe": worker_probe}[mode]
+              "probe": worker_probe, "serde": worker_serde}[mode]
         print(json.dumps(fn()))
     else:
         main()

@@ -1,0 +1,262 @@
+#!/usr/bin/env bash
+# CI data-plane gate (CPU, no accelerator needed) — PR 14's promotion
+# of tools/rss_check.sh to the zero-copy exchange plane:
+#   1. spawn a 2-executor fleet WITH the durable-shuffle side-car,
+#      wire format v2 + pid fusion + push/fetch PIPELINING all ON
+#      (auron.shuffle.pipeline.depth=4 — the defaults, pinned so the
+#      gate cannot silently hollow out)
+#   2. POST six concurrent /submit requests (IT-corpus queries)
+#   3. kill -9 the busiest executor MID-STREAM (>= 1 of its queries'
+#      stages committed+sealed on the side-car, pushes in flight)
+#   4. assert the requeued queries RESUME (stage-skip counters, flat
+#      side-car commit totals), EVERY query succeeds value-identical
+#      to its solo fault-free run, zero task-retry budget consumed,
+#      the STREAMED Arrow result (?format=arrow, chunked IPC) decodes
+#      byte-for-byte to the same rows the JSON representation serves,
+#      and the new exchange byte counters are visible on /metrics
+#      (auron_fleet_worker_shuffle_bytes_pushed/fetched_total — the
+#      workers push, so the driver sees them via heartbeat counter
+#      aggregation).
+#
+# The same check runs inside the suite (tests/test_dataplane.py::
+# test_tools_dataplane_check_script, marked slow), mirroring how
+# rss_check.sh / fleet_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source tools/prom_assert.sh
+PROM_OUT="$(mktemp)"
+export PROM_OUT
+trap 'rm -f "$PROM_OUT"' EXIT
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pyarrow as pa
+
+from auron_tpu import faults
+from auron_tpu.config import conf
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.it import datagen, queries
+from auron_tpu.it.oracle import PyArrowEngine
+from auron_tpu.memmgr.manager import reset_manager
+from auron_tpu.runtime import counters
+from auron_tpu.serving import FleetManager, QueryServer, register_catalog
+
+SF = 0.002
+NAMES = ["q01", "q42", "q01", "q42", "q01", "q42"]
+
+catalog = datagen.generate(
+    tempfile.mkdtemp(prefix="auron-dataplane-check-"), sf=SF)
+register_catalog(SF, catalog)
+
+
+def canon(t):
+    t = t.combine_chunks()
+    return t.sort_by([(n, "ascending") for n in t.column_names]) \
+        if t.num_rows and t.num_columns else t
+
+
+serial = {"auron.spmd.singleDevice.enable": False}
+baselines = {}
+with conf.scoped(serial):
+    for name in set(NAMES):
+        s = AuronSession(foreign_engine=PyArrowEngine())
+        baselines[name] = canon(s.execute(queries.build(name, catalog)).table)
+
+# the data plane pinned ON (they are the defaults — pinning keeps the
+# gate honest if a default ever flips): v2 wire format, pid fusion,
+# pipelined push/fetch.  Worker chaos latency-only: the zero-retries
+# assertion covers every worker, and pipelined pushes must overlap the
+# injected delays without reordering anything.
+worker_conf = {**serial,
+               "auron.serde.format.version": 2,
+               "auron.shuffle.pid.fuse.enable": True,
+               "auron.shuffle.pipeline.depth": 4,
+               "auron.faults.spec":
+                   "op.execute:latency:p=0.5,ms=150,max=60,seed=11;"
+                   "rss.push:latency:p=0.2,ms=3,max=40,seed=5",
+               "auron.task.retries": 2,
+               "auron.retry.backoff.base.ms": 1.0,
+               "auron.retry.backoff.max.ms": 10.0,
+               "auron.serving.preempt.watermark": 0.0,
+               "auron.serving.max.concurrent": 4}
+hb = 1.5
+scope = {"auron.retry.backoff.base.ms": 1.0,
+         "auron.retry.backoff.max.ms": 10.0,
+         "auron.net.timeout.seconds": 10.0,
+         "auron.fleet.heartbeat.seconds": hb,
+         "auron.fleet.death.probes": 3,
+         "auron.admission.default.forecast.bytes": 1 << 20,
+         "auron.serving.max.concurrent": 4}
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.load(r)
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=300) as r:
+        return r.read()
+
+
+t_retried0 = counters.get("tasks_retried")
+with conf.scoped(scope):
+    reset_manager(1 << 30)
+    fleet = FleetManager.spawn(2, conf_map=worker_conf,
+                               budget_bytes=1 << 29, rss_sidecar=True)
+    control = fleet._sidecar.control
+    srv = QueryServer(scheduler=fleet).start()
+    try:
+        qids = {}
+        errs = []
+
+        def submit(i, name):
+            try:
+                doc = post(srv.url + "/submit",
+                           {"corpus": name, "sf": SF,
+                            "priority": 1 + (i % 3)})
+                qids[i] = (name, doc["query_id"])
+            except Exception as e:   # noqa: BLE001
+                errs.append((name, repr(e)))
+
+        threads = [threading.Thread(target=submit, args=(i, n))
+                   for i, n in enumerate(NAMES)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert len(qids) == len(NAMES)
+
+        # kill -9 the busiest executor once one of its in-flight
+        # queries has a committed+sealed stage on the side-car — the
+        # pipelined pushes of its OTHER stages are mid-stream
+        victim = survivor = None
+        resumed_qid = sealed_sid = None
+        commits_before = None
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            snap = fleet.fleet_snapshot()
+            busy = sorted(snap.items(), key=lambda kv: -kv[1]["inflight"])
+            eid, doc = busy[0]
+            if doc["inflight"] >= 2 and doc["load"].get("running", 0) >= 1:
+                inflight = [q for _, q in qids.values()
+                            if fleet.get(q).executor_id == eid
+                            and not fleet.get(q).done.is_set()]
+                stats = control.stats()
+                for q in inflight:
+                    for sid, sdoc in stats["shuffles"].items():
+                        if sid.startswith(f"{q}|") and \
+                                sdoc["sealed"] is not None and \
+                                sdoc["maps"] >= sdoc["sealed"]:
+                            victim, survivor = eid, busy[1][0]
+                            resumed_qid, sealed_sid = q, sid
+                            commits_before = \
+                                stats["totals"][sid]["commits"]
+                            break
+                    if victim:
+                        break
+            if victim:
+                break
+            time.sleep(0.1)
+        assert victim is not None, (fleet.fleet_snapshot(),
+                                    control.stats())
+        victim_qids = [q for _, q in qids.values()
+                       if fleet.get(q).executor_id == victim
+                       and not fleet.get(q).done.is_set()]
+        os.kill(fleet._handles[victim].endpoint.pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+
+        detect_s = None
+        while time.monotonic() - t_kill < 30:
+            if fleet.fleet_snapshot()[victim]["state"] == "dead":
+                detect_s = time.monotonic() - t_kill
+                break
+            time.sleep(0.05)
+        assert detect_s is not None, "death never declared"
+
+        for i, (name, qid) in sorted(qids.items()):
+            assert fleet.wait(qid, timeout=600), \
+                f"{name} did not finish: {fleet.status(qid)}"
+            st = json.loads(get(srv.url + f"/status/{qid}"))
+            assert st["state"] == "succeeded", (name, st)
+            res = json.loads(get(srv.url + f"/result/{qid}"))
+            assert not res["truncated"]
+            got = canon(pa.Table.from_pylist(
+                res["rows"], schema=baselines[name].schema))
+            assert got.equals(baselines[name]), \
+                f"{name} served result diverged from its solo run"
+            # the STREAMED Arrow result decodes to the same rows the
+            # JSON representation serves (chunked IPC, no row cap)
+            raw = get(srv.url + f"/result/{qid}?format=arrow")
+            streamed = pa.ipc.open_stream(pa.py_buffer(raw)).read_all()
+            assert streamed.num_rows == res["num_rows"]
+            assert streamed.to_pylist() == res["rows"], \
+                f"{name} streamed-Arrow rows != JSON rows"
+
+        requeued = [q for q in victim_qids
+                    if fleet.status(q)["requeues"] >= 1]
+        assert requeued, "the killed executor's queries never requeued"
+
+        prom = get(srv.url + "/metrics").decode()
+        with open(os.environ["PROM_OUT"], "w") as f:
+            f.write(prom)
+        post_stats = control.stats(prefix=f"{resumed_qid}|")
+        assert post_stats["totals"][sealed_sid]["commits"] == \
+            commits_before, "map tasks re-ran for the sealed stage"
+
+        # side-car ledger cleaned at terminal states
+        for _, qid in qids.values():
+            assert not control.stats(prefix=f"{qid}|")["shuffles"], qid
+
+        # zero retry budget consumed: driver + every worker
+        wt = fleet.fleet_counter_totals()
+        assert counters.get("tasks_retried") - t_retried0 == 0
+        assert wt.get("tasks_retried", 0) == 0
+        assert wt.get("shuffle_bytes_pushed", 0) > 0, \
+            "workers reported no pushed exchange bytes"
+        assert wt.get("shuffle_bytes_fetched", 0) > 0, \
+            "workers reported no fetched exchange bytes"
+        assert fleet.admission.held_bytes() == 0
+        print(f"dataplane_check: {len(NAMES)}/{len(NAMES)} queries "
+              f"value-identical to solo runs with v2+pidfuse+pipeline "
+              f"on; executor {victim} killed -9 mid-stream, "
+              f"{len(requeued)} query(ies) requeued and RESUMED "
+              f"(sealed stage commit total flat at {commits_before}; "
+              f"death detected {detect_s:.1f}s after kill); streamed "
+              f"Arrow results row-equal to JSON; workers pushed "
+              f"{wt.get('shuffle_bytes_pushed', 0)}B / fetched "
+              f"{wt.get('shuffle_bytes_fetched', 0)}B")
+    finally:
+        procs = [h.endpoint.proc for h in fleet._handles.values()
+                 if getattr(h.endpoint, "proc", None) is not None]
+        sc = fleet._sidecar.proc
+        srv.stop()
+        for p in procs:
+            assert p.poll() is not None, "worker process leaked"
+        assert sc.proc.poll() is not None, "side-car process leaked"
+        reset_manager()
+        faults.reset()
+EOF
+
+prom_assert_contains "$PROM_OUT" \
+  "auron_fleet_worker_shuffle_bytes_pushed_total" \
+  "auron_fleet_worker_shuffle_bytes_fetched_total" \
+  "auron_fleet_worker_rss_stage_skips_total" \
+  "auron_shuffle_bytes_pushed_total" \
+  "auron_rss_sidecar_up 1"
+prom_assert_ge "$PROM_OUT" auron_fleet_worker_shuffle_bytes_pushed_total 1
+prom_assert_ge "$PROM_OUT" auron_fleet_worker_shuffle_bytes_fetched_total 1
+prom_assert_ge "$PROM_OUT" auron_fleet_worker_rss_stage_skips_total 1
+
+echo "dataplane_check.sh: ok"
