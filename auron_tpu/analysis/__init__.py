@@ -25,6 +25,9 @@ from typing import Dict, Optional
 from auron_tpu.analysis.diagnostics import (  # noqa: F401 - public API
     AnalysisResult, Diagnostic, DiagnosticSink, PlanVerificationError,
 )
+from auron_tpu.analysis.adaptive import (  # noqa: F401 - public API
+    AdaptiveContractPass,
+)
 from auron_tpu.analysis.passes import (  # noqa: F401 - public API
     ColumnResolutionPass, FusionContractPass, PartitioningContractsPass,
     Pass, PassManager, SchemaCheckPass, SerdeRoundTripPass, TpuLintPass,

@@ -1,0 +1,76 @@
+"""Adaptive-execution contract pass.
+
+Every plan the AQE replanner rewrites (runtime/adaptive.py) is re-run
+through the FULL analyzer battery before execution; this pass adds the
+checks specific to the shapes those rewrites produce — and, running in
+the default battery, it also guards hand-built or converted plans that
+use the same nodes:
+
+- a BroadcastJoin carrying `cached_build_hash_map_id` must find a
+  BroadcastJoinBuildHashMap with the SAME cache id on its broadcast
+  side (a mismatched id would silently build an empty probe table from
+  whatever the stale cache holds);
+- a BroadcastJoinBuildHashMap's keys must be non-empty when its parent
+  join has join keys (an AQE conversion that dropped the build keys
+  would hash every row into one bucket);
+- the broadcast side of a BroadcastJoin must be a join type whose
+  BUILD side never emits unmatched rows when the build table is shared
+  across probe partitions (build-side outer under a shared table would
+  duplicate unmatched rows once per partition) — the same legality rule
+  the replanner enforces, verified rather than trusted.
+"""
+
+from __future__ import annotations
+
+from auron_tpu.analysis.diagnostics import DiagnosticSink
+from auron_tpu.analysis.passes import Pass
+from auron_tpu.analysis.schema_infer import SchemaContext
+from auron_tpu.ir import plan as P
+
+# mirror of runtime/adaptive._BCAST_SAFE_TYPES (duplicated here so the
+# analyzer stays importable without the jax-adjacent runtime module)
+_BCAST_SAFE_TYPES = {
+    "right": {"inner", "left", "left_semi", "left_anti", "existence"},
+    "left": {"inner", "right", "right_semi", "right_anti"},
+}
+
+
+class AdaptiveContractPass(Pass):
+    id = "adaptive"
+
+    def run(self, ctx: SchemaContext, sink: DiagnosticSink) -> None:
+        for node, path in ctx.nodes():
+            if not isinstance(node, P.BroadcastJoin):
+                continue
+            side = node.broadcast_side
+            build = node.right if side == "right" else node.left
+            if isinstance(build, P.BroadcastJoinBuildHashMap):
+                if node.cached_build_hash_map_id and \
+                        build.cache_id != node.cached_build_hash_map_id:
+                    sink.error(
+                        self.id, path, node,
+                        "BroadcastJoin cache id "
+                        f"{node.cached_build_hash_map_id!r} does not "
+                        f"match its build node's {build.cache_id!r}",
+                        hint="the probe would read a stale or empty "
+                             "cached build table; rewrites must mint "
+                             "one id for both nodes")
+                keys = node.on.right_keys if side == "right" \
+                    else node.on.left_keys
+                if keys and not build.keys:
+                    sink.error(
+                        self.id, path, node,
+                        "broadcast build node carries no build keys "
+                        "while the join has join keys",
+                        hint="an AQE conversion must copy the build "
+                             "side's join keys onto the "
+                             "BroadcastJoinBuildHashMap")
+            if node.join_type not in _BCAST_SAFE_TYPES.get(side, ()):
+                sink.error(
+                    self.id, path, node,
+                    f"join type {node.join_type!r} cannot broadcast "
+                    f"its {side} side: the shared build table would "
+                    "emit build-side unmatched rows once per probe "
+                    "partition",
+                    hint="keep the shuffled form (runtime/adaptive.py "
+                         "_BCAST_SAFE_TYPES is the legality rule)")

@@ -648,9 +648,11 @@ class SerdeRoundTripPass(Pass):
 # ---------------------------------------------------------------------------
 
 def default_passes() -> List[Pass]:
+    # local import: the adaptive pass module imports Pass from here
+    from auron_tpu.analysis.adaptive import AdaptiveContractPass
     return [SchemaCheckPass(), ColumnResolutionPass(),
             PartitioningContractsPass(), FusionContractPass(),
-            TpuLintPass(), SerdeRoundTripPass()]
+            AdaptiveContractPass(), TpuLintPass(), SerdeRoundTripPass()]
 
 
 class PassManager:

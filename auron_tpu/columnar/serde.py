@@ -134,6 +134,21 @@ def _resolve_codec(codec: Optional[str]) -> str:
     return codec
 
 
+def exchange_codec(transport: str) -> Optional[str]:
+    """Per-transport exchange codec policy: frames pushed through a
+    LOCAL transport (the in-process shuffle service, broadcast
+    collects) never leave the process — compressing them only to
+    decompress in the same address space burns CPU for nothing, so
+    `auron.shuffle.codec.local` defaults to `none`.  Remote transports
+    (celeborn / uniffle / durable side-car) pay real wire bandwidth and
+    use `auron.shuffle.codec.remote` (empty = the default codec).
+    Frames stay self-describing, so readers decode any mix."""
+    key = "auron.shuffle.codec.local" if transport == "local" \
+        else "auron.shuffle.codec.remote"
+    c = str(conf.get(key) or "")
+    return c or None
+
+
 # ---------------------------------------------------------------------------
 # v1: arrow-IPC frames
 # ---------------------------------------------------------------------------

@@ -330,6 +330,100 @@ SHUFFLE_PID_FUSE = conf.define(
     "capable; host-column batches fall back to the standalone "
     "computer per batch (bit-identical either way)."
 )
+SHUFFLE_CODEC_LOCAL = conf.define(
+    "auron.shuffle.codec.local", "none",
+    "Codec for exchange frames pushed through a LOCAL transport (the "
+    "in-process shuffle service): the bytes never leave the process, "
+    "so compressing them only to decompress in the same address space "
+    "burns CPU for nothing — `none` (default) is free bandwidth.  "
+    "Empty falls back to auron.shuffle.compression.codec.  Frames are "
+    "self-describing, so readers decode any mix."
+)
+SHUFFLE_CODEC_REMOTE = conf.define(
+    "auron.shuffle.codec.remote", "",
+    "Codec for exchange frames pushed to a REMOTE shuffle transport "
+    "(celeborn / uniffle / durable side-car), where wire bandwidth is "
+    "real.  Empty (default) falls back to "
+    "auron.shuffle.compression.codec."
+)
+ADAPTIVE_ENABLE = conf.define(
+    "auron.adaptive.enable", False,
+    "Adaptive query execution (runtime/adaptive.py): at each stage "
+    "boundary of the serial exchange path the driver observes the map "
+    "side's REAL per-partition output sizes and re-plans the "
+    "not-yet-executed remainder — broadcast-vs-shuffle join "
+    "conversion, reduce partition coalescing, skew splitting — with "
+    "every rewritten plan re-verified by the static analyzer before "
+    "execution and every decision surfaced on SessionResult."
+    "aqe_decisions, /queries/<id> and EXPLAIN ANALYZE.  Results are "
+    "value-identical with the feature on or off."
+)
+ADAPTIVE_BROADCAST_ENABLE = conf.define(
+    "auron.adaptive.broadcast.enable", True,
+    "Allow the broadcast-vs-shuffle join conversion when "
+    "auron.adaptive.enable is on."
+)
+ADAPTIVE_COALESCE_ENABLE = conf.define(
+    "auron.adaptive.coalesce.enable", True,
+    "Allow reduce partition coalescing when auron.adaptive.enable is "
+    "on."
+)
+ADAPTIVE_SKEW_ENABLE = conf.define(
+    "auron.adaptive.skew.enable", True,
+    "Allow skew splitting when auron.adaptive.enable is on."
+)
+ADAPTIVE_BROADCAST_THRESHOLD = conf.define(
+    "auron.adaptive.broadcast.threshold.bytes", 1 << 20,
+    "Broadcast conversion fires when an exchange's TOTAL observed map "
+    "output (wire bytes) lands at or under this and the exchange "
+    "feeds the build side of a shuffled hash join with a "
+    "conversion-safe join type.  The committed map side is reused — "
+    "conversion replaces only the partition-indexed fetch plan with "
+    "one collect."
+)
+ADAPTIVE_TARGET_PARTITION_BYTES = conf.define(
+    "auron.adaptive.target.partition.bytes", 1 << 20,
+    "Coalescing merges ADJACENT reduce partitions toward this many "
+    "observed wire bytes per merged partition (and skew splitting "
+    "sizes its fan-out toward it): fewer reduce tasks, fewer jit "
+    "signatures.  Co-partitioned exchanges of one stage receive the "
+    "same grouping so join key alignment survives."
+)
+ADAPTIVE_SKEW_FACTOR = conf.define(
+    "auron.adaptive.skew.factor", 4.0,
+    "A reduce partition is skewed when it holds more than this factor "
+    "times the median partition's observed bytes (and more than "
+    "auron.adaptive.skew.min.partition.bytes).  The skewed partition "
+    "fans out across extra tasks over contiguous block runs with an "
+    "order-preserving concat; only row-local consumers qualify."
+)
+ADAPTIVE_SKEW_MIN_BYTES = conf.define(
+    "auron.adaptive.skew.min.partition.bytes", 4 << 20,
+    "Skew splitting floor: partitions under this many observed bytes "
+    "are never split regardless of the ratio (the fan-out's task "
+    "overhead would exceed the imbalance)."
+)
+ADAPTIVE_FUSE_ADJACENCY = conf.define(
+    "auron.adaptive.fuse.adjacency.enable", False,
+    "Conversion-side projection/filter adjacency (the PR 3 "
+    "follow-up): keep a scan's pushed-down filter ALSO as an explicit "
+    "Filter node above the scan when the unified cost model says the "
+    "re-evaluation is cheaper than the fusion it unlocks (pushdown "
+    "otherwise hides filter/projection chains from the fuser).  "
+    "Chosen by cost per SystemML's fusion-plan exemplar, not "
+    "greedily; value-identical either way (the scan predicate still "
+    "prunes IO)."
+)
+ADAPTIVE_REFORECAST = conf.define(
+    "auron.adaptive.reforecast.enable", True,
+    "Release admission reservation at stage boundaries: when adaptive "
+    "execution observes an exchange's real size, the scheduler-"
+    "registered hook re-forecasts the RUNNING query's reservation "
+    "through AdmissionController.reforecast (the same path heartbeat "
+    "telemetry feeds), so a query that turns out light lets the "
+    "admission queue drain sooner.  Requires "
+    "auron.admission.reforecast.enable."
+)
 TASK_RETRIES = conf.define(
     "auron.task.retries", 0,
     "Per-partition task retry count above the runtime (the Spark "

@@ -205,7 +205,23 @@ def _scan(node: ForeignNode, children, ctx: ConvertContext) -> P.PlanNode:
                          predicate=predicate)
     else:
         raise NotConvertible(f"scan format {fmt}")
-    return ctx.set_parts(plan, len(groups))
+    ctx.set_parts(plan, len(groups))
+    if predicate is not None and \
+            config.conf.get("auron.adaptive.fuse.adjacency.enable"):
+        # the PR 3 follow-up: pushdown hides filter/projection chains
+        # from the fuser (the scan predicate swallows the filter).  When
+        # the unified cost model says re-evaluating the pushed filter is
+        # cheaper than the fusion it unlocks, keep it ALSO as an
+        # explicit Filter node above the scan — the scan predicate still
+        # prunes IO, the filter re-applies device-side (idempotent, so
+        # value-identical), and the fuser sees an adjacent chain.
+        # Chosen by cost (SystemML's fusion-plan exemplar), not greedily.
+        preds = tuple(EC.convert_expr(p) for p in pushed)
+        from auron_tpu.runtime.adaptive import unified_cost_model
+        if unified_cost_model().filter_adjacency_pays(preds, schema):
+            plan = ctx.set_parts(
+                P.Filter(child=plan, predicates=preds), len(groups))
+    return plan
 
 
 @_plan("LocalTableScanExec")

@@ -67,6 +67,14 @@ class SessionResult:
     query_id: Optional[str] = None
     wall_s: float = 0.0
     trace: Optional[object] = None   # runtime.tracing.TraceRecorder
+    # adaptive execution (runtime/adaptive.py): structured replan
+    # decisions and the observed per-exchange size histograms that
+    # drove them — the audit trail /queries/<id> and EXPLAIN ANALYZE
+    # surface.  exchange_stats is populated whenever the serial
+    # exchange path runs (observation is free); aqe_decisions only
+    # when auron.adaptive.enable made replanning act on them.
+    aqe_decisions: List[dict] = field(default_factory=list)
+    exchange_stats: List[dict] = field(default_factory=list)
 
     def to_pylist(self) -> List[dict]:
         return self.table.to_pylist()
@@ -84,6 +92,7 @@ class SessionResult:
                    spmd=self.spmd,
                    retries=totals.get("num_retries", 0),
                    fallbacks=totals.get("num_fallbacks", 0),
+                   aqe=self.aqe_decisions,
                    normalize=normalize)
 
     def all_native(self) -> bool:
@@ -124,6 +133,13 @@ class AuronSession:
         self._exchange_local: set = set()
         self._rss_degraded = False
         self._stream_root: Optional[int] = None
+        # adaptive execution (runtime/adaptive.py): per-query replan
+        # decisions + observed exchange histograms, and the wall-clock
+        # start the stage-boundary re-forecast ages against
+        self._aqe_decisions: List[dict] = []
+        self._exchange_stats: List[dict] = []
+        self._plan_signature: str = ""
+        self._wall_start: float = 0.0
 
     # -- public entry (preColumnarTransitions analogue) -------------------
 
@@ -157,6 +173,7 @@ class AuronSession:
         counters.bump("queries_started")
         t0 = time.perf_counter()
         wall_start = time.time()
+        self._wall_start = wall_start
         res: Optional[SessionResult] = None
         error: Optional[str] = None
         try:
@@ -197,6 +214,8 @@ class AuronSession:
                 metric_trees=[{"tasks": n, "tree": t.to_dict()}
                               for t, n in merge_metric_trees(trees)],
                 timeline=timeline,
+                aqe_decisions=list(self._aqe_decisions) or None,
+                exchange_stats=list(self._exchange_stats) or None,
                 trace=scope.recorder.to_chrome_trace()
                 if scope.recorder is not None else None))
         counters.bump("queries_completed")
@@ -221,6 +240,17 @@ class AuronSession:
         self._spmd_rejection = None
         self._exchange_sids = {}
         self._exchange_local = set()
+        self._aqe_decisions = []
+        self._exchange_stats = []
+        self._plan_signature = ""
+        if config.ADAPTIVE_ENABLE.get():
+            # the unified cost model keys its live exchange history by
+            # plan signature (serving/forecast.py) — computed once here
+            from auron_tpu.serving.forecast import plan_signature
+            try:
+                self._plan_signature = plan_signature(plan)
+            except Exception:
+                self._plan_signature = ""
         # result streaming (runtime/result_stream.py): only the ROOT
         # native plan's partitions are the query result — exchange map
         # sides and broadcast subtrees run through the same _run_native
@@ -276,7 +306,9 @@ class AuronSession:
                 self._clear_exchange(rid)
         res = SessionResult(table=table, converted=converted, tags=tags,
                             metrics=self._metrics, ctx=ctx,
-                            spmd_rejection=self._spmd_rejection)
+                            spmd_rejection=self._spmd_rejection,
+                            aqe_decisions=list(self._aqe_decisions),
+                            exchange_stats=list(self._exchange_stats))
         # count foreign sections that needed the host engine (local-table
         # sources are data, not computation)
         res._foreign_sections = sum(  # type: ignore[attr-defined]
@@ -310,11 +342,16 @@ class AuronSession:
 
     def _run_native(self, plan: P.PlanNode, ctx: ConvertContext) -> pa.Table:
         from auron_tpu.runtime import result_stream, tracing
-        resources = self._materialize_deps(plan, ctx)
+        # stream-root identity is checked BEFORE dependency
+        # materialization: with adaptive execution the stage-boundary
+        # replan may return a REWRITTEN plan object
+        is_stream_root = self._stream_root is not None and \
+            id(plan) == self._stream_root
+        resources, plan = self._materialize_deps(plan, ctx)
         n_parts = ctx.parts(plan)
         batches: List[pa.RecordBatch] = []
         stream_qid = None
-        if self._stream_root is not None and id(plan) == self._stream_root:
+        if is_stream_root:
             qid = tracing.current_query_id()
             if result_stream.active(qid):
                 stream_qid = qid
@@ -367,13 +404,26 @@ class AuronSession:
                 self._collect_rids(c, rids)
 
     def _materialize_deps(self, plan: P.PlanNode, ctx: ConvertContext
-                          ) -> ResourceRegistry:
+                          ) -> "tuple[ResourceRegistry, P.PlanNode]":
+        """Materialize every dependency of `plan` and return
+        (resources, plan).  With `auron.adaptive.enable` off the plan
+        comes back unchanged and the materialization order is exactly
+        the legacy one (the chaos fault-draw sequences depend on it);
+        with it on, every exchange's MAP side completes first, then the
+        stage-boundary replanner (runtime/adaptive.py) may rewrite the
+        consumer before the reduce-side fetch resources register."""
+        from auron_tpu.runtime import adaptive
         resources = ResourceRegistry()
         rids: List[str] = []
         self._collect_rids(plan, rids)
         # a subtree may be referenced from several places (e.g. a union's
         # flattened partition mapping repeats the child) — materialize once
-        for rid in dict.fromkeys(rids):
+        unique = list(dict.fromkeys(rids))
+        if adaptive.enabled() and \
+                any(rid in ctx.exchanges for rid in unique):
+            return self._materialize_deps_adaptive(plan, ctx, resources,
+                                                   unique)
+        for rid in unique:
             if rid in ctx.sources:
                 self._materialize_source(ctx.sources[rid], ctx, resources)
             elif rid in ctx.broadcasts:
@@ -382,7 +432,163 @@ class AuronSession:
             elif rid in ctx.exchanges:
                 self._materialize_exchange(ctx.exchanges[rid], ctx,
                                            resources)
-        return resources
+        return resources, plan
+
+    # -- the adaptive stage boundary (runtime/adaptive.py) ----------------
+
+    def _materialize_deps_adaptive(self, plan: P.PlanNode,
+                                   ctx: ConvertContext,
+                                   resources: ResourceRegistry,
+                                   rids: List[str]
+                                   ) -> "tuple[ResourceRegistry, P.PlanNode]":
+        """Run every exchange's map side, observe the REAL per-partition
+        output sizes, re-plan the consumer, then register reduce-side
+        resources per decision (partitioned / broadcast collect /
+        coalesced groups / skew fan-out)."""
+        import time as _time
+
+        from auron_tpu.runtime import adaptive, tracing
+        pending: Dict[str, dict] = {}
+        for rid in rids:
+            if rid in ctx.sources:
+                self._materialize_source(ctx.sources[rid], ctx, resources)
+            elif rid in ctx.broadcasts:
+                self._materialize_broadcast(ctx.broadcasts[rid], ctx,
+                                            resources)
+            elif rid in ctx.exchanges:
+                pending[rid] = self._adaptive_map_side(
+                    ctx.exchanges[rid], ctx)
+        stats = {rid: p["stats"] for rid, p in pending.items()
+                 if p.get("stats") is not None}
+        with tracing.span("aqe.replan", cat="plan",
+                          exchanges=len(stats)):
+            plan, decisions, actions = adaptive.replan(plan, ctx, stats)
+        for d in decisions:
+            doc = d.to_dict()
+            self._aqe_decisions.append(doc)
+            tracing.event("aqe.decision", cat="plan", **doc)
+            log.info("aqe: %s %s: %s", d.kind, d.exchange, d.reason)
+        for rid, pend in pending.items():
+            self._adaptive_fetch(ctx.exchanges[rid], ctx, resources,
+                                 pend, actions.get(rid), plan)
+        if stats and config.conf.get("auron.adaptive.reforecast.enable"):
+            # close the admission loop: re-forecast the running query's
+            # reservation from bytes actually observed, so a light
+            # query releases early (serving/admission.reforecast via
+            # the scheduler-registered hook)
+            qid = tracing.current_query_id()
+            est = adaptive.stage_mem_estimate(qid, stats.values())
+            age = _time.time() - self._wall_start \
+                if self._wall_start else 0.0
+            new_res = adaptive.stage_boundary_reforecast(qid, est, age)
+            if new_res is not None:
+                tracing.event("aqe.reforecast", cat="plan",
+                              reservation=new_res, estimate=est)
+        return resources, plan
+
+    def _adaptive_map_side(self, job: ShuffleJob,
+                           ctx: ConvertContext) -> dict:
+        """Run ONE exchange's map side (durable commit protocol or
+        plain transport) without fetching, returning the observed
+        stats and everything the later fetch needs."""
+        from auron_tpu.shuffle_rss.durable import (
+            DurableShuffleClient, RssUnavailable,
+        )
+        n_reduce = job.partitioning.num_partitions
+        if isinstance(self.shuffle_service, DurableShuffleClient) \
+                and not self._rss_degraded:
+            try:
+                sid, man, stats = self._durable_map_side(job, ctx)
+                self._observe_exchange(job, stats)
+                return {"mode": "durable", "sid": sid, "man": man,
+                        "stats": stats, "n_reduce": n_reduce}
+            except RssUnavailable as e:
+                self._note_rss_degrade(job.rid, e)
+        service = self._exchange_service(job.rid)
+        stats = self._plain_map_side(job, ctx, service)
+        self._observe_exchange(job, stats)
+        return {"mode": "plain", "service": service, "stats": stats,
+                "n_reduce": n_reduce}
+
+    def _adaptive_fetch(self, job: ShuffleJob, ctx: ConvertContext,
+                        resources: ResourceRegistry, pend: dict,
+                        action, plan: P.PlanNode) -> None:
+        """Fetch one exchange's reduce side and register it per the
+        replan decision.  The partition count of the (possibly
+        rewritten) consumer is refined here when a skew split lands
+        fewer parts than planned (block granularity)."""
+        from auron_tpu.runtime import adaptive, tracing
+        from auron_tpu.shuffle_rss.durable import RssUnavailable
+        rid = job.rid
+        n_reduce = pend["n_reduce"]
+        with tracing.span("shuffle.fetch", cat="shuffle", rid=rid,
+                          parts=n_reduce):
+            if pend["mode"] == "durable":
+                try:
+                    blocks = self._durable_fetch_checked(
+                        job, ctx, pend["sid"], pend["man"], n_reduce)
+                except RssUnavailable as e:
+                    # mirror the legacy degrade tier: the side-car died
+                    # between commit and fetch — recompute this
+                    # exchange executor-locally (results identical)
+                    self._note_rss_degrade(rid, e)
+                    service = self._exchange_service(rid)
+                    self._plain_map_side(job, ctx, service)
+                    blocks = self._plain_fetch(job, service, n_reduce)
+            else:
+                blocks = self._plain_fetch(job, pend["service"],
+                                           n_reduce)
+        if action is None:
+            resources.put(rid, PartitionedBlocks(blocks))
+            return
+        if action.kind == "broadcast":
+            # the collected form: ONE chained block stream every probe
+            # task shares (the build hash map is built once and cached)
+            resources.put(rid, [b for part in blocks for b in part])
+        elif action.kind == "coalesce":
+            merged = adaptive.merge_partition_groups(blocks,
+                                                     action.groups)
+            resources.put(rid, PartitionedBlocks(merged))
+            ctx.set_parts(plan, len(merged))
+        elif action.kind == "skew_split":
+            out = adaptive.split_skewed_partition(
+                blocks, action.split_pid, action.split_parts)
+            resources.put(rid, PartitionedBlocks(out))
+            ctx.set_parts(plan, len(out))
+            if len(out) == n_reduce:
+                log.info("aqe: skew split of %s collapsed (partition "
+                         "has a single block run)", rid)
+
+    def _note_rss_degrade(self, rid: str, err: Exception) -> None:
+        """Shared degrade bookkeeping (sticky flag + counter + trace
+        event + one log line) for the durable->local fallback."""
+        from auron_tpu.runtime import counters, tracing
+        self._rss_degraded = True
+        counters.bump("rss_degrades")
+        tracing.event("rss.degrade", cat="shuffle", rid=rid,
+                      error=str(err))
+        log.warning(
+            "durable shuffle degraded to executor-local for this "
+            "query (rid %s): %s", rid, err)
+
+    def _observe_exchange(self, job: ShuffleJob, stats) -> None:
+        """Surface one exchange's observed output: the session list
+        (-> SessionResult / QueryRecord / bench JSON), a metric-tree
+        marker node (-> EXPLAIN ANALYZE; byte values are canonical-
+        volatile), and the unified cost model's live history."""
+        self._exchange_stats.append(stats.to_dict())
+        mn = MetricNode(f"ExchangeStats[{stats.ordinal()}]")
+        mn.add("partitions", stats.num_partitions)
+        mn.add("rows_out", stats.total_rows)
+        mn.add("bytes_out", stats.total_bytes)
+        if stats.partition_bytes:
+            mn.add("part_bytes_max", max(stats.partition_bytes))
+            mn.add("part_bytes_min", min(stats.partition_bytes))
+        self._metrics.append(mn)
+        if self._plan_signature:
+            from auron_tpu.runtime.adaptive import unified_cost_model
+            unified_cost_model().record_exchange(self._plan_signature,
+                                                 stats)
 
     def _source_table(self, src: ForeignSource,
                       ctx: ConvertContext) -> pa.Table:
@@ -416,9 +622,12 @@ class AuronSession:
                           rid=job.rid):
             table = self._run_converted(job.child, ctx)
             sink = io.BytesIO()
+            # broadcast bytes never leave the process: the local
+            # exchange codec policy applies (none by default)
+            codec = batch_serde.exchange_codec("local")
             for rb in table.to_batches():
                 if rb.num_rows:
-                    batch_serde.write_one_batch(rb, sink)
+                    batch_serde.write_one_batch(rb, sink, codec=codec)
             resources.put(job.rid, sink.getvalue())
 
     def _materialize_exchange(self, job: ShuffleJob, ctx: ConvertContext,
@@ -435,7 +644,6 @@ class AuronSession:
         )
         if isinstance(self.shuffle_service, DurableShuffleClient) \
                 and not self._rss_degraded:
-            from auron_tpu.runtime import counters, tracing
             try:
                 self._materialize_exchange_durable(job, ctx, resources)
                 return
@@ -445,13 +653,7 @@ class AuronSession:
                 # results stay bit-identical, and the diagnostic is
                 # structured (counter + trace event + one log line),
                 # never a hang (every RPC rode bounded retries)
-                self._rss_degraded = True
-                counters.bump("rss_degrades")
-                tracing.event("rss.degrade", cat="shuffle",
-                              rid=job.rid, error=str(e))
-                log.warning(
-                    "durable shuffle degraded to executor-local for "
-                    "this query (rid %s): %s", job.rid, e)
+                self._note_rss_degrade(job.rid, e)
         self._materialize_exchange_via(job, ctx, resources,
                                        self._exchange_service(job.rid))
 
@@ -488,12 +690,24 @@ class AuronSession:
                                   ctx: ConvertContext,
                                   resources: ResourceRegistry,
                                   service) -> None:
+        from auron_tpu.runtime import tracing
+        stats = self._plain_map_side(job, ctx, service)
+        self._observe_exchange(job, stats)
+        n_reduce = job.partitioning.num_partitions
+        with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
+                          parts=n_reduce):
+            resources.put(job.rid, PartitionedBlocks(
+                self._plain_fetch(job, service, n_reduce)))
+
+    def _plain_map_side(self, job: ShuffleJob, ctx: ConvertContext,
+                        service):
+        """Run the map side against a plain (in-process/remote)
+        transport; returns the observed per-partition ExchangeStats."""
         # job.child is always native: convert_recursively runs every
         # foreign subtree through convert_to_native (FFI source) before a
         # converter sees it
-        map_plan = job.child
+        map_deps, map_plan = self._materialize_deps(job.child, ctx)
         map_parts = ctx.parts(map_plan)
-        map_deps = self._materialize_deps(map_plan, ctx)
 
         def map_task(map_pid: int):
             writer_rid = f"{job.rid}:writer:{map_pid}"
@@ -523,13 +737,19 @@ class AuronSession:
                 results = [map_task(pid) for pid in range(map_parts)]
         for res in results:
             self._metrics.append(res.metrics)
-        n_reduce = job.partitioning.num_partitions
-        # reduce-side resource: partition-indexed block lists; the task
-        # context picks its partition's list (resources.ResourceRegistry
-        # supports per-partition values via PartitionedResource).  The
-        # fetch rides the shared retry policy: it is a pure read (the
-        # remote clients dedup by id, the in-process store is committed),
-        # so replays after an injected/transport fault are idempotent.
+        from auron_tpu.runtime.adaptive import stats_from_map_results
+        return stats_from_map_results(job.rid, results,
+                                      job.partitioning.num_partitions)
+
+    def _plain_fetch(self, job: ShuffleJob, service,
+                     n_reduce: int) -> List[List[bytes]]:
+        """Per-partition block lists from a plain transport.  The fetch
+        rides the shared retry policy: it is a pure read (the remote
+        clients dedup by id, the in-process store is committed), so
+        replays after an injected/transport fault are idempotent.
+        Pipelined: up to auron.shuffle.pipeline.depth partition fetches
+        in flight, results in partition order, the smallest-pid error
+        raised first (the sequential loop's error)."""
         from auron_tpu.runtime.retry import (
             RetryPolicy, call_with_retry, task_classify,
         )
@@ -542,13 +762,7 @@ class AuronSession:
                 policy=policy, classify=task_classify,
                 label=f"shuffle fetch {job.rid}:{pid}")
 
-        # pipelined fetch: up to auron.shuffle.pipeline.depth partition
-        # fetches in flight, results in partition order, the smallest-
-        # pid error raised first (the sequential loop's error)
-        with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
-                          parts=n_reduce):
-            resources.put(job.rid, PartitionedBlocks(
-                run_windowed(fetch_one, range(n_reduce))))
+        return run_windowed(fetch_one, range(n_reduce))
 
     # -- the durable side-car exchange (commit protocol + resume) ---------
 
@@ -575,12 +789,27 @@ class AuronSession:
         then fetch with manifest integrity checks — a damaged block
         regenerates exactly its map output (targeted re-dispatch), not
         a blind replay."""
-        from auron_tpu.runtime import counters, tracing
+        from auron_tpu.runtime import tracing
+        sid, man, stats = self._durable_map_side(job, ctx)
+        self._observe_exchange(job, stats)
+        n_reduce = job.partitioning.num_partitions
+        with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
+                          parts=n_reduce):
+            blocks = self._durable_fetch_checked(job, ctx, sid, man,
+                                                 n_reduce)
+        resources.put(job.rid, PartitionedBlocks(blocks))
+
+    def _durable_map_side(self, job: ShuffleJob, ctx: ConvertContext):
+        """Map half of the commit protocol: manifest consult, run the
+        uncommitted remainder, seal.  Returns (sid, manifest, observed
+        ExchangeStats) — for a RESUMED stage the per-partition bytes
+        come from the manifest's committed ledger, so the replanner
+        sees real sizes without the map side ever re-running."""
+        from auron_tpu.runtime import adaptive, counters, tracing
         svc = self.shuffle_service
         sid = self._durable_sid(job.rid)
         self._exchange_sids[job.rid] = sid
         map_parts = ctx.parts(job.child)
-        n_reduce = job.partitioning.num_partitions
         resume = bool(config.conf.get("auron.rss.resume.enable"))
         man = svc.manifest(sid) if resume \
             else {"sealed": None, "maps": {}}
@@ -589,7 +818,8 @@ class AuronSession:
         skipped = map_parts - len(to_run)
         if skipped:
             counters.bump("rss_map_tasks_skipped", skipped)
-        if not to_run and man["sealed"] == map_parts:
+        resumed = not to_run and man["sealed"] == map_parts
+        if resumed:
             # the whole map stage is committed: RESUME — reduce fetches
             # from the side-car, the map subtree (and every exchange
             # under it) is never materialized
@@ -603,33 +833,45 @@ class AuronSession:
             self._run_durable_map_stage(job, ctx, sid, to_run)
             svc.seal(sid, map_parts)
             man = svc.manifest(sid)
-        with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
-                          parts=n_reduce):
+        n_reduce = job.partitioning.num_partitions
+        stats = adaptive.stats_from_manifest(job.rid, man, n_reduce)
+        stats.resumed = resumed
+        stats.rows_known = False
+        return sid, man, stats
+
+    def _durable_fetch_checked(self, job: ShuffleJob,
+                               ctx: ConvertContext, sid: str, man: dict,
+                               n_reduce: int) -> List[List[bytes]]:
+        """Fetch half of the commit protocol: integrity-checked fetch
+        with ONE targeted-regeneration round for damaged map outputs."""
+        from auron_tpu.runtime import counters, tracing
+        svc = self.shuffle_service
+        map_parts = ctx.parts(job.child)
+        blocks, bad = self._durable_fetch(sid, n_reduce, man)
+        if bad:
+            # missing/corrupt committed block: deterministic, so
+            # regenerate those map outputs and fetch once more
+            counters.bump("rss_fetch_regens")
+            tracing.event("rss.fetch.regen", cat="shuffle",
+                          rid=job.rid, sid=sid, maps=sorted(bad))
+            log.warning(
+                "durable shuffle %s: fetch failed integrity for "
+                "map output(s) %s; regenerating via targeted "
+                "re-dispatch", sid, sorted(bad))
+            self._run_durable_map_stage(
+                job, ctx, sid,
+                [m for m in sorted(bad) if m < map_parts])
+            svc.seal(sid, map_parts)
+            man = svc.manifest(sid)
             blocks, bad = self._durable_fetch(sid, n_reduce, man)
             if bad:
-                # missing/corrupt committed block: deterministic, so
-                # regenerate those map outputs and fetch once more
-                counters.bump("rss_fetch_regens")
-                tracing.event("rss.fetch.regen", cat="shuffle",
-                              rid=job.rid, sid=sid, maps=sorted(bad))
-                log.warning(
-                    "durable shuffle %s: fetch failed integrity for "
-                    "map output(s) %s; regenerating via targeted "
-                    "re-dispatch", sid, sorted(bad))
-                self._run_durable_map_stage(
-                    job, ctx, sid,
-                    [m for m in sorted(bad) if m < map_parts])
-                svc.seal(sid, map_parts)
-                man = svc.manifest(sid)
-                blocks, bad = self._durable_fetch(sid, n_reduce, man)
-                if bad:
-                    from auron_tpu.shuffle_rss.durable import (
-                        FetchFailedError,
-                    )
-                    raise FetchFailedError(
-                        sid, sorted(bad),
-                        detail="regeneration did not converge")
-        resources.put(job.rid, PartitionedBlocks(blocks))
+                from auron_tpu.shuffle_rss.durable import (
+                    FetchFailedError,
+                )
+                raise FetchFailedError(
+                    sid, sorted(bad),
+                    detail="regeneration did not converge")
+        return blocks
 
     def _run_durable_map_stage(self, job: ShuffleJob,
                                ctx: ConvertContext, sid: str,
@@ -642,9 +884,13 @@ class AuronSession:
         from auron_tpu.runtime.task_pool import run_tasks
         if not pids:
             return
-        map_plan = job.child
-        map_parts = ctx.parts(map_plan)
-        map_deps = self._materialize_deps(map_plan, ctx)
+        map_deps, map_plan = self._materialize_deps(job.child, ctx)
+        # the commit protocol's map-id space must be attempt-stable, so
+        # task count stays the ORIGINAL conversion-time partition count
+        # even when a nested adaptive replan coalesced the map plan's
+        # own inputs (the surplus map tasks read empty partitions and
+        # commit empty outputs — resume math stays consistent)
+        map_parts = ctx.parts(job.child)
 
         def map_task(map_pid: int):
             writer_rid = f"{job.rid}:writer:{map_pid}"

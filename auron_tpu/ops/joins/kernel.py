@@ -145,7 +145,14 @@ def build_probe_index(sorted_hashes, b_bits: Optional[int] = None
     uvals, ustart, ucnt, bs, max_span = k(sorted_hashes)
     with jitcheck.declared_transfer("join.probe_index.span"):  # jitcheck: waive (the partitioned strategy's ONE build-time sync: bakes the bounded search's static iteration count)
         span = int(host_sync(max_span))
-    iters = (max(span, 1) - 1).bit_length()
+    # span.bit_length() == floor(log2(span)) + 1, the exact iteration
+    # count that drives a [lo, hi) lower-bound interval of `span` to
+    # size 0.  The previous ceil(log2(span)) form was ONE short exactly
+    # when the max bucket span is a power of two (span=2: one iteration
+    # can stop at the bucket start and miss a real match one slot
+    # right) — surfaced by AQE's broadcast-converted builds, whose
+    # small dedup'd tables produce tiny power-of-two spans.
+    iters = int(max(span, 1)).bit_length()
     return ProbeIndex(uvals=uvals, ustart=ustart, ucnt=ucnt,
                       bucket_start=bs, b_bits=b_bits, iters=iters)
 

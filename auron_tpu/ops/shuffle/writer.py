@@ -36,7 +36,13 @@ from auron_tpu.ops.shuffle.partitioner import PartitionIdComputer
 class RssPartitionWriter:
     """SPI the native writer pushes partition bytes into
     (RssPartitionWriterBase.scala:21 analogue).  Implementations: local
-    files, in-memory service, Celeborn/Uniffle-style clients."""
+    files, in-memory service, Celeborn/Uniffle-style clients.
+
+    `transport` drives the exchange codec policy (columnar.serde
+    .exchange_codec): "local" writers keep the bytes in-process (no
+    compression by default), everything else is wire-bound."""
+
+    transport = "remote"
 
     def write(self, partition_id: int, data: bytes) -> None:
         raise NotImplementedError
@@ -233,17 +239,22 @@ class RssShuffleWriterExec(_ShuffleWriterBase):
         v2 = batch_serde.format_version() >= 2
         header = batch_serde.encode_stream_header(self.child_schema) \
             if v2 else b""
+        # per-transport codec policy: in-process pushes skip the
+        # compress-only-to-decompress round trip (codec.local=none)
+        codec = batch_serde.exchange_codec(
+            getattr(writer, "transport", "remote"))
         started: set = set()
         for pid, sub in self._partitioned_stream(ctx):
             if v2:
                 # schema once per (map, partition) stream, then raw
                 # device-layout frames — no arrow materialization
-                frame = batch_serde.encode_batch_v2(sub)
+                frame = batch_serde.encode_batch_v2(sub, codec=codec)
                 data = frame if pid in started else header + frame
                 started.add(pid)
             else:
                 sink = io.BytesIO()
-                batch_serde.write_one_batch(sub.to_arrow(), sink)
+                batch_serde.write_one_batch(sub.to_arrow(), sink,
+                                            codec=codec)
                 data = sink.getvalue()
             writer.write(pid, data)
             counters.bump("shuffle_bytes_pushed", len(data))
@@ -287,6 +298,8 @@ class InProcessShuffleService:
             itself retried like the remote clients retry their push RPCs
             (the fault point raises BEFORE any mutation, so a replayed
             push never double-stages)."""
+
+            transport = "local"
 
             def __init__(self) -> None:
                 self._staged: Dict[int, List[bytes]] = {}

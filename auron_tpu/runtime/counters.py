@@ -71,6 +71,13 @@ _COUNTERS: Dict[str, int] = {
     # dataplane_check gate
     "shuffle_bytes_pushed": 0,
     "shuffle_bytes_fetched": 0,
+    # adaptive execution (runtime/adaptive.py): stage-boundary replan
+    # decisions that FIRED — broadcast-vs-shuffle join conversions,
+    # reduce partition coalesces, skew splits (tools/aqe_check.sh
+    # asserts all three via prom_assert)
+    "adaptive_broadcast": 0,
+    "adaptive_coalesce": 0,
+    "adaptive_skew_split": 0,
     # tracing: spans dropped past auron.trace.max.events (per-recorder
     # `dropped` counts feed trace_truncated on the exported trace; this
     # is the process total `auron_trace_dropped_events_total` exports)

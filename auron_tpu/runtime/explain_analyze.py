@@ -41,11 +41,17 @@ _VOLATILE_KEYS = frozenset({
     "jit_compiles",
     # exchange wire bytes: codec- and format-version-dependent
     "shuffle_write_bytes", "shuffle_read_bytes",
+    # observed exchange histograms (session ExchangeStats marker
+    # nodes): byte values move with codec/format, rows_out/partitions
+    # stay canonical
+    "bytes_out", "part_bytes_max", "part_bytes_min",
 })
 
 # byte-valued metrics: rendered human-readable in the non-canonical form
 _BYTE_KEYS = frozenset({"mem_peak", "mem_spill_size", "disk_spill_size",
-                        "shuffle_write_bytes", "shuffle_read_bytes"})
+                        "shuffle_write_bytes", "shuffle_read_bytes",
+                        "bytes_out", "part_bytes_max",
+                        "part_bytes_min"})
 
 # render order: row/batch flow first, then time, then memory, then the
 # rest sorted
@@ -222,11 +228,15 @@ def explain_analyze(trees: List[MetricNode],
                     spmd: bool = False,
                     retries: int = 0,
                     fallbacks: int = 0,
+                    aqe: Optional[List[Dict[str, Any]]] = None,
                     normalize: bool = False) -> str:
     """The full EXPLAIN ANALYZE text: a summary header + the annotated
     executed plan.  `normalize=True` omits the volatile header fields
     (query id, wall time) and metric values — the golden-comparable
-    canonical form."""
+    canonical form.  `aqe` lists the adaptive replan decisions
+    (SessionResult.aqe_decisions); in the canonical form only the
+    decision kind + exchange ordinal survive (byte counts and
+    groupings move with codec/format)."""
     head = ["== EXPLAIN ANALYZE"]
     if not normalize:
         if query_id:
@@ -239,6 +249,11 @@ def explain_analyze(trees: List[MetricNode],
     head.append(f"retries={retries}")
     head.append(f"fallbacks={fallbacks}")
     out = [" ".join(head) + " =="]
+    for d in aqe or ():
+        line = f"aqe: {d.get('kind')} {d.get('exchange')}"
+        if not normalize and d.get("reason"):
+            line += f" ({d['reason']})"
+        out.append(line)
     if not trees:
         out.append("(no per-operator metrics: the query compiled to one "
                    "SPMD stage program; run with "

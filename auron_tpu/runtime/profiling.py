@@ -211,6 +211,11 @@ def _prometheus_text() -> str:
         emit(f"auron_{key}_total", snap.get(key, 0),
              help_="exchange data plane (this process): "
                    f"{key.replace('_', ' ')}")
+    for key in ("adaptive_broadcast", "adaptive_coalesce",
+                "adaptive_skew_split"):
+        emit(f"auron_{key}_total", snap.get(key, 0),
+             help_="adaptive execution: stage-boundary "
+                   f"{key.replace('_', ' ')} decisions fired")
     emit("auron_trace_dropped_events_total",
          snap.get("trace_dropped_events", 0),
          help_="spans dropped past auron.trace.max.events across all "
@@ -426,6 +431,37 @@ def _queries_diff(qa: str, qb: str, as_json: bool):
     return 200, body.encode(), "text/html"
 
 
+def _aqe_section(rec) -> str:
+    """Adaptive-execution audit trail on /queries/<id>: replan
+    decisions + observed per-exchange histograms (empty when the query
+    ran without the serial exchange path)."""
+    import html as _html
+    if not rec.aqe_decisions and not rec.exchange_stats:
+        return ""
+    out = []
+    if rec.aqe_decisions:
+        rows = "".join(
+            f"<tr><td>{_html.escape(str(d.get('kind')))}</td>"
+            f"<td>{_html.escape(str(d.get('exchange')))}</td>"
+            f"<td>{_html.escape(str(d.get('reason', '')))}</td></tr>"
+            for d in rec.aqe_decisions)
+        out.append("<h3>Adaptive decisions</h3><table><tr><th>kind"
+                   "</th><th>exchange</th><th>reason</th></tr>"
+                   f"{rows}</table>")
+    if rec.exchange_stats:
+        rows = "".join(
+            f"<tr><td>{_html.escape(str(s.get('exchange')))}</td>"
+            f"<td>{s.get('partitions')}</td>"
+            f"<td>{s.get('bytes_out')}</td>"
+            f"<td>{s.get('rows_out')}</td>"
+            f"<td>{'yes' if s.get('resumed') else 'no'}</td></tr>"
+            for s in rec.exchange_stats)
+        out.append("<h3>Observed exchanges</h3><table><tr>"
+                   "<th>exchange</th><th>partitions</th><th>bytes</th>"
+                   f"<th>rows</th><th>resumed</th></tr>{rows}</table>")
+    return "".join(out)
+
+
 def _query_detail(qid: str, as_json: bool):
     """(status, body, content_type) for /queries/<id>: the full record
     — lifecycle timeline with per-state durations, and the merged
@@ -473,6 +509,7 @@ def _query_detail(qid: str, as_json: bool):
            if rec.error else "") + "</p>"
         "<h3>Lifecycle</h3><table><tr><th>state</th><th>t</th>"
         f"<th>duration</th></tr>{tl_rows}</table>"
+        + _aqe_section(rec) +
         "<h3>Per-operator metrics</h3><pre>"
         + _html.escape(analyzed or "(no per-operator metric trees "
                        "recorded)") +

@@ -565,6 +565,11 @@ class QueryRecord:
     # patch/record the full machine, direct executes a running/terminal
     # pair
     timeline: Optional[List[Dict[str, Any]]] = None
+    # adaptive execution (runtime/adaptive.py): structured stage-
+    # boundary replan decisions and the observed per-exchange size
+    # histograms that drove them — the /queries/<id> audit trail
+    aqe_decisions: Optional[List[Dict[str, Any]]] = None
+    exchange_stats: Optional[List[Dict[str, Any]]] = None
     trace: Optional[Dict[str, Any]] = None   # chrome-trace doc, if traced
 
     def to_dict(self, with_trace: bool = False,
@@ -578,6 +583,8 @@ class QueryRecord:
              "mem_peak": self.mem_peak, "mem_spills": self.mem_spills,
              "mem_spill_bytes": self.mem_spill_bytes,
              "timeline": self.timeline,
+             "aqe_decisions": self.aqe_decisions,
+             "exchange_stats": self.exchange_stats,
              "metric_totals": dict(self.metric_totals)}
         if with_trees:
             d["metric_trees"] = self.metric_trees

@@ -336,6 +336,16 @@ class QueryScheduler:
             overlay["auron.task.parallelism"] = 1
             overlay["auron.spmd.singleDevice.enable"] = False
         requeue = False
+        # stage-boundary admission re-forecast (runtime/adaptive.py):
+        # when adaptive execution observes an exchange's real size, the
+        # session routes its estimate through this hook into the SAME
+        # reforecast path heartbeat telemetry feeds — a query that
+        # turns out light releases reservation before it finishes
+        from auron_tpu.runtime import adaptive
+        adaptive.set_reforecast_hook(
+            sub.query_id,
+            lambda est, age, _q=sub.query_id:
+            self.admission.reforecast(_q, est, age))
         try:
             # session construction INSIDE the overlay: the per-query
             # conf governs construction-time choices too (e.g. the
@@ -398,6 +408,7 @@ class QueryScheduler:
             # BEFORE a requeue makes the submission runnable again —
             # a requeued run must start with a clean slate
             from auron_tpu.runtime import result_stream
+            adaptive.clear_reforecast_hook(sub.query_id)
             if sub.state == SUCCEEDED:
                 result_stream.mark_done(sub.query_id)
             elif not requeue:

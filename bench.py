@@ -619,6 +619,92 @@ def worker_serde() -> dict:
     return out
 
 
+def worker_aqe() -> dict:
+    """Adaptive-execution numbers (the PR 15 headline):
+
+    1. interleaved in-process A/B on a coalesce/skew-sensitive corpus
+       query, `auron.adaptive.enable` on vs off on the serial exchange
+       path, results value-identical — the no-regression acceptance
+       gate (tools/aqe_check.sh asserts the decision counters).
+    2. per-exchange observed sizes + the structured decisions from the
+       AQE-on run (`aqe_decisions`, `exchange_bytes`), so the artifact
+       records WHAT the replanner did, not just how fast it was.
+    3. the exchange codec-policy delta: the in-process service at
+       codec.local=none (default) vs forced zlib on the same query —
+       the compress-only-to-decompress round trip the policy removed.
+    """
+    import tempfile
+
+    import auron_tpu  # noqa: F401
+    from auron_tpu.config import conf
+    from auron_tpu.frontend.session import AuronSession
+    from auron_tpu.it import compare, datagen, oracle, queries
+
+    catalog = datagen.generate(tempfile.mkdtemp(prefix="auron-aqe-ab-"),
+                               sf=0.01)
+    BASE = {"auron.spmd.singleDevice.enable": False,
+            "auron.force.shuffled.hash.join": True}
+    ON = {**BASE, "auron.adaptive.enable": True}
+    name = "q01"
+
+    def run_q(extra):
+        with conf.scoped({**BASE, **extra}):
+            sess = AuronSession(foreign_engine=oracle.PyArrowEngine())
+            t0 = time.perf_counter()
+            res = sess.execute(queries.build(name, catalog))
+            return time.perf_counter() - t0, res
+
+    run_q({}); run_q(ON)          # warm both paths
+    on_t, off_t = [], []
+    identical = True
+    decisions = []
+    exchange_bytes = []
+    plan = queries.build(name, catalog)
+    for _ in range(5):
+        dt_on, r_on = run_q(ON)
+        dt_off, r_off = run_q({})
+        on_t.append(dt_on)
+        off_t.append(dt_off)
+        identical = identical and compare.compare_tables(
+            r_on.table, r_off.table,
+            ordered=compare.plan_is_ordered(plan)) is None
+        decisions = r_on.aqe_decisions
+        exchange_bytes = [
+            {"exchange": s["exchange"], "partitions": s["partitions"],
+             "bytes_out": s["bytes_out"]}
+            for s in r_on.exchange_stats]
+    on_t.sort(); off_t.sort()
+    out = {
+        "platform": jax_platform(),
+        "aqe_ab_query": name,
+        "aqe_ab_on_ms": round(on_t[len(on_t) // 2] * 1e3),
+        "aqe_ab_off_ms": round(off_t[len(off_t) // 2] * 1e3),
+        "aqe_ab_ratio": round(
+            off_t[len(off_t) // 2] / on_t[len(on_t) // 2], 3),
+        "aqe_ab_identical": identical,
+        "aqe_decisions": decisions,
+        "exchange_bytes": exchange_bytes,
+    }
+
+    # codec-policy delta: default local `none` vs forced zlib
+    zlib_t, none_t = [], []
+    for _ in range(3):
+        dt_none, _r = run_q({})
+        dt_zlib, _r = run_q({"auron.shuffle.codec.local": "zlib"})
+        none_t.append(dt_none)
+        zlib_t.append(dt_zlib)
+    out["codec_local_none_ms"] = round(min(none_t) * 1e3)
+    out["codec_local_zlib_ms"] = round(min(zlib_t) * 1e3)
+    out["codec_local_ratio"] = round(min(zlib_t) / max(min(none_t),
+                                                       1e-9), 3)
+    return out
+
+
+def jax_platform() -> str:
+    import jax
+    return jax.default_backend()
+
+
 def _run_worker(mode: str, env_extra=None, timeout=WORKER_TIMEOUT_S
                 ) -> dict:
     env = dict(os.environ)
@@ -804,6 +890,16 @@ def _summarize(results: dict, baseline_rps: float,
                   "exchange_bytes_pushed", "exchange_bytes_fetched"):
             if k in sd:
                 out[k] = sd[k]
+    aq = results.get("aqe")
+    if aq is not None:
+        # the PR 15 adaptive-execution numbers (BENCH_r06 notes):
+        # interleaved A/B + the decision audit + the codec-policy delta
+        for k in ("aqe_ab_query", "aqe_ab_on_ms", "aqe_ab_off_ms",
+                  "aqe_ab_ratio", "aqe_ab_identical", "aqe_decisions",
+                  "exchange_bytes", "codec_local_none_ms",
+                  "codec_local_zlib_ms", "codec_local_ratio"):
+            if k in aq:
+                out[k] = aq[k]
     # top-level platform = whatever produced the HEADLINE metric
     headline = engine_any if engine_any is not None else fused
     if headline is not None:
@@ -897,7 +993,7 @@ def main() -> None:
     # worker (profile) wedged on a congested tunnel and the old policy
     # then forced CPU for everything after it.  The artifact's reason to
     # exist is an on-chip engine number — aux workers must never cost it.
-    order = ("engine", "spmd", "fused", "profile", "serde")
+    order = ("engine", "spmd", "fused", "profile", "serde", "aqe")
     # single attempt: the probe IS the flake detector, a second try
     # would just re-burn its timeout on a wedged tunnel.  Fail FAST: a
     # wedged backend hangs in init, and every healthy probe in five
@@ -992,7 +1088,8 @@ if __name__ == "__main__":
         mode = sys.argv[2]
         fn = {"engine": worker_engine, "fused": worker_fused,
               "profile": worker_profile, "spmd": worker_spmd,
-              "probe": worker_probe, "serde": worker_serde}[mode]
+              "probe": worker_probe, "serde": worker_serde,
+              "aqe": worker_aqe}[mode]
         print(json.dumps(fn()))
     else:
         main()

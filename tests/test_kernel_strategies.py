@@ -182,11 +182,38 @@ def test_bounded_probe_degenerate_single_value():
     from auron_tpu.ops.joins.kernel import bounded_probe, build_probe_index
     sh = np.full(512, 0x1234, np.uint64)
     idx = build_probe_index(jnp.asarray(sh))
-    assert idx.iters == 0
+    assert idx.iters == 1   # span.bit_length(): span 1 -> one iteration
     lo, cnt = bounded_probe(idx, jnp.asarray(
         np.array([0x1234, 0x1235, 0], np.uint64)))
     assert list(np.asarray(cnt)) == [512, 0, 0]
     assert int(np.asarray(lo)[0]) == 0
+
+
+def test_bounded_probe_power_of_two_span_regression():
+    """PR 15 regression: `iters = ceil(log2(span))` was ONE iteration
+    short exactly when the max bucket span is a POWER OF TWO — a
+    bucket holding 2^k distinct hashes could stop the bounded search
+    one slot before the match and report a miss (surfaced as a lost
+    anti-join match when AQE's broadcast-converted builds produced
+    tiny dedup'd tables; q16a/q06a/q17m/q38i/q45s/q50c/q87a corpus
+    diffs).  Exact formula: span.bit_length()."""
+    from auron_tpu.ops.joins.kernel import bounded_probe, build_probe_index
+    # two distinct hashes in ONE radix bucket (equal top 16 bits):
+    # max span = 2, the minimal failing power of two
+    h = np.array([0x1234567800000000, 0x1234567800000001], np.uint64)
+    idx = build_probe_index(jnp.asarray(np.sort(h)), b_bits=16)
+    assert idx.iters == 2
+    lo, cnt = bounded_probe(idx, jnp.asarray(h))
+    assert list(np.asarray(cnt)) == [1, 1]   # the upper slot must hit
+    assert list(np.asarray(lo)) == [0, 1]
+    # and every power-of-two span up to 64, probing every member
+    for m in range(1, 7):
+        n = 1 << m
+        vals = (np.uint64(0x1234567800000000) +
+                np.arange(n, dtype=np.uint64))
+        idx = build_probe_index(jnp.asarray(vals), b_bits=16)
+        _lo, cnt = bounded_probe(idx, jnp.asarray(vals))
+        assert np.asarray(cnt).tolist() == [1] * n, f"span {n}"
 
 
 def _run_join(rows_l, rows_r, join_type, scope):

@@ -1,0 +1,211 @@
+#!/usr/bin/env bash
+# CI adaptive-execution gate (CPU, no accelerator needed):
+#   1. run a skewed + tiny-partition corpus mix through the serial
+#      exchange path twice per query — auron.adaptive.enable off, then
+#      on (forced thresholds so every decision family fires on the
+#      small data)
+#   2. assert EVERY AQE-on result is value-identical to its AQE-off
+#      run, every rewritten plan passed the analyzer (a failed rewrite
+#      would have been dropped and the decision would be missing), the
+#      forced-decision microbenches hold (coalescing reduces the
+#      reduce-task count; broadcast conversion removes the build
+#      side's partition-indexed fetch), and an interleaved in-process
+#      A/B on the coalesce-sensitive query shows no regression
+#   3. dump the Prometheus snapshot and prom_assert
+#      auron_adaptive_{broadcast,coalesce,skew_split}_total >= 1
+#
+# The same check runs inside the suite (tests/test_adaptive.py::
+# test_tools_aqe_check_script, marked slow), mirroring how
+# rss_check.sh / fleet_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source tools/prom_assert.sh
+PROM_OUT="$(mktemp)"
+export PROM_OUT
+trap 'rm -f "$PROM_OUT"' EXIT
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import os
+import tempfile
+import time
+
+from auron_tpu import config
+from auron_tpu.frontend import AuronSession, ForeignNode, fcol
+from auron_tpu.ir.schema import DataType, Field, Schema
+from auron_tpu.it import compare, datagen, queries
+from auron_tpu.it.oracle import PyArrowEngine
+from auron_tpu.runtime import counters
+
+I64 = DataType.int64()
+F64 = DataType.float64()
+S = Schema((Field("k", I64), Field("v", F64)))
+
+SERIAL = {"auron.spmd.singleDevice.enable": False,
+          "auron.force.shuffled.hash.join": True}
+AQE = {**SERIAL, "auron.adaptive.enable": True,
+       "auron.adaptive.target.partition.bytes": 1 << 20,
+       "auron.adaptive.skew.factor": 2.0,
+       "auron.adaptive.skew.min.partition.bytes": 1024}
+
+catalog = datagen.generate(
+    tempfile.mkdtemp(prefix="auron-aqe-check-"), sf=0.002)
+
+
+def run(plan, overlay):
+    with config.conf.scoped(overlay):
+        return AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
+
+
+def check_same(name, plan, off, on):
+    err = compare.compare_tables(
+        on.table, off.table, ordered=compare.plan_is_ordered(plan))
+    assert err is None, f"{name}: AQE-on deviates: {err}"
+
+
+# -- corpus mix: tiny partitions force broadcast + coalesce ---------------
+fired = set()
+for name in ("q01", "q42"):
+    plan = queries.build(name, catalog)
+    off = run(plan, SERIAL)
+    on = run(plan, AQE)
+    check_same(name, plan, off, on)
+    fired.update(d["kind"] for d in on.aqe_decisions)
+    print(f"{name}: decisions="
+          f"{[(d['kind'], d['exchange']) for d in on.aqe_decisions]}")
+
+# -- synthetic skew: a hot key over a row-local consumer ------------------
+hot = [ForeignNode("LocalTableScanExec", output=S, attrs={"rows": [
+    {"k": 7 if i % 4 else (i % 97), "v": float(i)}
+    for i in range(c * 4000, (c + 1) * 4000)]}) for c in range(4)]
+union = ForeignNode("UnionExec", children=tuple(hot), output=S)
+ex = ForeignNode(
+    "ShuffleExchangeExec", children=(union,), output=S,
+    attrs={"partitioning": {"mode": "hash", "num_partitions": 4,
+                            "expressions": [fcol("k", I64)]}})
+skew_plan = ForeignNode(
+    "ProjectExec", children=(ex,), output=S,
+    attrs={"project_list": [fcol("k", I64), fcol("v", F64)]})
+skew_conf = {**AQE, "auron.adaptive.broadcast.enable": False,
+             "auron.adaptive.coalesce.enable": False,
+             "auron.adaptive.target.partition.bytes": 1 << 18}
+off = run(skew_plan, SERIAL)
+on = run(skew_plan, skew_conf)
+check_same("skew", skew_plan, off, on)
+fired.update(d["kind"] for d in on.aqe_decisions)
+assert {"broadcast", "coalesce", "skew_split"} <= fired, \
+    f"decision families missing: fired={fired}"
+
+# -- forced-decision microbenches ----------------------------------------
+from auron_tpu.runtime.explain_analyze import merge_metric_trees
+
+DIM = Schema((Field("k2", I64), Field("w", F64)))
+left = ForeignNode("LocalTableScanExec", output=S, attrs={
+    "rows": [{"k": i % 40, "v": float(i)} for i in range(2000)]})
+right = ForeignNode("LocalTableScanExec", output=DIM, attrs={
+    "rows": [{"k2": i, "w": float(i)} for i in range(40)]})
+
+
+def hash_ex(child, key, n=8):
+    return ForeignNode(
+        "ShuffleExchangeExec", children=(child,), output=child.output,
+        attrs={"partitioning": {"mode": "hash", "num_partitions": n,
+                                "expressions": [fcol(key, I64)]}})
+
+
+join = ForeignNode(
+    "ShuffledHashJoinExec",
+    children=(hash_ex(left, "k"), hash_ex(right, "k2")),
+    output=S.concat(DIM),
+    attrs={"left_keys": [fcol("k", I64)],
+           "right_keys": [fcol("k2", I64)],
+           "join_type": "Inner", "build_side": "right"})
+
+
+def n_shuffle_readers(res):
+    def walk(n):
+        n._settle()
+        yield n
+        for c in n.children:
+            yield from walk(c)
+    return sum(1 for t in res.metrics for node in walk(t)
+               if node.name.startswith("IpcReaderExec")
+               and node.values.get("shuffle_read_bytes"))
+
+
+off = run(join, SERIAL)
+on = run(join, {**AQE, "auron.adaptive.coalesce.enable": False,
+                "auron.adaptive.skew.enable": False})
+check_same("join", join, off, on)
+assert any(d["kind"] == "broadcast" for d in on.aqe_decisions)
+assert n_shuffle_readers(on) < n_shuffle_readers(off), \
+    "broadcast conversion did not remove the build-side fetch"
+print(f"broadcast microbench: partitioned fetch readers "
+      f"{n_shuffle_readers(off)} -> {n_shuffle_readers(on)}")
+
+
+def reduce_tasks(res, prefix):
+    return sum(n for t, n in merge_metric_trees(res.metrics)
+               if t.name.startswith(prefix))
+
+
+from auron_tpu.frontend import fcall
+from auron_tpu.frontend.foreign import ForeignExpr
+
+aggs = [ForeignExpr("AggregateExpression",
+                    children=(fcall("Sum", fcol("v", F64), dtype=F64),))]
+partial = ForeignNode(
+    "HashAggregateExec", children=(left,),
+    output=Schema((Field("k", I64), Field("s#sum", F64))),
+    attrs={"grouping": [fcol("k", I64)], "aggs": aggs,
+           "agg_names": ["s"], "mode": "partial"})
+agg_plan = ForeignNode(
+    "HashAggregateExec", children=(hash_ex(partial, "k"),),
+    output=Schema((Field("k", I64), Field("s", F64))),
+    attrs={"grouping": [fcol("k", I64)], "aggs": aggs,
+           "agg_names": ["s"], "mode": "final"})
+coal_conf = {**AQE, "auron.adaptive.broadcast.enable": False,
+             "auron.adaptive.skew.enable": False}
+off = run(agg_plan, SERIAL)
+on = run(agg_plan, coal_conf)
+check_same("agg", agg_plan, off, on)
+assert any(d["kind"] == "coalesce" for d in on.aqe_decisions)
+t_off, t_on = reduce_tasks(off, "AggExec"), reduce_tasks(on, "AggExec")
+assert t_on < t_off, \
+    f"coalescing did not reduce reduce-task count ({t_off} -> {t_on})"
+print(f"coalesce microbench: reduce tasks {t_off} -> {t_on}")
+
+# -- interleaved A/B: no regression on the coalesce-sensitive shape ------
+for _ in range(2):                       # warm both paths
+    run(agg_plan, SERIAL)
+    run(agg_plan, coal_conf)
+t_offs, t_ons = [], []
+for _ in range(3):                       # alternate to ride load swings
+    t0 = time.perf_counter()
+    run(agg_plan, SERIAL)
+    t_offs.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    run(agg_plan, coal_conf)
+    t_ons.append(time.perf_counter() - t0)
+off_s, on_s = min(t_offs), min(t_ons)
+ratio = off_s / max(on_s, 1e-9)
+print(f"aqe A/B (interleaved, best-of-3): off={off_s * 1e3:.0f}ms "
+      f"on={on_s * 1e3:.0f}ms speedup={ratio:.2f}x")
+assert on_s <= off_s * 1.3, \
+    f"AQE-on regressed: {on_s:.3f}s vs {off_s:.3f}s off"
+
+snap = counters.snapshot()
+for key in ("adaptive_broadcast", "adaptive_coalesce",
+            "adaptive_skew_split"):
+    print(f"{key}_total={snap.get(key, 0)}")
+
+from auron_tpu.runtime import profiling
+
+with open(os.environ["PROM_OUT"], "w") as f:
+    f.write(profiling._prometheus_text())
+print("AQE_CHECK_DRIVER_OK")
+EOF
+
+prom_assert_ge "$PROM_OUT" auron_adaptive_broadcast_total 1
+prom_assert_ge "$PROM_OUT" auron_adaptive_coalesce_total 1
+prom_assert_ge "$PROM_OUT" auron_adaptive_skew_split_total 1
+echo "aqe_check: OK"
