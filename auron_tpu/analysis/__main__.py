@@ -15,6 +15,9 @@ serialized node ({"@kind": ...} — the wire form ir/serde.py emits).
     python -m auron_tpu.analysis --compilation --regen-golden
                                       # rerun q01+q03, rebuild the
                                       # compile manifest
+    python -m auron_tpu.analysis --protocol           # wire-protocol lint
+    python -m auron_tpu.analysis --protocol --regen-golden
+                                      # rebuild the wire manifest
 
 --regen-golden re-derives the documents from the IT corpus: every
 query in auron_tpu.it.queries is converted exactly as the runner
@@ -186,6 +189,37 @@ def run_concurrency(regen: bool, golden_dir: str) -> int:
     return 2 if n_err else 0
 
 
+def run_protocol(regen: bool, golden_dir: str) -> int:
+    """The static wire-protocol pass (`--protocol`): server-ladder vs
+    registry exhaustiveness (both directions), client request literals
+    inside the contract, transport fault-point + retry-policy riding,
+    idempotency-vs-replay consistency, raw struct framing lint, golden
+    wire-manifest comparison."""
+    from auron_tpu.analysis import protocol as proto
+
+    report = proto.analyze_protocol()
+    golden = os.path.join(golden_dir, "wire_manifest.txt")
+    if regen:
+        text = proto.render_golden()
+        os.makedirs(golden_dir, exist_ok=True)
+        with open(golden, "w") as fh:
+            fh.write(text)
+        print(f"wrote {golden}: {report.command_count()} commands on "
+              f"{len(report.ladders) + 1} wires")
+    problems = [] if regen else proto.check_against_golden(golden)
+    for d in report.result.diagnostics:
+        print(d)
+    for p in problems:
+        print(f"error[protocol-golden] {p}")
+    n_err = len(report.result.errors) + len(problems)
+    status = "FAIL" if n_err else "ok"
+    print(f"{status}: {report.command_count()} commands, "
+          f"{sum(len(c) for c in report.ladders.values())} ladder arms, "
+          f"{len(report.framing_sites)} framing sites, "
+          f"{n_err} unwaived errors")
+    return 2 if n_err else 0
+
+
 def run_compilation(regen: bool, golden_dir: str) -> int:
     """The static compilation pass (`--compilation`): raw-jit lint,
     host-materialization inside jitted bodies, mutable-capture lint,
@@ -244,12 +278,21 @@ def main(argv=None) -> int:
                          "bypass, host materialization inside jitted "
                          "bodies, mutable-capture, strategy-fingerprint "
                          "cache keys, config-knob lint)")
+    ap.add_argument("--protocol", action="store_true",
+                    help="run the static wire-protocol pass instead of "
+                         "the plan lint (server dispatch ladders vs the "
+                         "wirecheck command registry both ways, client "
+                         "sites on named fault points + the shared "
+                         "retry policy, idempotency-vs-replay audit, "
+                         "raw struct framing lint, wire-manifest "
+                         "golden)")
     ap.add_argument("--regen-golden", action="store_true",
                     help="rebuild the golden plan documents from the IT "
                          "corpus (with --concurrency: rebuild the "
                          "lock-order graph golden; with --compilation: "
                          "rerun the canonical q01+q03 and rebuild the "
-                         "compile manifest)")
+                         "compile manifest; with --protocol: rebuild "
+                         "the wire manifest)")
     ap.add_argument("--golden-dir", default=None)
     ap.add_argument("--sf", type=float, default=0.001)
     ap.add_argument("--data-dir", default="/tmp/auron_tpcds_lint")
@@ -260,6 +303,8 @@ def main(argv=None) -> int:
         return run_concurrency(args.regen_golden, golden)
     if args.compilation:
         return run_compilation(args.regen_golden, golden)
+    if args.protocol:
+        return run_protocol(args.regen_golden, golden)
     if args.regen_golden:
         return regen_golden(golden, args.sf, args.data_dir)
     paths = args.paths or [golden]

@@ -1,0 +1,604 @@
+"""Static wire-protocol conformance pass (`--protocol`).
+
+The static half of wirecheck (runtime/wirecheck.py holds the declarative
+command registry and the dynamic frame checks; see its module docstring
+for the contract).  This pass AST-scans `auron_tpu/` and proves, on
+every CI run, that
+
+1. SERVER LADDERS and the registry cover each other exactly: every
+   ``cmd == "x"`` / ``cmd in (...)`` comparison in the three dispatch
+   ladders (shuffle_rss/server.py `_Handler._serve`,
+   serving/executor_endpoint.py `_ExecHandler._dispatch`,
+   service/engine.py `_Handler._dispatch`) names a registered command,
+   and every registered in-ladder command appears in its ladder —
+   exhaustiveness in BOTH directions;
+2. CLIENT SITES stay inside the contract: every ``{"cmd": ...}``
+   request literal in the wire client modules names a registered
+   command, each wire's transport function (`_Conn.request`,
+   `ProcessExecutor._rpc`, `EngineClient._call`,
+   `KafkaWireClient._call`) rides a named fault point AND the ONE
+   shared retry policy (`call_with_retry`), and the per-command fault
+   points observed in code (the celeborn/durable `_FAULT_POINTS`
+   tables, the `self._rpc(<site>, ...)` pairs, the kafka API table)
+   match the registry's declarations;
+3. IDEMPOTENCY is consistent with the retry tiers: a command dispatched
+   through a replaying transport must be `idempotent` or `dedup-keyed`
+   (with its dedup key declared in the request schema) — a
+   non-replayable command inside a replaying tier is an ERROR.  This
+   mechanizes the MCOMMIT/push_id replay audit PR 12 did by hand;
+4. RAW FRAMING is linted: a function that both packs/unpacks with
+   `struct` and touches a socket (`sendall`/`recv`) is transport — it
+   must be one of the shared framed helpers (shuffle_rss/server.py
+   send_msg/recv_msg) or carry an explicit in-body
+   ``# wirecheck: waive (<reason>)`` (the kafka client's binary
+   protocol).  Pure payload users of `struct` (ir/serde, bloom,
+   columnar serde, jvm templates) never touch sockets and pass
+   untouched.
+
+The committed golden is `tests/golden_plans/wire_manifest.txt`
+(commands x wires x versions x idempotency x fault points); regenerate
+with ``python -m auron_tpu.analysis --protocol --regen-golden``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from auron_tpu.analysis.diagnostics import AnalysisResult, DiagnosticSink
+from auron_tpu.runtime import wirecheck
+
+PASS_ID = "protocol"
+
+# (wire) -> (module rel path, dispatch method name) of the server ladder
+_LADDERS: Dict[str, Tuple[str, str]] = {
+    "rss": ("shuffle_rss/server.py", "_serve"),
+    "executor": ("serving/executor_endpoint.py", "_dispatch"),
+    "engine": ("service/engine.py", "_dispatch"),
+}
+
+# module rel path (or package prefix ending in /) -> wire whose request
+# literals it may construct
+_CLIENT_MODULES: Dict[str, str] = {
+    "shuffle_rss/": "rss",
+    "serving/executor_endpoint.py": "executor",
+    "service/engine.py": "engine",
+}
+
+# (wire) -> (module rel path, transport function name) that must carry
+# fault_point + call_with_retry (the ONE replaying tier per wire)
+_TRANSPORTS: Dict[str, Tuple[str, str]] = {
+    "rss": ("shuffle_rss/celeborn.py", "request"),
+    "executor": ("serving/executor_endpoint.py", "_rpc"),
+    "engine": ("service/engine.py", "_call"),
+    "kafka": ("streaming/kafka_client.py", "_call"),
+}
+
+# the shared framed-TCP helpers: the ONLY functions allowed to combine
+# struct framing with socket IO without a waiver
+_FRAMING_ALLOWLIST: Set[Tuple[str, str]] = {
+    ("shuffle_rss/server.py", "send_msg"),
+    ("shuffle_rss/server.py", "recv_msg"),
+    ("shuffle_rss/server.py", "_recv_exact"),
+}
+
+
+@dataclass
+class _ModuleScan:
+    rel: str
+    tree: ast.AST
+    src_lines: List[str]
+
+
+@dataclass
+class ProtocolReport:
+    """Everything the CLI and the golden need from one pass run."""
+    ladders: Dict[str, Set[str]] = field(default_factory=dict)
+    client_cmds: Dict[str, Set[str]] = field(default_factory=dict)
+    tier_cmds: Dict[str, Set[str]] = field(default_factory=dict)
+    observed_fps: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    framing_sites: List[str] = field(default_factory=list)
+    result: AnalysisResult = field(
+        default_factory=lambda: AnalysisResult(diagnostics=[]))
+
+    def command_count(self) -> int:
+        return sum(len(c) for c in wirecheck.COMMANDS.values())
+
+
+def _load_package(root: str) -> List[_ModuleScan]:
+    scans: List[_ModuleScan] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path) as fh:
+                src = fh.read()
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError:
+                continue   # ruff's department
+            scans.append(_ModuleScan(rel, tree, src.splitlines()))
+    return scans
+
+
+def _functions(scan: _ModuleScan) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(scan.tree)
+            if isinstance(n, ast.FunctionDef)]
+
+
+def _find_function(scans: List[_ModuleScan], rel: str,
+                   name: str) -> Optional[ast.FunctionDef]:
+    for scan in scans:
+        if scan.rel != rel:
+            continue
+        for fn in _functions(scan):
+            if fn.name == name:
+                return fn
+    return None
+
+
+def _ladder_cmds(fn: ast.FunctionDef) -> Set[str]:
+    """Every command the dispatch method compares `cmd` against:
+    ``cmd == "x"`` and ``cmd in ("a", "b")``."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not (isinstance(node.left, ast.Name) and
+                node.left.id == "cmd" and len(node.ops) == 1):
+            continue
+        comp = node.comparators[0]
+        if isinstance(node.ops[0], ast.Eq) and \
+                isinstance(comp, ast.Constant) and \
+                isinstance(comp.value, str):
+            out.add(comp.value)
+        elif isinstance(node.ops[0], ast.In) and \
+                isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+            for el in comp.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    out.add(el.value)
+    return out
+
+
+def _wire_of(rel: str) -> Optional[str]:
+    for prefix, wire in _CLIENT_MODULES.items():
+        if rel == prefix or (prefix.endswith("/") and
+                             rel.startswith(prefix)):
+            return wire
+    return None
+
+
+def _dict_cmd(node: ast.Dict) -> Optional[str]:
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and k.value == "cmd" and \
+                isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return v.value
+    return None
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _contains_call(fn: ast.FunctionDef, name: str) -> bool:
+    return any(isinstance(n, ast.Call) and _call_name(n) == name
+               for n in ast.walk(fn))
+
+
+def _const_dict(node: ast.Dict) -> Dict[str, str]:
+    """{str-key: str-value} pairs of a dict literal (Name keys use the
+    identifier — the kafka API_* table)."""
+    out: Dict[str, str] = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(v, ast.Constant) and isinstance(v.value, str)):
+            continue
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            out[k.value] = v.value
+        elif isinstance(k, ast.Name):
+            out[k.id] = v.value
+    return out
+
+
+def _fault_point_table(scans: List[_ModuleScan]) -> Dict[str, str]:
+    """The EFFECTIVE rss fault-point map: celeborn.py's module-level
+    `_FAULT_POINTS = {...}` plus durable.py's `.update({...})` (the two
+    share one dict object at runtime)."""
+    table: Dict[str, str] = {}
+    for rel in ("shuffle_rss/celeborn.py", "shuffle_rss/durable.py"):
+        for scan in scans:
+            if scan.rel != rel:
+                continue
+            for node in ast.walk(scan.tree):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Dict) and \
+                        any(isinstance(t, ast.Name) and
+                            t.id == "_FAULT_POINTS"
+                            for t in node.targets):
+                    table.update(_const_dict(node.value))
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "update" and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "_FAULT_POINTS" and \
+                        node.args and isinstance(node.args[0], ast.Dict):
+                    table.update(_const_dict(node.args[0]))
+    return table
+
+
+def _kafka_fault_points(scans: List[_ModuleScan]) -> Dict[str, str]:
+    """kafka `_FAULT_POINTS = {API_FETCH: "kafka.fetch", ...}`: the
+    API_* identifier maps to the registry command name (fetch)."""
+    out: Dict[str, str] = {}
+    for scan in scans:
+        if scan.rel != "streaming/kafka_client.py":
+            continue
+        for node in ast.walk(scan.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Dict) and \
+                    any(isinstance(t, ast.Name) and
+                        t.id == "_FAULT_POINTS" for t in node.targets):
+                for ident, fp in _const_dict(node.value).items():
+                    if ident.startswith("API_"):
+                        out[ident[len("API_"):].lower()] = fp
+    return out
+
+
+def _executor_rpc_sites(scans: List[_ModuleScan]
+                        ) -> Dict[str, Tuple[str, int]]:
+    """cmd -> (fleet.<site>, line) from `self._rpc(<site>, {"cmd": ..})`
+    call sites in the executor client."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for scan in scans:
+        if scan.rel != "serving/executor_endpoint.py":
+            continue
+        for node in ast.walk(scan.tree):
+            if not (isinstance(node, ast.Call) and
+                    _call_name(node) == "_rpc" and len(node.args) >= 2):
+                continue
+            site, header = node.args[0], node.args[1]
+            if not (isinstance(site, ast.Constant) and
+                    isinstance(site.value, str) and
+                    isinstance(header, ast.Dict)):
+                continue
+            cmd = _dict_cmd(header)
+            if cmd is not None:
+                out.setdefault(cmd, (f"fleet.{site.value}", node.lineno))
+    return out
+
+
+def _body_has_waiver(scan: _ModuleScan, fn: ast.FunctionDef) -> bool:
+    end = getattr(fn, "end_lineno", None) or fn.lineno
+    for line in scan.src_lines[fn.lineno - 1:end]:
+        if "# wirecheck: waive" in line:
+            return True
+    return False
+
+
+def analyze_protocol(root: Optional[str] = None) -> ProtocolReport:
+    """Run the full static protocol pass over the auron_tpu package."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    scans = _load_package(root)
+    report = ProtocolReport()
+    sink = DiagnosticSink()
+
+    # -- 0. registry self-consistency --------------------------------------
+    for wire, cmds in wirecheck.COMMANDS.items():
+        for name, spec in cmds.items():
+            where = f"runtime/wirecheck.py:{wire}.{name}"
+            if spec.idempotency not in ("idempotent", "dedup-keyed",
+                                        "non-replayable"):
+                sink.error(PASS_ID, where, None,
+                           f"unknown idempotency class "
+                           f"{spec.idempotency!r}")
+            if spec.idempotency == "dedup-keyed":
+                if not spec.dedup_key:
+                    sink.error(PASS_ID, where, None,
+                               "dedup-keyed command declares no "
+                               "dedup_key",
+                               hint="name the request field the server "
+                                    "deduplicates on")
+                elif spec.dedup_key not in spec.request:
+                    sink.error(PASS_ID, where, None,
+                               f"dedup_key {spec.dedup_key!r} is not a "
+                               f"declared request field")
+            try:
+                major = int(spec.since.split(".", 1)[0])
+            except ValueError:
+                major = -1
+            if major < 0 or major > wirecheck.PROTO_MAJOR:
+                sink.error(PASS_ID, where, None,
+                           f"since version {spec.since!r} is not a "
+                           f"released protocol version "
+                           f"(current {wirecheck.PROTO_MAJOR}."
+                           f"{wirecheck.PROTO_MINOR})")
+            if spec.in_ladder and not spec.fault_point:
+                sink.error(PASS_ID, where, None,
+                           "ladder command declares no fault_point",
+                           hint="every client RPC site must ride a "
+                                "named chaos fault point")
+
+    # -- 1. ladder exhaustiveness, both directions --------------------------
+    for wire, (rel, meth) in _LADDERS.items():
+        fn = _find_function(scans, rel, meth)
+        if fn is None:
+            sink.error(PASS_ID, rel, None,
+                       f"server dispatch method {meth!r} not found "
+                       f"(the {wire} ladder moved?)")
+            continue
+        ladder = _ladder_cmds(fn)
+        report.ladders[wire] = ladder
+        declared = {n for n, s in wirecheck.COMMANDS[wire].items()
+                    if s.in_ladder}
+        for cmd in sorted(ladder - declared):
+            sink.error(PASS_ID, f"{rel}:{fn.lineno}", None,
+                       f"ladder dispatches {cmd!r} but the wirecheck "
+                       f"registry does not declare it on wire "
+                       f"{wire!r}",
+                       hint="declare it in runtime/wirecheck.py "
+                            "COMMANDS (schema, idempotency, fault "
+                            "point, since-version)")
+        for cmd in sorted(declared - ladder):
+            sink.error(PASS_ID, f"{rel}:{fn.lineno}", None,
+                       f"registry declares {wire}.{cmd} but the server "
+                       f"ladder never dispatches it",
+                       hint="add the ladder arm, or mark the command "
+                            "in_ladder=False / remove it")
+
+    # -- 2. client request literals ∈ registry ------------------------------
+    for scan in scans:
+        wire = _wire_of(scan.rel)
+        if wire is None:
+            continue
+        for node in ast.walk(scan.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            cmd = _dict_cmd(node)
+            if cmd is None:
+                continue
+            report.client_cmds.setdefault(wire, set()).add(cmd)
+            if wirecheck.command(wire, cmd) is None:
+                sink.error(PASS_ID, f"{scan.rel}:{node.lineno}", None,
+                           f"client constructs undeclared command "
+                           f"{cmd!r} on wire {wire!r}",
+                           hint="declare it in the wirecheck registry")
+
+    # -- 3. transports ride fault_point + the ONE retry policy --------------
+    for wire, (rel, name) in _TRANSPORTS.items():
+        fn = _find_function(scans, rel, name)
+        if fn is None:
+            sink.error(PASS_ID, rel, None,
+                       f"transport function {name!r} not found (the "
+                       f"{wire} client moved?)")
+            continue
+        if not _contains_call(fn, "fault_point"):
+            sink.error(PASS_ID, f"{rel}:{fn.lineno}", None,
+                       f"{wire} transport {name!r} carries no named "
+                       f"fault_point",
+                       hint="chaos coverage requires every RPC spine "
+                            "to be injectable")
+        if not _contains_call(fn, "call_with_retry"):
+            sink.error(PASS_ID, f"{rel}:{fn.lineno}", None,
+                       f"{wire} transport {name!r} does not ride "
+                       f"call_with_retry",
+                       hint="all wire RPCs share the ONE retry policy "
+                            "(runtime/retry.py)")
+    # the engine's streaming path replays by hand (pre-first-batch
+    # only); it still must be a named injectable site
+    es = _find_function(scans, "service/engine.py", "execute_stream")
+    if es is not None and not _contains_call(es, "fault_point"):
+        sink.error(PASS_ID, "service/engine.py", None,
+                   "execute_stream carries no named fault_point")
+
+    # -- 4. observed fault points match the registry ------------------------
+    rss_fp = _fault_point_table(scans)
+    report.observed_fps["rss"] = rss_fp
+    for name, spec in wirecheck.COMMANDS["rss"].items():
+        observed = rss_fp.get(name, f"shuffle.{name}")
+        if observed != spec.fault_point:
+            sink.error(PASS_ID, "shuffle_rss/celeborn.py", None,
+                       f"rss.{name} rides fault point {observed!r} in "
+                       f"code but the registry declares "
+                       f"{spec.fault_point!r}")
+    exec_sites = _executor_rpc_sites(scans)
+    report.observed_fps["executor"] = {c: fp for c, (fp, _l)
+                                       in exec_sites.items()}
+    for cmd, (fp, line) in sorted(exec_sites.items()):
+        spec = wirecheck.command("executor", cmd)
+        if spec is not None and fp != spec.fault_point:
+            sink.error(PASS_ID,
+                       f"serving/executor_endpoint.py:{line}", None,
+                       f"executor.{cmd} rides fault point {fp!r} in "
+                       f"code but the registry declares "
+                       f"{spec.fault_point!r}")
+    kafka_fp = _kafka_fault_points(scans)
+    report.observed_fps["kafka"] = kafka_fp
+    for name, spec in wirecheck.COMMANDS["kafka"].items():
+        observed = kafka_fp.get(name, "kafka.call")
+        if observed != spec.fault_point:
+            sink.error(PASS_ID, "streaming/kafka_client.py", None,
+                       f"kafka.{name} rides fault point {observed!r} "
+                       f"in code but the registry declares "
+                       f"{spec.fault_point!r}")
+    for name, spec in wirecheck.COMMANDS["engine"].items():
+        if spec.fault_point not in (None, "service.call"):
+            sink.error(PASS_ID, "service/engine.py", None,
+                       f"engine.{name} declares fault point "
+                       f"{spec.fault_point!r} but every engine call "
+                       f"rides 'service.call'")
+
+    # -- 5. idempotency vs the replaying tiers ------------------------------
+    # rss / executor / kafka clients have exactly ONE transport, and it
+    # replays: every command they construct is inside the tier.  The
+    # engine client is mixed (control plane rides _call; execute /
+    # resource_data deliberately do not), so only literals passed
+    # DIRECTLY to _call count.
+    report.tier_cmds["rss"] = set(report.client_cmds.get("rss", ()))
+    report.tier_cmds["executor"] = set(exec_sites)
+    report.tier_cmds["kafka"] = set(wirecheck.COMMANDS["kafka"])
+    engine_tier: Set[str] = set()
+    for scan in scans:
+        if scan.rel != "service/engine.py":
+            continue
+        for node in ast.walk(scan.tree):
+            if isinstance(node, ast.Call) and \
+                    _call_name(node) == "_call" and node.args and \
+                    isinstance(node.args[0], ast.Dict):
+                cmd = _dict_cmd(node.args[0])
+                if cmd is not None:
+                    engine_tier.add(cmd)
+    report.tier_cmds["engine"] = engine_tier
+    for wire, cmds in report.tier_cmds.items():
+        for cmd in sorted(cmds):
+            spec = wirecheck.command(wire, cmd)
+            if spec is None:
+                continue   # already diagnosed above
+            if spec.idempotency == "non-replayable":
+                sink.error(
+                    PASS_ID, f"runtime/wirecheck.py:{wire}.{cmd}", None,
+                    f"non-replayable command {wire}.{cmd} is "
+                    f"dispatched through the replaying retry tier "
+                    f"without a dedup token",
+                    hint="give the server a dedup key (the MCOMMIT/"
+                         "push_id pattern) and declare dedup-keyed, "
+                         "or move the call off call_with_retry")
+
+    # -- 6. raw struct framing outside the shared helpers -------------------
+    for scan in scans:
+        for fn in _functions(scan):
+            has_struct = any(
+                isinstance(n, ast.Call) and
+                isinstance(n.func, ast.Attribute) and
+                n.func.attr in ("pack", "unpack", "pack_into",
+                                "unpack_from") and
+                isinstance(n.func.value, ast.Name) and
+                n.func.value.id == "struct"
+                for n in ast.walk(fn))
+            has_socket = any(
+                isinstance(n, ast.Call) and
+                isinstance(n.func, ast.Attribute) and
+                n.func.attr in ("sendall", "send", "recv", "recv_into")
+                for n in ast.walk(fn))
+            if not (has_struct and has_socket):
+                continue
+            site = f"{scan.rel}:{fn.lineno}"
+            report.framing_sites.append(site)
+            if (scan.rel, fn.name) in _FRAMING_ALLOWLIST:
+                continue
+            if _body_has_waiver(scan, fn):
+                continue
+            sink.error(PASS_ID, site, None,
+                       f"function {fn.name!r} hand-rolls struct "
+                       f"framing over a socket outside the shared "
+                       f"framed-TCP helpers",
+                       hint="use shuffle_rss.server.send_msg/recv_msg, "
+                            "or annotate the body with '# wirecheck: "
+                            "waive (<reason>)' for a foreign binary "
+                            "protocol")
+
+    report.result = AnalysisResult(diagnostics=sink.diagnostics)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# golden wire manifest (tests/golden_plans/wire_manifest.txt)
+# ---------------------------------------------------------------------------
+
+GOLDEN_HEADER = (
+    "# Wire-protocol manifest over auron_tpu/ — every command on every\n"
+    "# framed wire with its since-version, idempotency class (and dedup\n"
+    "# key) and named fault point; the committed contract the static\n"
+    "# protocol pass and the dynamic checker (runtime/wirecheck.py)\n"
+    "# both enforce.\n"
+    "# Regenerate: python -m auron_tpu.analysis --protocol "
+    "--regen-golden\n")
+
+
+def _row(spec) -> str:
+    idem = spec.idempotency
+    if spec.dedup_key:
+        idem += f"[{spec.dedup_key}]"
+    flags = []
+    if spec.stream is not None:
+        flags.append("stream")
+    if not spec.framed:
+        flags.append("unframed")
+    if spec.framed and not spec.in_ladder:
+        flags.append("reply")
+    return (f"cmd {spec.wire}.{spec.name} v{spec.since} {idem} "
+            f"@ {spec.fault_point or '-'}"
+            + (" " + " ".join(flags) if flags else ""))
+
+
+def render_golden() -> str:
+    lines = [GOLDEN_HEADER.rstrip(),
+             f"proto {wirecheck.PROTO_MAJOR}.{wirecheck.PROTO_MINOR}"]
+    for wire in sorted(wirecheck.COMMANDS):
+        for name in sorted(wirecheck.COMMANDS[wire]):
+            lines.append(_row(wirecheck.COMMANDS[wire][name]))
+    return "\n".join(lines) + "\n"
+
+
+def parse_golden(text: str) -> Tuple[Optional[str], Dict[str, str]]:
+    """-> (proto version, {"wire.name": rest-of-row})."""
+    proto: Optional[str] = None
+    rows: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split(None, 2)
+        if parts[0] == "proto" and len(parts) >= 2:
+            proto = parts[1]
+        elif parts[0] == "cmd" and len(parts) == 3:
+            rows[parts[1]] = parts[2]
+    return proto, rows
+
+
+def golden_path() -> str:
+    env = os.environ.get("AURON_GOLDEN_PLANS")
+    base = env or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tests", "golden_plans")
+    return os.path.join(base, "wire_manifest.txt")
+
+
+def check_against_golden(path: Optional[str] = None) -> List[str]:
+    """Mismatch descriptions ([] = clean).  A drifted manifest is an
+    error with a regen hint, exactly like the lock-order golden."""
+    path = path or golden_path()
+    if not os.path.exists(path):
+        return [f"missing golden wire manifest {path} "
+                f"(regen: python -m auron_tpu.analysis --protocol "
+                f"--regen-golden)"]
+    with open(path) as fh:
+        proto, rows = parse_golden(fh.read())
+    _cur_proto, cur_rows = parse_golden(render_golden())
+    problems: List[str] = []
+    if proto != _cur_proto:
+        problems.append(f"protocol version drifted: golden {proto} vs "
+                        f"current {_cur_proto}")
+    for key in sorted(set(cur_rows) - set(rows)):
+        problems.append(f"command {key} not in golden")
+    for key in sorted(set(rows) - set(cur_rows)):
+        problems.append(f"golden command {key} no longer declared")
+    for key in sorted(set(rows) & set(cur_rows)):
+        if rows[key] != cur_rows[key]:
+            problems.append(f"command {key} changed: golden "
+                            f"{rows[key]!r} vs current "
+                            f"{cur_rows[key]!r}")
+    if problems:
+        problems.append("regen: python -m auron_tpu.analysis "
+                        "--protocol --regen-golden")
+    return problems

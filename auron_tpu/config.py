@@ -1275,6 +1275,41 @@ JITCHECK_TRANSFER_GUARD = conf.define(
     "kernel_cache.host_sync or jitcheck.declared_transfer(site) with "
     "a '# jitcheck: waive' comment.",
 )
+WIRECHECK_ENABLE = conf.define(
+    "auron.wirecheck.enable", False,
+    "Wire-protocol conformance checking (runtime/wirecheck.py): frame "
+    "headers on the framed-TCP wires (executor endpoint, RSS shuffle "
+    "server, engine service) are validated against the declarative "
+    "command registry at the client send/receive boundaries (structured "
+    "WirecheckError with wire, command, field and fix hint instead of a "
+    "downstream KeyError) and at the server receive boundary (answered "
+    "in-band as a deterministic error; the connection survives).  "
+    "Decided at process start from the env fallback (AURON_TPU_AURON_"
+    "WIRECHECK_ENABLE=1); off (default) every check is one flag read "
+    "and the framed path is bit-identical to the unchecked one.  "
+    "Forced on under the test suite (tests/conftest.py), like "
+    "auron.lockcheck.enable.  The static half is `python -m "
+    "auron_tpu.analysis --protocol` against tests/golden_plans/"
+    "wire_manifest.txt.",
+)
+WIRECHECK_RAISE = conf.define(
+    "auron.wirecheck.raise", True,
+    "Raise WirecheckError at the violating client send/receive site "
+    "(the malformed frame never crosses the wire).  Off = record "
+    "structured diagnostics (wirecheck.diagnostics()) without raising.  "
+    "Server-side validation never raises either way: it answers "
+    "in-band.",
+)
+WIRE_PROTO_VERSION = conf.define(
+    "auron.wire.proto.version", "",
+    "Override the protocol version this process ADVERTISES (hello "
+    "responses, listening lines) and asserts as a client — a test "
+    "hook for impersonating a newer peer in version-handshake tests.  "
+    "Empty (default) = the build's own version (wirecheck.PROTO_MAJOR."
+    "PROTO_MINOR).  Peers refuse a newer MAJOR version with a "
+    "structured refusal frame; minor drift is compatible by the "
+    "fix-forward rule.",
+)
 KERNEL_COST_PROFILE_PATH = conf.define(
     "auron.kernel.cost.profile.path", "",
     "Path to a recorded kernel-profile artifact (a BENCH_r0x.json or a "

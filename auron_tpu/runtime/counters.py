@@ -82,6 +82,10 @@ _COUNTERS: Dict[str, int] = {
     # `dropped` counts feed trace_truncated on the exported trace; this
     # is the process total `auron_trace_dropped_events_total` exports)
     "trace_dropped_events": 0,
+    # wire-protocol contract layer (runtime/wirecheck.py): peers
+    # refused by the version handshake (`auron_wire_rejects_total`);
+    # per-(wire,cmd) frame counts fold in from wirecheck.frame_counts()
+    "wire_rejects": 0,
 }
 
 # -- latency histograms (the /metrics `auron_query_*_seconds` family) -------
@@ -154,15 +158,18 @@ def get(key: str) -> int:
 def snapshot() -> Dict[str, int]:
     """Flat counter snapshot: lifecycle counters here + the retry-policy
     stats (prefixed `retry_`) + per-site jit compile counts (prefixed
-    `jit_compiles_`, runtime/jitcheck.py) so `/metrics` exports one
-    namespace."""
-    from auron_tpu.runtime import jitcheck, retry
+    `jit_compiles_`, runtime/jitcheck.py) + per-(wire,cmd) frame counts
+    (prefixed `wire_frames_`, runtime/wirecheck.py) so `/metrics`
+    exports one namespace."""
+    from auron_tpu.runtime import jitcheck, retry, wirecheck
     with _LOCK:
         out = dict(_COUNTERS)
     for k, v in retry.stats_snapshot().items():
         out[f"retry_{k}"] = v
     for site, n in jitcheck.compile_counts().items():
         out[f"jit_compiles_{site}"] = n
+    for (wire, cmd), n in wirecheck.frame_counts().items():
+        out[f"wire_frames_{wire}_{cmd}"] = n
     return out
 
 

@@ -221,6 +221,19 @@ def _prometheus_text() -> str:
          help_="spans dropped past auron.trace.max.events across all "
                "recorders (per-query drops flag trace_truncated on "
                "the exported trace)")
+    emit("auron_wire_rejects_total", snap.get("wire_rejects", 0),
+         help_="peers refused by the wire-protocol version handshake "
+               "(runtime/wirecheck.py refusal frames, both directions)")
+    from auron_tpu.runtime import wirecheck
+    frames = wirecheck.frame_counts()
+    name = "auron_wire_frames_total"
+    lines.append(f"# HELP {name} frames served/sent per wire and "
+                 f"command (wirecheck conformance counting; empty "
+                 f"until auron.wirecheck.enable)")
+    lines.append(f"# TYPE {name} counter")
+    for (wire, cmd), n in sorted(frames.items()):
+        lines.append(f'{name}{{wire="{_prom_escape(wire)}",'
+                     f'cmd="{_prom_escape(cmd)}"}} {n}')
     sched = _serving_scheduler()
     up_fn = getattr(sched, "executor_up", None)
     if callable(up_fn):

@@ -18,7 +18,17 @@ Classification is a two-way split:
   identically forever.
 - **deterministic**: everything else (ValueError, RuntimeError, plan
   verification errors, injected `error` faults) — retrying replays the
-  same failure, so it ferries immediately.
+  same failure, so it ferries immediately.  Wire-contract violations
+  (`wirecheck.WirecheckError`, the RSS server's in-band protocol
+  errors, version-handshake refusals) declare
+  ``auron_deterministic = True``: a malformed or refused frame fails
+  identically on every replay, so no retry tier ever spins on it.
+
+WHICH commands may sit inside a replaying tier at all is declared in
+the wirecheck registry (runtime/wirecheck.py, idempotency classes) and
+statically enforced by `python -m auron_tpu.analysis --protocol` — a
+non-replayable command dispatched through `call_with_retry` without a
+dedup token is a CI error, not a review comment.
 
 Backoff is capped exponential with *seeded* jitter: attempt N sleeps
 ``min(base * 2**N, max) * (1 + jitter * u)`` with ``u`` drawn from a

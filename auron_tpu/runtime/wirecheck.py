@@ -1,0 +1,648 @@
+"""Wire-protocol contract registry + runtime frame conformance
+(wirecheck).
+
+The reference engine's JVM↔native boundary is safe because the protobuf
+task definition is ONE typed contract; our four framed-TCP wires — the
+executor endpoint (serving/executor_endpoint.py), the RSS shuffle
+server's aggregate/block/durable dispatch (shuffle_rss/server.py), the
+engine service (service/engine.py) and the kafka client
+(streaming/kafka_client.py) — grew as stringly-typed if/elif ladders.
+This module is the contract: the third member of the house pattern
+(lockcheck owns locks, jitcheck owns compiles, wirecheck owns frames).
+
+Every wire command is declared ONCE in `COMMANDS` with
+
+- its request/response field schemas (name -> type, required or not),
+- an IDEMPOTENCY class — ``idempotent`` (replaying is always safe),
+  ``dedup-keyed`` (replay is safe because the server deduplicates on
+  the declared ``dedup_key``: push_id / block_id / attempt — the
+  MCOMMIT contract PR 12 audited by hand), or ``non-replayable``
+  (a blind transport replay can duplicate effects; such a command must
+  NOT sit inside a `call_with_retry` tier),
+- the named `fault_point` its client rides (the chaos vocabulary), and
+- the protocol version that introduced it (``since``).
+
+The static half is `auron_tpu/analysis/protocol.py`: it AST-checks that
+the server dispatch ladders and this registry cover each other exactly,
+that every client RPC site rides its declared fault point and the ONE
+shared retry policy consistently with the idempotency class, and that
+no raw `struct.pack` framing exists outside the shared helpers; the
+committed golden is `tests/golden_plans/wire_manifest.txt`.
+
+The dynamic half lives here, following the lockcheck/jitcheck template:
+
+- ``check_request`` / ``check_response`` validate a frame header at the
+  CLIENT send/receive boundary and raise a structured `WirecheckError`
+  (wire, command, field, fix hint) instead of a downstream `KeyError`;
+- ``request_problem`` validates at the SERVER receive boundary and only
+  RECORDS the diagnostic — the server answers the problem in-band as a
+  structured ``{"ok": False, "deterministic": True}`` error and keeps
+  the connection, because raising would kill the handler thread;
+- ``note_frame`` counts frames per (wire, command) for the Prometheus
+  ``auron_wire_frames_total{wire,cmd}`` series.
+
+COST CONTRACT: with ``auron.wirecheck.enable`` off (the default) every
+check above is one module-global flag read and the framed path is
+bit-identical to the unchecked one.  Enablement is decided at process
+start from the env fallback (``AURON_TPU_AURON_WIRECHECK_ENABLE``); the
+test suite forces it on in `tests/conftest.py` exactly like lockcheck.
+
+VERSION NEGOTIATION is deliberately NOT gated on the enable flag (it is
+fix-forward wire behavior, not checking): servers advertise
+``proto_version`` in their hello responses and listening lines, clients
+may send ``proto`` in a request header, and a peer with a NEWER MAJOR
+version receives a structured refusal frame (``refusal_frame``) plus a
+flight-recorder ``wire.refusal`` event — never a hang or a garbled
+decode.  This is the seam the multi-host token-per-frame authn rides.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from auron_tpu.runtime import lockcheck
+
+__all__ = [
+    "PROTO_MAJOR", "PROTO_MINOR", "proto_version",
+    "Field", "Command", "COMMANDS", "WIRES", "command",
+    "WireDiagnostic", "WirecheckError",
+    "check_request", "request_problem", "check_response",
+    "check_stream_frame", "note_frame", "frame_counts",
+    "peer_refusal", "advertised_refusal", "refusal_frame",
+    "enabled", "configure", "diagnostics", "clear_diagnostics",
+    "reset_state",
+]
+
+# the CURRENT protocol: servers advertise it, clients may assert it.
+# Fix-forward rule: a newer MINOR is compatible (new optional fields,
+# new commands an old peer never sends); a newer MAJOR is refused.
+PROTO_MAJOR = 1
+PROTO_MINOR = 0
+
+MAX_DIAGNOSTICS = 256
+
+
+def _env_bool(key: str, default: bool = False) -> bool:
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+# decided at import (env fallback of `auron.wirecheck.enable`), like
+# lockcheck: off => every check is one flag read, the wire path is
+# bit-identical to the unchecked one.
+_ENABLED = _env_bool("AURON_TPU_AURON_WIRECHECK_ENABLE")
+_RAISE = _env_bool("AURON_TPU_AURON_WIRECHECK_RAISE", True)
+
+# leaf-only guard: no code path acquires another lock while holding it
+_GUARD = lockcheck.Lock("wirecheck")
+_DIAGNOSTICS: List["WireDiagnostic"] = []
+_SEEN_KEYS: set = set()
+_FRAMES: Dict[Tuple[str, str], int] = {}
+
+
+def proto_version() -> str:
+    """The advertised protocol version string.  The conf override
+    (`auron.wire.proto.version`) lets tests impersonate a newer peer;
+    empty means the build's own PROTO_MAJOR.PROTO_MINOR."""
+    try:
+        from auron_tpu.config import conf
+        raw = str(conf.get("auron.wire.proto.version")).strip()
+    except Exception:
+        raw = ""
+    return raw if raw else f"{PROTO_MAJOR}.{PROTO_MINOR}"
+
+
+class WirecheckError(RuntimeError):
+    """A wire-contract violation (client-side: raised BEFORE the bad
+    frame is sent / acted on).  Deterministic for the shared retry
+    policy — replaying a malformed frame cannot make it well-formed."""
+
+    auron_deterministic = True
+
+    def __init__(self, diagnostic: "WireDiagnostic"):
+        self.diagnostic = diagnostic
+        super().__init__(str(diagnostic))
+
+
+@dataclass(frozen=True)
+class WireDiagnostic:
+    """One structured finding of the dynamic checker."""
+    kind: str                 # unknown-command | missing-field |
+    #                           bad-type | unknown-field | bad-frame
+    wire: str
+    cmd: str
+    field: str
+    message: str
+    hint: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "wire": self.wire, "cmd": self.cmd,
+                "field": self.field, "message": self.message,
+                "hint": self.hint}
+
+    def __str__(self) -> str:
+        s = f"wirecheck[{self.kind}] {self.wire}.{self.cmd}" \
+            f"{' field ' + self.field if self.field else ''}: " \
+            f"{self.message}"
+        if self.hint:
+            s += f"  hint: {self.hint}"
+        return s
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Field:
+    """One declared frame field: a type name (str | int | num | bool |
+    list | dict | any) and whether the field is required."""
+    type: str
+    required: bool = False
+
+
+@dataclass(frozen=True)
+class Command:
+    """One wire command, declared once.
+
+    ``framed``     — rides the shared JSON-header framing of
+                     `shuffle_rss.server.send_msg/recv_msg` (the kafka
+                     wire is binary: framed=False, waived in code).
+    ``in_ladder``  — appears in a server dispatch ladder (client->server
+                     reply frames like engine `resource_data` do not).
+    ``stream``     — for streaming commands (engine `execute`): frame
+                     type -> field schema of the server->client frames.
+    ``dedup_key``  — the request field that makes a replayed delivery
+                     at-most-once server-side (dedup-keyed class only).
+    """
+    wire: str
+    name: str
+    since: str
+    idempotency: str          # idempotent | dedup-keyed | non-replayable
+    fault_point: Optional[str]
+    request: Mapping[str, Field]
+    response: Mapping[str, Field]
+    dedup_key: Optional[str] = None
+    framed: bool = True
+    in_ladder: bool = True
+    stream: Optional[Mapping[str, Mapping[str, Field]]] = None
+
+
+def _f(spec: str) -> Field:
+    if spec.endswith("!"):
+        return Field(spec[:-1], True)
+    return Field(spec, False)
+
+
+def _fields(d: Mapping[str, str]) -> Dict[str, Field]:
+    return {k: _f(v) for k, v in d.items()}
+
+
+# request fields every framed command may carry: the command selector,
+# the payload length, the durable trace flag (durable._guarded_request
+# sets it when a recorder is armed) and the optional client protocol
+# assertion the version handshake rides.
+GLOBAL_REQUEST: Dict[str, Field] = _fields(
+    {"cmd": "str", "len": "int", "trace": "any", "proto": "str"})
+
+# response fields every framed command may carry: the ok bit, the
+# structured error surface (error/deterministic/exhausted/draining —
+# the retry-classification markers that cross the wire), the refusal
+# bit + advertised version of the handshake, and the payload length.
+GLOBAL_RESPONSE: Dict[str, Field] = _fields(
+    {"ok": "bool", "error": "str", "deterministic": "bool",
+     "exhausted": "bool", "draining": "bool", "refused": "bool",
+     "proto_version": "str", "len": "int"})
+
+COMMANDS: Dict[str, Dict[str, Command]] = {}
+
+
+def _cmd(wire: str, name: str, *, idem: str, fp: Optional[str],
+         req: Mapping[str, str], resp: Mapping[str, str],
+         since: str = "1.0", dedup_key: Optional[str] = None,
+         framed: bool = True, in_ladder: bool = True,
+         stream: Optional[Mapping[str, Mapping[str, str]]] = None
+         ) -> None:
+    COMMANDS.setdefault(wire, {})[name] = Command(
+        wire=wire, name=name, since=since, idempotency=idem,
+        fault_point=fp, request=_fields(req), response=_fields(resp),
+        dedup_key=dedup_key, framed=framed, in_ladder=in_ladder,
+        stream=None if stream is None else
+        {t: _fields(f) for t, f in stream.items()})
+
+
+# -- rss: the shuffle side-car wire (shuffle_rss/server.py ladder;
+#    clients celeborn.py / uniffle.py / durable.py over _Conn.request) --
+_cmd("rss", "ping", idem="idempotent", fp="rss.ping",
+     req={}, resp={"now": "num!"})
+_cmd("rss", "push", idem="dedup-keyed", dedup_key="push_id",
+     fp="shuffle.push",
+     req={"shuffle": "str!", "partition": "int!", "push_id": "str"},
+     resp={})
+_cmd("rss", "push_block", idem="dedup-keyed", dedup_key="block_id",
+     fp="shuffle.push",
+     req={"shuffle": "str!", "partition": "int!", "block_id": "str!"},
+     resp={})
+_cmd("rss", "fetch", idem="idempotent", fp="shuffle.fetch",
+     req={"shuffle": "str!", "partition": "int!"}, resp={})
+_cmd("rss", "fetch_blocks", idem="idempotent", fp="shuffle.fetch",
+     req={"shuffle": "str!", "partition": "int!"},
+     resp={"blocks": "list!"})
+_cmd("rss", "mpush", idem="dedup-keyed", dedup_key="push_id",
+     fp="rss.push",
+     req={"shuffle": "str!", "map": "int!", "attempt": "str!",
+          "partition": "int!", "push_id": "str"},
+     resp={})
+_cmd("rss", "mcommit", idem="dedup-keyed", dedup_key="attempt",
+     fp="rss.commit",
+     req={"shuffle": "str!", "map": "int!", "attempt": "str!"},
+     resp={"maps": "int!"})
+_cmd("rss", "mseal", idem="idempotent", fp="rss.commit",
+     req={"shuffle": "str!", "maps": "int!"}, resp={})
+_cmd("rss", "manifest", idem="idempotent", fp="rss.manifest",
+     req={"shuffle": "str!"},
+     resp={"sealed": "any!", "maps": "dict!"})
+_cmd("rss", "mfetch", idem="idempotent", fp="rss.fetch",
+     req={"shuffle": "str!", "partition": "int!"},
+     resp={"blocks": "list!"})
+_cmd("rss", "stats", idem="idempotent", fp="rss.manifest",
+     req={"prefix": "str"},
+     resp={"shuffles": "dict!", "totals": "dict!"})
+_cmd("rss", "delete", idem="idempotent", fp="shuffle.delete",
+     req={"shuffle": "str!"}, resp={})
+_cmd("rss", "delete_prefix", idem="idempotent", fp="rss.manifest",
+     req={"prefix": "str!"}, resp={})
+# tspans is harvest-AND-CLEAR but still classed idempotent: spans are
+# best-effort telemetry, and a replayed harvest returns the (possibly
+# empty) remainder — no state is duplicated or corrupted by replay.
+_cmd("rss", "tspans", idem="idempotent", fp="rss.manifest",
+     req={"prefix": "str", "clear": "bool"},
+     resp={"dropped": "int!", "now": "num!"})
+
+# -- executor: the fleet wire (serving/executor_endpoint.py ladder;
+#    client ProcessExecutor._rpc -> fault_point("fleet.<site>")) --
+_EXEC_ID_RESP = {"executor_id": "str!", "pid": "int!"}
+_cmd("executor", "ping", idem="idempotent", fp="fleet.status",
+     req={}, resp=_EXEC_ID_RESP)
+_cmd("executor", "hello", idem="idempotent", fp="fleet.status",
+     req={}, resp=_EXEC_ID_RESP)
+_cmd("executor", "heartbeat", idem="idempotent", fp="fleet.heartbeat",
+     req={"ids": "list"},
+     resp={"executor_id": "str!", "pid": "int!", "now": "num!",
+           "load": "dict!", "queries": "dict!"})
+_cmd("executor", "harvest", idem="idempotent", fp="fleet.harvest",
+     req={"ids": "list"}, resp={"pid": "int!", "now": "num!"})
+# dispatch replays are made at-most-once by the query id: the worker
+# scheduler rejects a duplicate submission of an id it already holds,
+# so the retry tier the RPC rides cannot double-run a query.
+_cmd("executor", "dispatch", idem="dedup-keyed", dedup_key="query_id",
+     fp="fleet.dispatch",
+     req={"query_id": "str!", "conf": "dict", "priority": "int"},
+     resp={})
+_cmd("executor", "status", idem="idempotent", fp="fleet.status",
+     req={"query_id": "str!"}, resp={"status": "any!"})
+_cmd("executor", "result", idem="idempotent", fp="fleet.result",
+     req={"query_id": "str!"}, resp={"rows": "int!"})
+_cmd("executor", "cancel", idem="idempotent", fp="fleet.cancel",
+     req={"query_id": "str!"}, resp={"cancelled": "bool!"})
+_cmd("executor", "drain", idem="idempotent", fp="fleet.drain",
+     req={}, resp={"moved": "list!"})
+_cmd("executor", "shutdown", idem="idempotent", fp="fleet.shutdown",
+     req={}, resp={})
+
+# -- engine: the out-of-process engine service (service/engine.py
+#    ladder; client EngineClient._call / execute_stream) --
+_cmd("engine", "ping", idem="idempotent", fp="service.call",
+     req={}, resp={})
+_cmd("engine", "put_resource", idem="idempotent", fp="service.call",
+     req={"key": "str!", "kind": "str"}, resp={})
+_cmd("engine", "delete_resource", idem="idempotent", fp="service.call",
+     req={"key": "str!"}, resp={})
+# execute is NON-REPLAYABLE as a transport frame (batches already
+# consumed cannot be un-consumed by a blind replay); the client's
+# replay-before-first-batch logic in EngineClient.execute_stream is a
+# hand-rolled safe subset, deliberately NOT a call_with_retry tier.
+_cmd("engine", "execute", idem="non-replayable", fp="service.call",
+     req={},
+     resp={},
+     stream={"batch": {},
+             "done": {"metrics": "dict!"},
+             "error": {"message": "str!", "traceback": "str"},
+             "need_resource": {"key": "str!"}})
+_cmd("engine", "shutdown", idem="idempotent", fp="service.call",
+     req={}, resp={})
+# the client->server reply to a need_resource upcall: not a ladder
+# command and never inside a retry tier (it answers an open stream)
+_cmd("engine", "resource_data", idem="non-replayable", fp=None,
+     in_ladder=False,
+     req={"kind": "str!"}, resp={})
+
+# -- kafka: the broker wire (streaming/kafka_client.py).  Binary Kafka
+#    protocol — signed-i32 length prefix, no JSON header — so the
+#    shared framing does not apply (framed=False; the struct framing in
+#    kafka_client carries explicit wirecheck waivers).  All three APIs
+#    are reads: idempotent by construction. --
+_cmd("kafka", "fetch", idem="idempotent", fp="kafka.fetch",
+     req={}, resp={}, framed=False, in_ladder=False)
+_cmd("kafka", "metadata", idem="idempotent", fp="kafka.metadata",
+     req={}, resp={}, framed=False, in_ladder=False)
+_cmd("kafka", "list_offsets", idem="idempotent", fp="kafka.list_offsets",
+     req={}, resp={}, framed=False, in_ladder=False)
+
+WIRES: Tuple[str, ...] = tuple(COMMANDS)
+
+
+def command(wire: str, name: str) -> Optional[Command]:
+    """The declared command, or None."""
+    return COMMANDS.get(wire, {}).get(name)
+
+
+# ---------------------------------------------------------------------------
+# dynamic checking
+# ---------------------------------------------------------------------------
+
+def _type_ok(value: Any, type_name: str) -> bool:
+    if type_name == "any":
+        return True
+    if type_name == "str":
+        return isinstance(value, str)
+    if type_name == "int":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if type_name == "num":
+        return isinstance(value, (int, float)) and \
+            not isinstance(value, bool)
+    if type_name == "bool":
+        # JSON round-trips may widen bools; 0/1 ints are acceptable
+        return isinstance(value, bool) or value in (0, 1)
+    if type_name == "list":
+        return isinstance(value, (list, tuple))
+    if type_name == "dict":
+        return isinstance(value, dict)
+    return True
+
+
+def _report(diag: WireDiagnostic, dedupe_key: Optional[tuple],
+            do_raise: bool = True) -> None:
+    with _GUARD:
+        if dedupe_key is not None:
+            if dedupe_key in _SEEN_KEYS and not (_RAISE and do_raise):
+                return
+            _SEEN_KEYS.add(dedupe_key)
+        if len(_DIAGNOSTICS) < MAX_DIAGNOSTICS:
+            _DIAGNOSTICS.append(diag)
+    if _RAISE and do_raise:
+        raise WirecheckError(diag)
+
+
+def _frame_problems(wire: str, spec: Command, header: Mapping[str, Any],
+                    schema: Mapping[str, Field],
+                    globals_: Mapping[str, Field],
+                    direction: str) -> List[WireDiagnostic]:
+    name = spec.name
+    out: List[WireDiagnostic] = []
+    for fname, f in schema.items():
+        if f.required and fname not in header:
+            out.append(WireDiagnostic(
+                kind="missing-field", wire=wire, cmd=name, field=fname,
+                message=f"{direction} is missing required field "
+                        f"{fname!r} ({f.type})",
+                hint=f"declared in runtime/wirecheck.py: "
+                     f"{wire}.{name} since v{spec.since}"))
+    for fname, value in header.items():
+        f = schema.get(fname) or globals_.get(fname)
+        if f is None:
+            out.append(WireDiagnostic(
+                kind="unknown-field", wire=wire, cmd=name, field=fname,
+                message=f"{direction} carries undeclared field "
+                        f"{fname!r}",
+                hint="declare it in the wirecheck registry (and bump "
+                     "the minor protocol version) or drop it"))
+            continue
+        if value is None and not f.required:
+            continue
+        if not _type_ok(value, f.type):
+            out.append(WireDiagnostic(
+                kind="bad-type", wire=wire, cmd=name, field=fname,
+                message=f"{direction} field {fname!r} is "
+                        f"{type(value).__name__}, declared {f.type}",
+                hint=f"value: {value!r:.80}"))
+    return out
+
+
+def _check_header(wire: str, header: Mapping[str, Any],
+                  direction: str) -> List[WireDiagnostic]:
+    cmd = header.get("cmd")
+    if not isinstance(cmd, str):
+        return [WireDiagnostic(
+            kind="bad-frame", wire=wire, cmd=str(cmd), field="cmd",
+            message=f"{direction} has no string 'cmd' selector "
+                    f"(got {cmd!r})",
+            hint="every framed request carries cmd")]
+    spec = command(wire, cmd)
+    if spec is None:
+        return [WireDiagnostic(
+            kind="unknown-command", wire=wire, cmd=cmd, field="",
+            message=f"command {cmd!r} is not declared on wire "
+                    f"{wire!r}",
+            hint="add it to runtime/wirecheck.py COMMANDS (and the "
+                 "server ladder) or fix the caller")]
+    return _frame_problems(wire, spec, header, spec.request,
+                           GLOBAL_REQUEST, direction)
+
+
+def check_request(wire: str, header: Mapping[str, Any]) -> None:
+    """CLIENT send boundary: validate an outgoing request header
+    against the registry; raises WirecheckError when enabled."""
+    if not _ENABLED:
+        return
+    for diag in _check_header(wire, header, "request"):
+        _report(diag, ("req", wire, diag.cmd, diag.kind, diag.field))
+
+
+def request_problem(wire: str,
+                    header: Mapping[str, Any]) -> Optional[str]:
+    """SERVER receive boundary: validate an incoming request header.
+    Never raises — the server must answer in-band and keep serving —
+    but records the diagnostic and returns the first problem message
+    (None = conformant or checking disabled)."""
+    if not _ENABLED:
+        return None
+    problems = _check_header(wire, header, "request")
+    for diag in problems:
+        _report(diag, ("srv", wire, diag.cmd, diag.kind, diag.field),
+                do_raise=False)
+    return str(problems[0]) if problems else None
+
+
+def check_response(wire: str, cmd: str,
+                   header: Mapping[str, Any]) -> None:
+    """CLIENT receive boundary: validate a response header.  Error
+    responses (ok is not True) are shaped by GLOBAL_RESPONSE alone —
+    the per-command schema describes the success shape."""
+    if not _ENABLED:
+        return
+    spec = command(wire, cmd)
+    if spec is None:
+        return   # the request check already diagnosed the command
+    ok = header.get("ok") is True
+    schema = spec.response if ok else {}
+    for diag in _frame_problems(wire, spec, header, schema,
+                                GLOBAL_RESPONSE, "response"):
+        if not ok and diag.kind == "missing-field":
+            continue
+        _report(diag, ("resp", wire, cmd, diag.kind, diag.field))
+
+
+def check_stream_frame(wire: str, cmd: str,
+                       header: Mapping[str, Any]) -> None:
+    """CLIENT receive boundary for streaming commands (engine
+    `execute`): validate one server->client stream frame."""
+    if not _ENABLED:
+        return
+    spec = command(wire, cmd)
+    if spec is None or spec.stream is None:
+        return
+    ftype = header.get("type")
+    schema = spec.stream.get(ftype) if isinstance(ftype, str) else None
+    if schema is None:
+        _report(WireDiagnostic(
+            kind="bad-frame", wire=wire, cmd=cmd, field="type",
+            message=f"stream frame type {ftype!r} is not declared for "
+                    f"{wire}.{cmd} "
+                    f"(declared: {sorted(spec.stream)})",
+            hint="declare the frame type in the command's stream "
+                 "schema"), ("stream", wire, cmd, str(ftype)))
+        return
+    globals_ = dict(GLOBAL_RESPONSE)
+    globals_["type"] = Field("str", True)
+    for diag in _frame_problems(wire, spec, header, schema, globals_,
+                                f"stream[{ftype}] frame"):
+        _report(diag, ("stream", wire, cmd, ftype, diag.kind,
+                       diag.field))
+
+
+def note_frame(wire: str, cmd: Any) -> None:
+    """Count one served/sent frame per (wire, cmd) — the
+    `auron_wire_frames_total{wire,cmd}` series.  Enabled-only, like
+    jitcheck's compile counts: the OFF path stays untouched."""
+    if not _ENABLED:
+        return
+    key = (wire, cmd if isinstance(cmd, str) else str(cmd))
+    with _GUARD:
+        _FRAMES[key] = _FRAMES.get(key, 0) + 1
+
+
+def frame_counts() -> Dict[Tuple[str, str], int]:
+    with _GUARD:
+        return dict(_FRAMES)
+
+
+# ---------------------------------------------------------------------------
+# version negotiation (fix-forward; NOT gated on the enable flag)
+# ---------------------------------------------------------------------------
+
+def _major_of(version: Any) -> Optional[int]:
+    try:
+        return int(str(version).split(".", 1)[0])
+    except (ValueError, TypeError):
+        return None
+
+
+def peer_refusal(header: Mapping[str, Any]) -> Optional[str]:
+    """SERVER side: refusal message when a request header asserts a
+    protocol this build cannot speak (missing/older `proto` passes —
+    fix-forward keeps old peers working)."""
+    asserted = header.get("proto")
+    if asserted is None:
+        return None
+    major = _major_of(asserted)
+    if major is None:
+        return (f"unparseable protocol version {asserted!r} "
+                f"(this build speaks {proto_version()})")
+    if major > PROTO_MAJOR:
+        return (f"peer speaks protocol {asserted} but this build "
+                f"speaks {proto_version()}: upgrade this process "
+                f"before the peer")
+    return None
+
+
+def advertised_refusal(doc: Mapping[str, Any]) -> Optional[str]:
+    """CLIENT side: refusal message when a server's advertised
+    `proto_version` (hello response / listening line) has a newer
+    major than this build."""
+    advertised = doc.get("proto_version")
+    if advertised is None:
+        return None   # pre-contract server: fix-forward accepts it
+    major = _major_of(advertised)
+    if major is None:
+        return (f"server advertises unparseable protocol version "
+                f"{advertised!r} (this build speaks {proto_version()})")
+    if major > PROTO_MAJOR:
+        return (f"server speaks protocol {advertised} but this client "
+                f"speaks {proto_version()}: upgrade this process "
+                f"before the server")
+    return None
+
+
+def refusal_frame(wire: str, message: str,
+                  peer: str = "") -> Dict[str, Any]:
+    """The structured refusal a server answers a version-mismatched
+    peer with (then closes the connection).  Counted on /metrics
+    (`auron_wire_rejects_total`) and recorded on the flight recorder."""
+    from auron_tpu.runtime import counters, events
+    counters.bump("wire_rejects")
+    events.emit("wire.refusal", message, wire=wire, peer=peer,
+                proto_version=proto_version())
+    return {"ok": False, "refused": True, "deterministic": True,
+            "error": message, "proto_version": proto_version()}
+
+
+# ---------------------------------------------------------------------------
+# introspection / control
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def configure(enabled: Optional[bool] = None,
+              raise_on_violation: Optional[bool] = None) -> bool:
+    """Flip checking at runtime.  `enabled=None` re-reads
+    `auron.wirecheck.enable` from the config registry (the env fallback
+    decides the process default at import, like lockcheck)."""
+    global _ENABLED, _RAISE
+    if enabled is None:
+        from auron_tpu.config import conf
+        enabled = bool(conf.get("auron.wirecheck.enable"))
+    if raise_on_violation is None and enabled is not None:
+        from auron_tpu.config import conf
+        raise_on_violation = bool(conf.get("auron.wirecheck.raise"))
+    _ENABLED = bool(enabled)
+    if raise_on_violation is not None:
+        _RAISE = bool(raise_on_violation)
+    return _ENABLED
+
+
+def diagnostics() -> List[WireDiagnostic]:
+    with _GUARD:
+        return list(_DIAGNOSTICS)
+
+
+def clear_diagnostics() -> None:
+    with _GUARD:
+        _DIAGNOSTICS.clear()
+        _SEEN_KEYS.clear()
+
+
+def reset_state() -> None:
+    """Test hook: drop diagnostics and frame counts (the registry
+    describes code, not a run — it persists)."""
+    with _GUARD:
+        _DIAGNOSTICS.clear()
+        _SEEN_KEYS.clear()
+        _FRAMES.clear()

@@ -41,6 +41,7 @@ import pyarrow as pa
 
 from auron_tpu.config import conf
 from auron_tpu.faults import fault_point
+from auron_tpu.runtime import wirecheck
 from auron_tpu.runtime.retry import RetryPolicy, call_with_retry
 from auron_tpu.shuffle_rss.server import read_timeout, recv_msg, send_msg
 
@@ -97,6 +98,29 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             except ValueError:
                 return  # oversized/garbled frame: drop the connection
+            # version handshake (fix-forward, always on): refuse a
+            # newer-major peer with a structured frame, then close
+            refusal = wirecheck.peer_refusal(header)
+            if refusal is not None:
+                try:
+                    send_msg(sock, wirecheck.refusal_frame(
+                        "engine", refusal,
+                        peer=f"{self.client_address[0]}:"
+                             f"{self.client_address[1]}"))
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass
+                return
+            # frame conformance (enabled-only): answered in-band, the
+            # connection (and every resource registered on it) survives
+            problem = wirecheck.request_problem("engine", header)
+            if problem is not None:
+                try:
+                    send_msg(sock, {"ok": False,
+                                    "error": problem})
+                except (BrokenPipeError, ConnectionError, OSError):
+                    return
+                continue
+            wirecheck.note_frame("engine", header.get("cmd"))
             try:
                 if not self._dispatch(server, sock, header, payload):
                     return
@@ -264,11 +288,20 @@ def serve(host: str = "127.0.0.1", port: int = 0) -> None:
             pass
     s = EngineServer(host, port)
     print(json.dumps({"event": "listening", "host": s.address[0],
-                      "port": s.address[1]}), flush=True)
+                      "port": s.address[1],
+                      "proto_version": wirecheck.proto_version()}),
+          flush=True)
     s.serve_forever()
 
 
 class RemoteExecutionError(RuntimeError):
+    """The engine ANSWERED with a ferried failure.  Deterministic for
+    the shared retry policy by declaration (not just by the RuntimeError
+    default): the request reached the server, so a transport replay
+    reproduces the same answer."""
+
+    auron_deterministic = True
+
     def __init__(self, message: str, remote_traceback: str = ""):
         super().__init__(message)
         self.remote_traceback = remote_traceback
@@ -323,6 +356,8 @@ class EngineClient:
         self.close()
 
     def _call(self, header: dict, payload: bytes = b"") -> dict:
+        wirecheck.check_request("engine", header)
+
         def _once():
             fault_point("service.call")
             s = self._ensure_sock()
@@ -341,6 +376,7 @@ class EngineClient:
                 _once, policy=RetryPolicy.from_conf(),
                 label=f"engine {header.get('cmd')} to "
                       f"{self.host}:{self.port}")
+        wirecheck.check_response("engine", str(header.get("cmd")), resp)
         if not resp.get("ok"):
             raise RemoteExecutionError(resp.get("error", "request failed"))
         return resp
@@ -376,6 +412,8 @@ class EngineClient:
         data = task if isinstance(task, (bytes, bytearray)) \
             else ir_serde.serialize(task)
         self.last_metrics: dict = {}
+        wirecheck.check_request("engine", {"cmd": "execute",
+                                           "len": len(data)})
         policy = RetryPolicy.from_conf()
         rng = random.Random(policy.seed)
         attempts = max(1, policy.max_attempts)
@@ -392,6 +430,8 @@ class EngineClient:
                              data)
                 while True:
                     header, payload = recv_msg(s)
+                    wirecheck.check_stream_frame("engine", "execute",
+                                                 header)
                     t = header.get("type")
                     if t == "batch":
                         yielded = True
@@ -432,11 +472,15 @@ class EngineClient:
         s = self._ensure_sock()
         src = self._provided.get(str(key))
         if src is None:
-            send_msg(s, {"cmd": "resource_data", "kind": "missing"})
+            header = {"cmd": "resource_data", "kind": "missing"}
+            wirecheck.check_request("engine", header)
+            send_msg(s, header)
             return
         data = _batches_to_ipc(src)
-        send_msg(s, {"cmd": "resource_data", "kind": "arrow_ipc",
-                     "len": len(data)}, data)
+        header = {"cmd": "resource_data", "kind": "arrow_ipc",
+                  "len": len(data)}
+        wirecheck.check_request("engine", header)
+        send_msg(s, header, data)
 
     def execute(self, task: Any) -> pa.Table:
         batches = list(self.execute_stream(task))

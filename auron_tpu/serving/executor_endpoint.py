@@ -53,7 +53,7 @@ import pyarrow as pa
 
 from auron_tpu.config import conf
 from auron_tpu.faults import fault_point
-from auron_tpu.runtime import lockcheck
+from auron_tpu.runtime import lockcheck, wirecheck
 from auron_tpu.runtime.retry import RetryPolicy, call_with_retry
 from auron_tpu.shuffle_rss.server import recv_msg, send_msg
 
@@ -287,6 +287,29 @@ class _ExecHandler(socketserver.BaseRequestHandler):
                 header, payload = recv_msg(sock, MAX_REQUEST_PAYLOAD)
             except (ConnectionError, OSError, ValueError):
                 return
+            # version handshake (fix-forward, always on): refuse a
+            # newer-major peer with a structured frame, then close
+            refusal = wirecheck.peer_refusal(header)
+            if refusal is not None:
+                try:
+                    send_msg(sock, wirecheck.refusal_frame(
+                        "executor", refusal,
+                        peer=f"{self.client_address[0]}:"
+                             f"{self.client_address[1]}"))
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass
+                return
+            # frame conformance (enabled-only): answered in-band, the
+            # connection survives
+            problem = wirecheck.request_problem("executor", header)
+            if problem is not None:
+                try:
+                    send_msg(sock, {"ok": False, "deterministic": True,
+                                    "error": problem})
+                except (BrokenPipeError, ConnectionError, OSError):
+                    return
+                continue
+            wirecheck.note_frame("executor", header.get("cmd"))
             try:
                 if not self._dispatch(server, sock, header, payload):
                     return
@@ -313,7 +336,8 @@ class _ExecHandler(socketserver.BaseRequestHandler):
         if cmd in ("ping", "hello"):
             send_msg(sock, {"ok": True,
                             "executor_id": server.executor_id,
-                            "pid": os.getpid()})
+                            "pid": os.getpid(),
+                            "proto_version": wirecheck.proto_version()})
             return True
         if cmd == "heartbeat":
             ids = header.get("ids") or []
@@ -566,6 +590,7 @@ class ProcessExecutor(ExecutorEndpoint):
         through the shared policy.  Transport errors are retryable-IO;
         an answered failure raises EndpointError (deterministic, with
         the worker's exhausted marker mirrored)."""
+        wirecheck.check_request("executor", header)
 
         def _once():
             fault_point(f"fleet.{site}")
@@ -587,14 +612,30 @@ class ProcessExecutor(ExecutorEndpoint):
                     draining=resp.get("draining", False))
             return resp, data
 
-        return call_with_retry(
+        resp, data = call_with_retry(
             _once, policy=RetryPolicy.from_conf(max_attempts),
             label=f"fleet {site} -> {self.executor_id}")
+        wirecheck.check_response("executor", str(header.get("cmd")),
+                                 resp)
+        return resp, data
 
     # -- endpoint surface ---------------------------------------------------
 
     def hello(self) -> dict:
-        resp, _ = self._rpc("status", {"cmd": "hello"})
+        """First contact: assert this client's protocol version and
+        check the server's advertisement — a newer-major server is
+        refused with a structured EndpointError (flight-recorder
+        `wire.refusal` event), never a garbled decode later."""
+        resp, _ = self._rpc("status", {
+            "cmd": "hello", "proto": wirecheck.proto_version()})
+        refusal = wirecheck.advertised_refusal(resp)
+        if refusal is not None:
+            from auron_tpu.runtime import counters, events
+            counters.bump("wire_rejects")
+            events.emit("wire.refusal", refusal, wire="executor",
+                        peer=f"{self.host}:{self.port}",
+                        proto_version=wirecheck.proto_version())
+            raise EndpointError(refusal)
         return resp
 
     def dispatch(self, query_id: str, plan, conf_map: Dict[str, Any],
@@ -719,7 +760,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     host, port = srv.address
     print(json.dumps({"event": "listening", "host": host, "port": port,
                       "executor_id": args.executor_id,
-                      "pid": os.getpid()}), flush=True)
+                      "pid": os.getpid(),
+                      "proto_version": wirecheck.proto_version()}),
+          flush=True)
     srv.serve_forever()
     return 0
 

@@ -17,6 +17,7 @@ from typing import List
 from auron_tpu.config import conf
 from auron_tpu.faults import fault_point
 from auron_tpu.ops.shuffle.writer import RssPartitionWriter
+from auron_tpu.runtime import wirecheck
 from auron_tpu.runtime.retry import RetryPolicy, call_with_retry
 from auron_tpu.shuffle_rss.server import recv_msg, send_msg
 
@@ -24,6 +25,15 @@ from auron_tpu.shuffle_rss.server import recv_msg, send_msg
 # push/fetch vocabulary the chaos specs target
 _FAULT_POINTS = {"push": "shuffle.push", "push_block": "shuffle.push",
                  "fetch": "shuffle.fetch", "fetch_blocks": "shuffle.fetch"}
+
+
+class ShuffleServerError(RuntimeError):
+    """The server ANSWERED with an error frame.  Deterministic for the
+    shared retry policy: the transport worked, so replaying the same
+    request reproduces the same answer (transport failures stay
+    retryable OSError/EOFError on the socket path)."""
+
+    auron_deterministic = True
 
 
 def net_timeout() -> float:
@@ -64,6 +74,7 @@ class _Conn:
         # Retried pushes are safe because every push carries a dedupable
         # id (push_id / block_id) the server applies at most once.
         cmd = header.get("cmd", "")
+        wirecheck.check_request("rss", header)
 
         def _once():
             fault_point(_FAULT_POINTS.get(cmd, f"shuffle.{cmd}"))
@@ -79,8 +90,9 @@ class _Conn:
         resp, body = call_with_retry(
             _once, policy=RetryPolicy.from_conf(),
             label=f"shuffle {cmd} to {self.host}:{self.port}")
+        wirecheck.check_response("rss", cmd, resp)
         if not resp.get("ok"):
-            raise RuntimeError(f"shuffle server error: {resp}")
+            raise ShuffleServerError(f"shuffle server error: {resp}")
         return resp, body
 
 

@@ -37,7 +37,12 @@ from auron_tpu.ops.shuffle.writer import RssPartitionWriter
 from auron_tpu.shuffle_rss.celeborn import _FAULT_POINTS, _Conn
 
 # named fault points per durable wire command (the chaos vocabulary the
-# ISSUE acceptance targets); _Conn routes per-cmd through this table
+# ISSUE acceptance targets); _Conn routes per-cmd through this table.
+# NOTE: this mapping is part of the wire contract — the static protocol
+# pass (analysis/protocol.py) parses it and errors if it drifts from
+# the per-command fault points declared in runtime/wirecheck.COMMANDS,
+# and the dedup tokens it relies on (mpush push_id, mcommit attempt)
+# are declared there as idempotency classes
 _FAULT_POINTS.update({
     "mpush": "rss.push",
     "mcommit": "rss.commit",

@@ -38,7 +38,7 @@ import time
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
-from auron_tpu.runtime import lockcheck
+from auron_tpu.runtime import lockcheck, wirecheck
 
 # deliberate blocking-under-lock (see _State._maybe_spill / read_agg):
 # the state lock is the append-order and torn-read serialization point
@@ -333,9 +333,12 @@ class _Handler(socketserver.BaseRequestHandler):
         self.request.settimeout(t if t is not None else read_timeout())
         try:
             self._serve(state)
-        except (ConnectionError, OSError, ValueError):
-            # bad frame / oversized header / idle past the read timeout:
-            # drop the connection quietly
+        except (ConnectionError, OSError, ValueError, KeyError,
+                TypeError):
+            # bad frame / oversized header / malformed field types with
+            # checking off / idle past the read timeout: drop the
+            # connection quietly (a structured close, never a pinned
+            # handler thread)
             return
 
     def _serve(self, state: "_State") -> None:
@@ -347,7 +350,29 @@ class _Handler(socketserver.BaseRequestHandler):
             # conversation and the client's retry policy must recover
             # (push dedup by push_id keeps retries exactly-once)
             fault_point("shuffle.server")
-            cmd = header["cmd"]
+            cmd = header.get("cmd")
+            # version handshake (fix-forward, independent of the
+            # wirecheck enable flag): a peer asserting a newer major
+            # protocol gets a structured refusal and a closed
+            # connection — never a garbled decode of frames this build
+            # does not understand
+            refusal = wirecheck.peer_refusal(header)
+            if refusal is not None:
+                send_msg(self.request, wirecheck.refusal_frame(
+                    "rss", refusal,
+                    peer=f"{self.client_address[0]}:"
+                         f"{self.client_address[1]}"))
+                return
+            # frame conformance (enabled-only): a malformed request is
+            # answered in-band as a deterministic error — the handler
+            # thread and the connection both survive
+            problem = wirecheck.request_problem("rss", header)
+            if problem is not None:
+                send_msg(self.request, {"ok": False,
+                                        "deterministic": True,
+                                        "error": problem})
+                continue
+            wirecheck.note_frame("rss", cmd)
             # server-side span recording for the durable commit
             # protocol: armed per REQUEST by the client's trace flag
             # (zero cost otherwise), keyed by the sid's query tag,
@@ -556,7 +581,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         else None).start()
     host, port = srv.address
     print(json.dumps({"event": "listening", "host": host, "port": port,
-                      "pid": os.getpid()}), flush=True)
+                      "pid": os.getpid(),
+                      "proto_version": wirecheck.proto_version()}),
+          flush=True)
 
     def _term(signum, frame):
         srv.stop()

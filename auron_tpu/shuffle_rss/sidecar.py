@@ -67,6 +67,23 @@ class SidecarProcess:
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=log_file, text=True, env=env)
         info = cls._await_listening(proc, boot_timeout_s, log_path)
+        # version handshake on the listening line (fix-forward): a
+        # side-car advertising a newer-major protocol is fenced at
+        # spawn with a structured refusal, never a garbled wire later
+        from auron_tpu.runtime import wirecheck
+        refusal = wirecheck.advertised_refusal(info)
+        if refusal is not None:
+            from auron_tpu.runtime import counters, events
+            counters.bump("wire_rejects")
+            events.emit("wire.refusal", refusal, wire="rss",
+                        peer=f"{info.get('host')}:{info.get('port')}",
+                        proto_version=wirecheck.proto_version())
+            proc.kill()
+            try:
+                log_file.close()
+            except OSError:
+                pass
+            raise RuntimeError(f"rss side-car refused: {refusal}")
         sc = cls(info["host"], info["port"], proc=proc,
                  log_path=log_path)
         sc._log_file = log_file

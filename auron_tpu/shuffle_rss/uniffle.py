@@ -11,7 +11,13 @@ Transport robustness is inherited from `_Conn` (celeborn.py): the shared
 retry policy (runtime/retry.py) replays lost pushes/fetches with capped
 backoff, the `shuffle.push`/`shuffle.fetch` fault points arm under
 `auron.faults.spec`, and block-id dedup keeps the at-least-once replays
-invisible to the reducer."""
+invisible to the reducer.
+
+The replay contract is DECLARED, not just documented: the wirecheck
+registry (runtime/wirecheck.py) marks `push_block` dedup-keyed on
+`block_id`, and the static protocol pass (`python -m auron_tpu.analysis
+--protocol`) errors if a command ever rides the replaying `_Conn` tier
+without being idempotent or dedup-keyed."""
 
 from __future__ import annotations
 

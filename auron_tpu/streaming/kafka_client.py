@@ -398,6 +398,10 @@ class KafkaWireClient:
             frame = bytes(header.b) + body
             s = self._conn(addr)
             try:
+                # wirecheck: waive (Kafka binary protocol: signed-i32
+                # length prefix, no JSON header — the shared framed-TCP
+                # helper cannot carry it; declared on the `kafka` wire
+                # with framed=False in runtime/wirecheck.py)
                 s.sendall(struct.pack(">i", len(frame)) + frame)
                 raw = self._recv_frame(s)
             except (OSError, EOFError):
@@ -412,8 +416,20 @@ class KafkaWireClient:
             r = _Reader(raw)
             got_corr = r.i32()
             if got_corr != corr:
-                raise RuntimeError(f"kafka correlation mismatch: "
-                                   f"{got_corr} != {corr}")
+                # a desynced socket (stale in-flight response) is
+                # recoverable by reconnecting: drop the cached socket
+                # and classify RETRYABLE for the shared policy — every
+                # attempt allocates a fresh correlation id, so the
+                # replay is read-idempotent
+                self._conns.pop(addr, None)
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                e = RuntimeError(f"kafka correlation mismatch: "
+                                 f"{got_corr} != {corr}")
+                e.auron_retryable = True  # type: ignore[attr-defined]
+                raise e
             return r
 
         # shared retry policy (replacing the old hand-rolled single
@@ -425,6 +441,8 @@ class KafkaWireClient:
 
     @staticmethod
     def _recv_frame(s: socket.socket) -> bytes:
+        # wirecheck: waive (Kafka binary framing, see _call; the recv
+        # loop mirrors the broker's signed-i32 length contract)
         hdr = b""
         while len(hdr) < 4:
             chunk = s.recv(4 - len(hdr))

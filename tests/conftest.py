@@ -36,6 +36,14 @@ os.environ.setdefault("AURON_TPU_AURON_LOCKCHECK_ENABLE", "1")
 # a structured JitcheckError at the offending site.
 os.environ.setdefault("AURON_TPU_AURON_JITCHECK_ENABLE", "1")
 
+# wire-protocol conformance checking is ON for the whole suite too (env
+# fallback of `auron.wirecheck.enable`) — also BEFORE auron_tpu import:
+# the enable flag is decided at process start like lockcheck's.  Every
+# malformed frame a test sends or receives on the framed-TCP wires
+# raises a structured WirecheckError (client side) or is answered
+# in-band (server side) instead of surfacing as a downstream KeyError.
+os.environ.setdefault("AURON_TPU_AURON_WIRECHECK_ENABLE", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -618,7 +618,9 @@ def corpus_catalog(tmp_path_factory):
 
 # tier-1 keeps two cheap exemplars (~20s for both on/off pairs); q01
 # (~18s alone) and the full sweep ride -m slow / tools/aqe_check.sh
-CORPUS_FAST = ["q42", "q03"]
+# q03 is the tier-1 representative; q42 rides -m slow (budget re-split,
+# see the ROADMAP tier-1 time-budget note)
+CORPUS_FAST = ["q03", pytest.param("q42", marks=pytest.mark.slow)]
 AQE_FORCED = {
     **AQE,
     # force decisions to actually fire on the tiny corpus

@@ -656,6 +656,7 @@ def _solo_baselines(names, catalog):
     return out
 
 
+@pytest.mark.slow
 def test_rss_kill9_resume_acceptance_stress(catalog, tmp_path):
     """THE acceptance gate: 6 concurrent corpus queries across 2
     worker PROCESSES pushing shuffle through a side-car process; the

@@ -339,8 +339,16 @@ def test_unknown_command_and_missing_result_are_deterministic():
         with pytest.raises(EndpointError) as ei:
             ep.result("no-such-query")
         assert ei.value.auron_deterministic
-        with pytest.raises(EndpointError):
+        # with wirecheck ON (the suite default) an unknown command is
+        # refused at the client SEND boundary — structured and
+        # deterministic, and the malformed frame never crosses the
+        # wire (the server-side in-band answer for contract-less peers
+        # is covered by tests/test_wire_fuzz.py::unknown_command)
+        from auron_tpu.runtime import wirecheck
+        with pytest.raises(wirecheck.WirecheckError) as wei:
             ep._rpc("status", {"cmd": "frobnicate"})
+        assert wei.value.auron_deterministic
+        assert wei.value.diagnostic.kind == "unknown-command"
     finally:
         srv.stop()
 

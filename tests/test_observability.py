@@ -323,6 +323,7 @@ def test_explain_analyze_golden_q03(catalog):
     assert res.query_id in human and "compute=" in human
 
 
+@pytest.mark.slow
 def test_traced_query_spans_and_latency(catalog, tmp_path):
     """Acceptance + chaos-trace satellite: a traced TPC-DS run exports
     valid Chrome-trace JSON containing the convert/fuse/compile/execute/

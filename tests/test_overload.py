@@ -597,6 +597,7 @@ def _solo_baselines(names, catalog):
     return out
 
 
+@pytest.mark.slow
 def test_overload_stress_preempt_requeue_bit_identical(catalog):
     """THE acceptance gate: 10 concurrent queries under io+latency+mem
     faults against a tiny shared pool with watermark preemption armed —

@@ -73,6 +73,13 @@ def runner(catalog):
 # 13.9s).  q36r (8.0s) deliberately STAYS: it is the remaining
 # in-tier rollup/sort query test_some_queries_ride_the_mesh pins.
 # Post-split tier-1: 769 tests in ~725s on this box.
+# PR 16 budget re-measure (2026-08-06): the wirecheck additions plus
+# a slower box (the PR 15 corpus alone clocked 804s here) pushed
+# tier-1 to 839s/870, so the kill-9/overload stresses and the q42
+# AQE-equivalence variant moved to -m slow, and the SINGLE-DEVICE
+# q36r (10.4s) moves out here — its mesh variant stays in tier-1
+# because the rollup pin in test_some_queries_ride_the_mesh rides
+# the mesh run, not this one.
 _TIER1_STRAGGLERS = {
     "q67r", "q39v", "q98", "q25m", "q76u", "q80s", "q56s", "q20c",
     "q68s", "q22r", "q43", "q79s", "q62w",
@@ -85,9 +92,12 @@ _TIER1_QUERIES = (set(names()[::4]) | {
 }) - _TIER1_STRAGGLERS
 
 
+_TIER1_SERIAL = _TIER1_QUERIES - {"q36r"}
+
+
 @pytest.mark.parametrize(
     "query",
-    [q if q in _TIER1_QUERIES else
+    [q if q in _TIER1_SERIAL else
      pytest.param(q, marks=pytest.mark.slow) for q in names()])
 def test_tpcds_query(runner, query):
     r = runner.run(query)

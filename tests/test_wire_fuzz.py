@@ -1,0 +1,229 @@
+"""Malformed-frame fuzzing of the three framed-TCP servers (rss
+side-car, executor endpoint, engine service).
+
+Contract under fuzz (ISSUE 16): a malformed frame produces either a
+STRUCTURED in-band error or a clean connection close — never a hang, a
+garbled decode, or a pinned handler thread — and the server keeps
+serving well-formed peers afterwards.  The deterministic case matrix
+(truncated header, oversize length prefix, unknown command, garbage
+payload, mid-frame disconnect) runs in tier-1; the seeded randomized
+sweep (~200 frames per server) runs under ``-m slow``.
+
+Also here: the wirecheck OFF-path bit-identity gate — with
+`auron.wirecheck.enable` off the framed push/fetch path must move the
+same bytes as with it on (the COST CONTRACT of runtime/wirecheck.py).
+"""
+
+import random
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from auron_tpu.runtime import wirecheck
+from auron_tpu.service import EngineServer
+from auron_tpu.serving import ExecutorServer
+from auron_tpu.shuffle_rss import ShuffleServer
+from auron_tpu.shuffle_rss.server import (MAX_HEADER_LEN, recv_msg,
+                                          send_msg)
+
+
+@pytest.fixture(scope="module")
+def servers():
+    with ShuffleServer() as rss:
+        ex = ExecutorServer(executor_id="fuzz").start()
+        en = EngineServer().start()
+        try:
+            yield {"rss": rss.address, "executor": ex.address,
+                   "engine": en.address}
+        finally:
+            ex.stop()
+            en.stop()
+
+
+def _connect(addr):
+    s = socket.create_connection(addr, timeout=10)
+    s.settimeout(10)
+    return s
+
+
+def _probe_ok(addr):
+    """A well-formed ping on a fresh connection must round-trip."""
+    s = _connect(addr)
+    try:
+        send_msg(s, {"cmd": "ping"})
+        resp, _ = recv_msg(s)
+        assert resp.get("ok") is True, resp
+    finally:
+        s.close()
+
+
+def _assert_threads_settle(baseline, deadline_s=10.0):
+    """No handler thread stays pinned past the malformed exchange."""
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        if threading.active_count() <= baseline:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"handler threads pinned: {threading.active_count()} alive vs "
+        f"baseline {baseline}")
+
+
+def _expect_structured_or_close(s):
+    """Read the server's reaction: a structured error frame or a clean
+    close — anything else (hang, garbled frame) fails."""
+    try:
+        resp, _ = recv_msg(s)
+    except (ConnectionError, ValueError, OSError):
+        return None           # clean close
+    assert resp.get("ok") is False, resp
+    assert resp.get("error"), resp
+    return resp
+
+
+def _case_truncated_header(s):
+    s.sendall(b"\x00\x00")                       # half a length prefix
+    s.shutdown(socket.SHUT_WR)
+    assert _expect_structured_or_close(s) is None
+
+
+def _case_oversize_length_prefix(s):
+    s.sendall(struct.pack(">I", MAX_HEADER_LEN + 1) + b"x" * 64)
+    _expect_structured_or_close(s)
+
+
+def _case_unknown_command(s):
+    send_msg(s, {"cmd": "zzz_not_a_command"})
+    resp = _expect_structured_or_close(s)
+    # wirecheck is ON suite-wide: the unknown command is answered
+    # in-band with a structured deterministic error
+    assert resp is not None and "zzz_not_a_command" in resp["error"]
+
+
+def _case_garbage_payload(s):
+    blob = b"\xde\xad\xbe\xef not json at all"
+    s.sendall(struct.pack(">I", len(blob)) + blob)
+    _expect_structured_or_close(s)
+
+
+def _case_mid_frame_disconnect(s):
+    # declare an 8 KiB payload, send the header and 10 bytes, vanish
+    send_msg(s, {"cmd": "ping", "len": 8192}, b"x" * 10)
+    s.close()
+
+
+_CASES = {
+    "truncated_header": _case_truncated_header,
+    "oversize_length_prefix": _case_oversize_length_prefix,
+    "unknown_command": _case_unknown_command,
+    "garbage_payload": _case_garbage_payload,
+    "mid_frame_disconnect": _case_mid_frame_disconnect,
+}
+
+
+@pytest.mark.parametrize("case", sorted(_CASES))
+@pytest.mark.parametrize("wire", ["rss", "executor", "engine"])
+def test_malformed_frame(servers, wire, case):
+    addr = servers[wire]
+    _probe_ok(addr)                       # server healthy before
+    baseline = threading.active_count()
+    s = _connect(addr)
+    try:
+        _CASES[case](s)
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
+    _assert_threads_settle(baseline)
+    _probe_ok(addr)                       # ...and healthy after
+
+
+def test_off_path_moves_identical_bytes(servers):
+    """COST CONTRACT: the framed push/fetch path is bit-identical with
+    wirecheck off (the default outside the suite) and on (the suite's
+    forced mode)."""
+    addr = servers["rss"]
+    payload = bytes(range(256)) * 64      # 16 KiB, every byte value
+
+    def roundtrip(partition):
+        s = _connect(addr)
+        try:
+            send_msg(s, {"cmd": "push", "shuffle": "ab",
+                         "partition": partition, "len": len(payload)},
+                     payload)
+            resp, _ = recv_msg(s)
+            assert resp["ok"] is True, resp
+            send_msg(s, {"cmd": "fetch", "shuffle": "ab",
+                         "partition": partition})
+            resp, data = recv_msg(s)
+            assert resp["ok"] is True, resp
+            return data
+        finally:
+            s.close()
+
+    try:
+        on_bytes = roundtrip(0)
+        wirecheck.configure(enabled=False)
+        off_bytes = roundtrip(1)
+    finally:
+        wirecheck.configure(enabled=True, raise_on_violation=True)
+    assert on_bytes == off_bytes == payload
+
+
+@pytest.mark.slow
+def test_randomized_frame_sweep(servers):
+    """~200 seeded random frames against each server: random binary
+    blobs, hostile length prefixes, random JSON headers.  Invariants:
+    every reaction is a structured error or a clean close within the
+    socket timeout, the server answers a well-formed probe afterwards,
+    and no handler threads leak."""
+    rng = random.Random(0xA17)
+
+    def random_frame():
+        kind = rng.randrange(4)
+        if kind == 0:                      # raw binary noise
+            return bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(1, 64)))
+        if kind == 1:                      # hostile length prefix
+            return struct.pack(
+                ">I", rng.choice([0, 1, MAX_HEADER_LEN,
+                                  MAX_HEADER_LEN + 1, 2**31 - 1,
+                                  2**32 - 1])) + \
+                bytes(rng.randrange(256)
+                      for _ in range(rng.randrange(0, 32)))
+        if kind == 2:                      # framed garbage header
+            blob = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(1, 128)))
+            return struct.pack(">I", len(blob)) + blob
+        # framed JSON with a random command / fields
+        import json
+        header = {"cmd": rng.choice(["ping", "push", "fetch", "xyz",
+                                     "dispatch", "execute", ""]),
+                  rng.choice(["shuffle", "partition", "len", "junk"]):
+                  rng.choice(["s", -1, 3.5, None, [1], {"a": 1}])}
+        h = json.dumps(header).encode()
+        return struct.pack(">I", len(h)) + h
+
+    for wire, addr in servers.items():
+        baseline = threading.active_count()
+        for i in range(200):
+            s = _connect(addr)
+            s.settimeout(2)
+            try:
+                s.sendall(random_frame())
+                if rng.random() < 0.5:
+                    s.shutdown(socket.SHUT_WR)
+                try:
+                    recv_msg(s)
+                except (ConnectionError, ValueError, OSError):
+                    pass                   # clean close / timeout
+            except OSError:
+                pass                       # server dropped us mid-send
+            finally:
+                s.close()
+        _assert_threads_settle(baseline, deadline_s=30.0)
+        _probe_ok(addr)

@@ -1,0 +1,397 @@
+"""Wire-protocol contract layer (runtime/wirecheck.py +
+analysis/protocol.py).
+
+- Registry sanity: every command carries schemas, an idempotency class
+  (dedup-keyed ones name a declared dedup field), a since-version, and
+  (in-ladder) a named fault point.
+- Client-side conformance: malformed request/response frames raise a
+  structured WirecheckError (wire, command, field path, fix hint) that
+  the shared retry policy treats as deterministic; `configure(False)`
+  turns every check into a no-op.
+- Server-side conformance: a malformed frame is answered IN-BAND as a
+  structured deterministic error and the connection stays usable —
+  raising would kill the handler thread.
+- Version negotiation (NOT gated on the enable flag): a peer declaring
+  a newer major protocol version gets a structured refusal frame plus a
+  flight-recorder `wire.refusal` event, in both directions
+  (client-declares-newer over the wire, server-advertises-newer via
+  hello / the side-car listening line).
+- The static pass is green against the committed wire manifest, and
+  manifest drift is an error with a regen hint.
+- Observability: per-(wire,cmd) frame counts fold into the counter
+  snapshot and export as `auron_wire_frames_total{wire,cmd}`.
+
+The suite runs with wirecheck forced ON (tests/conftest.py); the
+OFF-default path is covered by the A/B bit-identity gate in
+test_wire_fuzz.py and by test_disabled_checks_are_noops here.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from auron_tpu.runtime import counters, events, retry, wirecheck
+from auron_tpu.shuffle_rss import ShuffleServer
+from auron_tpu.shuffle_rss.server import recv_msg, send_msg
+
+
+@pytest.fixture(autouse=True)
+def _clean_wirecheck():
+    wirecheck.clear_diagnostics()
+    yield
+    wirecheck.configure(enabled=True, raise_on_violation=True)
+    wirecheck.clear_diagnostics()
+
+
+# ---------------------------------------------------------------------------
+# registry sanity
+# ---------------------------------------------------------------------------
+
+def test_registry_covers_all_four_wires():
+    assert set(wirecheck.COMMANDS) == {"rss", "executor", "engine",
+                                       "kafka"}
+    total = sum(len(c) for c in wirecheck.COMMANDS.values())
+    assert total >= 30
+    # the hand-audited replay contracts of PR 12 are declared
+    assert wirecheck.command("rss", "mpush").dedup_key == "push_id"
+    assert wirecheck.command("rss", "mcommit").dedup_key == "attempt"
+    assert wirecheck.command("executor", "dispatch").dedup_key == \
+        "query_id"
+
+
+def test_registry_dedup_keys_are_declared_request_fields():
+    for wire, cmds in wirecheck.COMMANDS.items():
+        for name, spec in cmds.items():
+            assert spec.idempotency in (
+                "idempotent", "dedup-keyed", "non-replayable"), \
+                f"{wire}.{name}"
+            if spec.idempotency == "dedup-keyed":
+                assert spec.dedup_key in spec.request, f"{wire}.{name}"
+            if spec.in_ladder:
+                assert spec.fault_point, f"{wire}.{name}"
+            int(spec.since.split(".", 1)[0])
+
+
+# ---------------------------------------------------------------------------
+# client-side frame checks
+# ---------------------------------------------------------------------------
+
+def test_check_request_passes_valid_frames():
+    wirecheck.check_request("rss", {"cmd": "push", "shuffle": "s",
+                                    "partition": 3, "len": 10,
+                                    "push_id": "p1"})
+    wirecheck.check_request("executor", {"cmd": "dispatch",
+                                         "query_id": "q1", "len": 0})
+
+
+def test_check_request_missing_required_field_raises():
+    with pytest.raises(wirecheck.WirecheckError) as ei:
+        wirecheck.check_request("rss", {"cmd": "push", "shuffle": "s",
+                                        "len": 0})
+    d = ei.value.diagnostic
+    assert (d.kind, d.wire, d.cmd, d.field) == (
+        "missing-field", "rss", "push", "partition")
+    assert "hint" in str(d)
+    # deterministic: the shared retry policy must NOT replay it
+    assert not retry.is_retryable(ei.value)
+
+
+def test_check_request_unknown_command_raises():
+    with pytest.raises(wirecheck.WirecheckError) as ei:
+        wirecheck.check_request("rss", {"cmd": "pusj", "len": 0})
+    assert ei.value.diagnostic.kind == "unknown-command"
+
+
+def test_check_request_wrong_type_and_unknown_field_raise():
+    with pytest.raises(wirecheck.WirecheckError) as ei:
+        wirecheck.check_request("rss", {"cmd": "push", "shuffle": "s",
+                                        "partition": "three"})
+    assert ei.value.diagnostic.kind == "bad-type"
+    with pytest.raises(wirecheck.WirecheckError) as ei:
+        wirecheck.check_request("rss", {"cmd": "ping", "surprise": 1})
+    assert ei.value.diagnostic.kind == "unknown-field"
+
+
+def test_check_response_validates_ok_frames_only():
+    # ok responses must carry the declared fields...
+    with pytest.raises(wirecheck.WirecheckError) as ei:
+        wirecheck.check_response("rss", "mcommit", {"ok": True})
+    assert ei.value.diagnostic.field == "maps"
+    wirecheck.check_response("rss", "mcommit", {"ok": True, "maps": 2})
+    # ...error responses are exempt from the per-command schema
+    wirecheck.check_response("rss", "mcommit",
+                             {"ok": False, "error": "boom",
+                              "deterministic": True})
+
+
+def test_check_stream_frame_engine_execute():
+    wirecheck.check_stream_frame("engine", "execute",
+                                 {"type": "batch", "len": 16})
+    wirecheck.check_stream_frame("engine", "execute",
+                                 {"type": "done", "metrics": {}})
+    with pytest.raises(wirecheck.WirecheckError):
+        wirecheck.check_stream_frame("engine", "execute",
+                                     {"type": "done"})
+    with pytest.raises(wirecheck.WirecheckError) as ei:
+        wirecheck.check_stream_frame("engine", "execute",
+                                     {"type": "mystery"})
+    assert ei.value.diagnostic.kind == "bad-frame"
+
+
+def test_disabled_checks_are_noops():
+    wirecheck.configure(enabled=False)
+    wirecheck.check_request("rss", {"cmd": "nope"})
+    wirecheck.check_response("rss", "mcommit", {"ok": True})
+    assert wirecheck.request_problem("rss", {"cmd": "nope"}) is None
+    assert wirecheck.diagnostics() == []
+
+
+def test_record_mode_collects_without_raising():
+    wirecheck.configure(enabled=True, raise_on_violation=False)
+    wirecheck.check_request("rss", {"cmd": "push", "shuffle": "s"})
+    kinds = {d.kind for d in wirecheck.diagnostics()}
+    assert "missing-field" in kinds
+
+
+# ---------------------------------------------------------------------------
+# server-side: in-band structured errors, connection survives
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rss_server():
+    with ShuffleServer() as srv:
+        yield srv
+
+
+def _connect(addr):
+    s = socket.create_connection(addr, timeout=10)
+    s.settimeout(10)
+    return s
+
+
+def test_server_answers_malformed_frame_in_band(rss_server):
+    s = _connect(rss_server.address)
+    try:
+        send_msg(s, {"cmd": "push", "shuffle": "s"})   # no partition
+        resp, _ = recv_msg(s)
+        assert resp["ok"] is False
+        assert resp["deterministic"] is True
+        assert "partition" in resp["error"]
+        # the handler thread survived: same connection still serves
+        send_msg(s, {"cmd": "ping"})
+        resp, _ = recv_msg(s)
+        assert resp["ok"] is True and "now" in resp
+    finally:
+        s.close()
+
+
+def test_server_answers_unknown_command_in_band(rss_server):
+    s = _connect(rss_server.address)
+    try:
+        send_msg(s, {"cmd": "pusj"})
+        resp, _ = recv_msg(s)
+        assert resp["ok"] is False and resp["deterministic"] is True
+        assert "pusj" in resp["error"]
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# version negotiation, both directions
+# ---------------------------------------------------------------------------
+
+def test_peer_refusal_logic():
+    assert wirecheck.peer_refusal({"cmd": "ping"}) is None
+    assert wirecheck.peer_refusal(
+        {"cmd": "ping", "proto": wirecheck.proto_version()}) is None
+    assert wirecheck.peer_refusal({"cmd": "ping", "proto": "99.0"})
+    assert wirecheck.peer_refusal({"cmd": "ping", "proto": "bogus"})
+    assert wirecheck.advertised_refusal({"proto_version": "99.0"})
+    assert wirecheck.advertised_refusal(
+        {"proto_version": wirecheck.proto_version()}) is None
+    assert wirecheck.advertised_refusal({}) is None
+
+
+def test_server_refuses_newer_major_peer(rss_server):
+    before = counters.get("wire_rejects")
+    cursor = events.snapshot()[-1]["seq"] if events.snapshot() else 0
+    s = _connect(rss_server.address)
+    try:
+        send_msg(s, {"cmd": "ping", "proto": "99.0"})
+        resp, _ = recv_msg(s)
+        assert resp["refused"] is True and resp["ok"] is False
+        assert resp["deterministic"] is True
+        assert resp["proto_version"] == wirecheck.proto_version()
+        # refusal closes the connection (no half-open garbled decode)
+        with pytest.raises((ConnectionError, ValueError, OSError)):
+            send_msg(s, {"cmd": "ping"})
+            recv_msg(s)
+    finally:
+        s.close()
+    assert counters.get("wire_rejects") == before + 1
+    evs = events.snapshot(since=cursor, kind="wire.refusal")
+    assert evs and evs[-1]["attrs"]["wire"] == "rss"
+
+
+def test_executor_hello_rejects_newer_server():
+    """Client direction: a server advertising a newer major version in
+    its hello response is refused by ProcessExecutor.hello with a
+    structured EndpointError, and the refusal is flight-recorded."""
+    from auron_tpu.serving import EndpointError, ProcessExecutor
+
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    host, port = lst.getsockname()
+
+    def _serve_one():
+        s, _ = lst.accept()
+        recv_msg(s)
+        send_msg(s, {"ok": True, "executor_id": "x", "pid": 1,
+                     "proto_version": "99.0"})
+        s.close()
+
+    t = threading.Thread(target=_serve_one, daemon=True)
+    t.start()
+    cursor = events.snapshot()[-1]["seq"] if events.snapshot() else 0
+    ep = ProcessExecutor("x", host, port)
+    try:
+        with pytest.raises(EndpointError) as ei:
+            ep.hello()
+        assert "protocol" in str(ei.value)
+        evs = events.snapshot(since=cursor, kind="wire.refusal")
+        assert evs and evs[-1]["attrs"]["wire"] == "executor"
+        t.join(5)
+    finally:
+        lst.close()
+
+
+def test_executor_hello_advertises_current_version():
+    from auron_tpu.serving import ExecutorServer, ProcessExecutor
+
+    srv = ExecutorServer(executor_id="wc").start()
+    ep = ProcessExecutor("wc", *srv.address)
+    try:
+        resp = ep.hello()
+        assert resp["proto_version"] == wirecheck.proto_version()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# static pass + golden manifest
+# ---------------------------------------------------------------------------
+
+def test_static_protocol_pass_is_green():
+    from auron_tpu.analysis import protocol as proto
+
+    report = proto.analyze_protocol()
+    assert report.result.errors == [], \
+        [str(d) for d in report.result.errors]
+    # the three dispatch ladders resolved
+    assert set(report.ladders) == {"rss", "executor", "engine"}
+    assert report.framing_sites   # the shared helpers + kafka showed up
+
+
+def test_committed_wire_manifest_is_current():
+    from auron_tpu.analysis import protocol as proto
+
+    assert proto.check_against_golden() == []
+
+
+def test_wire_manifest_drift_is_an_error(tmp_path):
+    from auron_tpu.analysis import protocol as proto
+
+    stale = proto.render_golden().replace(
+        "cmd rss.mcommit v1.0 dedup-keyed[attempt]",
+        "cmd rss.mcommit v1.0 non-replayable")
+    p = tmp_path / "wire_manifest.txt"
+    p.write_text(stale)
+    problems = proto.check_against_golden(str(p))
+    assert any("rss.mcommit" in s for s in problems)
+    assert any("regen" in s for s in problems)
+    assert any("missing golden" in s for s in
+               proto.check_against_golden(str(tmp_path / "absent.txt")))
+
+
+def test_static_pass_flags_undeclared_ladder_command(tmp_path):
+    """Exhaustiveness is bidirectional: a ladder arm the registry does
+    not declare is an ERROR (and vice versa, via the same set diff)."""
+    from auron_tpu.analysis import protocol as proto
+
+    pkg = tmp_path / "pkg"
+    (pkg / "shuffle_rss").mkdir(parents=True)
+    (pkg / "shuffle_rss" / "server.py").write_text(
+        "def _serve(self):\n"
+        "    cmd = 'x'\n"
+        "    if cmd == 'frobnicate':\n"
+        "        pass\n")
+    report = proto.analyze_protocol(root=str(pkg))
+    msgs = [str(d) for d in report.result.errors]
+    assert any("frobnicate" in m for m in msgs)
+    assert any("never dispatches" in m for m in msgs)   # reverse dir
+
+
+def test_static_pass_flags_raw_struct_framing(tmp_path):
+    from auron_tpu.analysis import protocol as proto
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import struct\n"
+        "def leak(sock, data):\n"
+        "    sock.sendall(struct.pack('>I', len(data)) + data)\n")
+    report = proto.analyze_protocol(root=str(pkg))
+    assert any("struct" in str(d) for d in report.result.errors)
+    # an explicit waiver silences it
+    (pkg / "rogue.py").write_text(
+        "import struct\n"
+        "def leak(sock, data):\n"
+        "    # wirecheck: waive (test fixture)\n"
+        "    sock.sendall(struct.pack('>I', len(data)) + data)\n")
+    report = proto.analyze_protocol(root=str(pkg))
+    assert not any("struct" in str(d) and "rogue" in str(d)
+                   for d in report.result.errors)
+
+
+# ---------------------------------------------------------------------------
+# observability: frame counters on /metrics
+# ---------------------------------------------------------------------------
+
+def test_frame_counts_fold_into_metrics(rss_server):
+    s = _connect(rss_server.address)
+    try:
+        send_msg(s, {"cmd": "ping"})
+        recv_msg(s)
+    finally:
+        s.close()
+    assert wirecheck.frame_counts().get(("rss", "ping"), 0) >= 1
+    snap = counters.snapshot()
+    assert snap.get("wire_frames_rss_ping", 0) >= 1
+
+    from auron_tpu.runtime.profiling import _prometheus_text
+    text = _prometheus_text()
+    assert "auron_wire_rejects_total" in text
+    assert 'auron_wire_frames_total{wire="rss",cmd="ping"}' in text
+
+
+# ---------------------------------------------------------------------------
+# CI gate script
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_tools_wirecheck_script():
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "tools", "wirecheck.sh")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(["bash", script], cwd=repo, env=env,
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "wirecheck.sh: ok" in proc.stdout

@@ -1,0 +1,102 @@
+#!/usr/bin/env bash
+# CI wire-protocol contract gate (CPU-only, fast):
+#   1. the STATIC pass — server dispatch ladders vs the wirecheck
+#      command registry (both directions), client request literals
+#      inside the contract, transports on named fault points + the ONE
+#      shared retry policy, idempotency-vs-replay audit (the mechanized
+#      MCOMMIT/push_id check), raw struct framing lint, and the
+#      committed wire manifest (tests/golden_plans/wire_manifest.txt)
+#      — must report 0 unwaived errors;
+#   2. the CONFORMANCE suite — registry/schema/version-handshake units,
+#      server in-band answers, static-pass self-tests — runs under
+#      `auron.wirecheck.enable` (forced on by tests/conftest.py);
+#   3. the FUZZ fast subset — the deterministic malformed-frame matrix
+#      against all three servers (structured error or clean close,
+#      no pinned handler threads);
+#   4. the COST-CONTRACT A/B — framed push/fetch roundtrips with
+#      wirecheck off vs on must move bit-identical bytes, with the
+#      checked path inside the noise gate of the unchecked one.
+#
+# Regen after intentional protocol changes:
+#   python -m auron_tpu.analysis --protocol --regen-golden
+#
+# Usage: tools/wirecheck.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m auron_tpu.analysis --protocol
+
+# conformance + fuzz fast subsets, minus THIS script's own pytest
+# wrapper (the randomized 200-frame sweep stays behind -m slow)
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m pytest tests/test_wirecheck.py tests/test_wire_fuzz.py \
+    -q -m 'not slow' \
+    --deselect tests/test_wirecheck.py::test_tools_wirecheck_script \
+    -p no:cacheprovider "$@"
+
+# cost-contract A/B: interleaved best-of-3 framed roundtrip batches,
+# wirecheck OFF (the shipped default) vs ON (the suite's mode).  Bytes
+# must be identical; the ON path must sit inside the OFF path's noise
+# (gated at 1.3x like tools/aqe_check.sh — CI wall clock jitters far
+# above the ~0% steady-state delta, which is printed for trend eyes).
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import time
+
+from auron_tpu.runtime import wirecheck
+from auron_tpu.shuffle_rss import ShuffleServer
+from auron_tpu.shuffle_rss.server import recv_msg, send_msg
+import socket
+
+payload = bytes(range(256)) * 256          # 64 KiB
+
+
+def batch(addr, shuffle, n=60):
+    s = socket.create_connection(addr, timeout=10)
+    try:
+        for i in range(n):
+            send_msg(s, {"cmd": "push", "shuffle": shuffle,
+                         "partition": i % 4, "len": len(payload)},
+                     payload)
+            resp, _ = recv_msg(s)
+            assert resp["ok"] is True, resp
+        out = b""
+        for p in range(4):
+            send_msg(s, {"cmd": "fetch", "shuffle": shuffle,
+                         "partition": p})
+            resp, data = recv_msg(s)
+            assert resp["ok"] is True, resp
+            out += data
+        return out
+    finally:
+        s.close()
+
+
+with ShuffleServer() as srv:
+    addr = srv.address
+    wirecheck.configure(enabled=True, raise_on_violation=True)
+    on_bytes = batch(addr, "warm_on")
+    wirecheck.configure(enabled=False)
+    off_bytes = batch(addr, "warm_off")
+    assert on_bytes == off_bytes, "checked frame path is not bit-identical"
+
+    t_offs, t_ons = [], []
+    for i in range(3):
+        wirecheck.configure(enabled=False)
+        t0 = time.perf_counter()
+        batch(addr, f"off{i}")
+        t_offs.append(time.perf_counter() - t0)
+        wirecheck.configure(enabled=True)
+        t0 = time.perf_counter()
+        batch(addr, f"on{i}")
+        t_ons.append(time.perf_counter() - t0)
+    off_s, on_s = min(t_offs), min(t_ons)
+    delta = (on_s - off_s) / max(off_s, 1e-9) * 100.0
+    print(f"wirecheck A/B (interleaved, best-of-3): off={off_s * 1e3:.1f}ms "
+          f"on={on_s * 1e3:.1f}ms delta={delta:+.1f}%")
+    assert on_s <= off_s * 1.3, \
+        f"wirecheck ON regressed the wire path: {on_s:.4f}s vs {off_s:.4f}s"
+print("WIRECHECK_AB_OK")
+EOF
+
+echo "wirecheck.sh: ok"
