@@ -206,6 +206,56 @@ _MISSING = object()
 conf = Configuration()
 
 # ---------------------------------------------------------------------------
+# Redacted keys: options whose VALUES must never leave this process —
+# not in dispatch-frame conf overlays, not in worker spawn argv, not in
+# /scheduler | /queries JSON, not in trace exports or log prefixes.
+# Secrets travel by env fallback (AURON_TPU_*) only; every export
+# surface strips them through redact_overlay().
+# ---------------------------------------------------------------------------
+
+REDACTED_KEYS = {"auron.net.auth.secret"}
+
+
+def mark_redacted(key: str) -> None:
+    """Register another option key whose value must never be exported."""
+    REDACTED_KEYS.add(key)
+
+
+def redact_overlay(mapping: Optional[Dict[str, Any]],
+                   mask: Optional[str] = None) -> Dict[str, Any]:
+    """A copy of `mapping` safe for export: redacted keys are DROPPED
+    (default — receivers read their own env) or replaced with `mask`
+    when a surface needs to show the key existed."""
+    out: Dict[str, Any] = {}
+    for k, v in (mapping or {}).items():
+        if k in REDACTED_KEYS:
+            if mask is not None:
+                out[k] = mask
+            continue
+        out[k] = v
+    return out
+
+
+def net_bind_host() -> str:
+    """The listen address every server this process starts should bind
+    (`auron.net.bind.host`; loopback by default)."""
+    return str(conf.get("auron.net.bind.host") or "127.0.0.1")
+
+
+def net_advertise_host(bind_host: Optional[str] = None) -> str:
+    """The host peers should DIAL to reach servers bound on
+    `bind_host`: the explicit `auron.net.advertise.host` when set, else
+    the bind host itself — except wildcard binds, which are not
+    dialable and advertise loopback."""
+    adv = str(conf.get("auron.net.advertise.host") or "").strip()
+    if adv:
+        return adv
+    host = bind_host if bind_host is not None else net_bind_host()
+    if host in ("", "0.0.0.0", "::", "::0", "0:0:0:0:0:0:0:0"):
+        return "127.0.0.1"
+    return host
+
+# ---------------------------------------------------------------------------
 # Core engine options (names parallel spark.auron.* semantics, TPU-adapted).
 # ---------------------------------------------------------------------------
 
@@ -290,6 +340,30 @@ RSS_SIDECAR_ENABLE = conf.define(
     "death then turns whole-query recompute into partial-stage "
     "resume; side-car death degrades workers back to executor-local "
     "shuffle with a structured diagnostic.")
+RSS_SHARDS = conf.define(
+    "auron.rss.shards", 1,
+    "Durable side-car shard count for FleetManager.spawn: N > 1 runs N "
+    "side-car processes with a consistent shuffle-id -> shard map "
+    "(shuffle_rss/shard_map.py rendezvous hash over the ordered "
+    "address list in auron.shuffle.service.address, so every worker "
+    "and the driver agree from the dispatch overlay alone).  Each "
+    "shard rides its own health machine: ONE dead shard degrades only "
+    "the shuffles it owns; delete_prefix/stats/tspans fan out across "
+    "live shards.  1 (default) keeps the single side-car wire "
+    "behavior bit-identical.",
+)
+RSS_COMMITTED_SPILL_WATERMARK = conf.define(
+    "auron.rss.committed.spill.watermark", 0,
+    "Resident-byte watermark for the side-car's COMMITTED map outputs "
+    "(shuffle_rss/server.py): above it, committed blocks spill to "
+    "files under the server's spill dir largest-shuffle-first, "
+    "manifests keep naming them, and MFETCH restores them "
+    "transparently — a side-car survives committed datasets far "
+    "beyond RAM.  Spill attribution (committed_spills, "
+    "committed_spilled_bytes, committed_restores) rides STATS.  "
+    "0 (default) = committed blocks stay resident (the aggregate-"
+    "model spill threshold is separate and unchanged).",
+)
 SHUFFLE_COMPRESSION_CODEC = conf.define(
     "auron.shuffle.compression.codec", "zstd",
     "Codec for shuffle/spill blocks: zstd, zlib, lz4, none."
@@ -451,6 +525,38 @@ NET_TIMEOUT_SECONDS = conf.define(
     "Socket connect/read timeout for every network client (RSS shuffle "
     "clients, engine-service client, kafka consumer) — replaces the "
     "hard-coded per-client timeouts; <= 0 disables (blocking sockets).",
+)
+NET_BIND_HOST = conf.define(
+    "auron.net.bind.host", "127.0.0.1",
+    "Listen address for every framed-TCP server this process starts "
+    "(executor endpoint, RSS shuffle side-car, engine service) and the "
+    "serving/profiling HTTP port.  The multi-host default stays "
+    "loopback; fleet deployments bind '0.0.0.0' (or a NIC address) and "
+    "set auron.net.advertise.host to the reachable name peers should "
+    "dial.",
+)
+NET_ADVERTISE_HOST = conf.define(
+    "auron.net.advertise.host", "",
+    "Host peers should DIAL to reach servers started by this process — "
+    "carried in listening lines and hello replies instead of the bind "
+    "address (binding 0.0.0.0 is not dialable; binding a NIC address "
+    "usually is).  Empty (default): advertise the bind host, or "
+    "127.0.0.1 when bound to a wildcard.",
+)
+NET_AUTH_SECRET = conf.define(
+    "auron.net.auth.secret", "",
+    "Shared-secret wire authentication for the framed-TCP wires "
+    "(rss/executor/engine): when non-empty every client frame carries "
+    "a `token` header field (wire protocol >= 1.1) and every server "
+    "REFUSES frames whose token is missing or wrong with a structured "
+    "deterministic refusal (wire.refusal flight-recorder event, "
+    "auron_wire_rejects_total) — the ONE retry policy ferries it "
+    "instead of spinning.  Source it from the environment "
+    "(AURON_TPU_AURON_NET_AUTH_SECRET): the value is REDACTED from "
+    "every export surface (dispatch overlays, worker argv, /scheduler "
+    "and /queries JSON, trace exports — config.REDACTED_KEYS) and "
+    "workers read their own env copy.  Empty (default) = "
+    "unauthenticated wires, frame bytes bit-identical to proto 1.0.",
 )
 SERVICE_READ_TIMEOUT_SECONDS = conf.define(
     "auron.service.read.timeout.seconds", 300.0,
@@ -1110,6 +1216,28 @@ FLEET_BOOT_TIMEOUT_SECONDS = conf.define(
     "How long FleetManager.spawn waits for a worker process to print "
     "its listening line before declaring the boot failed (the worker "
     "is killed and its log tail surfaced in the error).",
+)
+FLEET_LAUNCHER = conf.define(
+    "auron.fleet.launcher", "local",
+    "How FleetManager.spawn starts worker and side-car processes "
+    "(serving/fleet.py WorkerLauncher seam): 'local' (default) forks "
+    "children on this host exactly as before; 'command' wraps every "
+    "spawn in the argv template from auron.fleet.launcher.command — "
+    "the ssh/k8s-shaped remote hook.  Either way the child prints the "
+    "same listening-line JSON and ADVERTISES a reachable host:port "
+    "(auron.net.advertise.host) instead of the driver assuming "
+    "loopback.",
+)
+FLEET_LAUNCHER_COMMAND = conf.define(
+    "auron.fleet.launcher.command", "",
+    "Whitespace-split argv template for auron.fleet.launcher=command.  "
+    "The token '{argv}' expands in place to the worker's own argv "
+    "(python -m auron_tpu.serving.executor_endpoint ... or the "
+    "side-car module); '{python}' expands to this driver's "
+    "interpreter.  Example: 'ssh worker-2 -- {argv}' or a container "
+    "wrapper script.  The launched command must still print the "
+    "worker's listening-line JSON on stdout.  Empty with "
+    "launcher=command is a spawn-time error.",
 )
 FLEET_SCALE_UP_QUEUE_DEPTH = conf.define(
     "auron.fleet.scale.up.queue.depth", 0,

@@ -132,6 +132,9 @@ class AuronSession:
         self._exchange_sids: Dict[str, str] = {}
         self._exchange_local: set = set()
         self._rss_degraded = False
+        # sharded side-cars degrade per SHARD ("host:port"), so a dead
+        # shard takes down only the shuffle ids it owns
+        self._rss_degraded_shards: set = set()
         self._stream_root: Optional[int] = None
         # adaptive execution (runtime/adaptive.py): per-query replan
         # decisions + observed exchange histograms, and the wall-clock
@@ -496,7 +499,7 @@ class AuronSession:
         )
         n_reduce = job.partitioning.num_partitions
         if isinstance(self.shuffle_service, DurableShuffleClient) \
-                and not self._rss_degraded:
+                and not self._rss_degraded_for(job.rid):
             try:
                 sid, man, stats = self._durable_map_side(job, ctx)
                 self._observe_exchange(job, stats)
@@ -561,15 +564,44 @@ class AuronSession:
 
     def _note_rss_degrade(self, rid: str, err: Exception) -> None:
         """Shared degrade bookkeeping (sticky flag + counter + trace
-        event + one log line) for the durable->local fallback."""
+        event + one log line) for the durable->local fallback.  With a
+        SHARDED side-car client the stickiness is per shard: only the
+        shuffle ids owned by the dead endpoint fall back to local."""
         from auron_tpu.runtime import counters, tracing
-        self._rss_degraded = True
+        from auron_tpu.shuffle_rss.shard_map import (
+            ShardedDurableShuffleClient,
+        )
+        endpoint = getattr(err, "rss_endpoint", None)
+        if endpoint and isinstance(self.shuffle_service,
+                                   ShardedDurableShuffleClient):
+            self._rss_degraded_shards.add(endpoint)
+            scope = f"shard {endpoint}"
+        else:
+            self._rss_degraded = True
+            scope = "this query"
         counters.bump("rss_degrades")
         tracing.event("rss.degrade", cat="shuffle", rid=rid,
                       error=str(err))
         log.warning(
-            "durable shuffle degraded to executor-local for this "
-            "query (rid %s): %s", rid, err)
+            "durable shuffle degraded to executor-local for %s "
+            "(rid %s): %s", scope, rid, err)
+
+    def _rss_degraded_for(self, rid: str) -> bool:
+        """Is the durable path out of service for THIS exchange?  The
+        global flag covers single side-cars; with a sharded client only
+        the owner shard's death counts."""
+        if self._rss_degraded:
+            return True
+        if not self._rss_degraded_shards:
+            return False
+        from auron_tpu.shuffle_rss.shard_map import (
+            ShardedDurableShuffleClient,
+        )
+        svc = self.shuffle_service
+        if not isinstance(svc, ShardedDurableShuffleClient):
+            return True
+        shard = svc.shard_of(self._durable_sid(rid))
+        return f"{shard.host}:{shard.port}" in self._rss_degraded_shards
 
     def _observe_exchange(self, job: ShuffleJob, stats) -> None:
         """Surface one exchange's observed output: the session list
@@ -643,7 +675,7 @@ class AuronSession:
             DurableShuffleClient, RssUnavailable,
         )
         if isinstance(self.shuffle_service, DurableShuffleClient) \
-                and not self._rss_degraded:
+                and not self._rss_degraded_for(job.rid):
             try:
                 self._materialize_exchange_durable(job, ctx, resources)
                 return

@@ -72,6 +72,7 @@ __all__ = [
     "check_request", "request_problem", "check_response",
     "check_stream_frame", "note_frame", "frame_counts",
     "peer_refusal", "advertised_refusal", "refusal_frame",
+    "auth_secret", "attach_token", "auth_refusal",
     "enabled", "configure", "diagnostics", "clear_diagnostics",
     "reset_state",
 ]
@@ -79,8 +80,9 @@ __all__ = [
 # the CURRENT protocol: servers advertise it, clients may assert it.
 # Fix-forward rule: a newer MINOR is compatible (new optional fields,
 # new commands an old peer never sends); a newer MAJOR is refused.
+# 1.1: the optional per-frame `token` auth field (auron.net.auth.secret)
 PROTO_MAJOR = 1
-PROTO_MINOR = 0
+PROTO_MINOR = 1
 
 MAX_DIAGNOSTICS = 256
 
@@ -205,10 +207,13 @@ def _fields(d: Mapping[str, str]) -> Dict[str, Field]:
 
 # request fields every framed command may carry: the command selector,
 # the payload length, the durable trace flag (durable._guarded_request
-# sets it when a recorder is armed) and the optional client protocol
-# assertion the version handshake rides.
+# sets it when a recorder is armed), the optional client protocol
+# assertion the version handshake rides, and (since 1.1) the optional
+# shared-secret auth token (`auron.net.auth.secret`) every transport
+# spine attaches when the secret is set.
 GLOBAL_REQUEST: Dict[str, Field] = _fields(
-    {"cmd": "str", "len": "int", "trace": "any", "proto": "str"})
+    {"cmd": "str", "len": "int", "trace": "any", "proto": "str",
+     "token": "str"})
 
 # response fields every framed command may carry: the ok bit, the
 # structured error surface (error/deterministic/exhausted/draining —
@@ -592,14 +597,61 @@ def advertised_refusal(doc: Mapping[str, Any]) -> Optional[str]:
 def refusal_frame(wire: str, message: str,
                   peer: str = "") -> Dict[str, Any]:
     """The structured refusal a server answers a version-mismatched
-    peer with (then closes the connection).  Counted on /metrics
-    (`auron_wire_rejects_total`) and recorded on the flight recorder."""
+    (or auth-failed) peer with (then closes the connection).  Counted
+    on /metrics (`auron_wire_rejects_total`) and recorded on the
+    flight recorder."""
     from auron_tpu.runtime import counters, events
     counters.bump("wire_rejects")
     events.emit("wire.refusal", message, wire=wire, peer=peer,
                 proto_version=proto_version())
     return {"ok": False, "refused": True, "deterministic": True,
             "error": message, "proto_version": proto_version()}
+
+
+# ---------------------------------------------------------------------------
+# shared-secret wire authentication (since 1.1; like version
+# negotiation it is wire BEHAVIOR, not checking — never gated on the
+# enable flag).  The secret value itself must never cross an export
+# surface: config.REDACTED_KEYS strips it from overlays/argv, and the
+# refusal message below never echoes either side's token.
+# ---------------------------------------------------------------------------
+
+def auth_secret() -> str:
+    """The process's shared wire secret (`auron.net.auth.secret`,
+    env-sourced via AURON_TPU_AURON_NET_AUTH_SECRET); '' = auth off."""
+    try:
+        from auron_tpu.config import conf
+        return str(conf.get("auron.net.auth.secret") or "")
+    except Exception:
+        return ""
+
+
+def attach_token(header: Dict[str, Any]) -> Dict[str, Any]:
+    """CLIENT side: attach the auth token to an outgoing request header
+    when the secret is set.  With auth off the header is returned
+    UNTOUCHED — frame bytes stay bit-identical to proto 1.0."""
+    secret = auth_secret()
+    if secret:
+        header.setdefault("token", secret)
+    return header
+
+
+def auth_refusal(header: Mapping[str, Any]) -> Optional[str]:
+    """SERVER side: refusal message when this process requires a wire
+    token and the request's is missing or wrong.  A server WITHOUT a
+    secret ignores any token it receives (fix-forward: a 1.1 client
+    talking to an unsecured server keeps working)."""
+    secret = auth_secret()
+    if not secret:
+        return None
+    token = header.get("token")
+    if token == secret:
+        return None
+    if token is None:
+        return ("frame carries no auth token but this server requires "
+                "one (set auron.net.auth.secret in the client's "
+                "environment)")
+    return "frame auth token does not match this server's secret"
 
 
 # ---------------------------------------------------------------------------

@@ -110,6 +110,19 @@ class _Handler(socketserver.BaseRequestHandler):
                 except (BrokenPipeError, ConnectionError, OSError):
                     pass
                 return
+            # shared-secret auth (always on when the secret is set):
+            # a bad/missing token gets a structured refusal, then the
+            # connection closes — deterministic, so retries never spin
+            denied = wirecheck.auth_refusal(header)
+            if denied is not None:
+                try:
+                    send_msg(sock, wirecheck.refusal_frame(
+                        "engine", denied,
+                        peer=f"{self.client_address[0]}:"
+                             f"{self.client_address[1]}"))
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass
+                return
             # frame conformance (enabled-only): answered in-band, the
             # connection (and every resource registered on it) survives
             problem = wirecheck.request_problem("engine", header)
@@ -244,8 +257,10 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 class EngineServer:
     """Serve loop owning one resource registry (the JVM resource map
-    analogue); binds loopback by default — the channel is unauthenticated
-    like the in-process JNI surface it replaces."""
+    analogue); binds loopback by default.  The channel is unauthenticated
+    like the in-process JNI surface it replaces unless
+    `auron.net.auth.secret` is set, in which case every frame must carry
+    the matching token."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  resources=None):
@@ -275,8 +290,10 @@ class EngineServer:
         self._server.server_close()
 
 
-def serve(host: str = "127.0.0.1", port: int = 0) -> None:
+def serve(host: Optional[str] = None, port: int = 0,
+          advertise_host: Optional[str] = None) -> None:
     """Blocking entry point (`python -m auron_tpu.service.engine`)."""
+    from auron_tpu import config
     platform = os.environ.get("JAX_PLATFORMS")
     if platform:
         # some TPU platform plugins override the env var; pin the
@@ -286,8 +303,12 @@ def serve(host: str = "127.0.0.1", port: int = 0) -> None:
             jax.config.update("jax_platforms", platform)
         except Exception:
             pass
+    if host is None:
+        host = config.net_bind_host()
     s = EngineServer(host, port)
-    print(json.dumps({"event": "listening", "host": s.address[0],
+    adv = advertise_host if advertise_host is not None \
+        else config.net_advertise_host(host)
+    print(json.dumps({"event": "listening", "host": adv,
                       "port": s.address[1],
                       "proto_version": wirecheck.proto_version()}),
           flush=True)
@@ -356,6 +377,7 @@ class EngineClient:
         self.close()
 
     def _call(self, header: dict, payload: bytes = b"") -> dict:
+        wirecheck.attach_token(header)
         wirecheck.check_request("engine", header)
 
         def _once():
@@ -412,8 +434,9 @@ class EngineClient:
         data = task if isinstance(task, (bytes, bytearray)) \
             else ir_serde.serialize(task)
         self.last_metrics: dict = {}
-        wirecheck.check_request("engine", {"cmd": "execute",
-                                           "len": len(data)})
+        exec_header = wirecheck.attach_token({"cmd": "execute",
+                                              "len": len(data)})
+        wirecheck.check_request("engine", exec_header)
         policy = RetryPolicy.from_conf()
         rng = random.Random(policy.seed)
         attempts = max(1, policy.max_attempts)
@@ -426,8 +449,7 @@ class EngineClient:
                           attempt=attempt, nbytes=len(data)):
                     fault_point("service.call")
                     s = self._ensure_sock()
-                    send_msg(s, {"cmd": "execute", "len": len(data)},
-                             data)
+                    send_msg(s, exec_header, data)
                 while True:
                     header, payload = recv_msg(s)
                     wirecheck.check_stream_frame("engine", "execute",
@@ -472,13 +494,15 @@ class EngineClient:
         s = self._ensure_sock()
         src = self._provided.get(str(key))
         if src is None:
-            header = {"cmd": "resource_data", "kind": "missing"}
+            header = wirecheck.attach_token(
+                {"cmd": "resource_data", "kind": "missing"})
             wirecheck.check_request("engine", header)
             send_msg(s, header)
             return
         data = _batches_to_ipc(src)
-        header = {"cmd": "resource_data", "kind": "arrow_ipc",
-                  "len": len(data)}
+        header = wirecheck.attach_token(
+            {"cmd": "resource_data", "kind": "arrow_ipc",
+             "len": len(data)})
         wirecheck.check_request("engine", header)
         send_msg(s, header, data)
 
@@ -490,7 +514,7 @@ class EngineClient:
 
     def shutdown_server(self) -> None:
         s = self._ensure_sock()
-        send_msg(s, {"cmd": "shutdown"})
+        send_msg(s, wirecheck.attach_token({"cmd": "shutdown"}))
         try:
             recv_msg(s)
         except (ConnectionError, OSError, ValueError):
@@ -502,7 +526,11 @@ if __name__ == "__main__":
     import argparse
 
     ap = argparse.ArgumentParser(description="Auron engine service")
-    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--host", default=None,
+                    help="bind host (default: auron.net.bind.host)")
+    ap.add_argument("--advertise-host", default=None,
+                    help="host advertised in the listening line "
+                         "(default: auron.net.advertise.host)")
     ap.add_argument("--port", type=int, default=0)
     args = ap.parse_args()
-    serve(args.host, args.port)
+    serve(args.host, args.port, advertise_host=args.advertise_host)

@@ -299,6 +299,19 @@ class _ExecHandler(socketserver.BaseRequestHandler):
                 except (BrokenPipeError, ConnectionError, OSError):
                     pass
                 return
+            # shared-secret wire auth (since 1.1, always on like the
+            # handshake): missing/wrong token -> structured
+            # deterministic refusal, then close
+            denied = wirecheck.auth_refusal(header)
+            if denied is not None:
+                try:
+                    send_msg(sock, wirecheck.refusal_frame(
+                        "executor", denied,
+                        peer=f"{self.client_address[0]}:"
+                             f"{self.client_address[1]}"))
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass
+                return
             # frame conformance (enabled-only): answered in-band, the
             # connection survives
             problem = wirecheck.request_problem("executor", header)
@@ -425,8 +438,9 @@ class _ExecHandler(socketserver.BaseRequestHandler):
 class ExecutorServer:
     """One worker process's serve loop: a QueryScheduler (pass-through
     admission — the fleet's controller is the front door) behind the
-    framed-TCP wire.  Binds loopback by default; the channel is
-    unauthenticated like the engine service it mirrors."""
+    framed-TCP wire.  Binds loopback by default; non-loopback
+    deployments set `auron.net.auth.secret` so every frame carries a
+    shared-secret token the server verifies."""
 
     def __init__(self, scheduler=None, session_factory=None,
                  executor_id: str = "exec-0",
@@ -502,17 +516,26 @@ class ProcessExecutor(ExecutorEndpoint):
     def spawn(cls, executor_id: str,
               conf_map: Optional[Dict[str, Any]] = None,
               budget_bytes: int = 0,
-              log_dir: Optional[str] = None) -> "ProcessExecutor":
+              log_dir: Optional[str] = None,
+              launcher=None) -> "ProcessExecutor":
         """Launch a worker process running `python -m
         auron_tpu.serving.executor_endpoint` and wait for its listening
-        line (`auron.fleet.boot.timeout.seconds`)."""
+        line (`auron.fleet.boot.timeout.seconds`).  `launcher` (a
+        serving.fleet.WorkerLauncher) may wrap the argv — the
+        ssh/k8s-shaped remote seam; None spawns locally as before."""
+        from auron_tpu import config
         cmd = [sys.executable, "-m",
                "auron_tpu.serving.executor_endpoint",
                "--executor-id", executor_id, "--port", "0"]
         if conf_map:
-            cmd += ["--conf", json.dumps(conf_map)]
+            # redacted keys (the wire secret) never ride argv — they
+            # are visible in /proc cmdline; workers read their own env
+            cmd += ["--conf", json.dumps(
+                config.redact_overlay(conf_map))]
         if budget_bytes:
             cmd += ["--budget", str(int(budget_bytes))]
+        if launcher is not None:
+            cmd = launcher.wrap(cmd)
         if log_dir is None:
             log_dir = tempfile.mkdtemp(prefix="auron-fleet-")
         log_path = os.path.join(log_dir, f"{executor_id}.log")
@@ -590,6 +613,7 @@ class ProcessExecutor(ExecutorEndpoint):
         through the shared policy.  Transport errors are retryable-IO;
         an answered failure raises EndpointError (deterministic, with
         the worker's exhausted marker mirrored)."""
+        wirecheck.attach_token(header)
         wirecheck.check_request("executor", header)
 
         def _once():
@@ -730,7 +754,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m auron_tpu.serving.executor_endpoint",
         description="Auron TPU fleet executor server")
-    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--host", default=None,
+                    help="bind address (default: auron.net.bind.host)")
+    ap.add_argument("--advertise-host", default=None,
+                    help="host the driver should dial (default: "
+                         "auron.net.advertise.host, else the bind "
+                         "host; wildcard binds advertise loopback)")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--executor-id", default="exec-0")
     ap.add_argument("--conf", default="",
@@ -755,10 +784,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.budget:
         from auron_tpu.memmgr.manager import reset_manager
         reset_manager(int(args.budget))
+    from auron_tpu import config
+    bind_host = args.host if args.host is not None \
+        else config.net_bind_host()
     srv = ExecutorServer(executor_id=args.executor_id,
-                         host=args.host, port=args.port)
+                         host=bind_host, port=args.port)
     host, port = srv.address
-    print(json.dumps({"event": "listening", "host": host, "port": port,
+    adv = args.advertise_host if args.advertise_host is not None \
+        else config.net_advertise_host(host)
+    print(json.dumps({"event": "listening", "host": adv, "port": port,
                       "executor_id": args.executor_id,
                       "pid": os.getpid(),
                       "proto_version": wirecheck.proto_version()}),

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import sys
 import threading
 import time
 from collections import deque
@@ -213,6 +214,75 @@ class ExecutorHealth:
                                   if self.last_ok is not None else None)}
 
 
+class WorkerLauncher:
+    """Where worker and side-car processes RUN: the remote seam behind
+    the fleet's spawn template.  `wrap(argv)` receives the local spawn
+    argv (`python -m auron_tpu...`) and returns the argv the driver
+    actually executes — identity for local children, or a prefix
+    command (ssh/kubectl/srun-shaped) that carries the worker to
+    another host.  The worker's listening line advertises a reachable
+    host:port back (`auron.net.advertise.host`), so the driver never
+    assumes loopback."""
+
+    name = "abstract"
+
+    def wrap(self, argv: List[str]) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalLauncher(WorkerLauncher):
+    """Today's behavior: spawn the argv as a local child, unchanged."""
+
+    name = "local"
+
+    def wrap(self, argv: List[str]) -> List[str]:
+        return list(argv)
+
+
+class CommandLauncher(WorkerLauncher):
+    """Command-template launcher (`auron.fleet.launcher=command`):
+    `auron.fleet.launcher.command` is a whitespace-split argv template;
+    `{argv}` expands in place to the worker argv (appended when the
+    template never names it) and `{python}` to this interpreter —
+    e.g. ``ssh -o BatchMode=yes worker-2 {argv}``."""
+
+    name = "command"
+
+    def __init__(self, template: str):
+        if not str(template or "").strip():
+            raise ValueError(
+                "auron.fleet.launcher=command requires a non-empty "
+                "auron.fleet.launcher.command argv template")
+        self.template = str(template).split()
+
+    def wrap(self, argv: List[str]) -> List[str]:
+        out: List[str] = []
+        expanded = False
+        for part in self.template:
+            if part == "{argv}":
+                out.extend(argv)
+                expanded = True
+            elif part == "{python}":
+                out.append(sys.executable)
+            else:
+                out.append(part)
+        if not expanded:
+            out.extend(argv)
+        return out
+
+
+def launcher_from_conf() -> WorkerLauncher:
+    """The spawn-time launcher selection (`auron.fleet.launcher`)."""
+    kind = str(config.conf.get("auron.fleet.launcher") or "local")
+    if kind == "local":
+        return LocalLauncher()
+    if kind == "command":
+        return CommandLauncher(
+            config.conf.get("auron.fleet.launcher.command"))
+    raise ValueError(f"unknown auron.fleet.launcher {kind!r} "
+                     f"(expected 'local' or 'command')")
+
+
 @dataclass
 class FleetSubmission(Submission):
     """A Submission plus its fleet placement: which executor holds it,
@@ -294,22 +364,25 @@ class _ExecHandle:
 
 @dataclass
 class _SidecarState:
-    """Fleet-side supervision of the durable-shuffle side-car: the
-    process handle (anything with .address/.kill/.close), the control
-    client (shuffle_rss.durable.DurableShuffleClient) and its own
-    health machine — the same alive/suspect/dead evidence rules as an
-    executor, with DEAD equally sticky (new dispatches DEGRADE to
-    executor-local shuffle; nothing is requeued)."""
+    """Fleet-side supervision of ONE durable-shuffle side-car shard:
+    the process handle (anything with .address/.kill/.close), the
+    control client (shuffle_rss.durable.DurableShuffleClient) and its
+    own health machine — the same alive/suspect/dead evidence rules as
+    an executor, with DEAD equally sticky.  A dead shard degrades ONLY
+    the shuffle ids the shard map routes to it (the address list in the
+    dispatch overlay never changes, so the map never shifts); nothing
+    is requeued."""
 
     proc: Any
     control: Any
     health: ExecutorHealth
+    shard: int = 0
     dead: bool = False
     clock_off: float = 0.0         # ping RTT-midpoint estimate
     clock_rtt: float = float("inf")
 
     def snapshot(self) -> Dict[str, Any]:
-        doc = {"dead": self.dead}
+        doc = {"dead": self.dead, "shard": self.shard}
         doc.update(self.health.snapshot())
         if self.dead:
             doc["state"] = DEAD
@@ -349,17 +422,22 @@ class FleetManager:
             self._handles[ep.executor_id] = _ExecHandle(
                 endpoint=ep, health=ExecutorHealth.from_conf(),
                 last_active=now)
-        # durable-shuffle side-car (anything with .address (host, port)
-        # + best-effort .kill()/.close()); supervised by its own health
-        # machine, consulted by every dispatch overlay
-        self._sidecar: Optional[_SidecarState] = None
+        # durable-shuffle side-car shard(s) (anything with .address
+        # (host, port) + best-effort .kill()/.close(), or a list of
+        # them); each shard is supervised by its OWN health machine and
+        # the ordered address list is consulted by every dispatch
+        # overlay — its order IS the shard map (shard_map.py)
+        self._sidecars: List[_SidecarState] = []
         if rss_sidecar is not None:
             from auron_tpu.shuffle_rss.durable import DurableShuffleClient
-            host, port = rss_sidecar.address
-            self._sidecar = _SidecarState(
-                proc=rss_sidecar,
-                control=DurableShuffleClient(host, port),
-                health=ExecutorHealth.from_conf())
+            procs = rss_sidecar if isinstance(rss_sidecar, (list, tuple)) \
+                else [rss_sidecar]
+            for i, proc in enumerate(procs):
+                host, port = proc.address
+                self._sidecars.append(_SidecarState(
+                    proc=proc,
+                    control=DurableShuffleClient(host, port),
+                    health=ExecutorHealth.from_conf(), shard=i))
         # elastic sizing (auron.fleet.scale.*): only active when the
         # fleet knows how to build a worker
         self._worker_factory = worker_factory
@@ -377,18 +455,30 @@ class FleetManager:
 
     # -- construction helpers ----------------------------------------------
 
+    @property
+    def _sidecar(self) -> Optional[_SidecarState]:
+        """Single-shard compatibility view (shard 0)."""
+        return self._sidecars[0] if self._sidecars else None
+
     @classmethod
     def spawn(cls, n: int, conf_map: Optional[Dict[str, Any]] = None,
               budget_bytes: int = 0,
               log_dir: Optional[str] = None,
-              rss_sidecar: Optional[bool] = None) -> "FleetManager":
+              rss_sidecar: Optional[bool] = None,
+              rss_shards: Optional[int] = None,
+              launcher: Optional[WorkerLauncher] = None
+              ) -> "FleetManager":
         """Launch N worker processes, each with an equal slice of the
         federated memory budget (`auron.fleet.memory.budget.bytes`,
         else the driver manager's budget).  With `rss_sidecar` (default
-        `auron.rss.sidecar.enable`) a durable-shuffle side-car process
-        is launched first and every dispatch routes its exchanges
-        through it.  The spawn template doubles as the elastic-scaling
-        worker factory (`auron.fleet.scale.*`)."""
+        `auron.rss.sidecar.enable`) durable-shuffle side-car shard
+        process(es) launch first (`rss_shards`, default
+        `auron.rss.shards`) and every dispatch routes its exchanges
+        through them via the consistent shard map.  `launcher` (default
+        `auron.fleet.launcher`) decides WHERE the children run — local
+        spawn, or a command template carrying them to other hosts.  The
+        spawn template doubles as the elastic-scaling worker factory
+        (`auron.fleet.scale.*`)."""
         from auron_tpu.memmgr import get_manager
         n = max(1, int(n))
         total = int(budget_bytes) or \
@@ -397,31 +487,45 @@ class FleetManager:
         if rss_sidecar is None:
             rss_sidecar = bool(
                 config.conf.get("auron.rss.sidecar.enable"))
-        sidecar = None
+        if rss_shards is None:
+            rss_shards = int(config.conf.get("auron.rss.shards"))
+        rss_shards = max(1, int(rss_shards))
+        if launcher is None:
+            launcher = launcher_from_conf()
+        watermark = int(config.conf.get(
+            "auron.rss.committed.spill.watermark"))
+        sidecars: List[Any] = []
         endpoints: List[ExecutorEndpoint] = []
         try:
             if rss_sidecar:
                 from auron_tpu.shuffle_rss.sidecar import SidecarProcess
-                sidecar = SidecarProcess.spawn(log_dir=log_dir)
+                for i in range(rss_shards):
+                    sidecars.append(SidecarProcess.spawn(
+                        log_dir=log_dir,
+                        shard=i if rss_shards > 1 else None,
+                        committed_watermark=watermark,
+                        launcher=launcher))
             slice_bytes = max(1, total // n)
             for i in range(n):
                 endpoints.append(ProcessExecutor.spawn(
                     f"exec-{i}", conf_map=conf_map,
-                    budget_bytes=slice_bytes, log_dir=log_dir))
+                    budget_bytes=slice_bytes, log_dir=log_dir,
+                    launcher=launcher))
         except BaseException:
             for ep in endpoints:
                 ep.kill()
-            if sidecar is not None:
-                sidecar.kill()
+            for sc in sidecars:
+                sc.kill()
             raise
 
         def factory(executor_id: str) -> ExecutorEndpoint:
             return ProcessExecutor.spawn(
                 executor_id, conf_map=conf_map,
-                budget_bytes=slice_bytes, log_dir=log_dir)
+                budget_bytes=slice_bytes, log_dir=log_dir,
+                launcher=launcher)
 
         return cls(endpoints=endpoints, budget_bytes=total,
-                   rss_sidecar=sidecar, worker_factory=factory)
+                   rss_sidecar=sidecars or None, worker_factory=factory)
 
     def _fleet_budget(self) -> int:
         if self._budget_bytes:
@@ -603,22 +707,28 @@ class FleetManager:
         The tag is the FLEET query id (stable across requeues — the
         executor-side id carries a ~rN suffix) so a requeued attempt
         finds its predecessor's committed map outputs; cleanup is
-        deferred to the fleet's terminal-state hook.  A dead side-car
-        simply stops appearing here: new dispatches degrade to
-        executor-local shuffle."""
-        conf_map = dict(sub.conf)
+        deferred to the fleet's terminal-state hook.  The ordered
+        shard address list is the SERIALIZED SHARD MAP (shard_map.py):
+        it never changes while any shard lives — a dead shard stays in
+        the list (removing it would remap every shuffle id), and the
+        worker degrades exactly the shuffle ids that route to it.  Only
+        with EVERY shard dead does the durable overlay stop appearing.
+        Redacted keys (auron.net.auth.secret) never ride the overlay —
+        workers read their own environment."""
+        conf_map = config.redact_overlay(dict(sub.conf))
         if sub.recorder is not None:
             # trace-context propagation: the dispatch overlay arms the
             # worker's recorder for this query (the worker's
             # trace_scope reads per-query conf), so its spans exist to
             # harvest back over heartbeats
             conf_map["auron.trace.enable"] = True
-        sc = self._sidecar
-        if sc is not None and not sc.dead:
-            host, port = sc.proc.address
+        if self._sidecars and not all(sc.dead for sc in self._sidecars):
+            address = ",".join(
+                "{}:{}".format(*sc.proc.address)
+                for sc in self._sidecars)
             conf_map.update({
                 "auron.shuffle.service": "durable",
-                "auron.shuffle.service.address": f"{host}:{port}",
+                "auron.shuffle.service.address": address,
                 "auron.rss.tag": sub.query_id,
                 "auron.rss.defer.cleanup": True,
             })
@@ -953,7 +1063,7 @@ class FleetManager:
             # terminal lifecycle instant on the driver lane
             sub.recorder.add(f"query.{sub.state}", "fleet",
                              time.perf_counter_ns(), -1, None)
-            sidecar_lane = self._sidecar_lane(sub)
+            sidecar_lanes = self._sidecar_lanes(sub)
             with self._lock:
                 lanes = []
                 for eid, lane in sub.lanes.items():
@@ -967,8 +1077,7 @@ class FleetManager:
                         else 0.0})
                     if not lane["complete"]:
                         incomplete.append(eid)
-            if sidecar_lane is not None:
-                lanes.append(sidecar_lane)
+            lanes.extend(sidecar_lanes)
             trace_doc = tracing.stitch_traces(
                 sub.recorder.to_chrome_trace(), lanes,
                 incomplete=incomplete)
@@ -995,41 +1104,47 @@ class FleetManager:
             trace=trace_doc)
         tracing.record_query(rec)
 
-    def _sidecar_lane(self, sub: FleetSubmission
-                      ) -> Optional[Dict[str, Any]]:
-        """Harvest the side-car's server-side spans for this query tag
-        (before terminal cleanup deletes them)."""
-        sc = self._sidecar
-        if sc is None or sc.dead:
-            return None
-        try:
-            ts = sc.control.trace_spans(sub.query_id)
-        except BaseException as e:  # noqa: BLE001 - loss-tolerant
-            log.warning("side-car span harvest for %s failed: %s",
-                        sub.query_id, e)
-            return None
-        if not ts["spans"]:
-            return None
-        pid = getattr(sc.proc, "pid", None) or 0
-        with self._lock:
-            off = sc.clock_off
-            # anchor on the earliest executor dispatch: the side-car
-            # only sees work that some dispatch caused
-            anchors = [lane["anchor_us"]
-                       for lane in sub.lanes.values()
-                       if lane.get("anchor_us") is not None]
-        return {"label": f"rss-sidecar (pid {pid})" if pid
-                else "rss-sidecar",
-                "pid": pid or 99999, "spans": ts["spans"],
+    def _sidecar_lanes(self, sub: FleetSubmission
+                       ) -> List[Dict[str, Any]]:
+        """Harvest each live shard's server-side spans for this query
+        tag (before terminal cleanup deletes them) — one trace lane per
+        shard that saw work."""
+        lanes: List[Dict[str, Any]] = []
+        for sc in self._sidecars:
+            if sc.dead:
+                continue
+            try:
+                ts = sc.control.trace_spans(sub.query_id)
+            except BaseException as e:  # noqa: BLE001 - loss-tolerant
+                log.warning("side-car shard %d span harvest for %s "
+                            "failed: %s", sc.shard, sub.query_id, e)
+                continue
+            if not ts["spans"]:
+                continue
+            pid = getattr(sc.proc, "pid", None) or 0
+            with self._lock:
+                off = sc.clock_off
+                # anchor on the earliest executor dispatch: the
+                # side-car only sees work that some dispatch caused
+                anchors = [lane["anchor_us"]
+                           for lane in sub.lanes.values()
+                           if lane.get("anchor_us") is not None]
+            name = "rss-sidecar" if len(self._sidecars) == 1 \
+                else f"rss-sidecar-{sc.shard}"
+            lanes.append({
+                "label": f"{name} (pid {pid})" if pid else name,
+                "pid": pid or 99999 - sc.shard, "spans": ts["spans"],
                 "dropped": ts["dropped"], "offset_s": off,
-                "anchor_us": min(anchors) if anchors else None}
+                "anchor_us": min(anchors) if anchors else None})
+        return lanes
 
     # -- the side-car: health, degrade, cleanup ----------------------------
 
     def _probe_sidecar(self) -> None:
-        sc = self._sidecar
-        if sc is None:
-            return
+        for sc in self._sidecars:
+            self._probe_one_sidecar(sc)
+
+    def _probe_one_sidecar(self, sc: _SidecarState) -> None:
         with self._lock:
             due = not sc.dead and sc.health.due()
         if not due:
@@ -1059,15 +1174,20 @@ class FleetManager:
             if sc.dead:
                 return
             sc.dead = True
+            shards = len(self._sidecars)
         counters.bump("rss_sidecar_deaths")
+        scope = "new dispatches degrade to executor-local shuffle" \
+            if shards == 1 else \
+            f"only the shuffle ids shard {sc.shard} owns degrade " \
+            f"(the shard map never shifts)"
         events.emit("sidecar.death",
-                    f"rss side-car declared dead: {reason}; new "
-                    f"dispatches degrade to executor-local shuffle")
+                    f"rss side-car shard {sc.shard} declared dead: "
+                    f"{reason}; {scope}")
         log.warning(
-            "rss side-car declared DEAD (%s): new dispatches degrade "
-            "to executor-local shuffle; in-flight queries degrade "
-            "through their own bounded RPC budgets (no requeue — "
-            "executor state is intact)", reason)
+            "rss side-car shard %d declared DEAD (%s): %s; in-flight "
+            "queries degrade through their own bounded RPC budgets "
+            "(no requeue — executor state is intact)",
+            sc.shard, reason, scope)
         # fence a half-alive incarnation, mirroring executor death
         try:
             sc.proc.kill()
@@ -1078,15 +1198,20 @@ class FleetManager:
         """Terminal-state manifest/ledger cleanup: delete every durable
         shuffle the query's attempts committed (keyed by the fleet
         query tag).  Never called on requeue — resume depends on the
-        blocks surviving the killed attempt."""
-        sc = self._sidecar
-        if sc is None or sc.dead:
-            return
-        try:
-            sc.control.clear_prefix(f"{query_id}|")
+        blocks surviving the killed attempt.  Fans out across every
+        LIVE shard — a query's exchanges spread over all of them."""
+        cleaned = False
+        for sc in self._sidecars:
+            if sc.dead:
+                continue
+            try:
+                sc.control.clear_prefix(f"{query_id}|")
+                cleaned = True
+            except BaseException as e:  # noqa: BLE001 - best effort
+                log.warning("rss cleanup for %s on shard %d failed: %s",
+                            query_id, sc.shard, e)
+        if cleaned:
             counters.bump("rss_cleanups")
-        except BaseException as e:  # noqa: BLE001 - best effort
-            log.warning("rss cleanup for %s failed: %s", query_id, e)
 
     # -- elastic sizing (auron.fleet.scale.*) ------------------------------
 
@@ -1400,13 +1525,13 @@ class FleetManager:
                     for eid, h in self._handles.items()}
 
     def rss_sidecar_up(self) -> Optional[bool]:
-        """None without a side-car; else its liveness — the
-        `auron_rss_sidecar_up` gauge on /metrics."""
-        sc = self._sidecar
-        if sc is None:
+        """None without a side-car; else liveness — the
+        `auron_rss_sidecar_up` gauge on /metrics.  With shards, True
+        only while EVERY shard lives (one dead shard = degraded)."""
+        if not self._sidecars:
             return None
         with self._lock:
-            return not sc.dead
+            return not any(sc.dead for sc in self._sidecars)
 
     def fleet_counter_totals(self) -> Dict[str, int]:
         """Worker-process counters summed from the last heartbeat
@@ -1436,13 +1561,15 @@ class FleetManager:
                 preemptions += sub.num_preemptions
             queued = len(self._queue)
             running = states.get(RUNNING, 0)
-            sidecar = self._sidecar.snapshot() \
-                if self._sidecar is not None else None
+            sidecars = [sc.snapshot() for sc in self._sidecars]
         fleet: Dict[str, Any] = {"executors": self.fleet_snapshot(),
                                  "worker_counters":
                                      self.fleet_counter_totals()}
-        if sidecar is not None:
-            fleet["rss_sidecar"] = sidecar
+        if sidecars:
+            # shard 0 keeps the legacy key; the full shard list rides
+            # alongside for sharded deployments
+            fleet["rss_sidecar"] = sidecars[0]
+            fleet["rss_sidecars"] = sidecars
         return {"queued": queued, "running": running, "states": states,
                 "preemptions": preemptions, "requeues": requeues,
                 "admission": self.admission.snapshot(),
@@ -1471,14 +1598,14 @@ class FleetManager:
             except BaseException as e:  # noqa: BLE001 - best effort
                 log.warning("closing executor %s failed: %s",
                             handle.endpoint.executor_id, e)
-        sc = self._sidecar
-        if sc is not None:
+        for sc in self._sidecars:
             close = getattr(sc.proc, "close", None)
             try:
                 if callable(close):
                     close()
             except BaseException as e:  # noqa: BLE001 - best effort
-                log.warning("closing rss side-car failed: %s", e)
+                log.warning("closing rss side-car shard %d failed: %s",
+                            sc.shard, e)
         if wait:
             deadline = time.time() + timeout
             for handle in handles:

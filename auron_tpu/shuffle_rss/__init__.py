@@ -44,6 +44,17 @@ def service_from_conf():
             f"auron.shuffle.service={kind!r} requires "
             f"auron.shuffle.service.address=host:port "
             f"(got {address!r})")
+    if "," in address:
+        # comma-separated address list = the serialized shard map
+        # (shard_map.py): only the durable commit protocol shards
+        if kind != "durable":
+            raise ValueError(
+                f"auron.shuffle.service={kind!r} does not support a "
+                f"sharded address list (got {address!r})")
+        from auron_tpu.shuffle_rss.shard_map import (
+            ShardedDurableShuffleClient, parse_addresses,
+        )
+        return ShardedDurableShuffleClient(parse_addresses(address))
     host, port = address.rsplit(":", 1)
     if kind == "celeborn":
         return CelebornShuffleClient(host, int(port))

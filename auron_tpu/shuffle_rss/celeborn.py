@@ -74,6 +74,7 @@ class _Conn:
         # Retried pushes are safe because every push carries a dedupable
         # id (push_id / block_id) the server applies at most once.
         cmd = header.get("cmd", "")
+        wirecheck.attach_token(header)
         wirecheck.check_request("rss", header)
 
         def _once():

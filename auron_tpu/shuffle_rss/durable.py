@@ -148,9 +148,13 @@ def _guarded_request(conn: _Conn, header: Dict[str, Any],
         raise
     except (OSError, EOFError, ConnectionError, ValueError,
             RuntimeError) as e:
-        raise RssUnavailable(
+        err = RssUnavailable(
             f"rss side-car {conn.host}:{conn.port} unavailable for "
-            f"{header.get('cmd')}: {type(e).__name__}: {e}") from e
+            f"{header.get('cmd')}: {type(e).__name__}: {e}")
+        # which endpoint died: a sharded session degrades only the
+        # shuffle ids this shard owns (shard_map.py)
+        err.rss_endpoint = f"{conn.host}:{conn.port}"
+        raise err from e
 
 
 class DurableShuffleClient:

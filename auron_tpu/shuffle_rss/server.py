@@ -103,7 +103,8 @@ def _remove_spill_files(paths: List[str]) -> None:
 
 
 class _State:
-    def __init__(self, spill_dir: Optional[str], spill_threshold: int):
+    def __init__(self, spill_dir: Optional[str], spill_threshold: int,
+                 committed_watermark: int = 0):
         self.lock = lockcheck.Lock("rss.state")
         # aggregate model: (shuffle, partition) -> bytearray | spill path
         self.agg: Dict[Tuple[str, int], bytearray] = {}
@@ -137,6 +138,18 @@ class _State:
         self.tspans: Dict[str, Dict[str, Any]] = {}
         self.spill_dir = spill_dir
         self.spill_threshold = spill_threshold
+        # committed-block spill tier (`auron.rss.committed.spill.
+        # watermark`): resident committed bytes above the watermark are
+        # written to per-(shuffle, partition) spill files largest-first
+        # — manifests keep naming the blocks and mfetch restores them
+        # transparently, so a side-car survives committed datasets far
+        # beyond RAM.  0 = committed frames stay resident.
+        self.committed_watermark = int(committed_watermark or 0)
+        self.committed_bytes = 0         # resident committed frames only
+        # (shuffle, partition) -> mid -> {"off": int, "lens": [int]}
+        self.committed_spilled: Dict[Tuple[str, int],
+                                     Dict[int, Dict[str, Any]]] = {}
+        self.committed_spill_files: Dict[Tuple[str, int], str] = {}
         # spill files die with the state: explicitly at server stop, by
         # finalizer on GC/interpreter exit (mirrors the PR 2
         # weakref.finalize fix for operator spill files)
@@ -244,17 +257,92 @@ class _State:
             for pid in entry["parts"]:
                 maps = self.committed.get((sid, int(pid)))
                 if maps is not None:
-                    maps.pop(mid, None)
+                    old = maps.pop(mid, None)
+                    if old is not None:
+                        self.committed_bytes -= \
+                            sum(len(d) for d in old)
+                # a spilled earlier attempt just drops its index entry;
+                # its stale file bytes are reclaimed at shuffle delete
+                sp = self.committed_spilled.get((sid, int(pid)))
+                if sp is not None:
+                    sp.pop(mid, None)
         parts: Dict[str, Dict[str, int]] = {}
         for pid, frames in staged.items():
             data = [d for _, d in frames]
             self.committed.setdefault((sid, pid), {})[mid] = data
-            parts[str(pid)] = {"n": len(data),
-                               "bytes": sum(len(d) for d in data)}
+            nbytes = sum(len(d) for d in data)
+            self.committed_bytes += nbytes
+            parts[str(pid)] = {"n": len(data), "bytes": nbytes}
         self.manifest.setdefault(sid, {})[mid] = {
             "attempt": attempt, "parts": parts}
         self._bump_total(sid, "commits")
+        self._maybe_spill_committed()
         return len(self.manifest[sid])
+
+    def _committed_spill_path(self, key: Tuple[str, int]) -> str:
+        path = self.committed_spill_files.get(key)
+        if path is None:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(
+                self.spill_dir,
+                f"{key[0].replace(':', '_').replace('|', '_')}"
+                f"-{key[1]}.cmt")
+            self.committed_spill_files[key] = path
+            self._spill_paths.append(path)
+        return path
+
+    def _maybe_spill_committed(self) -> None:
+        """Caller holds self.lock.  Above the watermark, move resident
+        committed frames of the LARGEST (shuffle, partition) entries
+        into their spill file (append-only; per-mid offset+lens index
+        stays in memory, so the file is never rewritten) until the
+        resident total is back under the watermark."""
+        if self.committed_watermark <= 0 or self.spill_dir is None:
+            return
+        while self.committed_bytes > self.committed_watermark:
+            key = max((k for k, maps in self.committed.items() if maps),
+                      key=lambda k: sum(
+                          len(d) for frames in
+                          self.committed[k].values() for d in frames),
+                      default=None)
+            if key is None:
+                return
+            maps = self.committed.pop(key)
+            sid = key[0]
+            # append order into the spill file must match the recorded
+            # offsets; the state lock is the only serialization point
+            # (same contract as the aggregate-model spill above)
+            lockcheck.blocked("rss.spill.write")
+            path = self._committed_spill_path(key)
+            index = self.committed_spilled.setdefault(key, {})
+            with open(path, "ab") as f:  # lockcheck: waive (append order)
+                off = f.tell()
+                for mid in sorted(maps):
+                    frames = maps[mid]
+                    for d in frames:
+                        f.write(d)
+                    nbytes = sum(len(d) for d in frames)
+                    index[mid] = {"off": off,
+                                  "lens": [len(d) for d in frames]}
+                    off += nbytes
+                    self.committed_bytes -= nbytes
+                    self._bump_total(sid, "committed_spilled_bytes",
+                                     nbytes)
+            self._bump_total(sid, "committed_spills")
+
+    def _read_spilled_committed(self, key: Tuple[str, int],
+                                mid: int) -> List[bytes]:
+        """Caller holds self.lock: restore one spilled map output's
+        frames (mfetch's transparent-restore path)."""
+        ent = self.committed_spilled[key][mid]
+        lockcheck.blocked("rss.spill.read")
+        frames: List[bytes] = []
+        with open(self.committed_spill_files[key], "rb") as f:  # lockcheck: waive (torn-read guard)
+            f.seek(ent["off"])
+            for ln in ent["lens"]:
+                frames.append(f.read(ln))
+        self._bump_total(key[0], "committed_restores")
+        return frames
 
     def mfetch(self, sid: str, pid: int
                ) -> Tuple[List[Dict[str, Any]], bytes]:
@@ -262,11 +350,14 @@ class _State:
         (deterministic reduce-side stream, the in-process service's
         sort-by-map-id contract) plus per-map frame metadata the client
         validates against the manifest."""
-        maps = self.committed.get((sid, pid), {})
+        key = (sid, pid)
+        maps = self.committed.get(key, {})
+        spilled = self.committed_spilled.get(key, {})
         blocks: List[Dict[str, Any]] = []
         body = bytearray()
-        for mid in sorted(maps):
-            frames = maps[mid]
+        for mid in sorted(set(maps) | set(spilled)):
+            frames = maps[mid] if mid in maps \
+                else self._read_spilled_committed(key, mid)
             blocks.append({"map": mid,
                            "lens": [len(d) for d in frames]})
             for d in frames:
@@ -299,7 +390,22 @@ class _State:
             for k in [k for k in self.pending if k[0] == sid]:
                 del self.pending[k]
             for k in [k for k in self.committed if k[0] == sid]:
+                self.committed_bytes -= sum(
+                    len(d) for frames in self.committed[k].values()
+                    for d in frames)
                 del self.committed[k]
+            for k in [k for k in self.committed_spilled
+                      if k[0] == sid]:
+                del self.committed_spilled[k]
+            for k in [k for k in self.committed_spill_files
+                      if k[0] == sid]:
+                path = self.committed_spill_files.pop(k)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                if path in self._spill_paths:
+                    self._spill_paths.remove(path)
             self.manifest.pop(sid, None)
             self.sealed.pop(sid, None)
 
@@ -360,6 +466,18 @@ class _Handler(socketserver.BaseRequestHandler):
             if refusal is not None:
                 send_msg(self.request, wirecheck.refusal_frame(
                     "rss", refusal,
+                    peer=f"{self.client_address[0]}:"
+                         f"{self.client_address[1]}"))
+                return
+            # shared-secret wire auth (since 1.1, independent of the
+            # wirecheck enable flag like the version handshake): a
+            # missing/wrong token gets a structured DETERMINISTIC
+            # refusal — the client's retry policy ferries it instead of
+            # spinning — and the connection closes
+            denied = wirecheck.auth_refusal(header)
+            if denied is not None:
+                send_msg(self.request, wirecheck.refusal_frame(
+                    "rss", denied,
                     peer=f"{self.client_address[0]}:"
                          f"{self.client_address[1]}"))
                 return
@@ -512,18 +630,22 @@ class ShuffleServer:
     """Threaded in-process server; `with ShuffleServer() as srv:` yields
     (host, port).
 
-    Security note: the protocol is unauthenticated — bind loopback (the
-    default) or a trusted network only.  Frame sizes are capped
-    (MAX_HEADER_LEN / MAX_PAYLOAD_LEN) so a malformed header cannot force
-    unbounded allocations."""
+    Security note: bind loopback (the default) or set
+    `auron.net.auth.secret` so every frame carries a shared-secret
+    token the server verifies (missing/wrong tokens get a structured
+    refusal).  Frame sizes are capped (MAX_HEADER_LEN /
+    MAX_PAYLOAD_LEN) so a malformed header cannot force unbounded
+    allocations."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  spill_dir: Optional[str] = None,
                  spill_threshold: int = 64 << 20,
-                 read_timeout_s: Optional[float] = None):
+                 read_timeout_s: Optional[float] = None,
+                 committed_watermark: int = 0):
         self._srv = _TCPServer((host, port), _Handler,
                                bind_and_activate=True)
-        self._srv.state = _State(spill_dir, spill_threshold)  # type: ignore
+        self._srv.state = _State(spill_dir, spill_threshold,  # type: ignore
+                                 committed_watermark)
         self._srv.read_timeout_s = read_timeout_s  # type: ignore
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
@@ -559,28 +681,55 @@ def main(argv: Optional[List[str]] = None) -> int:
     import signal
     import sys
 
+    from auron_tpu import config
+
     ap = argparse.ArgumentParser(
         prog="python -m auron_tpu.shuffle_rss.server",
         description="Auron TPU remote-shuffle side-car server")
-    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--host", default=None,
+                    help="bind address (default: auron.net.bind.host)")
+    ap.add_argument("--advertise-host", default=None,
+                    help="host peers should dial (default: "
+                         "auron.net.advertise.host, else the bind "
+                         "host; wildcard binds advertise loopback)")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--spill-dir", default="",
                     help="spill oversize aggregate partitions here "
                          "(default: no spilling)")
     ap.add_argument("--spill-threshold", type=int, default=64 << 20)
+    ap.add_argument("--committed-watermark", type=int, default=None,
+                    help="resident-byte watermark for COMMITTED map "
+                         "outputs (default: auron.rss.committed.spill."
+                         "watermark); above it committed blocks spill "
+                         "to the spill dir and mfetch restores them "
+                         "transparently")
     ap.add_argument("--read-timeout", type=float, default=60.0,
                     help="per-connection read timeout seconds (0 = "
                          "blocking); half-dead clients are dropped "
                          "past it")
     args = ap.parse_args(argv)
+    bind_host = args.host if args.host is not None \
+        else config.net_bind_host()
+    watermark = args.committed_watermark \
+        if args.committed_watermark is not None \
+        else int(config.conf.get("auron.rss.committed.spill.watermark"))
+    spill_dir = args.spill_dir or None
+    if watermark > 0 and spill_dir is None:
+        # the committed spill tier needs a spill dir: a watermark
+        # without one would silently never spill
+        import tempfile
+        spill_dir = tempfile.mkdtemp(prefix="auron-rss-spill-")
     srv = ShuffleServer(
-        host=args.host, port=args.port,
-        spill_dir=args.spill_dir or None,
+        host=bind_host, port=args.port,
+        spill_dir=spill_dir,
         spill_threshold=args.spill_threshold,
         read_timeout_s=args.read_timeout if args.read_timeout > 0
-        else None).start()
+        else None,
+        committed_watermark=watermark).start()
     host, port = srv.address
-    print(json.dumps({"event": "listening", "host": host, "port": port,
+    adv = args.advertise_host if args.advertise_host is not None \
+        else config.net_advertise_host(host)
+    print(json.dumps({"event": "listening", "host": adv, "port": port,
                       "pid": os.getpid(),
                       "proto_version": wirecheck.proto_version()}),
           flush=True)

@@ -1,0 +1,150 @@
+"""Consistent shuffle-id -> shard map for sharded RSS side-cars.
+
+`FleetManager.spawn(rss_shards=N)` runs N side-car processes; every
+participant (driver and each worker) must route a shuffle id to the SAME
+shard or manifests and frames would split across servers.  The map is
+therefore a pure function of (shuffle id, ordered shard address list) —
+the address list rides the dispatch overlay in
+`auron.shuffle.service.address` (comma-separated), so serializing the
+addresses IS serializing the map.
+
+The placement is rendezvous (highest-random-weight) hashing keyed on
+CRC32: stable under shard-count growth at spawn time — going from N to
+N+1 shards moves only the ~1/(N+1) of ids the new shard wins, every
+other id keeps its owner.  CRC32 rather than Python's `hash()` because
+the latter is salted per process (PYTHONHASHSEED) and would give each
+worker a different map.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from auron_tpu.shuffle_rss.durable import (
+    DurableShuffleClient, RssUnavailable,
+)
+
+
+def shard_for(shuffle_id: str, n_shards: int) -> int:
+    """Owner shard index for one shuffle id (rendezvous hashing)."""
+    if n_shards <= 1:
+        return 0
+    key = str(shuffle_id).encode("utf-8", "surrogatepass")
+    best, best_w = 0, -1
+    for i in range(n_shards):
+        w = zlib.crc32(key + b"|%d" % i)
+        if w > best_w:          # ties break to the lower index
+            best, best_w = i, w
+    return best
+
+
+def parse_addresses(address: str) -> List[Tuple[str, int]]:
+    """Split `auron.shuffle.service.address` into ordered (host, port)
+    pairs.  Order is significant: it is the shard numbering every
+    participant agrees on."""
+    out: List[Tuple[str, int]] = []
+    for part in str(address or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"shuffle service address {part!r} is not host:port "
+                f"(in {address!r})")
+        host, port = part.rsplit(":", 1)
+        out.append((host, int(port)))
+    return out
+
+
+def format_addresses(addresses: List[Tuple[str, int]]) -> str:
+    return ",".join(f"{h}:{p}" for h, p in addresses)
+
+
+class ShardedDurableShuffleClient(DurableShuffleClient):
+    """N durable side-car shards behind the one-shard client interface.
+
+    Per-shuffle commands route to the owner shard (`shard_for`), so a
+    dead shard degrades ONLY the shuffle ids it owns — the session's
+    RssUnavailable handling then recomputes exactly those exchanges
+    locally.  Prefix-scoped commands (delete_prefix, stats, tspans)
+    fan out across every shard; cleanup fan-out is best-effort on the
+    live shards before the first failure is re-raised."""
+
+    def __init__(self, addresses: List[Tuple[str, int]]):
+        if not addresses:
+            raise ValueError("sharded shuffle client needs >= 1 address")
+        self.shards = [DurableShuffleClient(h, p) for h, p in addresses]
+        # the base-class identity points at shard 0 so diagnostics that
+        # read .host/.port keep working; routed calls never use it
+        super().__init__(addresses[0][0], addresses[0][1])
+
+    @property
+    def addresses(self) -> List[Tuple[str, int]]:
+        return [(s.host, s.port) for s in self.shards]
+
+    def shard_of(self, shuffle_id: str) -> DurableShuffleClient:
+        return self.shards[shard_for(shuffle_id, len(self.shards))]
+
+    # -- per-shuffle commands: route to the owner shard --------------------
+
+    def rss_writer(self, shuffle_id: str, map_id: int):
+        return self.shard_of(shuffle_id).rss_writer(shuffle_id, map_id)
+
+    def reduce_blocks(self, shuffle_id: str, reduce_pid: int,
+                      expect: Optional[Dict[str, Any]] = None
+                      ) -> List[bytes]:
+        return self.shard_of(shuffle_id).reduce_blocks(
+            shuffle_id, reduce_pid, expect)
+
+    def clear(self, shuffle_id: str) -> None:
+        self.shard_of(shuffle_id).clear(shuffle_id)
+
+    def manifest(self, shuffle_id: str) -> Dict[str, Any]:
+        return self.shard_of(shuffle_id).manifest(shuffle_id)
+
+    def seal(self, shuffle_id: str, n_maps: int) -> None:
+        self.shard_of(shuffle_id).seal(shuffle_id, n_maps)
+
+    # -- prefix-scoped commands: fan out across every shard ----------------
+
+    def clear_prefix(self, prefix: str) -> None:
+        first: Optional[RssUnavailable] = None
+        for shard in self.shards:
+            try:
+                shard.clear_prefix(prefix)
+            except RssUnavailable as e:
+                first = first or e      # clean the live shards first
+        if first is not None:
+            raise first
+
+    def stats(self, prefix: str = "") -> Dict[str, Any]:
+        shuffles: Dict[str, Any] = {}
+        totals: Dict[str, Any] = {}
+        for shard in self.shards:
+            part = shard.stats(prefix)
+            shuffles.update(part["shuffles"])
+            for k, v in part["totals"].items():
+                if isinstance(v, (int, float)):
+                    totals[k] = totals.get(k, 0) + v
+                else:
+                    totals[k] = v
+        return {"shuffles": shuffles, "totals": totals}
+
+    def trace_spans(self, tag: str, clear: bool = True) -> Dict[str, Any]:
+        spans: List[Any] = []
+        dropped = 0
+        now = None
+        for shard in self.shards:
+            part = shard.trace_spans(tag, clear)
+            spans.extend(part["spans"])
+            dropped += int(part["dropped"] or 0)
+            if part.get("now") is not None:
+                now = part["now"]
+        return {"spans": spans, "dropped": dropped, "now": now}
+
+    def ping(self) -> bool:
+        return all(shard.ping() for shard in self.shards)
+
+    def ping_info(self) -> Dict[str, Any]:
+        return self.shards[0].ping_info()

@@ -48,14 +48,34 @@ class SidecarProcess:
     @classmethod
     def spawn(cls, log_dir: Optional[str] = None,
               spill_dir: Optional[str] = None,
-              boot_timeout_s: float = 60.0) -> "SidecarProcess":
+              boot_timeout_s: float = 60.0,
+              host: Optional[str] = None,
+              shard: Optional[int] = None,
+              committed_watermark: Optional[int] = None,
+              launcher=None) -> "SidecarProcess":
+        """`host` pins the bind host (else the server resolves
+        `auron.net.bind.host` in its own environment); `shard` only
+        names the log file (rss-sidecar-N.log) — the shard MAP lives in
+        the fleet's ordered address list; `committed_watermark` ships
+        the driver's `auron.rss.committed.spill.watermark` to the
+        child explicitly (conf set via the API does not cross the
+        process boundary); `launcher` (serving.fleet.WorkerLauncher)
+        may wrap the argv — the remote seam."""
         cmd = [sys.executable, "-m", "auron_tpu.shuffle_rss.server",
                "--port", "0"]
+        if host:
+            cmd += ["--host", str(host)]
         if spill_dir:
             cmd += ["--spill-dir", spill_dir]
+        if committed_watermark is not None and committed_watermark > 0:
+            cmd += ["--committed-watermark", str(int(committed_watermark))]
+        if launcher is not None:
+            cmd = launcher.wrap(cmd)
         if log_dir is None:
             log_dir = tempfile.mkdtemp(prefix="auron-rss-")
-        log_path = os.path.join(log_dir, "rss-sidecar.log")
+        name = "rss-sidecar.log" if shard is None \
+            else f"rss-sidecar-{int(shard)}.log"
+        log_path = os.path.join(log_dir, name)
         log_file = open(log_path, "wb")  # noqa: SIM115 - sidecar lifetime
         env = dict(os.environ)
         # the package root on PYTHONPATH: the side-car must boot even

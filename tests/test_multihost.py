@@ -1,0 +1,603 @@
+"""Multi-host fleet plumbing (ISSUE 17).
+
+- Authenticated wires: `auron.net.auth.secret` rides every frame as the
+  since-1.1 `token` registry field.  Missing/garbage tokens get a
+  structured DETERMINISTIC refusal (+ `wire.refusal` event +
+  `auron_wire_rejects_total`), the connection closes, the retry policy
+  never spins; with the secret unset, headers are byte-identical to
+  PR 16 (the OFF path).
+- Secret hygiene: the secret never rides dispatch overlays, spawn argv,
+  or any fleet/scheduler JSON export surface.
+- Shard map (shuffle_rss/shard_map.py): rendezvous placement is
+  deterministic across processes, uniform within 2x over 10k ids, and
+  stable under shard ADD (only ids won by the new shard move).  The
+  comma-joined address list in `auron.shuffle.service.address` IS the
+  serialized map; a dead shard degrades only the shuffle ids it owns.
+- Committed-block spill tier: above
+  `auron.rss.committed.spill.watermark` the side-car spills COMMITTED
+  map outputs to disk; manifests keep naming them, mfetch restores them
+  bit-identically, STATS attributes the spill, delete removes the
+  files.
+- Worker launcher seam: LocalLauncher is identity; CommandLauncher
+  expands the `auron.fleet.launcher.command` argv template.
+
+The heavy 2-host kill -9 gate rides tools/multihost_check.sh (slow).
+"""
+
+import json
+import os
+import socket
+import sys
+
+import pytest
+
+from auron_tpu import config
+from auron_tpu.runtime import counters, events, retry, wirecheck
+from auron_tpu.shuffle_rss import ShuffleServer, service_from_conf
+from auron_tpu.shuffle_rss.celeborn import ShuffleServerError, _Conn
+from auron_tpu.shuffle_rss.durable import (
+    DurableShuffleClient, RssUnavailable,
+)
+from auron_tpu.shuffle_rss.shard_map import (
+    ShardedDurableShuffleClient, format_addresses, parse_addresses,
+    shard_for,
+)
+from auron_tpu.shuffle_rss.server import recv_msg, send_msg
+
+SECRET = "sentinel-wire-secret-360"
+FAST_RETRY = {"auron.retry.backoff.base.ms": 1.0,
+              "auron.retry.backoff.max.ms": 5.0,
+              "auron.retry.max.attempts": 2,
+              "auron.net.timeout.seconds": 5.0}
+
+
+def _connect(addr):
+    s = socket.create_connection(addr, timeout=10)
+    s.settimeout(10)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# auth helpers: token attach/verify logic
+# ---------------------------------------------------------------------------
+
+def test_auth_refusal_logic_and_hygiene():
+    # OFF: no secret -> no token demanded, tokens ignored (fix-forward)
+    assert wirecheck.auth_refusal({"cmd": "ping"}) is None
+    assert wirecheck.auth_refusal({"cmd": "ping", "token": "x"}) is None
+    with config.conf.scoped({"auron.net.auth.secret": SECRET}):
+        assert wirecheck.auth_refusal(
+            {"cmd": "ping", "token": SECRET}) is None
+        missing = wirecheck.auth_refusal({"cmd": "ping"})
+        wrong = wirecheck.auth_refusal({"cmd": "ping", "token": "nope"})
+        assert missing and wrong
+        # refusal text never echoes either side's token
+        for msg in (missing, wrong):
+            assert SECRET not in msg and "nope" not in msg
+
+
+def test_attach_token_off_path_is_identity():
+    h = {"cmd": "ping"}
+    assert wirecheck.attach_token(h) is h
+    assert h == {"cmd": "ping"}          # OFF: bit-identical header
+    with config.conf.scoped({"auron.net.auth.secret": SECRET}):
+        assert wirecheck.attach_token({"cmd": "ping"})["token"] == SECRET
+        # an explicit token survives (setdefault, not overwrite)
+        assert wirecheck.attach_token(
+            {"cmd": "ping", "token": "keep"})["token"] == "keep"
+
+
+def test_token_is_since_versioned_registry_field():
+    field = wirecheck.GLOBAL_REQUEST["token"]
+    assert field.type == "str" and field.required is False
+    assert wirecheck.proto_version() == "1.1"
+
+
+# ---------------------------------------------------------------------------
+# auth on the wire: rss / executor / engine servers refuse bad tokens
+# ---------------------------------------------------------------------------
+
+def test_rss_server_refuses_missing_and_garbage_token():
+    before = counters.get("wire_rejects")
+    cursor = events.snapshot()[-1]["seq"] if events.snapshot() else 0
+    with ShuffleServer() as srv, \
+            config.conf.scoped({"auron.net.auth.secret": SECRET}):
+        for bad in ({"cmd": "ping"}, {"cmd": "ping", "token": "junk"}):
+            s = _connect(srv.address)
+            try:
+                send_msg(s, bad)
+                resp, _ = recv_msg(s)
+                assert resp["refused"] is True and resp["ok"] is False
+                assert resp["deterministic"] is True
+                assert SECRET not in json.dumps(resp)
+                # the refusal closes the connection
+                with pytest.raises((ConnectionError, ValueError,
+                                    OSError)):
+                    send_msg(s, {"cmd": "ping", "token": SECRET})
+                    recv_msg(s)
+            finally:
+                s.close()
+        # the right token serves normally on a fresh connection
+        s = _connect(srv.address)
+        try:
+            send_msg(s, {"cmd": "ping", "token": SECRET})
+            resp, _ = recv_msg(s)
+            assert resp["ok"] is True
+        finally:
+            s.close()
+    assert counters.get("wire_rejects") == before + 2
+    evs = events.snapshot(since=cursor, kind="wire.refusal")
+    assert len(evs) == 2 and evs[-1]["attrs"]["wire"] == "rss"
+
+
+def test_rss_client_bad_token_is_deterministic_no_spin():
+    """A refused frame surfaces as a deterministic error after ONE
+    round trip — the shared retry policy must not replay it."""
+    with ShuffleServer() as srv, \
+            config.conf.scoped({"auron.net.auth.secret": SECRET,
+                                **FAST_RETRY}):
+        conn = _Conn(*srv.address)
+        with pytest.raises(ShuffleServerError) as ei:
+            # attach_token is setdefault: the stale token survives
+            conn.request({"cmd": "ping", "token": "stale"})
+        assert not retry.is_retryable(ei.value)
+        assert SECRET not in str(ei.value)
+
+
+def test_rss_client_roundtrip_with_auth_on():
+    with ShuffleServer() as srv, \
+            config.conf.scoped({"auron.net.auth.secret": SECRET,
+                                **FAST_RETRY}):
+        cli = DurableShuffleClient(*srv.address)
+        w = cli.rss_writer("authq|x0", 0)
+        w.write(0, b"payload")
+        w.flush()
+        cli.seal("authq|x0", 1)
+        man = cli.manifest("authq|x0")
+        assert cli.reduce_blocks("authq|x0", 0, man) == [b"payload"]
+        cli.clear_prefix("authq|")
+
+
+def test_executor_server_refuses_bad_token():
+    from auron_tpu.serving import ExecutorServer
+    srv = ExecutorServer(executor_id="auth-x").start()
+    try:
+        with config.conf.scoped({"auron.net.auth.secret": SECRET}):
+            s = _connect(srv.address)
+            try:
+                send_msg(s, {"cmd": "hello"})
+                resp, _ = recv_msg(s)
+                assert resp["refused"] is True
+                assert resp["deterministic"] is True
+            finally:
+                s.close()
+            # with the token, the same server answers
+            s = _connect(srv.address)
+            try:
+                send_msg(s, {"cmd": "hello", "token": SECRET})
+                resp, _ = recv_msg(s)
+                assert resp["ok"] is True
+            finally:
+                s.close()
+    finally:
+        srv.stop()
+
+
+def test_engine_server_refuses_bad_token():
+    from auron_tpu.service.engine import EngineClient, EngineServer
+    srv = EngineServer().start()
+    try:
+        with config.conf.scoped({"auron.net.auth.secret": SECRET,
+                                 **FAST_RETRY}):
+            s = _connect(srv.address)
+            try:
+                send_msg(s, {"cmd": "ping", "token": "junk"})
+                resp, _ = recv_msg(s)
+                assert resp["refused"] is True
+            finally:
+                s.close()
+            # EngineClient attaches the shared secret and serves
+            with EngineClient(*srv.address) as cli:
+                assert cli.ping() is True
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# secret hygiene: no export surface ever carries the secret
+# ---------------------------------------------------------------------------
+
+def test_secret_dropped_from_overlays_and_exports():
+    assert "auron.net.auth.secret" in config.REDACTED_KEYS
+    overlay = config.redact_overlay(
+        {"auron.batch.size": 64, "auron.net.auth.secret": SECRET})
+    assert overlay == {"auron.batch.size": 64}
+    masked = config.redact_overlay(
+        {"auron.net.auth.secret": SECRET}, mask="***")
+    assert masked == {"auron.net.auth.secret": "***"}
+
+
+def test_secret_never_rides_dispatch_overlay_or_fleet_json():
+    from auron_tpu.serving.fleet import FleetManager, FleetSubmission
+    fleet = FleetManager()
+    try:
+        with config.conf.scoped({"auron.net.auth.secret": SECRET}):
+            sub = FleetSubmission(
+                query_id="q-hygiene", plan=None,
+                conf={"auron.batch.size": 64,
+                      "auron.net.auth.secret": SECRET},
+                priority=0, signature="s")
+            with fleet._lock:
+                fleet._subs["q-hygiene"] = sub
+                overlay = fleet._dispatch_conf_locked(sub)
+            assert "auron.net.auth.secret" not in overlay
+            assert overlay["auron.batch.size"] == 64
+            # every JSON export surface is clean
+            for doc in (sub.status(), fleet.stats(),
+                        fleet.fleet_snapshot()):
+                assert SECRET not in json.dumps(doc, default=str)
+    finally:
+        fleet.shutdown()
+
+
+def test_secret_never_rides_spawn_argv():
+    """The worker spawn ships its conf overlay on argv (visible in
+    /proc); redacted keys must be dropped there — workers read their
+    own environment for the secret."""
+    from auron_tpu.serving.executor_endpoint import ProcessExecutor
+    from auron_tpu.serving.fleet import WorkerLauncher
+
+    class Recorder(WorkerLauncher):
+        def __init__(self):
+            self.argv = None
+
+        def wrap(self, argv):
+            self.argv = list(argv)
+            # never boots: spawn fails fast on the listening timeout
+            return [sys.executable, "-c", "import time; time.sleep(9)"]
+
+    rec = Recorder()
+    with config.conf.scoped(
+            {"auron.fleet.boot.timeout.seconds": 1.0}):
+        with pytest.raises(RuntimeError):
+            ProcessExecutor.spawn(
+                "argv-x",
+                conf_map={"auron.batch.size": 64,
+                          "auron.net.auth.secret": SECRET},
+                launcher=rec)
+    assert rec.argv is not None
+    joined = " ".join(rec.argv)
+    assert SECRET not in joined
+    assert "auron.batch.size" in joined   # non-secret conf still rides
+
+
+# ---------------------------------------------------------------------------
+# shard map properties
+# ---------------------------------------------------------------------------
+
+IDS = [f"q{i:05d}|x{i % 7}" for i in range(10_000)]
+
+
+def test_shard_map_deterministic_and_in_range():
+    for n in (1, 2, 3, 5, 8):
+        for sid in IDS[:200]:
+            s = shard_for(sid, n)
+            assert 0 <= s < n
+            assert s == shard_for(sid, n)    # pure function
+    assert shard_for("anything", 1) == 0
+
+
+def test_shard_map_uniform_within_2x():
+    for n in (2, 4, 8):
+        counts = [0] * n
+        for sid in IDS:
+            counts[shard_for(sid, n)] += 1
+        assert min(counts) > 0
+        assert max(counts) <= 2 * min(counts), (n, counts)
+
+
+def test_shard_map_stable_under_shard_add():
+    """Rendezvous property: growing n -> n+1 at spawn time moves ONLY
+    the ids the new shard wins; every other id keeps its owner."""
+    for n in range(1, 7):
+        moved = 0
+        for sid in IDS[:2000]:
+            old, new = shard_for(sid, n), shard_for(sid, n + 1)
+            if old != new:
+                moved += 1
+                assert new == n, (sid, n, old, new)
+        # expected ~1/(n+1) of ids move; allow 2x slack
+        assert moved <= 2 * len(IDS[:2000]) // (n + 1), (n, moved)
+
+
+def test_shard_map_agreement_from_serialized_overlay():
+    """Driver and worker agree from the overlay string alone: parsing
+    the comma-joined address list reproduces the same ordered shard
+    numbering on any host."""
+    addrs = [("127.0.0.1", 7001), ("127.0.0.2", 7002),
+             ("127.0.0.3", 7003)]
+    wire = format_addresses(addrs)
+    assert parse_addresses(wire) == addrs
+    assert wire.count(",") == 2
+    with pytest.raises(ValueError):
+        parse_addresses("no-port-here")
+    for sid in IDS[:50]:
+        assert shard_for(sid, len(addrs)) == \
+            shard_for(sid, len(parse_addresses(wire)))
+
+
+def test_service_from_conf_builds_sharded_client():
+    with ShuffleServer() as a, ShuffleServer() as b:
+        addr = format_addresses([a.address, b.address])
+        with config.conf.scoped({"auron.shuffle.service": "durable",
+                                 "auron.shuffle.service.address": addr}):
+            svc = service_from_conf()
+            assert isinstance(svc, ShardedDurableShuffleClient)
+            assert isinstance(svc, DurableShuffleClient)  # session gate
+            assert len(svc.shards) == 2
+        with config.conf.scoped({"auron.shuffle.service": "celeborn",
+                                 "auron.shuffle.service.address": addr}):
+            with pytest.raises(ValueError):
+                service_from_conf()
+
+
+# ---------------------------------------------------------------------------
+# sharded client: routing, fan-out, per-shard degrade
+# ---------------------------------------------------------------------------
+
+def _two_sids(n=2):
+    """One sid per shard index for a 2-shard map."""
+    want = {i: None for i in range(n)}
+    i = 0
+    while any(v is None for v in want.values()):
+        sid = f"route{i}|x0"
+        s = shard_for(sid, n)
+        if want[s] is None:
+            want[s] = sid
+        i += 1
+    return [want[i] for i in range(n)]
+
+
+def test_sharded_client_routes_to_owner_and_fans_out():
+    with ShuffleServer() as a, ShuffleServer() as b, \
+            config.conf.scoped(FAST_RETRY):
+        cli = ShardedDurableShuffleClient([a.address, b.address])
+        sid0, sid1 = _two_sids()
+        for sid, data in ((sid0, b"alpha"), (sid1, b"beta")):
+            w = cli.rss_writer(sid, 0)
+            w.write(0, data)
+            w.flush()
+            cli.seal(sid, 1)
+            man = cli.manifest(sid)
+            assert cli.reduce_blocks(sid, 0, man) == [data]
+        # frames landed ONLY on the owner shard
+        assert sid0 in a._srv.state.manifest
+        assert sid0 not in b._srv.state.manifest
+        assert sid1 in b._srv.state.manifest
+        assert sid1 not in a._srv.state.manifest
+        # stats fan out and merge across shards
+        st = cli.stats("route")
+        assert sid0 in st["shuffles"] and sid1 in st["shuffles"]
+        assert st["totals"][sid0]["commits"] == 1
+        # ping requires every shard
+        assert cli.ping() is True
+        # delete_prefix fans out: both shards forget
+        cli.clear_prefix("route")
+        assert not cli.stats("route")["shuffles"]
+
+
+def test_sharded_client_dead_shard_degrades_only_its_sids():
+    a = ShuffleServer().start()
+    b = ShuffleServer().start()
+    try:
+        with config.conf.scoped(FAST_RETRY):
+            cli = ShardedDurableShuffleClient([a.address, b.address])
+            sid0, sid1 = _two_sids()
+            b.stop()                      # shard 1 dies
+            # shard 0's shuffles keep working
+            w = cli.rss_writer(sid0, 0)
+            w.write(0, b"live")
+            w.flush()
+            cli.seal(sid0, 1)
+            assert cli.reduce_blocks(
+                sid0, 0, cli.manifest(sid0)) == [b"live"]
+            # shard 1's shuffles raise RssUnavailable naming the shard
+            with pytest.raises(RssUnavailable) as ei:
+                cli.manifest(sid1)
+            assert ei.value.rss_endpoint == \
+                "{}:{}".format(*b.address)
+            # prefix fan-out cleans the live shard, then re-raises
+            with pytest.raises(RssUnavailable):
+                cli.clear_prefix("route")
+            assert sid0 not in a._srv.state.manifest
+    finally:
+        a.stop()
+        for _ in range(1):
+            try:
+                b.stop()
+            except Exception:
+                pass
+
+
+def test_session_degrade_is_per_shard():
+    """The session-side gate: a dead shard's endpoint only degrades
+    the exchanges the shard map routes to it."""
+    from auron_tpu.frontend.session import AuronSession
+    with ShuffleServer() as a, ShuffleServer() as b, \
+            config.conf.scoped(FAST_RETRY):
+        cli = ShardedDurableShuffleClient([a.address, b.address])
+        sess = AuronSession(shuffle_service=cli)
+        sid0, sid1 = _two_sids()
+        # find rids whose durable sid routes to shard 0 / shard 1
+        dead = "{}:{}".format(*b.address)
+        err = RssUnavailable("down")
+        err.rss_endpoint = dead
+        sess._note_rss_degrade("conv:x0", err)
+        assert not sess._rss_degraded          # global flag untouched
+        hit = miss = None
+        for i in range(64):
+            rid = f"conv:{i}"
+            owner = shard_for(sess._durable_sid(rid), 2)
+            if owner == 1 and hit is None:
+                hit = rid
+            if owner == 0 and miss is None:
+                miss = rid
+            if hit and miss:
+                break
+        assert sess._rss_degraded_for(hit) is True
+        assert sess._rss_degraded_for(miss) is False
+
+
+# ---------------------------------------------------------------------------
+# committed-block spill tier
+# ---------------------------------------------------------------------------
+
+def _commit(cli, sid, mid, frames):
+    w = cli.rss_writer(sid, mid)
+    for pid, data in frames:
+        w.write(pid, data)
+    w.flush()
+
+
+def test_committed_spill_restores_bit_identical(tmp_path):
+    blobs = {mid: bytes([65 + mid]) * 4096 for mid in range(6)}
+    with ShuffleServer(spill_dir=str(tmp_path),
+                       committed_watermark=8192) as srv, \
+            config.conf.scoped(FAST_RETRY):
+        cli = DurableShuffleClient(*srv.address)
+        sid = "spillq|x0"
+        for mid, data in blobs.items():
+            _commit(cli, sid, mid, [(0, data)])
+        cli.seal(sid, len(blobs))
+        state = srv._srv.state
+        with state.lock:
+            assert state.committed_bytes <= 8192
+            spilled = {k: dict(v)
+                       for k, v in state.committed_spilled.items()}
+        assert spilled, "watermark never spilled"
+        # STATS attributes the spill per shuffle
+        totals = cli.stats("spillq")["totals"][sid]
+        assert totals["committed_spills"] >= 1
+        assert totals["committed_spilled_bytes"] > 0
+        # mfetch restores spilled blocks transparently, bit-identical,
+        # in map-id order, and attributes the restores
+        man = cli.manifest(sid)
+        got = cli.reduce_blocks(sid, 0, man)
+        assert got == [blobs[mid] for mid in sorted(blobs)]
+        assert cli.stats("spillq")["totals"][sid][
+            "committed_restores"] >= 1
+        # spill files exist on disk, then die with the shuffle
+        files = list(tmp_path.glob("*.cmt"))
+        assert files
+        cli.clear(sid)
+        assert not list(tmp_path.glob("*.cmt"))
+        with state.lock:
+            assert state.committed_bytes == 0
+
+
+def test_committed_spill_replaced_attempt_stays_consistent(tmp_path):
+    """A replayed map task's commit REPLACES its spilled predecessor:
+    fetch returns only the new attempt's frames."""
+    with ShuffleServer(spill_dir=str(tmp_path),
+                       committed_watermark=1024) as srv, \
+            config.conf.scoped(FAST_RETRY):
+        cli = DurableShuffleClient(*srv.address)
+        sid = "replayq|x0"
+        _commit(cli, sid, 0, [(0, b"x" * 4096)])     # spills
+        _commit(cli, sid, 0, [(0, b"fresh")])        # new attempt
+        cli.seal(sid, 1)
+        man = cli.manifest(sid)
+        assert cli.reduce_blocks(sid, 0, man) == [b"fresh"]
+
+
+def test_committed_spill_off_by_default(tmp_path):
+    with ShuffleServer(spill_dir=str(tmp_path)) as srv, \
+            config.conf.scoped(FAST_RETRY):
+        cli = DurableShuffleClient(*srv.address)
+        _commit(cli, "noq|x0", 0, [(0, b"y" * 65536)])
+        state = srv._srv.state
+        with state.lock:
+            assert not state.committed_spilled
+        assert "committed_spills" not in \
+            cli.stats("noq")["totals"]["noq|x0"]
+
+
+# ---------------------------------------------------------------------------
+# worker launcher seam
+# ---------------------------------------------------------------------------
+
+def test_local_launcher_is_identity():
+    from auron_tpu.serving.fleet import LocalLauncher
+    argv = ["python", "-m", "x", "--flag"]
+    assert LocalLauncher().wrap(argv) == argv
+
+
+def test_command_launcher_template_expansion():
+    from auron_tpu.serving.fleet import CommandLauncher
+    argv = ["python", "-m", "auron_tpu.x"]
+    lo = CommandLauncher("ssh -o BatchMode=yes host2 {argv}")
+    assert lo.wrap(argv) == \
+        ["ssh", "-o", "BatchMode=yes", "host2"] + argv
+    # {python} expands to this interpreter; bare templates append argv
+    assert CommandLauncher("{python} -u").wrap(["a"])[:2] == \
+        [sys.executable, "-u"]
+    assert CommandLauncher("nice -n 10").wrap(argv) == \
+        ["nice", "-n", "10"] + argv
+    with pytest.raises(ValueError):
+        CommandLauncher("   ")
+
+
+def test_launcher_from_conf_selection():
+    from auron_tpu.serving.fleet import (
+        CommandLauncher, LocalLauncher, launcher_from_conf,
+    )
+    assert isinstance(launcher_from_conf(), LocalLauncher)
+    with config.conf.scoped({"auron.fleet.launcher": "command",
+                             "auron.fleet.launcher.command":
+                                 "ssh h {argv}"}):
+        assert isinstance(launcher_from_conf(), CommandLauncher)
+    with config.conf.scoped({"auron.fleet.launcher": "command"}):
+        with pytest.raises(ValueError):
+            launcher_from_conf()
+    with config.conf.scoped({"auron.fleet.launcher": "slurm"}):
+        with pytest.raises(ValueError):
+            launcher_from_conf()
+
+
+# ---------------------------------------------------------------------------
+# bind/advertise host resolution
+# ---------------------------------------------------------------------------
+
+def test_bind_and_advertise_host_resolution():
+    assert config.net_bind_host() == "127.0.0.1"
+    with config.conf.scoped({"auron.net.bind.host": "0.0.0.0"}):
+        assert config.net_bind_host() == "0.0.0.0"
+        # wildcard binds advertise loopback unless configured
+        assert config.net_advertise_host() == "127.0.0.1"
+    with config.conf.scoped({"auron.net.bind.host": "10.0.0.7"}):
+        assert config.net_advertise_host() == "10.0.0.7"
+    with config.conf.scoped({"auron.net.advertise.host": "db.example"}):
+        assert config.net_advertise_host("0.0.0.0") == "db.example"
+
+
+# ---------------------------------------------------------------------------
+# the 2-host kill -9 gate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_tools_multihost_check_script():
+    """tools/multihost_check.sh is the CI multi-host gate: 2 distinct
+    bind hosts, auth ON, kill -9 of the remote worker AND one side-car
+    shard, bit-identical results + resume counters; keep it green from
+    pytest (mirrors rss_check wiring)."""
+    import shutil
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "multihost_check.sh")
+    if not os.path.exists(script) or shutil.which("bash") is None:
+        pytest.skip("multihost script or bash unavailable")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    out = subprocess.run(["bash", script], capture_output=True,
+                         text=True, timeout=540, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
