@@ -1440,10 +1440,84 @@ WIRE_PROTO_VERSION = conf.define(
 )
 KERNEL_COST_PROFILE_PATH = conf.define(
     "auron.kernel.cost.profile.path", "",
-    "Path to a recorded kernel-profile artifact (a BENCH_r0x.json or a "
-    "raw worker-profile dict) that seeds the strategy cost model "
-    "(ops/strategy.py KernelCostModel).  Empty = the embedded "
-    "BENCH_r05 CPU numbers.",
+    "Path to a recorded kernel-profile artifact (a BENCH_r0x.json, a "
+    "raw worker-profile dict, or a perfscope.export_profile() export) "
+    "that seeds the strategy cost model (ops/strategy.py "
+    "KernelCostModel).  Empty = the embedded BENCH_r05 CPU numbers.",
+)
+KERNEL_COST_CALIBRATE = conf.define(
+    "auron.kernel.cost.calibrate", False,
+    "Resolve the strategy cost model from THIS process's live perfscope "
+    "ledgers (runtime/perfscope.py live_profile()) instead of the "
+    "embedded seed numbers: with auron.perf.enable on, kernels measured "
+    "during earlier queries re-price auto-resolution for later ones on "
+    "this machine's observed bandwidths.  Sites with no samples yet "
+    "fall through to auron.kernel.cost.profile.path / the seed, so a "
+    "cold process behaves exactly as before.",
+)
+PERF_ENABLE = conf.define(
+    "auron.perf.enable", False,
+    "Arm perfscope: every jitcheck-registered jit site records wall "
+    "seconds + estimated bytes per (site, signature) into bounded "
+    "reservoirs, feeding EXPLAIN ANALYZE bytes/GB/s columns, GET "
+    "/rooflines, auron_kernel_seconds / auron_kernel_bytes_total "
+    "Prometheus series, and `python -m auron_tpu.perfscope report`.  "
+    "Off (default) = one module-flag read per kernel call, ledgers "
+    "stay empty, results bit-identical.",
+)
+PERF_SYNC = conf.define(
+    "auron.perf.sync", True,
+    "With perfscope armed, block_until_ready() each timed kernel's "
+    "outputs so recorded wall time is device time, not dispatch time.  "
+    "Off = time the (async) dispatch only — cheaper, but on real "
+    "accelerators the numbers become lower bounds.",
+)
+PERF_SAMPLE_STRIDE = conf.define(
+    "auron.perf.sample.stride", 8,
+    "With perfscope armed, time (and under auron.perf.sync, block on) "
+    "every Nth kernel execution per site; the other calls record bytes "
+    "and call counts only.  Blocking each call serializes dispatch the "
+    "engine otherwise overlaps with host work (~5% on warm q01), so "
+    "sampling is how the armed mode stays inside the perf_check.sh "
+    "overhead gate; per-site seconds become sampled estimates "
+    "(avg timed call x calls).  1 = time every call.",
+)
+PERF_RESERVOIR_MAX = conf.define(
+    "auron.perf.reservoir.max", 64,
+    "Per-(site, signature) sample reservoir capacity: after this many "
+    "calls new samples overwrite slots round-robin, keeping memory "
+    "bounded while the EMA tracks the recent distribution.",
+)
+PERF_SIGNATURES_MAX = conf.define(
+    "auron.perf.signatures.max", 8,
+    "Distinct abstract signatures tracked per jit site before further "
+    "signatures aggregate under '<other>' — the same cardinality guard "
+    "jitcheck's retrace-storm detector exists for.",
+)
+PERF_EMA_ALPHA = conf.define(
+    "auron.perf.ema.alpha", 0.2,
+    "Smoothing factor of the per-signature wall-time EMA (new = "
+    "alpha*sample + (1-alpha)*old).",
+)
+PERF_PEAK_GBPS = conf.define(
+    "auron.perf.peak.gbps", 0.0,
+    "Machine peak memory bandwidth (GB/s) used as the roofline "
+    "ceiling.  0 (default) = measure once with a STREAM-style memcpy "
+    "probe and cache the verdict per platform in "
+    "auron.perf.peak.path.",
+)
+PERF_PEAK_PATH = conf.define(
+    "auron.perf.peak.path", "",
+    "Cache file for the measured machine-peak verdict (JSON keyed by "
+    "platform).  Empty = <repo>/.jax_cache/perf_peak.json, beside the "
+    "bench probe-verdict cache.",
+)
+PERF_EXPORT_PATH = conf.define(
+    "auron.perf.export.path", "",
+    "Default path for perfscope.export_profile(): the live per-site "
+    "ledgers rendered in kernel_profile_ms schema, valid as "
+    "auron.kernel.cost.profile.path input for a later process.  Empty "
+    "= export_profile() requires an explicit path argument.",
 )
 
 

@@ -39,6 +39,12 @@ from auron_tpu.runtime.resources import ResourceRegistry
 log = logging.getLogger("auron_tpu.frontend")
 
 
+def _blocks_nbytes(blocks) -> int:
+    """Total serialized bytes of a per-partition block-list fetch result
+    (the late-bound `nbytes` span arg on shuffle.fetch)."""
+    return sum(len(d) for part in blocks for d in part)
+
+
 class ForeignEngine(Protocol):
     """The host engine executing non-converted plan sections (the role
     Spark itself plays in the reference).  Native child results arrive as
@@ -525,7 +531,7 @@ class AuronSession:
         rid = job.rid
         n_reduce = pend["n_reduce"]
         with tracing.span("shuffle.fetch", cat="shuffle", rid=rid,
-                          parts=n_reduce):
+                          parts=n_reduce) as sp:
             if pend["mode"] == "durable":
                 try:
                     blocks = self._durable_fetch_checked(
@@ -541,6 +547,7 @@ class AuronSession:
             else:
                 blocks = self._plain_fetch(job, pend["service"],
                                            n_reduce)
+            sp.set_args(nbytes=_blocks_nbytes(blocks))
         if action is None:
             resources.put(rid, PartitionedBlocks(blocks))
             return
@@ -727,9 +734,10 @@ class AuronSession:
         self._observe_exchange(job, stats)
         n_reduce = job.partitioning.num_partitions
         with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
-                          parts=n_reduce):
-            resources.put(job.rid, PartitionedBlocks(
-                self._plain_fetch(job, service, n_reduce)))
+                          parts=n_reduce) as sp:
+            blocks = self._plain_fetch(job, service, n_reduce)
+            sp.set_args(nbytes=_blocks_nbytes(blocks))
+            resources.put(job.rid, PartitionedBlocks(blocks))
 
     def _plain_map_side(self, job: ShuffleJob, ctx: ConvertContext,
                         service):
@@ -826,9 +834,10 @@ class AuronSession:
         self._observe_exchange(job, stats)
         n_reduce = job.partitioning.num_partitions
         with tracing.span("shuffle.fetch", cat="shuffle", rid=job.rid,
-                          parts=n_reduce):
+                          parts=n_reduce) as sp:
             blocks = self._durable_fetch_checked(job, ctx, sid, man,
                                                  n_reduce)
+            sp.set_args(nbytes=_blocks_nbytes(blocks))
         resources.put(job.rid, PartitionedBlocks(blocks))
 
     def _durable_map_side(self, job: ShuffleJob, ctx: ConvertContext):

@@ -47,16 +47,18 @@ class HostMemSpill(Spill):
         self._codec = codec or conf.get("auron.spill.compression.codec")
 
     def write_batches(self, batches) -> int:
-        with span("spill.write", cat="spill", tier="host"):
+        with span("spill.write", cat="spill", tier="host") as sp:
             fault_point("spill.write")
             sink = io.BytesIO()
             for rb in batches:
                 batch_serde.write_one_batch(rb, sink, codec=self._codec)
             self._buf = sink.getvalue()
+            sp.set_args(nbytes=len(self._buf))
             return len(self._buf)
 
     def read_batches(self):
-        with span("spill.read", cat="spill", tier="host"):
+        with span("spill.read", cat="spill", tier="host",
+                  nbytes=len(self._buf)):
             fault_point("spill.read")
         yield from batch_serde.read_batches(io.BytesIO(self._buf))
 
@@ -94,16 +96,18 @@ class FileSpill(Spill):
         self._cleanup = weakref.finalize(self, _unlink_quiet, self.path)
 
     def write_batches(self, batches) -> int:
-        with span("spill.write", cat="spill", tier="file"):
+        with span("spill.write", cat="spill", tier="file") as sp:
             fault_point("spill.write")
             with open(self.path, "wb") as f:
                 for rb in batches:
                     self._size += batch_serde.write_one_batch(
                         rb, f, codec=self._codec)
+            sp.set_args(nbytes=self._size)
             return self._size
 
     def read_batches(self):
-        with span("spill.read", cat="spill", tier="file"):
+        with span("spill.read", cat="spill", tier="file",
+                  nbytes=self._size):
             fault_point("spill.read")
         with open(self.path, "rb") as f:
             yield from batch_serde.read_batches(f)

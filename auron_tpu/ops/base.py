@@ -68,9 +68,20 @@ class Operator:
         # tier re-runs (num_retries) — the dynamic proof that operator
         # failure recovery works end to end
         fault_point("op.execute")
+        from auron_tpu.runtime import perfscope
         it = self.execute(ctx)
         while True:
+            # with perfscope armed, kernels executed during this pull
+            # attribute their bytes/seconds to THIS operator's metric
+            # node (the EXPLAIN ANALYZE bytes/GB/s columns); the
+            # innermost pulling operator wins, matching whose compute
+            # slice the kernel wall time already lands in.  Disarmed:
+            # one flag read per batch.
+            attr = (perfscope.attribution_scope(self.metrics)
+                    if perfscope.enabled() else None)
             t0 = time.perf_counter_ns()
+            if attr is not None:
+                attr.__enter__()
             try:
                 batch = next(it)
             except StopIteration:
@@ -85,6 +96,9 @@ class Operator:
                     rows=self.metrics.values.get("output_rows", 0),
                     batches=self.metrics.values.get("output_batches", 0))
                 return
+            finally:
+                if attr is not None:
+                    attr.__exit__(None, None, None)
             self.metrics.add("elapsed_compute_ns", time.perf_counter_ns() - t0)
             if not ctx.is_running:
                 return

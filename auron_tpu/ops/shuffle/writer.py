@@ -347,12 +347,14 @@ class InProcessShuffleService:
         from auron_tpu.faults import fault_point
         from auron_tpu.runtime.tracing import span
         with span("shuffle.fetch.part", cat="shuffle",
-                  partition=reduce_pid):
+                  partition=reduce_pid) as sp:
             fault_point("shuffle.fetch")
             with self._lock:
                 entries = list(self._blocks.get((shuffle_id, reduce_pid),
                                                 []))
-            return [d for _mid, d in sorted(entries, key=lambda e: e[0])]
+            out = [d for _mid, d in sorted(entries, key=lambda e: e[0])]
+            sp.set_args(nbytes=sum(len(d) for d in out))
+            return out
 
     def clear(self, shuffle_id: str) -> None:
         with self._lock:

@@ -99,14 +99,47 @@ _MODEL_CACHE: Dict[str, KernelCostModel] = {}
 
 
 def cost_model() -> KernelCostModel:
-    """The active cost model: seeded from the recorded profile file when
-    auron.kernel.cost.profile.path is set (a BENCH_r0x.json artifact or a
-    raw worker-profile dict), else from the embedded r05 numbers."""
+    """The active cost model: with auron.kernel.cost.calibrate on,
+    resolved from this process's LIVE perfscope ledgers (kernels timed
+    during earlier queries re-price auto-resolution for later ones on
+    this machine); else seeded from the recorded profile file when
+    auron.kernel.cost.profile.path is set (a BENCH_r0x.json artifact, a
+    raw worker-profile dict, or a perfscope export), else from the
+    embedded r05 numbers."""
     from auron_tpu.config import conf
     path = str(conf.get("auron.kernel.cost.profile.path"))
+    if bool(conf.get("auron.kernel.cost.calibrate")):
+        from auron_tpu.runtime import perfscope
+        live, live_rows = perfscope.live_profile()
+        if live:
+            # keyed by ledger version: new samples invalidate the cached
+            # model, so the SECOND query of an armed process already
+            # prices on the first query's measured numbers
+            key = f"live:{perfscope.profile_version()}:{path}"
+            m = _MODEL_CACHE.get(key)
+            if m is None:
+                # sites without live samples fall through to the seed
+                # defaults inside from_profile — NOT the path artifact:
+                # live numbers are normalized to _SEED_PROFILE_ROWS
+                # while a path profile carries its own rows, and mixing
+                # denominators would mis-price every kernel
+                m = KernelCostModel.from_profile(dict(live), live_rows)
+                _MODEL_CACHE[key] = m
+            return m
+        # calibrate requested but no samples yet (cold/disarmed): fall
+        # through to the static resolution below
     m = _MODEL_CACHE.get(path)
     if m is not None:
         return m
+    profile, rows = _path_profile(path)
+    m = KernelCostModel.from_profile(profile, rows)
+    _MODEL_CACHE[path] = m
+    return m
+
+
+def _path_profile(path: str):
+    """(profile_ms, rows) from a recorded artifact at `path`, or the
+    embedded seed when unset/unreadable."""
     profile, rows = _SEED_PROFILE_MS, _SEED_PROFILE_ROWS
     if path:
         try:
@@ -123,9 +156,7 @@ def cost_model() -> KernelCostModel:
                 rows = int(doc.get("rows", _SEED_PROFILE_ROWS))
         except (OSError, ValueError):
             pass  # unreadable profile: keep the embedded seed
-    m = KernelCostModel.from_profile(profile, rows)
-    _MODEL_CACHE[path] = m
-    return m
+    return profile, rows
 
 
 def _backend() -> str:
@@ -251,7 +282,27 @@ def strategy_fingerprint() -> tuple:
         int(conf.get("auron.kernel.group.onehot.max.segments")),
         str(conf.get("auron.kernel.cost.profile.path")),
         bool(conf.get("auron.segments.sorted.enable")),
+        # live calibration: the model a traced body priced against is
+        # pinned by the ledger version it resolved from — new samples
+        # must produce a different fingerprint or a cached program
+        # would keep a stale strategy
+        _calibrate_fingerprint(conf),
     )
+
+
+def _calibrate_fingerprint(conf):
+    """Fingerprint contribution of live calibration: the RESOLVED model,
+    quantized to 2 significant digits per field — not the raw ledger
+    version, which bumps on every recorded kernel and would retrace
+    every cached program per batch.  Quantized, the fingerprint only
+    moves when the measured numbers move enough (~5%) to possibly flip
+    a strategy decision."""
+    if not bool(conf.get("auron.kernel.cost.calibrate")):
+        return 0
+    m = cost_model()
+    return tuple(float(f"{v:.2g}") for v in (
+        m.argsort_ns, m.packsort_pass_ns, m.gather_ns,
+        m.searchsorted_ns, m.scatter_ns))
 
 
 # ---------------------------------------------------------------------------

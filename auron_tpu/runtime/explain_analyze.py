@@ -45,13 +45,17 @@ _VOLATILE_KEYS = frozenset({
     # nodes): byte values move with codec/format, rows_out/partitions
     # stay canonical
     "bytes_out", "part_bytes_max", "part_bytes_min",
+    # perfscope kernel accounting (runtime/perfscope.py): estimated
+    # kernel bytes move with batch padding/strategy and only appear
+    # when armed — never part of the canonical form
+    "perf_bytes",
 })
 
 # byte-valued metrics: rendered human-readable in the non-canonical form
 _BYTE_KEYS = frozenset({"mem_peak", "mem_spill_size", "disk_spill_size",
                         "shuffle_write_bytes", "shuffle_read_bytes",
                         "bytes_out", "part_bytes_max",
-                        "part_bytes_min"})
+                        "part_bytes_min", "perf_bytes"})
 
 # render order: row/batch flow first, then time, then memory, then the
 # rest sorted
@@ -155,6 +159,19 @@ def _fmt_value(key: str, value: int) -> str:
     return f"{key}={value}"
 
 
+def _derived_parts(values: Dict[str, Any], normalize: bool) -> List[str]:
+    """Derived columns of the human render: achieved kernel bandwidth
+    from the perfscope accounting (bytes/ns IS GB/s — both 1e9-scaled).
+    Dropped under normalize with the volatile inputs it derives from."""
+    if normalize:
+        return []
+    nbytes = values.get("perf_bytes", 0)
+    ns = values.get("perf_kernel_ns", 0)
+    if nbytes and ns:
+        return [f"kernel_gbps={nbytes / ns:.2f}"]
+    return []
+
+
 def _render_node(node: MetricNode, depth: int, lines: List[str],
                  normalize: bool) -> None:
     node._settle()
@@ -169,6 +186,7 @@ def _render_node(node: MetricNode, depth: int, lines: List[str],
             continue
         parts.append(_fmt_value(k, v) if not normalize
                      else f"{k}={v}")
+    parts += _derived_parts(node.values, normalize)
     pad = "  " * depth
     lines.append(f"{pad}{node.name}: " + (" ".join(parts) or "-"))
     for c in node.children:
@@ -200,6 +218,7 @@ def _render_dict_node(node: Dict[str, Any], depth: int,
             continue
         parts.append(_fmt_value(k, v) if not normalize
                      else f"{k}={v}")
+    parts += _derived_parts(values, normalize)
     pad = "  " * depth
     lines.append(f"{pad}{node.get('name')}: " + (" ".join(parts) or "-"))
     for c in node.get("children") or ():

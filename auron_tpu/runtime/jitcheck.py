@@ -265,8 +265,15 @@ class JitSite:
         actual trace — jax never calls it again for cached shapes."""
         if static_argnames:
             jit_kw["static_argnames"] = static_argnames
+        # every site-built program carries the perfscope shim (runtime/
+        # perfscope.py): disarmed (the default) it is one module-flag
+        # read per execution; armed it records wall seconds + estimated
+        # bytes per (site, signature) into the roofline ledger.  Unlike
+        # jitcheck's own probe this is a RUNTIME decision — the shim
+        # wraps the jitted callable, not the traced function.
+        from auron_tpu.runtime import perfscope
         if not _ENABLED:
-            return jax.jit(fn, **jit_kw)
+            return perfscope.wrap(self.name, jax.jit(fn, **jit_kw))
         with _GUARD:
             prog = _ProgramState(
                 f"{getattr(fn, '__name__', type(fn).__name__)}"
@@ -278,7 +285,7 @@ class JitSite:
             self._note_trace(prog, args, kwargs)
             return fn(*args, **kwargs)
 
-        return jax.jit(probe, **jit_kw)
+        return perfscope.wrap(self.name, jax.jit(probe, **jit_kw))
 
     def __repr__(self) -> str:
         return f"<jitcheck.JitSite {self.name!r} " \

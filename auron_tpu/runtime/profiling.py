@@ -325,6 +325,36 @@ def _prometheus_text() -> str:
          sum(1 for d in jitcheck.diagnostics()
              if d.kind == "retrace-storm"),
          help_="retrace-storm diagnostics recorded this process")
+    from auron_tpu.ops.kernel_cache import family_builds
+    fb = family_builds()
+    if fb:
+        name = "auron_kernel_builds_total"
+        lines.append(f"# HELP {name} kernel builds (cache misses) per "
+                     f"kernel family — a strategy flip shows up as a "
+                     f"second family building")
+        lines.append(f"# TYPE {name} counter")
+        for fam in sorted(fb):
+            lines.append(
+                f'{name}{{family="{_prom_escape(fam)}"}} {fb[fam]}')
+    from auron_tpu.runtime import perfscope
+    psec = perfscope.kernel_seconds()
+    pbytes = perfscope.kernel_bytes()
+    if psec:
+        name = "auron_kernel_seconds"
+        lines.append(f"# HELP {name} wall seconds inside jitted kernels "
+                     f"per jit site (runtime/perfscope.py; empty until "
+                     f"auron.perf.enable)")
+        lines.append(f"# TYPE {name} counter")
+        for s in sorted(psec):
+            lines.append(
+                f'{name}{{site="{_prom_escape(s)}"}} {psec[s]:.6f}')
+        name = "auron_kernel_bytes_total"
+        lines.append(f"# HELP {name} estimated bytes moved by jitted "
+                     f"kernels per jit site (perfscope estimators)")
+        lines.append(f"# TYPE {name} counter")
+        for s in sorted(pbytes):
+            lines.append(
+                f'{name}{{site="{_prom_escape(s)}"}} {pbytes[s]}')
     ic = ingest_cache_info()
     emit("auron_ffi_ingest_cache_entries", ic.get("entries", 0), "gauge")
     emit("auron_ffi_ingest_cache_bytes", ic.get("bytes", 0), "gauge")
@@ -777,6 +807,10 @@ class _Handler(BaseHTTPRequestHandler):
                     url.path[len("/queries/"):],
                     q.get("format", [""])[0] == "json")
                 self._send(code, body, ctype)
+            elif url.path == "/rooflines":
+                from auron_tpu.runtime import perfscope
+                self._send(200,
+                           json.dumps(perfscope.rooflines()).encode())
             elif url.path == "/events":
                 from auron_tpu.runtime import events
                 evs = events.snapshot(

@@ -230,6 +230,9 @@ class _NoopSpan:
     def __exit__(self, *exc) -> bool:
         return False
 
+    def set_args(self, **args: Any) -> None:
+        """No-op twin of _SpanCtx.set_args."""
+
 
 _NOOP = _NoopSpan()
 
@@ -247,6 +250,14 @@ class _SpanCtx:
     def __enter__(self) -> "_SpanCtx":
         self._t0 = time.perf_counter_ns()
         return self
+
+    def set_args(self, **args: Any) -> None:
+        """Attach args whose values only exist once the work inside the
+        span ran (fetch byte totals, row counts): merged into the
+        event's `args` when the span closes."""
+        merged = dict(self._args or {})
+        merged.update(args)
+        self._args = merged
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         dur = time.perf_counter_ns() - self._t0

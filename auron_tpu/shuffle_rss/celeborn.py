@@ -134,7 +134,15 @@ class _CelebornPartitionWriter(RssPartitionWriter):
                   "push_id": push_id}
         body = bytes(buf)
         self._buf[partition_id] = bytearray()
-        self._pipe.submit(lambda: self.conn.request(header, body))
+        def _send() -> None:
+            # span on the sender thread (contextvars copied by the
+            # pipeline) so pipelined pushes carry byte counts
+            from auron_tpu.runtime.tracing import span
+            with span("shuffle.push", cat="shuffle",
+                      transport="celeborn", partition=partition_id,
+                      nbytes=len(body)):
+                self.conn.request(header, body)
+        self._pipe.submit(_send)
 
     def flush(self) -> None:
         for pid in list(self._buf):

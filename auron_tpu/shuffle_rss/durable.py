@@ -122,7 +122,17 @@ class _DurableMapWriter(RssPartitionWriter):
                   "map": self.map_id, "attempt": self.attempt,
                   "partition": partition_id, "push_id": push_id,
                   "len": len(data)}
-        self._pipe.submit(lambda: self._request(header, data))
+        def _send() -> None:
+            # the span opens ON the sender thread (PushPipeline copies
+            # the submitter's contextvars, so the trace recorder and
+            # span parent propagate) — pipelined pushes are attributed
+            # with their true wall time and byte count
+            from auron_tpu.runtime.tracing import span
+            with span("shuffle.push", cat="shuffle",
+                      transport="durable", partition=partition_id,
+                      nbytes=len(data)):
+                self._request(header, data)
+        self._pipe.submit(_send)
 
     def flush(self) -> None:
         self._pipe.close()   # every staged push answered BEFORE commit

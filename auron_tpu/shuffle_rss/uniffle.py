@@ -48,12 +48,18 @@ class _UnifflePartitionWriter(RssPartitionWriter):
             # at-least-once: a retrying client may push the same block
             # twice; the reader's dedup must make this invisible.  The
             # duplicates stay adjacent on the one sender thread —
-            # exactly the synchronous arrival order.
-            for _ in range(self.duplicate_pushes):
-                self.conn.request(
-                    {"cmd": "push_block", "shuffle": self.shuffle_id,
-                     "partition": partition_id, "block_id": block_id,
-                     "len": len(data)}, data)
+            # exactly the synchronous arrival order.  The span opens on
+            # the sender thread (contextvars copied by the pipeline) so
+            # pipelined pushes carry wall time + byte counts.
+            from auron_tpu.runtime.tracing import span
+            with span("shuffle.push", cat="shuffle",
+                      transport="uniffle", partition=partition_id,
+                      nbytes=len(data) * self.duplicate_pushes):
+                for _ in range(self.duplicate_pushes):
+                    self.conn.request(
+                        {"cmd": "push_block", "shuffle": self.shuffle_id,
+                         "partition": partition_id, "block_id": block_id,
+                         "len": len(data)}, data)
         self._pipe.submit(push)
 
     def flush(self) -> None:

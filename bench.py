@@ -155,6 +155,25 @@ def worker_engine() -> dict:
     # a site recompiling INSIDE the timed loop is a broken cache key,
     # not a slower kernel — name it in the artifact
     retrace_sites = jitcheck.retrace_sites(baseline=warm_counts)
+    # perfscope pass: the same warm loop armed — the artifact records
+    # the per-site roofline (achieved GB/s vs the measured machine
+    # peak) and the armed-over-disarmed overhead ratio the OFF-default
+    # claim rests on
+    from auron_tpu.runtime import perfscope
+    perfscope.reset_state()
+    perfscope.configure(True)
+    try:
+        armed_times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = execute_plan(plan, resources=res)
+            for b in r.batches:
+                b.num_rows
+            armed_times.append(time.perf_counter() - t0)
+        rooflines = perfscope.rooflines()
+    finally:
+        perfscope.configure(False)
+    armed_med = sorted(armed_times)[1]
     # fusion observability: how many fragments/ops the rewriter fused in
     # this plan (runtime/fusion.py), so the artifact records whether the
     # serial number ran fused and at what coverage
@@ -167,6 +186,10 @@ def worker_engine() -> dict:
             "fused_ops": fusion_rep.ops_fused,
             "compile_count": sum(jitcheck.compile_counts().values()),
             "retrace_sites": retrace_sites,
+            "perfscope_sites": rooflines.get("sites", {}),
+            "machine_peak_gbps": rooflines.get("peak_gbps", 0.0),
+            "perfscope_overhead_ratio": round(armed_med / med, 4)
+            if med > 0 else 1.0,
             "platform": jax.devices()[0].platform}
 
 
@@ -837,6 +860,16 @@ def _summarize(results: dict, baseline_rps: float,
             out["fuse_enabled"] = engine.get("fuse_enabled")
             out["fused_fragments"] = engine.get("fused_fragments")
             out["fused_ops"] = engine.get("fused_ops")
+            if engine.get("perfscope_sites"):
+                # per-jit-site roofline from the armed warm loop (the
+                # live-ledger view; the microbench roofline from the
+                # profile worker lands under the same key below when
+                # that worker runs too)
+                out.setdefault("kernel_roofline", {})["perfscope_sites"] \
+                    = engine["perfscope_sites"]
+                out["machine_peak_gbps"] = engine.get("machine_peak_gbps")
+                out["perfscope_overhead_ratio"] = \
+                    engine.get("perfscope_overhead_ratio")
     elif fused is not None:
         rps = fused["rows"] / fused["seconds"]
         out = {
@@ -875,7 +908,10 @@ def _summarize(results: dict, baseline_rps: float,
         if profile.get("kernel_strategy"):
             out["kernel_strategy"] = profile["kernel_strategy"]
         if profile.get("roofline"):
-            out["kernel_roofline"] = profile["roofline"]
+            # merge, don't overwrite: the engine worker may already have
+            # folded its live per-site table under perfscope_sites
+            out.setdefault("kernel_roofline", {}).update(
+                profile["roofline"])
             out["hbm_roofline_gbps"] = profile.get("hbm_roofline_gbps")
             out["device_kind"] = profile.get("device_kind")
     sd = results.get("serde")

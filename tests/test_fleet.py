@@ -550,6 +550,8 @@ def test_fleet_fails_queued_when_every_executor_is_dead():
         srv1.stop()
 
 
+@pytest.mark.slow   # PR 18 tier-1 re-split (10.7s; decommission is
+# also exercised by the scale-down tests)
 def test_fleet_decommission_moves_queued_keeps_running():
     blocky = _BlockingFactory()
     srv1, ep1 = _start_server(session_factory=blocky, executor_id="e1")

@@ -155,6 +155,8 @@ def sort_rows(rows, key):
     return sorted(rows, key=lambda r: (r[key] is not None, r[key] or 0))
 
 
+@pytest.mark.slow   # PR 18 tier-1 re-split (8.1s; SMJ fast coverage
+#   stays via test_smj_giant_group + the mesh corpus q93s pin)
 def test_smj_matches_hash_join():
     rng = np.random.default_rng(8)
     left, right = make_sides(rng, nl=200, nr=200)
@@ -185,6 +187,8 @@ def test_smj_streaming_types(how):
     assert canon(got) == canon(exp), how
 
 
+@pytest.mark.slow   # PR 18 tier-1 re-split (10.8s; smj core parity
+# stays via test_smj_matches_hash_join)
 def test_smj_string_keys():
     rng = np.random.default_rng(22)
     words = ["ant", "bee", "cat", "dog", "elk", "fox", None, "anteater"]

@@ -151,6 +151,8 @@ def test_num_passes_word_packing():
 # partitioned probe vs double searchsorted
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # PR 18 tier-1 re-split (10.1s; the non-randomized
+# bounded-probe regressions stay fast)
 def test_bounded_probe_matches_searchsorted_randomized():
     from auron_tpu.ops.joins.kernel import bounded_probe, build_probe_index
     rng = np.random.default_rng(9)
@@ -275,6 +277,8 @@ def test_partitioned_probe_kernel_family_built():
 # one-hot group reduce
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # PR 18 tier-1 re-split (7.4s; randomized sweep —
+#   deterministic onehot-vs-scatter equivalence stays fast)
 def test_onehot_reducers_match_scatter_randomized():
     from auron_tpu.ops.hash_group import (
         onehot_segment_extreme, onehot_segment_sum,

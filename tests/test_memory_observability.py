@@ -509,6 +509,8 @@ def _run_agg(table):
     return execute_plan(_agg_plan(table), resources=res)
 
 
+@pytest.mark.slow   # PR 18 tier-1 re-split (9.4s; spill-metric
+# plumbing stays covered by the other staged-spill tests)
 def test_agg_staged_spilled_mid_collapse_not_lost(monkeypatch):
     """Serving-PR regression: with concurrent queries sharing the pool,
     the accounting update INSIDE AggExec._compact_staged can push usage

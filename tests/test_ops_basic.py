@@ -162,6 +162,8 @@ def test_agg_single_mode():
         assert out[k]["av"] == pytest.approx(sum(vs) / len(vs))
 
 
+@pytest.mark.slow   # PR 18 tier-1 re-split (10.3s; partial/final agg
+# rides every tier-1 corpus query)
 def test_agg_partial_final_pipeline():
     rows = [{"k": i % 5, "v": i} for i in range(500)]
     partial = AggExec(scan_of(rows), "partial", [col("k")], ["k"],

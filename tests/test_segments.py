@@ -88,6 +88,8 @@ def test_scatter_fallback_path():
         conf.set("auron.segments.sorted.enable", old)
 
 
+@pytest.mark.slow   # PR 18 tier-1 re-split (7.6s; exactness property
+#   — the deterministic segment-sum units keep the family fast)
 def test_sorted_segment_sum_exact_zero_segments():
     """Round-3 regression (q74-shape): an all-zero float segment embedded
     among large-magnitude segments must sum to EXACTLY 0.0 — the

@@ -84,6 +84,9 @@ _TIER1_STRAGGLERS = {
     "q67r", "q39v", "q98", "q25m", "q76u", "q80s", "q56s", "q20c",
     "q68s", "q22r", "q43", "q79s", "q62w",
     "q23c", "q27r", "q24s", "q74y", "q53m",
+    # PR 18 tier-1 re-split (8.4s each; serial-only variants whose
+    # operator families ride other tier-1 queries — nightly covers them)
+    "q86r", "q14c",
 }
 _TIER1_QUERIES = (set(names()[::4]) | {
     "q03", "q07", "q42", "q55", "q13a", "q26a", "q48a", "q19", "q65w",
@@ -92,7 +95,12 @@ _TIER1_QUERIES = (set(names()[::4]) | {
 }) - _TIER1_STRAGGLERS
 
 
-_TIER1_SERIAL = _TIER1_QUERIES - {"q36r"}
+# PR 18 tier-1 re-split: queries whose MESH variant stays in tier-1
+# (MESH_QUERIES below) drop their serial twin from the fast box —
+# the serial path still runs them nightly, and serial q01/q93s/q55/...
+# keep the single-device corpus exercised every push (~55s back)
+_TIER1_SERIAL = _TIER1_QUERIES - {
+    "q36r", "q03", "q42", "q19", "q71u", "q07", "q33b", "q60b"}
 
 
 @pytest.mark.parametrize(
