@@ -1519,6 +1519,43 @@ PERF_EXPORT_PATH = conf.define(
     "auron.kernel.cost.profile.path input for a later process.  Empty "
     "= export_profile() requires an explicit path argument.",
 )
+STATS_STORE_DIR = conf.define(
+    "auron.stats.store.dir", "",
+    "Arm the durable per-plan-signature statistics store "
+    "(runtime/statshist.py): at query terminal the QueryRecord's "
+    "wall/queue/exec breakdown, mem peaks, per-exchange observed "
+    "{bytes, rows, partitions}, AQE decisions and the perfscope kernel "
+    "profile fold into an append-only crash-safe JSONL file under this "
+    "directory; on startup the store seeds MemForecaster admission "
+    "forecasts, the CostModel's per-(signature, exchange) history (the "
+    "learned-initial-plan feed) and auron.kernel.cost.calibrate.  "
+    "Empty (default) = OFF, terminal path bit-identical.  In a fleet "
+    "the DRIVER owns the store (worker records ship over harvest; "
+    "worker processes never write it).",
+)
+STATS_COMPACT_MAX_RECORDS = conf.define(
+    "auron.stats.compact.max.records", 512,
+    "Per-run record lines tolerated in the store file before it is "
+    "rewritten as one EMA summary line per signature (atomic temp+"
+    "rename); with the 30-day signature age cap this bounds the store "
+    "however many queries a long-lived server folds.",
+)
+STATS_REGRESSION_FACTOR = conf.define(
+    "auron.stats.regression.factor", 2.0,
+    "Baseline regression threshold: a terminal record whose wall, "
+    "exec, shuffle-bytes or spill dimension exceeds its signature's "
+    "EMA baseline by more than this factor (above per-dimension noise "
+    "floors) emits one structured `query.regression` flight-recorder "
+    "event naming the offending dimensions, bumps "
+    "auron_query_regressions_total{kind}, and lands on GET "
+    "/regressions.",
+)
+STATS_REGRESSION_MIN_RUNS = conf.define(
+    "auron.stats.regression.min.runs", 3,
+    "Runs a signature's baseline must have folded before regression "
+    "detection arms for it — the first executions of a new plan shape "
+    "establish the EMA instead of comparing against one cold sample.",
+)
 
 
 _COMPILE_CACHE_APPLIED: List[str] = []

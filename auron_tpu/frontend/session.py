@@ -180,6 +180,9 @@ class AuronSession:
 
         scope = tracing.trace_scope(query_id=query_id)
         counters.bump("queries_started")
+        # a conversion failure must not record THIS run under the
+        # previous run's plan signature
+        self._plan_signature = ""
         t0 = time.perf_counter()
         wall_start = time.time()
         self._wall_start = wall_start
@@ -210,6 +213,7 @@ class AuronSession:
                          "t": wall_start + wall_s}]
             tracing.record_query(tracing.QueryRecord(
                 query_id=scope.query_id, wall_s=wall_s,
+                signature=self._plan_signature,
                 rows=res.table.num_rows if res is not None else 0,
                 spmd=res.spmd if res is not None else False,
                 attempts=st.get("attempts", 0),
@@ -252,9 +256,12 @@ class AuronSession:
         self._aqe_decisions = []
         self._exchange_stats = []
         self._plan_signature = ""
-        if config.ADAPTIVE_ENABLE.get():
+        from auron_tpu.runtime import statshist
+        if config.ADAPTIVE_ENABLE.get() or statshist.enabled():
             # the unified cost model keys its live exchange history by
-            # plan signature (serving/forecast.py) — computed once here
+            # plan signature (serving/forecast.py) — computed once
+            # here; the durable stats store (runtime/statshist.py)
+            # keys its terminal fold by the same signature
             from auron_tpu.serving.forecast import plan_signature
             try:
                 self._plan_signature = plan_signature(plan)

@@ -243,6 +243,24 @@ class CostModel:
                 dq = self._history[key] = deque(maxlen=self._keep)
             dq.append((stats.total_bytes, stats.total_rows))
 
+    def seed_exchange(self, signature: str, ordinal: str,
+                      total_bytes: int, total_rows: int) -> bool:
+        """Prime one (plan signature, exchange) history entry from the
+        durable stats store — the learned-initial-plan feed: a fresh
+        process costs a repeated plan shape from what the SAME exchange
+        produced last lifetime, BEFORE its first stage runs here.  Live
+        observations own the key: an entry that already has history is
+        left alone."""
+        if not signature or total_bytes <= 0:
+            return False
+        key = (signature, ordinal)
+        with self._lock:
+            if self._history.get(key):
+                return False
+            dq = self._history[key] = deque(maxlen=self._keep)
+            dq.append((int(total_bytes), int(total_rows)))
+            return True
+
     def expected_exchange_bytes(self, signature: str, ordinal: str
                                 ) -> Optional[int]:
         """Largest recently observed total for this (plan, exchange) —

@@ -159,9 +159,10 @@ def snapshot() -> Dict[str, int]:
     """Flat counter snapshot: lifecycle counters here + the retry-policy
     stats (prefixed `retry_`) + per-site jit compile counts (prefixed
     `jit_compiles_`, runtime/jitcheck.py) + per-(wire,cmd) frame counts
-    (prefixed `wire_frames_`, runtime/wirecheck.py) so `/metrics`
-    exports one namespace."""
-    from auron_tpu.runtime import jitcheck, retry, wirecheck
+    (prefixed `wire_frames_`, runtime/wirecheck.py) + the durable
+    stats-store totals (prefixed `stats_`, runtime/statshist.py) so
+    `/metrics` exports one namespace."""
+    from auron_tpu.runtime import jitcheck, retry, statshist, wirecheck
     with _LOCK:
         out = dict(_COUNTERS)
     for k, v in retry.stats_snapshot().items():
@@ -170,6 +171,8 @@ def snapshot() -> Dict[str, int]:
         out[f"jit_compiles_{site}"] = n
     for (wire, cmd), n in wirecheck.frame_counts().items():
         out[f"wire_frames_{wire}_{cmd}"] = n
+    for k, v in statshist.store_stats().items():
+        out[f"stats_{k}"] = v
     return out
 
 

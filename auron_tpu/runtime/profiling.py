@@ -355,6 +355,26 @@ def _prometheus_text() -> str:
         for s in sorted(pbytes):
             lines.append(
                 f'{name}{{site="{_prom_escape(s)}"}} {pbytes[s]}')
+    # durable stats store (runtime/statshist.py): store gauges + the
+    # per-dimension regression counter.  Headers always emitted (like
+    # wire_frames) so dashboards see the series exist before the first
+    # regression fires.
+    emit("auron_stats_store_signatures",
+         snap.get("stats_store_signatures", 0), "gauge",
+         "plan signatures resident in the durable stats store "
+         "(0 until auron.stats.store.dir)")
+    emit("auron_stats_store_bytes", snap.get("stats_store_bytes", 0),
+         "gauge", "on-disk size of the durable stats store file")
+    name = "auron_query_regressions_total"
+    lines.append(f"# HELP {name} baseline regressions detected per "
+                 f"dimension (statshist EMA baselines; empty until a "
+                 f"stored signature regresses)")
+    lines.append(f"# TYPE {name} counter")
+    for k in sorted(snap):
+        if k.startswith("query_regressions_"):
+            kind = k[len("query_regressions_"):]
+            lines.append(
+                f'{name}{{kind="{_prom_escape(kind)}"}} {snap[k]}')
     ic = ingest_cache_info()
     emit("auron_ffi_ingest_cache_entries", ic.get("entries", 0), "gauge")
     emit("auron_ffi_ingest_cache_bytes", ic.get("bytes", 0), "gauge")
@@ -471,6 +491,151 @@ def _queries_diff(qa: str, qb: str, as_json: bool):
             f"(wall {ra.wall_s:.3f}s vs {rb.wall_s:.3f}s)</p>"
             f"<pre>{_html.escape(text)}</pre>"
             "<p><a href='/queries'>queries</a></p></body></html>")
+    return 200, body.encode(), "text/html"
+
+
+def _queries_diff_baseline(qa: str, sig: str, as_json: bool):
+    """(status, body, content_type) for /queries/diff?baseline=<sig>:
+    diff a completed run's metric tree against the stored signature
+    baseline from the durable stats store.  With `a` unset the most
+    recent history record carrying that signature is used."""
+    from auron_tpu.runtime import statshist, tracing
+    from auron_tpu.runtime.explain_analyze import (
+        diff_metric_trees, render_diff,
+    )
+    base_trees = statshist.baseline_trees(sig)
+    if not base_trees:
+        return 404, json.dumps(
+            {"error": f"no stored history for signature {sig!r} "
+                      "(arm auron.stats.store.dir and run the query "
+                      "at least once)"}).encode(), "application/json"
+    ra = None
+    if qa:
+        ra = tracing.find_query(qa)
+        if ra is None:
+            return 404, json.dumps(
+                {"error": f"unknown query id {qa!r}"}
+            ).encode(), "application/json"
+    else:
+        for rec in reversed(tracing.query_history()):
+            if getattr(rec, "signature", "") == sig and \
+                    rec.metric_trees:
+                ra = rec
+                break
+        if ra is None:
+            return 404, json.dumps(
+                {"error": f"no completed run with signature {sig!r} "
+                          "in this process's history — pass a=<id> or "
+                          "run the query first"}
+            ).encode(), "application/json"
+    if not ra.metric_trees:
+        return 404, json.dumps(
+            {"error": "no per-operator metric trees recorded for the "
+                      "run (SPMD stage programs have none — run with "
+                      "auron.spmd.singleDevice.enable=false)"}
+        ).encode(), "application/json"
+    try:
+        diff = diff_metric_trees(ra.metric_trees, base_trees)
+    except ValueError as e:
+        return 400, json.dumps({"error": str(e)}).encode(), \
+            "application/json"
+    if as_json:
+        return 200, json.dumps(
+            {"a": ra.to_dict(), "baseline_signature": sig,
+             "diff": diff}).encode(), "application/json"
+    import html as _html
+    text = render_diff(diff, query_a=ra.query_id,
+                       query_b=f"baseline:{sig}")
+    body = ("<html><head><title>Auron baseline diff</title></head>"
+            "<body><h2>Run vs stored baseline</h2>"
+            f"<p><code>{_html.escape(ra.query_id)}</code> vs stored "
+            f"baseline of <code>{_html.escape(sig)}</code></p>"
+            f"<pre>{_html.escape(text)}</pre>"
+            "<p><a href='/signatures'>signatures</a></p></body></html>")
+    return 200, body.encode(), "text/html"
+
+
+def _signatures_view(as_json: bool):
+    """(status, body, content_type) for /signatures."""
+    from auron_tpu.runtime import statshist
+    snap = statshist.signatures_snapshot()
+    if as_json:
+        return 200, json.dumps(snap).encode(), "application/json"
+    import html as _html
+    rows = "".join(
+        f'<tr><td><a href="/signatures/{_html.escape(sig)}">'
+        f"<code>{_html.escape(sig)}</code></a></td>"
+        f"<td>{d['runs']}</td><td>{d['ema_wall_s']:.3f}s</td>"
+        f"<td>{_fmt_mem(int(d['ema_mem_peak']))}</td>"
+        f"<td>{d['exchanges']}</td><td>{d['regressions']}</td>"
+        f"<td>{'yes' if d['has_baseline_trees'] else '-'}</td></tr>"
+        for sig, d in sorted(snap.items()))
+    body = (
+        "<html><head><title>Auron signatures</title><style>"
+        "body{font-family:sans-serif;margin:2em}"
+        "table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 10px}"
+        "</style></head><body><h2>Stored plan signatures</h2>"
+        f"<p>{len(snap)} signatures in the durable stats store"
+        "</p><table><tr><th>signature</th><th>runs</th>"
+        "<th>ema wall</th><th>ema mem peak</th><th>exchanges</th>"
+        "<th>regressions</th><th>baseline trees</th></tr>"
+        + rows +
+        "</table><p><a href='/'>home</a> · "
+        "<a href='/signatures?format=json'>json</a> · "
+        "<a href='/regressions'>regressions</a> · diff vs baseline: "
+        "<code>/queries/diff?baseline=SIG</code></p></body></html>")
+    return 200, body.encode(), "text/html"
+
+
+def _signature_view(sig: str, as_json: bool):
+    """(status, body, content_type) for /signatures/<sig>."""
+    from auron_tpu.runtime import statshist
+    doc = statshist.signature_detail(sig)
+    if doc is None:
+        return 404, json.dumps(
+            {"error": f"unknown signature {sig!r}"}).encode(), \
+            "application/json"
+    if as_json:
+        return 200, json.dumps(doc).encode(), "application/json"
+    import html as _html
+    body = ("<html><head><title>Auron signature "
+            f"{_html.escape(sig)}</title></head><body>"
+            f"<h2>Signature <code>{_html.escape(sig)}</code></h2>"
+            f"<pre>{_html.escape(json.dumps(doc, indent=2))}</pre>"
+            "<p><a href='/signatures'>signatures</a></p>"
+            "</body></html>")
+    return 200, body.encode(), "text/html"
+
+
+def _regressions_view(as_json: bool):
+    """(status, body, content_type) for /regressions."""
+    from auron_tpu.runtime import statshist
+    regs = statshist.regressions_snapshot()
+    if as_json:
+        return 200, json.dumps({"regressions": regs}).encode(), \
+            "application/json"
+    import html as _html
+    rows = "".join(
+        f"<tr><td><code>{_html.escape(str(r['query_id']))}</code></td>"
+        f'<td><a href="/signatures/{_html.escape(str(r["signature"]))}">'
+        f"<code>{_html.escape(str(r['signature']))}</code></a></td>"
+        f"<td>{_html.escape(', '.join(d['dim'] for d in r['dims']))}"
+        f"</td><td><code>{_html.escape(json.dumps(r['dims']))}"
+        f"</code></td></tr>" for r in regs)
+    body = (
+        "<html><head><title>Auron regressions</title><style>"
+        "body{font-family:sans-serif;margin:2em}"
+        "table{border-collapse:collapse}"
+        "td,th{border:1px solid #ccc;padding:4px 10px}"
+        "</style></head><body><h2>Baseline regressions</h2>"
+        f"<p>{len(regs)} detected (EMA baseline &times; "
+        "auron.stats.regression.factor)</p>"
+        "<table><tr><th>query</th><th>signature</th>"
+        "<th>dimensions</th><th>detail</th></tr>" + rows +
+        "</table><p><a href='/'>home</a> · "
+        "<a href='/regressions?format=json'>json</a> · "
+        "<a href='/signatures'>signatures</a></p></body></html>")
     return 200, body.encode(), "text/html"
 
 
@@ -768,11 +933,20 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/queries/diff":
                 qa = q.get("a", [""])[0]
                 qb = q.get("b", [""])[0]
-                if not qa or not qb:
-                    self._send(400, b'{"error": "need a=<id>&b=<id>"}')
+                base = q.get("baseline", [""])[0]
+                as_json = q.get("format", [""])[0] == "json"
+                if base:
+                    # diff a run (a=<id>, default: latest run of the
+                    # signature) against its stored statshist baseline
+                    code, body, ctype = _queries_diff_baseline(
+                        qa, base, as_json)
+                    self._send(code, body, ctype)
+                elif not qa or not qb:
+                    self._send(400, b'{"error": "need a=<id>&b=<id> '
+                                     b'or baseline=<signature>"}')
                 else:
                     code, body, ctype = _queries_diff(
-                        qa, qb, q.get("format", [""])[0] == "json")
+                        qa, qb, as_json)
                     self._send(code, body, ctype)
             elif url.path == "/queries":
                 if q.get("format", [""])[0] == "json":
@@ -811,6 +985,19 @@ class _Handler(BaseHTTPRequestHandler):
                 from auron_tpu.runtime import perfscope
                 self._send(200,
                            json.dumps(perfscope.rooflines()).encode())
+            elif url.path == "/signatures":
+                code, body, ctype = _signatures_view(
+                    q.get("format", [""])[0] == "json")
+                self._send(code, body, ctype)
+            elif url.path.startswith("/signatures/"):
+                code, body, ctype = _signature_view(
+                    url.path[len("/signatures/"):],
+                    q.get("format", [""])[0] == "json")
+                self._send(code, body, ctype)
+            elif url.path == "/regressions":
+                code, body, ctype = _regressions_view(
+                    q.get("format", [""])[0] == "json")
+                self._send(code, body, ctype)
             elif url.path == "/events":
                 from auron_tpu.runtime import events
                 evs = events.snapshot(

@@ -550,6 +550,11 @@ class QueryRecord:
     Spark UI tab shows per execution, plus the trace when recorded."""
     query_id: str
     wall_s: float
+    # structural plan signature (serving/forecast.plan_signature) — the
+    # cross-surface correlation key admission forecasts, the CostModel
+    # and the durable statistics store (runtime/statshist.py) share;
+    # "" when neither adaptive execution nor the stats store needed it
+    signature: str = ""
     rows: int = 0
     spmd: bool = False
     attempts: int = 0
@@ -586,6 +591,7 @@ class QueryRecord:
     def to_dict(self, with_trace: bool = False,
                 with_trees: bool = False) -> Dict[str, Any]:
         d = {"query_id": self.query_id, "wall_s": round(self.wall_s, 4),
+             "signature": self.signature,
              "rows": self.rows, "spmd": self.spmd,
              "attempts": self.attempts, "retries": self.retries,
              "fallbacks": self.fallbacks,
@@ -619,6 +625,12 @@ def record_query(rec: QueryRecord) -> None:
         _HISTORY.append(rec)
         if len(_HISTORY) > limit:
             del _HISTORY[:len(_HISTORY) - limit]
+    # durable statistics fold (runtime/statshist.py): every terminal
+    # entry point funnels through here, so the store sees session,
+    # scheduler and fleet-harvested records alike.  No-op (one dict
+    # read) unless auron.stats.store.dir is armed.
+    from auron_tpu.runtime import statshist
+    statshist.on_record(rec)
 
 
 def query_history() -> List[QueryRecord]:

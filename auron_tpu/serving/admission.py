@@ -75,6 +75,10 @@ class AdmissionController:
         self.events: Dict[str, int] = {"admitted": 0, "queued": 0,
                                        "shed": 0, "degraded": 0,
                                        "reforecast": 0}
+        # cross-restart admission: a fresh controller forecasts from
+        # the durable stats store (no-op with the store unarmed)
+        from auron_tpu.runtime import statshist
+        statshist.seed_forecaster(self.forecaster)
 
     def _budget(self) -> int:
         if self._budget_fn is not None:

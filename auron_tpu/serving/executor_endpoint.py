@@ -781,6 +781,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.conf:
         for key, value in json.loads(args.conf).items():
             conf.set(key, value)
+    # worker records ship to the driver over harvest and the DRIVER
+    # owns the durable stats store — disarm it here so a conf overlay
+    # leaking auron.stats.store.dir cannot double-fold every query
+    from auron_tpu.runtime import statshist
+    statshist.mark_worker()
     if args.budget:
         from auron_tpu.memmgr.manager import reset_manager
         reset_manager(int(args.budget))

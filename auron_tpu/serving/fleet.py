@@ -1086,6 +1086,7 @@ class FleetManager:
             query_id=sub.query_id,
             wall_s=float(status.get("wall_s") or hr.get("wall_s")
                          or sub.wall_s or 0.0),
+            signature=sub.signature or str(hr.get("signature") or ""),
             rows=int(status.get("rows") or hr.get("rows") or 0),
             spmd=bool(hr.get("spmd", False)),
             attempts=int(hr.get("attempts") or 0),
@@ -1101,6 +1102,8 @@ class FleetManager:
             mem_spill_bytes=int(hr.get("mem_spill_bytes") or 0),
             metric_trees=hr.get("metric_trees"),
             timeline=list(sub.timeline),
+            aqe_decisions=hr.get("aqe_decisions"),
+            exchange_stats=hr.get("exchange_stats"),
             trace=trace_doc)
         tracing.record_query(rec)
 

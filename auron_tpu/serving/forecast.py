@@ -51,6 +51,10 @@ class MemForecaster:
         self._keep = keep
         self._lock = lockcheck.Lock("serving.forecast")
         self._history: Dict[str, deque] = {}
+        # signatures whose history came from the durable stats store
+        # rather than this process's own runs — surfaced as forecast
+        # PROVENANCE on /scheduler, cleared on the first live peak
+        self._seeded: set = set()
 
     def record(self, signature: str, peak_bytes: int) -> None:
         if peak_bytes <= 0:
@@ -60,6 +64,23 @@ class MemForecaster:
             if dq is None:
                 dq = self._history[signature] = deque(maxlen=self._keep)
             dq.append(int(peak_bytes))
+            self._seeded.discard(signature)
+
+    def seed(self, signature: str, peaks) -> bool:
+        """Prime a signature's history from the durable stats store
+        (cross-restart admission: a fresh process forecasts from what
+        the plan ACTUALLY used last lifetime).  Live observations always
+        win — a signature that already has history is left alone."""
+        peaks = [int(p) for p in peaks if int(p) > 0][-self._keep:]
+        if not peaks:
+            return False
+        with self._lock:
+            if self._history.get(signature):
+                return False
+            dq = self._history[signature] = deque(maxlen=self._keep)
+            dq.extend(peaks)
+            self._seeded.add(signature)
+            return True
 
     def forecast(self, signature: str) -> Optional[int]:
         """Max of the recent observations, or None with no history (the
@@ -68,8 +89,10 @@ class MemForecaster:
             dq = self._history.get(signature)
             return max(dq) if dq else None
 
-    def snapshot(self) -> Dict[str, Dict[str, int]]:
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
             return {sig: {"runs": len(dq), "max_peak": max(dq),
-                          "last_peak": dq[-1]}
+                          "last_peak": dq[-1],
+                          "provenance": ("store" if sig in self._seeded
+                                         else "live")}
                     for sig, dq in self._history.items() if dq}

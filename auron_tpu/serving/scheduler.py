@@ -346,6 +346,12 @@ class QueryScheduler:
             sub.query_id,
             lambda est, age, _q=sub.query_id:
             self.admission.reforecast(_q, est, age))
+        # durable stats: the session-level record fires with a minimal
+        # running->terminal timeline; defer its fold so the ONE store
+        # entry carries the full queued->admitted->... machine this
+        # driver patches in below (no-op with the store unarmed)
+        from auron_tpu.runtime import statshist
+        statshist.defer(sub.query_id)
         try:
             # session construction INSIDE the overlay: the per-query
             # conf governs construction-time choices too (e.g. the
@@ -446,13 +452,24 @@ class QueryScheduler:
                 if started is not None:
                     counters.observe("query_exec_seconds",
                                      max(0.0, sub.finished_at - started))
-                sub.done.set()
             rec = tracing.find_query(sub.query_id)
             if rec is not None:
                 # surface the kill-and-requeue count + the lifecycle
                 # timeline on the /queries row
                 rec.preemptions = sub.num_preemptions
                 rec.timeline = list(sub.timeline)
+                if not rec.signature:
+                    rec.signature = sub.signature
+            if not requeue:
+                # the deferred durable-stats fold, now that the record
+                # carries the full lifecycle timeline (a requeued run
+                # re-defers and folds at its own terminal).  done.set()
+                # strictly AFTER: a client observing terminal must find
+                # the fold (and any regression verdict) already landed.
+                try:
+                    statshist.observe_deferred(sub.query_id, rec)
+                finally:
+                    sub.done.set()
             self._pump()
 
     # -- watermark preemption ----------------------------------------------

@@ -1,0 +1,425 @@
+"""statshist coverage (runtime/statshist.py): the durable per-plan-
+signature statistics store — terminal fold + EMA baselines, regression
+detection (event + counters + ring), store durability edges (torn and
+garbage tails, concurrent appenders, EMA compaction bounds), the
+cross-restart seeding of MemForecaster / CostModel / perfscope, the
+/signatures + /regressions + baseline-diff HTTP surfaces, and the
+OFF-default bit-identity claim tools/stats_check.sh rides end to end."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from auron_tpu import config
+from auron_tpu.config import conf
+from auron_tpu.runtime import adaptive, counters, events, statshist, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_store():
+    """Every test starts and ends DISARMED with an empty in-memory
+    mirror (the OFF-default production contract); the process-global
+    cost model is reset so seeding tests see a cold one."""
+    statshist.reset_state()
+    statshist.mark_worker(False)
+    adaptive._MODEL = None
+    events.clear()
+    yield
+    statshist.reset_state()
+    statshist.mark_worker(False)
+    adaptive._MODEL = None
+    events.clear()
+
+
+def _rec(qid="q-1", sig="sigA", wall=1.0, rows=10, mem_peak=1 << 20,
+         spills=0, trees=True, exchanges=True, error=None, run_s=None):
+    """A synthetic terminal QueryRecord with a full lifecycle timeline
+    (0.1s queued + 0.1s admitted + `run_s` running)."""
+    run_s = wall if run_s is None else run_s
+    return tracing.QueryRecord(
+        query_id=qid, wall_s=wall, signature=sig, rows=rows,
+        mem_peak=mem_peak, mem_spills=spills,
+        timeline=[{"state": "queued", "t": 0.0},
+                  {"state": "admitted", "t": 0.1},
+                  {"state": "running", "t": 0.2},
+                  {"state": "succeeded", "t": 0.2 + run_s}],
+        exchange_stats=[{"exchange": "x0", "partitions": 4,
+                         "bytes_out": 4096, "rows_out": rows,
+                         "resumed": False}] if exchanges else None,
+        aqe_decisions=[{"kind": "coalesce", "exchange": "x0"}],
+        metric_trees=[{"tasks": 1,
+                       "tree": {"name": "scan",
+                                "values": {"output_rows": rows},
+                                "children": []}}] if trees else None,
+        error=error)
+
+
+def _armed(tmp_path):
+    return conf.scoped({"auron.stats.store.dir": str(tmp_path)})
+
+
+# ---------------------------------------------------------------------------
+# OFF default
+# ---------------------------------------------------------------------------
+
+def test_off_default_no_store_side_effects(tmp_path):
+    """Dir unset (the default): the terminal path neither creates files
+    nor accumulates store state — bit-identity with the pre-statshist
+    terminal path."""
+    assert not statshist.enabled()
+    statshist.on_record(_rec())
+    assert statshist.signatures_snapshot() == {}
+    ss = statshist.store_stats()
+    assert ss["store_signatures"] == 0 and ss["store_appends"] == 0
+    assert os.listdir(tmp_path) == []
+
+
+def test_worker_role_disarms_even_with_dir_set(tmp_path):
+    with _armed(tmp_path):
+        statshist.mark_worker()
+        assert not statshist.enabled()
+        statshist.on_record(_rec())
+        assert not os.path.exists(tmp_path / "stats.jsonl")
+        statshist.mark_worker(False)
+        assert statshist.enabled()
+
+
+def test_failed_and_unsigned_records_are_skipped(tmp_path):
+    with _armed(tmp_path):
+        statshist.on_record(_rec(error="boom"))
+        statshist.on_record(_rec(sig=""))
+        assert statshist.signatures_snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# fold + EMA + regression
+# ---------------------------------------------------------------------------
+
+def test_fold_ema_exchanges_and_aqe(tmp_path):
+    with _armed(tmp_path):
+        for i in range(4):
+            statshist.on_record(_rec(qid=f"q-{i}"))
+        snap = statshist.signatures_snapshot()
+        assert snap["sigA"]["runs"] == 4
+        assert abs(snap["sigA"]["ema_wall_s"] - 1.0) < 1e-6
+        assert snap["sigA"]["has_baseline_trees"]
+        detail = statshist.signature_detail("sigA")
+        assert detail["exchanges"]["x0"]["bytes"] == 4096
+        assert detail["aqe"]["coalesce"] == 4
+        assert statshist.signature_detail("nope") is None
+        # the store file holds one run line per fold
+        path = tmp_path / "stats.jsonl"
+        lines = path.read_bytes().splitlines()
+        assert sum(1 for ln in lines
+                   if json.loads(ln)["kind"] == "run") == 4
+
+
+def test_regression_event_counters_and_ring(tmp_path):
+    with _armed(tmp_path), conf.scoped(
+            {"auron.stats.regression.min.runs": 3,
+             "auron.stats.regression.factor": 2.0}):
+        before = counters.snapshot().get("query_regressions_wall_s", 0)
+        for i in range(3):
+            statshist.on_record(_rec(qid=f"q-{i}"))
+        # 3rd run is the baseline; a 10x run must regress on wall+exec
+        statshist.on_record(_rec(qid="q-slow", wall=10.0))
+        regs = statshist.regressions_snapshot()
+        assert len(regs) == 1 and regs[0]["query_id"] == "q-slow"
+        dims = {d["dim"] for d in regs[0]["dims"]}
+        assert {"wall_s", "exec_s"} <= dims
+        evs = events.snapshot(kind="query.regression")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["signature"] == "sigA"
+        assert "wall_s" in evs[0]["attrs"]["dims"]
+        snap = counters.snapshot()
+        assert snap["query_regressions_wall_s"] == before + 1
+        # a regressed run must not become the diff baseline, and it
+        # counts on the signature summary
+        sig = statshist.signatures_snapshot()["sigA"]
+        assert sig["regressions"] == 1
+        assert statshist.baseline_trees("sigA") is not None
+
+
+def test_regression_min_runs_gate(tmp_path):
+    with _armed(tmp_path), conf.scoped(
+            {"auron.stats.regression.min.runs": 5}):
+        for i in range(3):
+            statshist.on_record(_rec(qid=f"q-{i}"))
+        statshist.on_record(_rec(qid="q-slow", wall=50.0))
+        assert statshist.regressions_snapshot() == []
+        assert events.snapshot(kind="query.regression") == []
+
+
+def test_deferred_fold_waits_for_the_driver(tmp_path):
+    """A scheduler-owned query folds ONCE, via observe_deferred with
+    the patched record — the session-level record_query hook skips it."""
+    with _armed(tmp_path):
+        statshist.defer("q-d")
+        rec = _rec(qid="q-d")
+        statshist.on_record(rec)          # the record_query half: skipped
+        assert statshist.signatures_snapshot() == {}
+        statshist.observe_deferred("q-d", rec)
+        assert statshist.signatures_snapshot()["sigA"]["runs"] == 1
+        statshist.observe_deferred("q-d", rec)   # not deferred: no-op
+        assert statshist.signatures_snapshot()["sigA"]["runs"] == 1
+
+
+# ---------------------------------------------------------------------------
+# durability edges
+# ---------------------------------------------------------------------------
+
+def test_torn_and_garbage_tail_skipped_with_diagnostic(tmp_path):
+    with _armed(tmp_path):
+        statshist.on_record(_rec(qid="q-ok"))
+        path = tmp_path / "stats.jsonl"
+        with open(path, "ab") as f:
+            f.write(b'{"v":1,"kind":"run","sig":"sigB","dims":{"wa')
+            f.write(b"\n\x00\x7fgarbage not json\n")
+            f.write(b'{"v":1,"kind":"run","sig":""}\n')
+            f.write(b'["not","a","dict"]\n')
+        statshist.reset_state()
+        snap = statshist.signatures_snapshot()   # forces the re-load
+        assert snap["sigA"]["runs"] == 1         # good prefix survives
+        assert "sigB" not in snap
+        diags = statshist.diagnostics()
+        assert len(diags) == 4
+        assert all(d["kind"] == "corrupt-record" for d in diags)
+        assert statshist.store_stats()["store_corrupt_skipped"] == 4
+
+
+def test_concurrent_append_from_two_processes(tmp_path):
+    """Two processes folding into ONE store dir interleave whole
+    records (single-write O_APPEND lines): a fresh load sees every run
+    from both, zero corruption."""
+    script = (
+        "import sys\n"
+        "from auron_tpu.config import conf\n"
+        "from auron_tpu.runtime import statshist, tracing\n"
+        "conf.set('auron.stats.store.dir', sys.argv[1])\n"
+        "for i in range(20):\n"
+        "    statshist.on_record(tracing.QueryRecord(\n"
+        "        query_id=f'{sys.argv[2]}-{i}', wall_s=1.0,\n"
+        "        signature='sig-' + sys.argv[2], rows=1,\n"
+        "        mem_peak=1024))\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, str(tmp_path), tag],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for tag in ("a", "b")]
+    for p in procs:
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+    with _armed(tmp_path):
+        snap = statshist.signatures_snapshot()
+        assert snap["sig-a"]["runs"] == 20
+        assert snap["sig-b"]["runs"] == 20
+        assert statshist.store_stats()["store_corrupt_skipped"] == 0
+
+
+def test_ema_compaction_bounds_the_file(tmp_path):
+    with _armed(tmp_path), conf.scoped(
+            {"auron.stats.compact.max.records": 8}):
+        for i in range(30):
+            statshist.on_record(_rec(qid=f"q-{i}", trees=False))
+        assert statshist.store_stats()["store_compactions"] >= 1
+        lines = [json.loads(ln) for ln in
+                 (tmp_path / "stats.jsonl").read_bytes().splitlines()]
+        # bounded: at most the compact summary + max.records run tails
+        assert sum(1 for d in lines if d["kind"] == "run") <= 8
+        assert sum(1 for d in lines if d["kind"] == "compact") == 1
+        # the summary preserves the full run count across reload
+        statshist.reset_state()
+        assert statshist.signatures_snapshot()["sigA"]["runs"] == 30
+
+
+# ---------------------------------------------------------------------------
+# cross-restart seeding
+# ---------------------------------------------------------------------------
+
+def test_restart_seeds_forecaster_costmodel_with_provenance(tmp_path):
+    from auron_tpu.serving import AdmissionController
+    with _armed(tmp_path):
+        for i in range(3):
+            statshist.on_record(_rec(qid=f"q-{i}"))
+        # "restart": forget the in-memory mirror, cold consumers
+        statshist.reset_state()
+        adaptive._MODEL = None
+        ctl = AdmissionController()
+        snap = ctl.forecaster.snapshot()
+        assert snap["sigA"]["provenance"] == "store"
+        assert ctl.forecaster.forecast("sigA") == 1 << 20
+        # the learned-initial-plan feed: exchange history is non-empty
+        # BEFORE the fresh process runs its first stage
+        model = adaptive.unified_cost_model()
+        assert model.expected_exchange_bytes("sigA", "x0") == 4096
+        # the first LIVE observation flips provenance and owns the key
+        ctl.observe("sigA", 2 << 20)
+        assert ctl.forecaster.snapshot()["sigA"]["provenance"] == "live"
+
+
+def test_seeds_never_clobber_live_history(tmp_path):
+    from auron_tpu.serving.forecast import MemForecaster
+    f = MemForecaster()
+    f.record("sigA", 999)
+    assert f.seed("sigA", [111, 222]) is False
+    assert f.forecast("sigA") == 999
+    assert f.seed("sigX", [0, -5]) is False   # nothing real to seed
+    model = adaptive.CostModel()
+    model.seed_exchange("sigA", "x0", 100, 1)
+    assert model.seed_exchange("sigA", "x0", 777, 7) is False
+    assert model.expected_exchange_bytes("sigA", "x0") == 100
+
+
+def test_restart_seeds_perfscope_kernel_profile(tmp_path):
+    from auron_tpu.runtime import perfscope
+    perfscope.reset_state()
+    try:
+        perfscope.record("unit.statshist", 0.5, 10 ** 6, signature="s")
+        with _armed(tmp_path):
+            statshist.on_record(_rec())
+            # restart: cold perfscope ledger, the stored kern line
+            # re-seeds the site so calibration survives
+            statshist.reset_state()
+            perfscope.reset_state()
+            assert "unit.statshist" not in perfscope.snapshot()
+            statshist.signatures_snapshot()    # triggers load + seed
+            ent = perfscope.snapshot()["unit.statshist"]
+            assert ent["seconds"] == pytest.approx(0.5)
+    finally:
+        perfscope.reset_state()
+
+
+# ---------------------------------------------------------------------------
+# the terminal entry points carry the signature
+# ---------------------------------------------------------------------------
+
+def test_query_record_to_dict_carries_signature():
+    doc = _rec().to_dict()
+    assert doc["signature"] == "sigA"
+
+
+def test_session_terminal_folds_into_store(tmp_path):
+    """A real (non-adaptive) session run with the store armed lands one
+    signed run record — the signature gate widens beyond adaptive."""
+    from auron_tpu.frontend.session import AuronSession
+    from tests.test_durable_shuffle import _agg_query, _rows
+    with _armed(tmp_path), conf.scoped(
+            {"auron.spmd.singleDevice.enable": False}):
+        AuronSession().execute(_agg_query(_rows(40)))
+        snap = statshist.signatures_snapshot()
+        assert len(snap) == 1
+        (sig, ent), = snap.items()
+        assert ent["runs"] == 1 and len(sig) == 16
+
+
+# ---------------------------------------------------------------------------
+# HTTP + Prometheus surfaces
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_http_signatures_and_regressions_endpoints(tmp_path):
+    from auron_tpu.runtime import profiling
+    with _armed(tmp_path), conf.scoped(
+            {"auron.stats.regression.min.runs": 2}):
+        for i in range(2):
+            statshist.on_record(_rec(qid=f"q-{i}"))
+        statshist.on_record(_rec(qid="q-slow", wall=9.0))
+        srv = profiling.ProfilingServer().start()
+        try:
+            code, body = _get(srv.url + "/signatures?format=json")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["sigA"]["runs"] == 3
+            code, body = _get(srv.url + "/signatures")
+            assert code == 200 and b"sigA" in body
+            code, body = _get(srv.url + "/signatures/sigA?format=json")
+            assert code == 200
+            assert json.loads(body)["has_baseline_trees"] is True
+            code, _ = _get(srv.url + "/signatures/zzz")
+            assert code == 404
+            code, body = _get(srv.url + "/regressions?format=json")
+            assert code == 200
+            regs = json.loads(body)["regressions"]
+            assert len(regs) == 1 and regs[0]["query_id"] == "q-slow"
+            code, body = _get(srv.url + "/regressions")
+            assert code == 200 and b"q-slow" in body
+        finally:
+            srv.stop()
+
+
+def test_queries_diff_baseline_mode(tmp_path):
+    from auron_tpu.runtime import profiling
+    with _armed(tmp_path):
+        srv = profiling.ProfilingServer().start()
+        try:
+            # no stored history yet: 404 with the arming hint
+            code, body = _get(srv.url + "/queries/diff?baseline=sigA")
+            assert code == 404
+            assert b"auron.stats.store.dir" in body
+            statshist.on_record(_rec(qid="q-base"))
+            rec = _rec(qid="q-new", rows=20)
+            tracing.record_query(rec)
+            code, body = _get(
+                srv.url + "/queries/diff?baseline=sigA&format=json")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["a"]["query_id"] == "q-new"
+            assert doc["baseline_signature"] == "sigA"
+            assert doc["diff"]
+            # explicit a=<id> and the html rendering
+            code, body = _get(
+                srv.url + "/queries/diff?a=q-new&baseline=sigA")
+            assert code == 200 and b"baseline" in body
+            code, _ = _get(
+                srv.url + "/queries/diff?a=zzz&baseline=sigA")
+            assert code == 404
+        finally:
+            srv.stop()
+
+
+def test_prometheus_store_gauges_and_regression_series(tmp_path):
+    from auron_tpu.runtime.profiling import _prometheus_text
+    with _armed(tmp_path), conf.scoped(
+            {"auron.stats.regression.min.runs": 2}):
+        for i in range(2):
+            statshist.on_record(_rec(qid=f"q-{i}"))
+        statshist.on_record(_rec(qid="q-slow", wall=9.0))
+        text = _prometheus_text()
+        assert "auron_stats_store_signatures 1" in text
+        assert "auron_stats_store_bytes " in text
+        assert 'auron_query_regressions_total{kind="wall_s"}' in text
+    # counters.snapshot carries the store totals in one namespace
+    snap = counters.snapshot()
+    assert "stats_store_signatures" in snap
+
+
+# ---------------------------------------------------------------------------
+# the CI gate script (cross-restart proof + regression injection + A/B)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow   # PR 19: ~3min — the full stats_check.sh gate
+def test_tools_stats_check_script():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [os.path.join(repo, "tools", "stats_check.sh")],
+        cwd=repo, capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "stats_check.sh: ok" in proc.stdout
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

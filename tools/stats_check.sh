@@ -1,0 +1,232 @@
+#!/usr/bin/env bash
+# CI durable-statistics gate (CPU, no accelerator needed) — the PR 19
+# member of the tools/*_check.sh family, and the cross-restart proof:
+#
+#   1. the statshist unit suite must pass (fold/EMA/regression units,
+#      torn-tail tolerance, concurrent appenders, compaction bounds,
+#      the /signatures + /regressions + baseline-diff surfaces);
+#   2. process A runs q01 three times with `auron.stats.store.dir`
+#      armed, then is killed -9 (appends are per-terminal: a crash
+#      must lose nothing already folded);
+#   3. a FRESH process B over the same store must — BEFORE its first
+#      run — show a store-seeded forecast for q01's signature on
+#      /scheduler (provenance "store") and a non-empty CostModel
+#      per-exchange history (the learned-initial-plan feed);
+#   4. process B then submits ONE fault-slowed q01: it must produce
+#      exactly one `query.regression` flight-recorder event naming the
+#      regressed dimensions, a row on /regressions, and the
+#      auron_query_regressions_total series on /metrics;
+#   5. the OFF-default claim: an interleaved warm q01 serial A/B with
+#      the store unarmed vs armed stays bit-identical and the armed
+#      overhead stays under AURON_STATS_MAX_OVERHEAD (default 2%).
+#
+# The same check runs inside the suite (tests/test_statshist.py::
+# test_tools_stats_check_script, marked slow), mirroring how
+# perf_check.sh / obs_check.sh are wired.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source tools/prom_assert.sh
+
+SF=${AURON_STATS_CHECK_SF:-0.002}
+MAX_OVERHEAD=${AURON_STATS_MAX_OVERHEAD:-0.02}
+PROM_OUT="$(mktemp)"
+STORE_DIR="$(mktemp -d)"
+DATA_DIR="$(mktemp -d)"
+export PROM_OUT STORE_DIR DATA_DIR SF MAX_OVERHEAD
+trap 'rm -f "$PROM_OUT"; rm -rf "$STORE_DIR" "$DATA_DIR"' EXIT
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+    python -m pytest tests/test_statshist.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:randomly
+
+# ---- process A: arm the store, run q01 x3, signal, get killed -9 ----
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF' &
+import os
+import time
+
+from auron_tpu.config import conf
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.it import datagen, queries
+from auron_tpu.it.oracle import PyArrowEngine
+
+sf = float(os.environ["SF"])
+catalog = datagen.generate(os.environ["DATA_DIR"], sf=sf)
+plan = queries.build("q01", catalog)
+with conf.scoped({"auron.spmd.singleDevice.enable": False}):
+    # warm-up with the store DISARMED: first-run compiles must not
+    # poison the EMA baseline the regression half of the gate rides
+    AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
+    conf.set("auron.stats.store.dir", os.environ["STORE_DIR"])
+    for i in range(3):
+        res = AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
+        print(f"stats_check[A]: q01 run {i + 1}/3 "
+              f"({res.wall_s * 1e3:.0f}ms, {res.table.num_rows} rows)",
+              flush=True)
+# every terminal already appended durably — nothing to flush. Signal
+# readiness and idle: the parent kill -9s this process (the crash half
+# of the crash-safety claim).
+open(os.path.join(os.environ["STORE_DIR"], "A_READY"), "w").close()
+time.sleep(600)
+EOF
+A_PID=$!
+
+for _ in $(seq 1 600); do
+    [ -f "$STORE_DIR/A_READY" ] && break
+    if ! kill -0 "$A_PID" 2>/dev/null; then
+        echo "stats_check: process A died before folding q01" >&2
+        wait "$A_PID" || true
+        exit 1
+    fi
+    sleep 0.5
+done
+[ -f "$STORE_DIR/A_READY" ] || {
+    echo "stats_check: process A never signalled readiness" >&2; exit 1; }
+kill -9 "$A_PID" 2>/dev/null || true
+wait "$A_PID" 2>/dev/null || true
+echo "stats_check: process A killed -9 after 3 armed q01 runs"
+
+# ---- process B: fresh process, same store — seed proof + regression ----
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import os
+import urllib.request
+
+from auron_tpu.config import conf
+from auron_tpu.it import datagen, queries
+from auron_tpu.runtime import adaptive
+from auron_tpu.serving import QueryServer, register_catalog
+from auron_tpu.serving.forecast import plan_signature
+
+sf = float(os.environ["SF"])
+conf.set("auron.stats.store.dir", os.environ["STORE_DIR"])
+# the injected slowdown below is ~1.5-2x, not the default 2x factor
+conf.set("auron.stats.regression.factor", 1.25)
+catalog = datagen.generate(os.environ["DATA_DIR"], sf=sf)  # manifest reuse
+register_catalog(sf, catalog)
+sig = plan_signature(queries.build("q01", catalog))
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=300) as r:
+        return r.read()
+
+
+def post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.load(r)
+
+
+srv = QueryServer().start()
+try:
+    # BEFORE the first run: the admission forecast for q01's signature
+    # must already exist, marked as store-seeded
+    sched = json.loads(get(srv.url + "/scheduler"))
+    fc = sched["admission"]["forecasts"]
+    assert sig in fc, (sig, sorted(fc))
+    assert fc[sig]["provenance"] == "store", fc[sig]
+    assert fc[sig]["max_peak"] > 0, fc[sig]
+    # ... and the CostModel's per-exchange history is non-empty (the
+    # learned-initial-plan feed) before stage 1 ever runs here
+    hist = adaptive.unified_cost_model().snapshot()
+    seeded = {k: v for k, v in hist.items() if k.startswith(sig + ":")}
+    assert seeded, (sig, hist)
+    print(f"stats_check[B]: fresh process store-seeded BEFORE first "
+          f"run — forecast {fc[sig]['max_peak']}B (provenance store), "
+          f"{len(seeded)} exchange histogram(s) for {sig}")
+
+    # ONE deliberately slowed q01 (per-query fault overlay): must land
+    # exactly one query.regression event naming the dimensions
+    doc = post(srv.url + "/submit",
+               {"corpus": "q01", "sf": sf,
+                "conf": {"auron.spmd.singleDevice.enable": False,
+                         "auron.faults.spec":
+                             "op.execute:latency:p=1.0,ms=150,max=200",
+                         "auron.task.retries": 2}})
+    qid = doc["query_id"]
+    assert srv.scheduler.wait(qid, timeout=600)
+    st = json.loads(get(srv.url + f"/status/{qid}"))
+    assert st["state"] == "succeeded", st
+
+    evs = json.loads(get(srv.url + "/events"))["events"]
+    regs = [e for e in evs if e["kind"] == "query.regression"]
+    assert len(regs) == 1, regs
+    assert regs[0]["query_ids"] == [qid], regs[0]
+    dims = regs[0]["attrs"]["dims"]
+    assert "wall_s" in dims, regs[0]
+    rows = json.loads(get(srv.url + "/regressions?format=json"))
+    rows = rows["regressions"]
+    assert len(rows) == 1 and rows[0]["query_id"] == qid, rows
+    sigdoc = json.loads(get(srv.url + f"/signatures/{sig}?format=json"))
+    assert sigdoc["regressions"] == 1, sigdoc
+    print(f"stats_check[B]: slowed q01 ({qid}) raised exactly one "
+          f"query.regression ({', '.join(dims)}) — on /events, "
+          f"/regressions and /signatures/{sig}")
+
+    with open(os.environ["PROM_OUT"], "w") as f:
+        f.write(get(srv.url + "/metrics").decode())
+finally:
+    srv.stop()
+EOF
+
+prom_assert_contains "$PROM_OUT" \
+  'auron_query_regressions_total{kind="wall_s"}' \
+  "auron_stats_store_bytes"
+prom_assert_ge "$PROM_OUT" auron_stats_store_signatures 1
+
+# ---- OFF-default bit-identity + <2% armed overhead (interleaved) ----
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import os
+import sys
+import tempfile
+import time
+
+from auron_tpu.config import conf
+from auron_tpu.frontend.session import AuronSession
+from auron_tpu.it import datagen, queries
+from auron_tpu.it.oracle import PyArrowEngine
+
+sf = float(os.environ["SF"])
+max_overhead = float(os.environ["MAX_OVERHEAD"])
+catalog = datagen.generate(os.environ["DATA_DIR"], sf=sf)
+plan = queries.build("q01", catalog)
+armed = {"auron.spmd.singleDevice.enable": False,
+         "auron.stats.store.dir": tempfile.mkdtemp(prefix="auron-ab-")}
+off = {"auron.spmd.singleDevice.enable": False}
+
+
+def run(scope):
+    with conf.scoped(scope):
+        return AuronSession(foreign_engine=PyArrowEngine()).execute(plan)
+
+
+# warm BOTH paths first so compiles never land in a measured rep
+base = run(off)
+a0 = run(armed)
+if not base.table.equals(a0.table):
+    print("stats ab: armed run is NOT bit-identical to unarmed",
+          file=sys.stderr)
+    sys.exit(1)
+t_off, t_on = [], []
+for _ in range(5):
+    t0 = time.perf_counter()
+    run(off)
+    t_off.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    run(armed)
+    t_on.append(time.perf_counter() - t0)
+med_off = sorted(t_off)[len(t_off) // 2]
+med_on = sorted(t_on)[len(t_on) // 2]
+ratio = med_on / med_off if med_off > 0 else 1.0
+print(f"stats ab: q01 x5 interleaved warm — unarmed "
+      f"{med_off * 1e3:.1f}ms, armed {med_on * 1e3:.1f}ms, overhead "
+      f"ratio {ratio:.4f} (results identical)")
+if ratio > 1.0 + max_overhead:
+    print(f"stats ab: armed overhead {ratio - 1.0:.2%} exceeds "
+          f"{max_overhead:.0%}", file=sys.stderr)
+    sys.exit(1)
+EOF
+
+echo "stats_check.sh: ok"
